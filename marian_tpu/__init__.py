@@ -1,0 +1,10 @@
+"""marian-tpu: a TPU-native neural machine translation framework with the
+capabilities of Marian NMT (reference: tneck/marian-nmt-distributed), built
+idiomatically on JAX/XLA (jit, shard_map over device meshes, Pallas kernels)
+rather than as a port of the reference's C++/CUDA per-node kernel dispatch.
+
+See SURVEY.md at the repo root for the structural map of the reference this
+framework mirrors, layer by layer.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,406 @@
+"""Marian-compatible configuration surface: YAML config files + CLI overrides.
+
+TPU-native rebuild of reference src/common/config_parser.cpp ::
+ConfigParser::parseOptions and src/common/cli_wrapper.cpp. Flag NAMES and
+semantics follow Marian so existing Marian command lines / config.yml files run
+unmodified (north-star requirement); the implementation is plain argparse+yaml.
+
+Precedence (same as Marian): defaults < config file(s) < CLI flags.
+``--dump-config [minimal|expand]`` prints the effective config and exits.
+Aliases (``--task transformer-big``) expand to canonical hyperparameter sets
+(reference: src/common/aliases.cpp) before user overrides are applied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+from .options import Options
+from .aliases import ALIASES, expand_aliases
+
+# ---------------------------------------------------------------------------
+# Flag table. Each entry: (name, type, default, help, group)
+# type: bool flags are implicit-true switches with optional value, like CLI11.
+# A default of None means "unset" (Options.has() is False) unless the mode
+# defaults below fill it in.
+# ---------------------------------------------------------------------------
+
+F = dataclasses.make_dataclass("F", ["name", "type", "default", "help", "group", "nargs"])
+
+
+def _f(name, type_, default, help_, group, nargs=None):
+    return F(name, type_, default, help_, group, nargs)
+
+
+_COMMON = [
+    _f("config", str, None, "Paths to YAML config file(s); later files override earlier", "general", "+"),
+    _f("workspace", int, -1, "Device workspace hint in MB (XLA manages memory; kept for CLI compat)", "general"),
+    _f("log", str, None, "Log to file in addition to stderr", "general"),
+    _f("log-level", str, "info", "trace/debug/info/warn/error/critical/off", "general"),
+    _f("log-time-zone", str, "", "Time zone for log timestamps", "general"),
+    _f("quiet", bool, False, "Suppress all logging to stderr", "general"),
+    _f("quiet-translation", bool, False, "Suppress logging for translation", "general"),
+    _f("seed", int, 0, "RNG seed; 0 means use wall-clock", "general"),
+    _f("check-nan", bool, False, "Check gradients for NaN/inf (jax_debug_nans)", "general"),
+    _f("interpolate-env-vars", bool, False, "Interpolate ${ENV_VAR} in config/paths", "general"),
+    _f("relative-paths", bool, False, "Paths in configs are relative to the config file", "general"),
+    _f("dump-config", str, None, "Dump effective config and exit: full/minimal/expand", "general"),
+    _f("sigterm", str, "save-and-exit", "SIGTERM behavior: save-and-exit or exit-immediately", "general"),
+    _f("authors", bool, False, "Print list of authors and exit", "general"),
+    _f("cite", bool, False, "Print citation and exit", "general"),
+    _f("build-info", str, None, "Print build info and exit", "general"),
+    _f("version", bool, False, "Print version and exit", "general"),
+]
+
+_MODEL = [
+    _f("model", str, "model.npz", "Path prefix for model to be saved/resumed", "model"),
+    _f("pretrained-model", str, None, "Initialize weights from this model", "model"),
+    _f("ignore-model-config", bool, False, "Ignore the config embedded in the model file", "model"),
+    _f("type", str, "amun", "Model type: transformer, s2s, nematus, amun, multi-s2s, multi-transformer, bert, bert-classifier, transformer-lm", "model"),
+    _f("dim-vocabs", int, [0, 0], "Maximum vocabulary sizes (0 = from vocab file)", "model", "+"),
+    _f("dim-emb", int, 512, "Embedding vector size", "model"),
+    _f("factors-dim-emb", int, 0, "Embedding size of factors (0 = sum combine)", "model"),
+    _f("factors-combine", str, "sum", "How to combine factor embeddings: sum or concat", "model"),
+    _f("lemma-dim-emb", int, 0, "Re-embedding dimension of lemma in factors", "model"),
+    _f("dim-rnn", int, 1024, "RNN state size", "model"),
+    _f("enc-type", str, "bidirectional", "Encoder type: bidirectional, bi-unidirectional, alternating", "model"),
+    _f("enc-cell", str, "gru", "Encoder cell: gru, lstm, ssru, gru-nematus", "model"),
+    _f("enc-cell-depth", int, 1, "Cells per encoder transition (deep transition)", "model"),
+    _f("enc-depth", int, 1, "Encoder layers", "model"),
+    _f("dec-cell", str, "gru", "Decoder cell: gru, lstm, ssru, gru-nematus", "model"),
+    _f("dec-cell-base-depth", int, 2, "Cells in first decoder transition (incl. attention cell)", "model"),
+    _f("dec-cell-high-depth", int, 1, "Cells in higher decoder transitions", "model"),
+    _f("dec-depth", int, 1, "Decoder layers", "model"),
+    _f("skip", bool, False, "Residual/skip connections in RNN layers", "model"),
+    _f("layer-normalization", bool, False, "Layer normalization in RNN cells", "model"),
+    _f("right-left", bool, False, "Train right-to-left model", "model"),
+    _f("input-types", str, [], "Input types per stream: sequence, class, alignment, weight", "model", "*"),
+    _f("tied-embeddings", bool, False, "Tie target embeddings and output layer", "model"),
+    _f("tied-embeddings-src", bool, False, "Tie source and target embeddings", "model"),
+    _f("tied-embeddings-all", bool, False, "Tie all embeddings and output layer", "model"),
+    # transformer
+    _f("transformer-heads", int, 8, "Number of attention heads", "model"),
+    _f("transformer-dim-ffn", int, 2048, "FFN hidden size", "model"),
+    _f("transformer-decoder-dim-ffn", int, 0, "Decoder FFN hidden size (0 = transformer-dim-ffn)", "model"),
+    _f("transformer-ffn-depth", int, 2, "FFN depth (number of linear layers)", "model"),
+    _f("transformer-decoder-ffn-depth", int, 0, "Decoder FFN depth (0 = transformer-ffn-depth)", "model"),
+    _f("transformer-ffn-activation", str, "swish", "relu, swish, gelu", "model"),
+    _f("transformer-no-projection", bool, False, "Omit output projection in MHA", "model"),
+    _f("transformer-pool", bool, False, "Pooler instead of self-attention (experimental)", "model"),
+    _f("transformer-dim-aan", int, 2048, "AAN FFN hidden size", "model"),
+    _f("transformer-decoder-autoreg", str, "self-attention", "self-attention, average-attention, rnn", "model"),
+    _f("transformer-tied-layers", int, [], "Tie decoder layers to these encoder layers", "model", "*"),
+    _f("transformer-guided-alignment-layer", str, "last", "Decoder layer for guided alignment", "model"),
+    _f("transformer-preprocess", str, "", "Per-sublayer preprocess ops: d=dropout, a=add(residual), n=layernorm", "model"),
+    _f("transformer-postprocess", str, "dan", "Per-sublayer postprocess ops", "model"),
+    _f("transformer-postprocess-emb", str, "d", "Embedding postprocess ops", "model"),
+    _f("transformer-postprocess-top", str, "", "Final decoder-top postprocess ops", "model"),
+    _f("transformer-train-position-embeddings", bool, False, "Learned positional embeddings", "model"),
+    _f("transformer-depth-scaling", bool, False, "Depth-scaled parameter initialization", "model"),
+    _f("transformer-rnn-projection", bool, False, "Projection after decoder RNN (autoreg=rnn)", "model"),
+    _f("max-length", int, 50, "Maximum sentence length (training crop/skip; decode cap)", "model"),
+    _f("max-length-crop", bool, False, "Crop instead of skipping over-long sentences", "model"),
+    _f("bert-mask-symbol", str, "[MASK]", "BERT masking symbol", "model"),
+    _f("bert-sep-symbol", str, "[SEP]", "BERT separator symbol", "model"),
+    _f("bert-class-symbol", str, "[CLS]", "BERT class symbol", "model"),
+    _f("bert-masking-fraction", float, 0.15, "BERT masking fraction", "model"),
+    _f("bert-train-type-embeddings", bool, True, "Train sentence-type embeddings", "model"),
+    _f("bert-type-vocab-size", int, 2, "Type vocab size", "model"),
+    # precision
+    _f("precision", str, ["float32", "float32"], "Training precisions: compute, optimizer accumulation (float16 is mapped to bfloat16 on TPU)", "model", "+"),
+    _f("cost-scaling", str, [], "Dynamic loss scaling (mostly unneeded in bf16; kept for parity)", "model", "*"),
+    _f("gradient-checkpointing", bool, False, "Rematerialization (jax.checkpoint) to save memory", "model"),
+    # tpu-specific (new, no Marian equivalent)
+    _f("attention-kernel", str, "auto", "Attention impl: auto, dense, flash (Pallas)", "model"),
+    _f("scan-layers", bool, False, "lax.scan over layer stack (faster compile, needs uniform layers)", "model"),
+]
+
+_TRAINING = [
+    _f("task", str, None, "Shortcut for a predefined hyperparameter bundle: transformer-base, transformer-big, transformer-base-prenorm, transformer-big-prenorm", "training", "?"),
+    _f("cost-type", str, "ce-sum", "ce-mean, ce-mean-words, ce-sum, perplexity", "training"),
+    _f("multi-loss-type", str, "sum", "sum, scaled, mean", "training"),
+    _f("unlikelihood-loss", bool, False, "Use word-level weights as indicators for unlikelihood loss", "training"),
+    _f("overwrite", bool, False, "Do not create checkpoints per save, overwrite model file", "training"),
+    _f("no-reload", bool, False, "Do not load existing model file before training", "training"),
+    _f("train-sets", str, [], "Paths to training corpora (source target ...)", "training", "*"),
+    _f("vocabs", str, [], "Paths to vocabulary files; created if missing", "training", "*"),
+    _f("sentencepiece-alphas", float, [], "Subword-regularization sampling alphas per stream", "training", "*"),
+    _f("sentencepiece-options", str, "", "Options passed to on-the-fly SentencePiece training", "training"),
+    _f("sentencepiece-max-lines", int, 2000000, "Max lines for SentencePiece vocab training", "training"),
+    _f("after-epochs", int, 0, "Stop after this many epochs (0 = no limit); same as --after Ne", "training"),
+    _f("after-batches", int, 0, "Stop after this many updates (0 = no limit)", "training"),
+    _f("after", str, "0e", "Stop after: e.g. 10e (epochs), 100Ku (updates), 1Gt (labels)", "training"),
+    _f("disp-freq", str, "1000u", "Display information every N updates/epochs/labels", "training"),
+    _f("disp-first", int, 0, "Display information for the first N updates", "training"),
+    _f("disp-label-counts", bool, True, "Display label counts in progress", "training"),
+    _f("save-freq", str, "10000u", "Save model every N", "training"),
+    _f("logical-epoch", str, ["1e"], "Logical epoch spec, e.g. 1Gt", "training", "+"),
+    _f("max-length-factor", float, 3.0, "Max target length factor of source length while decoding", "training"),
+    _f("shuffle", str, "data", "data, batches, none", "training"),
+    _f("no-shuffle", bool, False, "Disable shuffling (= --shuffle none)", "training"),
+    _f("no-restore-corpus", bool, False, "Do not restore corpus position on resume", "training"),
+    _f("tempdir", str, "/tmp", "Temporary directory for shuffling", "training"),
+    _f("sqlite", str, None, "Keep corpus in an on-disk database for O(1) mid-epoch resume", "training", "?"),
+    _f("mini-batch", int, 64, "Minibatch size (sentences)", "training"),
+    _f("mini-batch-words", int, 0, "Minibatch size in target labels (token budget)", "training"),
+    _f("mini-batch-fit", bool, False, "Determine minibatch automatically from workspace (TPU: bucket table)", "training"),
+    _f("mini-batch-fit-step", int, 10, "Step for mini-batch-fit search", "training"),
+    _f("gradient-checkpointing-unused", bool, False, "(reserved)", "training"),
+    _f("maxi-batch", int, 100, "Number of minibatches to preload and sort", "training"),
+    _f("maxi-batch-sort", str, "trg", "Sorting within maxi-batch: trg, src, none", "training"),
+    _f("shuffle-in-ram", bool, False, "Shuffle corpus in RAM instead of temp files", "training"),
+    _f("data-threads", int, 8, "Host threads for data pipeline", "training"),
+    _f("all-caps-every", int, 0, "Upper-case every Nth batch (data augmentation)", "training"),
+    _f("english-title-case-every", int, 0, "Title-case every Nth batch", "training"),
+    _f("mini-batch-words-ref", int, 0, "Reference batch size in words for LR auto-adjustment", "training"),
+    _f("mini-batch-warmup", str, "0", "Linear batch-size warmup period", "training"),
+    _f("mini-batch-track-lr", bool, False, "Adjust LR for tracked batch-size ramp", "training"),
+    _f("mini-batch-round-up", bool, True, "Round up batch size for warmup", "training"),
+    _f("optimizer", str, "adam", "adam, adagrad, sgd", "training"),
+    _f("optimizer-params", float, [], "Optimizer hyperparameters (Adam: beta1 beta2 eps)", "training", "*"),
+    _f("optimizer-delay", float, 1.0, "SGD update delay (gradient accumulation): N updates or fractional", "training"),
+    _f("sync-sgd", bool, False, "Synchronous SGD (the only mode on TPU; async maps to it with a warning)", "training"),
+    _f("learn-rate", float, 0.0001, "Learning rate", "training"),
+    _f("lr-report", bool, False, "Report learning rate in progress lines", "training"),
+    _f("lr-decay", float, 0.0, "Decay factor: lr = lr * decay", "training"),
+    _f("lr-decay-strategy", str, "epoch+stalled", "epoch, batches, stalled, epoch+batches, epoch+stalled", "training"),
+    _f("lr-decay-start", int, [10, 1], "Decay start: [epoch, batches/stalled]", "training", "+"),
+    _f("lr-decay-freq", int, 50000, "Decay frequency (strategy: batches)", "training"),
+    _f("lr-decay-reset-optimizer", bool, False, "Reset optimizer state at LR decay", "training"),
+    _f("lr-decay-repeat-warmup", bool, False, "Repeat warmup after decay", "training"),
+    _f("lr-decay-inv-sqrt", str, ["0"], "Inverse-sqrt decay with this warmup, e.g. 16000u", "training", "+"),
+    _f("lr-warmup", str, "0", "Linear LR warmup period", "training"),
+    _f("lr-warmup-start-rate", float, 0.0, "Warmup start LR", "training"),
+    _f("lr-warmup-cycle", bool, False, "Cyclic warmup", "training"),
+    _f("lr-warmup-at-reload", bool, False, "Repeat warmup after checkpoint reload", "training"),
+    _f("label-smoothing", float, 0.0, "Label smoothing epsilon", "training"),
+    _f("factor-weight", float, 1.0, "Weight for loss of factors vs lemma", "training"),
+    _f("clip-norm", float, 1.0, "Global gradient-norm clipping (0 = off)", "training"),
+    _f("exponential-smoothing", float, 0.0, "EMA decay of parameters, e.g. 1e-4 (0 = off)", "training", "?"),
+    _f("guided-alignment", str, "none", "Path to alignments or 'none'", "training"),
+    _f("guided-alignment-cost", str, "ce", "ce, mse, mult", "training"),
+    _f("guided-alignment-weight", float, 0.1, "Weight for guided-alignment cost", "training"),
+    _f("data-weighting", str, None, "Path to per-sentence/word weight file", "training"),
+    _f("data-weighting-type", str, "sentence", "sentence or word", "training"),
+    _f("embedding-vectors", str, [], "Paths to pretrained embedding vectors", "training", "*"),
+    _f("embedding-normalization", bool, False, "Normalize pretrained embedding vectors", "training"),
+    _f("embedding-fix-src", bool, False, "Fix source embeddings", "training"),
+    _f("embedding-fix-trg", bool, False, "Fix target embeddings", "training"),
+    _f("quantize-bits", int, 0, "Train-time model quantization bits (0 = off)", "training"),
+    _f("quantize-optimization-steps", int, 0, "Scale-optimization steps for quantization", "training"),
+    _f("quantize-log-based", bool, False, "Log-based quantization", "training"),
+    _f("quantize-biases", bool, False, "Quantize biases too", "training"),
+    _f("ulr", bool, False, "Universal language representation", "training"),
+    _f("ulr-query-vectors", str, "", "Path to ULR query vectors", "training"),
+    _f("ulr-keys-vectors", str, "", "Path to ULR key vectors", "training"),
+    _f("ulr-trainable-transformation", bool, False, "Trainable ULR transformation", "training"),
+    _f("ulr-dim-emb", int, 0, "ULR embedding dim", "training"),
+    _f("ulr-dropout", float, 0.0, "ULR dropout", "training"),
+    _f("ulr-softmax-temperature", float, 1.0, "ULR softmax temperature", "training"),
+    # dropout group
+    _f("dropout-rnn", float, 0.0, "RNN state dropout", "training"),
+    _f("dropout-src", float, 0.0, "Source word dropout", "training"),
+    _f("dropout-trg", float, 0.0, "Target word dropout", "training"),
+    _f("transformer-dropout", float, 0.0, "Dropout between transformer layers", "training"),
+    _f("transformer-dropout-attention", float, 0.0, "Attention-weight dropout", "training"),
+    _f("transformer-dropout-ffn", float, 0.0, "FFN dropout", "training"),
+    # devices
+    _f("devices", str, ["0"], "Device ids (GPU compat) or tpu:N..M mesh spec", "training", "+"),
+    _f("num-devices", int, 0, "Number of devices (0 = all visible)", "training"),
+    _f("no-nccl", bool, False, "(GPU compat; ignored — ICI collectives are always used)", "training"),
+    _f("sharding", str, "global", "Optimizer sharding domain: global (ZeRO-1 over all devices) or local", "training"),
+    _f("sync-freq", str, "200u", "Param sync frequency for local sharding", "training"),
+    _f("cpu-threads", int, 0, "Use CPU with this many threads (inference)", "training", "?"),
+    # multi-node
+    _f("multi-node", bool, False, "Multi-host training (jax.distributed)", "training"),
+    _f("multi-node-overlap", bool, True, "(compat; XLA overlaps automatically)", "training"),
+    _f("coordinator-address", str, None, "jax.distributed coordinator ip:port", "training"),
+    _f("num-processes", int, 1, "Number of hosts (jax.distributed)", "training"),
+    _f("process-id", int, 0, "This host's rank", "training"),
+    # mesh axes (TPU-native extension; absent in reference)
+    _f("mesh", str, [], "Mesh axes as name:size pairs, e.g. data:8 model:4 seq:2 (default: all devices on data)", "training", "*"),
+]
+
+_VALIDATION = [
+    _f("valid-sets", str, [], "Paths to validation corpora", "valid", "*"),
+    _f("valid-freq", str, "10000u", "Validate every N", "valid"),
+    _f("valid-metrics", str, ["cross-entropy"], "cross-entropy, ce-mean-words, perplexity, bleu, bleu-detok, bleu-segmented, chrf, valid-script, translation", "valid", "+"),
+    _f("valid-reset-stalled", bool, False, "Reset stalled counts on training restart", "valid"),
+    _f("valid-reset-all", bool, False, "Reset all validation state on restart", "valid"),
+    _f("early-stopping", int, 10, "Stop after N consecutive non-improving validations", "valid"),
+    _f("early-stopping-epsilon", float, [0.0], "Minimum required improvement per metric", "valid", "+"),
+    _f("early-stopping-on", str, "first", "first, all, any of valid-metrics", "valid"),
+    _f("keep-best", bool, False, "Keep best model per metric", "valid"),
+    _f("valid-log", str, None, "Validation log file", "valid"),
+    _f("valid-max-length", int, 1000, "Max length for validation sentences", "valid"),
+    _f("valid-mini-batch", int, 32, "Validation minibatch size", "valid"),
+    _f("valid-script-path", str, None, "External validation script", "valid"),
+    _f("valid-script-args", str, [], "Args for external validation script", "valid", "*"),
+    _f("valid-translation-output", str, None, "Print validation translations to file", "valid"),
+]
+
+_TRANSLATION = [
+    _f("input", str, ["stdin"], "Input file(s) or stdin", "translate", "+"),
+    _f("output", str, "stdout", "Output file or stdout", "translate"),
+    _f("models", str, [], "Model file(s) to ensemble", "translate", "*"),
+    _f("weights", float, [], "Ensemble scorer weights", "translate", "*"),
+    _f("beam-size", int, 12, "Beam size", "translate"),
+    _f("normalize", float, 0.0, "Divide score by length^alpha", "translate", "?"),
+    _f("word-penalty", float, 0.0, "Subtract penalty*length from score", "translate"),
+    _f("allow-unk", bool, False, "Allow <unk> in output", "translate"),
+    _f("allow-special", bool, False, "Allow special symbols in output", "translate"),
+    _f("n-best", bool, False, "Produce n-best lists", "translate"),
+    _f("alignment", str, None, "Return word alignments: 0.x threshold, soft, hard", "translate", "?"),
+    _f("force-decode", bool, False, "Force-decode given prefixes", "translate"),
+    _f("best-deep", bool, False, "(compat)", "translate"),
+    _f("output-sampling", str, [], "Sampling instead of argmax: full [temp] / topk k [temp]", "translate", "*"),
+    _f("output-approx-knn", int, [], "LSH-approximated output layer: nodes, hashes", "translate", "*"),
+    _f("max-length-factor-translate", float, 3.0, "(see max-length-factor)", "translate"),
+    _f("skip-cost", bool, False, "Skip costly final scoring", "translate"),
+    _f("shortlist", str, [], "Lexical shortlist: path [first] [best] [prune]", "translate", "*"),
+    _f("port", int, 8080, "marian-server port", "translate"),
+    _f("fuse", bool, False, "(compat; XLA always fuses)", "translate"),
+    _f("gemm-type", str, "float32", "float32, bfloat16, int8 (TPU AQT path), intgemm8/packed* map to int8", "translate"),
+    _f("quantize-range", float, 0.0, "Quantization clip range in stddevs (0 = absmax)", "translate"),
+    _f("mini-batch-words-translate", int, 0, "(see mini-batch-words)", "translate"),
+]
+
+_SCORER = [
+    _f("train-sets-scorer", str, [], "(scorer) corpora to score", "scorer", "*"),
+    _f("n-best-feature", str, "Score", "Feature name for n-best rescoring", "scorer"),
+    _f("summary", str, None, "Summary score: cross-entropy, ce-mean-words, perplexity", "scorer", "?"),
+    _f("normalize-scorer", float, 0.0, "(see normalize)", "scorer"),
+]
+
+
+MODE_FLAGS: Dict[str, List[Any]] = {
+    "training": _COMMON + _MODEL + _TRAINING + _VALIDATION,
+    "translation": _COMMON + _MODEL + _TRANSLATION,
+    "scoring": _COMMON + _MODEL + _TRAINING + _SCORER + _TRANSLATION,
+    "embedding": _COMMON + _MODEL + _TRANSLATION,
+    "vocab": _COMMON,
+    "server": _COMMON + _MODEL + _TRANSLATION,
+}
+
+
+def _flag_table(mode: str) -> Dict[str, Any]:
+    seen: Dict[str, Any] = {}
+    for f in MODE_FLAGS[mode]:
+        if f.name not in seen:
+            seen[f.name] = f
+    return seen
+
+
+class ConfigParser:
+    """parseOptions equivalent. Returns a fully-populated Options."""
+
+    def __init__(self, mode: str = "training"):
+        if mode not in MODE_FLAGS:
+            raise ValueError(f"Unknown mode '{mode}'")
+        self.mode = mode
+        self.flags = _flag_table(mode)
+
+    def _build_argparser(self) -> argparse.ArgumentParser:
+        p = argparse.ArgumentParser(
+            prog=f"marian-tpu ({self.mode})", add_help=True, allow_abbrev=False
+        )
+        for f in self.flags.values():
+            arg = f"--{f.name}"
+            kwargs: Dict[str, Any] = {"dest": f.name.replace("-", "_"), "default": None}
+            if f.type is bool:
+                # CLI11-style: bare flag = true, or explicit --flag true/false
+                kwargs.update(nargs="?", const=True, type=_parse_bool)
+            else:
+                kwargs["type"] = f.type
+                if f.nargs:
+                    kwargs["nargs"] = f.nargs
+                    if f.nargs == "?":
+                        kwargs["const"] = True if f.type is bool else ""
+            p.add_argument(arg, help=f.help, **kwargs)
+        return p
+
+    def defaults(self) -> Dict[str, Any]:
+        return {f.name: f.default for f in self.flags.values() if f.default is not None}
+
+    def parse(self, argv: Optional[Sequence[str]] = None) -> Options:
+        argv = list(sys.argv[1:] if argv is None else argv)
+        parser = self._build_argparser()
+        ns, unknown = parser.parse_known_args(argv)
+        if unknown:
+            raise SystemExit(f"Unknown option(s): {' '.join(unknown)}")
+        cli: Dict[str, Any] = {
+            k.replace("_", "-"): v for k, v in vars(ns).items() if v is not None
+        }
+
+        # layer 1: defaults
+        merged = self.defaults()
+
+        # layer 2: config file(s)
+        for path in _as_list(cli.get("config")):
+            with open(path, "r", encoding="utf-8") as fh:
+                loaded = yaml.safe_load(fh) or {}
+            for k, v in loaded.items():
+                merged[str(k)] = v
+
+        # layer 3: alias expansion (--task / from config), before CLI overrides
+        task = cli.get("task", merged.get("task"))
+        if task:
+            merged = expand_aliases(task, merged)
+            merged["task"] = task
+
+        # layer 4: CLI overrides
+        for k, v in cli.items():
+            if k == "config":
+                continue
+            merged[k] = v
+
+        if merged.get("no-shuffle"):
+            merged["shuffle"] = "none"
+
+        opts = Options(merged)
+
+        dump = cli.get("dump-config") or (True if "dump-config" in cli else None)
+        if dump:
+            self.dump(opts, mode=dump if isinstance(dump, str) else "full")
+            raise SystemExit(0)
+        return opts
+
+    def dump(self, opts: Options, mode: str = "full", stream=None) -> None:
+        """--dump-config: print effective config as YAML (reference:
+        config_parser.cpp dumpConfig)."""
+        stream = stream or sys.stdout
+        data = opts.as_dict()
+        if mode == "minimal":
+            defaults = self.defaults()
+            data = {k: v for k, v in data.items() if defaults.get(k) != v}
+        data.pop("dump-config", None)
+        yaml.safe_dump(data, stream, default_flow_style=False, sort_keys=True)
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def _as_list(v: Any) -> List[Any]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v]
+
+
+def parse_options(argv: Optional[Sequence[str]] = None, mode: str = "training",
+                  validate: bool = True) -> Options:
+    """Module-level convenience mirroring ConfigParser::parseOptions."""
+    opts = ConfigParser(mode).parse(argv)
+    if validate:
+        from .config_validator import validate_options
+        validate_options(opts, mode)
+    return opts

@@ -1,0 +1,176 @@
+"""Model IO: ``.npz`` checkpoints (Marian-compatible) and a fast mmap-able
+``.bin`` format.
+
+Rebuild of reference src/common/io.cpp :: io::loadItems/saveItems and
+src/common/binary.cpp. Conventions kept for checkpoint compatibility with
+upstream Marian models:
+
+- a checkpoint is a set of named tensors ("items");
+- the model config travels inside the checkpoint as a special int8 tensor
+  named ``special:model.yml`` holding the YAML text (NUL-terminated);
+- optimizer state is a sibling file ``<model>.optimizer.npz``;
+- training progress is a sibling YAML ``<model>.progress.yml``.
+
+The ``.bin`` format here is little-endian: magic ``MTPUBIN1``, u64 item count,
+then per item (u32 name_len, name bytes, u32 dtype_len, dtype str, u32 ndim,
+u64 dims..., u64 byte_len, padding to 64B, raw data). Data offsets are 64-byte
+aligned so tensors can be used directly from an mmap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io as _pyio
+import mmap
+import os
+import struct
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import yaml
+
+SPECIAL_CONFIG_KEY = "special:model.yml"
+_BIN_MAGIC = b"MTPUBIN1"
+_ALIGN = 64
+
+
+@dataclasses.dataclass
+class Item:
+    """One named tensor (reference: src/common/io/item.h :: io::Item)."""
+    name: str
+    array: np.ndarray
+
+
+def config_to_item(config_yaml: str) -> Item:
+    """Marian stores the config as int8 bytes incl. trailing NUL."""
+    raw = config_yaml.encode("utf-8") + b"\x00"
+    return Item(SPECIAL_CONFIG_KEY, np.frombuffer(raw, dtype=np.int8).copy())
+
+
+def item_to_config(item: Item) -> str:
+    raw = item.array.astype(np.int8).tobytes()
+    return raw.rstrip(b"\x00").decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# npz
+# ---------------------------------------------------------------------------
+
+def load_items(path: str) -> List[Item]:
+    """Load npz or bin by extension (reference: io::loadItems)."""
+    if path.endswith(".bin"):
+        return _load_bin(path)
+    out: List[Item] = []
+    with np.load(path, allow_pickle=False) as npz:
+        for name in npz.files:
+            out.append(Item(name, npz[name]))
+    return out
+
+
+def save_items(path: str, items: List[Item]) -> None:
+    """Save as npz or bin by extension (reference: io::saveItems).
+
+    Writes atomically via a temp file + rename so SIGTERM/preemption during
+    save never corrupts the previous checkpoint.
+    """
+    tmp = path + ".tmp"
+    if path.endswith(".bin"):
+        _save_bin(tmp, items)
+    else:
+        arrays = {it.name: np.asarray(it.array) for it in items}
+        # np.savez_compressed writes a zip; build in-memory then flush once.
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+    os.replace(tmp, path)
+
+
+def load_model(path: str):
+    """Returns (params: dict name->ndarray, config_yaml: Optional[str])."""
+    items = load_items(path)
+    params: Dict[str, np.ndarray] = {}
+    config: Optional[str] = None
+    for it in items:
+        if it.name == SPECIAL_CONFIG_KEY:
+            config = item_to_config(it)
+        else:
+            params[it.name] = it.array
+    return params, config
+
+
+def save_model(path: str, params: Dict[str, np.ndarray],
+               config_yaml: Optional[str] = None) -> None:
+    items = [Item(k, np.asarray(v)) for k, v in sorted(params.items())]
+    if config_yaml is not None:
+        items.append(config_to_item(config_yaml))
+    save_items(path, items)
+
+
+# ---------------------------------------------------------------------------
+# bin (mmap-able)
+# ---------------------------------------------------------------------------
+
+def _pad(n: int) -> int:
+    return (-n) % _ALIGN
+
+
+def _save_bin(path: str, items: List[Item]) -> None:
+    with open(path, "wb") as fh:
+        fh.write(_BIN_MAGIC)
+        fh.write(struct.pack("<Q", len(items)))
+        for it in items:
+            arr = np.ascontiguousarray(it.array)
+            name_b = it.name.encode("utf-8")
+            dtype_b = arr.dtype.str.encode("ascii")
+            fh.write(struct.pack("<I", len(name_b)))
+            fh.write(name_b)
+            fh.write(struct.pack("<I", len(dtype_b)))
+            fh.write(dtype_b)
+            fh.write(struct.pack("<I", arr.ndim))
+            for d in arr.shape:
+                fh.write(struct.pack("<Q", d))
+            data = arr.tobytes()
+            fh.write(struct.pack("<Q", len(data)))
+            fh.write(b"\x00" * _pad(fh.tell()))
+            fh.write(data)
+
+
+def _load_bin(path: str) -> List[Item]:
+    out: List[Item] = []
+    with open(path, "rb") as fh:
+        mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        pos = 0
+        if mm[pos:pos + 8] != _BIN_MAGIC:
+            raise ValueError(f"{path}: not a marian-tpu .bin file")
+        pos += 8
+        (count,) = struct.unpack_from("<Q", mm, pos); pos += 8
+        for _ in range(count):
+            (nlen,) = struct.unpack_from("<I", mm, pos); pos += 4
+            name = mm[pos:pos + nlen].decode("utf-8"); pos += nlen
+            (dlen,) = struct.unpack_from("<I", mm, pos); pos += 4
+            dtype = np.dtype(mm[pos:pos + dlen].decode("ascii")); pos += dlen
+            (ndim,) = struct.unpack_from("<I", mm, pos); pos += 4
+            shape = struct.unpack_from(f"<{ndim}Q", mm, pos); pos += 8 * ndim
+            (blen,) = struct.unpack_from("<Q", mm, pos); pos += 8
+            pos += _pad(pos)
+            arr = np.frombuffer(mm, dtype=dtype, count=blen // dtype.itemsize,
+                                offset=pos).reshape(shape)
+            pos += blen
+            out.append(Item(name, arr))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# progress yaml (TrainingState serialization lives in training/training_state)
+# ---------------------------------------------------------------------------
+
+def load_yaml(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return yaml.safe_load(fh) or {}
+
+
+def save_yaml(path: str, data: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        yaml.safe_dump(data, fh, default_flow_style=False, sort_keys=False)
+    os.replace(tmp, path)

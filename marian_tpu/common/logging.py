@@ -1,0 +1,91 @@
+"""Logging with Marian's look-and-feel (reference: src/common/logging.cpp ::
+createLoggers, LOG macro; spdlog pattern "[%Y-%m-%d %T] %v").
+
+Two named loggers, like Marian: ``general`` (training/runtime messages, goes
+to stderr + optional --log file) and ``valid`` (validation messages, prefixed
+``[valid]``, goes to stderr + optional --valid-log file). stdout stays clean
+for translations.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+    "off": logging.CRITICAL + 10,
+}
+
+
+class _MarianFormatter(logging.Formatter):
+    def __init__(self, prefix: str = ""):
+        super().__init__(fmt="[%(asctime)s] " + prefix + "%(message)s",
+                         datefmt="%Y-%m-%d %H:%M:%S")
+
+
+_initialized = False
+
+
+def create_loggers(options=None) -> None:
+    """Set up 'general' and 'valid' loggers from Options (or defaults)."""
+    global _initialized
+    quiet = bool(options and options.get("quiet", False))
+    level = _LEVELS.get((options.get("log-level", "info") if options else "info"), logging.INFO)
+    log_file: Optional[str] = options.get("log", None) if options else None
+    valid_file: Optional[str] = options.get("valid-log", None) if options else None
+
+    for name, prefix, fpath in (("general", "", log_file),
+                                ("valid", "[valid] ", valid_file)):
+        lg = logging.getLogger(f"marian.{name}")
+        lg.setLevel(level)
+        lg.propagate = False
+        lg.handlers.clear()
+        if not quiet:
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(_MarianFormatter(prefix))
+            lg.addHandler(h)
+        if fpath:
+            fh = logging.FileHandler(fpath)
+            fh.setFormatter(_MarianFormatter(prefix))
+            lg.addHandler(fh)
+        if quiet and not fpath:
+            lg.addHandler(logging.NullHandler())
+    _initialized = True
+
+
+def _get(name: str) -> logging.Logger:
+    if not _initialized:
+        create_loggers(None)
+    return logging.getLogger(f"marian.{name}")
+
+
+def log(level: str, msg: str, *args) -> None:
+    """LOG(info, "...") equivalent; {} placeholders like spdlog."""
+    if args:
+        msg = msg.replace("{}", "%s") % args
+    _get("general").log(_LEVELS.get(level, logging.INFO), msg)
+
+
+def log_valid(level: str, msg: str, *args) -> None:
+    if args:
+        msg = msg.replace("{}", "%s") % args
+    _get("valid").log(_LEVELS.get(level, logging.INFO), msg)
+
+
+def info(msg: str, *args) -> None:
+    log("info", msg, *args)
+
+
+def warn(msg: str, *args) -> None:
+    log("warn", msg, *args)
+
+
+def error(msg: str, *args) -> None:
+    log("error", msg, *args)

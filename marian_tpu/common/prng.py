@@ -1,0 +1,32 @@
+"""PRNG-key discipline — replaces the reference's per-device cuRAND generators
+(src/tensors/gpu/backend.gpu.cpp seeds). One root key derived from --seed;
+every consumer folds in a static stream id + step so dropout masks etc. are
+reproducible and resume-exact."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    if seed == 0:
+        seed = int(time.time_ns() % (2**31))
+    return jax.random.key(seed)
+
+
+# Stable stream ids (fold_in constants) for the different consumers.
+STREAM_SHUFFLE = 1
+STREAM_DROPOUT = 2
+STREAM_INIT = 3
+STREAM_SAMPLING = 4
+STREAM_SPM = 5
+
+
+def stream(key: jax.Array, stream_id: int, step: Optional[int] = None) -> jax.Array:
+    k = jax.random.fold_in(key, stream_id)
+    if step is not None:
+        k = jax.random.fold_in(k, step)
+    return k

@@ -1,0 +1,17 @@
+"""Wall-clock timers (reference: src/common/timer.h :: timer::Timer)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self):
+        self.start()
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        """Seconds since start()."""
+        return time.perf_counter() - self._t0

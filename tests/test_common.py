@@ -1,0 +1,140 @@
+"""Foundation-layer tests: Options, ConfigParser (YAML+CLI+aliases),
+SchedulingParameter, npz/bin IO round-trips."""
+
+import numpy as np
+import pytest
+import yaml
+
+from marian_tpu.common import Options, ConfigParser, parse_options, SchedulingParameter
+from marian_tpu.common.scheduling_parameter import SchedulingUnit
+from marian_tpu.common import io as mio
+
+
+class TestOptions:
+    def test_get_set_has(self):
+        o = Options({"dim-emb": 512})
+        assert o.get("dim-emb") == 512
+        assert o.get("dim_emb") == 512  # underscore alias
+        assert o.has("dim-emb") and not o.has("missing")
+        assert o.get("missing", 7) == 7
+        with pytest.raises(KeyError):
+            o.get("missing")
+
+    def test_with_returns_copy(self):
+        o = Options({"a": 1})
+        o2 = o.with_(a=2, b=3)
+        assert o.get("a") == 1 and o2.get("a") == 2 and o2.get("b") == 3
+
+    def test_yaml_roundtrip(self):
+        o = Options({"type": "transformer", "dim-emb": 256, "devices": [0, 1]})
+        o2 = Options.from_yaml(o.as_yaml())
+        assert o2.as_dict() == o.as_dict()
+
+
+class TestConfigParser:
+    def test_defaults(self):
+        opts = ConfigParser("training").parse([])
+        assert opts.get("dim-emb") == 512
+        assert opts.get("mini-batch") == 64
+        assert opts.get("type") == "amun"
+
+    def test_cli_overrides(self):
+        opts = ConfigParser("training").parse(
+            ["--dim-emb", "1024", "--type", "transformer", "--tied-embeddings-all"])
+        assert opts.get("dim-emb") == 1024
+        assert opts.get("type") == "transformer"
+        assert opts.get("tied-embeddings-all") is True
+
+    def test_config_file_and_cli_precedence(self, tmp_path):
+        cfg = tmp_path / "config.yml"
+        cfg.write_text(yaml.safe_dump({"dim-emb": 300, "mini-batch": 17}))
+        opts = ConfigParser("training").parse(
+            ["--config", str(cfg), "--dim-emb", "400"])
+        assert opts.get("dim-emb") == 400    # CLI wins
+        assert opts.get("mini-batch") == 17  # file wins over default
+
+    def test_task_alias_expansion(self):
+        opts = ConfigParser("training").parse(["--task", "transformer-big"])
+        assert opts.get("dim-emb") == 1024
+        assert opts.get("transformer-dim-ffn") == 4096
+        assert opts.get("transformer-heads") == 16
+        assert opts.get("tied-embeddings-all") is True
+        # CLI overrides alias
+        opts = ConfigParser("training").parse(
+            ["--task", "transformer-big", "--transformer-heads", "8"])
+        assert opts.get("transformer-heads") == 8
+
+    def test_unknown_flag_rejected(self):
+        with pytest.raises(SystemExit):
+            ConfigParser("training").parse(["--no-such-flag", "1"])
+
+    def test_validation_catches_bad_config(self):
+        with pytest.raises(ValueError):
+            parse_options(["--type", "transformer", "--dim-emb", "100",
+                           "--transformer-heads", "8", "--train-sets", "a", "b"],
+                          mode="training")
+
+    def test_dump_config_exits(self, capsys):
+        with pytest.raises(SystemExit):
+            ConfigParser("training").parse(["--dump-config", "minimal",
+                                            "--dim-emb", "128"])
+        out = capsys.readouterr().out
+        data = yaml.safe_load(out)
+        assert data["dim-emb"] == 128
+
+
+class TestSchedulingParameter:
+    def test_parse_units(self):
+        assert SchedulingParameter.parse("100u") == SchedulingParameter(100, SchedulingUnit.UPDATES)
+        assert SchedulingParameter.parse("10e").unit == SchedulingUnit.EPOCHS
+        assert SchedulingParameter.parse("1Mt") == SchedulingParameter(10**6, SchedulingUnit.TRG_LABELS)
+        assert SchedulingParameter.parse("16000").n == 16000
+        assert SchedulingParameter.parse("500Ku").n == 500_000
+        assert not SchedulingParameter.parse("0")
+        assert SchedulingParameter.parse(300).n == 300
+
+    def test_str_roundtrip(self):
+        for s in ["100u", "10e", "1000000t"]:
+            assert str(SchedulingParameter.parse(s)) == s
+
+
+class TestIO:
+    def _params(self):
+        rs = np.random.RandomState(0)
+        return {
+            "encoder_l1_self_Wq": rs.randn(8, 8).astype(np.float32),
+            "Wemb": rs.randn(31, 8).astype(np.float32),
+            "decoder_ff_logit_out_b": rs.randn(1, 31).astype(np.float32),
+        }
+
+    @pytest.mark.parametrize("ext", ["npz", "bin"])
+    def test_roundtrip(self, tmp_path, ext):
+        path = str(tmp_path / f"model.{ext}")
+        params = self._params()
+        cfg = "type: transformer\ndim-emb: 8\n"
+        mio.save_model(path, params, cfg)
+        loaded, cfg2 = mio.load_model(path)
+        assert cfg2 == cfg
+        assert set(loaded) == set(params)
+        for k in params:
+            np.testing.assert_array_equal(loaded[k], params[k])
+
+    def test_config_item_roundtrip(self):
+        cfg = "type: s2s\n"
+        item = mio.config_to_item(cfg)
+        assert item.name == mio.SPECIAL_CONFIG_KEY
+        assert item.array.dtype == np.int8
+        assert mio.item_to_config(item) == cfg
+
+    def test_atomic_save_overwrites(self, tmp_path):
+        path = str(tmp_path / "model.npz")
+        mio.save_model(path, self._params(), None)
+        mio.save_model(path, {"x": np.zeros(3, np.float32)}, None)
+        loaded, _ = mio.load_model(path)
+        assert list(loaded) == ["x"]
+
+    def test_yaml_io(self, tmp_path):
+        p = str(tmp_path / "progress.yml")
+        data = {"epochs": 2, "batches": 100, "stalled": 0}
+        mio.save_yaml(p, data)
+        assert mio.load_yaml(p) == data
