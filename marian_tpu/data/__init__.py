@@ -1,0 +1,18 @@
+from .vocab import VocabBase, DefaultVocab, create_vocab, EOS_ID, UNK_ID
+from .corpus import Corpus, CorpusState, SentenceTuple, TextInput
+from .batch_generator import (BatchGenerator, CorpusBatch, SubBatch, make_batch,
+                              bucket_length, bucket_batch_size,
+                              DEFAULT_LENGTH_BUCKETS)
+from .shortlist import (Shortlist, ShortlistGenerator, LexicalShortlistGenerator,
+                        parse_shortlist_options)
+from .alignment import WordAlignment, hard_alignment_from_soft
+
+__all__ = [
+    "VocabBase", "DefaultVocab", "create_vocab", "EOS_ID", "UNK_ID",
+    "Corpus", "CorpusState", "SentenceTuple", "TextInput",
+    "BatchGenerator", "CorpusBatch", "SubBatch", "make_batch",
+    "bucket_length", "bucket_batch_size", "DEFAULT_LENGTH_BUCKETS",
+    "Shortlist", "ShortlistGenerator", "LexicalShortlistGenerator",
+    "parse_shortlist_options",
+    "WordAlignment", "hard_alignment_from_soft",
+]

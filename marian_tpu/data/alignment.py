@@ -1,0 +1,58 @@
+"""Word alignments (reference: src/data/alignment.cpp :: WordAlignment) —
+'0-0 1-2 ...' Pharaoh format parsing for guided-alignment training and
+alignment output during decoding."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class WordAlignment:
+    points: List[Tuple[int, int, float]]  # (src, trg, prob)
+
+    @classmethod
+    def parse(cls, line: str) -> "WordAlignment":
+        pts = []
+        for tok in line.split():
+            parts = tok.split("-")
+            if len(parts) < 2:
+                continue
+            s, t = int(parts[0]), int(parts[1])
+            p = float(parts[2]) if len(parts) > 2 else 1.0
+            pts.append((s, t, p))
+        return cls(pts)
+
+    def fill_dense(self, out: np.ndarray) -> None:
+        """out: [trg_len, src_len]; normalized per target word like Marian's
+        guided-alignment matrix."""
+        for s, t, p in self.points:
+            if t < out.shape[0] and s < out.shape[1]:
+                out[t, s] = p
+        sums = out.sum(axis=-1, keepdims=True)
+        np.divide(out, sums, out=out, where=sums > 0)
+
+    def __str__(self) -> str:
+        return " ".join(f"{s}-{t}" for s, t, _ in self.points)
+
+
+def hard_alignment_from_soft(soft: np.ndarray, src_len: int, trg_len: int,
+                             threshold: float = 1.0) -> WordAlignment:
+    """Extract alignment points from a soft attention matrix [trg, src].
+    threshold 1.0 → argmax per target word ('hard'); else keep points with
+    prob >= threshold (reference: src/data/alignment.cpp ConvertSoftAlignToHardAlign)."""
+    pts: List[Tuple[int, int, float]] = []
+    m = soft[:trg_len, :src_len]
+    if threshold >= 1.0:
+        for t in range(trg_len):
+            s = int(np.argmax(m[t]))
+            pts.append((s, t, float(m[t, s])))
+    else:
+        for t in range(trg_len):
+            for s in range(src_len):
+                if m[t, s] >= threshold:
+                    pts.append((s, t, float(m[t, s])))
+    return WordAlignment(pts)

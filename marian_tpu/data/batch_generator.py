@@ -1,0 +1,282 @@
+"""Token-budget batch generation with XLA-friendly static shapes.
+
+Rebuild of reference src/data/batch_generator.h :: BatchGenerator<Corpus>::
+fetchBatches and src/data/corpus_base.h :: CorpusBatch/SubBatch, redesigned
+for the TPU compilation model:
+
+- same maxi-batch logic: prefetch ``--maxi-batch`` × ``--mini-batch``
+  sentences, sort by target (or source) length, fill minibatches by sentence
+  count (``--mini-batch``) or token budget (``--mini-batch-words``), then
+  shuffle the minibatch order;
+- NEW (the one real design change vs. the GPU reference, SURVEY.md §7):
+  every emitted batch is padded to a shape from a small static **bucket
+  table** — sequence lengths snap up to a bucket boundary and the sentence
+  dimension snaps up to a divisor-friendly size — so XLA compiles a handful
+  of programs instead of one per shape (the reference's --mini-batch-fit
+  binary search becomes this table);
+- background prefetch on a host thread (the reference's fetchBatches thread).
+
+Batch layout is batch-major ``[batch, time]`` (the reference is time-major
+``[time * batch]``; batch-major is the natural XLA layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .corpus import Corpus, SentenceTuple, CorpusState
+from ..common import logging as log
+
+# Default sequence-length buckets: fine steps early (NMT sentences are short),
+# geometric later. Snapping to these keeps compile count ~O(10).
+DEFAULT_LENGTH_BUCKETS = (8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512,
+                          768, 1024, 1536, 2048, 3072, 4096)
+
+
+def bucket_length(n: int, buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return ((n + 511) // 512) * 512
+
+
+def bucket_batch_size(n: int, multiple: int = 8) -> int:
+    """Snap sentence count up to a multiple (pad rows are fully masked)."""
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+@dataclasses.dataclass
+class SubBatch:
+    """One stream of a batch (reference: SubBatch: indices + mask)."""
+    ids: np.ndarray    # [batch, time] int32, EOS-terminated, 0-padded
+    mask: np.ndarray   # [batch, time] float32; 1 on real tokens (incl. EOS)
+
+    @property
+    def batch_size(self) -> int:
+        return self.ids.shape[0]
+
+    @property
+    def batch_width(self) -> int:
+        return self.ids.shape[1]
+
+    @property
+    def batch_words(self) -> int:
+        return int(self.mask.sum())
+
+
+@dataclasses.dataclass
+class CorpusBatch:
+    """A training batch across streams (reference: CorpusBatch)."""
+    sub: List[SubBatch]               # [src..., trg]; trg is last
+    sentence_ids: np.ndarray          # [batch] corpus line numbers (-1 = pad row)
+    guided_alignment: Optional[np.ndarray] = None  # [batch, trg_len, src_len]
+    data_weights: Optional[np.ndarray] = None      # [batch, trg_len] or [batch, 1]
+    corpus_state: Optional[dict] = None            # snapshot for exact resume
+
+    @property
+    def src(self) -> SubBatch:
+        return self.sub[0]
+
+    @property
+    def trg(self) -> SubBatch:
+        return self.sub[-1]
+
+    @property
+    def size(self) -> int:
+        return int((self.sentence_ids >= 0).sum())
+
+    @property
+    def batch_size(self) -> int:
+        return self.sub[0].batch_size
+
+    @property
+    def words(self) -> int:
+        """Real target labels (the scheduler's label count)."""
+        return self.trg.batch_words
+
+    @property
+    def src_words(self) -> int:
+        return self.src.batch_words
+
+    def shape_key(self) -> Tuple[int, ...]:
+        return tuple(s.ids.shape[1] for s in self.sub) + (self.batch_size,)
+
+
+def make_batch(tuples: Sequence[SentenceTuple], n_streams: int,
+               length_buckets=DEFAULT_LENGTH_BUCKETS,
+               batch_multiple: int = 8,
+               pad_batch: bool = True,
+               corpus_state: Optional[dict] = None) -> CorpusBatch:
+    """Pad a list of SentenceTuples into one fixed-shape CorpusBatch."""
+    n = len(tuples)
+    bsz = bucket_batch_size(n, batch_multiple) if pad_batch else n
+    subs: List[SubBatch] = []
+    for s in range(n_streams):
+        maxlen = max(len(t.streams[s]) for t in tuples)
+        width = bucket_length(maxlen, length_buckets) if pad_batch else maxlen
+        ids = np.zeros((bsz, width), dtype=np.int32)
+        mask = np.zeros((bsz, width), dtype=np.float32)
+        for b, t in enumerate(tuples):
+            seq = t.streams[s]
+            ids[b, : len(seq)] = seq
+            mask[b, : len(seq)] = 1.0
+        subs.append(SubBatch(ids, mask))
+    sent_ids = np.full((bsz,), -1, dtype=np.int64)
+    for b, t in enumerate(tuples):
+        sent_ids[b] = t.idx
+
+    guided = None
+    if any(t.alignment is not None for t in tuples):
+        tw, sw = subs[-1].ids.shape[1], subs[0].ids.shape[1]
+        guided = np.zeros((bsz, tw, sw), dtype=np.float32)
+        for b, t in enumerate(tuples):
+            if t.alignment is not None:
+                t.alignment.fill_dense(guided[b])
+
+    weights = None
+    if any(t.weights is not None for t in tuples):
+        tw = subs[-1].ids.shape[1]
+        word_level = any(t.weights is not None and len(t.weights) > 1 for t in tuples)
+        if word_level:
+            weights = np.ones((bsz, tw), dtype=np.float32)
+            for b, t in enumerate(tuples):
+                if t.weights is not None:
+                    w = t.weights[:tw]
+                    weights[b, : len(w)] = w
+        else:
+            weights = np.ones((bsz, 1), dtype=np.float32)
+            for b, t in enumerate(tuples):
+                if t.weights is not None:
+                    weights[b, 0] = t.weights[0]
+
+    return CorpusBatch(subs, sent_ids, guided, weights, corpus_state)
+
+
+class BatchGenerator:
+    """Iterator of CorpusBatches with maxi-batch sorting and prefetch."""
+
+    def __init__(self, corpus: Corpus, options=None,
+                 mini_batch: int = 64, mini_batch_words: int = 0,
+                 maxi_batch: int = 100, maxi_batch_sort: str = "trg",
+                 shuffle_batches: Optional[bool] = None,
+                 batch_multiple: int = 8, pad_batch: bool = True,
+                 length_buckets=DEFAULT_LENGTH_BUCKETS,
+                 prefetch: bool = True, seed: int = 1):
+        self.corpus = corpus
+        if options is not None:
+            mini_batch = int(options.get("mini-batch", mini_batch) or mini_batch)
+            mini_batch_words = int(options.get("mini-batch-words", mini_batch_words) or 0)
+            maxi_batch = int(options.get("maxi-batch", maxi_batch) or 1)
+            maxi_batch_sort = options.get("maxi-batch-sort", maxi_batch_sort)
+            seed = int(options.get("seed", seed)) or seed
+            if shuffle_batches is None:
+                shuffle_batches = options.get("shuffle", "data") in ("data", "batches")
+        self.mini_batch = max(1, mini_batch)
+        self.mini_batch_words = mini_batch_words
+        self.maxi_batch = max(1, maxi_batch)
+        self.sort_key = maxi_batch_sort
+        self.shuffle_batches = bool(shuffle_batches) and not corpus.inference
+        self.batch_multiple = batch_multiple
+        self.pad_batch = pad_batch
+        self.length_buckets = length_buckets
+        self.prefetch = prefetch
+        self._rs = np.random.RandomState(seed % (2**31))
+        self.n_streams = len(corpus.vocabs)
+
+    # -- batching core ------------------------------------------------------
+    def _split_maxi(self, buf: List[SentenceTuple], state: dict) -> List[CorpusBatch]:
+        if not buf:
+            return []
+        if self.sort_key == "trg":
+            buf = sorted(buf, key=lambda t: (len(t.trg), len(t.src)))
+        elif self.sort_key == "src":
+            buf = sorted(buf, key=lambda t: (len(t.src), len(t.trg)))
+        batches: List[CorpusBatch] = []
+        cur: List[SentenceTuple] = []
+        cur_maxlens = [0] * self.n_streams
+
+        def flush():
+            if cur:
+                batches.append(make_batch(cur, self.n_streams, self.length_buckets,
+                                          self.batch_multiple, self.pad_batch,
+                                          corpus_state=state))
+
+        for t in buf:
+            lens = [len(s) for s in t.streams]
+            new_maxlens = [max(a, b) for a, b in zip(cur_maxlens, lens)]
+            n = len(cur) + 1
+            if self.mini_batch_words > 0:
+                # token budget on padded target size (Marian counts labels);
+                # use the bucketed width so the budget reflects real cost
+                padded = bucket_length(new_maxlens[-1], self.length_buckets) \
+                    if self.pad_batch else new_maxlens[-1]
+                over = n * padded > self.mini_batch_words and len(cur) > 0
+            else:
+                over = n > self.mini_batch
+            if over:
+                flush()
+                cur = []
+                new_maxlens = lens
+            cur.append(t)
+            cur_maxlens = new_maxlens
+        flush()
+        if self.shuffle_batches:
+            self._rs.shuffle(batches)
+        return batches
+
+    def _generate(self) -> Iterator[CorpusBatch]:
+        buf: List[SentenceTuple] = []
+        cap = self.maxi_batch * self.mini_batch
+        it = iter(self.corpus)
+        state = self.corpus.state.as_dict()
+        for t in it:
+            buf.append(t)
+            if len(buf) >= cap:
+                yield from self._split_maxi(buf, state)
+                buf = []
+                state = self.corpus.state.as_dict()
+        yield from self._split_maxi(buf, state)
+
+    def __iter__(self) -> Iterator[CorpusBatch]:
+        if not self.prefetch:
+            yield from self._generate()
+            return
+        # background prefetch thread (reference: fetchBatches thread)
+        q: "queue.Queue" = queue.Queue(maxsize=16)
+        _END = object()
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for b in self._generate():
+                    q.put(b)
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        th = threading.Thread(target=worker, daemon=True, name="batchgen-prefetch")
+        th.start()
+        while True:
+            b = q.get()
+            if b is _END:
+                break
+            yield b
+        th.join()
+        if err:
+            raise err[0]
+
+    # -- stats (reference: GraphGroup::collectStats analogue) ---------------
+    def stats(self, n: int = 1000) -> dict:
+        """Sample shape distribution for logging/tuning."""
+        shapes = {}
+        for i, b in enumerate(self):
+            if i >= n:
+                break
+            shapes[b.shape_key()] = shapes.get(b.shape_key(), 0) + 1
+        return shapes

@@ -1,0 +1,100 @@
+"""SentencePiece vocabulary (reference: src/data/sentencepiece_vocab.cpp ::
+SentencePieceVocab, which wraps the vendored SentencePiece C++ library).
+
+Here we wrap the ``sentencepiece`` Python package on the host side; the module
+is gated so environments without it still run word-level configs. Supports
+on-the-fly training (``--sentencepiece-options``, ``--sentencepiece-max-lines``)
+and subword-regularization sampling (``--sentencepiece-alphas``)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from .vocab import VocabBase, EOS_ID, UNK_ID
+from ..common import logging as log
+
+try:
+    import sentencepiece as _spm
+    HAVE_SPM = True
+except ImportError:  # pragma: no cover - environment-dependent
+    _spm = None
+    HAVE_SPM = False
+
+
+class SentencePieceVocab(VocabBase):
+    def __init__(self, path: str, options=None, stream_index: int = 0,
+                 train_paths: Optional[List[str]] = None):
+        if not HAVE_SPM:
+            raise RuntimeError(
+                "SentencePiece vocab requested but the 'sentencepiece' package "
+                "is not installed; use a .yml word vocab or install sentencepiece")
+        self.alpha = 0.0
+        if options is not None:
+            alphas = options.get("sentencepiece-alphas", [])
+            if stream_index < len(alphas):
+                self.alpha = float(alphas[stream_index])
+        if not os.path.exists(path):
+            if not train_paths:
+                raise FileNotFoundError(path)
+            self._train(path, train_paths, options)
+        self._sp = _spm.SentencePieceProcessor(model_file=path)
+
+    def _train(self, path: str, train_paths: List[str], options) -> None:
+        extra = (options.get("sentencepiece-options", "") if options else "")
+        max_lines = (options.get("sentencepiece-max-lines", 2000000)
+                     if options else 2000000)
+        dim_vocabs = options.get("dim-vocabs", [32000]) if options else [32000]
+        vocab_size = max(dim_vocabs) or 32000
+        log.info("Training SentencePiece model {} from {}", path, ",".join(train_paths))
+        _spm.SentencePieceTrainer.train(
+            input=",".join(train_paths),
+            model_prefix=path[:-len(".spm")] if path.endswith(".spm") else path,
+            vocab_size=vocab_size,
+            input_sentence_size=max_lines,
+            shuffle_input_sentence=True,
+            eos_id=EOS_ID, unk_id=UNK_ID, bos_id=-1, pad_id=-1,
+            eos_piece="</s>", unk_piece="<unk>",
+            **_parse_extra(extra),
+        )
+        prefix = path[:-len(".spm")] if path.endswith(".spm") else path
+        os.replace(prefix + ".model", path)
+
+    def encode(self, line: str, add_eos: bool = True, inference: bool = False) -> List[int]:
+        if self.alpha > 0 and not inference:
+            ids = self._sp.encode(line, out_type=int, enable_sampling=True,
+                                  alpha=self.alpha, nbest_size=-1)
+        else:
+            ids = self._sp.encode(line, out_type=int)
+        if add_eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def decode(self, ids: Sequence[int], ignore_eos: bool = True) -> str:
+        return self._sp.decode([int(i) for i in ids if not (ignore_eos and i == EOS_ID)])
+
+    def surface(self, ids: Sequence[int]) -> List[str]:
+        return [self._sp.id_to_piece(int(i)) for i in ids]
+
+    def __len__(self) -> int:
+        return self._sp.get_piece_size()
+
+
+def _parse_extra(extra: str) -> dict:
+    """Parse '--key=value --flag' style --sentencepiece-options string."""
+    out = {}
+    for tok in extra.split():
+        tok = tok.lstrip("-")
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            try:
+                v = int(v)
+            except ValueError:
+                try:
+                    v = float(v)
+                except ValueError:
+                    pass
+            out[k] = v
+        elif tok:
+            out[tok] = True
+    return out

@@ -1,0 +1,218 @@
+"""Data-pipeline tests: vocab build/encode/decode, corpus shuffle+resume,
+batch generator token budgets + bucketed static shapes, shortlist."""
+
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data import (
+    DefaultVocab, create_vocab, Corpus, CorpusState, BatchGenerator,
+    make_batch, bucket_length, bucket_batch_size, EOS_ID, UNK_ID,
+    LexicalShortlistGenerator, WordAlignment, TextInput,
+)
+
+
+class TestVocab:
+    def test_build_and_specials(self):
+        v = DefaultVocab.build(["a b b c c c"])
+        assert v["</s>"] == EOS_ID and v["<unk>"] == UNK_ID
+        assert v["c"] == 2 and v["b"] == 3 and v["a"] == 4  # freq order
+        assert v["zzz"] == UNK_ID
+        assert len(v) == 5
+
+    def test_encode_decode_roundtrip(self):
+        v = DefaultVocab.build(["hello world foo"])
+        ids = v.encode("hello foo")
+        assert ids[-1] == EOS_ID
+        assert v.decode(ids) == "hello foo"
+
+    def test_save_load(self, tmp_path):
+        v = DefaultVocab.build(["x y z z"])
+        p = str(tmp_path / "vocab.yml")
+        v.save(p)
+        v2 = DefaultVocab.load(p)
+        assert len(v2) == len(v)
+        assert v2["z"] == v["z"]
+
+    def test_create_builds_missing(self, tmp_corpus, tmp_path):
+        src, tgt, _ = tmp_corpus
+        p = str(tmp_path / "v.yml")
+        v = create_vocab(p, train_paths=[src])
+        assert (tmp_path / "v.yml").exists()
+        assert v["the"] != UNK_ID
+
+
+class TestCorpus:
+    def _vocabs(self, tmp_corpus):
+        src, tgt, (sl, tl) = tmp_corpus
+        return DefaultVocab.build(sl), DefaultVocab.build(tl)
+
+    def test_iterates_epoch(self, tmp_corpus):
+        src, tgt, (sl, _) = tmp_corpus
+        vs, vt = self._vocabs(tmp_corpus)
+        c = Corpus([src, tgt], [vs, vt], Options({"max-length": 100, "shuffle": "none", "seed": 1}))
+        tuples = list(c)
+        assert len(tuples) == len(sl)
+        assert all(t.src[-1] == EOS_ID and t.trg[-1] == EOS_ID for t in tuples)
+
+    def test_shuffle_deterministic_per_epoch(self, tmp_corpus):
+        src, tgt, _ = tmp_corpus
+        vs, vt = self._vocabs(tmp_corpus)
+        c1 = Corpus([src, tgt], [vs, vt], Options({"max-length": 100, "shuffle": "data", "seed": 7}))
+        c2 = Corpus([src, tgt], [vs, vt], Options({"max-length": 100, "shuffle": "data", "seed": 7}))
+        assert [t.idx for t in c1] == [t.idx for t in c2]
+        assert c1.state.epoch == 1
+        # next epoch differs
+        order1 = [t.idx for t in c1]
+        order_e2 = [t.idx for t in c1]
+        assert order1 != order_e2
+
+    def test_resume_mid_epoch(self, tmp_corpus):
+        src, tgt, _ = tmp_corpus
+        vs, vt = self._vocabs(tmp_corpus)
+        opts = Options({"max-length": 100, "shuffle": "data", "seed": 3})
+        c = Corpus([src, tgt], [vs, vt], opts)
+        it = iter(c)
+        first_three = [next(it).idx for _ in range(3)]
+        state = c.state.as_dict()
+        # fresh corpus restored to that state continues identically
+        c2 = Corpus([src, tgt], [vs, vt], opts)
+        c2.restore(state)
+        rest = [t.idx for t in c2]
+        full = [t.idx for t in Corpus([src, tgt], [vs, vt], opts)]
+        assert first_three + rest == full
+
+    def test_max_length_skips_and_crops(self, tmp_corpus):
+        src, tgt, (sl, _) = tmp_corpus
+        vs, vt = self._vocabs(tmp_corpus)
+        c = Corpus([src, tgt], [vs, vt], Options({"max-length": 4, "shuffle": "none"}))
+        kept = list(c)
+        assert len(kept) < len(sl)  # long ones skipped
+        c2 = Corpus([src, tgt], [vs, vt],
+                    Options({"max-length": 4, "max-length-crop": True, "shuffle": "none"}))
+        cropped = list(c2)
+        assert len(cropped) == len(sl)
+        assert all(len(t.src) <= 5 for t in cropped)  # 4 + EOS
+
+
+class TestBatchGenerator:
+    def test_bucket_functions(self):
+        assert bucket_length(1) == 8 and bucket_length(8) == 8
+        assert bucket_length(9) == 16 and bucket_length(100) == 128
+        assert bucket_batch_size(1) == 8 and bucket_batch_size(9) == 16
+
+    def test_static_shapes(self, tmp_corpus):
+        src, tgt, _ = tmp_corpus
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        vt = DefaultVocab.build(open(tgt).read().splitlines())
+        c = Corpus([src, tgt], [vs, vt], Options({"max-length": 100, "shuffle": "none"}))
+        bg = BatchGenerator(c, mini_batch=3, maxi_batch=10, prefetch=False)
+        batches = list(bg)
+        assert batches
+        for b in batches:
+            assert b.src.ids.shape[0] % 8 == 0
+            assert b.src.ids.shape[1] in (8, 16, 24, 32)
+            assert b.src.ids.shape == b.src.mask.shape
+            # pad rows are fully masked
+            pads = b.sentence_ids < 0
+            assert b.src.mask[pads].sum() == 0
+        total = sum(b.size for b in batches)
+        assert total == 8
+
+    def test_token_budget(self, tmp_corpus):
+        src, tgt, _ = tmp_corpus
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        vt = DefaultVocab.build(open(tgt).read().splitlines())
+        c = Corpus([src, tgt], [vs, vt], Options({"max-length": 100, "shuffle": "none"}))
+        bg = BatchGenerator(c, mini_batch_words=24, maxi_batch=100, prefetch=False)
+        batches = list(bg)
+        for b in batches:
+            real = b.size
+            padded_trg = b.trg.ids.shape[1]
+            assert real * padded_trg <= 24 or real == 1
+        assert sum(b.size for b in batches) == 8
+
+    def test_prefetch_thread_equivalent(self, tmp_corpus):
+        src, tgt, _ = tmp_corpus
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        vt = DefaultVocab.build(open(tgt).read().splitlines())
+        def make():
+            c = Corpus([src, tgt], [vs, vt],
+                       Options({"max-length": 100, "shuffle": "data", "seed": 5}))
+            return c
+        b1 = [b.src.ids.tolist() for b in BatchGenerator(make(), mini_batch=4, prefetch=False, seed=5)]
+        b2 = [b.src.ids.tolist() for b in BatchGenerator(make(), mini_batch=4, prefetch=True, seed=5)]
+        assert b1 == b2
+
+    def test_length_sorting_reduces_padding(self, tmp_corpus):
+        src, tgt, _ = tmp_corpus
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        vt = DefaultVocab.build(open(tgt).read().splitlines())
+        c = Corpus([src, tgt], [vs, vt], Options({"max-length": 100, "shuffle": "none"}))
+        bg = BatchGenerator(c, mini_batch=4, maxi_batch=2, maxi_batch_sort="trg",
+                            prefetch=False, shuffle_batches=False)
+        batches = list(bg)
+        # with sorting, short sentences group together: first batch narrow
+        widths = sorted(b.trg.ids.shape[1] for b in batches)
+        assert widths[0] <= widths[-1]
+
+
+class TestShortlist:
+    def test_lexical_shortlist(self, tmp_path):
+        vs = DefaultVocab.build(["katze hund fuchs"])
+        vt = DefaultVocab.build(["cat dog fox"])
+        lex = tmp_path / "lex.s2t"
+        lex.write_text("katze cat 0.9\nkatze dog 0.05\nhund dog 0.95\nfuchs fox 0.8\n")
+        gen = LexicalShortlistGenerator(str(lex), vs, vt, first=2, best=1, k_multiple=8)
+        sl = gen.generate([vs["katze"], vs["hund"]])
+        assert len(sl) % 8 == 0
+        ids = set(sl.indices.tolist())
+        assert vt["cat"] in ids and vt["dog"] in ids
+        assert EOS_ID in ids
+        # reverse map works
+        pos = list(sl.indices).index(vt["cat"])
+        assert sl.reverse_map(np.array([pos]))[0] == vt["cat"]
+
+    def test_binary_roundtrip(self, tmp_path):
+        vs = DefaultVocab.build(["a b"])
+        vt = DefaultVocab.build(["x y"])
+        lex = tmp_path / "lex.s2t"
+        lex.write_text("a x 0.9\nb y 0.8\n")
+        gen = LexicalShortlistGenerator(str(lex), vs, vt, first=1, best=2, k_multiple=8)
+        binp = str(tmp_path / "lex.bin.npz")
+        gen.save_binary(binp)
+        gen2 = LexicalShortlistGenerator(binp, vs, vt, first=1, best=2, k_multiple=8)
+        sl1 = gen.generate([vs["a"]]).indices.tolist()
+        sl2 = gen2.generate([vs["a"]]).indices.tolist()
+        assert sl1 == sl2
+
+
+class TestAlignmentAndWeights:
+    def test_alignment_parse_and_dense(self):
+        a = WordAlignment.parse("0-0 1-2 2-1")
+        m = np.zeros((3, 3), dtype=np.float32)
+        a.fill_dense(m)
+        assert m[0, 0] == 1.0 and m[2, 1] == 1.0 and m[1, 2] == 1.0
+
+    def test_guided_alignment_batch(self, tmp_path):
+        src = tmp_path / "s.txt"; src.write_text("a b\nc d\n")
+        tgt = tmp_path / "t.txt"; tgt.write_text("x y\nz w\n")
+        aln = tmp_path / "a.txt"; aln.write_text("0-0 1-1\n0-1 1-0\n")
+        vs = DefaultVocab.build(["a b c d"])
+        vt = DefaultVocab.build(["x y z w"])
+        c = Corpus([str(src), str(tgt)], [vs, vt],
+                   Options({"max-length": 10, "shuffle": "none",
+                            "guided-alignment": str(aln)}))
+        tuples = list(c)
+        assert tuples[0].alignment is not None
+        b = make_batch(tuples, 2)
+        assert b.guided_alignment is not None
+        assert b.guided_alignment.shape[0] == b.src.ids.shape[0]
+        assert b.guided_alignment[0, 0, 0] == 1.0
+
+    def test_text_input(self):
+        vs = DefaultVocab.build(["hello world"])
+        ti = TextInput([["hello world", "world hello"]], [vs])
+        tuples = list(ti)
+        assert len(tuples) == 2
+        assert tuples[0].src[-1] == EOS_ID
