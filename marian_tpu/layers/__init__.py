@@ -1,0 +1,5 @@
+from . import initializers
+from .loss import RationalLoss, cross_entropy_loss, guided_alignment_loss
+
+__all__ = ["initializers", "RationalLoss", "cross_entropy_loss",
+           "guided_alignment_loss"]

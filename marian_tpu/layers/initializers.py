@@ -1,0 +1,47 @@
+"""Parameter initializers (reference: src/graph/node_initializers.cpp ::
+inits::glorotUniform/glorotNormal/he etc.). All return f32 numpy-compatible
+jax arrays; fromItem (checkpoint load) lives in common/io.py."""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def glorot_uniform(key: jax.Array, shape: Sequence[int],
+                   fan_in: int = 0, fan_out: int = 0, scale: float = 1.0) -> jax.Array:
+    if not fan_in:
+        fan_in = shape[0] if len(shape) > 1 else shape[-1]
+    if not fan_out:
+        fan_out = shape[-1]
+    limit = scale * math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, tuple(shape), jnp.float32, -limit, limit)
+
+
+def glorot_normal(key: jax.Array, shape: Sequence[int],
+                  fan_in: int = 0, fan_out: int = 0, scale: float = 1.0) -> jax.Array:
+    if not fan_in:
+        fan_in = shape[0] if len(shape) > 1 else shape[-1]
+    if not fan_out:
+        fan_out = shape[-1]
+    std = scale * math.sqrt(2.0 / (fan_in + fan_out))
+    return jax.random.normal(key, tuple(shape), jnp.float32) * std
+
+
+def uniform(key: jax.Array, shape: Sequence[int], scale: float = 0.1) -> jax.Array:
+    return jax.random.uniform(key, tuple(shape), jnp.float32, -scale, scale)
+
+
+def normal(key: jax.Array, shape: Sequence[int], std: float = 1.0) -> jax.Array:
+    return jax.random.normal(key, tuple(shape), jnp.float32) * std
+
+
+def zeros(shape: Sequence[int]) -> jax.Array:
+    return jnp.zeros(tuple(shape), jnp.float32)
+
+
+def ones(shape: Sequence[int]) -> jax.Array:
+    return jnp.ones(tuple(shape), jnp.float32)

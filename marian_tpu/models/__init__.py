@@ -1,0 +1,2 @@
+from . import transformer
+from .encoder_decoder import EncoderDecoder, create_model, batch_to_arrays

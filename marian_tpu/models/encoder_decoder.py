@@ -1,0 +1,116 @@
+"""EncoderDecoder: the model-level API used by training and translation —
+``build`` (teacher-forced loss graph), ``start_state``/``step`` (incremental
+decoding). Rebuild of reference src/models/encoder_decoder.cpp and
+src/models/costs.h (cost wrapping).
+
+Where the reference assembles encoder/decoder objects and walks a tape, this
+class closes a model *function family* (transformer or s2s) over a static
+config; everything it returns is jit-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers.loss import RationalLoss, cross_entropy_loss, guided_alignment_loss
+from . import transformer as T
+
+Params = Dict[str, jax.Array]
+
+
+class EncoderDecoder:
+    def __init__(self, options, src_vocab_size: int, trg_vocab_size: int,
+                 inference: bool = False):
+        self.options = options
+        self.model_type = options.get("type", "transformer")
+        self.inference = inference
+        self.label_smoothing = float(options.get("label-smoothing", 0.0) or 0.0)
+        self.guided_weight = float(options.get("guided-alignment-weight", 0.1))
+        self.guided_cost = str(options.get("guided-alignment-cost", "ce"))
+        ga = options.get("guided-alignment", "none")
+        self.use_guided = bool(ga and ga != "none") and not inference
+        if self.model_type in ("transformer", "multi-transformer", "transformer-lm"):
+            self.cfg = T.config_from_options(options, src_vocab_size,
+                                             trg_vocab_size, inference)
+            self._mod = T
+        elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s"):
+            from . import s2s as S
+            self.cfg = S.config_from_options(options, src_vocab_size,
+                                             trg_vocab_size, inference)
+            self._mod = S
+        else:
+            raise NotImplementedError(f"model type '{self.model_type}'")
+
+    # -- parameters ---------------------------------------------------------
+    def init(self, key: jax.Array) -> Params:
+        return self._mod.init_params(self.cfg, key)
+
+    # -- training graph (reference: EncoderDecoder::build + costs.h) --------
+    def loss(self, params: Params, batch: Dict[str, jax.Array],
+             key: Optional[jax.Array] = None, train: bool = True
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Returns (ce_sum_plus_aux, aux dict with loss_sum/labels)."""
+        cparams = T.cast_params(params, self.cfg.compute_dtype)
+        k_enc = jax.random.fold_in(key, 1) if key is not None else None
+        k_dec = jax.random.fold_in(key, 2) if key is not None else None
+        enc_out = self._mod.encode(self.cfg, cparams, batch["src_ids"],
+                                   batch["src_mask"], train, k_enc)
+        want_align = self.use_guided and "guided" in batch
+        res = self._mod.decode_train(self.cfg, cparams, enc_out,
+                                     batch["src_mask"], batch["trg_ids"],
+                                     batch["trg_mask"], train, k_dec,
+                                     return_alignment=want_align)
+        logits, align = res if want_align else (res, None)
+        rl = cross_entropy_loss(logits, batch["trg_ids"], batch["trg_mask"],
+                                self.label_smoothing,
+                                batch.get("data_weights"))
+        total = rl.loss_sum
+        aux = {"ce_sum": rl.loss_sum, "labels": rl.labels}
+        if want_align and align is not None:
+            ga = guided_alignment_loss(align, batch["guided"],
+                                       batch["trg_mask"], self.guided_cost)
+            total = total + self.guided_weight * ga * rl.labels
+            aux["guided"] = ga
+        return total, aux
+
+    # -- incremental decoding (reference: startState/step) ------------------
+    def encode_for_decode(self, params: Params, src_ids, src_mask):
+        cparams = T.cast_params(params, self.cfg.compute_dtype)
+        return self._mod.encode(self.cfg, cparams, src_ids, src_mask,
+                                train=False, key=None)
+
+    def start_state(self, params: Params, enc_out, src_mask, max_len: int):
+        cparams = T.cast_params(params, self.cfg.compute_dtype)
+        return self._mod.init_decode_state(self.cfg, cparams, enc_out,
+                                           src_mask, max_len)
+
+    def step(self, params: Params, state, prev_ids, src_mask,
+             shortlist=None, return_alignment: bool = False):
+        cparams = T.cast_params(params, self.cfg.compute_dtype)
+        return self._mod.decode_step(self.cfg, cparams, state, prev_ids,
+                                     src_mask, shortlist, return_alignment)
+
+
+def create_model(options, src_vocab_size: int, trg_vocab_size: int,
+                 inference: bool = False) -> EncoderDecoder:
+    """Model factory (reference: src/models/model_factory.cpp ::
+    models::createModelFromOptions)."""
+    return EncoderDecoder(options, src_vocab_size, trg_vocab_size, inference)
+
+
+def batch_to_arrays(batch) -> Dict[str, jnp.ndarray]:
+    """CorpusBatch → dict of device arrays for the jitted loss."""
+    out = {
+        "src_ids": jnp.asarray(batch.src.ids),
+        "src_mask": jnp.asarray(batch.src.mask),
+        "trg_ids": jnp.asarray(batch.trg.ids),
+        "trg_mask": jnp.asarray(batch.trg.mask),
+    }
+    if batch.guided_alignment is not None:
+        out["guided"] = jnp.asarray(batch.guided_alignment)
+    if batch.data_weights is not None:
+        out["data_weights"] = jnp.asarray(batch.data_weights)
+    return out

@@ -1,0 +1,571 @@
+"""Transformer encoder-decoder as pure JAX functions over a flat param dict.
+
+Rebuild of reference src/models/transformer.h :: TransformerEncoder /
+TransformerDecoder / MultiHead. The reference builds a fresh expression-graph
+tape per batch and interprets it node-by-node; here the model is a pure
+function jit-compiled once per input shape (SURVEY.md §2.3's central point).
+
+Design notes:
+- The parameter tree is a FLAT dict keyed by Marian's parameter names
+  (``encoder_l1_self_Wq``, ``Wemb``, ``decoder_ff_logit_out_b``, …) so
+  upstream Marian ``.npz`` checkpoints map 1:1 (symbol names recalled from
+  upstream marian-dev; re-verify against a real checkpoint when available —
+  see SURVEY.md provenance caveat). Weights are stored [in, out] like Marian
+  and applied as ``x @ W``; all params f32, cast to the compute dtype (bf16)
+  inside the forward pass.
+- Pre/post-process strings follow Marian semantics: each sublayer wraps its
+  core op with ``preprocess`` ops applied to the input and ``postprocess``
+  ops applied to (output, input): 'd'=dropout, 'a'=residual add,
+  'n'=layer-norm. Default "dan" = post-norm; --task *-prenorm sets pre="n",
+  post="da", top="n".
+- Incremental decoding keeps per-layer K/V caches as fixed-size
+  [B, H, max_len, Dh] buffers updated with dynamic_update_slice — static
+  shapes under jit (the reference appends to growing tensors instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers import initializers as inits
+from ..ops.ops import (activation, affine, dropout, layer_norm)
+from ..ops.attention import (causal_mask, dense_attention_with_weights)
+
+Params = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Static model hyperparameters (closed over by the jitted functions)."""
+    src_vocab: int
+    trg_vocab: int
+    dim_emb: int = 512
+    heads: int = 8
+    dim_ffn: int = 2048
+    dec_dim_ffn: int = 0            # 0 → dim_ffn
+    ffn_depth: int = 2
+    dec_ffn_depth: int = 0          # 0 → ffn_depth
+    enc_depth: int = 6
+    dec_depth: int = 6
+    ffn_activation: str = "relu"
+    preprocess: str = ""
+    postprocess: str = "dan"
+    postprocess_emb: str = "d"
+    postprocess_top: str = ""
+    tied_embeddings: bool = False       # tie trg emb ↔ output
+    tied_embeddings_src: bool = False   # tie src ↔ trg emb
+    tied_embeddings_all: bool = True    # tie all three
+    train_position_embeddings: bool = False
+    max_length: int = 512               # positional table length
+    dropout: float = 0.0                # between-layer (pre/post 'd')
+    attention_dropout: float = 0.0
+    ffn_dropout: float = 0.0
+    dropout_src: float = 0.0            # whole-word dropout
+    dropout_trg: float = 0.0
+    depth_scaling: bool = False
+    no_projection: bool = False
+    decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
+    compute_dtype: Any = jnp.bfloat16
+    guided_alignment_layer: str = "last"
+
+    @property
+    def dim_head(self) -> int:
+        return self.dim_emb // self.heads
+
+    @property
+    def dec_ffn(self) -> int:
+        return self.dec_dim_ffn or self.dim_ffn
+
+    @property
+    def dec_ffn_d(self) -> int:
+        return self.dec_ffn_depth or self.ffn_depth
+
+
+def config_from_options(options, src_vocab: int, trg_vocab: int,
+                        for_inference: bool = False) -> TransformerConfig:
+    """Map Marian flags → TransformerConfig (reference: transformer.h reads
+    the same option names)."""
+    g = options.get
+    precision = g("precision", ["float32"])
+    compute = precision[0] if isinstance(precision, list) else precision
+    # the reference's float16 path maps to bf16 on TPU (MXU-native)
+    dtype = {"float32": jnp.float32, "float16": jnp.bfloat16,
+             "bfloat16": jnp.bfloat16}.get(str(compute), jnp.float32)
+    drop = 0.0 if for_inference else float(g("transformer-dropout", 0.0))
+    return TransformerConfig(
+        src_vocab=src_vocab,
+        trg_vocab=trg_vocab,
+        dim_emb=int(g("dim-emb", 512)),
+        heads=int(g("transformer-heads", 8)),
+        dim_ffn=int(g("transformer-dim-ffn", 2048)),
+        dec_dim_ffn=int(g("transformer-decoder-dim-ffn", 0)),
+        ffn_depth=int(g("transformer-ffn-depth", 2)),
+        dec_ffn_depth=int(g("transformer-decoder-ffn-depth", 0)),
+        enc_depth=int(g("enc-depth", 6)),
+        dec_depth=int(g("dec-depth", 6)),
+        ffn_activation=str(g("transformer-ffn-activation", "relu")),
+        preprocess=str(g("transformer-preprocess", "")),
+        postprocess=str(g("transformer-postprocess", "dan")),
+        postprocess_emb=str(g("transformer-postprocess-emb", "d")),
+        postprocess_top=str(g("transformer-postprocess-top", "")),
+        tied_embeddings=bool(g("tied-embeddings", False)),
+        tied_embeddings_src=bool(g("tied-embeddings-src", False)),
+        tied_embeddings_all=bool(g("tied-embeddings-all", False)),
+        train_position_embeddings=bool(g("transformer-train-position-embeddings", False)),
+        max_length=max(int(g("max-length", 50)) * 2, 512),
+        dropout=drop,
+        attention_dropout=0.0 if for_inference else float(g("transformer-dropout-attention", 0.0)),
+        ffn_dropout=0.0 if for_inference else float(g("transformer-dropout-ffn", 0.0)),
+        dropout_src=0.0 if for_inference else float(g("dropout-src", 0.0)),
+        dropout_trg=0.0 if for_inference else float(g("dropout-trg", 0.0)),
+        depth_scaling=bool(g("transformer-depth-scaling", False)),
+        no_projection=bool(g("transformer-no-projection", False)),
+        decoder_autoreg=str(g("transformer-decoder-autoreg", "self-attention")),
+        compute_dtype=dtype,
+        guided_alignment_layer=str(g("transformer-guided-alignment-layer", "last")),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Initialization (param names follow upstream Marian's transformer.h)
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    p: Params = {}
+    k = iter(jax.random.split(key, 4096))
+    d = cfg.dim_emb
+
+    def glorot(shape, depth_layer: int = 0):
+        scale = 1.0
+        if cfg.depth_scaling and depth_layer > 0:
+            scale = 1.0 / math.sqrt(depth_layer)
+        return inits.glorot_uniform(next(k), shape, scale=scale)
+
+    # embeddings
+    if cfg.tied_embeddings_all or cfg.tied_embeddings_src:
+        if cfg.src_vocab != cfg.trg_vocab:
+            raise ValueError("tied src embeddings require equal vocab sizes")
+        p["Wemb"] = glorot((cfg.src_vocab, d))
+    else:
+        p["encoder_Wemb"] = glorot((cfg.src_vocab, d))
+        p["decoder_Wemb"] = glorot((cfg.trg_vocab, d))
+    if cfg.train_position_embeddings:
+        p["Wpos"] = glorot((cfg.max_length, d))
+    if "n" in cfg.postprocess_emb:
+        p["encoder_emb_ln_scale"] = inits.ones((1, d))
+        p["encoder_emb_ln_bias"] = inits.zeros((1, d))
+        p["decoder_emb_ln_scale"] = inits.ones((1, d))
+        p["decoder_emb_ln_bias"] = inits.zeros((1, d))
+
+    def attn_block(prefix: str, layer: int):
+        p[f"{prefix}_Wq"] = glorot((d, d), layer)
+        p[f"{prefix}_bq"] = inits.zeros((1, d))
+        p[f"{prefix}_Wk"] = glorot((d, d), layer)
+        p[f"{prefix}_bk"] = inits.zeros((1, d))
+        p[f"{prefix}_Wv"] = glorot((d, d), layer)
+        p[f"{prefix}_bv"] = inits.zeros((1, d))
+        if not cfg.no_projection:
+            p[f"{prefix}_Wo"] = glorot((d, d), layer)
+            p[f"{prefix}_bo"] = inits.zeros((1, d))
+        if "n" in cfg.preprocess or "n" in cfg.postprocess:
+            p[f"{prefix}_Wo_ln_scale"] = inits.ones((1, d))
+            p[f"{prefix}_Wo_ln_bias"] = inits.zeros((1, d))
+
+    def ffn_block(prefix: str, dim_ffn: int, depth: int, layer: int):
+        dims = [d] + [dim_ffn] * (depth - 1) + [d]
+        for i in range(depth):
+            p[f"{prefix}_W{i+1}"] = glorot((dims[i], dims[i + 1]), layer)
+            p[f"{prefix}_b{i+1}"] = inits.zeros((1, dims[i + 1]))
+        if "n" in cfg.preprocess or "n" in cfg.postprocess:
+            p[f"{prefix}_ffn_ln_scale"] = inits.ones((1, d))
+            p[f"{prefix}_ffn_ln_bias"] = inits.zeros((1, d))
+
+    for l in range(1, cfg.enc_depth + 1):
+        attn_block(f"encoder_l{l}_self", l)
+        ffn_block(f"encoder_l{l}_ffn", cfg.dim_ffn, cfg.ffn_depth, l)
+    if "n" in cfg.postprocess_top or "n" in cfg.preprocess:
+        p["encoder_top_ln_scale"] = inits.ones((1, d))
+        p["encoder_top_ln_bias"] = inits.zeros((1, d))
+
+    for l in range(1, cfg.dec_depth + 1):
+        attn_block(f"decoder_l{l}_self", l)
+        attn_block(f"decoder_l{l}_context", l)
+        ffn_block(f"decoder_l{l}_ffn", cfg.dec_ffn, cfg.dec_ffn_d, l)
+    if "n" in cfg.postprocess_top or "n" in cfg.preprocess:
+        p["decoder_top_ln_scale"] = inits.ones((1, d))
+        p["decoder_top_ln_bias"] = inits.zeros((1, d))
+
+    if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
+        p["decoder_ff_logit_out_W"] = glorot((d, cfg.trg_vocab))
+    p["decoder_ff_logit_out_b"] = inits.zeros((1, cfg.trg_vocab))
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def _pre_post(cfg: TransformerConfig, ops: str, x: jax.Array,
+              residual: Optional[jax.Array], prefix: str, params: Params,
+              key, train: bool) -> jax.Array:
+    """Apply a Marian process string ('d','a','n') to x."""
+    for i, op in enumerate(ops):
+        if op == "d":
+            if train and cfg.dropout > 0.0 and key is not None:
+                x = dropout(x, cfg.dropout, jax.random.fold_in(key, i))
+        elif op == "a":
+            if residual is not None:
+                x = x + residual
+        elif op == "n":
+            x = layer_norm(x, params[f"{prefix}_ln_scale"],
+                           params[f"{prefix}_ln_bias"])
+        else:
+            raise ValueError(f"Unknown process op '{op}'")
+    return x
+
+
+def _split_heads(x: jax.Array, heads: int) -> jax.Array:
+    b, t, d = x.shape
+    return x.reshape(b, t, heads, d // heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x: jax.Array) -> jax.Array:
+    b, h, t, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+
+
+def _mha(cfg: TransformerConfig, params: Params, prefix: str,
+         q_in: jax.Array, kv_in: jax.Array, mask: Optional[jax.Array],
+         key, train: bool,
+         cache: Optional[Dict[str, jax.Array]] = None,
+         cache_pos: Optional[jax.Array] = None,
+         static_kv: bool = False,
+         return_weights: bool = False):
+    """Multi-head attention with optional decode cache.
+
+    cache (self-attn): dict with 'k','v' [B,H,L,Dh]; new K/V written at
+    cache_pos. static_kv (cross-attn): K/V precomputed in cache, reused.
+    """
+    h = cfg.heads
+    q = _split_heads(affine(q_in, params[f"{prefix}_Wq"], params[f"{prefix}_bq"]), h)
+    if static_kv and cache is not None:
+        k_, v_ = cache["k"], cache["v"]
+    else:
+        k_ = _split_heads(affine(kv_in, params[f"{prefix}_Wk"], params[f"{prefix}_bk"]), h)
+        v_ = _split_heads(affine(kv_in, params[f"{prefix}_Wv"], params[f"{prefix}_bv"]), h)
+        if cache is not None and cache_pos is not None:
+            # write this step's K/V into the fixed-size cache at position pos
+            k_ = jax.lax.dynamic_update_slice(
+                cache["k"], k_.astype(cache["k"].dtype), (0, 0, cache_pos, 0))
+            v_ = jax.lax.dynamic_update_slice(
+                cache["v"], v_.astype(cache["v"].dtype), (0, 0, cache_pos, 0))
+            cache["k"], cache["v"] = k_, v_
+    dk = jax.random.fold_in(key, 97) if (key is not None) else None
+    out, weights = dense_attention_with_weights(
+        q, k_, v_, mask,
+        dropout_rate=cfg.attention_dropout, dropout_key=dk,
+        deterministic=not train, return_weights=return_weights)
+    out = _merge_heads(out)
+    if not cfg.no_projection:
+        out = affine(out, params[f"{prefix}_Wo"], params[f"{prefix}_bo"])
+    return out, weights
+
+
+def _ffn(cfg: TransformerConfig, params: Params, prefix: str, x: jax.Array,
+         dim_ffn: int, depth: int, key, train: bool) -> jax.Array:
+    act = activation(cfg.ffn_activation)
+    for i in range(depth):
+        x = affine(x, params[f"{prefix}_W{i+1}"], params[f"{prefix}_b{i+1}"])
+        if i < depth - 1:
+            x = act(x)
+            if train and cfg.ffn_dropout > 0.0 and key is not None:
+                x = dropout(x, cfg.ffn_dropout, jax.random.fold_in(key, i))
+    return x
+
+
+def sinusoidal_positions(length: int, dim: int, start: int = 0) -> jax.Array:
+    """Tensor2tensor-style timing signal (reference: transformer.h
+    addPositionalEmbeddings): first half sin, second half cos."""
+    pos = jnp.arange(start, start + length, dtype=jnp.float32)[:, None]
+    half = dim // 2
+    inv_freq = jnp.exp(-jnp.arange(half, dtype=jnp.float32)
+                       * (math.log(10000.0) / max(half - 1, 1)))
+    angles = pos * inv_freq[None, :]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+
+
+def _embed_words(cfg: TransformerConfig, params: Params, ids: jax.Array,
+                 side: str) -> jax.Array:
+    """Token embedding * sqrt(dim) (reference: transformer.h embFactor)."""
+    if cfg.tied_embeddings_all or (cfg.tied_embeddings_src and side == "src") \
+            or ("Wemb" in params and f"{'encoder' if side == 'src' else 'decoder'}_Wemb" not in params):
+        table = params["Wemb"]
+    else:
+        table = params["encoder_Wemb" if side == "src" else "decoder_Wemb"]
+    x = table[ids].astype(cfg.compute_dtype)
+    return x * jnp.asarray(math.sqrt(cfg.dim_emb), cfg.compute_dtype)
+
+
+def _word_dropout(cfg: TransformerConfig, x: jax.Array, rate: float, key,
+                  train: bool) -> jax.Array:
+    """Whole-word dropout (reference: --dropout-src/--dropout-trg)."""
+    if train and rate > 0.0 and key is not None:
+        keep = jax.random.bernoulli(jax.random.fold_in(key, 11), 1.0 - rate,
+                                    x.shape[:-1])
+        x = x * keep[..., None].astype(x.dtype)
+    return x
+
+
+def _add_pos(cfg: TransformerConfig, params: Params, x: jax.Array,
+             start_pos=0) -> jax.Array:
+    t = x.shape[-2]
+    if cfg.train_position_embeddings:
+        pos_ids = (jnp.arange(t) + start_pos).astype(jnp.int32)
+        return x + params["Wpos"][pos_ids].astype(x.dtype)
+    return x + sinusoidal_positions_dynamic(t, cfg.dim_emb, start_pos).astype(x.dtype)
+
+
+def _embed(cfg: TransformerConfig, params: Params, ids: jax.Array,
+           side: str, key, train: bool, start_pos=0) -> jax.Array:
+    x = _embed_words(cfg, params, ids, side)
+    rate = cfg.dropout_src if side == "src" else cfg.dropout_trg
+    x = _word_dropout(cfg, x, rate, key, train)
+    return _add_pos(cfg, params, x, start_pos)
+
+
+def shift_right_embeddings(x: jax.Array) -> jax.Array:
+    """Shift target embeddings one step right, zero vector at t=0 — Marian's
+    decoder-start convention: no BOS token, position 0 attends to a zero
+    embedding (reference: transformer.h shiftEmbeddings)."""
+    return jnp.pad(x, ((0, 0), (1, 0), (0, 0)))[:, :-1, :]
+
+
+def sinusoidal_positions_dynamic(length: int, dim: int, start) -> jax.Array:
+    """Like sinusoidal_positions but `start` may be a traced scalar (decode)."""
+    pos = (jnp.arange(length, dtype=jnp.float32)
+           + jnp.asarray(start, jnp.float32))[:, None]
+    half = dim // 2
+    inv_freq = jnp.exp(-jnp.arange(half, dtype=jnp.float32)
+                       * (math.log(10000.0) / max(half - 1, 1)))
+    angles = pos * inv_freq[None, :]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Encoder
+# ---------------------------------------------------------------------------
+
+def encode(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
+           src_mask: jax.Array, train: bool = False,
+           key: Optional[jax.Array] = None) -> jax.Array:
+    """[B, Ts] ids + mask → [B, Ts, D] encoder states (reference:
+    TransformerEncoder::apply)."""
+    kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
+    x = _embed(cfg, params, src_ids, "src", kk(0), train)
+    x = _pre_post(cfg, cfg.postprocess_emb, x, None, "encoder_emb", params,
+                  kk(1), train)
+    attn_mask = src_mask[:, None, None, :]  # [B,1,1,Ts]
+    for l in range(1, cfg.enc_depth + 1):
+        lk = kk(l * 10)
+        # self-attention sublayer
+        pre = _pre_post(cfg, cfg.preprocess, x, None,
+                        f"encoder_l{l}_self_Wo", params, lk, train)
+        out, _ = _mha(cfg, params, f"encoder_l{l}_self", pre, pre, attn_mask,
+                      lk, train)
+        x = _pre_post(cfg, cfg.postprocess, out, x,
+                      f"encoder_l{l}_self_Wo", params, lk, train)
+        # ffn sublayer
+        lk2 = kk(l * 10 + 5)
+        pre = _pre_post(cfg, cfg.preprocess, x, None,
+                        f"encoder_l{l}_ffn_ffn", params, lk2, train)
+        out = _ffn(cfg, params, f"encoder_l{l}_ffn", pre, cfg.dim_ffn,
+                   cfg.ffn_depth, lk2, train)
+        x = _pre_post(cfg, cfg.postprocess, out, x,
+                      f"encoder_l{l}_ffn_ffn", params, lk2, train)
+    x = _pre_post(cfg, cfg.postprocess_top, x, None, "encoder_top", params,
+                  kk(9999), train)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Decoder (teacher-forced training path)
+# ---------------------------------------------------------------------------
+
+def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
+                 src_mask: jax.Array, trg_ids: jax.Array,
+                 trg_mask: jax.Array, train: bool = True,
+                 key: Optional[jax.Array] = None,
+                 return_alignment: bool = False):
+    """Teacher-forced decoder: [B, Tt] gold target ids → [B, Tt, V] logits.
+    Input embeddings are the gold embeddings shifted right with a zero vector
+    at t=0 (reference: TransformerDecoder::step on full groundTruth)."""
+    kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
+    we = _embed_words(cfg, params, trg_ids, "trg")
+    we = shift_right_embeddings(we)
+    we = _word_dropout(cfg, we, cfg.dropout_trg, kk(0), train)
+    x = _add_pos(cfg, params, we, 0)
+    x = _pre_post(cfg, cfg.postprocess_emb, x, None, "decoder_emb", params,
+                  kk(1), train)
+    tt = trg_ids.shape[1]
+    self_mask = causal_mask(tt) * trg_mask[:, None, None, :]
+    cross_mask = src_mask[:, None, None, :]
+    align = None
+    for l in range(1, cfg.dec_depth + 1):
+        lk = kk(l * 10)
+        pre = _pre_post(cfg, cfg.preprocess, x, None,
+                        f"decoder_l{l}_self_Wo", params, lk, train)
+        out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre, self_mask,
+                      lk, train)
+        x = _pre_post(cfg, cfg.postprocess, out, x,
+                      f"decoder_l{l}_self_Wo", params, lk, train)
+
+        lk2 = kk(l * 10 + 3)
+        want_w = return_alignment and _is_alignment_layer(cfg, l)
+        pre = _pre_post(cfg, cfg.preprocess, x, None,
+                        f"decoder_l{l}_context_Wo", params, lk2, train)
+        out, w = _mha(cfg, params, f"decoder_l{l}_context", pre, enc_out,
+                      cross_mask, lk2, train, return_weights=want_w)
+        if want_w and w is not None:
+            align = w.mean(axis=1)  # [B, Tt, Ts] head-averaged soft alignment
+        x = _pre_post(cfg, cfg.postprocess, out, x,
+                      f"decoder_l{l}_context_Wo", params, lk2, train)
+
+        lk3 = kk(l * 10 + 7)
+        pre = _pre_post(cfg, cfg.preprocess, x, None,
+                        f"decoder_l{l}_ffn_ffn", params, lk3, train)
+        out = _ffn(cfg, params, f"decoder_l{l}_ffn", pre, cfg.dec_ffn,
+                   cfg.dec_ffn_d, lk3, train)
+        x = _pre_post(cfg, cfg.postprocess, out, x,
+                      f"decoder_l{l}_ffn_ffn", params, lk3, train)
+    x = _pre_post(cfg, cfg.postprocess_top, x, None, "decoder_top", params,
+                  kk(9999), train)
+    logits = output_logits(cfg, params, x)
+    if return_alignment:
+        return logits, align
+    return logits
+
+
+def _is_alignment_layer(cfg: TransformerConfig, l: int) -> bool:
+    gal = cfg.guided_alignment_layer
+    if gal == "last":
+        return l == cfg.dec_depth
+    return l == int(gal)
+
+
+def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
+                  shortlist: Optional[jax.Array] = None) -> jax.Array:
+    """Output projection with tied embeddings and optional shortlist slice
+    (reference: src/layers/output.cpp :: mlp::Output). Returns f32 logits."""
+    if cfg.tied_embeddings_all:
+        w = params["Wemb"].T
+    elif cfg.tied_embeddings:
+        w = (params["Wemb"] if "Wemb" in params else params["decoder_Wemb"]).T
+    else:
+        w = params["decoder_ff_logit_out_W"]
+    b = params["decoder_ff_logit_out_b"]
+    if shortlist is not None:
+        w = w[:, shortlist]
+        b = b[:, shortlist]
+    y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
+    return y.astype(jnp.float32) + b.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Incremental decoding (beam/greedy): startState / step
+# ---------------------------------------------------------------------------
+
+def init_decode_state(cfg: TransformerConfig, params: Params,
+                      enc_out: jax.Array, src_mask: jax.Array,
+                      max_len: int) -> Dict[str, Any]:
+    """Precompute cross-attention K/V; allocate fixed-size self-attn caches
+    (reference: EncoderDecoder::startState + per-layer cache init)."""
+    b = enc_out.shape[0]
+    h, dh = cfg.heads, cfg.dim_head
+    state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
+    for l in range(1, cfg.dec_depth + 1):
+        kv = enc_out
+        state[f"l{l}_cross_k"] = _split_heads(
+            affine(kv, params[f"decoder_l{l}_context_Wk"],
+                   params[f"decoder_l{l}_context_bk"]), h)
+        state[f"l{l}_cross_v"] = _split_heads(
+            affine(kv, params[f"decoder_l{l}_context_Wv"],
+                   params[f"decoder_l{l}_context_bv"]), h)
+        state[f"l{l}_self_k"] = jnp.zeros((b, h, max_len, dh), cfg.compute_dtype)
+        state[f"l{l}_self_v"] = jnp.zeros((b, h, max_len, dh), cfg.compute_dtype)
+    return state
+
+
+def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
+                prev_ids: jax.Array, src_mask: jax.Array,
+                shortlist: Optional[jax.Array] = None,
+                return_alignment: bool = False):
+    """One decode step on [B, 1] previous ids → ([B, V] logits, new state).
+
+    All shapes static; `state['pos']` is the traced time index. The self-attn
+    mask allows positions <= pos (cache beyond pos is zeros but masked out).
+    """
+    pos = state["pos"]
+    max_len = state["l1_self_k"].shape[2]
+    we = _embed_words(cfg, params, prev_ids, "trg")
+    # step 0 uses the zero embedding (Marian's no-BOS decoder start)
+    we = jnp.where(pos == 0, jnp.zeros_like(we), we)
+    x = _add_pos(cfg, params, we, pos)
+    x = _pre_post(cfg, _strip_dropout(cfg.postprocess_emb), x, None,
+                  "decoder_emb", params, None, False)
+    # self mask: [1,1,1,max_len] — attend to steps 0..pos
+    steps = jnp.arange(max_len)
+    self_mask = (steps <= pos).astype(cfg.compute_dtype)[None, None, None, :]
+    cross_mask = src_mask[:, None, None, :]
+    align = None
+    new_state = dict(state)
+    for l in range(1, cfg.dec_depth + 1):
+        pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
+                        f"decoder_l{l}_self_Wo", params, None, False)
+        cache = {"k": state[f"l{l}_self_k"], "v": state[f"l{l}_self_v"]}
+        out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre, self_mask,
+                      None, False, cache=cache, cache_pos=pos)
+        new_state[f"l{l}_self_k"] = cache["k"]
+        new_state[f"l{l}_self_v"] = cache["v"]
+        x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
+                      f"decoder_l{l}_self_Wo", params, None, False)
+
+        want_w = return_alignment and _is_alignment_layer(cfg, l)
+        pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
+                        f"decoder_l{l}_context_Wo", params, None, False)
+        cross_cache = {"k": state[f"l{l}_cross_k"], "v": state[f"l{l}_cross_v"]}
+        out, w = _mha(cfg, params, f"decoder_l{l}_context", pre, None,
+                      cross_mask, None, False, cache=cross_cache,
+                      static_kv=True, return_weights=want_w)
+        if want_w and w is not None:
+            align = w.mean(axis=1)[:, 0, :]  # [B, Ts]
+        x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
+                      f"decoder_l{l}_context_Wo", params, None, False)
+
+        pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
+                        f"decoder_l{l}_ffn_ffn", params, None, False)
+        out = _ffn(cfg, params, f"decoder_l{l}_ffn", pre, cfg.dec_ffn,
+                   cfg.dec_ffn_d, None, False)
+        x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
+                      f"decoder_l{l}_ffn_ffn", params, None, False)
+    x = _pre_post(cfg, _strip_dropout(cfg.postprocess_top), x, None,
+                  "decoder_top", params, None, False)
+    logits = output_logits(cfg, params, x[:, 0, :], shortlist)
+    new_state["pos"] = pos + 1
+    if return_alignment:
+        return logits, new_state, align
+    return logits, new_state
+
+
+def _strip_dropout(ops: str) -> str:
+    return ops.replace("d", "")
+
+
+def cast_params(params: Params, dtype) -> Params:
+    """Cast float params to the compute dtype (kept f32 in the optimizer)."""
+    return {k: (v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.floating) else v)
+            for k, v in params.items()}

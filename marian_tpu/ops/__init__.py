@@ -1,0 +1,5 @@
+from .ops import (layer_norm, rms_norm, dropout, activation, affine,
+                  masked_softmax, masked_log_softmax, cross_entropy,
+                  global_norm, clip_by_global_norm, NEG_INF)
+from .attention import (dense_attention, dense_attention_with_weights,
+                        causal_mask, combine_masks)

@@ -1,0 +1,66 @@
+"""Scaled dot-product attention — the MXU hot path.
+
+The reference implements attention as strided-batched cuBLAS GEMMs +
+masked-softmax kernels (src/tensors/gpu/prod.cpp :: ProdBatched,
+src/models/transformer.h :: MultiHead). Here the dense path is einsum-based
+(XLA maps it straight onto the MXU and fuses mask+softmax); a Pallas
+flash-attention kernel (ops/pallas/flash_attention.py) takes over for long
+sequences where the O(L²) score tensor would blow HBM bandwidth.
+
+Shapes are batch-major: q [B, H, Tq, Dh], k/v [B, H, Tk, Dh],
+mask [B, 1, Tq, Tk] (1 = attend).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .ops import NEG_INF, dropout as _dropout
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: Optional[jax.Array] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_key: Optional[jax.Array] = None,
+                    deterministic: bool = True) -> jax.Array:
+    """Returns ([B, H, Tq, Dh] context, attention weights are not returned;
+    use dense_attention_with_weights when alignments are needed)."""
+    out, _ = dense_attention_with_weights(
+        q, k, v, mask, dropout_rate, dropout_key, deterministic,
+        return_weights=False)
+    return out
+
+
+def dense_attention_with_weights(q, k, v, mask=None, dropout_rate=0.0,
+                                 dropout_key=None, deterministic=True,
+                                 return_weights=True):
+    dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32)).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k,
+                        preferred_element_type=jnp.float32)
+    if mask is not None:
+        scores = scores + (1.0 - mask.astype(scores.dtype)) * NEG_INF
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and not deterministic:
+        weights = _dropout(weights, dropout_rate, dropout_key)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return out, (weights if return_weights else None)
+
+
+def causal_mask(length: int, dtype=jnp.float32) -> jax.Array:
+    """[1, 1, T, T] future mask (reference: transformer.h triangle mask)."""
+    m = jnp.tril(jnp.ones((length, length), dtype=dtype))
+    return m[None, None, :, :]
+
+
+def combine_masks(*masks: Optional[jax.Array]) -> Optional[jax.Array]:
+    out = None
+    for m in masks:
+        if m is None:
+            continue
+        out = m if out is None else out * m
+    return out

@@ -1,0 +1,140 @@
+"""Core tensor ops. The reference implements these as hand-written CUDA
+kernels (src/tensors/gpu/tensor_operators.cu, element.cu, add_all.cu); here
+each is a few lines of jnp that XLA fuses into the surrounding computation —
+the per-node kernel dispatch the reference does at runtime collapses into one
+compiled program (SURVEY.md §2.3/§2.4).
+
+Numerics conventions kept from the reference:
+- layer_norm uses epsilon inside sqrt(var + eps) (gpu::LayerNormalization);
+- dropout uses inverted scaling (mask / keep_prob) with explicit PRNG keys
+  (the reference's cuRAND bernoulli nodes become functional masks);
+- masked softmax adds a large negative to masked logits pre-softmax.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative mask value; safe in bf16 (min normal ~ -3.4e38)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: Optional[jax.Array] = None,
+               eps: float = 1e-9) -> jax.Array:
+    """LayerNorm over the last axis (reference: gpu::LayerNormalization;
+    Marian's default eps is 1e-9)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, bias: Optional[jax.Array] = None,
+             eps: float = 1e-9) -> jax.Array:
+    """RMSNorm (reference: rmsNorm in expression_operators.cpp)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(ms + eps) * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def dropout(x: jax.Array, rate: float, key: Optional[jax.Array],
+            deterministic: bool = False) -> jax.Array:
+    """Inverted dropout with explicit key (reference: dropout nodes backed by
+    cuRAND bernoulli; PRNG-key discipline replaces device RNG state)."""
+    if deterministic or rate <= 0.0 or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x)
+
+
+def swish(x: jax.Array) -> jax.Array:
+    return x * jax.nn.sigmoid(x)
+
+
+ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "swish": swish,
+    "gelu": gelu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def activation(name: str):
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(f"Unknown activation '{name}'") from None
+
+
+def affine(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
+    """x @ w + b (reference: gpu::Affine / cublasLt fused bias). XLA fuses the
+    bias add; weights stored [in, out] like Marian."""
+    y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=x.dtype)
+    if b is not None:
+        y = y + b.astype(x.dtype)
+    return y
+
+
+def masked_log_softmax(logits: jax.Array, mask: Optional[jax.Array] = None,
+                       axis: int = -1) -> jax.Array:
+    if mask is not None:
+        logits = jnp.where(mask > 0, logits, NEG_INF)
+    return jax.nn.log_softmax(logits, axis=axis)
+
+
+def masked_softmax(logits: jax.Array, mask: Optional[jax.Array] = None,
+                   axis: int = -1) -> jax.Array:
+    """Softmax with additive log-mask (reference: gpu::Softmax with mask)."""
+    if mask is not None:
+        logits = logits + (1.0 - mask) * NEG_INF
+    return jax.nn.softmax(logits, axis=axis)
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  label_smoothing: float = 0.0) -> jax.Array:
+    """Per-position CE with Marian's label smoothing (reference:
+    gpu::CrossEntropyPick + layers/loss.cpp):
+      ce = (1-eps) * -logP(label) - eps * mean_v logP(v)
+    computed in f32 regardless of logit dtype."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(logp, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    return nll
+
+
+def global_norm(tree) -> jax.Array:
+    """L2 norm over a pytree of grads (reference: clippers.cpp norm over the
+    flat gradient arena)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+def clip_by_global_norm(tree, max_norm: float, norm: Optional[jax.Array] = None):
+    if max_norm <= 0:
+        return tree
+    if norm is None:
+        norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-8))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), tree)

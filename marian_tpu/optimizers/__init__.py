@@ -1,0 +1,2 @@
+from .optimizers import OptimizerConfig, init_state, apply_update, smoothed_params
+from .schedule import LRSchedule

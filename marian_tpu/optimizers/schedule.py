@@ -1,0 +1,51 @@
+"""Learning-rate schedule with Marian's warmup + inverse-sqrt decay
+(reference: src/training/scheduler.h :: Scheduler::getScheduledLRate).
+
+base * min(step/warmup, 1) * sqrt(warmup / max(step, warmup))   [inv-sqrt]
+
+Both warmup and inv-sqrt accept SchedulingParameters (updates or labels);
+the schedule function takes the current count in the matching unit.
+Discrete --lr-decay (epoch/batches/stalled strategies) is applied by the
+Scheduler as a multiplicative factor on top.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..common.scheduling_parameter import SchedulingParameter, SchedulingUnit
+
+
+@dataclasses.dataclass
+class LRSchedule:
+    base_lr: float
+    warmup: int = 0                  # in updates (or labels)
+    inv_sqrt: int = 0                # warmup constant for inv-sqrt decay
+    warmup_start_rate: float = 0.0
+    decay_factor: float = 1.0        # multiplicative, set by Scheduler
+
+    @classmethod
+    def from_options(cls, options) -> "LRSchedule":
+        warmup = SchedulingParameter.parse(str(options.get("lr-warmup", "0")))
+        inv_raw = options.get("lr-decay-inv-sqrt", ["0"])
+        if not isinstance(inv_raw, list):
+            inv_raw = [inv_raw]
+        inv = SchedulingParameter.parse(str(inv_raw[0]))
+        return cls(base_lr=float(options.get("learn-rate", 1e-4)),
+                   warmup=warmup.n, inv_sqrt=inv.n,
+                   warmup_start_rate=float(options.get("lr-warmup-start-rate", 0.0)))
+
+    def __call__(self, step) -> jnp.ndarray:
+        """step: 1-based update count (f32 scalar or python int)."""
+        step = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        lr = jnp.asarray(self.base_lr, jnp.float32)
+        if self.warmup > 0:
+            frac = jnp.minimum(step / float(self.warmup), 1.0)
+            start = self.warmup_start_rate
+            lr = start + (lr - start) * frac if start > 0 else lr * frac
+        if self.inv_sqrt > 0:
+            lr = lr * jnp.sqrt(float(self.inv_sqrt)
+                               / jnp.maximum(step, float(self.inv_sqrt)))
+        return lr * self.decay_factor

@@ -1,0 +1,5 @@
+from .graph_group import GraphGroup, TrainOutput
+from .scheduler import Scheduler
+from .training_state import TrainingState
+from .train import Train, train_main
+from .checkpoint import save_checkpoint, load_checkpoint

@@ -1,0 +1,56 @@
+"""Checkpoint bundle: model.npz (+ embedded config), model.npz.optimizer.npz,
+model.npz.progress.yml (reference layout: SURVEY.md §5 checkpoint/resume row;
+src/training/training.h restore logic + OptimizerBase::save/load)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..common import io as mio
+from ..common import logging as log
+from .training_state import TrainingState
+
+
+def save_checkpoint(model_path: str, params: Dict[str, Any], config_yaml: str,
+                    graph_group=None, state: Optional[TrainingState] = None,
+                    smooth_params: Optional[Dict[str, Any]] = None,
+                    overwrite_checkpoint: bool = True,
+                    suffix: str = "") -> None:
+    """Save model (+optimizer +progress). `suffix` e.g. '.best-bleu' for
+    per-metric best checkpoints (reference: validator keep-best files)."""
+    path = model_path + suffix + (".npz" if not model_path.endswith((".npz", ".bin")) else "")
+    if model_path.endswith((".npz", ".bin")):
+        base, ext = os.path.splitext(model_path)
+        path = base + suffix + ext
+    host_params = {k: np.asarray(v) for k, v in params.items()}
+    mio.save_model(path, host_params, config_yaml)
+    if smooth_params is not None:
+        base, ext = os.path.splitext(path)
+        mio.save_model(base + ".ema" + ext,
+                       {k: np.asarray(v) for k, v in smooth_params.items()},
+                       config_yaml)
+    if graph_group is not None and not suffix:
+        np.savez(path + ".optimizer.npz", **graph_group.optimizer_arrays())
+    if state is not None and not suffix:
+        state.save(path + ".progress.yml")
+    log.info("Saved model to {}", path)
+
+
+def load_checkpoint(model_path: str, graph_group=None
+                    ) -> Tuple[Dict[str, np.ndarray], Optional[str],
+                               Optional[TrainingState]]:
+    params, config = mio.load_model(model_path)
+    state = None
+    prog = model_path + ".progress.yml"
+    if os.path.exists(prog):
+        state = TrainingState.load(prog)
+    opt = model_path + ".optimizer.npz"
+    if graph_group is not None and os.path.exists(opt):
+        with np.load(opt) as z:
+            graph_group.load_optimizer_arrays({k: z[k] for k in z.files})
+    return params, config, state

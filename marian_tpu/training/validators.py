@@ -1,0 +1,88 @@
+"""Validators (reference: src/training/validator.cpp/.h): run on the dev set
+at --valid-freq, track best checkpoints, drive early stopping.
+
+Implemented: cross-entropy / ce-mean-words / perplexity (teacher-forced dev
+loss). bleu / chrf / translation validators run the jitted beam decoder —
+wired in translator/validators integration once BeamSearch lands (they are
+created here and import lazily).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common import logging as log
+from ..data import BatchGenerator, Corpus
+from ..models.encoder_decoder import batch_to_arrays
+
+
+class Validator:
+    name = "validator"
+    lower_is_better = True
+
+    def validate(self, params) -> float:
+        raise NotImplementedError
+
+
+class CrossEntropyValidator(Validator):
+    """cost on the validation set (reference: CrossEntValidator)."""
+
+    def __init__(self, options, vocabs, model, name: str = "cross-entropy"):
+        self.name = name
+        self.options = options
+        self.vocabs = vocabs
+        self.model = model
+        self._loss_jit = jax.jit(
+            lambda p, b: model.loss(p, b, key=None, train=False))
+
+    def validate(self, params) -> float:
+        opts = self.options
+        valid_sets = list(opts.get("valid-sets", []))
+        if not valid_sets:
+            return float("nan")
+        corpus = Corpus(valid_sets, self.vocabs,
+                        opts.with_(**{"max-length": opts.get("valid-max-length", 1000),
+                                      "max-length-crop": True,
+                                      "shuffle": "none"}),
+                        inference=False)
+        bg = BatchGenerator(corpus, None,
+                            mini_batch=int(self.options.get("valid-mini-batch", 32)),
+                            maxi_batch=10, shuffle_batches=False, prefetch=False)
+        total, labels = 0.0, 0.0
+        for batch in bg:
+            _, aux = self._loss_jit(params, batch_to_arrays(batch))
+            total += float(aux["ce_sum"])
+            labels += float(aux["labels"])
+        if labels == 0:
+            return float("nan")
+        if self.name == "perplexity":
+            import math
+            return math.exp(min(total / labels, 700.0))
+        if self.name in ("ce-mean-words",):
+            return total / labels
+        return total / labels if self.options.get("cost-type", "ce-sum") \
+            .startswith("ce-mean") else total
+
+
+def create_validators(options, vocabs, model) -> List[Validator]:
+    out: List[Validator] = []
+    if not options.get("valid-sets", []):
+        return out
+    for metric in options.get("valid-metrics", ["cross-entropy"]):
+        if metric in ("cross-entropy", "ce-mean-words", "perplexity"):
+            out.append(CrossEntropyValidator(options, vocabs, model, metric))
+        elif metric in ("bleu", "bleu-detok", "bleu-segmented", "chrf"):
+            from ..translator.validators import TranslationMetricValidator
+            out.append(TranslationMetricValidator(options, vocabs, model, metric))
+        elif metric == "translation":
+            from ..translator.validators import TranslationValidator
+            out.append(TranslationValidator(options, vocabs, model))
+        elif metric == "valid-script":
+            from ..translator.validators import ScriptValidator
+            out.append(ScriptValidator(options, vocabs, model))
+        else:
+            log.warn("Unknown valid-metric '{}' ignored", metric)
+    return out

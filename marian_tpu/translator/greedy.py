@@ -1,0 +1,35 @@
+"""Greedy decoding — the minimal incremental-decode path (used by tests and
+as the beam-size-1 fast path). Runs the same start_state/step API as
+BeamSearch (reference: the b=1 special case of beam_search.cpp)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.vocab import EOS_ID
+
+
+def greedy_decode(model, params, src_ids: jnp.ndarray, src_mask: jnp.ndarray,
+                  max_len: int) -> np.ndarray:
+    """Returns [B, max_len] int32 output ids, EOS-padded after finish."""
+    b = src_ids.shape[0]
+    enc_out = model.encode_for_decode(params, src_ids, src_mask)
+    state = model.start_state(params, enc_out, src_mask, max_len)
+    prev = jnp.zeros((b, 1), jnp.int32)  # ignored at step 0 (zero embedding)
+    finished = jnp.zeros((b,), bool)
+    outs = []
+    step_fn = jax.jit(lambda p, s, pr: model.step(p, s, pr, src_mask))
+    for _ in range(max_len):
+        logits, state = step_fn(params, state, prev)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, EOS_ID, nxt)
+        outs.append(nxt)
+        finished = finished | (nxt == EOS_ID)
+        prev = nxt[:, None]
+        if bool(jnp.all(finished)):
+            break
+    return np.asarray(jnp.stack(outs, axis=1))
