@@ -69,13 +69,19 @@ def _get(name: str) -> logging.Logger:
 def log(level: str, msg: str, *args) -> None:
     """LOG(info, "...") equivalent; {} placeholders like spdlog."""
     if args:
-        msg = msg.replace("{}", "%s") % args
+        try:
+            msg = msg.format(*args)
+        except (IndexError, KeyError, ValueError):
+            msg = f"{msg} {args}"
     _get("general").log(_LEVELS.get(level, logging.INFO), msg)
 
 
 def log_valid(level: str, msg: str, *args) -> None:
     if args:
-        msg = msg.replace("{}", "%s") % args
+        try:
+            msg = msg.format(*args)
+        except (IndexError, KeyError, ValueError):
+            msg = f"{msg} {args}"
     _get("valid").log(_LEVELS.get(level, logging.INFO), msg)
 
 

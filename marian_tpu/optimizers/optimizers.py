@@ -70,7 +70,10 @@ def init_state(cfg: OptimizerConfig, params: Params) -> Dict[str, Any]:
     elif cfg.name != "sgd":
         raise ValueError(f"Unknown optimizer '{cfg.name}'")
     if cfg.smoothing > 0:
-        st["avg"] = {k: v.astype(jnp.float32) for k, v in params.items()}
+        # copy=True: astype on an f32 array is a no-op alias, and aliasing
+        # params here makes jit buffer donation see the same buffer twice
+        st["avg"] = {k: jnp.array(v, dtype=jnp.float32, copy=True)
+                     for k, v in params.items()}
     return st
 
 

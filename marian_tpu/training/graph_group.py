@@ -73,7 +73,8 @@ class GraphGroup:
                    init_params: Optional[Params] = None) -> None:
         self.params = init_params if init_params is not None \
             else self.model.init(key)
-        self.opt_state = init_state(self.opt_cfg, self.params)
+        if self.opt_state is None:  # keep state restored from checkpoint
+            self.opt_state = init_state(self.opt_cfg, self.params)
         self._build()
 
     def _build(self) -> None:
