@@ -1,0 +1,2 @@
+from . import mesh, zero
+from .mesh import make_mesh, initialize_distributed

@@ -1,0 +1,142 @@
+"""ZeRO-1 data-parallel training step — the heart of the rebuild
+(reference: src/training/graph_group_sync.cpp :: SyncGraphGroup::update +
+communicator_nccl.h :: NCCLCommunicator::scatterReduceAndResetGrads /
+allGatherParams; SURVEY.md §2.7 "TPU-native equivalent").
+
+One jitted function contains the full SyncGraphGroup cycle:
+
+    per-shard fwd/bwd on the data-sharded batch
+      → (GSPMD-inserted) reduce-scatter of gradients over 'data'
+      → global-norm clip (psum'd norm), per-shard Adam update on the
+        PartitionSpec('data') optimizer state
+      → (GSPMD-inserted) all-gather of updated params back to replicated
+
+The collectives are not written by hand: annotating the optimizer state
+sharded and the params replicated makes XLA's SPMD partitioner emit exactly
+the reduce-scatter + all-gather pattern (cf. "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training", arXiv:2004.13336 —
+implemented in XLA; PAPERS.md). On a 1-device mesh the same program runs
+collective-free — single-chip and pod training share one code path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..optimizers.optimizers import OptimizerConfig, apply_update
+from ..ops.ops import clip_by_global_norm, global_norm
+from . import mesh as M
+
+Params = Dict[str, jax.Array]
+
+
+def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
+                     mesh: Mesh, params: Params, opt_state,
+                     delay: int = 1, donate: bool = True):
+    """Returns a jitted fn(params, opt_state, batch, step) →
+    (params, opt_state, metrics) with SyncGraphGroup semantics.
+
+    `batch` leaves carry a leading micro-batch axis of size `delay` when
+    delay > 1 (accumulation by lax.scan inside the step — no host round-trip
+    per micro-batch, unlike the reference's per-delay-loop host logic).
+    """
+
+    def loss_fn(p, b, rng):
+        total, aux = model.loss(p, b, rng, train=True)
+        return total, aux
+
+    def grads_of(p, b, rng):
+        (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b, rng)
+        return g, aux
+
+    def step_fn(p, opt_state, batch, step, rng):
+        if delay > 1:
+            def body(carry, micro):
+                acc, tot, lab = carry
+                g, aux = grads_of(p, micro, rng)
+                acc = jax.tree_util.tree_map(jnp.add, acc, g)
+                return (acc, tot + aux["ce_sum"], lab + aux["labels"]), None
+            zeros = jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), p)
+            (grads, ce_sum, labels), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)), batch)
+        else:
+            grads, aux = grads_of(p, batch, rng)
+            ce_sum, labels = aux["ce_sum"], aux["labels"]
+
+        # cost normalization → gradient scale (Marian's costScaleFactor)
+        if cost_type in ("ce-mean-words", "perplexity"):
+            denom = jnp.maximum(labels, 1.0)
+        elif cost_type == "ce-mean":
+            bsz = (batch["trg_ids"].shape[0] if delay == 1
+                   else batch["trg_ids"].shape[0] * batch["trg_ids"].shape[1])
+            denom = jnp.asarray(float(bsz), jnp.float32)
+        else:
+            denom = jnp.asarray(1.0, jnp.float32)
+        grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+
+        gnorm = global_norm(grads)
+        if opt_cfg.clip_norm > 0:
+            grads = clip_by_global_norm(grads, opt_cfg.clip_norm, gnorm)
+        lr = schedule(step)
+        new_opt, new_p = apply_update(opt_cfg, opt_state, p, grads, lr, labels)
+        metrics = {"ce_sum": ce_sum, "labels": labels, "gnorm": gnorm,
+                   "lr": lr}
+        return new_p, new_opt, metrics
+
+    rep = M.replicated(mesh)
+    p_shardings = jax.tree_util.tree_map(lambda _: rep, params)
+    o_shardings = M.zero1_tree_shardings(opt_state, mesh)
+    b_sharding = NamedSharding(mesh, P(None, "data") if delay > 1 else P("data"))
+    metrics_shardings = {"ce_sum": rep, "labels": rep, "gnorm": rep, "lr": rep}
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(p_shardings, o_shardings, b_sharding, rep, rep),
+        out_shardings=(p_shardings, o_shardings, metrics_shardings),
+        donate_argnums=(0, 1) if donate else ())
+
+
+def place(params, opt_state, mesh: Mesh):
+    """Put params replicated and optimizer state ZeRO-1-sharded on the mesh
+    (reference: SyncGraphGroup::initialize laying out per-device shards)."""
+    params = jax.device_put(
+        params, jax.tree_util.tree_map(lambda _: M.replicated(mesh), params))
+    opt_state = jax.device_put(opt_state, M.zero1_tree_shardings(opt_state, mesh))
+    return params, opt_state
+
+
+# ---------------------------------------------------------------------------
+# driver dry-run (called by __graft_entry__.dryrun_multichip)
+# ---------------------------------------------------------------------------
+
+def dryrun(n_devices: int, options, batch_maker, vocab: int = 256) -> None:
+    import numpy as np
+    from ..models.encoder_decoder import create_model
+    from ..optimizers.optimizers import init_state
+    from ..optimizers.schedule import LRSchedule
+
+    devices = jax.devices()[:n_devices]
+    mesh = M.make_mesh(options, devices)
+    model = create_model(options, vocab, vocab)
+    params = model.init(jax.random.key(0))
+    opt_cfg = OptimizerConfig.from_options(options)
+    opt_state = init_state(opt_cfg, params)
+    params, opt_state = place(params, opt_state, mesh)
+    schedule = LRSchedule.from_options(options)
+    step = build_train_step(model, opt_cfg, schedule,
+                            options.get("cost-type", "ce-sum"), mesh,
+                            params, opt_state, delay=1, donate=False)
+    batch = batch_maker(8 * max(1, mesh.shape["data"]), 16, 16, vocab)
+    batch = M.shard_batch(batch, mesh)
+    p2, o2, metrics = step(params, opt_state,
+                           batch, jnp.asarray(1.0, jnp.float32),
+                           jax.random.key(1))
+    jax.block_until_ready(p2)
+    assert np.isfinite(float(metrics["ce_sum"]))

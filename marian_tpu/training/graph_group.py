@@ -1,42 +1,45 @@
 """The training-step engine — equivalent of the reference's GraphGroup stack
-(src/training/graph_group_sync.cpp :: SyncGraphGroup::update).
+(src/training/graph_group_sync.cpp :: SyncGraphGroup::update,
+graph_group.cpp :: GraphGroup base).
 
 Where the reference spawns one host thread per GPU, builds a tape per
 replica, reduce-scatters gradients over NCCL, Adam-updates a 1/N parameter
 shard per device and all-gathers params, here ONE jitted function contains
-the whole cycle and GSPMD/shard_map inserts the identical collectives over
-ICI (SURVEY.md §2.7). Single-device is the same program on a 1-device mesh.
+the whole cycle and GSPMD inserts the identical collectives over ICI
+(parallel/zero.py). A single device is the same program on a 1-device mesh —
+SingletonGraph (graph_group_singleton.cpp) is not a separate code path.
 
 Semantics carried over exactly:
-- --optimizer-delay N: accumulate N micro-batch gradients, then one update
-  (gradients summed, label counts summed; ce-sum normalization divides by
-  accumulated labels like Marian's costScaleFactor path);
+- --optimizer-delay N: accumulate N micro-batch gradients, then one update;
+  gradient normalization follows the cost-type (ce-mean-words divides the
+  accumulated gradient by the accumulated label count, like Marian's
+  costScaleFactor);
 - clip-then-update order: global-norm clip on the FULL gradient before the
-  optimizer shard update;
-- EMA (exponential smoothing) updated after each optimizer step;
-- loss reported as the cost-type value over the accumulated batch.
-
-ZeRO-1 sharding: optimizer state lives sharded over the 'data' mesh axis via
-NamedSharding(P('data')) on the flattened leading dim — see parallel/zero.py
-wired in train.py; this module stays sharding-agnostic (the same code runs
-replicated or sharded because collectives are inserted by the compiler from
-output shardings).
+  sharded optimizer update;
+- EMA (exponential smoothing) updated after each optimizer step, stored with
+  the sharded optimizer state;
+- async-SGD (--sync-sgd false) intentionally maps to sync with a warning —
+  hogwild updates have no TPU/SPMD equivalent and sync is the reference's
+  recommended path (AsyncGraphGroup is legacy).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..common import logging as log
 from ..models.encoder_decoder import EncoderDecoder
 from ..optimizers.optimizers import (OptimizerConfig, apply_update, init_state,
                                      smoothed_params)
 from ..optimizers.schedule import LRSchedule
 from ..ops.ops import clip_by_global_norm, global_norm
+from ..parallel import mesh as M
+from ..parallel.zero import build_train_step, place
 
 Params = Dict[str, jax.Array]
 
@@ -49,7 +52,7 @@ class TrainOutput:
 
 
 class GraphGroup:
-    """Builds and owns the jitted grad/update functions + optimizer state."""
+    """Owns params + sharded optimizer state + the jitted step functions."""
 
     def __init__(self, model: EncoderDecoder, options,
                  mesh: Optional[jax.sharding.Mesh] = None,
@@ -59,14 +62,16 @@ class GraphGroup:
         self.opt_cfg = OptimizerConfig.from_options(options)
         self.schedule = LRSchedule.from_options(options)
         self.delay = max(1, int(float(options.get("optimizer-delay", 1))))
-        self.mesh = mesh
+        if options.has("sync-sgd") and options.get("sync-sgd") is False:
+            log.warn("Asynchronous SGD has no SPMD equivalent; using sync-sgd")
+        self.mesh = mesh if mesh is not None else M.make_mesh(options)
+        self.cost_type = options.get("cost-type", "ce-sum")
         self.params: Optional[Params] = None
         self.opt_state: Optional[Dict[str, Any]] = None
+        self._donate = donate
+        self._fused = None
         self._grad_fn = None
         self._update_fn = None
-        self._accum = None
-        self._accum_count = 0
-        self._donate = donate
 
     # -- init / load --------------------------------------------------------
     def initialize(self, key: jax.Array,
@@ -75,74 +80,84 @@ class GraphGroup:
             else self.model.init(key)
         if self.opt_state is None:  # keep state restored from checkpoint
             self.opt_state = init_state(self.opt_cfg, self.params)
+        self.params, self.opt_state = place(self.params, self.opt_state,
+                                            self.mesh)
         self._build()
 
     def _build(self) -> None:
-        model = self.model
+        mesh = self.mesh
+        rep = M.replicated(mesh)
+        p_sh = jax.tree_util.tree_map(lambda _: rep, self.params)
+        o_sh = M.zero1_tree_shardings(self.opt_state, mesh)
+        b_sh = NamedSharding(mesh, P("data"))
+        model, opt_cfg, schedule = self.model, self.opt_cfg, self.schedule
 
-        def loss_fn(params, batch, rng):
-            total, aux = model.loss(params, batch, rng, train=True)
-            # normalize by labels inside grad so accumulation averages per
-            # label (Marian normalizes the summed cost by the label count of
-            # the accumulated batch at display/update time; dividing by the
-            # per-micro-batch labels and weighting at accumulation keeps
-            # gradients identical for delay=1 and proportional otherwise)
-            return total, aux
+        # fused single-batch step (the hot path; delay==1)
+        self._fused = build_train_step(model, opt_cfg, schedule,
+                                       self.cost_type, mesh, self.params,
+                                       self.opt_state, delay=1,
+                                       donate=self._donate)
 
-        def grad_step(params, batch, rng):
+        # split path for --optimizer-delay with heterogeneous batch shapes
+        def grad_step(p, batch, rng):
+            def loss_fn(pp, b, r):
+                return model.loss(pp, b, r, train=True)
             (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, batch, rng)
+                p, batch, rng)
             return grads, aux
 
-        def update_step(params, opt_state, grads, step, labels, mb_words):
-            # Marian divides the accumulated gradient by the cost scale /
-            # normalizer: for ce-sum the effective grad is sum over labels.
-            gnorm = global_norm(grads)
-            if self.opt_cfg.clip_norm > 0:
-                grads = clip_by_global_norm(grads, self.opt_cfg.clip_norm, gnorm)
-            lr = self.schedule(step)
-            opt_state, params = apply_update(self.opt_cfg, opt_state, params,
-                                             grads, lr, mb_words)
-            return params, opt_state, gnorm, lr
+        self._grad_fn = jax.jit(grad_step, in_shardings=(p_sh, b_sh, rep))
 
-        self._grad_fn = jax.jit(grad_step)
-        donate = (0, 1, 2) if self._donate else ()
-        self._update_fn = jax.jit(update_step, donate_argnums=donate)
+        def update_step(p, opt_state, grads, step, labels, n_sents):
+            if self.cost_type in ("ce-mean-words", "perplexity"):
+                denom = jnp.maximum(labels, 1.0)
+            elif self.cost_type == "ce-mean":
+                denom = jnp.maximum(n_sents, 1.0)
+            else:
+                denom = jnp.asarray(1.0, jnp.float32)
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            gnorm = global_norm(grads)
+            if opt_cfg.clip_norm > 0:
+                grads = clip_by_global_norm(grads, opt_cfg.clip_norm, gnorm)
+            lr = schedule(step)
+            new_opt, new_p = apply_update(opt_cfg, opt_state, p, grads, lr,
+                                          labels)
+            return new_p, new_opt, gnorm, lr
+
+        self._update_fn = jax.jit(
+            update_step,
+            in_shardings=(p_sh, o_sh, p_sh, rep, rep, rep),
+            out_shardings=(p_sh, o_sh, rep, rep),
+            donate_argnums=(0, 1, 2) if self._donate else ())
 
     # -- one (macro-)update --------------------------------------------------
     def update(self, batches, step: int, rng) -> TrainOutput:
-        """batches: list of `delay` micro-batch dicts (device arrays)."""
-        if not isinstance(batches, (list, tuple)):
+        """batches: one batch dict, or a list of `delay` micro-batch dicts."""
+        if isinstance(batches, dict):
             batches = [batches]
-        total_loss = 0.0
-        total_labels = 0.0
+        if len(batches) == 1:
+            b = M.shard_batch(batches[0], self.mesh)
+            self.params, self.opt_state, metrics = self._fused(
+                self.params, self.opt_state, b,
+                jnp.asarray(step, jnp.float32), rng)
+            return TrainOutput(float(metrics["ce_sum"]),
+                               float(metrics["labels"]),
+                               float(metrics["gnorm"]))
+        total_loss = total_labels = n_sents = 0.0
         grads_acc = None
         for i, b in enumerate(batches):
             r = jax.random.fold_in(rng, i)
-            grads, aux = self._grad_fn(self.params, b, r)
+            grads, aux = self._grad_fn(self.params, M.shard_batch(b, self.mesh), r)
             total_loss += float(aux["ce_sum"])
             total_labels += float(aux["labels"])
-            if grads_acc is None:
-                grads_acc = grads
-            else:
-                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-        # normalize accumulated grads the way the reference normalizes cost:
-        # ce-sum → divide by total labels (so LR is per-label scale-free)
-        cost_type = self.options.get("cost-type", "ce-sum")
-        if cost_type in ("ce-mean-words", "perplexity"):
-            denom = max(total_labels, 1.0)
-        elif cost_type == "ce-mean":
-            denom = float(sum(int(b["trg_ids"].shape[0]) for b in batches))
-        else:  # ce-sum: gradient of the plain sum
-            denom = 1.0
-        if denom != 1.0:
-            grads_acc = jax.tree_util.tree_map(
-                lambda g: g / denom, grads_acc)
-        self.params, self.opt_state, gnorm, lr = self._update_fn(
+            n_sents += int(b["trg_ids"].shape[0])
+            grads_acc = grads if grads_acc is None else \
+                jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+        self.params, self.opt_state, gnorm, _lr = self._update_fn(
             self.params, self.opt_state, grads_acc,
             jnp.asarray(step, jnp.float32),
             jnp.asarray(total_labels, jnp.float32),
-            jnp.asarray(total_labels, jnp.float32))
+            jnp.asarray(n_sents, jnp.float32))
         return TrainOutput(total_loss, total_labels, float(gnorm))
 
     # -- EMA access for validation/saving -----------------------------------
@@ -151,9 +166,8 @@ class GraphGroup:
 
     # -- checkpoint glue -----------------------------------------------------
     def optimizer_arrays(self) -> Dict[str, Any]:
-        """Flatten optimizer state for .optimizer.npz saving (reference:
-        OptimizerBase::save gathers shards via scatterState/gatherState —
-        jax.device_get here plays that role)."""
+        """Gather (device_get) sharded optimizer state for .optimizer.npz —
+        the role of the reference's scatterState/gatherState shard IO."""
         import numpy as np
         flat: Dict[str, Any] = {"t": np.asarray(self.opt_state["t"])}
         for part in ("m", "v", "gt", "avg"):

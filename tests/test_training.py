@@ -182,7 +182,7 @@ class TestEMAAndDelay:
                        Options({"max-length": 64, "shuffle": "none"}))
             bg = BatchGenerator(c, mini_batch=4, maxi_batch=1, prefetch=False,
                                 shuffle_batches=False, pad_batch=True,
-                                batch_multiple=4)
+                                batch_multiple=8)
             batches = [batch_to_arrays(b) for b in list(bg)[:2]]
             o = opts.with_(**{"optimizer-delay": 2 if delayed else 1})
             gg = GraphGroup(model, o, donate=False)
