@@ -1,0 +1,291 @@
+"""Batched beam search, jit-compiled with static shapes.
+
+Rebuild of reference src/translator/beam_search.cpp :: BeamSearch::search and
+translator/nth_element.cu (fused beam×vocab top-k). The reference purges
+finished sentences from the batch (shapes shrink every few steps) and appends
+to growing K/V tensors; under XLA both become masking over fixed shapes:
+
+- state = (tokens [B,K,L], scores [B,K], finished [B,K], KV caches [B*K,...])
+  inside a lax.while_loop over decode positions with an all-finished early
+  exit — shapes never change, so ONE compiled program serves every batch of
+  the same (B, Ts, L) bucket;
+- the reference's NthElement GPU kernel is jax.lax.top_k over the flattened
+  beam×vocab axis (XLA lowers to a TPU-native sort/top-k);
+- finished beams are frozen by forcing their token distribution to
+  {EOS: 0.0} so path scores stop changing;
+- beam expansion at t=0 is masked to beam 0 (all beams start identical).
+
+Semantics kept from the reference: Marian's score bookkeeping (cumulative
+log-prob; length normalization score/len^alpha and word penalty applied when
+ranking finished hypotheses), --allow-unk suppression, n-best, ensembles
+(weighted log-prob sum across scorers), lexical shortlist (top-k runs in
+shortlist coordinates, tokens mapped back through the per-batch index set).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.vocab import EOS_ID, UNK_ID
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class BeamConfig:
+    beam_size: int = 6
+    normalize: float = 0.6          # length-normalization alpha (0 = off)
+    word_penalty: float = 0.0
+    allow_unk: bool = False
+    max_length: int = 256           # decode cap L (static)
+    n_best: int = 1
+    return_alignment: bool = False
+
+    @classmethod
+    def from_options(cls, options, max_length: int) -> "BeamConfig":
+        norm = options.get("normalize", 0.0)
+        if norm is True:
+            norm = 1.0
+        return cls(
+            beam_size=int(options.get("beam-size", 6)),
+            normalize=float(norm or 0.0),
+            word_penalty=float(options.get("word-penalty", 0.0) or 0.0),
+            allow_unk=bool(options.get("allow-unk", False)),
+            max_length=max_length,
+            n_best=int(options.get("beam-size", 6))
+            if options.get("n-best", False) else 1,
+            return_alignment=options.get("alignment", None) is not None,
+        )
+
+
+def _flatten_beams(x: jax.Array) -> jax.Array:
+    return x.reshape((-1,) + x.shape[2:])
+
+
+def _expand_to_beams(x: jax.Array, k: int) -> jax.Array:
+    """[B, ...] → [B*K, ...] by repeat (encoder outputs shared per beam)."""
+    return jnp.repeat(x, k, axis=0)
+
+
+def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
+                    weights: Sequence[float], cfg: BeamConfig,
+                    src_ids: jax.Array, src_mask: jax.Array,
+                    shortlist: Optional[jax.Array] = None):
+    """The jittable core. Returns (tokens [B,K,L], raw_scores [B,K],
+    lengths [B,K], norm_scores [B,K], alignments [B,K,L,Ts] or None).
+
+    params_list/weights: ensemble of scorers (reference: scorers.h); each
+    scorer keeps its own decode state, log-probs are weight-summed.
+    """
+    b = src_ids.shape[0]
+    k = cfg.beam_size
+    L = cfg.max_length
+    bk = b * k
+
+    # encoder once per scorer; expand rows to B*K (reference: startState then
+    # flattened batch×beam decoding)
+    src_mask_bk = _expand_to_beams(src_mask, k)
+    states = []
+    for params in params_list:
+        enc = model.encode_for_decode(params, src_ids, src_mask)
+        enc_bk = _expand_to_beams(enc, k)
+        states.append(model.start_state(params, enc_bk, src_mask_bk, L))
+
+    vocab = (shortlist.shape[0] if shortlist is not None
+             else model.cfg.trg_vocab)
+
+    tokens0 = jnp.zeros((b, k, L), jnp.int32)
+    scores0 = jnp.where(jnp.arange(k)[None, :] == 0, 0.0, NEG_INF
+                        ).astype(jnp.float32).repeat(b, axis=0).reshape(b, k)
+    finished0 = jnp.zeros((b, k), bool)
+    lengths0 = jnp.zeros((b, k), jnp.int32)
+    prev0 = jnp.zeros((bk, 1), jnp.int32)
+    aligns0 = (jnp.zeros((b, k, L, src_ids.shape[1]), jnp.float32)
+               if cfg.return_alignment else jnp.zeros((0,), jnp.float32))
+
+    def cond(carry):
+        t, _tokens, _scores, finished, _lengths, _prev, _states, _al = carry
+        return jnp.logical_and(t < L, ~jnp.all(finished))
+
+    def body(carry):
+        t, tokens, scores, finished, lengths, prev, states, aligns = carry
+        # ensemble log-probs
+        logp = None
+        align_t = None
+        new_states = []
+        for params, st, w in zip(params_list, states, weights):
+            if cfg.return_alignment:
+                logits, st2, al = model.step(params, st, prev, src_mask_bk,
+                                             shortlist=shortlist,
+                                             return_alignment=True)
+                align_t = al if align_t is None else align_t + al
+            else:
+                logits, st2 = model.step(params, st, prev, src_mask_bk,
+                                         shortlist=shortlist)
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = w * lp if logp is None else logp + w * lp
+            new_states.append(st2)
+        logp = logp.reshape(b, k, vocab)
+
+        if not cfg.allow_unk and shortlist is None:
+            logp = logp.at[:, :, UNK_ID].set(NEG_INF)
+
+        # frozen finished beams: only EOS, with log-prob 0
+        eos_onehot = jnp.where(jnp.arange(vocab)[None, None, :] == _eos_index(shortlist),
+                               0.0, NEG_INF)
+        logp = jnp.where(finished[:, :, None], eos_onehot, logp)
+
+        combined = scores[:, :, None] + logp            # [B,K,V]
+        flat = combined.reshape(b, k * vocab)
+        top_scores, top_idx = jax.lax.top_k(flat, k)    # [B,K]
+        beam_idx = top_idx // vocab                     # [B,K] source beam
+        tok_sl = top_idx % vocab                        # token in (shortlist) coords
+        tok_full = (shortlist[tok_sl] if shortlist is not None
+                    else tok_sl).astype(jnp.int32)
+
+        # reorder beam-carried state by beam_idx
+        def reorder(x):  # [B,K,...] gather along K
+            return jnp.take_along_axis(
+                x, beam_idx.reshape(beam_idx.shape + (1,) * (x.ndim - 2)), axis=1)
+
+        tokens = reorder(tokens)
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, tok_full.astype(jnp.int32), t, axis=2)
+        was_finished = reorder(finished.astype(jnp.int32)).astype(bool)
+        lengths = reorder(lengths)
+        if cfg.return_alignment:
+            aligns = reorder(aligns)
+            al = align_t.reshape(b, k, -1)
+            al = reorder(al)
+            aligns = jax.lax.dynamic_update_index_in_dim(aligns, al, t, axis=2)
+
+        now_eos = tok_full == _eos_token(shortlist)
+        new_finished = was_finished | now_eos
+        # length counts tokens incl. EOS (Marian hypothesis length)
+        lengths = jnp.where(was_finished, lengths, t + 1)
+        scores = top_scores
+
+        # reorder each scorer's KV caches: rows are b*k, new row j takes old
+        # row (batch*k + beam_idx)
+        flat_src = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)  # [B*K]
+
+        def reorder_state(st):
+            out = {}
+            for key, v in st.items():
+                if key == "pos":
+                    out[key] = v
+                elif key.endswith(("_self_k", "_self_v")):
+                    out[key] = v[flat_src]
+                else:  # cross K/V are beam-invariant after expansion
+                    out[key] = v
+            return out
+
+        states2 = tuple(reorder_state(st) for st in new_states)
+        prev = tok_full.reshape(bk, 1)
+        return (t + 1, tokens, scores, new_finished, lengths, prev, states2,
+                aligns)
+
+    init = (jnp.zeros((), jnp.int32), tokens0, scores0, finished0, lengths0,
+            prev0, tuple(states), aligns0)
+    (t, tokens, scores, finished, lengths, prev, states, aligns) = \
+        jax.lax.while_loop(cond, body, init)
+
+    # unfinished beams at L: length = L
+    lengths = jnp.where(finished, lengths, L)
+    norm = jnp.ones_like(scores)
+    if cfg.normalize > 0:
+        norm = jnp.power(lengths.astype(jnp.float32), cfg.normalize)
+    norm_scores = scores / norm - cfg.word_penalty * lengths.astype(jnp.float32)
+    return tokens, scores, lengths, norm_scores, \
+        (aligns if cfg.return_alignment else None)
+
+
+def _eos_index(shortlist: Optional[jax.Array]):
+    """Index of EOS in (shortlist) coordinates. The shortlist generator always
+    places EOS_ID=0 at position 0 (sorted unique ids)."""
+    return 0 if shortlist is not None else EOS_ID
+
+
+def _eos_token(shortlist: Optional[jax.Array]):
+    return EOS_ID
+
+
+class BeamSearch:
+    """Host-side wrapper: jit cache per (B, Ts, L) bucket, Histories out
+    (reference: BeamSearch::search + translator.h per-batch loop)."""
+
+    def __init__(self, model, params_list, weights: Optional[Sequence[float]],
+                 options, trg_vocab):
+        self.model = model
+        self.params_list = params_list
+        n = len(params_list)
+        self.weights = list(weights) if weights else [1.0 / max(n, 1)] * n
+        self.options = options
+        self.trg_vocab = trg_vocab
+        self.max_length_factor = float(options.get("max-length-factor", 3.0))
+        self.max_length_cap = int(options.get("max-length", 1000))
+        self._jitted = {}
+
+    def _get_fn(self, cfg: BeamConfig, has_shortlist: bool):
+        key = (cfg, has_shortlist)
+        if key not in self._jitted:
+            model, weights = self.model, tuple(self.weights)
+
+            def fn(params_list, src_ids, src_mask, shortlist=None):
+                return beam_search_jit(model, list(params_list), weights, cfg,
+                                       src_ids, src_mask, shortlist)
+
+            self._jitted[key] = jax.jit(fn)
+        return self._jitted[key]
+
+    def search(self, src_ids: np.ndarray, src_mask: np.ndarray,
+               shortlist=None) -> List[List[dict]]:
+        """Returns per-sentence n-best lists of dicts
+        {tokens, score, norm_score, alignment}."""
+        b, ts = src_ids.shape
+        # static decode cap per source bucket (Marian: factor * src length)
+        L = int(min(self.max_length_cap,
+                    max(8, round(self.max_length_factor * ts))))
+        cfg = BeamConfig.from_options(self.options, L)
+        sl_idx = jnp.asarray(shortlist.indices) if shortlist is not None else None
+        fn = self._get_fn(cfg, sl_idx is not None)
+        args = (tuple(self.params_list), jnp.asarray(src_ids),
+                jnp.asarray(src_mask))
+        if sl_idx is not None:
+            tokens, scores, lengths, norm_scores, aligns = fn(*args, sl_idx)
+        else:
+            tokens, scores, lengths, norm_scores, aligns = fn(*args)
+        return self._collect(np.asarray(tokens), np.asarray(scores),
+                             np.asarray(lengths), np.asarray(norm_scores),
+                             None if aligns is None else np.asarray(aligns),
+                             cfg)
+
+    def _collect(self, tokens, scores, lengths, norm_scores, aligns,
+                 cfg: BeamConfig) -> List[List[dict]]:
+        b, k, L = tokens.shape
+        out = []
+        for i in range(b):
+            order = np.argsort(-norm_scores[i])
+            nbest = []
+            for rank in range(min(cfg.n_best, k) if cfg.n_best > 1 else 1):
+                j = order[rank]
+                ln = int(lengths[i, j])
+                toks = tokens[i, j, :ln].tolist()
+                if toks and toks[-1] == EOS_ID:
+                    toks = toks[:-1]
+                entry = {
+                    "tokens": toks,
+                    "score": float(scores[i, j]),
+                    "norm_score": float(norm_scores[i, j]),
+                }
+                if aligns is not None:
+                    entry["alignment"] = aligns[i, j, :ln, :]
+                nbest.append(entry)
+            out.append(nbest)
+        return out

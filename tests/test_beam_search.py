@@ -1,0 +1,211 @@
+"""Beam-search tests: the jitted static-shape beam must match an independent
+host-loop reference beam exactly on tiny models (the reference pins decode
+outputs in its regression suite — SURVEY.md §4/§7 stage-4 gate)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.vocab import EOS_ID, UNK_ID
+from marian_tpu.models.encoder_decoder import create_model
+from marian_tpu.translator.beam_search import BeamSearch, BeamConfig, beam_search_jit
+from marian_tpu.translator.greedy import greedy_decode
+
+
+def tiny_model(vocab=19, seed=0, **over):
+    base = {
+        "type": "transformer",
+        "dim-emb": 16, "transformer-heads": 2, "transformer-dim-ffn": 32,
+        "enc-depth": 1, "dec-depth": 1, "tied-embeddings-all": True,
+        "precision": ["float32", "float32"], "max-length": 64,
+    }
+    base.update(over)
+    opts = Options(base)
+    model = create_model(opts, vocab, vocab, inference=True)
+    params = model.init(jax.random.key(seed))
+    return model, params, opts
+
+
+def reference_beam(model, params, src_ids, src_mask, k, L, normalize=0.0,
+                   allow_unk=False):
+    """Plain-python beam search over model.step — deliberately different
+    control flow from the jitted version (dynamic beam lists, no masking)."""
+    b = src_ids.shape[0]
+    results = []
+    for i in range(b):
+        sid = jnp.asarray(src_ids[i:i + 1])
+        smask = jnp.asarray(src_mask[i:i + 1])
+        enc = model.encode_for_decode(params, sid, smask)
+        enc_k = jnp.repeat(enc, 1, axis=0)
+        # beams: list of (tokens, score, state, finished)
+        state0 = model.start_state(params, enc, smask, L)
+        beams = [([], 0.0, state0, False)]
+        finished = []
+        for t in range(L):
+            cands = []
+            for toks, score, st, fin in beams:
+                if fin:
+                    continue
+                prev = jnp.asarray([[toks[-1] if toks else 0]], jnp.int32)
+                logits, st2 = model.step(params, st, prev, smask)
+                lp = np.array(jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1))[0]
+                if not allow_unk:
+                    lp[UNK_ID] = -1e9
+                for v in np.argsort(-lp)[: k + 1]:
+                    cands.append((toks + [int(v)], score + float(lp[v]), st2,
+                                  int(v) == EOS_ID))
+            if not cands:
+                break
+            cands.sort(key=lambda c: -c[1])
+            beams = []
+            for c in cands[:k]:
+                if c[3]:
+                    finished.append(c)
+                else:
+                    beams.append(c)
+            if len(finished) >= k:
+                break
+        for toks, score, st, fin in beams:
+            finished.append((toks, score, st, False))
+
+        def norm_score(c):
+            ln = len(c[0])
+            return c[1] / (ln ** normalize if normalize > 0 else 1.0)
+        finished.sort(key=lambda c: -norm_score(c))
+        best = finished[0]
+        toks = best[0]
+        if toks and toks[-1] == EOS_ID:
+            toks = toks[:-1]
+        results.append((toks, norm_score(best)))
+    return results
+
+
+def random_batch(vocab, b, ts, seed):
+    rs = np.random.RandomState(seed)
+    src = rs.randint(2, vocab, (b, ts)).astype(np.int32)
+    src[:, -1] = EOS_ID
+    mask = np.ones((b, ts), np.float32)
+    return src, mask
+
+
+class TestBeamVsReference:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_reference_beam(self, seed):
+        vocab = 19
+        model, params, opts = tiny_model(vocab, seed=seed)
+        src, mask = random_batch(vocab, b=3, ts=6, seed=seed)
+        L = 12
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 4, "normalize": 0.0,
+                                      "max-length": L,
+                                      "max-length-factor": L / 6}),
+                        trg_vocab=None)
+        got = bs.search(src, mask)
+        ref = reference_beam(model, params, src, mask, k=4, L=L)
+        for i in range(3):
+            assert got[i][0]["tokens"] == ref[i][0], \
+                f"sent {i}: {got[i][0]['tokens']} vs {ref[i][0]}"
+
+    def test_normalized_matches_reference(self):
+        vocab = 17
+        model, params, opts = tiny_model(vocab, seed=5)
+        src, mask = random_batch(vocab, b=2, ts=5, seed=9)
+        L = 10
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 4, "normalize": 0.6,
+                                      "max-length": L,
+                                      "max-length-factor": 2.0}),
+                        trg_vocab=None)
+        got = bs.search(src, mask)
+        ref = reference_beam(model, params, src, mask, k=4, L=L, normalize=0.6)
+        for i in range(2):
+            assert got[i][0]["tokens"] == ref[i][0]
+            assert got[i][0]["norm_score"] == pytest.approx(ref[i][1], rel=1e-3)
+
+
+class TestBeamProperties:
+    def test_beam1_equals_greedy(self):
+        vocab = 19
+        model, params, opts = tiny_model(vocab, seed=3)
+        src, mask = random_batch(vocab, b=4, ts=6, seed=3)
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 1, "normalize": 0.0,
+                                      "max-length": 12,
+                                      "max-length-factor": 2.0}),
+                        trg_vocab=None)
+        got = bs.search(src, mask)
+        greedy = greedy_decode(model, params, jnp.asarray(src),
+                               jnp.asarray(mask), max_len=12)
+        for i in range(4):
+            g = [int(x) for x in greedy[i]]
+            g = g[: g.index(EOS_ID)] if EOS_ID in g else g
+            assert got[i][0]["tokens"] == g
+
+    def test_ensemble_of_identical_models_is_identity(self):
+        vocab = 19
+        model, params, opts = tiny_model(vocab, seed=4)
+        src, mask = random_batch(vocab, b=2, ts=5, seed=4)
+        o = opts.with_(**{"beam-size": 3, "normalize": 0.0, "max-length": 10,
+                          "max-length-factor": 2.0})
+        single = BeamSearch(model, [params], None, o, None).search(src, mask)
+        double = BeamSearch(model, [params, params], None, o, None).search(src, mask)
+        for i in range(2):
+            assert single[i][0]["tokens"] == double[i][0]["tokens"]
+
+    def test_nbest_sorted_and_distinct(self):
+        vocab = 19
+        model, params, opts = tiny_model(vocab, seed=6)
+        src, mask = random_batch(vocab, b=2, ts=5, seed=6)
+        o = opts.with_(**{"beam-size": 4, "normalize": 0.6, "n-best": True,
+                          "max-length": 10, "max-length-factor": 2.0})
+        res = BeamSearch(model, [params], None, o, None).search(src, mask)
+        for nbest in res:
+            assert len(nbest) == 4
+            scores = [h["norm_score"] for h in nbest]
+            assert scores == sorted(scores, reverse=True)
+
+    def test_shortlist_restricts_vocab(self):
+        vocab = 19
+        model, params, opts = tiny_model(vocab, seed=7)
+        src, mask = random_batch(vocab, b=2, ts=5, seed=7)
+        o = opts.with_(**{"beam-size": 2, "normalize": 0.0, "max-length": 8,
+                          "max-length-factor": 2.0})
+
+        class FakeShortlist:
+            # allowed ids only (padded to 8 with EOS); includes EOS + UNK
+            indices = np.array([0, 1, 3, 5, 7, 0, 0, 0], dtype=np.int32)
+
+        res = BeamSearch(model, [params], None, o, None).search(
+            src, mask, shortlist=FakeShortlist())
+        allowed = {0, 1, 3, 5, 7}
+        for nbest in res:
+            for h in nbest:
+                assert set(h["tokens"]) <= allowed
+
+    def test_unk_suppressed_by_default(self):
+        vocab = 19
+        model, params, opts = tiny_model(vocab, seed=8)
+        src, mask = random_batch(vocab, b=4, ts=6, seed=8)
+        o = opts.with_(**{"beam-size": 4, "normalize": 0.0, "max-length": 12,
+                          "max-length-factor": 2.0, "n-best": True})
+        res = BeamSearch(model, [params], None, o, None).search(src, mask)
+        for nbest in res:
+            for h in nbest:
+                assert UNK_ID not in h["tokens"]
+
+    def test_alignment_output_shape(self):
+        vocab = 19
+        model, params, opts = tiny_model(vocab, seed=9)
+        src, mask = random_batch(vocab, b=2, ts=5, seed=9)
+        o = opts.with_(**{"beam-size": 2, "normalize": 0.0, "max-length": 8,
+                          "max-length-factor": 2.0, "alignment": "soft"})
+        res = BeamSearch(model, [params], None, o, None).search(src, mask)
+        h = res[0][0]
+        assert "alignment" in h
+        assert h["alignment"].shape[1] == 5  # src length
+        # rows are attention distributions
+        np.testing.assert_allclose(h["alignment"].sum(-1),
+                                   np.ones(h["alignment"].shape[0]), atol=1e-3)
