@@ -1,0 +1,12 @@
+"""marian-decoder entry point (reference: src/command/marian_decoder.cpp)."""
+
+
+def main(argv=None):
+    from ..common.config_parser import parse_options
+    opts = parse_options(argv, mode="translation")
+    from ..translator.translator import translate_main
+    translate_main(opts)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""marian-embedder entry point (reference: src/embedder/)."""
+
+
+def main(argv=None):
+    from ..common.config_parser import parse_options
+    opts = parse_options(argv, mode="embedding")
+    from ..embedder import embed_main
+    embed_main(opts)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""marian-scorer entry point (reference: src/command/marian_scorer.cpp)."""
+
+
+def main(argv=None):
+    from ..common.config_parser import parse_options
+    opts = parse_options(argv, mode="scoring")
+    from ..rescorer import rescore_main
+    rescore_main(opts)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""marian-server entry point (reference: src/command/marian_server.cpp)."""
+
+
+def main(argv=None):
+    from ..common.config_parser import parse_options
+    opts = parse_options(argv, mode="server")
+    from ..server.server import serve_main
+    serve_main(opts)
+
+
+if __name__ == "__main__":
+    main()

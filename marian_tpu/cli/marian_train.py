@@ -1,0 +1,15 @@
+"""marian / marian-train entry point (reference: src/command/marian_train.cpp
+and src/command/marian_main.cpp)."""
+
+
+def main(argv=None):
+    from ..common.config_parser import parse_options
+    from ..parallel.mesh import initialize_distributed
+    opts = parse_options(argv, mode="training")
+    initialize_distributed(opts)
+    from ..training.train import train_main
+    train_main(opts)
+
+
+if __name__ == "__main__":
+    main()

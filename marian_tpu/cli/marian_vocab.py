@@ -1,0 +1,23 @@
+"""marian-vocab: build a frequency-sorted vocab YAML from stdin text
+(reference: src/command/marian_vocab.cpp)."""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="marian-vocab")
+    p.add_argument("--max-size", type=int, default=0,
+                   help="Generate only N most common vocabulary items")
+    args = p.parse_args(argv)
+    from ..data.vocab import DefaultVocab
+    lines = (l.rstrip("\n") for l in sys.stdin)
+    vocab = DefaultVocab.build(lines, max_size=args.max_size)
+    import yaml
+    for i, w in sorted({i: w for w, i in vocab._w2i.items()}.items()):
+        yaml.safe_dump({w: i}, sys.stdout, default_flow_style=False,
+                       allow_unicode=True)
+
+
+if __name__ == "__main__":
+    main()

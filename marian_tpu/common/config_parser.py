@@ -245,6 +245,12 @@ _VALIDATION = [
 ]
 
 _TRANSLATION = [
+    _f("vocabs", str, [], "Paths to vocabulary files", "translate", "*"),
+    _f("mini-batch", int, 1, "Minibatch size (sentences)", "translate"),
+    _f("mini-batch-words", int, 0, "Minibatch size in words", "translate"),
+    _f("maxi-batch", int, 1, "Number of minibatches to preload and sort", "translate"),
+    _f("maxi-batch-sort", str, "src", "Sorting within maxi-batch: src, none", "translate"),
+    _f("data-threads", int, 8, "Host threads for data pipeline", "translate"),
     _f("input", str, ["stdin"], "Input file(s) or stdin", "translate", "+"),
     _f("output", str, "stdout", "Output file or stdout", "translate"),
     _f("models", str, [], "Model file(s) to ensemble", "translate", "*"),
@@ -279,7 +285,10 @@ _SCORER = [
 
 
 MODE_FLAGS: Dict[str, List[Any]] = {
-    "training": _COMMON + _MODEL + _TRAINING + _VALIDATION,
+    # training includes the translation group: the translation validator
+    # runs beam search with --beam-size/--normalize etc. (reference:
+    # config_parser.cpp addOptionsTranslation in training mode)
+    "training": _COMMON + _MODEL + _TRAINING + _VALIDATION + _TRANSLATION,
     "translation": _COMMON + _MODEL + _TRANSLATION,
     "scoring": _COMMON + _MODEL + _TRAINING + _SCORER + _TRANSLATION,
     "embedding": _COMMON + _MODEL + _TRANSLATION,

@@ -1,0 +1,65 @@
+"""Sentence-embedding extraction (reference: src/embedder/ :: Embed<Embedder>)
+— encode the source and mean-pool over real positions, one vector per line."""
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import io as mio
+from .common import logging as log
+from .data import BatchGenerator, Corpus, create_vocab
+from .models.encoder_decoder import create_model
+
+
+class Embedder:
+    def __init__(self, options):
+        self.options = options
+        log.create_loggers(options)
+        model_path = (list(options.get("models", [])) or [options.get("model")])[0]
+        params, cfg_yaml = mio.load_model(model_path)
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        from .models.encoder_decoder import apply_embedded_config
+        options = self.options = apply_embedded_config(options, cfg_yaml)
+        vocab_paths = list(options.get("vocabs", []))
+        self.vocabs = [create_vocab(p, options, i)
+                       for i, p in enumerate(vocab_paths[:1])]
+        self.model = create_model(options, len(self.vocabs[0]),
+                                  len(self.vocabs[0]), inference=True)
+
+        def embed(params, src_ids, src_mask):
+            enc = self.model.encode_for_decode(params, src_ids, src_mask)
+            m = src_mask[..., None]
+            return (enc * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+
+        self._fn = jax.jit(embed)
+
+    def run(self, stream=None) -> None:
+        stream = stream or sys.stdout
+        sets = list(self.options.get("train-sets", [])) or \
+            list(self.options.get("input", []))
+        corpus = Corpus(sets[:1], self.vocabs,
+                        self.options.with_(**{"shuffle": "none",
+                                              "max-length-crop": True}),
+                        inference=True)
+        bg = BatchGenerator(corpus, None, mini_batch=64, maxi_batch=10,
+                            maxi_batch_sort="src", shuffle_batches=False,
+                            prefetch=True)
+        out: dict = {}
+        for batch in bg:
+            vecs = np.asarray(self._fn(self.params,
+                                       jnp.asarray(batch.src.ids),
+                                       jnp.asarray(batch.src.mask)))
+            for row in range(batch.size):
+                out[int(batch.sentence_ids[row])] = vecs[row]
+        for i in sorted(out):
+            stream.write(" ".join(f"{x:.6f}" for x in out[i]) + "\n")
+        stream.flush()
+
+
+def embed_main(options) -> None:
+    Embedder(options).run()

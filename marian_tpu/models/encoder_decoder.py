@@ -101,6 +101,25 @@ def create_model(options, src_vocab_size: int, trg_vocab_size: int,
     return EncoderDecoder(options, src_vocab_size, trg_vocab_size, inference)
 
 
+ARCH_KEY_PREFIXES = ("transformer", "enc-", "dec-", "dim-", "tied-",
+                     "factors-", "lemma-", "input-types", "bert-")
+ARCH_KEYS = ("type", "skip", "layer-normalization", "right-left",
+             "max-length")
+
+
+def apply_embedded_config(options, config_yaml: Optional[str]):
+    """Overlay the architecture part of a checkpoint's embedded
+    special:model.yml onto runtime options (reference: model config loading
+    in translator.h/rescorer.h; disabled by --ignore-model-config)."""
+    if not config_yaml or options.get("ignore-model-config", False):
+        return options
+    import yaml as _yaml
+    emb = _yaml.safe_load(config_yaml) or {}
+    keys = [k for k in emb
+            if k.startswith(ARCH_KEY_PREFIXES) or k in ARCH_KEYS]
+    return options.with_(**{k: emb[k] for k in keys})
+
+
 def batch_to_arrays(batch) -> Dict[str, jnp.ndarray]:
     """CorpusBatch → dict of device arrays for the jitted loss."""
     out = {

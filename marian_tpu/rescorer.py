@@ -1,0 +1,100 @@
+"""Rescorer: teacher-forced CE scoring of parallel corpora / n-best lists
+(reference: src/rescorer/rescorer.h :: Rescore<Rescorer>::run, used for
+R2L reranking and --summary perplexity).
+
+Outputs one score per line (sum of target log-probs, negated CE), or a
+summary (cross-entropy / ce-mean-words / perplexity) over the corpus.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import logging as log
+from .common import io as mio
+from .data import BatchGenerator, Corpus, create_vocab
+from .models.encoder_decoder import batch_to_arrays, create_model
+from .ops.ops import cross_entropy
+
+
+class Rescorer:
+    def __init__(self, options):
+        self.options = options
+        log.create_loggers(options)
+        model_path = (list(options.get("models", [])) or [options.get("model")])[0]
+        params, cfg_yaml = mio.load_model(model_path)
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        from .models.encoder_decoder import apply_embedded_config
+        options = self.options = apply_embedded_config(options, cfg_yaml)
+        vocab_paths = list(options.get("vocabs", []))
+        self.vocabs = [create_vocab(p, options, i)
+                       for i, p in enumerate(vocab_paths)]
+        self.model = create_model(options, len(self.vocabs[0]),
+                                  len(self.vocabs[-1]), inference=True)
+
+        def per_sentence_ce(params, batch):
+            from .models import transformer as T
+            cparams = T.cast_params(params, self.model.cfg.compute_dtype)
+            enc = self.model._mod.encode(self.model.cfg, cparams,
+                                         batch["src_ids"], batch["src_mask"],
+                                         False, None)
+            logits = self.model._mod.decode_train(
+                self.model.cfg, cparams, enc, batch["src_mask"],
+                batch["trg_ids"], batch["trg_mask"], train=False)
+            ce = cross_entropy(logits, batch["trg_ids"], 0.0)
+            ce = ce * batch["trg_mask"]
+            return ce.sum(axis=-1), batch["trg_mask"].sum(axis=-1)
+
+        self._score_fn = jax.jit(per_sentence_ce)
+
+    def run(self, stream=None) -> List[float]:
+        opts = self.options
+        stream = stream or sys.stdout
+        sets = list(opts.get("train-sets", []))
+        corpus = Corpus(sets, self.vocabs,
+                        opts.with_(**{"shuffle": "none",
+                                      "max-length": opts.get("max-length", 1000),
+                                      "max-length-crop": True}),
+                        inference=False)
+        bg = BatchGenerator(corpus, None,
+                            mini_batch=int(opts.get("mini-batch", 64) or 64),
+                            maxi_batch=10, maxi_batch_sort="src",
+                            shuffle_batches=False, prefetch=True)
+        scores: dict = {}
+        total_ce = 0.0
+        total_words = 0.0
+        for batch in bg:
+            ce, words = self._score_fn(self.params, batch_to_arrays(batch))
+            ce, words = np.asarray(ce), np.asarray(words)
+            for row in range(batch.size):
+                sid = int(batch.sentence_ids[row])
+                scores[sid] = -float(ce[row])  # log-prob (Marian prints logP)
+                total_ce += float(ce[row])
+                total_words += float(words[row])
+        ordered = [scores[i] for i in sorted(scores)]
+        summary = opts.get("summary", None)
+        if summary:
+            if summary in (True, "cross-entropy"):
+                value = total_ce
+            elif summary == "ce-mean-words":
+                value = total_ce / max(total_words, 1.0)
+            elif summary == "perplexity":
+                import math
+                value = math.exp(min(total_ce / max(total_words, 1.0), 700))
+            else:
+                value = total_ce
+            stream.write(f"{value:.6f}\n")
+        else:
+            for s in ordered:
+                stream.write(f"{s:.6f}\n")
+        stream.flush()
+        return ordered
+
+
+def rescore_main(options) -> None:
+    Rescorer(options).run()

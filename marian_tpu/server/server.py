@@ -1,0 +1,62 @@
+"""marian-server: translation service on a WebSocket port (reference:
+src/command/marian_server.cpp + vendored simple-websocket-server).
+
+Protocol kept Marian-compatible: client sends newline-joined source
+sentences as a text frame, server replies with newline-joined translations.
+Uses the `websockets` package (gated — a clear error if unavailable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..common import logging as log
+
+try:
+    import websockets
+    HAVE_WS = True
+except ImportError:  # pragma: no cover
+    HAVE_WS = False
+
+
+class TranslationService:
+    """Preloaded graphs + jitted search shared across requests (reference:
+    TranslationService in marian_server.cpp)."""
+
+    def __init__(self, options):
+        from ..translator.translator import Translate
+        self.translator = Translate(options)
+
+    def translate(self, text: str) -> str:
+        lines = text.split("\n")
+        import io as _io
+        buf = _io.StringIO()
+        self.translator.run(lines=lines, stream=buf)
+        return buf.getvalue().rstrip("\n")
+
+
+async def _serve(options) -> None:
+    service = TranslationService(options)
+    port = int(options.get("port", 8080))
+
+    async def handler(ws):
+        async for message in ws:
+            try:
+                reply = await asyncio.get_event_loop().run_in_executor(
+                    None, service.translate, message)
+            except Exception as e:  # keep the server alive on bad input
+                log.error("translation error: {}", e)
+                reply = ""
+            await ws.send(reply)
+
+    log.info("Server is listening on port {}", port)
+    async with websockets.serve(handler, "0.0.0.0", port):
+        await asyncio.Future()
+
+
+def serve_main(options) -> None:
+    if not HAVE_WS:
+        raise RuntimeError(
+            "marian-server needs the 'websockets' package (not installed)")
+    asyncio.run(_serve(options))

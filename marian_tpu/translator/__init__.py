@@ -1,0 +1,4 @@
+from .beam_search import BeamSearch, BeamConfig, beam_search_jit
+from .greedy import greedy_decode
+from .output_collector import OutputCollector, OutputPrinter
+from .metrics import corpus_bleu, corpus_chrf

@@ -1,0 +1,94 @@
+"""In-order output collection and printing (reference:
+src/translator/output_collector.cpp :: OutputCollector,
+output_printer.cpp :: OutputPrinter).
+
+Batches may finish out of order (async device dispatch / multiple streams);
+the collector buffers results and flushes them in input order. The printer
+formats single-best or n-best lines and hard/soft alignments."""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, List, Optional, TextIO
+
+import numpy as np
+
+from ..data.alignment import hard_alignment_from_soft, WordAlignment
+
+
+class OutputCollector:
+    def __init__(self, stream: Optional[TextIO] = None):
+        self.stream = stream or sys.stdout
+        self._next = 0
+        self._pending: Dict[int, str] = {}
+        self._lock = threading.Lock()
+
+    def write(self, sentence_id: int, text: str) -> None:
+        with self._lock:
+            self._pending[sentence_id] = text
+            while self._next in self._pending:
+                self.stream.write(self._pending.pop(self._next))
+                self.stream.write("\n")
+                self._next += 1
+            self.stream.flush()
+
+    def flush_remaining(self) -> None:
+        with self._lock:
+            for sid in sorted(self._pending):
+                self.stream.write(self._pending[sid])
+                self.stream.write("\n")
+            self._pending.clear()
+            self.stream.flush()
+
+
+class OutputPrinter:
+    def __init__(self, options, vocab):
+        self.vocab = vocab
+        self.n_best = bool(options.get("n-best", False))
+        self.feature = options.get("n-best-feature", "Score")
+        align = options.get("alignment", None)
+        self.align_mode: Optional[str] = None
+        self.align_threshold = 1.0
+        if align is not None and align is not False:
+            if align in ("soft", "hard"):
+                self.align_mode = align
+                self.align_threshold = 1.0 if align == "hard" else 0.0
+            else:
+                self.align_mode = "threshold"
+                try:
+                    self.align_threshold = float(align)
+                except (TypeError, ValueError):
+                    self.align_mode = "hard"
+
+    def _detok(self, tokens: List[int]) -> str:
+        return self.vocab.decode(tokens)
+
+    def _align_str(self, soft: np.ndarray) -> str:
+        if self.align_mode == "soft":
+            rows = []
+            for t in range(soft.shape[0]):
+                rows.append(",".join(f"{p:.6f}" for p in soft[t]))
+            return " ".join(rows)
+        thr = 1.0 if self.align_mode == "hard" else self.align_threshold
+        wa = hard_alignment_from_soft(soft, soft.shape[1], soft.shape[0], thr)
+        return str(wa)
+
+    def line(self, sentence_id: int, nbest: List[dict]) -> str:
+        """Format one sentence's result (reference: OutputPrinter::print)."""
+        if not self.n_best:
+            h = nbest[0]
+            out = self._detok(h["tokens"])
+            if self.align_mode and "alignment" in h:
+                out += " ||| " + self._align_str(np.asarray(h["alignment"]))
+            return out
+        lines = []
+        for h in nbest:
+            parts = [str(sentence_id), self._detok(h["tokens"]),
+                     f"{self.feature}= {h['score']:.6f}",
+                     f"{h['norm_score']:.6f}"]
+            line = " ||| ".join(parts)
+            if self.align_mode and "alignment" in h:
+                line += " ||| " + self._align_str(np.asarray(h["alignment"]))
+            lines.append(line)
+        return "\n".join(lines)

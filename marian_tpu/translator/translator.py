@@ -1,0 +1,117 @@
+"""The translation task driver — reference src/translator/translator.h ::
+Translate<BeamSearch>::run.
+
+Loads model(s) + vocabs + shortlist, batches input (maxi-batch length sort
+for padding efficiency, like the decoder's --maxi-batch), runs the jitted
+beam search batch by batch, and emits translations in input order.
+
+The reference runs one host thread per GPU with per-thread graphs; here one
+process drives the TPU (XLA pipelines batches via async dispatch), so the
+ThreadPool collapses to a simple loop — the collector still guards ordering.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import logging as log
+from ..common import io as mio
+from ..data import (BatchGenerator, Corpus, TextInput, create_vocab,
+                    parse_shortlist_options)
+from ..models.encoder_decoder import create_model
+from .beam_search import BeamSearch
+from .output_collector import OutputCollector, OutputPrinter
+
+
+class Translate:
+    def __init__(self, options):
+        self.options = options
+        log.create_loggers(options)
+
+        model_paths = list(options.get("models", [])) or [options.get("model")]
+        self.params_list = []
+        embedded_cfg = None
+        for mp in model_paths:
+            params, cfg_yaml = mio.load_model(mp)
+            self.params_list.append({k: jnp.asarray(v) for k, v in params.items()})
+            if cfg_yaml and embedded_cfg is None:
+                embedded_cfg = cfg_yaml
+        # model architecture comes from the checkpoint-embedded config unless
+        # --ignore-model-config (reference: translator.h config precedence)
+        from ..models.encoder_decoder import apply_embedded_config
+        self.options = apply_embedded_config(options, embedded_cfg)
+
+        vocab_paths = list(self.options.get("vocabs", []))
+        if not vocab_paths:
+            raise ValueError("--vocabs required for translation")
+        self.vocabs = [create_vocab(p, self.options, i)
+                       for i, p in enumerate(vocab_paths)]
+        self.src_vocab = self.vocabs[0]
+        self.trg_vocab = self.vocabs[-1]
+
+        self.model = create_model(self.options, len(self.src_vocab),
+                                  len(self.trg_vocab), inference=True)
+        weights = self.options.get("weights", []) or None
+        self.search = BeamSearch(self.model, self.params_list, weights,
+                                 self.options, self.trg_vocab)
+        self.shortlist_gen = parse_shortlist_options(
+            self.options.get("shortlist", []), self.src_vocab, self.trg_vocab)
+        self.printer = OutputPrinter(self.options, self.trg_vocab)
+
+    def _input_corpus(self, lines: Optional[List[str]] = None):
+        if lines is not None:
+            return TextInput([lines], [self.src_vocab], self.options)
+        inputs = self.options.get("input", ["stdin"])
+        path = inputs[0] if isinstance(inputs, list) else inputs
+        if path in ("stdin", "-"):
+            lines = [l.rstrip("\n") for l in sys.stdin]
+            return TextInput([lines], [self.src_vocab], self.options)
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = [l.rstrip("\n") for l in fh]
+        return TextInput([lines], [self.src_vocab], self.options)
+
+    def run(self, lines: Optional[List[str]] = None,
+            stream=None) -> List[str]:
+        corpus = self._input_corpus(lines)
+        bg = BatchGenerator(
+            corpus, None,
+            mini_batch=int(self.options.get("mini-batch", 32) or 32),
+            mini_batch_words=int(self.options.get("mini-batch-words", 0) or 0),
+            maxi_batch=int(self.options.get("maxi-batch", 100) or 1),
+            maxi_batch_sort=self.options.get("maxi-batch-sort", "src"),
+            shuffle_batches=False, prefetch=True)
+        out_path = self.options.get("output", "stdout")
+        close = False
+        if stream is None:
+            if out_path in ("stdout", "-"):
+                stream = sys.stdout
+            else:
+                stream = open(out_path, "w", encoding="utf-8")
+                close = True
+        collector = OutputCollector(stream)
+        results: List[str] = []
+        for batch in bg:
+            real = batch.size
+            src_ids = batch.src.ids
+            src_mask = batch.src.mask
+            shortlist = None
+            if self.shortlist_gen is not None:
+                shortlist = self.shortlist_gen.generate(
+                    np.unique(src_ids[src_mask > 0]))
+            nbests = self.search.search(src_ids, src_mask, shortlist=shortlist)
+            for row in range(real):
+                sid = int(batch.sentence_ids[row])
+                text = self.printer.line(sid, nbests[row])
+                collector.write(sid, text)
+        collector.flush_remaining()
+        if close:
+            stream.close()
+        return results
+
+
+def translate_main(options) -> None:
+    Translate(options).run()

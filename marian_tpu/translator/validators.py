@@ -1,0 +1,131 @@
+"""Translation-based validators (reference: src/training/validator.cpp ::
+BleuValidator/SacreBleuValidator/TranslationValidator/ScriptValidator).
+Run the jitted beam search over the dev set with current (EMA) params."""
+
+from __future__ import annotations
+
+import subprocess
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..common import logging as log
+from ..data import BatchGenerator, Corpus
+from ..training.validators import Validator
+from .beam_search import BeamSearch
+from .metrics import corpus_bleu, corpus_chrf
+
+
+class _BeamOverDevSet:
+    """Shared machinery: decode the validation sources with current params."""
+
+    def __init__(self, options, vocabs, model):
+        self.options = options
+        self.vocabs = vocabs
+        self.model = model
+
+    def decode_dev(self, params) -> (List[str], List[str]):
+        opts = self.options
+        valid_sets = list(opts.get("valid-sets", []))
+        if len(valid_sets) < 2:
+            raise ValueError("translation validators need source+reference "
+                             "in --valid-sets")
+        corpus = Corpus(valid_sets, self.vocabs,
+                        opts.with_(**{"max-length": opts.get("valid-max-length", 1000),
+                                      "max-length-crop": True,
+                                      "shuffle": "none"}),
+                        inference=True)
+        bg = BatchGenerator(corpus, None,
+                            mini_batch=int(opts.get("valid-mini-batch", 32)),
+                            maxi_batch=10, maxi_batch_sort="src",
+                            shuffle_batches=False, prefetch=False)
+        # inference model (no dropout) sharing the train param structure
+        from ..models.encoder_decoder import create_model
+        infer_model = create_model(opts, len(self.vocabs[0]),
+                                   len(self.vocabs[-1]), inference=True)
+        bs = BeamSearch(infer_model, [params], None,
+                        opts.with_(**{"beam-size": int(opts.get("beam-size", 12)),
+                                      "n-best": False}),
+                        self.vocabs[-1])
+        hyps: dict = {}
+        for batch in bg:
+            res = bs.search(batch.src.ids, batch.src.mask)
+            for row in range(batch.size):
+                sid = int(batch.sentence_ids[row])
+                hyps[sid] = self.vocabs[-1].decode(res[row][0]["tokens"])
+        ordered = [hyps[i] for i in sorted(hyps)]
+        with open(valid_sets[-1], "r", encoding="utf-8") as fh:
+            refs = [l.rstrip("\n") for l in fh][: len(ordered)]
+        return ordered, refs
+
+
+class TranslationMetricValidator(Validator, _BeamOverDevSet):
+    """bleu / bleu-detok / chrf (reference: SacreBleuValidator)."""
+    lower_is_better = False
+
+    def __init__(self, options, vocabs, model, metric: str = "bleu"):
+        _BeamOverDevSet.__init__(self, options, vocabs, model)
+        self.name = metric
+
+    def validate(self, params) -> float:
+        hyps, refs = self.decode_dev(params)
+        if self.name == "chrf":
+            return corpus_chrf(hyps, refs)
+        return corpus_bleu(hyps, refs)
+
+
+class TranslationValidator(Validator, _BeamOverDevSet):
+    """Decode dev set, optionally write --valid-translation-output, score
+    with --valid-script-path if given, else report BLEU (reference:
+    TranslationValidator)."""
+    lower_is_better = False
+    name = "translation"
+
+    def __init__(self, options, vocabs, model):
+        _BeamOverDevSet.__init__(self, options, vocabs, model)
+
+    def validate(self, params) -> float:
+        hyps, refs = self.decode_dev(params)
+        out_path = self.options.get("valid-translation-output", None)
+        if out_path:
+            with open(out_path, "w", encoding="utf-8") as fh:
+                fh.write("\n".join(hyps) + "\n")
+        script = self.options.get("valid-script-path", None)
+        if script:
+            with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                             delete=False) as tf:
+                tf.write("\n".join(hyps) + "\n")
+                tmp = tf.name
+            args = [script] + list(self.options.get("valid-script-args", [])) \
+                + [tmp]
+            out = subprocess.run(args, capture_output=True, text=True,
+                                 timeout=3600)
+            try:
+                return float(out.stdout.strip().split()[-1])
+            except (ValueError, IndexError):
+                log.warn("valid-script output unparsable: {}", out.stdout[:200])
+                return 0.0
+        return corpus_bleu(hyps, refs)
+
+
+class ScriptValidator(Validator):
+    """Run an external script on the saved model (reference: ScriptValidator:
+    model saved first, script's stdout last token is the metric)."""
+    lower_is_better = False
+    name = "valid-script"
+
+    def __init__(self, options, vocabs, model):
+        self.options = options
+
+    def validate(self, params) -> float:
+        script = self.options.get("valid-script-path", None)
+        if not script:
+            raise ValueError("valid-script requires --valid-script-path")
+        args = [script] + list(self.options.get("valid-script-args", []))
+        out = subprocess.run(args, capture_output=True, text=True,
+                             timeout=3600)
+        try:
+            return float(out.stdout.strip().split()[-1])
+        except (ValueError, IndexError):
+            return 0.0

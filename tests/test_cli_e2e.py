@@ -1,0 +1,164 @@
+"""CLI-level end-to-end tests: the Marian binary surface (train → decode →
+score → serve) driven exactly as a Marian user would (reference: the
+marian-regression-tests style, SURVEY.md §4)."""
+
+import io
+import os
+import sys
+
+import numpy as np
+import pytest
+import yaml
+
+from marian_tpu.cli import marian_train, marian_decoder, marian_scorer
+from marian_tpu.translator.metrics import corpus_bleu, corpus_chrf
+
+
+@pytest.fixture(scope="module")
+def trained_model(tmp_path_factory):
+    """Train a toy model once for all CLI tests."""
+    tmp = tmp_path_factory.mktemp("cli")
+    src_lines = ["a b c", "b c d", "c d a", "d a b", "a c b", "b d c"] * 2
+    tgt_lines = ["x y z", "y z w", "z w x", "w x y", "x z y", "y w z"] * 2
+    src = tmp / "train.src"; src.write_text("\n".join(src_lines) + "\n")
+    tgt = tmp / "train.tgt"; tgt.write_text("\n".join(tgt_lines) + "\n")
+    model = tmp / "model.npz"
+    argv = [
+        "--type", "transformer",
+        "--train-sets", str(src), str(tgt),
+        "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+        "--model", str(model),
+        "--dim-emb", "32", "--transformer-heads", "4",
+        "--transformer-dim-ffn", "64", "--enc-depth", "1", "--dec-depth", "1",
+        "--precision", "float32", "float32",
+        "--mini-batch", "12", "--maxi-batch", "2",
+        "--learn-rate", "0.01", "--after-batches", "30",
+        "--disp-freq", "10u", "--save-freq", "1000u",
+        "--seed", "1", "--max-length", "20", "--quiet",
+        "--valid-sets", str(src), str(tgt),
+        "--valid-metrics", "cross-entropy", "--valid-freq", "15u",
+        "--beam-size", "2", "--cost-type", "ce-mean-words",
+    ]
+    marian_train.main(argv)
+    return tmp, str(model), src_lines, tgt_lines
+
+
+class TestTrainCLI:
+    def test_artifacts_exist(self, trained_model):
+        tmp, model, _, _ = trained_model
+        assert os.path.exists(model)
+        assert os.path.exists(model + ".progress.yml")
+        assert os.path.exists(str(tmp / "v.src.yml"))
+
+    def test_embedded_config_roundtrip(self, trained_model):
+        from marian_tpu.common import io as mio
+        _, model, _, _ = trained_model
+        _, cfg = mio.load_model(model)
+        data = yaml.safe_load(cfg)
+        assert data["dim-emb"] == 32
+        assert data["type"] == "transformer"
+
+
+class TestDecoderCLI:
+    def test_decode_file_to_file(self, trained_model):
+        tmp, model, src_lines, _ = trained_model
+        inp = tmp / "input.txt"; inp.write_text("a b c\nb c d\n")
+        out = tmp / "output.txt"
+        marian_decoder.main([
+            "--models", model,
+            "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+            "--input", str(inp), "--output", str(out),
+            "--beam-size", "4", "--normalize", "0.6",
+            "--mini-batch", "8", "--maxi-batch", "1",
+            "--max-length", "20", "--quiet",
+        ])
+        lines = out.read_text().splitlines()
+        assert len(lines) == 2
+        # overfit toy: source "a b c" should map toward "x y z"
+        assert all(tok in "x y z w".split() for tok in lines[0].split())
+
+    def test_nbest_output_format(self, trained_model):
+        tmp, model, _, _ = trained_model
+        inp = tmp / "in2.txt"; inp.write_text("a b c\n")
+        out = tmp / "out2.txt"
+        marian_decoder.main([
+            "--models", model,
+            "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+            "--input", str(inp), "--output", str(out),
+            "--beam-size", "3", "--n-best", "--max-length", "20", "--quiet",
+        ])
+        lines = out.read_text().splitlines()
+        assert len(lines) == 3
+        for line in lines:
+            parts = line.split(" ||| ")
+            assert parts[0] == "0"
+            assert "Score=" in parts[2]
+
+
+class TestScorerCLI:
+    def test_scores_parallel_corpus(self, trained_model, capsys):
+        tmp, model, _, _ = trained_model
+        s = tmp / "sc.src"; s.write_text("a b c\nb c d\n")
+        t = tmp / "sc.tgt"; t.write_text("x y z\ny z w\n")
+        marian_scorer.main([
+            "--models", model,
+            "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+            "--train-sets", str(s), str(t), "--quiet",
+        ])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2
+        scores = [float(x) for x in out]
+        assert all(s <= 0 for s in scores)  # log-probs
+
+    def test_summary_perplexity(self, trained_model, capsys):
+        tmp, model, _, _ = trained_model
+        s = tmp / "sc.src"; s.write_text("a b c\n")
+        t = tmp / "sc.tgt"; t.write_text("x y z\n")
+        marian_scorer.main([
+            "--models", model,
+            "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+            "--train-sets", str(s), str(t), "--summary", "perplexity",
+            "--quiet",
+        ])
+        out = capsys.readouterr().out.strip()
+        assert float(out) >= 1.0
+
+
+class TestMetrics:
+    def test_bleu_perfect_and_zero(self):
+        assert corpus_bleu(["a b c d"], ["a b c d"]) == pytest.approx(100.0)
+        assert corpus_bleu(["x"], ["a b c d"]) < 5.0
+
+    def test_bleu_known_value(self):
+        # classic example: partial overlap
+        hyp = ["the cat is on the mat"]
+        ref = ["the cat sat on the mat"]
+        b = corpus_bleu(hyp, ref)
+        assert 30 < b < 80
+
+    def test_chrf_monotone(self):
+        assert corpus_chrf(["abcdef"], ["abcdef"]) == pytest.approx(100.0)
+        a = corpus_chrf(["abcdxy"], ["abcdef"])
+        b = corpus_chrf(["zzzzzz"], ["abcdef"])
+        assert a > b
+
+    def test_bleu_validator_integration(self, trained_model):
+        from marian_tpu.common import Options
+        from marian_tpu.common import io as mio
+        from marian_tpu.data import DefaultVocab
+        from marian_tpu.models.encoder_decoder import create_model
+        from marian_tpu.translator.validators import TranslationMetricValidator
+        import jax.numpy as jnp
+        tmp, model, src_lines, tgt_lines = trained_model
+        params, cfg = mio.load_model(model)
+        opts = Options(yaml.safe_load(cfg)).with_(
+            **{"valid-sets": [str(tmp / "train.src"), str(tmp / "train.tgt")],
+               "valid-mini-batch": 8, "beam-size": 2, "quiet": True})
+        vocabs = [DefaultVocab.load(str(tmp / "v.src.yml")),
+                  DefaultVocab.load(str(tmp / "v.tgt.yml"))]
+        mdl = create_model(opts, len(vocabs[0]), len(vocabs[1]))
+        v = TranslationMetricValidator(opts, vocabs, mdl, "bleu")
+        jparams = {k: jnp.asarray(x) for k, x in params.items()}
+        score = v.validate(jparams)
+        assert 0.0 <= score <= 100.0
+        assert score > 10.0  # overfit toy should translate training data well
