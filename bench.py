@@ -1,0 +1,118 @@
+"""Benchmark: training throughput (src-tokens/sec/chip) of transformer-big
+En-De-shaped training — the driver's headline metric (BASELINE.json: north
+star 180k src-tok/s/chip on v4-32; vs_baseline is measured/180k).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Runs on whatever jax.devices() provides (the real TPU chip under the axon
+tunnel; CPU fallback for smoke-testing with MARIAN_BENCH_PRESET=tiny).
+Method: jitted fused train step (grads + Adam + EMA, bf16 compute, donated
+buffers), warmup until compile settles, then timed steps with a single
+block_until_ready at the end — no host sync inside the loop.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    preset = os.environ.get("MARIAN_BENCH_PRESET", "big")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from marian_tpu.common.options import Options
+    from marian_tpu.models.encoder_decoder import create_model
+    from marian_tpu.optimizers.optimizers import OptimizerConfig, init_state
+    from marian_tpu.optimizers.schedule import LRSchedule
+    from marian_tpu.parallel import mesh as M
+    from marian_tpu.parallel.zero import build_train_step, place
+
+    if preset == "big":
+        # transformer-big En-De (BASELINE.json config #2); 32k joint vocab
+        dims = dict(emb=1024, ffn=4096, heads=16, depth=6, vocab=32000)
+        batch, src_len, trg_len = 64, 64, 64
+        steps, warmup = 20, 3
+    elif preset == "base":
+        dims = dict(emb=512, ffn=2048, heads=8, depth=6, vocab=32000)
+        batch, src_len, trg_len = 128, 64, 64
+        steps, warmup = 20, 3
+    else:  # tiny smoke preset
+        dims = dict(emb=64, ffn=128, heads=4, depth=2, vocab=512)
+        batch, src_len, trg_len = 16, 16, 16
+        steps, warmup = 5, 2
+
+    opts = Options({
+        "type": "transformer",
+        "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
+        "transformer-heads": dims["heads"],
+        "enc-depth": dims["depth"], "dec-depth": dims["depth"],
+        "tied-embeddings-all": True,
+        "transformer-ffn-activation": "relu",
+        "precision": ["bfloat16", "float32"],
+        "label-smoothing": 0.1, "cost-type": "ce-mean-words",
+        "learn-rate": 2e-4, "lr-warmup": "8000", "lr-decay-inv-sqrt": ["8000"],
+        "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
+        "clip-norm": 0.0, "exponential-smoothing": 1e-4,
+        "max-length": max(src_len, trg_len),
+    })
+
+    devices = jax.devices()
+    mesh = M.make_mesh(None, devices)
+    n_chips = len(devices)
+
+    model = create_model(opts, dims["vocab"], dims["vocab"])
+    params = model.init(jax.random.key(0))
+    opt_cfg = OptimizerConfig.from_options(opts)
+    opt_state = init_state(opt_cfg, params)
+    params, opt_state = place(params, opt_state, mesh)
+    schedule = LRSchedule.from_options(opts)
+    step_fn = build_train_step(model, opt_cfg, schedule, "ce-mean-words",
+                               mesh, params, opt_state, delay=1, donate=True)
+
+    rs = np.random.RandomState(0)
+    global_batch = batch * max(1, mesh.shape["data"])
+
+    def make_batch(seed):
+        r = np.random.RandomState(seed)
+        return M.shard_batch({
+            "src_ids": jnp.asarray(r.randint(2, dims["vocab"],
+                                             (global_batch, src_len)), jnp.int32),
+            "src_mask": jnp.ones((global_batch, src_len), jnp.float32),
+            "trg_ids": jnp.asarray(r.randint(2, dims["vocab"],
+                                             (global_batch, trg_len)), jnp.int32),
+            "trg_mask": jnp.ones((global_batch, trg_len), jnp.float32),
+        }, mesh)
+
+    batches = [make_batch(i) for i in range(4)]
+    rng = jax.random.key(1)
+
+    for i in range(warmup):
+        params, opt_state, metrics = step_fn(
+            params, opt_state, batches[i % 4],
+            jnp.asarray(i + 1, jnp.float32), rng)
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt_state, metrics = step_fn(
+            params, opt_state, batches[i % 4],
+            jnp.asarray(warmup + i + 1, jnp.float32), rng)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    src_tokens = steps * global_batch * src_len
+    tok_per_sec_chip = src_tokens / dt / n_chips
+    baseline = 180_000.0  # north-star src-tok/s/chip (BASELINE.json)
+    print(json.dumps({
+        "metric": "train_src_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "src-tokens/sec/chip",
+        "vs_baseline": round(tok_per_sec_chip / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
