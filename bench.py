@@ -13,7 +13,6 @@ block_until_ready at the end — no host sync inside the loop.
 
 import json
 import os
-import sys
 import time
 
 
@@ -72,7 +71,6 @@ def main():
     step_fn = build_train_step(model, opt_cfg, schedule, "ce-mean-words",
                                mesh, params, opt_state, delay=1, donate=True)
 
-    rs = np.random.RandomState(0)
     global_batch = batch * max(1, mesh.shape["data"])
 
     def make_batch(seed):

@@ -48,6 +48,12 @@ class EncoderDecoder:
     def init(self, key: jax.Array) -> Params:
         return self._mod.init_params(self.cfg, key)
 
+    @property
+    def beam_carried_suffixes(self) -> Tuple[str, ...]:
+        """Decode-state key suffixes that ride the beam (reordered by
+        backpointers); model-family specific (KV caches vs RNN states)."""
+        return self._mod.BEAM_CARRIED_SUFFIXES
+
     # -- training graph (reference: EncoderDecoder::build + costs.h) --------
     def loss(self, params: Params, batch: Dict[str, jax.Array],
              key: Optional[jax.Array] = None, train: bool = True

@@ -1,0 +1,474 @@
+"""Deep-RNN sequence-to-sequence model (Nematus/amun lineage).
+
+Rebuild of reference src/models/s2s.h :: EncoderS2S / DecoderS2S (with
+src/rnn/attention.cpp's Bahdanau attention and src/rnn/cells.h cells —
+see ops/rnn.py). Config #3 of the baseline matrix (deep RNN En-Ro).
+
+Architecture (same shape as the reference):
+- Encoder: embeddings → layer 1 BIdirectional (forward + backward cells,
+  outputs concatenated → context dim C = 2*dim_rnn) → enc_depth-1 further
+  layers of dim C (unidirectional, or direction-alternating when
+  ``--enc-type alternating``), each with optional deep-transition cells
+  (``--enc-cell-depth``) and residual skip (``--skip``).
+- Decoder: start state s0 = tanh((mean-pooled context) @ ff_state) —
+  reference: DecoderS2S::startState; layer 1 is the *conditional* cell
+  (reference: rnn/constructors.h stacked cell with attention): base cell on
+  the previous embedding → MLP attention over the encoder context → one or
+  more transition cells fed the attended context (``--dec-cell-base-depth``
+  counts all of them); layers 2..dec_depth are plain cells with skip
+  (``--dec-high-depth`` transition depth each).
+- Deep output (reference: mlp::Output over [state, embedding, context] —
+  Nematus' ff_logit): logit = tanh(s W1 + e W2 + ctx W3 + b) @ W_out, with
+  optional embedding tying.
+
+TPU design notes: input projections for every cell are hoisted out of the
+scan into whole-sequence GEMMs; SSRU layers run as parallel prefix scans
+(ops/rnn.py); the attention MLP's encoder-side projection is computed once
+per batch. Incremental decode state is a flat dict of [B, dim] recurrent
+states — static shapes, reordered per beam via the "_h"/"_c" key suffixes
+(BEAM_CARRIED_SUFFIXES).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers import initializers as inits
+from ..ops.ops import dropout as _dropout, layer_norm
+from ..ops import rnn as R
+from .transformer import cast_params  # same flat-dict convention
+
+Params = Dict[str, jax.Array]
+
+# decode-state keys with these suffixes ride the beam and are reordered by
+# backpointers in beam search; everything else is beam-invariant.
+BEAM_CARRIED_SUFFIXES = ("_h", "_c", "_feed")
+
+
+@dataclasses.dataclass(frozen=True)
+class S2SConfig:
+    src_vocab: int
+    trg_vocab: int
+    dim_emb: int = 512
+    dim_rnn: int = 1024
+    enc_type: str = "bidirectional"      # or "alternating"
+    enc_cell: str = "gru"
+    enc_cell_depth: int = 1
+    enc_depth: int = 1
+    dec_cell: str = "gru"
+    dec_cell_base_depth: int = 2         # cell1 + attention + (depth-1) cells
+    dec_cell_high_depth: int = 1
+    dec_depth: int = 1
+    skip: bool = False
+    layer_normalization: bool = False
+    tied_embeddings: bool = False        # trg emb ↔ output layer
+    tied_embeddings_src: bool = False
+    tied_embeddings_all: bool = False
+    dropout_rnn: float = 0.0
+    dropout_src: float = 0.0
+    dropout_trg: float = 0.0
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def dim_ctx(self) -> int:            # bidirectional concat
+        return 2 * self.dim_rnn
+
+
+def config_from_options(options, src_vocab: int, trg_vocab: int,
+                        for_inference: bool = False) -> S2SConfig:
+    g = options.get
+    precision = g("precision", ["float32"])
+    compute = precision[0] if isinstance(precision, list) else precision
+    dtype = {"float32": jnp.float32, "float16": jnp.bfloat16,
+             "bfloat16": jnp.bfloat16}.get(str(compute), jnp.float32)
+    inf = for_inference
+    return S2SConfig(
+        src_vocab=src_vocab,
+        trg_vocab=trg_vocab,
+        dim_emb=int(g("dim-emb", 512)),
+        dim_rnn=int(g("dim-rnn", 1024)),
+        enc_type=str(g("enc-type", "bidirectional")),
+        enc_cell=str(g("enc-cell", "gru")),
+        enc_cell_depth=int(g("enc-cell-depth", 1)),
+        enc_depth=int(g("enc-depth", 1)),
+        dec_cell=str(g("dec-cell", "gru")),
+        dec_cell_base_depth=int(g("dec-cell-base-depth", 2)),
+        dec_cell_high_depth=int(g("dec-cell-high-depth", 1)),
+        dec_depth=int(g("dec-depth", 1)),
+        skip=bool(g("skip", False)),
+        layer_normalization=bool(g("layer-normalization", False)),
+        tied_embeddings=bool(g("tied-embeddings", False)),
+        tied_embeddings_src=bool(g("tied-embeddings-src", False)),
+        tied_embeddings_all=bool(g("tied-embeddings-all", False)),
+        dropout_rnn=0.0 if inf else float(g("dropout-rnn", 0.0)),
+        dropout_src=0.0 if inf else float(g("dropout-src", 0.0)),
+        dropout_trg=0.0 if inf else float(g("dropout-trg", 0.0)),
+        compute_dtype=dtype,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Cell/topology helpers
+# ---------------------------------------------------------------------------
+
+def _chain(kind: str, first_prefix: str, dim_in: int, dim: int, ln: bool,
+           depth: int, trans_fmt: str) -> List[Tuple[str, R.Cell]]:
+    """A deep-transition chain: input cell + (depth-1) bias-only cells."""
+    chain = [(first_prefix, R.make_cell(kind, dim_in, dim, ln))]
+    for j in range(2, depth + 1):
+        chain.append((trans_fmt.format(j=j), R.make_cell(kind, 0, dim, ln)))
+    return chain
+
+
+def _enc_chains(cfg: S2SConfig) -> List[Tuple[List[Tuple[str, R.Cell]], bool]]:
+    """[(chain, reverse)] per encoder RNN run. Runs 0/1 are the
+    bidirectional pair of layer 1; runs 2.. are the deeper C-dim layers."""
+    ln = cfg.layer_normalization
+    out = [
+        (_chain(cfg.enc_cell, "encoder_bi", cfg.dim_emb, cfg.dim_rnn, ln,
+                cfg.enc_cell_depth, "encoder_bi_cell{j}"), False),
+        (_chain(cfg.enc_cell, "encoder_bi_r", cfg.dim_emb, cfg.dim_rnn, ln,
+                cfg.enc_cell_depth, "encoder_bi_r_cell{j}"), True),
+    ]
+    for l in range(2, cfg.enc_depth + 1):
+        rev = cfg.enc_type == "alternating" and l % 2 == 0
+        out.append((_chain(cfg.enc_cell, f"encoder_l{l}", cfg.dim_ctx,
+                           cfg.dim_ctx, ln, cfg.enc_cell_depth,
+                           f"encoder_l{l}_cell{{j}}"), rev))
+    return out
+
+
+def _dec_base_chain(cfg: S2SConfig) -> List[Tuple[str, R.Cell]]:
+    """Conditional-cell stack of decoder layer 1 (reference: cGRU): cell 1
+    takes the previous embedding, cell 2 the attended context, cells 3..
+    are transitions; ONE recurrent state flows through the whole chain."""
+    ln = cfg.layer_normalization
+    chain = [("decoder_cell1",
+              R.make_cell(cfg.dec_cell, cfg.dim_emb, cfg.dim_rnn, ln))]
+    for j in range(2, cfg.dec_cell_base_depth + 1):
+        dim_in = cfg.dim_ctx if j == 2 else 0
+        chain.append((f"decoder_cell{j}",
+                      R.make_cell(cfg.dec_cell, dim_in, cfg.dim_rnn, ln)))
+    return chain
+
+
+def _dec_high_chains(cfg: S2SConfig) -> List[List[Tuple[str, R.Cell]]]:
+    ln = cfg.layer_normalization
+    return [_chain(cfg.dec_cell, f"decoder_l{l}", cfg.dim_rnn, cfg.dim_rnn,
+                   ln, cfg.dec_cell_high_depth, f"decoder_l{l}_cell{{j}}")
+            for l in range(2, cfg.dec_depth + 1)]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
+    p: Params = {}
+    keys = iter(jax.random.split(key, 4096))
+
+    def glorot(shape):
+        return inits.glorot_uniform(next(keys), shape)
+
+    # embeddings (Nematus names Wemb / Wemb_dec)
+    if cfg.tied_embeddings_all or cfg.tied_embeddings_src:
+        if cfg.src_vocab != cfg.trg_vocab:
+            raise ValueError("tied src embeddings require equal vocab sizes")
+        p["Wemb"] = glorot((cfg.src_vocab, cfg.dim_emb))
+    else:
+        p["Wemb"] = glorot((cfg.src_vocab, cfg.dim_emb))
+        p["Wemb_dec"] = glorot((cfg.trg_vocab, cfg.dim_emb))
+
+    for chain, _rev in _enc_chains(cfg):
+        for prefix, cell in chain:
+            cell.init(next(keys), p, prefix)
+
+    # decoder start state (reference: DecoderS2S::startState → ff_state)
+    p["ff_state_W"] = glorot((cfg.dim_ctx, cfg.dim_rnn))
+    p["ff_state_b"] = inits.zeros((1, cfg.dim_rnn))
+    if cfg.layer_normalization:
+        p["ff_state_ln_scale"] = inits.ones((1, cfg.dim_rnn))
+
+    for prefix, cell in _dec_base_chain(cfg):
+        cell.init(next(keys), p, prefix)
+    for chain in _dec_high_chains(cfg):
+        for prefix, cell in chain:
+            cell.init(next(keys), p, prefix)
+
+    # Bahdanau MLP attention (reference: rnn/attention.cpp; Nematus names)
+    a = cfg.dim_rnn
+    p["decoder_att_W"] = glorot((cfg.dim_rnn, a))     # W_comb_att
+    p["decoder_att_U"] = glorot((cfg.dim_ctx, a))     # Wc_att
+    p["decoder_att_b"] = inits.zeros((1, a))
+    p["decoder_att_v"] = glorot((a, 1))               # U_att
+    if cfg.layer_normalization:
+        p["decoder_att_ln_scale"] = inits.ones((1, a))
+
+    # deep output (Nematus ff_logit_prev/lstm/ctx + ff_logit)
+    e = cfg.dim_emb
+    p["ff_logit_l1_W0"] = glorot((cfg.dim_rnn, e))    # from state
+    p["ff_logit_l1_W1"] = glorot((e, e))              # from prev embedding
+    p["ff_logit_l1_W2"] = glorot((cfg.dim_ctx, e))    # from context
+    p["ff_logit_l1_b"] = inits.zeros((1, e))
+    if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
+        p["ff_logit_l2_W"] = glorot((e, cfg.trg_vocab))
+    p["ff_logit_l2_b"] = inits.zeros((1, cfg.trg_vocab))
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Embeddings / output
+# ---------------------------------------------------------------------------
+
+def _embed(cfg: S2SConfig, params: Params, ids: jax.Array,
+           side: str) -> jax.Array:
+    if side == "src" or cfg.tied_embeddings_all or "Wemb_dec" not in params:
+        table = params["Wemb"]
+    else:
+        table = params["Wemb_dec"]
+    return table[ids].astype(cfg.compute_dtype)
+
+
+def _word_dropout(x: jax.Array, rate: float, key, train: bool) -> jax.Array:
+    if train and rate > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - rate, x.shape[:-1])
+        x = x * keep[..., None].astype(x.dtype)
+    return x
+
+
+def _output_logits(cfg: S2SConfig, params: Params, state: jax.Array,
+                   emb: jax.Array, ctx: jax.Array,
+                   shortlist: Optional[jax.Array] = None) -> jax.Array:
+    """Deep output → f32 logits (reference: s2s.h DecoderS2S output mlp)."""
+    t = (jnp.dot(state, params["ff_logit_l1_W0"].astype(state.dtype))
+         + jnp.dot(emb, params["ff_logit_l1_W1"].astype(emb.dtype))
+         + jnp.dot(ctx, params["ff_logit_l1_W2"].astype(ctx.dtype))
+         + params["ff_logit_l1_b"].astype(state.dtype))
+    t = jnp.tanh(t)
+    if cfg.tied_embeddings_all or cfg.tied_embeddings:
+        w = (params["Wemb"] if cfg.tied_embeddings_all
+             or "Wemb_dec" not in params else params["Wemb_dec"]).T
+    else:
+        w = params["ff_logit_l2_W"]
+    b = params["ff_logit_l2_b"]
+    if shortlist is not None:
+        w = w[:, shortlist]
+        b = b[:, shortlist]
+    y = jnp.dot(t, w.astype(t.dtype), preferred_element_type=jnp.float32)
+    return y.astype(jnp.float32) + b.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Encoder
+# ---------------------------------------------------------------------------
+
+def encode(cfg: S2SConfig, params: Params, src_ids: jax.Array,
+           src_mask: jax.Array, train: bool = False,
+           key: Optional[jax.Array] = None) -> jax.Array:
+    """[B, Ts] → [B, Ts, C] encoder context (reference: EncoderS2S::build)."""
+    x = _embed(cfg, params, src_ids, "src")
+    x = _word_dropout(x, cfg.dropout_src,
+                      jax.random.fold_in(key, 0) if key is not None else None,
+                      train)
+    if train and cfg.dropout_rnn > 0.0 and key is not None:
+        x = _variational_dropout(x, cfg.dropout_rnn, jax.random.fold_in(key, 1))
+    mask = src_mask.astype(x.dtype)
+
+    chains = _enc_chains(cfg)
+    # layer 1: bidirectional pair (deep-transition chains)
+    fw_out, _ = R.run_layer(chains[0][0], params, x, mask)
+    bw_out, _ = R.run_layer(chains[1][0], params, x, mask, reverse=True)
+    h = jnp.concatenate([fw_out, bw_out], axis=-1)     # [B, Ts, C]
+
+    for chain, rev in chains[2:]:
+        out, _ = R.run_layer(chain, params, h, mask, reverse=rev)
+        h = h + out if cfg.skip else out
+    return h * mask[..., None]
+
+
+def _variational_dropout(x: jax.Array, rate: float, key) -> jax.Array:
+    """Same mask at every time step (reference: Marian's rnn dropout)."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, (x.shape[0], 1, x.shape[-1]))
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attention (Bahdanau MLP; reference: src/rnn/attention.cpp)
+# ---------------------------------------------------------------------------
+
+def _att_keys(cfg: S2SConfig, params: Params, enc_out: jax.Array) -> jax.Array:
+    """Encoder-side projection U*h_j, computed once (reference: attention.cpp
+    precomputes mappedContext)."""
+    return (jnp.dot(enc_out, params["decoder_att_U"].astype(enc_out.dtype))
+            + params["decoder_att_b"].astype(enc_out.dtype))
+
+
+def _attend(cfg: S2SConfig, params: Params, state: jax.Array,
+            att_keys: jax.Array, enc_out: jax.Array,
+            src_mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """state [B, D] × keys [B, Ts, A] → (context [B, C], weights [B, Ts])."""
+    q = jnp.dot(state, params["decoder_att_W"].astype(state.dtype))
+    e = jnp.tanh(q[:, None, :] + att_keys)
+    if cfg.layer_normalization:
+        e = layer_norm(e, params["decoder_att_ln_scale"])
+    scores = jnp.dot(e, params["decoder_att_v"].astype(e.dtype))[..., 0]
+    scores = scores.astype(jnp.float32)
+    scores = jnp.where(src_mask > 0, scores, -1e9)
+    w = jax.nn.softmax(scores, axis=-1).astype(enc_out.dtype)
+    ctx = jnp.einsum("bs,bsc->bc", w, enc_out,
+                     preferred_element_type=jnp.float32).astype(enc_out.dtype)
+    return ctx, w
+
+
+# ---------------------------------------------------------------------------
+# Decoder core: one conditional step (shared by train scan and decode step)
+# ---------------------------------------------------------------------------
+
+def _layer_state_names(cfg: S2SConfig) -> List[Tuple[str, Tuple[str, ...]]]:
+    """[(layer state prefix, cell state keys)] — one recurrent state per
+    decoder layer (the chain state), named decoder_base / decoder_l{l}."""
+    keys = R.make_cell(cfg.dec_cell, 1, 1).state_keys
+    names = [("decoder_base", keys)]
+    for l in range(2, cfg.dec_depth + 1):
+        names.append((f"decoder_l{l}", keys))
+    return names
+
+
+def _cell_states_init(cfg: S2SConfig, params: Params, enc_out: jax.Array,
+                      src_mask: jax.Array) -> Dict[str, jax.Array]:
+    """s0 = tanh(mean-context @ ff_state) for every decoder layer
+    (reference: DecoderS2S::startState mean-pooled start)."""
+    m = src_mask[..., None].astype(enc_out.dtype)
+    mean_ctx = (enc_out * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    s0 = jnp.dot(mean_ctx, params["ff_state_W"].astype(mean_ctx.dtype)) \
+        + params["ff_state_b"].astype(mean_ctx.dtype)
+    if cfg.layer_normalization:
+        s0 = layer_norm(s0, params["ff_state_ln_scale"])
+    s0 = jnp.tanh(s0)
+    states: Dict[str, jax.Array] = {}
+    for name, keys in _layer_state_names(cfg):
+        for k in keys:
+            states[f"{name}_{k}"] = s0
+    return states
+
+
+def _conditional_step(cfg: S2SConfig, params: Params,
+                      states: Dict[str, jax.Array], emb: jax.Array,
+                      att_keys: jax.Array, enc_out: jax.Array,
+                      src_mask: jax.Array):
+    """One decoder time step: conditional stack + high layers.
+    Returns (top_state [B,D], context [B,C], att_weights [B,Ts], new_states).
+    """
+    new_states = dict(states)
+    base = _dec_base_chain(cfg)
+
+    # cGRU: cell1 on prev embedding → attention → cells 2.. on the context,
+    # one state flowing through (reference: rnn/constructors.h cond. cell)
+    prefix, cell = base[0]
+    st = {k: states[f"decoder_base_{k}"] for k in cell.state_keys}
+    out, st = cell.step(params, prefix, cell.x_proj(params, prefix, emb), st)
+
+    ctx, w = _attend(cfg, params, out, att_keys, enc_out, src_mask)
+
+    for j, (prefix, cell) in enumerate(base[1:], start=2):
+        xp = cell.x_proj(params, prefix, ctx if j == 2 else None)
+        out, st = cell.step(params, prefix, xp, st)
+    for k, v in st.items():
+        new_states[f"decoder_base_{k}"] = v
+
+    layer_in = out
+    for chain in _dec_high_chains(cfg):
+        name = chain[0][0]  # decoder_l{l}
+        st = {k: states[f"{name}_{k}"] for k in chain[0][1].state_keys}
+        xp = chain[0][1].x_proj(params, chain[0][0], layer_in)
+        out, st = chain[0][1].step(params, chain[0][0], xp, st)
+        for prefix, cell in chain[1:]:
+            out, st = cell.step(params, prefix,
+                                cell.x_proj(params, prefix, None), st)
+        for k, v in st.items():
+            new_states[f"{name}_{k}"] = v
+        layer_in = layer_in + out if cfg.skip else out
+    return layer_in, ctx, w, new_states
+
+
+# ---------------------------------------------------------------------------
+# Teacher-forced training path
+# ---------------------------------------------------------------------------
+
+def decode_train(cfg: S2SConfig, params: Params, enc_out: jax.Array,
+                 src_mask: jax.Array, trg_ids: jax.Array,
+                 trg_mask: jax.Array, train: bool = True,
+                 key: Optional[jax.Array] = None,
+                 return_alignment: bool = False):
+    """[B, Tt] gold ids → [B, Tt, V] logits. Decoder input at t is the gold
+    embedding of t-1 (zero at t=0 — same no-BOS convention as the
+    transformer path)."""
+    b, tt = trg_ids.shape
+    emb = _embed(cfg, params, trg_ids, "trg")
+    emb = jnp.pad(emb, ((0, 0), (1, 0), (0, 0)))[:, :-1, :]   # shift right
+    kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
+    emb = _word_dropout(emb, cfg.dropout_trg, kk(0), train)
+    if train and cfg.dropout_rnn > 0.0 and key is not None:
+        emb = _variational_dropout(emb, cfg.dropout_rnn, kk(1))
+
+    att_keys = _att_keys(cfg, params, enc_out)
+    states0 = _cell_states_init(cfg, params, enc_out, src_mask)
+
+    emb_tm = jnp.swapaxes(emb, 0, 1)                           # [Tt, B, E]
+
+    def step_fn(states, e_t):
+        top, ctx, w, new_states = _conditional_step(
+            cfg, params, states, e_t, att_keys, enc_out, src_mask)
+        return new_states, (top, ctx, w)
+
+    _, (tops, ctxs, ws) = jax.lax.scan(step_fn, states0, emb_tm)
+    tops = jnp.swapaxes(tops, 0, 1)                            # [B, Tt, D]
+    ctxs = jnp.swapaxes(ctxs, 0, 1)                            # [B, Tt, C]
+    if train and cfg.dropout_rnn > 0.0 and key is not None:
+        tops = _variational_dropout(tops, cfg.dropout_rnn, kk(2))
+    logits = _output_logits(cfg, params, tops, emb, ctxs)      # [B, Tt, V]
+    if return_alignment:
+        return logits, jnp.swapaxes(ws, 0, 1)                  # [B, Tt, Ts]
+    return logits
+
+
+# ---------------------------------------------------------------------------
+# Incremental decoding
+# ---------------------------------------------------------------------------
+
+def init_decode_state(cfg: S2SConfig, params: Params, enc_out: jax.Array,
+                      src_mask: jax.Array, max_len: int) -> Dict[str, Any]:
+    """State: pos scalar + per-cell recurrent states (beam-carried) +
+    precomputed attention keys / encoder context (beam-invariant)."""
+    state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
+    state["enc_ctx"] = enc_out
+    state["enc_att_keys"] = _att_keys(cfg, params, enc_out)
+    state.update(_cell_states_init(cfg, params, enc_out, src_mask))
+    return state
+
+
+def decode_step(cfg: S2SConfig, params: Params, state: Dict[str, Any],
+                prev_ids: jax.Array, src_mask: jax.Array,
+                shortlist: Optional[jax.Array] = None,
+                return_alignment: bool = False):
+    pos = state["pos"]
+    emb = _embed(cfg, params, prev_ids[:, 0], "trg")           # [B, E]
+    emb = jnp.where(pos == 0, jnp.zeros_like(emb), emb)
+    cell_states = {k: v for k, v in state.items()
+                   if k.endswith(BEAM_CARRIED_SUFFIXES)}
+    top, ctx, w, new_cell_states = _conditional_step(
+        cfg, params, cell_states, emb, state["enc_att_keys"],
+        state["enc_ctx"], src_mask)
+    logits = _output_logits(cfg, params, top, emb, ctx, shortlist)
+    new_state = dict(state)
+    new_state.update(new_cell_states)
+    new_state["pos"] = pos + 1
+    if return_alignment:
+        return logits, new_state, w
+    return logits, new_state

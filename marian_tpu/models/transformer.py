@@ -38,6 +38,11 @@ from ..ops.attention import (causal_mask, dense_attention_with_weights)
 
 Params = Dict[str, jax.Array]
 
+# decode-state keys with these suffixes are per-beam and must be reordered
+# by backpointers in beam search (self-attention K/V caches); cross K/V and
+# 'pos' are beam-invariant.
+BEAM_CARRIED_SUFFIXES = ("_self_k", "_self_v")
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:

@@ -1,0 +1,319 @@
+"""RNN cell zoo — GRU (Nematus variant), LSTM, SSRU — as pure functions
+designed for `lax.scan`.
+
+Rebuild of reference src/rnn/cells.h (GRU/LSTM/SSRU) and src/rnn/rnn.h
+(RNN runner). The reference runs one fused CUDA kernel per cell step
+(gpu::GRUFastForward); the TPU design instead splits each cell into
+
+  1. an *input projection* computed for the WHOLE sequence in one large
+     [B*T, in] x [in, G*D] matmul before the scan (MXU-friendly — this is
+     where nearly all the FLOPs are), and
+  2. a small per-step recurrence inside `lax.scan` (only the h-dependent
+     matmul, which is irreducibly sequential).
+
+SSRU has NO h-dependent matmul, so its recurrence is a first-order linear
+scan c_t = f_t * c_{t-1} + i_t that runs as a PARALLEL prefix scan
+(`lax.associative_scan`) over the time axis — O(log T) depth on TPU instead
+of O(T). This is why Marian uses SSRU for fast decoders
+(src/rnn/cells.h :: SSRU); on TPU it additionally parallelizes training.
+
+Conventions:
+- cell params live in a FLAT dict under a string prefix (matches the model
+  param style); weights are [in, out], applied as x @ W;
+- cell state is a dict with keys from ("h", "c");
+- a cell with `dim_in == 0` is a *transition* cell (deep-transition RNNs,
+  reference: rnn.h stacked transition cells): no input matrix, the input
+  projection is just the bias;
+- optional layer-normalization normalizes the input- and state-projections
+  separately, scale-only (reference: cells.h layer-norm variants).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers import initializers as inits
+from .ops import layer_norm
+
+Params = Dict[str, jax.Array]
+State = Dict[str, jax.Array]
+
+
+def _ln(x: jax.Array, params: Params, name: str, enabled: bool) -> jax.Array:
+    if not enabled or name not in params:
+        return x
+    return layer_norm(x, params[name])
+
+
+class Cell:
+    """Common interface: init / x_proj (whole-sequence input GEMM) / step."""
+
+    kind: str = ""
+    state_keys: Tuple[str, ...] = ("h",)
+    n_gates: int = 1
+
+    def __init__(self, dim_in: int, dim: int, ln: bool = False):
+        self.dim_in = dim_in
+        self.dim = dim
+        self.ln = ln
+
+    def init(self, key: jax.Array, params: Params, prefix: str) -> None:
+        raise NotImplementedError
+
+    def x_proj(self, params: Params, prefix: str,
+               x: Optional[jax.Array]) -> jax.Array:
+        raise NotImplementedError
+
+    def step(self, params: Params, prefix: str, xp: jax.Array,
+             state: State) -> Tuple[jax.Array, State]:
+        raise NotImplementedError
+
+    def init_state(self, batch: int, dtype) -> State:
+        return {k: jnp.zeros((batch, self.dim), dtype) for k in self.state_keys}
+
+
+class GRU(Cell):
+    """Nematus-style GRU (reference: cells.h :: GRU):
+
+        z = sigmoid(x Wz + h Uz)        (update gate)
+        r = sigmoid(x Wr + h Ur)        (reset gate)
+        h~ = tanh(x Wx + r * (h Ux))    (reset applied after the matmul)
+        h' = z * h + (1 - z) * h~
+    """
+
+    kind = "gru"
+    state_keys = ("h",)
+    n_gates = 3
+
+    def init(self, key, params, prefix):
+        k = jax.random.split(key, 4)
+        d = self.dim
+        if self.dim_in > 0:
+            params[f"{prefix}_W"] = inits.glorot_uniform(k[0], (self.dim_in, 3 * d))
+        params[f"{prefix}_b"] = inits.zeros((1, 3 * d))
+        params[f"{prefix}_U"] = inits.glorot_uniform(k[1], (d, 2 * d))
+        params[f"{prefix}_Ux"] = inits.glorot_uniform(k[2], (d, d))
+        if self.ln:
+            params[f"{prefix}_W_ln_scale"] = inits.ones((1, 3 * d))
+            params[f"{prefix}_U_ln_scale"] = inits.ones((1, 2 * d))
+            params[f"{prefix}_Ux_ln_scale"] = inits.ones((1, d))
+
+    def x_proj(self, params, prefix, x):
+        b = params[f"{prefix}_b"]
+        if x is None or self.dim_in == 0:
+            return b
+        xp = jnp.dot(x, params[f"{prefix}_W"].astype(x.dtype),
+                     preferred_element_type=x.dtype)
+        xp = _ln(xp, params, f"{prefix}_W_ln_scale", self.ln)
+        return xp + b.astype(xp.dtype)
+
+    def step(self, params, prefix, xp, state):
+        h = state["h"]
+        d = self.dim
+        hu = jnp.dot(h, params[f"{prefix}_U"].astype(h.dtype),
+                     preferred_element_type=h.dtype)
+        hu = _ln(hu, params, f"{prefix}_U_ln_scale", self.ln)
+        hx = jnp.dot(h, params[f"{prefix}_Ux"].astype(h.dtype),
+                     preferred_element_type=h.dtype)
+        hx = _ln(hx, params, f"{prefix}_Ux_ln_scale", self.ln)
+        xz, xr, xh = xp[..., :d], xp[..., d:2 * d], xp[..., 2 * d:]
+        hz, hr = hu[..., :d], hu[..., d:]
+        z = jax.nn.sigmoid(xz + hz)
+        r = jax.nn.sigmoid(xr + hr)
+        hh = jnp.tanh(xh + r * hx)
+        h2 = z * h + (1.0 - z) * hh
+        return h2, {"h": h2}
+
+
+class LSTM(Cell):
+    """Standard LSTM (reference: cells.h :: LSTM): fused 4-gate projection,
+    c' = f*c + i*tanh(g), h' = o*tanh(c')."""
+
+    kind = "lstm"
+    state_keys = ("h", "c")
+    n_gates = 4
+
+    def init(self, key, params, prefix):
+        k = jax.random.split(key, 2)
+        d = self.dim
+        if self.dim_in > 0:
+            params[f"{prefix}_W"] = inits.glorot_uniform(k[0], (self.dim_in, 4 * d))
+        params[f"{prefix}_b"] = inits.zeros((1, 4 * d))
+        params[f"{prefix}_U"] = inits.glorot_uniform(k[1], (d, 4 * d))
+        if self.ln:
+            params[f"{prefix}_W_ln_scale"] = inits.ones((1, 4 * d))
+            params[f"{prefix}_U_ln_scale"] = inits.ones((1, 4 * d))
+
+    def x_proj(self, params, prefix, x):
+        b = params[f"{prefix}_b"]
+        if x is None or self.dim_in == 0:
+            return b
+        xp = jnp.dot(x, params[f"{prefix}_W"].astype(x.dtype),
+                     preferred_element_type=x.dtype)
+        xp = _ln(xp, params, f"{prefix}_W_ln_scale", self.ln)
+        return xp + b.astype(xp.dtype)
+
+    def step(self, params, prefix, xp, state):
+        h, c = state["h"], state["c"]
+        d = self.dim
+        hu = jnp.dot(h, params[f"{prefix}_U"].astype(h.dtype),
+                     preferred_element_type=h.dtype)
+        hu = _ln(hu, params, f"{prefix}_U_ln_scale", self.ln)
+        g = xp + hu
+        i = jax.nn.sigmoid(g[..., :d])
+        f = jax.nn.sigmoid(g[..., d:2 * d])
+        o = jax.nn.sigmoid(g[..., 2 * d:3 * d])
+        cand = jnp.tanh(g[..., 3 * d:])
+        c2 = f * c + i * cand
+        h2 = o * jnp.tanh(c2)
+        return h2, {"h": h2, "c": c2}
+
+
+class SSRU(Cell):
+    """Simpler Simple Recurrent Unit (reference: cells.h :: SSRU; Kim et al.
+    "From Research to Production"):
+
+        f = sigmoid(x Wf + bf)
+        c' = f * c + (1 - f) * (x W)
+        h  = relu(c')
+
+    No h-dependent matmul → the whole-sequence path runs as a parallel
+    prefix scan (`scan_linear_recurrence`)."""
+
+    kind = "ssru"
+    state_keys = ("c",)
+    n_gates = 2
+
+    def init(self, key, params, prefix):
+        k = jax.random.split(key, 2)
+        d = self.dim
+        di = self.dim_in if self.dim_in > 0 else d
+        params[f"{prefix}_W"] = inits.glorot_uniform(k[0], (di, d))
+        params[f"{prefix}_Wf"] = inits.glorot_uniform(k[1], (di, d))
+        params[f"{prefix}_bf"] = inits.zeros((1, d))
+        if self.ln:
+            params[f"{prefix}_W_ln_scale"] = inits.ones((1, d))
+
+    def x_proj(self, params, prefix, x):
+        if x is None or self.dim_in == 0:
+            x = jnp.zeros((1, self.dim), params[f"{prefix}_bf"].dtype)
+        xw = jnp.dot(x, params[f"{prefix}_W"].astype(x.dtype),
+                     preferred_element_type=x.dtype)
+        xw = _ln(xw, params, f"{prefix}_W_ln_scale", self.ln)
+        f = jax.nn.sigmoid(
+            jnp.dot(x, params[f"{prefix}_Wf"].astype(x.dtype),
+                    preferred_element_type=x.dtype)
+            + params[f"{prefix}_bf"].astype(x.dtype))
+        return jnp.concatenate([f, (1.0 - f) * xw], axis=-1)
+
+    def step(self, params, prefix, xp, state):
+        d = self.dim
+        f, inp = xp[..., :d], xp[..., d:]
+        c2 = f * state["c"] + inp
+        return jax.nn.relu(c2), {"c": c2}
+
+
+CELLS = {"gru": GRU, "lstm": LSTM, "ssru": SSRU,
+         "gru-nematus": GRU}
+
+
+def make_cell(kind: str, dim_in: int, dim: int, ln: bool = False) -> Cell:
+    try:
+        return CELLS[kind](dim_in, dim, ln)
+    except KeyError:
+        raise NotImplementedError(f"RNN cell '{kind}'") from None
+
+
+def scan_linear_recurrence(f: jax.Array, inp: jax.Array,
+                           c0: jax.Array) -> jax.Array:
+    """Parallel prefix scan for c_t = f_t * c_{t-1} + inp_t over axis 0
+    (time-major [T, B, D]). Composition of two affine maps is affine:
+    (a2, b2) ∘ (a1, b1) = (a1*a2, a2*b1 + b2)."""
+
+    def combine(x, y):
+        a1, b1 = x
+        a2, b2 = y
+        return a1 * a2, a2 * b1 + b2
+
+    inp0 = inp.at[0].add(f[0] * c0)
+    _, c = jax.lax.associative_scan(combine, (f, inp0), axis=0)
+    return c
+
+
+def chain_step(chain, params: Params, xp: jax.Array,
+               state: State) -> Tuple[jax.Array, State]:
+    """One deep-transition step: ONE recurrent state flows through the cell
+    chain (reference: rnn.h stacked transition cells / Nematus deep
+    transition). `chain` = [(prefix, cell)]; the first cell consumes the
+    (precomputed) input projection `xp`, the rest are bias-only transition
+    cells operating on the running state."""
+    out = None
+    for i, (prefix, cell) in enumerate(chain):
+        cxp = xp if i == 0 else cell.x_proj(params, prefix, None)
+        out, state = cell.step(params, prefix, cxp, state)
+    return out, state
+
+
+def run_layer(chain, params: Params,
+              xs: jax.Array, mask: Optional[jax.Array],
+              state0: Optional[State] = None,
+              reverse: bool = False) -> Tuple[jax.Array, State]:
+    """Run a deep-transition cell chain over a [B, T, in] sequence →
+    ([B, T, D] outputs, final state). `chain` is [(prefix, cell)] (a single
+    cell is the depth-1 case). `mask` [B, T] (1 = real token): on padding
+    the state carries through unchanged and the output is zeroed
+    (reference: rnn.h masked transitions). `reverse=True` scans
+    right-to-left (backward encoder)."""
+    if isinstance(chain, tuple) and len(chain) == 2 and isinstance(chain[0], str):
+        chain = [chain]
+    (prefix0, cell0) = chain[0]
+    b, t = xs.shape[0], xs.shape[1]
+    dtype = xs.dtype
+    xp_all = cell0.x_proj(params, prefix0, xs)         # [B, T, G*D] big GEMM
+    if xp_all.ndim == 2:                               # transition: bias only
+        xp_all = jnp.broadcast_to(xp_all[None, :, :], (b, t, xp_all.shape[-1]))
+    state = state0 or cell0.init_state(b, dtype)
+
+    xp_tm = jnp.swapaxes(xp_all, 0, 1)                 # [T, B, G*D]
+    m_tm = (jnp.swapaxes(mask, 0, 1)[..., None].astype(dtype)
+            if mask is not None else None)
+
+    if cell0.kind == "ssru" and state0 is None and len(chain) == 1:
+        # parallel linear recurrence — no sequential scan at all
+        d = cell0.dim
+        f, inp = xp_tm[..., :d], xp_tm[..., d:]
+        if m_tm is not None:
+            # pad steps: c_t = c_{t-1}  (f=1, inp=0)
+            f = jnp.where(m_tm > 0, f, jnp.ones_like(f))
+            inp = jnp.where(m_tm > 0, inp, jnp.zeros_like(inp))
+        if reverse:
+            f, inp = f[::-1], inp[::-1]
+        c = scan_linear_recurrence(f, inp, jnp.zeros((b, d), dtype))
+        if reverse:
+            c = c[::-1]
+        out = jax.nn.relu(c)
+        if m_tm is not None:
+            out = out * m_tm
+        final = {"c": c[-1] if not reverse else c[0]}
+        return jnp.swapaxes(out, 0, 1), final
+
+    def step_fn(carry, inputs):
+        xp, m = inputs
+        out, new_state = chain_step(chain, params, xp, carry)
+        if m is not None:
+            new_state = {k: m * new_state[k] + (1.0 - m) * carry[k]
+                         for k in new_state}
+            out = out * m
+        return new_state, out
+
+    if m_tm is None:
+        final, outs = jax.lax.scan(
+            lambda c, xp: step_fn(c, (xp, None)), state, xp_tm,
+            reverse=reverse)
+    else:
+        final, outs = jax.lax.scan(step_fn, state, (xp_tm, m_tm),
+                                   reverse=reverse)
+    return jnp.swapaxes(outs, 0, 1), final

@@ -175,14 +175,16 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         # row (batch*k + beam_idx)
         flat_src = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)  # [B*K]
 
+        carried = model.beam_carried_suffixes
+
         def reorder_state(st):
             out = {}
             for key, v in st.items():
                 if key == "pos":
                     out[key] = v
-                elif key.endswith(("_self_k", "_self_v")):
+                elif key.endswith(carried):
                     out[key] = v[flat_src]
-                else:  # cross K/V are beam-invariant after expansion
+                else:  # cross K/V / encoder context are beam-invariant
                     out[key] = v
             return out
 

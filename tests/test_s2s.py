@@ -1,0 +1,212 @@
+"""Deep-RNN s2s model tests (config #3 family): cell zoo math, SSRU parallel
+scan vs sequential oracle, teacher-forcing vs incremental-decode consistency,
+depth/skip/layer-norm variants, beam search integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.models import s2s as S
+from marian_tpu.models.encoder_decoder import create_model
+from marian_tpu.ops import rnn as R
+
+
+def s2s_options(**over):
+    base = {
+        "type": "s2s",
+        "dim-emb": 12, "dim-rnn": 16,
+        "enc-type": "bidirectional",
+        "enc-cell": "gru", "enc-cell-depth": 1, "enc-depth": 1,
+        "dec-cell": "gru", "dec-cell-base-depth": 2,
+        "dec-cell-high-depth": 1, "dec-depth": 1,
+        "label-smoothing": 0.0,
+        "precision": ["float32", "float32"],
+        "max-length": 64,
+    }
+    base.update(over)
+    return Options(base)
+
+
+def make_model(vocab=19, **over):
+    opts = s2s_options(**over)
+    model = create_model(opts, vocab, vocab)
+    params = model.init(jax.random.key(0))
+    return model, params
+
+
+def fake_batch(rng, b=3, ts=7, tt=9, vocab=19):
+    src = rng.randint(2, vocab, size=(b, ts)).astype(np.int32)
+    trg = rng.randint(2, vocab, size=(b, tt)).astype(np.int32)
+    src_mask = np.ones((b, ts), np.float32)
+    trg_mask = np.ones((b, tt), np.float32)
+    for i in range(b):
+        ls = rng.randint(3, ts)
+        src[i, ls:] = 0
+        src_mask[i, ls + 1:] = 0
+    return {"src_ids": jnp.asarray(src), "src_mask": jnp.asarray(src_mask),
+            "trg_ids": jnp.asarray(trg), "trg_mask": jnp.asarray(trg_mask)}
+
+
+# ---------------------------------------------------------------------------
+# Cells
+# ---------------------------------------------------------------------------
+
+class TestCells:
+    @pytest.mark.parametrize("kind", ["gru", "lstm", "ssru"])
+    def test_step_shapes_and_finite(self, kind, rng):
+        cell = R.make_cell(kind, 6, 8)
+        params = {}
+        cell.init(jax.random.key(0), params, "c")
+        x = jnp.asarray(rng.randn(4, 6), jnp.float32)
+        xp = cell.x_proj(params, "c", x)
+        out, st = cell.step(params, "c", xp, cell.init_state(4, jnp.float32))
+        assert out.shape == (4, 8)
+        for k in cell.state_keys:
+            assert st[k].shape == (4, 8)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_ssru_parallel_scan_matches_sequential(self, rng):
+        """associative_scan linear recurrence == step-by-step loop."""
+        cell = R.make_cell("ssru", 6, 8)
+        params = {}
+        cell.init(jax.random.key(1), params, "c")
+        xs = jnp.asarray(rng.randn(2, 10, 6), jnp.float32)
+        mask = jnp.ones((2, 10), jnp.float32)
+        out_par, fin_par = R.run_layer([("c", cell)], params, xs, mask)
+
+        # sequential oracle
+        st = cell.init_state(2, jnp.float32)
+        outs = []
+        for t in range(10):
+            xp = cell.x_proj(params, "c", xs[:, t])
+            o, st = cell.step(params, "c", xp, st)
+            outs.append(o)
+        out_seq = jnp.stack(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(out_par), np.asarray(out_seq),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fin_par["c"]),
+                                   np.asarray(st["c"]), rtol=1e-5, atol=1e-5)
+
+    def test_masked_layer_carries_state_through_pads(self, rng):
+        cell = R.make_cell("gru", 4, 5)
+        params = {}
+        cell.init(jax.random.key(2), params, "c")
+        xs = jnp.asarray(rng.randn(1, 6, 4), jnp.float32)
+        mask_full = jnp.ones((1, 6), jnp.float32)
+        mask_cut = mask_full.at[0, 4:].set(0.0)
+        out_cut, fin_cut = R.run_layer([("c", cell)], params, xs, mask_cut)
+        out_full, _ = R.run_layer([("c", cell)], params, xs, mask_full)
+        # up to the cut, outputs identical; after it, zeros
+        np.testing.assert_allclose(np.asarray(out_cut[:, :4]),
+                                   np.asarray(out_full[:, :4]), rtol=1e-6)
+        assert np.all(np.asarray(out_cut[:, 4:]) == 0.0)
+        # final state == state at the cut
+        np.testing.assert_allclose(np.asarray(fin_cut["h"][0]),
+                                   np.asarray(out_cut[0, 3]), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+class TestS2SModel:
+    def test_param_names(self):
+        model, params = make_model(enc_depth=2, dec_depth=2,
+                                   **{"enc-cell-depth": 2,
+                                      "dec-cell-base-depth": 3})
+        names = set(params)
+        for want in ("Wemb", "Wemb_dec", "encoder_bi_W", "encoder_bi_r_U",
+                     "encoder_bi_cell2_U", "ff_state_W", "decoder_cell1_W",
+                     "decoder_cell2_W", "decoder_cell3_U", "decoder_att_W",
+                     "decoder_att_v", "ff_logit_l1_W0", "ff_logit_l2_W"):
+            assert want in names, want
+
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"dec-cell": "lstm", "enc-cell": "lstm"},
+        {"dec-cell": "ssru", "enc-cell": "ssru"},
+        {"enc-depth": 2, "dec-depth": 2, "skip": True},
+        {"enc-type": "alternating", "enc-depth": 3},
+        {"layer-normalization": True},
+        {"enc-cell-depth": 2, "dec-cell-base-depth": 3,
+         "dec-cell-high-depth": 2, "dec-depth": 2},
+        {"tied-embeddings-all": True},
+        {"tied-embeddings": True},
+    ])
+    def test_loss_finite_and_grads_flow(self, kw, rng):
+        model, params = make_model(**kw)
+        batch = fake_batch(rng)
+
+        def loss_fn(p):
+            total, aux = model.loss(p, batch, key=jax.random.key(3),
+                                    train=True)
+            return total
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        gnorm = sum(float(jnp.sum(g * g)) for g in grads.values())
+        assert gnorm > 0.0
+        for name, g in grads.items():
+            assert np.all(np.isfinite(np.asarray(g))), name
+
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"dec-cell": "lstm"},
+        {"dec-cell": "ssru"},
+        {"enc-depth": 2, "dec-depth": 2, "skip": True},
+        {"dec-cell-base-depth": 3, "dec-cell-high-depth": 2, "dec-depth": 2},
+        {"layer-normalization": True},
+    ])
+    def test_teacher_forcing_matches_incremental(self, kw, rng):
+        """decode_train logits[t] == step-by-step decode logits at t when fed
+        the gold prefix — the strongest structural correctness check."""
+        model, params = make_model(**kw)
+        batch = fake_batch(rng, b=2, ts=6, tt=5)
+        cp = params  # f32 already
+        enc = model.encode_for_decode(cp, batch["src_ids"], batch["src_mask"])
+        tf_logits = S.decode_train(model.cfg, cp, enc, batch["src_mask"],
+                                   batch["trg_ids"], batch["trg_mask"],
+                                   train=False)
+        state = model.start_state(cp, enc, batch["src_mask"], max_len=5)
+        prev = jnp.zeros((2, 1), jnp.int32)
+        for t in range(5):
+            logits, state = model.step(cp, state, prev, batch["src_mask"])
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(tf_logits[:, t]),
+                rtol=2e-4, atol=2e-4)
+            prev = batch["trg_ids"][:, t:t + 1]
+
+    def test_alignment_shape(self, rng):
+        model, params = make_model()
+        batch = fake_batch(rng, b=2, ts=6, tt=5)
+        enc = model.encode_for_decode(params, batch["src_ids"],
+                                      batch["src_mask"])
+        logits, align = S.decode_train(
+            model.cfg, params, enc, batch["src_mask"], batch["trg_ids"],
+            batch["trg_mask"], train=False, return_alignment=True)
+        assert align.shape == (2, 5, 6)
+        s = np.asarray(align).sum(axis=-1)
+        np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-4)
+
+    def test_beam_search_runs_on_s2s(self, rng):
+        from marian_tpu.translator.beam_search import BeamConfig, beam_search_jit
+        model, params = make_model()
+        batch = fake_batch(rng, b=2, ts=6)
+        cfg = BeamConfig(beam_size=3, max_length=7, normalize=0.6)
+        tokens, scores, lengths, norm_scores, _ = beam_search_jit(
+            model, [params], [1.0], cfg, batch["src_ids"], batch["src_mask"])
+        assert tokens.shape == (2, 3, 7)
+        assert np.all(np.isfinite(np.asarray(norm_scores)))
+        # beams are sorted by score
+        s = np.asarray(scores)
+        assert np.all(np.diff(s, axis=1) <= 1e-6)
+
+    def test_greedy_decode_runs(self, rng):
+        from marian_tpu.translator.greedy import greedy_decode
+        model, params = make_model()
+        batch = fake_batch(rng, b=2, ts=6)
+        out = greedy_decode(model, params, batch["src_ids"],
+                            batch["src_mask"], max_len=8)
+        assert out.shape[0] == 2 and out.shape[1] <= 8
