@@ -1,0 +1,187 @@
+"""Factored vocabulary: words are a lemma ⊕ factor tags (``Hello|ci|gl-``).
+
+Rebuild of reference src/data/factored_vocab.cpp :: FactoredVocab (consumed
+by src/layers/logits.cpp for the factored softmax and by the factored
+embedding composition in src/layers/embedding.cpp). Config #4 of the
+baseline matrix uses this.
+
+File format (``.fsv``): plain text, one factored word form per line,
+``lemma|factor|factor...``; ids are line order after the specials
+(``</s>`` = 0, ``<unk>`` = 1 are prepended if absent); ``#`` comments and
+blank lines skipped.
+
+Factor groups: a factor name belongs to the group named by its alphabetic
+stem — ``gl+``/``gl-`` → group ``gl``; ``ci``/``cn``/``ca`` → group ``c``
+(capitalization: initial/none/all); ``wb``/``we`` → group ``w``; i.e. the
+name minus a trailing ``+``/``-``, else its first letter. Every factored
+form must carry at most one factor per group.
+
+The *unit* axis concatenates [lemmas..., factors...] plus one PAD slot —
+this is the axis the embedding table and output matrix are sized over.
+``factor_indices`` maps word id → its units (PAD where a group is absent):
+the TPU model computes embeddings as a masked gather-sum over units and
+output scores as a sum of per-group log-softmaxes gathered back to word
+space (layers/logits.py) — Marian's Logits class does the same group-wise
+combination lazily on the GPU graph.
+
+Surface realization on decode applies the capitalization factors and the
+glue factors (``gl+`` = no space to the left, ``gr+`` = none to the right).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .vocab import (DEFAULT_EOS_STR, DEFAULT_UNK_STR, EOS_ID, UNK_ID,
+                    VocabBase)
+
+
+def _group_of(factor: str) -> str:
+    if factor and factor[-1] in "+-":
+        return factor[:-1]
+    return factor[:1]
+
+
+class FactoredVocab(VocabBase):
+    factored = True
+
+    def __init__(self, forms: List[str]):
+        # forms[i] = full factored string for word id i
+        self._forms = forms
+        self._form2id: Dict[str, int] = {f: i for i, f in enumerate(forms)}
+
+        lemmas: List[str] = []
+        lemma_idx: Dict[str, int] = {}
+        factors: List[str] = []
+        factor_idx: Dict[str, int] = {}
+        groups: List[str] = []
+        parsed: List[Tuple[str, List[str]]] = []
+        for f in forms:
+            parts = f.split("|")
+            lemma, facs = parts[0], parts[1:]
+            if lemma not in lemma_idx:
+                lemma_idx[lemma] = len(lemmas)
+                lemmas.append(lemma)
+            for fac in facs:
+                if fac not in factor_idx:
+                    factor_idx[fac] = len(factors)
+                    factors.append(fac)
+                    g = _group_of(fac)
+                    if g not in groups:
+                        groups.append(g)
+            parsed.append((lemma, facs))
+
+        self.lemmas = lemmas
+        self.factors = factors
+        self.groups = groups                      # factor group names
+        self.n_lemmas = len(lemmas)
+        self.n_units = len(lemmas) + len(factors) + 1   # + PAD
+        self.pad_unit = self.n_units - 1
+
+        # unit index of each factor (grouped contiguously for the per-group
+        # softmax slices): reorder factors by group
+        order = sorted(range(len(factors)),
+                       key=lambda i: (groups.index(_group_of(factors[i])), i))
+        self._factor_unit = {}
+        slices: List[Tuple[str, int, int]] = [("lemma", 0, self.n_lemmas)]
+        pos = self.n_lemmas
+        for g in groups:
+            start = pos
+            for i in order:
+                if _group_of(factors[i]) == g:
+                    self._factor_unit[factors[i]] = pos
+                    pos += 1
+            slices.append((g, start, pos))
+        self.group_slices: Tuple[Tuple[str, int, int], ...] = tuple(slices)
+
+        # word → units table [V, 1 + n_groups]
+        k = 1 + len(groups)
+        tbl = np.full((len(forms), k), self.pad_unit, np.int32)
+        for wid, (lemma, facs) in enumerate(parsed):
+            tbl[wid, 0] = lemma_idx[lemma]
+            for fac in facs:
+                gi = groups.index(_group_of(fac))
+                tbl[wid, 1 + gi] = self._factor_unit[fac]
+        self.factor_indices = tbl
+
+    # -- IO -----------------------------------------------------------------
+    @classmethod
+    def load(cls, path: str) -> "FactoredVocab":
+        forms: List[str] = []
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                forms.append(line.split()[0] if " " in line else line)
+        for special in (DEFAULT_UNK_STR, DEFAULT_EOS_STR):
+            if special in forms:
+                forms.remove(special)
+            forms.insert(0, special)
+        assert forms[EOS_ID] == DEFAULT_EOS_STR
+        return cls(forms)
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            for f in self._forms:
+                fh.write(f + "\n")
+
+    # -- encode / decode ----------------------------------------------------
+    def _lookup(self, token: str) -> int:
+        wid = self._form2id.get(token)
+        if wid is not None:
+            return wid
+        # surface-form analysis: try capitalization factors (the reference
+        # relies on the external factored segmenter; this is the minimal
+        # inverse for plain-text input)
+        low = token.lower()
+        for cand in (token + "|cn", low + "|ci" if token[:1].isupper() else None,
+                     low + "|ca" if token.isupper() else None,
+                     low + "|cn", low):
+            if cand and cand in self._form2id:
+                return self._form2id[cand]
+        return UNK_ID
+
+    def encode(self, line: str, add_eos: bool = True,
+               inference: bool = False) -> List[int]:
+        ids = [self._lookup(t) for t in line.split()]
+        if add_eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def _realize(self, form: str) -> Tuple[str, bool, bool]:
+        """factored form → (surface, glue_left, glue_right)."""
+        parts = form.split("|")
+        word, facs = parts[0], set(parts[1:])
+        if "ci" in facs:
+            word = word[:1].upper() + word[1:]
+        elif "ca" in facs:
+            word = word.upper()
+        return word, ("gl+" in facs), ("gr+" in facs)
+
+    def decode(self, ids: Sequence[int], ignore_eos: bool = True) -> str:
+        out = []
+        prev_glue_right = False
+        for i in ids:
+            if ignore_eos and i == EOS_ID:
+                continue
+            word, gl, gr = self._realize(self._forms[int(i)])
+            if out and (gl or prev_glue_right):
+                out[-1] += word
+            else:
+                out.append(word)
+            prev_glue_right = gr
+        return " ".join(out)
+
+    def surface(self, ids: Sequence[int], ignore_eos: bool = True) -> List[str]:
+        return [self._forms[int(i)] for i in ids
+                if not (ignore_eos and i == EOS_ID)]
+
+    def __len__(self) -> int:
+        return len(self._forms)
+
+    def __getitem__(self, form: str) -> int:
+        return self._form2id.get(form, UNK_ID)

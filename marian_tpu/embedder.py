@@ -28,8 +28,8 @@ class Embedder:
         vocab_paths = list(options.get("vocabs", []))
         self.vocabs = [create_vocab(p, options, i)
                        for i, p in enumerate(vocab_paths[:1])]
-        self.model = create_model(options, len(self.vocabs[0]),
-                                  len(self.vocabs[0]), inference=True)
+        self.model = create_model(options, self.vocabs[0],
+                                  self.vocabs[0], inference=True)
 
         def embed(params, src_ids, src_mask):
             enc = self.model.encode_for_decode(params, src_ids, src_mask)

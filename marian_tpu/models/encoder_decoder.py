@@ -21,8 +21,23 @@ from . import transformer as T
 Params = Dict[str, jax.Array]
 
 
+def _vocab_info(v):
+    """Accept an int size, a VocabBase, or a list of either (multi-source);
+    returns (size-or-tuple, FactorTables|None) (reference: models get vocab
+    dims + factored-vocab handle from Vocab objects in model_factory.cpp)."""
+    if isinstance(v, (tuple, list)):
+        sizes, factors = zip(*[_vocab_info(x) for x in v])
+        return tuple(sizes), factors[0]
+    if isinstance(v, int):
+        return v, None
+    if getattr(v, "factored", False):
+        from ..layers.logits import FactorTables
+        return len(v), FactorTables.from_vocab(v)
+    return len(v), None
+
+
 class EncoderDecoder:
-    def __init__(self, options, src_vocab_size: int, trg_vocab_size: int,
+    def __init__(self, options, src_vocab, trg_vocab,
                  inference: bool = False):
         self.options = options
         self.model_type = options.get("type", "transformer")
@@ -32,14 +47,25 @@ class EncoderDecoder:
         self.guided_cost = str(options.get("guided-alignment-cost", "ce"))
         ga = options.get("guided-alignment", "none")
         self.use_guided = bool(ga and ga != "none") and not inference
+        src_vocab_size, src_factors = _vocab_info(src_vocab)
+        trg_vocab_size, trg_factors = _vocab_info(trg_vocab)
         if self.model_type in ("transformer", "multi-transformer", "transformer-lm"):
             self.cfg = T.config_from_options(options, src_vocab_size,
-                                             trg_vocab_size, inference)
+                                             trg_vocab_size, inference,
+                                             src_factors=src_factors,
+                                             trg_factors=trg_factors)
             self._mod = T
         elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s"):
             from . import s2s as S
+            if isinstance(src_vocab_size, tuple):
+                raise NotImplementedError(
+                    "multi-source is supported for transformer models; "
+                    "use --type multi-transformer")
             self.cfg = S.config_from_options(options, src_vocab_size,
                                              trg_vocab_size, inference)
+            if src_factors or trg_factors:
+                raise NotImplementedError(
+                    "factored vocabs are supported for transformer models")
             self._mod = S
         else:
             raise NotImplementedError(f"model type '{self.model_type}'")
@@ -62,11 +88,12 @@ class EncoderDecoder:
         cparams = T.cast_params(params, self.cfg.compute_dtype)
         k_enc = jax.random.fold_in(key, 1) if key is not None else None
         k_dec = jax.random.fold_in(key, 2) if key is not None else None
-        enc_out = self._mod.encode(self.cfg, cparams, batch["src_ids"],
-                                   batch["src_mask"], train, k_enc)
+        src_ids, src_mask = self._batch_sources(batch)
+        enc_out = self._mod.encode(self.cfg, cparams, src_ids,
+                                   src_mask, train, k_enc)
         want_align = self.use_guided and "guided" in batch
         res = self._mod.decode_train(self.cfg, cparams, enc_out,
-                                     batch["src_mask"], batch["trg_ids"],
+                                     src_mask, batch["trg_ids"],
                                      batch["trg_mask"], train, k_dec,
                                      return_alignment=want_align)
         logits, align = res if want_align else (res, None)
@@ -81,6 +108,16 @@ class EncoderDecoder:
             total = total + self.guided_weight * ga * rl.labels
             aux["guided"] = ga
         return total, aux
+
+    def _batch_sources(self, batch):
+        """Collect source streams from a batch dict: 'src_ids'/'src_mask'
+        plus 'src{i}_ids'/'src{i}_mask' for multi-source (i = 2..N)."""
+        n = getattr(self.cfg, "n_encoders", 1)
+        if n == 1:
+            return batch["src_ids"], batch["src_mask"]
+        ids = [batch["src_ids"]] + [batch[f"src{i}_ids"] for i in range(2, n + 1)]
+        masks = [batch["src_mask"]] + [batch[f"src{i}_mask"] for i in range(2, n + 1)]
+        return tuple(ids), tuple(masks)
 
     # -- incremental decoding (reference: startState/step) ------------------
     def encode_for_decode(self, params: Params, src_ids, src_mask):
@@ -100,11 +137,12 @@ class EncoderDecoder:
                                      src_mask, shortlist, return_alignment)
 
 
-def create_model(options, src_vocab_size: int, trg_vocab_size: int,
+def create_model(options, src_vocab, trg_vocab,
                  inference: bool = False) -> EncoderDecoder:
     """Model factory (reference: src/models/model_factory.cpp ::
-    models::createModelFromOptions)."""
-    return EncoderDecoder(options, src_vocab_size, trg_vocab_size, inference)
+    models::createModelFromOptions). Vocab args may be int sizes or
+    VocabBase objects (factored vocabs enable the factored softmax)."""
+    return EncoderDecoder(options, src_vocab, trg_vocab, inference)
 
 
 ARCH_KEY_PREFIXES = ("transformer", "enc-", "dec-", "dim-", "tied-",
@@ -127,13 +165,17 @@ def apply_embedded_config(options, config_yaml: Optional[str]):
 
 
 def batch_to_arrays(batch) -> Dict[str, jnp.ndarray]:
-    """CorpusBatch → dict of device arrays for the jitted loss."""
+    """CorpusBatch → dict of device arrays for the jitted loss. Extra
+    source streams (multi-source) become src{i}_ids/src{i}_mask."""
     out = {
         "src_ids": jnp.asarray(batch.src.ids),
         "src_mask": jnp.asarray(batch.src.mask),
         "trg_ids": jnp.asarray(batch.trg.ids),
         "trg_mask": jnp.asarray(batch.trg.mask),
     }
+    for i, sb in enumerate(batch.sub[1:-1], start=2):
+        out[f"src{i}_ids"] = jnp.asarray(sb.ids)
+        out[f"src{i}_mask"] = jnp.asarray(sb.mask)
     if batch.guided_alignment is not None:
         out["guided"] = jnp.asarray(batch.guided_alignment)
     if batch.data_weights is not None:

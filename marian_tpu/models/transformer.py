@@ -77,6 +77,15 @@ class TransformerConfig:
     decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
     compute_dtype: Any = jnp.bfloat16
     guided_alignment_layer: str = "last"
+    # factored-vocab metadata (layers/logits.py FactorTables); None = plain
+    src_factors: Any = None
+    trg_factors: Any = None
+    # multi-source (reference: model_factory.cpp assembling N encoders for
+    # --type multi-transformer; doc-level context, config #4): encoder i
+    # gets param prefix 'encoder' / 'encoder2' / ...; every decoder layer
+    # stacks one cross-attention sublayer per encoder, in order.
+    n_encoders: int = 1
+    src_vocabs: Tuple[int, ...] = ()          # per-encoder vocab sizes
 
     @property
     def dim_head(self) -> int:
@@ -91,11 +100,17 @@ class TransformerConfig:
         return self.dec_ffn_depth or self.ffn_depth
 
 
-def config_from_options(options, src_vocab: int, trg_vocab: int,
-                        for_inference: bool = False) -> TransformerConfig:
+def config_from_options(options, src_vocab, trg_vocab: int,
+                        for_inference: bool = False,
+                        src_factors=None, trg_factors=None) -> TransformerConfig:
     """Map Marian flags → TransformerConfig (reference: transformer.h reads
-    the same option names)."""
+    the same option names). `src_vocab` may be a tuple of sizes
+    (multi-source: one encoder per entry)."""
     g = options.get
+    if isinstance(src_vocab, (tuple, list)):
+        src_vocabs = tuple(int(v) for v in src_vocab)
+    else:
+        src_vocabs = (int(src_vocab),)
     precision = g("precision", ["float32"])
     compute = precision[0] if isinstance(precision, list) else precision
     # the reference's float16 path maps to bf16 on TPU (MXU-native)
@@ -103,8 +118,10 @@ def config_from_options(options, src_vocab: int, trg_vocab: int,
              "bfloat16": jnp.bfloat16}.get(str(compute), jnp.float32)
     drop = 0.0 if for_inference else float(g("transformer-dropout", 0.0))
     return TransformerConfig(
-        src_vocab=src_vocab,
+        src_vocab=src_vocabs[0],
         trg_vocab=trg_vocab,
+        n_encoders=len(src_vocabs),
+        src_vocabs=src_vocabs,
         dim_emb=int(g("dim-emb", 512)),
         heads=int(g("transformer-heads", 8)),
         dim_ffn=int(g("transformer-dim-ffn", 2048)),
@@ -133,7 +150,31 @@ def config_from_options(options, src_vocab: int, trg_vocab: int,
         decoder_autoreg=str(g("transformer-decoder-autoreg", "self-attention")),
         compute_dtype=dtype,
         guided_alignment_layer=str(g("transformer-guided-alignment-layer", "last")),
+        src_factors=src_factors,
+        trg_factors=trg_factors,
     )
+
+
+def _src_rows(cfg: TransformerConfig) -> int:
+    return cfg.src_factors.n_units if cfg.src_factors else cfg.src_vocab
+
+
+def _trg_rows(cfg: TransformerConfig) -> int:
+    return cfg.trg_factors.n_units if cfg.trg_factors else cfg.trg_vocab
+
+
+def _enc_prefix(i: int) -> str:
+    """Param prefix of encoder i (multi-source: encoder, encoder2, ...)."""
+    return "encoder" if i == 0 else f"encoder{i + 1}"
+
+
+def _ctx_suffix(i: int) -> str:
+    """Suffix of the decoder cross-attention block for encoder i."""
+    return "" if i == 0 else str(i + 1)
+
+
+def _as_tuple(x) -> tuple:
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
 
 
 # ---------------------------------------------------------------------------
@@ -151,19 +192,24 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             scale = 1.0 / math.sqrt(depth_layer)
         return inits.glorot_uniform(next(k), shape, scale=scale)
 
-    # embeddings
+    # embeddings (row count = factor units for factored vocabs)
     if cfg.tied_embeddings_all or cfg.tied_embeddings_src:
-        if cfg.src_vocab != cfg.trg_vocab:
+        if _src_rows(cfg) != _trg_rows(cfg) or \
+                any(v != cfg.src_vocab for v in cfg.src_vocabs):
             raise ValueError("tied src embeddings require equal vocab sizes")
-        p["Wemb"] = glorot((cfg.src_vocab, d))
+        p["Wemb"] = glorot((_src_rows(cfg), d))
     else:
-        p["encoder_Wemb"] = glorot((cfg.src_vocab, d))
-        p["decoder_Wemb"] = glorot((cfg.trg_vocab, d))
+        for i in range(cfg.n_encoders):
+            rows = (cfg.src_factors.n_units if cfg.src_factors and i == 0
+                    else cfg.src_vocabs[i])
+            p[f"{_enc_prefix(i)}_Wemb"] = glorot((rows, d))
+        p["decoder_Wemb"] = glorot((_trg_rows(cfg), d))
     if cfg.train_position_embeddings:
         p["Wpos"] = glorot((cfg.max_length, d))
     if "n" in cfg.postprocess_emb:
-        p["encoder_emb_ln_scale"] = inits.ones((1, d))
-        p["encoder_emb_ln_bias"] = inits.zeros((1, d))
+        for i in range(cfg.n_encoders):
+            p[f"{_enc_prefix(i)}_emb_ln_scale"] = inits.ones((1, d))
+            p[f"{_enc_prefix(i)}_emb_ln_bias"] = inits.zeros((1, d))
         p["decoder_emb_ln_scale"] = inits.ones((1, d))
         p["decoder_emb_ln_bias"] = inits.zeros((1, d))
 
@@ -190,24 +236,27 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             p[f"{prefix}_ffn_ln_scale"] = inits.ones((1, d))
             p[f"{prefix}_ffn_ln_bias"] = inits.zeros((1, d))
 
-    for l in range(1, cfg.enc_depth + 1):
-        attn_block(f"encoder_l{l}_self", l)
-        ffn_block(f"encoder_l{l}_ffn", cfg.dim_ffn, cfg.ffn_depth, l)
-    if "n" in cfg.postprocess_top or "n" in cfg.preprocess:
-        p["encoder_top_ln_scale"] = inits.ones((1, d))
-        p["encoder_top_ln_bias"] = inits.zeros((1, d))
+    for i in range(cfg.n_encoders):
+        ep = _enc_prefix(i)
+        for l in range(1, cfg.enc_depth + 1):
+            attn_block(f"{ep}_l{l}_self", l)
+            ffn_block(f"{ep}_l{l}_ffn", cfg.dim_ffn, cfg.ffn_depth, l)
+        if "n" in cfg.postprocess_top or "n" in cfg.preprocess:
+            p[f"{ep}_top_ln_scale"] = inits.ones((1, d))
+            p[f"{ep}_top_ln_bias"] = inits.zeros((1, d))
 
     for l in range(1, cfg.dec_depth + 1):
         attn_block(f"decoder_l{l}_self", l)
-        attn_block(f"decoder_l{l}_context", l)
+        for i in range(cfg.n_encoders):
+            attn_block(f"decoder_l{l}_context{_ctx_suffix(i)}", l)
         ffn_block(f"decoder_l{l}_ffn", cfg.dec_ffn, cfg.dec_ffn_d, l)
     if "n" in cfg.postprocess_top or "n" in cfg.preprocess:
         p["decoder_top_ln_scale"] = inits.ones((1, d))
         p["decoder_top_ln_bias"] = inits.zeros((1, d))
 
     if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
-        p["decoder_ff_logit_out_W"] = glorot((d, cfg.trg_vocab))
-    p["decoder_ff_logit_out_b"] = inits.zeros((1, cfg.trg_vocab))
+        p["decoder_ff_logit_out_W"] = glorot((d, _trg_rows(cfg)))
+    p["decoder_ff_logit_out_b"] = inits.zeros((1, _trg_rows(cfg)))
     return p
 
 
@@ -305,14 +354,22 @@ def sinusoidal_positions(length: int, dim: int, start: int = 0) -> jax.Array:
 
 
 def _embed_words(cfg: TransformerConfig, params: Params, ids: jax.Array,
-                 side: str) -> jax.Array:
-    """Token embedding * sqrt(dim) (reference: transformer.h embFactor)."""
+                 side: str, enc_idx: int = 0) -> jax.Array:
+    """Token embedding * sqrt(dim) (reference: transformer.h embFactor);
+    factored vocabs compose emb(lemma) + Σ emb(factor) (layers/logits.py)."""
+    own = _enc_prefix(enc_idx) + "_Wemb" if side == "src" else "decoder_Wemb"
     if cfg.tied_embeddings_all or (cfg.tied_embeddings_src and side == "src") \
-            or ("Wemb" in params and f"{'encoder' if side == 'src' else 'decoder'}_Wemb" not in params):
+            or ("Wemb" in params and own not in params):
         table = params["Wemb"]
     else:
-        table = params["encoder_Wemb" if side == "src" else "decoder_Wemb"]
-    x = table[ids].astype(cfg.compute_dtype)
+        table = params[own]
+    ft = (cfg.src_factors if enc_idx == 0 else None) if side == "src" \
+        else cfg.trg_factors
+    if ft is not None:
+        from ..layers.logits import factored_embed
+        x = factored_embed(table, ft, ids, cfg.compute_dtype)
+    else:
+        x = table[ids].astype(cfg.compute_dtype)
     return x * jnp.asarray(math.sqrt(cfg.dim_emb), cfg.compute_dtype)
 
 
@@ -336,8 +393,9 @@ def _add_pos(cfg: TransformerConfig, params: Params, x: jax.Array,
 
 
 def _embed(cfg: TransformerConfig, params: Params, ids: jax.Array,
-           side: str, key, train: bool, start_pos=0) -> jax.Array:
-    x = _embed_words(cfg, params, ids, side)
+           side: str, key, train: bool, start_pos=0,
+           enc_idx: int = 0) -> jax.Array:
+    x = _embed_words(cfg, params, ids, side, enc_idx)
     rate = cfg.dropout_src if side == "src" else cfg.dropout_trg
     x = _word_dropout(cfg, x, rate, key, train)
     return _add_pos(cfg, params, x, start_pos)
@@ -365,34 +423,48 @@ def sinusoidal_positions_dynamic(length: int, dim: int, start) -> jax.Array:
 # Encoder
 # ---------------------------------------------------------------------------
 
-def encode(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
-           src_mask: jax.Array, train: bool = False,
-           key: Optional[jax.Array] = None) -> jax.Array:
+def encode(cfg: TransformerConfig, params: Params, src_ids,
+           src_mask, train: bool = False,
+           key: Optional[jax.Array] = None):
     """[B, Ts] ids + mask → [B, Ts, D] encoder states (reference:
-    TransformerEncoder::apply)."""
+    TransformerEncoder::apply). Multi-source: pass tuples of ids/masks —
+    one encoder stack per stream, returns a tuple of states."""
+    if isinstance(src_ids, (tuple, list)):
+        masks = _as_tuple(src_mask)
+        return tuple(
+            _encode_one(cfg, params, ids_i, masks[i], train,
+                        jax.random.fold_in(key, 1000 + i) if key is not None
+                        else None, i)
+            for i, ids_i in enumerate(src_ids))
+    return _encode_one(cfg, params, src_ids, src_mask, train, key, 0)
+
+
+def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
+                src_mask: jax.Array, train: bool, key, enc_idx: int) -> jax.Array:
+    ep = _enc_prefix(enc_idx)
     kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
-    x = _embed(cfg, params, src_ids, "src", kk(0), train)
-    x = _pre_post(cfg, cfg.postprocess_emb, x, None, "encoder_emb", params,
+    x = _embed(cfg, params, src_ids, "src", kk(0), train, enc_idx=enc_idx)
+    x = _pre_post(cfg, cfg.postprocess_emb, x, None, f"{ep}_emb", params,
                   kk(1), train)
     attn_mask = src_mask[:, None, None, :]  # [B,1,1,Ts]
     for l in range(1, cfg.enc_depth + 1):
         lk = kk(l * 10)
         # self-attention sublayer
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"encoder_l{l}_self_Wo", params, lk, train)
-        out, _ = _mha(cfg, params, f"encoder_l{l}_self", pre, pre, attn_mask,
+                        f"{ep}_l{l}_self_Wo", params, lk, train)
+        out, _ = _mha(cfg, params, f"{ep}_l{l}_self", pre, pre, attn_mask,
                       lk, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"encoder_l{l}_self_Wo", params, lk, train)
+                      f"{ep}_l{l}_self_Wo", params, lk, train)
         # ffn sublayer
         lk2 = kk(l * 10 + 5)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"encoder_l{l}_ffn_ffn", params, lk2, train)
-        out = _ffn(cfg, params, f"encoder_l{l}_ffn", pre, cfg.dim_ffn,
+                        f"{ep}_l{l}_ffn_ffn", params, lk2, train)
+        out = _ffn(cfg, params, f"{ep}_l{l}_ffn", pre, cfg.dim_ffn,
                    cfg.ffn_depth, lk2, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"encoder_l{l}_ffn_ffn", params, lk2, train)
-    x = _pre_post(cfg, cfg.postprocess_top, x, None, "encoder_top", params,
+                      f"{ep}_l{l}_ffn_ffn", params, lk2, train)
+    x = _pre_post(cfg, cfg.postprocess_top, x, None, f"{ep}_top", params,
                   kk(9999), train)
     return x
 
@@ -418,7 +490,9 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
                   kk(1), train)
     tt = trg_ids.shape[1]
     self_mask = causal_mask(tt) * trg_mask[:, None, None, :]
-    cross_mask = src_mask[:, None, None, :]
+    enc_outs = _as_tuple(enc_out)
+    masks = _as_tuple(src_mask)
+    cross_masks = [m[:, None, None, :] for m in masks]
     align = None
     for l in range(1, cfg.dec_depth + 1):
         lk = kk(l * 10)
@@ -429,16 +503,20 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
         x = _pre_post(cfg, cfg.postprocess, out, x,
                       f"decoder_l{l}_self_Wo", params, lk, train)
 
-        lk2 = kk(l * 10 + 3)
-        want_w = return_alignment and _is_alignment_layer(cfg, l)
-        pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"decoder_l{l}_context_Wo", params, lk2, train)
-        out, w = _mha(cfg, params, f"decoder_l{l}_context", pre, enc_out,
-                      cross_mask, lk2, train, return_weights=want_w)
-        if want_w and w is not None:
-            align = w.mean(axis=1)  # [B, Tt, Ts] head-averaged soft alignment
-        x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"decoder_l{l}_context_Wo", params, lk2, train)
+        # one cross-attention sublayer per encoder (multi-source stacks them)
+        for i, eo in enumerate(enc_outs):
+            cname = f"decoder_l{l}_context{_ctx_suffix(i)}"
+            lk2 = kk(l * 10 + 3 + i)
+            want_w = (return_alignment and i == 0
+                      and _is_alignment_layer(cfg, l))
+            pre = _pre_post(cfg, cfg.preprocess, x, None,
+                            f"{cname}_Wo", params, lk2, train)
+            out, w = _mha(cfg, params, cname, pre, eo,
+                          cross_masks[i], lk2, train, return_weights=want_w)
+            if want_w and w is not None:
+                align = w.mean(axis=1)  # [B,Tt,Ts] head-averaged alignment
+            x = _pre_post(cfg, cfg.postprocess, out, x,
+                          f"{cname}_Wo", params, lk2, train)
 
         lk3 = kk(l * 10 + 7)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
@@ -465,7 +543,13 @@ def _is_alignment_layer(cfg: TransformerConfig, l: int) -> bool:
 def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
                   shortlist: Optional[jax.Array] = None) -> jax.Array:
     """Output projection with tied embeddings and optional shortlist slice
-    (reference: src/layers/output.cpp :: mlp::Output). Returns f32 logits."""
+    (reference: src/layers/output.cpp :: mlp::Output). Returns f32 logits.
+
+    Factored vocab: ONE matmul over the unit axis, then the group-wise
+    log-softmax combination (reference: layers/logits.cpp; the returned
+    values are word log-probs — downstream softmax/log-softmax renormalizes
+    over the word axis, which only shifts scores by a constant per
+    position)."""
     if cfg.tied_embeddings_all:
         w = params["Wemb"].T
     elif cfg.tied_embeddings:
@@ -473,6 +557,12 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
     else:
         w = params["decoder_ff_logit_out_W"]
     b = params["decoder_ff_logit_out_b"]
+    if cfg.trg_factors is not None:
+        from ..layers.logits import factored_log_probs
+        units = jnp.dot(x, w.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+        units = units.astype(jnp.float32) + b.astype(jnp.float32)
+        return factored_log_probs(units, cfg.trg_factors, shortlist)
     if shortlist is not None:
         w = w[:, shortlist]
         b = b[:, shortlist]
@@ -485,21 +575,23 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
 # ---------------------------------------------------------------------------
 
 def init_decode_state(cfg: TransformerConfig, params: Params,
-                      enc_out: jax.Array, src_mask: jax.Array,
+                      enc_out, src_mask,
                       max_len: int) -> Dict[str, Any]:
     """Precompute cross-attention K/V; allocate fixed-size self-attn caches
-    (reference: EncoderDecoder::startState + per-layer cache init)."""
-    b = enc_out.shape[0]
+    (reference: EncoderDecoder::startState + per-layer cache init).
+    Multi-source: per-encoder cross K/V under suffixed keys."""
+    enc_outs = _as_tuple(enc_out)
+    b = enc_outs[0].shape[0]
     h, dh = cfg.heads, cfg.dim_head
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
     for l in range(1, cfg.dec_depth + 1):
-        kv = enc_out
-        state[f"l{l}_cross_k"] = _split_heads(
-            affine(kv, params[f"decoder_l{l}_context_Wk"],
-                   params[f"decoder_l{l}_context_bk"]), h)
-        state[f"l{l}_cross_v"] = _split_heads(
-            affine(kv, params[f"decoder_l{l}_context_Wv"],
-                   params[f"decoder_l{l}_context_bv"]), h)
+        for i, kv in enumerate(enc_outs):
+            cname = f"decoder_l{l}_context{_ctx_suffix(i)}"
+            sfx = _ctx_suffix(i)
+            state[f"l{l}_cross_k{sfx}"] = _split_heads(
+                affine(kv, params[f"{cname}_Wk"], params[f"{cname}_bk"]), h)
+            state[f"l{l}_cross_v{sfx}"] = _split_heads(
+                affine(kv, params[f"{cname}_Wv"], params[f"{cname}_bv"]), h)
         state[f"l{l}_self_k"] = jnp.zeros((b, h, max_len, dh), cfg.compute_dtype)
         state[f"l{l}_self_v"] = jnp.zeros((b, h, max_len, dh), cfg.compute_dtype)
     return state
@@ -525,7 +617,7 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
     # self mask: [1,1,1,max_len] — attend to steps 0..pos
     steps = jnp.arange(max_len)
     self_mask = (steps <= pos).astype(cfg.compute_dtype)[None, None, None, :]
-    cross_mask = src_mask[:, None, None, :]
+    cross_masks = [m[:, None, None, :] for m in _as_tuple(src_mask)]
     align = None
     new_state = dict(state)
     for l in range(1, cfg.dec_depth + 1):
@@ -539,17 +631,22 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
         x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
                       f"decoder_l{l}_self_Wo", params, None, False)
 
-        want_w = return_alignment and _is_alignment_layer(cfg, l)
-        pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
-                        f"decoder_l{l}_context_Wo", params, None, False)
-        cross_cache = {"k": state[f"l{l}_cross_k"], "v": state[f"l{l}_cross_v"]}
-        out, w = _mha(cfg, params, f"decoder_l{l}_context", pre, None,
-                      cross_mask, None, False, cache=cross_cache,
-                      static_kv=True, return_weights=want_w)
-        if want_w and w is not None:
-            align = w.mean(axis=1)[:, 0, :]  # [B, Ts]
-        x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
-                      f"decoder_l{l}_context_Wo", params, None, False)
+        for i in range(cfg.n_encoders):
+            sfx = _ctx_suffix(i)
+            cname = f"decoder_l{l}_context{sfx}"
+            want_w = (return_alignment and i == 0
+                      and _is_alignment_layer(cfg, l))
+            pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
+                            f"{cname}_Wo", params, None, False)
+            cross_cache = {"k": state[f"l{l}_cross_k{sfx}"],
+                           "v": state[f"l{l}_cross_v{sfx}"]}
+            out, w = _mha(cfg, params, cname, pre, None,
+                          cross_masks[i], None, False, cache=cross_cache,
+                          static_kv=True, return_weights=want_w)
+            if want_w and w is not None:
+                align = w.mean(axis=1)[:, 0, :]  # [B, Ts]
+            x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
+                          f"{cname}_Wo", params, None, False)
 
         pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
                         f"decoder_l{l}_ffn_ffn", params, None, False)

@@ -34,8 +34,8 @@ class Rescorer:
         vocab_paths = list(options.get("vocabs", []))
         self.vocabs = [create_vocab(p, options, i)
                        for i, p in enumerate(vocab_paths)]
-        self.model = create_model(options, len(self.vocabs[0]),
-                                  len(self.vocabs[-1]), inference=True)
+        self.model = create_model(options, self.vocabs[0],
+                                  self.vocabs[-1], inference=True)
 
         def per_sentence_ce(params, batch):
             from .models import transformer as T

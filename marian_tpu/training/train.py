@@ -50,7 +50,8 @@ class Train:
         corpus = Corpus(train_sets, vocabs, opts)
 
         # -- model + graph group -------------------------------------------
-        model = create_model(opts, len(vocabs[0]), len(vocabs[-1]))
+        src_side = vocabs[:-1] if len(vocabs) > 2 else vocabs[0]
+        model = create_model(opts, src_side, vocabs[-1])
         gg = GraphGroup(model, opts)
 
         model_path = opts.get("model", "model.npz")

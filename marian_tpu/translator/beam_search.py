@@ -68,9 +68,17 @@ def _flatten_beams(x: jax.Array) -> jax.Array:
     return x.reshape((-1,) + x.shape[2:])
 
 
-def _expand_to_beams(x: jax.Array, k: int) -> jax.Array:
-    """[B, ...] → [B*K, ...] by repeat (encoder outputs shared per beam)."""
+def _expand_to_beams(x, k: int):
+    """[B, ...] → [B*K, ...] by repeat (encoder outputs shared per beam).
+    Tuples (multi-source) are expanded leaf-wise."""
+    if isinstance(x, (tuple, list)):
+        return tuple(_expand_to_beams(e, k) for e in x)
     return jnp.repeat(x, k, axis=0)
+
+
+def _first(x):
+    """First stream of a possibly-multi-source input."""
+    return x[0] if isinstance(x, (tuple, list)) else x
 
 
 def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
@@ -83,7 +91,7 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
     params_list/weights: ensemble of scorers (reference: scorers.h); each
     scorer keeps its own decode state, log-probs are weight-summed.
     """
-    b = src_ids.shape[0]
+    b = _first(src_ids).shape[0]
     k = cfg.beam_size
     L = cfg.max_length
     bk = b * k
@@ -106,7 +114,7 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
     finished0 = jnp.zeros((b, k), bool)
     lengths0 = jnp.zeros((b, k), jnp.int32)
     prev0 = jnp.zeros((bk, 1), jnp.int32)
-    aligns0 = (jnp.zeros((b, k, L, src_ids.shape[1]), jnp.float32)
+    aligns0 = (jnp.zeros((b, k, L, _first(src_ids).shape[1]), jnp.float32)
                if cfg.return_alignment else jnp.zeros((0,), jnp.float32))
 
     def cond(carry):

@@ -53,8 +53,8 @@ class Translate:
         self.src_vocab = self.vocabs[0]
         self.trg_vocab = self.vocabs[-1]
 
-        self.model = create_model(self.options, len(self.src_vocab),
-                                  len(self.trg_vocab), inference=True)
+        self.model = create_model(self.options, self.src_vocab,
+                                  self.trg_vocab, inference=True)
         weights = self.options.get("weights", []) or None
         self.search = BeamSearch(self.model, self.params_list, weights,
                                  self.options, self.trg_vocab)

@@ -1,0 +1,173 @@
+"""Factored vocabulary + factored softmax tests (config #4 family)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.factored_vocab import FactoredVocab
+from marian_tpu.layers.logits import (FactorTables, factored_embed,
+                                      factored_log_probs)
+from marian_tpu.models.encoder_decoder import create_model
+
+FSV = """\
+</s>
+<unk>
+hello|ci
+hello|cn
+world|cn
+world|ci|gl+
+cat|cn
+dog|cn
+s|gl+
+"""
+
+
+@pytest.fixture
+def fsv_path(tmp_path):
+    p = tmp_path / "vocab.fsv"
+    p.write_text(FSV)
+    return str(p)
+
+
+@pytest.fixture
+def fvocab(fsv_path):
+    return FactoredVocab.load(fsv_path)
+
+
+class TestFactoredVocab:
+    def test_specials_and_ids(self, fvocab):
+        assert fvocab["</s>"] == 0 and fvocab["<unk>"] == 1
+        assert len(fvocab) == 9
+
+    def test_groups_and_slices(self, fvocab):
+        # groups: c (ci/cn), gl (gl+)
+        assert set(fvocab.groups) == {"c", "gl"}
+        names = [s[0] for s in fvocab.group_slices]
+        assert names[0] == "lemma"
+        # slices partition the unit axis (minus PAD)
+        total = sum(e - s for _, s, e in fvocab.group_slices)
+        assert total == fvocab.n_units - 1
+
+    def test_factor_indices_shape_and_pad(self, fvocab):
+        tbl = fvocab.factor_indices
+        assert tbl.shape == (len(fvocab), 1 + len(fvocab.groups))
+        # '</s>' has no factors: all factor columns PAD
+        assert all(tbl[0, 1:] == fvocab.pad_unit)
+        # every word's lemma column is a valid lemma unit
+        assert (tbl[:, 0] < fvocab.n_lemmas).all()
+
+    def test_encode_capitalization_analysis(self, fvocab):
+        ids = fvocab.encode("Hello world", add_eos=False)
+        assert ids[0] == fvocab["hello|ci"]
+        assert ids[1] == fvocab["world|cn"]
+
+    def test_decode_realizes_caps_and_glue(self, fvocab):
+        ids = [fvocab["hello|ci"], fvocab["world|ci|gl+"]]
+        assert fvocab.decode(ids) == "HelloWorld"
+        ids = [fvocab["cat|cn"], fvocab["s|gl+"]]
+        assert fvocab.decode(ids) == "cats"
+
+    def test_unknown_word_is_unk(self, fvocab):
+        assert fvocab.encode("zebra", add_eos=False) == [1]
+
+
+class TestFactoredMath:
+    def test_log_probs_are_group_normalized(self, fvocab, rng):
+        ft = FactorTables.from_vocab(fvocab)
+        units = jnp.asarray(rng.randn(2, ft.n_units), jnp.float32)
+        logp = factored_log_probs(units, ft)
+        assert logp.shape == (2, len(fvocab))
+        # each word's log-prob = sum of its units' group log-probs
+        pieces = []
+        for _n, s, e in ft.group_slices:
+            pieces.append(jax.nn.log_softmax(units[..., s:e]))
+        full = np.concatenate([np.asarray(x) for x in pieces] +
+                              [np.zeros((2, 1), np.float32)], axis=-1)
+        for wid in range(len(fvocab)):
+            want = sum(full[:, u] for u in ft.factor_indices[wid]
+                       if u != ft.pad_unit)
+            np.testing.assert_allclose(np.asarray(logp[:, wid]), want,
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_shortlist_slice_matches_full(self, fvocab, rng):
+        ft = FactorTables.from_vocab(fvocab)
+        units = jnp.asarray(rng.randn(3, ft.n_units), jnp.float32)
+        sl = jnp.asarray([0, 2, 5], jnp.int32)
+        full = factored_log_probs(units, ft)
+        sliced = factored_log_probs(units, ft, shortlist=sl)
+        np.testing.assert_allclose(np.asarray(sliced),
+                                   np.asarray(full[:, sl]), rtol=1e-6)
+
+    def test_factored_embed_sums_units(self, fvocab, rng):
+        ft = FactorTables.from_vocab(fvocab)
+        table = jnp.asarray(rng.randn(ft.n_units, 8), jnp.float32)
+        wid = fvocab["world|ci|gl+"]
+        emb = factored_embed(table, ft, jnp.asarray([[wid]]), jnp.float32)
+        units = [u for u in ft.factor_indices[wid] if u != ft.pad_unit]
+        want = sum(np.asarray(table[u]) for u in units)
+        np.testing.assert_allclose(np.asarray(emb[0, 0]), want, rtol=1e-5)
+
+
+class TestFactoredModel:
+    def _model(self, fvocab, **over):
+        base = {"type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+                "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+                "tied-embeddings-all": True, "label-smoothing": 0.0,
+                "precision": ["float32", "float32"], "max-length": 32}
+        base.update(over)
+        model = create_model(Options(base), fvocab, fvocab)
+        params = model.init(jax.random.key(0))
+        return model, params
+
+    def test_embedding_table_sized_by_units(self, fvocab):
+        model, params = self._model(fvocab)
+        assert params["Wemb"].shape[0] == fvocab.n_units
+        assert params["decoder_ff_logit_out_b"].shape[1] == fvocab.n_units
+
+    def test_loss_and_grads(self, fvocab, rng):
+        model, params = self._model(fvocab)
+        v = len(fvocab)
+        batch = {
+            "src_ids": jnp.asarray(rng.randint(2, v, (2, 5)), jnp.int32),
+            "src_mask": jnp.ones((2, 5), jnp.float32),
+            "trg_ids": jnp.asarray(rng.randint(2, v, (2, 6)), jnp.int32),
+            "trg_mask": jnp.ones((2, 6), jnp.float32),
+        }
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss(p, batch, None, train=False)[0])(params)
+        assert np.isfinite(float(loss))
+        assert float(jnp.sum(jnp.abs(grads["Wemb"]))) > 0
+
+    def test_teacher_forcing_matches_incremental(self, fvocab, rng):
+        model, params = self._model(fvocab)
+        v = len(fvocab)
+        src = jnp.asarray(rng.randint(2, v, (2, 5)), jnp.int32)
+        src_mask = jnp.ones((2, 5), jnp.float32)
+        trg = jnp.asarray(rng.randint(2, v, (2, 4)), jnp.int32)
+        from marian_tpu.models import transformer as T
+        enc = model.encode_for_decode(params, src, src_mask)
+        tf = T.decode_train(model.cfg, params, enc, src_mask, trg,
+                            jnp.ones((2, 4), jnp.float32), train=False)
+        state = model.start_state(params, enc, src_mask, max_len=4)
+        prev = jnp.zeros((2, 1), jnp.int32)
+        for t in range(4):
+            logits, state = model.step(params, state, prev, src_mask)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(tf[:, t]),
+                                       rtol=2e-4, atol=2e-4)
+            prev = trg[:, t:t + 1]
+
+    def test_beam_search_decodes_factored(self, fvocab, rng):
+        from marian_tpu.translator.beam_search import BeamConfig, beam_search_jit
+        model, params = self._model(fvocab)
+        v = len(fvocab)
+        src = jnp.asarray(rng.randint(2, v, (2, 5)), jnp.int32)
+        mask = jnp.ones((2, 5), jnp.float32)
+        cfg = BeamConfig(beam_size=2, max_length=6)
+        tokens, scores, lengths, norm, _ = beam_search_jit(
+            model, [params], [1.0], cfg, src, mask)
+        assert tokens.shape == (2, 2, 6)
+        assert int(tokens.max()) < v
+        assert np.all(np.isfinite(np.asarray(norm)))
