@@ -34,7 +34,8 @@ import jax.numpy as jnp
 
 from ..layers import initializers as inits
 from ..ops.ops import (activation, affine, dropout, layer_norm)
-from ..ops.attention import (causal_mask, dense_attention_with_weights)
+from ..ops.attention import (attention, causal_mask,
+                             dense_attention_with_weights)
 
 Params = Dict[str, jax.Array]
 
@@ -75,6 +76,7 @@ class TransformerConfig:
     depth_scaling: bool = False
     no_projection: bool = False
     decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
+    flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
     compute_dtype: Any = jnp.bfloat16
     guided_alignment_layer: str = "last"
     # factored-vocab metadata (layers/logits.py FactorTables); None = plain
@@ -148,6 +150,7 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         depth_scaling=bool(g("transformer-depth-scaling", False)),
         no_projection=bool(g("transformer-no-projection", False)),
         decoder_autoreg=str(g("transformer-decoder-autoreg", "self-attention")),
+        flash_attention=str(g("transformer-flash-attention", "auto")),
         compute_dtype=dtype,
         guided_alignment_layer=str(g("transformer-guided-alignment-layer", "last")),
         src_factors=src_factors,
@@ -299,7 +302,9 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
          cache: Optional[Dict[str, jax.Array]] = None,
          cache_pos: Optional[jax.Array] = None,
          static_kv: bool = False,
-         return_weights: bool = False):
+         return_weights: bool = False,
+         kv_mask: Optional[jax.Array] = None,
+         causal: bool = False):
     """Multi-head attention with optional decode cache.
 
     cache (self-attn): dict with 'k','v' [B,H,L,Dh]; new K/V written at
@@ -320,10 +325,11 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
                 cache["v"], v_.astype(cache["v"].dtype), (0, 0, cache_pos, 0))
             cache["k"], cache["v"] = k_, v_
     dk = jax.random.fold_in(key, 97) if (key is not None) else None
-    out, weights = dense_attention_with_weights(
-        q, k_, v_, mask,
+    out, weights = attention(
+        q, k_, v_, mask, kv_mask=kv_mask, causal=causal,
         dropout_rate=cfg.attention_dropout, dropout_key=dk,
-        deterministic=not train, return_weights=return_weights)
+        deterministic=not train, return_weights=return_weights,
+        flash=cfg.flash_attention)
     out = _merge_heads(out)
     if not cfg.no_projection:
         out = affine(out, params[f"{prefix}_Wo"], params[f"{prefix}_bo"])
@@ -453,7 +459,7 @@ def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
         pre = _pre_post(cfg, cfg.preprocess, x, None,
                         f"{ep}_l{l}_self_Wo", params, lk, train)
         out, _ = _mha(cfg, params, f"{ep}_l{l}_self", pre, pre, attn_mask,
-                      lk, train)
+                      lk, train, kv_mask=src_mask)
         x = _pre_post(cfg, cfg.postprocess, out, x,
                       f"{ep}_l{l}_self_Wo", params, lk, train)
         # ffn sublayer
@@ -499,7 +505,7 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
         pre = _pre_post(cfg, cfg.preprocess, x, None,
                         f"decoder_l{l}_self_Wo", params, lk, train)
         out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre, self_mask,
-                      lk, train)
+                      lk, train, kv_mask=trg_mask, causal=True)
         x = _pre_post(cfg, cfg.postprocess, out, x,
                       f"decoder_l{l}_self_Wo", params, lk, train)
 
@@ -512,7 +518,8 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
             pre = _pre_post(cfg, cfg.preprocess, x, None,
                             f"{cname}_Wo", params, lk2, train)
             out, w = _mha(cfg, params, cname, pre, eo,
-                          cross_masks[i], lk2, train, return_weights=want_w)
+                          cross_masks[i], lk2, train, return_weights=want_w,
+                          kv_mask=masks[i])
             if want_w and w is not None:
                 align = w.mean(axis=1)  # [B,Tt,Ts] head-averaged alignment
             x = _pre_post(cfg, cfg.postprocess, out, x,

@@ -51,6 +51,40 @@ def dense_attention_with_weights(q, k, v, mask=None, dropout_rate=0.0,
     return out, (weights if return_weights else None)
 
 
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              mask: Optional[jax.Array] = None,
+              kv_mask: Optional[jax.Array] = None,
+              causal: bool = False,
+              dropout_rate: float = 0.0,
+              dropout_key: Optional[jax.Array] = None,
+              deterministic: bool = True,
+              return_weights: bool = False,
+              flash: str = "auto",
+              flash_min_len: int = 1024):
+    """Attention dispatcher: dense (XLA-fused einsum) vs Pallas flash.
+
+    `mask` is the general [B,1,Tq,Tk] dense mask; `kv_mask` [B,Tk] + `causal`
+    is the structured form the flash kernel understands. Callers that can,
+    pass both — flash is picked when it is (a) allowed (`flash` = auto|on),
+    (b) applicable (no returned weights, no active attention dropout, a
+    structured mask describing the dense one, multi-query step), and (c) for
+    "auto", worth it (sequence long enough that streaming K/V blocks beats
+    one fused dense batch matmul; crossover measured on v5e ~1-2k)."""
+    applicable = (
+        flash != "off"
+        and not return_weights
+        and (deterministic or dropout_rate == 0.0)
+        and q.shape[-2] > 1
+        and (kv_mask is not None or causal or mask is None))
+    if applicable and (flash == "on" or
+                       max(q.shape[-2], k.shape[-2]) >= flash_min_len):
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, kv_mask=kv_mask, causal=causal), None
+    return dense_attention_with_weights(
+        q, k, v, mask, dropout_rate, dropout_key, deterministic,
+        return_weights)
+
+
 def causal_mask(length: int, dtype=jnp.float32) -> jax.Array:
     """[1, 1, T, T] future mask (reference: transformer.h triangle mask)."""
     m = jnp.tril(jnp.ones((length, length), dtype=dtype))

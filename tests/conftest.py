@@ -24,6 +24,16 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Pallas registers MLIR lowerings for the "tpu" platform at import time, which
+# requires the tpu backend factory to still be registered — import it BEFORE
+# dropping the factories (kernels then run in interpret mode on CPU).
+try:
+    import jax.experimental.pallas  # noqa: F401
+    import jax.experimental.pallas.tpu  # noqa: F401
+except Exception:
+    pass
+
 try:
     import jax._src.xla_bridge as _xb
     for _plugin in ("axon", "tpu"):
