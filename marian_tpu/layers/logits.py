@@ -63,7 +63,6 @@ def factored_log_probs(unit_logits: jax.Array, ft: FactorTables,
     log-probs of its units (reference: Logits::getLoss /
     Logits::getLogits combination). With a shortlist, only the shortlisted
     words' rows of the index table are gathered (output [..., K_sl])."""
-    logp = jnp.empty_like(unit_logits)
     pieces = []
     for _name, start, end in ft.group_slices:
         pieces.append(jax.nn.log_softmax(unit_logits[..., start:end], axis=-1))

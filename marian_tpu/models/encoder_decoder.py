@@ -27,7 +27,7 @@ def _vocab_info(v):
     dims + factored-vocab handle from Vocab objects in model_factory.cpp)."""
     if isinstance(v, (tuple, list)):
         sizes, factors = zip(*[_vocab_info(x) for x in v])
-        return tuple(sizes), factors[0]
+        return tuple(sizes), tuple(factors)
     if isinstance(v, int):
         return v, None
     if getattr(v, "factored", False):

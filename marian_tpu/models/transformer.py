@@ -79,8 +79,9 @@ class TransformerConfig:
     flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
     compute_dtype: Any = jnp.bfloat16
     guided_alignment_layer: str = "last"
-    # factored-vocab metadata (layers/logits.py FactorTables); None = plain
-    src_factors: Any = None
+    # factored-vocab metadata (layers/logits.py FactorTables): one entry per
+    # encoder for the source side (None entry = plain vocab for that stream)
+    src_factors: Tuple[Any, ...] = (None,)
     trg_factors: Any = None
     # multi-source (reference: model_factory.cpp assembling N encoders for
     # --type multi-transformer; doc-level context, config #4): encoder i
@@ -113,6 +114,11 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         src_vocabs = tuple(int(v) for v in src_vocab)
     else:
         src_vocabs = (int(src_vocab),)
+    # normalize src_factors to one entry per encoder
+    if not isinstance(src_factors, (tuple, list)):
+        src_factors = (src_factors,)
+    src_factors = (tuple(src_factors)
+                   + (None,) * (len(src_vocabs) - len(src_factors)))
     precision = g("precision", ["float32"])
     compute = precision[0] if isinstance(precision, list) else precision
     # the reference's float16 path maps to bf16 on TPU (MXU-native)
@@ -158,8 +164,9 @@ def config_from_options(options, src_vocab, trg_vocab: int,
     )
 
 
-def _src_rows(cfg: TransformerConfig) -> int:
-    return cfg.src_factors.n_units if cfg.src_factors else cfg.src_vocab
+def _src_rows(cfg: TransformerConfig, i: int = 0) -> int:
+    ft = cfg.src_factors[i] if i < len(cfg.src_factors) else None
+    return ft.n_units if ft else cfg.src_vocabs[i]
 
 
 def _trg_rows(cfg: TransformerConfig) -> int:
@@ -197,15 +204,13 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
 
     # embeddings (row count = factor units for factored vocabs)
     if cfg.tied_embeddings_all or cfg.tied_embeddings_src:
-        if _src_rows(cfg) != _trg_rows(cfg) or \
-                any(v != cfg.src_vocab for v in cfg.src_vocabs):
+        if any(_src_rows(cfg, i) != _trg_rows(cfg)
+               for i in range(cfg.n_encoders)):
             raise ValueError("tied src embeddings require equal vocab sizes")
-        p["Wemb"] = glorot((_src_rows(cfg), d))
+        p["Wemb"] = glorot((_trg_rows(cfg), d))
     else:
         for i in range(cfg.n_encoders):
-            rows = (cfg.src_factors.n_units if cfg.src_factors and i == 0
-                    else cfg.src_vocabs[i])
-            p[f"{_enc_prefix(i)}_Wemb"] = glorot((rows, d))
+            p[f"{_enc_prefix(i)}_Wemb"] = glorot((_src_rows(cfg, i), d))
         p["decoder_Wemb"] = glorot((_trg_rows(cfg), d))
     if cfg.train_position_embeddings:
         p["Wpos"] = glorot((cfg.max_length, d))
@@ -369,8 +374,7 @@ def _embed_words(cfg: TransformerConfig, params: Params, ids: jax.Array,
         table = params["Wemb"]
     else:
         table = params[own]
-    ft = (cfg.src_factors if enc_idx == 0 else None) if side == "src" \
-        else cfg.trg_factors
+    ft = cfg.src_factors[enc_idx] if side == "src" else cfg.trg_factors
     if ft is not None:
         from ..layers.logits import factored_embed
         x = factored_embed(table, ft, ids, cfg.compute_dtype)

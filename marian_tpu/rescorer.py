@@ -34,17 +34,21 @@ class Rescorer:
         vocab_paths = list(options.get("vocabs", []))
         self.vocabs = [create_vocab(p, options, i)
                        for i, p in enumerate(vocab_paths)]
-        self.model = create_model(options, self.vocabs[0],
+        # every vocab but the last is a source stream (multi-source parity
+        # with training; see train.py)
+        src_side = self.vocabs[:-1] if len(self.vocabs) > 2 else self.vocabs[0]
+        self.model = create_model(options, src_side,
                                   self.vocabs[-1], inference=True)
 
         def per_sentence_ce(params, batch):
             from .models import transformer as T
             cparams = T.cast_params(params, self.model.cfg.compute_dtype)
+            src_ids, src_mask = self.model._batch_sources(batch)
             enc = self.model._mod.encode(self.model.cfg, cparams,
-                                         batch["src_ids"], batch["src_mask"],
+                                         src_ids, src_mask,
                                          False, None)
             logits = self.model._mod.decode_train(
-                self.model.cfg, cparams, enc, batch["src_mask"],
+                self.model.cfg, cparams, enc, src_mask,
                 batch["trg_ids"], batch["trg_mask"], train=False)
             ce = cross_entropy(logits, batch["trg_ids"], 0.0)
             ce = ce * batch["trg_mask"]

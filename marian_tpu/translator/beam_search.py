@@ -254,19 +254,25 @@ class BeamSearch:
             self._jitted[key] = jax.jit(fn)
         return self._jitted[key]
 
-    def search(self, src_ids: np.ndarray, src_mask: np.ndarray,
+    def search(self, src_ids, src_mask,
                shortlist=None) -> List[List[dict]]:
         """Returns per-sentence n-best lists of dicts
-        {tokens, score, norm_score, alignment}."""
-        b, ts = src_ids.shape
+        {tokens, score, norm_score, alignment}. src_ids/src_mask may be
+        tuples of streams (multi-source)."""
+        b, ts = _first(src_ids).shape
         # static decode cap per source bucket (Marian: factor * src length)
         L = int(min(self.max_length_cap,
                     max(8, round(self.max_length_factor * ts))))
         cfg = BeamConfig.from_options(self.options, L)
         sl_idx = jnp.asarray(shortlist.indices) if shortlist is not None else None
         fn = self._get_fn(cfg, sl_idx is not None)
-        args = (tuple(self.params_list), jnp.asarray(src_ids),
-                jnp.asarray(src_mask))
+
+        def _dev(x):
+            if isinstance(x, (tuple, list)):
+                return tuple(jnp.asarray(e) for e in x)
+            return jnp.asarray(x)
+
+        args = (tuple(self.params_list), _dev(src_ids), _dev(src_mask))
         if sl_idx is not None:
             tokens, scores, lengths, norm_scores, aligns = fn(*args, sl_idx)
         else:

@@ -52,9 +52,16 @@ class Translate:
                        for i, p in enumerate(vocab_paths)]
         self.src_vocab = self.vocabs[0]
         self.trg_vocab = self.vocabs[-1]
+        # multi-source models (--type multi-transformer) take every vocab but
+        # the last as a source stream, mirroring training (train.py)
+        self.src_vocab_list = self.vocabs[:-1] if len(self.vocabs) > 2 \
+            else [self.src_vocab]
 
-        self.model = create_model(self.options, self.src_vocab,
-                                  self.trg_vocab, inference=True)
+        self.model = create_model(
+            self.options,
+            self.src_vocab_list if len(self.src_vocab_list) > 1
+            else self.src_vocab,
+            self.trg_vocab, inference=True)
         weights = self.options.get("weights", []) or None
         self.search = BeamSearch(self.model, self.params_list, weights,
                                  self.options, self.trg_vocab)
@@ -63,16 +70,25 @@ class Translate:
         self.printer = OutputPrinter(self.options, self.trg_vocab)
 
     def _input_corpus(self, lines: Optional[List[str]] = None):
+        n_src = len(self.src_vocab_list)
         if lines is not None:
+            if n_src > 1:
+                raise ValueError("multi-source decoding requires --input "
+                                 "with one file per source stream")
             return TextInput([lines], [self.src_vocab], self.options)
         inputs = self.options.get("input", ["stdin"])
-        path = inputs[0] if isinstance(inputs, list) else inputs
-        if path in ("stdin", "-"):
-            lines = [l.rstrip("\n") for l in sys.stdin]
-            return TextInput([lines], [self.src_vocab], self.options)
-        with open(path, "r", encoding="utf-8") as fh:
-            lines = [l.rstrip("\n") for l in fh]
-        return TextInput([lines], [self.src_vocab], self.options)
+        paths = inputs if isinstance(inputs, list) else [inputs]
+        if n_src > 1 and len(paths) != n_src:
+            raise ValueError(f"multi-source model expects {n_src} --input "
+                             f"files, got {len(paths)}")
+        streams = []
+        for path in paths[:max(n_src, 1)]:
+            if path in ("stdin", "-"):
+                streams.append([l.rstrip("\n") for l in sys.stdin])
+            else:
+                with open(path, "r", encoding="utf-8") as fh:
+                    streams.append([l.rstrip("\n") for l in fh])
+        return TextInput(streams, self.src_vocab_list, self.options)
 
     def run(self, lines: Optional[List[str]] = None,
             stream=None) -> List[str]:
@@ -96,12 +112,18 @@ class Translate:
         results: List[str] = []
         for batch in bg:
             real = batch.size
-            src_ids = batch.src.ids
-            src_mask = batch.src.mask
+            if len(self.src_vocab_list) > 1:
+                src_ids = tuple(sb.ids for sb in batch.sub)
+                src_mask = tuple(sb.mask for sb in batch.sub)
+            else:
+                src_ids = batch.src.ids
+                src_mask = batch.src.mask
             shortlist = None
             if self.shortlist_gen is not None:
+                ids0 = src_ids[0] if isinstance(src_ids, tuple) else src_ids
+                mask0 = src_mask[0] if isinstance(src_mask, tuple) else src_mask
                 shortlist = self.shortlist_gen.generate(
-                    np.unique(src_ids[src_mask > 0]))
+                    np.unique(ids0[mask0 > 0]))
             nbests = self.search.search(src_ids, src_mask, shortlist=shortlist)
             for row in range(real):
                 sid = int(batch.sentence_ids[row])

@@ -116,3 +116,91 @@ class TestMultiSource:
         arrays = batch_to_arrays(cb)
         assert "src2_ids" in arrays and arrays["src2_ids"].shape == (2, 4)
         assert arrays["trg_ids"].shape == (2, 6)
+
+
+class TestMultiSourceDrivers:
+    """The task drivers must assemble the same multi-encoder model that
+    training used (regression: Translate/Rescorer used to pass only the
+    first vocab, silently decoding with a single-encoder network)."""
+
+    def _vocab_yaml(self, tmp_path, name, words):
+        p = tmp_path / name
+        lines = ["</s>: 0", "<unk>: 1"]
+        lines += [f"{w}: {i}" for i, w in enumerate(words, start=2)]
+        p.write_text("\n".join(lines) + "\n")
+        return str(p)
+
+    def test_translator_builds_all_encoders(self, tmp_path, rng):
+        import yaml
+        from marian_tpu.common import io as mio
+        from marian_tpu.translator.translator import Translate
+
+        v1 = self._vocab_yaml(tmp_path, "v1.yml", ["a", "b", "c"])
+        v2 = self._vocab_yaml(tmp_path, "v2.yml", ["x", "y"])
+        vt = self._vocab_yaml(tmp_path, "vt.yml", ["u", "v", "w"])
+        opts = multi_options(**{
+            "models": [], "model": str(tmp_path / "m.npz"),
+            "vocabs": [v1, v2, vt], "beam-size": 2, "max-length": 16,
+            "mini-batch": 2, "maxi-batch": 1, "input": ["f1", "f2"],
+        })
+        model = create_model(opts, [5, 4], 5)
+        params = model.init(jax.random.key(0))
+        cfg_yaml = yaml.safe_dump(dict(opts.items())
+                                  if hasattr(opts, "items") else {})
+        mio.save_model(str(tmp_path / "m.npz"),
+                       {k: np.asarray(v) for k, v in params.items()},
+                       config_yaml=cfg_yaml)
+
+        f1 = tmp_path / "in1.txt"
+        f2 = tmp_path / "in2.txt"
+        f1.write_text("a b\nc a\n")
+        f2.write_text("x y\ny x\n")
+        opts = opts.with_(input=[str(f1), str(f2)],
+                          output=str(tmp_path / "out.txt"))
+        tr = Translate(opts)
+        assert getattr(tr.model.cfg, "n_encoders", 1) == 2
+        tr.run()
+        out = (tmp_path / "out.txt").read_text().splitlines()
+        assert len(out) == 2
+
+    def test_rescorer_builds_all_encoders(self, tmp_path, rng):
+        import yaml
+        from marian_tpu.common import io as mio
+        from marian_tpu.rescorer import Rescorer
+
+        v1 = self._vocab_yaml(tmp_path, "v1.yml", ["a", "b", "c"])
+        v2 = self._vocab_yaml(tmp_path, "v2.yml", ["x", "y"])
+        vt = self._vocab_yaml(tmp_path, "vt.yml", ["u", "v", "w"])
+        model = create_model(multi_options(), [5, 4], 5)
+        params = model.init(jax.random.key(0))
+        mio.save_model(str(tmp_path / "m.npz"),
+                       {k: np.asarray(v) for k, v in params.items()},
+                       config_yaml=yaml.safe_dump({"type": "multi-transformer"}))
+        s1 = tmp_path / "s1.txt"; s1.write_text("a b\nc a\n")
+        s2 = tmp_path / "s2.txt"; s2.write_text("x y\ny x\n")
+        st = tmp_path / "st.txt"; st.write_text("u v\nw u\n")
+        opts = multi_options(**{
+            "model": str(tmp_path / "m.npz"), "models": [],
+            "vocabs": [v1, v2, vt],
+            "train-sets": [str(s1), str(s2), str(st)],
+            "mini-batch": 2,
+        })
+        r = Rescorer(opts)
+        assert getattr(r.model.cfg, "n_encoders", 1) == 2
+        scores = r.run(stream=open(tmp_path / "scores.txt", "w"))
+        assert len(scores) == 2
+
+
+class TestMultiSourceFactored:
+    def test_per_encoder_factor_tables(self):
+        """_vocab_info must keep one FactorTables per source stream."""
+        from marian_tpu.models.encoder_decoder import _vocab_info
+
+        class FakeFactored:
+            factored = False  # plain streams here; tuple shape is the point
+            def __len__(self):
+                return 7
+
+        sizes, factors = _vocab_info([FakeFactored(), FakeFactored()])
+        assert sizes == (7, 7)
+        assert isinstance(factors, tuple) and len(factors) == 2
