@@ -1,0 +1,82 @@
+"""marian-conv: model format conversion — float checkpoints → int8-quantized
+and/or mmap-able .bin models, plus lexical-shortlist binarization (reference:
+src/command/marian_conv.cpp; the intgemm8/packed16 preparation becomes TPU
+int8 per-channel quantization, ops/quantization.py).
+
+Usage:
+    marian-conv --from model.npz --to model.int8.npz --gemm-type int8tpu
+    marian-conv --from model.npz --to model.bin                  # format only
+    marian-conv --shortlist lex.s2t 100 100 --vocabs v1 v2 --to lex.bin
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="marian-conv")
+    p.add_argument("--from", dest="src", metavar="FROM",
+                   help="Input model file (.npz or .bin)")
+    p.add_argument("--to", dest="dst", required=True,
+                   help="Output file (.npz or .bin)")
+    p.add_argument("--gemm-type", "-g", default="float32",
+                   choices=["float32", "int8tpu"],
+                   help="float32 = format conversion only; int8tpu = "
+                        "per-channel int8 weights for MXU int8 decode")
+    p.add_argument("--shortlist", nargs="*", default=None,
+                   help="Convert a lexical shortlist: lex.s2t [first] [best]")
+    p.add_argument("--vocabs", nargs=2, default=None,
+                   help="Vocabs for shortlist conversion")
+    args = p.parse_args(argv)
+
+    if args.shortlist is not None:
+        _convert_shortlist(args)
+        return
+
+    if not args.src:
+        p.error("--from is required for model conversion")
+
+    import numpy as np
+    import yaml
+    from ..common import io as mio
+    from ..ops.quantization import quantize_params
+
+    params, cfg_yaml = mio.load_model(args.src)
+    n_before = sum(np.asarray(v).nbytes for v in params.values())
+    if args.gemm_type == "int8tpu":
+        cfg = yaml.safe_load(cfg_yaml) if cfg_yaml else {}
+        mtype = str(cfg.get("type", "transformer"))
+        if mtype not in ("transformer", "multi-transformer", "transformer-lm"):
+            raise SystemExit(
+                f"marian-conv: int8tpu supports transformer models only "
+                f"(checkpoint type '{mtype}'); the s2s/RNN decode path "
+                f"does not consume quantized tensors")
+        params = quantize_params(params)
+        cfg["gemm-type"] = "int8tpu"
+        cfg_yaml = yaml.safe_dump(cfg, default_flow_style=False)
+    n_after = sum(np.asarray(v).nbytes for v in params.values())
+    mio.save_model(args.dst, params, cfg_yaml)
+    print(f"Converted {args.src} -> {args.dst} "
+          f"[{args.gemm_type}] {n_before / 1e6:.1f}MB -> {n_after / 1e6:.1f}MB",
+          file=sys.stderr)
+
+
+def _convert_shortlist(args):
+    """lex.s2t text table → binary shortlist (QuickSand-style binarization;
+    reference: marian_conv.cpp shortlist conversion path)."""
+    from ..data.shortlist import LexicalShortlistGenerator
+    from ..data.vocab import create_vocab
+    if not args.vocabs:
+        raise SystemExit("--vocabs SRC TRG required for shortlist conversion")
+    path = args.shortlist[0]
+    first = int(args.shortlist[1]) if len(args.shortlist) > 1 else 100
+    best = int(args.shortlist[2]) if len(args.shortlist) > 2 else 100
+    sv = create_vocab(args.vocabs[0], None, 0)
+    tv = create_vocab(args.vocabs[1], None, 1)
+    gen = LexicalShortlistGenerator(path, sv, tv, first=first, best=best)
+    gen.save_binary(args.dst)
+    print(f"Converted shortlist {path} -> {args.dst}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

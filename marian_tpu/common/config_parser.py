@@ -118,6 +118,7 @@ _MODEL = [
     _f("gradient-checkpointing", bool, False, "Rematerialization (jax.checkpoint) to save memory", "model"),
     # tpu-specific (new, no Marian equivalent)
     _f("attention-kernel", str, "auto", "Attention impl: auto, dense, flash (Pallas)", "model"),
+    _f("sequence-parallel", str, "none", "Sequence/context parallelism over the 'seq' mesh axis: none, ring (K/V blocks rotate via ppermute), ulysses (all-to-all head<->seq swap) (TPU extension)", "model"),
     _f("scan-layers", bool, False, "lax.scan over layer stack (faster compile, needs uniform layers)", "model"),
 ]
 
@@ -213,6 +214,7 @@ _TRAINING = [
     # devices
     _f("devices", str, ["0"], "Device ids (GPU compat) or tpu:N..M mesh spec", "training", "+"),
     _f("num-devices", int, 0, "Number of devices (0 = all visible)", "training"),
+    _f("data-backend", str, "python", "Batch pipeline: python, or native (C++ tokenizer+batcher, marian_tpu/native) (TPU extension)", "training"),
     _f("no-nccl", bool, False, "(GPU compat; ignored — ICI collectives are always used)", "training"),
     _f("sharding", str, "global", "Optimizer sharding domain: global (ZeRO-1 over all devices) or local", "training"),
     _f("sync-freq", str, "200u", "Param sync frequency for local sharding", "training"),

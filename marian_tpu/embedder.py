@@ -22,7 +22,9 @@ class Embedder:
         log.create_loggers(options)
         model_path = (list(options.get("models", [])) or [options.get("model")])[0]
         params, cfg_yaml = mio.load_model(model_path)
-        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        from .ops.quantization import wrap_quantized
+        self.params = wrap_quantized(
+            {k: jnp.asarray(v) for k, v in params.items()})
         from .models.encoder_decoder import apply_embedded_config
         options = self.options = apply_embedded_config(options, cfg_yaml)
         vocab_paths = list(options.get("vocabs", []))

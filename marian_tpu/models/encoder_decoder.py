@@ -50,10 +50,15 @@ class EncoderDecoder:
         src_vocab_size, src_factors = _vocab_info(src_vocab)
         trg_vocab_size, trg_factors = _vocab_info(trg_vocab)
         if self.model_type in ("transformer", "multi-transformer", "transformer-lm"):
+            seq_mesh = None
+            if str(options.get("sequence-parallel", "none") or "none") != "none":
+                from ..parallel import mesh as _mesh
+                seq_mesh = _mesh.make_mesh(options)
             self.cfg = T.config_from_options(options, src_vocab_size,
                                              trg_vocab_size, inference,
                                              src_factors=src_factors,
-                                             trg_factors=trg_factors)
+                                             trg_factors=trg_factors,
+                                             seq_mesh=seq_mesh)
             self._mod = T
         elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s"):
             from . import s2s as S

@@ -77,6 +77,11 @@ class TransformerConfig:
     no_projection: bool = False
     decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
     flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
+    # sequence/context parallelism over the mesh 'seq' axis (TPU extension,
+    # parallel/sequence.py): "none" | "ring" | "ulysses". seq_mesh is the
+    # device mesh the shard_map'd attention runs on (closed over, not traced).
+    sequence_parallel: str = "none"
+    seq_mesh: Any = None
     compute_dtype: Any = jnp.bfloat16
     guided_alignment_layer: str = "last"
     # factored-vocab metadata (layers/logits.py FactorTables): one entry per
@@ -105,7 +110,8 @@ class TransformerConfig:
 
 def config_from_options(options, src_vocab, trg_vocab: int,
                         for_inference: bool = False,
-                        src_factors=None, trg_factors=None) -> TransformerConfig:
+                        src_factors=None, trg_factors=None,
+                        seq_mesh=None) -> TransformerConfig:
     """Map Marian flags → TransformerConfig (reference: transformer.h reads
     the same option names). `src_vocab` may be a tuple of sizes
     (multi-source: one encoder per entry)."""
@@ -157,6 +163,8 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         no_projection=bool(g("transformer-no-projection", False)),
         decoder_autoreg=str(g("transformer-decoder-autoreg", "self-attention")),
         flash_attention=str(g("transformer-flash-attention", "auto")),
+        sequence_parallel=str(g("sequence-parallel", "none") or "none"),
+        seq_mesh=seq_mesh,
         compute_dtype=dtype,
         guided_alignment_layer=str(g("transformer-guided-alignment-layer", "last")),
         src_factors=src_factors,
@@ -330,11 +338,32 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
                 cache["v"], v_.astype(cache["v"].dtype), (0, 0, cache_pos, 0))
             cache["k"], cache["v"] = k_, v_
     dk = jax.random.fold_in(key, 97) if (key is not None) else None
-    out, weights = attention(
-        q, k_, v_, mask, kv_mask=kv_mask, causal=causal,
-        dropout_rate=cfg.attention_dropout, dropout_key=dk,
-        deterministic=not train, return_weights=return_weights,
-        flash=cfg.flash_attention)
+    # sequence-parallel path: full-sequence attention (training/scoring, not
+    # the cached decode step) runs ring/ulysses over the 'seq' mesh axis so
+    # the time dimension stays sharded end-to-end (parallel/sequence.py)
+    n_seq = cfg.seq_mesh.shape.get("seq", 1) if cfg.seq_mesh is not None else 1
+    n_model = cfg.seq_mesh.shape.get("model", 1) if cfg.seq_mesh is not None else 1
+    if (cfg.sequence_parallel != "none" and n_seq > 1
+            and cache is None and not return_weights
+            and q.shape[-2] > 1
+            # shard_map needs even splits: time dims over 'seq', heads over
+            # 'model' (length buckets guarantee this only up to seq<=8 —
+            # fall back to dense/GSPMD otherwise)
+            and q.shape[-2] % n_seq == 0 and k_.shape[-2] % n_seq == 0
+            and q.shape[1] % max(n_model, 1) == 0
+            and q.shape[0] % max(cfg.seq_mesh.shape.get("data", 1), 1) == 0
+            and (cfg.attention_dropout == 0.0 or not train)):
+        from ..parallel.sequence import ring_attention_sharded
+        out = ring_attention_sharded(cfg.seq_mesh, q, k_, v_,
+                                     kv_mask=kv_mask, causal=causal,
+                                     mode=cfg.sequence_parallel)
+        weights = None
+    else:
+        out, weights = attention(
+            q, k_, v_, mask, kv_mask=kv_mask, causal=causal,
+            dropout_rate=cfg.attention_dropout, dropout_key=dk,
+            deterministic=not train, return_weights=return_weights,
+            flash=cfg.flash_attention)
     out = _merge_heads(out)
     if not cfg.no_projection:
         out = affine(out, params[f"{prefix}_Wo"], params[f"{prefix}_bo"])
@@ -375,9 +404,14 @@ def _embed_words(cfg: TransformerConfig, params: Params, ids: jax.Array,
     else:
         table = params[own]
     ft = cfg.src_factors[enc_idx] if side == "src" else cfg.trg_factors
+    from ..ops.quantization import QTensor, int8_gather
     if ft is not None:
         from ..layers.logits import factored_embed
+        if isinstance(table, QTensor):
+            table = table.dequantize(cfg.compute_dtype)
         x = factored_embed(table, ft, ids, cfg.compute_dtype)
+    elif isinstance(table, QTensor):
+        x = int8_gather(table, ids, cfg.compute_dtype)
     else:
         x = table[ids].astype(cfg.compute_dtype)
     return x * jnp.asarray(math.sqrt(cfg.dim_emb), cfg.compute_dtype)
@@ -561,13 +595,36 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
     values are word log-probs — downstream softmax/log-softmax renormalizes
     over the word axis, which only shifts scores by a constant per
     position)."""
+    from ..ops.quantization import QTensor, int8_logits
     if cfg.tied_embeddings_all:
-        w = params["Wemb"].T
+        table = params["Wemb"]
     elif cfg.tied_embeddings:
-        w = (params["Wemb"] if "Wemb" in params else params["decoder_Wemb"]).T
+        table = params["Wemb"] if "Wemb" in params else params["decoder_Wemb"]
+    else:
+        table = None
+    b = params["decoder_ff_logit_out_b"]
+    if table is not None and isinstance(table, QTensor):
+        # tied quantized table [V, d], per-row scales → int8 x @ table.T
+        if cfg.trg_factors is not None:
+            from ..layers.logits import factored_log_probs
+            units = int8_logits(x, table, None) + b.astype(jnp.float32)
+            return factored_log_probs(units, cfg.trg_factors, shortlist)
+        y = int8_logits(x, table, shortlist)
+        bb = b if shortlist is None else b[:, shortlist]
+        return y + bb.astype(jnp.float32)
+    if table is not None:
+        w = table.T
     else:
         w = params["decoder_ff_logit_out_W"]
-    b = params["decoder_ff_logit_out_b"]
+        if isinstance(w, QTensor):
+            if cfg.trg_factors is None:
+                from ..ops.quantization import QTensor as _QT, int8_affine
+                q = w                      # [d, V], per-column (vocab) scales
+                if shortlist is not None:
+                    q = _QT(q.values[:, shortlist], q.scale[shortlist], 1)
+                    b = b[:, shortlist]
+                return int8_affine(x.astype(jnp.float32), q, b)
+            w = w.dequantize(jnp.float32)
     if cfg.trg_factors is not None:
         from ..layers.logits import factored_log_probs
         units = jnp.dot(x, w.astype(x.dtype),
@@ -679,6 +736,11 @@ def _strip_dropout(ops: str) -> str:
 
 
 def cast_params(params: Params, dtype) -> Params:
-    """Cast float params to the compute dtype (kept f32 in the optimizer)."""
-    return {k: (v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.floating) else v)
+    """Cast float params to the compute dtype (kept f32 in the optimizer).
+    Quantized (QTensor) leaves pass through — their int8 payload + f32
+    scales are dtype-handled at the op sites."""
+    from ..ops.quantization import QTensor
+    return {k: (v.astype(dtype)
+                if not isinstance(v, QTensor)
+                and jnp.issubdtype(v.dtype, jnp.floating) else v)
             for k, v in params.items()}

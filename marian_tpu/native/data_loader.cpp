@@ -1,0 +1,341 @@
+// Native data loader — the TPU-era equivalent of the reference's C++ data
+// layer (src/data/corpus.cpp, corpus_base.cpp, batch_generator.h). The
+// tokenize → shuffle → maxi-batch-sort → token-budget-split → pad pipeline
+// is the host-side hot loop that feeds the device; doing it in C++ keeps the
+// input pipeline off the Python GIL while XLA runs the previous step.
+//
+// Semantics mirror marian_tpu/data/batch_generator.py EXACTLY (tests assert
+// batch-for-batch equality): same bucket table, same sort keys, same
+// token-budget rule, same shuffle RNG consumption points (a Mersenne-like
+// LCG here — seeded identically across epochs, NOT bit-compatible with
+// numpy; equality tests run with shuffle off).
+//
+// C ABI (ctypes, no pybind11 in this image):
+//   mtd_create(n_streams)                        -> handle
+//   mtd_set_vocab(h, stream, buf, len)           vocab as "word\tid\n" utf-8
+//   mtd_load_corpus(h, paths[], max_len, crop)   tokenize whole corpus in RAM
+//   mtd_start_epoch(h, shuffle, seed)            (re)start iteration
+//   mtd_next_batch(h, cfg, out)                  -> 1 batch / 0 epoch end
+//   mtd_position(h) / mtd_seek(h, pos)           resumable iterator state
+//   mtd_destroy(h)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <numeric>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kEos = 0;
+constexpr int32_t kUnk = 1;
+constexpr int kMaxStreams = 8;
+
+// Default bucket table — keep in sync with batch_generator.py
+const int kBuckets[] = {8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512,
+                        768, 1024, 1536, 2048, 3072, 4096};
+
+int bucket_length(int n) {
+  for (int b : kBuckets)
+    if (n <= b) return b;
+  return (n + 511) / 512 * 512;
+}
+
+int bucket_batch_size(int n, int multiple) {
+  int m = multiple > 0 ? multiple : 8;
+  return std::max(m, (n + m - 1) / m * m);
+}
+
+// splitmix64 — deterministic, seedable, fast (shuffle quality only)
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed + 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  uint64_t below(uint64_t n) { return n ? next() % n : 0; }
+};
+
+struct Sentence {
+  int64_t idx;                                  // corpus line number
+  std::vector<std::vector<int32_t>> streams;    // ids per stream, EOS-capped
+};
+
+struct BatchConfig {
+  int mini_batch;
+  int mini_batch_words;
+  int maxi_batch;
+  int sort_key;          // 0 = none, 1 = src, 2 = trg
+  int batch_multiple;
+  int shuffle_batches;   // shuffle minibatch order within a maxi-batch
+};
+
+// One stream's padded block, owned by the handle, valid until next call.
+struct OutBlock {
+  std::vector<int32_t> ids;
+  std::vector<float> mask;
+};
+
+struct Handle {
+  int n_streams = 0;
+  std::vector<std::unordered_map<std::string, int32_t>> vocabs;
+  std::vector<Sentence> corpus;                 // tokenized, in RAM
+  std::vector<uint32_t> order;                  // epoch permutation
+  size_t pos = 0;                               // cursor into `order`
+  size_t window_start = 0;                      // pos at current maxi window
+  Rng rng{1};
+  // ready minibatches (built one maxi-batch at a time)
+  std::vector<std::vector<uint32_t>> pending;   // each = sentence indices
+  size_t pending_pos = 0;
+  // output storage
+  OutBlock out[kMaxStreams];
+  std::vector<int64_t> out_sent_ids;
+  std::string error;
+};
+
+void tokenize_line(const std::string& line,
+                   const std::unordered_map<std::string, int32_t>& vocab,
+                   std::vector<int32_t>* out) {
+  std::istringstream ss(line);
+  std::string w;
+  while (ss >> w) {
+    auto it = vocab.find(w);
+    out->push_back(it == vocab.end() ? kUnk : it->second);
+  }
+  out->push_back(kEos);
+}
+
+// Build pending minibatches from the next maxi-batch window.
+void fill_pending(Handle* h, const BatchConfig& cfg) {
+  h->pending.clear();
+  h->pending_pos = 0;
+  h->window_start = h->pos;
+  size_t cap = static_cast<size_t>(std::max(1, cfg.maxi_batch)) *
+               std::max(1, cfg.mini_batch);
+  size_t end = std::min(h->pos + cap, h->order.size());
+  if (h->pos >= end) return;
+  std::vector<uint32_t> window(h->order.begin() + h->pos,
+                               h->order.begin() + end);
+  h->pos = end;
+
+  if (cfg.sort_key != 0) {
+    int primary = cfg.sort_key == 1 ? 0 : h->n_streams - 1;
+    int secondary = cfg.sort_key == 1 ? h->n_streams - 1 : 0;
+    std::stable_sort(window.begin(), window.end(),
+                     [&](uint32_t a, uint32_t b) {
+      const auto& sa = h->corpus[a].streams;
+      const auto& sb = h->corpus[b].streams;
+      if (sa[primary].size() != sb[primary].size())
+        return sa[primary].size() < sb[primary].size();
+      return sa[secondary].size() < sb[secondary].size();
+    });
+  }
+
+  std::vector<uint32_t> cur;
+  int cur_max_trg = 0;
+  auto flush = [&]() {
+    if (!cur.empty()) h->pending.push_back(cur);
+  };
+  for (uint32_t si : window) {
+    const auto& s = h->corpus[si];
+    int trg_len = static_cast<int>(s.streams[h->n_streams - 1].size());
+    int new_max = std::max(cur_max_trg, trg_len);
+    size_t n = cur.size() + 1;
+    bool over;
+    if (cfg.mini_batch_words > 0) {
+      over = n * bucket_length(new_max) >
+                 static_cast<size_t>(cfg.mini_batch_words) && !cur.empty();
+    } else {
+      over = n > static_cast<size_t>(std::max(1, cfg.mini_batch));
+    }
+    if (over) {
+      flush();
+      cur.clear();
+      new_max = trg_len;
+    }
+    cur.push_back(si);
+    cur_max_trg = new_max;
+  }
+  flush();
+
+  if (cfg.shuffle_batches) {
+    for (size_t i = h->pending.size(); i > 1; --i)
+      std::swap(h->pending[i - 1], h->pending[h->rng.below(i)]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Layout of one emitted batch; pointers owned by the handle, valid until the
+// next mtd_next_batch / mtd_destroy.
+struct MtdBatch {
+  int n_streams;
+  int batch_size;                 // padded sentence count
+  int real_size;                  // unpadded sentence count
+  int widths[kMaxStreams];        // padded time dims
+  const int32_t* ids[kMaxStreams];
+  const float* mask[kMaxStreams];
+  const int64_t* sent_ids;        // [batch_size], -1 on padding rows
+};
+
+void* mtd_create(int n_streams) {
+  if (n_streams < 1 || n_streams > kMaxStreams) return nullptr;
+  auto* h = new Handle();
+  h->n_streams = n_streams;
+  h->vocabs.resize(n_streams);
+  return h;
+}
+
+void mtd_destroy(void* vh) { delete static_cast<Handle*>(vh); }
+
+const char* mtd_error(void* vh) {
+  return static_cast<Handle*>(vh)->error.c_str();
+}
+
+// buf: utf-8 "word\tid\n" lines (id ascii decimal)
+int mtd_set_vocab(void* vh, int stream, const char* buf, int64_t len) {
+  auto* h = static_cast<Handle*>(vh);
+  if (stream < 0 || stream >= h->n_streams) return -1;
+  auto& v = h->vocabs[stream];
+  v.clear();
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* tab = static_cast<const char*>(memchr(p, '\t', end - p));
+    if (!tab) break;
+    const char* nl = static_cast<const char*>(memchr(tab, '\n', end - tab));
+    if (!nl) nl = end;
+    v.emplace(std::string(p, tab - p),
+              static_cast<int32_t>(strtol(tab + 1, nullptr, 10)));
+    p = nl + 1;
+  }
+  return static_cast<int>(v.size());
+}
+
+// paths: n_streams parallel text files. Tokenizes everything into RAM.
+// max_length: crop (crop=1) or skip (crop=0) sentences longer than this
+// (counting the appended EOS like the Python Corpus does).
+int64_t mtd_load_corpus(void* vh, const char** paths, int max_length,
+                        int crop) {
+  auto* h = static_cast<Handle*>(vh);
+  std::vector<std::ifstream> fhs(h->n_streams);
+  for (int s = 0; s < h->n_streams; ++s) {
+    fhs[s].open(paths[s]);
+    if (!fhs[s]) {
+      h->error = std::string("cannot open ") + paths[s];
+      return -1;
+    }
+  }
+  h->corpus.clear();
+  std::string line;
+  int64_t idx = 0;
+  for (;; ++idx) {
+    Sentence sent;
+    sent.idx = idx;
+    sent.streams.resize(h->n_streams);
+    bool eof = false;
+    for (int s = 0; s < h->n_streams; ++s) {
+      if (!std::getline(fhs[s], line)) {
+        eof = true;
+        break;
+      }
+      tokenize_line(line, h->vocabs[s], &sent.streams[s]);
+    }
+    if (eof) break;
+    bool ok = true;
+    for (auto& st : sent.streams) {
+      if (max_length > 0 && static_cast<int>(st.size()) > max_length) {
+        if (crop) {
+          st.resize(max_length);
+          st.back() = kEos;
+        } else {
+          ok = false;
+        }
+      }
+      if (st.size() <= 1) ok = false;  // empty line (EOS only)
+    }
+    if (ok) h->corpus.push_back(std::move(sent));
+  }
+  return static_cast<int64_t>(h->corpus.size());
+}
+
+void mtd_start_epoch(void* vh, int shuffle, uint64_t seed) {
+  auto* h = static_cast<Handle*>(vh);
+  h->order.resize(h->corpus.size());
+  std::iota(h->order.begin(), h->order.end(), 0u);
+  h->rng = Rng(seed);
+  if (shuffle) {
+    for (size_t i = h->order.size(); i > 1; --i)
+      std::swap(h->order[i - 1], h->order[h->rng.below(i)]);
+  }
+  h->pos = 0;
+  h->pending.clear();
+  h->pending_pos = 0;
+}
+
+uint64_t mtd_position(void* vh) {
+  auto* h = static_cast<Handle*>(vh);
+  // Maxi-window granularity, matching the Python BatchGenerator's
+  // corpus-state snapshots: resume replays the current window from its
+  // start (reference: corpus position restore is also window-coarse).
+  if (h->pending_pos < h->pending.size()) return h->window_start;
+  return static_cast<uint64_t>(h->pos);
+}
+
+void mtd_seek(void* vh, uint64_t position) {
+  auto* h = static_cast<Handle*>(vh);
+  h->pos = std::min(static_cast<size_t>(position), h->order.size());
+  h->window_start = h->pos;
+  h->pending.clear();
+  h->pending_pos = 0;
+}
+
+int mtd_next_batch(void* vh, const BatchConfig* cfg, MtdBatch* out) {
+  auto* h = static_cast<Handle*>(vh);
+  if (h->pending_pos >= h->pending.size()) {
+    fill_pending(h, *cfg);
+    if (h->pending.empty()) return 0;  // epoch done
+  }
+  const auto& sel = h->pending[h->pending_pos++];
+  int n = static_cast<int>(sel.size());
+  int bsz = bucket_batch_size(n, cfg->batch_multiple);
+
+  out->n_streams = h->n_streams;
+  out->batch_size = bsz;
+  out->real_size = n;
+  for (int s = 0; s < h->n_streams; ++s) {
+    int maxlen = 0;
+    for (uint32_t si : sel)
+      maxlen = std::max(maxlen,
+                        static_cast<int>(h->corpus[si].streams[s].size()));
+    int width = bucket_length(maxlen);
+    auto& blk = h->out[s];
+    blk.ids.assign(static_cast<size_t>(bsz) * width, 0);
+    blk.mask.assign(static_cast<size_t>(bsz) * width, 0.0f);
+    for (int b = 0; b < n; ++b) {
+      const auto& seq = h->corpus[sel[b]].streams[s];
+      std::copy(seq.begin(), seq.end(), blk.ids.begin() + b * width);
+      std::fill(blk.mask.begin() + b * width,
+                blk.mask.begin() + b * width + seq.size(), 1.0f);
+    }
+    out->widths[s] = width;
+    out->ids[s] = blk.ids.data();
+    out->mask[s] = blk.mask.data();
+  }
+  h->out_sent_ids.assign(bsz, -1);
+  for (int b = 0; b < n; ++b) h->out_sent_ids[b] = h->corpus[sel[b]].idx;
+  out->sent_ids = h->out_sent_ids.data();
+  return 1;
+}
+
+}  // extern "C"

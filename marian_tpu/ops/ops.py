@@ -84,9 +84,13 @@ def activation(name: str):
         raise ValueError(f"Unknown activation '{name}'") from None
 
 
-def affine(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
+def affine(x: jax.Array, w, b: Optional[jax.Array] = None) -> jax.Array:
     """x @ w + b (reference: gpu::Affine / cublasLt fused bias). XLA fuses the
-    bias add; weights stored [in, out] like Marian."""
+    bias add; weights stored [in, out] like Marian. Quantized (QTensor)
+    weights from marian-conv run as int8×int8 MXU matmuls."""
+    from .quantization import QTensor, int8_affine
+    if isinstance(w, QTensor):
+        return int8_affine(x, w, b)
     y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=x.dtype)
     if b is not None:
         y = y + b.astype(x.dtype)

@@ -1,0 +1,170 @@
+"""Int8 quantized inference — the TPU-native answer to the reference's CPU
+intgemm/FBGEMM path (src/tensors/cpu/integer_common.h, cpu/fbgemm/;
+SURVEY.md §2.4 "intgemm/FBGEMM int8 path → native TPU int8 matmuls").
+
+Weights are quantized OFFLINE by marian-conv (symmetric per-channel int8:
+q = round(w / s), s = amax|w| / 127); activations are quantized ON THE FLY
+per token row (dynamic symmetric), and the matmul runs as an int8×int8 →
+int32 ``lax.dot_general`` on the MXU, rescaled by (act_scale ⊗ weight_scale).
+This is the AQT recipe (PAPERS.md) — int8 halves HBM weight traffic, which
+is what bounds autoregressive decode.
+
+A quantized parameter is a QTensor pytree leaf-pair (int8 values + f32
+per-channel scales), so jitted model functions take quantized and float
+checkpoints through the same code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """Symmetric per-channel int8 tensor: dequant = values * scale along
+    `axis` (0 = per-row scales, e.g. vocab-indexed embeddings; 1 = per-column
+    scales, e.g. [in, out] matmul weights)."""
+    values: jax.Array          # int8
+    scale: jax.Array           # f32, shape [values.shape[axis]]
+    axis: int = 1
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    def tree_flatten(self):
+        return (self.values, self.scale), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, axis, children):
+        return cls(children[0], children[1], axis)
+
+    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+        s = self.scale.astype(dtype)
+        if self.axis == 0:
+            return self.values.astype(dtype) * s[:, None]
+        return self.values.astype(dtype) * s[None, :]
+
+
+def quantize(w, axis: int = 1) -> QTensor:
+    """Symmetric per-channel int8 quantization (reference: intgemm's
+    PrepareA/PrepareB quantization; marian-conv --gemm-type intgemm8)."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=1 - axis)
+    scale = np.maximum(amax, 1e-8) / 127.0
+    s = scale[:, None] if axis == 0 else scale[None, :]
+    q = np.clip(np.rint(w / s), -127, 127).astype(np.int8)
+    return QTensor(jnp.asarray(q), jnp.asarray(scale, jnp.float32), axis)
+
+
+def _quantize_acts(x: jax.Array):
+    """Dynamic per-row symmetric int8 activation quantization (the runtime
+    half of the AQT recipe; reference: intgemm PrepareA at each GEMM)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127
+                  ).astype(jnp.int8)
+    return xq, s
+
+
+def int8_affine(x: jax.Array, q: QTensor,
+                b: Optional[jax.Array] = None) -> jax.Array:
+    """x @ dequant(q) + b computed as int8×int8→int32 on the MXU.
+    q is an [in, out] weight with per-out-channel scales (axis=1)."""
+    assert q.axis == 1, "int8_affine expects per-output-channel scales"
+    xq, xs = _quantize_acts(x)
+    y = jax.lax.dot_general(xq, q.values, (((xq.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    y = y.astype(jnp.float32) * xs * q.scale[None, :]
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def int8_logits(x: jax.Array, q: QTensor,
+                shortlist: Optional[jax.Array] = None) -> jax.Array:
+    """x @ dequant(q).T for a vocab-major table ([V, d], per-row scales) —
+    the tied-embedding output projection with optional shortlist row slice
+    (reference: mlp::Output with intgemm8 + Shortlist::indices)."""
+    assert q.axis == 0, "int8_logits expects per-row (vocab) scales"
+    vals, scale = q.values, q.scale
+    if shortlist is not None:
+        vals = vals[shortlist]
+        scale = scale[shortlist]
+    xq, xs = _quantize_acts(x)
+    y = jax.lax.dot_general(xq, vals, (((xq.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * xs * scale[None, :]
+
+
+def int8_gather(q: QTensor, ids: jax.Array, dtype) -> jax.Array:
+    """Embedding lookup from a per-row-quantized [V, d] table."""
+    assert q.axis == 0
+    return (q.values[ids].astype(dtype)
+            * q.scale[ids][..., None].astype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint plumbing (marian-conv output format)
+# ---------------------------------------------------------------------------
+
+QSCALE_SUFFIX = ":qscale"
+
+# Param-name suffixes excluded from quantization: biases, layer norms,
+# positional tables (tiny and precision-critical).
+_SKIP_SUFFIXES = ("_ln_scale", "_ln_bias")
+
+
+def quantizable(name: str, arr) -> bool:
+    if getattr(arr, "ndim", 0) != 2 or arr.shape[0] < 2:
+        return False
+    if name.endswith(_SKIP_SUFFIXES) or name == "Wpos":
+        return False
+    if not np.issubdtype(np.asarray(arr).dtype, np.floating):
+        return False
+    # biases are [1, d]
+    return arr.shape[0] > 1
+
+
+def quantize_params(params: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Quantize a float checkpoint's matmul weights for saving — embeddings
+    ([V, d], also the tied output layer) per row, [in, out] weights per
+    column (reference: marian-conv's intgemm8 model preparation)."""
+    out: Dict[str, np.ndarray] = {}
+    for name, arr in params.items():
+        if not quantizable(name, arr):
+            out[name] = np.asarray(arr)
+            continue
+        axis = 0 if name.endswith("Wemb") else 1
+        q = quantize(arr, axis=axis)
+        out[name] = np.asarray(q.values)
+        out[name + QSCALE_SUFFIX] = np.asarray(q.scale)
+    return out
+
+
+def wrap_quantized(params: Dict[str, jax.Array]) -> Dict:
+    """Pair `X` (int8) + `X:qscale` items loaded from a converted checkpoint
+    back into QTensor leaves; float params pass through unchanged."""
+    out: Dict = {}
+    for name, arr in params.items():
+        if name.endswith(QSCALE_SUFFIX):
+            continue
+        skey = name + QSCALE_SUFFIX
+        if skey in params:
+            # axis mirrors quantize_params: embeddings per-row, else per-col
+            axis = 0 if name.endswith("Wemb") else 1
+            out[name] = QTensor(jnp.asarray(arr, jnp.int8),
+                                jnp.asarray(params[skey], jnp.float32), axis)
+        else:
+            out[name] = arr
+    return out
+
+
+def is_quantized(params: Dict) -> bool:
+    return any(k.endswith(QSCALE_SUFFIX) for k in params)

@@ -76,9 +76,19 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def data_sharded(mesh: Mesh) -> NamedSharding:
-    """Batch-dim sharding over 'data' (and 'seq' kept on time via SP later)."""
-    return NamedSharding(mesh, P("data"))
+def batch_leaf_spec(name: str, ndim: int, micro: bool = False) -> P:
+    """Per-leaf batch sharding by NAME: token id/mask streams [B, T] shard
+    (data, seq); other leaves — 'guided' alignment [B, Tt, Ts] and
+    'data_weights' [B, Tt] or [B, 1] — shard only the batch dim (their
+    trailing dims are not bucket-padded, so 'seq' divisibility isn't
+    guaranteed). `micro` marks a leading --optimizer-delay micro-batch axis,
+    which stays unsharded."""
+    if micro:
+        inner = batch_leaf_spec(name, ndim - 1)
+        return P(*((None,) + tuple(inner)))
+    if (name.endswith("_ids") or name.endswith("_mask")) and ndim == 2:
+        return P("data", "seq")
+    return P("data") if ndim >= 1 else P()
 
 
 def zero1_leaf_spec(shape: Tuple[int, ...], mesh: Mesh) -> P:
@@ -101,19 +111,15 @@ def zero1_leaf_spec(shape: Tuple[int, ...], mesh: Mesh) -> P:
     return P()
 
 
-def zero1_tree_shardings(tree, mesh: Mesh):
-    return jax.tree_util.tree_map(
-        lambda x: NamedSharding(mesh, zero1_leaf_spec(getattr(x, "shape", ()), mesh)),
-        tree)
-
-
-def batch_shardings(batch, mesh: Mesh):
-    return {k: data_sharded(mesh) for k in batch}
-
-
 def replicate_tree(tree, mesh: Mesh):
     return jax.device_put(tree, replicated(mesh))
 
 
-def shard_batch(batch, mesh: Mesh):
-    return {k: jax.device_put(v, data_sharded(mesh)) for k, v in batch.items()}
+def shard_batch(batch, mesh: Mesh, micro: bool = False):
+    """Place batch leaves on the mesh with name-aware specs. `micro=True`
+    for stacked [delay, B, T] micro-batches (build_train_step delay>1)."""
+    return {k: jax.device_put(
+                v, NamedSharding(mesh,
+                                 batch_leaf_spec(k, getattr(v, "ndim", 2),
+                                                 micro)))
+            for k, v in batch.items()}

@@ -1,0 +1,137 @@
+"""Tensor-parallel parameter shardings over the 'model' mesh axis.
+
+The reference has NO tensor parallelism (SURVEY.md §2.7: TP absent; mesh API
+designed so a 'model' axis can be added without refactor) — this module is
+the TPU-native extension that adds it. Instead of rewriting the model with
+explicit collectives, we express Megatron-style TP purely as GSPMD
+PartitionSpecs on the flat Marian-named param dict; XLA's SPMD partitioner
+inserts the all-reduces (papers: Megatron-LM arXiv:1909.08053; GSPMD
+arXiv:2105.04663 — see PAPERS.md):
+
+- attention Wq/Wk/Wv column-split  → heads computed shard-local;
+- attention Wo row-split           → one psum per attention block;
+- FFN W1 column-split, W2 row-split→ one psum per FFN block;
+- embeddings vocab-split           → logits sharded over vocab, psum'd gather;
+- layer-norm scales/biases replicated (tiny).
+
+ZeRO-1 composes on top: optimizer-state leaves additionally shard their
+first still-unsharded divisible axis over 'data' (reference sharded Adam,
+communicator_nccl.h scatterReduce/allGather — see parallel/zero.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, jax.Array]
+
+_FFN_W = re.compile(r"_(?:ffn|logit)_W(\d+)$")
+_FFN_B = re.compile(r"_(?:ffn|logit)_b(\d+)$")
+
+
+def tp_param_spec(name: str, shape: Tuple[int, ...], dim_emb: int) -> P:
+    """Megatron TP spec for one Marian-named parameter (shape [in, out])."""
+    if name.endswith(("_Wq", "_Wk", "_Wv", "_bq", "_bk", "_bv")):
+        return P(None, "model")                      # column/head split
+    if name.endswith("_Wo"):
+        return P("model", None)                      # row split (psum output)
+    if name.endswith("_bo"):
+        return P()
+    if name.endswith(("_ln_scale", "_ln_bias")):
+        return P()
+    m = _FFN_W.search(name)
+    if m:
+        # inner FFN weights map d→ffn (column-split); the final one maps
+        # ffn→d (row-split). Disambiguate by which side is the model dim.
+        if len(shape) == 2 and shape[1] != dim_emb:
+            return P(None, "model")
+        if len(shape) == 2 and shape[0] != dim_emb:
+            return P("model", None)
+        # square d×d FFN (rare): W1 column-split, others row-split
+        return P(None, "model") if m.group(1) == "1" else P("model", None)
+    m = _FFN_B.search(name)
+    if m:
+        return P(None, "model") if len(shape) == 2 and shape[1] != dim_emb else P()
+    if name.endswith("Wemb"):
+        return P("model", None)                      # vocab-split rows
+    if name == "Wpos":
+        return P()
+    if name.endswith("ff_logit_out_W"):
+        return P(None, "model")                      # vocab-split columns
+    if name.endswith("ff_logit_out_b"):
+        return P(None, "model")
+    return P()
+
+
+def _divisible(shape: Tuple[int, ...], spec: P, mesh: Mesh) -> bool:
+    n = mesh.shape.get("model", 1)
+    for axis, part in enumerate(spec):
+        if part == "model" and (axis >= len(shape) or shape[axis] % n != 0):
+            return False
+    return True
+
+
+def tp_param_specs(params: Params, mesh: Mesh,
+                   dim_emb: int = 0) -> Dict[str, P]:
+    """TP PartitionSpec per param. Falls back to replicated when the 'model'
+    axis is 1, the param family is unknown (e.g. RNN s2s params), or the
+    shape doesn't divide (safety: GSPMD requires divisibility)."""
+    if mesh.shape.get("model", 1) <= 1:
+        return {k: P() for k in params}
+    if not dim_emb:
+        for k, v in params.items():
+            if k.endswith("_Wq"):
+                dim_emb = v.shape[0]
+                break
+    out: Dict[str, P] = {}
+    for k, v in params.items():
+        spec = tp_param_spec(k, tuple(v.shape), dim_emb)
+        out[k] = spec if _divisible(tuple(v.shape), spec, mesh) else P()
+    return out
+
+
+def param_shardings(params: Params, mesh: Mesh,
+                    specs: Dict[str, P] = None) -> Dict[str, NamedSharding]:
+    if specs is None:
+        specs = tp_param_specs(params, mesh)
+    return {k: NamedSharding(mesh, specs[k]) for k in params}
+
+
+def zero1_combined_spec(param_spec: P, shape: Tuple[int, ...],
+                        mesh: Mesh) -> P:
+    """Compose ZeRO-1 ('data'-axis) sharding with a TP spec: shard the first
+    axis that is not already model-split and divides the data-axis size."""
+    n = mesh.shape["data"]
+    parts = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    if n > 1:
+        for axis, dim in enumerate(shape):
+            if parts[axis] is None and dim % n == 0 and dim >= n:
+                parts[axis] = "data"
+                break
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def opt_state_shardings(opt_state, param_specs: Dict[str, P],
+                        mesh: Mesh):
+    """Shardings for the optimizer-state tree ({'t', 'm', 'v'/'gt', 'avg'}
+    with per-param leaf dicts): each leaf gets TP spec + ZeRO-1 'data' axis."""
+    rep = NamedSharding(mesh, P())
+
+    def leaf(name: str, arr) -> NamedSharding:
+        spec = zero1_combined_spec(param_specs.get(name, P()),
+                                   tuple(arr.shape), mesh)
+        return NamedSharding(mesh, spec)
+
+    out = {}
+    for key, group in opt_state.items():
+        if isinstance(group, dict):
+            out[key] = {k: leaf(k, v) for k, v in group.items()}
+        else:
+            out[key] = rep
+    return out

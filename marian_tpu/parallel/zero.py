@@ -31,19 +31,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..optimizers.optimizers import OptimizerConfig, apply_update
 from ..ops.ops import clip_by_global_norm, global_norm
 from . import mesh as M
+from . import tensor as T
 
 Params = Dict[str, jax.Array]
 
 
 def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                      mesh: Mesh, params: Params, opt_state,
-                     delay: int = 1, donate: bool = True):
+                     delay: int = 1, donate: bool = True, shardings=None):
     """Returns a jitted fn(params, opt_state, batch, step) →
     (params, opt_state, metrics) with SyncGraphGroup semantics.
 
     `batch` leaves carry a leading micro-batch axis of size `delay` when
     delay > 1 (accumulation by lax.scan inside the step — no host round-trip
     per micro-batch, unlike the reference's per-delay-loop host logic).
+    Inputs must arrive committed: params/opt_state via place(), batches via
+    mesh.shard_batch (per-leaf name-aware specs; pass micro=True there when
+    delay > 1 so the leading micro axis stays unsharded). Only the outputs
+    are pinned here so donation layouts match. `shardings` optionally passes
+    precomputed (param_shardings, opt_state_shardings) to avoid recomputing.
     """
 
     def loss_fn(p, b, rng):
@@ -91,24 +97,31 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
         return new_p, new_opt, metrics
 
     rep = M.replicated(mesh)
-    p_shardings = jax.tree_util.tree_map(lambda _: rep, params)
-    o_shardings = M.zero1_tree_shardings(opt_state, mesh)
-    b_sharding = NamedSharding(mesh, P(None, "data") if delay > 1 else P("data"))
+    # TP (Megatron-style over 'model') via GSPMD param specs; replicated when
+    # the model axis is 1. ZeRO-1 'data' sharding composes on the opt state.
+    if shardings is None:
+        dim_emb = int(getattr(getattr(model, "cfg", None), "dim_emb", 0) or 0)
+        p_specs = T.tp_param_specs(params, mesh, dim_emb=dim_emb)
+        p_shardings = T.param_shardings(params, mesh, p_specs)
+        o_shardings = T.opt_state_shardings(opt_state, p_specs, mesh)
+    else:
+        p_shardings, o_shardings = shardings
     metrics_shardings = {"ce_sum": rep, "labels": rep, "gnorm": rep, "lr": rep}
 
     return jax.jit(
         step_fn,
-        in_shardings=(p_shardings, o_shardings, b_sharding, rep, rep),
         out_shardings=(p_shardings, o_shardings, metrics_shardings),
         donate_argnums=(0, 1) if donate else ())
 
 
-def place(params, opt_state, mesh: Mesh):
-    """Put params replicated and optimizer state ZeRO-1-sharded on the mesh
-    (reference: SyncGraphGroup::initialize laying out per-device shards)."""
-    params = jax.device_put(
-        params, jax.tree_util.tree_map(lambda _: M.replicated(mesh), params))
-    opt_state = jax.device_put(opt_state, M.zero1_tree_shardings(opt_state, mesh))
+def place(params, opt_state, mesh: Mesh, dim_emb: int = 0):
+    """Put params TP-sharded-over-'model' (replicated when model axis is 1)
+    and optimizer state ZeRO-1-sharded on the mesh (reference:
+    SyncGraphGroup::initialize laying out per-device shards)."""
+    p_specs = T.tp_param_specs(params, mesh, dim_emb=dim_emb)
+    params = jax.device_put(params, T.param_shardings(params, mesh, p_specs))
+    opt_state = jax.device_put(
+        opt_state, T.opt_state_shardings(opt_state, p_specs, mesh))
     return params, opt_state
 
 
@@ -128,7 +141,9 @@ def dryrun(n_devices: int, options, batch_maker, vocab: int = 256) -> None:
     params = model.init(jax.random.key(0))
     opt_cfg = OptimizerConfig.from_options(options)
     opt_state = init_state(opt_cfg, params)
-    params, opt_state = place(params, opt_state, mesh)
+    params, opt_state = place(
+        params, opt_state, mesh,
+        dim_emb=int(getattr(model.cfg, "dim_emb", 0) or 0))
     schedule = LRSchedule.from_options(options)
     step = build_train_step(model, opt_cfg, schedule,
                             options.get("cost-type", "ce-sum"), mesh,

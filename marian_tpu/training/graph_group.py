@@ -80,25 +80,31 @@ class GraphGroup:
             else self.model.init(key)
         if self.opt_state is None:  # keep state restored from checkpoint
             self.opt_state = init_state(self.opt_cfg, self.params)
-        self.params, self.opt_state = place(self.params, self.opt_state,
-                                            self.mesh)
+        self.params, self.opt_state = place(
+            self.params, self.opt_state, self.mesh,
+            dim_emb=int(getattr(self.model.cfg, "dim_emb", 0) or 0))
         self._build()
 
     def _build(self) -> None:
+        from ..parallel import tensor as T
         mesh = self.mesh
         rep = M.replicated(mesh)
-        p_sh = jax.tree_util.tree_map(lambda _: rep, self.params)
-        o_sh = M.zero1_tree_shardings(self.opt_state, mesh)
-        b_sh = NamedSharding(mesh, P("data"))
+        dim_emb = int(getattr(self.model.cfg, "dim_emb", 0) or 0)
+        p_specs = T.tp_param_specs(self.params, mesh, dim_emb=dim_emb)
+        p_sh = T.param_shardings(self.params, mesh, p_specs)
+        o_sh = T.opt_state_shardings(self.opt_state, p_specs, mesh)
         model, opt_cfg, schedule = self.model, self.opt_cfg, self.schedule
 
         # fused single-batch step (the hot path; delay==1)
         self._fused = build_train_step(model, opt_cfg, schedule,
                                        self.cost_type, mesh, self.params,
                                        self.opt_state, delay=1,
-                                       donate=self._donate)
+                                       donate=self._donate,
+                                       shardings=(p_sh, o_sh))
 
-        # split path for --optimizer-delay with heterogeneous batch shapes
+        # split path for --optimizer-delay with heterogeneous batch shapes.
+        # Batches arrive committed via M.shard_batch (per-leaf name-aware
+        # specs), so no in_shardings here; grads keep the param layout.
         def grad_step(p, batch, rng):
             def loss_fn(pp, b, r):
                 return model.loss(pp, b, r, train=True)
@@ -106,7 +112,7 @@ class GraphGroup:
                 p, batch, rng)
             return grads, aux
 
-        self._grad_fn = jax.jit(grad_step, in_shardings=(p_sh, b_sh, rep))
+        self._grad_fn = jax.jit(grad_step, out_shardings=(p_sh, None))
 
         def update_step(p, opt_state, grads, step, labels, n_sents):
             if self.cost_type in ("ce-mean-words", "perplexity"):
@@ -126,7 +132,6 @@ class GraphGroup:
 
         self._update_fn = jax.jit(
             update_step,
-            in_shardings=(p_sh, o_sh, p_sh, rep, rep, rep),
             out_shardings=(p_sh, o_sh, rep, rep),
             donate_argnums=(0, 1, 2) if self._donate else ())
 

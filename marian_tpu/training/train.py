@@ -48,6 +48,7 @@ class Train:
         log.info("Vocabulary sizes: {}", " ".join(str(len(v)) for v in vocabs))
 
         corpus = Corpus(train_sets, vocabs, opts)
+        native_bg = _native_batch_generator(opts, train_sets, vocabs)
 
         # -- model + graph group -------------------------------------------
         src_side = vocabs[:-1] if len(vocabs) > 2 else vocabs[0]
@@ -65,6 +66,10 @@ class Train:
                 state = loaded_state
                 if not opts.get("no-restore-corpus", False) and state.corpus:
                     corpus.restore(state.corpus)
+                    if native_bg is not None:
+                        native_bg.seek(int(state.corpus.get("epoch", 1) or 1),
+                                       int(state.corpus.get("position", 0)),
+                                       seed=state.corpus.get("seed"))
                     log.info("Restored corpus position: epoch {}, sent {}",
                              state.corpus.get("epoch"), state.corpus.get("position"))
         elif opts.get("pretrained-model", None):
@@ -85,7 +90,8 @@ class Train:
         delay = gg.delay
 
         def do_save(suffix: str = "") -> None:
-            state.corpus = corpus.state.as_dict()
+            state.corpus = (native_bg.state_dict() if native_bg is not None
+                            else corpus.state.as_dict())
             smooth = gg.smoothed() if gg.opt_cfg.smoothing > 0 else None
             save_checkpoint(model_path, gg.params, config_yaml, gg, state,
                             smooth_params=smooth, suffix=suffix)
@@ -111,7 +117,8 @@ class Train:
         log.info("Training started")
         stop = False
         while scheduler.keep_going() and not stop:
-            bg = BatchGenerator(corpus, opts)
+            bg = native_bg if native_bg is not None \
+                else BatchGenerator(corpus, opts)
             micro: List = []
             for batch in bg:
                 micro.append(batch)
@@ -141,6 +148,36 @@ class Train:
                 scheduler.new_epoch()
         log.info("Training finished")
         do_save()
+
+
+def _native_batch_generator(opts, train_sets, vocabs):
+    """Opt-in C++ data loader (--data-backend native; marian_tpu/native/).
+    Falls back to the Python BatchGenerator when the config needs features
+    the native path doesn't cover (subword/factored vocabs, guided
+    alignment, data weighting) or the library can't build."""
+    if str(opts.get("data-backend", "python") or "python") != "native":
+        return None
+    from ..data.vocab import DefaultVocab
+    ga = opts.get("guided-alignment", "none")
+    supported = (all(type(v) is DefaultVocab for v in vocabs)
+                 and (not ga or ga == "none")
+                 and not opts.get("data-weighting", None)
+                 # text augmentation hooks live only in the Python Corpus
+                 and not int(opts.get("all-caps-every", 0) or 0)
+                 and not int(opts.get("english-title-case-every", 0) or 0))
+    if not supported:
+        log.warn("--data-backend native does not support this data config "
+                 "(needs plain word vocabs, no alignment/weighting); "
+                 "falling back to the python pipeline")
+        return None
+    try:
+        from ..native import NativeBatchGenerator
+        bg = NativeBatchGenerator(train_sets, vocabs, opts)
+        log.info("Native data backend: {} sentences in RAM", bg.n_sentences)
+        return bg
+    except Exception as e:  # toolchain missing etc.
+        log.warn("Native data backend unavailable ({}); using python", e)
+        return None
 
 
 def train_main(options) -> None:

@@ -37,7 +37,10 @@ class Translate:
         embedded_cfg = None
         for mp in model_paths:
             params, cfg_yaml = mio.load_model(mp)
-            self.params_list.append({k: jnp.asarray(v) for k, v in params.items()})
+            # marian-conv int8 checkpoints: pair values+scales into QTensors
+            from ..ops.quantization import wrap_quantized
+            self.params_list.append(wrap_quantized(
+                {k: jnp.asarray(v) for k, v in params.items()}))
             if cfg_yaml and embedded_cfg is None:
                 embedded_cfg = cfg_yaml
         # model architecture comes from the checkpoint-embedded config unless

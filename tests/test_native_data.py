@@ -1,0 +1,145 @@
+"""Native (C++) data loader vs the Python BatchGenerator — the two pipelines
+must agree batch-for-batch with shuffle off (marian_tpu/native/data_loader.cpp
+mirrors data/batch_generator.py; reference: src/data/batch_generator.h)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from marian_tpu.common.options import Options
+from marian_tpu.data.batch_generator import BatchGenerator
+from marian_tpu.data.corpus import Corpus
+from marian_tpu.data.vocab import DefaultVocab
+
+native = pytest.importorskip("marian_tpu.native")
+
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def corpus_files(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("native")
+    src_lines = ["the cat sat on the mat", "a dog barks", "hello world",
+                 "the quick brown fox jumps over the lazy dog",
+                 "a cat and a dog", "hello again world", "the dog runs",
+                 "a fox jumps", "the lazy dog sleeps", "hello cat dog fox"]
+    tgt_lines = ["die katze sass auf der matte", "ein hund bellt",
+                 "hallo welt",
+                 "der schnelle braune fuchs springt ueber den faulen hund",
+                 "eine katze und ein hund", "hallo nochmal welt",
+                 "der hund rennt", "ein fuchs springt",
+                 "der faule hund schlaeft", "hallo katze hund fuchs"]
+    src = tmp / "c.src"; src.write_text("\n".join(src_lines) + "\n")
+    tgt = tmp / "c.tgt"; tgt.write_text("\n".join(tgt_lines) + "\n")
+    vs = DefaultVocab.build(src_lines)
+    vt = DefaultVocab.build(tgt_lines)
+    return str(src), str(tgt), vs, vt
+
+
+def _python_batches(src, tgt, vs, vt, **kw):
+    opts = Options({"max-length": 50, "shuffle": "none", "seed": 7, **{
+        k.replace("_", "-"): v for k, v in kw.items()}})
+    corpus = Corpus([src, tgt], [vs, vt], opts)
+    bg = BatchGenerator(corpus, opts, shuffle_batches=False, prefetch=False)
+    return list(bg)
+
+
+def _native_batches(src, tgt, vs, vt, **kw):
+    opts = Options({"max-length": 50, "shuffle": "none", "seed": 7, **{
+        k.replace("_", "-"): v for k, v in kw.items()}})
+    bg = native.NativeBatchGenerator([src, tgt], [vs, vt], opts)
+    return list(bg)
+
+
+class TestNativeMatchesPython:
+    @pytest.mark.parametrize("kw", [
+        dict(mini_batch=4),
+        dict(mini_batch=3, maxi_batch=2),
+        dict(mini_batch_words=40, mini_batch=64),
+        dict(mini_batch=4, maxi_batch_sort="src"),
+    ])
+    def test_batch_for_batch(self, corpus_files, kw):
+        src, tgt, vs, vt = corpus_files
+        pb = _python_batches(src, tgt, vs, vt, **kw)
+        nb = _native_batches(src, tgt, vs, vt, **kw)
+        assert len(pb) == len(nb)
+        for p, n in zip(pb, nb):
+            assert p.src.ids.shape == n.src.ids.shape
+            np.testing.assert_array_equal(p.src.ids, n.src.ids)
+            np.testing.assert_array_equal(p.trg.ids, n.trg.ids)
+            np.testing.assert_array_equal(p.src.mask, n.src.mask)
+            np.testing.assert_array_equal(p.trg.mask, n.trg.mask)
+            np.testing.assert_array_equal(p.sentence_ids, n.sentence_ids)
+
+    def test_max_length_skip(self, corpus_files):
+        src, tgt, vs, vt = corpus_files
+        nb = native.NativeBatchGenerator(
+            [src, tgt], [vs, vt], None, mini_batch=64, shuffle=False,
+            max_length=5)
+        # only sentences with <=5 tokens incl. EOS survive on BOTH sides
+        total = sum(b.size for b in nb)
+        pb = _python_batches(src, tgt, vs, vt, mini_batch=64)
+        opts = Options({"max-length": 5, "shuffle": "none"})
+        corpus = Corpus([src, tgt], [vs, vt], opts)
+        expect = sum(1 for _ in corpus)
+        assert total == expect
+
+    def test_shuffle_covers_corpus(self, corpus_files):
+        src, tgt, vs, vt = corpus_files
+        bg = native.NativeBatchGenerator([src, tgt], [vs, vt], None,
+                                         mini_batch=3, shuffle=True, seed=3)
+        seen = []
+        for b in bg:
+            seen.extend(int(i) for i in b.sentence_ids if i >= 0)
+        assert sorted(seen) == list(range(10))
+        first_epoch = list(seen)
+        seen2 = []
+        for b in bg:          # second epoch: different permutation
+            seen2.extend(int(i) for i in b.sentence_ids if i >= 0)
+        assert sorted(seen2) == list(range(10))
+        assert seen2 != first_epoch
+
+    def test_resume_seek(self, corpus_files):
+        """Window-granular exact resume (maxi_batch=1 → one batch per
+        window, so positions step per batch; mirrors the Python
+        BatchGenerator's corpus-state snapshot semantics)."""
+        src, tgt, vs, vt = corpus_files
+        kw = dict(mini_batch=2, maxi_batch=1, shuffle=False)
+        bg = native.NativeBatchGenerator([src, tgt], [vs, vt], None, **kw)
+        all_ids = []
+        states = []
+        for b in bg:
+            states.append(dict(b.corpus_state))
+            all_ids.append([int(i) for i in b.sentence_ids if i >= 0])
+        # with one batch per window, the state after batch i resumes at i+1
+        assert states[1]["position"] == 4
+        bg2 = native.NativeBatchGenerator([src, tgt], [vs, vt], None, **kw)
+        bg2.seek(states[1]["epoch"], states[1]["position"])
+        replay = [[int(i) for i in b.sentence_ids if i >= 0] for b in bg2]
+        assert replay == all_ids[2:]
+
+
+class TestNativeTrainCLI:
+    def test_train_with_native_backend(self, tmp_path):
+        from marian_tpu.cli import marian_train
+        src_lines = ["a b c", "b c d", "c d a", "d a b"] * 3
+        tgt_lines = ["x y z", "y z w", "z w x", "w x y"] * 3
+        (tmp_path / "t.src").write_text("\n".join(src_lines) + "\n")
+        (tmp_path / "t.tgt").write_text("\n".join(tgt_lines) + "\n")
+        model = str(tmp_path / "m.npz")
+        marian_train.main([
+            "--type", "transformer",
+            "--train-sets", str(tmp_path / "t.src"), str(tmp_path / "t.tgt"),
+            "--vocabs", str(tmp_path / "v.s.yml"), str(tmp_path / "v.t.yml"),
+            "--model", model, "--data-backend", "native",
+            "--dim-emb", "32", "--transformer-heads", "4",
+            "--transformer-dim-ffn", "64", "--enc-depth", "1",
+            "--dec-depth", "1", "--precision", "float32", "float32",
+            "--mini-batch", "8", "--learn-rate", "0.01",
+            "--after-batches", "10", "--disp-freq", "5u",
+            "--save-freq", "100u", "--seed", "1", "--max-length", "20",
+            "--quiet", "--cost-type", "ce-mean-words",
+        ])
+        assert os.path.exists(model)

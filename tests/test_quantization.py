@@ -1,0 +1,166 @@
+"""Int8 quantized inference (config #5) — ops/quantization.py + marian-conv
+(reference: intgemm8 CPU decode path, SURVEY.md §2.4/§2.9)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.ops.quantization import (QTensor, int8_affine, int8_gather,
+                                         int8_logits, is_quantized, quantize,
+                                         quantize_params, wrap_quantized)
+
+
+class TestQuantizeOps:
+    def test_roundtrip_error_bounded(self, rng):
+        w = rng.randn(64, 32).astype(np.float32)
+        q = quantize(w, axis=1)
+        back = np.asarray(q.dequantize())
+        # per-column symmetric int8: max error <= scale/2 per column
+        scale = np.asarray(q.scale)
+        assert np.all(np.abs(back - w) <= scale[None, :] * 0.5 + 1e-7)
+
+    def test_int8_affine_close_to_float(self, rng):
+        x = jnp.asarray(rng.randn(4, 64), jnp.float32)
+        w = rng.randn(64, 32).astype(np.float32)
+        b = rng.randn(1, 32).astype(np.float32)
+        q = quantize(w, axis=1)
+        y_int8 = np.asarray(int8_affine(x, q, jnp.asarray(b)))
+        y_f32 = np.asarray(x) @ w + b
+        # int8×int8 with dynamic act quant on unstructured gaussians:
+        # worst element ~8-10% relative, mean ~1.5%
+        denom = np.maximum(np.abs(y_f32), np.abs(y_f32).max() * 0.1)
+        rel = np.abs(y_int8 - y_f32) / denom
+        assert np.max(rel) < 0.15
+        assert np.mean(rel) < 0.03
+
+    def test_int8_logits_matches_transposed_affine(self, rng):
+        x = jnp.asarray(rng.randn(3, 16), jnp.float32)
+        table = rng.randn(40, 16).astype(np.float32)   # [V, d]
+        q = quantize(table, axis=0)
+        y = np.asarray(int8_logits(x, q))
+        ref = np.asarray(x) @ table.T
+        assert y.shape == (3, 40)
+        denom = np.maximum(np.abs(ref), np.abs(ref).max() * 0.1)
+        rel = np.abs(y - ref) / denom
+        assert np.max(rel) < 0.15
+        assert np.mean(rel) < 0.03
+        # shortlist slicing
+        sl = jnp.asarray([0, 5, 7], jnp.int32)
+        y_sl = np.asarray(int8_logits(x, q, sl))
+        np.testing.assert_allclose(y_sl, y[:, [0, 5, 7]], rtol=1e-6)
+
+    def test_int8_gather(self, rng):
+        table = rng.randn(20, 8).astype(np.float32)
+        q = quantize(table, axis=0)
+        ids = jnp.asarray([[1, 3], [0, 19]], jnp.int32)
+        out = np.asarray(int8_gather(q, ids, jnp.float32))
+        np.testing.assert_allclose(out, np.asarray(q.dequantize())[[[1, 3], [0, 19]]],
+                                   rtol=1e-6)
+
+    def test_qtensor_is_pytree(self, rng):
+        q = quantize(rng.randn(8, 8).astype(np.float32))
+        leaves, treedef = jax.tree_util.tree_flatten(q)
+        assert len(leaves) == 2
+        q2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert q2.axis == q.axis
+        # usable inside jit as an argument
+        out = jax.jit(lambda x, qq: int8_affine(x, qq))(
+            jnp.ones((2, 8), jnp.float32), q)
+        assert out.shape == (2, 8)
+
+
+class TestQuantizeParams:
+    def test_pairs_and_wrap(self, rng):
+        params = {
+            "Wemb": rng.randn(32, 16).astype(np.float32),
+            "encoder_l1_self_Wq": rng.randn(16, 16).astype(np.float32),
+            "encoder_l1_self_bq": np.zeros((1, 16), np.float32),
+            "encoder_l1_self_Wo_ln_scale": np.ones((1, 16), np.float32),
+        }
+        qp = quantize_params(params)
+        assert is_quantized(qp)
+        assert qp["Wemb"].dtype == np.int8
+        assert "Wemb:qscale" in qp and qp["Wemb:qscale"].shape == (32,)
+        assert qp["encoder_l1_self_Wq:qscale"].shape == (16,)
+        # biases / layer norms untouched
+        assert qp["encoder_l1_self_bq"].dtype == np.float32
+        assert "encoder_l1_self_bq:qscale" not in qp
+        wrapped = wrap_quantized({k: jnp.asarray(v) for k, v in qp.items()})
+        assert isinstance(wrapped["Wemb"], QTensor)
+        assert wrapped["Wemb"].axis == 0
+        assert isinstance(wrapped["encoder_l1_self_Wq"], QTensor)
+        assert wrapped["encoder_l1_self_Wq"].axis == 1
+        assert not isinstance(wrapped["encoder_l1_self_bq"], QTensor)
+
+
+class TestConvCLI:
+    def test_convert_and_decode(self, trained_model_q, capsys):
+        """marian-conv int8tpu on a trained toy model; int8 beam decode
+        reproduces the float decode on the training sentences."""
+        from marian_tpu.cli import marian_conv, marian_decoder
+        tmp, model, src_lines, _ = trained_model_q
+        qmodel = str(tmp / "model.int8.npz")
+        marian_conv.main(["--from", model, "--to", qmodel,
+                          "--gemm-type", "int8tpu"])
+        assert os.path.getsize(qmodel) < os.path.getsize(model)
+
+        def decode(mpath, lines):
+            from marian_tpu.translator.translator import Translate
+            from marian_tpu.common.options import Options
+            from marian_tpu.common.config_parser import parse_options
+            opts = parse_options(
+                ["--models", mpath,
+                 "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+                 "--beam-size", "2", "--quiet"], mode="translation")
+            import io as _io
+            out = _io.StringIO()
+            Translate(opts).run(lines, stream=out)
+            return out.getvalue().strip().split("\n")
+
+        f32 = decode(model, src_lines[:4])
+        q8 = decode(qmodel, src_lines[:4])
+        # int8 on an overfit toy model: decodes agree
+        assert sum(a == b for a, b in zip(f32, q8)) >= 3
+
+    def test_format_conversion_bin(self, trained_model_q):
+        from marian_tpu.cli import marian_conv
+        from marian_tpu.common import io as mio
+        tmp, model, _, _ = trained_model_q
+        bpath = str(tmp / "model.bin")
+        marian_conv.main(["--from", model, "--to", bpath])
+        p1, c1 = mio.load_model(model)
+        p2, c2 = mio.load_model(bpath)
+        assert set(p1) == set(p2)
+        for k in p1:
+            np.testing.assert_array_equal(p1[k], p2[k])
+
+
+@pytest.fixture(scope="module")
+def trained_model_q(tmp_path_factory):
+    """Small trained model for conversion tests (separate from test_cli_e2e's
+    fixture so the files can run independently)."""
+    from marian_tpu.cli import marian_train
+    tmp = tmp_path_factory.mktemp("conv")
+    src_lines = ["a b c", "b c d", "c d a", "d a b", "a c b", "b d c"] * 2
+    tgt_lines = ["x y z", "y z w", "z w x", "w x y", "x z y", "y w z"] * 2
+    (tmp / "train.src").write_text("\n".join(src_lines) + "\n")
+    (tmp / "train.tgt").write_text("\n".join(tgt_lines) + "\n")
+    model = str(tmp / "model.npz")
+    marian_train.main([
+        "--type", "transformer",
+        "--train-sets", str(tmp / "train.src"), str(tmp / "train.tgt"),
+        "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+        "--model", model,
+        "--dim-emb", "32", "--transformer-heads", "4",
+        "--transformer-dim-ffn", "64", "--enc-depth", "1", "--dec-depth", "1",
+        "--precision", "float32", "float32",
+        "--mini-batch", "12", "--maxi-batch", "2",
+        "--learn-rate", "0.01", "--after-batches", "30",
+        "--disp-freq", "10u", "--save-freq", "1000u",
+        "--seed", "1", "--max-length", "20", "--quiet",
+        "--cost-type", "ce-mean-words",
+    ])
+    return tmp, model, src_lines, tgt_lines
