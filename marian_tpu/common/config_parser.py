@@ -118,6 +118,7 @@ _MODEL = [
     _f("gradient-checkpointing", bool, False, "Rematerialization (jax.checkpoint) to save memory", "model"),
     # tpu-specific (new, no Marian equivalent)
     _f("attention-kernel", str, "auto", "Attention impl: auto, dense, flash (Pallas)", "model"),
+    _f("auto-tune", bool, False, "Time implementation alternatives (dense vs Pallas flash attention crossover) on the current backend and bind the fastest, like the reference's AutoTuner (TPU extension)", "model"),
     _f("sequence-parallel", str, "none", "Sequence/context parallelism over the 'seq' mesh axis: none, ring (K/V blocks rotate via ppermute), ulysses (all-to-all head<->seq swap) (TPU extension)", "model"),
     _f("scan-layers", bool, False, "lax.scan over layer stack (faster compile, needs uniform layers)", "model"),
 ]
@@ -194,6 +195,7 @@ _TRAINING = [
     _f("embedding-fix-src", bool, False, "Fix source embeddings", "training"),
     _f("embedding-fix-trg", bool, False, "Fix target embeddings", "training"),
     _f("quantize-bits", int, 0, "Train-time model quantization bits (0 = off)", "training"),
+    _f("gradient-dropping-rate", float, 0.0, "Drop this fraction of each gradient tensor (DGC-style, with error feedback); 0 = off", "training"),
     _f("quantize-optimization-steps", int, 0, "Scale-optimization steps for quantization", "training"),
     _f("quantize-log-based", bool, False, "Log-based quantization", "training"),
     _f("quantize-biases", bool, False, "Quantize biases too", "training"),

@@ -1,0 +1,174 @@
+"""BERT model family: masked-LM pretraining and sequence classification
+(reference: src/models/bert.h :: BertEncoderClassifier / BertMaskedLM,
+src/data/corpus_base.cpp BERT batch transform; SURVEY.md §2.5).
+
+The encoder is the transformer encoder stack (models/transformer.py — same
+param names, so TP sharding and checkpoint IO apply unchanged). Differences
+from the reference's design, TPU-first:
+
+- the 15% masking transform runs INSIDE the jitted loss from a PRNG key
+  (80% [MASK] / 10% random / 10% keep), not as a host-side batch mutation —
+  no host RNG in the input pipeline, fully reproducible from the step key;
+- masked positions are selected by bernoulli mask + weighting, keeping
+  shapes static (the reference gathers masked positions into a ragged list).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers import initializers as inits
+from ..ops.ops import affine, layer_norm
+from . import transformer as T
+
+Params = Dict[str, jax.Array]
+
+
+class BertModel:
+    """--type bert (masked LM) / bert-classifier (sequence classification).
+    Implements the same (init/loss) contract as EncoderDecoder, so
+    GraphGroup/Train/validators drive it unchanged."""
+
+    def __init__(self, options, vocab, label_vocab=None,
+                 inference: bool = False):
+        self.options = options
+        self.model_type = options.get("type", "bert")
+        self.classify = self.model_type == "bert-classifier"
+        self.inference = inference
+        vocab_size = len(vocab) if not isinstance(vocab, int) else vocab
+        self.cfg = T.config_from_options(options, vocab_size, vocab_size,
+                                         inference)
+        # encoder-only: no decoder layers; tied output head reused for MLM
+        self.cfg = dataclasses.replace(
+            self.cfg, dec_depth=0, tied_embeddings_all=True, n_encoders=1,
+            src_vocabs=(vocab_size,))
+        self.vocab_size = vocab_size
+        self.n_classes = (len(label_vocab) if label_vocab is not None
+                          and not isinstance(label_vocab, int)
+                          else int(label_vocab or 0)) if self.classify else 0
+        self.mask_fraction = float(options.get("bert-masking-fraction", 0.15))
+        self.type_vocab = int(options.get("bert-type-vocab-size", 2))
+        self.train_type_emb = bool(options.get("bert-train-type-embeddings",
+                                               True))
+        mask_symbol = str(options.get("bert-mask-symbol", "[MASK]"))
+        if not isinstance(vocab, int) and hasattr(vocab, "__getitem__"):
+            self.mask_id = vocab[mask_symbol]
+            # DefaultVocab returns UNK for unknown words; a missing mask
+            # symbol would silently conflate masking with OOV (the
+            # reference bert.h aborts here too)
+            if self.mask_id == 1 and mask_symbol != "<unk>":
+                raise ValueError(
+                    f"BERT mask symbol '{mask_symbol}' not found in the "
+                    f"vocabulary; add it or set --bert-mask-symbol")
+        else:
+            self.mask_id = 1
+        self.label_smoothing = 0.0
+
+    # -- params --------------------------------------------------------------
+    def init(self, key: jax.Array) -> Params:
+        p = T.init_params(self.cfg, key)
+        d = self.cfg.dim_emb
+        k = jax.random.split(key, 8)
+        if self.train_type_emb:
+            p["Wtype"] = inits.glorot_uniform(k[1], (self.type_vocab, d))
+        # MLM transform head (reference: bert.h "masked-lm" ff + layer-norm)
+        p["masked-lm_ff_logit_l1_W"] = inits.glorot_uniform(k[2], (d, d))
+        p["masked-lm_ff_logit_l1_b"] = inits.zeros((1, d))
+        p["masked-lm_ln_scale"] = inits.ones((1, d))
+        p["masked-lm_ln_bias"] = inits.zeros((1, d))
+        if self.classify:
+            p["classifier_ff_logit_l1_W"] = inits.glorot_uniform(k[3], (d, d))
+            p["classifier_ff_logit_l1_b"] = inits.zeros((1, d))
+            p["classifier_ff_logit_l2_W"] = inits.glorot_uniform(
+                k[4], (d, self.n_classes))
+            p["classifier_ff_logit_l2_b"] = inits.zeros((1, self.n_classes))
+        return p
+
+    @property
+    def beam_carried_suffixes(self) -> Tuple[str, ...]:
+        return ()
+
+    # -- masking transform (jitted; reference does this host-side) ----------
+    def _mask_inputs(self, ids, mask, key):
+        """BERT 80/10/10 masking. Returns (masked_ids, mlm_weights)."""
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        real = mask > 0
+        # never mask the EOS terminator (id 0 rows are padding anyway)
+        candidates = real & (ids != 0)
+        select = jax.random.bernoulli(k1, self.mask_fraction, ids.shape) \
+            & candidates
+        r = jax.random.uniform(k2, ids.shape)
+        random_ids = jax.random.randint(k3, ids.shape, 2, self.vocab_size)
+        replaced = jnp.where(r < 0.8, jnp.full_like(ids, self.mask_id),
+                             jnp.where(r < 0.9, random_ids, ids))
+        masked_ids = jnp.where(select, replaced, ids)
+        return masked_ids, select.astype(jnp.float32)
+
+    def _encode(self, params: Params, ids, mask, train: bool, key):
+        cparams = T.cast_params(params, self.cfg.compute_dtype)
+        # single-segment batches: sentence-type-0 embedding added to the
+        # input embeddings (reference: bert.h addSentenceEmbeddings)
+        offset = (cparams["Wtype"][0][None, None, :]
+                  if self.train_type_emb else None)
+        x = T._encode_one(self.cfg, cparams, ids, mask, train, key, 0,
+                          emb_offset=offset)
+        return x, cparams
+
+    # -- losses --------------------------------------------------------------
+    def loss(self, params: Params, batch: Dict[str, jax.Array],
+             key: Optional[jax.Array] = None, train: bool = True
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        if self.classify:
+            return self._classifier_loss(params, batch, key, train)
+        return self._mlm_loss(params, batch, key, train)
+
+    def _mlm_loss(self, params, batch, key, train):
+        ids, mask = batch["src_ids"], batch["src_mask"]
+        mkey = key if key is not None else jax.random.key(0)
+        masked_ids, weights = self._mask_inputs(ids, mask,
+                                                jax.random.fold_in(mkey, 7))
+        x, cparams = self._encode(params, masked_ids, mask, train,
+                                  jax.random.fold_in(mkey, 8) if key is not None
+                                  else None)
+        # transform head: dense+gelu+ln, then tied-embedding logits
+        h = affine(x, cparams["masked-lm_ff_logit_l1_W"],
+                   cparams["masked-lm_ff_logit_l1_b"])
+        h = jax.nn.gelu(h)
+        h = layer_norm(h, cparams["masked-lm_ln_scale"],
+                       cparams["masked-lm_ln_bias"])
+        logits = T.output_logits(self.cfg, cparams, h)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        gold = jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
+        ce_sum = -jnp.sum(gold * weights)
+        labels = jnp.sum(weights)
+        return ce_sum, {"ce_sum": ce_sum, "labels": jnp.maximum(labels, 1.0)}
+
+    def _classifier_loss(self, params, batch, key, train):
+        ids, mask = batch["src_ids"], batch["src_mask"]
+        labels = batch["trg_ids"][:, 0]          # label stream: one id + EOS
+        x, cparams = self._encode(params, ids, mask, train, key)
+        logits = self.classify_logits(cparams, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        row_valid = (mask[:, 0] > 0).astype(jnp.float32)   # padding rows out
+        gold = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        ce_sum = -jnp.sum(gold * row_valid)
+        n = jnp.maximum(jnp.sum(row_valid), 1.0)
+        return ce_sum, {"ce_sum": ce_sum, "labels": n}
+
+    def classify_logits(self, cparams, enc_out) -> jax.Array:
+        """[CLS]-position (t=0) classification head (reference: bert.h
+        BertClassifier: first-token state -> ff tanh -> ff n-classes)."""
+        cls = enc_out[:, 0, :]
+        h = jnp.tanh(affine(cls, cparams["classifier_ff_logit_l1_W"],
+                            cparams["classifier_ff_logit_l1_b"]))
+        return affine(h, cparams["classifier_ff_logit_l2_W"],
+                      cparams["classifier_ff_logit_l2_b"])
+
+    # -- inference: predict classes / fill masks -----------------------------
+    def predict_classes(self, params, ids, mask) -> jax.Array:
+        x, cparams = self._encode(params, ids, mask, False, None)
+        return jnp.argmax(self.classify_logits(cparams, x), axis=-1)

@@ -143,10 +143,17 @@ class EncoderDecoder:
 
 
 def create_model(options, src_vocab, trg_vocab,
-                 inference: bool = False) -> EncoderDecoder:
+                 inference: bool = False):
     """Model factory (reference: src/models/model_factory.cpp ::
     models::createModelFromOptions). Vocab args may be int sizes or
-    VocabBase objects (factored vocabs enable the factored softmax)."""
+    VocabBase objects (factored vocabs enable the factored softmax).
+    --type bert / bert-classifier build the encoder-only BERT family
+    (models/bert.py); everything else is an EncoderDecoder."""
+    mtype = options.get("type", "transformer")
+    if mtype in ("bert", "bert-classifier"):
+        from .bert import BertModel
+        label_vocab = trg_vocab if mtype == "bert-classifier" else None
+        return BertModel(options, src_vocab, label_vocab, inference)
     return EncoderDecoder(options, src_vocab, trg_vocab, inference)
 
 

@@ -352,6 +352,9 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
             and q.shape[-2] % n_seq == 0 and k_.shape[-2] % n_seq == 0
             and q.shape[1] % max(n_model, 1) == 0
             and q.shape[0] % max(cfg.seq_mesh.shape.get("data", 1), 1) == 0
+            # ulysses swaps heads<->seq: per-device heads must split over seq
+            and (cfg.sequence_parallel != "ulysses"
+                 or (q.shape[1] // max(n_model, 1)) % n_seq == 0)
             and (cfg.attention_dropout == 0.0 or not train)):
         from ..parallel.sequence import ring_attention_sharded
         out = ring_attention_sharded(cfg.seq_mesh, q, k_, v_,
@@ -484,10 +487,13 @@ def encode(cfg: TransformerConfig, params: Params, src_ids,
 
 
 def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
-                src_mask: jax.Array, train: bool, key, enc_idx: int) -> jax.Array:
+                src_mask: jax.Array, train: bool, key, enc_idx: int,
+                emb_offset: Optional[jax.Array] = None) -> jax.Array:
     ep = _enc_prefix(enc_idx)
     kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
     x = _embed(cfg, params, src_ids, "src", kk(0), train, enc_idx=enc_idx)
+    if emb_offset is not None:   # e.g. BERT sentence-type embeddings
+        x = x + emb_offset.astype(x.dtype)
     x = _pre_post(cfg, cfg.postprocess_emb, x, None, f"{ep}_emb", params,
                   kk(1), train)
     attn_mask = src_mask[:, None, None, :]  # [B,1,1,Ts]

@@ -205,7 +205,7 @@ class NativeBatchGenerator:
         (the role of the reference's SQLite corpus / corpus-position restore).
         `seed` restores the checkpoint's shuffle seed so the permutation
         matches the interrupted run even if --seed changed."""
-        if seed:
+        if seed is not None:
             self._seed = int(seed)
         self.epoch = epoch
         self._pending_seek = position

@@ -60,7 +60,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               deterministic: bool = True,
               return_weights: bool = False,
               flash: str = "auto",
-              flash_min_len: int = 1024):
+              flash_min_len: Optional[int] = None):
     """Attention dispatcher: dense (XLA-fused einsum) vs Pallas flash.
 
     `mask` is the general [B,1,Tq,Tk] dense mask; `kv_mask` [B,Tk] + `causal`
@@ -70,6 +70,10 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     structured mask describing the dense one, multi-query step), and (c) for
     "auto", worth it (sequence long enough that streaming K/V blocks beats
     one fused dense batch matmul; crossover measured on v5e ~1-2k)."""
+    if flash_min_len is None:
+        # default crossover; --auto-tune rebinds it (ops/auto_tuner.py)
+        from .auto_tuner import flash_threshold
+        flash_min_len = flash_threshold()
     applicable = (
         flash != "off"
         and not return_weights

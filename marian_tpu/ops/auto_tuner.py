@@ -1,0 +1,105 @@
+"""Auto-tuner: time alternative implementations and bind the fastest
+(reference: src/graph/auto_tuner.h :: AutoTuner — Marian times e.g. int16
+vs fp32 GEMM per shape-hash and calls the winner thereafter).
+
+On TPU the choice that actually matters is made OUTSIDE jit, because the
+implementation choice changes the compiled program: which attention kernel
+(XLA-fused dense einsum vs the Pallas flash kernel) to compile for a given
+sequence-length bucket. ``calibrate_flash_attention`` measures the crossover
+once per process and rebinds the threshold that ``ops.attention.attention``
+consults for its "auto" mode (opt-in via --auto-tune; the static default is
+the v5e-measured ~1k crossover)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AutoTuner:
+    """Generic per-key implementation chooser (reference: AutoTuner::run /
+    ::start/stop timing protocol, collapsed to explicit measurement)."""
+
+    def __init__(self, warmup: int = 1, iters: int = 3):
+        self.warmup = warmup
+        self.iters = iters
+        self._choice: Dict[Any, str] = {}
+        self._timings: Dict[Any, Dict[str, float]] = {}
+
+    def measure(self, fn: Callable, *args) -> float:
+        """Median wall time of fn(*args) with device sync (block_until_ready
+        replaces the reference's cudaStreamSynchronize timing fences)."""
+        for _ in range(self.warmup):
+            jax.block_until_ready(fn(*args))
+        times = []
+        for _ in range(self.iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2]
+
+    def pick(self, key: Any,
+             candidates: Dict[str, Tuple[Callable, tuple]]) -> str:
+        """Return the name of the fastest candidate for `key`, timing each
+        once and caching the winner (per-shape-hash binding)."""
+        if key in self._choice:
+            return self._choice[key]
+        timings = {name: self.measure(fn, *args)
+                   for name, (fn, args) in candidates.items()}
+        winner = min(timings, key=timings.get)
+        self._choice[key] = winner
+        self._timings[key] = timings
+        return winner
+
+    def run(self, key: Any,
+            candidates: Dict[str, Tuple[Callable, tuple]]):
+        """pick + call the winner (the reference AutoTuner::run shape)."""
+        name = self.pick(key, candidates)
+        fn, args = candidates[name]
+        return fn(*args)
+
+
+# ---------------------------------------------------------------------------
+# flash-attention crossover calibration
+# ---------------------------------------------------------------------------
+
+_calibrated_threshold: Optional[int] = None
+
+
+def flash_threshold(default: int = 1024) -> int:
+    """Sequence length above which 'auto' picks the Pallas flash kernel."""
+    return _calibrated_threshold if _calibrated_threshold is not None \
+        else default
+
+
+def calibrate_flash_attention(heads: int = 8, dim_head: int = 64,
+                              batch: int = 4,
+                              lengths=(256, 512, 1024, 2048),
+                              causal: bool = True) -> int:
+    """Time dense vs flash attention per length bucket on the current
+    backend; bind the smallest length where flash wins (--auto-tune)."""
+    global _calibrated_threshold
+    from .attention import dense_attention
+    from .pallas.flash_attention import flash_attention
+
+    tuner = AutoTuner()
+    crossover = None
+    for t in lengths:
+        q = jnp.ones((batch, heads, t, dim_head), jnp.bfloat16)
+        mask = (jnp.tril(jnp.ones((t, t), jnp.bfloat16))[None, None]
+                if causal else None)
+        dense_j = jax.jit(lambda a, m: dense_attention(a, a, a, m))
+        flash_j = jax.jit(lambda a: flash_attention(a, a, a, causal=causal))
+        name = tuner.pick(("attn", t), {
+            "dense": (dense_j, (q, mask)),
+            "flash": (flash_j, (q,)),
+        })
+        if name == "flash" and crossover is None:
+            crossover = t
+    _calibrated_threshold = crossover if crossover is not None \
+        else max(lengths) * 2
+    return _calibrated_threshold

@@ -126,10 +126,8 @@ def quantizable(name: str, arr) -> bool:
         return False
     if name.endswith(_SKIP_SUFFIXES) or name == "Wpos":
         return False
-    if not np.issubdtype(np.asarray(arr).dtype, np.floating):
-        return False
-    # biases are [1, d]
-    return arr.shape[0] > 1
+    # biases ([1, d]) were already rejected by the ndim/shape check above
+    return np.issubdtype(np.asarray(arr).dtype, np.floating)
 
 
 def quantize_params(params: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

@@ -38,6 +38,12 @@ class OptimizerConfig:
     clip_norm: float = 1.0             # 0 = off  (--clip-norm)
     smoothing: float = 0.0             # --exponential-smoothing
     ref_mb_words: int = 0              # --mini-batch-words-ref
+    # train-time compression (optimizers/compression.py)
+    quantize_bits: int = 0             # --quantize-bits (0 = off)
+    quantize_log: bool = False         # --quantize-log-based
+    quantize_biases: bool = False      # --quantize-biases
+    quantize_opt_steps: int = 0        # --quantize-optimization-steps
+    grad_drop_rate: float = 0.0        # --gradient-dropping-rate (0 = off)
 
     @classmethod
     def from_options(cls, options) -> "OptimizerConfig":
@@ -46,7 +52,14 @@ class OptimizerConfig:
         cfg = cls(name=name,
                   clip_norm=float(options.get("clip-norm", 1.0) or 0.0),
                   smoothing=float(options.get("exponential-smoothing", 0.0) or 0.0),
-                  ref_mb_words=int(options.get("mini-batch-words-ref", 0) or 0))
+                  ref_mb_words=int(options.get("mini-batch-words-ref", 0) or 0),
+                  quantize_bits=int(options.get("quantize-bits", 0) or 0),
+                  quantize_log=bool(options.get("quantize-log-based", False)),
+                  quantize_biases=bool(options.get("quantize-biases", False)),
+                  quantize_opt_steps=int(
+                      options.get("quantize-optimization-steps", 0) or 0),
+                  grad_drop_rate=float(
+                      options.get("gradient-dropping-rate", 0.0) or 0.0))
         if name == "adam":
             if len(params) > 0:
                 cfg.beta1 = params[0]
@@ -74,6 +87,12 @@ def init_state(cfg: OptimizerConfig, params: Params) -> Dict[str, Any]:
         # params here makes jit buffer donation see the same buffer twice
         st["avg"] = {k: jnp.array(v, dtype=jnp.float32, copy=True)
                      for k, v in params.items()}
+    if cfg.quantize_bits > 0:     # quantization error feedback (quantizer.cpp)
+        st["qerr"] = {k: jnp.zeros(v.shape, jnp.float32)
+                      for k, v in params.items()}
+    if cfg.grad_drop_rate > 0:    # gradient-dropping residual (DGC)
+        st["gerr"] = {k: jnp.zeros(v.shape, jnp.float32)
+                      for k, v in params.items()}
     return st
 
 
@@ -91,6 +110,13 @@ def apply_update(cfg: OptimizerConfig, state: Dict[str, Any], params: Params,
         ratio = mb_words.astype(jnp.float32) / float(cfg.ref_mb_words)
         lr = lr * ratio
         eps = eps * ratio
+
+    if cfg.grad_drop_rate > 0:
+        # DGC-style sparsification with error feedback (reference:
+        # training/gradient_dropping/; warmup ramps the rate via t)
+        from .compression import drop_gradients
+        grads, new_state["gerr"] = drop_gradients(
+            grads, state["gerr"], cfg.grad_drop_rate)
 
     out: Params = {}
     if cfg.name == "adam":
@@ -120,6 +146,14 @@ def apply_update(cfg: OptimizerConfig, state: Dict[str, Any], params: Params,
         for k, p in params.items():
             out[k] = (p.astype(jnp.float32)
                       - lr * grads[k].astype(jnp.float32)).astype(p.dtype)
+
+    if cfg.quantize_bits > 0:
+        # train-time model quantization with error feedback (quantizer.cpp);
+        # runs before EMA so the smoothed params track the quantized model
+        from .compression import quantize_model
+        out, new_state["qerr"] = quantize_model(
+            out, state["qerr"], cfg.quantize_bits, cfg.quantize_log,
+            cfg.quantize_opt_steps, cfg.quantize_biases)
 
     if cfg.smoothing > 0:
         # reference ExponentialSmoothing: avg += tau * (p - avg), with tau

@@ -80,6 +80,13 @@ class GraphGroup:
             else self.model.init(key)
         if self.opt_state is None:  # keep state restored from checkpoint
             self.opt_state = init_state(self.opt_cfg, self.params)
+        else:
+            # a restored checkpoint may predate newly-enabled features
+            # (EMA, --quantize-bits, --gradient-dropping-rate): backfill
+            # any missing state groups with fresh zeros
+            template = init_state(self.opt_cfg, self.params)
+            for k, v in template.items():
+                self.opt_state.setdefault(k, v)
         self.params, self.opt_state = place(
             self.params, self.opt_state, self.mesh,
             dim_emb=int(getattr(self.model.cfg, "dim_emb", 0) or 0))
@@ -175,7 +182,7 @@ class GraphGroup:
         the role of the reference's scatterState/gatherState shard IO."""
         import numpy as np
         flat: Dict[str, Any] = {"t": np.asarray(self.opt_state["t"])}
-        for part in ("m", "v", "gt", "avg"):
+        for part in ("m", "v", "gt", "avg", "qerr", "gerr"):
             if part in self.opt_state:
                 for k, v in self.opt_state[part].items():
                     flat[f"{part}:{k}"] = np.asarray(v)

@@ -51,6 +51,13 @@ class Train:
         native_bg = _native_batch_generator(opts, train_sets, vocabs)
 
         # -- model + graph group -------------------------------------------
+        if opts.get("auto-tune", False):
+            from ..ops.auto_tuner import calibrate_flash_attention
+            thr = calibrate_flash_attention(
+                heads=int(opts.get("transformer-heads", 8)),
+                dim_head=max(int(opts.get("dim-emb", 512))
+                             // max(int(opts.get("transformer-heads", 8)), 1), 1))
+            log.info("Auto-tuned flash-attention crossover: {} tokens", thr)
         src_side = vocabs[:-1] if len(vocabs) > 2 else vocabs[0]
         model = create_model(opts, src_side, vocabs[-1])
         gg = GraphGroup(model, opts)

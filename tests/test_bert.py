@@ -1,0 +1,162 @@
+"""BERT family (masked LM + classifier) — reference src/models/bert.h
+(SURVEY.md §2.5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common.options import Options
+from marian_tpu.data.vocab import DefaultVocab
+from marian_tpu.models.encoder_decoder import create_model
+
+
+def _vocab(words, specials=("[MASK]",)):
+    m = {"</s>": 0, "<unk>": 1}
+    for i, w in enumerate(list(specials) + list(words)):
+        m[w] = i + 2
+    return DefaultVocab(m)
+
+
+def _opts(mtype="bert", **kw):
+    return Options({
+        "type": mtype,
+        "dim-emb": 32, "transformer-heads": 4, "transformer-dim-ffn": 64,
+        "enc-depth": 2, "dec-depth": 2,
+        "precision": ["float32", "float32"],
+        "cost-type": "ce-mean-words",
+        "max-length": 32, **{k.replace("_", "-"): v for k, v in kw.items()}})
+
+
+def _batch(vocab_size, b=8, t=12, seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "src_ids": jnp.asarray(rs.randint(3, vocab_size, (b, t)), jnp.int32),
+        "src_mask": jnp.ones((b, t), jnp.float32),
+        "trg_ids": jnp.asarray(rs.randint(3, vocab_size, (b, t)), jnp.int32),
+        "trg_mask": jnp.ones((b, t), jnp.float32),
+    }
+
+
+class TestMaskedLM:
+    def test_loss_finite_and_masking_rate(self):
+        v = _vocab([f"w{i}" for i in range(20)])
+        model = create_model(_opts(), v, v)
+        params = model.init(jax.random.key(0))
+        batch = _batch(len(v))
+        total, aux = model.loss(params, batch, jax.random.key(1), train=True)
+        assert np.isfinite(float(total))
+        # ~15% of tokens masked (binomial, loose bounds)
+        frac = float(aux["labels"]) / batch["src_ids"].size
+        assert 0.05 < frac < 0.3
+
+    def test_mask_symbol_used(self):
+        v = _vocab([f"w{i}" for i in range(20)])
+        model = create_model(_opts(), v, v)
+        ids = jnp.asarray(np.full((4, 16), 5), jnp.int32)
+        mask = jnp.ones((4, 16), jnp.float32)
+        masked, weights = model._mask_inputs(ids, mask, jax.random.key(3))
+        changed = np.asarray(masked != ids)
+        sel = np.asarray(weights) > 0
+        assert sel.any()
+        # 80% of selected become [MASK]
+        mask_id = v["[MASK]"]
+        frac_masked = (np.asarray(masked)[sel] == mask_id).mean()
+        assert 0.5 < frac_masked <= 1.0
+        # unselected positions never change
+        assert not changed[~sel].any()
+
+    def test_mlm_training_reduces_loss(self):
+        v = _vocab([f"w{i}" for i in range(12)])
+        opts = _opts(learn_rate=1e-3, optimizer="adam", clip_norm=0.0)
+        model = create_model(opts, v, v)
+        params = model.init(jax.random.key(0))
+        batch = _batch(len(v), b=16, t=8, seed=1)
+
+        def loss_fn(p, key):
+            total, aux = model.loss(p, batch, key, train=True)
+            return total / aux["labels"]
+
+        g = jax.jit(jax.value_and_grad(loss_fn))
+        first = None
+        for step in range(30):
+            val, grads = g(params, jax.random.key(step % 3))
+            params = jax.tree_util.tree_map(lambda p_, g_: p_ - 0.01 * g_,
+                                            params, grads)
+            if first is None:
+                first = float(val)
+        assert float(val) < first
+
+
+class TestClassifier:
+    def test_learns_first_token_rule(self):
+        """Classify by the first token — a few steps should overfit."""
+        v = _vocab([f"w{i}" for i in range(10)])
+        lv = DefaultVocab({"</s>": 0, "<unk>": 1, "A": 2, "B": 3})
+        opts = _opts("bert-classifier", learn_rate=1e-2)
+        model = create_model(opts, v, lv)
+        params = model.init(jax.random.key(0))
+        rs = np.random.RandomState(0)
+        ids = rs.randint(3, len(v), (16, 6)).astype(np.int32)
+        labels = np.where(ids[:, 0] % 2 == 0, 2, 3).astype(np.int32)
+        batch = {
+            "src_ids": jnp.asarray(ids),
+            "src_mask": jnp.ones(ids.shape, jnp.float32),
+            "trg_ids": jnp.asarray(
+                np.stack([labels, np.zeros_like(labels)], 1)),
+            "trg_mask": jnp.ones((16, 2), jnp.float32),
+        }
+
+        def loss_fn(p):
+            total, aux = model.loss(p, batch, None, train=False)
+            return total / aux["labels"]
+
+        g = jax.jit(jax.value_and_grad(loss_fn))
+        for _ in range(60):
+            val, grads = g(params)
+            params = jax.tree_util.tree_map(lambda p_, g_: p_ - 0.05 * g_,
+                                            params, grads)
+        pred = model.predict_classes(params, batch["src_ids"],
+                                     batch["src_mask"])
+        assert (np.asarray(pred) == labels).mean() >= 0.9
+
+    def test_padding_rows_excluded(self):
+        v = _vocab([f"w{i}" for i in range(10)])
+        lv = DefaultVocab({"</s>": 0, "<unk>": 1, "A": 2})
+        model = create_model(_opts("bert-classifier"), v, lv)
+        params = model.init(jax.random.key(0))
+        batch = _batch(len(v), b=4, t=6)
+        batch["src_mask"] = batch["src_mask"].at[2:].set(0.0)  # padding rows
+        total, aux = model.loss(params, batch, None, train=False)
+        assert float(aux["labels"]) == 2.0
+
+
+class TestTrainCLI:
+    def test_bert_pretraining_e2e(self, tmp_path):
+        """marian-train --type bert on a monolingual file."""
+        import os
+        import yaml
+        from marian_tpu.cli import marian_train
+        lines = ["a b c d", "b c d a", "c d a b", "d a b c"] * 3
+        (tmp_path / "mono.txt").write_text("\n".join(lines) + "\n")
+        # vocab must contain [MASK]
+        vmap = {"</s>": 0, "<unk>": 1, "[MASK]": 2,
+                "a": 3, "b": 4, "c": 5, "d": 6}
+        with open(tmp_path / "v.yml", "w") as fh:
+            yaml.safe_dump(vmap, fh)
+        model = str(tmp_path / "bert.npz")
+        marian_train.main([
+            "--type", "bert",
+            "--train-sets", str(tmp_path / "mono.txt"),
+            "--vocabs", str(tmp_path / "v.yml"),
+            "--model", model,
+            "--dim-emb", "32", "--transformer-heads", "4",
+            "--transformer-dim-ffn", "64", "--enc-depth", "1",
+            "--dec-depth", "1",
+            "--precision", "float32", "float32",
+            "--mini-batch", "8", "--learn-rate", "0.005",
+            "--after-batches", "8", "--disp-freq", "4u",
+            "--save-freq", "100u", "--seed", "2", "--max-length", "20",
+            "--quiet", "--cost-type", "ce-mean-words",
+        ])
+        assert os.path.exists(model)

@@ -1,0 +1,89 @@
+"""Train-time compression: model quantizer (--quantize-bits, reference
+src/optimizers/quantizer.cpp) and DGC gradient dropping (reference
+src/training/gradient_dropping/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from marian_tpu.common.options import Options
+from marian_tpu.optimizers.compression import (drop_gradients, quantize_model,
+                                               quantize_tensor,
+                                               zeros_like_tree)
+from marian_tpu.optimizers.optimizers import (OptimizerConfig, apply_update,
+                                              init_state)
+
+
+class TestQuantizeTensor:
+    def test_levels(self, rng):
+        v = jnp.asarray(rng.randn(32, 16), jnp.float32)
+        q = np.asarray(quantize_tensor(v, bits=4))
+        # at most 2^4-1 distinct magnitude levels (symmetric ±7 + 0)
+        assert len(np.unique(np.round(np.abs(q), 7))) <= 8
+        assert np.max(np.abs(q - np.asarray(v))) <= float(jnp.max(jnp.abs(v))) / 7 * 0.51 + 1e-6
+
+    def test_log_based(self, rng):
+        v = jnp.asarray(rng.randn(16, 16), jnp.float32)
+        q = np.asarray(quantize_tensor(v, bits=4, log_based=True))
+        s = float(jnp.max(jnp.abs(v)))
+        nz = q[q != 0]
+        ratios = np.log2(np.abs(nz) / s)
+        np.testing.assert_allclose(ratios, np.round(ratios), atol=1e-5)
+
+    def test_opt_steps_reduce_error(self, rng):
+        v = jnp.asarray(rng.randn(64, 64), jnp.float32)
+        e0 = float(jnp.sum((quantize_tensor(v, 3) - v) ** 2))
+        e3 = float(jnp.sum((quantize_tensor(v, 3, opt_steps=3) - v) ** 2))
+        assert e3 <= e0 * 1.001
+
+
+class TestErrorFeedback:
+    def test_quantize_model_error_carries(self, rng):
+        params = {"W": jnp.asarray(rng.randn(8, 8), jnp.float32),
+                  "b": jnp.asarray(rng.randn(1, 8), jnp.float32)}
+        err = zeros_like_tree(params)
+        q1, e1 = quantize_model(params, err, bits=4)
+        # biases untouched by default
+        np.testing.assert_array_equal(q1["b"], params["b"])
+        np.testing.assert_allclose(np.asarray(q1["W"]) + np.asarray(e1["W"]),
+                                   np.asarray(params["W"]), atol=1e-6)
+
+    def test_drop_gradients(self, rng):
+        g = {"W": jnp.asarray(rng.randn(100, 10), jnp.float32)}
+        r = zeros_like_tree(g)
+        g2, r2 = drop_gradients(g, r, drop_rate=0.9)
+        kept = np.count_nonzero(np.asarray(g2["W"]))
+        assert kept <= 200          # ~10% of 1000 kept (sampled threshold)
+        assert kept >= 20
+        np.testing.assert_allclose(np.asarray(g2["W"]) + np.asarray(r2["W"]),
+                                   np.asarray(g["W"]), atol=1e-6)
+
+
+class TestOptimizerIntegration:
+    def _run_steps(self, opts_dict, n=5, seed=0):
+        rs = np.random.RandomState(seed)
+        params = {"W": jnp.asarray(rs.randn(16, 16), jnp.float32)}
+        cfg = OptimizerConfig.from_options(Options(opts_dict))
+        state = init_state(cfg, params)
+        step = jax.jit(lambda s, p, g: apply_update(cfg, s, p, g, 0.01))
+        for i in range(n):
+            g = {"W": jnp.asarray(rs.randn(16, 16), jnp.float32)}
+            state, params = step(state, params, g)
+        return params, state
+
+    def test_quantized_training_params_on_grid(self):
+        params, state = self._run_steps(
+            {"optimizer": "adam", "quantize-bits": 4})
+        assert "qerr" in state
+        w = np.asarray(params["W"])
+        assert len(np.unique(np.round(np.abs(w), 7))) <= 8
+
+    def test_gradient_dropping_state(self):
+        params, state = self._run_steps(
+            {"optimizer": "sgd", "gradient-dropping-rate": 0.99})
+        assert "gerr" in state
+        assert np.any(np.asarray(state["gerr"]["W"]) != 0)
+
+    def test_off_by_default(self):
+        params, state = self._run_steps({"optimizer": "adam"})
+        assert "qerr" not in state and "gerr" not in state
