@@ -1,0 +1,82 @@
+"""Hermetic virtual-CPU platform setup, shared by tests/conftest.py and
+__graft_entry__.dryrun_multichip.
+
+The deployment environment's sitecustomize pre-imports jax with
+JAX_PLATFORMS=axon (a single-chip TPU tunnel whose health must not affect
+CPU-only code paths), so "run on N virtual CPU devices" takes more than env
+vars: the platform must be forced through jax.config (the env var was read
+at import time), the axon/tpu backend factories dropped, and — if any client
+was already created in this process — the backends and dispatch caches
+cleared so the CPU client is rebuilt with the requested device count.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_devices(n_devices: int):
+    """Force jax onto a CPU platform with at least ``n_devices`` devices.
+
+    Safe to call whether or not jax backends were already initialized.
+    Returns the jax module. Raises RuntimeError if the platform cannot be
+    provisioned (never silently under-provisions — a 1-device run must not
+    report success for an 8-device request).
+    """
+    # Honor a larger preexisting override (e.g. a developer running the
+    # suite at 16 devices) — only ever grow the count.
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    want = max(n_devices, int(m.group(1)) if m else 0)
+    flag = f"--xla_force_host_platform_device_count={want}"
+    if m:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+    import jax
+
+    # Pallas registers MLIR lowerings for the "tpu" platform at import time,
+    # which needs the tpu backend factory still registered — import BEFORE
+    # dropping the factories (kernels then run in interpret mode on CPU).
+    # Broad except: an experimental plugin's registration failure must not
+    # take down CPU-only runs — pallas is simply unavailable then.
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        import jax.experimental.pallas.tpu  # noqa: F401
+    except Exception:
+        pass
+
+    import jax._src.xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        devs = jax.devices()
+        if devs and devs[0].platform == "cpu" and len(devs) >= n_devices:
+            return jax  # already satisfied — don't discard jit caches
+        # Public API: clears backend clients AND the dispatch/pjit caches
+        # that hold references to the old client (the private
+        # xb._clear_backends alone leaves get_backend's memo populated).
+        import jax.extend.backend as eb
+        eb.clear_backends()
+
+    jax.config.update("jax_platforms", "cpu")
+    for _plugin in ("axon", "tpu"):
+        xb._backend_factories.pop(_plugin, None)
+    # XLA_FLAGS may already have been parsed by an earlier client creation;
+    # the config state is the reliable knob (its validator only rejects
+    # changes while backends are initialized, and we just cleared them).
+    if jax.config.jax_num_cpu_devices < want:
+        jax.config.update("jax_num_cpu_devices", want)
+
+    devs = jax.devices()
+    if len(devs) < n_devices or devs[0].platform != "cpu":
+        raise RuntimeError(
+            f"hermetic CPU setup failed: got {len(devs)} "
+            f"{devs[0].platform if devs else '?'} devices, "
+            f"need {n_devices} cpu devices")
+    return jax

@@ -136,6 +136,11 @@ def dryrun(n_devices: int, options, batch_maker, vocab: int = 256) -> None:
     from ..optimizers.schedule import LRSchedule
 
     devices = jax.devices()[:n_devices]
+    if len(devices) != n_devices:
+        raise RuntimeError(
+            f"dryrun requested {n_devices} devices but the platform "
+            f"provides only {len(devices)} — refusing to silently "
+            f"under-provision")
     mesh = M.make_mesh(options, devices)
     model = create_model(options, vocab, vocab)
     params = model.init(jax.random.key(0))

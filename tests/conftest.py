@@ -8,38 +8,11 @@ Must set flags BEFORE jax initializes a backend, hence module-level here.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Tests must be hermetic CPU-only. If a TPU-tunnel PJRT plugin (axon) was
-# registered by sitecustomize at interpreter start, jax is already imported
-# and (a) the env-var JAX_PLATFORMS was read at import time, (b) backends()
-# would initialize the tunnel client, whose health must not affect tests.
-# Force the platform via jax.config and drop the plugin's backend factory
-# BEFORE any backend is initialized.
-import jax  # noqa: E402
+from marian_tpu.common.hermetic import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-
-# Pallas registers MLIR lowerings for the "tpu" platform at import time, which
-# requires the tpu backend factory to still be registered — import it BEFORE
-# dropping the factories (kernels then run in interpret mode on CPU).
-try:
-    import jax.experimental.pallas  # noqa: F401
-    import jax.experimental.pallas.tpu  # noqa: F401
-except Exception:
-    pass
-
-try:
-    import jax._src.xla_bridge as _xb
-    for _plugin in ("axon", "tpu"):
-        _xb._backend_factories.pop(_plugin, None)
-except Exception:
-    pass
+jax = force_cpu_devices(8)
 
 import numpy as np
 import pytest
