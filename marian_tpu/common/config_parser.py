@@ -95,6 +95,7 @@ _MODEL = [
     _f("transformer-dim-aan", int, 2048, "AAN FFN hidden size", "model"),
     _f("transformer-decoder-autoreg", str, "self-attention", "self-attention, average-attention, rnn", "model"),
     _f("transformer-flash-attention", str, "auto", "Pallas blockwise attention kernel: auto, on, off (TPU extension)", "model"),
+    _f("fused-ce", str, "auto", "Streaming fused softmax cross-entropy kernel (logit blocks stay in VMEM): auto (TPU only), on, off (TPU extension)", "model"),
     _f("transformer-tied-layers", int, [], "Tie decoder layers to these encoder layers", "model", "*"),
     _f("transformer-guided-alignment-layer", str, "last", "Decoder layer for guided alignment", "model"),
     _f("transformer-preprocess", str, "", "Per-sublayer preprocess ops: d=dropout, a=add(residual), n=layernorm", "model"),

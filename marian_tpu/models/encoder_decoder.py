@@ -43,6 +43,7 @@ class EncoderDecoder:
         self.model_type = options.get("type", "transformer")
         self.inference = inference
         self.label_smoothing = float(options.get("label-smoothing", 0.0) or 0.0)
+        self._fused_ce_mode = str(options.get("fused-ce", "auto") or "auto")
         self.guided_weight = float(options.get("guided-alignment-weight", 0.1))
         self.guided_cost = str(options.get("guided-alignment-cost", "ce"))
         ga = options.get("guided-alignment", "none")
@@ -97,14 +98,19 @@ class EncoderDecoder:
         enc_out = self._mod.encode(self.cfg, cparams, src_ids,
                                    src_mask, train, k_enc)
         want_align = self.use_guided and "guided" in batch
+        table = self._fused_ce_table(cparams)
+        kw = {"return_hidden": True} if table is not None else {}
         res = self._mod.decode_train(self.cfg, cparams, enc_out,
                                      src_mask, batch["trg_ids"],
                                      batch["trg_mask"], train, k_dec,
-                                     return_alignment=want_align)
-        logits, align = res if want_align else (res, None)
-        rl = cross_entropy_loss(logits, batch["trg_ids"], batch["trg_mask"],
-                                self.label_smoothing,
-                                batch.get("data_weights"))
+                                     return_alignment=want_align, **kw)
+        hidden, align = res if want_align else (res, None)
+        if table is not None:
+            rl = self._fused_ce_loss(cparams, table, hidden, batch)
+        else:
+            rl = cross_entropy_loss(hidden, batch["trg_ids"],
+                                    batch["trg_mask"], self.label_smoothing,
+                                    batch.get("data_weights"))
         total = rl.loss_sum
         aux = {"ce_sum": rl.loss_sum, "labels": rl.labels}
         if want_align and align is not None:
@@ -113,6 +119,57 @@ class EncoderDecoder:
             total = total + self.guided_weight * ga * rl.labels
             aux["guided"] = ga
         return total, aux
+
+    # -- fused streaming CE (ops/pallas/fused_ce.py) ------------------------
+    def _fused_ce_table(self, cparams):
+        """[V, E] output table when the streaming fused CE applies, else None
+        (→ dense logits + layers/loss.py). Applies for plain-tensor output
+        projections of the transformer family; factored/quantized vocabs and
+        non-TPU backends (unless --fused-ce on) use the dense path."""
+        if self._fused_ce_mode == "off" or self._mod is not T:
+            return None
+        if self._fused_ce_mode == "auto" and jax.default_backend() != "tpu":
+            return None
+        cfg = self.cfg
+        if getattr(cfg, "trg_factors", None) is not None:
+            return None
+        from ..ops.quantization import QTensor
+        from ..ops.pallas.fused_ce import fused_available
+        if not fused_available(int(cfg.dim_emb)):
+            return None
+        if cfg.tied_embeddings_all:
+            t = cparams.get("Wemb")
+        elif cfg.tied_embeddings:
+            t = cparams.get("Wemb", cparams.get("decoder_Wemb"))
+        else:
+            w = cparams.get("decoder_ff_logit_out_W")
+            if w is None or isinstance(w, QTensor):
+                return None
+            return w.T                     # [E, V] → table orientation
+        if t is None or isinstance(t, QTensor):
+            return None
+        return t
+
+    def _fused_ce_loss(self, cparams, table, hidden, batch) -> RationalLoss:
+        """Label-smoothed CE straight from decoder hidden states — logits
+        blocks live only in VMEM (same numbers as cross_entropy_loss of
+        output_logits; see fused_ce.py docstring for the algebra)."""
+        from ..ops.pallas.fused_ce import fused_softmax_xent
+        b, t, e = hidden.shape
+        bias = cparams["decoder_ff_logit_out_b"].reshape(-1)
+        ce = fused_softmax_xent(
+            hidden.reshape(b * t, e), table, bias,
+            batch["trg_ids"].reshape(-1), self.label_smoothing,
+            interpret=None if self._fused_ce_mode == "auto" else
+            (jax.default_backend() != "tpu"))
+        ce = ce.reshape(b, t)
+        mask = batch["trg_mask"]
+        w = mask.astype(jnp.float32)
+        dw = batch.get("data_weights")
+        if dw is not None:
+            w = w * jnp.broadcast_to(dw.astype(jnp.float32), w.shape)
+        return RationalLoss(jnp.sum(ce * w),
+                            jnp.sum(mask.astype(jnp.float32)))
 
     def _batch_sources(self, batch):
         """Collect source streams from a batch dict: 'src_ids'/'src_mask'

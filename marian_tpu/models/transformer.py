@@ -309,6 +309,57 @@ def _merge_heads(x: jax.Array) -> jax.Array:
     return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
 
 
+@jax.custom_vjp
+def _bias_add_bhtd(y: jax.Array, b4: jax.Array) -> jax.Array:
+    return y + b4
+
+
+def _bias_add_bhtd_fwd(y, b4):
+    return y + b4, None
+
+
+def _bias_add_bhtd_bwd(res, g):
+    # db as a dot (ones contraction over batch+time) instead of the 4-D
+    # reduce XLA emits, which lowers to a slow transpose+reduce on TPU (the
+    # round-1 profile showed 54 such reduces costing ~10% of the step).
+    bb, h, t, d = g.shape
+    ones = jnp.ones((bb, t), g.dtype)
+    db = jax.lax.dot_general(ones, g, (((0, 1), (0, 2)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return g, db[None, :, None, :].astype(g.dtype)
+
+
+_bias_add_bhtd.defvjp(_bias_add_bhtd_fwd, _bias_add_bhtd_bwd)
+
+
+def _proj_heads(x: jax.Array, w, b, heads: int) -> jax.Array:
+    """affine + split_heads in ONE dot: 'bte,ehd->bhtd'. The [B,T,H,Dh] →
+    [B,H,T,Dh] transpose becomes the matmul's output layout instead of a
+    physical copy — the round-1 profile showed those copies ("data
+    formatting") costing >10% of the train step. Identical numerics to
+    _split_heads(affine(...)): the weight reshape splits output columns
+    head-major exactly like the activation reshape did."""
+    e = w.shape[0]
+    dh = w.shape[1] // heads
+    y = jnp.einsum("bte,ehd->bhtd", x, w.reshape(e, heads, dh),
+                   preferred_element_type=x.dtype)
+    if b is not None:
+        y = _bias_add_bhtd(y, b.reshape(1, heads, 1, dh).astype(y.dtype))
+    return y
+
+
+def _unproj_heads(x: jax.Array, w, b) -> jax.Array:
+    """merge_heads + output affine in ONE dot: 'bhtd,hde->bte' (see
+    _proj_heads)."""
+    h, dh = x.shape[1], x.shape[3]
+    e = w.shape[1]
+    y = jnp.einsum("bhtd,hde->bte", x, w.reshape(h, dh, e),
+                   preferred_element_type=x.dtype)
+    if b is not None:
+        y = y + b.reshape(1, 1, e).astype(y.dtype)
+    return y
+
+
 def _mha(cfg: TransformerConfig, params: Params, prefix: str,
          q_in: jax.Array, kv_in: jax.Array, mask: Optional[jax.Array],
          key, train: bool,
@@ -323,13 +374,22 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
     cache (self-attn): dict with 'k','v' [B,H,L,Dh]; new K/V written at
     cache_pos. static_kv (cross-attn): K/V precomputed in cache, reused.
     """
+    from ..ops.quantization import QTensor
+
     h = cfg.heads
-    q = _split_heads(affine(q_in, params[f"{prefix}_Wq"], params[f"{prefix}_bq"]), h)
+
+    def proj(x, wname, bname):
+        w, b = params[wname], params[bname]
+        if isinstance(w, QTensor):  # int8 decode weights: affine handles them
+            return _split_heads(affine(x, w, b), h)
+        return _proj_heads(x, w, b, h)
+
+    q = proj(q_in, f"{prefix}_Wq", f"{prefix}_bq")
     if static_kv and cache is not None:
         k_, v_ = cache["k"], cache["v"]
     else:
-        k_ = _split_heads(affine(kv_in, params[f"{prefix}_Wk"], params[f"{prefix}_bk"]), h)
-        v_ = _split_heads(affine(kv_in, params[f"{prefix}_Wv"], params[f"{prefix}_bv"]), h)
+        k_ = proj(kv_in, f"{prefix}_Wk", f"{prefix}_bk")
+        v_ = proj(kv_in, f"{prefix}_Wv", f"{prefix}_bv")
         if cache is not None and cache_pos is not None:
             # write this step's K/V into the fixed-size cache at position pos
             k_ = jax.lax.dynamic_update_slice(
@@ -367,10 +427,12 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
             dropout_rate=cfg.attention_dropout, dropout_key=dk,
             deterministic=not train, return_weights=return_weights,
             flash=cfg.flash_attention)
-    out = _merge_heads(out)
-    if not cfg.no_projection:
-        out = affine(out, params[f"{prefix}_Wo"], params[f"{prefix}_bo"])
-    return out, weights
+    if cfg.no_projection:
+        return _merge_heads(out), weights
+    wo, bo = params[f"{prefix}_Wo"], params[f"{prefix}_bo"]
+    if isinstance(wo, QTensor):
+        return affine(_merge_heads(out), wo, bo), weights
+    return _unproj_heads(out, wo, bo), weights
 
 
 def _ffn(cfg: TransformerConfig, params: Params, prefix: str, x: jax.Array,
@@ -527,8 +589,11 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
                  src_mask: jax.Array, trg_ids: jax.Array,
                  trg_mask: jax.Array, train: bool = True,
                  key: Optional[jax.Array] = None,
-                 return_alignment: bool = False):
-    """Teacher-forced decoder: [B, Tt] gold target ids → [B, Tt, V] logits.
+                 return_alignment: bool = False,
+                 return_hidden: bool = False):
+    """Teacher-forced decoder: [B, Tt] gold target ids → [B, Tt, V] logits
+    (or the pre-logits hidden states when return_hidden — the fused-CE path
+    computes the output projection inside its streaming kernel).
     Input embeddings are the gold embeddings shifted right with a zero vector
     at t=0 (reference: TransformerDecoder::step on full groundTruth)."""
     kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
@@ -578,10 +643,10 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
                       f"decoder_l{l}_ffn_ffn", params, lk3, train)
     x = _pre_post(cfg, cfg.postprocess_top, x, None, "decoder_top", params,
                   kk(9999), train)
-    logits = output_logits(cfg, params, x)
+    out = x if return_hidden else output_logits(cfg, params, x)
     if return_alignment:
-        return logits, align
-    return logits
+        return out, align
+    return out
 
 
 def _is_alignment_layer(cfg: TransformerConfig, l: int) -> bool:

@@ -17,6 +17,61 @@ jax = force_cpu_devices(8)
 import numpy as np
 import pytest
 
+# ---------------------------------------------------------------------------
+# Test tiers. `pytest -m "not slow"` is the fast tier (CI-on-every-commit,
+# target <3 min on CPU); `pytest` runs everything (the TP/SP sweeps and
+# end-to-end training runs take several minutes more). Centralized here so
+# the tier stays visible in one place; names are test functions (parametrized
+# variants inherit).
+# ---------------------------------------------------------------------------
+
+SLOW_TESTS = {
+    # multi-device sweeps (tests/test_parallel_tp_sp.py, test_distributed.py)
+    "test_ring_is_differentiable",
+    "test_dryrun_multichip_8",
+    "test_tp_sp_matches_single_device_loss",
+    "test_sp_training_step_matches_dense",
+    "test_ring_grad_finite_with_empty_rows",
+    "test_matches_dense",
+    "test_8dev_matches_1dev_trajectory",
+    # end-to-end training runs (test_training.py)
+    "test_exact_resume",
+    "test_optimizer_delay_equivalent_to_big_batch",
+    "test_loss_decreases_and_decodes",
+    "test_ema_saved",
+    "test_sigterm_like_save",
+    "test_progress_state_counts",
+    # heavier model/decoder correctness (several-second jit compiles each)
+    "test_step_matches_teacher_forcing",
+    "test_forward_shapes_and_dtype",
+    "test_grad_matches_finite_difference",
+    "test_loss_finite_and_grads_flow",
+    "test_teacher_forcing_matches_incremental",
+    "test_param_names",
+    "test_learns_first_token_rule",
+    "test_mlm_training_reduces_loss",
+    "test_bert_pretraining_e2e",
+    "test_loss_finite_and_masking_rate",
+    "test_matches_reference_beam",
+    "test_normalized_matches_reference",
+    "test_beam1_equals_greedy",
+    "test_ensemble_of_identical_models_is_identity",
+    "test_loss_uses_both_sources",
+    "test_translator_builds_all_encoders",
+    "test_params_have_two_encoders_and_two_context_blocks",
+    "test_second_source_changes_output",
+    "test_loss_and_grads",
+    "test_train_with_native_backend",
+    "test_convert_and_decode",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.name.split("[")[0]
+        if base in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture
 def rng():
