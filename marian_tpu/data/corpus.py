@@ -56,11 +56,18 @@ class CorpusState:
     seed: int = 1
 
     def as_dict(self):
-        return dataclasses.asdict(self)
+        # Positions are backend-specific: python counts raw corpus lines,
+        # native indexes its length-filtered order. The tag lets resume
+        # detect a --data-backend switch instead of silently seeking to the
+        # wrong sentence (ADVICE r1).
+        return {**dataclasses.asdict(self), "backend": "python"}
 
     @classmethod
     def from_dict(cls, d):
-        return cls(**d) if d else cls()
+        if not d:
+            return cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 class Corpus:

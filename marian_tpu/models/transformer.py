@@ -304,6 +304,22 @@ def _split_heads(x: jax.Array, heads: int) -> jax.Array:
     return x.reshape(b, t, heads, d // heads).transpose(0, 2, 1, 3)
 
 
+_SP_FALLBACK_WARNED: set = set()
+
+
+def _warn_sp_fallback(reason: str) -> None:
+    """One-time (per reason) warning when --sequence-parallel is configured
+    but a shape/dropout gate silently routes attention to the dense path —
+    otherwise SP can be a no-op with its memory benefit lost and no signal
+    (ADVICE r1). Runs at trace time, so it fires once per compiled shape."""
+    if reason in _SP_FALLBACK_WARNED:
+        return
+    _SP_FALLBACK_WARNED.add(reason)
+    from ..common.logging import log
+    log.warn("sequence-parallel configured but falling back to dense "
+             "attention: {}", reason)
+
+
 def _merge_heads(x: jax.Array) -> jax.Array:
     b, h, t, dh = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
@@ -403,19 +419,31 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
     # the time dimension stays sharded end-to-end (parallel/sequence.py)
     n_seq = cfg.seq_mesh.shape.get("seq", 1) if cfg.seq_mesh is not None else 1
     n_model = cfg.seq_mesh.shape.get("model", 1) if cfg.seq_mesh is not None else 1
-    if (cfg.sequence_parallel != "none" and n_seq > 1
-            and cache is None and not return_weights
-            and q.shape[-2] > 1
-            # shard_map needs even splits: time dims over 'seq', heads over
-            # 'model' (length buckets guarantee this only up to seq<=8 —
-            # fall back to dense/GSPMD otherwise)
-            and q.shape[-2] % n_seq == 0 and k_.shape[-2] % n_seq == 0
-            and q.shape[1] % max(n_model, 1) == 0
-            and q.shape[0] % max(cfg.seq_mesh.shape.get("data", 1), 1) == 0
-            # ulysses swaps heads<->seq: per-device heads must split over seq
-            and (cfg.sequence_parallel != "ulysses"
-                 or (q.shape[1] // max(n_model, 1)) % n_seq == 0)
-            and (cfg.attention_dropout == 0.0 or not train)):
+    sp_wanted = (cfg.sequence_parallel != "none" and n_seq > 1
+                 and cache is None and not return_weights and q.shape[-2] > 1)
+    sp_fallback = None
+    if sp_wanted:
+        # shard_map needs even splits: time dims over 'seq', heads over
+        # 'model' (length buckets guarantee this only up to seq<=8 —
+        # fall back to dense/GSPMD otherwise)
+        if q.shape[-2] % n_seq != 0 or k_.shape[-2] % n_seq != 0:
+            sp_fallback = (f"sequence length ({q.shape[-2]}/{k_.shape[-2]}) "
+                           f"not divisible by seq={n_seq}")
+        elif q.shape[1] % max(n_model, 1) != 0:
+            sp_fallback = f"heads ({q.shape[1]}) not divisible by model={n_model}"
+        elif q.shape[0] % max(cfg.seq_mesh.shape.get("data", 1), 1) != 0:
+            sp_fallback = (f"batch ({q.shape[0]}) not divisible by "
+                           f"data={cfg.seq_mesh.shape.get('data', 1)}")
+        elif (cfg.sequence_parallel == "ulysses"
+              # ulysses swaps heads<->seq: per-device heads split over seq
+              and (q.shape[1] // max(n_model, 1)) % n_seq != 0):
+            sp_fallback = (f"ulysses needs per-device heads "
+                           f"({q.shape[1]}//{n_model}) divisible by seq={n_seq}")
+        elif cfg.attention_dropout != 0.0 and train:
+            sp_fallback = "attention dropout is active in training"
+        if sp_fallback is not None:
+            _warn_sp_fallback(sp_fallback)
+    if sp_wanted and sp_fallback is None:
         from ..parallel.sequence import ring_attention_sharded
         out = ring_attention_sharded(cfg.seq_mesh, q, k_, v_,
                                      kv_mask=kv_mask, causal=causal,

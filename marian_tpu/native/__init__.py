@@ -195,7 +195,7 @@ class NativeBatchGenerator:
         """CorpusState-compatible snapshot for the training checkpoint."""
         return {"epoch": self.epoch,
                 "position": int(self._lib.mtd_position(self._h)),
-                "seed": self._seed}
+                "seed": self._seed, "backend": "native"}
 
     # -- resume ---------------------------------------------------------------
     def seek(self, epoch: int, position: int,

@@ -244,14 +244,33 @@ int64_t mtd_load_corpus(void* vh, const char** paths, int max_length,
     sent.idx = idx;
     sent.streams.resize(h->n_streams);
     bool eof = false;
+    int eof_stream = -1;
     for (int s = 0; s < h->n_streams; ++s) {
       if (!std::getline(fhs[s], line)) {
         eof = true;
+        eof_stream = s;
         break;
       }
       tokenize_line(line, h->vocabs[s], &sent.streams[s]);
     }
-    if (eof) break;
+    if (eof) {
+      // Parallel streams must end together, like the Python Corpus
+      // ("Corpus streams differ in length"). A stream hitting EOF after an
+      // earlier stream yielded a line this iteration, or any remaining
+      // stream still having lines, means misaligned corpora — error out
+      // instead of silently training on a truncated prefix.
+      if (eof_stream > 0) {
+        h->error = "Corpus streams differ in length";
+        return -1;
+      }
+      for (int s = 1; s < h->n_streams; ++s) {
+        if (std::getline(fhs[s], line)) {
+          h->error = "Corpus streams differ in length";
+          return -1;
+        }
+      }
+      break;
+    }
     bool ok = true;
     for (auto& st : sent.streams) {
       if (max_length > 0 && static_cast<int>(st.size()) > max_length) {

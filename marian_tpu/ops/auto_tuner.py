@@ -12,6 +12,7 @@ the v5e-measured ~1k crossover)."""
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -100,6 +101,8 @@ def calibrate_flash_attention(heads: int = 8, dim_head: int = 64,
         })
         if name == "flash" and crossover is None:
             crossover = t
+    # No crossover measured → flash lost at every tested length; disable it
+    # for 'auto' outright rather than extrapolating a win past the sweep.
     _calibrated_threshold = crossover if crossover is not None \
-        else max(lengths) * 2
+        else sys.maxsize
     return _calibrated_threshold

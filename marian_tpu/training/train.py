@@ -72,6 +72,19 @@ class Train:
             if loaded_state is not None:
                 state = loaded_state
                 if not opts.get("no-restore-corpus", False) and state.corpus:
+                    saved_be = state.corpus.get("backend")
+                    active_be = "native" if native_bg is not None else "python"
+                    if saved_be is not None and saved_be != active_be:
+                        # positions are not portable across backends (python
+                        # counts raw lines, native its filtered order) —
+                        # restart the epoch rather than seek to the wrong
+                        # sentence (ADVICE r1)
+                        log.warn(
+                            "Corpus state was saved by the '{}' data backend "
+                            "but '{}' is active; restarting epoch {} from "
+                            "the beginning", saved_be, active_be,
+                            state.corpus.get("epoch"))
+                        state.corpus = {**state.corpus, "position": 0}
                     corpus.restore(state.corpus)
                     if native_bg is not None:
                         native_bg.seek(int(state.corpus.get("epoch", 1) or 1),

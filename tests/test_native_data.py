@@ -121,6 +121,37 @@ class TestNativeMatchesPython:
         assert replay == all_ids[2:]
 
 
+class TestMisalignedStreams:
+    @pytest.mark.parametrize("extra_on", ["src", "tgt"])
+    def test_native_raises_like_python(self, tmp_path, extra_on):
+        """Parallel files of unequal length must raise, not silently
+        truncate (ADVICE r1 medium: native loader stopped at first EOF)."""
+        src_lines = ["a b", "b c", "c d"]
+        tgt_lines = ["x y", "y z", "z w"]
+        (src_lines if extra_on == "src" else tgt_lines).append("extra line")
+        src = tmp_path / "m.src"; src.write_text("\n".join(src_lines) + "\n")
+        tgt = tmp_path / "m.tgt"; tgt.write_text("\n".join(tgt_lines) + "\n")
+        vs = DefaultVocab.build(src_lines)
+        vt = DefaultVocab.build(tgt_lines)
+        with pytest.raises(Exception, match="differ in length"):
+            native.NativeBatchGenerator([str(src), str(tgt)], [vs, vt], None,
+                                        mini_batch=2, shuffle=False)
+
+
+class TestBackendTag:
+    def test_state_dicts_tagged(self, corpus_files):
+        src, tgt, vs, vt = corpus_files
+        bg = native.NativeBatchGenerator([src, tgt], [vs, vt], None,
+                                         mini_batch=4, shuffle=False)
+        assert bg.state_dict()["backend"] == "native"
+        opts = Options({"max-length": 50, "shuffle": "none"})
+        corpus = Corpus([src, tgt], [vs, vt], opts)
+        assert corpus.state.as_dict()["backend"] == "python"
+        # round trip: python restore tolerates the tag (and native's)
+        corpus.restore(corpus.state.as_dict())
+        corpus.restore(bg.state_dict())
+
+
 class TestNativeTrainCLI:
     def test_train_with_native_backend(self, tmp_path):
         from marian_tpu.cli import marian_train
