@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
+import re
 import sys
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -154,7 +156,6 @@ _TRAINING = [
     _f("mini-batch-words", int, 0, "Minibatch size in target labels (token budget)", "training"),
     _f("mini-batch-fit", bool, False, "Determine minibatch automatically from workspace (TPU: bucket table)", "training"),
     _f("mini-batch-fit-step", int, 10, "Step for mini-batch-fit search", "training"),
-    _f("gradient-checkpointing-unused", bool, False, "(reserved)", "training"),
     _f("maxi-batch", int, 100, "Number of minibatches to preload and sort", "training"),
     _f("maxi-batch-sort", str, "trg", "Sorting within maxi-batch: trg, src, none", "training"),
     _f("shuffle-in-ram", bool, False, "Shuffle corpus in RAM instead of temp files", "training"),
@@ -356,11 +357,20 @@ class ConfigParser:
         merged = self.defaults()
 
         # layer 2: config file(s)
+        explicit = set(cli.keys())       # keys the user actually provided
         for path in _as_list(cli.get("config")):
             with open(path, "r", encoding="utf-8") as fh:
                 loaded = yaml.safe_load(fh) or {}
+            interp = loaded.get("interpolate-env-vars",
+                                cli.get("interpolate-env-vars", False))
+            if interp:
+                loaded = _interpolate_env_vars(loaded)
+            if loaded.get("relative-paths", cli.get("relative-paths", False)):
+                loaded = _make_paths_absolute(loaded, os.path.dirname(
+                    os.path.abspath(path)))
             for k, v in loaded.items():
                 merged[str(k)] = v
+                explicit.add(str(k))
 
         # layer 3: alias expansion (--task / from config), before CLI overrides
         task = cli.get("task", merged.get("task"))
@@ -376,8 +386,35 @@ class ConfigParser:
 
         if merged.get("no-shuffle"):
             merged["shuffle"] = "none"
+        # bare `--output-sampling` (Marian shorthand) = full sampling, temp 1
+        if cli.get("output-sampling") == []:
+            merged["output-sampling"] = ["full"]
+        if cli.get("interpolate-env-vars") or merged.get("interpolate-env-vars"):
+            merged = _interpolate_env_vars(merged)
+
+        # mode-suffixed duplicates and synonyms → the canonical key runtime
+        # code reads (the suffixed names exist because translate/scorer modes
+        # share one flag registry with training); config-file values count
+        # as explicit too, and the canonical key wins if the user set both
+        for alias, (canon, modes, vmap) in _CANONICAL.items():
+            if modes is not None and self.mode not in modes:
+                continue
+            if alias in explicit and canon not in explicit:
+                val = merged[alias]
+                if vmap is not None:
+                    if str(val) not in vmap:
+                        raise SystemExit(
+                            f"--{alias}: unknown value '{val}' "
+                            f"(expected one of {sorted(vmap)})")
+                    val = vmap[str(val)]
+                merged[canon] = val
 
         opts = Options(merged)
+
+        for meta in ("authors", "cite", "build-info", "version"):
+            if cli.get(meta):
+                print(_META_TEXT[meta]())
+                raise SystemExit(0)
 
         dump = cli.get("dump-config") or (True if "dump-config" in cli else None)
         if dump:
@@ -397,6 +434,214 @@ class ConfigParser:
         yaml.safe_dump(data, stream, default_flow_style=False, sort_keys=True)
 
 
+# Mode-suffixed duplicates / synonyms → the canonical key runtime code
+# reads: alias → (canonical, applicable modes or None for all, value map or
+# None for identity). The mode gate matters: in training mode the
+# translate-suffixed names configure the validation decoder only and must
+# NOT clobber the training-side canonical keys (e.g. the token budget).
+_CANONICAL = {
+    "max-length-factor-translate":
+        ("max-length-factor", ("translation", "scoring"), None),
+    "mini-batch-words-translate":
+        ("mini-batch-words", ("translation", "scoring"), None),
+    "normalize-scorer": ("normalize", ("scoring",), None),
+    "train-sets-scorer": ("train-sets", ("scoring",), None),
+    "attention-kernel":
+        ("transformer-flash-attention", None,
+         {"auto": "auto", "dense": "off", "flash": "on"}),
+}
+
+_META_TEXT = {
+    "authors": lambda: "marian-tpu contributors (TPU-native rebuild of the "
+                       "Marian NMT toolkit; reference authors: Junczys-"
+                       "Dowmunt et al., see --cite)",
+    "cite": lambda: ("@inproceedings{junczys2018marian,\n"
+                     "  title={Marian: Fast Neural Machine Translation in "
+                     "C++},\n  author={Junczys-Dowmunt, Marcin and others},\n"
+                     "  booktitle={Proceedings of ACL 2018, System "
+                     "Demonstrations},\n  year={2018}\n}"),
+    "build-info": lambda: _build_info(),
+    "version": lambda: "marian-tpu v0.1.0 (jax %s)" % __import__("jax").__version__,
+}
+
+
+def _build_info() -> str:
+    import platform
+    try:
+        import jax
+        backend = jax.default_backend()
+        jv = jax.__version__
+    except Exception:  # pragma: no cover
+        backend, jv = "?", "?"
+    return (f"marian-tpu 0.1.0; python {platform.python_version()}; "
+            f"jax {jv}; backend {backend}")
+
+
+_ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+def _interpolate_env_vars(obj: Any) -> Any:
+    """${ENV_VAR} substitution in string config values (reference:
+    cli::interpolateEnvVars)."""
+    if isinstance(obj, str):
+        return _ENV_RE.sub(lambda m: os.environ.get(m.group(1), m.group(0)), obj)
+    if isinstance(obj, list):
+        return [_interpolate_env_vars(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _interpolate_env_vars(v) for k, v in obj.items()}
+    return obj
+
+
+# Config keys holding filesystem paths, for --relative-paths (reference:
+# cli::makeAbsolutePaths / ConfigParser's PATHS list).
+_PATH_KEYS = {
+    "model", "models", "pretrained-model", "train-sets", "vocabs",
+    "valid-sets", "valid-script-path", "valid-translation-output",
+    "valid-log", "log", "sqlite", "shortlist", "embedding-vectors",
+    "guided-alignment", "data-weighting", "input", "output", "tempdir",
+    "ulr-keys-vectors", "ulr-query-vectors", "train-embedder-rank",
+}
+
+
+def _make_paths_absolute(cfg: Dict[str, Any], base: str) -> Dict[str, Any]:
+    def fix(v):
+        if isinstance(v, str) and v and not os.path.isabs(v) \
+                and v not in ("stdin", "stdout", "stderr", "-"):
+            return os.path.normpath(os.path.join(base, v))
+        return v
+
+    out = dict(cfg)
+    for k in _PATH_KEYS & set(out.keys()):
+        v = out[k]
+        if isinstance(v, list):
+            # e.g. shortlist is [path, k, ...]: only fix path-looking strings
+            out[k] = [fix(x) if isinstance(x, str) and not str(x).isdigit()
+                      else x for x in v]
+        else:
+            out[k] = fix(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Unimplemented-flag audit (reference parity rule: same behavior per flag —
+# accept-and-silently-ignore is never allowed; VERDICT r1). Every flag that
+# is parsed but has no runtime reader is registered here with an action:
+#   warn  — the TPU design makes it unnecessary or a safe no-op; a one-line
+#           rationale is logged when the user sets it to a non-default value
+#   error — honoring it would require semantics we don't provide; training or
+#           decoding would silently differ, so refuse to run
+# Implementing a flag removes it from this table (tests assert every parsed
+# flag is either read somewhere in the package or listed here).
+# ---------------------------------------------------------------------------
+
+UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
+    # -- safe no-ops under the TPU/XLA design --
+    "workspace": ("warn", "XLA owns device memory; batch fitting uses the "
+                          "bucket table (data/batch_generator.py)"),
+    "cpu-threads": ("warn", "host threading is managed by XLA/the runtime"),
+    "data-threads": ("warn", "the data pipeline prefetches asynchronously; "
+                             "thread count is not user-tunable"),
+    "no-nccl": ("warn", "collectives are XLA GSPMD over ICI/DCN, not NCCL"),
+    "sync-freq": ("warn", "parameter sync is every step under GSPMD data "
+                          "parallelism (no stale local copies exist)"),
+    "multi-node-overlap": ("warn", "XLA overlaps collectives with compute "
+                                   "automatically"),
+    "tempdir": ("warn", "corpus shuffling happens in RAM; no temp files"),
+    "log-time-zone": ("warn", "log timestamps use the process-local time "
+                              "zone; set TZ in the environment instead"),
+    "mini-batch-fit-step": ("warn", "bucketed static shapes replace the "
+                                    "binary batch-fitting search"),
+    "mini-batch-round-up": ("warn", "bucket table already snaps batch sizes "
+                                    "to hardware-friendly multiples"),
+    "cost-scaling": ("warn", "bf16 training keeps gradients in f32 master "
+                             "range; dynamic loss scaling (an fp16 "
+                             "necessity) has nothing to rescue"),
+    "fuse": ("warn", "XLA fuses elementwise chains into matmuls "
+                     "automatically"),
+    "sharding": ("warn", "optimizer state is ZeRO-1 sharded over the full "
+                         "'data' mesh axis; there is no node-local NVLink "
+                         "domain to restrict to on ICI"),
+    "shuffle-in-ram": ("warn", "the corpus always shuffles in RAM"),
+    "sqlite": ("warn", "the resumable in-RAM corpus replaces the SQLite "
+                       "shuffle database; positions checkpoint in "
+                       "progress.yml"),
+    "best-deep": ("warn", "s2s depth/variant comes from --type and the "
+                          "dim/depth flags directly"),
+    "skip-cost": ("warn", "hypothesis scores fall out of the beam at no "
+                          "extra cost; there is nothing to skip"),
+    "scan-layers": ("warn", "lax.scan over the layer stack is not wired "
+                            "yet; layers are unrolled"),
+    "bert-sep-symbol": ("warn", "sentence-pair assembly takes the token "
+                                "streams as given; separators are not "
+                                "re-inserted by the pipeline"),
+    "bert-class-symbol": ("warn", "classifier pooling uses the first "
+                                  "position; the symbol itself is not "
+                                  "re-inserted by the pipeline"),
+    "interpolate-env-vars": ("none", "handled at config load"),
+    "relative-paths": ("none", "handled at config load"),
+    # -- would silently change training/decoding semantics: refuse --
+    "mini-batch-warmup": ("error", "dynamic batch-size ramp-up is not "
+                                   "implemented"),
+    "mini-batch-track-lr": ("error", "batch-size-tracking LR is not "
+                                     "implemented"),
+    "embedding-vectors": ("error", "pretrained embedding import is not "
+                                   "implemented"),
+    "embedding-normalization": ("error", "embedding normalization is not "
+                                         "implemented"),
+    "transformer-tied-layers": ("error", "cross-layer parameter tying is "
+                                         "not implemented"),
+    "transformer-pool": ("error", "pooled attention variant is not "
+                                  "implemented"),
+    "unlikelihood-loss": ("error", "unlikelihood loss is not implemented"),
+    "force-decode": ("error", "constrained decoding is not implemented"),
+    "factor-weight": ("error", "factor loss re-weighting is not implemented"),
+    "factors-combine": ("error-unless", "sum", "only sum-combination of "
+                                              "factor embeddings"),
+    "factors-dim-emb": ("error", "concatenative factor embeddings are not "
+                                 "implemented (sum combine only)"),
+    "lemma-dim-emb": ("error", "lemma re-embedding is not implemented"),
+    "ulr": ("error", "ULR embeddings are not implemented"),
+    "ulr-dim-emb": ("error", "ULR embeddings are not implemented"),
+    "ulr-dropout": ("error", "ULR embeddings are not implemented"),
+    "ulr-keys-vectors": ("error", "ULR embeddings are not implemented"),
+    "ulr-query-vectors": ("error", "ULR embeddings are not implemented"),
+    "ulr-softmax-temperature": ("error", "ULR embeddings are not "
+                                         "implemented"),
+    "ulr-trainable-transformation": ("error", "ULR embeddings are not "
+                                              "implemented"),
+    "output-approx-knn": ("error", "the LSH output shortlist is not "
+                                   "implemented"),
+}
+
+
+def audit_flags(opts: Options, parser: "ConfigParser") -> None:
+    """Warn or refuse for parsed-but-unimplemented flags the user actually
+    set (compared against the registry defaults)."""
+    from . import logging as log
+    for name, spec in UNIMPLEMENTED_FLAGS.items():
+        f = parser.flags.get(name)
+        if f is None or not opts.has(name):
+            continue
+        val = opts.get(name)
+        if val == f.default or val in (None, [], False, "", 0, 0.0):
+            continue
+        action = spec[0]
+        if action == "none":
+            continue
+        if action == "error-unless":
+            allowed, why = spec[1], spec[2]
+            if val == allowed:
+                continue
+            raise ValueError(f"--{name} {val}: {why} is supported")
+        why = spec[1]
+        if action == "error":
+            raise ValueError(
+                f"--{name} is accepted for Marian config compatibility but "
+                f"its semantics are not implemented ({why}); refusing to "
+                f"silently ignore it")
+        log.warn("--{} has no effect on TPU: {}", name, why)
+
+
 def _parse_bool(v: Any) -> bool:
     if isinstance(v, bool):
         return v
@@ -414,8 +659,10 @@ def _as_list(v: Any) -> List[Any]:
 def parse_options(argv: Optional[Sequence[str]] = None, mode: str = "training",
                   validate: bool = True) -> Options:
     """Module-level convenience mirroring ConfigParser::parseOptions."""
-    opts = ConfigParser(mode).parse(argv)
+    parser = ConfigParser(mode)
+    opts = parser.parse(argv)
     if validate:
         from .config_validator import validate_options
         validate_options(opts, mode)
+        audit_flags(opts, parser)
     return opts

@@ -37,6 +37,11 @@ def create_loggers(options=None) -> None:
     """Set up 'general' and 'valid' loggers from Options (or defaults)."""
     global _initialized
     quiet = bool(options and options.get("quiet", False))
+    # --quiet-translation: only the translation drivers pass mode hints;
+    # suppress stderr info chatter while still honoring --log files
+    if options and options.get("quiet-translation", False) \
+            and options.get("_translation_task", False):
+        quiet = True
     level = _LEVELS.get((options.get("log-level", "info") if options else "info"), logging.INFO)
     log_file: Optional[str] = options.get("log", None) if options else None
     valid_file: Optional[str] = options.get("valid-log", None) if options else None

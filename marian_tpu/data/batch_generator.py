@@ -111,7 +111,8 @@ def make_batch(tuples: Sequence[SentenceTuple], n_streams: int,
                length_buckets=DEFAULT_LENGTH_BUCKETS,
                batch_multiple: int = 8,
                pad_batch: bool = True,
-               corpus_state: Optional[dict] = None) -> CorpusBatch:
+               corpus_state: Optional[dict] = None,
+               weighting_type: Optional[str] = None) -> CorpusBatch:
     """Pad a list of SentenceTuples into one fixed-shape CorpusBatch."""
     n = len(tuples)
     bsz = bucket_batch_size(n, batch_multiple) if pad_batch else n
@@ -141,7 +142,13 @@ def make_batch(tuples: Sequence[SentenceTuple], n_streams: int,
     weights = None
     if any(t.weights is not None for t in tuples):
         tw = subs[-1].ids.shape[1]
-        word_level = any(t.weights is not None and len(t.weights) > 1 for t in tuples)
+        # --data-weighting-type declares the level explicitly; without it,
+        # infer word-level from multi-valued weight lines
+        if weighting_type in ("word", "sentence"):
+            word_level = weighting_type == "word"
+        else:
+            word_level = any(t.weights is not None and len(t.weights) > 1
+                             for t in tuples)
         if word_level:
             weights = np.ones((bsz, tw), dtype=np.float32)
             for b, t in enumerate(tuples):
@@ -176,6 +183,11 @@ class BatchGenerator:
             seed = int(options.get("seed", seed)) or seed
             if shuffle_batches is None:
                 shuffle_batches = options.get("shuffle", "data") in ("data", "batches")
+        self.weighting_type = (str(options.get("data-weighting-type",
+                                               "sentence"))
+                               if options is not None
+                               and options.get("data-weighting", None)
+                               else None)
         self.mini_batch = max(1, mini_batch)
         self.mini_batch_words = mini_batch_words
         self.maxi_batch = max(1, maxi_batch)
@@ -204,7 +216,8 @@ class BatchGenerator:
             if cur:
                 batches.append(make_batch(cur, self.n_streams, self.length_buckets,
                                           self.batch_multiple, self.pad_batch,
-                                          corpus_state=state))
+                                          corpus_state=state,
+                                          weighting_type=self.weighting_type))
 
         for t in buf:
             lens = [len(s) for s in t.streams]

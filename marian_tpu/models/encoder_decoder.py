@@ -45,6 +45,8 @@ class EncoderDecoder:
         self.label_smoothing = float(options.get("label-smoothing", 0.0) or 0.0)
         self._fused_ce_mode = str(options.get("fused-ce", "auto") or "auto")
         self.guided_weight = float(options.get("guided-alignment-weight", 0.1))
+        self.multi_loss_type = str(options.get("multi-loss-type", "sum")
+                                   or "sum")
         self.guided_cost = str(options.get("guided-alignment-cost", "ce"))
         ga = options.get("guided-alignment", "none")
         self.use_guided = bool(ga and ga != "none") and not inference
@@ -116,7 +118,16 @@ class EncoderDecoder:
         if want_align and align is not None:
             ga = guided_alignment_loss(align, batch["guided"],
                                        batch["trg_mask"], self.guided_cost)
-            total = total + self.guided_weight * ga * rl.labels
+            # --multi-loss-type combination of the partial losses
+            # (reference: layers/loss.h MultiRationalLoss subclasses):
+            # sum/scaled add the aux loss at the CE label count (scaled
+            # multiplies by count_0/count_i — here both counts are the
+            # target labels, so the factor is 1); mean adds the per-label
+            # mean directly.
+            if self.multi_loss_type == "mean":
+                total = total + self.guided_weight * ga
+            else:
+                total = total + self.guided_weight * ga * rl.labels
             aux["guided"] = ga
         return total, aux
 

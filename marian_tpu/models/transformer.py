@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -42,7 +43,17 @@ Params = Dict[str, jax.Array]
 # decode-state keys with these suffixes are per-beam and must be reordered
 # by backpointers in beam search (self-attention K/V caches); cross K/V and
 # 'pos' are beam-invariant.
-BEAM_CARRIED_SUFFIXES = ("_self_k", "_self_v")
+BEAM_CARRIED_SUFFIXES = ("_self_k", "_self_v", "_aan_sum", "_rnn_c")
+
+_AUTOREG_MODES = ("self-attention", "average-attention", "rnn")
+
+
+def _check_autoreg(mode: str) -> str:
+    if mode not in _AUTOREG_MODES:
+        raise ValueError(
+            f"--transformer-decoder-autoreg '{mode}' is not implemented "
+            f"(supported: {', '.join(_AUTOREG_MODES)})")
+    return mode
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,7 +87,10 @@ class TransformerConfig:
     depth_scaling: bool = False
     no_projection: bool = False
     decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
+    dim_aan: int = 2048                       # AAN FFN size (--transformer-dim-aan)
+    rnn_projection: bool = False              # --transformer-rnn-projection
     flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
+    gradient_checkpointing: bool = False      # jax.checkpoint per layer
     # sequence/context parallelism over the mesh 'seq' axis (TPU extension,
     # parallel/sequence.py): "none" | "ring" | "ulysses". seq_mesh is the
     # device mesh the shard_map'd attention runs on (closed over, not traced).
@@ -161,8 +175,13 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         dropout_trg=0.0 if for_inference else float(g("dropout-trg", 0.0)),
         depth_scaling=bool(g("transformer-depth-scaling", False)),
         no_projection=bool(g("transformer-no-projection", False)),
-        decoder_autoreg=str(g("transformer-decoder-autoreg", "self-attention")),
+        decoder_autoreg=_check_autoreg(
+            str(g("transformer-decoder-autoreg", "self-attention"))),
+        dim_aan=int(g("transformer-dim-aan", 2048)),
+        rnn_projection=bool(g("transformer-rnn-projection", False)),
         flash_attention=str(g("transformer-flash-attention", "auto")),
+        gradient_checkpointing=(not for_inference
+                                and bool(g("gradient-checkpointing", False))),
         sequence_parallel=str(g("sequence-parallel", "none") or "none"),
         seq_mesh=seq_mesh,
         compute_dtype=dtype,
@@ -261,8 +280,45 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             p[f"{ep}_top_ln_scale"] = inits.ones((1, d))
             p[f"{ep}_top_ln_bias"] = inits.zeros((1, d))
 
+    def aan_block(prefix: str, layer: int):
+        """Average Attention Network sublayer (reference:
+        src/models/transformer.h :: LayerAAN / AverageAttention): FFN over
+        the cumulative average + a sigmoid gate mixing with the input. The
+        pre/post layer-norm params keep the `_self_Wo` naming so the Marian
+        process strings apply unchanged."""
+        p[f"{prefix}_aan_W1"] = glorot((d, cfg.dim_aan), layer)
+        p[f"{prefix}_aan_b1"] = inits.zeros((1, cfg.dim_aan))
+        p[f"{prefix}_aan_W2"] = glorot((cfg.dim_aan, d), layer)
+        p[f"{prefix}_aan_b2"] = inits.zeros((1, d))
+        p[f"{prefix}_aan_Wi"] = glorot((d, d), layer)
+        p[f"{prefix}_aan_bi"] = inits.zeros((1, d))
+        p[f"{prefix}_aan_Wg"] = glorot((d, d), layer)
+        p[f"{prefix}_aan_bg"] = inits.zeros((1, d))
+        if "n" in cfg.preprocess or "n" in cfg.postprocess:
+            p[f"{prefix}_self_Wo_ln_scale"] = inits.ones((1, d))
+            p[f"{prefix}_self_Wo_ln_bias"] = inits.zeros((1, d))
+
+    def rnn_block(prefix: str, layer: int):
+        """SSRU decoder sublayer (reference: src/models/transformer.h ::
+        DecoderLayerRNN with --dec-cell ssru; ops/rnn.py supplies the cell
+        math). Param names follow the SSRU cell's x_proj contract."""
+        p[f"{prefix}_rnn_W"] = glorot((d, d), layer)
+        p[f"{prefix}_rnn_Wf"] = glorot((d, d), layer)
+        p[f"{prefix}_rnn_bf"] = inits.zeros((1, d))
+        if cfg.rnn_projection:
+            p[f"{prefix}_rnn_Wo"] = glorot((d, d), layer)
+            p[f"{prefix}_rnn_bo"] = inits.zeros((1, d))
+        if "n" in cfg.preprocess or "n" in cfg.postprocess:
+            p[f"{prefix}_self_Wo_ln_scale"] = inits.ones((1, d))
+            p[f"{prefix}_self_Wo_ln_bias"] = inits.zeros((1, d))
+
     for l in range(1, cfg.dec_depth + 1):
-        attn_block(f"decoder_l{l}_self", l)
+        if cfg.decoder_autoreg == "average-attention":
+            aan_block(f"decoder_l{l}", l)
+        elif cfg.decoder_autoreg == "rnn":
+            rnn_block(f"decoder_l{l}", l)
+        else:
+            attn_block(f"decoder_l{l}_self", l)
         for i in range(cfg.n_encoders):
             attn_block(f"decoder_l{l}_context{_ctx_suffix(i)}", l)
         ffn_block(f"decoder_l{l}_ffn", cfg.dec_ffn, cfg.dec_ffn_d, l)
@@ -463,6 +519,65 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
     return _unproj_heads(out, wo, bo), weights
 
 
+def _aan_apply(cfg: TransformerConfig, params: Params, l: int,
+               x_in: jax.Array, y_avg: jax.Array) -> jax.Array:
+    """FFN + sigmoid gate of the AAN sublayer applied to the cumulative
+    average (reference: transformer.h LayerAAN — gate mixes the raw input
+    with the transformed average: out = g⊙x + (1-g)⊙FFN(avg))."""
+    pfx = f"decoder_l{l}_aan"
+    act = activation(cfg.ffn_activation)
+    h = act(affine(y_avg, params[f"{pfx}_W1"], params[f"{pfx}_b1"]))
+    y = affine(h, params[f"{pfx}_W2"], params[f"{pfx}_b2"])
+    gate = jax.nn.sigmoid(
+        affine(x_in, params[f"{pfx}_Wi"], params[f"{pfx}_bi"])
+        + affine(y, params[f"{pfx}_Wg"], params[f"{pfx}_bg"]))
+    return gate * x_in + (1.0 - gate) * y
+
+
+def _aan_train(cfg: TransformerConfig, params: Params, l: int,
+               x: jax.Array) -> jax.Array:
+    """Full-sequence AAN: the cumulative mean over positions is a prefix
+    sum — O(T) HBM traffic instead of the T×T attention matrix (reference:
+    AverageAttention on groundTruth; 'Accelerating Neural Transformer via an
+    Average Attention Network', Zhang et al. 2018)."""
+    t = x.shape[1]
+    csum = jnp.cumsum(x.astype(jnp.float32), axis=1)
+    denom = jnp.arange(1, t + 1, dtype=jnp.float32)[None, :, None]
+    y = (csum / denom).astype(x.dtype)
+    return _aan_apply(cfg, params, l, x, y)
+
+
+def _ssru_train(cfg: TransformerConfig, params: Params, l: int,
+                x: jax.Array) -> jax.Array:
+    """Full-sequence SSRU decoder sublayer via the parallel linear-
+    recurrence scan (ops/rnn.py) — O(log T) depth on TPU."""
+    from ..ops.rnn import SSRU, scan_linear_recurrence
+    d = cfg.dim_emb
+    cell = SSRU(d, d, False)
+    xp = cell.x_proj(params, f"decoder_l{l}_rnn", x)      # [B,T,2D]
+    f, inp = xp[..., :d], xp[..., d:]
+    c = scan_linear_recurrence(f.transpose(1, 0, 2), inp.transpose(1, 0, 2),
+                               jnp.zeros_like(f[:, 0]))
+    out = jax.nn.relu(c.transpose(1, 0, 2)).astype(x.dtype)
+    if cfg.rnn_projection:
+        out = affine(out, params[f"decoder_l{l}_rnn_Wo"],
+                     params[f"decoder_l{l}_rnn_bo"])
+    return out
+
+
+def _autoreg_train(cfg: TransformerConfig, params: Params, l: int,
+                   pre: jax.Array, self_mask, trg_mask, lk, train):
+    """The decoder's autoregressive sublayer on the full target sequence
+    (--transformer-decoder-autoreg)."""
+    if cfg.decoder_autoreg == "average-attention":
+        return _aan_train(cfg, params, l, pre)
+    if cfg.decoder_autoreg == "rnn":
+        return _ssru_train(cfg, params, l, pre)
+    out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre, self_mask,
+                  lk, train, kv_mask=trg_mask, causal=True)
+    return out
+
+
 def _ffn(cfg: TransformerConfig, params: Params, prefix: str, x: jax.Array,
          dim_ffn: int, depth: int, key, train: bool) -> jax.Array:
     act = activation(cfg.ffn_activation)
@@ -587,7 +702,8 @@ def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
     x = _pre_post(cfg, cfg.postprocess_emb, x, None, f"{ep}_emb", params,
                   kk(1), train)
     attn_mask = src_mask[:, None, None, :]  # [B,1,1,Ts]
-    for l in range(1, cfg.enc_depth + 1):
+
+    def enc_layer(x, l):
         lk = kk(l * 10)
         # self-attention sublayer
         pre = _pre_post(cfg, cfg.preprocess, x, None,
@@ -602,8 +718,16 @@ def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
                         f"{ep}_l{l}_ffn_ffn", params, lk2, train)
         out = _ffn(cfg, params, f"{ep}_l{l}_ffn", pre, cfg.dim_ffn,
                    cfg.ffn_depth, lk2, train)
-        x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"{ep}_l{l}_ffn_ffn", params, lk2, train)
+        return _pre_post(cfg, cfg.postprocess, out, x,
+                         f"{ep}_l{l}_ffn_ffn", params, lk2, train)
+
+    for l in range(1, cfg.enc_depth + 1):
+        if cfg.gradient_checkpointing and train:
+            # --gradient-checkpointing: rematerialize the layer in the
+            # backward pass instead of keeping its activations in HBM
+            x = jax.checkpoint(partial(enc_layer, l=l))(x)
+        else:
+            x = enc_layer(x, l)
     x = _pre_post(cfg, cfg.postprocess_top, x, None, f"{ep}_top", params,
                   kk(9999), train)
     return x
@@ -637,28 +761,29 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
     masks = _as_tuple(src_mask)
     cross_masks = [m[:, None, None, :] for m in masks]
     align = None
-    for l in range(1, cfg.dec_depth + 1):
+
+    def dec_layer(x, l, want_align):
         lk = kk(l * 10)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
                         f"decoder_l{l}_self_Wo", params, lk, train)
-        out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre, self_mask,
-                      lk, train, kv_mask=trg_mask, causal=True)
+        out = _autoreg_train(cfg, params, l, pre, self_mask, trg_mask,
+                             lk, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
                       f"decoder_l{l}_self_Wo", params, lk, train)
 
+        align_l = None
         # one cross-attention sublayer per encoder (multi-source stacks them)
         for i, eo in enumerate(enc_outs):
             cname = f"decoder_l{l}_context{_ctx_suffix(i)}"
             lk2 = kk(l * 10 + 3 + i)
-            want_w = (return_alignment and i == 0
-                      and _is_alignment_layer(cfg, l))
+            want_w = want_align and i == 0
             pre = _pre_post(cfg, cfg.preprocess, x, None,
                             f"{cname}_Wo", params, lk2, train)
             out, w = _mha(cfg, params, cname, pre, eo,
                           cross_masks[i], lk2, train, return_weights=want_w,
                           kv_mask=masks[i])
             if want_w and w is not None:
-                align = w.mean(axis=1)  # [B,Tt,Ts] head-averaged alignment
+                align_l = w.mean(axis=1)  # [B,Tt,Ts] head-averaged
             x = _pre_post(cfg, cfg.postprocess, out, x,
                           f"{cname}_Wo", params, lk2, train)
 
@@ -669,6 +794,17 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
                    cfg.dec_ffn_d, lk3, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
                       f"decoder_l{l}_ffn_ffn", params, lk3, train)
+        return x, align_l
+
+    for l in range(1, cfg.dec_depth + 1):
+        want_align = return_alignment and _is_alignment_layer(cfg, l)
+        if cfg.gradient_checkpointing and train and not want_align:
+            x, _ = jax.checkpoint(
+                partial(dec_layer, l=l, want_align=False))(x)
+        else:
+            x, align_l = dec_layer(x, l, want_align)
+            if align_l is not None:
+                align = align_l
     x = _pre_post(cfg, cfg.postprocess_top, x, None, "decoder_top", params,
                   kk(9999), train)
     out = x if return_hidden else output_logits(cfg, params, x)
@@ -759,8 +895,19 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
                 affine(kv, params[f"{cname}_Wk"], params[f"{cname}_bk"]), h)
             state[f"l{l}_cross_v{sfx}"] = _split_heads(
                 affine(kv, params[f"{cname}_Wv"], params[f"{cname}_bv"]), h)
-        state[f"l{l}_self_k"] = jnp.zeros((b, h, max_len, dh), cfg.compute_dtype)
-        state[f"l{l}_self_v"] = jnp.zeros((b, h, max_len, dh), cfg.compute_dtype)
+        if cfg.decoder_autoreg == "average-attention":
+            # AAN needs only the running sum of inputs — O(D) per position
+            # decode state instead of the O(L·D) KV cache
+            state[f"l{l}_aan_sum"] = jnp.zeros((b, 1, cfg.dim_emb),
+                                               jnp.float32)
+        elif cfg.decoder_autoreg == "rnn":
+            state[f"l{l}_rnn_c"] = jnp.zeros((b, 1, cfg.dim_emb),
+                                             cfg.compute_dtype)
+        else:
+            state[f"l{l}_self_k"] = jnp.zeros((b, h, max_len, dh),
+                                              cfg.compute_dtype)
+            state[f"l{l}_self_v"] = jnp.zeros((b, h, max_len, dh),
+                                              cfg.compute_dtype)
     return state
 
 
@@ -774,7 +921,8 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
     mask allows positions <= pos (cache beyond pos is zeros but masked out).
     """
     pos = state["pos"]
-    max_len = state["l1_self_k"].shape[2]
+    max_len = (state["l1_self_k"].shape[2]
+               if cfg.decoder_autoreg == "self-attention" else 0)
     we = _embed_words(cfg, params, prev_ids, "trg")
     # step 0 uses the zero embedding (Marian's no-BOS decoder start)
     we = jnp.where(pos == 0, jnp.zeros_like(we), we)
@@ -782,19 +930,41 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
     x = _pre_post(cfg, _strip_dropout(cfg.postprocess_emb), x, None,
                   "decoder_emb", params, None, False)
     # self mask: [1,1,1,max_len] — attend to steps 0..pos
-    steps = jnp.arange(max_len)
-    self_mask = (steps <= pos).astype(cfg.compute_dtype)[None, None, None, :]
+    if cfg.decoder_autoreg == "self-attention":
+        steps = jnp.arange(max_len)
+        self_mask = (steps <= pos).astype(
+            cfg.compute_dtype)[None, None, None, :]
     cross_masks = [m[:, None, None, :] for m in _as_tuple(src_mask)]
     align = None
     new_state = dict(state)
     for l in range(1, cfg.dec_depth + 1):
         pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
                         f"decoder_l{l}_self_Wo", params, None, False)
-        cache = {"k": state[f"l{l}_self_k"], "v": state[f"l{l}_self_v"]}
-        out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre, self_mask,
-                      None, False, cache=cache, cache_pos=pos)
-        new_state[f"l{l}_self_k"] = cache["k"]
-        new_state[f"l{l}_self_v"] = cache["v"]
+        if cfg.decoder_autoreg == "average-attention":
+            # running-sum cumulative average: y = (sum + x_t) / (pos+1)
+            s = state[f"l{l}_aan_sum"] + pre.astype(jnp.float32)
+            y = (s / (pos + 1).astype(jnp.float32)).astype(pre.dtype)
+            out = _aan_apply(cfg, params, l, pre, y)
+            new_state[f"l{l}_aan_sum"] = s
+        elif cfg.decoder_autoreg == "rnn":
+            from ..ops.rnn import SSRU
+            d = cfg.dim_emb
+            cell = SSRU(d, d, False)
+            xp = cell.x_proj(params, f"decoder_l{l}_rnn", pre)
+            f, inp = xp[..., :d], xp[..., d:]
+            c2 = f * state[f"l{l}_rnn_c"].astype(f.dtype) + inp
+            out = jax.nn.relu(c2).astype(pre.dtype)
+            if cfg.rnn_projection:
+                out = affine(out, params[f"decoder_l{l}_rnn_Wo"],
+                             params[f"decoder_l{l}_rnn_bo"])
+            new_state[f"l{l}_rnn_c"] = c2.astype(
+                state[f"l{l}_rnn_c"].dtype)
+        else:
+            cache = {"k": state[f"l{l}_self_k"], "v": state[f"l{l}_self_v"]}
+            out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre,
+                          self_mask, None, False, cache=cache, cache_pos=pos)
+            new_state[f"l{l}_self_k"] = cache["k"]
+            new_state[f"l{l}_self_v"] = cache["v"]
         x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
                       f"decoder_l{l}_self_Wo", params, None, False)
 

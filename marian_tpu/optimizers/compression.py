@@ -30,13 +30,17 @@ Params = Dict[str, jax.Array]
 # ---------------------------------------------------------------------------
 
 def quantize_tensor(v: jax.Array, bits: int, log_based: bool = False,
-                    opt_steps: int = 0) -> jax.Array:
+                    opt_steps: int = 0, qrange: float = 0.0) -> jax.Array:
     """Quantize one tensor to 2^bits symmetric levels (reference:
     ModelQuantizer::quantizeImpl; opt_steps = the alternating scale fit of
-    --quantize-optimization-steps)."""
+    --quantize-optimization-steps; qrange = --quantize-range, clipping the
+    scale at N standard deviations instead of absmax when > 0)."""
     x = v.astype(jnp.float32)
     levels = float(2 ** (bits - 1) - 1)
-    s = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12)
+    if qrange > 0.0:
+        s = jnp.maximum(qrange * jnp.std(x), 1e-12)
+    else:
+        s = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12)
     if log_based:
         # centers at s * 2^-k, k in [0, levels]: round log2 magnitude
         sign = jnp.sign(x)
@@ -58,7 +62,7 @@ def quantize_tensor(v: jax.Array, bits: int, log_based: bool = False,
 
 def quantize_model(params: Params, error: Params, bits: int,
                    log_based: bool = False, opt_steps: int = 0,
-                   include_biases: bool = False
+                   include_biases: bool = False, qrange: float = 0.0
                    ) -> Tuple[Params, Params]:
     """Quantize the parameter tree with error feedback: the next step sees
     param + carried error, so quantization noise doesn't accumulate
@@ -72,7 +76,7 @@ def quantize_model(params: Params, error: Params, bits: int,
             new_e[k] = error[k]
             continue
         target = v.astype(jnp.float32) + error[k]
-        q = quantize_tensor(target, bits, log_based, opt_steps)
+        q = quantize_tensor(target, bits, log_based, opt_steps, qrange)
         new_p[k] = q.astype(v.dtype)
         new_e[k] = target - q.astype(jnp.float32)
     return new_p, new_e

@@ -43,6 +43,7 @@ class OptimizerConfig:
     quantize_log: bool = False         # --quantize-log-based
     quantize_biases: bool = False      # --quantize-biases
     quantize_opt_steps: int = 0        # --quantize-optimization-steps
+    quantize_range: float = 0.0        # --quantize-range (clip at N stddevs)
     grad_drop_rate: float = 0.0        # --gradient-dropping-rate (0 = off)
 
     @classmethod
@@ -58,6 +59,8 @@ class OptimizerConfig:
                   quantize_biases=bool(options.get("quantize-biases", False)),
                   quantize_opt_steps=int(
                       options.get("quantize-optimization-steps", 0) or 0),
+                  quantize_range=float(
+                      options.get("quantize-range", 0.0) or 0.0),
                   grad_drop_rate=float(
                       options.get("gradient-dropping-rate", 0.0) or 0.0))
         if name == "adam":
@@ -153,7 +156,8 @@ def apply_update(cfg: OptimizerConfig, state: Dict[str, Any], params: Params,
         from .compression import quantize_model
         out, new_state["qerr"] = quantize_model(
             out, state["qerr"], cfg.quantize_bits, cfg.quantize_log,
-            cfg.quantize_opt_steps, cfg.quantize_biases)
+            cfg.quantize_opt_steps, cfg.quantize_biases,
+            qrange=cfg.quantize_range)
 
     if cfg.smoothing > 0:
         # reference ExponentialSmoothing: avg += tau * (p - avg), with tau

@@ -25,6 +25,9 @@ class LRSchedule:
     inv_sqrt: int = 0                # warmup constant for inv-sqrt decay
     warmup_start_rate: float = 0.0
     decay_factor: float = 1.0        # multiplicative, set by Scheduler
+    warmup_cycle: bool = False       # --lr-warmup-cycle: sawtooth warmup
+    warmup_offset: int = 0           # warmup restarts here (--lr-warmup-at-
+                                     # reload / --lr-decay-repeat-warmup)
 
     @classmethod
     def from_options(cls, options) -> "LRSchedule":
@@ -35,14 +38,19 @@ class LRSchedule:
         inv = SchedulingParameter.parse(str(inv_raw[0]))
         return cls(base_lr=float(options.get("learn-rate", 1e-4)),
                    warmup=warmup.n, inv_sqrt=inv.n,
-                   warmup_start_rate=float(options.get("lr-warmup-start-rate", 0.0)))
+                   warmup_start_rate=float(
+                       options.get("lr-warmup-start-rate", 0.0)),
+                   warmup_cycle=bool(options.get("lr-warmup-cycle", False)))
 
     def __call__(self, step) -> jnp.ndarray:
         """step: 1-based update count (f32 scalar or python int)."""
         step = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
         lr = jnp.asarray(self.base_lr, jnp.float32)
         if self.warmup > 0:
-            frac = jnp.minimum(step / float(self.warmup), 1.0)
+            wstep = jnp.maximum(step - float(self.warmup_offset), 1.0)
+            if self.warmup_cycle:
+                wstep = jnp.mod(wstep - 1.0, float(self.warmup)) + 1.0
+            frac = jnp.minimum(wstep / float(self.warmup), 1.0)
             start = self.warmup_start_rate
             lr = start + (lr - start) * frac if start > 0 else lr * frac
         if self.inv_sqrt > 0:

@@ -49,6 +49,16 @@ def parse_mesh_spec(spec: Sequence[str]) -> Dict[str, int]:
 def make_mesh(options=None, devices: Optional[List] = None) -> Mesh:
     if devices is None:
         devices = jax.devices()
+        if options is not None and options.get("devices", None):
+            # GPU-style --devices 0 1 2 3: device *identity* is meaningless
+            # under the TPU runtime, but the requested parallel width isn't
+            n = len(options.get("devices", []))
+            if n > len(devices):
+                raise RuntimeError(
+                    f"--devices requests {n} devices but only "
+                    f"{len(devices)} are visible — refusing to silently "
+                    f"under-provision")
+            devices = devices[:n]
         if options is not None:
             n = int(options.get("num-devices", 0) or 0)
             if n:

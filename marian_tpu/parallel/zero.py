@@ -38,7 +38,8 @@ Params = Dict[str, jax.Array]
 
 def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                      mesh: Mesh, params: Params, opt_state,
-                     delay: int = 1, donate: bool = True, shardings=None):
+                     delay: int = 1, donate: bool = True, shardings=None,
+                     frozen=()):
     """Returns a jitted fn(params, opt_state, batch, step) →
     (params, opt_state, metrics) with SyncGraphGroup semantics.
 
@@ -56,8 +57,15 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
         total, aux = model.loss(p, b, rng, train=True)
         return total, aux
 
+    frozen_set = frozenset(frozen)
+
     def grads_of(p, b, rng):
         (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b, rng)
+        if frozen_set:
+            # --embedding-fix-src/trg: fixed tables get no update and no
+            # contribution to the global norm (reference: trainable=false)
+            g = {k: (jnp.zeros_like(v) if k in frozen_set else v)
+                 for k, v in g.items()}
         return g, aux
 
     def step_fn(p, opt_state, batch, step, rng):

@@ -72,6 +72,42 @@ class GraphGroup:
         self._fused = None
         self._grad_fn = None
         self._update_fn = None
+        self._fix_src = bool(options.get("embedding-fix-src", False))
+        self._fix_trg = bool(options.get("embedding-fix-trg", False))
+
+    def _frozen_names(self) -> frozenset:
+        """Embedding tables excluded from updates (--embedding-fix-src/trg).
+        With tied embeddings the shared table freezes if either side is
+        fixed (reference: Embedding with trainable=false on the same
+        tensor)."""
+        if not (self._fix_src or self._fix_trg):
+            return frozenset()
+        names = set()
+        for k in self.params:
+            is_src = (k.endswith("_Wemb") and not k.startswith("decoder")) \
+                or (k == "Wemb")
+            is_trg = k in ("decoder_Wemb", "Wemb_dec") or (
+                k == "Wemb" and not any(
+                    o in self.params for o in ("decoder_Wemb", "Wemb_dec")))
+            if (self._fix_src and is_src) or (self._fix_trg and is_trg):
+                names.add(k)
+        return frozenset(names)
+
+    def rebuild(self) -> None:
+        """Re-trace the jitted step functions. Needed whenever host-side
+        schedule state that is baked into the trace changes (decay factor,
+        warmup offset) — the compiled step otherwise keeps using the values
+        from build time."""
+        self._build()
+
+    def reset_optimizer(self) -> None:
+        """Re-initialize optimizer moments (--lr-decay-reset-optimizer),
+        keeping params and step count."""
+        self.opt_state = init_state(self.opt_cfg, self.params)
+        _, self.opt_state = place(
+            self.params, self.opt_state, self.mesh,
+            dim_emb=int(getattr(self.model.cfg, "dim_emb", 0) or 0))
+        self._build()
 
     # -- init / load --------------------------------------------------------
     def initialize(self, key: jax.Array,
@@ -103,11 +139,12 @@ class GraphGroup:
         model, opt_cfg, schedule = self.model, self.opt_cfg, self.schedule
 
         # fused single-batch step (the hot path; delay==1)
+        frozen = self._frozen_names()
         self._fused = build_train_step(model, opt_cfg, schedule,
                                        self.cost_type, mesh, self.params,
                                        self.opt_state, delay=1,
                                        donate=self._donate,
-                                       shardings=(p_sh, o_sh))
+                                       shardings=(p_sh, o_sh), frozen=frozen)
 
         # split path for --optimizer-delay with heterogeneous batch shapes.
         # Batches arrive committed via M.shard_batch (per-leaf name-aware
@@ -117,6 +154,9 @@ class GraphGroup:
                 return model.loss(pp, b, r, train=True)
             (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 p, batch, rng)
+            if frozen:
+                grads = {k: (jnp.zeros_like(v) if k in frozen else v)
+                         for k, v in grads.items()}
             return grads, aux
 
         self._grad_fn = jax.jit(grad_step, out_shardings=(p_sh, None))

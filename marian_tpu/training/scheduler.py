@@ -29,7 +29,20 @@ class Scheduler:
         self.after_epochs = int(options.get("after-epochs", 0) or 0)
         self.after_batches = int(options.get("after-batches", 0) or 0)
         self.early_stopping = int(options.get("early-stopping", 10) or 0)
+        # per-metric improvement margins (reference: --early-stopping-epsilon)
+        eps = options.get("early-stopping-epsilon", [0.0]) or [0.0]
+        self.early_stopping_eps = [float(e) for e in (
+            eps if isinstance(eps, list) else [eps])]
         self.lr_report = bool(options.get("lr-report", False))
+        self.disp_label_counts = bool(options.get("disp-label-counts", False))
+        # --logical-epoch [size, decimals]: epoch redefined as a data amount
+        # (e.g. 500Mt) for display/epoch-based scheduling consistency
+        le = options.get("logical-epoch", []) or []
+        if not isinstance(le, list):
+            le = [le]
+        self.logical_epoch = SchedulingParameter.parse(str(le[0])) \
+            if le and str(le[0]) not in ("", "1e") else None
+        self.logical_epoch_width = int(le[1]) if len(le) > 1 else 3
         # display accumulators
         self._cost_sum = 0.0
         self._label_sum = 0.0
@@ -104,8 +117,13 @@ class Scheduler:
         else:
             cost = self._cost_sum / max(self._sent_sum, 1)
         wps = self._words_sum / dt
-        line = (f"Ep. {s.epochs + 1} : Up. {s.batches} : Sen. {s.samples_epoch:,} "
-                f": Cost {cost:.8f} : Time {dt:.2f}s : {wps:.2f} words/s")
+        ep = self._epoch_display()
+        cost_part = f"Cost {cost:.8f}"
+        if self.disp_label_counts:
+            cost_part += (f" * {int(self._label_sum):,} labels"
+                          f" after {s.labels_total:,}")
+        line = (f"Ep. {ep} : Up. {s.batches} : Sen. {s.samples_epoch:,} "
+                f": {cost_part} : Time {dt:.2f}s : {wps:.2f} words/s")
         if self.lr_report:
             line += f" : L.r. {s.eta:.4e}"
         log.info("{}", line)
@@ -113,6 +131,19 @@ class Scheduler:
         self._sent_sum = 0
         self._disp_count = 0
         self._timer = time.perf_counter()
+
+    def _epoch_display(self):
+        s = self.state
+        if self.logical_epoch is None:
+            return s.epochs + 1
+        le = self.logical_epoch
+        if le.unit == SchedulingUnit.TRG_LABELS:
+            val = s.labels_total / max(le.n, 1)
+        elif le.unit == SchedulingUnit.UPDATES:
+            val = s.batches / max(le.n, 1)
+        else:
+            return s.epochs + 1
+        return f"{val:.{self.logical_epoch_width}f}"
 
     # -- triggers ------------------------------------------------------------
     def should_save(self) -> bool:
@@ -122,9 +153,9 @@ class Scheduler:
         return bool(self.valid_freq) and self._hit(self.valid_freq)
 
     def new_epoch(self) -> None:
+        seen = self.state.samples_epoch
         self.state.new_epoch()
-        log.info("Seen {} samples in epoch {}", self.state.samples_epoch,
-                 self.state.epochs)
+        log.info("Seen {} samples in epoch {}", seen, self.state.epochs)
 
     # -- validation bookkeeping (reference: Scheduler::validate) -------------
     def register_validation(self, metric: str, value: float,
@@ -133,23 +164,51 @@ class Scheduler:
         s = self.state
         rec = s.validators.setdefault(metric, {"last-best": None, "stalled": 0})
         best = rec["last-best"]
+        metrics_order = (self.options.get("valid-metrics", ["cross-entropy"])
+                         or ["cross-entropy"])
+        idx = metrics_order.index(metric) if metric in metrics_order else 0
+        eps = self.early_stopping_eps[min(idx,
+                                          len(self.early_stopping_eps) - 1)]
         improved = (best is None or
-                    (value < best if lower_is_better else value > best))
+                    (value < best - eps if lower_is_better
+                     else value > best + eps))
         if improved:
             rec["last-best"] = float(value)
             rec["stalled"] = 0
         else:
             rec["stalled"] += 1
-        # first metric drives global stall count (early-stopping-on: first)
-        first_metric = (self.options.get("valid-metrics", ["cross-entropy"]) or
-                        ["cross-entropy"])[0]
-        if metric == first_metric:
-            s.stalled = rec["stalled"]
-            s.max_stalled = max(s.max_stalled, s.stalled)
+        # --early-stopping-on: which metrics drive the global stall count
+        # (reference: Scheduler::validated): first (default) = first
+        # valid-metric only; any = most-stalled metric (stop as soon as any
+        # metric stalls long enough); all = least-stalled (stop only when
+        # every metric stalled)
+        mode = str(self.options.get("early-stopping-on", "first") or "first")
+        stalls = [r["stalled"] for r in s.validators.values()] or [0]
+        if mode == "any":
+            s.stalled = max(stalls)
+        elif mode == "all":
+            s.stalled = min(stalls)
+        else:
+            first_metric = metrics_order[0]
+            if metric == first_metric:
+                s.stalled = rec["stalled"]
+        s.max_stalled = max(s.max_stalled, s.stalled)
         return improved
 
+    def reset_stalled(self, reset_best: bool = False) -> None:
+        """--valid-reset-stalled / --valid-reset-all on resume: clear stall
+        counters (and optionally the recorded bests) so continued training
+        isn't immediately early-stopped by pre-restart validations."""
+        s = self.state
+        s.stalled = 0
+        s.max_stalled = 0
+        for rec in s.validators.values():
+            rec["stalled"] = 0
+            if reset_best:
+                rec["last-best"] = None
+
     # -- LR decay (reference: Scheduler::updateLearningRate strategies) ------
-    def maybe_decay_lr(self, schedule) -> None:
+    def maybe_decay_lr(self, schedule, graph_group=None) -> None:
         decay = float(self.options.get("lr-decay", 0.0) or 0.0)
         if decay <= 0:
             return
@@ -174,3 +233,16 @@ class Scheduler:
             s.factor *= decay
             schedule.decay_factor = s.factor
             log.info("Decaying learning rate to factor {}", s.factor)
+            if self.options.get("lr-decay-repeat-warmup", False):
+                schedule.warmup_offset = s.batches
+                log.info("Restarting learning-rate warmup at update {}",
+                         s.batches)
+            if graph_group is not None:
+                if self.options.get("lr-decay-reset-optimizer", False):
+                    # re-initializes moments AND rebuilds the jitted steps
+                    graph_group.reset_optimizer()
+                    log.info("Optimizer state reset after learning-rate decay")
+                else:
+                    # schedule factors are baked into the compiled train step
+                    # at trace time — rebuild so the decayed LR takes effect
+                    graph_group.rebuild()

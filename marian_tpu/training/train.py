@@ -36,6 +36,13 @@ class Train:
         seed = int(opts.get("seed", 0)) or 1234
         key = prng.root_key(seed)
 
+        if opts.get("check-nan", False):
+            # --check-nan: abort with a traceback on the first non-finite
+            # value anywhere under jit (reference: graph NaN sanitizer;
+            # SURVEY §5 "sanitizers/NaN-debug")
+            jax.config.update("jax_debug_nans", True)
+            log.info("NaN checking enabled (jax_debug_nans)")
+
         # -- data -----------------------------------------------------------
         train_sets = list(opts.get("train-sets"))
         vocab_paths = list(opts.get("vocabs", [])) or \
@@ -97,13 +104,24 @@ class Train:
                 .load_model(opts.get("pretrained-model"))
             init_params = {k: jnp.asarray(v) for k, v in host_params.items()}
 
+        # schedule factors are baked into the compiled step at trace time —
+        # restore them BEFORE initialize() builds the jitted functions
+        gg.schedule.decay_factor = state.factor
+        if state.batches > 0 and opts.get("lr-warmup-at-reload", False):
+            gg.schedule.warmup_offset = state.batches
+            log.info("Repeating learning-rate warmup from update {} "
+                     "(--lr-warmup-at-reload)", state.batches)
         gg.initialize(prng.stream(key, prng.STREAM_INIT), init_params)
         n_params = sum(int(np.prod(v.shape)) for v in gg.params.values())
         log.info("Model created: {} parameters ({:.1f}M)", n_params,
                  n_params / 1e6)
 
         scheduler = Scheduler(opts, state)
-        gg.schedule.decay_factor = state.factor
+        if state.batches > 0 and (opts.get("valid-reset-stalled", False)
+                                  or opts.get("valid-reset-all", False)):
+            scheduler.reset_stalled(
+                reset_best=bool(opts.get("valid-reset-all", False)))
+            log.info("Validation stall counters reset on resume")
         validators = create_validators(opts, vocabs, model)
 
         config_yaml = opts.as_yaml()
@@ -115,6 +133,12 @@ class Train:
             smooth = gg.smoothed() if gg.opt_cfg.smoothing > 0 else None
             save_checkpoint(model_path, gg.params, config_yaml, gg, state,
                             smooth_params=smooth, suffix=suffix)
+            if not suffix and not opts.get("overwrite", False):
+                # without --overwrite, keep an iteration-numbered copy of
+                # every periodic checkpoint (reference: Train::save)
+                save_checkpoint(model_path, gg.params, config_yaml,
+                                None, None, smooth_params=None,
+                                suffix=f".iter{state.batches}")
 
         def do_validate() -> None:
             params = gg.smoothed() if gg.opt_cfg.smoothing > 0 else gg.params
@@ -130,7 +154,7 @@ class Train:
                        f"stalled {state.validators[v.name]['stalled']} times"))
                 if improved and opts.get("keep-best", False):
                     do_save(suffix=".best-" + v.name)
-            scheduler.maybe_decay_lr(gg.schedule)
+            scheduler.maybe_decay_lr(gg.schedule, gg)
 
         # -- epoch loop ------------------------------------------------------
         train_key = prng.stream(key, prng.STREAM_DROPOUT)
@@ -157,6 +181,11 @@ class Train:
                 if scheduler.should_save():
                     do_save()
                 if signal_handling.signal_flag():
+                    if opts.get("sigterm", "save-and-exit") == \
+                            "exit-immediately":
+                        log.info("Caught termination signal; exiting "
+                                 "immediately (--sigterm exit-immediately)")
+                        return
                     log.info("Caught termination signal; saving and exiting")
                     do_save()
                     stop = True

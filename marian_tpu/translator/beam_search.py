@@ -46,6 +46,11 @@ class BeamConfig:
     max_length: int = 256           # decode cap L (static)
     n_best: int = 1
     return_alignment: bool = False
+    # --output-sampling: () = off; ("full", temp) samples the full softmax;
+    # ("topk", k, temp) restricts to the k most probable tokens first.
+    # Each beam becomes an independent sample trajectory (gumbel-max over
+    # the token log-probs — TPU-friendly: argmax, no host RNG in the loop).
+    sampling: tuple = ()
 
     @classmethod
     def from_options(cls, options, max_length: int) -> "BeamConfig":
@@ -61,7 +66,28 @@ class BeamConfig:
             n_best=int(options.get("beam-size", 6))
             if options.get("n-best", False) else 1,
             return_alignment=options.get("alignment", None) is not None,
+            sampling=_parse_sampling(options.get("output-sampling", [])),
         )
+
+
+def _parse_sampling(raw) -> tuple:
+    """'full [temp]' / 'topk [k] [temp]' → normalized tuple (reference:
+    --output-sampling in translator/sampling)."""
+    if raw in (None, False, [], ""):
+        return ()
+    if raw is True:
+        return ("full", 1.0)
+    parts = [str(p) for p in (raw if isinstance(raw, list) else [raw])]
+    mode = parts[0].lower()
+    if mode == "full":
+        temp = float(parts[1]) if len(parts) > 1 else 1.0
+        return ("full", temp)
+    if mode == "topk":
+        n = int(parts[1]) if len(parts) > 1 else 10
+        temp = float(parts[2]) if len(parts) > 2 else 1.0
+        return ("topk", n, temp)
+    raise ValueError(f"--output-sampling: unknown mode '{mode}' "
+                     f"(expected full or topk)")
 
 
 def _flatten_beams(x: jax.Array) -> jax.Array:
@@ -84,7 +110,8 @@ def _first(x):
 def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                     weights: Sequence[float], cfg: BeamConfig,
                     src_ids: jax.Array, src_mask: jax.Array,
-                    shortlist: Optional[jax.Array] = None):
+                    shortlist: Optional[jax.Array] = None,
+                    sample_key: Optional[jax.Array] = None):
     """The jittable core. Returns (tokens [B,K,L], raw_scores [B,K],
     lengths [B,K], norm_scores [B,K], alignments [B,K,L,Ts] or None).
 
@@ -109,8 +136,12 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
              else model.cfg.trg_vocab)
 
     tokens0 = jnp.zeros((b, k, L), jnp.int32)
-    scores0 = jnp.where(jnp.arange(k)[None, :] == 0, 0.0, NEG_INF
-                        ).astype(jnp.float32).repeat(b, axis=0).reshape(b, k)
+    if cfg.sampling:
+        # every beam is an independent sample — all start live at score 0
+        scores0 = jnp.zeros((b, k), jnp.float32)
+    else:
+        scores0 = jnp.where(jnp.arange(k)[None, :] == 0, 0.0, NEG_INF
+                            ).astype(jnp.float32).repeat(b, axis=0).reshape(b, k)
     finished0 = jnp.zeros((b, k), bool)
     lengths0 = jnp.zeros((b, k), jnp.int32)
     prev0 = jnp.zeros((bk, 1), jnp.int32)
@@ -149,11 +180,29 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                                0.0, NEG_INF)
         logp = jnp.where(finished[:, :, None], eos_onehot, logp)
 
-        combined = scores[:, :, None] + logp            # [B,K,V]
-        flat = combined.reshape(b, k * vocab)
-        top_scores, top_idx = jax.lax.top_k(flat, k)    # [B,K]
-        beam_idx = top_idx // vocab                     # [B,K] source beam
-        tok_sl = top_idx % vocab                        # token in (shortlist) coords
+        if cfg.sampling:
+            # --output-sampling: each beam samples its own next token via
+            # gumbel-max (argmax of tempered log-probs + gumbel noise — no
+            # categorical host round-trip; finished beams keep picking EOS
+            # because their distribution is the {EOS: 0} onehot above)
+            temp = float(cfg.sampling[-1])
+            slp = logp / max(temp, 1e-6)
+            if cfg.sampling[0] == "topk":
+                n = min(int(cfg.sampling[1]), vocab)
+                kth = jax.lax.top_k(slp, n)[0][..., -1:]
+                slp = jnp.where(slp < kth, NEG_INF, slp)
+            g = jax.random.gumbel(jax.random.fold_in(sample_key, t),
+                                  slp.shape, jnp.float32)
+            tok_sl = jnp.argmax(slp + g, axis=-1).astype(jnp.int32)  # [B,K]
+            top_scores = scores + jnp.take_along_axis(
+                logp, tok_sl[..., None], axis=-1)[..., 0]
+            beam_idx = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+        else:
+            combined = scores[:, :, None] + logp        # [B,K,V]
+            flat = combined.reshape(b, k * vocab)
+            top_scores, top_idx = jax.lax.top_k(flat, k)  # [B,K]
+            beam_idx = top_idx // vocab                 # [B,K] source beam
+            tok_sl = top_idx % vocab                    # token in (shortlist) coords
         tok_full = (shortlist[tok_sl] if shortlist is not None
                     else tok_sl).astype(jnp.int32)
 
@@ -241,17 +290,21 @@ class BeamSearch:
         self.max_length_factor = float(options.get("max-length-factor", 3.0))
         self.max_length_cap = int(options.get("max-length", 1000))
         self._jitted = {}
+        self._sample_calls = 0
+        self._sample_seed = int(options.get("seed", 0) or 0) or 1234
 
     def _get_fn(self, cfg: BeamConfig, has_shortlist: bool):
         key = (cfg, has_shortlist)
         if key not in self._jitted:
             model, weights = self.model, tuple(self.weights)
 
-            def fn(params_list, src_ids, src_mask, shortlist=None):
+            def fn(params_list, src_ids, src_mask, shortlist=None,
+                   sample_key=None):
                 return beam_search_jit(model, list(params_list), weights, cfg,
-                                       src_ids, src_mask, shortlist)
+                                       src_ids, src_mask, shortlist,
+                                       sample_key=sample_key)
 
-            self._jitted[key] = jax.jit(fn)
+            self._jitted[key] = jax.jit(fn, static_argnames=())
         return self._jitted[key]
 
     def search(self, src_ids, src_mask,
@@ -272,11 +325,14 @@ class BeamSearch:
                 return tuple(jnp.asarray(e) for e in x)
             return jnp.asarray(x)
 
+        sample_key = None
+        if cfg.sampling:
+            self._sample_calls += 1
+            sample_key = jax.random.fold_in(
+                jax.random.key(self._sample_seed), self._sample_calls)
         args = (tuple(self.params_list), _dev(src_ids), _dev(src_mask))
-        if sl_idx is not None:
-            tokens, scores, lengths, norm_scores, aligns = fn(*args, sl_idx)
-        else:
-            tokens, scores, lengths, norm_scores, aligns = fn(*args)
+        tokens, scores, lengths, norm_scores, aligns = fn(
+            *args, shortlist=sl_idx, sample_key=sample_key)
         return self._collect(np.asarray(tokens), np.asarray(scores),
                              np.asarray(lengths), np.asarray(norm_scores),
                              None if aligns is None else np.asarray(aligns),

@@ -46,6 +46,8 @@ class OutputPrinter:
     def __init__(self, options, vocab):
         self.vocab = vocab
         self.n_best = bool(options.get("n-best", False))
+        # --allow-special: keep </s> / <unk> visible in the output text
+        self.allow_special = bool(options.get("allow-special", False))
         self.feature = options.get("n-best-feature", "Score")
         align = options.get("alignment", None)
         self.align_mode: Optional[str] = None
@@ -62,7 +64,8 @@ class OutputPrinter:
                     self.align_mode = "hard"
 
     def _detok(self, tokens: List[int]) -> str:
-        return self.vocab.decode(tokens)
+        return self.vocab.decode(tokens,
+                                 ignore_eos=not self.allow_special)
 
     def _align_str(self, soft: np.ndarray) -> str:
         if self.align_mode == "soft":

@@ -30,6 +30,7 @@ from .output_collector import OutputCollector, OutputPrinter
 class Translate:
     def __init__(self, options):
         self.options = options
+        options.set("_translation_task", True)   # for --quiet-translation
         log.create_loggers(options)
 
         model_paths = list(options.get("models", [])) or [options.get("model")]

@@ -1,0 +1,96 @@
+small|c0 red|c0 has|c0 tree|c0 child|c0 man|c0 house|c0 house|c0 man|c0
+blue|c0 cat|c0 big|c0 sees|c0 young|c0
+loves|c0 tree|c0 woman|c0 the|c0 dog|c0
+fast|c0 the|c0 blue|c0 red|c0 child|c0 blue|c0
+loves|c0 small|c0 man|c0 big|c0 young|c0 young|c0 old|c0 fast|c0 red|c0
+blue|c0 woman|c0 dog|c0 fast|c0 red|c0 the|c0 the|c0 the|c0 house|c0
+woman|c0 house|c0 child|c0 big|c0 old|c0 old|c0
+the|c0 has|c0 child|c0 fast|c0 has|c0
+woman|c0 young|c0 sees|c0 blue|c0 the|c0 old|c0 loves|c0 child|c0 the|c0
+old|c0 house|c0 the|c0 house|c0 red|c0 young|c0
+blue|c0 big|c0 the|c0
+old|c0 man|c0 young|c0 young|c0 red|c0 fast|c0 fast|c0
+woman|c0 red|c0 child|c0 blue|c0 sees|c0 man|c0 loves|c0
+house|c0 the|c0 blue|c0
+red|c0 woman|c0 house|c0 fast|c0 loves|c0 small|c0 has|c0 small|c0 child|c0
+sees|c0 the|c0 red|c0
+small|c0 small|c0 old|c0 old|c0
+small|c0 sees|c0 tree|c0 blue|c0
+blue|c0 big|c0 house|c0 house|c0 blue|c0
+child|c0 cat|c0 sees|c0 dog|c0 tree|c0 tree|c0 cat|c0 red|c0 man|c0
+fast|c0 man|c0 old|c0 dog|c0 the|c0 old|c0 man|c0
+tree|c0 cat|c0 child|c0 woman|c0 has|c0
+old|c0 sees|c0 red|c0 house|c0 big|c0 loves|c0
+small|c0 small|c0 sees|c0 the|c0
+blue|c0 the|c0 the|c0 loves|c0 the|c0 the|c0
+the|c0 the|c0 woman|c0 fast|c0 tree|c0 sees|c0
+man|c0 house|c0 child|c0 has|c0
+cat|c0 the|c0 man|c0 young|c0 blue|c0 child|c0 big|c0
+the|c0 young|c0 man|c0 tree|c0 old|c0 big|c0
+the|c0 the|c0 cat|c0 old|c0 woman|c0 man|c0 old|c0 loves|c0 child|c0
+cat|c0 loves|c0 big|c0 young|c0 red|c0
+the|c0 the|c0 red|c0 the|c0 big|c0 old|c0 dog|c0 woman|c0 cat|c0
+has|c0 the|c0 child|c0 the|c0 woman|c0 young|c0 old|c0
+child|c0 woman|c0 red|c0 sees|c0
+house|c0 woman|c0 red|c0
+cat|c0 young|c0 blue|c0 tree|c0 the|c0 child|c0 has|c0
+child|c0 cat|c0 dog|c0
+man|c0 the|c0 woman|c0 loves|c0 sees|c0 dog|c0 the|c0 young|c0
+tree|c0 young|c0 young|c0 cat|c0 big|c0 cat|c0 man|c0 man|c0
+dog|c0 blue|c0 fast|c0 the|c0 sees|c0 dog|c0 the|c0 big|c0 child|c0
+has|c0 blue|c0 woman|c0 fast|c0 young|c0 young|c0
+small|c0 fast|c0 tree|c0
+red|c0 woman|c0 child|c0 young|c0 man|c0 dog|c0 woman|c0 fast|c0
+dog|c0 house|c0 the|c0 young|c0 the|c0 man|c0 sees|c0 house|c0 fast|c0
+small|c0 cat|c0 man|c0 tree|c0 the|c0 cat|c0 the|c0 big|c0 fast|c0
+big|c0 cat|c0 old|c0 man|c0 red|c0 young|c0 small|c0 big|c0 cat|c0
+has|c0 sees|c0 fast|c0 sees|c0 loves|c0 small|c0
+old|c0 fast|c0 tree|c0 has|c0
+tree|c0 the|c0 dog|c0 woman|c0
+the|c0 tree|c0 woman|c0 young|c0 the|c0
+cat|c0 old|c0 house|c0 the|c0 sees|c0 the|c0 dog|c0 cat|c0 old|c0
+small|c0 old|c0 woman|c0 man|c0
+the|c0 tree|c0 tree|c0 the|c0 red|c0 dog|c0 tree|c0
+has|c0 has|c0 woman|c0
+house|c0 loves|c0 the|c0 old|c0 man|c0
+tree|c0 cat|c0 old|c0 young|c0
+red|c0 big|c0 has|c0 big|c0 small|c0 tree|c0 child|c0
+house|c0 woman|c0 old|c0 dog|c0 small|c0 has|c0 cat|c0 the|c0
+has|c0 small|c0 child|c0 sees|c0 loves|c0 the|c0
+loves|c0 fast|c0 child|c0 woman|c0 young|c0 the|c0 small|c0
+child|c0 woman|c0 child|c0 young|c0
+cat|c0 dog|c0 house|c0
+sees|c0 big|c0 small|c0 the|c0 child|c0
+big|c0 sees|c0 the|c0
+loves|c0 has|c0 the|c0
+the|c0 child|c0 the|c0 young|c0
+man|c0 house|c0 blue|c0 the|c0 old|c0 woman|c0 small|c0
+woman|c0 loves|c0 woman|c0
+tree|c0 dog|c0 the|c0 the|c0
+cat|c0 red|c0 house|c0 big|c0 cat|c0 old|c0
+fast|c0 big|c0 blue|c0 old|c0 cat|c0 young|c0 fast|c0
+the|c0 has|c0 the|c0 woman|c0
+big|c0 tree|c0 cat|c0 big|c0 tree|c0 the|c0 sees|c0
+sees|c0 the|c0 loves|c0 loves|c0 young|c0
+has|c0 the|c0 tree|c0 big|c0
+man|c0 the|c0 the|c0 fast|c0 the|c0 blue|c0
+blue|c0 blue|c0 big|c0 fast|c0
+has|c0 red|c0 red|c0 dog|c0 the|c0 dog|c0 big|c0 small|c0
+small|c0 old|c0 has|c0 young|c0 has|c0
+blue|c0 dog|c0 sees|c0 man|c0 the|c0
+the|c0 fast|c0 fast|c0 old|c0
+the|c0 fast|c0 dog|c0 sees|c0 tree|c0
+fast|c0 old|c0 woman|c0 child|c0 house|c0 has|c0
+red|c0 woman|c0 the|c0 tree|c0 has|c0
+house|c0 has|c0 sees|c0 young|c0 man|c0 cat|c0 red|c0
+dog|c0 big|c0 woman|c0 red|c0 man|c0
+sees|c0 red|c0 young|c0 big|c0 woman|c0 red|c0 fast|c0
+loves|c0 fast|c0 big|c0 sees|c0 sees|c0 has|c0
+cat|c0 big|c0 loves|c0 small|c0 blue|c0 red|c0
+dog|c0 the|c0 the|c0 dog|c0 tree|c0 the|c0
+the|c0 tree|c0 big|c0 blue|c0 the|c0 the|c0 old|c0 house|c0
+red|c0 cat|c0 dog|c0
+small|c0 loves|c0 young|c0 child|c0 man|c0 child|c0
+the|c0 has|c0 dog|c0 small|c0 dog|c0 the|c0 blue|c0
+child|c0 tree|c0 small|c0 house|c0 fast|c0
+loves|c0 big|c0 blue|c0 woman|c0 blue|c0 the|c0 the|c0 young|c0 blue|c0

@@ -1,0 +1,96 @@
+small red has tree child man house house man
+blue cat big sees young
+loves tree woman the dog
+fast the blue red child blue
+loves small man big young young old fast red
+blue woman dog fast red the the the house
+woman house child big old old
+the has child fast has
+woman young sees blue the old loves child the
+old house the house red young
+blue big the
+old man young young red fast fast
+woman red child blue sees man loves
+house the blue
+red woman house fast loves small has small child
+sees the red
+small small old old
+small sees tree blue
+blue big house house blue
+child cat sees dog tree tree cat red man
+fast man old dog the old man
+tree cat child woman has
+old sees red house big loves
+small small sees the
+blue the the loves the the
+the the woman fast tree sees
+man house child has
+cat the man young blue child big
+the young man tree old big
+the the cat old woman man old loves child
+cat loves big young red
+the the red the big old dog woman cat
+has the child the woman young old
+child woman red sees
+house woman red
+cat young blue tree the child has
+child cat dog
+man the woman loves sees dog the young
+tree young young cat big cat man man
+dog blue fast the sees dog the big child
+has blue woman fast young young
+small fast tree
+red woman child young man dog woman fast
+dog house the young the man sees house fast
+small cat man tree the cat the big fast
+big cat old man red young small big cat
+has sees fast sees loves small
+old fast tree has
+tree the dog woman
+the tree woman young the
+cat old house the sees the dog cat old
+small old woman man
+the tree tree the red dog tree
+has has woman
+house loves the old man
+tree cat old young
+red big has big small tree child
+house woman old dog small has cat the
+has small child sees loves the
+loves fast child woman young the small
+child woman child young
+cat dog house
+sees big small the child
+big sees the
+loves has the
+the child the young
+man house blue the old woman small
+woman loves woman
+tree dog the the
+cat red house big cat old
+fast big blue old cat young fast
+the has the woman
+big tree cat big tree the sees
+sees the loves loves young
+has the tree big
+man the the fast the blue
+blue blue big fast
+has red red dog the dog big small
+small old has young has
+blue dog sees man the
+the fast fast old
+the fast dog sees tree
+fast old woman child house has
+red woman the tree has
+house has sees young man cat red
+dog big woman red man
+sees red young big woman red fast
+loves fast big sees sees has
+cat big loves small blue red
+dog the the dog tree the
+the tree big blue the the old house
+red cat dog
+small loves young child man child
+the has dog small dog the blue
+child tree small house fast
+loves big blue woman blue the the young blue

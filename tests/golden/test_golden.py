@@ -1,0 +1,195 @@
+"""Golden regression suite (reference: the marian-regression-tests repo
+pattern, SURVEY §4 — "the cheapest strong e2e signal"; VERDICT r1 #3).
+
+Five fixed-seed tiny configs mirroring BASELINE.json's benchmark families
+train for 20 updates on the committed corpus in tests/golden/data/; the
+per-update mean-CE trajectories and a greedy/beam decode are compared
+against committed expected files:
+
+    losses  — relative tolerance 1e-3 (CPU f32 determinism leaves headroom;
+              a forward-math change of ±ε > 1e-3 fails the suite)
+    decodes — exact token match
+
+Regenerate after an INTENDED numeric change with:
+
+    GOLDEN_REGEN=1 python -m pytest tests/golden -q
+"""
+
+import json
+import os
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common import prng
+from marian_tpu.data import BatchGenerator, Corpus, create_vocab
+from marian_tpu.data.vocab import DefaultVocab
+from marian_tpu.models.encoder_decoder import batch_to_arrays, create_model
+from marian_tpu.training.graph_group import GraphGroup
+
+pytestmark = pytest.mark.slow     # ~2.5 min CPU; always in the full run
+
+HERE = pathlib.Path(__file__).resolve().parent
+DATA = HERE / "data"
+EXPECTED = HERE / "expected"
+REGEN = bool(os.environ.get("GOLDEN_REGEN"))
+
+N_UPDATES = 20
+SEED = 1234
+
+COMMON = {
+    "precision": ["float32", "float32"],
+    "learn-rate": 0.05, "lr-warmup": "0", "optimizer": "adam",
+    "optimizer-params": [0.9, 0.98, 1e-9], "clip-norm": 1.0,
+    "cost-type": "ce-mean-words", "label-smoothing": 0.1,
+    "mini-batch": 16, "maxi-batch": 4, "maxi-batch-sort": "src",
+    "shuffle": "data", "seed": SEED, "max-length": 24,
+    "exponential-smoothing": 0.0,
+}
+
+# the 5 baseline config families (BASELINE.json), scaled to CPU-tiny
+CONFIGS = {
+    "transformer-base": {
+        "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
+        "tied-embeddings-all": True,
+        "transformer-ffn-activation": "relu",
+    },
+    "transformer-big-prenorm": {
+        "type": "transformer", "dim-emb": 48, "transformer-heads": 4,
+        "transformer-dim-ffn": 96, "enc-depth": 2, "dec-depth": 2,
+        "tied-embeddings-all": True,
+        "transformer-preprocess": "n", "transformer-postprocess": "da",
+        "transformer-postprocess-top": "n",
+        "transformer-ffn-activation": "swish",
+    },
+    "s2s": {
+        "type": "s2s", "dim-emb": 32, "dim-rnn": 48,
+        "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
+        "dec-cell": "gru", "layer-normalization": False,
+        "tied-embeddings": True,
+    },
+    "multi-source": {
+        "type": "multi-transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 1, "dec-depth": 2,
+        "tied-embeddings": True,
+    },
+    "aan-decoder": {
+        "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
+        "tied-embeddings-all": True,
+        "transformer-decoder-autoreg": "average-attention",
+        "transformer-dim-aan": 64,
+    },
+}
+
+
+def _streams(name):
+    src = str(DATA / "train.src")
+    trg = str(DATA / "train.trg")
+    if name == "multi-source":
+        return [src, src, trg]          # doc-context style: 2 source streams
+    return [src, trg]
+
+
+def _build(name):
+    cfg = CONFIGS[name]
+    opts = Options({**COMMON, **cfg})
+    paths = _streams(name)
+    if cfg.get("tied-embeddings-all"):
+        # tied-all requires one joint vocabulary (Marian convention)
+        lines = []
+        for p in dict.fromkeys(paths):
+            lines += pathlib.Path(p).read_text().splitlines()
+        joint = DefaultVocab.build(lines)
+        vocabs = [joint] * len(paths)
+    else:
+        vocabs = [DefaultVocab.build(pathlib.Path(p).read_text().splitlines())
+                  for p in paths]
+    corpus = Corpus(paths, vocabs, opts)
+    src_side = vocabs[:-1] if len(vocabs) > 2 else vocabs[0]
+    model = create_model(opts, src_side, vocabs[-1])
+    return opts, vocabs, corpus, model
+
+
+def _train(name):
+    opts, vocabs, corpus, model = _build(name)
+    gg = GraphGroup(model, opts)
+    key = prng.root_key(SEED)
+    gg.initialize(prng.stream(key, prng.STREAM_INIT))
+    train_key = prng.stream(key, prng.STREAM_DROPOUT)
+    losses = []
+    step = 0
+    while step < N_UPDATES:
+        bg = BatchGenerator(corpus, opts, prefetch=False)
+        for batch in bg:
+            arrays = batch_to_arrays(batch)
+            out = gg.update(arrays, step + 1,
+                            jax.random.fold_in(train_key, step))
+            losses.append(out.loss_sum / max(out.labels, 1.0))
+            step += 1
+            if step >= N_UPDATES:
+                break
+    return losses, gg, opts, vocabs, model
+
+
+def _decode(gg, opts, vocabs, model, name):
+    """Beam-6 decode of the first 8 training sentences through the real
+    BeamSearch (shapes bucketed like the translator driver)."""
+    from marian_tpu.translator.beam_search import BeamSearch
+    import jax.numpy as jnp
+    paths = _streams(name)
+    src_lines = pathlib.Path(paths[0]).read_text().splitlines()[:8]
+    svoc = vocabs[0]
+    enc = [svoc.encode(l) for l in src_lines]
+    ts = max(len(e) for e in enc)
+    ids = np.zeros((len(enc), ts), np.int32)
+    mask = np.zeros((len(enc), ts), np.float32)
+    for i, e in enumerate(enc):
+        ids[i, :len(e)] = e
+        mask[i, :len(e)] = 1.0
+    bopts = Options({"beam-size": 6, "normalize": 0.6, "max-length": 32,
+                     "seed": SEED})
+    bs = BeamSearch(model, [gg.params], None, bopts, vocabs[-1])
+    n_src = len(vocabs) - 1 if len(vocabs) > 2 else 1
+    if n_src > 1:
+        args = (tuple([jnp.asarray(ids)] * n_src),
+                tuple([jnp.asarray(mask)] * n_src))
+    else:
+        args = (jnp.asarray(ids), jnp.asarray(mask))
+    nbests = bs.search(*args)
+    tvoc = vocabs[-1]
+    return [tvoc.decode(nb[0]["tokens"]) for nb in nbests]
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_golden(name):
+    losses, gg, opts, vocabs, model = _train(name)
+    decodes = _decode(gg, opts, vocabs, model, name)
+
+    loss_file = EXPECTED / f"{name}_losses.json"
+    decode_file = EXPECTED / f"{name}_decode.txt"
+    if REGEN or not loss_file.exists():
+        loss_file.write_text(json.dumps([round(float(x), 8) for x in losses],
+                                        indent=0) + "\n")
+        decode_file.write_text("\n".join(decodes) + "\n")
+        if not REGEN:
+            pytest.skip(f"expected files for {name} regenerated; rerun")
+        return
+
+    expected_losses = json.loads(loss_file.read_text())
+    assert len(losses) == len(expected_losses)
+    np.testing.assert_allclose(np.asarray(losses),
+                               np.asarray(expected_losses), rtol=1e-3,
+                               err_msg=f"{name}: loss trajectory drifted "
+                                       f"(regenerate with GOLDEN_REGEN=1 if "
+                                       f"the change is intended)")
+    expected_decodes = decode_file.read_text().splitlines()
+    assert decodes == expected_decodes, (
+        f"{name}: beam-6 decodes drifted (GOLDEN_REGEN=1 if intended)")
+
+    # sanity: the model actually learned something in 20 updates
+    assert losses[-1] < losses[0]

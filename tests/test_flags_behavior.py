@@ -1,0 +1,159 @@
+"""Behavior tests for round-2 flag implementations: LR-warmup variants,
+early-stopping-on, embedding freezing, env-var interpolation, output
+sampling, gradient checkpointing (reference: the corresponding Marian flags;
+VERDICT r1 'stop silently ignoring flags')."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common.config_parser import ConfigParser
+from marian_tpu.optimizers.schedule import LRSchedule
+from marian_tpu.training.scheduler import Scheduler
+from marian_tpu.training.training_state import TrainingState
+
+from test_model import tiny_model, fake_batch
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(3)
+
+
+class TestLRScheduleVariants:
+    def test_warmup_offset_restarts_ramp(self):
+        s = LRSchedule(base_lr=1.0, warmup=10)
+        assert float(s(5)) == pytest.approx(0.5)
+        s.warmup_offset = 100
+        assert float(s(105)) == pytest.approx(0.5)
+        assert float(s(101)) == pytest.approx(0.1)
+
+    def test_warmup_cycle_sawtooth(self):
+        s = LRSchedule(base_lr=1.0, warmup=10, warmup_cycle=True)
+        assert float(s(25)) == pytest.approx(0.5)
+        assert float(s(10)) == pytest.approx(1.0)
+        assert float(s(11)) == pytest.approx(0.1)
+
+    def test_from_options_reads_cycle(self):
+        s = LRSchedule.from_options(Options({
+            "learn-rate": 1e-3, "lr-warmup": "16",
+            "lr-warmup-cycle": True}))
+        assert s.warmup_cycle
+
+
+class TestEarlyStopping:
+    def _sched(self, **over):
+        opts = Options({"valid-metrics": ["cross-entropy", "bleu"],
+                        "early-stopping": 2, **over})
+        return Scheduler(opts, TrainingState())
+
+    def test_epsilon_margin(self):
+        sc = self._sched(**{"early-stopping-epsilon": [0.5]})
+        assert sc.register_validation("cross-entropy", 10.0)
+        # 9.8 improves by only 0.2 < eps 0.5 → stalled
+        assert not sc.register_validation("cross-entropy", 9.8)
+        assert sc.state.stalled == 1
+        assert sc.register_validation("cross-entropy", 9.0)
+        assert sc.state.stalled == 0
+
+    def test_early_stopping_on_any_vs_all(self):
+        for mode, expected in (("any", 1), ("all", 0), ("first", 0)):
+            sc = self._sched(**{"early-stopping-on": mode})
+            sc.register_validation("cross-entropy", 10.0)
+            sc.register_validation("bleu", 20.0, lower_is_better=False)
+            sc.register_validation("cross-entropy", 9.0)   # improves
+            sc.register_validation("bleu", 19.0, lower_is_better=False)  # stalls
+            assert sc.state.stalled == expected, mode
+
+
+class TestEmbeddingFix:
+    def test_frozen_embeddings_do_not_move(self, rng):
+        from marian_tpu.training.graph_group import GraphGroup
+        opts = Options({
+            "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+            "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+            "tied-embeddings-all": True, "precision": ["float32", "float32"],
+            "learn-rate": 0.1, "optimizer": "adam", "clip-norm": 0.0,
+            "cost-type": "ce-mean-words", "embedding-fix-src": True,
+        })
+        from marian_tpu.models.encoder_decoder import create_model
+        model = create_model(opts, 23, 23)
+        gg = GraphGroup(model, opts)
+        gg.initialize(jax.random.key(0))
+        before = np.asarray(gg.params["Wemb"]).copy()
+        other_before = np.asarray(
+            gg.params["encoder_l1_self_Wq"]).copy()
+        batch = fake_batch(rng, b=8, ts=6, tt=7, vocab=23)
+        gg.update(dict(batch), 1, jax.random.key(1))
+        np.testing.assert_array_equal(np.asarray(gg.params["Wemb"]), before)
+        assert not np.allclose(np.asarray(gg.params["encoder_l1_self_Wq"]),
+                               other_before)
+
+
+class TestEnvInterpolation:
+    def test_config_env_vars(self, tmp_path):
+        os.environ["MTPU_TEST_DIR"] = str(tmp_path)
+        cfg = tmp_path / "c.yml"
+        cfg.write_text("interpolate-env-vars: true\n"
+                       "model: ${MTPU_TEST_DIR}/m.npz\n")
+        opts = ConfigParser("training").parse(
+            ["--config", str(cfg), "--train-sets", "a", "b"])
+        assert opts.get("model") == f"{tmp_path}/m.npz"
+
+    def test_relative_paths(self, tmp_path):
+        cfg = tmp_path / "c.yml"
+        cfg.write_text("relative-paths: true\nmodel: sub/m.npz\n")
+        opts = ConfigParser("training").parse(
+            ["--config", str(cfg), "--train-sets", "a", "b"])
+        assert opts.get("model") == str(tmp_path / "sub" / "m.npz")
+
+
+class TestOutputSampling:
+    def test_full_sampling_varies_and_topk_restricts(self, rng):
+        from marian_tpu.translator.beam_search import BeamSearch
+        model, params = tiny_model(vocab=17)
+        batch = fake_batch(rng, b=2, ts=5, tt=6, vocab=17)
+        outs = []
+        for seed in (1, 2):
+            opts = Options({"beam-size": 1, "max-length": 12,
+                            "output-sampling": ["full", "1.0"],
+                            "seed": seed})
+            bs = BeamSearch(model, [params], None, opts, None)
+            res = bs.search(batch["src_ids"], batch["src_mask"])
+            outs.append([h[0]["tokens"] for h in res])
+        # two seeds rarely produce identical samples for every sentence
+        # (untrained model ≈ uniform over 17 tokens × up to 12 positions)
+        assert outs[0] != outs[1]
+
+    def test_greedy_unchanged_without_sampling(self, rng):
+        from marian_tpu.translator.beam_search import BeamSearch
+        model, params = tiny_model(vocab=17)
+        batch = fake_batch(rng, b=2, ts=5, tt=6, vocab=17)
+        opts = Options({"beam-size": 1, "max-length": 12})
+        r1 = BeamSearch(model, [params], None, opts, None).search(
+            batch["src_ids"], batch["src_mask"])
+        r2 = BeamSearch(model, [params], None, opts, None).search(
+            batch["src_ids"], batch["src_mask"])
+        assert [h[0]["tokens"] for h in r1] == [h[0]["tokens"] for h in r2]
+
+
+class TestGradientCheckpointing:
+    def test_same_loss_and_grads(self, rng):
+        m1, p1 = tiny_model(vocab=19)
+        m2, p2 = tiny_model(vocab=19, **{"gradient-checkpointing": True})
+        batch = fake_batch(rng, b=3, ts=6, tt=7, vocab=19)
+
+        def loss(model, p):
+            total, _ = model.loss(p, batch, key=None, train=True)
+            return total
+
+        l1, g1 = jax.value_and_grad(lambda p: loss(m1, p))(p1)
+        l2, g2 = jax.value_and_grad(lambda p: loss(m2, p))(p2)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for k in g1:
+            np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                       rtol=1e-5, atol=1e-6)
