@@ -53,6 +53,10 @@ _COMMON = [
     _f("relative-paths", bool, False, "Paths in configs are relative to the config file", "general"),
     _f("dump-config", str, None, "Dump effective config and exit: full/minimal/expand", "general"),
     _f("sigterm", str, "save-and-exit", "SIGTERM behavior: save-and-exit or exit-immediately", "general"),
+    _f("profile", str, None, "Capture a jax.profiler device trace to this directory around a training-update window (TPU extension; view with tensorboard)", "general", "?"),
+    _f("profile-start", int, 10, "First update of the profiler trace window", "general"),
+    _f("profile-updates", int, 5, "Number of updates to trace", "general"),
+    _f("dump-hlo", str, None, "Write jaxpr + optimized HLO of the compiled train step to this path prefix and continue (graph-dump debugging equivalent)", "general"),
     _f("authors", bool, False, "Print list of authors and exit", "general"),
     _f("cite", bool, False, "Print citation and exit", "general"),
     _f("build-info", str, None, "Print build info and exit", "general"),
@@ -577,6 +581,8 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
     "bert-class-symbol": ("warn", "classifier pooling uses the first "
                                   "position; the symbol itself is not "
                                   "re-inserted by the pipeline"),
+    "ulr-dim-emb": ("warn", "the ULR query dimension is taken from the "
+                            "key-vectors file, not this flag"),
     "interpolate-env-vars": ("none", "handled at config load"),
     "relative-paths": ("none", "handled at config load"),
     # -- would silently change training/decoding semantics: refuse --
@@ -584,10 +590,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
                                    "implemented"),
     "mini-batch-track-lr": ("error", "batch-size-tracking LR is not "
                                      "implemented"),
-    "embedding-vectors": ("error", "pretrained embedding import is not "
-                                   "implemented"),
-    "embedding-normalization": ("error", "embedding normalization is not "
-                                         "implemented"),
     "transformer-tied-layers": ("error", "cross-layer parameter tying is "
                                          "not implemented"),
     "transformer-pool": ("error", "pooled attention variant is not "
@@ -600,17 +602,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
     "factors-dim-emb": ("error", "concatenative factor embeddings are not "
                                  "implemented (sum combine only)"),
     "lemma-dim-emb": ("error", "lemma re-embedding is not implemented"),
-    "ulr": ("error", "ULR embeddings are not implemented"),
-    "ulr-dim-emb": ("error", "ULR embeddings are not implemented"),
-    "ulr-dropout": ("error", "ULR embeddings are not implemented"),
-    "ulr-keys-vectors": ("error", "ULR embeddings are not implemented"),
-    "ulr-query-vectors": ("error", "ULR embeddings are not implemented"),
-    "ulr-softmax-temperature": ("error", "ULR embeddings are not "
-                                         "implemented"),
-    "ulr-trainable-transformation": ("error", "ULR embeddings are not "
-                                              "implemented"),
-    "output-approx-knn": ("error", "the LSH output shortlist is not "
-                                   "implemented"),
 }
 
 

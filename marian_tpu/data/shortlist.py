@@ -63,9 +63,12 @@ class LexicalShortlistGenerator(ShortlistGenerator):
         else:
             self._load_text(path, src_vocab, trg_vocab, table, prune)
         self.table: Dict[int, np.ndarray] = {}
-        for s, lst in table.items():
-            lst.sort(reverse=True)
-            self.table[s] = np.array([t for _, t in lst[: self.best]], dtype=np.int32)
+        self.probs: Dict[int, np.ndarray] = {}   # real P(trg|src), kept so a
+        for s, lst in table.items():             # text→binary→text round trip
+            lst.sort(reverse=True)               # preserves pruning behavior
+            top = lst[: self.best]
+            self.table[s] = np.array([t for _, t in top], dtype=np.int32)
+            self.probs[s] = np.array([p for p, _ in top], dtype=np.float32)
         log.info("Loaded lexical shortlist with {} source entries", len(self.table))
 
     def _load_text(self, path, src_vocab, trg_vocab, table, prune):
@@ -92,10 +95,11 @@ class LexicalShortlistGenerator(ShortlistGenerator):
     def save_binary(self, path: str) -> None:
         srcs, trgs, probs = [], [], []
         for s, arr in self.table.items():
+            ps = self.probs[s]
             for rank, t in enumerate(arr):
                 srcs.append(s)
                 trgs.append(int(t))
-                probs.append(1.0 / (1 + rank))  # rank-preserving placeholder
+                probs.append(float(ps[rank]))
         np.savez(path if path.endswith(".npz") else path + ".npz",
                  srcs=np.array(srcs, np.int32), trgs=np.array(trgs, np.int32),
                  probs=np.array(probs, np.float32))

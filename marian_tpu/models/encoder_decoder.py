@@ -62,6 +62,26 @@ class EncoderDecoder:
                                              src_factors=src_factors,
                                              trg_factors=trg_factors,
                                              seq_mesh=seq_mesh)
+            if self.cfg.ulr and self.cfg.n_encoders > 1:
+                raise ValueError("--ulr does not support multi-source "
+                                 "models (one query table, one source "
+                                 "stream)")
+            if self.cfg.ulr and not inference:
+                # fixed ULR query/key tables feed init_params only; decode
+                # reloads them from the checkpoint (self-contained)
+                import os as _os
+                import dataclasses as _dc
+                from ..layers.embedding_io import (load_word2vec,
+                                                   load_word2vec_raw)
+                qf = str(options.get("ulr-query-vectors", "") or "")
+                kf = str(options.get("ulr-keys-vectors", "") or "")
+                if qf and kf and _os.path.exists(qf) and _os.path.exists(kf) \
+                        and not isinstance(src_vocab, (int, tuple, list)) \
+                        and hasattr(src_vocab, "__getitem__"):
+                    _, keys = load_word2vec_raw(kf)
+                    queries = load_word2vec(qf, src_vocab, keys.shape[1])
+                    self.cfg = _dc.replace(self.cfg, ulr_queries=queries,
+                                           ulr_keys=keys)
             self._mod = T
         elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s"):
             from . import s2s as S
@@ -142,24 +162,10 @@ class EncoderDecoder:
         if self._fused_ce_mode == "auto" and jax.default_backend() != "tpu":
             return None
         cfg = self.cfg
-        if getattr(cfg, "trg_factors", None) is not None:
-            return None
-        from ..ops.quantization import QTensor
         from ..ops.pallas.fused_ce import fused_available
         if not fused_available(int(cfg.dim_emb)):
             return None
-        if cfg.tied_embeddings_all:
-            t = cparams.get("Wemb")
-        elif cfg.tied_embeddings:
-            t = cparams.get("Wemb", cparams.get("decoder_Wemb"))
-        else:
-            w = cparams.get("decoder_ff_logit_out_W")
-            if w is None or isinstance(w, QTensor):
-                return None
-            return w.T                     # [E, V] → table orientation
-        if t is None or isinstance(t, QTensor):
-            return None
-        return t
+        return T._plain_output_table(cfg, cparams)
 
     def _fused_ce_loss(self, cparams, table, hidden, batch) -> RationalLoss:
         """Label-smoothed CE straight from decoder hidden states — logits

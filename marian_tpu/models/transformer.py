@@ -87,7 +87,16 @@ class TransformerConfig:
     depth_scaling: bool = False
     no_projection: bool = False
     decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
+    output_approx_knn: Tuple[int, ...] = ()   # --output-approx-knn (k, nbits)
     dim_aan: int = 2048                       # AAN FFN size (--transformer-dim-aan)
+    # ULR (--ulr): fixed query/key tables are carried here as host arrays
+    # for init_params only; the forward pass reads them from params (so
+    # checkpoints are self-contained and decode needs no vector files)
+    ulr: bool = False
+    ulr_temperature: float = 1.0
+    ulr_dropout: float = 0.0
+    ulr_queries: Any = None                   # np [V_src, dq] or None
+    ulr_keys: Any = None                      # np [V_u, dq] or None
     rnn_projection: bool = False              # --transformer-rnn-projection
     flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
     gradient_checkpointing: bool = False      # jax.checkpoint per layer
@@ -177,6 +186,12 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         no_projection=bool(g("transformer-no-projection", False)),
         decoder_autoreg=_check_autoreg(
             str(g("transformer-decoder-autoreg", "self-attention"))),
+        output_approx_knn=tuple(
+            int(v) for v in (g("output-approx-knn", []) or [])),
+        ulr=bool(g("ulr", False)),
+        ulr_temperature=float(g("ulr-softmax-temperature", 1.0) or 1.0),
+        ulr_dropout=0.0 if for_inference else float(g("ulr-dropout", 0.0)
+                                                    or 0.0),
         dim_aan=int(g("transformer-dim-aan", 2048)),
         rnn_projection=bool(g("transformer-rnn-projection", False)),
         flash_attention=str(g("transformer-flash-attention", "auto")),
@@ -329,6 +344,18 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
         p["decoder_ff_logit_out_W"] = glorot((d, _trg_rows(cfg)))
     p["decoder_ff_logit_out_b"] = inits.zeros((1, _trg_rows(cfg)))
+
+    if cfg.ulr:
+        if cfg.ulr_queries is None or cfg.ulr_keys is None:
+            raise ValueError(
+                "--ulr training requires --ulr-query-vectors and "
+                "--ulr-keys-vectors files matching the source vocabulary")
+        q = jnp.asarray(cfg.ulr_queries, jnp.float32)
+        kk_ = jnp.asarray(cfg.ulr_keys, jnp.float32)
+        p["ulr_Q"] = q                           # fixed (frozen in updates)
+        p["ulr_K"] = kk_                         # fixed
+        p["ulr_A"] = jnp.eye(q.shape[1], dtype=jnp.float32)
+        p["ulr_Wu"] = glorot((kk_.shape[0], d))  # universal value embs
     return p
 
 
@@ -644,10 +671,35 @@ def _add_pos(cfg: TransformerConfig, params: Params, x: jax.Array,
     return x + sinusoidal_positions_dynamic(t, cfg.dim_emb, start_pos).astype(x.dtype)
 
 
+def _ulr_embed(cfg: TransformerConfig, params: Params, ids: jax.Array,
+               key, train: bool) -> jax.Array:
+    """Universal Language Representation term for source tokens
+    (reference: src/layers/embedding.cpp :: ULREmbedding; Gu et al. 2018
+    'Universal NMT for Extremely Low Resource Languages'): the token's
+    fixed query vector attends (via a trainable transform A) over the
+    fixed universal key table; the softmax mixes trainable universal
+    value embeddings. Per-token computation — [B,T,Vu] scores, no
+    [V_src,Vu] table materialization."""
+    q = params["ulr_Q"][ids].astype(jnp.float32)         # [B,T,dq] fixed
+    k = params["ulr_K"].astype(jnp.float32)              # [Vu,dq] fixed
+    scores = jnp.einsum("btd,de,ve->btv", q, params["ulr_A"], k,
+                        preferred_element_type=jnp.float32)
+    alpha = jax.nn.softmax(scores / max(cfg.ulr_temperature, 1e-6), axis=-1)
+    u = jnp.einsum("btv,vd->btd", alpha,
+                   params["ulr_Wu"].astype(jnp.float32))
+    if train and cfg.ulr_dropout > 0.0 and key is not None:
+        u = dropout(u, cfg.ulr_dropout, jax.random.fold_in(key, 23))
+    return u.astype(cfg.compute_dtype)
+
+
 def _embed(cfg: TransformerConfig, params: Params, ids: jax.Array,
            side: str, key, train: bool, start_pos=0,
            enc_idx: int = 0) -> jax.Array:
     x = _embed_words(cfg, params, ids, side, enc_idx)
+    if cfg.ulr and side == "src":
+        # word and universal parts share Marian's sqrt(dim) embed factor
+        x = x + _ulr_embed(cfg, params, ids, key, train) \
+            * jnp.asarray(math.sqrt(cfg.dim_emb), cfg.compute_dtype)
     rate = cfg.dropout_src if side == "src" else cfg.dropout_trg
     x = _word_dropout(cfg, x, rate, key, train)
     return _add_pos(cfg, params, x, start_pos)
@@ -820,6 +872,24 @@ def _is_alignment_layer(cfg: TransformerConfig, l: int) -> bool:
     return l == int(gal)
 
 
+def _plain_output_table(cfg: TransformerConfig, params: Params):
+    """The [V, E] output table when it is a plain tensor (no factors, no
+    int8 quantization) — the cases the LSH index supports; else None."""
+    from ..ops.quantization import QTensor
+    if cfg.trg_factors is not None:
+        return None
+    if cfg.tied_embeddings_all:
+        t = params.get("Wemb")
+    elif cfg.tied_embeddings:
+        t = params.get("Wemb", params.get("decoder_Wemb"))
+    else:
+        w = params.get("decoder_ff_logit_out_W")
+        if w is None or isinstance(w, QTensor):
+            return None
+        return w.T
+    return None if (t is None or isinstance(t, QTensor)) else t
+
+
 def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
                   shortlist: Optional[jax.Array] = None) -> jax.Array:
     """Output projection with tied embeddings and optional shortlist slice
@@ -908,6 +978,21 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
                                               cfg.compute_dtype)
             state[f"l{l}_self_v"] = jnp.zeros((b, h, max_len, dh),
                                               cfg.compute_dtype)
+    if cfg.output_approx_knn:
+        # --output-approx-knn: LSH index over the output table (ops/lsh.py).
+        # Pure function of params, built once per compiled search; the
+        # entries are beam-invariant so the beam reorder leaves them alone.
+        table = _plain_output_table(cfg, params)
+        if table is None:
+            raise ValueError("--output-approx-knn requires a plain-tensor "
+                             "output projection (no factored vocab, no "
+                             "int8-quantized table)")
+        from ..ops.lsh import build_index
+        nbits = cfg.output_approx_knn[1] if len(cfg.output_approx_knn) > 1 \
+            else 1024
+        planes, sigs = build_index(table, nbits)
+        state["lsh_planes"] = planes
+        state["lsh_signatures"] = sigs
     return state
 
 
@@ -993,7 +1078,17 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
                       f"decoder_l{l}_ffn_ffn", params, None, False)
     x = _pre_post(cfg, _strip_dropout(cfg.postprocess_top), x, None,
                   "decoder_top", params, None, False)
-    logits = output_logits(cfg, params, x[:, 0, :], shortlist)
+    if cfg.output_approx_knn and shortlist is None \
+            and "lsh_planes" in state:
+        from ..ops.lsh import lsh_logits
+        table = _plain_output_table(cfg, params)
+        logits = lsh_logits(
+            x[:, 0, :], table,
+            params["decoder_ff_logit_out_b"].reshape(-1),
+            state["lsh_planes"], state["lsh_signatures"],
+            k=int(cfg.output_approx_knn[0]))
+    else:
+        logits = output_logits(cfg, params, x[:, 0, :], shortlist)
     new_state["pos"] = pos + 1
     if return_alignment:
         return logits, new_state, align

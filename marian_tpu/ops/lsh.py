@@ -1,0 +1,87 @@
+"""LSH approximate-kNN output vocabulary search (--output-approx-knn k nbits).
+
+Rebuild of reference src/data/shortlist.h/.cpp :: LSHShortlist + the vendored
+faiss IndexLSH subset (src/3rd_party/faiss). Semantics kept: random-
+hyperplane signatures over the output embedding rows; at every decode step
+the k rows whose signatures are hamming-closest to the decoder state's
+signature form the candidate set, and only those k logits are computed
+exactly.
+
+TPU redesign (vs faiss's CPU bucket probing): everything is dense, static-
+shaped tensor math inside the jitted decode step —
+
+    sign bits      x @ planes.T > 0        → jnp.packbits   [.., nbits/8]
+    hamming        popcount(xor)           → lax.population_count + sum
+    candidates     lax.top_k(-hamming, k)  (the beam-search top-k machinery)
+    exact logits   gather k table rows → batched dot → scatter into [V]
+                   at -1e9 elsewhere, so beam search runs unchanged in
+                   full-vocab coordinates.
+
+EOS always gets its exact logit (a hypothesis must be able to finish even
+when EOS's signature is far — the reference forces EOS/UNK into the LSH
+shortlist too).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+_LSH_SEED = 0x15A9  # fixed: signatures must match across processes/calls
+
+
+def lsh_planes(dim: int, nbits: int, dtype=jnp.float32) -> jax.Array:
+    """[nbits, dim] random hyperplanes (deterministic seed: an index built
+    at save time stays valid at load time)."""
+    key = jax.random.key(_LSH_SEED)
+    return jax.random.normal(key, (nbits, dim), dtype)
+
+
+def pack_signatures(x: jax.Array, planes: jax.Array) -> jax.Array:
+    """Sign-bit signatures of rows of x [N, D] → packed uint8 [N, nbits/8]."""
+    bits = (x.astype(planes.dtype) @ planes.T) > 0
+    return jnp.packbits(bits.astype(jnp.uint8), axis=-1)
+
+
+def build_index(table: jax.Array, nbits: int) -> Tuple[jax.Array, jax.Array]:
+    """(planes [nbits, D], signatures [V, nbits/8]) for an output table
+    [V, D]. Pure function of the params — safe to compute under jit."""
+    planes = lsh_planes(table.shape[-1], nbits)
+    return planes, pack_signatures(table, planes)
+
+
+def hamming_topk(x: jax.Array, planes: jax.Array, signatures: jax.Array,
+                 k: int) -> jax.Array:
+    """Indices [N, k] of the k hamming-nearest table rows for each row of
+    x [N, D]. The [N, V, nbits/8] xor intermediate is fine at decode-step
+    batch sizes (N = batch×beam)."""
+    xs = pack_signatures(x, planes)                       # [N, W]
+    xored = jnp.bitwise_xor(xs[:, None, :], signatures[None, :, :])
+    ham = jax.lax.population_count(xored).astype(jnp.int32).sum(-1)  # [N, V]
+    _, idx = jax.lax.top_k(-ham, k)
+    return idx
+
+
+def lsh_logits(x: jax.Array, table: jax.Array, bias: jax.Array,
+               planes: jax.Array, signatures: jax.Array, k: int,
+               eos_id: int = 0) -> jax.Array:
+    """Approximate output logits [N, V]: exact dot products on the k LSH
+    candidates (+ EOS), NEG_INF elsewhere. x [N, D], table [V, D], bias [V].
+    """
+    n = x.shape[0]
+    v = table.shape[0]
+    idx = hamming_topk(x, planes, signatures, k)          # [N, k]
+    rows = table[idx]                                     # [N, k, D]
+    lg = jnp.einsum("nd,nkd->nk", x.astype(jnp.float32),
+                    rows.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    lg = lg + bias[idx].astype(jnp.float32)
+    out = jnp.full((n, v), NEG_INF, jnp.float32)
+    out = out.at[jnp.arange(n)[:, None], idx].set(lg)
+    # EOS exactly, always
+    eos_lg = (x.astype(jnp.float32) @ table[eos_id].astype(jnp.float32)
+              + bias[eos_id].astype(jnp.float32))
+    return out.at[:, eos_id].set(eos_lg)

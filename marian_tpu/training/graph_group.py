@@ -74,23 +74,28 @@ class GraphGroup:
         self._update_fn = None
         self._fix_src = bool(options.get("embedding-fix-src", False))
         self._fix_trg = bool(options.get("embedding-fix-trg", False))
+        self._dump_hlo = options.get("dump-hlo", None)
 
     def _frozen_names(self) -> frozenset:
-        """Embedding tables excluded from updates (--embedding-fix-src/trg).
-        With tied embeddings the shared table freezes if either side is
-        fixed (reference: Embedding with trainable=false on the same
-        tensor)."""
-        if not (self._fix_src or self._fix_trg):
-            return frozenset()
+        """Params excluded from updates: --embedding-fix-src/trg tables
+        (with tied embeddings the shared table freezes if either side is
+        fixed — reference: Embedding with trainable=false), plus the fixed
+        ULR query/key tables (and A unless --ulr-trainable-transformation)."""
         names = set()
-        for k in self.params:
-            is_src = (k.endswith("_Wemb") and not k.startswith("decoder")) \
-                or (k == "Wemb")
-            is_trg = k in ("decoder_Wemb", "Wemb_dec") or (
-                k == "Wemb" and not any(
-                    o in self.params for o in ("decoder_Wemb", "Wemb_dec")))
-            if (self._fix_src and is_src) or (self._fix_trg and is_trg):
-                names.add(k)
+        if self._fix_src or self._fix_trg:
+            for k in self.params:
+                is_src = (k.endswith("_Wemb") and not k.startswith("decoder")) \
+                    or (k == "Wemb")
+                is_trg = k in ("decoder_Wemb", "Wemb_dec") or (
+                    k == "Wemb" and not any(
+                        o in self.params
+                        for o in ("decoder_Wemb", "Wemb_dec")))
+                if (self._fix_src and is_src) or (self._fix_trg and is_trg):
+                    names.add(k)
+        if "ulr_Q" in self.params:
+            names.update(("ulr_Q", "ulr_K"))
+            if not self.options.get("ulr-trainable-transformation", False):
+                names.add("ulr_A")
         return frozenset(names)
 
     def rebuild(self) -> None:
@@ -189,6 +194,12 @@ class GraphGroup:
             batches = [batches]
         if len(batches) == 1:
             b = M.shard_batch(batches[0], self.mesh)
+            if self._dump_hlo:
+                from ..common.profiling import dump_lowered
+                dump_lowered(self._dump_hlo, self._fused.lower(
+                    self.params, self.opt_state, b,
+                    jnp.asarray(step, jnp.float32), rng))
+                self._dump_hlo = None
             self.params, self.opt_state, metrics = self._fused(
                 self.params, self.opt_state, b,
                 jnp.asarray(step, jnp.float32), rng)

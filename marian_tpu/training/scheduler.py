@@ -141,8 +141,8 @@ class Scheduler:
             val = s.labels_total / max(le.n, 1)
         elif le.unit == SchedulingUnit.UPDATES:
             val = s.batches / max(le.n, 1)
-        else:
-            return s.epochs + 1
+        else:  # e.g. '2e': one logical epoch = n data epochs
+            val = (s.epochs + 1) / max(le.n, 1)
         return f"{val:.{self.logical_epoch_width}f}"
 
     # -- triggers ------------------------------------------------------------

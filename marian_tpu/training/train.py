@@ -104,6 +104,33 @@ class Train:
                 .load_model(opts.get("pretrained-model"))
             init_params = {k: jnp.asarray(v) for k, v in host_params.items()}
 
+        emb_files = list(opts.get("embedding-vectors", []) or [])
+        if emb_files and init_params is None:
+            # --embedding-vectors src.vec [trg.vec]: word2vec-format init of
+            # the embedding tables (reference: Embedding with embFile);
+            # usually combined with --embedding-fix-src/trg
+            from ..layers.embedding_io import load_word2vec, normalize_rows
+            init_params = gg.model.init(prng.stream(key, prng.STREAM_INIT))
+            dim = int(opts.get("dim-emb", 512))
+            norm = bool(opts.get("embedding-normalization", False))
+
+            def load_into(name, path, vocab):
+                if name not in init_params:
+                    return
+                tab = load_word2vec(path, vocab, dim,
+                                    init=np.asarray(init_params[name]))
+                if norm:
+                    tab = normalize_rows(tab)
+                init_params[name] = jnp.asarray(tab)
+
+            src_name = "Wemb" if "Wemb" in init_params else "encoder_Wemb"
+            load_into(src_name, emb_files[0], vocabs[0])
+            if len(emb_files) > 1:
+                trg_name = ("decoder_Wemb" if "decoder_Wemb" in init_params
+                            else "Wemb_dec" if "Wemb_dec" in init_params
+                            else "Wemb")
+                load_into(trg_name, emb_files[1], vocabs[-1])
+
         # schedule factors are baked into the compiled step at trace time —
         # restore them BEFORE initialize() builds the jitted functions
         gg.schedule.decay_factor = state.factor
@@ -157,6 +184,8 @@ class Train:
             scheduler.maybe_decay_lr(gg.schedule, gg)
 
         # -- epoch loop ------------------------------------------------------
+        from ..common.profiling import TraceWindow
+        trace = TraceWindow(opts)
         train_key = prng.stream(key, prng.STREAM_DROPOUT)
         log.info("Training started")
         stop = False
@@ -169,6 +198,7 @@ class Train:
                 if len(micro) < delay:
                     continue
                 arrays = [batch_to_arrays(b) for b in micro]
+                trace.tick(state.batches + 1)
                 out = gg.update(arrays, state.batches + 1,
                                 jax.random.fold_in(train_key, state.batches))
                 scheduler.update(out.loss_sum, out.labels,
@@ -195,6 +225,7 @@ class Train:
                     break
             if not stop:
                 scheduler.new_epoch()
+        trace.close()
         log.info("Training finished")
         do_save()
 

@@ -1,0 +1,91 @@
+"""LSH approximate-kNN output search (--output-approx-knn; reference:
+src/data/shortlist.h :: LSHShortlist + vendored faiss IndexLSH subset)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.ops.lsh import build_index, hamming_topk, lsh_logits
+
+from test_model import tiny_model, fake_batch
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(11)
+
+
+class TestLSHCore:
+    def test_recall_vs_exact_topk(self, rng):
+        """Angular LSH with enough bits must recover most of the true
+        inner-product top-k (the recall bar VERDICT r1 set vs the lexical
+        shortlist, whose candidate sets routinely miss rare words)."""
+        v, d, n = 512, 32, 16
+        table = jnp.asarray(rng.randn(v, d), jnp.float32)
+        x = jnp.asarray(rng.randn(n, d), jnp.float32)
+        planes, sigs = build_index(table, nbits=1024)
+        idx = np.asarray(hamming_topk(x, planes, sigs, k=64))
+        exact = np.asarray(
+            jax.lax.top_k(x @ table.T, 8)[1])            # true top-8
+        hits = sum(len(set(exact[i]) & set(idx[i])) for i in range(n))
+        recall = hits / (n * 8)
+        assert recall >= 0.9, recall
+
+    def test_logits_match_exact_on_candidates(self, rng):
+        v, d, n = 128, 16, 4
+        table = jnp.asarray(rng.randn(v, d), jnp.float32)
+        bias = jnp.asarray(rng.randn(v), jnp.float32)
+        x = jnp.asarray(rng.randn(n, d), jnp.float32)
+        planes, sigs = build_index(table, nbits=256)
+        out = np.asarray(lsh_logits(x, table, bias, planes, sigs, k=16))
+        exact = np.asarray(x @ table.T + bias[None, :])
+        cand = out > -1e8
+        np.testing.assert_allclose(out[cand],
+                                   exact[cand], rtol=1e-5, atol=1e-5)
+        # EOS column always exact, candidates per row = k (+EOS)
+        np.testing.assert_allclose(out[:, 0], exact[:, 0], rtol=1e-5,
+                                   atol=1e-5)
+        assert (cand.sum(1) >= 16).all()
+
+
+class TestLSHDecode:
+    def test_full_k_matches_dense_decode(self, rng):
+        """k = V turns LSH into exact search — decode must equal the dense
+        path token-for-token."""
+        from marian_tpu.translator.beam_search import BeamSearch
+        model, params = tiny_model(vocab=23)
+        batch = fake_batch(rng, b=2, ts=5, tt=6, vocab=23)
+        dense = BeamSearch(model, [params], None,
+                           Options({"beam-size": 4, "max-length": 12}),
+                           None).search(batch["src_ids"], batch["src_mask"])
+        m2, _ = tiny_model(vocab=23, **{"output-approx-knn": [23, 256]})
+        approx = BeamSearch(m2, [params], None,
+                            Options({"beam-size": 4, "max-length": 12}),
+                            None).search(batch["src_ids"], batch["src_mask"])
+        assert [h[0]["tokens"] for h in dense] == \
+            [h[0]["tokens"] for h in approx]
+
+    def test_small_k_decodes_and_terminates(self, rng):
+        from marian_tpu.translator.beam_search import BeamSearch
+        model, params = tiny_model(vocab=64,
+                                   **{"output-approx-knn": [16, 512]})
+        batch = fake_batch(rng, b=2, ts=5, tt=6, vocab=64)
+        out = BeamSearch(model, [params], None,
+                         Options({"beam-size": 2, "max-length": 10}),
+                         None).search(batch["src_ids"], batch["src_mask"])
+        assert len(out) == 2
+        for nb in out:
+            assert len(nb[0]["tokens"]) <= 10
+
+    def test_factored_vocab_rejected(self):
+        from marian_tpu.models import transformer as T
+        model, params = tiny_model(vocab=23,
+                                   **{"output-approx-knn": [8, 128]})
+        import dataclasses
+        cfg = dataclasses.replace(model.cfg, trg_factors=object())
+        with pytest.raises(ValueError, match="plain-tensor"):
+            T.init_decode_state(cfg, params,
+                                jnp.zeros((1, 4, cfg.dim_emb)),
+                                jnp.ones((1, 4)), max_len=8)

@@ -1,0 +1,110 @@
+"""Upstream Marian checkpoint import (VERDICT r1 #10): the reference mount
+is still empty, so no real upstream .npz exists to load — instead this
+pins the exact upstream PARAMETER NAMING (reference: src/common/io.cpp ::
+loadItems naming as catalogued in SURVEY.md §2.5) and proves that an
+.npz written with those names + an embedded ``special:model.yml`` loads
+through common/io → create_model → beam decode. When the mount is fixed,
+pointing `_roundtrip` at a real upstream file is the only change needed."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from marian_tpu.common import Options
+from marian_tpu.common import io as mio
+from marian_tpu.models.encoder_decoder import create_model
+
+
+def _expected_transformer_names(enc_depth, dec_depth, tied_all=True,
+                                ln=False):
+    """The upstream marian transformer name set for --transformer-preprocess
+    '' --transformer-postprocess 'dan' (post-norm)."""
+    names = set()
+    names.add("Wemb" if tied_all else "decoder_Wemb")
+    if not tied_all:
+        names.add("encoder_Wemb")
+    names.add("decoder_ff_logit_out_b")
+    if not tied_all:
+        names.add("decoder_ff_logit_out_W")
+
+    def attn(prefix):
+        for s in ("Wq", "bq", "Wk", "bk", "Wv", "bv", "Wo", "bo"):
+            names.add(f"{prefix}_{s}")
+        names.add(f"{prefix}_Wo_ln_scale")
+        names.add(f"{prefix}_Wo_ln_bias")
+
+    def ffn(prefix):
+        for s in ("W1", "b1", "W2", "b2"):
+            names.add(f"{prefix}_{s}")
+        names.add(f"{prefix}_ffn_ln_scale")
+        names.add(f"{prefix}_ffn_ln_bias")
+
+    for l in range(1, enc_depth + 1):
+        attn(f"encoder_l{l}_self")
+        ffn(f"encoder_l{l}_ffn")
+    for l in range(1, dec_depth + 1):
+        attn(f"decoder_l{l}_self")
+        attn(f"decoder_l{l}_context")
+        ffn(f"decoder_l{l}_ffn")
+    return names
+
+
+CONFIG = {
+    "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+    "transformer-dim-ffn": 32, "enc-depth": 2, "dec-depth": 2,
+    "tied-embeddings-all": True, "precision": ["float32", "float32"],
+    "transformer-preprocess": "", "transformer-postprocess": "dan",
+    "max-length": 32,
+}
+
+
+class TestUpstreamNaming:
+    def test_init_params_match_upstream_name_set(self):
+        model = create_model(Options(dict(CONFIG)), 23, 23)
+        params = model.init(jax.random.key(0))
+        expected = _expected_transformer_names(2, 2, tied_all=True)
+        assert set(params) == expected, (
+            f"missing={sorted(expected - set(params))} "
+            f"extra={sorted(set(params) - expected)}")
+
+    def test_untied_name_set(self):
+        cfg = dict(CONFIG)
+        cfg["tied-embeddings-all"] = False
+        model = create_model(Options(cfg), 23, 23)
+        params = model.init(jax.random.key(0))
+        expected = _expected_transformer_names(2, 2, tied_all=False)
+        assert set(params) == expected
+
+
+class TestImportRoundTrip:
+    def _roundtrip(self, tmp_path, path=None):
+        """Write an upstream-named .npz (or take a real one via `path`),
+        then load → build → decode."""
+        if path is None:
+            model = create_model(Options(dict(CONFIG)), 23, 23)
+            params = {k: np.asarray(v) for k, v in
+                      model.init(jax.random.key(1)).items()}
+            path = str(tmp_path / "upstream.npz")
+            import yaml
+            cfg_yaml = yaml.safe_dump(dict(CONFIG))
+            mio.save_model(path, params, cfg_yaml)
+        host_params, cfg_yaml = mio.load_model(path)
+        assert cfg_yaml is not None
+        from marian_tpu.models.encoder_decoder import apply_embedded_config
+        opts = apply_embedded_config(Options({"max-length": 32}), cfg_yaml)
+        model = create_model(opts, 23, 23, inference=True)
+        from marian_tpu.translator.beam_search import BeamSearch
+        import jax.numpy as jnp
+        bs = BeamSearch(model,
+                        [{k: jnp.asarray(v) for k, v in host_params.items()}],
+                        None, Options({"beam-size": 2, "max-length": 10}),
+                        None)
+        src = jnp.asarray(np.arange(2, 8)[None, :].repeat(2, 0))
+        mask = jnp.ones_like(src, jnp.float32)
+        out = bs.search(src, mask)
+        assert len(out) == 2
+        return out
+
+    def test_constructed_upstream_npz_decodes(self, tmp_path):
+        self._roundtrip(tmp_path)

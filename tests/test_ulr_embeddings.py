@@ -1,0 +1,164 @@
+"""ULR embeddings + pretrained embedding import (reference:
+src/layers/embedding.cpp :: ULREmbedding / Embedding-with-embFile)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.vocab import DefaultVocab
+from marian_tpu.layers.embedding_io import (load_word2vec, load_word2vec_raw,
+                                            normalize_rows)
+from marian_tpu.models.encoder_decoder import create_model
+
+from test_model import fake_batch
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(13)
+
+
+@pytest.fixture
+def vocab():
+    return DefaultVocab.build(["aa bb cc dd ee ff gg hh"])
+
+
+def _write_vec(path, words, dim, rng, header=True):
+    with open(path, "w") as fh:
+        if header:
+            fh.write(f"{len(words)} {dim}\n")
+        for w in words:
+            fh.write(w + " " + " ".join(
+                f"{v:.4f}" for v in rng.randn(dim)) + "\n")
+
+
+class TestWord2Vec:
+    def test_load_maps_by_vocab_id(self, tmp_path, vocab, rng):
+        p = tmp_path / "v.vec"
+        _write_vec(str(p), ["bb", "dd", "zz"], 8, rng)
+        tab = load_word2vec(str(p), vocab, 8)
+        assert tab.shape == (len(vocab), 8)
+        assert np.abs(tab[vocab["bb"]]).sum() > 0
+        assert np.abs(tab[vocab["aa"]]).sum() == 0     # not in file
+        # unknown file word 'zz' must NOT clobber the UNK row
+        assert np.abs(tab[1]).sum() == 0
+
+    def test_raw_and_normalize(self, tmp_path, rng):
+        p = tmp_path / "k.vec"
+        _write_vec(str(p), ["u1", "u2", "u3"], 4, rng, header=False)
+        words, mat = load_word2vec_raw(str(p))
+        assert words == ["u1", "u2", "u3"] and mat.shape == (3, 4)
+        n = normalize_rows(mat)
+        np.testing.assert_allclose(np.linalg.norm(n, axis=1), 1.0,
+                                   rtol=1e-5)
+
+
+class TestULR:
+    def _model(self, tmp_path, vocab, rng, **over):
+        qf = tmp_path / "q.vec"
+        kf = tmp_path / "k.vec"
+        _write_vec(str(qf), ["aa", "bb", "cc", "dd"], 6, rng)
+        _write_vec(str(kf), [f"u{i}" for i in range(5)], 6, rng)
+        opts = Options({
+            "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+            "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+            "tied-embeddings-all": True, "precision": ["float32", "float32"],
+            "max-length": 32, "ulr": True,
+            "ulr-query-vectors": str(qf), "ulr-keys-vectors": str(kf),
+            "ulr-softmax-temperature": 0.5, **over,
+        })
+        model = create_model(opts, vocab, vocab)
+        return model, model.init(jax.random.key(0))
+
+    def test_params_and_forward(self, tmp_path, vocab, rng):
+        model, params = self._model(tmp_path, vocab, rng)
+        assert params["ulr_Q"].shape == (len(vocab), 6)
+        assert params["ulr_K"].shape == (5, 6)
+        assert params["ulr_A"].shape == (6, 6)
+        assert params["ulr_Wu"].shape == (5, 16)
+        batch = fake_batch(rng, b=2, ts=5, tt=6, vocab=len(vocab))
+        total, aux = model.loss(params, batch, key=None, train=False)
+        assert np.isfinite(float(total))
+
+    def test_ulr_changes_embeddings(self, tmp_path, vocab, rng):
+        model, params = self._model(tmp_path, vocab, rng)
+        batch = fake_batch(rng, b=2, ts=5, tt=6, vocab=len(vocab))
+        l1, _ = model.loss(params, batch, key=None, train=False)
+        p2 = dict(params)
+        p2["ulr_Wu"] = params["ulr_Wu"] + 1.0
+        l2, _ = model.loss(p2, batch, key=None, train=False)
+        assert float(l1) != float(l2)
+
+    def test_fixed_tables_frozen_in_training(self, tmp_path, vocab, rng):
+        from marian_tpu.training.graph_group import GraphGroup
+        qf = tmp_path / "q.vec"; kf = tmp_path / "k.vec"
+        _write_vec(str(qf), ["aa", "bb"], 6, rng)
+        _write_vec(str(kf), [f"u{i}" for i in range(4)], 6, rng)
+        opts = Options({
+            "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+            "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+            "tied-embeddings-all": True, "precision": ["float32", "float32"],
+            "learn-rate": 0.1, "optimizer": "adam", "clip-norm": 0.0,
+            "cost-type": "ce-mean-words", "max-length": 32,
+            "ulr": True, "ulr-query-vectors": str(qf),
+            "ulr-keys-vectors": str(kf),
+        })
+        model = create_model(opts, vocab, vocab)
+        gg = GraphGroup(model, opts)
+        gg.initialize(jax.random.key(0))
+        q0 = np.asarray(gg.params["ulr_Q"]).copy()
+        a0 = np.asarray(gg.params["ulr_A"]).copy()
+        wu0 = np.asarray(gg.params["ulr_Wu"]).copy()
+        batch = fake_batch(rng, b=8, ts=5, tt=6, vocab=len(vocab))
+        gg.update(dict(batch), 1, jax.random.key(1))
+        np.testing.assert_array_equal(np.asarray(gg.params["ulr_Q"]), q0)
+        np.testing.assert_array_equal(np.asarray(gg.params["ulr_A"]), a0)
+        assert not np.allclose(np.asarray(gg.params["ulr_Wu"]), wu0)
+
+    def test_missing_vectors_raise(self, vocab):
+        opts = Options({
+            "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+            "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+            "tied-embeddings-all": True, "max-length": 32, "ulr": True,
+        })
+        model = create_model(opts, vocab, vocab)
+        with pytest.raises(ValueError, match="ulr-query-vectors"):
+            model.init(jax.random.key(0))
+
+
+class TestEmbeddingVectorsCLI:
+    def test_train_with_pretrained_vectors(self, tmp_path, rng):
+        from marian_tpu.cli import marian_train
+        from marian_tpu.common import io as mio
+        src_lines = ["aa bb cc", "bb cc dd", "cc dd aa", "dd aa bb"] * 3
+        trg_lines = ["x y z", "y z w", "z w x", "w x y"] * 3
+        (tmp_path / "t.src").write_text("\n".join(src_lines) + "\n")
+        (tmp_path / "t.trg").write_text("\n".join(trg_lines) + "\n")
+        vec = tmp_path / "src.vec"
+        _write_vec(str(vec), ["aa", "bb", "cc", "dd"], 16, rng)
+        model = str(tmp_path / "m.npz")
+        marian_train.main([
+            "--type", "transformer",
+            "--train-sets", str(tmp_path / "t.src"), str(tmp_path / "t.trg"),
+            "--vocabs", str(tmp_path / "v.s.yml"), str(tmp_path / "v.t.yml"),
+            "--model", model, "--dim-emb", "16",
+            "--transformer-heads", "2", "--transformer-dim-ffn", "32",
+            "--enc-depth", "1", "--dec-depth", "1",
+            "--precision", "float32", "float32",
+            "--embedding-vectors", str(vec),
+            "--embedding-fix-src", "--embedding-normalization",
+            "--mini-batch", "8", "--learn-rate", "0.01",
+            "--after-batches", "4", "--disp-freq", "2u",
+            "--save-freq", "100u", "--seed", "1", "--max-length", "20",
+            "--quiet", "--cost-type", "ce-mean-words", "--overwrite",
+        ])
+        params, _ = mio.load_model(model)
+        emb = params["encoder_Wemb"] if "encoder_Wemb" in params \
+            else params["Wemb"]
+        from marian_tpu.data.vocab import DefaultVocab
+        v = DefaultVocab.load(str(tmp_path / "v.s.yml"))
+        # fixed + normalized pretrained row survived training unchanged
+        row = np.asarray(emb[v["aa"]], np.float32)
+        np.testing.assert_allclose(np.linalg.norm(row), 1.0, rtol=1e-4)
