@@ -124,7 +124,7 @@ def main():
     step = 0
     by_shape = {}
     for b in timed_batches:
-        by_shape.setdefault(b.shape_key, b)
+        by_shape.setdefault(b.shape_key(), b)
     for b in by_shape.values():
         gg.update(batch_to_arrays(b), step + 1,
                   jax.random.fold_in(train_key, step))

@@ -586,17 +586,8 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
     "interpolate-env-vars": ("none", "handled at config load"),
     "relative-paths": ("none", "handled at config load"),
     # -- would silently change training/decoding semantics: refuse --
-    "mini-batch-warmup": ("error", "dynamic batch-size ramp-up is not "
-                                   "implemented"),
-    "mini-batch-track-lr": ("error", "batch-size-tracking LR is not "
-                                     "implemented"),
-    "transformer-tied-layers": ("error", "cross-layer parameter tying is "
-                                         "not implemented"),
     "transformer-pool": ("error", "pooled attention variant is not "
                                   "implemented"),
-    "unlikelihood-loss": ("error", "unlikelihood loss is not implemented"),
-    "force-decode": ("error", "constrained decoding is not implemented"),
-    "factor-weight": ("error", "factor loss re-weighting is not implemented"),
     "factors-combine": ("error-unless", "sum", "only sum-combination of "
                                               "factor embeddings"),
     "factors-dim-emb": ("error", "concatenative factor embeddings are not "

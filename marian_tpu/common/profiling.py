@@ -38,19 +38,21 @@ class TraceWindow:
         self.n_updates = int(options.get("profile-updates", 5) or 5)
         self._active = False
         self._done = False
+        self._started_at = 0
 
     def tick(self, update: int) -> None:
         """Call once per train-loop update with the 1-based update count."""
         if self.dir is None or self._done:
             return
         import jax
-        if not self._active and update == self.start_update:
+        if not self._active and update >= self.start_update:
             os.makedirs(self.dir, exist_ok=True)
             jax.profiler.start_trace(self.dir)
             self._active = True
+            self._started_at = update
             log.info("Profiler trace started at update {} → {}", update,
                      self.dir)
-        elif self._active and update >= self.start_update + self.n_updates:
+        elif self._active and update >= self._started_at + self.n_updates:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
@@ -66,29 +68,10 @@ class TraceWindow:
             self._done = True
 
 
-def dump_hlo(path: str, fn, *args, **kwargs) -> None:
-    """Write <path>.jaxpr.txt and <path>.hlo.txt for a jittable fn
-    (reference: ExpressionGraph::graphviz / --dump-graph). The optimized
-    HLO is post-fusion — what actually runs on the chip."""
-    import jax
-    lowered = jax.jit(fn).lower(*args, **kwargs)
-    base = path[:-4] if path.endswith(".txt") else path
-    with open(base + ".jaxpr.txt", "w") as fh:
-        fh.write(str(jax.make_jaxpr(fn)(*args, **kwargs)))
-    with open(base + ".hlo.txt", "w") as fh:
-        fh.write(lowered.as_text())
-    try:
-        compiled = lowered.compile()
-        with open(base + ".hlo_opt.txt", "w") as fh:
-            fh.write(compiled.as_text())
-    except Exception as e:  # noqa: BLE001 — optimized dump is best-effort
-        log.warn("optimized-HLO dump failed: {}", e)
-    log.info("Dumped jaxpr/HLO to {}.*", base)
-
-
 def dump_lowered(path: str, lowered) -> None:
-    """Like dump_hlo, but for an already-lowered jitted call (avoids
-    re-tracing; used by GraphGroup on the live train step)."""
+    """Write <path>.hlo.txt (stable HLO) and <path>.hlo_opt.txt (post-
+    fusion — what actually runs on the chip) for a lowered jitted call
+    (reference: ExpressionGraph::graphviz / --dump-graph debugging)."""
     base = path[:-4] if path.endswith(".txt") else path
     with open(base + ".hlo.txt", "w") as fh:
         fh.write(lowered.as_text())

@@ -173,7 +173,8 @@ class BatchGenerator:
                  shuffle_batches: Optional[bool] = None,
                  batch_multiple: int = 8, pad_batch: bool = True,
                  length_buckets=DEFAULT_LENGTH_BUCKETS,
-                 prefetch: bool = True, seed: int = 1):
+                 prefetch: bool = True, seed: int = 1,
+                 budget_scale=None):
         self.corpus = corpus
         if options is not None:
             mini_batch = int(options.get("mini-batch", mini_batch) or mini_batch)
@@ -197,6 +198,10 @@ class BatchGenerator:
         self.pad_batch = pad_batch
         self.length_buckets = length_buckets
         self.prefetch = prefetch
+        # --mini-batch-warmup: a callable returning a scale in (0, 1] that
+        # shrinks the effective batch early in training (checked per
+        # maxi-window, so ramp-up is window-granular)
+        self.budget_scale = budget_scale
         self._rs = np.random.RandomState(seed % (2**31))
         self.n_streams = len(corpus.vocabs)
 
@@ -219,18 +224,24 @@ class BatchGenerator:
                                           corpus_state=state,
                                           weighting_type=self.weighting_type))
 
+        scale = 1.0
+        if self.budget_scale is not None:
+            scale = max(min(float(self.budget_scale()), 1.0), 1e-3)
+        words_budget = max(int(self.mini_batch_words * scale), 1) \
+            if self.mini_batch_words > 0 else 0
+        rows_budget = max(int(self.mini_batch * scale), 1)
         for t in buf:
             lens = [len(s) for s in t.streams]
             new_maxlens = [max(a, b) for a, b in zip(cur_maxlens, lens)]
             n = len(cur) + 1
-            if self.mini_batch_words > 0:
+            if words_budget > 0:
                 # token budget on padded target size (Marian counts labels);
                 # use the bucketed width so the budget reflects real cost
                 padded = bucket_length(new_maxlens[-1], self.length_buckets) \
                     if self.pad_batch else new_maxlens[-1]
-                over = n * padded > self.mini_batch_words and len(cur) > 0
+                over = n * padded > words_budget and len(cur) > 0
             else:
-                over = n > self.mini_batch
+                over = n > rows_budget
             if over:
                 flush()
                 cur = []

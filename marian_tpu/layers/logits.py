@@ -56,16 +56,22 @@ def factored_embed(table: jax.Array, ft: FactorTables,
 
 
 def factored_log_probs(unit_logits: jax.Array, ft: FactorTables,
-                       shortlist: Optional[jax.Array] = None) -> jax.Array:
+                       shortlist: Optional[jax.Array] = None,
+                       factor_weight: float = 1.0) -> jax.Array:
     """[..., n_units] unit scores → [..., V] word log-probs.
 
     Per-group log-softmax over each unit slice, then for every word sum the
     log-probs of its units (reference: Logits::getLoss /
     Logits::getLogits combination). With a shortlist, only the shortlisted
-    words' rows of the index table are gathered (output [..., K_sl])."""
+    words' rows of the index table are gathered (output [..., K_sl]).
+    `factor_weight` (--factor-weight) scales the non-lemma groups'
+    contributions (reference: Logits applying factorWeight_)."""
     pieces = []
-    for _name, start, end in ft.group_slices:
-        pieces.append(jax.nn.log_softmax(unit_logits[..., start:end], axis=-1))
+    for gi, (_name, start, end) in enumerate(ft.group_slices):
+        lp = jax.nn.log_softmax(unit_logits[..., start:end], axis=-1)
+        if gi > 0 and factor_weight != 1.0:    # group 0 is the lemma
+            lp = lp * factor_weight
+        pieces.append(lp)
     # PAD unit (last) gets log-prob 0 so absent factors are no-ops
     logp = jnp.concatenate(
         pieces + [jnp.zeros_like(unit_logits[..., -1:])], axis=-1)

@@ -38,10 +38,28 @@ class RationalLoss:
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        mask: jax.Array, label_smoothing: float = 0.0,
-                       data_weights: Optional[jax.Array] = None) -> RationalLoss:
-    """logits [B,T,V], labels [B,T], mask [B,T] → summed CE over real tokens."""
-    ce = cross_entropy(logits, labels, label_smoothing)  # [B,T] f32
+                       data_weights: Optional[jax.Array] = None,
+                       unlikelihood: bool = False) -> RationalLoss:
+    """logits [B,T,V], labels [B,T], mask [B,T] → summed CE over real tokens.
+
+    unlikelihood (--unlikelihood-loss, reference: layers/loss.h ::
+    SequenceUnlikelihoodLoss): the sign of the data weight selects the
+    objective per token — weight > 0 trains likelihood (-w·log p), weight
+    < 0 trains UNlikelihood (-|w|·log(1-p)), pushing probability away from
+    tokens marked as negative evidence."""
     w = mask.astype(jnp.float32)
+    if unlikelihood and data_weights is not None:
+        dw = jnp.broadcast_to(data_weights.astype(jnp.float32), w.shape)
+        pos = dw >= 0
+        ce_like = cross_entropy(logits, labels, label_smoothing)      # [B,T]
+        logp = -cross_entropy(logits, labels, 0.0)                    # log p
+        # log(1-p) = log1p(-exp(logp)), clamped away from logp==0
+        log1mp = jnp.log1p(-jnp.exp(jnp.minimum(logp, -1e-6)))
+        ce = jnp.where(pos, ce_like, -log1mp)
+        w = w * jnp.abs(dw)
+        return RationalLoss(jnp.sum(ce * w),
+                            jnp.sum(mask.astype(jnp.float32)))
+    ce = cross_entropy(logits, labels, label_smoothing)  # [B,T] f32
     if data_weights is not None:
         w = w * jnp.broadcast_to(data_weights.astype(jnp.float32), w.shape)
     return RationalLoss(jnp.sum(ce * w), jnp.sum(mask.astype(jnp.float32)))

@@ -47,6 +47,7 @@ class EncoderDecoder:
         self.guided_weight = float(options.get("guided-alignment-weight", 0.1))
         self.multi_loss_type = str(options.get("multi-loss-type", "sum")
                                    or "sum")
+        self.unlikelihood = bool(options.get("unlikelihood-loss", False))
         self.guided_cost = str(options.get("guided-alignment-cost", "ce"))
         ga = options.get("guided-alignment", "none")
         self.use_guided = bool(ga and ga != "none") and not inference
@@ -127,12 +128,16 @@ class EncoderDecoder:
                                      batch["trg_mask"], train, k_dec,
                                      return_alignment=want_align, **kw)
         hidden, align = res if want_align else (res, None)
-        if table is not None:
+        if table is not None and not (self.unlikelihood
+                                      and "data_weights" in batch):
             rl = self._fused_ce_loss(cparams, table, hidden, batch)
         else:
+            if table is not None:      # fused path skipped for unlikelihood
+                hidden = self._mod.output_logits(self.cfg, cparams, hidden)
             rl = cross_entropy_loss(hidden, batch["trg_ids"],
                                     batch["trg_mask"], self.label_smoothing,
-                                    batch.get("data_weights"))
+                                    batch.get("data_weights"),
+                                    unlikelihood=self.unlikelihood)
         total = rl.loss_sum
         aux = {"ce_sum": rl.loss_sum, "labels": rl.labels}
         if want_align and align is not None:

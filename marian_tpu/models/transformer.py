@@ -48,6 +48,19 @@ BEAM_CARRIED_SUFFIXES = ("_self_k", "_self_v", "_aan_sum", "_rnn_c")
 _AUTOREG_MODES = ("self-attention", "average-attention", "rnn")
 
 
+def _tied(cfg: "TransformerConfig", l: int) -> int:
+    """Parameter-owning layer for physical layer l (1-based) under
+    --transformer-tied-layers; identity without tying."""
+    if cfg.tied_layers and l <= len(cfg.tied_layers):
+        t = cfg.tied_layers[l - 1]
+        if not 1 <= t <= l:
+            raise ValueError(
+                f"--transformer-tied-layers: layer {l} cannot share layer "
+                f"{t} (must reference an earlier or same layer)")
+        return t
+    return l
+
+
 def _check_autoreg(mode: str) -> str:
     if mode not in _AUTOREG_MODES:
         raise ValueError(
@@ -89,6 +102,12 @@ class TransformerConfig:
     decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
     output_approx_knn: Tuple[int, ...] = ()   # --output-approx-knn (k, nbits)
     dim_aan: int = 2048                       # AAN FFN size (--transformer-dim-aan)
+    # --transformer-tied-layers: 1-based map, entry i = the layer whose
+    # parameters layer i+1 SHARES (e.g. (1,1,1,1,1,1) = ALBERT-style all
+    # layers share layer 1). Applies to encoder and decoder stacks; runtime
+    # state (KV caches) stays per-physical-layer. Empty = no tying.
+    tied_layers: Tuple[int, ...] = ()
+    factor_weight: float = 1.0                # --factor-weight
     # ULR (--ulr): fixed query/key tables are carried here as host arrays
     # for init_params only; the forward pass reads them from params (so
     # checkpoints are self-contained and decode needs no vector files)
@@ -188,6 +207,12 @@ def config_from_options(options, src_vocab, trg_vocab: int,
             str(g("transformer-decoder-autoreg", "self-attention"))),
         output_approx_knn=tuple(
             int(v) for v in (g("output-approx-knn", []) or [])),
+        tied_layers=tuple(int(v) for v in
+                          (g("transformer-tied-layers", []) or [])),
+        # training-loss weighting only (reference: applyLossFunction scales
+        # factor losses; getLogits sums unweighted — decode parity)
+        factor_weight=1.0 if for_inference
+        else float(g("factor-weight", 1.0) or 1.0),
         ulr=bool(g("ulr", False)),
         ulr_temperature=float(g("ulr-softmax-temperature", 1.0) or 1.0),
         ulr_dropout=0.0 if for_inference else float(g("ulr-dropout", 0.0)
@@ -289,6 +314,8 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     for i in range(cfg.n_encoders):
         ep = _enc_prefix(i)
         for l in range(1, cfg.enc_depth + 1):
+            if _tied(cfg, l) != l:
+                continue                 # shares an earlier layer's params
             attn_block(f"{ep}_l{l}_self", l)
             ffn_block(f"{ep}_l{l}_ffn", cfg.dim_ffn, cfg.ffn_depth, l)
         if "n" in cfg.postprocess_top or "n" in cfg.preprocess:
@@ -328,6 +355,8 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             p[f"{prefix}_self_Wo_ln_bias"] = inits.zeros((1, d))
 
     for l in range(1, cfg.dec_depth + 1):
+        if _tied(cfg, l) != l:
+            continue
         if cfg.decoder_autoreg == "average-attention":
             aan_block(f"decoder_l{l}", l)
         elif cfg.decoder_autoreg == "rnn":
@@ -757,21 +786,22 @@ def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
 
     def enc_layer(x, l):
         lk = kk(l * 10)
+        pl = _tied(cfg, l)               # parameter-owning layer
         # self-attention sublayer
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"{ep}_l{l}_self_Wo", params, lk, train)
-        out, _ = _mha(cfg, params, f"{ep}_l{l}_self", pre, pre, attn_mask,
+                        f"{ep}_l{pl}_self_Wo", params, lk, train)
+        out, _ = _mha(cfg, params, f"{ep}_l{pl}_self", pre, pre, attn_mask,
                       lk, train, kv_mask=src_mask)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"{ep}_l{l}_self_Wo", params, lk, train)
+                      f"{ep}_l{pl}_self_Wo", params, lk, train)
         # ffn sublayer
         lk2 = kk(l * 10 + 5)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"{ep}_l{l}_ffn_ffn", params, lk2, train)
-        out = _ffn(cfg, params, f"{ep}_l{l}_ffn", pre, cfg.dim_ffn,
+                        f"{ep}_l{pl}_ffn_ffn", params, lk2, train)
+        out = _ffn(cfg, params, f"{ep}_l{pl}_ffn", pre, cfg.dim_ffn,
                    cfg.ffn_depth, lk2, train)
         return _pre_post(cfg, cfg.postprocess, out, x,
-                         f"{ep}_l{l}_ffn_ffn", params, lk2, train)
+                         f"{ep}_l{pl}_ffn_ffn", params, lk2, train)
 
     for l in range(1, cfg.enc_depth + 1):
         if cfg.gradient_checkpointing and train:
@@ -816,17 +846,18 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
 
     def dec_layer(x, l, want_align):
         lk = kk(l * 10)
+        pl = _tied(cfg, l)               # parameter-owning layer
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"decoder_l{l}_self_Wo", params, lk, train)
-        out = _autoreg_train(cfg, params, l, pre, self_mask, trg_mask,
+                        f"decoder_l{pl}_self_Wo", params, lk, train)
+        out = _autoreg_train(cfg, params, pl, pre, self_mask, trg_mask,
                              lk, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"decoder_l{l}_self_Wo", params, lk, train)
+                      f"decoder_l{pl}_self_Wo", params, lk, train)
 
         align_l = None
         # one cross-attention sublayer per encoder (multi-source stacks them)
         for i, eo in enumerate(enc_outs):
-            cname = f"decoder_l{l}_context{_ctx_suffix(i)}"
+            cname = f"decoder_l{pl}_context{_ctx_suffix(i)}"
             lk2 = kk(l * 10 + 3 + i)
             want_w = want_align and i == 0
             pre = _pre_post(cfg, cfg.preprocess, x, None,
@@ -841,11 +872,11 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
 
         lk3 = kk(l * 10 + 7)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"decoder_l{l}_ffn_ffn", params, lk3, train)
-        out = _ffn(cfg, params, f"decoder_l{l}_ffn", pre, cfg.dec_ffn,
+                        f"decoder_l{pl}_ffn_ffn", params, lk3, train)
+        out = _ffn(cfg, params, f"decoder_l{pl}_ffn", pre, cfg.dec_ffn,
                    cfg.dec_ffn_d, lk3, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"decoder_l{l}_ffn_ffn", params, lk3, train)
+                      f"decoder_l{pl}_ffn_ffn", params, lk3, train)
         return x, align_l
 
     for l in range(1, cfg.dec_depth + 1):
@@ -913,7 +944,8 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
         if cfg.trg_factors is not None:
             from ..layers.logits import factored_log_probs
             units = int8_logits(x, table, None) + b.astype(jnp.float32)
-            return factored_log_probs(units, cfg.trg_factors, shortlist)
+            return factored_log_probs(units, cfg.trg_factors, shortlist,
+                                      cfg.factor_weight)
         y = int8_logits(x, table, shortlist)
         bb = b if shortlist is None else b[:, shortlist]
         return y + bb.astype(jnp.float32)
@@ -935,7 +967,8 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
         units = jnp.dot(x, w.astype(x.dtype),
                         preferred_element_type=jnp.float32)
         units = units.astype(jnp.float32) + b.astype(jnp.float32)
-        return factored_log_probs(units, cfg.trg_factors, shortlist)
+        return factored_log_probs(units, cfg.trg_factors, shortlist,
+                                      cfg.factor_weight)
     if shortlist is not None:
         w = w[:, shortlist]
         b = b[:, shortlist]
@@ -959,7 +992,7 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
     for l in range(1, cfg.dec_depth + 1):
         for i, kv in enumerate(enc_outs):
-            cname = f"decoder_l{l}_context{_ctx_suffix(i)}"
+            cname = f"decoder_l{_tied(cfg, l)}_context{_ctx_suffix(i)}"
             sfx = _ctx_suffix(i)
             state[f"l{l}_cross_k{sfx}"] = _split_heads(
                 affine(kv, params[f"{cname}_Wk"], params[f"{cname}_bk"]), h)
@@ -1023,39 +1056,40 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
     align = None
     new_state = dict(state)
     for l in range(1, cfg.dec_depth + 1):
+        pl = _tied(cfg, l)               # parameter-owning layer
         pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
-                        f"decoder_l{l}_self_Wo", params, None, False)
+                        f"decoder_l{pl}_self_Wo", params, None, False)
         if cfg.decoder_autoreg == "average-attention":
             # running-sum cumulative average: y = (sum + x_t) / (pos+1)
             s = state[f"l{l}_aan_sum"] + pre.astype(jnp.float32)
             y = (s / (pos + 1).astype(jnp.float32)).astype(pre.dtype)
-            out = _aan_apply(cfg, params, l, pre, y)
+            out = _aan_apply(cfg, params, pl, pre, y)
             new_state[f"l{l}_aan_sum"] = s
         elif cfg.decoder_autoreg == "rnn":
             from ..ops.rnn import SSRU
             d = cfg.dim_emb
             cell = SSRU(d, d, False)
-            xp = cell.x_proj(params, f"decoder_l{l}_rnn", pre)
+            xp = cell.x_proj(params, f"decoder_l{pl}_rnn", pre)
             f, inp = xp[..., :d], xp[..., d:]
             c2 = f * state[f"l{l}_rnn_c"].astype(f.dtype) + inp
             out = jax.nn.relu(c2).astype(pre.dtype)
             if cfg.rnn_projection:
-                out = affine(out, params[f"decoder_l{l}_rnn_Wo"],
-                             params[f"decoder_l{l}_rnn_bo"])
+                out = affine(out, params[f"decoder_l{pl}_rnn_Wo"],
+                             params[f"decoder_l{pl}_rnn_bo"])
             new_state[f"l{l}_rnn_c"] = c2.astype(
                 state[f"l{l}_rnn_c"].dtype)
         else:
             cache = {"k": state[f"l{l}_self_k"], "v": state[f"l{l}_self_v"]}
-            out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre,
+            out, _ = _mha(cfg, params, f"decoder_l{pl}_self", pre, pre,
                           self_mask, None, False, cache=cache, cache_pos=pos)
             new_state[f"l{l}_self_k"] = cache["k"]
             new_state[f"l{l}_self_v"] = cache["v"]
         x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
-                      f"decoder_l{l}_self_Wo", params, None, False)
+                      f"decoder_l{pl}_self_Wo", params, None, False)
 
         for i in range(cfg.n_encoders):
             sfx = _ctx_suffix(i)
-            cname = f"decoder_l{l}_context{sfx}"
+            cname = f"decoder_l{pl}_context{sfx}"
             want_w = (return_alignment and i == 0
                       and _is_alignment_layer(cfg, l))
             pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
@@ -1071,11 +1105,11 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
                           f"{cname}_Wo", params, None, False)
 
         pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
-                        f"decoder_l{l}_ffn_ffn", params, None, False)
-        out = _ffn(cfg, params, f"decoder_l{l}_ffn", pre, cfg.dec_ffn,
+                        f"decoder_l{pl}_ffn_ffn", params, None, False)
+        out = _ffn(cfg, params, f"decoder_l{pl}_ffn", pre, cfg.dec_ffn,
                    cfg.dec_ffn_d, None, False)
         x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
-                      f"decoder_l{l}_ffn_ffn", params, None, False)
+                      f"decoder_l{pl}_ffn_ffn", params, None, False)
     x = _pre_post(cfg, _strip_dropout(cfg.postprocess_top), x, None,
                   "decoder_top", params, None, False)
     if cfg.output_approx_knn and shortlist is None \

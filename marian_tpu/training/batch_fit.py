@@ -1,0 +1,108 @@
+"""Automated batch fitting (--mini-batch-fit): find the largest
+--mini-batch-words token budget whose worst-case bucketed batch trains
+without exhausting device memory.
+
+Reference: src/training/graph_group.h :: GraphGroup::collectStats — Marian
+binary-searches the largest sentence count per length bin that fits
+--workspace by building throwaway graphs. The TPU redesign searches over
+ONE number (the token budget; data/batch_generator.py turns it into
+per-bucket row counts) by actually compiling + running the fused train
+step on a worst-case synthetic batch and catching the allocator's
+RESOURCE_EXHAUSTED. Real measurement, not a heuristic — XLA's buffer
+assignment is the ground truth and is not predictable analytically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..common import logging as log
+
+_WORDS_MIN = 256
+_WORDS_CAP = 131072
+
+
+def _oom(err: Exception) -> bool:
+    s = str(err)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s \
+        or "out of memory" in s
+
+
+def _try_budget(gg, words: int, max_len: int, vocab: int) -> bool:
+    """One throwaway train step on the worst-case batch for this budget:
+    every sentence at full max_len (the bucket table can never produce a
+    worse [rows, max_len] shape for the same budget)."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel import mesh as M
+    from ..parallel.zero import build_train_step
+
+    rows = max(8, (words // max_len) // 8 * 8)
+    r = np.random.RandomState(0)
+    batch = {
+        "src_ids": jnp.asarray(r.randint(2, vocab, (rows, max_len)),
+                               jnp.int32),
+        "src_mask": jnp.ones((rows, max_len), jnp.float32),
+        "trg_ids": jnp.asarray(r.randint(2, vocab, (rows, max_len)),
+                               jnp.int32),
+        "trg_mask": jnp.ones((rows, max_len), jnp.float32),
+    }
+    try:
+        step = build_train_step(gg.model, gg.opt_cfg, gg.schedule,
+                                gg.cost_type, gg.mesh, gg.params,
+                                gg.opt_state, delay=1, donate=False)
+        b = M.shard_batch(batch, gg.mesh)
+        p2, o2, _ = step(gg.params, gg.opt_state, b,
+                         jnp.asarray(1.0, jnp.float32), jax.random.key(0))
+        jax.block_until_ready(p2)
+        del p2, o2, step
+        return True
+    except Exception as e:  # noqa: BLE001 — OOM class varies by backend
+        if _oom(e):
+            return False
+        raise
+
+
+def fit_mini_batch_words(gg, opts, vocab_size: int,
+                         cap: Optional[int] = None) -> int:
+    """Grow-then-bisect the token budget. Called once at startup when
+    --mini-batch-fit is set; the result feeds BatchGenerator as
+    mini-batch-words. Each probe is a full compile (~20-40 s on TPU), so
+    the search is log-bounded (≤ ~8 probes)."""
+    max_len = int(opts.get("max-length", 50))
+    start = int(opts.get("mini-batch-words", 0) or 0) or 2048
+    cap = cap or _WORDS_CAP
+    lo, hi = 0, None
+    words = max(_WORDS_MIN, min(start, cap))
+    while True:
+        ok = _try_budget(gg, words, max_len, vocab_size)
+        log.info("mini-batch-fit probe: {} words → {}", words,
+                 "fits" if ok else "OOM")
+        if ok:
+            lo = words
+            if words >= cap:
+                break
+            if hi is None:
+                words = min(words * 2, cap)
+            else:
+                if hi - lo <= max(256, lo // 8):
+                    break
+                words = (lo + hi) // 2
+        else:
+            hi = words
+            if lo == 0:
+                words = words // 2
+                if words < _WORDS_MIN:
+                    raise RuntimeError(
+                        "mini-batch-fit: even the minimum batch does not "
+                        "fit device memory — reduce --max-length or model "
+                        "size")
+            else:
+                if hi - lo <= max(256, lo // 8):
+                    break
+                words = (lo + hi) // 2
+    log.info("mini-batch-fit: using mini-batch-words={} (max-length {})",
+             lo, max_len)
+    return lo

@@ -210,6 +210,13 @@ class GraphGroup:
         grads_acc = None
         for i, b in enumerate(batches):
             r = jax.random.fold_in(rng, i)
+            if self._dump_hlo:
+                # delay>1 path: dump the gradient step (the compute-heavy
+                # half of the accumulation cycle)
+                from ..common.profiling import dump_lowered
+                dump_lowered(self._dump_hlo, self._grad_fn.lower(
+                    self.params, M.shard_batch(b, self.mesh), r))
+                self._dump_hlo = None
             grads, aux = self._grad_fn(self.params, M.shard_batch(b, self.mesh), r)
             total_loss += float(aux["ce_sum"])
             total_labels += float(aux["labels"])

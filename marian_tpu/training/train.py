@@ -183,6 +183,45 @@ class Train:
                     do_save(suffix=".best-" + v.name)
             scheduler.maybe_decay_lr(gg.schedule, gg)
 
+        if opts.get("mini-batch-fit", False):
+            # empirical largest token budget on this device (batch_fit.py);
+            # feeds BatchGenerator as the mini-batch-words budget
+            from .batch_fit import fit_mini_batch_words
+            fitted = fit_mini_batch_words(gg, opts, len(vocabs[-1]))
+            opts.set("mini-batch-words", fitted)
+            if native_bg is not None:
+                # the native generator captured the pre-fit budget at
+                # construction — rebuild it with the fitted value
+                native_bg = _native_batch_generator(opts, train_sets, vocabs)
+
+        # --mini-batch-track-lr: scale LR with the actual batch size by
+        # anchoring Marian's reference-batch mechanism at the (possibly
+        # fitted) full token budget — the jitted step then multiplies lr
+        # (and Adam eps) by actual_words/ref_words every update. opt_cfg is
+        # baked into the compiled step, so rebuild after changing it.
+        if opts.get("mini-batch-track-lr", False) \
+                and not int(opts.get("mini-batch-words-ref", 0) or 0):
+            ref = int(opts.get("mini-batch-words", 0) or 0)
+            if ref > 0:
+                opts.set("mini-batch-words-ref", ref)
+                gg.opt_cfg.ref_mb_words = ref
+                gg.rebuild()
+                log.info("mini-batch-track-lr: LR tracks batch size "
+                         "(reference {} words)", ref)
+
+        # --mini-batch-warmup: ramp the effective batch (rows AND token
+        # budget) linearly over the first N updates
+        warmup_sched = opts.get("mini-batch-warmup", None)
+        budget_scale = None
+        if warmup_sched:
+            from ..common.scheduling_parameter import SchedulingParameter
+            wu = SchedulingParameter.parse(str(warmup_sched))
+            if wu.n > 0:
+                budget_scale = lambda: min(  # noqa: E731
+                    (state.batches + 1) / float(wu.n), 1.0)
+                log.info("mini-batch-warmup: ramping batch size over the "
+                         "first {} updates", wu.n)
+
         # -- epoch loop ------------------------------------------------------
         from ..common.profiling import TraceWindow
         trace = TraceWindow(opts)
@@ -191,7 +230,8 @@ class Train:
         stop = False
         while scheduler.keep_going() and not stop:
             bg = native_bg if native_bg is not None \
-                else BatchGenerator(corpus, opts)
+                else BatchGenerator(corpus, opts,
+                                    budget_scale=budget_scale)
             micro: List = []
             for batch in bg:
                 micro.append(batch)
@@ -244,7 +284,9 @@ def _native_batch_generator(opts, train_sets, vocabs):
                  and not opts.get("data-weighting", None)
                  # text augmentation hooks live only in the Python Corpus
                  and not int(opts.get("all-caps-every", 0) or 0)
-                 and not int(opts.get("english-title-case-every", 0) or 0))
+                 and not int(opts.get("english-title-case-every", 0) or 0)
+                 # batch-size ramp-up needs the Python budget_scale hook
+                 and not opts.get("mini-batch-warmup", None))
     if not supported:
         log.warn("--data-backend native does not support this data config "
                  "(needs plain word vocabs, no alignment/weighting); "

@@ -111,7 +111,8 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                     weights: Sequence[float], cfg: BeamConfig,
                     src_ids: jax.Array, src_mask: jax.Array,
                     shortlist: Optional[jax.Array] = None,
-                    sample_key: Optional[jax.Array] = None):
+                    sample_key: Optional[jax.Array] = None,
+                    prefix: Optional[jax.Array] = None):
     """The jittable core. Returns (tokens [B,K,L], raw_scores [B,K],
     lengths [B,K], norm_scores [B,K], alignments [B,K,L,Ts] or None).
 
@@ -179,6 +180,20 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         eos_onehot = jnp.where(jnp.arange(vocab)[None, None, :] == _eos_index(shortlist),
                                0.0, NEG_INF)
         logp = jnp.where(finished[:, :, None], eos_onehot, logp)
+
+        if prefix is not None:
+            # --force-decode: while t is inside a sentence's prefix, mask
+            # the distribution to the forced token — it keeps its TRUE
+            # model log-prob, so scores stay comparable after the prefix
+            # ends (reference: forced decoding of given target prefixes).
+            # prefix arrives padded to L with -1 (= unconstrained).
+            ptok = jax.lax.dynamic_index_in_dim(prefix, t, axis=1,
+                                                keepdims=False)   # [B]
+            forced = ptok >= 0
+            onehot_p = (jnp.arange(vocab)[None, None, :]
+                        == jnp.maximum(ptok, 0)[:, None, None])
+            gate = forced[:, None, None] & ~finished[:, :, None]
+            logp = jnp.where(gate & ~onehot_p, NEG_INF, logp)
 
         if cfg.sampling:
             # --output-sampling: each beam samples its own next token via
@@ -299,19 +314,28 @@ class BeamSearch:
             model, weights = self.model, tuple(self.weights)
 
             def fn(params_list, src_ids, src_mask, shortlist=None,
-                   sample_key=None):
+                   sample_key=None, prefix=None):
                 return beam_search_jit(model, list(params_list), weights, cfg,
                                        src_ids, src_mask, shortlist,
-                                       sample_key=sample_key)
+                                       sample_key=sample_key, prefix=prefix)
 
             self._jitted[key] = jax.jit(fn, static_argnames=())
         return self._jitted[key]
 
     def search(self, src_ids, src_mask,
-               shortlist=None) -> List[List[dict]]:
+               shortlist=None, prefix=None) -> List[List[dict]]:
         """Returns per-sentence n-best lists of dicts
         {tokens, score, norm_score, alignment}. src_ids/src_mask may be
-        tuples of streams (multi-source)."""
+        tuples of streams (multi-source). `prefix` [B, P] int32 (pad -1)
+        force-decodes each sentence's target prefix (--force-decode)."""
+        if prefix is not None and shortlist is not None:
+            raise ValueError("--force-decode cannot be combined with a "
+                             "lexical shortlist (prefix ids are full-vocab)")
+        if prefix is not None and getattr(self.model.cfg,
+                                          "output_approx_knn", ()):
+            raise ValueError("--force-decode cannot be combined with "
+                             "--output-approx-knn (a forced token outside "
+                             "the LSH candidate set would have no logit)")
         b, ts = _first(src_ids).shape
         # static decode cap per source bucket (Marian: factor * src length)
         L = int(min(self.max_length_cap,
@@ -330,9 +354,16 @@ class BeamSearch:
             self._sample_calls += 1
             sample_key = jax.random.fold_in(
                 jax.random.key(self._sample_seed), self._sample_calls)
+        pfx = None
+        if prefix is not None:
+            # pad/crop to the decode cap with -1 (unconstrained past end)
+            pfx = np.full((b, L), -1, np.int32)
+            p = np.asarray(prefix)[:, :L]
+            pfx[:, :p.shape[1]] = p
+            pfx = jnp.asarray(pfx)
         args = (tuple(self.params_list), _dev(src_ids), _dev(src_mask))
         tokens, scores, lengths, norm_scores, aligns = fn(
-            *args, shortlist=sl_idx, sample_key=sample_key)
+            *args, shortlist=sl_idx, sample_key=sample_key, prefix=pfx)
         return self._collect(np.asarray(tokens), np.asarray(scores),
                              np.asarray(lengths), np.asarray(norm_scores),
                              None if aligns is None else np.asarray(aligns),

@@ -75,16 +75,24 @@ class Translate:
 
     def _input_corpus(self, lines: Optional[List[str]] = None):
         n_src = len(self.src_vocab_list)
+        self._prefixes: Optional[List[List[int]]] = None
+        force = bool(self.options.get("force-decode", False))
         if lines is not None:
             if n_src > 1:
                 raise ValueError("multi-source decoding requires --input "
                                  "with one file per source stream")
+            if force:
+                raise ValueError("--force-decode needs --input files "
+                                 "(source + target-prefix)")
             return TextInput([lines], [self.src_vocab], self.options)
         inputs = self.options.get("input", ["stdin"])
         paths = inputs if isinstance(inputs, list) else [inputs]
-        if n_src > 1 and len(paths) != n_src:
-            raise ValueError(f"multi-source model expects {n_src} --input "
-                             f"files, got {len(paths)}")
+        n_expected = n_src + (1 if force else 0)
+        if len(paths) != n_expected and (n_src > 1 or force):
+            raise ValueError(
+                f"model expects {n_expected} --input files "
+                f"({n_src} source{' + target prefix' if force else ''}), "
+                f"got {len(paths)}")
         streams = []
         for path in paths[:max(n_src, 1)]:
             if path in ("stdin", "-"):
@@ -92,6 +100,21 @@ class Translate:
             else:
                 with open(path, "r", encoding="utf-8") as fh:
                     streams.append([l.rstrip("\n") for l in fh])
+        if force:
+            # the last input file holds target PREFIXES, one per source
+            # line (empty line = unconstrained); encoded without EOS so
+            # the hypothesis continues after the prefix
+            with open(paths[-1], "r", encoding="utf-8") as fh:
+                self._prefixes = [
+                    self.trg_vocab.encode(l.rstrip("\n"), add_eos=False)
+                    if l.strip() else []
+                    for l in fh]
+            if len(self._prefixes) != len(streams[0]):
+                raise ValueError(
+                    f"--force-decode: prefix file has "
+                    f"{len(self._prefixes)} lines but the source has "
+                    f"{len(streams[0])} — one (possibly empty) prefix "
+                    f"line per source sentence required")
         return TextInput(streams, self.src_vocab_list, self.options)
 
     def run(self, lines: Optional[List[str]] = None,
@@ -128,7 +151,18 @@ class Translate:
                 mask0 = src_mask[0] if isinstance(src_mask, tuple) else src_mask
                 shortlist = self.shortlist_gen.generate(
                     np.unique(ids0[mask0 > 0]))
-            nbests = self.search.search(src_ids, src_mask, shortlist=shortlist)
+            prefix = None
+            if self._prefixes is not None:
+                plen = max([1] + [len(self._prefixes[int(s)])
+                                  for s in batch.sentence_ids if s >= 0])
+                prefix = np.full((batch.src.ids.shape[0], plen), -1,
+                                 np.int32)
+                for row in range(real):
+                    sid = int(batch.sentence_ids[row])
+                    pf = self._prefixes[sid]
+                    prefix[row, :len(pf)] = pf
+            nbests = self.search.search(src_ids, src_mask,
+                                        shortlist=shortlist, prefix=prefix)
             for row in range(real):
                 sid = int(batch.sentence_ids[row])
                 text = self.printer.line(sid, nbests[row])

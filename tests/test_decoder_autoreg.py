@@ -99,3 +99,39 @@ class TestAutoregVariants:
         with pytest.raises(ValueError, match="not implemented"):
             tiny_model(vocab=17,
                        **{"transformer-decoder-autoreg": "nonsense"})
+
+
+class TestTiedLayers:
+    def test_albert_style_sharing(self, rng):
+        """--transformer-tied-layers 1 1: both layers share layer-1 params;
+        decode must still match teacher forcing (state stays per-layer)."""
+        model, params = tiny_model(
+            vocab=17, **{"transformer-tied-layers": [1, 1]})
+        assert not any("_l2_" in n for n in params)
+        # full masks: past a sentence's EOS the teacher-forced and
+        # step-by-step paths legitimately differ (train masks padded keys,
+        # the incremental cache has no such notion), so compare unpadded
+        batch = {
+            "src_ids": jnp.asarray(rng.randint(2, 17, (2, 5)), jnp.int32),
+            "src_mask": jnp.ones((2, 5), jnp.float32),
+            "trg_ids": jnp.asarray(rng.randint(2, 17, (2, 6)), jnp.int32),
+            "trg_mask": jnp.ones((2, 6), jnp.float32),
+        }
+        enc = model.encode_for_decode(params, batch["src_ids"],
+                                      batch["src_mask"])
+        full = T.decode_train(model.cfg, params, enc, batch["src_mask"],
+                              batch["trg_ids"], batch["trg_mask"],
+                              train=False)
+        state = model.start_state(params, enc, batch["src_mask"], max_len=8)
+        prev = jnp.zeros((2, 1), jnp.int32)
+        for t in range(batch["trg_ids"].shape[1]):
+            logits, state = model.step(params, state, prev,
+                                       batch["src_mask"])
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, t, :]),
+                                       rtol=2e-4, atol=2e-4)
+            prev = batch["trg_ids"][:, t:t + 1]
+
+    def test_forward_reference_raises(self):
+        with pytest.raises(ValueError, match="tied-layers"):
+            tiny_model(vocab=17, **{"transformer-tied-layers": [2, 2]})

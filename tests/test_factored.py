@@ -171,3 +171,19 @@ class TestFactoredModel:
         assert tokens.shape == (2, 2, 6)
         assert int(tokens.max()) < v
         assert np.all(np.isfinite(np.asarray(norm)))
+
+
+class TestFactorWeight:
+    def test_weight_scales_factor_groups_only(self, fvocab):
+        import jax
+        ft = FactorTables.from_vocab(fvocab)
+        units = jnp.asarray(
+            np.random.RandomState(5).randn(2, ft.n_units), jnp.float32)
+        base = factored_log_probs(units, ft)
+        half = factored_log_probs(units, ft, factor_weight=0.5)
+        # lemma-only words (e.g. </s>: all factor cols PAD) are unaffected
+        np.testing.assert_allclose(np.asarray(base[:, 0]),
+                                   np.asarray(half[:, 0]), rtol=1e-6)
+        # factored words shift by half their factor log-prob contribution
+        diff = np.asarray(base - half)
+        assert np.abs(diff[:, 2:]).max() > 0

@@ -60,8 +60,8 @@ def test_every_flag_read_or_registered():
 
 def test_error_flags_raise():
     parser = cp.ConfigParser("training")
-    opts = Options({"force-decode": True})
-    with pytest.raises(ValueError, match="force-decode"):
+    opts = Options({"transformer-pool": True})
+    with pytest.raises(ValueError, match="transformer-pool"):
         cp.audit_flags(opts, parser)
 
 

@@ -1,0 +1,58 @@
+"""--force-decode: constrained decoding of given target prefixes
+(reference: translator force-decoding of the extra input stream)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.translator.beam_search import BeamSearch
+
+from test_model import tiny_model, fake_batch
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(19)
+
+
+class TestForceDecode:
+    def test_prefix_is_respected(self, rng):
+        model, params = tiny_model(vocab=23)
+        batch = fake_batch(rng, b=2, ts=5, tt=6, vocab=23)
+        prefix = np.array([[5, 9, 2], [7, -1, -1]], np.int32)
+        bs = BeamSearch(model, [params], None,
+                        Options({"beam-size": 3, "max-length": 12}), None)
+        out = bs.search(batch["src_ids"], batch["src_mask"], prefix=prefix)
+        toks0 = out[0][0]["tokens"]
+        toks1 = out[1][0]["tokens"]
+        assert toks0[:3] == [5, 9, 2]
+        assert toks1[:1] == [7]
+
+    def test_scores_are_model_scores(self, rng):
+        """The forced token keeps its true log-prob: forcing the tokens the
+        model would pick anyway must not change the hypothesis score."""
+        model, params = tiny_model(vocab=23)
+        batch = fake_batch(rng, b=1, ts=5, tt=6, vocab=23)
+        opts = Options({"beam-size": 1, "max-length": 12})
+        free = BeamSearch(model, [params], None, opts, None).search(
+            batch["src_ids"], batch["src_mask"])
+        toks = free[0][0]["tokens"]
+        if len(toks) < 2:
+            pytest.skip("degenerate free decode")
+        prefix = np.asarray([toks[:2]], np.int32)
+        forced = BeamSearch(model, [params], None, opts, None).search(
+            batch["src_ids"], batch["src_mask"], prefix=prefix)
+        assert forced[0][0]["tokens"] == toks
+        assert forced[0][0]["score"] == pytest.approx(
+            free[0][0]["score"], rel=1e-4)
+
+    def test_shortlist_combination_rejected(self, rng):
+        model, params = tiny_model(vocab=23)
+        batch = fake_batch(rng, b=1, ts=5, tt=6, vocab=23)
+        bs = BeamSearch(model, [params], None,
+                        Options({"beam-size": 1, "max-length": 8}), None)
+        with pytest.raises(ValueError, match="shortlist"):
+            bs.search(batch["src_ids"], batch["src_mask"],
+                      shortlist=object(),
+                      prefix=np.zeros((1, 2), np.int32))
