@@ -67,13 +67,15 @@ _MODEL = [
     _f("model", str, "model.npz", "Path prefix for model to be saved/resumed", "model"),
     _f("pretrained-model", str, None, "Initialize weights from this model", "model"),
     _f("ignore-model-config", bool, False, "Ignore the config embedded in the model file", "model"),
-    _f("type", str, "amun", "Model type: transformer, s2s, nematus, amun, multi-s2s, multi-transformer, bert, bert-classifier, transformer-lm", "model"),
+    _f("type", str, "amun", "Model type: transformer, s2s, nematus, amun, multi-s2s, char-s2s, multi-transformer, bert, bert-classifier, transformer-lm", "model"),
     _f("dim-vocabs", int, [0, 0], "Maximum vocabulary sizes (0 = from vocab file)", "model", "+"),
     _f("dim-emb", int, 512, "Embedding vector size", "model"),
     _f("factors-dim-emb", int, 0, "Embedding size of factors (0 = sum combine)", "model"),
     _f("factors-combine", str, "sum", "How to combine factor embeddings: sum or concat", "model"),
     _f("lemma-dim-emb", int, 0, "Re-embedding dimension of lemma in factors", "model"),
     _f("dim-rnn", int, 1024, "RNN state size", "model"),
+    _f("char-stride", int, 5, "Width of max-pooling layer after convolution layer in char-s2s model", "model"),
+    _f("char-highway", int, 4, "Number of highway network layers after max-pooling in char-s2s model", "model"),
     _f("enc-type", str, "bidirectional", "Encoder type: bidirectional, bi-unidirectional, alternating", "model"),
     _f("enc-cell", str, "gru", "Encoder cell: gru, lstm, ssru, gru-nematus", "model"),
     _f("enc-cell-depth", int, 1, "Cells per encoder transition (deep transition)", "model"),

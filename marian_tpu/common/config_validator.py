@@ -20,8 +20,8 @@ def _validate_common_model(opts: Options) -> None:
         raise ValueError("--dim-emb must be positive")
     t = opts.get("type", "transformer")
     known = {"transformer", "s2s", "nematus", "amun", "multi-s2s",
-             "multi-transformer", "bert", "bert-classifier", "transformer-lm",
-             "lm", "lm-transformer"}
+             "char-s2s", "multi-transformer", "bert", "bert-classifier",
+             "transformer-lm", "lm", "lm-transformer"}
     if t not in known:
         raise ValueError(f"Unknown model --type '{t}' (known: {sorted(known)})")
     if t == "transformer" and opts.get("dim-emb", 512) % opts.get("transformer-heads", 8) != 0:

@@ -88,6 +88,7 @@ class Corpus:
         self.max_length = int(options.get("max-length", 50)) if options else 10**9
         self.max_length_crop = bool(options.get("max-length-crop", False)) if options else False
         self.shuffle_mode = (options.get("shuffle", "data") if options else "none")
+        self.right_left = bool(options.get("right-left", False)) if options else False
         self.all_caps_every = int(options.get("all-caps-every", 0)) if options else 0
         self.title_case_every = int(options.get("english-title-case-every", 0)) if options else 0
         self.state = state or CorpusState(
@@ -169,6 +170,11 @@ class Corpus:
                     ids = ids[: self.max_length] + [vocab.eos_id]
                 else:
                     return None
+            # --right-left: train the target right-to-left (reference:
+            # corpus rightLeft_ reversing the target stream, EOS stays last)
+            if self.right_left and si == len(self.vocabs) - 1 \
+                    and not self.inference:
+                ids = ids[-2::-1] + [ids[-1]]
             encoded.append(ids)
         align = None
         if getattr(self, "_aligns", None) is not None:

@@ -84,7 +84,8 @@ class EncoderDecoder:
                     self.cfg = _dc.replace(self.cfg, ulr_queries=queries,
                                            ulr_keys=keys)
             self._mod = T
-        elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s"):
+        elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s",
+                                 "char-s2s"):
             from . import s2s as S
             if isinstance(src_vocab_size, tuple):
                 raise NotImplementedError(
@@ -237,7 +238,8 @@ def create_model(options, src_vocab, trg_vocab,
 
 
 ARCH_KEY_PREFIXES = ("transformer", "enc-", "dec-", "dim-", "tied-",
-                     "factors-", "lemma-", "input-types", "bert-")
+                     "factors-", "lemma-", "input-types", "bert-", "char-",
+                     "ulr")
 ARCH_KEYS = ("type", "skip", "layer-normalization", "right-left",
              "max-length")
 

@@ -72,16 +72,30 @@ class S2SConfig:
     dropout_rnn: float = 0.0
     dropout_src: float = 0.0
     dropout_trg: float = 0.0
+    # char-s2s (reference: src/models/char_s2s.h :: CharS2SEncoder, the
+    # fully character-level conv+pool+highway front-end of Lee et al. 2017;
+    # the reference's cuDNN conv/pool wrappers → lax.conv/reduce_window):
+    char_conv: bool = False
+    char_stride: int = 5                 # --char-stride (pool width=stride)
+    char_highway: int = 4                # --char-highway layers
+    # filter widths 1..8 with Lee et al.'s counts (reference charcnn config)
+    conv_widths: Tuple[int, ...] = (1, 2, 3, 4, 5, 6, 7, 8)
+    conv_filters: Tuple[int, ...] = (200, 200, 250, 250, 300, 300, 300, 300)
     compute_dtype: Any = jnp.bfloat16
 
     @property
     def dim_ctx(self) -> int:            # bidirectional concat
         return 2 * self.dim_rnn
 
+    @property
+    def conv_dim(self) -> int:
+        return sum(self.conv_filters)
+
 
 def config_from_options(options, src_vocab: int, trg_vocab: int,
                         for_inference: bool = False) -> S2SConfig:
     g = options.get
+    char_conv = str(g("type", "s2s")) == "char-s2s"
     precision = g("precision", ["float32"])
     compute = precision[0] if isinstance(precision, list) else precision
     dtype = {"float32": jnp.float32, "float16": jnp.bfloat16,
@@ -108,6 +122,9 @@ def config_from_options(options, src_vocab: int, trg_vocab: int,
         dropout_rnn=0.0 if inf else float(g("dropout-rnn", 0.0)),
         dropout_src=0.0 if inf else float(g("dropout-src", 0.0)),
         dropout_trg=0.0 if inf else float(g("dropout-trg", 0.0)),
+        char_conv=char_conv,
+        char_stride=int(g("char-stride", 5)),
+        char_highway=int(g("char-highway", 4)),
         compute_dtype=dtype,
     )
 
@@ -183,6 +200,22 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
     else:
         p["Wemb"] = glorot((cfg.src_vocab, cfg.dim_emb))
         p["Wemb_dec"] = glorot((cfg.trg_vocab, cfg.dim_emb))
+
+    if cfg.char_conv:
+        # conv+pool+highway front-end (reference: CharS2SEncoder; Lee et
+        # al. 2017 charcnn widths/filters)
+        for w, f in zip(cfg.conv_widths, cfg.conv_filters):
+            p[f"encoder_char_conv_w{w}_W"] = glorot((w, cfg.dim_emb, f))
+            p[f"encoder_char_conv_w{w}_b"] = inits.zeros((1, f))
+        cd = cfg.conv_dim
+        for i in range(1, cfg.char_highway + 1):
+            p[f"encoder_char_highway_l{i}_W"] = glorot((cd, cd))
+            p[f"encoder_char_highway_l{i}_b"] = inits.zeros((1, cd))
+            p[f"encoder_char_highway_l{i}_Wg"] = glorot((cd, cd))
+            # gate bias < 0: start mostly carrying the input through
+            p[f"encoder_char_highway_l{i}_bg"] = inits.zeros((1, cd)) - 2.0
+        p["encoder_char_proj_W"] = glorot((cd, cfg.dim_emb))
+        p["encoder_char_proj_b"] = inits.zeros((1, cfg.dim_emb))
 
     for chain, _rev in _enc_chains(cfg):
         for prefix, cell in chain:
@@ -267,10 +300,64 @@ def _output_logits(cfg: S2SConfig, params: Params, state: jax.Array,
 # Encoder
 # ---------------------------------------------------------------------------
 
+def enc_mask(cfg: S2SConfig, src_mask: jax.Array) -> jax.Array:
+    """The mask the decoder attends with. char-s2s pools time by
+    char_stride, so the attention mask is the max-pooled source mask (a
+    pure function of src_mask — decode paths recompute it instead of
+    threading a second mask through the beam)."""
+    if not cfg.char_conv:
+        return src_mask
+    s = cfg.char_stride
+    t = src_mask.shape[1]
+    pad = (-t) % s
+    m = jnp.pad(src_mask, ((0, 0), (0, pad)))
+    return m.reshape(m.shape[0], -1, s).max(axis=2)
+
+
+def _char_conv_encode(cfg: S2SConfig, params: Params, x: jax.Array,
+                      mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[B, T, E] char embeddings → ([B, T/stride, E], pooled mask):
+    SAME-padded width-w convolutions → relu → concat → stride-s max pool →
+    highway stack → projection back to dim_emb for the RNN chains
+    (reference: CharS2SEncoder using the cuDNN conv/pool wrappers)."""
+    xm = x * mask[..., None].astype(x.dtype)
+    feats = []
+    for w in cfg.conv_widths:
+        kern = params[f"encoder_char_conv_w{w}_W"].astype(x.dtype)
+        y = jax.lax.conv_general_dilated(
+            xm, kern, window_strides=(1,), padding="SAME",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        y = y + params[f"encoder_char_conv_w{w}_b"].astype(x.dtype)
+        feats.append(jax.nn.relu(y))
+    h = jnp.concatenate(feats, axis=-1)                    # [B, T, F]
+    # masked max pool over non-overlapping stride windows
+    s = cfg.char_stride
+    t = h.shape[1]
+    pad = (-t) % s
+    h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)),
+                constant_values=0.0)
+    mpad = jnp.pad(mask, ((0, 0), (0, pad)))
+    h = jnp.where(mpad[..., None] > 0, h, -jnp.inf)
+    h = h.reshape(h.shape[0], -1, s, h.shape[-1]).max(axis=2)
+    h = jnp.where(jnp.isfinite(h), h, 0.0)                 # all-pad windows
+    for i in range(1, cfg.char_highway + 1):
+        pre = f"encoder_char_highway_l{i}"
+        tr = jax.nn.relu(h @ params[f"{pre}_W"].astype(h.dtype)
+                         + params[f"{pre}_b"].astype(h.dtype))
+        g = jax.nn.sigmoid(h @ params[f"{pre}_Wg"].astype(h.dtype)
+                           + params[f"{pre}_bg"].astype(h.dtype))
+        h = g * tr + (1.0 - g) * h
+    h = h @ params["encoder_char_proj_W"].astype(h.dtype) \
+        + params["encoder_char_proj_b"].astype(h.dtype)
+    pooled_mask = mpad.reshape(mpad.shape[0], -1, s).max(axis=2)
+    return h, pooled_mask
+
+
 def encode(cfg: S2SConfig, params: Params, src_ids: jax.Array,
            src_mask: jax.Array, train: bool = False,
            key: Optional[jax.Array] = None) -> jax.Array:
-    """[B, Ts] → [B, Ts, C] encoder context (reference: EncoderS2S::build)."""
+    """[B, Ts] → [B, Ts, C] encoder context (reference: EncoderS2S::build;
+    char-s2s: [B, Ts/stride, C] after the conv front-end)."""
     x = _embed(cfg, params, src_ids, "src")
     x = _word_dropout(x, cfg.dropout_src,
                       jax.random.fold_in(key, 0) if key is not None else None,
@@ -278,6 +365,8 @@ def encode(cfg: S2SConfig, params: Params, src_ids: jax.Array,
     if train and cfg.dropout_rnn > 0.0 and key is not None:
         x = _variational_dropout(x, cfg.dropout_rnn, jax.random.fold_in(key, 1))
     mask = src_mask.astype(x.dtype)
+    if cfg.char_conv:
+        x, mask = _char_conv_encode(cfg, params, x, mask)
 
     chains = _enc_chains(cfg)
     # layer 1: bidirectional pair (deep-transition chains)
@@ -410,6 +499,7 @@ def decode_train(cfg: S2SConfig, params: Params, enc_out: jax.Array,
     embedding of t-1 (zero at t=0 — same no-BOS convention as the
     transformer path)."""
     b, tt = trg_ids.shape
+    src_mask = enc_mask(cfg, src_mask)     # char-s2s: pooled attention mask
     emb = _embed(cfg, params, trg_ids, "trg")
     emb = jnp.pad(emb, ((0, 0), (1, 0), (0, 0)))[:, :-1, :]   # shift right
     kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
@@ -449,7 +539,8 @@ def init_decode_state(cfg: S2SConfig, params: Params, enc_out: jax.Array,
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
     state["enc_ctx"] = enc_out
     state["enc_att_keys"] = _att_keys(cfg, params, enc_out)
-    state.update(_cell_states_init(cfg, params, enc_out, src_mask))
+    state.update(_cell_states_init(cfg, params, enc_out,
+                                   enc_mask(cfg, src_mask)))
     return state
 
 
@@ -464,7 +555,7 @@ def decode_step(cfg: S2SConfig, params: Params, state: Dict[str, Any],
                    if k.endswith(BEAM_CARRIED_SUFFIXES)}
     top, ctx, w, new_cell_states = _conditional_step(
         cfg, params, cell_states, emb, state["enc_att_keys"],
-        state["enc_ctx"], src_mask)
+        state["enc_ctx"], enc_mask(cfg, src_mask))
     logits = _output_logits(cfg, params, top, emb, ctx, shortlist)
     new_state = dict(state)
     new_state.update(new_cell_states)

@@ -990,14 +990,20 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
     b = enc_outs[0].shape[0]
     h, dh = cfg.heads, cfg.dim_head
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
+    proj_cache: Dict[Any, Any] = {}    # tied layers share cross projections
     for l in range(1, cfg.dec_depth + 1):
+        pl = _tied(cfg, l)
         for i, kv in enumerate(enc_outs):
-            cname = f"decoder_l{_tied(cfg, l)}_context{_ctx_suffix(i)}"
+            cname = f"decoder_l{pl}_context{_ctx_suffix(i)}"
             sfx = _ctx_suffix(i)
-            state[f"l{l}_cross_k{sfx}"] = _split_heads(
-                affine(kv, params[f"{cname}_Wk"], params[f"{cname}_bk"]), h)
-            state[f"l{l}_cross_v{sfx}"] = _split_heads(
-                affine(kv, params[f"{cname}_Wv"], params[f"{cname}_bv"]), h)
+            if (pl, i) not in proj_cache:
+                proj_cache[(pl, i)] = (
+                    _split_heads(affine(kv, params[f"{cname}_Wk"],
+                                        params[f"{cname}_bk"]), h),
+                    _split_heads(affine(kv, params[f"{cname}_Wv"],
+                                        params[f"{cname}_bv"]), h))
+            state[f"l{l}_cross_k{sfx}"], state[f"l{l}_cross_v{sfx}"] = \
+                proj_cache[(pl, i)]
         if cfg.decoder_autoreg == "average-attention":
             # AAN needs only the running sum of inputs — O(D) per position
             # decode state instead of the O(L·D) KV cache

@@ -31,33 +31,25 @@ def _oom(err: Exception) -> bool:
 
 
 def _try_budget(gg, words: int, max_len: int, vocab: int) -> bool:
-    """One throwaway train step on the worst-case batch for this budget:
-    every sentence at full max_len (the bucket table can never produce a
-    worse [rows, max_len] shape for the same budget)."""
+    """One throwaway update through the REAL GraphGroup.update path (the
+    fused step for delay=1, the grad-accumulation path for delay>1 — their
+    peak memories differ, and the fit must hold for the one training will
+    run) on the worst-case batch: every sentence at full max_len (the
+    bucket table can never produce a worse [rows, max_len] shape for the
+    same budget). The caller snapshots/restores params around the search."""
     import jax
-    import jax.numpy as jnp
-    from ..parallel import mesh as M
-    from ..parallel.zero import build_train_step
 
     rows = max(8, (words // max_len) // 8 * 8)
     r = np.random.RandomState(0)
     batch = {
-        "src_ids": jnp.asarray(r.randint(2, vocab, (rows, max_len)),
-                               jnp.int32),
-        "src_mask": jnp.ones((rows, max_len), jnp.float32),
-        "trg_ids": jnp.asarray(r.randint(2, vocab, (rows, max_len)),
-                               jnp.int32),
-        "trg_mask": jnp.ones((rows, max_len), jnp.float32),
+        "src_ids": r.randint(2, vocab, (rows, max_len)).astype(np.int32),
+        "src_mask": np.ones((rows, max_len), np.float32),
+        "trg_ids": r.randint(2, vocab, (rows, max_len)).astype(np.int32),
+        "trg_mask": np.ones((rows, max_len), np.float32),
     }
     try:
-        step = build_train_step(gg.model, gg.opt_cfg, gg.schedule,
-                                gg.cost_type, gg.mesh, gg.params,
-                                gg.opt_state, delay=1, donate=False)
-        b = M.shard_batch(batch, gg.mesh)
-        p2, o2, _ = step(gg.params, gg.opt_state, b,
-                         jnp.asarray(1.0, jnp.float32), jax.random.key(0))
-        jax.block_until_ready(p2)
-        del p2, o2, step
+        gg.update([dict(batch)] * gg.delay, 1, jax.random.key(0))
+        jax.block_until_ready(gg.params)
         return True
     except Exception as e:  # noqa: BLE001 — OOM class varies by backend
         if _oom(e):
@@ -71,9 +63,16 @@ def fit_mini_batch_words(gg, opts, vocab_size: int,
     --mini-batch-fit is set; the result feeds BatchGenerator as
     mini-batch-words. Each probe is a full compile (~20-40 s on TPU), so
     the search is log-bounded (≤ ~8 probes)."""
+    import jax
+
     max_len = int(opts.get("max-length", 50))
     start = int(opts.get("mini-batch-words", 0) or 0) or 2048
     cap = cap or _WORDS_CAP
+    # probes run REAL updates (gg.update, donated buffers) — snapshot the
+    # initialized params/optimizer state and restore after the search so
+    # the throwaway updates leave no trace in training
+    saved_params = {k: np.asarray(v) for k, v in gg.params.items()}
+    saved_opt = gg.optimizer_arrays()
     lo, hi = 0, None
     words = max(_WORDS_MIN, min(start, cap))
     while True:
@@ -103,6 +102,10 @@ def fit_mini_batch_words(gg, opts, vocab_size: int,
                 if hi - lo <= max(256, lo // 8):
                     break
                 words = (lo + hi) // 2
+    import jax.numpy as jnp
+    gg.params = {k: jnp.asarray(v) for k, v in saved_params.items()}
+    gg.load_optimizer_arrays(saved_opt)
+    gg.initialize(jax.random.key(0), gg.params)   # re-place + rebuild jits
     log.info("mini-batch-fit: using mini-batch-words={} (max-length {})",
              lo, max_len)
     return lo

@@ -211,16 +211,13 @@ class Train:
 
         # --mini-batch-warmup: ramp the effective batch (rows AND token
         # budget) linearly over the first N updates
-        warmup_sched = opts.get("mini-batch-warmup", None)
+        wu_n = _warmup_updates(opts)
         budget_scale = None
-        if warmup_sched:
-            from ..common.scheduling_parameter import SchedulingParameter
-            wu = SchedulingParameter.parse(str(warmup_sched))
-            if wu.n > 0:
-                budget_scale = lambda: min(  # noqa: E731
-                    (state.batches + 1) / float(wu.n), 1.0)
-                log.info("mini-batch-warmup: ramping batch size over the "
-                         "first {} updates", wu.n)
+        if wu_n > 0:
+            budget_scale = lambda: min(  # noqa: E731
+                (state.batches + 1) / float(wu_n), 1.0)
+            log.info("mini-batch-warmup: ramping batch size over the "
+                     "first {} updates", wu_n)
 
         # -- epoch loop ------------------------------------------------------
         from ..common.profiling import TraceWindow
@@ -270,6 +267,21 @@ class Train:
         do_save()
 
 
+def _warmup_updates(opts) -> int:
+    """--mini-batch-warmup parsed to an update count; only the update unit
+    is meaningful for a per-update ramp — other units refuse loudly rather
+    than ramping over the wrong horizon."""
+    raw = str(opts.get("mini-batch-warmup", "0") or "0")
+    from ..common.scheduling_parameter import (SchedulingParameter,
+                                               SchedulingUnit)
+    wu = SchedulingParameter.parse(raw)
+    if wu.n > 0 and wu.unit != SchedulingUnit.UPDATES:
+        raise ValueError(
+            f"--mini-batch-warmup {raw}: only update-counted warmup "
+            f"(e.g. 4000 or 4000u) is supported")
+    return wu.n
+
+
 def _native_batch_generator(opts, train_sets, vocabs):
     """Opt-in C++ data loader (--data-backend native; marian_tpu/native/).
     Falls back to the Python BatchGenerator when the config needs features
@@ -286,7 +298,8 @@ def _native_batch_generator(opts, train_sets, vocabs):
                  and not int(opts.get("all-caps-every", 0) or 0)
                  and not int(opts.get("english-title-case-every", 0) or 0)
                  # batch-size ramp-up needs the Python budget_scale hook
-                 and not opts.get("mini-batch-warmup", None))
+                 # (default is the string "0" = off — parse, don't truth-test)
+                 and not _warmup_updates(opts))
     if not supported:
         log.warn("--data-backend native does not support this data config "
                  "(needs plain word vocabs, no alignment/weighting); "

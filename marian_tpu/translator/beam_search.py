@@ -340,6 +340,14 @@ class BeamSearch:
         # static decode cap per source bucket (Marian: factor * src length)
         L = int(min(self.max_length_cap,
                     max(8, round(self.max_length_factor * ts))))
+        if prefix is not None:
+            plen = int(np.asarray(prefix).shape[1])
+            # the forced prefix must fit under the cap with room to continue
+            L = max(L, min(self.max_length_cap, plen + 8))
+            if plen >= self.max_length_cap:
+                raise ValueError(
+                    f"--force-decode: prefix length {plen} exceeds "
+                    f"--max-length {self.max_length_cap}")
         cfg = BeamConfig.from_options(self.options, L)
         sl_idx = jnp.asarray(shortlist.indices) if shortlist is not None else None
         fn = self._get_fn(cfg, sl_idx is not None)

@@ -48,6 +48,8 @@ class OutputPrinter:
         self.n_best = bool(options.get("n-best", False))
         # --allow-special: keep </s> / <unk> visible in the output text
         self.allow_special = bool(options.get("allow-special", False))
+        # right-left models emit reversed targets; un-reverse for display
+        self.right_left = bool(options.get("right-left", False))
         self.feature = options.get("n-best-feature", "Score")
         align = options.get("alignment", None)
         self.align_mode: Optional[str] = None
@@ -64,6 +66,8 @@ class OutputPrinter:
                     self.align_mode = "hard"
 
     def _detok(self, tokens: List[int]) -> str:
+        if self.right_left:
+            tokens = list(tokens)[::-1]
         return self.vocab.decode(tokens,
                                  ignore_eos=not self.allow_special)
 
@@ -77,13 +81,21 @@ class OutputPrinter:
         wa = hard_alignment_from_soft(soft, soft.shape[1], soft.shape[0], thr)
         return str(wa)
 
+    def _align_of(self, h) -> np.ndarray:
+        a = np.asarray(h["alignment"])
+        if self.right_left:
+            # the hypothesis is displayed re-reversed — mirror the target
+            # rows so alignment points match the printed word order
+            a = a[::-1]
+        return a
+
     def line(self, sentence_id: int, nbest: List[dict]) -> str:
         """Format one sentence's result (reference: OutputPrinter::print)."""
         if not self.n_best:
             h = nbest[0]
             out = self._detok(h["tokens"])
             if self.align_mode and "alignment" in h:
-                out += " ||| " + self._align_str(np.asarray(h["alignment"]))
+                out += " ||| " + self._align_str(self._align_of(h))
             return out
         lines = []
         for h in nbest:
@@ -92,6 +104,6 @@ class OutputPrinter:
                      f"{h['norm_score']:.6f}"]
             line = " ||| ".join(parts)
             if self.align_mode and "alignment" in h:
-                line += " ||| " + self._align_str(np.asarray(h["alignment"]))
+                line += " ||| " + self._align_str(self._align_of(h))
             lines.append(line)
         return "\n".join(lines)

@@ -1,0 +1,102 @@
+"""char-s2s: convolutional character encoder (reference: src/models/
+char_s2s.h :: CharS2SEncoder + the cuDNN conv/pool wrappers → lax.conv /
+masked max-pool; Lee et al. 2017)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.models import s2s as S
+from marian_tpu.models.encoder_decoder import create_model
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(23)
+
+
+def char_model(vocab=30, **over):
+    opts = Options({
+        "type": "char-s2s", "dim-emb": 16, "dim-rnn": 24,
+        "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
+        "dec-cell": "gru", "char-stride": 3, "char-highway": 2,
+        "precision": ["float32", "float32"], "max-length": 64, **over,
+    })
+    model = create_model(opts, vocab, vocab)
+    # shrink the Lee-et-al filter bank for CPU-tiny tests
+    import dataclasses
+    model.cfg = dataclasses.replace(model.cfg,
+                                    conv_widths=(1, 3, 5),
+                                    conv_filters=(8, 8, 8))
+    return model, model.init(jax.random.key(0))
+
+
+def char_batch(rng, b=2, ts=13, tt=6, vocab=30):
+    return {
+        "src_ids": jnp.asarray(rng.randint(2, vocab, (b, ts)), jnp.int32),
+        "src_mask": jnp.ones((b, ts), jnp.float32),
+        "trg_ids": jnp.asarray(rng.randint(2, vocab, (b, tt)), jnp.int32),
+        "trg_mask": jnp.ones((b, tt), jnp.float32),
+    }
+
+
+class TestCharEncoder:
+    def test_pooled_length_and_mask(self, rng):
+        model, params = char_model()
+        batch = char_batch(rng, ts=13)          # ceil(13/3) = 5 windows
+        enc = model.encode_for_decode(params, batch["src_ids"],
+                                      batch["src_mask"])
+        assert enc.shape[1] == 5
+        pm = S.enc_mask(model.cfg, batch["src_mask"])
+        assert pm.shape == (2, 5)
+        np.testing.assert_array_equal(np.asarray(pm), 1.0)
+
+    def test_ragged_mask_pools(self, rng):
+        model, params = char_model()
+        mask = np.ones((2, 13), np.float32)
+        mask[0, 4:] = 0.0                       # 4 real chars → 2 windows
+        pm = np.asarray(S.enc_mask(model.cfg, jnp.asarray(mask)))
+        np.testing.assert_array_equal(pm[0], [1, 1, 0, 0, 0])
+        np.testing.assert_array_equal(pm[1], 1.0)
+
+    def test_loss_and_grads_finite(self, rng):
+        model, params = char_model()
+        batch = char_batch(rng)
+
+        def loss_fn(p):
+            total, aux = model.loss(p, batch, key=None, train=False)
+            return total / jnp.maximum(aux["labels"], 1.0)
+
+        l, g = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(l))
+        assert any("char_conv" in k for k in g)
+        assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+    def test_step_matches_teacher_forcing(self, rng):
+        model, params = char_model()
+        batch = char_batch(rng)
+        enc = model.encode_for_decode(params, batch["src_ids"],
+                                      batch["src_mask"])
+        full = S.decode_train(model.cfg, params, enc, batch["src_mask"],
+                              batch["trg_ids"], batch["trg_mask"],
+                              train=False)
+        state = model.start_state(params, enc, batch["src_mask"], max_len=8)
+        prev = jnp.zeros((2, 1), jnp.int32)
+        for t in range(batch["trg_ids"].shape[1]):
+            logits, state = model.step(params, state, prev,
+                                       batch["src_mask"])
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, t, :]),
+                                       rtol=2e-4, atol=2e-4)
+            prev = batch["trg_ids"][:, t:t + 1]
+
+    def test_beam_decode_runs(self, rng):
+        from marian_tpu.translator.beam_search import BeamSearch
+        model, params = char_model()
+        batch = char_batch(rng)
+        out = BeamSearch(model, [params], None,
+                         Options({"beam-size": 3, "max-length": 10}),
+                         None).search(batch["src_ids"], batch["src_mask"])
+        assert len(out) == 2

@@ -216,3 +216,22 @@ class TestAlignmentAndWeights:
         tuples = list(ti)
         assert len(tuples) == 2
         assert tuples[0].src[-1] == EOS_ID
+
+
+class TestRightLeft:
+    def test_target_reversed_eos_last(self, tmp_path):
+        from marian_tpu.common import Options
+        from marian_tpu.data.corpus import Corpus
+        from marian_tpu.data.vocab import DefaultVocab
+        (tmp_path / "r.src").write_text("a b c\n")
+        (tmp_path / "r.trg").write_text("x y z\n")
+        v = DefaultVocab.build(["a b c x y z"])
+        opts = Options({"max-length": 20, "shuffle": "none",
+                        "right-left": True})
+        corpus = Corpus([str(tmp_path / "r.src"), str(tmp_path / "r.trg")],
+                        [v, v], opts)
+        st = next(iter(corpus))
+        # source untouched, target tokens reversed, EOS still terminal
+        assert st.streams[0] == v.encode("a b c")
+        assert st.streams[1][:-1] == v.encode("x y z")[:-1][::-1]
+        assert st.streams[1][-1] == v.eos_id
