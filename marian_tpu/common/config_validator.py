@@ -30,6 +30,19 @@ def _validate_common_model(opts: Options) -> None:
 
 def _validate_training(opts: Options) -> None:
     _validate_common_model(opts)
+    if opts.get("right-left", False):
+        # token-position side data is NOT remapped when the target is
+        # reversed — refuse rather than silently corrupt the supervision
+        ga = opts.get("guided-alignment", "none")
+        if ga and ga != "none":
+            raise ValueError("--right-left cannot be combined with "
+                             "--guided-alignment (alignment target indices "
+                             "are not remapped under target reversal)")
+        if opts.get("data-weighting", None) \
+                and str(opts.get("data-weighting-type", "sentence")) == "word":
+            raise ValueError("--right-left cannot be combined with "
+                             "word-level --data-weighting (per-token "
+                             "weights are not remapped under reversal)")
     if not opts.get("train-sets", []):
         raise ValueError("No train sets given in --train-sets")
     vocabs = opts.get("vocabs", [])

@@ -53,7 +53,8 @@ class EncoderDecoder:
         self.use_guided = bool(ga and ga != "none") and not inference
         src_vocab_size, src_factors = _vocab_info(src_vocab)
         trg_vocab_size, trg_factors = _vocab_info(trg_vocab)
-        if self.model_type in ("transformer", "multi-transformer", "transformer-lm"):
+        if self.model_type in ("transformer", "multi-transformer",
+                               "transformer-lm", "lm-transformer", "lm"):
             seq_mesh = None
             if str(options.get("sequence-parallel", "none") or "none") != "none":
                 from ..parallel import mesh as _mesh

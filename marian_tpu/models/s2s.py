@@ -340,6 +340,9 @@ def _char_conv_encode(cfg: S2SConfig, params: Params, x: jax.Array,
     h = jnp.where(mpad[..., None] > 0, h, -jnp.inf)
     h = h.reshape(h.shape[0], -1, s, h.shape[-1]).max(axis=2)
     h = jnp.where(jnp.isfinite(h), h, 0.0)                 # all-pad windows
+    # the attention mask the decoder recomputes (enc_mask) MUST match this
+    # pooling — share the implementation
+    pooled_mask = enc_mask(cfg, mask)
     for i in range(1, cfg.char_highway + 1):
         pre = f"encoder_char_highway_l{i}"
         tr = jax.nn.relu(h @ params[f"{pre}_W"].astype(h.dtype)
@@ -349,7 +352,6 @@ def _char_conv_encode(cfg: S2SConfig, params: Params, x: jax.Array,
         h = g * tr + (1.0 - g) * h
     h = h @ params["encoder_char_proj_W"].astype(h.dtype) \
         + params["encoder_char_proj_b"].astype(h.dtype)
-    pooled_mask = mpad.reshape(mpad.shape[0], -1, s).max(axis=2)
     return h, pooled_mask
 
 

@@ -108,6 +108,11 @@ class TransformerConfig:
     # state (KV caches) stays per-physical-layer. Empty = no tying.
     tied_layers: Tuple[int, ...] = ()
     factor_weight: float = 1.0                # --factor-weight
+    # decoder-only language model (--type transformer-lm; reference:
+    # src/models/model_factory.cpp 'transformer' DecoderOnly assembly used
+    # by marian-scorer for LM scoring / R2L reranking): no encoder stack,
+    # no cross-attention sublayers — just the autoregressive decoder
+    lm: bool = False
     # ULR (--ulr): fixed query/key tables are carried here as host arrays
     # for init_params only; the forward pass reads them from params (so
     # checkpoints are self-contained and decode needs no vector files)
@@ -209,6 +214,8 @@ def config_from_options(options, src_vocab, trg_vocab: int,
             int(v) for v in (g("output-approx-knn", []) or [])),
         tied_layers=tuple(int(v) for v in
                           (g("transformer-tied-layers", []) or [])),
+        lm=str(g("type", "transformer")) in ("transformer-lm",
+                                             "lm-transformer", "lm"),
         # training-loss weighting only (reference: applyLossFunction scales
         # factor losses; getLogits sums unweighted — decode parity)
         factor_weight=1.0 if for_inference
@@ -270,7 +277,12 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
         return inits.glorot_uniform(next(k), shape, scale=scale)
 
     # embeddings (row count = factor units for factored vocabs)
-    if cfg.tied_embeddings_all or cfg.tied_embeddings_src:
+    if cfg.lm:
+        if cfg.tied_embeddings_all or cfg.tied_embeddings:
+            p["Wemb"] = glorot((_trg_rows(cfg), d))
+        else:
+            p["decoder_Wemb"] = glorot((_trg_rows(cfg), d))
+    elif cfg.tied_embeddings_all or cfg.tied_embeddings_src:
         if any(_src_rows(cfg, i) != _trg_rows(cfg)
                for i in range(cfg.n_encoders)):
             raise ValueError("tied src embeddings require equal vocab sizes")
@@ -282,9 +294,10 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     if cfg.train_position_embeddings:
         p["Wpos"] = glorot((cfg.max_length, d))
     if "n" in cfg.postprocess_emb:
-        for i in range(cfg.n_encoders):
-            p[f"{_enc_prefix(i)}_emb_ln_scale"] = inits.ones((1, d))
-            p[f"{_enc_prefix(i)}_emb_ln_bias"] = inits.zeros((1, d))
+        if not cfg.lm:
+            for i in range(cfg.n_encoders):
+                p[f"{_enc_prefix(i)}_emb_ln_scale"] = inits.ones((1, d))
+                p[f"{_enc_prefix(i)}_emb_ln_bias"] = inits.zeros((1, d))
         p["decoder_emb_ln_scale"] = inits.ones((1, d))
         p["decoder_emb_ln_bias"] = inits.zeros((1, d))
 
@@ -311,7 +324,7 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             p[f"{prefix}_ffn_ln_scale"] = inits.ones((1, d))
             p[f"{prefix}_ffn_ln_bias"] = inits.zeros((1, d))
 
-    for i in range(cfg.n_encoders):
+    for i in range(0 if cfg.lm else cfg.n_encoders):
         ep = _enc_prefix(i)
         for l in range(1, cfg.enc_depth + 1):
             if _tied(cfg, l) != l:
@@ -363,7 +376,7 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             rnn_block(f"decoder_l{l}", l)
         else:
             attn_block(f"decoder_l{l}_self", l)
-        for i in range(cfg.n_encoders):
+        for i in range(0 if cfg.lm else cfg.n_encoders):
             attn_block(f"decoder_l{l}_context{_ctx_suffix(i)}", l)
         ffn_block(f"decoder_l{l}_ffn", cfg.dec_ffn, cfg.dec_ffn_d, l)
     if "n" in cfg.postprocess_top or "n" in cfg.preprocess:
@@ -762,6 +775,8 @@ def encode(cfg: TransformerConfig, params: Params, src_ids,
     """[B, Ts] ids + mask → [B, Ts, D] encoder states (reference:
     TransformerEncoder::apply). Multi-source: pass tuples of ids/masks —
     one encoder stack per stream, returns a tuple of states."""
+    if cfg.lm:
+        return None                      # decoder-only LM: no encoder
     if isinstance(src_ids, (tuple, list)):
         masks = _as_tuple(src_mask)
         return tuple(
@@ -839,9 +854,12 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
                   kk(1), train)
     tt = trg_ids.shape[1]
     self_mask = causal_mask(tt) * trg_mask[:, None, None, :]
-    enc_outs = _as_tuple(enc_out)
-    masks = _as_tuple(src_mask)
-    cross_masks = [m[:, None, None, :] for m in masks]
+    if cfg.lm:
+        enc_outs, masks, cross_masks = (), (), []
+    else:
+        enc_outs = _as_tuple(enc_out)
+        masks = _as_tuple(src_mask)
+        cross_masks = [m[:, None, None, :] for m in masks]
     align = None
 
     def dec_layer(x, l, want_align):
@@ -986,8 +1004,9 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
     """Precompute cross-attention K/V; allocate fixed-size self-attn caches
     (reference: EncoderDecoder::startState + per-layer cache init).
     Multi-source: per-encoder cross K/V under suffixed keys."""
-    enc_outs = _as_tuple(enc_out)
-    b = enc_outs[0].shape[0]
+    # decoder-only LM: no cross K/V; batch size from the (dummy) source mask
+    enc_outs = () if cfg.lm else _as_tuple(enc_out)
+    b = src_mask.shape[0] if cfg.lm else enc_outs[0].shape[0]
     h, dh = cfg.heads, cfg.dim_head
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
     proj_cache: Dict[Any, Any] = {}    # tied layers share cross projections
@@ -1093,7 +1112,7 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
         x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
                       f"decoder_l{pl}_self_Wo", params, None, False)
 
-        for i in range(cfg.n_encoders):
+        for i in range(0 if cfg.lm else cfg.n_encoders):
             sfx = _ctx_suffix(i)
             cname = f"decoder_l{pl}_context{sfx}"
             want_w = (return_alignment and i == 0

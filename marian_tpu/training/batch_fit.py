@@ -69,13 +69,26 @@ def fit_mini_batch_words(gg, opts, vocab_size: int,
     start = int(opts.get("mini-batch-words", 0) or 0) or 2048
     cap = cap or _WORDS_CAP
     # probes run REAL updates (gg.update, donated buffers) — snapshot the
-    # initialized params/optimizer state and restore after the search so
-    # the throwaway updates leave no trace in training
+    # initialized params/optimizer state and restore before EVERY probe: a
+    # runtime OOM mid-update leaves the donated buffers deleted, so the
+    # next probe would otherwise die on 'array has been deleted' instead
+    # of fitting (and the throwaway updates must leave no trace either way)
     saved_params = {k: np.asarray(v) for k, v in gg.params.items()}
     saved_opt = gg.optimizer_arrays()
+
+    def _restore():
+        import jax.numpy as jnp
+        gg.params = {k: jnp.asarray(v) for k, v in saved_params.items()}
+        gg.load_optimizer_arrays(saved_opt)
+        gg.initialize(jax.random.key(0), gg.params)
+
     lo, hi = 0, None
     words = max(_WORDS_MIN, min(start, cap))
+    first = True
     while True:
+        if not first:
+            _restore()
+        first = False
         ok = _try_budget(gg, words, max_len, vocab_size)
         log.info("mini-batch-fit probe: {} words → {}", words,
                  "fits" if ok else "OOM")
@@ -102,10 +115,7 @@ def fit_mini_batch_words(gg, opts, vocab_size: int,
                 if hi - lo <= max(256, lo // 8):
                     break
                 words = (lo + hi) // 2
-    import jax.numpy as jnp
-    gg.params = {k: jnp.asarray(v) for k, v in saved_params.items()}
-    gg.load_optimizer_arrays(saved_opt)
-    gg.initialize(jax.random.key(0), gg.params)   # re-place + rebuild jits
+    _restore()                                    # re-place + rebuild jits
     log.info("mini-batch-fit: using mini-batch-words={} (max-length {})",
              lo, max_len)
     return lo

@@ -83,10 +83,12 @@ class OutputPrinter:
 
     def _align_of(self, h) -> np.ndarray:
         a = np.asarray(h["alignment"])
-        if self.right_left:
+        if self.right_left and len(a) > 1:
             # the hypothesis is displayed re-reversed — mirror the target
-            # rows so alignment points match the printed word order
-            a = a[::-1]
+            # rows to match the printed word order, but the terminal EOS
+            # row stays LAST (training kept EOS terminal: corpus.py
+            # reverses ids[-2::-1] + [eos])
+            a = np.concatenate([a[-2::-1], a[-1:]], axis=0)
         return a
 
     def line(self, sentence_id: int, nbest: List[dict]) -> str:
