@@ -78,6 +78,8 @@ def main():
     tmp = tempfile.mkdtemp(prefix="marian_bench_")
     src_p, trg_p = _write_corpus(tmp, dims["vocab"], n_lines)
 
+    fused_mode = os.environ.get("MARIAN_BENCH_FUSED", "tune")
+
     opts = Options({
         "type": "transformer",
         "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
@@ -100,11 +102,49 @@ def main():
     vocab = DefaultVocab.build([" ".join(vocab_lines)])
     vocabs = [vocab, vocab]
     corpus = Corpus([src_p, trg_p], vocabs, opts)
-    model = create_model(opts, len(vocab), len(vocab))
-    gg = GraphGroup(model, opts)
     key = prng.root_key(1111)
-    gg.initialize(prng.stream(key, prng.STREAM_INIT))
     train_key = prng.stream(key, prng.STREAM_DROPOUT)
+
+    def build_gg(fused: str) -> GraphGroup:
+        o = opts.with_(**{"fused-ce": fused})
+        model = create_model(o, len(vocab), len(vocab))
+        gg = GraphGroup(model, o)
+        gg.initialize(prng.stream(key, prng.STREAM_INIT))
+        return gg
+
+    if fused_mode == "tune" and jax.default_backend() == "tpu":
+        # AutoTuner-style A/B: the streaming fused-CE kernel wins or loses
+        # depending on chip generation and batch shape — time both on a
+        # few real steps and keep the faster (reference: AutoTuner picking
+        # kernel alternatives by measurement). Snapshot/restore the corpus
+        # position so the timed window sees the same epoch regardless of
+        # whether the probe ran (numbers stay comparable across
+        # MARIAN_BENCH_FUSED settings).
+        corpus_state = corpus.state.as_dict()
+        probe = next(iter(BatchGenerator(corpus, opts, prefetch=False)))
+        corpus.restore(corpus_state)
+        times = {}
+        for mode in ("on", "off"):
+            g = build_gg(mode)
+            arrays = batch_to_arrays(probe)
+            for i in range(2):                       # compile + settle
+                g.update(dict(arrays), i + 1,
+                         jax.random.fold_in(train_key, i))
+            jax.block_until_ready(g.params)
+            t0 = time.perf_counter()
+            for i in range(6):
+                g.update(dict(arrays), i + 3,
+                         jax.random.fold_in(train_key, i))
+            jax.block_until_ready(g.params)
+            times[mode] = time.perf_counter() - t0
+            del g
+        fused_mode = min(times, key=times.get)
+        print(f"fused-ce A/B: on={times['on']:.3f}s off={times['off']:.3f}s "
+              f"→ {fused_mode}", file=sys.stderr)
+    elif fused_mode == "tune":
+        fused_mode = "auto"
+
+    gg = build_gg(fused_mode)
 
     n_chips = len(jax.devices())
 

@@ -46,7 +46,8 @@ def main(argv=None):
     if args.gemm_type == "int8tpu":
         cfg = yaml.safe_load(cfg_yaml) if cfg_yaml else {}
         mtype = str(cfg.get("type", "transformer"))
-        if mtype not in ("transformer", "multi-transformer", "transformer-lm"):
+        if mtype not in ("transformer", "multi-transformer",
+                         "transformer-lm", "lm-transformer", "lm"):
             raise SystemExit(
                 f"marian-conv: int8tpu supports transformer models only "
                 f"(checkpoint type '{mtype}'); the s2s/RNN decode path "

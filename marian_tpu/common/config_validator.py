@@ -30,6 +30,11 @@ def _validate_common_model(opts: Options) -> None:
 
 def _validate_training(opts: Options) -> None:
     _validate_common_model(opts)
+    ga_flag = opts.get("guided-alignment", "none")
+    if opts.get("type", "") in ("transformer-lm", "lm-transformer", "lm") \
+            and ga_flag and ga_flag != "none":
+        raise ValueError("--guided-alignment requires cross-attention; a "
+                         "decoder-only LM (--type transformer-lm) has none")
     if opts.get("right-left", False):
         # token-position side data is NOT remapped when the target is
         # reversed — refuse rather than silently corrupt the supervision
