@@ -1,0 +1,96 @@
+s m a l l _ r e d _ h a s _ t r e e _ c h i l d _ m a n _ h o u s e _ h o u s e _ m a n
+b l u e _ c a t _ b i g _ s e e s _ y o u n g
+l o v e s _ t r e e _ w o m a n _ t h e _ d o g
+f a s t _ t h e _ b l u e _ r e d _ c h i l d _ b l u e
+l o v e s _ s m a l l _ m a n _ b i g _ y o u n g _ y o u n g _ o l d _ f a s t _ r e d
+b l u e _ w o m a n _ d o g _ f a s t _ r e d _ t h e _ t h e _ t h e _ h o u s e
+w o m a n _ h o u s e _ c h i l d _ b i g _ o l d _ o l d
+t h e _ h a s _ c h i l d _ f a s t _ h a s
+w o m a n _ y o u n g _ s e e s _ b l u e _ t h e _ o l d _ l o v e s _ c h i l d _ t h e
+o l d _ h o u s e _ t h e _ h o u s e _ r e d _ y o u n g
+b l u e _ b i g _ t h e
+o l d _ m a n _ y o u n g _ y o u n g _ r e d _ f a s t _ f a s t
+w o m a n _ r e d _ c h i l d _ b l u e _ s e e s _ m a n _ l o v e s
+h o u s e _ t h e _ b l u e
+r e d _ w o m a n _ h o u s e _ f a s t _ l o v e s _ s m a l l _ h a s _ s m a l l _ c h i l d
+s e e s _ t h e _ r e d
+s m a l l _ s m a l l _ o l d _ o l d
+s m a l l _ s e e s _ t r e e _ b l u e
+b l u e _ b i g _ h o u s e _ h o u s e _ b l u e
+c h i l d _ c a t _ s e e s _ d o g _ t r e e _ t r e e _ c a t _ r e d _ m a n
+f a s t _ m a n _ o l d _ d o g _ t h e _ o l d _ m a n
+t r e e _ c a t _ c h i l d _ w o m a n _ h a s
+o l d _ s e e s _ r e d _ h o u s e _ b i g _ l o v e s
+s m a l l _ s m a l l _ s e e s _ t h e
+b l u e _ t h e _ t h e _ l o v e s _ t h e _ t h e
+t h e _ t h e _ w o m a n _ f a s t _ t r e e _ s e e s
+m a n _ h o u s e _ c h i l d _ h a s
+c a t _ t h e _ m a n _ y o u n g _ b l u e _ c h i l d _ b i g
+t h e _ y o u n g _ m a n _ t r e e _ o l d _ b i g
+t h e _ t h e _ c a t _ o l d _ w o m a n _ m a n _ o l d _ l o v e s _ c h i l d
+c a t _ l o v e s _ b i g _ y o u n g _ r e d
+t h e _ t h e _ r e d _ t h e _ b i g _ o l d _ d o g _ w o m a n _ c a t
+h a s _ t h e _ c h i l d _ t h e _ w o m a n _ y o u n g _ o l d
+c h i l d _ w o m a n _ r e d _ s e e s
+h o u s e _ w o m a n _ r e d
+c a t _ y o u n g _ b l u e _ t r e e _ t h e _ c h i l d _ h a s
+c h i l d _ c a t _ d o g
+m a n _ t h e _ w o m a n _ l o v e s _ s e e s _ d o g _ t h e _ y o u n g
+t r e e _ y o u n g _ y o u n g _ c a t _ b i g _ c a t _ m a n _ m a n
+d o g _ b l u e _ f a s t _ t h e _ s e e s _ d o g _ t h e _ b i g _ c h i l d
+h a s _ b l u e _ w o m a n _ f a s t _ y o u n g _ y o u n g
+s m a l l _ f a s t _ t r e e
+r e d _ w o m a n _ c h i l d _ y o u n g _ m a n _ d o g _ w o m a n _ f a s t
+d o g _ h o u s e _ t h e _ y o u n g _ t h e _ m a n _ s e e s _ h o u s e _ f a s t
+s m a l l _ c a t _ m a n _ t r e e _ t h e _ c a t _ t h e _ b i g _ f a s t
+b i g _ c a t _ o l d _ m a n _ r e d _ y o u n g _ s m a l l _ b i g _ c a t
+h a s _ s e e s _ f a s t _ s e e s _ l o v e s _ s m a l l
+o l d _ f a s t _ t r e e _ h a s
+t r e e _ t h e _ d o g _ w o m a n
+t h e _ t r e e _ w o m a n _ y o u n g _ t h e
+c a t _ o l d _ h o u s e _ t h e _ s e e s _ t h e _ d o g _ c a t _ o l d
+s m a l l _ o l d _ w o m a n _ m a n
+t h e _ t r e e _ t r e e _ t h e _ r e d _ d o g _ t r e e
+h a s _ h a s _ w o m a n
+h o u s e _ l o v e s _ t h e _ o l d _ m a n
+t r e e _ c a t _ o l d _ y o u n g
+r e d _ b i g _ h a s _ b i g _ s m a l l _ t r e e _ c h i l d
+h o u s e _ w o m a n _ o l d _ d o g _ s m a l l _ h a s _ c a t _ t h e
+h a s _ s m a l l _ c h i l d _ s e e s _ l o v e s _ t h e
+l o v e s _ f a s t _ c h i l d _ w o m a n _ y o u n g _ t h e _ s m a l l
+c h i l d _ w o m a n _ c h i l d _ y o u n g
+c a t _ d o g _ h o u s e
+s e e s _ b i g _ s m a l l _ t h e _ c h i l d
+b i g _ s e e s _ t h e
+l o v e s _ h a s _ t h e
+t h e _ c h i l d _ t h e _ y o u n g
+m a n _ h o u s e _ b l u e _ t h e _ o l d _ w o m a n _ s m a l l
+w o m a n _ l o v e s _ w o m a n
+t r e e _ d o g _ t h e _ t h e
+c a t _ r e d _ h o u s e _ b i g _ c a t _ o l d
+f a s t _ b i g _ b l u e _ o l d _ c a t _ y o u n g _ f a s t
+t h e _ h a s _ t h e _ w o m a n
+b i g _ t r e e _ c a t _ b i g _ t r e e _ t h e _ s e e s
+s e e s _ t h e _ l o v e s _ l o v e s _ y o u n g
+h a s _ t h e _ t r e e _ b i g
+m a n _ t h e _ t h e _ f a s t _ t h e _ b l u e
+b l u e _ b l u e _ b i g _ f a s t
+h a s _ r e d _ r e d _ d o g _ t h e _ d o g _ b i g _ s m a l l
+s m a l l _ o l d _ h a s _ y o u n g _ h a s
+b l u e _ d o g _ s e e s _ m a n _ t h e
+t h e _ f a s t _ f a s t _ o l d
+t h e _ f a s t _ d o g _ s e e s _ t r e e
+f a s t _ o l d _ w o m a n _ c h i l d _ h o u s e _ h a s
+r e d _ w o m a n _ t h e _ t r e e _ h a s
+h o u s e _ h a s _ s e e s _ y o u n g _ m a n _ c a t _ r e d
+d o g _ b i g _ w o m a n _ r e d _ m a n
+s e e s _ r e d _ y o u n g _ b i g _ w o m a n _ r e d _ f a s t
+l o v e s _ f a s t _ b i g _ s e e s _ s e e s _ h a s
+c a t _ b i g _ l o v e s _ s m a l l _ b l u e _ r e d
+d o g _ t h e _ t h e _ d o g _ t r e e _ t h e
+t h e _ t r e e _ b i g _ b l u e _ t h e _ t h e _ o l d _ h o u s e
+r e d _ c a t _ d o g
+s m a l l _ l o v e s _ y o u n g _ c h i l d _ m a n _ c h i l d
+t h e _ h a s _ d o g _ s m a l l _ d o g _ t h e _ b l u e
+c h i l d _ t r e e _ s m a l l _ h o u s e _ f a s t
+l o v e s _ b i g _ b l u e _ w o m a n _ b l u e _ t h e _ t h e _ y o u n g _ b l u e

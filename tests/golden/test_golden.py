@@ -84,6 +84,17 @@ CONFIGS = {
         "transformer-decoder-autoreg": "average-attention",
         "transformer-dim-aan": 64,
     },
+    "char-s2s": {
+        "type": "char-s2s", "dim-emb": 24, "dim-rnn": 32,
+        "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
+        "dec-cell": "gru", "char-stride": 3, "char-highway": 2,
+        "tied-embeddings": True, "max-length": 80,
+    },
+    "transformer-lm": {
+        "type": "transformer-lm", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "dec-depth": 2,
+        "tied-embeddings-all": True,
+    },
 }
 
 
@@ -92,6 +103,10 @@ def _streams(name):
     trg = str(DATA / "train.trg")
     if name == "multi-source":
         return [src, src, trg]          # doc-context style: 2 source streams
+    if name == "char-s2s":
+        return [str(DATA / "train.char.src"), str(DATA / "train.char.trg")]
+    if name == "transformer-lm":
+        return [trg]                    # single-stream LM corpus
     return [src, trg]
 
 
@@ -112,6 +127,11 @@ def _build(name):
     corpus = Corpus(paths, vocabs, opts)
     src_side = vocabs[:-1] if len(vocabs) > 2 else vocabs[0]
     model = create_model(opts, src_side, vocabs[-1])
+    if name == "char-s2s":
+        # CPU-tiny filter bank (the Lee et al. defaults are WMT-sized)
+        import dataclasses
+        model.cfg = dataclasses.replace(model.cfg, conv_widths=(1, 3, 5),
+                                        conv_filters=(8, 8, 8))
     return opts, vocabs, corpus, model
 
 
@@ -138,9 +158,29 @@ def _train(name):
 
 def _decode(gg, opts, vocabs, model, name):
     """Beam-6 decode of the first 8 training sentences through the real
-    BeamSearch (shapes bucketed like the translator driver)."""
+    BeamSearch (shapes bucketed like the translator driver). Decoder-only
+    LMs pin per-sentence teacher-forced scores instead."""
     from marian_tpu.translator.beam_search import BeamSearch
     import jax.numpy as jnp
+    if name == "transformer-lm":
+        from marian_tpu.models import transformer as Tm
+        from marian_tpu.ops.ops import cross_entropy
+        lines = pathlib.Path(_streams(name)[0]).read_text().splitlines()[:8]
+        voc = vocabs[0]
+        enc = [voc.encode(l) for l in lines]
+        tt = max(len(e) for e in enc)
+        ids = np.zeros((len(enc), tt), np.int32)
+        mask = np.zeros((len(enc), tt), np.float32)
+        for i, e in enumerate(enc):
+            ids[i, :len(e)] = e
+            mask[i, :len(e)] = 1.0
+        cp = Tm.cast_params(gg.params, model.cfg.compute_dtype)
+        logits = Tm.decode_train(model.cfg, cp, None, None,
+                                 jnp.asarray(ids), jnp.asarray(mask),
+                                 train=False)
+        ce = np.asarray(cross_entropy(logits, jnp.asarray(ids), 0.0)
+                        * jnp.asarray(mask))
+        return [f"{-s:.6f}" for s in ce.sum(axis=1)]
     paths = _streams(name)
     src_lines = pathlib.Path(paths[0]).read_text().splitlines()[:8]
     svoc = vocabs[0]
