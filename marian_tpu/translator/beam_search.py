@@ -331,6 +331,10 @@ class BeamSearch:
         if prefix is not None and shortlist is not None:
             raise ValueError("--force-decode cannot be combined with a "
                              "lexical shortlist (prefix ids are full-vocab)")
+        if getattr(self.model.cfg, "lm", False):
+            raise ValueError("a decoder-only LM (--type transformer-lm) "
+                             "has no source to translate; use "
+                             "marian-scorer for LM scoring")
         if prefix is not None and getattr(self.model.cfg,
                                           "output_approx_knn", ()):
             raise ValueError("--force-decode cannot be combined with "

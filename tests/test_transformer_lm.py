@@ -110,3 +110,12 @@ class TestTransformerLM:
         scores = [float(x) for x in buf.getvalue().split()]
         assert len(scores) == len(lines)
         assert all(np.isfinite(scores))
+
+    def test_translate_refused(self, rng):
+        from marian_tpu.translator.beam_search import BeamSearch
+        model, params = lm_model()
+        batch = lm_batch(rng)
+        bs = BeamSearch(model, [params], None,
+                        Options({"beam-size": 2, "max-length": 8}), None)
+        with pytest.raises(ValueError, match="marian-scorer"):
+            bs.search(batch["src_ids"], batch["src_mask"])
