@@ -55,6 +55,9 @@ def main():
         force_cpu_devices(1)
     import jax
 
+    from marian_tpu.common.profiling import enable_compilation_cache
+    enable_compilation_cache()
+
     from marian_tpu.common.options import Options
     from marian_tpu.common import prng
     from marian_tpu.data import BatchGenerator, Corpus

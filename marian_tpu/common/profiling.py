@@ -24,6 +24,26 @@ from typing import Any, Dict, Optional
 from . import logging as log
 
 
+def enable_compilation_cache(path: Optional[str] = None) -> None:
+    """Point JAX's persistent compilation cache at a repo-local directory so
+    repeated invocations (bench reruns, CLI restarts, the driver's
+    end-of-round bench) skip the 20-40s XLA compile per train-step shape.
+    Safe to call more than once; a cache miss behaves exactly like no cache.
+    """
+    import jax
+    path = path or os.environ.get(
+        "MARIAN_XLA_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".cache", "xla"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        log.warn("persistent compilation cache unavailable: {}", e)
+
+
 class TraceWindow:
     """Capture a jax.profiler trace for updates [start, stop)."""
 

@@ -112,10 +112,27 @@ def make_batch(tuples: Sequence[SentenceTuple], n_streams: int,
                batch_multiple: int = 8,
                pad_batch: bool = True,
                corpus_state: Optional[dict] = None,
-               weighting_type: Optional[str] = None) -> CorpusBatch:
-    """Pad a list of SentenceTuples into one fixed-shape CorpusBatch."""
+               weighting_type: Optional[str] = None,
+               fixed_rows: int = 0) -> CorpusBatch:
+    """Pad a list of SentenceTuples into one fixed-shape CorpusBatch.
+
+    `fixed_rows` > 0 pins the row count (extra rows fully masked): with a
+    token budget the generator derives ONE canonical row count per width
+    combo, collapsing the compiled-shape space to ~#length-buckets. Every
+    distinct (widths, rows) shape costs a full XLA compile of the train
+    step — on TPU that is tens of seconds (minutes over a remote tunnel),
+    so an unbounded shape space is the single worst data-layer decision a
+    TPU port can make. Masked pad rows cost only the FLOPs of an
+    already-budget-sized batch."""
     n = len(tuples)
-    bsz = bucket_batch_size(n, batch_multiple) if pad_batch else n
+    if fixed_rows > 0:
+        # n can overshoot fixed_rows by < batch_multiple (the budget check
+        # flushes on padded tokens, fixed_rows is the budget floored to the
+        # multiple); snapping up bounds the shape by the pre-canonical
+        # worst case, so at most 2 row counts exist per width combo
+        bsz = max(fixed_rows, bucket_batch_size(n, batch_multiple))
+    else:
+        bsz = bucket_batch_size(n, batch_multiple) if pad_batch else n
     subs: List[SubBatch] = []
     for s in range(n_streams):
         maxlen = max(len(t.streams[s]) for t in tuples)
@@ -218,11 +235,29 @@ class BatchGenerator:
         cur_maxlens = [0] * self.n_streams
 
         def flush():
-            if cur:
-                batches.append(make_batch(cur, self.n_streams, self.length_buckets,
-                                          self.batch_multiple, self.pad_batch,
-                                          corpus_state=state,
-                                          weighting_type=self.weighting_type))
+            if not cur:
+                return
+            fixed = 0
+            if self.pad_batch and words_budget > 0:
+                # canonical row count per width combo: the shape a full
+                # budget-sized batch of this width would have, so underfull
+                # batches (maxi-window tails) reuse an existing compile
+                # instead of minting a new (widths, rows) shape. Rounded
+                # DOWN so the canonical shape never exceeds the worst case
+                # --mini-batch-fit probed for this budget (batch_fit.py
+                # rounds down too); the rows-counted path keeps its natural
+                # sizes — inference entry points must not pay full-batch
+                # compute for small inputs.
+                w = bucket_length(max(len(t.trg) for t in cur),
+                                  self.length_buckets)
+                fixed = max(self.batch_multiple,
+                            (words_budget // w) // self.batch_multiple
+                            * self.batch_multiple)
+            batches.append(make_batch(cur, self.n_streams, self.length_buckets,
+                                      self.batch_multiple, self.pad_batch,
+                                      corpus_state=state,
+                                      weighting_type=self.weighting_type,
+                                      fixed_rows=fixed))
 
         scale = 1.0
         if self.budget_scale is not None:

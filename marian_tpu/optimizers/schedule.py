@@ -42,6 +42,25 @@ class LRSchedule:
                        options.get("lr-warmup-start-rate", 0.0)),
                    warmup_cycle=bool(options.get("lr-warmup-cycle", False)))
 
+    def host_lr(self, step) -> float:
+        """Pure-host mirror of __call__ for display/logging — the training
+        hot path must never pay a device round-trip for a scalar the host
+        can compute itself (math only, no jnp)."""
+        import math
+        step = max(float(step), 1.0)
+        lr = self.base_lr
+        if self.warmup > 0:
+            wstep = max(step - float(self.warmup_offset), 1.0)
+            if self.warmup_cycle:
+                wstep = math.fmod(wstep - 1.0, float(self.warmup)) + 1.0
+            frac = min(wstep / float(self.warmup), 1.0)
+            start = self.warmup_start_rate
+            lr = start + (lr - start) * frac if start > 0 else lr * frac
+        if self.inv_sqrt > 0:
+            lr = lr * math.sqrt(float(self.inv_sqrt)
+                                / max(step, float(self.inv_sqrt)))
+        return lr * self.decay_factor
+
     def __call__(self, step) -> jnp.ndarray:
         """step: 1-based update count (f32 scalar or python int)."""
         step = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)

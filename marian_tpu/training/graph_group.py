@@ -46,9 +46,13 @@ Params = Dict[str, jax.Array]
 
 @dataclasses.dataclass
 class TrainOutput:
-    loss_sum: float
-    labels: float
-    grad_norm: float
+    """Per-update metrics. Fields hold LAZY device scalars (jax.Array):
+    converting with float() blocks on the step — callers on the hot path
+    (train loop, bench) must NOT convert per step; the Scheduler defers the
+    sync to display boundaries so JAX's async dispatch can pipeline steps."""
+    loss_sum: Any
+    labels: Any
+    grad_norm: Any
 
 
 class GraphGroup:
@@ -203,10 +207,10 @@ class GraphGroup:
             self.params, self.opt_state, metrics = self._fused(
                 self.params, self.opt_state, b,
                 jnp.asarray(step, jnp.float32), rng)
-            return TrainOutput(float(metrics["ce_sum"]),
-                               float(metrics["labels"]),
-                               float(metrics["gnorm"]))
-        total_loss = total_labels = n_sents = 0.0
+            return TrainOutput(metrics["ce_sum"], metrics["labels"],
+                               metrics["gnorm"])
+        total_loss = total_labels = 0.0
+        n_sents = 0.0
         grads_acc = None
         for i, b in enumerate(batches):
             r = jax.random.fold_in(rng, i)
@@ -218,8 +222,8 @@ class GraphGroup:
                     self.params, M.shard_batch(b, self.mesh), r))
                 self._dump_hlo = None
             grads, aux = self._grad_fn(self.params, M.shard_batch(b, self.mesh), r)
-            total_loss += float(aux["ce_sum"])
-            total_labels += float(aux["labels"])
+            total_loss = total_loss + aux["ce_sum"]        # lazy device adds
+            total_labels = total_labels + aux["labels"]
             n_sents += int(b["trg_ids"].shape[0])
             grads_acc = grads if grads_acc is None else \
                 jax.tree_util.tree_map(jnp.add, grads_acc, grads)
@@ -228,7 +232,7 @@ class GraphGroup:
             jnp.asarray(step, jnp.float32),
             jnp.asarray(total_labels, jnp.float32),
             jnp.asarray(n_sents, jnp.float32))
-        return TrainOutput(total_loss, total_labels, float(gnorm))
+        return TrainOutput(total_loss, total_labels, gnorm)
 
     # -- EMA access for validation/saving -----------------------------------
     def smoothed(self) -> Params:

@@ -71,8 +71,12 @@ class Scheduler:
         return True
 
     # -- per-update bookkeeping (reference: Scheduler::update) ---------------
-    def update(self, loss_sum: float, labels: float, sentences: int,
+    def update(self, loss_sum, labels: float, sentences: int,
                src_words: float = 0.0, lr: Optional[float] = None) -> None:
+        """loss_sum may be a LAZY device scalar (jax.Array) — it is only
+        accumulated here; the host-device sync happens at the display
+        boundary (_display), keeping the hot loop free of per-step blocking
+        so dispatch can run ahead of the device."""
         s = self.state
         s.batches += 1
         s.batches_epoch += 1
@@ -109,6 +113,7 @@ class Scheduler:
         s = self.state
         dt = max(time.perf_counter() - self._timer, 1e-9)
         cost_type = self.options.get("cost-type", "ce-sum")
+        self._cost_sum = float(self._cost_sum)   # the one deferred sync
         if cost_type == "ce-mean-words" or cost_type == "ce-sum":
             cost = self._cost_sum / max(self._label_sum, 1.0)
         elif cost_type == "perplexity":

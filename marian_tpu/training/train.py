@@ -36,6 +36,9 @@ class Train:
         seed = int(opts.get("seed", 0)) or 1234
         key = prng.root_key(seed)
 
+        from ..common.profiling import enable_compilation_cache
+        enable_compilation_cache()
+
         if opts.get("check-nan", False):
             # --check-nan: abort with a traceback on the first non-finite
             # value anywhere under jit (reference: graph NaN sanitizer;
@@ -238,10 +241,13 @@ class Train:
                 trace.tick(state.batches + 1)
                 out = gg.update(arrays, state.batches + 1,
                                 jax.random.fold_in(train_key, state.batches))
-                scheduler.update(out.loss_sum, out.labels,
+                # loss_sum stays a lazy device scalar (sync deferred to the
+                # display boundary); labels/lr come from host-side math so
+                # the hot loop never blocks on the device
+                scheduler.update(out.loss_sum, sum(b.words for b in micro),
                                  sum(b.size for b in micro),
                                  src_words=sum(b.src_words for b in micro),
-                                 lr=float(gg.schedule(state.batches + 1)))
+                                 lr=gg.schedule.host_lr(state.batches + 1))
                 micro = []
                 if scheduler.should_validate():
                     do_validate()
