@@ -590,11 +590,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
     # -- would silently change training/decoding semantics: refuse --
     "transformer-pool": ("error", "pooled attention variant is not "
                                   "implemented"),
-    "factors-combine": ("error-unless", "sum", "only sum-combination of "
-                                              "factor embeddings"),
-    "factors-dim-emb": ("error", "concatenative factor embeddings are not "
-                                 "implemented (sum combine only)"),
-    "lemma-dim-emb": ("error", "lemma re-embedding is not implemented"),
 }
 
 

@@ -55,6 +55,24 @@ def factored_embed(table: jax.Array, ft: FactorTables,
     return (gathered * mask).sum(axis=-2)              # [..., D]
 
 
+def factored_embed_concat(lemma_table: jax.Array, factor_table: jax.Array,
+                          ft: FactorTables, ids: jax.Array,
+                          dtype) -> jax.Array:
+    """--factors-combine concat (reference: src/layers/embedding.cpp
+    concatenative composition): emb(word) = [emb(lemma);
+    emb(factor_1); ...; emb(factor_G)] with a (dim_emb - G*f)-wide lemma
+    table and f-wide per-factor vectors. `factor_table` rows are the factor
+    units in unit order with the PAD unit as its LAST row; absent factors
+    contribute a zero block (masked, no trainable PAD bias)."""
+    idx = jnp.asarray(ft.factor_indices)[ids]              # [..., K]
+    parts = [lemma_table[idx[..., 0]].astype(dtype)]       # lemma column
+    for kcol in range(1, idx.shape[-1]):
+        u = idx[..., kcol] - ft.n_lemmas                   # factor-row index
+        mask = (idx[..., kcol] != ft.pad_unit)[..., None].astype(dtype)
+        parts.append(factor_table[u].astype(dtype) * mask)
+    return jnp.concatenate(parts, axis=-1)
+
+
 def factored_log_probs(unit_logits: jax.Array, ft: FactorTables,
                        shortlist: Optional[jax.Array] = None,
                        factor_weight: float = 1.0) -> jax.Array:

@@ -95,6 +95,15 @@ class S2SConfig:
 def config_from_options(options, src_vocab: int, trg_vocab: int,
                         for_inference: bool = False) -> S2SConfig:
     g = options.get
+    # factored-embedding knobs are transformer-family only: refuse rather
+    # than silently train plain embeddings (audit principle — same flag,
+    # same behavior, or a loud error)
+    if str(g("factors-combine", "sum") or "sum") != "sum" \
+            or int(g("factors-dim-emb", 0) or 0) \
+            or int(g("lemma-dim-emb", 0) or 0):
+        raise ValueError(
+            "--factors-combine concat / --factors-dim-emb / --lemma-dim-emb "
+            "are only supported by the transformer model family")
     char_conv = str(g("type", "s2s")) == "char-s2s"
     precision = g("precision", ["float32"])
     compute = precision[0] if isinstance(precision, list) else precision

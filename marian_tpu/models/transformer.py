@@ -108,6 +108,20 @@ class TransformerConfig:
     # state (KV caches) stays per-physical-layer. Empty = no tying.
     tied_layers: Tuple[int, ...] = ()
     factor_weight: float = 1.0                # --factor-weight
+    # --factors-combine concat (--factors-dim-emb f): each factor group
+    # contributes an f-dim embedding CONCATENATED after an (emb - G*f)-dim
+    # lemma embedding instead of summing same-width vectors (reference:
+    # src/layers/embedding.cpp concatenative composition). Embedding-side
+    # only; the factored output stays the unit-axis softmax.
+    factors_combine: str = "sum"              # "sum" | "concat"
+    factors_dim_emb: int = 0
+    # --lemma-dim-emb L: soft lemma re-embedding in the factored output
+    # (reference: src/layers/output.cpp :: Output::applyAsLogits, the
+    # lemma-conditioned factor prediction): lemma distribution → expected
+    # L-dim lemma embedding → projected and added to the decoder state
+    # BEFORE the factor-group logits, so factor predictions condition on
+    # the predicted lemma. L = -1 uses dim-emb.
+    lemma_dim_emb: int = 0
     # decoder-only language model (--type transformer-lm; reference:
     # src/models/model_factory.cpp 'transformer' DecoderOnly assembly used
     # by marian-scorer for LM scoring / R2L reranking): no encoder stack,
@@ -235,7 +249,56 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         guided_alignment_layer=str(g("transformer-guided-alignment-layer", "last")),
         src_factors=src_factors,
         trg_factors=trg_factors,
+        factors_combine=_check_factors_combine(
+            str(g("factors-combine", "sum") or "sum"),
+            int(g("factors-dim-emb", 0) or 0), int(g("dim-emb", 512)),
+            src_factors, trg_factors,
+            bool(g("tied-embeddings-all", False))
+            or bool(g("tied-embeddings", False))
+            or bool(g("tied-embeddings-src", False))),
+        factors_dim_emb=int(g("factors-dim-emb", 0) or 0),
+        lemma_dim_emb=_check_lemma_dim(int(g("lemma-dim-emb", 0) or 0),
+                                       int(g("dim-emb", 512)), trg_factors),
     )
+
+
+def _check_factors_combine(mode: str, f_dim: int, d: int, src_factors,
+                           trg_factors, tied: bool) -> str:
+    if mode not in ("sum", "concat"):
+        raise ValueError(f"--factors-combine '{mode}' (sum or concat)")
+    if mode == "sum" and f_dim > 0:
+        raise ValueError(
+            "--factors-dim-emb only applies with --factors-combine concat "
+            "(sum combination uses full-width dim-emb factor vectors)")
+    if mode == "concat":
+        if f_dim <= 0:
+            raise ValueError("--factors-combine concat requires "
+                             "--factors-dim-emb > 0")
+        if tied:
+            raise ValueError(
+                "--factors-combine concat is incompatible with tied "
+                "embeddings: the lemma table is narrower than dim-emb and "
+                "cannot double as the unit-axis output matrix")
+        for ft in tuple(src_factors or ()) + (trg_factors,):
+            if ft is None:
+                continue
+            groups = len(ft.group_slices) - 1
+            if d - groups * f_dim < 1:
+                raise ValueError(
+                    f"--factors-dim-emb {f_dim}: {groups} factor groups "
+                    f"leave no room for the lemma embedding at dim-emb {d}")
+    return mode
+
+
+def _check_lemma_dim(val: int, d: int, trg_factors) -> int:
+    if val == -1:
+        val = d
+    if val < 0:
+        raise ValueError(f"--lemma-dim-emb {val}: use 0 (off), -1 "
+                         f"(= dim-emb) or a positive dimension")
+    if val > 0 and trg_factors is None:
+        raise ValueError("--lemma-dim-emb needs a factored target vocab")
+    return val
 
 
 def _src_rows(cfg: TransformerConfig, i: int = 0) -> int:
@@ -276,12 +339,22 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             scale = 1.0 / math.sqrt(depth_layer)
         return inits.glorot_uniform(next(k), shape, scale=scale)
 
-    # embeddings (row count = factor units for factored vocabs)
-    if cfg.lm:
-        if cfg.tied_embeddings_all or cfg.tied_embeddings:
-            p["Wemb"] = glorot((_trg_rows(cfg), d))
+    # embeddings (row count = factor units for factored vocabs; concat
+    # combination splits each factored table into a narrower lemma table
+    # plus an f-wide factor table — see layers/logits.py)
+    def emb_tables(name: str, ft, rows: int):
+        if ft is not None and cfg.factors_combine == "concat":
+            groups = len(ft.group_slices) - 1
+            p[name] = glorot((ft.n_lemmas,
+                              d - groups * cfg.factors_dim_emb))
+            p[name + "_factors"] = glorot((ft.n_units - ft.n_lemmas,
+                                           cfg.factors_dim_emb))
         else:
-            p["decoder_Wemb"] = glorot((_trg_rows(cfg), d))
+            p[name] = glorot((rows, d))
+
+    if cfg.lm:
+        emb_tables("Wemb" if (cfg.tied_embeddings_all or cfg.tied_embeddings)
+                   else "decoder_Wemb", cfg.trg_factors, _trg_rows(cfg))
     elif cfg.tied_embeddings_all or cfg.tied_embeddings_src:
         if any(_src_rows(cfg, i) != _trg_rows(cfg)
                for i in range(cfg.n_encoders)):
@@ -289,8 +362,10 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
         p["Wemb"] = glorot((_trg_rows(cfg), d))
     else:
         for i in range(cfg.n_encoders):
-            p[f"{_enc_prefix(i)}_Wemb"] = glorot((_src_rows(cfg, i), d))
-        p["decoder_Wemb"] = glorot((_trg_rows(cfg), d))
+            emb_tables(f"{_enc_prefix(i)}_Wemb",
+                       cfg.src_factors[i] if i < len(cfg.src_factors)
+                       else None, _src_rows(cfg, i))
+        emb_tables("decoder_Wemb", cfg.trg_factors, _trg_rows(cfg))
     if cfg.train_position_embeddings:
         p["Wpos"] = glorot((cfg.max_length, d))
     if "n" in cfg.postprocess_emb:
@@ -386,6 +461,12 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
         p["decoder_ff_logit_out_W"] = glorot((d, _trg_rows(cfg)))
     p["decoder_ff_logit_out_b"] = inits.zeros((1, _trg_rows(cfg)))
+    if cfg.trg_factors is not None and cfg.lemma_dim_emb > 0:
+        # soft lemma re-embedding (--lemma-dim-emb; see TransformerConfig)
+        p["decoder_lemma_reembed_W"] = glorot(
+            (cfg.trg_factors.n_lemmas, cfg.lemma_dim_emb))
+        p["decoder_lemma_reembed_Wp"] = glorot((cfg.lemma_dim_emb, d))
+        p["decoder_lemma_reembed_bp"] = inits.zeros((1, d))
 
     if cfg.ulr:
         if cfg.ulr_queries is None or cfg.ulr_keys is None:
@@ -683,10 +764,16 @@ def _embed_words(cfg: TransformerConfig, params: Params, ids: jax.Array,
     ft = cfg.src_factors[enc_idx] if side == "src" else cfg.trg_factors
     from ..ops.quantization import QTensor, int8_gather
     if ft is not None:
-        from ..layers.logits import factored_embed
+        from ..layers.logits import factored_embed, factored_embed_concat
         if isinstance(table, QTensor):
             table = table.dequantize(cfg.compute_dtype)
-        x = factored_embed(table, ft, ids, cfg.compute_dtype)
+        if cfg.factors_combine == "concat":
+            fac = params[own + "_factors"]     # tying is refused for concat
+            if isinstance(fac, QTensor):
+                fac = fac.dequantize(cfg.compute_dtype)
+            x = factored_embed_concat(table, fac, ft, ids, cfg.compute_dtype)
+        else:
+            x = factored_embed(table, ft, ids, cfg.compute_dtype)
     elif isinstance(table, QTensor):
         x = int8_gather(table, ids, cfg.compute_dtype)
     else:
@@ -939,6 +1026,38 @@ def _plain_output_table(cfg: TransformerConfig, params: Params):
     return None if (t is None or isinstance(t, QTensor)) else t
 
 
+def _lemma_conditioned_units(cfg: TransformerConfig, params: Params,
+                             x: jax.Array, w, b) -> jax.Array:
+    """--lemma-dim-emb: unit scores with soft lemma re-embedding
+    (reference: src/layers/output.cpp lemma-conditioned factor logits).
+    Lemma logits come from the plain decoder state; the lemma posterior's
+    expected L-dim embedding is projected back to dim-emb and added to the
+    state before the factor-group logits, so factor predictions see the
+    (softly) chosen lemma. Two matmuls over disjoint unit columns — same
+    total FLOPs as the single fused matmul."""
+    from ..ops.quantization import QTensor
+
+    def _f32(t):
+        return (t.dequantize(jnp.float32) if isinstance(t, QTensor)
+                else t.astype(jnp.float32))
+
+    ft = cfg.trg_factors
+    nl = ft.n_lemmas
+    w = w.astype(x.dtype)
+    b = b.astype(jnp.float32)
+    lemma_units = jnp.dot(x, w[:, :nl],
+                          preferred_element_type=jnp.float32)
+    lemma_units = lemma_units.astype(jnp.float32) + b[..., :nl]
+    probs = jax.nn.softmax(lemma_units, axis=-1)
+    e = jnp.dot(probs, _f32(params["decoder_lemma_reembed_W"]))
+    delta = jnp.dot(e, _f32(params["decoder_lemma_reembed_Wp"])) \
+        + params["decoder_lemma_reembed_bp"].astype(jnp.float32)
+    x = x + delta.astype(x.dtype)
+    fac_units = jnp.dot(x, w[:, nl:], preferred_element_type=jnp.float32)
+    fac_units = fac_units.astype(jnp.float32) + b[..., nl:]
+    return jnp.concatenate([lemma_units, fac_units], axis=-1)
+
+
 def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
                   shortlist: Optional[jax.Array] = None) -> jax.Array:
     """Output projection with tied embeddings and optional shortlist slice
@@ -961,6 +1080,10 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
         # tied quantized table [V, d], per-row scales → int8 x @ table.T
         if cfg.trg_factors is not None:
             from ..layers.logits import factored_log_probs
+            if cfg.lemma_dim_emb > 0:
+                raise NotImplementedError(
+                    "--lemma-dim-emb with an int8-quantized tied output "
+                    "table is not supported; decode with a float model")
             units = int8_logits(x, table, None) + b.astype(jnp.float32)
             return factored_log_probs(units, cfg.trg_factors, shortlist,
                                       cfg.factor_weight)
@@ -982,9 +1105,12 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
             w = w.dequantize(jnp.float32)
     if cfg.trg_factors is not None:
         from ..layers.logits import factored_log_probs
-        units = jnp.dot(x, w.astype(x.dtype),
-                        preferred_element_type=jnp.float32)
-        units = units.astype(jnp.float32) + b.astype(jnp.float32)
+        if cfg.lemma_dim_emb > 0:
+            units = _lemma_conditioned_units(cfg, params, x, w, b)
+        else:
+            units = jnp.dot(x, w.astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+            units = units.astype(jnp.float32) + b.astype(jnp.float32)
         return factored_log_probs(units, cfg.trg_factors, shortlist,
                                       cfg.factor_weight)
     if shortlist is not None:

@@ -88,9 +88,10 @@ class GraphGroup:
         names = set()
         if self._fix_src or self._fix_trg:
             for k in self.params:
-                is_src = (k.endswith("_Wemb") and not k.startswith("decoder")) \
-                    or (k == "Wemb")
-                is_trg = k in ("decoder_Wemb", "Wemb_dec") or (
+                is_src = ((k.endswith("_Wemb") or k.endswith("_Wemb_factors"))
+                          and not k.startswith("decoder")) or (k == "Wemb")
+                is_trg = k in ("decoder_Wemb", "decoder_Wemb_factors",
+                               "Wemb_dec") or (
                     k == "Wemb" and not any(
                         o in self.params
                         for o in ("decoder_Wemb", "Wemb_dec")))

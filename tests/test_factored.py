@@ -1,5 +1,7 @@
 """Factored vocabulary + factored softmax tests (config #4 family)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -187,3 +189,140 @@ class TestFactorWeight:
         # factored words shift by half their factor log-prob contribution
         diff = np.asarray(base - half)
         assert np.abs(diff[:, 2:]).max() > 0
+
+
+class TestConcatFactors:
+    """--factors-combine concat + --factors-dim-emb (embedding side)."""
+
+    def _model(self, fvocab, **over):
+        base = {"type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+                "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+                "tied-embeddings-all": False, "label-smoothing": 0.0,
+                "factors-combine": "concat", "factors-dim-emb": 4,
+                "precision": ["float32", "float32"], "max-length": 32}
+        base.update(over)
+        model = create_model(Options(base), fvocab, fvocab)
+        params = model.init(jax.random.key(0))
+        return model, params
+
+    def test_table_shapes(self, fvocab):
+        model, params = self._model(fvocab)
+        groups = len(fvocab.groups)
+        lemma_dim = 16 - groups * 4
+        assert params["encoder_Wemb"].shape == (fvocab.n_lemmas, lemma_dim)
+        assert params["encoder_Wemb_factors"].shape == \
+            (fvocab.n_units - fvocab.n_lemmas, 4)
+        # output stays the unit-axis matrix
+        assert params["decoder_ff_logit_out_W"].shape[1] == fvocab.n_units
+
+    def test_embedding_is_concatenation(self, fvocab, rng):
+        from marian_tpu.layers.logits import factored_embed_concat
+        ft = FactorTables.from_vocab(fvocab)
+        groups = len(fvocab.groups)
+        lemma_dim = 16 - groups * 4
+        lt = jnp.asarray(rng.randn(ft.n_lemmas, lemma_dim), jnp.float32)
+        ftb = jnp.asarray(rng.randn(ft.n_units - ft.n_lemmas, 4), jnp.float32)
+        wid = fvocab["world|ci|gl+"]
+        emb = factored_embed_concat(lt, ftb, ft, jnp.asarray([[wid]]),
+                                    jnp.float32)
+        assert emb.shape == (1, 1, 16)
+        units = ft.factor_indices[wid]
+        want = [np.asarray(lt[units[0]])]
+        for u in units[1:]:
+            want.append(np.zeros(4, np.float32) if u == ft.pad_unit
+                        else np.asarray(ftb[u - ft.n_lemmas]))
+        np.testing.assert_allclose(np.asarray(emb[0, 0]),
+                                   np.concatenate(want), rtol=1e-6)
+
+    def test_trains_and_decodes(self, fvocab, rng):
+        model, params = self._model(fvocab)
+        v = len(fvocab)
+        batch = {
+            "src_ids": jnp.asarray(rng.randint(2, v, (2, 5)), jnp.int32),
+            "src_mask": jnp.ones((2, 5), jnp.float32),
+            "trg_ids": jnp.asarray(rng.randint(2, v, (2, 6)), jnp.int32),
+            "trg_mask": jnp.ones((2, 6), jnp.float32),
+        }
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss(p, batch, None, train=False)[0])(params)
+        assert np.isfinite(float(loss))
+        assert float(jnp.sum(jnp.abs(grads["encoder_Wemb_factors"]))) > 0
+        from marian_tpu.translator.beam_search import (BeamConfig,
+                                                       beam_search_jit)
+        tokens, _, _, norm, _ = beam_search_jit(
+            model, [params], [1.0], BeamConfig(beam_size=2, max_length=5),
+            batch["src_ids"], batch["src_mask"])
+        assert np.all(np.isfinite(np.asarray(norm)))
+
+    def test_concat_refuses_tied_and_bad_dims(self, fvocab):
+        import pytest as _pt
+        with _pt.raises(ValueError, match="tied"):
+            self._model(fvocab, **{"tied-embeddings-all": True})
+        with _pt.raises(ValueError, match="factors-dim-emb"):
+            self._model(fvocab, **{"factors-dim-emb": 8})
+
+
+class TestLemmaReembedding:
+    """--lemma-dim-emb: lemma-conditioned factor prediction."""
+
+    def _model(self, fvocab, lemma_dim=6, **over):
+        base = {"type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+                "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+                "tied-embeddings-all": True, "label-smoothing": 0.0,
+                "lemma-dim-emb": lemma_dim,
+                "precision": ["float32", "float32"], "max-length": 32}
+        base.update(over)
+        model = create_model(Options(base), fvocab, fvocab)
+        params = model.init(jax.random.key(0))
+        return model, params
+
+    def test_params_exist_and_train(self, fvocab, rng):
+        model, params = self._model(fvocab)
+        assert params["decoder_lemma_reembed_W"].shape == \
+            (fvocab.n_lemmas, 6)
+        assert params["decoder_lemma_reembed_Wp"].shape == (6, 16)
+        v = len(fvocab)
+        batch = {
+            "src_ids": jnp.asarray(rng.randint(2, v, (2, 5)), jnp.int32),
+            "src_mask": jnp.ones((2, 5), jnp.float32),
+            "trg_ids": jnp.asarray(rng.randint(2, v, (2, 6)), jnp.int32),
+            "trg_mask": jnp.ones((2, 6), jnp.float32),
+        }
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss(p, batch, None, train=False)[0])(params)
+        assert np.isfinite(float(loss))
+        # the re-embedding participates in the graph
+        assert float(jnp.sum(jnp.abs(
+            grads["decoder_lemma_reembed_W"]))) > 0
+
+    def test_lemma_scores_unchanged_factors_conditioned(self, fvocab, rng):
+        """Lemma log-probs must be identical with/without re-embedding for
+        the SAME parameters (the lemma head sees the plain state); factor
+        scores must differ (they see the lemma-conditioned state)."""
+        from marian_tpu.models import transformer as T
+        model, params = self._model(fvocab)
+        x = jnp.asarray(rng.randn(2, 3, 16), jnp.float32)
+        with_d = T.output_logits(model.cfg, params, x)
+        cfg_off = dataclasses.replace(model.cfg, lemma_dim_emb=0)
+        without = T.output_logits(cfg_off, params, x)
+        ft = model.cfg.trg_factors
+        # '</s>' is lemma-only → identical score either way
+        np.testing.assert_allclose(np.asarray(with_d[..., 0]),
+                                   np.asarray(without[..., 0]),
+                                   rtol=1e-5, atol=1e-5)
+        # factored words: conditioned factor logits shift the scores
+        assert np.abs(np.asarray(with_d - without))[..., 2:].max() > 1e-6
+
+    def test_minus_one_uses_dim_emb(self, fvocab):
+        model, params = self._model(fvocab, lemma_dim=-1)
+        assert params["decoder_lemma_reembed_W"].shape == \
+            (fvocab.n_lemmas, 16)
+
+    def test_requires_factored_target(self, tmp_path):
+        import pytest as _pt
+        from marian_tpu.data.vocab import DefaultVocab
+        plain = DefaultVocab.build(["a b c"])
+        with _pt.raises(ValueError, match="factored"):
+            create_model(Options({"type": "transformer", "dim-emb": 16,
+                                  "lemma-dim-emb": 4,
+                                  "transformer-heads": 2}), plain, plain)

@@ -66,10 +66,20 @@ def test_error_flags_raise():
 
 
 def test_error_unless_allows_default_value():
+    # factors-combine concat is implemented now; exercise the error-unless
+    # mechanism itself with a synthetic registry entry
     parser = cp.ConfigParser("training")
-    cp.audit_flags(Options({"factors-combine": "sum"}), parser)  # no raise
-    with pytest.raises(ValueError, match="factors-combine"):
-        cp.audit_flags(Options({"factors-combine": "concat"}), parser)
+    cp.audit_flags(Options({"factors-combine": "concat"}), parser)  # no raise
+    entry = {"maxi-batch-sort": ("error-unless", "trg", "synthetic test")}
+    old = dict(cp.UNIMPLEMENTED_FLAGS)
+    cp.UNIMPLEMENTED_FLAGS.update(entry)
+    try:
+        cp.audit_flags(Options({"maxi-batch-sort": "trg"}), parser)
+        with pytest.raises(ValueError, match="maxi-batch-sort"):
+            cp.audit_flags(Options({"maxi-batch-sort": "src"}), parser)
+    finally:
+        cp.UNIMPLEMENTED_FLAGS.clear()
+        cp.UNIMPLEMENTED_FLAGS.update(old)
 
 
 def test_warn_flags_do_not_raise():
