@@ -38,8 +38,8 @@ def _write_corpus(tmp, vocab_size, n_lines, seed=7):
         fs.write(" ".join(words) + "\n")
         ft.write(" ".join(words) + "\n")
         for _ in range(n_lines):
-            n = min(64, max(4, int(rng.lognormvariate(3.2, 0.45))))
-            m = min(64, max(4, int(n * rng.uniform(0.8, 1.25))))
+            n = min(63, max(4, int(rng.lognormvariate(3.2, 0.45))))
+            m = min(63, max(4, int(n * rng.uniform(0.8, 1.25))))
             fs.write(" ".join(rng.choice(words) for _ in range(n)) + "\n")
             ft.write(" ".join(rng.choice(words) for _ in range(m)) + "\n")
     return src_p, trg_p
@@ -65,6 +65,16 @@ def main():
     from marian_tpu.models.encoder_decoder import batch_to_arrays, create_model
     from marian_tpu.training.graph_group import GraphGroup
 
+    # Coarse 2-bucket length table for the bench: every distinct
+    # (src_w, trg_w, rows) shape costs a full XLA compile of the train
+    # step — minutes over a remote TPU tunnel — so the bench corpus is
+    # quantized to ≤4 shape combos while still mixing real lengths
+    # inside each bucket (padding waste stays in the measurement).
+    # max-length 63 → crop to 63 tokens + EOS = width 64 exactly; corpus
+    # lines are capped at 63 words so nothing falls past the last bucket
+    # (bucket_length would jump to 512 → a surprise multi-minute compile)
+    buckets = (32, 64)
+    max_len = 63
     if preset == "big":
         dims = dict(emb=1024, ffn=4096, heads=16, depth=6, vocab=32000)
         words = int(os.environ.get("MARIAN_BENCH_WORDS", 8192))
@@ -95,7 +105,7 @@ def main():
         "learn-rate": 2e-4, "lr-warmup": "8000", "lr-decay-inv-sqrt": ["8000"],
         "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
         "clip-norm": 0.0, "exponential-smoothing": 1e-4,
-        "max-length": 64, "max-length-crop": True,
+        "max-length": max_len, "max-length-crop": True,
         "mini-batch": 512, "mini-batch-words": words,
         "maxi-batch": 100, "maxi-batch-sort": "trg",
         "shuffle": "data", "seed": 1111,
@@ -124,7 +134,8 @@ def main():
         # whether the probe ran (numbers stay comparable across
         # MARIAN_BENCH_FUSED settings).
         corpus_state = corpus.state.as_dict()
-        probe = next(iter(BatchGenerator(corpus, opts, prefetch=False)))
+        probe = next(iter(BatchGenerator(corpus, opts, prefetch=False,
+                                         length_buckets=buckets)))
         corpus.restore(corpus_state)
         times = {}
         for mode in ("on", "off"):
@@ -153,7 +164,8 @@ def main():
 
     def batches():
         while True:
-            for b in BatchGenerator(corpus, opts, prefetch=True):
+            for b in BatchGenerator(corpus, opts, prefetch=True,
+                                    length_buckets=buckets):
                 yield b
 
     gen = batches()
@@ -168,9 +180,15 @@ def main():
     by_shape = {}
     for b in timed_batches:
         by_shape.setdefault(b.shape_key(), b)
-    for b in by_shape.values():
+    print(f"warming {len(by_shape)} shapes: {sorted(by_shape)}",
+          file=sys.stderr, flush=True)
+    for sk, b in by_shape.items():
+        t0 = time.perf_counter()
         gg.update(batch_to_arrays(b), step + 1,
                   jax.random.fold_in(train_key, step))
+        jax.block_until_ready(gg.params)
+        print(f"  shape {sk}: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
         step += 1
     for _ in range(warmup):
         b = timed_batches[step % len(timed_batches)]

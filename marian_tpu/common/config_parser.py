@@ -129,7 +129,7 @@ _MODEL = [
     _f("attention-kernel", str, "auto", "Attention impl: auto, dense, flash (Pallas)", "model"),
     _f("auto-tune", bool, False, "Time implementation alternatives (dense vs Pallas flash attention crossover) on the current backend and bind the fastest, like the reference's AutoTuner (TPU extension)", "model"),
     _f("sequence-parallel", str, "none", "Sequence/context parallelism over the 'seq' mesh axis: none, ring (K/V blocks rotate via ppermute), ulysses (all-to-all head<->seq swap) (TPU extension)", "model"),
-    _f("scan-layers", bool, False, "lax.scan over layer stack (faster compile, needs uniform layers)", "model"),
+    _f("scan-layers", bool, True, "lax.scan over layer stack (compile time O(1) in depth; auto-falls back for tied layers/alignment/int8)", "model"),
 ]
 
 _TRAINING = [
@@ -575,8 +575,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
                           "dim/depth flags directly"),
     "skip-cost": ("warn", "hypothesis scores fall out of the beam at no "
                           "extra cost; there is nothing to skip"),
-    "scan-layers": ("warn", "lax.scan over the layer stack is not wired "
-                            "yet; layers are unrolled"),
     "bert-sep-symbol": ("warn", "sentence-pair assembly takes the token "
                                 "streams as given; separators are not "
                                 "re-inserted by the pipeline"),

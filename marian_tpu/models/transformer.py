@@ -136,6 +136,11 @@ class TransformerConfig:
     ulr_queries: Any = None                   # np [V_src, dq] or None
     ulr_keys: Any = None                      # np [V_u, dq] or None
     rnn_projection: bool = False              # --transformer-rnn-projection
+    # --scan-layers: run the layer stack as one lax.scan over stacked
+    # [L, ...] params (compile time O(1) in depth — the dominant TPU
+    # cold-start cost); falls back to the unrolled stack for tied layers,
+    # alignment extraction, and quantized (QTensor) layer weights
+    scan_layers: bool = True
     flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
     gradient_checkpointing: bool = False      # jax.checkpoint per layer
     # sequence/context parallelism over the mesh 'seq' axis (TPU extension,
@@ -240,6 +245,7 @@ def config_from_options(options, src_vocab, trg_vocab: int,
                                                     or 0.0),
         dim_aan=int(g("transformer-dim-aan", 2048)),
         rnn_projection=bool(g("transformer-rnn-projection", False)),
+        scan_layers=bool(g("scan-layers", True)),
         flash_attention=str(g("transformer-flash-attention", "auto")),
         gradient_checkpointing=(not for_inference
                                 and bool(g("gradient-checkpointing", False))),
@@ -669,12 +675,13 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
     return _unproj_heads(out, wo, bo), weights
 
 
-def _aan_apply(cfg: TransformerConfig, params: Params, l: int,
+def _aan_apply(cfg: TransformerConfig, params: Params, lp: str,
                x_in: jax.Array, y_avg: jax.Array) -> jax.Array:
     """FFN + sigmoid gate of the AAN sublayer applied to the cumulative
     average (reference: transformer.h LayerAAN — gate mixes the raw input
-    with the transformed average: out = g⊙x + (1-g)⊙FFN(avg))."""
-    pfx = f"decoder_l{l}_aan"
+    with the transformed average: out = g⊙x + (1-g)⊙FFN(avg)).
+    `lp` is the layer param prefix (e.g. 'decoder_l3')."""
+    pfx = f"{lp}_aan"
     act = activation(cfg.ffn_activation)
     h = act(affine(y_avg, params[f"{pfx}_W1"], params[f"{pfx}_b1"]))
     y = affine(h, params[f"{pfx}_W2"], params[f"{pfx}_b2"])
@@ -684,7 +691,7 @@ def _aan_apply(cfg: TransformerConfig, params: Params, l: int,
     return gate * x_in + (1.0 - gate) * y
 
 
-def _aan_train(cfg: TransformerConfig, params: Params, l: int,
+def _aan_train(cfg: TransformerConfig, params: Params, lp: str,
                x: jax.Array) -> jax.Array:
     """Full-sequence AAN: the cumulative mean over positions is a prefix
     sum — O(T) HBM traffic instead of the T×T attention matrix (reference:
@@ -694,36 +701,36 @@ def _aan_train(cfg: TransformerConfig, params: Params, l: int,
     csum = jnp.cumsum(x.astype(jnp.float32), axis=1)
     denom = jnp.arange(1, t + 1, dtype=jnp.float32)[None, :, None]
     y = (csum / denom).astype(x.dtype)
-    return _aan_apply(cfg, params, l, x, y)
+    return _aan_apply(cfg, params, lp, x, y)
 
 
-def _ssru_train(cfg: TransformerConfig, params: Params, l: int,
+def _ssru_train(cfg: TransformerConfig, params: Params, lp: str,
                 x: jax.Array) -> jax.Array:
     """Full-sequence SSRU decoder sublayer via the parallel linear-
     recurrence scan (ops/rnn.py) — O(log T) depth on TPU."""
     from ..ops.rnn import SSRU, scan_linear_recurrence
     d = cfg.dim_emb
     cell = SSRU(d, d, False)
-    xp = cell.x_proj(params, f"decoder_l{l}_rnn", x)      # [B,T,2D]
+    xp = cell.x_proj(params, f"{lp}_rnn", x)              # [B,T,2D]
     f, inp = xp[..., :d], xp[..., d:]
     c = scan_linear_recurrence(f.transpose(1, 0, 2), inp.transpose(1, 0, 2),
                                jnp.zeros_like(f[:, 0]))
     out = jax.nn.relu(c.transpose(1, 0, 2)).astype(x.dtype)
     if cfg.rnn_projection:
-        out = affine(out, params[f"decoder_l{l}_rnn_Wo"],
-                     params[f"decoder_l{l}_rnn_bo"])
+        out = affine(out, params[f"{lp}_rnn_Wo"],
+                     params[f"{lp}_rnn_bo"])
     return out
 
 
-def _autoreg_train(cfg: TransformerConfig, params: Params, l: int,
+def _autoreg_train(cfg: TransformerConfig, params: Params, lp: str,
                    pre: jax.Array, self_mask, trg_mask, lk, train):
     """The decoder's autoregressive sublayer on the full target sequence
-    (--transformer-decoder-autoreg)."""
+    (--transformer-decoder-autoreg). `lp` = layer param prefix."""
     if cfg.decoder_autoreg == "average-attention":
-        return _aan_train(cfg, params, l, pre)
+        return _aan_train(cfg, params, lp, pre)
     if cfg.decoder_autoreg == "rnn":
-        return _ssru_train(cfg, params, l, pre)
-    out, _ = _mha(cfg, params, f"decoder_l{l}_self", pre, pre, self_mask,
+        return _ssru_train(cfg, params, lp, pre)
+    out, _ = _mha(cfg, params, f"{lp}_self", pre, pre, self_mask,
                   lk, train, kv_mask=trg_mask, causal=True)
     return out
 
@@ -856,6 +863,40 @@ def sinusoidal_positions_dynamic(length: int, dim: int, start) -> jax.Array:
 # Encoder
 # ---------------------------------------------------------------------------
 
+def _stacked_layer_params(cfg: TransformerConfig, params: Params,
+                          base: str, n: int):
+    """--scan-layers: stack each per-layer weight into one [n, ...] leaf so
+    the layer stack runs as ONE lax.scan instead of n unrolled copies —
+    the compiled HLO (and XLA compile time, the dominant cold-start cost
+    on TPU) stays O(1) in depth. Returns {suffix: stacked} keyed by the
+    name after '{base}{l}_', or None when scanning doesn't apply: flag
+    off, depth < 2, cross-layer tying (layers share leaves), or
+    non-array leaves (int8 QTensor decode params).
+
+    The stack is rebuilt inside every jitted forward (one HBM copy of the
+    layer weights per step, ~1ms for transformer-big — measured against
+    ~100ms steps). That per-step cost is deliberate: params stay stored
+    flat under Marian's per-layer names, keeping checkpoint IO, TP
+    sharding specs, freezing, and quantization untouched."""
+    if not cfg.scan_layers or n < 2 or cfg.tied_layers:
+        return None
+    first = f"{base}1_"
+    sfxs = [k[len(first):] for k in params if k.startswith(first)]
+    if not sfxs:
+        return None
+    out = {}
+    for s in sfxs:
+        leaves = []
+        for l in range(1, n + 1):
+            v = params.get(f"{base}{l}_{s}")
+            if v is None or not isinstance(v, jax.Array) \
+                    or v.shape != params[f"{base}1_{s}"].shape:
+                return None
+            leaves.append(v)
+        out[s] = jnp.stack(leaves)
+    return out
+
+
 def encode(cfg: TransformerConfig, params: Params, src_ids,
            src_mask, train: bool = False,
            key: Optional[jax.Array] = None):
@@ -886,32 +927,50 @@ def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
                   kk(1), train)
     attn_mask = src_mask[:, None, None, :]  # [B,1,1,Ts]
 
-    def enc_layer(x, l):
-        lk = kk(l * 10)
-        pl = _tied(cfg, l)               # parameter-owning layer
+    def enc_layer(x, pp, lp, lnum):
+        """One encoder layer; `pp` is the param view, `lp` the layer param
+        prefix (e.g. 'encoder_l3'), `lnum` the 1-based layer number for
+        dropout-key folding (may be a traced int under lax.scan)."""
+        lk = kk(lnum * 10)
         # self-attention sublayer
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"{ep}_l{pl}_self_Wo", params, lk, train)
-        out, _ = _mha(cfg, params, f"{ep}_l{pl}_self", pre, pre, attn_mask,
+                        f"{lp}_self_Wo", pp, lk, train)
+        out, _ = _mha(cfg, pp, f"{lp}_self", pre, pre, attn_mask,
                       lk, train, kv_mask=src_mask)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"{ep}_l{pl}_self_Wo", params, lk, train)
+                      f"{lp}_self_Wo", pp, lk, train)
         # ffn sublayer
-        lk2 = kk(l * 10 + 5)
+        lk2 = kk(lnum * 10 + 5)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"{ep}_l{pl}_ffn_ffn", params, lk2, train)
-        out = _ffn(cfg, params, f"{ep}_l{pl}_ffn", pre, cfg.dim_ffn,
+                        f"{lp}_ffn_ffn", pp, lk2, train)
+        out = _ffn(cfg, pp, f"{lp}_ffn", pre, cfg.dim_ffn,
                    cfg.ffn_depth, lk2, train)
         return _pre_post(cfg, cfg.postprocess, out, x,
-                         f"{ep}_l{pl}_ffn_ffn", params, lk2, train)
+                         f"{lp}_ffn_ffn", pp, lk2, train)
 
-    for l in range(1, cfg.enc_depth + 1):
+    stacked = _stacked_layer_params(cfg, params, f"{ep}_l", cfg.enc_depth)
+    if stacked is not None:
+        def body(x, sl):
+            lp_leaves, lnum = sl
+            pv = {**params, **{f"{ep}_lS_{s}": v
+                               for s, v in lp_leaves.items()}}
+            return enc_layer(x, pv, f"{ep}_lS", lnum), None
         if cfg.gradient_checkpointing and train:
-            # --gradient-checkpointing: rematerialize the layer in the
-            # backward pass instead of keeping its activations in HBM
-            x = jax.checkpoint(partial(enc_layer, l=l))(x)
-        else:
-            x = enc_layer(x, l)
+            # prevent_cse=False: safe and faster under lax.scan (the loop
+            # already prevents the CSE remat guards against)
+            body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(
+            body, x, (stacked, jnp.arange(1, cfg.enc_depth + 1)))
+    else:
+        for l in range(1, cfg.enc_depth + 1):
+            pl = _tied(cfg, l)           # parameter-owning layer
+            f = partial(enc_layer, pp=params, lp=f"{ep}_l{pl}", lnum=l)
+            if cfg.gradient_checkpointing and train:
+                # --gradient-checkpointing: rematerialize the layer in the
+                # backward pass instead of keeping its activations in HBM
+                x = jax.checkpoint(f)(x)
+            else:
+                x = f(x)
     x = _pre_post(cfg, cfg.postprocess_top, x, None, f"{ep}_top", params,
                   kk(9999), train)
     return x
@@ -949,50 +1008,71 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
         cross_masks = [m[:, None, None, :] for m in masks]
     align = None
 
-    def dec_layer(x, l, want_align):
-        lk = kk(l * 10)
-        pl = _tied(cfg, l)               # parameter-owning layer
+    def dec_layer(x, pp, lp, lnum, want_align):
+        """One decoder layer; `pp`/`lp`/`lnum` as in enc_layer."""
+        lk = kk(lnum * 10)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"decoder_l{pl}_self_Wo", params, lk, train)
-        out = _autoreg_train(cfg, params, pl, pre, self_mask, trg_mask,
+                        f"{lp}_self_Wo", pp, lk, train)
+        out = _autoreg_train(cfg, pp, lp, pre, self_mask, trg_mask,
                              lk, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"decoder_l{pl}_self_Wo", params, lk, train)
+                      f"{lp}_self_Wo", pp, lk, train)
 
         align_l = None
         # one cross-attention sublayer per encoder (multi-source stacks them)
         for i, eo in enumerate(enc_outs):
-            cname = f"decoder_l{pl}_context{_ctx_suffix(i)}"
-            lk2 = kk(l * 10 + 3 + i)
+            cname = f"{lp}_context{_ctx_suffix(i)}"
+            lk2 = kk(lnum * 10 + 3 + i)
             want_w = want_align and i == 0
             pre = _pre_post(cfg, cfg.preprocess, x, None,
-                            f"{cname}_Wo", params, lk2, train)
-            out, w = _mha(cfg, params, cname, pre, eo,
+                            f"{cname}_Wo", pp, lk2, train)
+            out, w = _mha(cfg, pp, cname, pre, eo,
                           cross_masks[i], lk2, train, return_weights=want_w,
                           kv_mask=masks[i])
             if want_w and w is not None:
                 align_l = w.mean(axis=1)  # [B,Tt,Ts] head-averaged
             x = _pre_post(cfg, cfg.postprocess, out, x,
-                          f"{cname}_Wo", params, lk2, train)
+                          f"{cname}_Wo", pp, lk2, train)
 
-        lk3 = kk(l * 10 + 7)
+        lk3 = kk(lnum * 10 + 7)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
-                        f"decoder_l{pl}_ffn_ffn", params, lk3, train)
-        out = _ffn(cfg, params, f"decoder_l{pl}_ffn", pre, cfg.dec_ffn,
+                        f"{lp}_ffn_ffn", pp, lk3, train)
+        out = _ffn(cfg, pp, f"{lp}_ffn", pre, cfg.dec_ffn,
                    cfg.dec_ffn_d, lk3, train)
         x = _pre_post(cfg, cfg.postprocess, out, x,
-                      f"decoder_l{pl}_ffn_ffn", params, lk3, train)
+                      f"{lp}_ffn_ffn", pp, lk3, train)
         return x, align_l
 
-    for l in range(1, cfg.dec_depth + 1):
-        want_align = return_alignment and _is_alignment_layer(cfg, l)
-        if cfg.gradient_checkpointing and train and not want_align:
-            x, _ = jax.checkpoint(
-                partial(dec_layer, l=l, want_align=False))(x)
-        else:
-            x, align_l = dec_layer(x, l, want_align)
-            if align_l is not None:
-                align = align_l
+    # alignment extraction needs one specific layer's attention weights —
+    # scan can't surface a single iteration's side output cheaply, so the
+    # guided-alignment path keeps the unrolled stack
+    stacked = None if return_alignment else _stacked_layer_params(
+        cfg, params, "decoder_l", cfg.dec_depth)
+    if stacked is not None:
+        def body(x, sl):
+            lp_leaves, lnum = sl
+            pv = {**params, **{f"decoder_lS_{s}": v
+                               for s, v in lp_leaves.items()}}
+            x, _ = dec_layer(x, pv, "decoder_lS", lnum, False)
+            return x, None
+        if cfg.gradient_checkpointing and train:
+            # prevent_cse=False: safe and faster under lax.scan (the loop
+            # already prevents the CSE remat guards against)
+            body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(
+            body, x, (stacked, jnp.arange(1, cfg.dec_depth + 1)))
+    else:
+        for l in range(1, cfg.dec_depth + 1):
+            want_align = return_alignment and _is_alignment_layer(cfg, l)
+            pl = _tied(cfg, l)           # parameter-owning layer
+            f = partial(dec_layer, pp=params, lp=f"decoder_l{pl}", lnum=l,
+                        want_align=want_align)
+            if cfg.gradient_checkpointing and train and not want_align:
+                x, _ = jax.checkpoint(f)(x)
+            else:
+                x, align_l = f(x)
+                if align_l is not None:
+                    align = align_l
     x = _pre_post(cfg, cfg.postprocess_top, x, None, "decoder_top", params,
                   kk(9999), train)
     out = x if return_hidden else output_logits(cfg, params, x)
@@ -1214,7 +1294,7 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
             # running-sum cumulative average: y = (sum + x_t) / (pos+1)
             s = state[f"l{l}_aan_sum"] + pre.astype(jnp.float32)
             y = (s / (pos + 1).astype(jnp.float32)).astype(pre.dtype)
-            out = _aan_apply(cfg, params, pl, pre, y)
+            out = _aan_apply(cfg, params, f"decoder_l{pl}", pre, y)
             new_state[f"l{l}_aan_sum"] = s
         elif cfg.decoder_autoreg == "rnn":
             from ..ops.rnn import SSRU
