@@ -114,8 +114,8 @@ class BertModel:
         # input embeddings (reference: bert.h addSentenceEmbeddings)
         offset = (cparams["Wtype"][0][None, None, :]
                   if self.train_type_emb else None)
-        x = T._encode_one(self.cfg, cparams, ids, mask, train, key, 0,
-                          emb_offset=offset)
+        x, _aux = T._encode_one(self.cfg, cparams, ids, mask, train, key, 0,
+                                emb_offset=offset)
         return x, cparams
 
     # -- losses --------------------------------------------------------------

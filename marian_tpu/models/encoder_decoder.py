@@ -120,16 +120,29 @@ class EncoderDecoder:
         k_enc = jax.random.fold_in(key, 1) if key is not None else None
         k_dec = jax.random.fold_in(key, 2) if key is not None else None
         src_ids, src_mask = self._batch_sources(batch)
-        enc_out = self._mod.encode(self.cfg, cparams, src_ids,
-                                   src_mask, train, k_enc)
+        moe = self._mod is T and getattr(self.cfg, "moe_experts", 0) > 0
+        if moe:
+            enc_out, moe_aux = self._mod.encode(self.cfg, cparams, src_ids,
+                                                src_mask, train, k_enc,
+                                                with_aux=True)
+        else:
+            enc_out = self._mod.encode(self.cfg, cparams, src_ids,
+                                       src_mask, train, k_enc)
+            moe_aux = None
         want_align = self.use_guided and "guided" in batch
         table = self._fused_ce_table(cparams)
         kw = {"return_hidden": True} if table is not None else {}
+        if moe:
+            kw["with_aux"] = True
         res = self._mod.decode_train(self.cfg, cparams, enc_out,
                                      src_mask, batch["trg_ids"],
                                      batch["trg_mask"], train, k_dec,
                                      return_alignment=want_align, **kw)
-        hidden, align = res if want_align else (res, None)
+        parts = list(res) if isinstance(res, tuple) else [res]
+        hidden = parts.pop(0)
+        align = parts.pop(0) if want_align else None
+        if moe:
+            moe_aux = moe_aux + parts.pop(0)
         if table is not None and not (self.unlikelihood
                                       and "data_weights" in batch):
             rl = self._fused_ce_loss(cparams, table, hidden, batch)
@@ -142,6 +155,12 @@ class EncoderDecoder:
                                     unlikelihood=self.unlikelihood)
         total = rl.loss_sum
         aux = {"ce_sum": rl.loss_sum, "labels": rl.labels}
+        if moe and getattr(self.cfg, "moe_aux_weight", 0.0) > 0:
+            # load-balance aux joins at label scale like the guided loss
+            # (cost normalization divides by labels → effective weight is
+            # moe_aux_weight per token)
+            total = total + self.cfg.moe_aux_weight * moe_aux * rl.labels
+            aux["moe_aux"] = moe_aux
         if want_align and align is not None:
             ga = guided_alignment_loss(align, batch["guided"],
                                        batch["trg_mask"], self.guided_cost)

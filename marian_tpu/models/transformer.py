@@ -141,6 +141,15 @@ class TransformerConfig:
     # cold-start cost); falls back to the unrolled stack for tied layers,
     # alignment extraction, and quantized (QTensor) layer weights
     scan_layers: bool = True
+    # --transformer-moe-experts (TPU extension; the reference has no MoE):
+    # the FFN sublayer becomes a top-k-routed Mixture of Experts in the
+    # GShard dispatch/combine-einsum formulation — expert tables [E, ...]
+    # shard over the 'expert' mesh axis and XLA inserts the all-to-alls.
+    # Tokens beyond an expert's capacity fall through the residual stream.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
     gradient_checkpointing: bool = False      # jax.checkpoint per layer
     # sequence/context parallelism over the mesh 'seq' axis (TPU extension,
@@ -246,6 +255,11 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         dim_aan=int(g("transformer-dim-aan", 2048)),
         rnn_projection=bool(g("transformer-rnn-projection", False)),
         scan_layers=bool(g("scan-layers", True)),
+        moe_experts=int(g("transformer-moe-experts", 0) or 0),
+        moe_top_k=_check_moe(int(g("transformer-moe-experts", 0) or 0),
+                             int(g("transformer-moe-top-k", 2) or 2)),
+        moe_capacity_factor=float(g("moe-capacity-factor", 1.25) or 1.25),
+        moe_aux_weight=float(g("moe-aux-weight", 0.01) or 0.01),
         flash_attention=str(g("transformer-flash-attention", "auto")),
         gradient_checkpointing=(not for_inference
                                 and bool(g("gradient-checkpointing", False))),
@@ -294,6 +308,14 @@ def _check_factors_combine(mode: str, f_dim: int, d: int, src_factors,
                     f"--factors-dim-emb {f_dim}: {groups} factor groups "
                     f"leave no room for the lemma embedding at dim-emb {d}")
     return mode
+
+
+def _check_moe(experts: int, top_k: int) -> int:
+    if experts > 0 and not (1 <= top_k <= experts):
+        raise ValueError(
+            f"--transformer-moe-top-k {top_k}: must be between 1 and the "
+            f"number of experts ({experts})")
+    return top_k
 
 
 def _check_lemma_dim(val: int, d: int, trg_factors) -> int:
@@ -397,10 +419,28 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             p[f"{prefix}_Wo_ln_bias"] = inits.zeros((1, d))
 
     def ffn_block(prefix: str, dim_ffn: int, depth: int, layer: int):
-        dims = [d] + [dim_ffn] * (depth - 1) + [d]
-        for i in range(depth):
-            p[f"{prefix}_W{i+1}"] = glorot((dims[i], dims[i + 1]), layer)
-            p[f"{prefix}_b{i+1}"] = inits.zeros((1, dims[i + 1]))
+        if cfg.moe_experts > 0:
+            # MoE FFN (--transformer-moe-experts): expert-stacked tables;
+            # glorot fans are the per-expert matmul dims, not the E axis
+            ex = cfg.moe_experts
+            base = prefix[:-4]           # strip '_ffn' → '{ep}_l{l}'
+            scale = 1.0 / math.sqrt(layer) if (cfg.depth_scaling and layer)\
+                else 1.0
+            p[f"{base}_moe_gate"] = inits.glorot_uniform(
+                next(k), (d, ex), scale=scale)
+            p[f"{base}_moe_W1"] = inits.glorot_uniform(
+                next(k), (ex, d, dim_ffn), fan_in=d, fan_out=dim_ffn,
+                scale=scale)
+            p[f"{base}_moe_b1"] = inits.zeros((ex, 1, dim_ffn))
+            p[f"{base}_moe_W2"] = inits.glorot_uniform(
+                next(k), (ex, dim_ffn, d), fan_in=dim_ffn, fan_out=d,
+                scale=scale)
+            p[f"{base}_moe_b2"] = inits.zeros((ex, 1, d))
+        else:
+            dims = [d] + [dim_ffn] * (depth - 1) + [d]
+            for i in range(depth):
+                p[f"{prefix}_W{i+1}"] = glorot((dims[i], dims[i + 1]), layer)
+                p[f"{prefix}_b{i+1}"] = inits.zeros((1, dims[i + 1]))
         if "n" in cfg.preprocess or "n" in cfg.postprocess:
             p[f"{prefix}_ffn_ln_scale"] = inits.ones((1, d))
             p[f"{prefix}_ffn_ln_bias"] = inits.zeros((1, d))
@@ -747,6 +787,72 @@ def _ffn(cfg: TransformerConfig, params: Params, prefix: str, x: jax.Array,
     return x
 
 
+def _moe_ffn(cfg: TransformerConfig, params: Params, prefix: str,
+             x: jax.Array, train: bool = False,
+             key=None, mask: Optional[jax.Array] = None
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k-routed Mixture-of-Experts FFN (TPU extension; GShard
+    arXiv:2006.16668 / Switch arXiv:2101.03961 dispatch-einsum form —
+    PAPERS.md). Returns (out [B,T,D], aux load-balance scalar).
+
+    Tokens flatten to S=B*T; the router picks top-k experts per token with
+    renormalized gates; slot 0 of every token claims capacity before slot 1
+    (GShard's priority rule). Dispatch/combine are one-hot einsums — no
+    gather/scatter — so with expert tables sharded P('expert', ...) the
+    SPMD partitioner lowers them to all-to-alls over the 'expert' axis.
+    Over-capacity tokens get a zero update (the residual stream carries
+    them). Aux loss is Switch's E * Σ_e fraction_e · mean_gate_e."""
+    e, k = cfg.moe_experts, cfg.moe_top_k
+    b, t, d = x.shape
+    s = b * t
+    if train:
+        cap = min(max(1, int(math.ceil(
+            k * s * cfg.moe_capacity_factor / e))), s)
+    else:
+        # inference: full capacity (no token dropping) so routing is purely
+        # per-token — teacher-forced scoring and incremental beam decode
+        # then agree exactly (capacity pooling across timesteps cannot be
+        # reproduced step-by-step)
+        cap = s
+    xf = x.reshape(s, d)
+    mf = (jnp.ones((s, 1), jnp.float32) if mask is None
+          else mask.reshape(s, 1).astype(jnp.float32))
+    gates = jax.nn.softmax(jnp.dot(
+        xf, params[f"{prefix}_gate"].astype(x.dtype),
+        preferred_element_type=jnp.float32).astype(jnp.float32))   # [S,E]
+    vals, idx = jax.lax.top_k(gates, k)                            # [S,k]
+    vals = vals / jnp.maximum(vals.sum(-1, keepdims=True), 1e-9)
+    # padding tokens claim no expert slot, no gate mass, no aux weight —
+    # otherwise identical pad embeddings pile onto one expert and displace
+    # real tokens from its capacity
+    oh = jax.nn.one_hot(idx, e, dtype=jnp.float32) * mf[:, :, None]
+    # capacity positions: slot-major order (all slot-0 claims first)
+    flat = oh.transpose(1, 0, 2).reshape(k * s, e)                 # [kS,E]
+    pos = (jnp.cumsum(flat, axis=0) - 1.0) * flat                  # [kS,E]
+    keep = flat * (pos < cap)
+    pos_k = pos.reshape(k, s, e)
+    keep_k = keep.reshape(k, s, e)
+    disp = jnp.einsum("kse,ksec->sec", keep_k,
+                      jax.nn.one_hot(pos_k.astype(jnp.int32), cap,
+                                     dtype=jnp.float32))
+    gate_se = jnp.einsum("ske,sk->se", oh, vals)                   # [S,E]
+    comb = (disp * gate_se[:, :, None]).astype(x.dtype)            # [S,E,C]
+    ein = jnp.einsum("sec,sd->ecd", disp.astype(x.dtype), xf)      # [E,C,D]
+    act = activation(cfg.ffn_activation)
+    h = act(jnp.einsum("ecd,edf->ecf", ein, params[f"{prefix}_W1"])
+            + params[f"{prefix}_b1"])
+    if train and cfg.ffn_dropout > 0.0 and key is not None:
+        h = dropout(h, cfg.ffn_dropout, jax.random.fold_in(key, 91))
+    y = jnp.einsum("ecf,efd->ecd", h, params[f"{prefix}_W2"]) \
+        + params[f"{prefix}_b2"]
+    out = jnp.einsum("sec,ecd->sd", comb, y).reshape(b, t, d)
+    # load balance over REAL tokens: fraction routed to e × mean gate
+    n_real = jnp.maximum(mf.sum(), 1.0)
+    aux = e * jnp.sum((oh[:, 0, :].sum(axis=0) / n_real)
+                      * ((gates * mf).sum(axis=0) / n_real))
+    return out, aux
+
+
 def sinusoidal_positions(length: int, dim: int, start: int = 0) -> jax.Array:
     """Tensor2tensor-style timing signal (reference: transformer.h
     addPositionalEmbeddings): first half sin, second half cos."""
@@ -863,6 +969,60 @@ def sinusoidal_positions_dynamic(length: int, dim: int, start) -> jax.Array:
 # Encoder
 # ---------------------------------------------------------------------------
 
+def layer_param_groups(cfg: TransformerConfig):
+    """(prefix, depth) per layer stack: encoders (unless LM) + decoder."""
+    groups = []
+    if not cfg.lm:
+        for i in range(cfg.n_encoders):
+            groups.append((_enc_prefix(i), cfg.enc_depth))
+    groups.append(("decoder", cfg.dec_depth))
+    return groups
+
+
+def can_stack_layers(cfg: TransformerConfig) -> Optional[str]:
+    """None if depth-stacked parameter storage applies, else the reason it
+    can't (pipeline-parallel 'pipe' sharding requires the scanned stack)."""
+    if not cfg.scan_layers:
+        return "--scan-layers off"
+    if cfg.tied_layers:
+        return "--transformer-tied-layers shares leaves across layers"
+    if cfg.enc_depth < 2 and cfg.dec_depth < 2:
+        return "layer stacks of depth 1"
+    return None
+
+
+def stack_layer_params(cfg: TransformerConfig, tree: Params) -> Params:
+    """Depth-stacked parameter storage (pipeline-parallel memory layout):
+    per-layer leaves '{prefix}_l{l}_{suffix}' are replaced by ONE
+    '{prefix}_stack_{suffix}' leaf of shape [L, ...], which parallel/
+    tensor.py shards P('pipe', ...) over the mesh — each pipeline stage
+    holds (and Adam-updates) only its layers, and the lax.scan forward
+    streams one layer's weights at a time (the TPU-era equivalent of
+    pipeline-stage weight residency; compute overlap comes from XLA's
+    latency-hiding scheduler). Checkpoints stay Marian-flat via
+    unstack_layer_params."""
+    out = dict(tree)
+    for prefix, n in layer_param_groups(cfg):
+        first = f"{prefix}_l1_"
+        for s in [k[len(first):] for k in tree if k.startswith(first)]:
+            leaves = [out.pop(f"{prefix}_l{l}_{s}") for l in range(1, n + 1)]
+            out[f"{prefix}_stack_{s}"] = jnp.stack(
+                [jnp.asarray(v) for v in leaves])
+    return out
+
+
+def unstack_layer_params(cfg: TransformerConfig, tree: Params) -> Params:
+    """Inverse of stack_layer_params (checkpoint IO, validators, decode)."""
+    out = dict(tree)
+    for prefix, n in layer_param_groups(cfg):
+        pre = f"{prefix}_stack_"
+        for k in [k for k in out if k.startswith(pre)]:
+            stacked = out.pop(k)
+            for l in range(1, n + 1):
+                out[f"{prefix}_l{l}_{k[len(pre):]}"] = stacked[l - 1]
+    return out
+
+
 def _stacked_layer_params(cfg: TransformerConfig, params: Params,
                           base: str, n: int):
     """--scan-layers: stack each per-layer weight into one [n, ...] leaf so
@@ -878,6 +1038,11 @@ def _stacked_layer_params(cfg: TransformerConfig, params: Params,
     ~100ms steps). That per-step cost is deliberate: params stay stored
     flat under Marian's per-layer names, keeping checkpoint IO, TP
     sharding specs, freezing, and quantization untouched."""
+    pre = base[:-2] + "_stack_"          # base = '{prefix}_l'
+    pre_stacked = {k[len(pre):]: v for k, v in params.items()
+                   if k.startswith(pre)}
+    if pre_stacked:
+        return pre_stacked               # depth-stacked storage (pipe mode)
     if not cfg.scan_layers or n < 2 or cfg.tied_layers:
         return None
     first = f"{base}1_"
@@ -899,25 +1064,39 @@ def _stacked_layer_params(cfg: TransformerConfig, params: Params,
 
 def encode(cfg: TransformerConfig, params: Params, src_ids,
            src_mask, train: bool = False,
-           key: Optional[jax.Array] = None):
+           key: Optional[jax.Array] = None, with_aux: bool = False):
     """[B, Ts] ids + mask → [B, Ts, D] encoder states (reference:
     TransformerEncoder::apply). Multi-source: pass tuples of ids/masks —
-    one encoder stack per stream, returns a tuple of states."""
+    one encoder stack per stream, returns a tuple of states.
+    `with_aux` additionally returns the summed MoE load-balance loss."""
     if cfg.lm:
-        return None                      # decoder-only LM: no encoder
+        return (None, jnp.zeros((), jnp.float32)) if with_aux else None
     if isinstance(src_ids, (tuple, list)):
         masks = _as_tuple(src_mask)
-        return tuple(
+        res = tuple(
             _encode_one(cfg, params, ids_i, masks[i], train,
                         jax.random.fold_in(key, 1000 + i) if key is not None
                         else None, i)
             for i, ids_i in enumerate(src_ids))
-    return _encode_one(cfg, params, src_ids, src_mask, train, key, 0)
+        outs = tuple(r[0] for r in res)
+        return (outs, sum(r[1] for r in res)) if with_aux else outs
+    out, aux = _encode_one(cfg, params, src_ids, src_mask, train, key, 0)
+    return (out, aux) if with_aux else out
+
+
+def _ffn_or_moe(cfg: TransformerConfig, pp: Params, lp: str, pre, dim_ffn,
+                depth, key, train, mask=None):
+    """FFN sublayer body: dense _ffn or the routed MoE; returns (out, aux)
+    with aux = 0 for the dense path (type-stable for lax.scan)."""
+    if cfg.moe_experts > 0:
+        return _moe_ffn(cfg, pp, f"{lp}_moe", pre, train, key, mask)
+    return (_ffn(cfg, pp, f"{lp}_ffn", pre, dim_ffn, depth, key, train),
+            jnp.zeros((), jnp.float32))
 
 
 def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
                 src_mask: jax.Array, train: bool, key, enc_idx: int,
-                emb_offset: Optional[jax.Array] = None) -> jax.Array:
+                emb_offset: Optional[jax.Array] = None):
     ep = _enc_prefix(enc_idx)
     kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
     x = _embed(cfg, params, src_ids, "src", kk(0), train, enc_idx=enc_idx)
@@ -939,28 +1118,30 @@ def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
                       lk, train, kv_mask=src_mask)
         x = _pre_post(cfg, cfg.postprocess, out, x,
                       f"{lp}_self_Wo", pp, lk, train)
-        # ffn sublayer
+        # ffn sublayer (dense or MoE)
         lk2 = kk(lnum * 10 + 5)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
                         f"{lp}_ffn_ffn", pp, lk2, train)
-        out = _ffn(cfg, pp, f"{lp}_ffn", pre, cfg.dim_ffn,
-                   cfg.ffn_depth, lk2, train)
+        out, aux = _ffn_or_moe(cfg, pp, lp, pre, cfg.dim_ffn,
+                               cfg.ffn_depth, lk2, train, mask=src_mask)
         return _pre_post(cfg, cfg.postprocess, out, x,
-                         f"{lp}_ffn_ffn", pp, lk2, train)
+                         f"{lp}_ffn_ffn", pp, lk2, train), aux
 
+    aux_total = jnp.zeros((), jnp.float32)
     stacked = _stacked_layer_params(cfg, params, f"{ep}_l", cfg.enc_depth)
     if stacked is not None:
         def body(x, sl):
             lp_leaves, lnum = sl
             pv = {**params, **{f"{ep}_lS_{s}": v
                                for s, v in lp_leaves.items()}}
-            return enc_layer(x, pv, f"{ep}_lS", lnum), None
+            return enc_layer(x, pv, f"{ep}_lS", lnum)
         if cfg.gradient_checkpointing and train:
             # prevent_cse=False: safe and faster under lax.scan (the loop
             # already prevents the CSE remat guards against)
             body = jax.checkpoint(body, prevent_cse=False)
-        x, _ = jax.lax.scan(
+        x, auxs = jax.lax.scan(
             body, x, (stacked, jnp.arange(1, cfg.enc_depth + 1)))
+        aux_total = aux_total + auxs.sum()
     else:
         for l in range(1, cfg.enc_depth + 1):
             pl = _tied(cfg, l)           # parameter-owning layer
@@ -968,12 +1149,13 @@ def _encode_one(cfg: TransformerConfig, params: Params, src_ids: jax.Array,
             if cfg.gradient_checkpointing and train:
                 # --gradient-checkpointing: rematerialize the layer in the
                 # backward pass instead of keeping its activations in HBM
-                x = jax.checkpoint(f)(x)
+                x, aux_l = jax.checkpoint(f)(x)
             else:
-                x = f(x)
+                x, aux_l = f(x)
+            aux_total = aux_total + aux_l
     x = _pre_post(cfg, cfg.postprocess_top, x, None, f"{ep}_top", params,
                   kk(9999), train)
-    return x
+    return x, aux_total
 
 
 # ---------------------------------------------------------------------------
@@ -985,7 +1167,8 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
                  trg_mask: jax.Array, train: bool = True,
                  key: Optional[jax.Array] = None,
                  return_alignment: bool = False,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 with_aux: bool = False):
     """Teacher-forced decoder: [B, Tt] gold target ids → [B, Tt, V] logits
     (or the pre-logits hidden states when return_hidden — the fused-CE path
     computes the output projection inside its streaming kernel).
@@ -1037,12 +1220,13 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
         lk3 = kk(lnum * 10 + 7)
         pre = _pre_post(cfg, cfg.preprocess, x, None,
                         f"{lp}_ffn_ffn", pp, lk3, train)
-        out = _ffn(cfg, pp, f"{lp}_ffn", pre, cfg.dec_ffn,
-                   cfg.dec_ffn_d, lk3, train)
+        out, aux = _ffn_or_moe(cfg, pp, lp, pre, cfg.dec_ffn,
+                               cfg.dec_ffn_d, lk3, train, mask=trg_mask)
         x = _pre_post(cfg, cfg.postprocess, out, x,
                       f"{lp}_ffn_ffn", pp, lk3, train)
-        return x, align_l
+        return x, align_l, aux
 
+    aux_total = jnp.zeros((), jnp.float32)
     # alignment extraction needs one specific layer's attention weights —
     # scan can't surface a single iteration's side output cheaply, so the
     # guided-alignment path keeps the unrolled stack
@@ -1053,14 +1237,15 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
             lp_leaves, lnum = sl
             pv = {**params, **{f"decoder_lS_{s}": v
                                for s, v in lp_leaves.items()}}
-            x, _ = dec_layer(x, pv, "decoder_lS", lnum, False)
-            return x, None
+            x, _, aux = dec_layer(x, pv, "decoder_lS", lnum, False)
+            return x, aux
         if cfg.gradient_checkpointing and train:
             # prevent_cse=False: safe and faster under lax.scan (the loop
             # already prevents the CSE remat guards against)
             body = jax.checkpoint(body, prevent_cse=False)
-        x, _ = jax.lax.scan(
+        x, auxs = jax.lax.scan(
             body, x, (stacked, jnp.arange(1, cfg.dec_depth + 1)))
+        aux_total = aux_total + auxs.sum()
     else:
         for l in range(1, cfg.dec_depth + 1):
             want_align = return_alignment and _is_alignment_layer(cfg, l)
@@ -1068,17 +1253,21 @@ def decode_train(cfg: TransformerConfig, params: Params, enc_out: jax.Array,
             f = partial(dec_layer, pp=params, lp=f"decoder_l{pl}", lnum=l,
                         want_align=want_align)
             if cfg.gradient_checkpointing and train and not want_align:
-                x, _ = jax.checkpoint(f)(x)
+                x, _, aux_l = jax.checkpoint(f)(x)
             else:
-                x, align_l = f(x)
+                x, align_l, aux_l = f(x)
                 if align_l is not None:
                     align = align_l
+            aux_total = aux_total + aux_l
     x = _pre_post(cfg, cfg.postprocess_top, x, None, "decoder_top", params,
                   kk(9999), train)
     out = x if return_hidden else output_logits(cfg, params, x)
+    res = [out]
     if return_alignment:
-        return out, align
-    return out
+        res.append(align)
+    if with_aux:
+        res.append(aux_total)
+    return res[0] if len(res) == 1 else tuple(res)
 
 
 def _is_alignment_layer(cfg: TransformerConfig, l: int) -> bool:
@@ -1337,8 +1526,8 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
 
         pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
                         f"decoder_l{pl}_ffn_ffn", params, None, False)
-        out = _ffn(cfg, params, f"decoder_l{pl}_ffn", pre, cfg.dec_ffn,
-                   cfg.dec_ffn_d, None, False)
+        out, _ = _ffn_or_moe(cfg, params, f"decoder_l{pl}", pre,
+                             cfg.dec_ffn, cfg.dec_ffn_d, None, False)
         x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
                       f"decoder_l{pl}_ffn_ffn", params, None, False)
     x = _pre_post(cfg, _strip_dropout(cfg.postprocess_top), x, None,

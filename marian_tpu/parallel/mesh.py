@@ -21,7 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "model", "seq")
+AXES = ("data", "model", "seq", "pipe", "expert")
 
 
 def initialize_distributed(options) -> None:
@@ -63,7 +63,8 @@ def make_mesh(options=None, devices: Optional[List] = None) -> Mesh:
             n = int(options.get("num-devices", 0) or 0)
             if n:
                 devices = devices[:n]
-    sizes = {"data": len(devices), "model": 1, "seq": 1}
+    sizes = {"data": len(devices), "model": 1, "seq": 1, "pipe": 1,
+             "expert": 1}
     if options is not None and options.get("mesh", []):
         sizes.update(parse_mesh_spec(options.get("mesh")))
         unset = [a for a in AXES if a not in parse_mesh_spec(options.get("mesh"))]
@@ -72,11 +73,11 @@ def make_mesh(options=None, devices: Optional[List] = None) -> Mesh:
         rest = len(devices) // spec_prod
         for a in unset:
             sizes[a] = rest if a == "data" else 1
-    total = sizes["data"] * sizes["model"] * sizes["seq"]
+    total = int(np.prod([sizes[a] for a in AXES]))
     if total != len(devices):
         raise ValueError(
             f"Mesh {sizes} needs {total} devices, have {len(devices)}")
-    arr = np.array(devices).reshape(sizes["data"], sizes["model"], sizes["seq"])
+    arr = np.array(devices).reshape([sizes[a] for a in AXES])
     return Mesh(arr, AXES)
 
 

@@ -34,7 +34,24 @@ _FFN_B = re.compile(r"_(?:ffn|logit)_b(\d+)$")
 
 
 def tp_param_spec(name: str, shape: Tuple[int, ...], dim_emb: int) -> P:
-    """Megatron TP spec for one Marian-named parameter (shape [in, out])."""
+    """Megatron TP spec for one Marian-named parameter (shape [in, out]).
+
+    Depth-stacked leaves ('{prefix}_stack_{suffix}', models/transformer.py
+    stack_layer_params) shard their leading layer axis over 'pipe' —
+    pipeline-stage weight residency — composed with the suffix's TP spec
+    on the trailing axes."""
+    if "_stack_" in name:
+        inner = tp_param_spec("x_" + name.split("_stack_", 1)[1], shape[1:],
+                              dim_emb)
+        return P(*(("pipe",) + tuple(inner)))
+    if name.endswith("_moe_gate"):
+        return P()                                   # tiny router table
+    if name.endswith(("_moe_W1", "_moe_b1")):
+        return P("expert", None, "model")            # [E, d|1, ffn]
+    if name.endswith("_moe_W2"):
+        return P("expert", "model", None)            # [E, ffn, d]
+    if name.endswith("_moe_b2"):
+        return P("expert")                           # [E, 1, d]
     if name.endswith(("_Wq", "_Wk", "_Wv", "_bq", "_bk", "_bv")):
         return P(None, "model")                      # column/head split
     if name.endswith("_Wo"):
@@ -68,11 +85,23 @@ def tp_param_spec(name: str, shape: Tuple[int, ...], dim_emb: int) -> P:
 
 
 def _divisible(shape: Tuple[int, ...], spec: P, mesh: Mesh) -> bool:
-    n = mesh.shape.get("model", 1)
     for axis, part in enumerate(spec):
-        if part == "model" and (axis >= len(shape) or shape[axis] % n != 0):
+        if part is None:
+            continue
+        n = mesh.shape.get(part, 1)
+        if axis >= len(shape) or shape[axis] % n != 0:
             return False
     return True
+
+
+def _strip_unused_axes(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes of size 1 from a spec (e.g. 'model' on a pipe-only
+    mesh) so the fallback stays exact-replicated rather than fake-sharded."""
+    parts = [p if (p is None or mesh.shape.get(p, 1) > 1) else None
+             for p in spec]
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
 
 
 def tp_param_specs(params: Params, mesh: Mesh,
@@ -80,7 +109,8 @@ def tp_param_specs(params: Params, mesh: Mesh,
     """TP PartitionSpec per param. Falls back to replicated when the 'model'
     axis is 1, the param family is unknown (e.g. RNN s2s params), or the
     shape doesn't divide (safety: GSPMD requires divisibility)."""
-    if mesh.shape.get("model", 1) <= 1:
+    if mesh.shape.get("model", 1) <= 1 and mesh.shape.get("pipe", 1) <= 1 \
+            and mesh.shape.get("expert", 1) <= 1:
         return {k: P() for k in params}
     if not dim_emb:
         for k, v in params.items():
@@ -89,7 +119,8 @@ def tp_param_specs(params: Params, mesh: Mesh,
                 break
     out: Dict[str, P] = {}
     for k, v in params.items():
-        spec = tp_param_spec(k, tuple(v.shape), dim_emb)
+        spec = _strip_unused_axes(
+            tp_param_spec(k, tuple(v.shape), dim_emb), mesh)
         out[k] = spec if _divisible(tuple(v.shape), spec, mesh) else P()
     return out
 

@@ -152,6 +152,10 @@ def dryrun(n_devices: int, options, batch_maker, vocab: int = 256) -> None:
     mesh = M.make_mesh(options, devices)
     model = create_model(options, vocab, vocab)
     params = model.init(jax.random.key(0))
+    if mesh.shape.get("pipe", 1) > 1:
+        # depth-stacked storage so the layer axis shards over 'pipe'
+        from ..models import transformer as TT
+        params = TT.stack_layer_params(model.cfg, params)
     opt_cfg = OptimizerConfig.from_options(options)
     opt_state = init_state(opt_cfg, params)
     params, opt_state = place(

@@ -120,10 +120,43 @@ class GraphGroup:
         self._build()
 
     # -- init / load --------------------------------------------------------
+    def _maybe_stack(self) -> None:
+        """Depth-stacked storage when the mesh has a 'pipe' axis: layer
+        leaves become '{prefix}_stack_{suffix}' [L, ...] sharded
+        P('pipe', ...) — each pipeline stage holds and updates only its
+        layers (models/transformer.py stack_layer_params)."""
+        self._stacked = False
+        if self.mesh.shape.get("pipe", 1) <= 1:
+            return
+        from ..models import transformer as TT
+        cfg = getattr(self.model, "cfg", None)
+        if not isinstance(cfg, TT.TransformerConfig):
+            raise ValueError("pipeline ('pipe') sharding is only supported "
+                             "for the transformer family")
+        reason = TT.can_stack_layers(cfg)
+        if reason is None and self.options.get("guided-alignment", None):
+            reason = "guided alignment extracts one layer's attention " \
+                     "weights (unrolled stack)"
+        if reason is not None:
+            raise ValueError(f"pipeline sharding unavailable: {reason}")
+        self.params = TT.stack_layer_params(cfg, self.params)
+        if self.opt_state is not None:
+            for part, group in self.opt_state.items():
+                if isinstance(group, dict):
+                    self.opt_state[part] = TT.stack_layer_params(cfg, group)
+        self._stacked = True
+
+    def _unstack(self, tree: Params) -> Params:
+        if not getattr(self, "_stacked", False):
+            return tree
+        from ..models import transformer as TT
+        return TT.unstack_layer_params(self.model.cfg, tree)
+
     def initialize(self, key: jax.Array,
                    init_params: Optional[Params] = None) -> None:
         self.params = init_params if init_params is not None \
             else self.model.init(key)
+        self._maybe_stack()
         if self.opt_state is None:  # keep state restored from checkpoint
             self.opt_state = init_state(self.opt_cfg, self.params)
         else:
@@ -237,7 +270,13 @@ class GraphGroup:
 
     # -- EMA access for validation/saving -----------------------------------
     def smoothed(self) -> Params:
-        return smoothed_params(self.opt_cfg, self.opt_state, self.params)
+        return self._unstack(
+            smoothed_params(self.opt_cfg, self.opt_state, self.params))
+
+    def export_params(self) -> Params:
+        """Params in flat Marian naming for checkpoint IO / validators /
+        decoding (inverse of the depth-stacked training layout)."""
+        return self._unstack(self.params)
 
     # -- checkpoint glue -----------------------------------------------------
     def optimizer_arrays(self) -> Dict[str, Any]:
@@ -247,7 +286,7 @@ class GraphGroup:
         flat: Dict[str, Any] = {"t": np.asarray(self.opt_state["t"])}
         for part in ("m", "v", "gt", "avg", "qerr", "gerr"):
             if part in self.opt_state:
-                for k, v in self.opt_state[part].items():
+                for k, v in self._unstack(self.opt_state[part]).items():
                     flat[f"{part}:{k}"] = np.asarray(v)
         return flat
 
