@@ -114,9 +114,9 @@ class BertModel:
         # input embeddings (reference: bert.h addSentenceEmbeddings)
         offset = (cparams["Wtype"][0][None, None, :]
                   if self.train_type_emb else None)
-        x, _aux = T._encode_one(self.cfg, cparams, ids, mask, train, key, 0,
-                                emb_offset=offset)
-        return x, cparams
+        x, aux = T._encode_one(self.cfg, cparams, ids, mask, train, key, 0,
+                               emb_offset=offset)
+        return x, cparams, aux
 
     # -- losses --------------------------------------------------------------
     def loss(self, params: Params, batch: Dict[str, jax.Array],
@@ -131,9 +131,9 @@ class BertModel:
         mkey = key if key is not None else jax.random.key(0)
         masked_ids, weights = self._mask_inputs(ids, mask,
                                                 jax.random.fold_in(mkey, 7))
-        x, cparams = self._encode(params, masked_ids, mask, train,
-                                  jax.random.fold_in(mkey, 8) if key is not None
-                                  else None)
+        x, cparams, moe_aux = self._encode(
+            params, masked_ids, mask, train,
+            jax.random.fold_in(mkey, 8) if key is not None else None)
         # transform head: dense+gelu+ln, then tied-embedding logits
         h = affine(x, cparams["masked-lm_ff_logit_l1_W"],
                    cparams["masked-lm_ff_logit_l1_b"])
@@ -144,20 +144,28 @@ class BertModel:
         logp = jax.nn.log_softmax(logits, axis=-1)
         gold = jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
         ce_sum = -jnp.sum(gold * weights)
-        labels = jnp.sum(weights)
-        return ce_sum, {"ce_sum": ce_sum, "labels": jnp.maximum(labels, 1.0)}
+        labels = jnp.maximum(jnp.sum(weights), 1.0)
+        total = ce_sum
+        if getattr(self.cfg, "moe_experts", 0) > 0 \
+                and self.cfg.moe_aux_weight > 0:
+            total = total + self.cfg.moe_aux_weight * moe_aux * labels
+        return total, {"ce_sum": ce_sum, "labels": labels}
 
     def _classifier_loss(self, params, batch, key, train):
         ids, mask = batch["src_ids"], batch["src_mask"]
         labels = batch["trg_ids"][:, 0]          # label stream: one id + EOS
-        x, cparams = self._encode(params, ids, mask, train, key)
+        x, cparams, moe_aux = self._encode(params, ids, mask, train, key)
         logits = self.classify_logits(cparams, x)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         row_valid = (mask[:, 0] > 0).astype(jnp.float32)   # padding rows out
         gold = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
         ce_sum = -jnp.sum(gold * row_valid)
         n = jnp.maximum(jnp.sum(row_valid), 1.0)
-        return ce_sum, {"ce_sum": ce_sum, "labels": n}
+        total = ce_sum
+        if getattr(self.cfg, "moe_experts", 0) > 0 \
+                and self.cfg.moe_aux_weight > 0:
+            total = total + self.cfg.moe_aux_weight * moe_aux * n
+        return total, {"ce_sum": ce_sum, "labels": n}
 
     def classify_logits(self, cparams, enc_out) -> jax.Array:
         """[CLS]-position (t=0) classification head (reference: bert.h
@@ -170,5 +178,5 @@ class BertModel:
 
     # -- inference: predict classes / fill masks -----------------------------
     def predict_classes(self, params, ids, mask) -> jax.Array:
-        x, cparams = self._encode(params, ids, mask, False, None)
+        x, cparams, _ = self._encode(params, ids, mask, False, None)
         return jnp.argmax(self.classify_logits(cparams, x), axis=-1)

@@ -258,8 +258,12 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         moe_experts=int(g("transformer-moe-experts", 0) or 0),
         moe_top_k=_check_moe(int(g("transformer-moe-experts", 0) or 0),
                              int(g("transformer-moe-top-k", 2) or 2)),
-        moe_capacity_factor=float(g("moe-capacity-factor", 1.25) or 1.25),
-        moe_aux_weight=float(g("moe-aux-weight", 0.01) or 0.01),
+        moe_capacity_factor=float(
+            1.25 if g("moe-capacity-factor", None) is None
+            else g("moe-capacity-factor")),
+        moe_aux_weight=float(
+            0.01 if g("moe-aux-weight", None) is None
+            else g("moe-aux-weight")),
         flash_attention=str(g("transformer-flash-attention", "auto")),
         gradient_checkpointing=(not for_inference
                                 and bool(g("gradient-checkpointing", False))),
@@ -805,20 +809,51 @@ def _moe_ffn(cfg: TransformerConfig, params: Params, prefix: str,
     e, k = cfg.moe_experts, cfg.moe_top_k
     b, t, d = x.shape
     s = b * t
-    if train:
-        cap = min(max(1, int(math.ceil(
-            k * s * cfg.moe_capacity_factor / e))), s)
-    else:
-        # inference: full capacity (no token dropping) so routing is purely
-        # per-token — teacher-forced scoring and incremental beam decode
-        # then agree exactly (capacity pooling across timesteps cannot be
-        # reproduced step-by-step)
-        cap = s
     xf = x.reshape(s, d)
     mf = (jnp.ones((s, 1), jnp.float32) if mask is None
           else mask.reshape(s, 1).astype(jnp.float32))
+    if train:
+        cap = min(max(1, int(math.ceil(
+            k * s * cfg.moe_capacity_factor / e))), s)
+        out, r0, ge, n = _moe_route(cfg, params, prefix, xf, mf, cap, key,
+                                    True)
+    else:
+        # inference: NO token dropping, so routing is purely per-token —
+        # teacher-forced scoring and incremental beam decode then agree
+        # exactly (capacity pooling across timesteps cannot be reproduced
+        # step-by-step). Chunk the token axis so the [CH, E, CH] dispatch
+        # tensors stay bounded instead of O(S²·E) for long scoring batches;
+        # with per-chunk capacity == chunk size nothing ever overflows, so
+        # chunking cannot change any token's output.
+        ch = min(s, 256)
+        pad = (-s) % ch
+        xp = jnp.pad(xf, ((0, pad), (0, 0)))
+        mp = jnp.pad(mf, ((0, pad), (0, 0)))
+        xch = xp.reshape(-1, ch, d)
+        mch = mp.reshape(-1, ch, 1)
+
+        def body(_, xm):
+            xc, mc = xm
+            return None, _moe_route(cfg, params, prefix, xc, mc, ch, None,
+                                    False)
+        _, (outs, r0s, ges, ns) = jax.lax.scan(body, None, (xch, mch))
+        out = outs.reshape(-1, d)[:s]
+        r0, ge, n = r0s.sum(0), ges.sum(0), ns.sum()
+    n = jnp.maximum(n, 1.0)
+    # load balance over REAL tokens: fraction routed to e × mean gate
+    aux = e * jnp.sum((r0 / n) * (ge / n))
+    return out.reshape(b, t, d), aux
+
+
+def _moe_route(cfg: TransformerConfig, params: Params, prefix: str,
+               xf: jax.Array, mf: jax.Array, cap: int, key, train: bool):
+    """Dispatch/combine core on flat tokens [S, D] with expert capacity
+    `cap`; returns (out [S, D], top1-routing counts [E], masked gate sums
+    [E], real-token count) — the stats feed the load-balance aux loss."""
+    e, k = cfg.moe_experts, cfg.moe_top_k
+    s = xf.shape[0]
     gates = jax.nn.softmax(jnp.dot(
-        xf, params[f"{prefix}_gate"].astype(x.dtype),
+        xf, params[f"{prefix}_gate"].astype(xf.dtype),
         preferred_element_type=jnp.float32).astype(jnp.float32))   # [S,E]
     vals, idx = jax.lax.top_k(gates, k)                            # [S,k]
     vals = vals / jnp.maximum(vals.sum(-1, keepdims=True), 1e-9)
@@ -836,8 +871,8 @@ def _moe_ffn(cfg: TransformerConfig, params: Params, prefix: str,
                       jax.nn.one_hot(pos_k.astype(jnp.int32), cap,
                                      dtype=jnp.float32))
     gate_se = jnp.einsum("ske,sk->se", oh, vals)                   # [S,E]
-    comb = (disp * gate_se[:, :, None]).astype(x.dtype)            # [S,E,C]
-    ein = jnp.einsum("sec,sd->ecd", disp.astype(x.dtype), xf)      # [E,C,D]
+    comb = (disp * gate_se[:, :, None]).astype(xf.dtype)           # [S,E,C]
+    ein = jnp.einsum("sec,sd->ecd", disp.astype(xf.dtype), xf)     # [E,C,D]
     act = activation(cfg.ffn_activation)
     h = act(jnp.einsum("ecd,edf->ecf", ein, params[f"{prefix}_W1"])
             + params[f"{prefix}_b1"])
@@ -845,12 +880,8 @@ def _moe_ffn(cfg: TransformerConfig, params: Params, prefix: str,
         h = dropout(h, cfg.ffn_dropout, jax.random.fold_in(key, 91))
     y = jnp.einsum("ecf,efd->ecd", h, params[f"{prefix}_W2"]) \
         + params[f"{prefix}_b2"]
-    out = jnp.einsum("sec,ecd->sd", comb, y).reshape(b, t, d)
-    # load balance over REAL tokens: fraction routed to e × mean gate
-    n_real = jnp.maximum(mf.sum(), 1.0)
-    aux = e * jnp.sum((oh[:, 0, :].sum(axis=0) / n_real)
-                      * ((gates * mf).sum(axis=0) / n_real))
-    return out, aux
+    out = jnp.einsum("sec,ecd->sd", comb, y)
+    return out, oh[:, 0, :].sum(axis=0), (gates * mf).sum(axis=0), mf.sum()
 
 
 def sinusoidal_positions(length: int, dim: int, start: int = 0) -> jax.Array:

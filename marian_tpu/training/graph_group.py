@@ -139,6 +139,14 @@ class GraphGroup:
                      "weights (unrolled stack)"
         if reason is not None:
             raise ValueError(f"pipeline sharding unavailable: {reason}")
+        pipe = self.mesh.shape["pipe"]
+        for prefix, depth in TT.layer_param_groups(cfg):
+            if depth % pipe != 0:
+                # GSPMD requires divisibility; the silent alternative would
+                # replicate the whole stack (4x memory, no residency win)
+                raise ValueError(
+                    f"pipeline sharding: {prefix} depth {depth} is not "
+                    f"divisible by the 'pipe' axis size {pipe}")
         self.params = TT.stack_layer_params(cfg, self.params)
         if self.opt_state is not None:
             for part, group in self.opt_state.items():

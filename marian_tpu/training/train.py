@@ -161,12 +161,13 @@ class Train:
             state.corpus = (native_bg.state_dict() if native_bg is not None
                             else corpus.state.as_dict())
             smooth = gg.smoothed() if gg.opt_cfg.smoothing > 0 else None
-            save_checkpoint(model_path, gg.export_params(), config_yaml,
+            exported = gg.export_params()
+            save_checkpoint(model_path, exported, config_yaml,
                             gg, state, smooth_params=smooth, suffix=suffix)
             if not suffix and not opts.get("overwrite", False):
                 # without --overwrite, keep an iteration-numbered copy of
                 # every periodic checkpoint (reference: Train::save)
-                save_checkpoint(model_path, gg.export_params(), config_yaml,
+                save_checkpoint(model_path, exported, config_yaml,
                                 None, None, smooth_params=None,
                                 suffix=f".iter{state.batches}")
 

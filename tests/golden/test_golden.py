@@ -95,6 +95,12 @@ CONFIGS = {
         "transformer-dim-ffn": 64, "dec-depth": 2,
         "tied-embeddings-all": True,
     },
+    "moe-transformer": {
+        "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
+        "tied-embeddings-all": True,
+        "transformer-moe-experts": 4, "transformer-moe-top-k": 2,
+    },
 }
 
 
@@ -174,7 +180,7 @@ def _decode(gg, opts, vocabs, model, name):
         for i, e in enumerate(enc):
             ids[i, :len(e)] = e
             mask[i, :len(e)] = 1.0
-        cp = Tm.cast_params(gg.params, model.cfg.compute_dtype)
+        cp = Tm.cast_params(gg.export_params(), model.cfg.compute_dtype)
         logits = Tm.decode_train(model.cfg, cp, None, None,
                                  jnp.asarray(ids), jnp.asarray(mask),
                                  train=False)
@@ -193,7 +199,7 @@ def _decode(gg, opts, vocabs, model, name):
         mask[i, :len(e)] = 1.0
     bopts = Options({"beam-size": 6, "normalize": 0.6, "max-length": 32,
                      "seed": SEED})
-    bs = BeamSearch(model, [gg.params], None, bopts, vocabs[-1])
+    bs = BeamSearch(model, [gg.export_params()], None, bopts, vocabs[-1])
     n_src = len(vocabs) - 1 if len(vocabs) > 2 else 1
     if n_src > 1:
         args = (tuple([jnp.asarray(ids)] * n_src),

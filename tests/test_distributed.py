@@ -113,12 +113,14 @@ class TestZero1DataParallel:
 class TestMeshSpec:
     def test_default_mesh_all_data(self):
         m = M.make_mesh(None, jax.devices()[:8])
-        assert m.shape == {"data": 8, "model": 1, "seq": 1}
+        assert m.shape == {"data": 8, "model": 1, "seq": 1,
+                           "pipe": 1, "expert": 1}
 
     def test_mesh_option_spec(self):
         o = Options({"mesh": ["data:4", "model:2"]})
         m = M.make_mesh(o, jax.devices()[:8])
-        assert m.shape == {"data": 4, "model": 2, "seq": 1}
+        assert m.shape == {"data": 4, "model": 2, "seq": 1,
+                           "pipe": 1, "expert": 1}
 
     def test_mesh_mismatch_raises(self):
         o = Options({"mesh": ["data:3"]})
