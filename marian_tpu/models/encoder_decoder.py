@@ -230,8 +230,15 @@ class EncoderDecoder:
         return self._mod.encode(self.cfg, cparams, src_ids, src_mask,
                                 train=False, key=None)
 
-    def start_state(self, params: Params, enc_out, src_mask, max_len: int):
+    def start_state(self, params: Params, enc_out, src_mask, max_len: int,
+                    want_alignment: bool = False):
         cparams = T.cast_params(params, self.cfg.compute_dtype)
+        if self._mod is T:
+            # alignment extraction keeps the unrolled (per-layer-keyed)
+            # decode state; otherwise the scanned stacked caches apply
+            return T.init_decode_state(self.cfg, cparams, enc_out,
+                                       src_mask, max_len,
+                                       want_alignment=want_alignment)
         return self._mod.init_decode_state(self.cfg, cparams, enc_out,
                                            src_mask, max_len)
 

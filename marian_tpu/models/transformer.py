@@ -1424,9 +1424,19 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
 # Incremental decoding (beam/greedy): startState / step
 # ---------------------------------------------------------------------------
 
+def _decode_scan_stack(cfg: TransformerConfig, params: Params):
+    """Stacked decoder-layer params when the scanned decode step applies
+    (self-attention autoreg only — AAN/SSRU keep tiny per-layer states and
+    the unrolled path); None otherwise."""
+    if cfg.decoder_autoreg != "self-attention":
+        return None
+    return _stacked_layer_params(cfg, params, "decoder_l", cfg.dec_depth)
+
+
 def init_decode_state(cfg: TransformerConfig, params: Params,
                       enc_out, src_mask,
-                      max_len: int) -> Dict[str, Any]:
+                      max_len: int,
+                      want_alignment: bool = False) -> Dict[str, Any]:
     """Precompute cross-attention K/V; allocate fixed-size self-attn caches
     (reference: EncoderDecoder::startState + per-layer cache init).
     Multi-source: per-encoder cross K/V under suffixed keys."""
@@ -1435,6 +1445,37 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
     b = src_mask.shape[0] if cfg.lm else enc_outs[0].shape[0]
     h, dh = cfg.heads, cfg.dim_head
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
+
+    stacked = None if want_alignment else _decode_scan_stack(cfg, params)
+    if stacked is not None:
+        # scanned decode: ONE [L, ...] cache per kind; the step function
+        # runs the layer stack as a lax.scan (same O(1)-in-depth compile
+        # win as the training path). 'stack_*' keys gather on axis 1 when
+        # the beam reorders (translator/beam_search.py).
+        for i, kv in enumerate(enc_outs):
+            sfx = _ctx_suffix(i)
+            wk = stacked[f"context{sfx}_Wk"]            # [L, d, d]
+            wv = stacked[f"context{sfx}_Wv"]
+            bk2 = stacked[f"context{sfx}_bk"][:, None]  # [L, 1, 1, d]
+            bv2 = stacked[f"context{sfx}_bv"][:, None]
+            k_all = jnp.einsum("bsd,lde->lbse", kv, wk) + bk2
+            v_all = jnp.einsum("bsd,lde->lbse", kv, wv) + bv2
+            ts = kv.shape[1]
+            state[f"stack_cross_kc{sfx}"] = k_all.reshape(
+                -1, b, ts, h, dh).transpose(0, 1, 3, 2, 4)
+            state[f"stack_cross_vc{sfx}"] = v_all.reshape(
+                -1, b, ts, h, dh).transpose(0, 1, 3, 2, 4)
+        state["stack_self_k"] = jnp.zeros(
+            (cfg.dec_depth, b, h, max_len, dh), cfg.compute_dtype)
+        state["stack_self_v"] = jnp.zeros(
+            (cfg.dec_depth, b, h, max_len, dh), cfg.compute_dtype)
+        # stacked decoder weights computed ONCE here (beam-invariant;
+        # no param suffix collides with the beam-carried cache suffixes)
+        for sname, v in stacked.items():
+            state[f"stack_p_{sname}"] = v
+        _maybe_lsh_state(cfg, params, state)
+        return state
+
     proj_cache: Dict[Any, Any] = {}    # tied layers share cross projections
     for l in range(1, cfg.dec_depth + 1):
         pl = _tied(cfg, l)
@@ -1462,22 +1503,28 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
                                               cfg.compute_dtype)
             state[f"l{l}_self_v"] = jnp.zeros((b, h, max_len, dh),
                                               cfg.compute_dtype)
-    if cfg.output_approx_knn:
-        # --output-approx-knn: LSH index over the output table (ops/lsh.py).
-        # Pure function of params, built once per compiled search; the
-        # entries are beam-invariant so the beam reorder leaves them alone.
-        table = _plain_output_table(cfg, params)
-        if table is None:
-            raise ValueError("--output-approx-knn requires a plain-tensor "
-                             "output projection (no factored vocab, no "
-                             "int8-quantized table)")
-        from ..ops.lsh import build_index
-        nbits = cfg.output_approx_knn[1] if len(cfg.output_approx_knn) > 1 \
-            else 1024
-        planes, sigs = build_index(table, nbits)
-        state["lsh_planes"] = planes
-        state["lsh_signatures"] = sigs
+    _maybe_lsh_state(cfg, params, state)
     return state
+
+
+def _maybe_lsh_state(cfg: TransformerConfig, params: Params,
+                     state: Dict[str, Any]) -> None:
+    if not cfg.output_approx_knn:
+        return
+    # --output-approx-knn: LSH index over the output table (ops/lsh.py).
+    # Pure function of params, built once per compiled search; the
+    # entries are beam-invariant so the beam reorder leaves them alone.
+    table = _plain_output_table(cfg, params)
+    if table is None:
+        raise ValueError("--output-approx-knn requires a plain-tensor "
+                         "output projection (no factored vocab, no "
+                         "int8-quantized table)")
+    from ..ops.lsh import build_index
+    nbits = cfg.output_approx_knn[1] if len(cfg.output_approx_knn) > 1 \
+        else 1024
+    planes, sigs = build_index(table, nbits)
+    state["lsh_planes"] = planes
+    state["lsh_signatures"] = sigs
 
 
 def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
@@ -1490,8 +1537,12 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
     mask allows positions <= pos (cache beyond pos is zeros but masked out).
     """
     pos = state["pos"]
-    max_len = (state["l1_self_k"].shape[2]
-               if cfg.decoder_autoreg == "self-attention" else 0)
+    scanned = "stack_self_k" in state
+    if cfg.decoder_autoreg == "self-attention":
+        max_len = (state["stack_self_k"].shape[3] if scanned
+                   else state["l1_self_k"].shape[2])
+    else:
+        max_len = 0
     we = _embed_words(cfg, params, prev_ids, "trg")
     # step 0 uses the zero embedding (Marian's no-BOS decoder start)
     we = jnp.where(pos == 0, jnp.zeros_like(we), we)
@@ -1503,81 +1554,148 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
         steps = jnp.arange(max_len)
         self_mask = (steps <= pos).astype(
             cfg.compute_dtype)[None, None, None, :]
+    else:
+        self_mask = None                 # AAN/SSRU need no attention mask
     cross_masks = [m[:, None, None, :] for m in _as_tuple(src_mask)]
     align = None
     new_state = dict(state)
+
+    if scanned:
+        if return_alignment:
+            raise ValueError("alignment output needs the unrolled decode "
+                             "state — pass want_alignment to start_state")
+        n_enc = 0 if cfg.lm else cfg.n_encoders
+        # stacked decoder weights precomputed ONCE in init_decode_state
+        # ('stack_p_*', beam-invariant) — restacking here would copy every
+        # decoder weight per generated token
+        stacked = {k[len("stack_p_"):]: v for k, v in state.items()
+                   if k.startswith("stack_p_")}
+        caches = {"self_k": state["stack_self_k"],
+                  "self_v": state["stack_self_v"]}
+        for i in range(n_enc):
+            sfx = _ctx_suffix(i)
+            caches[f"cross_k{sfx}"] = state[f"stack_cross_kc{sfx}"]
+            caches[f"cross_v{sfx}"] = state[f"stack_cross_vc{sfx}"]
+
+        def body(x, xs):
+            leaves, cc = xs
+            pv = {**params, **{f"decoder_lS_{s}": v
+                               for s, v in leaves.items()}}
+            x, new_c, _ = _decode_layer(cfg, pv, "decoder_lS", x, pos,
+                                        self_mask, cross_masks, cc, n_enc)
+            return x, (new_c["self_k"], new_c["self_v"])
+
+        x, (new_sk, new_sv) = jax.lax.scan(body, x, (stacked, caches))
+        new_state["stack_self_k"] = new_sk
+        new_state["stack_self_v"] = new_sv
+        x = _pre_post(cfg, _strip_dropout(cfg.postprocess_top), x, None,
+                      "decoder_top", params, None, False)
+        logits = _final_logits(cfg, params, state, x, shortlist)
+        new_state["pos"] = pos + 1
+        return logits, new_state
+
+    n_enc = 0 if cfg.lm else cfg.n_encoders
     for l in range(1, cfg.dec_depth + 1):
         pl = _tied(cfg, l)               # parameter-owning layer
-        pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
-                        f"decoder_l{pl}_self_Wo", params, None, False)
-        if cfg.decoder_autoreg == "average-attention":
-            # running-sum cumulative average: y = (sum + x_t) / (pos+1)
-            s = state[f"l{l}_aan_sum"] + pre.astype(jnp.float32)
-            y = (s / (pos + 1).astype(jnp.float32)).astype(pre.dtype)
-            out = _aan_apply(cfg, params, f"decoder_l{pl}", pre, y)
-            new_state[f"l{l}_aan_sum"] = s
-        elif cfg.decoder_autoreg == "rnn":
-            from ..ops.rnn import SSRU
-            d = cfg.dim_emb
-            cell = SSRU(d, d, False)
-            xp = cell.x_proj(params, f"decoder_l{pl}_rnn", pre)
-            f, inp = xp[..., :d], xp[..., d:]
-            c2 = f * state[f"l{l}_rnn_c"].astype(f.dtype) + inp
-            out = jax.nn.relu(c2).astype(pre.dtype)
-            if cfg.rnn_projection:
-                out = affine(out, params[f"decoder_l{pl}_rnn_Wo"],
-                             params[f"decoder_l{pl}_rnn_bo"])
-            new_state[f"l{l}_rnn_c"] = c2.astype(
-                state[f"l{l}_rnn_c"].dtype)
-        else:
-            cache = {"k": state[f"l{l}_self_k"], "v": state[f"l{l}_self_v"]}
-            out, _ = _mha(cfg, params, f"decoder_l{pl}_self", pre, pre,
-                          self_mask, None, False, cache=cache, cache_pos=pos)
-            new_state[f"l{l}_self_k"] = cache["k"]
-            new_state[f"l{l}_self_v"] = cache["v"]
-        x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
-                      f"decoder_l{pl}_self_Wo", params, None, False)
-
-        for i in range(0 if cfg.lm else cfg.n_encoders):
+        kinds = (("aan_sum",) if cfg.decoder_autoreg == "average-attention"
+                 else ("rnn_c",) if cfg.decoder_autoreg == "rnn"
+                 else ("self_k", "self_v"))
+        caches_l = {kind: state[f"l{l}_{kind}"] for kind in kinds}
+        for i in range(n_enc):
             sfx = _ctx_suffix(i)
-            cname = f"decoder_l{pl}_context{sfx}"
-            want_w = (return_alignment and i == 0
-                      and _is_alignment_layer(cfg, l))
-            pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
-                            f"{cname}_Wo", params, None, False)
-            cross_cache = {"k": state[f"l{l}_cross_k{sfx}"],
-                           "v": state[f"l{l}_cross_v{sfx}"]}
-            out, w = _mha(cfg, params, cname, pre, None,
-                          cross_masks[i], None, False, cache=cross_cache,
-                          static_kv=True, return_weights=want_w)
-            if want_w and w is not None:
-                align = w.mean(axis=1)[:, 0, :]  # [B, Ts]
-            x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
-                          f"{cname}_Wo", params, None, False)
-
-        pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
-                        f"decoder_l{pl}_ffn_ffn", params, None, False)
-        out, _ = _ffn_or_moe(cfg, params, f"decoder_l{pl}", pre,
-                             cfg.dec_ffn, cfg.dec_ffn_d, None, False)
-        x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
-                      f"decoder_l{pl}_ffn_ffn", params, None, False)
+            caches_l[f"cross_k{sfx}"] = state[f"l{l}_cross_k{sfx}"]
+            caches_l[f"cross_v{sfx}"] = state[f"l{l}_cross_v{sfx}"]
+        want_w = return_alignment and _is_alignment_layer(cfg, l)
+        x, new_c, align_l = _decode_layer(
+            cfg, params, f"decoder_l{pl}", x, pos, self_mask, cross_masks,
+            caches_l, n_enc, want_w=want_w)
+        for kind in kinds:
+            new_state[f"l{l}_{kind}"] = new_c[kind]
+        if align_l is not None:
+            align = align_l
     x = _pre_post(cfg, _strip_dropout(cfg.postprocess_top), x, None,
                   "decoder_top", params, None, False)
-    if cfg.output_approx_knn and shortlist is None \
-            and "lsh_planes" in state:
-        from ..ops.lsh import lsh_logits
-        table = _plain_output_table(cfg, params)
-        logits = lsh_logits(
-            x[:, 0, :], table,
-            params["decoder_ff_logit_out_b"].reshape(-1),
-            state["lsh_planes"], state["lsh_signatures"],
-            k=int(cfg.output_approx_knn[0]))
-    else:
-        logits = output_logits(cfg, params, x[:, 0, :], shortlist)
+    logits = _final_logits(cfg, params, state, x, shortlist)
     new_state["pos"] = pos + 1
     if return_alignment:
         return logits, new_state, align
     return logits, new_state
+
+
+def _decode_layer(cfg: TransformerConfig, pv: Params, lp: str, x: jax.Array,
+                  pos, self_mask, cross_masks, caches: Dict[str, jax.Array],
+                  n_enc: int, want_w: bool = False):
+    """One decode-step layer, shared verbatim between the scanned and the
+    unrolled stacks (the training path shares dec_layer the same way).
+    `caches` holds THIS layer's state leaves keyed by kind ('self_k',
+    'aan_sum', 'rnn_c', 'cross_k{sfx}', ...); returns (x, updated caches,
+    head-averaged cross-attention row when want_w)."""
+    new_c: Dict[str, jax.Array] = {}
+    align = None
+    pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
+                    f"{lp}_self_Wo", pv, None, False)
+    if cfg.decoder_autoreg == "average-attention":
+        # running-sum cumulative average: y = (sum + x_t) / (pos+1)
+        s = caches["aan_sum"] + pre.astype(jnp.float32)
+        y = (s / (pos + 1).astype(jnp.float32)).astype(pre.dtype)
+        out = _aan_apply(cfg, pv, lp, pre, y)
+        new_c["aan_sum"] = s
+    elif cfg.decoder_autoreg == "rnn":
+        from ..ops.rnn import SSRU
+        d = cfg.dim_emb
+        cell = SSRU(d, d, False)
+        xp = cell.x_proj(pv, f"{lp}_rnn", pre)
+        f, inp = xp[..., :d], xp[..., d:]
+        c2 = f * caches["rnn_c"].astype(f.dtype) + inp
+        out = jax.nn.relu(c2).astype(pre.dtype)
+        if cfg.rnn_projection:
+            out = affine(out, pv[f"{lp}_rnn_Wo"], pv[f"{lp}_rnn_bo"])
+        new_c["rnn_c"] = c2.astype(caches["rnn_c"].dtype)
+    else:
+        cache = {"k": caches["self_k"], "v": caches["self_v"]}
+        out, _ = _mha(cfg, pv, f"{lp}_self", pre, pre, self_mask,
+                      None, False, cache=cache, cache_pos=pos)
+        new_c["self_k"] = cache["k"]
+        new_c["self_v"] = cache["v"]
+    x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
+                  f"{lp}_self_Wo", pv, None, False)
+
+    for i in range(n_enc):
+        sfx = _ctx_suffix(i)
+        cname = f"{lp}_context{sfx}"
+        pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
+                        f"{cname}_Wo", pv, None, False)
+        out, w = _mha(cfg, pv, cname, pre, None, cross_masks[i],
+                      None, False,
+                      cache={"k": caches[f"cross_k{sfx}"],
+                             "v": caches[f"cross_v{sfx}"]},
+                      static_kv=True, return_weights=want_w and i == 0)
+        if want_w and i == 0 and w is not None:
+            align = w.mean(axis=1)[:, 0, :]  # [B, Ts]
+        x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
+                      f"{cname}_Wo", pv, None, False)
+
+    pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
+                    f"{lp}_ffn_ffn", pv, None, False)
+    out, _ = _ffn_or_moe(cfg, pv, lp, pre, cfg.dec_ffn,
+                         cfg.dec_ffn_d, None, False)
+    x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,
+                  f"{lp}_ffn_ffn", pv, None, False)
+    return x, new_c, align
+
+
+def _final_logits(cfg: TransformerConfig, params: Params, state, x,
+                  shortlist):
+    if cfg.output_approx_knn and shortlist is None \
+            and "lsh_planes" in state:
+        from ..ops.lsh import lsh_logits
+        table = _plain_output_table(cfg, params)
+        return lsh_logits(
+            x[:, 0, :], table,
+            params["decoder_ff_logit_out_b"].reshape(-1),
+            state["lsh_planes"], state["lsh_signatures"],
+            k=int(cfg.output_approx_knn[0]))
+    return output_logits(cfg, params, x[:, 0, :], shortlist)
 
 
 def _strip_dropout(ops: str) -> str:

@@ -131,7 +131,8 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
     for params in params_list:
         enc = model.encode_for_decode(params, src_ids, src_mask)
         enc_bk = _expand_to_beams(enc, k)
-        states.append(model.start_state(params, enc_bk, src_mask_bk, L))
+        states.append(model.start_state(params, enc_bk, src_mask_bk, L,
+                                        want_alignment=cfg.return_alignment))
 
     vocab = (shortlist.shape[0] if shortlist is not None
              else model.cfg.trg_vocab)
@@ -255,7 +256,10 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                 if key == "pos":
                     out[key] = v
                 elif key.endswith(carried):
-                    out[key] = v[flat_src]
+                    # 'stack_*' = scanned decode caches [L, B*K, ...]:
+                    # the batch axis is axis 1
+                    out[key] = (v[:, flat_src] if key.startswith("stack_")
+                                else v[flat_src])
                 else:  # cross K/V / encoder context are beam-invariant
                     out[key] = v
             return out

@@ -234,8 +234,17 @@ def test_golden(name):
                                        f"(regenerate with GOLDEN_REGEN=1 if "
                                        f"the change is intended)")
     expected_decodes = decode_file.read_text().splitlines()
-    assert decodes == expected_decodes, (
-        f"{name}: beam-6 decodes drifted (GOLDEN_REGEN=1 if intended)")
+    if CONFIGS[name]["type"] in ("transformer-lm", "lm-transformer", "lm"):
+        # LM "decodes" are teacher-forced scores: numeric compare (exact
+        # string equality at 1e-6 print granularity would flag fusion-level
+        # float drift that the loss tolerance deliberately allows)
+        np.testing.assert_allclose(
+            np.asarray([float(d) for d in decodes]),
+            np.asarray([float(d) for d in expected_decodes]), rtol=1e-4,
+            err_msg=f"{name}: scores drifted (GOLDEN_REGEN=1 if intended)")
+    else:
+        assert decodes == expected_decodes, (
+            f"{name}: beam-6 decodes drifted (GOLDEN_REGEN=1 if intended)")
 
     # sanity: the model actually learned something in 20 updates
     assert losses[-1] < losses[0]
