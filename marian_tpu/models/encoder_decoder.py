@@ -88,13 +88,12 @@ class EncoderDecoder:
         elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s",
                                  "char-s2s"):
             from . import s2s as S
-            if isinstance(src_vocab_size, tuple):
-                raise NotImplementedError(
-                    "multi-source is supported for transformer models; "
-                    "use --type multi-transformer")
             self.cfg = S.config_from_options(options, src_vocab_size,
                                              trg_vocab_size, inference)
-            if src_factors or trg_factors:
+            has_src_factors = (any(src_factors)
+                               if isinstance(src_factors, (tuple, list))
+                               else bool(src_factors))
+            if has_src_factors or trg_factors:
                 raise NotImplementedError(
                     "factored vocabs are supported for transformer models")
             self._mod = S
@@ -233,14 +232,11 @@ class EncoderDecoder:
     def start_state(self, params: Params, enc_out, src_mask, max_len: int,
                     want_alignment: bool = False):
         cparams = T.cast_params(params, self.cfg.compute_dtype)
-        if self._mod is T:
-            # alignment extraction keeps the unrolled (per-layer-keyed)
-            # decode state; otherwise the scanned stacked caches apply
-            return T.init_decode_state(self.cfg, cparams, enc_out,
-                                       src_mask, max_len,
-                                       want_alignment=want_alignment)
+        # transformer: alignment extraction keeps the unrolled decode
+        # state; otherwise the scanned stacked caches apply
         return self._mod.init_decode_state(self.cfg, cparams, enc_out,
-                                           src_mask, max_len)
+                                           src_mask, max_len,
+                                           want_alignment=want_alignment)
 
     def step(self, params: Params, state, prev_ids, src_mask,
              shortlist=None, return_alignment: bool = False):

@@ -75,6 +75,13 @@ class S2SConfig:
     # char-s2s (reference: src/models/char_s2s.h :: CharS2SEncoder, the
     # fully character-level conv+pool+highway front-end of Lee et al. 2017;
     # the reference's cuDNN conv/pool wrappers → lax.conv/reduce_window):
+    # multi-s2s (reference: src/models/model_factory.cpp assembling N
+    # RNN encoders for --type multi-s2s; doc-level context): encoder i
+    # gets param prefix 'encoder'/'encoder2'/..., its own Bahdanau
+    # attention block 'decoder_att'/'decoder_att2'/...; the decoder
+    # consumes the CONCATENATED per-encoder contexts.
+    n_encoders: int = 1
+    src_vocabs: Tuple[int, ...] = ()
     char_conv: bool = False
     char_stride: int = 5                 # --char-stride (pool width=stride)
     char_highway: int = 4                # --char-highway layers
@@ -88,13 +95,25 @@ class S2SConfig:
         return 2 * self.dim_rnn
 
     @property
+    def dim_ctx_total(self) -> int:      # concat over encoders (multi-s2s)
+        return self.dim_ctx * max(self.n_encoders, 1)
+
+    @property
     def conv_dim(self) -> int:
         return sum(self.conv_filters)
 
 
-def config_from_options(options, src_vocab: int, trg_vocab: int,
+def config_from_options(options, src_vocab, trg_vocab: int,
                         for_inference: bool = False) -> S2SConfig:
     g = options.get
+    if isinstance(src_vocab, (tuple, list)):
+        src_vocabs = tuple(int(v) for v in src_vocab)
+    else:
+        src_vocabs = (int(src_vocab),)
+    if len(src_vocabs) > 1 and str(g("type", "s2s")) != "multi-s2s":
+        raise ValueError(
+            f"--type {g('type', 's2s')} is a single-encoder model; "
+            f"multiple source streams need --type multi-s2s")
     # factored-embedding knobs are transformer-family only: refuse rather
     # than silently train plain embeddings (audit principle — same flag,
     # same behavior, or a loud error)
@@ -111,7 +130,9 @@ def config_from_options(options, src_vocab: int, trg_vocab: int,
              "bfloat16": jnp.bfloat16}.get(str(compute), jnp.float32)
     inf = for_inference
     return S2SConfig(
-        src_vocab=src_vocab,
+        src_vocab=src_vocabs[0],
+        n_encoders=len(src_vocabs),
+        src_vocabs=src_vocabs,
         trg_vocab=trg_vocab,
         dim_emb=int(g("dim-emb", 512)),
         dim_rnn=int(g("dim-rnn", 1024)),
@@ -151,21 +172,33 @@ def _chain(kind: str, first_prefix: str, dim_in: int, dim: int, ln: bool,
     return chain
 
 
-def _enc_chains(cfg: S2SConfig) -> List[Tuple[List[Tuple[str, R.Cell]], bool]]:
+def _s2s_enc_prefix(i: int) -> str:
+    """Param prefix of encoder i (multi-s2s: encoder, encoder2, ...)."""
+    return "encoder" if i == 0 else f"encoder{i + 1}"
+
+
+def _att_prefix(i: int) -> str:
+    """Attention-block prefix for encoder i (decoder_att, decoder_att2)."""
+    return "decoder_att" if i == 0 else f"decoder_att{i + 1}"
+
+
+def _enc_chains(cfg: S2SConfig, enc_idx: int = 0
+                ) -> List[Tuple[List[Tuple[str, R.Cell]], bool]]:
     """[(chain, reverse)] per encoder RNN run. Runs 0/1 are the
     bidirectional pair of layer 1; runs 2.. are the deeper C-dim layers."""
     ln = cfg.layer_normalization
+    ep = _s2s_enc_prefix(enc_idx)
     out = [
-        (_chain(cfg.enc_cell, "encoder_bi", cfg.dim_emb, cfg.dim_rnn, ln,
-                cfg.enc_cell_depth, "encoder_bi_cell{j}"), False),
-        (_chain(cfg.enc_cell, "encoder_bi_r", cfg.dim_emb, cfg.dim_rnn, ln,
-                cfg.enc_cell_depth, "encoder_bi_r_cell{j}"), True),
+        (_chain(cfg.enc_cell, f"{ep}_bi", cfg.dim_emb, cfg.dim_rnn, ln,
+                cfg.enc_cell_depth, ep + "_bi_cell{j}"), False),
+        (_chain(cfg.enc_cell, f"{ep}_bi_r", cfg.dim_emb, cfg.dim_rnn, ln,
+                cfg.enc_cell_depth, ep + "_bi_r_cell{j}"), True),
     ]
     for l in range(2, cfg.enc_depth + 1):
         rev = cfg.enc_type == "alternating" and l % 2 == 0
-        out.append((_chain(cfg.enc_cell, f"encoder_l{l}", cfg.dim_ctx,
+        out.append((_chain(cfg.enc_cell, f"{ep}_l{l}", cfg.dim_ctx,
                            cfg.dim_ctx, ln, cfg.enc_cell_depth,
-                           f"encoder_l{l}_cell{{j}}"), rev))
+                           ep + f"_l{l}_cell{{j}}"), rev))
     return out
 
 
@@ -177,7 +210,7 @@ def _dec_base_chain(cfg: S2SConfig) -> List[Tuple[str, R.Cell]]:
     chain = [("decoder_cell1",
               R.make_cell(cfg.dec_cell, cfg.dim_emb, cfg.dim_rnn, ln))]
     for j in range(2, cfg.dec_cell_base_depth + 1):
-        dim_in = cfg.dim_ctx if j == 2 else 0
+        dim_in = cfg.dim_ctx_total if j == 2 else 0
         chain.append((f"decoder_cell{j}",
                       R.make_cell(cfg.dec_cell, dim_in, cfg.dim_rnn, ln)))
     return chain
@@ -201,13 +234,16 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
     def glorot(shape):
         return inits.glorot_uniform(next(keys), shape)
 
-    # embeddings (Nematus names Wemb / Wemb_dec)
+    # embeddings (Nematus names Wemb / Wemb_dec; multi-s2s: Wemb2, ...)
+    src_vocabs = cfg.src_vocabs or (cfg.src_vocab,)
     if cfg.tied_embeddings_all or cfg.tied_embeddings_src:
-        if cfg.src_vocab != cfg.trg_vocab:
+        if any(v != cfg.trg_vocab for v in src_vocabs):
             raise ValueError("tied src embeddings require equal vocab sizes")
-        p["Wemb"] = glorot((cfg.src_vocab, cfg.dim_emb))
+        p["Wemb"] = glorot((cfg.trg_vocab, cfg.dim_emb))
     else:
-        p["Wemb"] = glorot((cfg.src_vocab, cfg.dim_emb))
+        for i, v in enumerate(src_vocabs):
+            p["Wemb" if i == 0 else f"Wemb{i + 1}"] = glorot(
+                (v, cfg.dim_emb))
         p["Wemb_dec"] = glorot((cfg.trg_vocab, cfg.dim_emb))
 
     if cfg.char_conv:
@@ -226,12 +262,14 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
         p["encoder_char_proj_W"] = glorot((cd, cfg.dim_emb))
         p["encoder_char_proj_b"] = inits.zeros((1, cfg.dim_emb))
 
-    for chain, _rev in _enc_chains(cfg):
-        for prefix, cell in chain:
-            cell.init(next(keys), p, prefix)
+    for i in range(max(cfg.n_encoders, 1)):
+        for chain, _rev in _enc_chains(cfg, i):
+            for prefix, cell in chain:
+                cell.init(next(keys), p, prefix)
 
-    # decoder start state (reference: DecoderS2S::startState → ff_state)
-    p["ff_state_W"] = glorot((cfg.dim_ctx, cfg.dim_rnn))
+    # decoder start state (reference: DecoderS2S::startState → ff_state);
+    # multi-s2s: over the concatenated per-encoder mean contexts
+    p["ff_state_W"] = glorot((cfg.dim_ctx_total, cfg.dim_rnn))
     p["ff_state_b"] = inits.zeros((1, cfg.dim_rnn))
     if cfg.layer_normalization:
         p["ff_state_ln_scale"] = inits.ones((1, cfg.dim_rnn))
@@ -242,20 +280,23 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
         for prefix, cell in chain:
             cell.init(next(keys), p, prefix)
 
-    # Bahdanau MLP attention (reference: rnn/attention.cpp; Nematus names)
+    # Bahdanau MLP attention (reference: rnn/attention.cpp; Nematus
+    # names); multi-s2s: one attention block per encoder
     a = cfg.dim_rnn
-    p["decoder_att_W"] = glorot((cfg.dim_rnn, a))     # W_comb_att
-    p["decoder_att_U"] = glorot((cfg.dim_ctx, a))     # Wc_att
-    p["decoder_att_b"] = inits.zeros((1, a))
-    p["decoder_att_v"] = glorot((a, 1))               # U_att
-    if cfg.layer_normalization:
-        p["decoder_att_ln_scale"] = inits.ones((1, a))
+    for i in range(max(cfg.n_encoders, 1)):
+        ap = _att_prefix(i)
+        p[f"{ap}_W"] = glorot((cfg.dim_rnn, a))       # W_comb_att
+        p[f"{ap}_U"] = glorot((cfg.dim_ctx, a))       # Wc_att
+        p[f"{ap}_b"] = inits.zeros((1, a))
+        p[f"{ap}_v"] = glorot((a, 1))                 # U_att
+        if cfg.layer_normalization:
+            p[f"{ap}_ln_scale"] = inits.ones((1, a))
 
     # deep output (Nematus ff_logit_prev/lstm/ctx + ff_logit)
     e = cfg.dim_emb
     p["ff_logit_l1_W0"] = glorot((cfg.dim_rnn, e))    # from state
     p["ff_logit_l1_W1"] = glorot((e, e))              # from prev embedding
-    p["ff_logit_l1_W2"] = glorot((cfg.dim_ctx, e))    # from context
+    p["ff_logit_l1_W2"] = glorot((cfg.dim_ctx_total, e))  # from context
     p["ff_logit_l1_b"] = inits.zeros((1, e))
     if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
         p["ff_logit_l2_W"] = glorot((e, cfg.trg_vocab))
@@ -268,8 +309,13 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 def _embed(cfg: S2SConfig, params: Params, ids: jax.Array,
-           side: str) -> jax.Array:
-    if side == "src" or cfg.tied_embeddings_all or "Wemb_dec" not in params:
+           side: str, enc_idx: int = 0) -> jax.Array:
+    if side == "src":
+        if enc_idx == 0 or cfg.tied_embeddings_all or cfg.tied_embeddings_src:
+            table = params["Wemb"]       # shared table (tied embeddings)
+        else:
+            table = params[f"Wemb{enc_idx + 1}"]   # missing leaf must raise
+    elif cfg.tied_embeddings_all or "Wemb_dec" not in params:
         table = params["Wemb"]
     else:
         table = params["Wemb_dec"]
@@ -364,12 +410,26 @@ def _char_conv_encode(cfg: S2SConfig, params: Params, x: jax.Array,
     return h, pooled_mask
 
 
-def encode(cfg: S2SConfig, params: Params, src_ids: jax.Array,
-           src_mask: jax.Array, train: bool = False,
-           key: Optional[jax.Array] = None) -> jax.Array:
+def encode(cfg: S2SConfig, params: Params, src_ids,
+           src_mask, train: bool = False,
+           key: Optional[jax.Array] = None):
     """[B, Ts] → [B, Ts, C] encoder context (reference: EncoderS2S::build;
-    char-s2s: [B, Ts/stride, C] after the conv front-end)."""
-    x = _embed(cfg, params, src_ids, "src")
+    char-s2s: [B, Ts/stride, C] after the conv front-end). Multi-s2s:
+    tuples of ids/masks → tuple of contexts, one RNN stack per stream."""
+    if isinstance(src_ids, (tuple, list)):
+        masks = _as_tup(src_mask)
+        return tuple(
+            _encode_one(cfg, params, ids_i, masks[i], train,
+                        jax.random.fold_in(key, 1000 + i)
+                        if key is not None else None, i)
+            for i, ids_i in enumerate(src_ids))
+    return _encode_one(cfg, params, src_ids, src_mask, train, key, 0)
+
+
+def _encode_one(cfg: S2SConfig, params: Params, src_ids: jax.Array,
+                src_mask: jax.Array, train: bool, key,
+                enc_idx: int) -> jax.Array:
+    x = _embed(cfg, params, src_ids, "src", enc_idx)
     x = _word_dropout(x, cfg.dropout_src,
                       jax.random.fold_in(key, 0) if key is not None else None,
                       train)
@@ -379,7 +439,7 @@ def encode(cfg: S2SConfig, params: Params, src_ids: jax.Array,
     if cfg.char_conv:
         x, mask = _char_conv_encode(cfg, params, x, mask)
 
-    chains = _enc_chains(cfg)
+    chains = _enc_chains(cfg, enc_idx)
     # layer 1: bidirectional pair (deep-transition chains)
     fw_out, _ = R.run_layer(chains[0][0], params, x, mask)
     bw_out, _ = R.run_layer(chains[1][0], params, x, mask, reverse=True)
@@ -402,22 +462,26 @@ def _variational_dropout(x: jax.Array, rate: float, key) -> jax.Array:
 # Attention (Bahdanau MLP; reference: src/rnn/attention.cpp)
 # ---------------------------------------------------------------------------
 
-def _att_keys(cfg: S2SConfig, params: Params, enc_out: jax.Array) -> jax.Array:
+def _att_keys(cfg: S2SConfig, params: Params, enc_out: jax.Array,
+              enc_idx: int = 0) -> jax.Array:
     """Encoder-side projection U*h_j, computed once (reference: attention.cpp
     precomputes mappedContext)."""
-    return (jnp.dot(enc_out, params["decoder_att_U"].astype(enc_out.dtype))
-            + params["decoder_att_b"].astype(enc_out.dtype))
+    ap = _att_prefix(enc_idx)
+    return (jnp.dot(enc_out, params[f"{ap}_U"].astype(enc_out.dtype))
+            + params[f"{ap}_b"].astype(enc_out.dtype))
 
 
 def _attend(cfg: S2SConfig, params: Params, state: jax.Array,
             att_keys: jax.Array, enc_out: jax.Array,
-            src_mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+            src_mask: jax.Array,
+            enc_idx: int = 0) -> Tuple[jax.Array, jax.Array]:
     """state [B, D] × keys [B, Ts, A] → (context [B, C], weights [B, Ts])."""
-    q = jnp.dot(state, params["decoder_att_W"].astype(state.dtype))
+    ap = _att_prefix(enc_idx)
+    q = jnp.dot(state, params[f"{ap}_W"].astype(state.dtype))
     e = jnp.tanh(q[:, None, :] + att_keys)
     if cfg.layer_normalization:
-        e = layer_norm(e, params["decoder_att_ln_scale"])
-    scores = jnp.dot(e, params["decoder_att_v"].astype(e.dtype))[..., 0]
+        e = layer_norm(e, params[f"{ap}_ln_scale"])
+    scores = jnp.dot(e, params[f"{ap}_v"].astype(e.dtype))[..., 0]
     scores = scores.astype(jnp.float32)
     scores = jnp.where(src_mask > 0, scores, -1e9)
     w = jax.nn.softmax(scores, axis=-1).astype(enc_out.dtype)
@@ -440,12 +504,17 @@ def _layer_state_names(cfg: S2SConfig) -> List[Tuple[str, Tuple[str, ...]]]:
     return names
 
 
-def _cell_states_init(cfg: S2SConfig, params: Params, enc_out: jax.Array,
-                      src_mask: jax.Array) -> Dict[str, jax.Array]:
+def _cell_states_init(cfg: S2SConfig, params: Params, enc_out,
+                      src_mask) -> Dict[str, jax.Array]:
     """s0 = tanh(mean-context @ ff_state) for every decoder layer
-    (reference: DecoderS2S::startState mean-pooled start)."""
-    m = src_mask[..., None].astype(enc_out.dtype)
-    mean_ctx = (enc_out * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    (reference: DecoderS2S::startState mean-pooled start); multi-s2s:
+    mean contexts concatenated across encoders."""
+    means = []
+    for eo, sm in zip(_as_tup(enc_out), _as_tup(src_mask)):
+        m = sm[..., None].astype(eo.dtype)
+        means.append((eo * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0))
+    mean_ctx = jnp.concatenate(means, axis=-1) if len(means) > 1 \
+        else means[0]
     s0 = jnp.dot(mean_ctx, params["ff_state_W"].astype(mean_ctx.dtype)) \
         + params["ff_state_b"].astype(mean_ctx.dtype)
     if cfg.layer_normalization:
@@ -458,13 +527,17 @@ def _cell_states_init(cfg: S2SConfig, params: Params, enc_out: jax.Array,
     return states
 
 
+def _as_tup(x) -> tuple:
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
 def _conditional_step(cfg: S2SConfig, params: Params,
                       states: Dict[str, jax.Array], emb: jax.Array,
-                      att_keys: jax.Array, enc_out: jax.Array,
-                      src_mask: jax.Array):
+                      att_keys, enc_out, src_mask):
     """One decoder time step: conditional stack + high layers.
-    Returns (top_state [B,D], context [B,C], att_weights [B,Ts], new_states).
-    """
+    Returns (top_state [B,D], context [B,C·n], att_weights [B,Ts] of the
+    FIRST encoder, new_states). Multi-s2s: one attention per encoder,
+    contexts concatenated (reference: multi-source decoder assembly)."""
     new_states = dict(states)
     base = _dec_base_chain(cfg)
 
@@ -474,7 +547,14 @@ def _conditional_step(cfg: S2SConfig, params: Params,
     st = {k: states[f"decoder_base_{k}"] for k in cell.state_keys}
     out, st = cell.step(params, prefix, cell.x_proj(params, prefix, emb), st)
 
-    ctx, w = _attend(cfg, params, out, att_keys, enc_out, src_mask)
+    ctxs, w = [], None
+    for i, (ak, eo, sm) in enumerate(zip(_as_tup(att_keys), _as_tup(enc_out),
+                                         _as_tup(src_mask))):
+        ctx_i, w_i = _attend(cfg, params, out, ak, eo, sm, enc_idx=i)
+        ctxs.append(ctx_i)
+        if i == 0:
+            w = w_i
+    ctx = jnp.concatenate(ctxs, axis=-1) if len(ctxs) > 1 else ctxs[0]
 
     for j, (prefix, cell) in enumerate(base[1:], start=2):
         xp = cell.x_proj(params, prefix, ctx if j == 2 else None)
@@ -510,7 +590,8 @@ def decode_train(cfg: S2SConfig, params: Params, enc_out: jax.Array,
     embedding of t-1 (zero at t=0 — same no-BOS convention as the
     transformer path)."""
     b, tt = trg_ids.shape
-    src_mask = enc_mask(cfg, src_mask)     # char-s2s: pooled attention mask
+    # char-s2s: pooled attention mask; multi-s2s: one mask per stream
+    src_mask = tuple(enc_mask(cfg, m) for m in _as_tup(src_mask))
     emb = _embed(cfg, params, trg_ids, "trg")
     emb = jnp.pad(emb, ((0, 0), (1, 0), (0, 0)))[:, :-1, :]   # shift right
     kk = (lambda i: jax.random.fold_in(key, i)) if key is not None else (lambda i: None)
@@ -518,14 +599,16 @@ def decode_train(cfg: S2SConfig, params: Params, enc_out: jax.Array,
     if train and cfg.dropout_rnn > 0.0 and key is not None:
         emb = _variational_dropout(emb, cfg.dropout_rnn, kk(1))
 
-    att_keys = _att_keys(cfg, params, enc_out)
-    states0 = _cell_states_init(cfg, params, enc_out, src_mask)
+    enc_outs = _as_tup(enc_out)
+    att_keys = tuple(_att_keys(cfg, params, eo, i)
+                     for i, eo in enumerate(enc_outs))
+    states0 = _cell_states_init(cfg, params, enc_outs, src_mask)
 
     emb_tm = jnp.swapaxes(emb, 0, 1)                           # [Tt, B, E]
 
     def step_fn(states, e_t):
         top, ctx, w, new_states = _conditional_step(
-            cfg, params, states, e_t, att_keys, enc_out, src_mask)
+            cfg, params, states, e_t, att_keys, enc_outs, src_mask)
         return new_states, (top, ctx, w)
 
     _, (tops, ctxs, ws) = jax.lax.scan(step_fn, states0, emb_tm)
@@ -543,15 +626,21 @@ def decode_train(cfg: S2SConfig, params: Params, enc_out: jax.Array,
 # Incremental decoding
 # ---------------------------------------------------------------------------
 
-def init_decode_state(cfg: S2SConfig, params: Params, enc_out: jax.Array,
-                      src_mask: jax.Array, max_len: int) -> Dict[str, Any]:
-    """State: pos scalar + per-cell recurrent states (beam-carried) +
-    precomputed attention keys / encoder context (beam-invariant)."""
+def init_decode_state(cfg: S2SConfig, params: Params, enc_out,
+                      src_mask, max_len: int,
+                      want_alignment: bool = False) -> Dict[str, Any]:
+    """State: pos scalar (want_alignment accepted for signature parity —
+    the RNN decoder emits attention weights from the step directly) + per-cell recurrent states (beam-carried) +
+    precomputed attention keys / encoder context (beam-invariant;
+    multi-s2s: suffixed per encoder)."""
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
-    state["enc_ctx"] = enc_out
-    state["enc_att_keys"] = _att_keys(cfg, params, enc_out)
-    state.update(_cell_states_init(cfg, params, enc_out,
-                                   enc_mask(cfg, src_mask)))
+    enc_outs = _as_tup(enc_out)
+    for i, eo in enumerate(enc_outs):
+        sfx = "" if i == 0 else str(i + 1)
+        state[f"enc_ctx{sfx}"] = eo
+        state[f"enc_att_keys{sfx}"] = _att_keys(cfg, params, eo, i)
+    masks = tuple(enc_mask(cfg, m) for m in _as_tup(src_mask))
+    state.update(_cell_states_init(cfg, params, enc_outs, masks))
     return state
 
 
@@ -564,9 +653,13 @@ def decode_step(cfg: S2SConfig, params: Params, state: Dict[str, Any],
     emb = jnp.where(pos == 0, jnp.zeros_like(emb), emb)
     cell_states = {k: v for k, v in state.items()
                    if k.endswith(BEAM_CARRIED_SUFFIXES)}
+    n_enc = max(cfg.n_encoders, 1)
+    sfxs = ["" if i == 0 else str(i + 1) for i in range(n_enc)]
     top, ctx, w, new_cell_states = _conditional_step(
-        cfg, params, cell_states, emb, state["enc_att_keys"],
-        state["enc_ctx"], enc_mask(cfg, src_mask))
+        cfg, params, cell_states, emb,
+        tuple(state[f"enc_att_keys{x}"] for x in sfxs),
+        tuple(state[f"enc_ctx{x}"] for x in sfxs),
+        tuple(enc_mask(cfg, m) for m in _as_tup(src_mask)))
     logits = _output_logits(cfg, params, top, emb, ctx, shortlist)
     new_state = dict(state)
     new_state.update(new_cell_states)

@@ -195,6 +195,11 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         src_vocabs = tuple(int(v) for v in src_vocab)
     else:
         src_vocabs = (int(src_vocab),)
+    if len(src_vocabs) > 1 and str(g("type", "transformer")) not in (
+            "multi-transformer",):
+        raise ValueError(
+            f"--type {g('type', 'transformer')} is a single-encoder model; "
+            f"multiple source streams need --type multi-transformer")
     # normalize src_factors to one entry per encoder
     if not isinstance(src_factors, (tuple, list)):
         src_factors = (src_factors,)

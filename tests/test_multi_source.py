@@ -204,3 +204,97 @@ class TestMultiSourceFactored:
         sizes, factors = _vocab_info([FakeFactored(), FakeFactored()])
         assert sizes == (7, 7)
         assert isinstance(factors, tuple) and len(factors) == 2
+
+
+class TestMultiS2S:
+    """--type multi-s2s: multiple bi-RNN encoders, per-encoder Bahdanau
+    attention, concatenated contexts (reference: model_factory.cpp
+    multi-encoder s2s assembly)."""
+
+    def _make(self, vocabs=(17, 13, 11), **over):
+        base = {"type": "multi-s2s", "dim-emb": 16, "dim-rnn": 24,
+                "enc-depth": 1, "dec-depth": 2, "enc-cell": "gru",
+                "dec-cell": "gru", "label-smoothing": 0.0,
+                "precision": ["float32", "float32"], "max-length": 32}
+        base.update(over)
+        opts = Options(base)
+        model = create_model(opts, list(vocabs[:-1]), vocabs[-1])
+        params = model.init(jax.random.key(0))
+        return model, params
+
+    def test_params_have_two_encoders_and_attentions(self):
+        model, params = self._make()
+        names = set(params)
+        assert "encoder_bi_Wx" in names or any(
+            n.startswith("encoder_bi") for n in names)
+        assert any(n.startswith("encoder2_bi") for n in names)
+        assert "Wemb" in names and "Wemb2" in names
+        assert "decoder_att_U" in names and "decoder_att2_U" in names
+        # ff_state consumes the CONCATENATED mean contexts
+        assert params["ff_state_W"].shape[0] == 2 * 2 * 24
+        assert params["ff_logit_l1_W2"].shape[0] == 2 * 2 * 24
+
+    def test_loss_uses_both_sources(self, rng):
+        model, params = self._make()
+        batch = multi_batch(rng)
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss(p, batch, None, train=False)[0])(params)
+        assert np.isfinite(float(loss))
+        for name in ("Wemb2", "decoder_att2_U"):
+            assert float(jnp.sum(jnp.abs(grads[name]))) > 0, name
+        # second source changes the loss
+        batch2 = dict(batch)
+        batch2["src2_ids"] = jnp.asarray(
+            rng.randint(2, 13, batch["src2_ids"].shape), jnp.int32)
+        l2, _ = model.loss(params, batch2, None, train=False)
+        assert abs(float(loss) - float(l2)) > 1e-6
+
+    def test_teacher_forcing_matches_incremental(self, rng):
+        from marian_tpu.models import s2s as S
+        model, params = self._make()
+        batch = multi_batch(rng)
+        src = (batch["src_ids"], batch["src2_ids"])
+        masks = (batch["src_mask"], batch["src2_mask"])
+        cp = S.cast_params(params, model.cfg.compute_dtype)
+        enc = model.encode_for_decode(params, src, masks)
+        assert isinstance(enc, tuple) and len(enc) == 2
+        tf = S.decode_train(model.cfg, cp, enc, masks,
+                            batch["trg_ids"], batch["trg_mask"], train=False)
+        state = model.start_state(params, enc, masks, max_len=5)
+        prev = jnp.zeros((2, 1), jnp.int32)
+        for t in range(5):
+            logits, state = model.step(params, state, prev, masks)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(tf[:, t]),
+                                       rtol=2e-4, atol=2e-4)
+            prev = batch["trg_ids"][:, t:t + 1]
+
+    def test_beam_search_runs(self, rng):
+        from marian_tpu.translator.beam_search import BeamConfig, beam_search_jit
+        model, params = self._make()
+        batch = multi_batch(rng)
+        src = (batch["src_ids"], batch["src2_ids"])
+        masks = (batch["src_mask"], batch["src2_mask"])
+        tokens, _, _, norm, _ = beam_search_jit(
+            model, [params], [1.0], BeamConfig(beam_size=2, max_length=6),
+            src, masks)
+        assert tokens.shape == (2, 2, 6)
+        assert np.all(np.isfinite(np.asarray(norm)))
+
+    def test_training_reduces_loss(self, rng):
+        from marian_tpu.training.graph_group import GraphGroup
+        from marian_tpu.common import prng
+        model, params = self._make()
+        opts = Options({"type": "multi-s2s", "learn-rate": 0.05,
+                        "optimizer": "adam", "cost-type": "ce-mean-words",
+                        "clip-norm": 1.0, "seed": 3, "devices": ["0"]})
+        gg = GraphGroup(model, opts)
+        gg.initialize(prng.root_key(3), params)
+        batch = multi_batch(rng)
+        first = last = None
+        for step in range(8):
+            out = gg.update(dict(batch), step + 1, jax.random.key(step))
+            val = float(out.loss_sum)
+            first = val if first is None else first
+            last = val
+        assert last < first
