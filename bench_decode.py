@@ -1,0 +1,116 @@
+"""Decode benchmark: batched beam-6 translation throughput (sent/sec) —
+BASELINE.json's second driver metric (the train metric lives in bench.py).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}; the
+baseline field stays null (the empty reference mount ships no decode
+number — SURVEY §6).
+
+Drives the REAL translator path: Translator-style bucketed batches through
+the jitted BeamSearch (ensemble-capable, KV-cached, scanned decoder stack),
+on a freshly-initialized transformer-big. Sentence throughput counts real
+input sentences; the beam-6/normalize-0.6 settings mirror Marian's
+published decode configs.
+
+Env knobs:
+  MARIAN_DECBENCH_PRESET  big (default) | base | tiny (CPU smoke)
+  MARIAN_DECBENCH_SENTS   sentences in the timed window (default 256)
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+
+def main():
+    preset = os.environ.get("MARIAN_DECBENCH_PRESET", "big")
+    n_sents = int(os.environ.get("MARIAN_DECBENCH_SENTS", 256))
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from marian_tpu.common.hermetic import force_cpu_devices
+        force_cpu_devices(1)
+
+    # fail fast on a hung TPU tunnel (see bench.py)
+    import threading
+
+    def _die():
+        print("bench_decode: TPU device enumeration hung >120s — aborting",
+              file=sys.stderr, flush=True)
+        os._exit(3)
+
+    timer = threading.Timer(120, _die)
+    timer.daemon = True
+    timer.start()
+    import jax
+    jax.devices()
+    timer.cancel()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from marian_tpu.common.profiling import enable_compilation_cache
+    enable_compilation_cache()
+    from marian_tpu.common.options import Options
+    from marian_tpu.models.encoder_decoder import create_model
+    from marian_tpu.translator.beam_search import BeamConfig, beam_search_jit
+
+    if preset == "big":
+        dims = dict(emb=1024, ffn=4096, heads=16, depth=6, vocab=32000)
+        batch, src_len, max_len = 64, 32, 64
+    elif preset == "base":
+        dims = dict(emb=512, ffn=2048, heads=8, depth=6, vocab=32000)
+        batch, src_len, max_len = 64, 32, 64
+    else:
+        dims = dict(emb=64, ffn=128, heads=4, depth=2, vocab=512)
+        batch, src_len, max_len = 8, 12, 16
+        n_sents = min(n_sents, 32)
+
+    opts = Options({
+        "type": "transformer",
+        "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
+        "transformer-heads": dims["heads"],
+        "enc-depth": dims["depth"], "dec-depth": dims["depth"],
+        "tied-embeddings-all": True, "transformer-ffn-activation": "relu",
+        "precision": ["bfloat16", "float32"], "max-length": max_len,
+        "seed": 17,
+    })
+    model = create_model(opts, dims["vocab"], dims["vocab"],
+                         inference=True)
+    params = model.init(jax.random.key(17))
+    cfg = BeamConfig(beam_size=6, max_length=max_len, normalize=0.6)
+
+    rng = random.Random(17)
+    rs = np.random.RandomState(17)
+
+    def make_batch():
+        lens = [max(4, min(src_len, int(rng.lognormvariate(3.0, 0.4))))
+                for _ in range(batch)]
+        ids = np.zeros((batch, src_len), np.int32)
+        mask = np.zeros((batch, src_len), np.float32)
+        for i, n in enumerate(lens):
+            ids[i, :n] = rs.randint(2, dims["vocab"], n)
+            mask[i, :n] = 1.0
+        return jnp.asarray(ids), jnp.asarray(mask)
+
+    # compile + warm
+    ids, mask = make_batch()
+    out = beam_search_jit(model, [params], [1.0], cfg, ids, mask)
+    jax.block_until_ready(out[0])
+
+    batches = [make_batch() for _ in range(max(1, n_sents // batch))]
+    t0 = time.perf_counter()
+    for ids, mask in batches:
+        out = beam_search_jit(model, [params], [1.0], cfg, ids, mask)
+    jax.block_until_ready(out[0])
+    dt = time.perf_counter() - t0
+    sents = batch * len(batches)
+    print(json.dumps({
+        "metric": "beam6_sentences_per_sec",
+        "value": round(sents / dt, 2),
+        "unit": "sent/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

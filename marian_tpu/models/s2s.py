@@ -90,6 +90,13 @@ class S2SConfig:
     conv_filters: Tuple[int, ...] = (200, 200, 250, 250, 300, 300, 300, 300)
     compute_dtype: Any = jnp.bfloat16
 
+    def __post_init__(self):
+        # keep the three source-vocab views consistent however the config
+        # was built (config_from_options or hand-constructed in tests)
+        if not self.src_vocabs:
+            object.__setattr__(self, "src_vocabs", (self.src_vocab,))
+        object.__setattr__(self, "n_encoders", len(self.src_vocabs))
+
     @property
     def dim_ctx(self) -> int:            # bidirectional concat
         return 2 * self.dim_rnn
@@ -172,14 +179,20 @@ def _chain(kind: str, first_prefix: str, dim_in: int, dim: int, ln: bool,
     return chain
 
 
+def _sfx(i: int) -> str:
+    """Numbering suffix of encoder i ('' for the first, '2', '3', ...) —
+    the ONE definition behind every per-encoder name scheme."""
+    return "" if i == 0 else str(i + 1)
+
+
 def _s2s_enc_prefix(i: int) -> str:
     """Param prefix of encoder i (multi-s2s: encoder, encoder2, ...)."""
-    return "encoder" if i == 0 else f"encoder{i + 1}"
+    return f"encoder{_sfx(i)}"
 
 
 def _att_prefix(i: int) -> str:
     """Attention-block prefix for encoder i (decoder_att, decoder_att2)."""
-    return "decoder_att" if i == 0 else f"decoder_att{i + 1}"
+    return f"decoder_att{_sfx(i)}"
 
 
 def _enc_chains(cfg: S2SConfig, enc_idx: int = 0
@@ -235,15 +248,14 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
         return inits.glorot_uniform(next(keys), shape)
 
     # embeddings (Nematus names Wemb / Wemb_dec; multi-s2s: Wemb2, ...)
-    src_vocabs = cfg.src_vocabs or (cfg.src_vocab,)
+    src_vocabs = cfg.src_vocabs
     if cfg.tied_embeddings_all or cfg.tied_embeddings_src:
         if any(v != cfg.trg_vocab for v in src_vocabs):
             raise ValueError("tied src embeddings require equal vocab sizes")
         p["Wemb"] = glorot((cfg.trg_vocab, cfg.dim_emb))
     else:
         for i, v in enumerate(src_vocabs):
-            p["Wemb" if i == 0 else f"Wemb{i + 1}"] = glorot(
-                (v, cfg.dim_emb))
+            p[f"Wemb{_sfx(i)}"] = glorot((v, cfg.dim_emb))
         p["Wemb_dec"] = glorot((cfg.trg_vocab, cfg.dim_emb))
 
     if cfg.char_conv:
@@ -262,7 +274,7 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
         p["encoder_char_proj_W"] = glorot((cd, cfg.dim_emb))
         p["encoder_char_proj_b"] = inits.zeros((1, cfg.dim_emb))
 
-    for i in range(max(cfg.n_encoders, 1)):
+    for i in range(cfg.n_encoders):
         for chain, _rev in _enc_chains(cfg, i):
             for prefix, cell in chain:
                 cell.init(next(keys), p, prefix)
@@ -283,7 +295,7 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
     # Bahdanau MLP attention (reference: rnn/attention.cpp; Nematus
     # names); multi-s2s: one attention block per encoder
     a = cfg.dim_rnn
-    for i in range(max(cfg.n_encoders, 1)):
+    for i in range(cfg.n_encoders):
         ap = _att_prefix(i)
         p[f"{ap}_W"] = glorot((cfg.dim_rnn, a))       # W_comb_att
         p[f"{ap}_U"] = glorot((cfg.dim_ctx, a))       # Wc_att
@@ -314,7 +326,7 @@ def _embed(cfg: S2SConfig, params: Params, ids: jax.Array,
         if enc_idx == 0 or cfg.tied_embeddings_all or cfg.tied_embeddings_src:
             table = params["Wemb"]       # shared table (tied embeddings)
         else:
-            table = params[f"Wemb{enc_idx + 1}"]   # missing leaf must raise
+            table = params[f"Wemb{_sfx(enc_idx)}"]  # missing leaf must raise
     elif cfg.tied_embeddings_all or "Wemb_dec" not in params:
         table = params["Wemb"]
     else:
@@ -581,8 +593,8 @@ def _conditional_step(cfg: S2SConfig, params: Params,
 # Teacher-forced training path
 # ---------------------------------------------------------------------------
 
-def decode_train(cfg: S2SConfig, params: Params, enc_out: jax.Array,
-                 src_mask: jax.Array, trg_ids: jax.Array,
+def decode_train(cfg: S2SConfig, params: Params, enc_out,
+                 src_mask, trg_ids: jax.Array,
                  trg_mask: jax.Array, train: bool = True,
                  key: Optional[jax.Array] = None,
                  return_alignment: bool = False):
@@ -629,16 +641,16 @@ def decode_train(cfg: S2SConfig, params: Params, enc_out: jax.Array,
 def init_decode_state(cfg: S2SConfig, params: Params, enc_out,
                       src_mask, max_len: int,
                       want_alignment: bool = False) -> Dict[str, Any]:
-    """State: pos scalar (want_alignment accepted for signature parity —
-    the RNN decoder emits attention weights from the step directly) + per-cell recurrent states (beam-carried) +
+    """State: pos scalar + per-cell recurrent states (beam-carried) +
     precomputed attention keys / encoder context (beam-invariant;
-    multi-s2s: suffixed per encoder)."""
+    multi-s2s: suffixed per encoder). want_alignment is accepted for
+    signature parity — the RNN decoder emits attention weights from the
+    step directly, no alternative state layout exists."""
     state: Dict[str, Any] = {"pos": jnp.zeros((), jnp.int32)}
     enc_outs = _as_tup(enc_out)
     for i, eo in enumerate(enc_outs):
-        sfx = "" if i == 0 else str(i + 1)
-        state[f"enc_ctx{sfx}"] = eo
-        state[f"enc_att_keys{sfx}"] = _att_keys(cfg, params, eo, i)
+        state[f"enc_ctx{_sfx(i)}"] = eo
+        state[f"enc_att_keys{_sfx(i)}"] = _att_keys(cfg, params, eo, i)
     masks = tuple(enc_mask(cfg, m) for m in _as_tup(src_mask))
     state.update(_cell_states_init(cfg, params, enc_outs, masks))
     return state
@@ -653,8 +665,7 @@ def decode_step(cfg: S2SConfig, params: Params, state: Dict[str, Any],
     emb = jnp.where(pos == 0, jnp.zeros_like(emb), emb)
     cell_states = {k: v for k, v in state.items()
                    if k.endswith(BEAM_CARRIED_SUFFIXES)}
-    n_enc = max(cfg.n_encoders, 1)
-    sfxs = ["" if i == 0 else str(i + 1) for i in range(n_enc)]
+    sfxs = [_sfx(i) for i in range(cfg.n_encoders)]
     top, ctx, w, new_cell_states = _conditional_step(
         cfg, params, cell_states, emb,
         tuple(state[f"enc_att_keys{x}"] for x in sfxs),
