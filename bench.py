@@ -45,28 +45,6 @@ def _write_corpus(tmp, vocab_size, n_lines, seed=7):
     return src_p, trg_p
 
 
-def _watchdog_devices():
-    """The axon TPU tunnel sometimes hangs on device enumeration during
-    outages — fail FAST with a diagnosable message instead of eating the
-    driver's whole bench timeout."""
-    import threading
-
-    def _die():
-        # a thread, not SIGALRM: the hang sits in a native RPC wait that
-        # never returns to the interpreter, so signal handlers starve
-        print("bench: TPU device enumeration hung >120s "
-              "(tunnel outage?) — aborting", file=sys.stderr, flush=True)
-        os._exit(3)
-
-    timer = threading.Timer(120, _die)
-    timer.daemon = True
-    timer.start()
-    import jax
-    devs = jax.devices()
-    timer.cancel()
-    return devs
-
-
 def main():
     preset = os.environ.get("MARIAN_BENCH_PRESET", "big")
     profile_dir = os.environ.get("MARIAN_BENCH_PROFILE")
@@ -75,7 +53,8 @@ def main():
         # sitecustomize, which pre-selects the TPU tunnel backend
         from marian_tpu.common.hermetic import force_cpu_devices
         force_cpu_devices(1)
-    _watchdog_devices()
+    from marian_tpu.common.hermetic import watchdog_devices
+    watchdog_devices(label="bench")
     import jax
 
     from marian_tpu.common.profiling import enable_compilation_cache

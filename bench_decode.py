@@ -30,29 +30,18 @@ def main():
         from marian_tpu.common.hermetic import force_cpu_devices
         force_cpu_devices(1)
 
-    # fail fast on a hung TPU tunnel (see bench.py)
-    import threading
-
-    def _die():
-        print("bench_decode: TPU device enumeration hung >120s — aborting",
-              file=sys.stderr, flush=True)
-        os._exit(3)
-
-    timer = threading.Timer(120, _die)
-    timer.daemon = True
-    timer.start()
+    from marian_tpu.common.hermetic import watchdog_devices
+    watchdog_devices(label="bench_decode")
     import jax
-    jax.devices()
-    timer.cancel()
-
     import jax.numpy as jnp
     import numpy as np
 
     from marian_tpu.common.profiling import enable_compilation_cache
     enable_compilation_cache()
     from marian_tpu.common.options import Options
+    from marian_tpu.data.vocab import DefaultVocab
     from marian_tpu.models.encoder_decoder import create_model
-    from marian_tpu.translator.beam_search import BeamConfig, beam_search_jit
+    from marian_tpu.translator.beam_search import BeamSearch
 
     if preset == "big":
         dims = dict(emb=1024, ffn=4096, heads=16, depth=6, vocab=32000)
@@ -77,7 +66,13 @@ def main():
     model = create_model(opts, dims["vocab"], dims["vocab"],
                          inference=True)
     params = model.init(jax.random.key(17))
-    cfg = BeamConfig(beam_size=6, max_length=max_len, normalize=0.6)
+    # the REAL translator path: BeamSearch's jit cache + host-side
+    # n-best extraction, exactly what marian_decoder runs per batch
+    bopts = Options({"beam-size": 6, "normalize": 0.6,
+                     "max-length": max_len, "seed": 17})
+    vocab = DefaultVocab.build(
+        [" ".join(f"w{i}" for i in range(dims["vocab"] - 2))])
+    bs = BeamSearch(model, [params], None, bopts, vocab)
 
     rng = random.Random(17)
     rs = np.random.RandomState(17)
@@ -94,15 +89,14 @@ def main():
 
     # compile + warm
     ids, mask = make_batch()
-    out = beam_search_jit(model, [params], [1.0], cfg, ids, mask)
-    jax.block_until_ready(out[0])
+    bs.search(ids, mask)
 
     batches = [make_batch() for _ in range(max(1, n_sents // batch))]
     t0 = time.perf_counter()
     for ids, mask in batches:
-        out = beam_search_jit(model, [params], [1.0], cfg, ids, mask)
-    jax.block_until_ready(out[0])
+        nbests = bs.search(ids, mask)
     dt = time.perf_counter() - t0
+    assert len(nbests) == batch
     sents = batch * len(batches)
     print(json.dumps({
         "metric": "beam6_sentences_per_sec",

@@ -80,3 +80,26 @@ def force_cpu_devices(n_devices: int):
             f"{devs[0].platform if devs else '?'} devices, "
             f"need {n_devices} cpu devices")
     return jax
+
+
+def watchdog_devices(timeout_s: int = 120, label: str = "bench"):
+    """jax.devices() with a hard watchdog: the axon TPU tunnel can hang
+    device enumeration forever during outages, in a native RPC wait that
+    starves signal handlers — only a timer thread + os._exit gets out.
+    Returns the device list or exits the process with code 3."""
+    import os
+    import sys
+    import threading
+
+    def _die():
+        print(f"{label}: TPU device enumeration hung >{timeout_s}s "
+              f"(tunnel outage?) — aborting", file=sys.stderr, flush=True)
+        os._exit(3)
+
+    timer = threading.Timer(timeout_s, _die)
+    timer.daemon = True
+    timer.start()
+    import jax
+    devs = jax.devices()
+    timer.cancel()
+    return devs

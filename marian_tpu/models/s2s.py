@@ -92,9 +92,16 @@ class S2SConfig:
 
     def __post_init__(self):
         # keep the three source-vocab views consistent however the config
-        # was built (config_from_options or hand-constructed in tests)
+        # was built (config_from_options or hand-constructed in tests);
+        # an explicit n_encoders that disagrees with src_vocabs is a bug
+        # at the call site, not something to silently normalize away
         if not self.src_vocabs:
-            object.__setattr__(self, "src_vocabs", (self.src_vocab,))
+            object.__setattr__(self, "src_vocabs",
+                               (self.src_vocab,) * max(self.n_encoders, 1))
+        if self.n_encoders not in (1, len(self.src_vocabs)):
+            raise ValueError(
+                f"n_encoders={self.n_encoders} disagrees with "
+                f"{len(self.src_vocabs)} src_vocabs")
         object.__setattr__(self, "n_encoders", len(self.src_vocabs))
 
     @property

@@ -70,16 +70,21 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
 
     def step_fn(p, opt_state, batch, step, rng):
         if delay > 1:
-            def body(carry, micro):
+            def body(carry, sl):
                 acc, tot, lab = carry
-                g, aux = grads_of(p, micro, rng)
+                micro, i = sl
+                # per-micro-batch dropout keys fold exactly like the host
+                # accumulation loop (GraphGroup.update), so the two delay
+                # paths are numerically interchangeable
+                g, aux = grads_of(p, micro, jax.random.fold_in(rng, i))
                 acc = jax.tree_util.tree_map(jnp.add, acc, g)
                 return (acc, tot + aux["ce_sum"], lab + aux["labels"]), None
             zeros = jax.tree_util.tree_map(
                 lambda x: jnp.zeros(x.shape, jnp.float32), p)
             (grads, ce_sum, labels), _ = jax.lax.scan(
                 body, (zeros, jnp.zeros((), jnp.float32),
-                       jnp.zeros((), jnp.float32)), batch)
+                       jnp.zeros((), jnp.float32)),
+                (batch, jnp.arange(delay)))
         else:
             grads, aux = grads_of(p, batch, rng)
             ce_sum, labels = aux["ce_sum"], aux["labels"]

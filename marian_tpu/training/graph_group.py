@@ -74,6 +74,7 @@ class GraphGroup:
         self.opt_state: Optional[Dict[str, Any]] = None
         self._donate = donate
         self._fused = None
+        self._fused_delay = None         # delay>1 in-jit micro-batch scan
         self._grad_fn = None
         self._update_fn = None
         self._fix_src = bool(options.get("embedding-fix-src", False))
@@ -196,6 +197,15 @@ class GraphGroup:
                                        self.opt_state, delay=1,
                                        donate=self._donate,
                                        shardings=(p_sh, o_sh), frozen=frozen)
+        self._fused_delay = None
+        if self.delay > 1:
+            # in-jit micro-batch accumulation (one dispatch, one gradient
+            # accumulator in HBM) for the common case of shape-uniform
+            # micro-batches; heterogeneous shapes use the host loop below
+            self._fused_delay = build_train_step(
+                model, opt_cfg, schedule, self.cost_type, mesh,
+                self.params, self.opt_state, delay=self.delay,
+                donate=self._donate, shardings=(p_sh, o_sh), frozen=frozen)
 
         # split path for --optimizer-delay with heterogeneous batch shapes.
         # Batches arrive committed via M.shard_batch (per-leaf name-aware
@@ -248,6 +258,27 @@ class GraphGroup:
                 self._dump_hlo = None
             self.params, self.opt_state, metrics = self._fused(
                 self.params, self.opt_state, b,
+                jnp.asarray(step, jnp.float32), rng)
+            return TrainOutput(metrics["ce_sum"], metrics["labels"],
+                               metrics["gnorm"])
+        if (self._fused_delay is not None and len(batches) == self.delay
+                and all(all(v.shape == batches[0][k].shape
+                            for k, v in b.items())
+                        for b in batches[1:])):
+            # stack micro-batches on a leading [delay] axis → ONE jitted
+            # call (lax.scan accumulates grads on-device; SyncGraphGroup
+            # delay semantics preserved — see build_train_step)
+            stacked = {k: jnp.stack([b[k] for b in batches])
+                       for k in batches[0]}
+            stacked = M.shard_batch(stacked, self.mesh, micro=True)
+            if self._dump_hlo:
+                from ..common.profiling import dump_lowered
+                dump_lowered(self._dump_hlo, self._fused_delay.lower(
+                    self.params, self.opt_state, stacked,
+                    jnp.asarray(step, jnp.float32), rng))
+                self._dump_hlo = None
+            self.params, self.opt_state, metrics = self._fused_delay(
+                self.params, self.opt_state, stacked,
                 jnp.asarray(step, jnp.float32), rng)
             return TrainOutput(metrics["ce_sum"], metrics["labels"],
                                metrics["gnorm"])

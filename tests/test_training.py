@@ -212,3 +212,43 @@ class TestEMAAndDelay:
             np.testing.assert_allclose(np.asarray(p_delay[k]),
                                        np.asarray(p_cat[k]),
                                        rtol=5e-3, atol=5e-5, err_msg=k)
+
+
+class TestFusedDelay:
+    def test_fused_delay_matches_host_loop(self, tmp_corpus, tmp_path):
+        """Shape-uniform micro-batches take the in-jit lax.scan
+        accumulation; it must match the host-side loop bit-for-bit-ish,
+        including per-micro dropout key folding."""
+        import jax.numpy as jnp
+        src, tgt, _ = tmp_corpus
+        opts = train_options(tmp_path, src, tgt).with_(
+            **{"optimizer-delay": 2, "transformer-dropout": 0.1})
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        vt = DefaultVocab.build(open(tgt).read().splitlines())
+        model = create_model(opts, len(vs), len(vt))
+        rs = np.random.RandomState(3)
+        b = {
+            "src_ids": jnp.asarray(rs.randint(2, len(vs), (8, 9)), jnp.int32),
+            "src_mask": jnp.ones((8, 9), jnp.float32),
+            "trg_ids": jnp.asarray(rs.randint(2, len(vt), (8, 9)), jnp.int32),
+            "trg_mask": jnp.ones((8, 9), jnp.float32),
+        }
+        b2 = {k: jnp.roll(v, 1, axis=0) for k, v in b.items()}
+
+        def run(force_host):
+            gg = GraphGroup(model, opts, donate=False)
+            gg.initialize(jax.random.key(0))
+            if force_host:
+                gg._fused_delay = None
+            assert (gg._fused_delay is None) == force_host
+            gg.update([dict(b), dict(b2)], 1, jax.random.key(5))
+            return gg.params
+
+        p_fused = run(False)
+        p_host = run(True)
+        for k in p_host:
+            if k.endswith("_bk"):
+                continue    # see delay-equivalence test above
+            np.testing.assert_allclose(np.asarray(p_fused[k]),
+                                       np.asarray(p_host[k]),
+                                       rtol=2e-5, atol=1e-6, err_msg=k)
