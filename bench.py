@@ -140,6 +140,7 @@ def main():
                                          length_buckets=buckets)))
         corpus.restore(corpus_state)
         times = {}
+        t_ab = time.perf_counter()
         for mode in ("on", "off"):
             g = build_gg(mode)
             arrays = batch_to_arrays(probe)
@@ -154,9 +155,21 @@ def main():
             jax.block_until_ready(g.params)
             times[mode] = time.perf_counter() - t0
             del g
-        fused_mode = min(times, key=times.get)
-        print(f"fused-ce A/B: on={times['on']:.3f}s off={times['off']:.3f}s "
-              f"→ {fused_mode}", file=sys.stderr)
+            if mode == "on" and time.perf_counter() - t_ab > 300:
+                # cold compile over a slow tunnel: a second probe variant
+                # would double that cost — keep the fused default rather
+                # than risk the caller's whole time budget on the A/B
+                print(f"fused-ce A/B skipped after "
+                      f"{time.perf_counter() - t_ab:.0f}s cold compile "
+                      f"→ on", file=sys.stderr, flush=True)
+                times = None
+                fused_mode = "on"
+                break
+        if times is not None:
+            fused_mode = min(times, key=times.get)
+            print(f"fused-ce A/B: on={times['on']:.3f}s "
+                  f"off={times['off']:.3f}s → {fused_mode}", file=sys.stderr,
+                  flush=True)
     elif fused_mode == "tune":
         fused_mode = "auto"
 
