@@ -66,6 +66,13 @@ def main():
     model = create_model(opts, dims["vocab"], dims["vocab"],
                          inference=True)
     params = model.init(jax.random.key(17))
+    metric = "beam6_sentences_per_sec"
+    if os.environ.get("MARIAN_DECBENCH_INT8"):
+        # config #5 (int8 student decode): quantize offline like
+        # marian-conv int8tpu, decode through the int8 dot_general path
+        from marian_tpu.ops.quantization import quantize_params
+        params = quantize_params(params)
+        metric = "beam6_int8_sentences_per_sec"
     # the REAL translator path: BeamSearch's jit cache + host-side
     # n-best extraction, exactly what marian_decoder runs per batch
     bopts = Options({"beam-size": 6, "normalize": 0.6,
@@ -99,7 +106,7 @@ def main():
     assert len(nbests) == batch
     sents = batch * len(batches)
     print(json.dumps({
-        "metric": "beam6_sentences_per_sec",
+        "metric": metric,
         "value": round(sents / dt, 2),
         "unit": "sent/sec",
         "vs_baseline": None,
