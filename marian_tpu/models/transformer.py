@@ -1066,8 +1066,9 @@ def _stacked_layer_params(cfg: TransformerConfig, params: Params,
     the compiled HLO (and XLA compile time, the dominant cold-start cost
     on TPU) stays O(1) in depth. Returns {suffix: stacked} keyed by the
     name after '{base}{l}_', or None when scanning doesn't apply: flag
-    off, depth < 2, cross-layer tying (layers share leaves), or
-    non-array leaves (int8 QTensor decode params).
+    off, depth < 2, or cross-layer tying (layers share leaves). Int8
+    QTensor decode weights stack too (their values/scale children stack;
+    lax.scan slices them back into per-layer QTensors).
 
     The stack is rebuilt inside every jitted forward (one HBM copy of the
     layer weights per step, ~1ms for transformer-big — measured against
@@ -1085,16 +1086,27 @@ def _stacked_layer_params(cfg: TransformerConfig, params: Params,
     sfxs = [k[len(first):] for k in params if k.startswith(first)]
     if not sfxs:
         return None
+    from ..ops.quantization import QTensor
     out = {}
     for s in sfxs:
         leaves = []
         for l in range(1, n + 1):
             v = params.get(f"{base}{l}_{s}")
-            if v is None or not isinstance(v, jax.Array) \
-                    or v.shape != params[f"{base}1_{s}"].shape:
+            if v is None or v.shape != params[f"{base}1_{s}"].shape:
                 return None
             leaves.append(v)
-        out[s] = jnp.stack(leaves)
+        if all(isinstance(v, QTensor) for v in leaves):
+            # int8 decode weights: stack the pytree children — lax.scan
+            # slices them back into per-layer QTensors
+            if len({v.axis for v in leaves}) != 1:
+                return None
+            out[s] = QTensor(jnp.stack([v.values for v in leaves]),
+                             jnp.stack([v.scale for v in leaves]),
+                             leaves[0].axis)
+        elif all(isinstance(v, jax.Array) for v in leaves):
+            out[s] = jnp.stack(leaves)
+        else:
+            return None
     return out
 
 
@@ -1457,14 +1469,24 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
         # runs the layer stack as a lax.scan (same O(1)-in-depth compile
         # win as the training path). 'stack_*' keys gather on axis 1 when
         # the beam reorders (translator/beam_search.py).
+        from ..ops.quantization import QTensor
+
+        def cross_proj(kv, w, bias):
+            """[B,S,d] × stacked [L,d,d] weights → [L,B,S,d]; int8 stacks
+            vmap the per-layer int8 affine (same kernel as the unrolled
+            decode path, so quantization numerics are identical)."""
+            if isinstance(w, QTensor):
+                f = jax.vmap(lambda wl, bl: affine(kv, wl, bl),
+                             in_axes=(0, 0))
+                return f(w, bias).astype(kv.dtype)
+            return jnp.einsum("bsd,lde->lbse", kv, w) + bias[:, None]
+
         for i, kv in enumerate(enc_outs):
             sfx = _ctx_suffix(i)
-            wk = stacked[f"context{sfx}_Wk"]            # [L, d, d]
-            wv = stacked[f"context{sfx}_Wv"]
-            bk2 = stacked[f"context{sfx}_bk"][:, None]  # [L, 1, 1, d]
-            bv2 = stacked[f"context{sfx}_bv"][:, None]
-            k_all = jnp.einsum("bsd,lde->lbse", kv, wk) + bk2
-            v_all = jnp.einsum("bsd,lde->lbse", kv, wv) + bv2
+            k_all = cross_proj(kv, stacked[f"context{sfx}_Wk"],
+                               stacked[f"context{sfx}_bk"])
+            v_all = cross_proj(kv, stacked[f"context{sfx}_Wv"],
+                               stacked[f"context{sfx}_bv"])
             ts = kv.shape[1]
             state[f"stack_cross_kc{sfx}"] = k_all.reshape(
                 -1, b, ts, h, dh).transpose(0, 1, 3, 2, 4)

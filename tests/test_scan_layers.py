@@ -117,3 +117,43 @@ def test_alignment_path_falls_back(rng):
         b["src_mask"], b["trg_ids"], b["trg_mask"], train=False,
         return_alignment=True)
     assert align is not None and align.shape == (2, 6, 5)
+
+
+def test_int8_decode_scans_and_matches_unrolled(rng):
+    """Int8 (QTensor) decoder weights stack as pytrees, so the scanned
+    decode step applies to quantized models too — and must match the
+    unrolled int8 path exactly (same int8 kernels per layer)."""
+    from marian_tpu.ops.quantization import quantize_params, wrap_quantized
+    from marian_tpu.models import transformer as T
+    v = 31
+    m_on = create_model(_opts(**{"scan-layers": True}), v, v,
+                        inference=True)
+    m_off = create_model(_opts(**{"scan-layers": False}), v, v,
+                         inference=True)
+    params = m_on.init(jax.random.key(2))
+    qp = wrap_quantized({k: jnp.asarray(a) for k, a in
+                         quantize_params({k: np.asarray(x)
+                                          for k, x in params.items()}
+                                         ).items()})
+    src = jnp.asarray(np.random.RandomState(0).randint(2, v, (2, 5)),
+                      jnp.int32)
+    mask = jnp.ones((2, 5), jnp.float32)
+    trg = jnp.asarray(np.random.RandomState(1).randint(2, v, (2, 4)),
+                      jnp.int32)
+
+    def roll(model):
+        enc = model.encode_for_decode(qp, src, mask)
+        state = model.start_state(qp, enc, mask, max_len=4)
+        prev = jnp.zeros((2, 1), jnp.int32)
+        outs = []
+        for t in range(4):
+            logits, state = model.step(qp, state, prev, mask)
+            outs.append(np.asarray(logits))
+            prev = trg[:, t:t + 1]
+        return state, np.stack(outs)
+
+    st_on, out_on = roll(m_on)
+    st_off, out_off = roll(m_off)
+    assert "stack_self_k" in st_on          # scan actually engaged
+    assert "l1_self_k" in st_off
+    np.testing.assert_allclose(out_on, out_off, rtol=2e-4, atol=2e-4)
