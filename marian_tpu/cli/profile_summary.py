@@ -1,0 +1,83 @@
+"""Summarize a jax.profiler trace directory without TensorBoard.
+
+``python -m marian_tpu.cli.profile_summary <trace_dir> [top_n]``
+
+Reads the Chrome-trace JSON (``*.trace.json.gz``) that
+``jax.profiler.start_trace`` / ``--profile`` writes, aggregates device-op
+durations by name, and prints the top-N ops with total/mean time and the
+share of the profiled window — enough to answer "is the step matmul-bound,
+attention-bound, or host-gap-bound" on a machine with no TensorBoard
+(SURVEY §5 row 1; the reference's equivalent workflow is nvprof output).
+"""
+
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def _find_traces(root: str):
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f.endswith(".trace.json.gz") or f.endswith(".trace.json"):
+                yield os.path.join(dirpath, f)
+
+
+def summarize(trace_dir: str, top_n: int = 25) -> int:
+    paths = sorted(_find_traces(trace_dir))
+    if not paths:
+        print(f"no *.trace.json[.gz] under {trace_dir} — run with "
+              f"--profile first", file=sys.stderr)
+        return 1
+    by_name = defaultdict(lambda: [0.0, 0])      # name -> [total_us, count]
+    pid_names = {}
+    t_min, t_max = float("inf"), 0.0
+    for path in paths:
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rt") as fh:
+            data = json.load(fh)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_names[ev.get("pid")] = ev.get("args", {}).get("name", "")
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            # keep device lanes; drop python/host-runtime lanes whose
+            # spans nest and would double-count
+            pname = pid_names.get(ev.get("pid"), "")
+            if "python" in pname.lower():
+                continue
+            name = ev.get("name", "?")
+            # python source frames ('$file.py:123 fn') nest arbitrarily —
+            # XLA device ops never carry the '$'-prefixed source form
+            if name.startswith("$") or " _find_and_load" in name:
+                continue
+            by_name[name][0] += float(ev["dur"])
+            by_name[name][1] += 1
+            ts = float(ev.get("ts", 0.0))
+            t_min = min(t_min, ts)
+            t_max = max(t_max, ts + float(ev["dur"]))
+    window_us = max(t_max - t_min, 1e-9)
+    rows = sorted(by_name.items(), key=lambda kv: -kv[1][0])[:top_n]
+    total_us = sum(v[0] for v in by_name.values())
+    print(f"profiled window ≈ {window_us/1e3:.1f} ms, "
+          f"{len(by_name)} distinct ops, "
+          f"Σop time {total_us/1e3:.1f} ms (overlap counts twice)")
+    print(f"{'total ms':>10} {'mean us':>9} {'count':>7} "
+          f"{'%Σ':>6}  op")
+    for name, (tot, cnt) in rows:
+        print(f"{tot/1e3:10.2f} {tot/cnt:9.1f} {cnt:7d} "
+              f"{100*tot/total_us:6.2f}  {name[:90]}")
+    return 0
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__, file=sys.stderr)
+        raise SystemExit(2)
+    top = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    raise SystemExit(summarize(sys.argv[1], top))
+
+
+if __name__ == "__main__":
+    main()

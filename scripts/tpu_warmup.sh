@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Warm the persistent XLA compilation cache (.cache/xla) on the real TPU so
+# the driver's end-of-round bench (and any CLI restart) skips the ~8-minute
+# per-shape compiles over the axon tunnel. Run whenever the tunnel is up:
+#
+#     bash scripts/tpu_warmup.sh [logdir]
+#
+# Sequence: tunnel liveness probe (fails fast) → train bench (compiles the
+# fused train step for every bench shape + fused-CE A/B variants) → decode
+# bench (beam-6 float + int8) → driver entry compile-check.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/tpu_warmup}"
+mkdir -p "$LOG"
+
+echo "== probe =="
+timeout 120 python -c "import jax; print(jax.devices())" || {
+    echo "tunnel down — nothing to warm"; exit 3; }
+
+echo "== train bench (writes $LOG/bench.json) =="
+python bench.py >"$LOG/bench.json" 2>"$LOG/bench.err"
+echo "rc=$? $(cat "$LOG/bench.json" 2>/dev/null)"
+
+echo "== decode bench =="
+python bench_decode.py >"$LOG/bench_decode.json" 2>"$LOG/bench_decode.err"
+echo "rc=$? $(cat "$LOG/bench_decode.json" 2>/dev/null)"
+MARIAN_DECBENCH_INT8=1 python bench_decode.py \
+    >"$LOG/bench_decode_int8.json" 2>>"$LOG/bench_decode.err"
+echo "rc=$? $(cat "$LOG/bench_decode_int8.json" 2>/dev/null)"
+
+echo "== driver entry compile =="
+python - <<'PY'
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+print("entry loss:", float(jax.jit(fn)(*args)))
+PY
+echo "warmup done; cache entries: $(ls .cache/xla 2>/dev/null | wc -l)"
