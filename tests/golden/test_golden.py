@@ -95,6 +95,11 @@ CONFIGS = {
         "transformer-dim-ffn": 64, "dec-depth": 2,
         "tied-embeddings-all": True,
     },
+    "multi-s2s": {
+        "type": "multi-s2s", "dim-emb": 24, "dim-rnn": 32,
+        "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
+        "dec-cell": "gru", "tied-embeddings": True,
+    },
     "moe-transformer": {
         "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
         "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
@@ -107,7 +112,7 @@ CONFIGS = {
 def _streams(name):
     src = str(DATA / "train.src")
     trg = str(DATA / "train.trg")
-    if name == "multi-source":
+    if name in ("multi-source", "multi-s2s"):
         return [src, src, trg]          # doc-context style: 2 source streams
     if name == "char-s2s":
         return [str(DATA / "train.char.src"), str(DATA / "train.char.trg")]

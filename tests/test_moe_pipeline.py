@@ -153,13 +153,14 @@ class TestStackRoundTrip:
 class TestShardedEquivalence:
     """8-virtual-CPU-device mesh (conftest) equivalences."""
 
-    def _loss_after(self, o, batch, steps=2):
+    def _loss_after(self, o, batch, steps=2, micro=False):
         model = create_model(o, 64, 64)
         gg = GraphGroup(model, o)
         gg.initialize(prng.root_key(7))
         out = None
         for s in range(steps):
-            out = gg.update(dict(batch), s + 1, jax.random.key(3 + s))
+            payload = [dict(b) for b in batch] if micro else dict(batch)
+            out = gg.update(payload, s + 1, jax.random.key(3 + s))
         return float(out.loss_sum), gg
 
     def test_pipe_matches_single(self, rng):
@@ -196,6 +197,21 @@ class TestShardedEquivalence:
         opt = np.load(path + ".optimizer.npz")
         assert any(":encoder_l2_" in k or k.startswith("m:encoder_l2_")
                    for k in opt.files)
+
+    def test_pipe_with_fused_delay(self, rng):
+        """Depth-stacked storage composes with the in-jit --optimizer-delay
+        micro-batch scan (stacked params inside the delay scan body)."""
+        b = _batch(rng)
+        b2 = {k: jnp.roll(v, 1, axis=0) for k, v in b.items()}
+        single, _ = self._loss_after(
+            _opts(n=1, **{"optimizer-delay": 2}), [dict(b), dict(b2)],
+            steps=1, micro=True)
+        piped, gg = self._loss_after(
+            _opts(mesh=["data:2", "model:2", "pipe:2"], n=8,
+                  **{"optimizer-delay": 2}), [dict(b), dict(b2)],
+            steps=1, micro=True)
+        assert gg._stacked and gg._fused_delay is not None
+        assert abs(single - piped) / abs(single) < 1e-5
 
     def test_pipe_refuses_tied_layers(self):
         o = _opts(mesh=["data:2", "model:2", "pipe:2"], n=8,
