@@ -1,0 +1,86 @@
+"""Analytic FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference reports raw words/s only; on TPU a throughput number is
+uninterpretable without knowing how far it sits from the chip's matmul
+ceiling (is a 2.3x gap MXU idle time, or is the target near roofline for
+this chip generation?). This module prices a transformer train step in
+matmul FLOPs from the batch shapes, and maps ``device_kind`` strings to
+published peak bf16 FLOPs so bench.py can report ``mfu`` next to
+``vs_baseline`` (VERDICT r2 missing-item #5).
+
+Conventions (PaLM-appendix style "model FLOPs"):
+- only matmul work is counted (elementwise/softmax/norms are HBM-bound
+  noise on the MXU);
+- a matmul [m,k]x[k,n] costs 2*m*k*n;
+- token counts are REAL (mask-counted) tokens — padding rows burn MXU
+  cycles but do no useful work, so they lower MFU, which is the point;
+- attention-score terms use the PADDED sequence width: each real token
+  genuinely attends over the padded row on the device;
+- causal self-attention is priced at full width (the kernels compute
+  full blocks; no causal-sparsity discount);
+- train = 3x forward (activation grads + weight grads each replay every
+  forward matmul once).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def transformer_train_flops(emb: int, ffn: int, enc_depth: int,
+                            dec_depth: int, vocab: int,
+                            src_tokens: float, trg_tokens: float,
+                            src_width: int, trg_width: int) -> float:
+    """Matmul FLOPs for ONE training step (fwd+bwd) of an encoder-decoder
+    transformer on a batch with the given real token counts and padded
+    widths. Tied embeddings are assumed (the output projection is the
+    only embedding matmul priced; input embedding is a gather)."""
+    d, f = float(emb), float(ffn)
+    # encoder layer, per src token: QKV+out projections (4 matmuls of
+    # d x d) + FFN (d x f, f x d); scores+values: QK^T and AV, each
+    # 2*width*d per token.
+    enc_tok = 8 * d * d + 4 * d * f + 4 * src_width * d
+    enc = enc_depth * src_tokens * enc_tok
+    # decoder layer: self-attn like the encoder (trg width); cross-attn
+    # Q+out projections per trg token, K+V projections per SRC token
+    # (computed once over encoder output), scores over src width.
+    dec_tok = (8 * d * d + 4 * trg_width * d      # self-attn
+               + 4 * d * d + 4 * src_width * d    # cross-attn Q/out+scores
+               + 4 * d * f)                       # FFN
+    dec_kv = 4 * d * d * src_tokens               # cross K/V per src token
+    dec = dec_depth * (trg_tokens * dec_tok + dec_kv)
+    logits = 2 * d * float(vocab) * trg_tokens
+    return 3.0 * (enc + dec + logits)
+
+
+# Published peak dense bf16 FLOPs/s per JAX DEVICE. On v2/v3 a chip has
+# two TensorCores and jax.devices() lists each core as its own device,
+# so the per-device peak is HALF the published per-chip number; v4
+# onward is megacore (one device per chip). Substring match on jax
+# Device.device_kind; None = unknown generation (mfu is reported as
+# null rather than guessed).
+_PEAK_BF16 = (
+    ("v6 lite", 918e12),   # Trillium / v6e
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),   # v5e
+    ("v5e", 197e12),
+    ("v4 lite", 138e12),   # v4i inference chip
+    ("v4", 275e12),
+    ("v3", 61.5e12),       # 123 TFLOP/chip, 2 cores/chip → per device
+    ("v2", 22.5e12),       # 45 TFLOP/chip, 2 cores/chip → per device
+)
+
+
+def peak_bf16_flops(device_kind: str) -> Optional[float]:
+    """Peak dense bf16 FLOPs/s for ONE jax device of the given
+    ``device_kind``, or None for unrecognized kinds (e.g. the axon
+    tunnel may report a virtual name; CPU always returns None). Matches
+    bench.py's per-device throughput accounting (value / len(devices))."""
+    kind = (device_kind or "").lower()
+    if "tpu" not in kind:
+        return None
+    for tag, peak in _PEAK_BF16:
+        if tag in kind:
+            return peak
+    return None

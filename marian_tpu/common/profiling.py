@@ -24,6 +24,16 @@ from typing import Any, Dict, Optional
 from . import logging as log
 
 
+def default_cache_dir() -> str:
+    """The one place the persistent-cache location is decided: the
+    manifest check MUST look at the same directory the cache writes to,
+    or a drifted manifest silently re-enables cold-compile surprises."""
+    return os.environ.get(
+        "MARIAN_XLA_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".cache", "xla"))
+
+
 def enable_compilation_cache(path: Optional[str] = None) -> None:
     """Point JAX's persistent compilation cache at a repo-local directory so
     repeated invocations (bench reruns, CLI restarts, the driver's
@@ -31,10 +41,7 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
     Safe to call more than once; a cache miss behaves exactly like no cache.
     """
     import jax
-    path = path or os.environ.get(
-        "MARIAN_XLA_CACHE",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), ".cache", "xla"))
+    path = path or default_cache_dir()
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
@@ -42,6 +49,75 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception as e:  # noqa: BLE001 — cache is an optimization only
         log.warn("persistent compilation cache unavailable: {}", e)
+
+
+def _cache_fingerprint() -> Dict[str, str]:
+    """Identity of the compiler stack the persistent cache was warmed
+    against. A libtpu/jax version bump (the round-2 outage cause) or a
+    different chip generation invalidates every entry silently — XLA just
+    misses and recompiles, turning a warm 30s bench into a cold 20-40min
+    one over the tunnel."""
+    import jax
+    fp = {"jax": jax.version.__version__}
+    try:
+        import jaxlib.version
+        fp["jaxlib"] = jaxlib.version.__version__
+    except Exception:  # noqa: BLE001
+        fp["jaxlib"] = "?"
+    try:
+        import jax.extend.backend as eb
+        backend = eb.get_backend()
+        fp["platform"] = backend.platform
+        fp["platform_version"] = str(
+            getattr(backend, "platform_version", "?"))
+        devs = jax.devices()
+        fp["device_kind"] = devs[0].device_kind if devs else "?"
+    except Exception as e:  # noqa: BLE001
+        fp["platform"] = f"unavailable: {e}"
+    return fp
+
+
+def check_cache_manifest(write: bool = False,
+                         path: Optional[str] = None) -> bool:
+    """Compare the live compiler-stack fingerprint against
+    ``.cache/xla/MANIFEST.json``. Returns True when the warmed cache is
+    trustworthy (fingerprints match, or ``write=True`` just stamped a
+    fresh manifest). On mismatch: logs loudly and returns False so
+    callers can drop optional double-compile work (bench.py skips the
+    fused-CE A/B — VERDICT r2 next-step #6). Requires backends to be
+    initialized (call after watchdog_devices)."""
+    import json
+
+    cache_dir = path or default_cache_dir()
+    manifest_p = os.path.join(cache_dir, "MANIFEST.json")
+    fp = _cache_fingerprint()
+    if write:
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            with open(manifest_p, "w") as fh:
+                json.dump(fp, fh, indent=1)
+        except OSError as e:
+            log.warn("cache manifest write failed: {}", e)
+        return True
+    try:
+        with open(manifest_p) as fh:
+            stamped = json.load(fh)
+    except (OSError, ValueError):
+        log.warn("no cache manifest at {} — treating the {} -entry cache "
+                 "as cold (compiles may take minutes over the tunnel)",
+                 manifest_p,
+                 len(os.listdir(cache_dir)) if os.path.isdir(cache_dir)
+                 else 0)
+        return False
+    drift = {k: (stamped.get(k), v) for k, v in fp.items()
+             if stamped.get(k) != v}
+    if drift:
+        log.warn("XLA cache manifest MISMATCH (cache warmed on a "
+                 "different stack — every entry will silently miss): {}",
+                 "; ".join(f"{k}: cached={a!r} live={b!r}"
+                           for k, (a, b) in drift.items()))
+        return False
+    return True
 
 
 class TraceWindow:

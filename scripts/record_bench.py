@@ -1,0 +1,65 @@
+"""Record a bench result into the repo's committed artifact files.
+
+``python scripts/record_bench.py <stage> <result.json>``
+
+Appends the result (stamped with UTC time + stage) to BENCH_HISTORY.jsonl
+and regenerates BENCH_SELF.json as the latest result per metric — the
+at-a-glance artifact the judge reads, while the history keeps every run
+(A/Bs, word-budget sweeps, bucket-table comparisons) for the perf
+narrative. Called by scripts/bench_when_up.sh after every ladder stage so
+a tunnel drop between stages never loses a landed number.
+"""
+
+import datetime
+import json
+import os
+import sys
+
+
+def main():
+    stage, path = sys.argv[1], sys.argv[2]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(path) as fh:
+        text = fh.read().strip()
+    if not text:
+        print(f"record_bench: {path} empty — nothing to record",
+              file=sys.stderr)
+        return 1
+    # the bench prints exactly one JSON line; tolerate stray stderr mixed in
+    result = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except ValueError:
+                continue
+    if result is None or "metric" not in result:
+        print(f"record_bench: no metric JSON in {path}", file=sys.stderr)
+        return 1
+    result["stage"] = stage
+    result["ts"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    hist = os.path.join(root, "BENCH_HISTORY.jsonl")
+    with open(hist, "a") as fh:
+        fh.write(json.dumps(result) + "\n")
+    # latest result per (metric, stage-qualifier) — the sweep stages keep
+    # their own rows so BENCH_SELF.json shows the headline AND the A/Bs
+    latest = {}
+    with open(hist) as fh:
+        for line in fh:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            latest[(r.get("metric"), r.get("stage"))] = r
+    with open(os.path.join(root, "BENCH_SELF.json"), "w") as fh:
+        json.dump(sorted(latest.values(), key=lambda r: r.get("ts", "")),
+                  fh, indent=1)
+    print(f"record_bench: {stage} → {result.get('metric')}="
+          f"{result.get('value')} {result.get('unit')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

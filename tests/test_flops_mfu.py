@@ -1,0 +1,91 @@
+"""MFU accounting (common/flops.py) + XLA-cache manifest hardening
+(profiling.check_cache_manifest) — VERDICT r2 next-steps #3 and #6."""
+
+import json
+import os
+
+from marian_tpu.common.flops import peak_bf16_flops, transformer_train_flops
+
+
+class TestPeakTable:
+    def test_known_generations(self):
+        assert peak_bf16_flops("TPU v4") == 275e12
+        assert peak_bf16_flops("TPU v5 lite") == 197e12
+        assert peak_bf16_flops("TPU v5p") == 459e12
+        assert peak_bf16_flops("TPU v6 lite") == 918e12
+        assert peak_bf16_flops("TPU v3") == 123e12
+
+    def test_v4_lite_not_confused_with_v4(self):
+        assert peak_bf16_flops("TPU v4 lite") == 138e12
+
+    def test_unknown_returns_none(self):
+        assert peak_bf16_flops("cpu") is None
+        assert peak_bf16_flops("TPU v99") is None
+        assert peak_bf16_flops("") is None
+
+
+class TestTrainFlops:
+    dims = dict(emb=512, ffn=2048, enc_depth=6, dec_depth=6, vocab=32000)
+
+    def _f(self, **kw):
+        a = dict(self.dims, src_tokens=1000, trg_tokens=1000,
+                 src_width=64, trg_width=64)
+        a.update(kw)
+        return transformer_train_flops(**a)
+
+    def test_magnitude_vs_6n_rule(self):
+        """The 6·N·tokens rule of thumb (N = matmul params incl. the tied
+        output projection) should agree within ~25% at short widths where
+        attention-score terms are small."""
+        d, f, L, V = 512, 2048, 6, 32000
+        n_enc = L * (4 * d * d + 2 * d * f)
+        n_dec = L * (8 * d * d + 2 * d * f)
+        n_out = d * V
+        approx = 6 * (1000 * n_enc + 1000 * (n_dec + n_out))
+        exact = self._f()
+        assert 0.75 < exact / approx < 1.25
+
+    def test_attention_term_scales_with_width(self):
+        """Same token counts, wider padding → more score FLOPs (each real
+        token attends over the padded row). At 32k vocab the logits term
+        dominates, so 64→512 widths add ~13%, not 8× — the check is that
+        the attention term exists and is the right order, not that it
+        dominates."""
+        assert self._f(src_width=512, trg_width=512) > 1.10 * self._f()
+        # with a small vocab the width term is clearly visible
+        small = dict(vocab=1000)
+        assert self._f(src_width=512, trg_width=512, **small) \
+            > 1.15 * self._f(**small)
+
+    def test_linear_in_tokens(self):
+        one = self._f()
+        two = self._f(src_tokens=2000, trg_tokens=2000)
+        assert abs(two / one - 2.0) < 1e-6
+
+    def test_deeper_costs_more(self):
+        assert self._f(enc_depth=12) > self._f() > self._f(enc_depth=3)
+
+
+class TestCacheManifest:
+    def test_write_then_check_roundtrip(self, tmp_path):
+        from marian_tpu.common.profiling import check_cache_manifest
+        p = str(tmp_path)
+        assert check_cache_manifest(write=True, path=p) is True
+        assert os.path.exists(os.path.join(p, "MANIFEST.json"))
+        assert check_cache_manifest(path=p) is True
+
+    def test_missing_manifest_is_cold(self, tmp_path):
+        from marian_tpu.common.profiling import check_cache_manifest
+        assert check_cache_manifest(path=str(tmp_path / "nope")) is False
+
+    def test_drift_detected(self, tmp_path):
+        from marian_tpu.common.profiling import check_cache_manifest
+        p = str(tmp_path)
+        check_cache_manifest(write=True, path=p)
+        mp = os.path.join(p, "MANIFEST.json")
+        with open(mp) as fh:
+            fp = json.load(fh)
+        fp["platform_version"] = "libtpu-from-another-era"
+        with open(mp, "w") as fh:
+            json.dump(fp, fh)
+        assert check_cache_manifest(path=p) is False

@@ -1,0 +1,50 @@
+"""SentencePiece end-to-end train+decode (VERDICT r2 next-step #9).
+
+The ``sentencepiece`` pip package is ABSENT from this image (verified
+2026-07-30: ``pip install`` is disallowed and the wheel is not baked in),
+so the real-data config-#1 path (SPM vocab → corpus → train → decode)
+cannot be exercised here. This test is the explicit, driver-visible skip
+marker the verdict asked for: it runs the full pipeline the moment the
+package appears in the image, and until then reports exactly one SKIPPED
+with the reason, instead of the gap being invisible.
+
+Reference: src/data/sentencepiece_vocab.cpp :: SentencePieceVocab
+(train-on-the-fly via --sentencepiece-options, encode/decode round trip).
+"""
+
+import os
+import tempfile
+
+import pytest
+
+spm = pytest.importorskip(
+    "sentencepiece",
+    reason="sentencepiece package not in this image (pip install "
+    "disallowed) — SPM e2e path gated off; marian_tpu/data/spm_vocab.py "
+    "raises an actionable error at use. Unskips automatically when the "
+    "image ships the wheel.")
+
+
+def test_spm_train_encode_decode_roundtrip():
+    """Train a tiny SPM model on-the-fly (the --sentencepiece-options
+    path), then round-trip text through SentencePieceVocab."""
+    from marian_tpu.common.options import Options
+    from marian_tpu.data.spm_vocab import SentencePieceVocab
+
+    lines = ["the quick brown fox jumps over the lazy dog",
+             "pack my box with five dozen liquor jugs",
+             "how vexingly quick daft zebras jump"] * 40
+    with tempfile.TemporaryDirectory() as tmp:
+        corpus = os.path.join(tmp, "train.txt")
+        with open(corpus, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        model = os.path.join(tmp, "vocab.spm")
+        opts = Options({"dim-vocabs": [64],
+                        "sentencepiece-max-lines": 1000})
+        # missing model path + train_paths → trains on the fly (the
+        # reference's first-run marian-train behavior)
+        vocab = SentencePieceVocab(model, opts, train_paths=[corpus])
+        assert os.path.exists(model)
+        ids = vocab.encode("the quick brown fox")
+        assert len(ids) > 0
+        assert vocab.decode(ids).replace(" ", "") == "thequickbrownfox"
