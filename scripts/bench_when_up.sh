@@ -110,7 +110,13 @@ ladder() {
 
 while :; do
     if probe; then
-        ladder && [ "$ONCE" = 1 ] && exit 0
+        if ladder; then
+            [ "$ONCE" = 1 ] && exit 0
+            # full ladder landed — re-run only every ~3h to pick up code
+            # improvements without thrashing the chip all round
+            sleep 10800
+            continue
+        fi
     else
         echo "$(date -u +%H:%M:%SZ) tunnel down — next probe in ${INTERVAL}s"
     fi
