@@ -159,6 +159,8 @@ def main():
         "value": round(sents / dt, 2),
         "unit": "sent/sec",
         "vs_baseline": None,
+        "chip": jax.devices()[0].device_kind,
+        "preset": preset,
     }))
 
 

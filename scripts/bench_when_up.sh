@@ -31,7 +31,17 @@ LOCK=/tmp/marian_bench_when_up.lock
 exec 9>"$LOCK"
 flock -n 9 || { echo "bench_when_up: another instance holds $LOCK"; exit 1; }
 
+# ALLOW_CPU=1: ladder dry-run on the CPU backend with tiny presets — used
+# to shake out harness bugs BEFORE a scarce tunnel-up window is spent on
+# them. Artifacts still flow through record_bench + git, tagged by the
+# preset in the result row.
 probe() {
+    if [ "${ALLOW_CPU:-}" = 1 ]; then
+        JAX_PLATFORMS=cpu timeout 150 python -c \
+            "from marian_tpu.common.hermetic import force_cpu_devices; \
+             force_cpu_devices(1); print('cpu dry-run')"
+        return $?
+    fi
     timeout 150 python - <<'PY' 2>/dev/null
 from marian_tpu.common.hermetic import watchdog_devices
 watchdog_devices(timeout_s=120, label="probe")
@@ -42,8 +52,14 @@ PY
 }
 
 commit_artifacts() {  # $1 = message
-    git add -A BENCH_SELF.json BENCH_HISTORY.jsonl BENCH_PARTIAL.json \
-        docs/tpu_profile_r03.txt 2>/dev/null
+    # add each artifact individually: `git add a missing` aborts WHOLESALE
+    # on the unmatched pathspec, staging nothing (this silently dropped
+    # every pre-profile stage commit in the first dry-run)
+    local f
+    for f in BENCH_SELF.json BENCH_HISTORY.jsonl BENCH_PARTIAL.json \
+             docs/tpu_profile_r03.txt; do
+        [ -e "$f" ] && git add "$f"
+    done
     git diff --cached --quiet || git commit -q -m "$1"
 }
 
@@ -53,7 +69,7 @@ stage() {  # $1 = name, $2 = timeout_s, rest = env assignments
     echo "== stage $name =="
     if env "$@" timeout "$tmo" python bench.py >"$out" 2>"$out.err"; then
         python scripts/record_bench.py "$name" "$out"
-        commit_artifacts "bench: $name result (TPU, bench_when_up)"
+        commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
         return 0
     fi
     echo "stage $name failed rc=$? — $(tail -2 "$out.err" 2>/dev/null)"
@@ -67,7 +83,7 @@ stage_decode() {  # $1 = name, rest = env assignments
     echo "== stage $name =="
     if env "$@" timeout 3600 python bench_decode.py >"$out" 2>"$out.err"; then
         python scripts/record_bench.py "$name" "$out"
-        commit_artifacts "bench: $name result (TPU, bench_when_up)"
+        commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
         return 0
     fi
     echo "stage $name failed rc=$? — $(tail -2 "$out.err" 2>/dev/null)"
@@ -76,23 +92,33 @@ stage_decode() {  # $1 = name, rest = env assignments
 
 ladder() {
     export MARIAN_BENCH_PARTIAL=BENCH_PARTIAL.json
+    local PRESET=big WORDS_AB=16384
+    BACKEND_TAG=TPU
+    if [ "${ALLOW_CPU:-}" = 1 ]; then
+        PRESET=tiny
+        WORDS_AB=1024
+        BACKEND_TAG=CPU-dryrun
+        export JAX_PLATFORMS=cpu
+    fi
     # 1 — the one number that matters; generous timeout for cold compiles
-    stage train 5400 MARIAN_BENCH_PRESET=big || return 1
+    stage train 5400 MARIAN_BENCH_PRESET=$PRESET || return 1
     # 2 — decode family
-    stage_decode decode_float   MARIAN_DECBENCH_PRESET=big
-    stage_decode decode_int8    MARIAN_DECBENCH_PRESET=big \
+    stage_decode decode_float   MARIAN_DECBENCH_PRESET=$PRESET
+    stage_decode decode_int8    MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1
-    stage_decode decode_int8_sl MARIAN_DECBENCH_PRESET=big \
+    stage_decode decode_int8_sl MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1 \
                                 MARIAN_DECBENCH_SHORTLIST=1
     # 3/4 — train A/Bs (cache already warm for the base shapes)
-    stage scan_off   5400 MARIAN_BENCH_SCAN=off
-    stage words_16k  5400 MARIAN_BENCH_WORDS=16384
+    stage scan_off   5400 MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_SCAN=off
+    stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_WORDS=$WORDS_AB
     # 5 — profile-directed trace, summarized to a committed text artifact
     # (summarize into a temp file first: a failed/empty summary must not
     # truncate-and-commit over a previous good one)
     local ptmp=/tmp/tpu_trace_$$ psum=/tmp/tpu_trace_summary_$$
-    if MARIAN_BENCH_PROFILE=$ptmp timeout 3600 python bench.py \
+    if MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_PROFILE=$ptmp \
+            timeout 3600 python bench.py \
             >/tmp/prof_bench.json 2>/tmp/prof_bench.err; then
         if python -m marian_tpu.cli.profile_summary "$ptmp" 40 >"$psum" \
                 && [ -s "$psum" ]; then
@@ -104,7 +130,8 @@ ladder() {
         fi
     fi
     # 6 — padding tax at the full bucket table (many cold compiles: last)
-    stage buckets_full 7200 MARIAN_BENCH_BUCKETS=full
+    stage buckets_full 7200 MARIAN_BENCH_PRESET=$PRESET \
+                            MARIAN_BENCH_BUCKETS=full
     return 0
 }
 

@@ -13,7 +13,10 @@ class TestPeakTable:
         assert peak_bf16_flops("TPU v5 lite") == 197e12
         assert peak_bf16_flops("TPU v5p") == 459e12
         assert peak_bf16_flops("TPU v6 lite") == 918e12
-        assert peak_bf16_flops("TPU v3") == 123e12
+        # v2/v3: jax lists each of the chip's 2 TensorCores as a device,
+        # so the table carries PER-DEVICE peaks (half the per-chip number)
+        assert peak_bf16_flops("TPU v3") == 61.5e12
+        assert peak_bf16_flops("TPU v2") == 22.5e12
 
     def test_v4_lite_not_confused_with_v4(self):
         assert peak_bf16_flops("TPU v4 lite") == 138e12
