@@ -1,11 +1,20 @@
 """Checkpoint bundle: model.npz (+ embedded config), model.npz.optimizer.npz,
 model.npz.progress.yml (reference layout: SURVEY.md §5 checkpoint/resume row;
-src/training/training.h restore logic + OptimizerBase::save/load)."""
+src/training/training.h restore logic + OptimizerBase::save/load).
+
+``--async-save`` (beyond the reference — Train::save blocks the update
+loop while serializing): AsyncSaver overlaps the checkpoint write with
+training. The training thread only makes device-side copies of every
+leaf (safe against the next update's buffer donation) and kicks off
+their async device→host transfers; numpy conversion and all disk writes
+happen on one background worker thread. Saves are serialized and
+``wait()`` flushes the in-flight write (called before exit, SIGTERM
+save, and anything that re-reads the files)."""
 
 from __future__ import annotations
 
-import dataclasses
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -16,26 +25,135 @@ from ..common import logging as log
 from .training_state import TrainingState
 
 
+class AsyncSaver:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ckpt-save")
+        self._inflight = None
+
+    def snapshot(self, tree: Optional[Dict[str, Any]]
+                 ) -> Optional[Dict[str, Any]]:
+        """Device-side copy of every jax leaf + async host transfer kick.
+        MUST run on the training thread BEFORE the next update is
+        dispatched: the copy decouples the snapshot from buffers the
+        jitted step will donate; copy_to_host_async overlaps the
+        device→host fetch with subsequent training steps."""
+        if tree is None:
+            return None
+        import jax.numpy as jnp
+        out: Dict[str, Any] = {}
+        for k, v in tree.items():
+            if isinstance(v, jax.Array):
+                c = jnp.copy(v)
+                try:
+                    c.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — transfer is a hint only
+                    pass
+                out[k] = c
+            else:
+                out[k] = v
+        return out
+
+    def submit(self, fn) -> None:
+        """Queue one save; serialized with any in-flight one (bounded
+        memory: at most one snapshot waiting + one being written)."""
+        self.wait()
+        self._inflight = self._pool.submit(fn)
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) is fully on disk;
+        re-raises a failed save's exception on the training thread so a
+        disk-full checkpoint is a loud error, not a silent gap."""
+        if self._inflight is not None:
+            try:
+                self._inflight.result()
+            finally:
+                self._inflight = None
+
+
+def _suffixed_path(model_path: str, suffix: str) -> str:
+    if model_path.endswith((".npz", ".bin")):
+        base, ext = os.path.splitext(model_path)
+        return base + suffix + ext
+    return model_path + suffix + ".npz"
+
+
 def save_checkpoint(model_path: str, params: Dict[str, Any], config_yaml: str,
                     graph_group=None, state: Optional[TrainingState] = None,
                     smooth_params: Optional[Dict[str, Any]] = None,
                     overwrite_checkpoint: bool = True,
-                    suffix: str = "") -> None:
+                    suffix: str = "",
+                    async_saver: Optional[AsyncSaver] = None,
+                    extra_model_suffixes: Tuple[str, ...] = ()) -> None:
     """Save model (+optimizer +progress). `suffix` e.g. '.best-bleu' for
-    per-metric best checkpoints (reference: validator keep-best files)."""
-    path = model_path + suffix + (".npz" if not model_path.endswith((".npz", ".bin")) else "")
-    if model_path.endswith((".npz", ".bin")):
-        base, ext = os.path.splitext(model_path)
-        path = base + suffix + ext
-    host_params = {k: np.asarray(v) for k, v in params.items()}
+    per-metric best checkpoints (reference: validator keep-best files).
+    ``extra_model_suffixes`` writes additional params+config copies (the
+    no-``--overwrite`` '.iterN' files) inside the SAME write unit — one
+    snapshot, one worker submission, instead of a second save that would
+    stall behind the first.
+
+    With ``async_saver`` the disk writes overlap training (--async-save);
+    the on-disk result is bitwise-identical to the synchronous path.
+    Device-memory note: the snapshot transiently holds ONE device copy of
+    params (+EMA +optimizer state) until the worker has fetched each leaf
+    — configs sized near HBM capacity should keep the synchronous
+    default (flag help documents this)."""
+    path = _suffixed_path(model_path, suffix)
+    extra_paths = tuple(_suffixed_path(model_path, s)
+                        for s in extra_model_suffixes)
+
+    if async_saver is not None:
+        params = async_saver.snapshot(params)
+        smooth_params = async_saver.snapshot(smooth_params)
+        opt_flat = (async_saver.snapshot(graph_group.optimizer_device_arrays())
+                    if graph_group is not None and not suffix else None)
+        # progress is tiny host data, but the *object* (incl. nested
+        # validator dicts) keeps mutating on the training thread —
+        # freeze a deep copy now
+        import copy
+        state = copy.deepcopy(state) if state is not None else None
+
+        def _write():
+            _write_checkpoint(path, params, config_yaml, smooth_params,
+                              opt_flat, state, suffix, extra_paths,
+                              consume=True)
+        async_saver.submit(_write)
+        return
+
+    opt_flat = (graph_group.optimizer_device_arrays()
+                if graph_group is not None and not suffix else None)
+    _write_checkpoint(path, params, config_yaml, smooth_params, opt_flat,
+                      state, suffix, extra_paths)
+
+
+def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
+                      smooth_params: Optional[Dict[str, Any]],
+                      opt_flat: Optional[Dict[str, Any]],
+                      state: Optional[TrainingState], suffix: str,
+                      extra_paths: Tuple[str, ...] = (),
+                      consume: bool = False) -> None:
+    # consume=True (async path only — the dicts are worker-owned
+    # snapshots): np.asarray + pop releases each device-side snapshot
+    # copy as soon as the host has the bytes, bounding the transient HBM
+    # cost of --async-save to the tail of un-fetched leaves. The sync
+    # path must NOT consume: export_params() can return the live
+    # gg.params dict itself.
+    def fetch(tree):
+        if consume:
+            return {k: np.asarray(tree.pop(k)) for k in list(tree)}
+        return {k: np.asarray(v) for k, v in tree.items()}
+
+    host_params = fetch(params)
     mio.save_model(path, host_params, config_yaml)
+    for p in extra_paths:
+        mio.save_model(p, host_params, config_yaml)
+        log.info("Saved model to {}", p)
     if smooth_params is not None:
         base, ext = os.path.splitext(path)
-        mio.save_model(base + ".ema" + ext,
-                       {k: np.asarray(v) for k, v in smooth_params.items()},
+        mio.save_model(base + ".ema" + ext, fetch(smooth_params),
                        config_yaml)
-    if graph_group is not None and not suffix:
-        np.savez(path + ".optimizer.npz", **graph_group.optimizer_arrays())
+    if opt_flat is not None and not suffix:
+        np.savez(path + ".optimizer.npz", **fetch(opt_flat))
     if state is not None and not suffix:
         state.save(path + ".progress.yml")
     log.info("Saved model to {}", path)

@@ -318,16 +318,23 @@ class GraphGroup:
         return self._unstack(self.params)
 
     # -- checkpoint glue -----------------------------------------------------
+    def optimizer_device_arrays(self) -> Dict[str, Any]:
+        """Flat-named optimizer state, still as device arrays (unstacked
+        from any pipeline layout) — the async saver snapshots these and
+        fetches them off-thread."""
+        flat: Dict[str, Any] = {"t": self.opt_state["t"]}
+        for part in ("m", "v", "gt", "avg", "qerr", "gerr"):
+            if part in self.opt_state:
+                for k, v in self._unstack(self.opt_state[part]).items():
+                    flat[f"{part}:{k}"] = v
+        return flat
+
     def optimizer_arrays(self) -> Dict[str, Any]:
         """Gather (device_get) sharded optimizer state for .optimizer.npz —
         the role of the reference's scatterState/gatherState shard IO."""
         import numpy as np
-        flat: Dict[str, Any] = {"t": np.asarray(self.opt_state["t"])}
-        for part in ("m", "v", "gt", "avg", "qerr", "gerr"):
-            if part in self.opt_state:
-                for k, v in self._unstack(self.opt_state[part]).items():
-                    flat[f"{part}:{k}"] = np.asarray(v)
-        return flat
+        return {k: np.asarray(v)
+                for k, v in self.optimizer_device_arrays().items()}
 
     def load_optimizer_arrays(self, flat: Dict[str, Any]) -> None:
         st: Dict[str, Any] = {"t": jnp.asarray(flat["t"])}

@@ -157,21 +157,33 @@ class Train:
         config_yaml = opts.as_yaml()
         delay = gg.delay
 
+        # --async-save: checkpoint writes overlap training (checkpoint.py
+        # AsyncSaver — the training thread only snapshots device buffers)
+        saver = None
+        if opts.get("async-save", False):
+            from .checkpoint import AsyncSaver
+            saver = AsyncSaver()
+
         def do_save(suffix: str = "") -> None:
             state.corpus = (native_bg.state_dict() if native_bg is not None
                             else corpus.state.as_dict())
             smooth = gg.smoothed() if gg.opt_cfg.smoothing > 0 else None
-            exported = gg.export_params()
-            save_checkpoint(model_path, exported, config_yaml,
-                            gg, state, smooth_params=smooth, suffix=suffix)
-            if not suffix and not opts.get("overwrite", False):
-                # without --overwrite, keep an iteration-numbered copy of
-                # every periodic checkpoint (reference: Train::save)
-                save_checkpoint(model_path, exported, config_yaml,
-                                None, None, smooth_params=None,
-                                suffix=f".iter{state.batches}")
+            # without --overwrite, an iteration-numbered copy of every
+            # periodic checkpoint is written in the SAME save unit
+            # (reference: Train::save) — one snapshot, one worker job
+            extra = (f".iter{state.batches}",) \
+                if not suffix and not opts.get("overwrite", False) else ()
+            save_checkpoint(model_path, gg.export_params(), config_yaml,
+                            gg, state, smooth_params=smooth, suffix=suffix,
+                            async_saver=saver,
+                            extra_model_suffixes=extra)
 
         def do_validate() -> None:
+            if saver is not None:
+                # file-reading validators (valid-script) must see the
+                # checkpoint of THIS training moment, not a half-written
+                # or previous-cycle one — flush the in-flight async save
+                saver.wait()
             params = gg.smoothed() if gg.opt_cfg.smoothing > 0 \
                 else gg.export_params()
             for v in validators:
@@ -273,6 +285,8 @@ class Train:
         trace.close()
         log.info("Training finished")
         do_save()
+        if saver is not None:
+            saver.wait()        # final checkpoint must be on disk at exit
 
 
 def _warmup_updates(opts) -> int:
