@@ -326,12 +326,13 @@ class BeamSearch:
             self._jitted[key] = jax.jit(fn, static_argnames=())
         return self._jitted[key]
 
-    def search(self, src_ids, src_mask,
-               shortlist=None, prefix=None) -> List[List[dict]]:
-        """Returns per-sentence n-best lists of dicts
-        {tokens, score, norm_score, alignment}. src_ids/src_mask may be
-        tuples of streams (multi-source). `prefix` [B, P] int32 (pad -1)
-        force-decodes each sentence's target prefix (--force-decode)."""
+    def search_async(self, src_ids, src_mask,
+                     shortlist=None, prefix=None) -> "_SearchHandle":
+        """Dispatch one batch's beam search; returns a handle whose
+        ``collect()`` blocks on the device result and extracts n-bests.
+        src_ids/src_mask may be tuples of streams (multi-source).
+        `prefix` [B, P] int32 (pad -1) force-decodes each sentence's
+        target prefix (--force-decode)."""
         if prefix is not None and shortlist is not None:
             raise ValueError("--force-decode cannot be combined with a "
                              "lexical shortlist (prefix ids are full-vocab)")
@@ -380,13 +381,25 @@ class BeamSearch:
         args = (tuple(self.params_list), _dev(src_ids), _dev(src_mask))
         tokens, scores, lengths, norm_scores, aligns = fn(
             *args, shortlist=sl_idx, sample_key=sample_key, prefix=pfx)
-        return self._collect(np.asarray(tokens), np.asarray(scores),
-                             np.asarray(lengths), np.asarray(norm_scores),
-                             None if aligns is None else np.asarray(aligns),
-                             cfg)
+        # device results stay lazy here — collect() forces them. Callers
+        # that pipeline (translator driver) dispatch the NEXT batch's
+        # search before collecting this one, so host n-best extraction
+        # overlaps device beam steps (the role of the reference
+        # translator's worker thread pool, played by XLA async dispatch).
+        return _SearchHandle(tokens, scores, lengths, norm_scores, aligns,
+                             cfg, self)
+
+    def search(self, src_ids, src_mask,
+               shortlist=None, prefix=None) -> List[List[dict]]:
+        """Returns per-sentence n-best lists of dicts
+        {tokens, score, norm_score, alignment}. src_ids/src_mask may be
+        tuples of streams (multi-source). `prefix` [B, P] int32 (pad -1)
+        force-decodes each sentence's target prefix (--force-decode)."""
+        return self.search_async(src_ids, src_mask, shortlist=shortlist,
+                                 prefix=prefix).collect()
 
     def _collect(self, tokens, scores, lengths, norm_scores, aligns,
-                 cfg: BeamConfig) -> List[List[dict]]:
+                 cfg: BeamConfig) -> List[List[dict]]:  # noqa: C901
         b, k, L = tokens.shape
         out = []
         for i in range(b):
@@ -408,3 +421,24 @@ class BeamSearch:
                 nbest.append(entry)
             out.append(nbest)
         return out
+
+
+class _SearchHandle:
+    """Lazy result of one dispatched beam search. Holding it costs one
+    batch's device output buffers; ``collect()`` forces the transfer and
+    runs host n-best extraction. Depth-1 pipelining (dispatch batch i+1,
+    then collect batch i) hides the host extraction of every batch but
+    the last behind device compute."""
+
+    def __init__(self, tokens, scores, lengths, norm_scores, aligns,
+                 cfg, bs: "BeamSearch"):
+        self._dev = (tokens, scores, lengths, norm_scores, aligns)
+        self._cfg = cfg
+        self._bs = bs
+
+    def collect(self) -> List[List[dict]]:
+        tokens, scores, lengths, norm_scores, aligns = self._dev
+        return self._bs._collect(
+            np.asarray(tokens), np.asarray(scores), np.asarray(lengths),
+            np.asarray(norm_scores),
+            None if aligns is None else np.asarray(aligns), self._cfg)

@@ -13,7 +13,7 @@ ThreadPool collapses to a simple loop — the collector still guards ordering.
 from __future__ import annotations
 
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -136,7 +136,23 @@ class Translate:
                 stream = open(out_path, "w", encoding="utf-8")
                 close = True
         collector = OutputCollector(stream)
-        results: List[str] = []
+        by_sid: Dict[int, str] = {}
+        # depth-1 decode pipeline: dispatch batch i+1's (async) beam
+        # search BEFORE collecting batch i, so host n-best extraction +
+        # output writing overlap device beam steps (the reference hides
+        # this host work behind a worker thread pool; XLA async dispatch
+        # plays that role here)
+        pending = None      # (batch, _SearchHandle)
+
+        def _finalize(entry):
+            pbatch, handle = entry
+            nbests = handle.collect()
+            for row in range(pbatch.size):
+                sid = int(pbatch.sentence_ids[row])
+                text = self.printer.line(sid, nbests[row])
+                by_sid[sid] = text
+                collector.write(sid, text)
+
         for batch in bg:
             real = batch.size
             if len(self.src_vocab_list) > 1:
@@ -161,16 +177,19 @@ class Translate:
                     sid = int(batch.sentence_ids[row])
                     pf = self._prefixes[sid]
                     prefix[row, :len(pf)] = pf
-            nbests = self.search.search(src_ids, src_mask,
-                                        shortlist=shortlist, prefix=prefix)
-            for row in range(real):
-                sid = int(batch.sentence_ids[row])
-                text = self.printer.line(sid, nbests[row])
-                collector.write(sid, text)
+            handle = self.search.search_async(src_ids, src_mask,
+                                              shortlist=shortlist,
+                                              prefix=prefix)
+            if pending is not None:
+                _finalize(pending)
+            pending = (batch, handle)
+        if pending is not None:
+            _finalize(pending)
         collector.flush_remaining()
         if close:
             stream.close()
-        return results
+        # corpus order, like the written output (batches are length-sorted)
+        return [by_sid[s] for s in sorted(by_sid)]
 
 
 def translate_main(options) -> None:

@@ -1,0 +1,78 @@
+"""Translator driver with the depth-1 dispatch/collect decode pipeline
+(translator.py — the reference hides host n-best extraction behind a
+worker thread pool, src/translator/translator.h; here XLA async dispatch
+plays that role). Pins output order and equality with the direct
+(unpipelined) BeamSearch path across multiple batches."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.vocab import DefaultVocab
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    import jax
+    from marian_tpu.models.encoder_decoder import create_model
+    from marian_tpu.common import io as mio
+
+    tmp = tmp_path_factory.mktemp("xlate")
+    words = [f"w{i}" for i in range(30)]
+    vocab = DefaultVocab.build([" ".join(words)])
+    vpath = tmp / "v.yml"
+    vocab.save(str(vpath))
+
+    opts = Options({"type": "transformer", "dim-emb": 16,
+                    "transformer-heads": 2, "transformer-dim-ffn": 32,
+                    "enc-depth": 1, "dec-depth": 1,
+                    "tied-embeddings-all": True, "max-length": 16,
+                    "precision": ["float32", "float32"], "seed": 3})
+    model = create_model(opts, len(vocab), len(vocab), inference=True)
+    params = model.init(jax.random.key(3))
+    mpath = tmp / "m.npz"
+    mio.save_model(str(mpath), {k: np.asarray(v) for k, v in params.items()},
+                   opts.as_yaml())
+
+    rng = np.random.RandomState(3)
+    lines = [" ".join(words[i] for i in rng.randint(2, 28, rng.randint(2, 7)))
+             for _ in range(13)]           # 13 lines, mini-batch 4 → 4 batches
+    src = tmp / "in.txt"
+    src.write_text("\n".join(lines) + "\n")
+    return tmp, str(mpath), str(vpath), str(src), lines
+
+
+def _translate(setup, **extra):
+    tmp, mpath, vpath, src, lines = setup
+    from marian_tpu.translator.translator import Translate
+    out = tmp / f"out{len(extra)}.txt"
+    opts = Options({"models": [mpath], "vocabs": [vpath, vpath],
+                    "input": [src], "output": str(out),
+                    "beam-size": 3, "normalize": 0.6, "mini-batch": 4,
+                    "maxi-batch": 2, "max-length": 16,
+                    "max-length-crop": True, **extra})
+    Translate(opts).run()
+    return out.read_text().splitlines()
+
+
+def test_pipeline_outputs_in_input_order_and_match_direct(setup):
+    tmp, mpath, vpath, src, lines = setup
+    got = _translate(setup)
+    assert len(got) == len(lines)
+
+    # reference: the same sentences one-by-one through the UNpipelined
+    # BeamSearch path (batch size 1 would change padding/bucketing, so
+    # reuse the driver with mini-batch large enough for one batch — no
+    # pipelining happens with a single batch)
+    single = _translate(setup, **{"mini-batch": 64, "maxi-batch": 1})
+    assert got == single
+
+
+def test_pipeline_nbest_format(setup):
+    got = _translate(setup, **{"n-best": True})
+    # n-best lines: 'idx ||| text ||| ... Score= x' covering every input
+    idx = [int(line.split("|||")[0]) for line in got]
+    assert set(idx) == set(range(13))
+    assert all("|||" in line for line in got)
