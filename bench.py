@@ -162,6 +162,7 @@ def main():
 
     fused_mode = os.environ.get("MARIAN_BENCH_FUSED", "tune")
 
+    opt_dtype = os.environ.get("MARIAN_BENCH_OPT_DTYPE", "float32")
     scan_env = os.environ.get("MARIAN_BENCH_SCAN")  # on/off A/B knob
     if scan_env:
         scan_env = {"on": "on", "1": "on", "true": "on",
@@ -183,6 +184,7 @@ def main():
         "label-smoothing": 0.1, "cost-type": "ce-mean-words",
         "learn-rate": 2e-4, "lr-warmup": "8000", "lr-decay-inv-sqrt": ["8000"],
         "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
+        "optimizer-state-dtype": opt_dtype,
         "clip-norm": 0.0, "exponential-smoothing": 1e-4,
         "max-length": max_len, "max-length-crop": True,
         "mini-batch": 512, "mini-batch-words": words,
@@ -357,6 +359,7 @@ def main():
         "buckets": bucket_env,
         "fused_ce": fused_mode,
         "scan_layers": scan_env or "default",
+        "opt_state_dtype": opt_dtype,
         "words_budget": words,
     }
     progress.update(phase="done", result=result)

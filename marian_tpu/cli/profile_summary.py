@@ -32,11 +32,18 @@ def summarize(trace_dir: str, top_n: int = 25) -> int:
         return 1
     by_name = defaultdict(lambda: [0.0, 0])      # name -> [total_us, count]
     pid_names = {}
+    # busy/window accounting is PER TRACE FILE (one file per host per
+    # profiling session): a directory holding several sessions must not
+    # union them, or the idle minutes BETWEEN sessions would read as
+    # "host gaps" and fake a host-bound diagnosis
+    per_file = []                                # (window_us, intervals)
     t_min, t_max = float("inf"), 0.0
     for path in paths:
         op = gzip.open if path.endswith(".gz") else open
         with op(path, "rt") as fh:
             data = json.load(fh)
+        intervals = []
+        f_min, f_max = float("inf"), 0.0
         for ev in data.get("traceEvents", []):
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
                 pid_names[ev.get("pid")] = ev.get("args", {}).get("name", "")
@@ -57,12 +64,35 @@ def summarize(trace_dir: str, top_n: int = 25) -> int:
             ts = float(ev.get("ts", 0.0))
             t_min = min(t_min, ts)
             t_max = max(t_max, ts + float(ev["dur"]))
-    window_us = max(t_max - t_min, 1e-9)
+            f_min = min(f_min, ts)
+            f_max = max(f_max, ts + float(ev["dur"]))
+            intervals.append((ts, ts + float(ev["dur"])))
+        if intervals:
+            per_file.append((f_max - f_min, intervals))
+    window_us = max(sum(w for w, _ in per_file), 1e-9)
+    # union of device-lane spans, per trace file: the complement is time
+    # the device sat IDLE inside its session window — host gaps
+    # (dispatch, batch assembly, blocking transfers). This one line
+    # answers "matmul-bound or host-bound" before any per-op rows.
+    busy_us = 0.0
+    for _w, intervals in per_file:
+        cur_end = float("-inf")
+        for s, e in sorted(intervals):
+            if s > cur_end:
+                busy_us += e - s
+                cur_end = e
+            elif e > cur_end:
+                busy_us += e - cur_end
+                cur_end = e
     rows = sorted(by_name.items(), key=lambda kv: -kv[1][0])[:top_n]
     total_us = sum(v[0] for v in by_name.values())
-    print(f"profiled window ≈ {window_us/1e3:.1f} ms, "
-          f"{len(by_name)} distinct ops, "
+    print(f"profiled window ≈ {window_us/1e3:.1f} ms"
+          + (f" across {len(per_file)} trace files" if len(per_file) > 1
+             else "")
+          + f", {len(by_name)} distinct ops, "
           f"Σop time {total_us/1e3:.1f} ms (overlap counts twice)")
+    print(f"device busy {busy_us/1e3:.1f} ms = {100*busy_us/window_us:.1f}% "
+          f"of window → host/idle gaps {100*(1-busy_us/window_us):.1f}%")
     print(f"{'total ms':>10} {'mean us':>9} {'count':>7} "
           f"{'%Σ':>6}  op")
     for name, (tot, cnt) in rows:

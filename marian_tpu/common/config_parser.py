@@ -155,6 +155,7 @@ _TRAINING = [
     _f("disp-first", int, 0, "Display information for the first N updates", "training"),
     _f("disp-label-counts", bool, True, "Display label counts in progress", "training"),
     _f("save-freq", str, "10000u", "Save model every N", "training"),
+    _f("optimizer-state-dtype", str, "float32", "Storage dtype for Adam's first moment: float32 | bfloat16 (halves m's HBM footprint and per-step traffic; math stays f32, v stays f32; beyond the reference)", "training"),
     _f("async-save", bool, False, "Overlap checkpoint writes with training: device snapshots on the train thread, numpy+disk IO on a background worker (beyond the reference, whose Train::save blocks the update loop). Needs transient HBM headroom for one device copy of params+EMA+optimizer state at save time", "training"),
     _f("logical-epoch", str, ["1e"], "Logical epoch spec, e.g. 1Gt", "training", "+"),
     _f("max-length-factor", float, 3.0, "Max target length factor of source length while decoding", "training"),

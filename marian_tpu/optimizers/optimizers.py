@@ -45,6 +45,12 @@ class OptimizerConfig:
     quantize_opt_steps: int = 0        # --quantize-optimization-steps
     quantize_range: float = 0.0        # --quantize-range (clip at N stddevs)
     grad_drop_rate: float = 0.0        # --gradient-dropping-rate (0 = off)
+    # --optimizer-state-dtype: storage dtype of Adam's FIRST moment only
+    # (optax mu_dtype precedent). bfloat16 halves m's HBM footprint and
+    # per-step read/write traffic; the math still runs in f32 and the
+    # second moment v stays f32 (its sqrt sits in the update denominator,
+    # where bf16's 8 mantissa bits would bite). Beyond the reference.
+    state_dtype: str = "float32"       # float32 | bfloat16
 
     @classmethod
     def from_options(cls, options) -> "OptimizerConfig":
@@ -62,7 +68,13 @@ class OptimizerConfig:
                   quantize_range=float(
                       options.get("quantize-range", 0.0) or 0.0),
                   grad_drop_rate=float(
-                      options.get("gradient-dropping-rate", 0.0) or 0.0))
+                      options.get("gradient-dropping-rate", 0.0) or 0.0),
+                  state_dtype=str(options.get("optimizer-state-dtype",
+                                              "float32") or "float32"))
+        if cfg.state_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"--optimizer-state-dtype {cfg.state_dtype}: expected "
+                f"float32 or bfloat16")
         if name == "adam":
             if len(params) > 0:
                 cfg.beta1 = params[0]
@@ -79,7 +91,9 @@ def init_state(cfg: OptimizerConfig, params: Params) -> Dict[str, Any]:
     zeros_like = lambda: {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
     st: Dict[str, Any] = {"t": jnp.zeros((), jnp.float32)}
     if cfg.name == "adam":
-        st["m"] = zeros_like()
+        m_dtype = jnp.dtype(cfg.state_dtype)
+        st["m"] = {k: jnp.zeros(v.shape, m_dtype)
+                   for k, v in params.items()}
         st["v"] = zeros_like()
     elif cfg.name == "adagrad":
         st["gt"] = zeros_like()
@@ -126,11 +140,13 @@ def apply_update(cfg: OptimizerConfig, state: Dict[str, Any], params: Params,
         bc1 = 1.0 - jnp.power(cfg.beta1, t)
         bc2 = 1.0 - jnp.power(cfg.beta2, t)
         m_new, v_new = {}, {}
+        m_dtype = jnp.dtype(cfg.state_dtype)
         for k, p in params.items():
             g = grads[k].astype(jnp.float32)
-            m = cfg.beta1 * state["m"][k] + (1.0 - cfg.beta1) * g
+            m = cfg.beta1 * state["m"][k].astype(jnp.float32) \
+                + (1.0 - cfg.beta1) * g
             v = cfg.beta2 * state["v"][k] + (1.0 - cfg.beta2) * jnp.square(g)
-            m_new[k], v_new[k] = m, v
+            m_new[k], v_new[k] = m.astype(m_dtype), v
             mhat = m / bc1
             vhat = v / bc2
             out[k] = (p.astype(jnp.float32)

@@ -326,7 +326,13 @@ class GraphGroup:
         for part in ("m", "v", "gt", "avg", "qerr", "gerr"):
             if part in self.opt_state:
                 for k, v in self._unstack(self.opt_state[part]).items():
-                    flat[f"{part}:{k}"] = v
+                    # bf16 state (--optimizer-state-dtype) is stored as
+                    # f32 in the npz: numpy has no native bfloat16, and
+                    # f32 checkpoints stay loadable regardless of the
+                    # flag the resuming run uses
+                    flat[f"{part}:{k}"] = (
+                        v.astype(jnp.float32)
+                        if v.dtype == jnp.bfloat16 else v)
         return flat
 
     def optimizer_arrays(self) -> Dict[str, Any]:
@@ -337,9 +343,13 @@ class GraphGroup:
                 for k, v in self.optimizer_device_arrays().items()}
 
     def load_optimizer_arrays(self, flat: Dict[str, Any]) -> None:
+        m_dtype = jnp.dtype(getattr(self.opt_cfg, "state_dtype", "float32"))
         st: Dict[str, Any] = {"t": jnp.asarray(flat["t"])}
         for key, v in flat.items():
             if ":" in key:
                 part, name = key.split(":", 1)
-                st.setdefault(part, {})[name] = jnp.asarray(v)
+                arr = jnp.asarray(v)
+                if part == "m":   # stored f32; live dtype follows the flag
+                    arr = arr.astype(m_dtype)
+                st.setdefault(part, {})[name] = arr
         self.opt_state = st

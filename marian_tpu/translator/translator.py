@@ -136,6 +136,11 @@ class Translate:
                 stream = open(out_path, "w", encoding="utf-8")
                 close = True
         collector = OutputCollector(stream)
+        # return value is only materialized for library callers (lines=);
+        # file/stdin translation streams through the collector with
+        # O(one batch) memory — retaining every line of a corpus-sized
+        # decode would grow RSS without bound
+        keep_results = lines is not None
         by_sid: Dict[int, str] = {}
         # depth-1 decode pipeline: dispatch batch i+1's (async) beam
         # search BEFORE collecting batch i, so host n-best extraction +
@@ -150,7 +155,8 @@ class Translate:
             for row in range(pbatch.size):
                 sid = int(pbatch.sentence_ids[row])
                 text = self.printer.line(sid, nbests[row])
-                by_sid[sid] = text
+                if keep_results:
+                    by_sid[sid] = text
                 collector.write(sid, text)
 
         for batch in bg:
@@ -189,7 +195,7 @@ class Translate:
         if close:
             stream.close()
         # corpus order, like the written output (batches are length-sorted)
-        return [by_sid[s] for s in sorted(by_sid)]
+        return [by_sid[s] for s in sorted(by_sid)] if keep_results else []
 
 
 def translate_main(options) -> None:

@@ -113,6 +113,8 @@ ladder() {
     stage scan_off   5400 MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_SCAN=off
     stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_WORDS=$WORDS_AB
+    stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_OPT_DTYPE=bfloat16
     # 5 — profile-directed trace, summarized to a committed text artifact
     # (summarize into a temp file first: a failed/empty summary must not
     # truncate-and-commit over a previous good one)
