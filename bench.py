@@ -163,6 +163,8 @@ def main():
     fused_mode = os.environ.get("MARIAN_BENCH_FUSED", "tune")
 
     opt_dtype = os.environ.get("MARIAN_BENCH_OPT_DTYPE", "float32")
+    remat = os.environ.get("MARIAN_BENCH_REMAT", "").strip().lower() \
+        in ("1", "true", "on", "yes")
     scan_env = os.environ.get("MARIAN_BENCH_SCAN")  # on/off A/B knob
     if scan_env:
         scan_env = {"on": "on", "1": "on", "true": "on",
@@ -185,6 +187,7 @@ def main():
         "learn-rate": 2e-4, "lr-warmup": "8000", "lr-decay-inv-sqrt": ["8000"],
         "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
         "optimizer-state-dtype": opt_dtype,
+        "gradient-checkpointing": remat,
         "clip-norm": 0.0, "exponential-smoothing": 1e-4,
         "max-length": max_len, "max-length-crop": True,
         "mini-batch": 512, "mini-batch-words": words,
@@ -360,6 +363,7 @@ def main():
         "fused_ce": fused_mode,
         "scan_layers": scan_env or "default",
         "opt_state_dtype": opt_dtype,
+        "remat": remat,
         "words_budget": words,
     }
     progress.update(phase="done", result=result)

@@ -36,13 +36,12 @@ def summarize(trace_dir: str, top_n: int = 25) -> int:
     # profiling session): a directory holding several sessions must not
     # union them, or the idle minutes BETWEEN sessions would read as
     # "host gaps" and fake a host-bound diagnosis
-    per_file = []                                # (window_us, intervals)
-    t_min, t_max = float("inf"), 0.0
+    per_file = []                # (window_us, device_intervals, all_ivals)
     for path in paths:
         op = gzip.open if path.endswith(".gz") else open
         with op(path, "rt") as fh:
             data = json.load(fh)
-        intervals = []
+        dev_ivals, all_ivals = [], []
         f_min, f_max = float("inf"), 0.0
         for ev in data.get("traceEvents", []):
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
@@ -62,28 +61,37 @@ def summarize(trace_dir: str, top_n: int = 25) -> int:
             by_name[name][0] += float(ev["dur"])
             by_name[name][1] += 1
             ts = float(ev.get("ts", 0.0))
-            t_min = min(t_min, ts)
-            t_max = max(t_max, ts + float(ev["dur"]))
             f_min = min(f_min, ts)
             f_max = max(f_max, ts + float(ev["dur"]))
-            intervals.append((ts, ts + float(ev["dur"])))
-        if intervals:
-            per_file.append((f_max - f_min, intervals))
-    window_us = max(sum(w for w, _ in per_file), 1e-9)
+            span = (ts, ts + float(ev["dur"]))
+            all_ivals.append(span)
+            # the busy% diagnostic must count only ACCELERATOR lanes —
+            # host-runtime/transfer lanes spanning the step would read
+            # as device-busy and mask the very host gaps it looks for
+            if "tpu" in pname.lower() or "/device:" in pname.lower() \
+                    or "gpu" in pname.lower():
+                dev_ivals.append(span)
+        if all_ivals:
+            per_file.append((f_max - f_min, dev_ivals, all_ivals))
+    window_us = max(sum(w for w, _d, _a in per_file), 1e-9)
     # union of device-lane spans, per trace file: the complement is time
     # the device sat IDLE inside its session window — host gaps
     # (dispatch, batch assembly, blocking transfers). This one line
     # answers "matmul-bound or host-bound" before any per-op rows.
-    busy_us = 0.0
-    for _w, intervals in per_file:
-        cur_end = float("-inf")
-        for s, e in sorted(intervals):
+    have_dev = any(d for _w, d, _a in per_file)
+
+    def _union(ivals):
+        busy, cur_end = 0.0, float("-inf")
+        for s, e in sorted(ivals):
             if s > cur_end:
-                busy_us += e - s
+                busy += e - s
                 cur_end = e
             elif e > cur_end:
-                busy_us += e - cur_end
+                busy += e - cur_end
                 cur_end = e
+        return busy
+
+    busy_us = sum(_union(d if have_dev else a) for _w, d, a in per_file)
     rows = sorted(by_name.items(), key=lambda kv: -kv[1][0])[:top_n]
     total_us = sum(v[0] for v in by_name.values())
     print(f"profiled window ≈ {window_us/1e3:.1f} ms"
@@ -91,7 +99,9 @@ def summarize(trace_dir: str, top_n: int = 25) -> int:
              else "")
           + f", {len(by_name)} distinct ops, "
           f"Σop time {total_us/1e3:.1f} ms (overlap counts twice)")
-    print(f"device busy {busy_us/1e3:.1f} ms = {100*busy_us/window_us:.1f}% "
+    label = "device busy" if have_dev else \
+        "busy (no device lanes in trace — over all runtime lanes)"
+    print(f"{label} {busy_us/1e3:.1f} ms = {100*busy_us/window_us:.1f}% "
           f"of window → host/idle gaps {100*(1-busy_us/window_us):.1f}%")
     print(f"{'total ms':>10} {'mean us':>9} {'count':>7} "
           f"{'%Σ':>6}  op")

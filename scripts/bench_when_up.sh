@@ -115,6 +115,11 @@ ladder() {
                           MARIAN_BENCH_WORDS=$WORDS_AB
     stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_OPT_DTYPE=bfloat16
+    # 32k tokens needs remat headroom; if it OOMs the stage fails
+    # gracefully and the ladder continues
+    stage words_32k_remat 5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_WORDS=$((WORDS_AB * 2)) \
+                          MARIAN_BENCH_REMAT=1
     # 5 — profile-directed trace, summarized to a committed text artifact
     # (summarize into a temp file first: a failed/empty summary must not
     # truncate-and-commit over a previous good one)
