@@ -4,12 +4,21 @@ src/command/marian_server.cpp + vendored simple-websocket-server).
 Protocol kept Marian-compatible: client sends newline-joined source
 sentences as a text frame, server replies with newline-joined translations.
 Uses the `websockets` package (gated — a clear error if unavailable).
+
+Beyond the reference: concurrent requests are funneled through ONE
+worker with a short dynamic-batching window — sentences from requests
+arriving within ~5 ms translate as one device batch (better MXU
+utilization than per-request batches), and the single worker also
+serializes access to the shared Translate driver (whose jit caches and
+prefix state are not re-entrant). The reference serves each connection
+on its own thread against per-thread graphs; one TPU program shared by
+all clients replaces that design.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
+from typing import List, Optional, Tuple
 
 from ..common import logging as log
 
@@ -18,6 +27,10 @@ try:
     HAVE_WS = True
 except ImportError:  # pragma: no cover
     HAVE_WS = False
+
+# dynamic-batching window: long enough to coalesce a burst of concurrent
+# clients, far below human-visible latency
+BATCH_WINDOW_S = 0.005
 
 
 class TranslationService:
@@ -28,31 +41,125 @@ class TranslationService:
         from ..translator.translator import Translate
         self.translator = Translate(options)
 
-    def translate(self, text: str) -> str:
-        lines = text.split("\n")
+    def translate_lines(self, lines: List[str]) -> List[str]:
         import io as _io
         buf = _io.StringIO()
-        self.translator.run(lines=lines, stream=buf)
-        return buf.getvalue().rstrip("\n")
+        got = self.translator.run(lines=lines, stream=buf)
+        if len(got) != len(lines):
+            # one entry per input line is what the batched reply slicing
+            # relies on — a silent mismatch would route one client's
+            # translations to another, so fail loudly instead
+            raise RuntimeError(
+                f"translator returned {len(got)} lines for {len(lines)} "
+                f"inputs — per-request reply slicing would misalign")
+        return got
+
+    def translate(self, text: str) -> str:
+        return "\n".join(self.translate_lines(text.split("\n")))
 
 
-async def _serve(options) -> None:
-    service = TranslationService(options)
-    port = int(options.get("port", 8080))
+async def _batching_worker(queue: "asyncio.Queue[Tuple[str, asyncio.Future]]",
+                           translate_lines) -> None:
+    """Drain the request queue into dynamic batches: block for the first
+    request, then coalesce everything arriving inside the window; one
+    translate_lines call per batch (in an executor — the device work
+    must not block the event loop); per-request replies by line count.
 
+    Failure isolation: a failing BATCH is retried per request, so one
+    client's bad input fails only that client (the per-request error
+    domain of the unbatched design). The worker itself survives any
+    exception short of cancellation — a dead worker would hang every
+    future request on an unresolved future."""
+    loop = asyncio.get_event_loop()
+
+    async def _reply(batch):
+        lines: List[str] = []
+        counts: List[int] = []
+        for t, _f in batch:
+            ls = t.split("\n")
+            counts.append(len(ls))
+            lines.extend(ls)
+        out = await loop.run_in_executor(None, translate_lines, lines)
+        i = 0
+        for (_t, f), c in zip(batch, counts):
+            if not f.cancelled():
+                f.set_result("\n".join(out[i:i + c]))
+            i += c
+
+    while True:
+        try:
+            text, fut = await queue.get()
+            batch = [(text, fut)]
+            deadline = loop.time() + BATCH_WINDOW_S
+            while True:
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(queue.get(), timeout))
+                except asyncio.TimeoutError:
+                    break
+            try:
+                await _reply(batch)
+            except Exception as e:  # noqa: BLE001
+                if len(batch) == 1:
+                    log.error("translation error: {}", e)
+                    if not batch[0][1].cancelled():
+                        batch[0][1].set_exception(RuntimeError(str(e)))
+                else:
+                    # isolate the failure: one bad request must not fail
+                    # the whole coalesced batch
+                    log.error("batch translation error ({} requests — "
+                              "retrying individually): {}", len(batch), e)
+                    for entry in batch:
+                        try:
+                            await _reply([entry])
+                        except Exception as e1:  # noqa: BLE001
+                            log.error("translation error: {}", e1)
+                            if not entry[1].cancelled():
+                                entry[1].set_exception(
+                                    RuntimeError(str(e1)))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — supervision: never die
+            log.error("server worker error (recovered): {}", e)
+
+
+def _make_handler(queue: "asyncio.Queue[Tuple[str, asyncio.Future]]"):
+    """The per-connection protocol, shared by _serve and the tests (so
+    the real wiring is what gets exercised)."""
     async def handler(ws):
         async for message in ws:
+            fut = asyncio.get_event_loop().create_future()
+            await queue.put((message, fut))
             try:
-                reply = await asyncio.get_event_loop().run_in_executor(
-                    None, service.translate, message)
-            except Exception as e:  # keep the server alive on bad input
-                log.error("translation error: {}", e)
+                reply = await fut
+            except Exception:  # error already logged by the worker
                 reply = ""
             await ws.send(reply)
+    return handler
 
-    log.info("Server is listening on port {}", port)
-    async with websockets.serve(handler, "0.0.0.0", port):
-        await asyncio.Future()
+
+async def _serve(options, ready: Optional[asyncio.Future] = None) -> None:
+    """Serve forever. `ready` (tests): resolved with the bound port once
+    listening — pass --port 0 to bind an ephemeral port."""
+    service = TranslationService(options)
+    port = int(options.get("port", 8080))
+    queue: "asyncio.Queue[Tuple[str, asyncio.Future]]" = asyncio.Queue()
+    worker = asyncio.ensure_future(
+        _batching_worker(queue, service.translate_lines))
+
+    try:
+        async with websockets.serve(_make_handler(queue), "0.0.0.0",
+                                    port) as server:
+            bound = next(iter(server.sockets)).getsockname()[1]
+            log.info("Server is listening on port {}", bound)
+            if ready is not None and not ready.cancelled():
+                ready.set_result(bound)
+            await asyncio.Future()
+    finally:
+        worker.cancel()
 
 
 def serve_main(options) -> None:
