@@ -148,17 +148,18 @@ def main():
     batches = [make_batch() for _ in range(max(1, n_sents // batch))]
     # shortlist generation is host-side work the real translator does per
     # batch — keep it inside the timed window, like Marian does. The
-    # depth-1 dispatch/collect pipeline mirrors the translator driver:
-    # host n-best extraction overlaps device beam steps.
+    # depth-1 dispatch/collect pipeline is the translator driver's
+    # (common/pipeline.py): host n-best extraction overlaps device beam
+    # steps.
+    from marian_tpu.common.pipeline import pipelined
+    results = []
     t0 = time.perf_counter()
-    pending = None
-    for ids, mask in batches:
-        handle = bs.search_async(ids, mask, shortlist=shortlist_for(ids))
-        if pending is not None:
-            nbests = pending.collect()
-        pending = handle
-    nbests = pending.collect()
+    pipelined(batches,
+              lambda b: bs.search_async(b[0], b[1],
+                                        shortlist=shortlist_for(b[0])),
+              lambda b, h: results.append(h.collect()))
     dt = time.perf_counter() - t0
+    nbests = results[-1]
     assert len(nbests) == batch
     sents = batch * len(batches)
     print(json.dumps({

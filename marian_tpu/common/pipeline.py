@@ -1,0 +1,34 @@
+"""Depth-1 dispatch/finalize pipelining over device batches.
+
+The reference hides per-batch host work (n-best extraction, score
+bookkeeping, vector copy-out) behind worker thread pools
+(src/translator/translator.h); on TPU the same overlap falls out of XLA
+async dispatch — dispatch batch i+1's jitted computation BEFORE forcing
+batch i's results, and every batch's host cost except the last hides
+behind device compute. One shared skeleton so the translator, rescorer,
+embedder, and bench loops cannot drift apart."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, TypeVar
+
+B = TypeVar("B")
+H = TypeVar("H")
+
+
+def pipelined(batches: Iterable[B],
+              dispatch: Callable[[B], H],
+              finalize: Callable[[B, H], None]) -> None:
+    """For each batch: ``handle = dispatch(batch)`` (must only ENQUEUE
+    device work — anything that blocks defeats the overlap), then
+    ``finalize(prev_batch, prev_handle)`` for the previous batch; the
+    trailing batch is finalized at the end. ``finalize`` is where
+    blocking (np.asarray / .collect()) belongs."""
+    pending = None
+    for b in batches:
+        h = dispatch(b)
+        if pending is not None:
+            finalize(*pending)
+        pending = (b, h)
+    if pending is not None:
+        finalize(*pending)

@@ -52,12 +52,19 @@ class Embedder:
                             maxi_batch_sort="src", shuffle_batches=False,
                             prefetch=True)
         out: dict = {}
-        for batch in bg:
-            vecs = np.asarray(self._fn(self.params,
-                                       jnp.asarray(batch.src.ids),
-                                       jnp.asarray(batch.src.mask)))
-            for row in range(batch.size):
-                out[int(batch.sentence_ids[row])] = vecs[row]
+        # depth-1 pipeline (common/pipeline.py): dispatch batch i+1
+        # before forcing batch i's vectors off the device
+        from .common.pipeline import pipelined
+
+        def _finalize(pbatch, dev):
+            vecs = np.asarray(dev)
+            for row in range(pbatch.size):
+                out[int(pbatch.sentence_ids[row])] = vecs[row]
+
+        pipelined(bg,
+                  lambda b: self._fn(self.params, jnp.asarray(b.src.ids),
+                                     jnp.asarray(b.src.mask)),
+                  _finalize)
         for i in sorted(out):
             stream.write(" ".join(f"{x:.6f}" for x in out[i]) + "\n")
         stream.flush()

@@ -74,14 +74,22 @@ class Rescorer:
         scores: dict = {}
         total_ce = 0.0
         total_words = 0.0
-        for batch in bg:
-            ce, words = self._score_fn(self.params, batch_to_arrays(batch))
-            ce, words = np.asarray(ce), np.asarray(words)
-            for row in range(batch.size):
-                sid = int(batch.sentence_ids[row])
-                scores[sid] = -float(ce[row])  # log-prob (Marian prints logP)
+        # depth-1 pipeline (common/pipeline.py): host per-row bookkeeping
+        # of batch i hides behind batch i+1's device scoring
+        from .common.pipeline import pipelined
+
+        def _finalize(pbatch, handle):
+            nonlocal total_ce, total_words
+            ce, words = np.asarray(handle[0]), np.asarray(handle[1])
+            for row in range(pbatch.size):
+                sid = int(pbatch.sentence_ids[row])
+                scores[sid] = -float(ce[row])  # Marian prints logP
                 total_ce += float(ce[row])
                 total_words += float(words[row])
+
+        pipelined(bg,
+                  lambda b: self._score_fn(self.params, batch_to_arrays(b)),
+                  _finalize)
         ordered = [scores[i] for i in sorted(scores)]
         summary = opts.get("summary", None)
         if summary:

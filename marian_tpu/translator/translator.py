@@ -142,15 +142,14 @@ class Translate:
         # decode would grow RSS without bound
         keep_results = lines is not None
         by_sid: Dict[int, str] = {}
-        # depth-1 decode pipeline: dispatch batch i+1's (async) beam
-        # search BEFORE collecting batch i, so host n-best extraction +
-        # output writing overlap device beam steps (the reference hides
-        # this host work behind a worker thread pool; XLA async dispatch
-        # plays that role here)
-        pending = None      # (batch, _SearchHandle)
+        # depth-1 decode pipeline (common/pipeline.py): dispatch batch
+        # i+1's (async) beam search BEFORE collecting batch i, so host
+        # n-best extraction + output writing overlap device beam steps
+        # (the reference hides this host work behind a worker thread
+        # pool; XLA async dispatch plays that role here)
+        from ..common.pipeline import pipelined
 
-        def _finalize(entry):
-            pbatch, handle = entry
+        def _finalize(pbatch, handle):
             nbests = handle.collect()
             for row in range(pbatch.size):
                 sid = int(pbatch.sentence_ids[row])
@@ -159,7 +158,7 @@ class Translate:
                     by_sid[sid] = text
                 collector.write(sid, text)
 
-        for batch in bg:
+        def _dispatch(batch):
             real = batch.size
             if len(self.src_vocab_list) > 1:
                 src_ids = tuple(sb.ids for sb in batch.sub)
@@ -183,14 +182,11 @@ class Translate:
                     sid = int(batch.sentence_ids[row])
                     pf = self._prefixes[sid]
                     prefix[row, :len(pf)] = pf
-            handle = self.search.search_async(src_ids, src_mask,
-                                              shortlist=shortlist,
-                                              prefix=prefix)
-            if pending is not None:
-                _finalize(pending)
-            pending = (batch, handle)
-        if pending is not None:
-            _finalize(pending)
+            return self.search.search_async(src_ids, src_mask,
+                                            shortlist=shortlist,
+                                            prefix=prefix)
+
+        pipelined(bg, _dispatch, _finalize)
         collector.flush_remaining()
         if close:
             stream.close()
