@@ -165,6 +165,8 @@ def main():
     opt_dtype = os.environ.get("MARIAN_BENCH_OPT_DTYPE", "float32")
     remat = os.environ.get("MARIAN_BENCH_REMAT", "").strip().lower() \
         in ("1", "true", "on", "yes")
+    stacked = os.environ.get("MARIAN_BENCH_STACKED", "").strip().lower() \
+        in ("1", "true", "on", "yes")
     scan_env = os.environ.get("MARIAN_BENCH_SCAN")  # on/off A/B knob
     if scan_env:
         scan_env = {"on": "on", "1": "on", "true": "on",
@@ -188,6 +190,7 @@ def main():
         "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
         "optimizer-state-dtype": opt_dtype,
         "gradient-checkpointing": remat,
+        "stacked-params": stacked,
         "clip-norm": 0.0, "exponential-smoothing": 1e-4,
         "max-length": max_len, "max-length-crop": True,
         "mini-batch": 512, "mini-batch-words": words,
@@ -364,6 +367,7 @@ def main():
         "scan_layers": scan_env or "default",
         "opt_state_dtype": opt_dtype,
         "remat": remat,
+        "stacked_params": stacked,
         "words_budget": words,
     }
     progress.update(phase="done", result=result)

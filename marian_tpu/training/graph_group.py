@@ -122,24 +122,35 @@ class GraphGroup:
 
     # -- init / load --------------------------------------------------------
     def _maybe_stack(self) -> None:
-        """Depth-stacked storage when the mesh has a 'pipe' axis: layer
-        leaves become '{prefix}_stack_{suffix}' [L, ...] sharded
-        P('pipe', ...) — each pipeline stage holds and updates only its
-        layers (models/transformer.py stack_layer_params)."""
+        """Depth-stacked storage when the mesh has a 'pipe' axis, or on
+        --stacked-params: layer leaves become '{prefix}_stack_{suffix}'
+        [L, ...] sharded P('pipe', ...) (a no-op axis of size 1 without
+        pipeline sharding) — each pipeline stage holds and updates only
+        its layers (models/transformer.py stack_layer_params). Without
+        'pipe', the point is eliminating --scan-layers' per-step restack:
+        the scan consumes the stored stack directly, saving one full
+        HBM read+write of every layer weight per micro-batch."""
         self._stacked = False
-        if self.mesh.shape.get("pipe", 1) <= 1:
+        if self.mesh.shape.get("pipe", 1) <= 1 \
+                and not self.options.get("stacked-params", False):
             return
+        what = ("pipeline ('pipe') sharding"
+                if self.mesh.shape.get("pipe", 1) > 1 else "--stacked-params")
         from ..models import transformer as TT
         cfg = getattr(self.model, "cfg", None)
         if not isinstance(cfg, TT.TransformerConfig):
-            raise ValueError("pipeline ('pipe') sharding is only supported "
-                             "for the transformer family")
+            raise ValueError(f"{what} is only supported "
+                             f"for the transformer family")
         reason = TT.can_stack_layers(cfg)
-        if reason is None and self.options.get("guided-alignment", None):
+        # the CLI default for --guided-alignment is the STRING "none";
+        # comparison kept identical to encoder_decoder.use_guided /
+        # config_validator / train.py so every site agrees on off
+        ga = self.options.get("guided-alignment", None)
+        if reason is None and ga and ga != "none":
             reason = "guided alignment extracts one layer's attention " \
                      "weights (unrolled stack)"
         if reason is not None:
-            raise ValueError(f"pipeline sharding unavailable: {reason}")
+            raise ValueError(f"{what} unavailable: {reason}")
         pipe = self.mesh.shape["pipe"]
         for prefix, depth in TT.layer_param_groups(cfg):
             if depth % pipe != 0:

@@ -111,6 +111,8 @@ ladder() {
                                 MARIAN_DECBENCH_SHORTLIST=1
     # 3/4 — train A/Bs (cache already warm for the base shapes)
     stage scan_off   5400 MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_SCAN=off
+    stage stacked    5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_STACKED=1
     stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_WORDS=$WORDS_AB
     stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET \
