@@ -1,0 +1,70 @@
+"""scripts/record_bench.py — the harness's artifact recorder. A bug here
+silently loses TPU numbers landed in a scarce tunnel-up window, so the
+parsing/regeneration contract is pinned."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+SCRIPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "record_bench.py")
+
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+    # record_bench writes next to its own location's parent — run a COPY
+    # in a scratch repo dir so tests never touch the real artifacts
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    with open(SCRIPT) as fh:
+        (scripts / "record_bench.py").write_text(fh.read())
+    return tmp_path
+
+
+def _run_in(repo, stage, payload):
+    p = repo / "out.json"
+    p.write_text(payload)
+    return subprocess.run(
+        [sys.executable, str(repo / "scripts" / "record_bench.py"),
+         stage, str(p)], capture_output=True, text=True)
+
+
+def test_records_and_regenerates_latest_per_metric_stage(repo):
+    r = _run_in(repo, "train",
+                '{"metric": "m1", "value": 1.0, "unit": "u"}')
+    assert r.returncode == 0, r.stderr
+    r = _run_in(repo, "train",
+                '{"metric": "m1", "value": 2.0, "unit": "u"}')
+    assert r.returncode == 0
+    r = _run_in(repo, "scan_off",
+                '{"metric": "m1", "value": 3.0, "unit": "u"}')
+    assert r.returncode == 0
+
+    hist = (repo / "BENCH_HISTORY.jsonl").read_text().splitlines()
+    assert len(hist) == 3
+    latest = json.loads((repo / "BENCH_SELF.json").read_text())
+    # latest per (metric, stage): train row shows 2.0, scan_off 3.0
+    by_stage = {r["stage"]: r["value"] for r in latest}
+    assert by_stage == {"train": 2.0, "scan_off": 3.0}
+    assert all("ts" in r for r in latest)
+
+
+def test_tolerates_stderr_noise_and_picks_last_json(repo):
+    payload = ("WARNING: axon tunnel flaky\n"
+               '{"metric": "old", "value": 0, "unit": "u"}\n'
+               "garbage {not json}\n"
+               '{"metric": "m", "value": 9.5, "unit": "u"}\n')
+    r = _run_in(repo, "s", payload)
+    assert r.returncode == 0
+    latest = json.loads((repo / "BENCH_SELF.json").read_text())
+    assert latest[-1]["metric"] == "m" and latest[-1]["value"] == 9.5
+
+
+def test_empty_or_metricless_output_fails_loudly(repo):
+    assert _run_in(repo, "s", "").returncode == 1
+    assert _run_in(repo, "s", '{"no_metric": true}').returncode == 1
+    # and neither wrote artifacts
+    assert not (repo / "BENCH_SELF.json").exists()
