@@ -1,0 +1,50 @@
+"""Smoke tests for the driver-facing bench entry points (bench.py /
+bench_decode.py). These are the round's headline deliverable — a
+regression here would otherwise surface only when the driver runs the
+bench on scarce TPU time."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, env_extra, tmp_path, timeout=420):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "MARIAN_BENCH_PARTIAL": str(tmp_path / "partial.json")})
+    env.update(env_extra)
+    r = subprocess.run([sys.executable, os.path.join(ROOT, script)],
+                      capture_output=True, text=True, env=env,
+                      timeout=timeout, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_train_bench_tiny_contract(tmp_path):
+    out = _run("bench.py", {"MARIAN_BENCH_PRESET": "tiny"}, tmp_path)
+    # the driver's contract: metric/value/unit/vs_baseline on ONE line
+    assert out["metric"] == "train_src_tokens_per_sec_per_chip"
+    assert out["value"] > 0 and out["unit"] == "src-tokens/sec/chip"
+    assert 0 < out["vs_baseline"] < 10
+    # round-3 additions
+    assert out["chip"] == "cpu" and out["mfu"] is None
+    assert out["flops_per_src_token"] > 0
+    # progress checkpoints landed and finished
+    partial = json.loads((tmp_path / "partial.json").read_text())
+    assert partial["phase"] == "done"
+    assert partial["shape_warm_s"]
+
+
+def test_decode_bench_tiny_contract(tmp_path):
+    out = _run("bench_decode.py", {"MARIAN_DECBENCH_PRESET": "tiny"},
+               tmp_path)
+    assert out["metric"] == "beam6_sentences_per_sec"
+    assert out["value"] > 0 and out["unit"] == "sent/sec"
