@@ -54,6 +54,7 @@ _COMMON = [
     _f("dump-config", str, None, "Dump effective config and exit: full/minimal/expand", "general"),
     _f("sigterm", str, "save-and-exit", "SIGTERM behavior: save-and-exit or exit-immediately", "general"),
     _f("profile", str, None, "Capture a jax.profiler device trace to this directory around a training-update window (TPU extension; view with tensorboard)", "general", "?"),
+    _f("profile-server", int, 0, "Start a live jax.profiler server on this port (0 = off): attach TensorBoard's profile tab or xprof to a RUNNING training job and capture on demand (TPU extension; SURVEY tracing row)", "general"),
     _f("profile-start", int, 10, "First update of the profiler trace window", "general"),
     _f("profile-updates", int, 5, "Number of updates to trace", "general"),
     _f("dump-hlo", str, None, "Write jaxpr + optimized HLO of the compiled train step to this path prefix and continue (graph-dump debugging equivalent)", "general"),

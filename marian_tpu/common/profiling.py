@@ -120,6 +120,26 @@ def check_cache_manifest(write: bool = False,
     return True
 
 
+def maybe_start_profile_server(options) -> bool:
+    """--profile-server PORT: live profiler endpoint on a RUNNING job —
+    TensorBoard's profile tab / xprof connect and capture on demand,
+    with no pre-planned trace window (the TPU-era answer to attaching
+    nvprof to a running trainer; SURVEY §5 tracing row). Returns whether
+    a server was started."""
+    port = int(options.get("profile-server", 0) or 0)
+    if port <= 0:
+        return False
+    import jax
+    try:
+        jax.profiler.start_server(port)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill train
+        log.warn("--profile-server {}: failed to start ({})", port, e)
+        return False
+    log.info("Profiler server listening on port {} (attach with "
+             "TensorBoard's profile tab or xprof)", port)
+    return True
+
+
 class TraceWindow:
     """Capture a jax.profiler trace for updates [start, stop)."""
 

@@ -237,7 +237,9 @@ class Train:
                      "first {} updates", wu_n)
 
         # -- epoch loop ------------------------------------------------------
-        from ..common.profiling import TraceWindow
+        from ..common.profiling import (TraceWindow,
+                                        maybe_start_profile_server)
+        maybe_start_profile_server(opts)
         trace = TraceWindow(opts)
         train_key = prng.stream(key, prng.STREAM_DROPOUT)
         log.info("Training started")

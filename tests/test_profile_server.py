@@ -1,0 +1,36 @@
+"""--profile-server: live jax.profiler endpoint on a running job
+(common/profiling.py::maybe_start_profile_server — SURVEY §5 tracing
+row's 'trace server' answer to attaching nvprof to a running trainer)."""
+
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common.profiling import maybe_start_profile_server
+
+
+def test_off_by_default_and_zero_is_off():
+    assert maybe_start_profile_server(Options({})) is False
+    assert maybe_start_profile_server(
+        Options({"profile-server": 0})) is False
+
+
+def test_starts_on_port(monkeypatch):
+    import jax
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_server",
+                        lambda port: calls.append(port))
+    assert maybe_start_profile_server(
+        Options({"profile-server": 19878})) is True
+    assert calls == [19878]
+
+
+def test_start_failure_degrades_to_warning(monkeypatch):
+    import jax
+
+    def boom(port):
+        raise OSError("address in use")
+
+    monkeypatch.setattr(jax.profiler, "start_server", boom)
+    # diagnostics must never kill training: False, no raise
+    assert maybe_start_profile_server(
+        Options({"profile-server": 19879})) is False
