@@ -1,0 +1,99 @@
+"""Config validation guards (common/config_validator.py — reference:
+src/common/config_validator.cpp :: ConfigValidator::validateOptions).
+Each rule gets a positive and a negative pin so refusals stay loud and
+valid configs stay accepted."""
+
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common.config_validator import validate_options
+
+
+def _train_opts(**over):
+    base = {"type": "transformer", "dim-emb": 64, "transformer-heads": 8,
+            "train-sets": ["a.src", "a.trg"],
+            "vocabs": ["v.src", "v.trg"],
+            "label-smoothing": 0.1, "cost-type": "ce-mean-words"}
+    base.update(over)
+    return Options(base)
+
+
+class TestTraining:
+    def test_valid_config_passes(self):
+        validate_options(_train_opts(), "training")
+
+    def test_heads_divisibility(self):
+        with pytest.raises(ValueError, match="divisible"):
+            validate_options(_train_opts(**{"dim-emb": 65}), "training")
+
+    def test_unknown_type(self):
+        with pytest.raises(ValueError, match="Unknown model"):
+            validate_options(_train_opts(type="gpt5"), "training")
+
+    def test_missing_train_sets(self):
+        with pytest.raises(ValueError, match="train-sets"):
+            validate_options(_train_opts(**{"train-sets": []}), "training")
+
+    def test_vocab_count_mismatch(self):
+        with pytest.raises(ValueError, match="must match"):
+            validate_options(_train_opts(vocabs=["v.src"]), "training")
+
+    def test_label_smoothing_range(self):
+        with pytest.raises(ValueError, match="label-smoothing"):
+            validate_options(_train_opts(**{"label-smoothing": 1.0}),
+                             "training")
+        validate_options(_train_opts(**{"label-smoothing": 0.0}),
+                         "training")
+
+    def test_lm_refuses_guided_alignment(self):
+        with pytest.raises(ValueError, match="cross-attention"):
+            validate_options(
+                _train_opts(type="transformer-lm",
+                            **{"train-sets": ["a.trg"],
+                               "vocabs": ["v.trg"],
+                               "guided-alignment": "a.align"}), "training")
+        # the CLI default STRING "none" must pass
+        validate_options(
+            _train_opts(type="transformer-lm",
+                        **{"train-sets": ["a.trg"], "vocabs": ["v.trg"],
+                           "guided-alignment": "none"}), "training")
+
+    def test_right_left_refuses_alignment_and_word_weighting(self):
+        with pytest.raises(ValueError, match="right-left"):
+            validate_options(
+                _train_opts(**{"right-left": True,
+                               "guided-alignment": "a.align"}), "training")
+        with pytest.raises(ValueError, match="right-left"):
+            validate_options(
+                _train_opts(**{"right-left": True,
+                               "data-weighting": "w.txt",
+                               "data-weighting-type": "word"}), "training")
+        validate_options(_train_opts(**{"right-left": True}), "training")
+
+    def test_cost_type(self):
+        with pytest.raises(ValueError, match="cost-type"):
+            validate_options(_train_opts(**{"cost-type": "hinge"}),
+                             "training")
+
+
+class TestTranslation:
+    def test_requires_model(self):
+        with pytest.raises(ValueError, match="models"):
+            validate_options(Options({"type": "transformer",
+                                      "dim-emb": 64,
+                                      "transformer-heads": 8}),
+                             "translation")
+
+    def test_ensemble_weight_count(self):
+        with pytest.raises(ValueError, match="weights"):
+            validate_options(Options({"type": "transformer", "dim-emb": 64,
+                                      "transformer-heads": 8,
+                                      "models": ["a.npz", "b.npz"],
+                                      "weights": [0.5]}), "translation")
+
+    def test_beam_size_positive(self):
+        with pytest.raises(ValueError, match="beam-size"):
+            validate_options(Options({"type": "transformer", "dim-emb": 64,
+                                      "transformer-heads": 8,
+                                      "models": ["a.npz"],
+                                      "beam-size": 0}), "translation")
