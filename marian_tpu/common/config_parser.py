@@ -74,6 +74,8 @@ _MODEL = [
     _f("factors-dim-emb", int, 0, "Embedding size of factors (0 = sum combine)", "model"),
     _f("factors-combine", str, "sum", "How to combine factor embeddings: sum or concat", "model"),
     _f("lemma-dim-emb", int, 0, "Re-embedding dimension of lemma in factors", "model"),
+    _f("lemma-dependency", str, "", "Factor-prediction dependency mechanism (collapsed into --lemma-dim-emb re-embedding; see flag audit)", "model"),
+    _f("output-omit-bias", bool, False, "Output (logits) projection without a bias term", "model"),
     _f("dim-rnn", int, 1024, "RNN state size", "model"),
     _f("char-stride", int, 5, "Width of max-pooling layer after convolution layer in char-s2s model", "model"),
     _f("char-highway", int, 4, "Number of highway network layers after max-pooling in char-s2s model", "model"),
@@ -102,6 +104,9 @@ _MODEL = [
     _f("transformer-no-projection", bool, False, "Omit output projection in MHA", "model"),
     _f("transformer-pool", bool, False, "Pooler instead of self-attention (experimental)", "model"),
     _f("transformer-dim-aan", int, 2048, "AAN FFN hidden size", "model"),
+    _f("transformer-aan-depth", int, 2, "Depth of the AAN position-wise FFN", "model"),
+    _f("transformer-aan-activation", str, "swish", "Activation of the AAN FFN: swish | relu | gelu", "model"),
+    _f("transformer-aan-nogate", bool, False, "Disable the AAN input/forget gate", "model"),
     _f("transformer-decoder-autoreg", str, "self-attention", "self-attention, average-attention, rnn", "model"),
     _f("transformer-flash-attention", str, "auto", "Pallas blockwise attention kernel: auto, on, off (TPU extension)", "model"),
     _f("fused-ce", str, "auto", "Streaming fused softmax cross-entropy kernel (logit blocks stay in VMEM): auto (TPU only), on, off (TPU extension)", "model"),
@@ -157,6 +162,10 @@ _TRAINING = [
     _f("disp-first", int, 0, "Display information for the first N updates", "training"),
     _f("disp-label-counts", bool, True, "Display label counts in progress", "training"),
     _f("save-freq", str, "10000u", "Save model every N", "training"),
+    _f("normalize-gradient", bool, False, "Additionally divide the gradient by the batch's target-word count", "training"),
+    _f("check-gradient-nan", bool, False, "Skip the whole update (params + optimizer state unchanged) when the gradient norm is non-finite", "training"),
+    _f("dynamic-gradient-scaling", str, [], "FACTOR ['log']: scale outlier gradients down to FACTOR x the windowed average (log-)norm", "training", "*"),
+    _f("gradient-norm-average-window", int, 100, "Window for the running gradient-norm average used by --dynamic-gradient-scaling", "training"),
     _f("optimizer-state-dtype", str, "float32", "Storage dtype for Adam's first moment: float32 | bfloat16 (halves m's HBM footprint and per-step traffic; math stays f32, v stays f32; beyond the reference)", "training"),
     _f("async-save", bool, False, "Overlap checkpoint writes with training: device snapshots on the train thread, numpy+disk IO on a background worker (beyond the reference, whose Train::save blocks the update loop). Needs transient HBM headroom for one device copy of params+EMA+optimizer state at save time", "training"),
     _f("logical-epoch", str, ["1e"], "Logical epoch spec, e.g. 1Gt", "training", "+"),
@@ -166,6 +175,12 @@ _TRAINING = [
     _f("no-restore-corpus", bool, False, "Do not restore corpus position on resume", "training"),
     _f("tempdir", str, "/tmp", "Temporary directory for shuffling", "training"),
     _f("sqlite", str, None, "Keep corpus in an on-disk database for O(1) mid-epoch resume", "training", "?"),
+    _f("sqlite-drop", bool, False, "Drop the SQLite corpus database (no-op; see flag audit)", "training"),
+    _f("mini-batch-track-optimum", bool, False, "Track the optimal batch size (no-op; see flag audit)", "training"),
+    _f("train-embedder-rank", str, [], "Margin-based embedder-rank training (refused; see flag audit)", "training", "*"),
+    _f("tsv", bool, False, "Train sets are tab-separated files (one line carries all streams)", "training"),
+    _f("tsv-fields", int, 0, "Number of TSV columns (0 = infer from --vocabs count)", "training"),
+    _f("no-spm-encode", bool, False, "Input is already SentencePiece-encoded: skip encoding, split on whitespace", "training"),
     _f("mini-batch", int, 64, "Minibatch size (sentences)", "training"),
     _f("mini-batch-words", int, 0, "Minibatch size in target labels (token budget)", "training"),
     _f("mini-batch-fit", bool, False, "Determine minibatch automatically from workspace (TPU: bucket table)", "training"),
@@ -282,6 +297,7 @@ _TRANSLATION = [
     _f("allow-unk", bool, False, "Allow <unk> in output", "translate"),
     _f("allow-special", bool, False, "Allow special symbols in output", "translate"),
     _f("n-best", bool, False, "Produce n-best lists", "translate"),
+    _f("word-scores", bool, False, "Print per-word scores in n-best lists", "translate"),
     _f("alignment", str, None, "Return word alignments: 0.x threshold, soft, hard", "translate", "?"),
     _f("force-decode", bool, False, "Force-decode given prefixes", "translate"),
     _f("best-deep", bool, False, "(compat)", "translate"),
@@ -593,9 +609,24 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
                             "key-vectors file, not this flag"),
     "interpolate-env-vars": ("none", "handled at config load"),
     "relative-paths": ("none", "handled at config load"),
+    "sqlite-drop": ("warn", "the resumable in-RAM corpus replaces the "
+                            "SQLite shuffle database; there is nothing "
+                            "to drop"),
+    "mini-batch-track-optimum": ("warn", "bucketed static batch shapes "
+                                         "replace dynamic batch-size "
+                                         "tracking"),
+    "lemma-dependency": ("warn", "factor prediction is lemma-conditioned "
+                                 "via --lemma-dim-emb soft re-embedding "
+                                 "(layers/logits.py); the reference's "
+                                 "per-mechanism selector is collapsed "
+                                 "into that one implementation"),
     # -- would silently change training/decoding semantics: refuse --
     "transformer-pool": ("error", "pooled attention variant is not "
                                   "implemented"),
+    "train-embedder-rank": ("error", "margin-based embedder-rank training "
+                                     "is not implemented (semantics "
+                                     "unverifiable against the empty "
+                                     "reference mount)"),
 }
 
 

@@ -52,7 +52,12 @@ def _validate_training(opts: Options) -> None:
         raise ValueError("No train sets given in --train-sets")
     vocabs = opts.get("vocabs", [])
     trains = opts.get("train-sets", [])
-    if vocabs and len(vocabs) != len(trains):
+    if opts.get("tsv", False):
+        if len(trains) != 1:
+            raise ValueError(
+                f"--tsv expects ONE tab-separated --train-sets file, "
+                f"got {len(trains)}")
+    elif vocabs and len(vocabs) != len(trains):
         raise ValueError(
             f"Number of --vocabs ({len(vocabs)}) must match --train-sets ({len(trains)})")
     if opts.get("label-smoothing", 0.0) < 0 or opts.get("label-smoothing", 0.0) >= 1:

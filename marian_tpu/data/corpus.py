@@ -81,6 +81,23 @@ class Corpus:
     def __init__(self, paths: Sequence[str], vocabs: Sequence[VocabBase],
                  options=None, inference: bool = False,
                  state: Optional[CorpusState] = None):
+        # --tsv: ONE tab-separated file carries every stream (reference:
+        # CorpusBase TSV mode); --tsv-fields pins the column count,
+        # defaulting to the vocab count
+        self.tsv = bool(options.get("tsv", False)) if options else False
+        self.tsv_fields = (int(options.get("tsv-fields", 0) or 0)
+                           if options else 0)
+        if self.tsv:
+            if len(paths) != 1:
+                raise ValueError(
+                    f"--tsv expects ONE tab-separated train file, got "
+                    f"{len(paths)}")
+            n_fields = self.tsv_fields or len(vocabs)
+            if n_fields != len(vocabs):
+                raise ValueError(
+                    f"--tsv-fields {n_fields} must match the number of "
+                    f"--vocabs ({len(vocabs)})")
+            paths = list(paths) * len(vocabs)   # stream i = column i
         assert len(paths) == len(vocabs), (paths, len(vocabs))
         self.paths = list(paths)
         self.vocabs = list(vocabs)
@@ -110,10 +127,21 @@ class Corpus:
         """Read the full corpus into RAM (the reference offers in-RAM shuffle
         via --shuffle-in-ram; NMT corpora of the baseline configs fit)."""
         if self._lines_cache is None:
-            streams = []
-            for p in self.paths:
-                with _open_maybe_gz(p) as fh:
-                    streams.append([l.rstrip("\n") for l in fh])
+            if self.tsv:
+                with _open_maybe_gz(self.paths[0]) as fh:
+                    rows = [l.rstrip("\n").split("\t") for l in fh]
+                k = len(self.vocabs)
+                for i, row in enumerate(rows):
+                    if len(row) != k:
+                        raise ValueError(
+                            f"--tsv: line {i + 1} of {self.paths[0]} has "
+                            f"{len(row)} fields, expected {k}")
+                streams = [[row[j] for row in rows] for j in range(k)]
+            else:
+                streams = []
+                for p in self.paths:
+                    with _open_maybe_gz(p) as fh:
+                        streams.append([l.rstrip("\n") for l in fh])
             n = len(streams[0])
             for p, s in zip(self.paths[1:], streams[1:]):
                 if len(s) != n:

@@ -30,6 +30,10 @@ class SentencePieceVocab(VocabBase):
                 "SentencePiece vocab requested but the 'sentencepiece' package "
                 "is not installed; use a .yml word vocab or install sentencepiece")
         self.alpha = 0.0
+        # --no-spm-encode: input text is ALREADY SentencePiece-encoded —
+        # split on whitespace and look pieces up instead of re-encoding
+        self.no_encode = bool(options.get("no-spm-encode", False)) \
+            if options is not None else False
         if options is not None:
             alphas = options.get("sentencepiece-alphas", [])
             if stream_index < len(alphas):
@@ -61,7 +65,9 @@ class SentencePieceVocab(VocabBase):
         os.replace(prefix + ".model", path)
 
     def encode(self, line: str, add_eos: bool = True, inference: bool = False) -> List[int]:
-        if self.alpha > 0 and not inference:
+        if self.no_encode:
+            ids = [self._sp.piece_to_id(t) for t in line.split()]
+        elif self.alpha > 0 and not inference:
             ids = self._sp.encode(line, out_type=int, enable_sampling=True,
                                   alpha=self.alpha, nbest_size=-1)
         else:

@@ -198,7 +198,9 @@ class EncoderDecoder:
         output_logits; see fused_ce.py docstring for the algebra)."""
         from ..ops.pallas.fused_ce import fused_softmax_xent
         b, t, e = hidden.shape
-        bias = cparams["decoder_ff_logit_out_b"].reshape(-1)
+        bias = cparams.get("decoder_ff_logit_out_b")
+        bias = (bias.reshape(-1) if bias is not None       # --output-omit-bias
+                else jnp.zeros((table.shape[0],), jnp.float32))
         ce = fused_softmax_xent(
             hidden.reshape(b * t, e), table, bias,
             batch["trg_ids"].reshape(-1), self.label_smoothing,

@@ -102,6 +102,10 @@ class TransformerConfig:
     decoder_autoreg: str = "self-attention"   # or "average-attention", "rnn"
     output_approx_knn: Tuple[int, ...] = ()   # --output-approx-knn (k, nbits)
     dim_aan: int = 2048                       # AAN FFN size (--transformer-dim-aan)
+    aan_depth: int = 2                        # --transformer-aan-depth
+    aan_activation: str = "swish"             # --transformer-aan-activation
+    aan_nogate: bool = False                  # --transformer-aan-nogate
+    output_omit_bias: bool = False            # --output-omit-bias
     # --transformer-tied-layers: 1-based map, entry i = the layer whose
     # parameters layer i+1 SHARES (e.g. (1,1,1,1,1,1) = ALBERT-style all
     # layers share layer 1). Applies to encoder and decoder stacks; runtime
@@ -258,6 +262,10 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         ulr_dropout=0.0 if for_inference else float(g("ulr-dropout", 0.0)
                                                     or 0.0),
         dim_aan=int(g("transformer-dim-aan", 2048)),
+        aan_depth=int(g("transformer-aan-depth", 2)),
+        aan_activation=str(g("transformer-aan-activation", "swish")),
+        aan_nogate=bool(g("transformer-aan-nogate", False)),
+        output_omit_bias=bool(g("output-omit-bias", False)),
         rnn_projection=bool(g("transformer-rnn-projection", False)),
         scan_layers=bool(g("scan-layers", True)),
         moe_experts=int(g("transformer-moe-experts", 0) or 0),
@@ -465,20 +473,25 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             p[f"{ep}_top_ln_scale"] = inits.ones((1, d))
             p[f"{ep}_top_ln_bias"] = inits.zeros((1, d))
 
-    def aan_block(prefix: str, layer: int):
+    def aan_block_params(prefix: str, layer: int):
         """Average Attention Network sublayer (reference:
         src/models/transformer.h :: LayerAAN / AverageAttention): FFN over
         the cumulative average + a sigmoid gate mixing with the input. The
         pre/post layer-norm params keep the `_self_Wo` naming so the Marian
         process strings apply unchanged."""
-        p[f"{prefix}_aan_W1"] = glorot((d, cfg.dim_aan), layer)
-        p[f"{prefix}_aan_b1"] = inits.zeros((1, cfg.dim_aan))
-        p[f"{prefix}_aan_W2"] = glorot((cfg.dim_aan, d), layer)
-        p[f"{prefix}_aan_b2"] = inits.zeros((1, d))
-        p[f"{prefix}_aan_Wi"] = glorot((d, d), layer)
-        p[f"{prefix}_aan_bi"] = inits.zeros((1, d))
-        p[f"{prefix}_aan_Wg"] = glorot((d, d), layer)
-        p[f"{prefix}_aan_bg"] = inits.zeros((1, d))
+        # --transformer-aan-depth: chain of `depth` dense layers
+        # d → aan → … → d (activation between, none after the last)
+        n = max(1, cfg.aan_depth)
+        for i in range(1, n + 1):
+            din = d if i == 1 else cfg.dim_aan
+            dout = d if i == n else cfg.dim_aan
+            p[f"{prefix}_aan_W{i}"] = glorot((din, dout), layer)
+            p[f"{prefix}_aan_b{i}"] = inits.zeros((1, dout))
+        if not cfg.aan_nogate:      # --transformer-aan-nogate drops these
+            p[f"{prefix}_aan_Wi"] = glorot((d, d), layer)
+            p[f"{prefix}_aan_bi"] = inits.zeros((1, d))
+            p[f"{prefix}_aan_Wg"] = glorot((d, d), layer)
+            p[f"{prefix}_aan_bg"] = inits.zeros((1, d))
         if "n" in cfg.preprocess or "n" in cfg.postprocess:
             p[f"{prefix}_self_Wo_ln_scale"] = inits.ones((1, d))
             p[f"{prefix}_self_Wo_ln_bias"] = inits.zeros((1, d))
@@ -501,7 +514,7 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
         if _tied(cfg, l) != l:
             continue
         if cfg.decoder_autoreg == "average-attention":
-            aan_block(f"decoder_l{l}", l)
+            aan_block_params(f"decoder_l{l}", l)
         elif cfg.decoder_autoreg == "rnn":
             rnn_block(f"decoder_l{l}", l)
         else:
@@ -515,7 +528,8 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
 
     if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
         p["decoder_ff_logit_out_W"] = glorot((d, _trg_rows(cfg)))
-    p["decoder_ff_logit_out_b"] = inits.zeros((1, _trg_rows(cfg)))
+    if not cfg.output_omit_bias:    # --output-omit-bias drops the term
+        p["decoder_ff_logit_out_b"] = inits.zeros((1, _trg_rows(cfg)))
     if cfg.trg_factors is not None and cfg.lemma_dim_emb > 0:
         # soft lemma re-embedding (--lemma-dim-emb; see TransformerConfig)
         p["decoder_lemma_reembed_W"] = glorot(
@@ -731,9 +745,15 @@ def _aan_apply(cfg: TransformerConfig, params: Params, lp: str,
     with the transformed average: out = g⊙x + (1-g)⊙FFN(avg)).
     `lp` is the layer param prefix (e.g. 'decoder_l3')."""
     pfx = f"{lp}_aan"
-    act = activation(cfg.ffn_activation)
-    h = act(affine(y_avg, params[f"{pfx}_W1"], params[f"{pfx}_b1"]))
-    y = affine(h, params[f"{pfx}_W2"], params[f"{pfx}_b2"])
+    act = activation(cfg.aan_activation)
+    y = y_avg
+    n = max(1, cfg.aan_depth)
+    for i in range(1, n + 1):       # --transformer-aan-depth dense chain
+        y = affine(y, params[f"{pfx}_W{i}"], params[f"{pfx}_b{i}"])
+        if i < n:
+            y = act(y)
+    if cfg.aan_nogate:              # --transformer-aan-nogate
+        return y
     gate = jax.nn.sigmoid(
         affine(x_in, params[f"{pfx}_Wi"], params[f"{pfx}_bi"])
         + affine(y, params[f"{pfx}_Wg"], params[f"{pfx}_bg"]))
@@ -1392,7 +1412,11 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
         table = params["Wemb"] if "Wemb" in params else params["decoder_Wemb"]
     else:
         table = None
-    b = params["decoder_ff_logit_out_b"]
+    # --output-omit-bias: no bias param; a constant zero keeps every
+    # branch below uniform and XLA folds the add away
+    b = params.get("decoder_ff_logit_out_b")
+    if b is None:
+        b = jnp.zeros((1, _trg_rows(cfg)), jnp.float32)
     if table is not None and isinstance(table, QTensor):
         # tied quantized table [V, d], per-row scales → int8 x @ table.T
         if cfg.trg_factors is not None:
@@ -1717,9 +1741,12 @@ def _final_logits(cfg: TransformerConfig, params: Params, state, x,
             and "lsh_planes" in state:
         from ..ops.lsh import lsh_logits
         table = _plain_output_table(cfg, params)
+        lsh_b = params.get("decoder_ff_logit_out_b")
+        if lsh_b is None:           # --output-omit-bias
+            lsh_b = jnp.zeros((1, _trg_rows(cfg)), jnp.float32)
         return lsh_logits(
             x[:, 0, :], table,
-            params["decoder_ff_logit_out_b"].reshape(-1),
+            lsh_b.reshape(-1),
             state["lsh_planes"], state["lsh_signatures"],
             k=int(cfg.output_approx_knn[0]))
     return output_logits(cfg, params, x[:, 0, :], shortlist)

@@ -51,6 +51,22 @@ class OptimizerConfig:
     # second moment v stays f32 (its sqrt sits in the update denominator,
     # where bf16's 8 mantissa bits would bite). Beyond the reference.
     state_dtype: str = "float32"       # float32 | bfloat16
+    # --normalize-gradient: additionally divide gradients by the batch's
+    # target-word count (reference: SyncGraphGroup multiplies the update
+    # normalizer by updateTrgWords when the flag is set)
+    normalize_gradient: bool = False
+    # --check-gradient-nan: skip the ENTIRE update (params + optimizer
+    # state unchanged) when the gradient norm is non-finite (reference:
+    # GraphGroup checkGradientNan); metrics carry skipped=1
+    check_gradient_nan: bool = False
+    # --dynamic-gradient-scaling FACTOR [log]: track a windowed average
+    # of the (log-)gradient norm; when a step's norm exceeds
+    # factor x average, scale the gradient down to that threshold
+    # (reference: costScaling/dynamic gradient scaling in
+    # training/graph_group.cpp — outlier-step protection)
+    dyn_scale_factor: float = 0.0      # 0 = off
+    dyn_scale_log: bool = False
+    norm_window: int = 100             # --gradient-norm-average-window
 
     @classmethod
     def from_options(cls, options) -> "OptimizerConfig":
@@ -70,7 +86,23 @@ class OptimizerConfig:
                   grad_drop_rate=float(
                       options.get("gradient-dropping-rate", 0.0) or 0.0),
                   state_dtype=str(options.get("optimizer-state-dtype",
-                                              "float32") or "float32"))
+                                              "float32") or "float32"),
+                  normalize_gradient=bool(
+                      options.get("normalize-gradient", False)),
+                  check_gradient_nan=bool(
+                      options.get("check-gradient-nan", False)),
+                  norm_window=int(
+                      options.get("gradient-norm-average-window", 100)
+                      or 100))
+        dyn = options.get("dynamic-gradient-scaling", []) or []
+        if dyn is True:
+            dyn = ["2"]
+        if isinstance(dyn, (str, int, float)):
+            dyn = [dyn]
+        if dyn:
+            cfg.dyn_scale_factor = float(dyn[0])
+            cfg.dyn_scale_log = any(str(v).lower() == "log"
+                                    for v in dyn[1:])
         if cfg.state_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"--optimizer-state-dtype {cfg.state_dtype}: expected "
@@ -110,6 +142,9 @@ def init_state(cfg: OptimizerConfig, params: Params) -> Dict[str, Any]:
     if cfg.grad_drop_rate > 0:    # gradient-dropping residual (DGC)
         st["gerr"] = {k: jnp.zeros(v.shape, jnp.float32)
                       for k, v in params.items()}
+    if cfg.dyn_scale_factor > 0:  # --dynamic-gradient-scaling statistics
+        st["gstat"] = {"avg": jnp.zeros((), jnp.float32),
+                       "n": jnp.zeros((), jnp.float32)}
     return st
 
 
@@ -183,6 +218,10 @@ def apply_update(cfg: OptimizerConfig, state: Dict[str, Any], params: Params,
         new_state["avg"] = {
             k: state["avg"][k] + tau * (out[k].astype(jnp.float32) - state["avg"][k])
             for k in params}
+    if "gstat" in state:
+        # dynamic-gradient-scaling statistics are updated by the caller
+        # (zero.py step_fn, which owns the gradient norm) — pass through
+        new_state["gstat"] = state["gstat"]
     return new_state, out
 
 

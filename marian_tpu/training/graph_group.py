@@ -334,7 +334,7 @@ class GraphGroup:
         from any pipeline layout) — the async saver snapshots these and
         fetches them off-thread."""
         flat: Dict[str, Any] = {"t": self.opt_state["t"]}
-        for part in ("m", "v", "gt", "avg", "qerr", "gerr"):
+        for part in ("m", "v", "gt", "avg", "qerr", "gerr", "gstat"):
             if part in self.opt_state:
                 for k, v in self._unstack(self.opt_state[part]).items():
                     # bf16 state (--optimizer-state-dtype) is stored as

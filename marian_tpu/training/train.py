@@ -50,9 +50,15 @@ class Train:
         train_sets = list(opts.get("train-sets"))
         vocab_paths = list(opts.get("vocabs", [])) or \
             [p + ".yml" for p in train_sets]
+        # --tsv: ONE file holds every stream — each vocab trains against
+        # the same file (an on-the-fly-trained vocab sees all columns,
+        # like the reference's TSV mode with a joint vocab)
+        train_per_vocab = (train_sets * len(vocab_paths)
+                           if opts.get("tsv", False) and len(train_sets) == 1
+                           else train_sets)
         dim_vocabs = list(opts.get("dim-vocabs", [0, 0]))
         vocabs = []
-        for i, (vp, tp) in enumerate(zip(vocab_paths, train_sets)):
+        for i, (vp, tp) in enumerate(zip(vocab_paths, train_per_vocab)):
             mx = dim_vocabs[i] if i < len(dim_vocabs) else 0
             vocabs.append(create_vocab(vp, opts, i, [tp], max_size=mx))
         log.info("Vocabulary sizes: {}", " ".join(str(len(v)) for v in vocabs))
@@ -316,6 +322,7 @@ def _native_batch_generator(opts, train_sets, vocabs):
     from ..data.vocab import DefaultVocab
     ga = opts.get("guided-alignment", "none")
     supported = (all(type(v) is DefaultVocab for v in vocabs)
+                 and not opts.get("tsv", False)   # TSV split is python-side
                  and (not ga or ga == "none")
                  and not opts.get("data-weighting", None)
                  # text augmentation hooks live only in the Python Corpus

@@ -51,6 +51,7 @@ class BeamConfig:
     # Each beam becomes an independent sample trajectory (gumbel-max over
     # the token log-probs — TPU-friendly: argmax, no host RNG in the loop).
     sampling: tuple = ()
+    word_scores: bool = False       # --word-scores: per-token logP in n-best
 
     @classmethod
     def from_options(cls, options, max_length: int) -> "BeamConfig":
@@ -67,6 +68,7 @@ class BeamConfig:
             if options.get("n-best", False) else 1,
             return_alignment=options.get("alignment", None) is not None,
             sampling=_parse_sampling(options.get("output-sampling", [])),
+            word_scores=bool(options.get("word-scores", False)),
         )
 
 
@@ -114,7 +116,8 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                     sample_key: Optional[jax.Array] = None,
                     prefix: Optional[jax.Array] = None):
     """The jittable core. Returns (tokens [B,K,L], raw_scores [B,K],
-    lengths [B,K], norm_scores [B,K], alignments [B,K,L,Ts] or None).
+    lengths [B,K], norm_scores [B,K], alignments [B,K,L,Ts] or None,
+    word_scores [B,K,L] — per-step chosen-token logP, --word-scores).
 
     params_list/weights: ensemble of scorers (reference: scorers.h); each
     scorer keeps its own decode state, log-probs are weight-summed.
@@ -151,11 +154,13 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                if cfg.return_alignment else jnp.zeros((0,), jnp.float32))
 
     def cond(carry):
-        t, _tokens, _scores, finished, _lengths, _prev, _states, _al = carry
+        (t, _tokens, _scores, finished, _lengths, _prev, _states, _al,
+         _ws) = carry
         return jnp.logical_and(t < L, ~jnp.all(finished))
 
     def body(carry):
-        t, tokens, scores, finished, lengths, prev, states, aligns = carry
+        (t, tokens, scores, finished, lengths, prev, states, aligns,
+         wscores) = carry
         # ensemble log-probs
         logp = None
         align_t = None
@@ -230,6 +235,16 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         tokens = reorder(tokens)
         tokens = jax.lax.dynamic_update_index_in_dim(
             tokens, tok_full.astype(jnp.int32), t, axis=2)
+        if cfg.word_scores:
+            # per-word score = this step's cumulative minus the SOURCE
+            # beam's previous cumulative (--word-scores output; frozen
+            # beams pick EOS at logP 0 so their trace stops moving).
+            # Gated: the [B,K,L] carry + per-step reorder/scatter are
+            # dead weight for ordinary decodes (cf. aligns0)
+            prev_sel = jnp.take_along_axis(scores, beam_idx, axis=1)
+            wscores = reorder(wscores)
+            wscores = jax.lax.dynamic_update_index_in_dim(
+                wscores, top_scores - prev_sel, t, axis=2)
         was_finished = reorder(finished.astype(jnp.int32)).astype(bool)
         lengths = reorder(lengths)
         if cfg.return_alignment:
@@ -267,11 +282,13 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         states2 = tuple(reorder_state(st) for st in new_states)
         prev = tok_full.reshape(bk, 1)
         return (t + 1, tokens, scores, new_finished, lengths, prev, states2,
-                aligns)
+                aligns, wscores)
 
     init = (jnp.zeros((), jnp.int32), tokens0, scores0, finished0, lengths0,
-            prev0, tuple(states), aligns0)
-    (t, tokens, scores, finished, lengths, prev, states, aligns) = \
+            prev0, tuple(states), aligns0,
+            (jnp.zeros((b, k, L), jnp.float32) if cfg.word_scores
+             else jnp.zeros((0,), jnp.float32)))
+    (t, tokens, scores, finished, lengths, prev, states, aligns, wscores) = \
         jax.lax.while_loop(cond, body, init)
 
     # unfinished beams at L: length = L
@@ -281,7 +298,8 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         norm = jnp.power(lengths.astype(jnp.float32), cfg.normalize)
     norm_scores = scores / norm - cfg.word_penalty * lengths.astype(jnp.float32)
     return tokens, scores, lengths, norm_scores, \
-        (aligns if cfg.return_alignment else None)
+        (aligns if cfg.return_alignment else None), \
+        (wscores if cfg.word_scores else None)
 
 
 def _eos_index(shortlist: Optional[jax.Array]):
@@ -379,7 +397,7 @@ class BeamSearch:
             pfx[:, :p.shape[1]] = p
             pfx = jnp.asarray(pfx)
         args = (tuple(self.params_list), _dev(src_ids), _dev(src_mask))
-        tokens, scores, lengths, norm_scores, aligns = fn(
+        tokens, scores, lengths, norm_scores, aligns, wscores = fn(
             *args, shortlist=sl_idx, sample_key=sample_key, prefix=pfx)
         # device results stay lazy here — collect() forces them. Callers
         # that pipeline (translator driver) dispatch the NEXT batch's
@@ -387,7 +405,7 @@ class BeamSearch:
         # overlaps device beam steps (the role of the reference
         # translator's worker thread pool, played by XLA async dispatch).
         return _SearchHandle(tokens, scores, lengths, norm_scores, aligns,
-                             cfg, self)
+                             wscores, cfg, self)
 
     def search(self, src_ids, src_mask,
                shortlist=None, prefix=None) -> List[List[dict]]:
@@ -399,7 +417,7 @@ class BeamSearch:
                                  prefix=prefix).collect()
 
     def _collect(self, tokens, scores, lengths, norm_scores, aligns,
-                 cfg: BeamConfig) -> List[List[dict]]:  # noqa: C901
+                 cfg: BeamConfig, wscores=None) -> List[List[dict]]:  # noqa: C901
         b, k, L = tokens.shape
         out = []
         for i in range(b):
@@ -418,6 +436,11 @@ class BeamSearch:
                 }
                 if aligns is not None:
                     entry["alignment"] = aligns[i, j, :ln, :]
+                if wscores is not None:
+                    # per emitted token, incl. the EOS step (Marian's
+                    # WordScores covers the terminating </s>)
+                    entry["word_scores"] = [
+                        float(x) for x in wscores[i, j, :ln]]
                 nbest.append(entry)
             out.append(nbest)
         return out
@@ -431,14 +454,15 @@ class _SearchHandle:
     the last behind device compute."""
 
     def __init__(self, tokens, scores, lengths, norm_scores, aligns,
-                 cfg, bs: "BeamSearch"):
-        self._dev = (tokens, scores, lengths, norm_scores, aligns)
+                 wscores, cfg, bs: "BeamSearch"):
+        self._dev = (tokens, scores, lengths, norm_scores, aligns, wscores)
         self._cfg = cfg
         self._bs = bs
 
     def collect(self) -> List[List[dict]]:
-        tokens, scores, lengths, norm_scores, aligns = self._dev
+        tokens, scores, lengths, norm_scores, aligns, ws = self._dev
         return self._bs._collect(
             np.asarray(tokens), np.asarray(scores), np.asarray(lengths),
             np.asarray(norm_scores),
-            None if aligns is None else np.asarray(aligns), self._cfg)
+            None if aligns is None else np.asarray(aligns), self._cfg,
+            wscores=np.asarray(ws) if self._cfg.word_scores else None)

@@ -96,14 +96,30 @@ class OutputPrinter:
         if not self.n_best:
             h = nbest[0]
             out = self._detok(h["tokens"])
+            if "word_scores" in h:
+                # --word-scores applies to single-best output too
+                # (reference: OutputPrinter::print appends the segment)
+                ws = h["word_scores"]
+                if self.right_left and len(ws) > 1:
+                    ws = ws[-2::-1] + ws[-1:]
+                out += " ||| WordScores= " \
+                    + " ".join(f"{x:.6f}" for x in ws)
             if self.align_mode and "alignment" in h:
                 out += " ||| " + self._align_str(self._align_of(h))
             return out
         lines = []
         for h in nbest:
-            parts = [str(sentence_id), self._detok(h["tokens"]),
-                     f"{self.feature}= {h['score']:.6f}",
-                     f"{h['norm_score']:.6f}"]
+            parts = [str(sentence_id), self._detok(h["tokens"])]
+            if "word_scores" in h:
+                # --word-scores (reference: OutputPrinter WordScores
+                # segment): per emitted token incl. the terminating </s>
+                ws = h["word_scores"]
+                if self.right_left and len(ws) > 1:
+                    ws = ws[-2::-1] + ws[-1:]
+                parts.append("WordScores= "
+                             + " ".join(f"{x:.6f}" for x in ws))
+            parts += [f"{self.feature}= {h['score']:.6f}",
+                      f"{h['norm_score']:.6f}"]
             line = " ||| ".join(parts)
             if self.align_mode and "alignment" in h:
                 line += " ||| " + self._align_str(self._align_of(h))

@@ -168,7 +168,7 @@ class TestFactoredModel:
         src = jnp.asarray(rng.randint(2, v, (2, 5)), jnp.int32)
         mask = jnp.ones((2, 5), jnp.float32)
         cfg = BeamConfig(beam_size=2, max_length=6)
-        tokens, scores, lengths, norm, _ = beam_search_jit(
+        tokens, scores, lengths, norm, _, _ws = beam_search_jit(
             model, [params], [1.0], cfg, src, mask)
         assert tokens.shape == (2, 2, 6)
         assert int(tokens.max()) < v
@@ -249,7 +249,7 @@ class TestConcatFactors:
         assert float(jnp.sum(jnp.abs(grads["encoder_Wemb_factors"]))) > 0
         from marian_tpu.translator.beam_search import (BeamConfig,
                                                        beam_search_jit)
-        tokens, _, _, norm, _ = beam_search_jit(
+        tokens, _, _, norm, _, _ws = beam_search_jit(
             model, [params], [1.0], BeamConfig(beam_size=2, max_length=5),
             batch["src_ids"], batch["src_mask"])
         assert np.all(np.isfinite(np.asarray(norm)))

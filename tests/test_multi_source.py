@@ -99,7 +99,7 @@ class TestMultiSource:
         src = (batch["src_ids"], batch["src2_ids"])
         masks = (batch["src_mask"], batch["src2_mask"])
         cfg = BeamConfig(beam_size=2, max_length=6)
-        tokens, scores, lengths, norm, _ = beam_search_jit(
+        tokens, scores, lengths, norm, _, _ws = beam_search_jit(
             model, [params], [1.0], cfg, src, masks)
         assert tokens.shape == (2, 2, 6)
         assert np.all(np.isfinite(np.asarray(norm)))
@@ -275,7 +275,7 @@ class TestMultiS2S:
         batch = multi_batch(rng)
         src = (batch["src_ids"], batch["src2_ids"])
         masks = (batch["src_mask"], batch["src2_mask"])
-        tokens, _, _, norm, _ = beam_search_jit(
+        tokens, _, _, norm, _, _ws = beam_search_jit(
             model, [params], [1.0], BeamConfig(beam_size=2, max_length=6),
             src, masks)
         assert tokens.shape == (2, 2, 6)

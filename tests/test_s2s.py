@@ -195,7 +195,7 @@ class TestS2SModel:
         model, params = make_model()
         batch = fake_batch(rng, b=2, ts=6)
         cfg = BeamConfig(beam_size=3, max_length=7, normalize=0.6)
-        tokens, scores, lengths, norm_scores, _ = beam_search_jit(
+        tokens, scores, lengths, norm_scores, _, _ws = beam_search_jit(
             model, [params], [1.0], cfg, batch["src_ids"], batch["src_mask"])
         assert tokens.shape == (2, 3, 7)
         assert np.all(np.isfinite(np.asarray(norm_scores)))
