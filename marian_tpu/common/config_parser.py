@@ -181,6 +181,17 @@ _TRAINING = [
     _f("tsv", bool, False, "Train sets are tab-separated files (one line carries all streams)", "training"),
     _f("tsv-fields", int, 0, "Number of TSV columns (0 = infer from --vocabs count)", "training"),
     _f("no-spm-encode", bool, False, "Input is already SentencePiece-encoded: skip encoding, split on whitespace", "training"),
+    _f("input-reorder", int, [], "Permutation applied to TSV columns before they become streams, e.g. 1 0", "training", "*"),
+    _f("fp16", bool, False, "Half-precision shortcut: maps to bfloat16 compute on TPU (fp16's narrow exponent needs loss scaling; bf16 keeps the f32 range)", "training"),
+    _f("throw-on-divergence", bool, False, "Raise (instead of logging) when the training cost goes non-finite, so orchestration restarts from the last checkpoint", "training"),
+    _f("diverged-after", str, None, "fp16 divergence-recovery horizon (no-op; see flag audit)", "training", "?"),
+    _f("custom-fallbacks", str, [], "fp16 fallback config list (no-op; see flag audit)", "training", "*"),
+    _f("fp16-fallback-to-fp32", bool, False, "fp16 fallback (no-op; see flag audit)", "training"),
+    _f("recover-from-fallback-after", str, None, "fp16 fallback recovery (no-op; see flag audit)", "training", "?"),
+    _f("overwrite-checkpoint", bool, True, "Overwrite the single rolling checkpoint (no-op; see flag audit)", "training"),
+    _f("clip-gemm", float, 0.0, "Legacy GEMM clipping (no-op; see flag audit)", "training"),
+    _f("optimize", bool, False, "Legacy optimized int16 GEMM switch (no-op; see flag audit)", "translate"),
+    _f("model-mmap", bool, False, "Memory-map model loading (no-op; .bin checkpoints are always mmap-loaded)", "translate"),
     _f("mini-batch", int, 64, "Minibatch size (sentences)", "training"),
     _f("mini-batch-words", int, 0, "Minibatch size in target labels (token budget)", "training"),
     _f("mini-batch-fit", bool, False, "Determine minibatch automatically from workspace (TPU: bucket table)", "training"),
@@ -298,6 +309,7 @@ _TRANSLATION = [
     _f("allow-special", bool, False, "Allow special symbols in output", "translate"),
     _f("n-best", bool, False, "Produce n-best lists", "translate"),
     _f("word-scores", bool, False, "Print per-word scores in n-best lists", "translate"),
+    _f("n-best-feature", str, "Score", "Feature name for the n-best score column", "translate"),
     _f("alignment", str, None, "Return word alignments: 0.x threshold, soft, hard", "translate", "?"),
     _f("force-decode", bool, False, "Force-decode given prefixes", "translate"),
     _f("best-deep", bool, False, "(compat)", "translate"),
@@ -416,9 +428,30 @@ class ConfigParser:
 
         if merged.get("no-shuffle"):
             merged["shuffle"] = "none"
+        if merged.get("fp16"):
+            # --fp16 shortcut (reference: precision float16 float32 +
+            # cost-scaling defaults). On TPU fp16's 5-bit exponent would
+            # need the whole loss-scaling apparatus; bf16 keeps the f32
+            # range, so the shortcut maps there — same memory/matmul
+            # savings, no scaling machinery. An explicit --precision wins.
+            if "precision" not in explicit:
+                merged["precision"] = ["bfloat16", "float32"]
+        if str((merged.get("precision") or ["float32"])[0]) in (
+                "float16", "fp16", "half"):
+            from . import logging as _log
+            _log.warn("precision float16 is mapped to bfloat16 on TPU "
+                      "(same width, f32 exponent range — no loss scaling "
+                      "needed)")
+            merged["precision"] = ["bfloat16"] + \
+                list(merged["precision"][1:])
         # bare `--output-sampling` (Marian shorthand) = full sampling, temp 1
         if cli.get("output-sampling") == []:
             merged["output-sampling"] = ["full"]
+        # bare `--dynamic-gradient-scaling` = factor 2 (same default the
+        # YAML `true` spelling gets)
+        if cli.get("dynamic-gradient-scaling") == [] \
+                or merged.get("dynamic-gradient-scaling") is True:
+            merged["dynamic-gradient-scaling"] = ["2"]
         if cli.get("interpolate-env-vars") or merged.get("interpolate-env-vars"):
             merged = _interpolate_env_vars(merged)
 
@@ -609,9 +642,29 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
                             "key-vectors file, not this flag"),
     "interpolate-env-vars": ("none", "handled at config load"),
     "relative-paths": ("none", "handled at config load"),
+    "fp16": ("none", "handled at config load (maps to bfloat16 precision)"),
     "sqlite-drop": ("warn", "the resumable in-RAM corpus replaces the "
                             "SQLite shuffle database; there is nothing "
                             "to drop"),
+    "diverged-after": ("warn", "fp16 divergence recovery does not apply: "
+                               "bf16 keeps the f32 exponent range; use "
+                               "--throw-on-divergence + "
+                               "--check-gradient-nan"),
+    "custom-fallbacks": ("warn", "fp16 fallback machinery does not apply "
+                                 "to bf16 training"),
+    "fp16-fallback-to-fp32": ("warn", "fp16 fallback machinery does not "
+                                      "apply to bf16 training"),
+    "recover-from-fallback-after": ("warn", "fp16 fallback machinery does "
+                                           "not apply to bf16 training"),
+    "overwrite-checkpoint": ("warn", "checkpoint rotation is governed by "
+                                     "--overwrite (.iterN copies)"),
+    "clip-gemm": ("warn", "legacy intgemm clipping; XLA int8 GEMMs "
+                          "quantize with per-channel scales instead"),
+    "optimize": ("warn", "legacy int16 GEMM switch; use an int8 "
+                         "marian-conv checkpoint for quantized decode"),
+    "model-mmap": ("warn", ".bin checkpoints are always mmap-loaded; "
+                           ".npz loads copy (convert with marian-conv "
+                           "for mmap)"),
     "mini-batch-track-optimum": ("warn", "bucketed static batch shapes "
                                          "replace dynamic batch-size "
                                          "tracking"),

@@ -87,6 +87,10 @@ class Corpus:
         self.tsv = bool(options.get("tsv", False)) if options else False
         self.tsv_fields = (int(options.get("tsv-fields", 0) or 0)
                            if options else 0)
+        # --input-reorder: permutation mapping stream i ← column perm[i]
+        self.input_reorder = [int(i) for i in
+                              (options.get("input-reorder", []) or [])] \
+            if options else []
         if self.tsv:
             if len(paths) != 1:
                 raise ValueError(
@@ -136,7 +140,14 @@ class Corpus:
                         raise ValueError(
                             f"--tsv: line {i + 1} of {self.paths[0]} has "
                             f"{len(row)} fields, expected {k}")
-                streams = [[row[j] for row in rows] for j in range(k)]
+                cols = list(range(k))
+                if self.input_reorder:   # --input-reorder permutation
+                    if sorted(self.input_reorder) != cols:
+                        raise ValueError(
+                            f"--input-reorder {self.input_reorder} is not "
+                            f"a permutation of 0..{k - 1}")
+                    cols = self.input_reorder
+                streams = [[row[j] for row in rows] for j in cols]
             else:
                 streams = []
                 for p in self.paths:

@@ -200,7 +200,7 @@ class EncoderDecoder:
         b, t, e = hidden.shape
         bias = cparams.get("decoder_ff_logit_out_b")
         bias = (bias.reshape(-1) if bias is not None       # --output-omit-bias
-                else jnp.zeros((table.shape[0],), jnp.float32))
+                else jnp.zeros((table.shape[0],), hidden.dtype))
         ce = fused_softmax_xent(
             hidden.reshape(b * t, e), table, bias,
             batch["trg_ids"].reshape(-1), self.label_smoothing,

@@ -1413,10 +1413,11 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
     else:
         table = None
     # --output-omit-bias: no bias param; a constant zero keeps every
-    # branch below uniform and XLA folds the add away
+    # branch below uniform and XLA folds the add away. Activation dtype:
+    # an f32 zero would silently promote the [B,V] logits under bf16
     b = params.get("decoder_ff_logit_out_b")
     if b is None:
-        b = jnp.zeros((1, _trg_rows(cfg)), jnp.float32)
+        b = jnp.zeros((1, _trg_rows(cfg)), x.dtype)
     if table is not None and isinstance(table, QTensor):
         # tied quantized table [V, d], per-row scales → int8 x @ table.T
         if cfg.trg_factors is not None:
@@ -1742,8 +1743,8 @@ def _final_logits(cfg: TransformerConfig, params: Params, state, x,
         from ..ops.lsh import lsh_logits
         table = _plain_output_table(cfg, params)
         lsh_b = params.get("decoder_ff_logit_out_b")
-        if lsh_b is None:           # --output-omit-bias
-            lsh_b = jnp.zeros((1, _trg_rows(cfg)), jnp.float32)
+        if lsh_b is None:           # --output-omit-bias (activation dtype)
+            lsh_b = jnp.zeros((1, _trg_rows(cfg)), x.dtype)
         return lsh_logits(
             x[:, 0, :], table,
             lsh_b.reshape(-1),

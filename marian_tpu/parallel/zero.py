@@ -36,6 +36,63 @@ from . import tensor as T
 Params = Dict[str, jax.Array]
 
 
+def finalize_update(opt_cfg: OptimizerConfig, opt_state, p, grads,
+                    lr, labels, denom):
+    """The shared tail of every update path (fused step AND the
+    heterogeneous-delay host loop): cost normalization →
+    --normalize-gradient → --dynamic-gradient-scaling (stats in
+    opt_state['gstat']; outliers scaled down to factor x windowed
+    average) → --clip-norm (sees the scaled norm, so the cap composes
+    as min, never the product) → optimizer apply →
+    --check-gradient-nan (non-finite norm reverts params + every
+    optimizer-state part). Returns (new_p, new_opt, raw_gnorm,
+    skipped)."""
+    if opt_cfg.normalize_gradient:
+        # reference: update normalizer x= updateTrgWords
+        denom = denom * jnp.maximum(labels, 1.0)
+    grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+
+    gnorm = global_norm(grads)
+    post_dyn_norm = gnorm
+    opt_in = opt_state
+    if opt_cfg.dyn_scale_factor > 0:
+        # windowed running average of the (log-)norm; non-finite norms
+        # leave the average untouched (one NaN must not poison it)
+        gstat = opt_state["gstat"]
+        finite = jnp.isfinite(gnorm)
+        x = jnp.log(jnp.maximum(gnorm, 1e-30)) \
+            if opt_cfg.dyn_scale_log else gnorm
+        n = gstat["n"] + jnp.where(finite, 1.0, 0.0)
+        w = jnp.minimum(jnp.maximum(n, 1.0), float(opt_cfg.norm_window))
+        avg = jnp.where(finite, gstat["avg"] + (x - gstat["avg"]) / w,
+                        gstat["avg"])
+        thresh = (jnp.exp(avg) * opt_cfg.dyn_scale_factor
+                  if opt_cfg.dyn_scale_log
+                  else avg * opt_cfg.dyn_scale_factor)
+        # statistics need a few steps before the threshold means much
+        warm = n >= jnp.minimum(10.0, float(opt_cfg.norm_window))
+        scale = jnp.where(warm & finite & (gnorm > thresh),
+                          thresh / jnp.maximum(gnorm, 1e-30), 1.0)
+        grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        post_dyn_norm = gnorm * scale
+        opt_in = {**opt_state, "gstat": {"avg": avg, "n": n}}
+
+    if opt_cfg.clip_norm > 0:
+        grads = clip_by_global_norm(grads, opt_cfg.clip_norm,
+                                    post_dyn_norm)
+
+    new_opt, new_p = apply_update(opt_cfg, opt_in, p, grads, lr, labels)
+    skipped = jnp.zeros((), jnp.float32)
+    if opt_cfg.check_gradient_nan:
+        ok = jnp.isfinite(gnorm)
+        new_p = jax.tree_util.tree_map(
+            lambda n_, o: jnp.where(ok, n_, o), new_p, p)
+        new_opt = jax.tree_util.tree_map(
+            lambda n_, o: jnp.where(ok, n_, o), new_opt, opt_state)
+        skipped = jnp.where(ok, 0.0, 1.0)
+    return new_p, new_opt, gnorm, skipped
+
+
 def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                      mesh: Mesh, params: Params, opt_state,
                      delay: int = 1, donate: bool = True, shardings=None,
@@ -98,64 +155,17 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
             denom = jnp.asarray(float(bsz), jnp.float32)
         else:
             denom = jnp.asarray(1.0, jnp.float32)
-        if opt_cfg.normalize_gradient:
-            # --normalize-gradient: additionally divide by target words
-            # (reference: update normalizer x= updateTrgWords)
-            denom = denom * jnp.maximum(labels, 1.0)
-        grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
-
-        gnorm = global_norm(grads)
-
-        # dynamic scaling runs BEFORE clipping, on the raw norm; the clip
-        # then sees the scaled norm (a scalar multiply scales the global
-        # norm linearly), so the effective cap is min(clip, threshold) —
-        # never the product of both reductions
-        post_dyn_norm = gnorm
-        opt_in = opt_state
-        if opt_cfg.dyn_scale_factor > 0:
-            # --dynamic-gradient-scaling: windowed running average of the
-            # (log-)norm; an outlier step is scaled DOWN to
-            # factor x average. Non-finite norms leave the average
-            # untouched (one NaN must not poison the statistics).
-            gstat = opt_state["gstat"]
-            finite = jnp.isfinite(gnorm)
-            x = jnp.log(jnp.maximum(gnorm, 1e-30)) \
-                if opt_cfg.dyn_scale_log else gnorm
-            n = gstat["n"] + jnp.where(finite, 1.0, 0.0)
-            w = jnp.minimum(jnp.maximum(n, 1.0),
-                            float(opt_cfg.norm_window))
-            avg = jnp.where(finite, gstat["avg"] + (x - gstat["avg"]) / w,
-                            gstat["avg"])
-            thresh = (jnp.exp(avg) * opt_cfg.dyn_scale_factor
-                      if opt_cfg.dyn_scale_log
-                      else avg * opt_cfg.dyn_scale_factor)
-            # statistics need a few steps before the threshold is
-            # meaningful (reference waits for the averaging window)
-            warm = n >= jnp.minimum(10.0, float(opt_cfg.norm_window))
-            scale = jnp.where(warm & finite & (gnorm > thresh),
-                              thresh / jnp.maximum(gnorm, 1e-30), 1.0)
-            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            post_dyn_norm = gnorm * scale
-            opt_in = {**opt_state, "gstat": {"avg": avg, "n": n}}
-
-        if opt_cfg.clip_norm > 0:
-            grads = clip_by_global_norm(grads, opt_cfg.clip_norm,
-                                        post_dyn_norm)
-
         lr = schedule(step)
-        new_opt, new_p = apply_update(opt_cfg, opt_in, p, grads, lr, labels)
+        new_p, new_opt, gnorm, skipped = finalize_update(
+            opt_cfg, opt_state, p, grads, lr, labels, denom)
         metrics = {"ce_sum": ce_sum, "labels": labels, "gnorm": gnorm,
                    "lr": lr}
         if opt_cfg.check_gradient_nan:
-            # --check-gradient-nan: a non-finite gradient norm skips the
-            # WHOLE update — params and every optimizer-state part keep
-            # their previous values (reference: GraphGroup nan check)
-            ok = jnp.isfinite(gnorm)
-            new_p = jax.tree_util.tree_map(
-                lambda n_, o: jnp.where(ok, n_, o), new_p, p)
-            new_opt = jax.tree_util.tree_map(
-                lambda n_, o: jnp.where(ok, n_, o), new_opt, opt_state)
-            metrics["skipped"] = jnp.where(ok, 0.0, 1.0)
+            metrics["skipped"] = skipped
+            # a skipped batch must not poison the display window's cost
+            # (nan ce_sum would read as divergence the skip just averted)
+            metrics["ce_sum"] = jnp.where(skipped > 0, 0.0, ce_sum)
+            metrics["labels"] = jnp.where(skipped > 0, 0.0, labels)
         return new_p, new_opt, metrics
 
     rep = M.replicated(mesh)

@@ -240,13 +240,13 @@ class GraphGroup:
                 denom = jnp.maximum(n_sents, 1.0)
             else:
                 denom = jnp.asarray(1.0, jnp.float32)
-            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
-            gnorm = global_norm(grads)
-            if opt_cfg.clip_norm > 0:
-                grads = clip_by_global_norm(grads, opt_cfg.clip_norm, gnorm)
             lr = schedule(step)
-            new_opt, new_p = apply_update(opt_cfg, opt_state, p, grads, lr,
-                                          labels)
+            # shared tail (zero.py finalize_update): normalize-gradient,
+            # dynamic scaling, clip-as-min, nan-skip — the heterogeneous-
+            # delay fallback must not silently drop those flags
+            from ..parallel.zero import finalize_update
+            new_p, new_opt, gnorm, _skipped = finalize_update(
+                opt_cfg, opt_state, p, grads, lr, labels, denom)
             return new_p, new_opt, gnorm, lr
 
         self._update_fn = jax.jit(

@@ -9,12 +9,20 @@ Ep. 1 : Up. 1000 : Sen. 12,345 : Cost 4.52 : Time 12.3s : 45000.0 words/s : L.r.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Dict, List, Optional
 
 from ..common import logging as log
 from ..common.scheduling_parameter import SchedulingParameter, SchedulingUnit
 from .training_state import TrainingState
+
+
+class DivergenceError(RuntimeError):
+    """--throw-on-divergence: training cost went non-finite (reference:
+    divergence detection in training/scheduler.cpp — abort loudly so an
+    orchestrator restarts from the last checkpoint instead of burning
+    device hours on a dead run)."""
 
 
 class Scheduler:
@@ -114,10 +122,20 @@ class Scheduler:
         dt = max(time.perf_counter() - self._timer, 1e-9)
         cost_type = self.options.get("cost-type", "ce-sum")
         self._cost_sum = float(self._cost_sum)   # the one deferred sync
+        if not math.isfinite(self._cost_sum):
+            # divergence surfaces here, at the display boundary — the hot
+            # loop never syncs per step (reference: --throw-on-divergence
+            # aborts so orchestration restarts from the last checkpoint)
+            if self.options.get("throw-on-divergence", False):
+                raise DivergenceError(
+                    f"training diverged: non-finite cost at update "
+                    f"{s.batches} (--throw-on-divergence)")
+            log.warn("Non-finite training cost at update {} — continuing "
+                     "(--throw-on-divergence not set; consider "
+                     "--check-gradient-nan)", s.batches)
         if cost_type == "ce-mean-words" or cost_type == "ce-sum":
             cost = self._cost_sum / max(self._label_sum, 1.0)
         elif cost_type == "perplexity":
-            import math
             cost = math.exp(min(self._cost_sum / max(self._label_sum, 1.0), 700))
         else:
             cost = self._cost_sum / max(self._sent_sum, 1)

@@ -53,6 +53,59 @@ class TestTsvCorpus:
             Corpus(["a.tsv", "b.tsv"], [v, v], Options({"tsv": True}))
 
 
+class TestInputReorder:
+    def test_permutes_tsv_columns(self, tmp_path):
+        p = tmp_path / "t.tsv"
+        p.write_text("src line\ttrg line\n")
+        v = DefaultVocab.build(["src trg line"])
+        corpus = Corpus([str(p)], [v, v],
+                        Options({"tsv": True, "input-reorder": [1, 0],
+                                 "shuffle": "none"}))
+        t = list(corpus)[0]
+        assert v.decode(t.streams[0]) == "trg line"
+        assert v.decode(t.streams[1]) == "src line"
+
+    def test_rejects_non_permutation(self, tmp_path):
+        p = tmp_path / "t.tsv"
+        p.write_text("a\tb\n")
+        v = DefaultVocab.build(["a b"])
+        with pytest.raises(ValueError, match="permutation"):
+            list(Corpus([str(p)], [v, v],
+                        Options({"tsv": True, "input-reorder": [0, 2]})))
+
+
+class TestFp16AndDivergence:
+    def test_fp16_maps_to_bf16(self, tmp_path):
+        from marian_tpu.common.config_parser import parse_options
+        opts = parse_options(
+            ["--type", "transformer", "--fp16",
+             "--train-sets", "a.src", "a.trg",
+             "--vocabs", "v.src", "v.trg", "--model", "m.npz"],
+            mode="training", validate=False)
+        assert list(opts.get("precision"))[0] == "bfloat16"
+        # explicit --precision wins over the shortcut
+        opts2 = parse_options(
+            ["--type", "transformer", "--fp16",
+             "--precision", "float32", "float32",
+             "--train-sets", "a.src", "a.trg",
+             "--vocabs", "v.src", "v.trg", "--model", "m.npz"],
+            mode="training", validate=False)
+        assert list(opts2.get("precision"))[0] == "float32"
+
+    def test_throw_on_divergence(self):
+        from marian_tpu.training.scheduler import (DivergenceError,
+                                                   Scheduler)
+        from marian_tpu.training.training_state import TrainingState
+        sch = Scheduler(Options({"disp-freq": 1,
+                                 "throw-on-divergence": True}),
+                        TrainingState())
+        with pytest.raises(DivergenceError, match="non-finite"):
+            sch.update(float("nan"), 10, 2)
+        # without the flag: logged, not raised
+        sch2 = Scheduler(Options({"disp-freq": 1}), TrainingState())
+        sch2.update(float("nan"), 10, 2)
+
+
 def _model_and_batch(rng, **over):
     base = {"type": "transformer", "dim-emb": 16, "transformer-heads": 2,
             "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,

@@ -81,6 +81,17 @@ class TestCheckGradientNan:
                                       w_before)
         assert float(np.asarray(gg.opt_state["t"])) == 0.0
 
+    def test_skipped_batch_does_not_poison_metrics(self):
+        """The skip must also zero the reported ce_sum/labels — a nan
+        loss flowing into the scheduler would read as the divergence the
+        skip just averted (interacts with --throw-on-divergence)."""
+        gg = self._poisoned(**{"check-gradient-nan": True})
+        out = gg.update(_batch(), 1,
+                        prng.stream(prng.root_key(21),
+                                    prng.STREAM_DROPOUT))
+        assert float(np.asarray(out.loss_sum)) == 0.0
+        assert float(np.asarray(out.labels)) == 0.0
+
     def test_without_flag_nan_propagates(self):
         gg = self._poisoned()
         gg.update(_batch(), 1,
