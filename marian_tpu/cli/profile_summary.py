@@ -103,6 +103,15 @@ def summarize(trace_dir: str, top_n: int = 25) -> int:
         "busy (no device lanes in trace — over all runtime lanes)"
     print(f"{label} {busy_us/1e3:.1f} ms = {100*busy_us/window_us:.1f}% "
           f"of window → host/idle gaps {100*(1-busy_us/window_us):.1f}%")
+    # rollup by op family (dot.123 → dot, fusion.5 → fusion): the
+    # matmul-vs-elementwise-vs-copy split in three lines
+    fam = defaultdict(float)
+    for name, (tot, _cnt) in by_name.items():
+        fam[name.split(".")[0].split("(")[0].strip()[:40]] += tot
+    top_fam = sorted(fam.items(), key=lambda kv: -kv[1])[:10]
+    print("by op family: "
+          + "  ".join(f"{n}={t/1e3:.1f}ms({100*t/total_us:.0f}%)"
+                      for n, t in top_fam))
     print(f"{'total ms':>10} {'mean us':>9} {'count':>7} "
           f"{'%Σ':>6}  op")
     for name, (tot, cnt) in rows:

@@ -1,0 +1,90 @@
+"""Coverage for the small common/ pieces that had none: logging
+(Marian-format lines, --log/--valid-log files, --quiet), Timer, and the
+initializer library (layers/initializers.py)."""
+
+import logging as pylogging
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common import logging as mlog
+
+
+@pytest.fixture(autouse=True)
+def _restore_loggers():
+    yield
+    # leave the module in its default state for later tests
+    mlog.create_loggers(None)
+
+
+class TestLogging:
+    def test_marian_line_format(self, capsys):
+        mlog.create_loggers(None)
+        mlog.info("Hello {} {}", "a", 1)
+        err = capsys.readouterr().err
+        # [2026-07-30 12:34:56] Hello a 1
+        assert re.search(r"^\[\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}\] "
+                         r"Hello a 1$", err.strip())
+
+    def test_log_files_and_valid_prefix(self, tmp_path):
+        lf = tmp_path / "train.log"
+        vf = tmp_path / "valid.log"
+        mlog.create_loggers(Options({"log": str(lf),
+                                     "valid-log": str(vf)}))
+        mlog.info("general line")
+        mlog.log_valid("info", "bleu {}", 33.3)
+        for h in pylogging.getLogger("marian.general").handlers:
+            h.flush()
+        for h in pylogging.getLogger("marian.valid").handlers:
+            h.flush()
+        assert "general line" in lf.read_text()
+        vtext = vf.read_text()
+        assert "[valid] bleu 33.3" in vtext
+
+    def test_quiet_suppresses_stderr(self, capsys):
+        mlog.create_loggers(Options({"quiet": True}))
+        mlog.info("should not appear")
+        assert capsys.readouterr().err == ""
+
+    def test_bad_placeholder_degrades(self, capsys):
+        mlog.create_loggers(None)
+        mlog.info("only {} one", "x", "extra")   # too many args
+        assert "only x one" in capsys.readouterr().err
+
+
+class TestTimer:
+    def test_elapsed_monotonic(self):
+        from marian_tpu.common.timer import Timer
+        import time
+        t = Timer()
+        time.sleep(0.01)
+        e1 = t.elapsed()
+        assert e1 >= 0.01
+        t.start()
+        assert t.elapsed() < e1
+
+
+class TestInitializers:
+    def test_glorot_uniform_bounds_and_shape(self):
+        from marian_tpu.layers import initializers as I
+        w = I.glorot_uniform(jax.random.key(0), (64, 32))
+        assert w.shape == (64, 32)
+        limit = float(np.sqrt(6.0 / (64 + 32)))
+        a = np.asarray(w)
+        assert a.max() <= limit + 1e-6 and a.min() >= -limit - 1e-6
+        # draws actually fill the range (not degenerate)
+        assert a.std() > limit / 4
+
+    def test_glorot_normal_std(self):
+        from marian_tpu.layers import initializers as I
+        w = np.asarray(I.glorot_normal(jax.random.key(1), (256, 256)))
+        want = np.sqrt(2.0 / 512)
+        assert w.std() == pytest.approx(want, rel=0.15)
+
+    def test_zeros_ones(self):
+        from marian_tpu.layers import initializers as I
+        assert float(np.asarray(I.zeros((2, 3))).sum()) == 0.0
+        assert float(np.asarray(I.ones((2, 3))).sum()) == 6.0
