@@ -88,14 +88,16 @@ class EncoderDecoder:
         elif self.model_type in ("s2s", "nematus", "amun", "multi-s2s",
                                  "char-s2s"):
             from . import s2s as S
-            self.cfg = S.config_from_options(options, src_vocab_size,
-                                             trg_vocab_size, inference)
             has_src_factors = (any(src_factors)
                                if isinstance(src_factors, (tuple, list))
                                else bool(src_factors))
-            if has_src_factors or trg_factors:
+            if has_src_factors:
                 raise NotImplementedError(
-                    "factored vocabs are supported for transformer models")
+                    "factored SOURCE vocabs are supported for transformer "
+                    "models (the s2s family supports a factored target)")
+            self.cfg = S.config_from_options(options, src_vocab_size,
+                                             trg_vocab_size, inference,
+                                             trg_factors=trg_factors)
             self._mod = S
         else:
             raise NotImplementedError(f"model type '{self.model_type}'")

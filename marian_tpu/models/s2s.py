@@ -72,6 +72,12 @@ class S2SConfig:
     dropout_rnn: float = 0.0
     dropout_src: float = 0.0
     dropout_trg: float = 0.0
+    # factored TARGET vocab (reference: factored vocabs apply to any
+    # model family; the src side stays plain for s2s — loud refusal).
+    # trg tables are sized n_units; _embed sums unit embeddings and the
+    # deep output produces unit logits -> factored_log_probs
+    trg_factors: Any = None              # layers.logits.FactorTables
+    factor_weight: float = 1.0
     # char-s2s (reference: src/models/char_s2s.h :: CharS2SEncoder, the
     # fully character-level conv+pool+highway front-end of Lee et al. 2017;
     # the reference's cuDNN conv/pool wrappers → lax.conv/reduce_window):
@@ -118,8 +124,16 @@ class S2SConfig:
 
 
 def config_from_options(options, src_vocab, trg_vocab: int,
-                        for_inference: bool = False) -> S2SConfig:
+                        for_inference: bool = False,
+                        trg_factors=None) -> S2SConfig:
     g = options.get
+    if trg_factors is not None and (
+            bool(g("tied-embeddings-all", False))
+            or bool(g("tied-embeddings-src", False))):
+        raise ValueError(
+            "a factored target vocab cannot share tables with the plain "
+            "source side (--tied-embeddings-all/-src); --tied-embeddings "
+            "(trg emb ↔ output) is supported")
     if isinstance(src_vocab, (tuple, list)):
         src_vocabs = tuple(int(v) for v in src_vocab)
     else:
@@ -166,6 +180,11 @@ def config_from_options(options, src_vocab, trg_vocab: int,
         dropout_rnn=0.0 if inf else float(g("dropout-rnn", 0.0)),
         dropout_src=0.0 if inf else float(g("dropout-src", 0.0)),
         dropout_trg=0.0 if inf else float(g("dropout-trg", 0.0)),
+        trg_factors=trg_factors,
+        # --factor-weight is a TRAINING-loss knob (transformer family
+        # semantics): inference always combines factor groups at 1.0
+        factor_weight=(1.0 if inf
+                       else float(g("factor-weight", 1.0) or 1.0)),
         char_conv=char_conv,
         char_stride=int(g("char-stride", 5)),
         char_highway=int(g("char-highway", 4)),
@@ -263,7 +282,7 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
     else:
         for i, v in enumerate(src_vocabs):
             p[f"Wemb{_sfx(i)}"] = glorot((v, cfg.dim_emb))
-        p["Wemb_dec"] = glorot((cfg.trg_vocab, cfg.dim_emb))
+        p["Wemb_dec"] = glorot((_trg_rows(cfg), cfg.dim_emb))
 
     if cfg.char_conv:
         # conv+pool+highway front-end (reference: CharS2SEncoder; Lee et
@@ -318,14 +337,19 @@ def init_params(cfg: S2SConfig, key: jax.Array) -> Params:
     p["ff_logit_l1_W2"] = glorot((cfg.dim_ctx_total, e))  # from context
     p["ff_logit_l1_b"] = inits.zeros((1, e))
     if not (cfg.tied_embeddings_all or cfg.tied_embeddings):
-        p["ff_logit_l2_W"] = glorot((e, cfg.trg_vocab))
-    p["ff_logit_l2_b"] = inits.zeros((1, cfg.trg_vocab))
+        p["ff_logit_l2_W"] = glorot((e, _trg_rows(cfg)))
+    p["ff_logit_l2_b"] = inits.zeros((1, _trg_rows(cfg)))
     return p
 
 
 # ---------------------------------------------------------------------------
 # Embeddings / output
 # ---------------------------------------------------------------------------
+
+def _trg_rows(cfg: S2SConfig) -> int:
+    """Target table rows: factor units when the target vocab is factored."""
+    return cfg.trg_factors.n_units if cfg.trg_factors else cfg.trg_vocab
+
 
 def _embed(cfg: S2SConfig, params: Params, ids: jax.Array,
            side: str, enc_idx: int = 0) -> jax.Array:
@@ -338,6 +362,11 @@ def _embed(cfg: S2SConfig, params: Params, ids: jax.Array,
         table = params["Wemb"]
     else:
         table = params["Wemb_dec"]
+    if side == "trg" and cfg.trg_factors is not None:
+        # emb(word) = sum of its unit embeddings (factored composition)
+        from ..layers.logits import factored_embed
+        return factored_embed(table, cfg.trg_factors, ids,
+                              cfg.compute_dtype)
     return table[ids].astype(cfg.compute_dtype)
 
 
@@ -363,6 +392,16 @@ def _output_logits(cfg: S2SConfig, params: Params, state: jax.Array,
     else:
         w = params["ff_logit_l2_W"]
     b = params["ff_logit_l2_b"]
+    if cfg.trg_factors is not None:
+        # unit logits -> per-group log-softmax -> word log-probs; the
+        # shortlist lives in WORD space, so it applies inside
+        # factored_log_probs, never to the unit-space w/b
+        from ..layers.logits import factored_log_probs
+        units = jnp.dot(t, w.astype(t.dtype),
+                        preferred_element_type=jnp.float32)
+        units = units.astype(jnp.float32) + b.astype(jnp.float32)
+        return factored_log_probs(units, cfg.trg_factors, shortlist,
+                                  cfg.factor_weight)
     if shortlist is not None:
         w = w[:, shortlist]
         b = b[:, shortlist]

@@ -1,0 +1,95 @@
+"""Factored TARGET vocab for the s2s family (models/s2s.py — reference:
+factored vocabs apply across model families; closes the round-2-era
+refusal for the RNN lineage). Source-side factors remain a loud
+transformer-only refusal."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.factored_vocab import FactoredVocab
+from marian_tpu.data.vocab import DefaultVocab
+from marian_tpu.models.encoder_decoder import create_model
+
+FSV = """\
+</s>
+<unk>
+hello|ci
+hello|cn
+world|cn
+world|ci
+cat|cn
+dog|cn
+"""
+
+
+@pytest.fixture
+def fvocab(tmp_path):
+    p = tmp_path / "v.fsv"
+    p.write_text(FSV)
+    return FactoredVocab.load(str(p))
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(7)
+
+
+def _model(fvocab, **over):
+    base = {"type": "s2s", "dim-emb": 16, "dim-rnn": 24,
+            "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
+            "dec-cell": "gru", "label-smoothing": 0.0,
+            "precision": ["float32", "float32"], "max-length": 16}
+    base.update(over)
+    src = DefaultVocab.build(["a b c d e f"])
+    model = create_model(Options(base), src, fvocab)
+    return model, model.init(jax.random.key(7)), len(src)
+
+
+class TestS2SFactored:
+    def test_tables_sized_in_units(self, fvocab):
+        _, params, _ = _model(fvocab)
+        assert params["Wemb_dec"].shape[0] == fvocab.n_units
+        assert params["ff_logit_l2_b"].shape[1] == fvocab.n_units
+
+    def test_trains_and_gradients_flow(self, fvocab, rng):
+        model, params, nsrc = _model(fvocab)
+        v = len(fvocab)
+        batch = {
+            "src_ids": jnp.asarray(rng.randint(2, nsrc, (2, 5)), jnp.int32),
+            "src_mask": jnp.ones((2, 5), jnp.float32),
+            "trg_ids": jnp.asarray(rng.randint(2, v, (2, 6)), jnp.int32),
+            "trg_mask": jnp.ones((2, 6), jnp.float32),
+        }
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss(p, batch, None, train=False)[0])(params)
+        assert np.isfinite(float(loss))
+        assert float(jnp.abs(grads["Wemb_dec"]).sum()) > 0
+
+    def test_beam_decodes_factored_forms(self, fvocab, rng):
+        from marian_tpu.translator.beam_search import BeamSearch
+        model, params, nsrc = _model(fvocab)
+        bs = BeamSearch(model, [params], None,
+                        Options({"beam-size": 2, "normalize": 0.6,
+                                 "max-length": 8}), fvocab)
+        ids = jnp.asarray(rng.randint(2, nsrc, (2, 4)), jnp.int32)
+        nbests = bs.search(ids, jnp.ones((2, 4), jnp.float32))
+        assert len(nbests) == 2
+        for nb in nbests:
+            assert np.isfinite(nb[0]["norm_score"])
+            assert all(0 <= t < len(fvocab) for t in nb[0]["tokens"])
+
+    def test_tied_embeddings_trg_side_ok(self, fvocab, rng):
+        model, params, _ = _model(fvocab, **{"tied-embeddings": True})
+        assert "ff_logit_l2_W" not in params    # output tied to Wemb_dec
+
+    def test_tied_all_refused(self, fvocab):
+        with pytest.raises(ValueError, match="factored target"):
+            _model(fvocab, **{"tied-embeddings-all": True})
+
+    def test_src_factors_still_refused(self, fvocab):
+        with pytest.raises(NotImplementedError, match="SOURCE"):
+            create_model(Options({"type": "s2s", "dim-emb": 16,
+                                  "dim-rnn": 24}), fvocab, fvocab)
