@@ -115,6 +115,13 @@ CONFIGS = {
         "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
         "factor-weight": 1.0,
     },
+    # factored TARGET vocab on the RNN family (round-3 closure of the
+    # s2s factored refusal) — same data/fsv as the transformer config
+    "factored-s2s": {
+        "type": "s2s", "dim-emb": 24, "dim-rnn": 32,
+        "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
+        "dec-cell": "gru", "tied-embeddings": True,
+    },
 }
 
 
@@ -127,7 +134,7 @@ def _streams(name):
         return [str(DATA / "train.char.src"), str(DATA / "train.char.trg")]
     if name == "transformer-lm":
         return [trg]                    # single-stream LM corpus
-    if name == "factored":
+    if name in ("factored", "factored-s2s"):
         return [src, str(DATA / "train.fac.trg")]
     return [src, trg]
 
@@ -136,7 +143,7 @@ def _build(name):
     cfg = CONFIGS[name]
     opts = Options({**COMMON, **cfg})
     paths = _streams(name)
-    if name == "factored":
+    if name in ("factored", "factored-s2s"):
         from marian_tpu.data.factored_vocab import FactoredVocab
         src_v = DefaultVocab.build(
             pathlib.Path(paths[0]).read_text().splitlines())
