@@ -81,6 +81,30 @@ class TestS2SFactored:
             assert np.isfinite(nb[0]["norm_score"])
             assert all(0 <= t < len(fvocab) for t in nb[0]["tokens"])
 
+    def test_multi_s2s_factored_target(self, fvocab, rng):
+        """The factored target composes with the rest of the RNN family
+        (multi-encoder here)."""
+        src = DefaultVocab.build(["a b c d e f"])
+        model = create_model(
+            Options({"type": "multi-s2s", "dim-emb": 16, "dim-rnn": 24,
+                     "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
+                     "dec-cell": "gru", "label-smoothing": 0.0,
+                     "precision": ["float32", "float32"],
+                     "max-length": 16}), [src, src], fvocab)
+        params = model.init(jax.random.key(7))
+        assert params["Wemb_dec"].shape[0] == fvocab.n_units
+        batch = {
+            "src_ids": jnp.asarray(rng.randint(2, 8, (2, 5)), jnp.int32),
+            "src_mask": jnp.ones((2, 5), jnp.float32),
+            "src2_ids": jnp.asarray(rng.randint(2, 8, (2, 4)), jnp.int32),
+            "src2_mask": jnp.ones((2, 4), jnp.float32),
+            "trg_ids": jnp.asarray(rng.randint(2, len(fvocab), (2, 6)),
+                                   jnp.int32),
+            "trg_mask": jnp.ones((2, 6), jnp.float32),
+        }
+        loss, _ = model.loss(params, batch, None, train=False)
+        assert np.isfinite(float(loss))
+
     def test_tied_embeddings_trg_side_ok(self, fvocab, rng):
         model, params, _ = _model(fvocab, **{"tied-embeddings": True})
         assert "ff_logit_l2_W" not in params    # output tied to Wemb_dec
