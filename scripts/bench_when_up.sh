@@ -57,7 +57,7 @@ commit_artifacts() {  # $1 = message
     # every pre-profile stage commit in the first dry-run)
     local f
     for f in BENCH_SELF.json BENCH_HISTORY.jsonl BENCH_PARTIAL.json \
-             docs/tpu_profile_r03.txt; do
+             docs/tpu_profile_r03.txt docs/tpu_profile_r04.txt; do
         [ -e "$f" ] && git add "$f"
     done
     git diff --cached --quiet || git commit -q -m "$1"
@@ -132,7 +132,7 @@ ladder() {
         if python -m marian_tpu.cli.profile_summary "$ptmp" 40 >"$psum" \
                 && [ -s "$psum" ]; then
             mkdir -p docs
-            mv "$psum" docs/tpu_profile_r03.txt
+            mv "$psum" docs/tpu_profile_r04.txt
             commit_artifacts "bench: TPU profile trace summary (top ops)"
         else
             echo "profile summary failed — trace left in $ptmp"
