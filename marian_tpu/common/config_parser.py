@@ -182,7 +182,6 @@ _TRAINING = [
     _f("tsv-fields", int, 0, "Number of TSV columns (0 = infer from --vocabs count)", "training"),
     _f("no-spm-encode", bool, False, "Input is already SentencePiece-encoded: skip encoding, split on whitespace", "training"),
     _f("input-reorder", int, [], "Permutation applied to TSV columns before they become streams, e.g. 1 0", "training", "*"),
-    _f("fp16", bool, False, "Half-precision shortcut: maps to bfloat16 compute on TPU (fp16's narrow exponent needs loss scaling; bf16 keeps the f32 range)", "training"),
     _f("throw-on-divergence", bool, False, "Raise (instead of logging) when the training cost goes non-finite, so orchestration restarts from the last checkpoint", "training"),
     _f("diverged-after", str, None, "fp16 divergence-recovery horizon (no-op; see flag audit)", "training", "?"),
     _f("custom-fallbacks", str, [], "fp16 fallback config list (no-op; see flag audit)", "training", "*"),
@@ -190,8 +189,6 @@ _TRAINING = [
     _f("recover-from-fallback-after", str, None, "fp16 fallback recovery (no-op; see flag audit)", "training", "?"),
     _f("overwrite-checkpoint", bool, True, "Overwrite the single rolling checkpoint (no-op; see flag audit)", "training"),
     _f("clip-gemm", float, 0.0, "Legacy GEMM clipping (no-op; see flag audit)", "training"),
-    _f("optimize", bool, False, "Legacy optimized int16 GEMM switch (no-op; see flag audit)", "translate"),
-    _f("model-mmap", bool, False, "Memory-map model loading (no-op; .bin checkpoints are always mmap-loaded)", "translate"),
     _f("mini-batch", int, 64, "Minibatch size (sentences)", "training"),
     _f("mini-batch-words", int, 0, "Minibatch size in target labels (token budget)", "training"),
     _f("mini-batch-fit", bool, False, "Determine minibatch automatically from workspace (TPU: bucket table)", "training"),
@@ -323,6 +320,14 @@ _TRANSLATION = [
     _f("gemm-type", str, "float32", "float32, bfloat16, int8 (TPU AQT path), intgemm8/packed* map to int8", "translate"),
     _f("quantize-range", float, 0.0, "Quantization clip range in stddevs (0 = absmax)", "translate"),
     _f("mini-batch-words-translate", int, 0, "(see mini-batch-words)", "translate"),
+    # Decoder-compat shims live here, not in _TRAINING: translation /
+    # embedding / server modes parse _COMMON+_MODEL+_TRANSLATION only and
+    # SystemExit on unknown options, so Marian decoder command lines that
+    # carry these must still parse in those modes (ADVICE r3). Training
+    # mode also includes this list, so they remain accepted everywhere.
+    _f("optimize", bool, False, "Legacy optimized int16 GEMM switch (no-op; see flag audit)", "translate"),
+    _f("model-mmap", bool, False, "Memory-map model loading (no-op; .bin checkpoints are always mmap-loaded)", "translate"),
+    _f("fp16", bool, False, "Half-precision shortcut: maps to bfloat16 compute on TPU (fp16's narrow exponent needs loss scaling; bf16 keeps the f32 range)", "translate"),
 ]
 
 _SCORER = [

@@ -90,15 +90,16 @@ async def _batching_worker(queue: "asyncio.Queue[Tuple[str, asyncio.Future]]",
         try:
             text, fut = await queue.get()
             batch = [(text, fut)]
-            deadline = loop.time() + BATCH_WINDOW_S
+            # Coalesce the burst with sleep + get_nowait, NOT
+            # wait_for(queue.get()): cancelling a waiting get() (what
+            # wait_for does on timeout, Python < 3.12) can consume a
+            # just-enqueued item and drop it — the client would await an
+            # unresolved future forever (ADVICE r3).
+            await asyncio.sleep(BATCH_WINDOW_S)
             while True:
-                timeout = deadline - loop.time()
-                if timeout <= 0:
-                    break
                 try:
-                    batch.append(
-                        await asyncio.wait_for(queue.get(), timeout))
-                except asyncio.TimeoutError:
+                    batch.append(queue.get_nowait())
+                except asyncio.QueueEmpty:
                     break
             try:
                 await _reply(batch)

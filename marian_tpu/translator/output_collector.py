@@ -96,6 +96,11 @@ class OutputPrinter:
         if not self.n_best:
             h = nbest[0]
             out = self._detok(h["tokens"])
+            # Segment order matches Marian's OutputPrinter: alignment
+            # directly after the translation, WordScores after it
+            # (ADVICE r3 — index-based n-best consumers rely on this).
+            if self.align_mode and "alignment" in h:
+                out += " ||| " + self._align_str(self._align_of(h))
             if "word_scores" in h:
                 # --word-scores applies to single-best output too
                 # (reference: OutputPrinter::print appends the segment)
@@ -104,12 +109,12 @@ class OutputPrinter:
                     ws = ws[-2::-1] + ws[-1:]
                 out += " ||| WordScores= " \
                     + " ".join(f"{x:.6f}" for x in ws)
-            if self.align_mode and "alignment" in h:
-                out += " ||| " + self._align_str(self._align_of(h))
             return out
         lines = []
         for h in nbest:
             parts = [str(sentence_id), self._detok(h["tokens"])]
+            if self.align_mode and "alignment" in h:
+                parts.append(self._align_str(self._align_of(h)))
             if "word_scores" in h:
                 # --word-scores (reference: OutputPrinter WordScores
                 # segment): per emitted token incl. the terminating </s>
@@ -120,8 +125,5 @@ class OutputPrinter:
                              + " ".join(f"{x:.6f}" for x in ws))
             parts += [f"{self.feature}= {h['score']:.6f}",
                       f"{h['norm_score']:.6f}"]
-            line = " ||| ".join(parts)
-            if self.align_mode and "alignment" in h:
-                line += " ||| " + self._align_str(self._align_of(h))
-            lines.append(line)
+            lines.append(" ||| ".join(parts))
         return "\n".join(lines)

@@ -201,3 +201,23 @@ class TestWordScores:
                                          "word-scores": True}), vocab)
         line = printer.line(0, nbests[0])
         assert "WordScores= " in line
+
+        # --word-scores + --alignment together: segment order is
+        # id ||| translation ||| alignment ||| WordScores ||| Score |||
+        # norm, matching Marian's OutputPrinter (ADVICE r3 — index-based
+        # n-best consumers rely on alignment preceding WordScores)
+        h = dict(nbests[0][0])
+        h["alignment"] = np.full((len(h["tokens"]) + 1, 4), 0.25)
+        both = OutputPrinter(Options({"n-best": True, "word-scores": True,
+                                      "alignment": "hard"}), vocab)
+        segs = both.line(0, [h]).split(" ||| ")
+        assert segs[0] == "0"
+        assert segs[3].startswith("WordScores= ")
+        assert segs[4].startswith("Score= ")
+        # segs[2] is the alignment (src-trg pairs), between them
+        assert all("-" in p for p in segs[2].split())
+        # single-best: translation ||| alignment ||| WordScores
+        single = OutputPrinter(Options({"word-scores": True,
+                                        "alignment": "hard"}), vocab)
+        s = single.line(0, [h]).split(" ||| ")
+        assert s[2].startswith("WordScores= ") and "-" in s[1]
