@@ -135,14 +135,7 @@ def ring_attention_sharded(mesh: Mesh, q, k, v, kv_mask=None,
                            causal: bool = False, mode: str = "ring"):
     """Run ring/ulysses attention on full [B,H,T,Dh] arrays over `mesh`'s
     'seq' axis (the entry point for long-context encoders; jit-compatible)."""
-    import inspect
-    try:
-        from jax import shard_map
-    except ImportError:                     # older jax
-        from jax.experimental.shard_map import shard_map
-    # jax 0.8 renamed check_rep → check_vma
-    _ck = ("check_vma" if "check_vma" in inspect.signature(shard_map).parameters
-           else "check_rep")
+    from .mesh import compat_shard_map
 
     if kv_mask is None:
         kv_mask = jnp.ones((k.shape[0], k.shape[2]), jnp.float32)
@@ -150,11 +143,10 @@ def ring_attention_sharded(mesh: Mesh, q, k, v, kv_mask=None,
     # three compose; ring collectives only ever touch the 'seq' axis.
     qkv = P("data", "model", "seq", None)
 
-    @functools.partial(shard_map, mesh=mesh,
-                       in_specs=(qkv, qkv, qkv, P("data", "seq")),
-                       out_specs=qkv, **{_ck: False})
     def run(q_, k_, v_, mask_):
         return sequence_attention(q_, k_, v_, kv_mask=mask_, causal=causal,
                                   mode=mode)
 
-    return run(q, k, v, kv_mask)
+    return compat_shard_map(
+        run, mesh, in_specs=(qkv, qkv, qkv, P("data", "seq")),
+        out_specs=qkv)(q, k, v, kv_mask)

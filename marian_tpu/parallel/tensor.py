@@ -22,7 +22,7 @@ communicator_nccl.h scatterReduce/allGather — see parallel/zero.py).
 from __future__ import annotations
 
 import re
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -132,17 +132,29 @@ def param_shardings(params: Params, mesh: Mesh,
     return {k: NamedSharding(mesh, specs[k]) for k in params}
 
 
+def zero1_data_axis(param_spec: P, shape: Tuple[int, ...],
+                    mesh: Mesh) -> Optional[int]:
+    """The tensor axis ZeRO-1 shards over 'data': the first axis not already
+    model-split whose size divides the data-axis size; None when no axis
+    qualifies (the leaf stays replicated and its gradient is psum'd whole)."""
+    n = mesh.shape["data"]
+    if n <= 1:
+        return None
+    parts = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    for axis, dim in enumerate(shape):
+        if parts[axis] is None and dim % n == 0 and dim >= n:
+            return axis
+    return None
+
+
 def zero1_combined_spec(param_spec: P, shape: Tuple[int, ...],
                         mesh: Mesh) -> P:
     """Compose ZeRO-1 ('data'-axis) sharding with a TP spec: shard the first
     axis that is not already model-split and divides the data-axis size."""
-    n = mesh.shape["data"]
     parts = list(param_spec) + [None] * (len(shape) - len(param_spec))
-    if n > 1:
-        for axis, dim in enumerate(shape):
-            if parts[axis] is None and dim % n == 0 and dim >= n:
-                parts[axis] = "data"
-                break
+    axis = zero1_data_axis(param_spec, shape, mesh)
+    if axis is not None:
+        parts[axis] = "data"
     while parts and parts[-1] is None:
         parts.pop()
     return P(*parts)

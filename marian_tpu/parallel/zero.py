@@ -93,6 +93,174 @@ def finalize_update(opt_cfg: OptimizerConfig, opt_state, p, grads,
     return new_p, new_opt, gnorm, skipped
 
 
+class _GradMachinery:
+    """The gradient producer shared by the fused train step and the
+    heterogeneous-delay host loop (GraphGroup._grad_fn): per-device
+    fwd/bwd + the explicit scatter-reduce cycle. ONE implementation so the
+    two paths fold dropout keys and reduce gradients identically."""
+
+    def __init__(self, model, mesh: Mesh, params: Params, delay: int = 1,
+                 frozen=(), dim_emb: int = 0):
+        self.mesh = mesh
+        self.delay = delay
+        self.n_data = mesh.shape["data"]
+        # Explicit scatter-reduce runs on pure-DP meshes (the reference's
+        # only parallelism and the north-star config); meshes with TP/SP/
+        # pipe/expert axes compose through GSPMD annotations instead.
+        self.manual_dp = self.n_data > 1 and all(
+            mesh.shape[a] == 1 for a in mesh.shape if a != "data")
+        if not dim_emb:
+            dim_emb = int(getattr(getattr(model, "cfg", None),
+                                  "dim_emb", 0) or 0)
+        self.g_specs = T.tp_param_specs(params, mesh, dim_emb=dim_emb)
+        self._shapes = {k: tuple(v.shape) for k, v in params.items()}
+        self.data_axes = {
+            k: T.zero1_data_axis(self.g_specs.get(k, P()), shape, mesh)
+            for k, shape in self._shapes.items()}
+        self.frozen_set = frozenset(frozen)
+        self.model = model
+
+    def grads(self, p, batch, rng):
+        """(grads, ce_sum, labels) — grads globally reduced and ZeRO-1
+        sharded (manual path) or logically global (GSPMD path, pinned to
+        the combined spec)."""
+        if self.manual_dp:
+            return self._sharded_grads(p, batch, rng)
+        grads, ce_sum, labels = self._local_grads(p, batch, rng)
+        return self._constrain(grads), ce_sum, labels
+
+    def grad_shardings(self):
+        """NamedSharding per gradient leaf (combined TP + ZeRO-1 spec) —
+        what self.grads() produces; also the right out_shardings for a
+        grads-only jit."""
+        return {
+            k: NamedSharding(self.mesh, T.zero1_combined_spec(
+                self.g_specs.get(k, P()), shape, self.mesh))
+            for k, shape in self._shapes.items()}
+
+    def _grads_of(self, p, b, rng):
+        def loss_fn(pp, bb, r):
+            return self.model.loss(pp, bb, r, train=True)
+        (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b, rng)
+        if self.frozen_set:
+            # --embedding-fix-src/trg: fixed tables get no update and no
+            # contribution to the global norm (reference: trainable=false)
+            g = {k: (jnp.zeros_like(v) if k in self.frozen_set else v)
+                 for k, v in g.items()}
+        return g, aux
+
+    def _local_grads(self, p, batch, rng, axis_fold=None):
+        """Per-device fwd/bwd (+ --optimizer-delay accumulation): gradients
+        of the LOCAL batch shard's loss, no cross-device reduction yet —
+        the reference's per-device graph->forward/backward before the
+        communicator runs (graph_group_sync.cpp). `axis_fold` (manual-DP
+        path) folds the device index into each key AFTER the per-micro
+        fold, mirroring the host loop's key derivation order so fused and
+        host delay paths stay numerically interchangeable."""
+        def _k(key):
+            if axis_fold is None:
+                return key
+            return jax.random.fold_in(key, axis_fold)
+        if self.delay > 1:
+            def body(carry, sl):
+                acc, tot, lab = carry
+                micro, i = sl
+                # per-micro-batch dropout keys fold exactly like the host
+                # accumulation loop (GraphGroup.update), so the two delay
+                # paths are numerically interchangeable
+                g, aux = self._grads_of(p, micro,
+                                        _k(jax.random.fold_in(rng, i)))
+                acc = jax.tree_util.tree_map(jnp.add, acc, g)
+                return (acc, tot + aux["ce_sum"], lab + aux["labels"]), None
+            zeros = jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), p)
+            (grads, ce_sum, labels), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)),
+                (batch, jnp.arange(self.delay)))
+        else:
+            grads, aux = self._grads_of(p, batch, _k(rng))
+            ce_sum, labels = aux["ce_sum"], aux["labels"]
+        return grads, ce_sum, labels
+
+    def _constrain(self, grads):
+        """GSPMD path: pin each gradient leaf to its combined TP+ZeRO-1
+        layout (same spec as its Adam-moment leaf), so the partitioner
+        reshards grads once, ahead of the sharded optimizer math."""
+        return {
+            k: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh, T.zero1_combined_spec(
+                    self.g_specs.get(k, P()), tuple(g.shape), self.mesh)))
+            for k, g in grads.items()}
+
+    def _scatter_reduce_body(self, p, batch, rng):
+        """shard_map body, manual over 'data': per-device fwd/bwd on the
+        local batch shard, then an EXPLICIT per-leaf reduce-scatter of the
+        gradients onto each leaf's ZeRO-1 shard axis —
+        NCCLCommunicator::scatterReduceAndResetGrads made visible in the
+        program. Left to GSPMD alone, the partitioner materializes the
+        gradient sum as a full-size all-reduce and slices afterwards
+        (observed on the CPU partitioner): numerically identical but ~1.5×
+        the collective bytes. psum_scatter pins the reduce-scatter on every
+        backend; tests/test_distributed.py greps the compiled HLO for it.
+
+        The shard_map runs with check_vma=False (classic manual-mode
+        semantics): every value in the body is treated as device-varying,
+        so autodiff keeps the cotangents of the replicated params as LOCAL
+        partial sums (per-device backward, as in the reference). Under
+        varying-manual-axes typing (check_vma=True) shard_map's autodiff
+        would instead insert its own full-size psum for unvarying inputs —
+        double-counting ahead of psum_scatter — and unvarying lax.scan
+        carries inside the models (RNN hidden states, delay accumulators)
+        would need pcast plumbing throughout."""
+        # independent per-device dropout streams (reference: per-device
+        # cuRAND generators); with dropout off the key is never consumed
+        grads, ce_sum, labels = self._local_grads(
+            p, batch, rng, axis_fold=jax.lax.axis_index("data"))
+        out = {}
+        for k, g in grads.items():
+            ax = self.data_axes[k]
+            if ax is None:
+                out[k] = jax.lax.psum(g, "data")
+            else:
+                out[k] = jax.lax.psum_scatter(
+                    g, "data", scatter_dimension=ax, tiled=True)
+        return out, jax.lax.psum(ce_sum, "data"), jax.lax.psum(labels, "data")
+
+    @staticmethod
+    def _data_only(spec: P) -> P:
+        return P(*tuple(s if s == "data" else None for s in spec))
+
+    def _sharded_grads(self, p, batch, rng):
+        b_specs = {k: self._data_only(M.batch_leaf_spec(
+                       k, getattr(v, "ndim", 2), micro=self.delay > 1))
+                   for k, v in batch.items()}
+        g_out = {k: (P() if ax is None else P(*([None] * ax + ["data"])))
+                 for k, ax in self.data_axes.items()}
+        return M.compat_shard_map(
+            self._scatter_reduce_body, self.mesh,
+            in_specs=(P(), b_specs, P()),
+            out_specs=(g_out, P(), P()))(p, batch, rng)
+
+
+def build_grad_fn(model, mesh: Mesh, params: Params, frozen=(),
+                  dim_emb: int = 0):
+    """Jitted (params, batch, rng) → (grads, aux) for the heterogeneous-
+    delay host loop (GraphGroup._grad_fn): the SAME gradient machinery as
+    the fused step — per-device backward, explicit scatter-reduce, matching
+    dropout-key folds — so host-loop and fused accumulation stay
+    numerically interchangeable. Gradients come out ZeRO-1 sharded, ready
+    for the sharded update tail."""
+    m = _GradMachinery(model, mesh, params, delay=1, frozen=frozen,
+                       dim_emb=dim_emb)
+
+    def grad_step(p, batch, rng):
+        grads, ce_sum, labels = m.grads(p, batch, rng)
+        return grads, {"ce_sum": ce_sum, "labels": labels}
+
+    return jax.jit(grad_step, out_shardings=(m.grad_shardings(), None))
+
+
 def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                      mesh: Mesh, params: Params, opt_state,
                      delay: int = 1, donate: bool = True, shardings=None,
@@ -109,42 +277,12 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
     are pinned here so donation layouts match. `shardings` optionally passes
     precomputed (param_shardings, opt_state_shardings) to avoid recomputing.
     """
-
-    def loss_fn(p, b, rng):
-        total, aux = model.loss(p, b, rng, train=True)
-        return total, aux
-
-    frozen_set = frozenset(frozen)
-
-    def grads_of(p, b, rng):
-        (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b, rng)
-        if frozen_set:
-            # --embedding-fix-src/trg: fixed tables get no update and no
-            # contribution to the global norm (reference: trainable=false)
-            g = {k: (jnp.zeros_like(v) if k in frozen_set else v)
-                 for k, v in g.items()}
-        return g, aux
+    machinery = _GradMachinery(model, mesh, params, delay=delay,
+                               frozen=frozen)
+    g_specs = machinery.g_specs
 
     def step_fn(p, opt_state, batch, step, rng):
-        if delay > 1:
-            def body(carry, sl):
-                acc, tot, lab = carry
-                micro, i = sl
-                # per-micro-batch dropout keys fold exactly like the host
-                # accumulation loop (GraphGroup.update), so the two delay
-                # paths are numerically interchangeable
-                g, aux = grads_of(p, micro, jax.random.fold_in(rng, i))
-                acc = jax.tree_util.tree_map(jnp.add, acc, g)
-                return (acc, tot + aux["ce_sum"], lab + aux["labels"]), None
-            zeros = jax.tree_util.tree_map(
-                lambda x: jnp.zeros(x.shape, jnp.float32), p)
-            (grads, ce_sum, labels), _ = jax.lax.scan(
-                body, (zeros, jnp.zeros((), jnp.float32),
-                       jnp.zeros((), jnp.float32)),
-                (batch, jnp.arange(delay)))
-        else:
-            grads, aux = grads_of(p, batch, rng)
-            ce_sum, labels = aux["ce_sum"], aux["labels"]
+        grads, ce_sum, labels = machinery.grads(p, batch, rng)
 
         # cost normalization → gradient scale (Marian's costScaleFactor)
         if cost_type in ("ce-mean-words", "perplexity"):
@@ -172,10 +310,8 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
     # TP (Megatron-style over 'model') via GSPMD param specs; replicated when
     # the model axis is 1. ZeRO-1 'data' sharding composes on the opt state.
     if shardings is None:
-        dim_emb = int(getattr(getattr(model, "cfg", None), "dim_emb", 0) or 0)
-        p_specs = T.tp_param_specs(params, mesh, dim_emb=dim_emb)
-        p_shardings = T.param_shardings(params, mesh, p_specs)
-        o_shardings = T.opt_state_shardings(opt_state, p_specs, mesh)
+        p_shardings = T.param_shardings(params, mesh, g_specs)
+        o_shardings = T.opt_state_shardings(opt_state, g_specs, mesh)
     else:
         p_shardings, o_shardings = shardings
     metrics_shardings = {"ce_sum": rep, "labels": rep, "gnorm": rep, "lr": rep}

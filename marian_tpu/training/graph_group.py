@@ -220,18 +220,14 @@ class GraphGroup:
 
         # split path for --optimizer-delay with heterogeneous batch shapes.
         # Batches arrive committed via M.shard_batch (per-leaf name-aware
-        # specs), so no in_shardings here; grads keep the param layout.
-        def grad_step(p, batch, rng):
-            def loss_fn(pp, b, r):
-                return model.loss(pp, b, r, train=True)
-            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                p, batch, rng)
-            if frozen:
-                grads = {k: (jnp.zeros_like(v) if k in frozen else v)
-                         for k, v in grads.items()}
-            return grads, aux
-
-        self._grad_fn = jax.jit(grad_step, out_shardings=(p_sh, None))
+        # specs), so no in_shardings here. Shares the fused step's gradient
+        # machinery (per-device backward + explicit scatter-reduce,
+        # identical dropout-key folds), so host-loop accumulation matches
+        # the in-jit lax.scan bit-for-bit-ish; grads come out ZeRO-1
+        # sharded for the sharded update tail.
+        from ..parallel.zero import build_grad_fn
+        self._grad_fn = build_grad_fn(model, mesh, self.params,
+                                      frozen=frozen)
 
         def update_step(p, opt_state, grads, step, labels, n_sents):
             if self.cost_type in ("ce-mean-words", "perplexity"):

@@ -72,6 +72,35 @@ class Translate:
         self.shortlist_gen = parse_shortlist_options(
             self.options.get("shortlist", []), self.src_vocab, self.trg_vocab)
         self.printer = OutputPrinter(self.options, self.trg_vocab)
+        self._roofline_hint()
+
+    def _roofline_hint(self):
+        """One-time decode-defaults recommendation (the auto-tuner hook of
+        VERDICT r3 #5): on a TPU whose beam step the analytic roofline
+        puts in the weight-bound regime, say which off lever (int8 /
+        shortlist) would pay and by how much."""
+        cfg = getattr(self.model, "cfg", None)
+        if cfg is None or not hasattr(cfg, "dim_ffn"):
+            return                       # RNN family: no int8 decode path
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind
+        except Exception:                # noqa: BLE001 — hint only
+            return
+        from ..common.flops import decode_defaults_hint
+        from ..ops.quantization import QTensor
+        int8_on = any(isinstance(v, QTensor)
+                      for v in self.params_list[0].values())
+        hint = decode_defaults_hint(
+            emb=int(cfg.dim_emb), ffn=int(cfg.dim_ffn),
+            dec_depth=int(getattr(cfg, "dec_depth", 6)),
+            vocab=len(self.trg_vocab),
+            rows=int(self.options.get("mini-batch", 32) or 32)
+            * int(self.options.get("beam-size", 12) or 12),
+            device_kind=kind, int8_on=int8_on,
+            shortlist_on=self.shortlist_gen is not None)
+        if hint:
+            log.info("{}", hint)
 
     def _input_corpus(self, lines: Optional[List[str]] = None):
         n_src = len(self.src_vocab_list)

@@ -122,6 +122,28 @@ CONFIGS = {
         "enc-depth": 1, "dec-depth": 1, "enc-cell": "gru",
         "dec-cell": "gru", "tied-embeddings": True,
     },
+    # composed-mesh goldens (VERDICT r3 #3). NOTE: every config in this
+    # file already trains on the conftest's 8-virtual-device data:8 mesh
+    # (GraphGroup's default mesh covers all visible devices), so each
+    # pinned trajectory above regression-tests the manual-DP scatter-
+    # reduce path too. These two pin the OTHER parallelism axes: a
+    # dp×tp×sp step (Megatron-style TP shardings + ring sequence
+    # parallelism) and a dp×pipe×expert step (depth-stacked layer params
+    # + expert-sharded MoE tables), trajectories and decode both.
+    "tp-sp-transformer": {
+        "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
+        "tied-embeddings-all": True,
+        "mesh": ["data:2", "model:2", "seq:2"],
+        "sequence-parallel": "ring",
+    },
+    "pipe-expert-moe": {
+        "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
+        "tied-embeddings-all": True,
+        "transformer-moe-experts": 4, "transformer-moe-top-k": 2,
+        "mesh": ["data:2", "pipe:2", "expert:2"],
+    },
 }
 
 

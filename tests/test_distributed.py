@@ -136,6 +136,95 @@ class TestMeshSpec:
         assert M.zero1_leaf_spec((), m) == P()
 
 
+class TestZero1CollectivePattern:
+    """Pin the compiled communication pattern of the ZeRO-1 step (VERDICT
+    r3 #2): gradients must reduce-scatter onto their shard axis and updated
+    params must all-gather back — NCCLCommunicator::scatterReduceAndReset-
+    Grads / allGatherParams — with NO param-sized all-reduce. A sharding
+    regression that degrades to all-reduce + replicated Adam keeps numerics
+    bit-identical (every other test stays green) while inflating collective
+    bytes ~1.5× and optimizer FLOPs N×; only the HLO shows it."""
+
+    def _compiled_text(self):
+        o = Options({
+            "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+            "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+            "tied-embeddings-all": True, "precision": ["float32", "float32"],
+            "max-length": 16, "label-smoothing": 0.1,
+            "cost-type": "ce-mean-words", "learn-rate": 0.001,
+            "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
+            "clip-norm": 1.0, "exponential-smoothing": 1e-4,
+        })
+        vocab = 32
+        mesh = M.make_mesh(None, jax.devices()[:8])
+        model = create_model(o, vocab, vocab)
+        params = model.init(jax.random.key(7))
+        opt_cfg = OptimizerConfig.from_options(o)
+        opt_state = init_state(opt_cfg, params)
+        params, opt_state = place(params, opt_state, mesh)
+        step = build_train_step(model, opt_cfg, LRSchedule.from_options(o),
+                                "ce-mean-words", mesh, params, opt_state,
+                                delay=1, donate=False)
+        b = M.shard_batch(batch(vocab, b=16, ts=8, tt=8), mesh)
+        txt = step.lower(params, opt_state, b,
+                         jnp.asarray(1.0, jnp.float32),
+                         jax.random.key(0)).compile().as_text()
+        return txt, params
+
+    @pytest.mark.slow
+    def test_reduce_scatter_plus_all_gather_no_fat_all_reduce(self):
+        from marian_tpu.parallel.collectives import collective_stats
+        txt, params = self._compiled_text()
+        stats = collective_stats(txt)
+        n_leaves = len(params)
+        param_bytes = sum(int(np.prod(v.shape)) * 4 for v in params.values())
+
+        # every sharded gradient leaf reduce-scatters; every updated param
+        # leaf all-gathers back to replicated
+        rs = stats.get("reduce-scatter", {"count": 0, "bytes": 0})
+        ag = stats.get("all-gather", {"count": 0, "bytes": 0})
+        assert rs["count"] == n_leaves, (rs, n_leaves)
+        assert ag["count"] == n_leaves, (ag, n_leaves)
+        # reduce-scatter outputs are the 1/8 shards of what all-gather
+        # reassembles — byte accounting ties the two ends of the cycle
+        assert rs["bytes"] * 8 == ag["bytes"] == param_bytes
+
+        # all-reduces may only carry scalar reductions (loss sums, global
+        # grad norm) — never a parameter-sized gradient. The smallest param
+        # leaf here is 16 elems; scalar tuples stay well under it.
+        ar = stats.get("all-reduce", {"max_elems": 0, "bytes": 0})
+        assert ar["max_elems"] < 16, f"param-sized all-reduce: {ar}"
+        assert ar["bytes"] < 0.02 * param_bytes
+
+    @pytest.mark.slow
+    def test_collective_bytes_accounting(self):
+        from marian_tpu.parallel.collectives import (collective_stats,
+                                                     format_stats)
+        hlo = """
+          %rs = f32[4,16]{1,0} reduce-scatter(%a), channel_id=1
+          %ag.1 = f32[32,16]{1,0} all-gather(%b), channel_id=2
+          %ar = (f32[], f32[8]{0}) all-reduce(%c, %d), channel_id=3
+          %ars = bf16[64]{0} all-reduce-start(%e), channel_id=4
+          %ard = bf16[64]{0} all-reduce-done(%ars), channel_id=4
+          %ags = (f32[4,16]{1,0}, f32[32,16]{1,0}) all-gather-start(%f), channel_id=5
+          %agd = f32[32,16]{1,0} all-gather-done(%ags), channel_id=5
+          %cps = (f32[8]{0}, f32[8]{0}, u32[], u32[]) collective-permute-start(%g), channel_id=6
+        """
+        s = collective_stats(hlo)
+        assert s["reduce-scatter"] == {"count": 1, "bytes": 256,
+                                       "max_elems": 64}
+        # async -start tuples count only the transferred result buffer
+        # (not the operand alias / u32 context members); -done skipped
+        assert s["all-gather"] == {"count": 2, "bytes": 2048 * 2,
+                                   "max_elems": 512}
+        assert s["collective-permute"] == {"count": 1, "bytes": 32,
+                                           "max_elems": 8}
+        # sync tuple members (combiner-grouped results) DO sum
+        assert s["all-reduce"]["count"] == 2
+        assert s["all-reduce"]["bytes"] == (1 + 8) * 4 + 64 * 2
+        assert "all-reduce" in format_stats(s)
+
+
 class TestBufferDonation:
     def test_train_step_aliases_all_state_buffers(self):
         """Every param + optimizer-state leaf must be donated (aliased

