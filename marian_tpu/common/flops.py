@@ -189,20 +189,32 @@ def decode_defaults_hint(emb: int, ffn: int, dec_depth: int, vocab: int,
                            src_width,
                            weight_bytes=1.0 if int8_on else 2.0,
                            shortlist=shortlist_k if shortlist_on else 0)
-    if cur["flops"] / peak >= cur["hbm_bytes"] / bw:
-        return None                     # compute-bound: levers won't pay
+    t_cur = decode_step_time(cur, peak, bw)
+    # each missing lever is judged on its OWN projected gain — the
+    # shortlist also cuts logits FLOPs, so it can pay even when the step
+    # is compute-bound (int8 cannot: it only moves bytes)
+    missing = []
+    for on, wb, sl, label in (
+            (int8_on, 1.0, shortlist_k if shortlist_on else 0,
+             "int8 weights (marian-conv --gemm-type int8tpu)"),
+            (shortlist_on, 1.0 if int8_on else 2.0, shortlist_k,
+             "a lexical shortlist (--shortlist)")):
+        if on:
+            continue
+        c = decode_step_cost(emb, ffn, dec_depth, vocab, rows, t_past,
+                             src_width, weight_bytes=wb, shortlist=sl)
+        if t_cur / decode_step_time(c, peak, bw) >= 1.15:
+            missing.append(label)
+    if not missing:
+        return None
     best = decode_step_cost(emb, ffn, dec_depth, vocab, rows, t_past,
                             src_width, weight_bytes=1.0,
                             shortlist=shortlist_k)
-    gain = (decode_step_time(cur, peak, bw)
-            / decode_step_time(best, peak, bw))
-    if gain < 1.15:
-        return None
-    missing = [lever for on, lever in
-               ((int8_on, "int8 weights (marian-conv --gemm-type int8tpu)"),
-                (shortlist_on, "a lexical shortlist (--shortlist)"))
-               if not on]
-    return (f"decode is HBM-weight-bound on {device_kind} at "
+    gain = t_cur / decode_step_time(best, peak, bw)
+    bound = ("HBM-weight-bound"
+             if cur["hbm_bytes"] / bw > cur["flops"] / peak
+             else "compute-bound")
+    return (f"decode is {bound} on {device_kind} at "
             f"{rows} batchxbeam rows; enabling {' and '.join(missing)} "
             f"projects ~{gain:.1f}x on the analytic roofline "
             f"(docs/DECODE_ROOFLINE.md)")
@@ -212,9 +224,10 @@ def decode_lever_report(emb: int, ffn: int, dec_depth: int, vocab: int,
                         t_past: int, src_width: int, shortlist_k: int,
                         device_kind: str = "TPU v4") -> dict:
     """Evaluate the decode levers (int8 weights, lexical shortlist) across
-    batch×beam row counts on the analytic roofline. Returns per-rows
-    speedups vs bf16/full-vocab and the break-even row count below which
-    decode is memory-bound (where the levers pay).
+    batch×beam row counts on the analytic roofline. Returns
+    ``ridge_flops_per_byte``, ``break_even_rows`` (the row count above
+    which the bf16 full-vocab step stops being memory-bound — below it
+    the bandwidth levers pay), and per-rows speedups vs bf16/full-vocab.
 
     The defaults decision this feeds (docs/DECODE_ROOFLINE.md): int8 and
     the shortlist are BANDWIDTH levers — they help exactly while the step
@@ -228,8 +241,15 @@ def decode_lever_report(emb: int, ffn: int, dec_depth: int, vocab: int,
     peak = peak_bf16_flops(device_kind) or 275e12
     bw = hbm_bandwidth(device_kind) or 1228e9
     ridge = peak / bw                       # FLOPs/byte at the roofline knee
+    # closed-form break-even: flops = A*rows, hbm = W + C*rows →
+    # memory-bound iff W + C*r > A*r/ridge, i.e. r < W / (A/ridge - C)
+    one = decode_step_cost(emb, ffn, dec_depth, vocab, 1, t_past,
+                           src_width, weight_bytes=2.0)
+    a, w, c = one["flops"], one["weight_bytes"], one["kv_bytes"]
+    denom = a / ridge - c
+    break_even = float("inf") if denom <= 0 else w / denom
     out = {"device": device_kind, "ridge_flops_per_byte": ridge,
-           "rows": {}}
+           "break_even_rows": break_even, "rows": {}}
     for rows in (1, 8, 32, 64, 128, 256, 512, 1024, 4096):
         base = decode_step_cost(emb, ffn, dec_depth, vocab, rows,
                                 t_past, src_width, weight_bytes=2.0)

@@ -1,0 +1,220 @@
+"""In-repo BPE subword vocabulary — the fallback that keeps
+``--sentencepiece``-style workflows (train on raw text, no pre-built
+vocab) working when the ``sentencepiece`` wheel is absent from the image
+(reference: src/data/sentencepiece_vocab.cpp wraps a VENDORED
+SentencePiece precisely so the capability never depends on the
+environment; vendoring the C++ library is out of scope here, so the
+capability is preserved with a pure-Python byte-pair-encoding model
+behind the same VocabBase interface).
+
+Not byte-compatible with real ``.spm`` protobuf models (loading one
+without the wheel raises with a clear message); the model file is JSON
+with a versioned magic line. Word-initial pieces carry the SPM-style
+"▁" marker so decode is a join + marker replacement.
+
+Subword regularization (``--sentencepiece-alphas``) maps to BPE-dropout
+(Provilkov et al. 2020): during training-time encoding each merge is
+skipped with probability alpha, yielding sampled segmentations with the
+same regularizing effect as SPM's unigram sampling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .vocab import VocabBase, EOS_ID, UNK_ID
+from ..common import logging as log
+
+_MAGIC = "marian_tpu-bpe-v1"
+_WB = "▁"          # ▁ word-initial marker (SPM convention)
+
+
+def train_bpe(lines: Iterable[str], vocab_size: int,
+              max_lines: int = 2000000) -> Tuple[List[str],
+                                                 List[Tuple[str, str]]]:
+    """Learn a BPE model: returns (pieces, merges).
+
+    Classic subword-nmt algorithm with the pair→words index so each
+    merge only re-counts the words it touched (not the whole corpus):
+    ids 0/1 are reserved for </s>/<unk>; then single characters by
+    frequency; then merge outputs in merge order.
+    """
+    word_freq: Counter = Counter()
+    for i, line in enumerate(lines):
+        if i >= max_lines:
+            break
+        for w in line.split():
+            word_freq[_WB + w] += 1
+
+    # word → current symbol tuple
+    words: Dict[str, Tuple[str, ...]] = {w: tuple(w) for w in word_freq}
+    char_freq: Counter = Counter()
+    for w, f in word_freq.items():
+        for ch in w:
+            char_freq[ch] += f
+
+    pieces: List[str] = ["</s>", "<unk>"]
+    pieces += [c for c, _ in
+               sorted(char_freq.items(), key=lambda kv: (-kv[1], kv[0]))]
+
+    def _pairs(sym: Tuple[str, ...]) -> Iterable[Tuple[str, str]]:
+        return zip(sym, sym[1:])
+
+    pair_counts: Counter = Counter()
+    pair_words: Dict[Tuple[str, str], set] = {}
+    for w, sym in words.items():
+        f = word_freq[w]
+        for pr in _pairs(sym):
+            pair_counts[pr] += f
+            pair_words.setdefault(pr, set()).add(w)
+
+    merges: List[Tuple[str, str]] = []
+    seen = set(pieces)
+    while len(pieces) < vocab_size and pair_counts:
+        # deterministic: max count, then lexicographic pair
+        best = max(pair_counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
+        if pair_counts[best] < 2:
+            break                     # singleton pairs don't generalize
+        merged = best[0] + best[1]
+        merges.append(best)
+        if merged not in seen:
+            pieces.append(merged)
+            seen.add(merged)
+        for w in list(pair_words.get(best, ())):
+            f = word_freq[w]
+            old = words[w]
+            for pr in _pairs(old):
+                pair_counts[pr] -= f
+                if pair_counts[pr] <= 0:
+                    del pair_counts[pr]
+                s = pair_words.get(pr)
+                if s is not None:
+                    s.discard(w)
+                    if not s:
+                        del pair_words[pr]
+            new: List[str] = []
+            j = 0
+            while j < len(old):
+                if (j + 1 < len(old) and old[j] == best[0]
+                        and old[j + 1] == best[1]):
+                    new.append(merged)
+                    j += 2
+                else:
+                    new.append(old[j])
+                    j += 1
+            words[w] = tuple(new)
+            for pr in _pairs(words[w]):
+                pair_counts[pr] += f
+                pair_words.setdefault(pr, set()).add(w)
+    return pieces[:vocab_size], merges
+
+
+class BPEVocab(VocabBase):
+    """Subword vocab over a trained BPE model (drop-in for
+    SentencePieceVocab where the wheel is absent)."""
+
+    def __init__(self, path: str, options=None, stream_index: int = 0,
+                 train_paths: Optional[List[str]] = None):
+        self.alpha = 0.0
+        self.no_encode = bool(options.get("no-spm-encode", False)) \
+            if options is not None else False
+        if options is not None:
+            alphas = options.get("sentencepiece-alphas", [])
+            if stream_index < len(alphas):
+                self.alpha = float(alphas[stream_index])
+        seed = int(options.get("seed", 0) or 0) if options is not None else 0
+        self._rng = random.Random(seed + stream_index)
+        if not os.path.exists(path):
+            if not train_paths:
+                raise FileNotFoundError(path)
+            self._train(path, train_paths, options)
+        self._load(path)
+
+    # -- model IO -----------------------------------------------------------
+    def _train(self, path: str, train_paths: List[str], options) -> None:
+        dim_vocabs = (options.get("dim-vocabs", []) if options else []) \
+            or [8000]
+        vocab_size = max(dim_vocabs) or 8000
+        max_lines = int(options.get("sentencepiece-max-lines", 2000000)
+                        if options else 2000000)
+        log.info("Training in-repo BPE model {} from {} (sentencepiece "
+                 "wheel absent; BPE fallback, vocab {})",
+                 path, ",".join(train_paths), vocab_size)
+        if options is not None and options.get("sentencepiece-options", ""):
+            log.warn("--sentencepiece-options are SPM-trainer flags and "
+                     "do not apply to the BPE fallback (ignored)")
+
+        def _lines():
+            for tp in train_paths:
+                with open(tp, "r", encoding="utf-8") as fh:
+                    yield from (l.rstrip("\n") for l in fh)
+
+        pieces, merges = train_bpe(_lines(), vocab_size, max_lines)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"magic": _MAGIC, "pieces": pieces,
+                       "merges": [list(m) for m in merges]}, fh,
+                      ensure_ascii=False)
+
+    def _load(self, path: str) -> None:
+        with open(path, "rb") as fh:
+            head = fh.read(64)
+        if _MAGIC.encode() not in head:
+            raise RuntimeError(
+                f"{path} is not a {_MAGIC} model — it looks like a real "
+                f"SentencePiece binary, which needs the 'sentencepiece' "
+                f"package (absent in this environment). Re-train with "
+                f"this toolkit to get the BPE-fallback format, or "
+                f"install the wheel.")
+        with open(path, "r", encoding="utf-8") as fh:
+            m = json.load(fh)
+        self._pieces: List[str] = m["pieces"]
+        self._p2i = {p: i for i, p in enumerate(self._pieces)}
+        self._ranks = {tuple(pr): r for r, pr in enumerate(m["merges"])}
+
+    # -- encoding -----------------------------------------------------------
+    def _bpe_word(self, word: str, dropout: float) -> List[str]:
+        sym = list(word)
+        if not sym:
+            return sym
+        while len(sym) > 1:
+            cand = [(self._ranks[pr], j)
+                    for j, pr in enumerate(zip(sym, sym[1:]))
+                    if tuple(pr) in self._ranks
+                    and not (dropout > 0
+                             and self._rng.random() < dropout)]
+            if not cand:
+                break
+            _, j = min(cand)
+            sym[j:j + 2] = [sym[j] + sym[j + 1]]
+        return sym
+
+    def encode(self, line: str, add_eos: bool = True,
+               inference: bool = False) -> List[int]:
+        if self.no_encode:
+            ids = [self._p2i.get(t, UNK_ID) for t in line.split()]
+        else:
+            drop = self.alpha if not inference else 0.0
+            ids = []
+            for w in line.split():
+                for p in self._bpe_word(_WB + w, drop):
+                    ids.append(self._p2i.get(p, UNK_ID))
+        if add_eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def decode(self, ids: Sequence[int], ignore_eos: bool = True) -> str:
+        toks = [self._pieces[int(i)] for i in ids
+                if int(i) < len(self._pieces)
+                and not (ignore_eos and int(i) == EOS_ID)]
+        return "".join(toks).replace(_WB, " ").strip()
+
+    def surface(self, ids: Sequence[int]) -> List[str]:
+        return [self._pieces[int(i)] if int(i) < len(self._pieces)
+                else "<unk>" for i in ids]
+
+    def __len__(self) -> int:
+        return len(self._pieces)

@@ -157,9 +157,31 @@ def create_vocab(path: Optional[str], options=None, stream_index: int = 0,
     """Vocab factory (reference: Vocab::create). Dispatch on extension;
     builds the vocab from training data when the file does not exist."""
     if path and path.endswith(".spm"):
-        from .spm_vocab import SentencePieceVocab
-        return SentencePieceVocab(path, options=options, stream_index=stream_index,
-                                  train_paths=train_paths)
+        from .spm_vocab import HAVE_SPM, SentencePieceVocab
+        if os.path.exists(path):
+            # dispatch an EXISTING model by content, not environment: a
+            # BPE-fallback file must load as BPE even after the wheel
+            # appears (else SentencePieceProcessor dies with an opaque
+            # protobuf error on our JSON)
+            with open(path, "rb") as fh:
+                head = fh.read(64)
+            if b"marian_tpu-bpe-v1" in head:
+                from .bpe_vocab import BPEVocab
+                return BPEVocab(path, options=options,
+                                stream_index=stream_index)
+        if HAVE_SPM:
+            return SentencePieceVocab(path, options=options,
+                                      stream_index=stream_index,
+                                      train_paths=train_paths)
+        # wheel absent: the in-repo BPE fallback keeps raw-text →
+        # subword-vocab → train workflows alive (not byte-compatible
+        # with real .spm binaries — bpe_vocab.py refuses those loudly)
+        from .bpe_vocab import BPEVocab
+        log.warn("sentencepiece package not installed — using the "
+                 "in-repo BPE fallback for {} (SPM-format models are "
+                 "not loadable without the wheel)", path)
+        return BPEVocab(path, options=options, stream_index=stream_index,
+                        train_paths=train_paths)
     if path and path.endswith(".fsv"):
         from .factored_vocab import FactoredVocab
         return FactoredVocab.load(path)

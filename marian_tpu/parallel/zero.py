@@ -149,27 +149,19 @@ class _GradMachinery:
                  for k, v in g.items()}
         return g, aux
 
-    def _local_grads(self, p, batch, rng, axis_fold=None):
-        """Per-device fwd/bwd (+ --optimizer-delay accumulation): gradients
-        of the LOCAL batch shard's loss, no cross-device reduction yet —
-        the reference's per-device graph->forward/backward before the
-        communicator runs (graph_group_sync.cpp). `axis_fold` (manual-DP
-        path) folds the device index into each key AFTER the per-micro
-        fold, mirroring the host loop's key derivation order so fused and
-        host delay paths stay numerically interchangeable."""
-        def _k(key):
-            if axis_fold is None:
-                return key
-            return jax.random.fold_in(key, axis_fold)
+    def _local_grads(self, p, batch, rng):
+        """GSPMD-path fwd/bwd (+ --optimizer-delay accumulation):
+        logically global gradients; the partitioner places the
+        cross-device sums (graph_group_sync.cpp's per-device backward,
+        expressed as annotations). Per-micro dropout keys fold exactly
+        like the host accumulation loop (GraphGroup.update), so the two
+        delay paths are numerically interchangeable."""
         if self.delay > 1:
             def body(carry, sl):
                 acc, tot, lab = carry
                 micro, i = sl
-                # per-micro-batch dropout keys fold exactly like the host
-                # accumulation loop (GraphGroup.update), so the two delay
-                # paths are numerically interchangeable
                 g, aux = self._grads_of(p, micro,
-                                        _k(jax.random.fold_in(rng, i)))
+                                        jax.random.fold_in(rng, i))
                 acc = jax.tree_util.tree_map(jnp.add, acc, g)
                 return (acc, tot + aux["ce_sum"], lab + aux["labels"]), None
             zeros = jax.tree_util.tree_map(
@@ -179,7 +171,7 @@ class _GradMachinery:
                        jnp.zeros((), jnp.float32)),
                 (batch, jnp.arange(self.delay)))
         else:
-            grads, aux = self._grads_of(p, batch, _k(rng))
+            grads, aux = self._grads_of(p, batch, rng)
             ce_sum, labels = aux["ce_sum"], aux["labels"]
         return grads, ce_sum, labels
 
@@ -212,11 +204,49 @@ class _GradMachinery:
         would instead insert its own full-size psum for unvarying inputs —
         double-counting ahead of psum_scatter — and unvarying lax.scan
         carries inside the models (RNN hidden states, delay accumulators)
-        would need pcast plumbing throughout."""
+        would need pcast plumbing throughout.
+
+        --optimizer-delay accumulates SHARD-sized: each micro-batch's
+        local gradients are reduce-scattered inside the scan and the
+        shards summed, so (a) the accumulator costs 1/N of the full
+        gradient HBM, (b) micro i's collective overlaps micro i+1's
+        compute, and (c) the summation order (Σ_micro RS(g_i)) is the
+        SAME as the heterogeneous-shape host loop's, keeping the two
+        delay paths bit-for-bit-ish interchangeable."""
         # independent per-device dropout streams (reference: per-device
         # cuRAND generators); with dropout off the key is never consumed
-        grads, ce_sum, labels = self._local_grads(
-            p, batch, rng, axis_fold=jax.lax.axis_index("data"))
+        axis_fold = jax.lax.axis_index("data")
+
+        def _k(key, i=None):
+            if i is not None:
+                key = jax.random.fold_in(key, i)
+            return jax.random.fold_in(key, axis_fold)
+
+        if self.delay > 1:
+            def body(carry, sl):
+                acc, tot, lab = carry
+                micro, i = sl
+                g, aux = self._grads_of(p, micro, _k(rng, i))
+                acc = jax.tree_util.tree_map(
+                    jnp.add, acc, self._scatter(g))
+                return (acc, tot + aux["ce_sum"], lab + aux["labels"]), None
+            zeros = {k: jnp.zeros(self._shard_shape(k), jnp.float32)
+                     for k in p}
+            (grads, ce_sum, labels), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)),
+                (batch, jnp.arange(self.delay)))
+        else:
+            g, aux = self._grads_of(p, batch, _k(rng))
+            grads = self._scatter(g)
+            ce_sum, labels = aux["ce_sum"], aux["labels"]
+        return (grads, jax.lax.psum(ce_sum, "data"),
+                jax.lax.psum(labels, "data"))
+
+    def _scatter(self, grads):
+        """scatterReduceAndResetGrads on one gradient tree: per-leaf
+        reduce-scatter onto its ZeRO-1 axis; whole-tensor psum for the
+        few leaves no axis divides."""
         out = {}
         for k, g in grads.items():
             ax = self.data_axes[k]
@@ -225,7 +255,16 @@ class _GradMachinery:
             else:
                 out[k] = jax.lax.psum_scatter(
                     g, "data", scatter_dimension=ax, tiled=True)
-        return out, jax.lax.psum(ce_sum, "data"), jax.lax.psum(labels, "data")
+        return out
+
+    def _shard_shape(self, k):
+        """LOCAL shape of gradient leaf k after _scatter (inside the
+        manual region): the ZeRO-1 axis divided by the data-axis size."""
+        shape = list(self._shapes[k])
+        ax = self.data_axes[k]
+        if ax is not None:
+            shape[ax] //= self.n_data
+        return tuple(shape)
 
     @staticmethod
     def _data_only(spec: P) -> P:

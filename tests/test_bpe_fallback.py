@@ -1,0 +1,154 @@
+"""In-repo BPE subword fallback (VERDICT r3 #4): ``--sentencepiece``-style
+workflows — train directly on raw text, the vocab is learned, subword
+units below the word level — must work in THIS image, where the
+sentencepiece wheel is absent (reference: src/data/sentencepiece_vocab.cpp
+vendors the SPM library so the capability never depends on the
+environment). tests/test_spm_e2e.py keeps the skip-marker for real-SPM
+byte compatibility; this file exercises the always-available path."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.bpe_vocab import BPEVocab, train_bpe
+from marian_tpu.data.vocab import EOS_ID, UNK_ID, create_vocab
+
+CORPUS = [
+    "the lowland owls howl loudly",
+    "the lowest owl howls in the lowlands",
+    "low lights glow in the lowland night",
+    "owls glow lowly under low light",
+] * 4
+
+
+def _model(tmp_path, vocab_size=64, alphas=(), seed=7):
+    path = str(tmp_path / "test.spm")
+    src = tmp_path / "corpus.txt"
+    src.write_text("\n".join(CORPUS) + "\n")
+    opts = Options({"dim-vocabs": [vocab_size], "seed": seed,
+                    **({"sentencepiece-alphas": list(alphas)}
+                       if alphas else {})})
+    return BPEVocab(path, options=opts, train_paths=[str(src)])
+
+
+class TestTrainer:
+    def test_learns_frequent_merges(self, tmp_path):
+        v = _model(tmp_path)
+        # "low" recurs across words → must become a single piece
+        pieces = set(v._pieces)
+        assert any("low" in p for p in pieces)
+        assert len(v) <= 64
+        assert v._pieces[EOS_ID] == "</s>" and v._pieces[UNK_ID] == "<unk>"
+
+    def test_deterministic(self, tmp_path):
+        p, m = train_bpe(iter(CORPUS), 64)
+        p2, m2 = train_bpe(iter(CORPUS), 64)
+        assert p == p2 and m == m2
+
+    def test_roundtrip(self, tmp_path):
+        v = _model(tmp_path)
+        for line in ("the owls howl", "low light glows"):
+            ids = v.encode(line)
+            assert ids[-1] == EOS_ID
+            assert v.decode(ids) == line
+        # unseen characters → <unk> pieces, no crash
+        ids = v.encode("zebra+quartz")
+        assert UNK_ID in ids
+
+    def test_subword_not_word_level(self, tmp_path):
+        v = _model(tmp_path)
+        # an unseen-but-composable word must encode as multiple known
+        # sub-word pieces, not one <unk> (the whole point of subwords)
+        ids = v.encode("lowlight", add_eos=False)
+        assert len(ids) >= 2 and UNK_ID not in ids
+        assert v.decode(ids) == "lowlight"
+
+    def test_bpe_dropout_sampling(self, tmp_path):
+        v = _model(tmp_path, alphas=(0.5,))
+        segs = {tuple(v.encode("the lowland owls", inference=False))
+                for _ in range(20)}
+        assert len(segs) > 1                   # sampled segmentations
+        # inference path is deterministic (no dropout)
+        one = {tuple(v.encode("the lowland owls", inference=True))
+               for _ in range(5)}
+        assert len(one) == 1
+
+    def test_refuses_real_spm_binary(self, tmp_path):
+        path = tmp_path / "real.spm"
+        path.write_bytes(b"\x0a\x13\x08\x01binary-protobuf-ish")
+        with pytest.raises(RuntimeError, match="sentencepiece"):
+            BPEVocab(str(path), options=Options({}))
+
+    def test_factory_dispatches_spm_extension(self, tmp_path):
+        src = tmp_path / "c.txt"
+        src.write_text("\n".join(CORPUS) + "\n")
+        v = create_vocab(str(tmp_path / "f.spm"),
+                         Options({"dim-vocabs": [64]}),
+                         train_paths=[str(src)])
+        try:
+            import sentencepiece  # noqa: F401
+            pytest.skip("real sentencepiece present — fallback not used")
+        except ImportError:
+            pass
+        assert isinstance(v, BPEVocab)
+
+
+@pytest.mark.slow
+def test_raw_text_to_train_to_decode_e2e(tmp_path):
+    """The capability itself: raw parallel text + nonexistent .spm vocab
+    paths → vocabs train from data → model trains → beam decode returns
+    text (no pre-built vocab anywhere)."""
+    from marian_tpu.data import BatchGenerator, Corpus
+    from marian_tpu.models.encoder_decoder import (batch_to_arrays,
+                                                   create_model)
+    from marian_tpu.training.graph_group import GraphGroup
+    from marian_tpu.translator.beam_search import BeamSearch
+    from marian_tpu.common import prng
+    import jax
+
+    src = tmp_path / "t.src"
+    trg = tmp_path / "t.trg"
+    src.write_text("\n".join(CORPUS) + "\n")
+    trg.write_text("\n".join(l.upper() for l in CORPUS) + "\n")
+    opts = Options({
+        "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 1, "dec-depth": 1,
+        "tied-embeddings": True, "dim-vocabs": [64, 64],
+        "precision": ["float32", "float32"], "max-length": 32,
+        "learn-rate": 0.05, "optimizer": "adam", "clip-norm": 1.0,
+        "cost-type": "ce-mean-words", "label-smoothing": 0.1,
+        "mini-batch": 8, "maxi-batch": 2, "shuffle": "none", "seed": 11,
+    })
+    vocabs = [create_vocab(str(tmp_path / f"v{i}.spm"), opts,
+                           stream_index=i, train_paths=[p])
+              for i, p in enumerate([str(src), str(trg)])]
+    corpus = Corpus([str(src), str(trg)], vocabs, opts)
+    model = create_model(opts, vocabs[0], vocabs[1])
+    gg = GraphGroup(model, opts)
+    key = prng.root_key(11)
+    gg.initialize(prng.stream(key, prng.STREAM_INIT))
+    losses = []
+    step = 0
+    n_updates = 40
+    while step < n_updates:
+        for batch in BatchGenerator(corpus, opts, prefetch=False):
+            out = gg.update(batch_to_arrays(batch), step + 1,
+                            jax.random.fold_in(key, step))
+            losses.append(out.loss_sum / max(out.labels, 1.0))
+            step += 1
+            if step >= n_updates:
+                break
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    bs = BeamSearch(model, [gg.export_params()], None,
+                    Options({"beam-size": 4, "max-length": 32}), vocabs[1])
+    line = CORPUS[0]
+    ids = vocabs[0].encode(line)
+    src_ids = np.asarray([ids], np.int32)
+    mask = np.ones_like(src_ids, np.float32)
+    nbest = bs.search(src_ids, mask)
+    text = vocabs[1].decode(nbest[0][0]["tokens"])
+    assert isinstance(text, str) and len(text) > 0

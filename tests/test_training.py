@@ -249,13 +249,18 @@ class TestFusedDelay:
         for k in p_host:
             if k.endswith("_bk"):
                 continue    # see delay-equivalence test above
-            # rtol covers the one inherent float difference between the
-            # paths: fused reduce-scatters the accumulated local grads
-            # once, the host loop reduce-scatters each micro and adds
-            # shards — associativity-level grad deltas that Adam's
-            # m̂/(√v̂+ε) step amplifies for near-zero coordinates. A key-
-            # folding bug (what this test is for) mismatches dropout masks
-            # wholesale and blows far past this tolerance.
-            np.testing.assert_allclose(np.asarray(p_fused[k]),
-                                       np.asarray(p_host[k]),
-                                       rtol=5e-3, atol=1e-6, err_msg=k)
+            # both paths reduce in the SAME order — Σ_micro RS(g_i); the
+            # fused scan scatters each micro inside the loop (zero.py
+            # _scatter_reduce_body) — so elementwise they agree to
+            # ~1e-4 rel EXCEPT isolated near-zero-gradient coordinates,
+            # where Adam's step-1 m̂/(√v̂+ε) amplifies cross-program
+            # fusion-reassociation noise unboundedly in relative terms.
+            # Assert (a) almost all elements tight, (b) every element
+            # within a fraction of one Adam step (lr=1e-3 here): a
+            # dropout-key or scatter-axis bug perturbs MOST elements by
+            # O(lr) and fails both.
+            a, b = np.asarray(p_fused[k]), np.asarray(p_host[k])
+            loose = ~np.isclose(a, b, rtol=1e-4, atol=2e-6)
+            assert loose.mean() <= 2 / 1024, \
+                f"{k}: {loose.sum()}/{loose.size} elements off"
+            np.testing.assert_allclose(a, b, atol=5e-4, err_msg=k)
