@@ -19,7 +19,7 @@ import pytest
 
 # ---------------------------------------------------------------------------
 # Test tiers. `pytest -m "not slow"` is the fast tier (CI-on-every-commit,
-# target <3 min on CPU); `pytest` runs everything (the TP/SP sweeps and
+# ~7 min on one CPU core); `pytest` runs everything (the TP/SP sweeps and
 # end-to-end training runs take several minutes more). Centralized here so
 # the tier stays visible in one place; names are test functions (parametrized
 # variants inherit).
