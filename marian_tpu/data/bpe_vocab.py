@@ -174,6 +174,17 @@ class BPEVocab(VocabBase):
         self._pieces: List[str] = m["pieces"]
         self._p2i = {p: i for i, p in enumerate(self._pieces)}
         self._ranks = {tuple(pr): r for r, pr in enumerate(m["merges"])}
+        # native C++ encoder for the deterministic hot path (reference:
+        # vendored C++ SentencePiece); id-identical to the Python merge
+        # loop, falls back silently if the toolchain can't build it
+        self._native = None
+        try:
+            from ..native import NativeBPEEncoder
+            self._native = NativeBPEEncoder(
+                self._pieces, [tuple(pr) for pr in m["merges"]])
+        except Exception as e:  # noqa: BLE001 — optional fast path
+            log.info("native BPE encoder unavailable ({}); using the "
+                     "Python path", e)
 
     # -- encoding -----------------------------------------------------------
     def _bpe_word(self, word: str, dropout: float) -> List[str]:
@@ -198,6 +209,9 @@ class BPEVocab(VocabBase):
             ids = [self._p2i.get(t, UNK_ID) for t in line.split()]
         else:
             drop = self.alpha if not inference else 0.0
+            if drop == 0.0 and self._native is not None:
+                ids = self._native.encode(line, add_eos=add_eos)
+                return ids
             ids = []
             for w in line.split():
                 for p in self._bpe_word(_WB + w, drop):

@@ -1,0 +1,154 @@
+// Native BPE encoder — the host-side subword tokenization hot path.
+//
+// The reference tokenizes through vendored C++ SentencePiece
+// (src/3rd_party/sentencepiece, src/data/sentencepiece_vocab.cpp); this
+// is the same component for the TPU build's in-repo BPE models
+// (marian_tpu/data/bpe_vocab.py trains them; this encoder must produce
+// BIT-IDENTICAL ids to bpe_vocab.BPEVocab._bpe_word's greedy
+// lowest-rank merge — tests/test_bpe_fallback.py asserts the parity).
+//
+// Plain C ABI for ctypes (no pybind11 in the image). One handle holds
+// piece→id and merge→rank tables; encode() whitespace-splits, prefixes
+// each word with the SPM-style "▁" marker, merges greedily by rank,
+// and maps pieces to ids (unk=1). Deterministic, no sampling — the
+// BPE-dropout path (--sentencepiece-alphas) stays in Python.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kEos = 0;
+constexpr int32_t kUnk = 1;
+const char kWb[] = "\xe2\x96\x81";  // U+2581 in UTF-8
+
+struct PairHash {
+    size_t operator()(const std::pair<std::string, std::string>& p) const {
+        std::hash<std::string> h;
+        size_t a = h(p.first);
+        return a ^ (h(p.second) + 0x9e3779b97f4a7c15ull + (a << 6) + (a >> 2));
+    }
+};
+
+struct Bpe {
+    std::unordered_map<std::string, int32_t> piece2id;
+    std::unordered_map<std::pair<std::string, std::string>, int32_t,
+                       PairHash> rank;
+};
+
+// one UTF-8 codepoint starting at i: byte length and decoded value
+size_t cp_at(const char* s, size_t len, size_t i, uint32_t* value) {
+    unsigned char c = s[i];
+    size_t n = (c < 0x80) ? 1 : (c < 0xE0) ? 2 : (c < 0xF0) ? 3 : 4;
+    if (i + n > len) n = 1;  // tolerate malformed input
+    uint32_t v = (n == 1) ? c : c & (0x7F >> n);
+    for (size_t k = 1; k < n; ++k) v = (v << 6) | (s[i + k] & 0x3F);
+    *value = v;
+    return n;
+}
+
+// Python str.split() whitespace (str.isspace() set) — the Python
+// encoder splits on these, so parity requires the same set here
+bool is_py_space(uint32_t cp) {
+    if ((cp >= 0x09 && cp <= 0x0D) || cp == 0x20) return true;
+    if (cp >= 0x1C && cp <= 0x1F) return true;
+    if (cp == 0x85 || cp == 0xA0 || cp == 0x1680) return true;
+    if (cp >= 0x2000 && cp <= 0x200A) return true;
+    return cp == 0x2028 || cp == 0x2029 || cp == 0x202F ||
+           cp == 0x205F || cp == 0x3000;
+}
+
+// split a UTF-8 word into single codepoints (the trainer's symbol
+// alphabet is Python characters == codepoints)
+void codepoints(const std::string& w, std::vector<std::string>* out) {
+    out->clear();
+    size_t i = 0;
+    while (i < w.size()) {
+        uint32_t v;
+        size_t n = cp_at(w.data(), w.size(), i, &v);
+        out->push_back(w.substr(i, n));
+        i += n;
+    }
+}
+
+void bpe_word(const Bpe& m, const std::string& word,
+              std::vector<int32_t>* ids) {
+    std::vector<std::string> sym;
+    codepoints(word, &sym);
+    while (sym.size() > 1) {
+        // lowest-rank adjacent pair; ties by leftmost position (matches
+        // Python's min() over (rank, index) tuples)
+        int best_rank = INT32_MAX;
+        size_t best_j = 0;
+        for (size_t j = 0; j + 1 < sym.size(); ++j) {
+            auto it = m.rank.find({sym[j], sym[j + 1]});
+            if (it != m.rank.end() && it->second < best_rank) {
+                best_rank = it->second;
+                best_j = j;
+            }
+        }
+        if (best_rank == INT32_MAX) break;
+        sym[best_j] += sym[best_j + 1];
+        sym.erase(sym.begin() + best_j + 1);
+    }
+    for (const auto& p : sym) {
+        auto it = m.piece2id.find(p);
+        ids->push_back(it == m.piece2id.end() ? kUnk : it->second);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create() { return new Bpe(); }
+
+void bpe_destroy(void* h) { delete static_cast<Bpe*>(h); }
+
+void bpe_add_piece(void* h, const char* piece, int32_t id) {
+    static_cast<Bpe*>(h)->piece2id.emplace(piece, id);
+}
+
+void bpe_add_merge(void* h, const char* left, const char* right,
+                   int32_t rank) {
+    static_cast<Bpe*>(h)->rank.emplace(
+        std::make_pair(std::string(left), std::string(right)), rank);
+}
+
+// Encode one UTF-8 line (explicit byte length — embedded NULs are data,
+// as in Python) into out[0..max_out); returns the id count, or -1 if
+// the line needs more than max_out ids (caller retries bigger).
+int32_t bpe_encode(void* h, const char* line, int32_t line_len,
+                   int32_t add_eos, int32_t* out, int32_t max_out) {
+    Bpe* m = static_cast<Bpe*>(h);
+    std::vector<int32_t> ids;
+    std::string word;
+    auto flush = [&]() {
+        if (!word.empty()) {
+            bpe_word(*m, std::string(kWb) + word, &ids);
+            word.clear();
+        }
+    };
+    size_t i = 0;
+    const size_t len = static_cast<size_t>(line_len);
+    while (i < len) {
+        uint32_t v;
+        size_t n = cp_at(line, len, i, &v);
+        if (is_py_space(v)) {
+            flush();
+        } else {
+            word.append(line + i, n);
+        }
+        i += n;
+    }
+    flush();
+    if (add_eos) ids.push_back(kEos);
+    if (static_cast<int32_t>(ids.size()) > max_out) return -1;
+    std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+    return static_cast<int32_t>(ids.size());
+}
+
+}  // extern "C"

@@ -96,6 +96,50 @@ class TestTrainer:
         assert isinstance(v, BPEVocab)
 
 
+class TestNativeEncoder:
+    """The C++ encode hot path (native/bpe_encoder.cpp — the reference
+    tokenizes through vendored C++ SentencePiece) must be id-identical
+    to the Python merge loop."""
+
+    def test_matches_python_encoder(self, tmp_path):
+        v = _model(tmp_path)
+        if v._native is None:
+            pytest.skip("native toolchain unavailable")
+        lines = CORPUS + [
+            "lowlight owls", "unseen zebra words", "a", "",
+            "  doubled   spaces\tand tabs ",
+            "ünïcödé wörds çömpösé tøø",
+            # Python str.split() splits on Unicode whitespace (NBSP,
+            # ideographic space, line sep) — parity includes that set
+            "low light", "low　light", "low light",
+            "low\x1dlight", "low\x85light",
+            # embedded NUL is DATA to Python, not a terminator
+            "low\x00light owls",
+        ]
+        for line in lines:
+            native = v._native.encode(line, add_eos=True)
+            v._native, saved = None, v._native
+            try:
+                python = v.encode(line, add_eos=True)
+            finally:
+                v._native = saved
+            assert native == python, line
+
+    def test_used_only_without_dropout(self, tmp_path):
+        v = _model(tmp_path, alphas=(0.5,))
+        if v._native is None:
+            pytest.skip("native toolchain unavailable")
+        # training-time encode samples (Python path); inference encode is
+        # deterministic and may take the native path — both must decode
+        # back to the original text
+        for _ in range(5):
+            assert v.decode(v.encode("the lowland owls howl")) \
+                == "the lowland owls howl"
+        assert v.decode(v.encode("the lowland owls howl",
+                                 inference=True)) \
+            == "the lowland owls howl"
+
+
 @pytest.mark.slow
 def test_raw_text_to_train_to_decode_e2e(tmp_path):
     """The capability itself: raw parallel text + nonexistent .spm vocab
