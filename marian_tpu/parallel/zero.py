@@ -100,14 +100,18 @@ class _GradMachinery:
     two paths fold dropout keys and reduce gradients identically."""
 
     def __init__(self, model, mesh: Mesh, params: Params, delay: int = 1,
-                 frozen=(), dim_emb: int = 0):
+                 frozen=(), dim_emb: int = 0, force_gspmd: bool = False):
+        """``force_gspmd`` routes even pure-DP meshes through the GSPMD
+        annotation path — test hook so the two gradient paths can be
+        compared head-to-head on the same mesh
+        (tests/test_distributed.py::test_manual_and_gspmd_paths_agree)."""
         self.mesh = mesh
         self.delay = delay
         self.n_data = mesh.shape["data"]
         # Explicit scatter-reduce runs on pure-DP meshes (the reference's
         # only parallelism and the north-star config); meshes with TP/SP/
         # pipe/expert axes compose through GSPMD annotations instead.
-        self.manual_dp = self.n_data > 1 and all(
+        self.manual_dp = not force_gspmd and self.n_data > 1 and all(
             mesh.shape[a] == 1 for a in mesh.shape if a != "data")
         if not dim_emb:
             dim_emb = int(getattr(getattr(model, "cfg", None),
@@ -303,7 +307,7 @@ def build_grad_fn(model, mesh: Mesh, params: Params, frozen=(),
 def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                      mesh: Mesh, params: Params, opt_state,
                      delay: int = 1, donate: bool = True, shardings=None,
-                     frozen=()):
+                     frozen=(), force_gspmd: bool = False):
     """Returns a jitted fn(params, opt_state, batch, step) →
     (params, opt_state, metrics) with SyncGraphGroup semantics.
 
@@ -317,7 +321,7 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
     precomputed (param_shardings, opt_state_shardings) to avoid recomputing.
     """
     machinery = _GradMachinery(model, mesh, params, delay=delay,
-                               frozen=frozen)
+                               frozen=frozen, force_gspmd=force_gspmd)
     g_specs = machinery.g_specs
 
     def step_fn(p, opt_state, batch, step, rng):

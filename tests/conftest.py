@@ -34,6 +34,7 @@ SLOW_TESTS = {
     "test_ring_grad_finite_with_empty_rows",
     "test_matches_dense",
     "test_8dev_matches_1dev_trajectory",
+    "test_manual_and_gspmd_paths_agree",
     # end-to-end training runs (test_training.py)
     "test_exact_resume",
     "test_optimizer_delay_equivalent_to_big_batch",
