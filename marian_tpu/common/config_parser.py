@@ -337,6 +337,11 @@ _SCORER = [
     _f("normalize-scorer", float, 0.0, "(see normalize)", "scorer"),
 ]
 
+_EMBEDDER = [
+    _f("train-sets", str, [], "(embedder) input text stream(s) to embed", "embedder", "*"),
+    _f("compute-similarity", bool, False, "(embedder) cosine similarity of two parallel text streams' sentence embeddings instead of printing vectors", "embedder"),
+]
+
 
 MODE_FLAGS: Dict[str, List[Any]] = {
     # training includes the translation group: the translation validator
@@ -345,7 +350,7 @@ MODE_FLAGS: Dict[str, List[Any]] = {
     "training": _COMMON + _MODEL + _TRAINING + _VALIDATION + _TRANSLATION,
     "translation": _COMMON + _MODEL + _TRANSLATION,
     "scoring": _COMMON + _MODEL + _TRAINING + _SCORER + _TRANSLATION,
-    "embedding": _COMMON + _MODEL + _TRANSLATION,
+    "embedding": _COMMON + _MODEL + _EMBEDDER + _TRANSLATION,
     "vocab": _COMMON,
     "server": _COMMON + _MODEL + _TRANSLATION,
 }

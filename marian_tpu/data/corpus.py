@@ -110,6 +110,12 @@ class Corpus:
         self.max_length_crop = bool(options.get("max-length-crop", False)) if options else False
         self.shuffle_mode = (options.get("shuffle", "data") if options else "none")
         self.right_left = bool(options.get("right-left", False)) if options else False
+        # target-stream id reversal applies to teacher-forced streams
+        # (training, scoring); decode-time TextInput leaves targets alone
+        # (the printer un-reverses hypotheses instead). The n-best
+        # rescorer overrides this to score hypotheses against an R2L
+        # model (reverse_target=True despite inference encoding).
+        self.reverse_target = self.right_left and not inference
         self.all_caps_every = int(options.get("all-caps-every", 0)) if options else 0
         self.title_case_every = int(options.get("english-title-case-every", 0)) if options else 0
         self.state = state or CorpusState(
@@ -211,8 +217,7 @@ class Corpus:
                     return None
             # --right-left: train the target right-to-left (reference:
             # corpus rightLeft_ reversing the target stream, EOS stays last)
-            if self.right_left and si == len(self.vocabs) - 1 \
-                    and not self.inference:
+            if self.reverse_target and si == len(self.vocabs) - 1:
                 ids = ids[-2::-1] + [ids[-1]]
             encoded.append(ids)
         align = None
@@ -252,12 +257,14 @@ class TextInput(Corpus):
     src/data/text_input.cpp). No shuffling, no length filter by default."""
 
     def __init__(self, lines_per_stream: Sequence[Sequence[str]],
-                 vocabs: Sequence[VocabBase], options=None):
+                 vocabs: Sequence[VocabBase], options=None,
+                 reverse_target: bool = False):
         super().__init__(paths=["<text>"] * len(lines_per_stream), vocabs=vocabs,
                          options=None, inference=True)
         if options is not None:
             self.max_length = int(options.get("max-length", 1000))
             self.max_length_crop = True
+        self.reverse_target = reverse_target
         self.shuffle_mode = "none"
         self._lines_cache = [list(s) for s in lines_per_stream]
         self._aligns = None

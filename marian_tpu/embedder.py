@@ -44,7 +44,12 @@ class Embedder:
         stream = stream or sys.stdout
         sets = list(self.options.get("train-sets", [])) or \
             list(self.options.get("input", []))
-        corpus = Corpus(sets[:1], self.vocabs,
+        similarity = bool(self.options.get("compute-similarity", False))
+        n_streams = 2 if similarity else 1
+        if similarity and len(sets) < 2:
+            raise ValueError("--compute-similarity expects TWO parallel "
+                             "text streams (--train-sets A B)")
+        corpus = Corpus(sets[:n_streams], self.vocabs * n_streams,
                         self.options.with_(**{"shuffle": "none",
                                               "max-length-crop": True}),
                         inference=True)
@@ -56,17 +61,31 @@ class Embedder:
         # before forcing batch i's vectors off the device
         from .common.pipeline import pipelined
 
+        def _embed_batch(b):
+            if similarity:
+                # cosine of the two streams' sentence embeddings
+                # (reference: embedder's --compute-similarity mode)
+                va = self._fn(self.params, jnp.asarray(b.sub[0].ids),
+                              jnp.asarray(b.sub[0].mask))
+                vb = self._fn(self.params, jnp.asarray(b.sub[1].ids),
+                              jnp.asarray(b.sub[1].mask))
+                na = jnp.maximum(jnp.linalg.norm(va, axis=-1), 1e-9)
+                nb = jnp.maximum(jnp.linalg.norm(vb, axis=-1), 1e-9)
+                return (va * vb).sum(-1) / (na * nb)
+            return self._fn(self.params, jnp.asarray(b.src.ids),
+                            jnp.asarray(b.src.mask))
+
         def _finalize(pbatch, dev):
             vecs = np.asarray(dev)
             for row in range(pbatch.size):
                 out[int(pbatch.sentence_ids[row])] = vecs[row]
 
-        pipelined(bg,
-                  lambda b: self._fn(self.params, jnp.asarray(b.src.ids),
-                                     jnp.asarray(b.src.mask)),
-                  _finalize)
+        pipelined(bg, _embed_batch, _finalize)
         for i in sorted(out):
-            stream.write(" ".join(f"{x:.6f}" for x in out[i]) + "\n")
+            if similarity:
+                stream.write(f"{float(out[i]):.6f}\n")
+            else:
+                stream.write(" ".join(f"{x:.6f}" for x in out[i]) + "\n")
         stream.flush()
 
 

@@ -58,15 +58,11 @@ class Rescorer:
 
         self._score_fn = jax.jit(per_sentence_ce)
 
-    def run(self, stream=None) -> List[float]:
+    def _score_corpus(self, corpus):
+        """Teacher-force score every sentence tuple: returns
+        ({sid: logP}, total_ce, total_words). Shared by the parallel-
+        corpus and n-best paths so score semantics can't drift."""
         opts = self.options
-        stream = stream or sys.stdout
-        sets = list(opts.get("train-sets", []))
-        corpus = Corpus(sets, self.vocabs,
-                        opts.with_(**{"shuffle": "none",
-                                      "max-length": opts.get("max-length", 1000),
-                                      "max-length-crop": True}),
-                        inference=False)
         bg = BatchGenerator(corpus, None,
                             mini_batch=int(opts.get("mini-batch", 64) or 64),
                             maxi_batch=10, maxi_batch_sort="src",
@@ -90,6 +86,20 @@ class Rescorer:
         pipelined(bg,
                   lambda b: self._score_fn(self.params, batch_to_arrays(b)),
                   _finalize)
+        return scores, total_ce, total_words
+
+    def run(self, stream=None) -> List[float]:
+        opts = self.options
+        stream = stream or sys.stdout
+        sets = list(opts.get("train-sets", []))
+        if opts.get("n-best", False):
+            return self._run_nbest(sets, stream)
+        corpus = Corpus(sets, self.vocabs,
+                        opts.with_(**{"shuffle": "none",
+                                      "max-length": opts.get("max-length", 1000),
+                                      "max-length-crop": True}),
+                        inference=False)
+        scores, total_ce, total_words = self._score_corpus(corpus)
         ordered = [scores[i] for i in sorted(scores)]
         summary = opts.get("summary", None)
         if summary:
@@ -106,6 +116,60 @@ class Rescorer:
         else:
             for s in ordered:
                 stream.write(f"{s:.6f}\n")
+        stream.flush()
+        return ordered
+
+
+    def _run_nbest(self, sets, stream) -> List[float]:
+        """--n-best: the LAST train-set is an n-best list
+        (`sid ||| hyp ||| features ||| score`), preceded by one file per
+        source stream; every hypothesis is teacher-force scored against
+        its sentence's source(s) and the list is re-emitted with the new
+        feature appended to the features column (reference: rescorer.h
+        n-best rescoring, the marian-scorer half of R2L reranking — an
+        R2L model's hypotheses are reversed before scoring exactly as
+        the training corpus reverses targets)."""
+        opts = self.options
+        n_src = max(len(self.vocabs) - 1, 1)
+        if len(sets) != n_src + 1:
+            raise ValueError(
+                f"--n-best rescoring expects --train-sets with {n_src} "
+                f"source file(s) + the n-best list (got {len(sets)})")
+        src_streams = []
+        for p in sets[:-1]:
+            with open(p, "r", encoding="utf-8") as fh:
+                src_streams.append([l.rstrip("\n") for l in fh])
+        entries = []                      # (sid, hyp, parts)
+        with open(sets[-1], "r", encoding="utf-8") as fh:
+            for line in fh:
+                parts = line.rstrip("\n").split(" ||| ")
+                if len(parts) < 2:
+                    raise ValueError(f"malformed n-best line: {line!r}")
+                sid = int(parts[0])
+                if not 0 <= sid < len(src_streams[0]):
+                    raise ValueError(
+                        f"n-best sentence id {sid} out of range for "
+                        f"{len(src_streams[0])}-line source")
+                entries.append((sid, parts[1], parts))
+        from .data.corpus import TextInput
+        streams = [[s[sid] for sid, _, _ in entries] for s in src_streams]
+        streams.append([hyp for _, hyp, _ in entries])
+        corpus = TextInput(streams, self.vocabs, opts,
+                           reverse_target=bool(
+                               opts.get("right-left", False)))
+        scores, _, _ = self._score_corpus(corpus)
+        feature = opts.get("n-best-feature", "Score")
+        ordered = []
+        for i, (_sid, _hyp, parts) in enumerate(entries):
+            s = scores[i]
+            ordered.append(s)
+            seg = f"{feature}= {s:.6f}"
+            if len(parts) >= 3:
+                parts = list(parts)
+                parts[2] = (parts[2] + " " + seg).strip()
+            else:
+                parts = list(parts) + [seg]
+            stream.write(" ||| ".join(parts) + "\n")
         stream.flush()
         return ordered
 

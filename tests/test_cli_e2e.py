@@ -110,6 +110,39 @@ class TestScorerCLI:
         scores = [float(x) for x in out]
         assert all(s <= 0 for s in scores)  # log-probs
 
+    def test_nbest_rescoring(self, trained_model, capsys):
+        """--n-best: the scorer re-emits the n-best list with the new
+        feature appended to the features column (reference: rescorer.h
+        n-best rescoring — the marian-scorer half of R2L reranking)."""
+        tmp, model, _, _ = trained_model
+        s = tmp / "nb.src"; s.write_text("a b c\nb c d\n")
+        nb = tmp / "nb.lst"
+        nb.write_text(
+            "0 ||| x y z ||| F0= -0.1 ||| -0.1\n"
+            "0 ||| x y w ||| F0= -0.9 ||| -0.9\n"
+            "1 ||| y z w ||| F0= -0.2 ||| -0.2\n")
+        marian_scorer.main([
+            "--models", model,
+            "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+            "--train-sets", str(s), str(nb), "--n-best",
+            "--n-best-feature", "Rescore", "--quiet",
+        ])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 3
+        originals = nb.read_text().splitlines()
+        rescores = []
+        for i, line in enumerate(out):
+            parts = line.split(" ||| ")
+            assert parts[0] == ("0" if i < 2 else "1")
+            assert parts[2].startswith("F0= ") and "Rescore= " in parts[2]
+            # total column passes through untouched from the input list
+            assert parts[3] == originals[i].split(" ||| ")[3]
+            rescores.append(float(parts[2].split("Rescore= ")[1]))
+        assert all(r <= 0 for r in rescores)   # log-probs
+        # the overfit pair ("a b c" -> "x y z") must outscore the junk
+        # hypothesis for the same sentence
+        assert rescores[0] > rescores[1]
+
     def test_summary_perplexity(self, trained_model, capsys):
         tmp, model, _, _ = trained_model
         s = tmp / "sc.src"; s.write_text("a b c\n")
@@ -122,6 +155,40 @@ class TestScorerCLI:
         ])
         out = capsys.readouterr().out.strip()
         assert float(out) >= 1.0
+
+
+class TestEmbedderCLI:
+    def test_embeds_one_vector_per_line(self, trained_model, capsys):
+        from marian_tpu.cli import marian_embedder
+        tmp, model, _, _ = trained_model
+        s = tmp / "emb.txt"; s.write_text("a b c\nb c d\nc d a\n")
+        marian_embedder.main([
+            "--models", model, "--vocabs", str(tmp / "v.src.yml"),
+            "--train-sets", str(s), "--quiet",
+        ])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 3
+        dims = {len(line.split()) for line in out}
+        assert len(dims) == 1 and dims.pop() > 1
+
+    def test_compute_similarity(self, trained_model, capsys):
+        """--compute-similarity (reference: embedder similarity mode):
+        cosine per line pair; identical lines score 1.0 and beat
+        mismatched ones."""
+        from marian_tpu.cli import marian_embedder
+        tmp, model, _, _ = trained_model
+        a = tmp / "sim.a"; a.write_text("a b c\na b c\n")
+        b = tmp / "sim.b"; b.write_text("a b c\nd a b\n")
+        marian_embedder.main([
+            "--models", model, "--vocabs", str(tmp / "v.src.yml"),
+            "--train-sets", str(a), str(b), "--compute-similarity",
+            "--quiet",
+        ])
+        out = [float(x) for x in
+               capsys.readouterr().out.strip().splitlines()]
+        assert len(out) == 2
+        assert out[0] == pytest.approx(1.0, abs=1e-4)
+        assert -1.0 <= out[1] < out[0]
 
 
 class TestMetrics:

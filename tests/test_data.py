@@ -235,3 +235,18 @@ class TestRightLeft:
         assert st.streams[0] == v.encode("a b c")
         assert st.streams[1][:-1] == v.encode("x y z")[:-1][::-1]
         assert st.streams[1][-1] == v.eos_id
+
+    def test_textinput_reverse_target_for_nbest_rescoring(self):
+        """TextInput leaves targets alone at decode time, but the n-best
+        rescorer must reverse hypotheses before scoring them against an
+        R2L model (rescorer._run_nbest passes reverse_target=True)."""
+        from marian_tpu.data.corpus import TextInput
+        from marian_tpu.data.vocab import DefaultVocab
+        v = DefaultVocab.build(["a b c x y z"])
+        plain = next(iter(TextInput([["a b c"], ["x y z"]], [v, v])))
+        rev = next(iter(TextInput([["a b c"], ["x y z"]], [v, v],
+                                  reverse_target=True)))
+        assert plain.streams[1] == v.encode("x y z")
+        assert rev.streams[0] == plain.streams[0]       # source untouched
+        assert rev.streams[1][:-1] == plain.streams[1][:-1][::-1]
+        assert rev.streams[1][-1] == v.eos_id
