@@ -159,10 +159,16 @@ def main():
 
     tmp = tempfile.mkdtemp(prefix="marian_bench_")
     src_p, trg_p = _write_corpus(tmp, dims["vocab"], n_lines)
+    vsz = (dims["vocab"], dims["vocab"])  # static uint16 gate per stream
 
     fused_mode = os.environ.get("MARIAN_BENCH_FUSED", "tune")
 
     opt_dtype = os.environ.get("MARIAN_BENCH_OPT_DTYPE", "float32")
+    # uint16-token + row-length host→device transfer (default on; the
+    # bench device sits behind a network tunnel in some deployments, so
+    # per-step transfer bytes are a first-class lever — A/B with 0)
+    compact = os.environ.get("MARIAN_BENCH_COMPACT", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
     remat = os.environ.get("MARIAN_BENCH_REMAT", "").strip().lower() \
         in ("1", "true", "on", "yes")
     stacked = os.environ.get("MARIAN_BENCH_STACKED", "").strip().lower() \
@@ -236,7 +242,7 @@ def main():
         t_ab = time.perf_counter()
         for mode in ("on", "off"):
             g = build_gg(mode)
-            arrays = batch_to_arrays(probe)
+            arrays = batch_to_arrays(probe, compact=compact, vocab_sizes=vsz)
             for i in range(2):                       # compile + settle
                 g.update(dict(arrays), i + 1,
                          jax.random.fold_in(train_key, i))
@@ -293,7 +299,7 @@ def main():
     progress.update(phase="compile", n_shapes=len(by_shape))
     for sk, b in by_shape.items():
         t0 = time.perf_counter()
-        gg.update(batch_to_arrays(b), step + 1,
+        gg.update(batch_to_arrays(b, compact=compact, vocab_sizes=vsz), step + 1,
                   jax.random.fold_in(train_key, step))
         jax.block_until_ready(gg.params)
         dt_shape = time.perf_counter() - t0
@@ -304,7 +310,7 @@ def main():
     progress.update(phase="warmup")
     for _ in range(warmup):
         b = timed_batches[step % len(timed_batches)]
-        gg.update(batch_to_arrays(b), step + 1,
+        gg.update(batch_to_arrays(b, compact=compact, vocab_sizes=vsz), step + 1,
                   jax.random.fold_in(train_key, step))
         step += 1
     jax.block_until_ready(gg.params)
@@ -328,7 +334,7 @@ def main():
         chunk = timed_batches[i:i + CHUNK]
         t0 = time.perf_counter()
         for b in chunk:
-            gg.update(batch_to_arrays(b), step + 1,
+            gg.update(batch_to_arrays(b, compact=compact, vocab_sizes=vsz), step + 1,
                       jax.random.fold_in(train_key, step))
             step += 1
         jax.block_until_ready(gg.params)
@@ -369,6 +375,7 @@ def main():
         "remat": remat,
         "stacked_params": stacked,
         "words_budget": words,
+        "compact_transfer": compact,
     }
     progress.update(phase="done", result=result)
     if jax.default_backend() == "tpu":

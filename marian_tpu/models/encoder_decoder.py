@@ -284,18 +284,50 @@ def apply_embedded_config(options, config_yaml: Optional[str]):
     return options.with_(**{k: emb[k] for k in keys})
 
 
-def batch_to_arrays(batch) -> Dict[str, jnp.ndarray]:
+def batch_to_arrays(batch, compact: bool = False,
+                    vocab_sizes=None) -> Dict[str, jnp.ndarray]:
     """CorpusBatch → dict of device arrays for the jitted loss. Extra
-    source streams (multi-source) become src{i}_ids/src{i}_mask."""
-    out = {
-        "src_ids": jnp.asarray(batch.src.ids),
-        "src_mask": jnp.asarray(batch.src.mask),
-        "trg_ids": jnp.asarray(batch.trg.ids),
-        "trg_mask": jnp.asarray(batch.trg.mask),
-    }
+    source streams (multi-source) become src{i}_ids/src{i}_mask.
+
+    ``compact=True`` slims the host→device transfer (which crosses a
+    network tunnel in some deployments, and PCIe everywhere): token ids
+    ship as uint16 when they fit, and the 0/1 float masks ship as per-row
+    int32 LENGTHS (padding is terminal, so the mask is a prefix of ones)
+    — ~4× fewer bytes per step. The jitted step rebuilds int32 ids and
+    float masks on device (parallel/zero.py::expand_compact_batch).
+
+    ``vocab_sizes`` (one size per stream, batch.sub order) makes the
+    uint16 decision STATIC per run — required for stable jit signatures:
+    a per-batch ids.max() gate would flip the key set (and force a full
+    recompile) the first time a near-64k vocab's batch drew a high id.
+    Without it the per-batch max is used (fine for fixed test vocabs).
+    A mask that is not a prefix run (never produced by BatchGenerator)
+    still falls back to the full form per-stream, loudly correct."""
+    def stream(idx: int, prefix: str, sb) -> Dict[str, jnp.ndarray]:
+        if compact:
+            import numpy as np
+            ids = np.asarray(sb.ids)
+            mask = np.asarray(sb.mask)
+            if vocab_sizes is not None:
+                fits = int(vocab_sizes[idx]) <= 2 ** 16
+            else:
+                fits = ids.max(initial=0) < 2 ** 16
+            lengths = mask.sum(axis=-1).astype(np.int32)
+            prefix_run = (mask ==
+                          (np.arange(mask.shape[-1]) <
+                           lengths[..., None])).all()
+            if fits and prefix_run:
+                return {f"{prefix}_tok": jnp.asarray(
+                            ids.astype(np.uint16)),
+                        f"{prefix}_len": jnp.asarray(lengths)}
+        return {f"{prefix}_ids": jnp.asarray(sb.ids),
+                f"{prefix}_mask": jnp.asarray(sb.mask)}
+
+    out = {}
+    out.update(stream(0, "src", batch.src))
+    out.update(stream(len(batch.sub) - 1, "trg", batch.trg))
     for i, sb in enumerate(batch.sub[1:-1], start=2):
-        out[f"src{i}_ids"] = jnp.asarray(sb.ids)
-        out[f"src{i}_mask"] = jnp.asarray(sb.mask)
+        out.update(stream(i - 1, f"src{i}", sb))
     if batch.guided_alignment is not None:
         out["guided"] = jnp.asarray(batch.guided_alignment)
     if batch.data_weights is not None:

@@ -97,8 +97,10 @@ def batch_leaf_spec(name: str, ndim: int, micro: bool = False) -> P:
     if micro:
         inner = batch_leaf_spec(name, ndim - 1)
         return P(*((None,) + tuple(inner)))
-    if (name.endswith("_ids") or name.endswith("_mask")) and ndim == 2:
+    if (name.endswith("_ids") or name.endswith("_mask")
+            or name.endswith("_tok")) and ndim == 2:
         return P("data", "seq")
+    # compact per-row lengths ([B]) and other 1D+ leaves shard batch-only
     return P("data") if ndim >= 1 else P()
 
 

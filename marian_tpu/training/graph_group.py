@@ -269,8 +269,9 @@ class GraphGroup:
             return TrainOutput(metrics["ce_sum"], metrics["labels"],
                                metrics["gnorm"])
         if (self._fused_delay is not None and len(batches) == self.delay
-                and all(all(v.shape == batches[0][k].shape
-                            for k, v in b.items())
+                and all(b.keys() == batches[0].keys()
+                        and all(v.shape == batches[0][k].shape
+                                for k, v in b.items())
                         for b in batches[1:])):
             # stack micro-batches on a leading [delay] axis → ONE jitted
             # call (lax.scan accumulates grads on-device; SyncGraphGroup
@@ -304,7 +305,10 @@ class GraphGroup:
             grads, aux = self._grad_fn(self.params, M.shard_batch(b, self.mesh), r)
             total_loss = total_loss + aux["ce_sum"]        # lazy device adds
             total_labels = total_labels + aux["labels"]
-            n_sents += int(b["trg_ids"].shape[0])
+            # rows from whichever target form shipped (compact batches
+            # carry trg_tok/trg_len instead of trg_ids/trg_mask)
+            trg = b["trg_ids"] if "trg_ids" in b else b["trg_tok"]
+            n_sents += int(trg.shape[0])
             grads_acc = grads if grads_acc is None else \
                 jax.tree_util.tree_map(jnp.add, grads_acc, grads)
         self.params, self.opt_state, gnorm, _lr = self._update_fn(

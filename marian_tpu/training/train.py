@@ -248,6 +248,12 @@ class Train:
         maybe_start_profile_server(opts)
         trace = TraceWindow(opts)
         train_key = prng.stream(key, prng.STREAM_DROPOUT)
+        # --compact-transfer: ship uint16 tokens + row lengths instead of
+        # int32 ids + float masks (~4× less host→device traffic per step;
+        # the jitted step rebuilds ids/masks on device). Static per-stream
+        # vocab sizes keep the jit signature stable across batches.
+        compact = bool(opts.get("compact-transfer", True))
+        vocab_sizes = [len(v) for v in vocabs]
         log.info("Training started")
         stop = False
         while scheduler.keep_going() and not stop:
@@ -259,7 +265,9 @@ class Train:
                 micro.append(batch)
                 if len(micro) < delay:
                     continue
-                arrays = [batch_to_arrays(b) for b in micro]
+                arrays = [batch_to_arrays(b, compact=compact,
+                                          vocab_sizes=vocab_sizes)
+                          for b in micro]
                 trace.tick(state.batches + 1)
                 out = gg.update(arrays, state.batches + 1,
                                 jax.random.fold_in(train_key, state.batches))

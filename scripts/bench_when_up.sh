@@ -117,6 +117,10 @@ ladder() {
                           MARIAN_BENCH_WORDS=$WORDS_AB
     stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_OPT_DTYPE=bfloat16
+    # compact host→device transfer OFF (default is on): isolates how much
+    # of the step the tunnel's per-batch id/mask bytes cost
+    stage transfer_full 5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_COMPACT=0
     # 32k tokens needs remat headroom; if it OOMs the stage fails
     # gracefully and the ladder continues
     stage words_32k_remat 5400 MARIAN_BENCH_PRESET=$PRESET \

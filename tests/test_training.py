@@ -214,6 +214,57 @@ class TestEMAAndDelay:
                                        rtol=5e-3, atol=5e-5, err_msg=k)
 
 
+class TestCompactTransfer:
+    def test_compact_batch_is_equivalent(self, tmp_corpus, tmp_path):
+        """batch_to_arrays(compact=True) ships uint16 tokens + row
+        lengths; the jitted step rebuilds ids/masks on device — the
+        update must be numerically IDENTICAL to the full form."""
+        import jax.numpy as jnp
+        src, tgt, _ = tmp_corpus
+        opts = train_options(tmp_path, src, tgt)
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        vt = DefaultVocab.build(open(tgt).read().splitlines())
+        model = create_model(opts, len(vs), len(vt))
+        corpus = Corpus([src, tgt], [vs, vt], opts)
+        batch = next(iter(BatchGenerator(corpus, opts, prefetch=False)))
+
+        full = batch_to_arrays(batch, compact=False)
+        comp = batch_to_arrays(batch, compact=True)
+        assert "src_tok" in comp and comp["src_tok"].dtype == jnp.uint16
+        assert "src_mask" not in comp
+        # transfer bytes actually shrink (the point of the feature)
+        assert sum(v.nbytes for v in comp.values()) < \
+            0.5 * sum(v.nbytes for v in full.values())
+
+        def run(arrays):
+            gg = GraphGroup(model, opts, donate=False)
+            gg.initialize(jax.random.key(0))
+            out = gg.update(dict(arrays), 1, jax.random.key(3))
+            return float(out.loss_sum), gg.params
+
+        l_full, p_full = run(full)
+        l_comp, p_comp = run(comp)
+        assert l_full == l_comp
+        for k in p_full:
+            np.testing.assert_array_equal(np.asarray(p_full[k]),
+                                          np.asarray(p_comp[k]), err_msg=k)
+
+    def test_ragged_mask_falls_back_to_full_form(self, tmp_corpus,
+                                                 tmp_path):
+        """A mask that is not a prefix run (hand-built hole) must ship
+        in the classic ids+mask form rather than corrupt silently."""
+        src, tgt, _ = tmp_corpus
+        opts = train_options(tmp_path, src, tgt)
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        corpus = Corpus([src, tgt], [vs, vs], opts)
+        batch = next(iter(BatchGenerator(corpus, opts, prefetch=False)))
+        batch.src.mask[0, 0] = 0.0          # hole at position 0
+        arrays = batch_to_arrays(batch, compact=True)
+        assert "src_ids" in arrays and "src_mask" in arrays
+        # the target stream is untouched and still compacts
+        assert "trg_tok" in arrays
+
+
 class TestFusedDelay:
     def test_fused_delay_matches_host_loop(self, tmp_corpus, tmp_path):
         """Shape-uniform micro-batches take the in-jit lax.scan
