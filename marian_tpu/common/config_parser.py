@@ -169,6 +169,7 @@ _TRAINING = [
     _f("optimizer-state-dtype", str, "float32", "Storage dtype for Adam's first moment: float32 | bfloat16 (halves m's HBM footprint and per-step traffic; math stays f32, v stays f32; beyond the reference)", "training"),
     _f("async-save", bool, False, "Overlap checkpoint writes with training: device snapshots on the train thread, numpy+disk IO on a background worker (beyond the reference, whose Train::save blocks the update loop). Needs transient HBM headroom for one device copy of params+EMA+optimizer state at save time", "training"),
     _f("compact-transfer", bool, True, "Ship training batches as uint16 tokens + per-row lengths instead of int32 ids + float masks (~4x less host-to-device traffic per step; ids/masks are rebuilt inside the jitted step — beyond the reference)", "training"),
+    _f("tensorboard", str, None, "Write train/valid scalars (cost, words/s, learn rate, validation metrics) as TensorBoard events to this directory (beyond the reference, which logs text only)", "training", "?"),
     _f("logical-epoch", str, ["1e"], "Logical epoch spec, e.g. 1Gt", "training", "+"),
     _f("max-length-factor", float, 3.0, "Max target length factor of source length while decoding", "training"),
     _f("shuffle", str, "data", "data, batches, none", "training"),

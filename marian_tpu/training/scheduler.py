@@ -58,6 +58,40 @@ class Scheduler:
         self._sent_sum = 0
         self._timer = time.perf_counter()
         self._disp_count = 0
+        # --tensorboard DIR (TPU extension; the reference logs text only):
+        # train/valid scalars via torch's SummaryWriter (baked-in). Never
+        # a hard dependency — unavailable writer degrades to a warning.
+        self._tb = None
+        tb_dir = options.get("tensorboard", None)
+        if tb_dir is not None:
+            if not tb_dir:
+                # bare --tensorboard still means ON (same convention as
+                # --profile): default next to the model
+                tb_dir = str(options.get("model", "model.npz")) + ".tb"
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb = SummaryWriter(log_dir=str(tb_dir))
+            except Exception as e:  # noqa: BLE001 — optional extra
+                log.warn("--tensorboard unavailable ({}); scalars "
+                         "disabled", e)
+
+    def _tb_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._tb is not None:
+            try:
+                self._tb.add_scalar(tag, value, step)
+            except Exception:  # noqa: BLE001 — never kill training for TB
+                pass
+
+    def close(self) -> None:
+        """Flush+close the TensorBoard writer (torch's event thread
+        buffers up to 120s — without this the final display/validation
+        scalars are lost at process exit)."""
+        if self._tb is not None:
+            try:
+                self._tb.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._tb = None
 
     # -- continuation conditions (reference: keepGoing) ----------------------
     def keep_going(self) -> bool:
@@ -150,6 +184,15 @@ class Scheduler:
         if self.lr_report:
             line += f" : L.r. {s.eta:.4e}"
         log.info("{}", line)
+        self._tb_scalar("train/cost", cost, s.batches)
+        self._tb_scalar("train/words_per_sec", wps, s.batches)
+        self._tb_scalar("train/learn_rate", s.eta, s.batches)
+        try:
+            # same number the text line shows (1-based; honors
+            # --logical-epoch's fractional display)
+            self._tb_scalar("train/epoch", float(ep), s.batches)
+        except ValueError:
+            self._tb_scalar("train/epoch", s.epochs + 1, s.batches)
         self._cost_sum = self._label_sum = self._words_sum = 0.0
         self._sent_sum = 0
         self._disp_count = 0
@@ -195,6 +238,7 @@ class Scheduler:
         improved = (best is None or
                     (value < best - eps if lower_is_better
                      else value > best + eps))
+        self._tb_scalar(f"valid/{metric}", float(value), s.batches)
         if improved:
             rec["last-best"] = float(value)
             rec["stalled"] = 0

@@ -299,6 +299,7 @@ class Train:
             if not stop:
                 scheduler.new_epoch()
         trace.close()
+        scheduler.close()       # flush buffered TensorBoard scalars
         log.info("Training finished")
         do_save()
         if saver is not None:

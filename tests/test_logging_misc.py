@@ -55,6 +55,53 @@ class TestLogging:
         assert "only x one" in capsys.readouterr().err
 
 
+class TestTensorboardScalars:
+    def test_writes_events_at_display_and_validation(self, tmp_path):
+        """--tensorboard DIR: train scalars at each display boundary and
+        valid/<metric> at registration (beyond the reference; uses
+        torch's SummaryWriter, already in the image)."""
+        pytest.importorskip("torch.utils.tensorboard")
+        import os
+        from marian_tpu.common import Options
+        from marian_tpu.training.scheduler import Scheduler
+        from marian_tpu.training.training_state import TrainingState
+        tb = tmp_path / "tb"
+        opts = Options({"disp-freq": "2u", "tensorboard": str(tb),
+                        "cost-type": "ce-mean-words",
+                        "valid-metrics": ["cross-entropy"]})
+        sched = Scheduler(opts, TrainingState())
+        for i in range(4):
+            sched.update(3.0 * 10, 10.0, 2)
+        sched.register_validation("cross-entropy", 2.5)
+        sched.close()           # the train driver's shutdown flush
+        events = [f for f in os.listdir(tb) if "tfevents" in f]
+        assert events, "no TensorBoard event file written"
+        assert os.path.getsize(tb / events[0]) > 0
+
+    def test_bare_flag_defaults_next_to_model(self, tmp_path):
+        pytest.importorskip("torch.utils.tensorboard")
+        import os
+        from marian_tpu.common import Options
+        from marian_tpu.training.scheduler import Scheduler
+        from marian_tpu.training.training_state import TrainingState
+        # bare --tensorboard parses to "" (nargs='?') — still means ON,
+        # defaulting to <model>.tb like --profile's convention
+        opts = Options({"disp-freq": "1u", "tensorboard": "",
+                        "model": str(tmp_path / "m.npz")})
+        sched = Scheduler(opts, TrainingState())
+        sched.update(3.0, 1.0, 1)
+        sched.close()
+        assert os.path.isdir(tmp_path / "m.npz.tb")
+
+    def test_disabled_without_flag(self):
+        from marian_tpu.common import Options
+        from marian_tpu.training.scheduler import Scheduler
+        from marian_tpu.training.training_state import TrainingState
+        sched = Scheduler(Options({"disp-freq": "2u"}), TrainingState())
+        assert sched._tb is None
+        sched.close()           # no-op without a writer
+
+
 class TestTimer:
     def test_elapsed_monotonic(self):
         from marian_tpu.common.timer import Timer
