@@ -47,6 +47,25 @@ class TestCorpus:
         src, tgt, (sl, tl) = tmp_corpus
         return DefaultVocab.build(sl), DefaultVocab.build(tl)
 
+    def test_caps_augmentation_every_n(self, tmp_path):
+        """--all-caps-every / --english-title-case-every (corpus.cpp
+        augmentation): exactly every Nth sentence is upper/title-cased
+        before encoding — the off sentences stay untouched."""
+        (tmp_path / "c.src").write_text("ab cd\nab cd\nab cd\nab cd\n")
+        (tmp_path / "c.trg").write_text("xy\nxy\nxy\nxy\n")
+        v = DefaultVocab.build(["ab cd xy AB CD Ab Cd XY"])
+        paths = [str(tmp_path / "c.src"), str(tmp_path / "c.trg")]
+        opts = Options({"max-length": 20, "shuffle": "none",
+                        "all-caps-every": 2})
+        caps = [t.streams[0] for t in Corpus(paths, [v, v], opts)]
+        assert caps[0] == caps[2] == v.encode("ab cd")   # odd: untouched
+        assert caps[1] == caps[3] == v.encode("AB CD")   # every 2nd
+        opts = Options({"max-length": 20, "shuffle": "none",
+                        "english-title-case-every": 2})
+        title = [t.streams[0] for t in Corpus(paths, [v, v], opts)]
+        assert title[0] == title[2] == v.encode("ab cd")
+        assert title[1] == title[3] == v.encode("Ab Cd")
+
     def test_iterates_epoch(self, tmp_corpus):
         src, tgt, (sl, _) = tmp_corpus
         vs, vt = self._vocabs(tmp_corpus)
