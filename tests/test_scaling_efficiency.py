@@ -82,12 +82,16 @@ def test_scaling_overhead_bound_and_collective_budget():
     t1, _, _ = _timed_step(1)
     t8, hlo8, n_leaves = _timed_step(8)
     ratio = t8 / t1
-    # one core serializes the 8 virtual devices → ideal ratio 8.0 at
-    # fixed per-device batch. Bound chosen with headroom for timer noise
-    # and in-process collective scheduling on this 1-core box; a
-    # replicated-Adam or re-replication regression lands well above it,
-    # a vanished shard (under-provisioned mesh) well below.
-    assert 4.0 < ratio < 16.0, f"8-dev/1-dev wall ratio {ratio:.1f}"
+    # the N cores share the 8 virtual devices' serialized compute →
+    # ideal wall ratio is 8 / min(8, cores) at fixed per-device batch
+    # (8.0 on the usual 1-core box). Bounds leave headroom for timer
+    # noise and in-process collective scheduling; a replicated-Adam or
+    # re-replication regression lands well above, a vanished shard
+    # (under-provisioned mesh) well below.
+    import os
+    ideal = 8.0 / min(8, os.cpu_count() or 1)
+    assert ideal * 0.45 < ratio < ideal * 2.0 + 2.0, \
+        f"8-dev/1-dev wall ratio {ratio:.2f} (ideal {ideal:.1f})"
 
     from marian_tpu.parallel.collectives import (collective_stats,
                                                  format_stats)
