@@ -36,12 +36,33 @@ class Translate:
         model_paths = list(options.get("models", [])) or [options.get("model")]
         self.params_list = []
         embedded_cfg = None
+        first_names = None
         for mp in model_paths:
             params, cfg_yaml = mio.load_model(mp)
             # marian-conv int8 checkpoints: pair values+scales into QTensors
             from ..ops.quantization import wrap_quantized
             self.params_list.append(wrap_quantized(
                 {k: jnp.asarray(v) for k, v in params.items()}))
+            # ensemble scorers share ONE architecture (the jitted beam
+            # steps each params dict through the same model): a mixed-arch
+            # --models list must fail here with the file named, not as an
+            # obscure shape error deep inside the first traced step.
+            # Shapes, not just names: same-topology/different-dimension
+            # mixes (dim-emb, vocab size) are the common accident.
+            sig = {k: tuple(getattr(v, "shape", ()))
+                   for k, v in self.params_list[-1].items()}
+            if first_names is None:
+                first_names = sig
+            elif sig != first_names:
+                diff = sorted(
+                    set(sig) ^ set(first_names)
+                    or {k for k in sig
+                        if sig[k] != first_names.get(k)})[:5]
+                raise ValueError(
+                    f"--models ensemble members must share one "
+                    f"architecture; {mp} differs from {model_paths[0]} "
+                    f"(e.g. {diff}) — rescore n-best lists with "
+                    f"marian-scorer to combine unlike models")
             if cfg_yaml and embedded_cfg is None:
                 embedded_cfg = cfg_yaml
         # model architecture comes from the checkpoint-embedded config unless

@@ -229,3 +229,46 @@ class TestMetrics:
         score = v.validate(jparams)
         assert 0.0 <= score <= 100.0
         assert score > 10.0  # overfit toy should translate training data well
+
+
+class TestEnsembleValidation:
+    def test_mixed_architecture_models_fail_loudly(self, trained_model,
+                                                   tmp_path):
+        """--models with unlike architectures must name the offending
+        file instead of dying in a traced shape error."""
+        from marian_tpu.common import io as mio
+        from marian_tpu.common.config_parser import ConfigParser
+        from marian_tpu.translator.translator import Translate
+        tmp, model, _, _ = trained_model
+        other = tmp_path / "other.npz"
+        params, cfg = mio.load_model(model)
+        params = dict(params)
+        params["encoder_l1_extra_W"] = np.zeros((2, 2), np.float32)
+        mio.save_model(str(other), params, cfg)
+        opts = ConfigParser("translation").parse([
+            "--models", model, str(other),
+            "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+            "--beam-size", "2", "--quiet"])
+        with pytest.raises(ValueError, match="share one architecture"):
+            Translate(opts)
+
+    def test_same_names_different_shapes_fail_loudly(self, trained_model,
+                                                     tmp_path):
+        """Same topology, different dimensions (the common accidental
+        mix — e.g. dim-emb or vocab mismatch) must also be caught."""
+        from marian_tpu.common import io as mio
+        from marian_tpu.common.config_parser import ConfigParser
+        from marian_tpu.translator.translator import Translate
+        tmp, model, _, _ = trained_model
+        other = tmp_path / "widened.npz"
+        params, cfg = mio.load_model(model)
+        params = {k: (np.zeros((v.shape[0] * 2,) + v.shape[1:],
+                               np.float32) if k == "Wemb" else v)
+                  for k, v in dict(params).items()}
+        mio.save_model(str(other), params, cfg)
+        opts = ConfigParser("translation").parse([
+            "--models", model, str(other),
+            "--vocabs", str(tmp / "v.src.yml"), str(tmp / "v.tgt.yml"),
+            "--beam-size", "2", "--quiet"])
+        with pytest.raises(ValueError, match="share one architecture"):
+            Translate(opts)
