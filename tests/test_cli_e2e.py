@@ -263,8 +263,9 @@ class TestEnsembleValidation:
         other = tmp_path / "widened.npz"
         params, cfg = mio.load_model(model)
         params = {k: (np.zeros((v.shape[0] * 2,) + v.shape[1:],
-                               np.float32) if k == "Wemb" else v)
+                               np.float32) if k == "encoder_Wemb" else v)
                   for k, v in dict(params).items()}
+        assert any(k == "encoder_Wemb" for k in params)
         mio.save_model(str(other), params, cfg)
         opts = ConfigParser("translation").parse([
             "--models", model, str(other),
