@@ -20,6 +20,7 @@ same regularizing effect as SPM's unigram sampling.
 
 from __future__ import annotations
 
+import heapq
 import json
 import os
 import random
@@ -72,23 +73,38 @@ def train_bpe(lines: Iterable[str], vocab_size: int,
             pair_counts[pr] += f
             pair_words.setdefault(pr, set()).add(w)
 
+    # lazy-deletion max-heap over pair counts: after each merge, every
+    # TOUCHED pair (count moved either direction) gets one fresh entry
+    # at its final count; stale entries are skipped at pop time. A
+    # linear max() scan per merge is O(pairs × merges) — hours at real
+    # scale (32k merges over millions of distinct pairs); the heap makes
+    # each merge O(touched·log P). Deterministic: ties pop the
+    # lexicographically smallest pair (the defined order — models are
+    # trained per-environment, no artifacts pin a different one).
+    heap = [(-c, pr) for pr, c in pair_counts.items()]
+    heapq.heapify(heap)
+
     merges: List[Tuple[str, str]] = []
     seen = set(pieces)
-    while len(pieces) < vocab_size and pair_counts:
-        # deterministic: max count, then lexicographic pair
-        best = max(pair_counts.items(), key=lambda kv: (kv[1], kv[0]))[0]
-        if pair_counts[best] < 2:
+    while len(pieces) < vocab_size and heap:
+        negc, best = heapq.heappop(heap)
+        cur = pair_counts.get(best, 0)
+        if cur != -negc:
+            continue                  # stale entry (count changed since push)
+        if cur < 2:
             break                     # singleton pairs don't generalize
         merged = best[0] + best[1]
         merges.append(best)
         if merged not in seen:
             pieces.append(merged)
             seen.add(merged)
+        touched = set()
         for w in list(pair_words.get(best, ())):
             f = word_freq[w]
             old = words[w]
             for pr in _pairs(old):
                 pair_counts[pr] -= f
+                touched.add(pr)
                 if pair_counts[pr] <= 0:
                     del pair_counts[pr]
                 s = pair_words.get(pr)
@@ -109,7 +125,16 @@ def train_bpe(lines: Iterable[str], vocab_size: int,
             words[w] = tuple(new)
             for pr in _pairs(words[w]):
                 pair_counts[pr] += f
+                touched.add(pr)
                 pair_words.setdefault(pr, set()).add(w)
+        # one fresh entry per touched pair at its FINAL count — covers
+        # decrements too (a pair whose count only ever falls must still
+        # be reachable at its reduced count; pushing only on increments
+        # would orphan it once its init-time entry goes stale)
+        for pr in touched:
+            c = pair_counts.get(pr, 0)
+            if c >= 2:
+                heapq.heappush(heap, (-c, pr))
     return pieces[:vocab_size], merges
 
 

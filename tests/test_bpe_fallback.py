@@ -48,6 +48,15 @@ class TestTrainer:
         p2, m2 = train_bpe(iter(CORPUS), 64)
         assert p == p2 and m == m2
 
+    def test_decremented_pair_stays_mergeable(self):
+        """Lazy-heap regression: a pair whose count only ever FALLS
+        (here (▁a,x) drops when (x,y) merges first inside '▁axy') must
+        still be selected at its reduced count — push-on-increment-only
+        orphans it once its init-time heap entry goes stale."""
+        lines = ["xy"] * 5 + ["axy"] * 3 + ["ax"] * 4
+        _, merges = train_bpe(iter(lines), 64)
+        assert merges.index(("x", "y")) < merges.index(("▁a", "x"))
+
     def test_roundtrip(self, tmp_path):
         v = _model(tmp_path)
         for line in ("the owls howl", "low light glows"):
