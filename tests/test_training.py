@@ -249,6 +249,40 @@ class TestCompactTransfer:
             np.testing.assert_array_equal(np.asarray(p_full[k]),
                                           np.asarray(p_comp[k]), err_msg=k)
 
+    def test_compact_equivalent_on_composed_mesh(self, tmp_corpus,
+                                                 tmp_path):
+        """Compact batches must also be exact through the GSPMD path on
+        a composed dp×tp×sp mesh (the manual-DP path only runs on pure-
+        data meshes; _tok/_len leaves carry their own sharding specs)."""
+        import jax.numpy as jnp
+        src, tgt, _ = tmp_corpus
+        opts = train_options(tmp_path, src, tgt).with_(
+            **{"mesh": ["data:2", "model:2", "seq:2"]})
+        vs = DefaultVocab.build(open(src).read().splitlines())
+        vt = DefaultVocab.build(open(tgt).read().splitlines())
+        model = create_model(opts, len(vs), len(vt))
+        corpus = Corpus([src, tgt], [vs, vt], opts)
+        batch = next(iter(BatchGenerator(corpus, opts, prefetch=False)))
+
+        def run(arrays):
+            gg = GraphGroup(model, opts, donate=False)
+            gg.initialize(jax.random.key(0))
+            out = gg.update(dict(arrays), 1, jax.random.key(3))
+            return float(out.loss_sum), gg.params
+
+        l_full, p_full = run(batch_to_arrays(batch, compact=False))
+        l_comp, p_comp = run(batch_to_arrays(batch, compact=True))
+        # same ids/masks VALUES, but the partitioner schedules the
+        # in-jit expansion differently than a transferred mask →
+        # reduction orders differ at float-associativity level (the
+        # pure-DP manual path above is bitwise; this one is merely
+        # numerically tight)
+        np.testing.assert_allclose(l_full, l_comp, rtol=1e-6)
+        for k in p_full:
+            np.testing.assert_allclose(np.asarray(p_full[k]),
+                                       np.asarray(p_comp[k]),
+                                       rtol=1e-5, atol=1e-7, err_msg=k)
+
     def test_ragged_mask_falls_back_to_full_form(self, tmp_corpus,
                                                  tmp_path):
         """A mask that is not a prefix run (hand-built hole) must ship
