@@ -35,6 +35,7 @@ SLOW_TESTS = {
     "test_matches_dense",
     "test_8dev_matches_1dev_trajectory",
     "test_manual_and_gspmd_paths_agree",
+    "test_compact_equivalent_on_composed_mesh",
     # end-to-end training runs (test_training.py)
     "test_exact_resume",
     "test_optimizer_delay_equivalent_to_big_batch",
