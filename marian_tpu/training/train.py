@@ -159,6 +159,10 @@ class Train:
                 reset_best=bool(opts.get("valid-reset-all", False)))
             log.info("Validation stall counters reset on resume")
         validators = create_validators(opts, vocabs, model)
+        for v in validators:
+            # the mutable TrainingState, attached once: validators read
+            # the CURRENT moment for {U}/{E}/{B}/{T} output-path templates
+            v.training_state = state
 
         config_yaml = opts.as_yaml()
         delay = gg.delay

@@ -89,6 +89,18 @@ class TranslationValidator(Validator, _BeamOverDevSet):
         hyps, refs = self.decode_dev(params)
         out_path = self.options.get("valid-translation-output", None)
         if out_path:
+            # {U}/{E}/{B}/{T} expand to the training moment (reference:
+            # TranslationValidator output-path templates — update count,
+            # 1-based epoch, updates within the epoch, total target
+            # labels), so successive validations keep their own files
+            # instead of overwriting
+            st = getattr(self, "training_state", None)
+            if st is not None:
+                out_path = (str(out_path)
+                            .replace("{U}", str(st.batches))
+                            .replace("{E}", str(st.epochs + 1))
+                            .replace("{B}", str(st.batches_epoch))
+                            .replace("{T}", str(int(st.labels_total))))
             with open(out_path, "w", encoding="utf-8") as fh:
                 fh.write("\n".join(hyps) + "\n")
         script = self.options.get("valid-script-path", None)

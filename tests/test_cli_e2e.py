@@ -273,3 +273,36 @@ class TestEnsembleValidation:
             "--beam-size", "2", "--quiet"])
         with pytest.raises(ValueError, match="share one architecture"):
             Translate(opts)
+
+
+class TestTranslationValidator:
+    def test_templated_validation_output(self, tmp_path):
+        """--valid-metrics translation + --valid-translation-output with
+        {U}/{E} templates: each validation beam-decodes the dev set and
+        writes its own file (reference: TranslationValidator path
+        templates), so successive validations don't overwrite."""
+        src = tmp_path / "t.src"; trg = tmp_path / "t.trg"
+        src.write_text("a b c\nb c a\n" * 3)
+        trg.write_text("x y z\ny z x\n" * 3)
+        out_tpl = tmp_path / "dev.u{U}.e{E}.txt"
+        marian_train.main([
+            "--type", "transformer",
+            "--train-sets", str(src), str(trg),
+            "--vocabs", str(tmp_path / "v.s.yml"), str(tmp_path / "v.t.yml"),
+            "--model", str(tmp_path / "m.npz"),
+            "--dim-emb", "16", "--transformer-heads", "2",
+            "--transformer-dim-ffn", "32", "--enc-depth", "1",
+            "--dec-depth", "1", "--precision", "float32", "float32",
+            "--mini-batch", "6", "--learn-rate", "0.01",
+            "--after-batches", "8", "--disp-freq", "8",
+            "--save-freq", "100", "--seed", "3", "--max-length", "16",
+            "--valid-sets", str(src), str(trg),
+            "--valid-metrics", "translation", "--valid-freq", "4",
+            "--valid-translation-output", str(out_tpl),
+            "--beam-size", "2", "--quiet",
+        ])
+        outs = sorted(p.name for p in tmp_path.glob("dev.u*.txt"))
+        assert len(outs) >= 2, outs            # one file per validation
+        assert "dev.u4.e" in outs[0] and "{U}" not in outs[0]
+        first = (tmp_path / outs[0]).read_text().splitlines()
+        assert len(first) == 6                 # one hyp per dev line
