@@ -28,6 +28,13 @@ Env knobs:
                         for the padding-tax run — VERDICT r2 weak #6)
   MARIAN_BENCH_SCAN     force --scan-layers on/off for an A/B (default:
                         model default)
+  MARIAN_BENCH_SEQLEN   long-sequence stage: one bucket at exactly this
+                        width, corpus lines at [s/2, s] words (doc-level
+                        lengths; pairs with MARIAN_BENCH_FLASH for the
+                        flash-attention A/B)
+  MARIAN_BENCH_FLASH    force --transformer-flash-attention on/off/auto
+  MARIAN_BENCH_COMPACT  0 disables the uint16+lengths host→device
+                        transfer (transfer_full A/B stage)
 """
 
 import datetime
@@ -70,10 +77,12 @@ class Progress:
             pass
 
 
-def _write_corpus(tmp, vocab_size, n_lines, seed=7):
-    """Mixed-length synthetic parallel corpus (Zipf-ish lengths 4..64,
-    mean ~28 — matches a WMT-style length histogram closely enough to
-    exercise the bucket table the way real data does)."""
+def _write_corpus(tmp, vocab_size, n_lines, seed=7, max_words=63):
+    """Mixed-length synthetic parallel corpus (Zipf-ish lengths 4..64 by
+    default, mean ~28 — matches a WMT-style length histogram closely
+    enough to exercise the bucket table the way real data does). For the
+    long-sequence stage (max_words >> 64, doc-level concatenation
+    lengths) lines are drawn uniform in [max_words//2, max_words]."""
     rng = random.Random(seed)
     words = [f"w{i}" for i in range(vocab_size - 2)]  # EOS/UNK take 2 slots
     src_p = os.path.join(tmp, "b.src")
@@ -83,8 +92,13 @@ def _write_corpus(tmp, vocab_size, n_lines, seed=7):
         fs.write(" ".join(words) + "\n")
         ft.write(" ".join(words) + "\n")
         for _ in range(n_lines):
-            n = min(63, max(4, int(rng.lognormvariate(3.2, 0.45))))
-            m = min(63, max(4, int(n * rng.uniform(0.8, 1.25))))
+            if max_words > 64:
+                n = rng.randint(max_words // 2, max_words)
+                m = min(max_words, max(4, int(n * rng.uniform(0.9, 1.1))))
+            else:
+                n = min(max_words, max(4, int(rng.lognormvariate(3.2, 0.45))))
+                m = min(max_words,
+                        max(4, int(n * rng.uniform(0.8, 1.25))))
             fs.write(" ".join(rng.choice(words) for _ in range(n)) + "\n")
             ft.write(" ".join(rng.choice(words) for _ in range(m)) + "\n")
     return src_p, trg_p
@@ -157,8 +171,28 @@ def main():
         words = int(os.environ.get("MARIAN_BENCH_WORDS", 512))
         n_lines, steps, warmup = 200, 5, 2
 
+    # MARIAN_BENCH_SEQLEN: long-sequence stage (doc-level concatenation
+    # lengths — the long-context story measured, not just designed):
+    # one bucket at exactly this width (rows crop to seqlen-1 + EOS),
+    # corpus drawn at [s/2, s], token budget floored to ≥4 rows/batch.
+    try:
+        seqlen = int(os.environ.get("MARIAN_BENCH_SEQLEN", 0) or 0)
+    except ValueError:
+        # unattended ladder: a typo must not kill the tunnel-up window
+        print(f"bench: bad MARIAN_BENCH_SEQLEN="
+              f"{os.environ['MARIAN_BENCH_SEQLEN']!r} — ignoring",
+              file=sys.stderr, flush=True)
+        seqlen = 0
+    if seqlen > 64:
+        max_len = seqlen - 1
+        buckets = (seqlen,)
+        bucket_env = str(seqlen)
+        words = max(words, 4 * seqlen)
+        n_lines = min(n_lines, 600)
+
     tmp = tempfile.mkdtemp(prefix="marian_bench_")
-    src_p, trg_p = _write_corpus(tmp, dims["vocab"], n_lines)
+    src_p, trg_p = _write_corpus(tmp, dims["vocab"], n_lines,
+                                 max_words=max_len)
     vsz = (dims["vocab"], dims["vocab"])  # static uint16 gate per stream
 
     fused_mode = os.environ.get("MARIAN_BENCH_FUSED", "tune")
@@ -182,9 +216,18 @@ def main():
             print(f"bench: bad MARIAN_BENCH_SCAN="
                   f"{os.environ['MARIAN_BENCH_SCAN']!r} (want on/off) — "
                   f"using model default", file=sys.stderr, flush=True)
+    flash_env = os.environ.get("MARIAN_BENCH_FLASH")  # on/off/auto A/B
+    if flash_env:
+        flash_env = flash_env.strip().lower()
+        if flash_env not in ("on", "off", "auto"):
+            print(f"bench: bad MARIAN_BENCH_FLASH={flash_env!r} "
+                  f"(want on/off/auto) — using model default",
+                  file=sys.stderr, flush=True)
+            flash_env = None
     opts = Options({
         "type": "transformer",
         **({"scan-layers": scan_env == "on"} if scan_env else {}),
+        **({"transformer-flash-attention": flash_env} if flash_env else {}),
         "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
         "transformer-heads": dims["heads"],
         "enc-depth": dims["depth"], "dec-depth": dims["depth"],
@@ -376,6 +419,8 @@ def main():
         "stacked_params": stacked,
         "words_budget": words,
         "compact_transfer": compact,
+        "seqlen": max_len + 1,
+        "flash": flash_env or "default",
     }
     progress.update(phase="done", result=result)
     if jax.default_backend() == "tpu":

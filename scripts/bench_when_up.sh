@@ -126,6 +126,19 @@ ladder() {
     stage words_32k_remat 5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_WORDS=$((WORDS_AB * 2)) \
                           MARIAN_BENCH_REMAT=1
+    # long-context: doc-concatenation lengths with the Pallas flash
+    # kernel on vs off (the long-sequence story measured on silicon)
+    local SEQ=2048
+    [ "${ALLOW_CPU:-}" = 1 ] && SEQ=128
+    # fused-CE pinned ON so the only variable between the two legs is
+    # the attention kernel (the tune probe would also cold-compile the
+    # new 2048-wide shape once per leg for nothing)
+    stage longseq_flash 5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_SEQLEN=$SEQ MARIAN_BENCH_FUSED=on \
+                          MARIAN_BENCH_REMAT=1 MARIAN_BENCH_FLASH=on
+    stage longseq_dense 5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_SEQLEN=$SEQ MARIAN_BENCH_FUSED=on \
+                          MARIAN_BENCH_REMAT=1 MARIAN_BENCH_FLASH=off
     # 5 — profile-directed trace, summarized to a committed text artifact
     # (summarize into a temp file first: a failed/empty summary must not
     # truncate-and-commit over a previous good one)
