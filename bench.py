@@ -35,6 +35,10 @@ Env knobs:
   MARIAN_BENCH_FLASH    force --transformer-flash-attention on/off/auto
   MARIAN_BENCH_COMPACT  0 disables the uint16+lengths host→device
                         transfer (transfer_full A/B stage)
+  MARIAN_BENCH_DISPATCH K>1 = --dispatch-window: K full updates per
+                        jitted dispatch (lax.scan over same-bucket
+                        batches) — amortizes per-dispatch host/tunnel
+                        latency over K real updates
 """
 
 import datetime
@@ -207,6 +211,9 @@ def main():
         in ("1", "true", "on", "yes")
     stacked = os.environ.get("MARIAN_BENCH_STACKED", "").strip().lower() \
         in ("1", "true", "on", "yes")
+    # --dispatch-window: K full updates per jitted dispatch (lax.scan) —
+    # amortizes per-dispatch host/tunnel latency over K real updates
+    window = max(1, int(os.environ.get("MARIAN_BENCH_DISPATCH", "1") or 1))
     scan_env = os.environ.get("MARIAN_BENCH_SCAN")  # on/off A/B knob
     if scan_env:
         scan_env = {"on": "on", "1": "on", "true": "on",
@@ -227,6 +234,7 @@ def main():
     opts = Options({
         "type": "transformer",
         **({"scan-layers": scan_env == "on"} if scan_env else {}),
+        **({"dispatch-window": window} if window > 1 else {}),
         **({"transformer-flash-attention": flash_env} if flash_env else {}),
         "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
         "transformer-heads": dims["heads"],
@@ -350,6 +358,40 @@ def main():
         progress.state["shape_warm_s"][str(sk)] = round(dt_shape, 1)
         progress.flush()
         step += 1
+    # dispatch plan: with --dispatch-window, stable-sort the timed batches
+    # by bucket shape and group runs of K — full windows go through ONE
+    # jitted dispatch (update_window), stragglers singly. Total tokens and
+    # batch population are identical to the unwindowed run.
+    if window > 1:
+        order = sorted(range(len(timed_batches)),
+                       key=lambda j: (str(timed_batches[j].shape_key()), j))
+        timed_batches = [timed_batches[j] for j in order]
+        plan, run_ = [], []
+        for b in timed_batches:
+            if run_ and (b.shape_key() != run_[0].shape_key()
+                         or len(run_) == window):
+                plan.append(run_)
+                run_ = []
+            run_.append(b)
+        if run_:
+            plan.append(run_)
+        for sk in sorted({g[0].shape_key() for g in plan
+                          if len(g) == window}):
+            b = by_shape[sk]
+            arrays = batch_to_arrays(b, compact=compact, vocab_sizes=vsz)
+            t0 = time.perf_counter()
+            gg.update_window([dict(arrays) for _ in range(window)],
+                             step + 1, train_key)
+            jax.block_until_ready(gg.params)
+            print(f"  window[{window}] shape {sk}: "
+                  f"{time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
+            progress.state["shape_warm_s"][f"win{window}:{sk}"] = round(
+                time.perf_counter() - t0, 1)
+            progress.flush()
+            step += window
+    else:
+        plan = [[b] for b in timed_batches]
     progress.update(phase="warmup")
     for _ in range(warmup):
         b = timed_batches[step % len(timed_batches)]
@@ -373,25 +415,37 @@ def main():
     src_tokens = flops = 0.0
     dt = 0.0
     i = 0
-    while i < len(timed_batches):
-        chunk = timed_batches[i:i + CHUNK]
+    done = 0
+    while i < len(plan):
+        chunk = plan[i:i + CHUNK]        # CHUNK dispatches, not batches
         t0 = time.perf_counter()
-        for b in chunk:
-            gg.update(batch_to_arrays(b, compact=compact, vocab_sizes=vsz), step + 1,
-                      jax.random.fold_in(train_key, step))
-            step += 1
+        for grp in chunk:
+            if window > 1 and len(grp) == window:
+                gg.update_window(
+                    [batch_to_arrays(b, compact=compact, vocab_sizes=vsz)
+                     for b in grp],
+                    step + 1, train_key)
+                step += window
+            else:
+                for b in grp:
+                    gg.update(batch_to_arrays(b, compact=compact,
+                                              vocab_sizes=vsz),
+                              step + 1, jax.random.fold_in(train_key, step))
+                    step += 1
         jax.block_until_ready(gg.params)
         dt += time.perf_counter() - t0
-        for b in chunk:
-            src_tokens += b.src_words      # real (mask-counted) src tokens
-            flops += transformer_train_flops(
-                dims["emb"], dims["ffn"], dims["depth"], dims["depth"],
-                dims["vocab"], b.src_words, b.words,
-                b.src.batch_width, b.trg.batch_width)
+        for grp in chunk:
+            for b in grp:
+                src_tokens += b.src_words  # real (mask-counted) src tokens
+                flops += transformer_train_flops(
+                    dims["emb"], dims["ffn"], dims["depth"], dims["depth"],
+                    dims["vocab"], b.src_words, b.words,
+                    b.src.batch_width, b.trg.batch_width)
+                done += 1
         i += CHUNK
         progress.update(
             tok_per_sec_running=round(src_tokens / dt / max(n_chips, 1), 1),
-            timed_steps_done=i)
+            timed_steps_done=done)
 
     if profile_dir:
         jax.profiler.stop_trace()
@@ -418,6 +472,7 @@ def main():
         "remat": remat,
         "stacked_params": stacked,
         "words_budget": words,
+        "dispatch_window": window,
         "compact_transfer": compact,
         "seqlen": max_len + 1,
         "flash": flash_env or "default",

@@ -329,7 +329,8 @@ def build_grad_fn(model, mesh: Mesh, params: Params, frozen=(),
 def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                      mesh: Mesh, params: Params, opt_state,
                      delay: int = 1, donate: bool = True, shardings=None,
-                     frozen=(), force_gspmd: bool = False):
+                     frozen=(), force_gspmd: bool = False,
+                     n_updates: int = 1):
     """Returns a jitted fn(params, opt_state, batch, step) →
     (params, opt_state, metrics) with SyncGraphGroup semantics.
 
@@ -341,12 +342,30 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
     delay > 1 so the leading micro axis stays unsharded). Only the outputs
     are pinned here so donation layouts match. `shardings` optionally passes
     precomputed (param_shardings, opt_state_shardings) to avoid recomputing.
+
+    `n_updates` > 1 (--dispatch-window) runs K FULL update cycles —
+    fwd/bwd, reduce-scatter, clip, Adam, EMA, all-gather — inside ONE
+    jitted dispatch via lax.scan over a leading [K] window axis on the
+    batch leaves (shard_batch micro=True keeps it unsharded). `rng` must
+    be the RAW training stream key: scan iteration i folds it by the
+    absolute step number step+i-1 — the same derivation the sequential
+    path uses on the host — so trajectories are bit-identical no matter
+    how updates group into windows; metrics come back stacked [K]. The point is amortizing
+    host→device dispatch latency (a network-tunneled chip, or host-bound
+    dispatch on a pod) over K real updates — the reference has no
+    equivalent lever because its per-update host loop is mandatory
+    (graph_group_sync.cpp :: SyncGraphGroup::update returns to the host
+    scheduler every update). Requires delay == 1.
     """
+    if n_updates > 1 and delay > 1:
+        raise ValueError("--dispatch-window composes with in-jit "
+                         "--optimizer-delay accumulation only via the "
+                         "host loop; use one or the other")
     machinery = _GradMachinery(model, mesh, params, delay=delay,
                                frozen=frozen, force_gspmd=force_gspmd)
     g_specs = machinery.g_specs
 
-    def step_fn(p, opt_state, batch, step, rng):
+    def one_update(p, opt_state, batch, step, rng):
         batch = expand_compact_batch(batch)
         grads, ce_sum, labels = machinery.grads(p, batch, rng)
 
@@ -371,6 +390,27 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
             metrics["ce_sum"] = jnp.where(skipped > 0, 0.0, ce_sum)
             metrics["labels"] = jnp.where(skipped > 0, 0.0, labels)
         return new_p, new_opt, metrics
+
+    if n_updates <= 1:
+        step_fn = one_update
+    else:
+        def step_fn(p, opt_state, batch, step, rng):
+            # rng here is the RAW training stream key (callers fold it on
+            # the host for the single-step path); sub-update i folds by the
+            # ABSOLUTE step number step+i-1 in-scan, so the windowed
+            # trajectory is bit-identical to sequential update() calls
+            # regardless of how updates group into windows. f32→i32 step
+            # cast is exact below 2^24 updates.
+            def body(carry, xs):
+                pp, oo = carry
+                b, i = xs
+                k = jax.random.fold_in(rng, step.astype(jnp.int32) + i - 1)
+                np_, no_, m = one_update(pp, oo, b,
+                                         step + i.astype(jnp.float32), k)
+                return (np_, no_), m
+            (p, opt_state), metrics = jax.lax.scan(
+                body, (p, opt_state), (batch, jnp.arange(n_updates)))
+            return p, opt_state, metrics
 
     rep = M.replicated(mesh)
     # TP (Megatron-style over 'model') via GSPMD param specs; replicated when

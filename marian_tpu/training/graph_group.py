@@ -66,6 +66,11 @@ class GraphGroup:
         self.opt_cfg = OptimizerConfig.from_options(options)
         self.schedule = LRSchedule.from_options(options)
         self.delay = max(1, int(float(options.get("optimizer-delay", 1))))
+        self.window = max(1, int(options.get("dispatch-window", 1)))
+        if self.window > 1 and self.delay > 1:
+            raise ValueError("--dispatch-window requires --optimizer-delay 1 "
+                             "(in-jit windowing and in-jit accumulation do "
+                             "not compose; pick one)")
         if options.has("sync-sgd") and options.get("sync-sgd") is False:
             log.warn("Asynchronous SGD has no SPMD equivalent; using sync-sgd")
         self.mesh = mesh if mesh is not None else M.make_mesh(options)
@@ -75,6 +80,7 @@ class GraphGroup:
         self._donate = donate
         self._fused = None
         self._fused_delay = None         # delay>1 in-jit micro-batch scan
+        self._fused_window = None        # dispatch-window>1 multi-update scan
         self._grad_fn = None
         self._update_fn = None
         self._fix_src = bool(options.get("embedding-fix-src", False))
@@ -209,6 +215,14 @@ class GraphGroup:
                                        donate=self._donate,
                                        shardings=(p_sh, o_sh), frozen=frozen)
         self._fused_delay = None
+        # K updates per dispatch (build_train_step n_updates>1) — built
+        # LAZILY on the first update_window call so paths that never fill
+        # a window (the fused-CE A/B probe, short runs) skip its compile
+        self._fused_window = None
+        self._window_build = lambda: build_train_step(
+            model, opt_cfg, schedule, self.cost_type, mesh,
+            self.params, self.opt_state, delay=1, donate=self._donate,
+            shardings=(p_sh, o_sh), frozen=frozen, n_updates=self.window)
         if self.delay > 1:
             # in-jit micro-batch accumulation (one dispatch, one gradient
             # accumulator in HBM) for the common case of shape-uniform
@@ -317,6 +331,36 @@ class GraphGroup:
             jnp.asarray(total_labels, jnp.float32),
             jnp.asarray(n_sents, jnp.float32))
         return TrainOutput(total_loss, total_labels, gnorm)
+
+    def update_window(self, batches, step: int, rng) -> "list[TrainOutput]":
+        """K = --dispatch-window full updates in ONE jitted dispatch.
+
+        `batches`: list of exactly `self.window` batch dicts sharing one
+        padded shape (the train loop groups by bucket). `rng` is the RAW
+        training stream key — sub-update i folds it in-scan by the
+        absolute step number step+i-1, exactly matching sequential
+        update(b, s, fold_in(rng, s-1)) calls, so the trajectory is
+        bitwise independent of window grouping. Returns one TrainOutput
+        per sub-update (lazy [K]-stacked device scalars — no host sync
+        here)."""
+        assert self.window > 1 and len(batches) == self.window
+        if self._fused_window is None:
+            self._fused_window = self._window_build()
+        stacked = {k: jnp.stack([b[k] for b in batches])
+                   for k in batches[0]}
+        stacked = M.shard_batch(stacked, self.mesh, micro=True)
+        if self._dump_hlo:
+            from ..common.profiling import dump_lowered
+            dump_lowered(self._dump_hlo, self._fused_window.lower(
+                self.params, self.opt_state, stacked,
+                jnp.asarray(step, jnp.float32), rng))
+            self._dump_hlo = None
+        self.params, self.opt_state, metrics = self._fused_window(
+            self.params, self.opt_state, stacked,
+            jnp.asarray(step, jnp.float32), rng)
+        return [TrainOutput(metrics["ce_sum"][i], metrics["labels"][i],
+                            metrics["gnorm"][i])
+                for i in range(self.window)]
 
     # -- EMA access for validation/saving -----------------------------------
     def smoothed(self) -> Params:

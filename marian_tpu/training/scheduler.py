@@ -218,6 +218,45 @@ class Scheduler:
     def should_validate(self) -> bool:
         return bool(self.valid_freq) and self._hit(self.valid_freq)
 
+    def _hit_since(self, freq: SchedulingParameter, batches_before: int,
+                   labels_before: int) -> bool:
+        """Crossing test over a RANGE of updates: did any multiple of
+        `freq` land in (before, now]? --dispatch-window applies K updates
+        per dispatch, so the exact-multiple test in _hit would skip a
+        trigger that fell mid-window."""
+        if not freq:
+            return False
+        s = self.state
+        if freq.unit == SchedulingUnit.UPDATES:
+            return (s.batches // freq.n) > (batches_before // freq.n)
+        if freq.unit == SchedulingUnit.TRG_LABELS:
+            return (s.labels_total // freq.n) > (labels_before // freq.n)
+        return False
+
+    def should_save_since(self, batches_before: int,
+                          labels_before: int) -> bool:
+        return bool(self.save_freq) and self._hit_since(
+            self.save_freq, batches_before, labels_before)
+
+    def updates_remaining(self) -> Optional[int]:
+        """Updates left before an update-counted hard limit
+        (--after-batches / --after Nu), or None when no such limit is
+        set. --dispatch-window caps its fill with this so a window never
+        overshoots the limit by more than the final partial window."""
+        limits = []
+        if self.after_batches:
+            limits.append(self.after_batches)
+        if self.after and self.after.unit == SchedulingUnit.UPDATES:
+            limits.append(self.after.n)
+        if not limits:
+            return None
+        return max(0, min(limits) - self.state.batches)
+
+    def should_validate_since(self, batches_before: int,
+                              labels_before: int) -> bool:
+        return bool(self.valid_freq) and self._hit_since(
+            self.valid_freq, batches_before, labels_before)
+
     def new_epoch(self) -> None:
         seen = self.state.samples_epoch
         self.state.new_epoch()

@@ -260,48 +260,147 @@ class Train:
         vocab_sizes = [len(v) for v in vocabs]
         log.info("Training started")
         stop = False
+
+        def _check_stop():
+            """Signal / stopping-condition tail shared by both update
+            paths. Returns 'exit' (leave run() now), 'stop' (save done /
+            limits hit), or None."""
+            if signal_handling.signal_flag():
+                if opts.get("sigterm", "save-and-exit") == \
+                        "exit-immediately":
+                    log.info("Caught termination signal; exiting "
+                             "immediately (--sigterm exit-immediately)")
+                    return "exit"
+                log.info("Caught termination signal; saving and exiting")
+                do_save()
+                return "stop"
+            if not scheduler.keep_going():
+                return "stop"
+            return None
+
+        def _after_update(out, group):
+            """Scheduler bookkeeping + triggers for ONE applied update.
+            loss_sum stays a lazy device scalar (sync deferred to the
+            display boundary); labels/lr come from host-side math so the
+            hot loop never blocks on the device."""
+            scheduler.update(out.loss_sum, sum(b.words for b in group),
+                             sum(b.size for b in group),
+                             src_words=sum(b.src_words for b in group),
+                             lr=gg.schedule.host_lr(state.batches + 1))
+            if scheduler.should_validate():
+                do_validate()
+            if scheduler.should_save():
+                do_save()
+            return _check_stop()
+
+        # --dispatch-window: buffer same-shape batches and run K full
+        # updates per jitted dispatch (GraphGroup.update_window). Triggers
+        # (validate/save/sigterm) quantize to the window boundary — the
+        # same way --optimizer-delay quantizes them to macro-updates —
+        # with range-crossing detection (should_*_since) so a freq
+        # boundary that falls mid-window still fires at the drain.
+        # (GraphGroup refuses window>1 with delay>1, so no guard here.)
+        window = gg.window
+        win: List = []
+        win_key: List = []               # cached _shape_key of win[0]
+
+        def _shape_key(arrays):
+            return tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                                for k, v in arrays.items()))
+
+        def _drain_window():
+            """Dispatch the buffered batches — a full window through the
+            scanned K-update step (ONE host dispatch), stragglers (bucket
+            change / epoch end) singly. ALL applied sub-updates are
+            accounted in the scheduler before any trigger runs, so a
+            save/validate at the boundary always sees a progress count
+            equal to the updates baked into the params."""
+            if not win:
+                return None
+            trace.tick(state.batches + 1)
+            if len(win) == window:
+                outs = gg.update_window([a for a, _ in win],
+                                        state.batches + 1, train_key)
+                pairs = [(o, b) for o, (_, b) in zip(outs, win)]
+            else:
+                pairs = []
+                for idx, (a, b) in enumerate(win):
+                    s0 = state.batches + 1 + idx
+                    pairs.append((gg.update(
+                        a, s0, jax.random.fold_in(train_key, s0 - 1)), b))
+            win.clear()
+            win_key.clear()
+            before_b, before_l = state.batches, state.labels_total
+            for out, b in pairs:
+                scheduler.update(out.loss_sum, b.words, b.size,
+                                 src_words=b.src_words,
+                                 lr=gg.schedule.host_lr(state.batches + 1))
+            if scheduler.should_validate_since(before_b, before_l):
+                do_validate()
+            if scheduler.should_save_since(before_b, before_l):
+                do_save()
+            return _check_stop()
+
         while scheduler.keep_going() and not stop:
             bg = native_bg if native_bg is not None \
                 else BatchGenerator(corpus, opts,
                                     budget_scale=budget_scale)
             micro: List = []
+            rc = None
             for batch in bg:
-                micro.append(batch)
-                if len(micro) < delay:
-                    continue
-                arrays = [batch_to_arrays(b, compact=compact,
-                                          vocab_sizes=vocab_sizes)
-                          for b in micro]
-                trace.tick(state.batches + 1)
-                out = gg.update(arrays, state.batches + 1,
-                                jax.random.fold_in(train_key, state.batches))
-                # loss_sum stays a lazy device scalar (sync deferred to the
-                # display boundary); labels/lr come from host-side math so
-                # the hot loop never blocks on the device
-                scheduler.update(out.loss_sum, sum(b.words for b in micro),
-                                 sum(b.size for b in micro),
-                                 src_words=sum(b.src_words for b in micro),
-                                 lr=gg.schedule.host_lr(state.batches + 1))
-                micro = []
-                if scheduler.should_validate():
-                    do_validate()
-                if scheduler.should_save():
-                    do_save()
-                if signal_handling.signal_flag():
-                    if opts.get("sigterm", "save-and-exit") == \
-                            "exit-immediately":
-                        log.info("Caught termination signal; exiting "
-                                 "immediately (--sigterm exit-immediately)")
-                        return
-                    log.info("Caught termination signal; saving and exiting")
-                    do_save()
-                    stop = True
-                    break
-                if not scheduler.keep_going():
+                if window > 1:
+                    # cheap host-side check per batch: a SIGTERM (or a
+                    # crossed stopping condition) must not wait for a
+                    # whole new window of batches to assemble
+                    if signal_handling.signal_flag() \
+                            or not scheduler.keep_going():
+                        rc = _drain_window() or _check_stop()
+                        if rc == "exit":
+                            return
+                        stop = True
+                        break
+                    arrays = batch_to_arrays(batch, compact=compact,
+                                             vocab_sizes=vocab_sizes)
+                    k_ = _shape_key(arrays)
+                    if win and k_ != win_key[0]:
+                        rc = _drain_window()      # bucket shape changed
+                    if rc is None:
+                        if not win:
+                            win_key[:] = [k_]
+                        win.append((arrays, batch))
+                        # fill to the window, but never past an update-
+                        # counted hard limit (--after-batches overshoot
+                        # bounded by the final PARTIAL window, not K)
+                        rem = scheduler.updates_remaining()
+                        if len(win) == window or \
+                                (rem is not None and len(win) >= rem):
+                            rc = _drain_window()
+                else:
+                    micro.append(batch)
+                    if len(micro) < delay:
+                        continue
+                    arrays = [batch_to_arrays(b, compact=compact,
+                                              vocab_sizes=vocab_sizes)
+                              for b in micro]
+                    trace.tick(state.batches + 1)
+                    out = gg.update(arrays, state.batches + 1,
+                                    jax.random.fold_in(train_key,
+                                                       state.batches))
+                    rc = _after_update(out, micro)
+                    micro = []
+                if rc == "exit":
+                    return
+                if rc is not None:
                     stop = True
                     break
             if not stop:
-                scheduler.new_epoch()
+                rc = _drain_window()              # epoch-end stragglers
+                if rc == "exit":
+                    return
+                if rc is not None:
+                    stop = True
+                else:
+                    scheduler.new_epoch()
         trace.close()
         scheduler.close()       # flush buffered TensorBoard scalars
         log.info("Training finished")

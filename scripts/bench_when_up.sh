@@ -121,6 +121,13 @@ ladder() {
     # of the step the tunnel's per-batch id/mask bytes cost
     stage transfer_full 5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_COMPACT=0
+    # --dispatch-window: K full updates per jitted dispatch. THE lever for
+    # a dispatch-latency-bound chip (the r4 train row showed 19% MFU with
+    # ~53ms ideal compute in a ~280ms step — tunnel dispatch suspected)
+    stage dispatch_8  5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_DISPATCH=8
+    stage dispatch_32 5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_DISPATCH=32
     # 32k tokens needs remat headroom; if it OOMs the stage fails
     # gracefully and the ladder continues
     stage words_32k_remat 5400 MARIAN_BENCH_PRESET=$PRESET \
