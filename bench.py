@@ -416,21 +416,24 @@ def main():
     dt = 0.0
     i = 0
     done = 0
+    last_out = None
     while i < len(plan):
         chunk = plan[i:i + CHUNK]        # CHUNK dispatches, not batches
         t0 = time.perf_counter()
         for grp in chunk:
             if window > 1 and len(grp) == window:
-                gg.update_window(
+                outs = gg.update_window(
                     [batch_to_arrays(b, compact=compact, vocab_sizes=vsz)
                      for b in grp],
                     step + 1, train_key)
+                last_out = outs[-1]
                 step += window
             else:
                 for b in grp:
-                    gg.update(batch_to_arrays(b, compact=compact,
-                                              vocab_sizes=vsz),
-                              step + 1, jax.random.fold_in(train_key, step))
+                    last_out = gg.update(
+                        batch_to_arrays(b, compact=compact,
+                                        vocab_sizes=vsz),
+                        step + 1, jax.random.fold_in(train_key, step))
                     step += 1
         jax.block_until_ready(gg.params)
         dt += time.perf_counter() - t0
@@ -446,6 +449,21 @@ def main():
         progress.update(
             tok_per_sec_running=round(src_tokens / dt / max(n_chips, 1), 1),
             timed_steps_done=done)
+
+    # hardened sync: block_until_ready(params) SHOULD imply the whole
+    # chain executed, but the r4 transfer_full row (MFU 1.79 — above the
+    # chip's physical peak) showed the experimental axon backend can
+    # return early on some input paths. Fetching a metric VALUE cannot
+    # lie: it requires the last update's forward pass to have run. Any
+    # residue is time the timed window missed — fold it into dt and
+    # report it so an under-synced row is self-evident. Runs BEFORE
+    # stop_trace: trace collection blocks, and pending work draining
+    # inside it would escape both dt and the residue.
+    t_sync = time.perf_counter()
+    if last_out is not None:
+        float(last_out.loss_sum)
+    sync_residue = time.perf_counter() - t_sync
+    dt += sync_residue
 
     if profile_dir:
         jax.profiler.stop_trace()
@@ -473,10 +491,15 @@ def main():
         "stacked_params": stacked,
         "words_budget": words,
         "dispatch_window": window,
+        "final_sync_s": round(sync_residue, 3),
         "compact_transfer": compact,
         "seqlen": max_len + 1,
         "flash": flash_env or "default",
     }
+    if mfu is not None and mfu > 0.95:
+        # faster than the chip's physical peak = the measurement lied
+        # somewhere; poison the row visibly rather than publish it
+        result["suspect"] = "mfu>0.95: impossible — sync/accounting bug"
     progress.update(phase="done", result=result)
     if jax.default_backend() == "tpu":
         # every bench shape is now in the persistent cache for THIS

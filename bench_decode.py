@@ -152,6 +152,10 @@ def main():
     # (common/pipeline.py): host n-best extraction overlaps device beam
     # steps.
     from marian_tpu.common.pipeline import pipelined
+    profile_dir = os.environ.get("MARIAN_DECBENCH_PROFILE")
+    if profile_dir:
+        os.makedirs(profile_dir, exist_ok=True)
+        jax.profiler.start_trace(profile_dir)
     results = []
     t0 = time.perf_counter()
     pipelined(batches,
@@ -159,6 +163,10 @@ def main():
                                         shortlist=shortlist_for(b[0])),
               lambda b, h: results.append(h.collect()))
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+        print(f"decode trace: tensorboard --logdir {profile_dir}",
+              file=sys.stderr)
     nbests = results[-1]
     assert len(nbests) == batch
     sents = batch * len(batches)

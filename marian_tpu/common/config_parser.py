@@ -135,7 +135,7 @@ _MODEL = [
     _f("attention-kernel", str, "auto", "Attention impl: auto, dense, flash (Pallas)", "model"),
     _f("auto-tune", bool, False, "Time implementation alternatives (dense vs Pallas flash attention crossover) on the current backend and bind the fastest, like the reference's AutoTuner (TPU extension)", "model"),
     _f("sequence-parallel", str, "none", "Sequence/context parallelism over the 'seq' mesh axis: none, ring (K/V blocks rotate via ppermute), ulysses (all-to-all head<->seq swap) (TPU extension)", "model"),
-    _f("scan-layers", bool, True, "lax.scan over layer stack (compile time O(1) in depth; auto-falls back for tied layers/alignment/int8)", "model"),
+    _f("scan-layers", bool, False, "lax.scan over layer stack: compile time O(1) in depth, but measured 25-33% slower per step than unrolled on TPU v5e (r4 bench scan A/B — XLA schedules/fuses across unrolled layers, not across a while-loop boundary). Default off; turn on for very deep stacks or compile-time-bound jobs. Auto-falls back for tied layers/alignment/int8; implied ON by --stacked-params and pipe-sharded meshes (they consume the stacked layout)", "model"),
     _f("stacked-params", bool, False, "Store transformer layer weights depth-stacked [L,...] during training: the --scan-layers forward consumes the stack directly, removing its per-step restack (one full HBM read+write of every layer weight per micro-batch). Implied by meshes with pipe>1; checkpoints stay Marian-flat", "model"),
     _f("transformer-moe-experts", int, 0, "Mixture-of-Experts FFN: number of experts (0 = dense FFN; TPU extension, shards over the 'expert' mesh axis)", "model"),
     _f("transformer-moe-top-k", int, 2, "MoE router top-k (1 = Switch, 2 = GShard)", "model"),

@@ -27,6 +27,11 @@ cd "$(dirname "$0")/.."
 ONCE=0; INTERVAL=1200
 for a in "$@"; do case "$a" in --once) ONCE=1;; *) INTERVAL="$a";; esac; done
 
+# the axon remote-compile helper intermittently 500s with "could not
+# determine TPU accelerator type … set TPU_ACCELERATOR_TYPE" (killed the
+# r4 stacked/words_16k stages); give it the hint (harmless if ignored)
+export TPU_ACCELERATOR_TYPE="${TPU_ACCELERATOR_TYPE:-v5litepod-1}"
+
 LOCK=/tmp/marian_bench_when_up.lock
 exec 9>"$LOCK"
 flock -n 9 || { echo "bench_when_up: another instance holds $LOCK"; exit 1; }
@@ -109,10 +114,13 @@ ladder() {
     stage_decode decode_int8_sl MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1 \
                                 MARIAN_DECBENCH_SHORTLIST=1
-    # 3/4 — train A/Bs (cache already warm for the base shapes)
-    stage scan_off   5400 MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_SCAN=off
+    # 3/4 — train A/Bs (cache already warm for the base shapes).
+    # scan-layers defaults OFF since r4 (the r4 A/B measured scan 25-33%
+    # slower per step on v5e), so the A/B leg is now scan ON; stacked
+    # storage structurally requires the scanned stack.
+    stage scan_on    5400 MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_SCAN=on
     stage stacked    5400 MARIAN_BENCH_PRESET=$PRESET \
-                          MARIAN_BENCH_STACKED=1
+                          MARIAN_BENCH_STACKED=1 MARIAN_BENCH_SCAN=on
     stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_WORDS=$WORDS_AB
     stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET \
