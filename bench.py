@@ -108,6 +108,39 @@ def _write_corpus(tmp, vocab_size, n_lines, seed=7, max_words=63):
     return src_p, trg_p
 
 
+def retry_compile(fn, what: str, attempts: int = 3, reset=None):
+    """First call of a jitted fn compiles over the axon tunnel, whose
+    remote-compile endpoint intermittently drops ('HTTP 500',
+    'response body closed…' — killed the r4 stacked/words_16k stages
+    and the first dispatch_8 probe). Transient transport faults get
+    retried; anything else (or persistent failure) propagates.
+
+    `reset` runs before each retry. REQUIRED when fn dispatches a
+    donated-argument step (GraphGroup.update/update_window): a fault
+    that fires after dispatch has already consumed the donated
+    params/opt_state buffers, so retrying against the same GraphGroup
+    hits deleted arrays — reset must rebuild/re-place that state (cf.
+    batch_fit.py's snapshot-before-probe for the same hazard)."""
+    import jax as _jax
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except _jax.errors.JaxRuntimeError as e:
+            msg = str(e)
+            transient = ("remote_compile" in msg or
+                         "response body closed" in msg or
+                         "HTTP 500" in msg)
+            if not transient or attempt == attempts - 1:
+                raise
+            print(f"bench: transient remote-compile fault on {what} "
+                  f"(attempt {attempt + 1}/{attempts}) — retrying: "
+                  f"{msg.splitlines()[0][:120]}",
+                  file=sys.stderr, flush=True)
+            time.sleep(10 * (attempt + 1))
+            if reset is not None:
+                reset()
+
+
 def main():
     preset = os.environ.get("MARIAN_BENCH_PRESET", "big")
     profile_dir = os.environ.get("MARIAN_BENCH_PROFILE")
@@ -295,8 +328,12 @@ def main():
             g = build_gg(mode)
             arrays = batch_to_arrays(probe, compact=compact, vocab_sizes=vsz)
             for i in range(2):                       # compile + settle
-                g.update(dict(arrays), i + 1,
-                         jax.random.fold_in(train_key, i))
+                retry_compile(
+                    lambda i=i: g.update(dict(arrays), i + 1,
+                                         jax.random.fold_in(train_key, i)),
+                    f"fused-CE probe ({mode})",
+                    reset=lambda: g.initialize(
+                        prng.stream(key, prng.STREAM_INIT)))
             jax.block_until_ready(g.params)
             t0 = time.perf_counter()
             for i in range(6):
@@ -350,8 +387,12 @@ def main():
     progress.update(phase="compile", n_shapes=len(by_shape))
     for sk, b in by_shape.items():
         t0 = time.perf_counter()
-        gg.update(batch_to_arrays(b, compact=compact, vocab_sizes=vsz), step + 1,
-                  jax.random.fold_in(train_key, step))
+        retry_compile(
+            lambda: gg.update(batch_to_arrays(b, compact=compact,
+                                              vocab_sizes=vsz), step + 1,
+                              jax.random.fold_in(train_key, step)),
+            f"shape {sk}",
+            reset=lambda: gg.initialize(prng.stream(key, prng.STREAM_INIT)))
         jax.block_until_ready(gg.params)
         dt_shape = time.perf_counter() - t0
         print(f"  shape {sk}: {dt_shape:.1f}s", file=sys.stderr, flush=True)
@@ -380,8 +421,12 @@ def main():
             b = by_shape[sk]
             arrays = batch_to_arrays(b, compact=compact, vocab_sizes=vsz)
             t0 = time.perf_counter()
-            gg.update_window([dict(arrays) for _ in range(window)],
-                             step + 1, train_key)
+            retry_compile(
+                lambda: gg.update_window(
+                    [dict(arrays) for _ in range(window)],
+                    step + 1, train_key),
+                f"window[{window}] shape {sk}",
+                reset=lambda: gg.initialize(prng.stream(key, prng.STREAM_INIT)))
             jax.block_until_ready(gg.params)
             print(f"  window[{window}] shape {sk}: "
                   f"{time.perf_counter() - t0:.1f}s",

@@ -141,9 +141,12 @@ def main():
         flat = [int(x) for x in np.asarray(ids).ravel() if x > 1]
         return sl_gen.generate(flat)
 
-    # compile + warm
+    # compile + warm (retry transient tunnel remote-compile drops)
+    from bench import retry_compile
     ids, mask = make_batch()
-    bs.search(ids, mask, shortlist=shortlist_for(ids))
+    retry_compile(lambda: bs.search(ids, mask,
+                                    shortlist=shortlist_for(ids)),
+                  "beam search")
 
     batches = [make_batch() for _ in range(max(1, n_sents // batch))]
     # shortlist generation is host-side work the real translator does per
