@@ -72,12 +72,16 @@ stage() {  # $1 = name, $2 = timeout_s, rest = env assignments
     local name="$1" tmo="$2"; shift 2
     local out; out=$(mktemp)
     echo "== stage $name =="
-    if env "$@" timeout "$tmo" python bench.py >"$out" 2>"$out.err"; then
+    local rc=0
+    env "$@" timeout "$tmo" python bench.py >"$out" 2>"$out.err" || rc=$?
+    if [ "$rc" = 0 ]; then
         python scripts/record_bench.py "$name" "$out"
         commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
         return 0
     fi
-    echo "stage $name failed rc=$? — $(tail -2 "$out.err" 2>/dev/null)"
+    # capture rc BEFORE any other command: the old `if env …; then` form
+    # reported rc=0 for every failure (the if-statement's own status)
+    echo "stage $name failed rc=$rc — $(tail -2 "$out.err" 2>/dev/null | head -c 300)"
     commit_artifacts "bench: $name partial progress (tunnel drop?)"
     return 1
 }
@@ -86,12 +90,14 @@ stage_decode() {  # $1 = name, rest = env assignments
     local name="$1"; shift
     local out; out=$(mktemp)
     echo "== stage $name =="
-    if env "$@" timeout 3600 python bench_decode.py >"$out" 2>"$out.err"; then
+    local rc=0
+    env "$@" timeout 3600 python bench_decode.py >"$out" 2>"$out.err" || rc=$?
+    if [ "$rc" = 0 ]; then
         python scripts/record_bench.py "$name" "$out"
         commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
         return 0
     fi
-    echo "stage $name failed rc=$? — $(tail -2 "$out.err" 2>/dev/null)"
+    echo "stage $name failed rc=$rc — $(tail -2 "$out.err" 2>/dev/null | head -c 300)"
     return 1
 }
 
@@ -105,8 +111,15 @@ ladder() {
         BACKEND_TAG=CPU-dryrun
         export JAX_PLATFORMS=cpu
     fi
-    # 1 — the one number that matters; generous timeout for cold compiles
-    stage train 5400 MARIAN_BENCH_PRESET=$PRESET || return 1
+    # 1 — the cheap trend-critical leg FIRST and it alone gates the
+    # ladder (a dead tunnel must not burn the window on the many-compile
+    # headline config): `train` pins the historical 32,64/K=1 leg;
+    # `headline` = bench.py defaults (full buckets + dispatch-window 8 —
+    # the measured-best r4 config, what the driver's plain run records).
+    stage train 5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=1 \
+                          || return 1
+    stage headline 7200 MARIAN_BENCH_PRESET=$PRESET
     # 2 — decode family
     stage_decode decode_float   MARIAN_DECBENCH_PRESET=$PRESET
     stage_decode decode_int8    MARIAN_DECBENCH_PRESET=$PRESET \
@@ -114,31 +127,35 @@ ladder() {
     stage_decode decode_int8_sl MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1 \
                                 MARIAN_DECBENCH_SHORTLIST=1
-    # 3/4 — train A/Bs (cache already warm for the base shapes).
+    # 3/4 — train A/Bs (cache already warm for the base shapes). Every
+    # A/B leg pins the cheap historical baseline config (2 buckets, no
+    # dispatch window) so its lever stays the ONLY variable vs `train`;
+    # `headline` alone carries the combined best config.
+    local -a AB=(MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=1)
     # scan-layers defaults OFF since r4 (the r4 A/B measured scan 25-33%
     # slower per step on v5e), so the A/B leg is now scan ON; stacked
     # storage structurally requires the scanned stack.
-    stage scan_on    5400 MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_SCAN=on
-    stage stacked    5400 MARIAN_BENCH_PRESET=$PRESET \
+    stage scan_on    5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" MARIAN_BENCH_SCAN=on
+    stage stacked    5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_STACKED=1 MARIAN_BENCH_SCAN=on
-    stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET \
+    stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_WORDS=$WORDS_AB
-    stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET \
+    stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_OPT_DTYPE=bfloat16
     # compact host→device transfer OFF (default is on): isolates how much
     # of the step the tunnel's per-batch id/mask bytes cost
-    stage transfer_full 5400 MARIAN_BENCH_PRESET=$PRESET \
+    stage transfer_full 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_COMPACT=0
     # --dispatch-window: K full updates per jitted dispatch. THE lever for
     # a dispatch-latency-bound chip (the r4 train row showed 19% MFU with
     # ~53ms ideal compute in a ~280ms step — tunnel dispatch suspected)
     stage dispatch_8  5400 MARIAN_BENCH_PRESET=$PRESET \
-                          MARIAN_BENCH_DISPATCH=8
+                          MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=8
     stage dispatch_32 5400 MARIAN_BENCH_PRESET=$PRESET \
-                          MARIAN_BENCH_DISPATCH=32
+                          MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=32
     # 32k tokens needs remat headroom; if it OOMs the stage fails
     # gracefully and the ladder continues
-    stage words_32k_remat 5400 MARIAN_BENCH_PRESET=$PRESET \
+    stage words_32k_remat 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_WORDS=$((WORDS_AB * 2)) \
                           MARIAN_BENCH_REMAT=1
     # long-context: doc-concatenation lengths with the Pallas flash
@@ -148,10 +165,10 @@ ladder() {
     # fused-CE pinned ON so the only variable between the two legs is
     # the attention kernel (the tune probe would also cold-compile the
     # new 2048-wide shape once per leg for nothing)
-    stage longseq_flash 5400 MARIAN_BENCH_PRESET=$PRESET \
+    stage longseq_flash 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_SEQLEN=$SEQ MARIAN_BENCH_FUSED=on \
                           MARIAN_BENCH_REMAT=1 MARIAN_BENCH_FLASH=on
-    stage longseq_dense 5400 MARIAN_BENCH_PRESET=$PRESET \
+    stage longseq_dense 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_SEQLEN=$SEQ MARIAN_BENCH_FUSED=on \
                           MARIAN_BENCH_REMAT=1 MARIAN_BENCH_FLASH=off
     # 5 — profile-directed trace, summarized to a committed text artifact
@@ -171,8 +188,10 @@ ladder() {
         fi
     fi
     # 6 — padding tax at the full bucket table (many cold compiles: last)
+    # padding-tax A/B vs `train`: full table at K=1 (the combined
+    # full+window config is the `headline` stage)
     stage buckets_full 7200 MARIAN_BENCH_PRESET=$PRESET \
-                            MARIAN_BENCH_BUCKETS=full
+                            MARIAN_BENCH_BUCKETS=full MARIAN_BENCH_DISPATCH=1
     return 0
 }
 

@@ -17,8 +17,15 @@ echo "== probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || {
     echo "tunnel down — nothing to warm"; exit 3; }
 
-echo "== train bench (writes $LOG/bench.json) =="
-python bench.py >"$LOG/bench.json" 2>"$LOG/bench.err"
+echo "== train bench, baseline leg (writes $LOG/bench_base.json) =="
+# cheap 2-bucket/K=1 shapes first — these are what the ladder's train
+# and A/B legs need; a tunnel drop mid-warm still leaves them cached
+MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=1 \
+    python bench.py >"$LOG/bench_base.json" 2>"$LOG/bench.err"
+echo "rc=$? $(cat "$LOG/bench_base.json" 2>/dev/null)"
+
+echo "== train bench, headline config (full buckets + K=8; many compiles) =="
+python bench.py >"$LOG/bench.json" 2>>"$LOG/bench.err"
 echo "rc=$? $(cat "$LOG/bench.json" 2>/dev/null)"
 
 echo "== decode bench =="
