@@ -328,6 +328,8 @@ _TRANSLATION = [
     # SystemExit on unknown options, so Marian decoder command lines that
     # carry these must still parse in those modes (ADVICE r3). Training
     # mode also includes this list, so they remain accepted everywhere.
+    _f("devices", str, ["0"], "Device ids (GPU compat; the data-parallel decode mesh uses all visible devices)", "translate", "+"),
+    _f("num-devices", int, 0, "Cap the data-parallel decode mesh (0 = all visible devices; the batch dim shards over a 'data' mesh — the SPMD equivalent of per-device translator workers)", "translate"),
     _f("optimize", bool, False, "Legacy optimized int16 GEMM switch (no-op; see flag audit)", "translate"),
     _f("model-mmap", bool, False, "Memory-map model loading (no-op; .bin checkpoints are always mmap-loaded)", "translate"),
     _f("fp16", bool, False, "Half-precision shortcut: maps to bfloat16 compute on TPU (fp16's narrow exponent needs loss scaling; bf16 keeps the f32 range)", "translate"),

@@ -329,6 +329,42 @@ class BeamSearch:
         self._jitted = {}
         self._sample_calls = 0
         self._sample_seed = int(options.get("seed", 0) or 0) or 1234
+        # Data-parallel decode: shard the batch dim over visible devices
+        # (reference: translator.h round-robins batches over --devices GPU
+        # workers, one model replica per device; the SPMD equivalent is
+        # ONE jitted program with the batch sharded over a 'data' mesh —
+        # GSPMD partitions every beam-search op along rows). --num-devices
+        # caps the mesh; a single visible device means no mesh.
+        # local (addressable) devices only: under multi-process (multihost)
+        # each process decodes its own batches on its own chips — the same
+        # per-worker decomposition as the reference's translator workers
+        local = jax.local_devices()
+        nd = int(options.get("num-devices", 0) or 0) or len(local)
+        nd = max(1, min(nd, len(local)))
+        self.mesh = None
+        if nd > 1 and not any(self._mesh_sharded(p)
+                              for p in self.params_list):
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            self.mesh = Mesh(np.array(local[:nd]), ("data",))
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            # scorer params replicate to every device once, up front
+            # (device_put maps over the pytree, incl. QTensor leaves)
+            self.params_list = [jax.device_put(p, rep)
+                                for p in self.params_list]
+
+    @staticmethod
+    def _mesh_sharded(params) -> bool:
+        """True if any param leaf is already non-replicated device-sharded
+        (TP/pipe-sharded training params reaching a validation decode):
+        re-placing those replicated would materialize a full model copy
+        per device mid-training — decode with them where they are
+        instead (GSPMD handles sharded inputs without our mesh)."""
+        for v in jax.tree_util.tree_leaves(params):
+            sh = getattr(v, "sharding", None)
+            if sh is not None and not getattr(sh, "is_fully_replicated",
+                                              True):
+                return True
+        return False
 
     def _get_fn(self, cfg: BeamConfig, has_shortlist: bool):
         key = (cfg, has_shortlist)
@@ -364,6 +400,24 @@ class BeamSearch:
                              "--output-approx-knn (a forced token outside "
                              "the LSH candidate set would have no logit)")
         b, ts = _first(src_ids).shape
+        n_rows = b
+        if self.mesh is not None:
+            # pad rows to a multiple of the mesh by REPLICATING row 0
+            # (replicated rows decode safely — an all-zero mask row would
+            # risk NaNs in fully-masked attention); extras drop at collect
+            pad = (-b) % self.mesh.shape["data"]
+            if pad:
+                def _padrows(x):
+                    if isinstance(x, (tuple, list)):
+                        return tuple(_padrows(e) for e in x)
+                    x = np.asarray(x)
+                    return np.concatenate(
+                        [x, np.repeat(x[:1], pad, axis=0)], axis=0)
+                src_ids = _padrows(src_ids)
+                src_mask = _padrows(src_mask)
+                if prefix is not None:
+                    prefix = _padrows(prefix)
+                b += pad
         # static decode cap per source bucket (Marian: factor * src length)
         L = int(min(self.max_length_cap,
                     max(8, round(self.max_length_factor * ts))))
@@ -381,8 +435,13 @@ class BeamSearch:
 
         def _dev(x):
             if isinstance(x, (tuple, list)):
-                return tuple(jnp.asarray(e) for e in x)
-            return jnp.asarray(x)
+                return tuple(_dev(e) for e in x)
+            x = jnp.asarray(x)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                spec = PartitionSpec("data", *([None] * (x.ndim - 1)))
+                x = jax.device_put(x, NamedSharding(self.mesh, spec))
+            return x
 
         sample_key = None
         if cfg.sampling:
@@ -395,7 +454,7 @@ class BeamSearch:
             pfx = np.full((b, L), -1, np.int32)
             p = np.asarray(prefix)[:, :L]
             pfx[:, :p.shape[1]] = p
-            pfx = jnp.asarray(pfx)
+            pfx = _dev(pfx)       # same 'data' placement as its siblings
         args = (tuple(self.params_list), _dev(src_ids), _dev(src_mask))
         tokens, scores, lengths, norm_scores, aligns, wscores = fn(
             *args, shortlist=sl_idx, sample_key=sample_key, prefix=pfx)
@@ -405,7 +464,8 @@ class BeamSearch:
         # overlaps device beam steps (the role of the reference
         # translator's worker thread pool, played by XLA async dispatch).
         return _SearchHandle(tokens, scores, lengths, norm_scores, aligns,
-                             wscores, cfg, self)
+                             wscores, cfg, self,
+                             n_rows=n_rows if n_rows != b else None)
 
     def search(self, src_ids, src_mask,
                shortlist=None, prefix=None) -> List[List[dict]]:
@@ -454,15 +514,22 @@ class _SearchHandle:
     the last behind device compute."""
 
     def __init__(self, tokens, scores, lengths, norm_scores, aligns,
-                 wscores, cfg, bs: "BeamSearch"):
+                 wscores, cfg, bs: "BeamSearch", n_rows: Optional[int] = None):
         self._dev = (tokens, scores, lengths, norm_scores, aligns, wscores)
         self._cfg = cfg
         self._bs = bs
+        self._n = n_rows                 # original rows before mesh padding
 
     def collect(self) -> List[List[dict]]:
         tokens, scores, lengths, norm_scores, aligns, ws = self._dev
+
+        def _h(x):
+            if x is None:
+                return None
+            x = np.asarray(x)
+            return x[:self._n] if self._n is not None else x
+
         return self._bs._collect(
-            np.asarray(tokens), np.asarray(scores), np.asarray(lengths),
-            np.asarray(norm_scores),
-            None if aligns is None else np.asarray(aligns), self._cfg,
-            wscores=np.asarray(ws) if self._cfg.word_scores else None)
+            _h(tokens), _h(scores), _h(lengths), _h(norm_scores),
+            _h(aligns) if aligns is not None else None, self._cfg,
+            wscores=_h(ws) if self._cfg.word_scores else None)

@@ -1,0 +1,98 @@
+"""Data-parallel decode over a device mesh: the reference translator
+round-robins batches over --devices GPU workers, one model replica each
+(src/translator/translator.h); the SPMD equivalent is ONE jitted beam
+search with the batch dim sharded over a 'data' mesh. Outputs must be
+identical to the single-device program — GSPMD only changes placement."""
+
+import jax
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.translator.beam_search import BeamSearch
+
+from tests.test_beam_search import tiny_model
+
+
+def _batch(vocab, b=5, ts=7, seed=3):
+    rs = np.random.RandomState(seed)
+    lens = rs.randint(3, ts + 1, size=b)
+    ids = np.zeros((b, ts), np.int32)
+    mask = np.zeros((b, ts), np.float32)
+    for i, n in enumerate(lens):
+        ids[i, :n] = rs.randint(3, vocab, n)
+        mask[i, :n] = 1.0
+    return ids, mask
+
+
+class TestMeshDecode:
+    def test_mesh_equals_single_device(self):
+        """8-device mesh decode == 1-device decode, bitwise on ids and
+        allclose on scores. Batch of 5 rows exercises the pad-by-
+        replication path (5 → 8 rows, extras dropped at collect)."""
+        vocab = 19
+        model, params, opts = tiny_model(vocab=vocab)
+        ids, mask = _batch(vocab)
+        res = {}
+        for nd in (1, 8):
+            bs = BeamSearch(model, [params], None,
+                            opts.with_(**{"beam-size": 4, "normalize": 0.6,
+                                          "num-devices": nd}), vocab)
+            assert (bs.mesh is None) == (nd == 1)
+            res[nd] = bs.search(ids, mask)
+        assert len(res[8]) == 5            # padding rows dropped
+        for h1, h8 in zip(res[1], res[8]):
+            assert [h["tokens"] for h in h1] == [h["tokens"] for h in h8]
+            np.testing.assert_allclose(
+                [h["norm_score"] for h in h1],
+                [h["norm_score"] for h in h8], rtol=1e-5)
+
+    def test_sharded_params_disable_decode_mesh(self):
+        """TP/pipe-sharded training params reaching a validation decode
+        must NOT be re-replicated per device (a full model copy per chip
+        mid-training): the decode mesh gates off and decodes them where
+        they are."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        vocab = 19
+        model, params, opts = tiny_model(vocab=vocab)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                    ("data", "model"))
+        sharded = dict(params)
+        k = next(k for k, v in params.items()
+                 if getattr(v, "ndim", 0) == 2 and v.shape[-1] % 2 == 0)
+        sharded[k] = jax.device_put(
+            params[k], NamedSharding(mesh, P(None, "model")))
+        bs = BeamSearch(model, [sharded], None,
+                        opts.with_(**{"beam-size": 2}), vocab)
+        assert bs.mesh is None
+        ids, mask = _batch(vocab, b=3)
+        out = bs.search(ids, mask)       # still decodes correctly
+        assert len(out) == 3
+
+    def test_force_decode_on_mesh(self):
+        """--force-decode prefixes ride the same 'data' sharding as the
+        other batch inputs."""
+        vocab = 19
+        model, params, opts = tiny_model(vocab=vocab)
+        ids, mask = _batch(vocab, b=5)
+        prefix = np.full((5, 3), -1, np.int32)
+        prefix[:, 0] = 7                 # force first target token
+        res = {}
+        for nd in (1, 8):
+            bs = BeamSearch(model, [params], None,
+                            opts.with_(**{"beam-size": 2,
+                                          "num-devices": nd}), vocab)
+            res[nd] = bs.search(ids, mask, prefix=prefix)
+        for h1, h8 in zip(res[1], res[8]):
+            assert h1[0]["tokens"] == h8[0]["tokens"]
+            assert h1[0]["tokens"][0] == 7
+
+    def test_mesh_divisible_batch_no_padding(self):
+        vocab = 19
+        model, params, opts = tiny_model(vocab=vocab)
+        ids, mask = _batch(vocab, b=8)
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 2, "num-devices": 8}),
+                        vocab)
+        out = bs.search(ids, mask)
+        assert len(out) == 8 and all(len(nb) >= 1 for nb in out)
