@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ..data.vocab import EOS_ID, UNK_ID
 
@@ -109,12 +110,29 @@ def _first(x):
     return x[0] if isinstance(x, (tuple, list)) else x
 
 
+def _topk_rows(flat, k: int, mesh):
+    """Per-row top-k. Under a 'data' decode mesh this runs per batch
+    shard via shard_map: rows are independent, but XLA's TopK
+    custom-call is opaque to GSPMD's partitioner, which otherwise
+    ALL-GATHERS the sharded batch dim inside the decode loop — at
+    transformer-big beam-6 scale that is ~50 MB of ICI traffic per
+    step (caught by test_mesh_decode_is_collective_free)."""
+    if mesh is None:
+        return jax.lax.top_k(flat, k)
+    from ..parallel.mesh import compat_shard_map
+    nones = (None,) * (flat.ndim - 1)
+    spec = P("data", *nones)
+    return compat_shard_map(lambda f: tuple(jax.lax.top_k(f, k)), mesh,
+                            in_specs=(spec,), out_specs=(spec, spec))(flat)
+
+
 def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                     weights: Sequence[float], cfg: BeamConfig,
                     src_ids: jax.Array, src_mask: jax.Array,
                     shortlist: Optional[jax.Array] = None,
                     sample_key: Optional[jax.Array] = None,
-                    prefix: Optional[jax.Array] = None):
+                    prefix: Optional[jax.Array] = None,
+                    mesh=None):
     """The jittable core. Returns (tokens [B,K,L], raw_scores [B,K],
     lengths [B,K], norm_scores [B,K], alignments [B,K,L,Ts] or None,
     word_scores [B,K,L] — per-step chosen-token logP, --word-scores).
@@ -210,7 +228,7 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
             slp = logp / max(temp, 1e-6)
             if cfg.sampling[0] == "topk":
                 n = min(int(cfg.sampling[1]), vocab)
-                kth = jax.lax.top_k(slp, n)[0][..., -1:]
+                kth = _topk_rows(slp, n, mesh)[0][..., -1:]
                 slp = jnp.where(slp < kth, NEG_INF, slp)
             g = jax.random.gumbel(jax.random.fold_in(sample_key, t),
                                   slp.shape, jnp.float32)
@@ -221,7 +239,7 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         else:
             combined = scores[:, :, None] + logp        # [B,K,V]
             flat = combined.reshape(b, k * vocab)
-            top_scores, top_idx = jax.lax.top_k(flat, k)  # [B,K]
+            top_scores, top_idx = _topk_rows(flat, k, mesh)  # [B,K]
             beam_idx = top_idx // vocab                 # [B,K] source beam
             tok_sl = top_idx % vocab                    # token in (shortlist) coords
         tok_full = (shortlist[tok_sl] if shortlist is not None
@@ -259,11 +277,21 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         lengths = jnp.where(was_finished, lengths, t + 1)
         scores = top_scores
 
-        # reorder each scorer's KV caches: rows are b*k, new row j takes old
-        # row (batch*k + beam_idx)
-        flat_src = (jnp.arange(b)[:, None] * k + beam_idx).reshape(-1)  # [B*K]
-
+        # reorder each scorer's KV caches: rows are b*k, new row j takes
+        # old row (batch*k + beam_idx). The gather is written BATCH-LOCAL
+        # — reshape [.., B*K, ..] → [.., B, K, ..] and take_along_axis on
+        # the beam axis — so GSPMD partitions it along B under the decode
+        # mesh; the flat v[b*k+idx] form is an opaque cross-row gather
+        # that all-gathered the ENTIRE cache every step (~600 MB/step at
+        # transformer-big scale; test_mesh_decode_is_collective_free).
         carried = model.beam_carried_suffixes
+
+        def beam_rows(v, axis):
+            shape = v.shape
+            vr = v.reshape(shape[:axis] + (b, k) + shape[axis + 1:])
+            idx = beam_idx.reshape((1,) * axis + (b, k) +
+                                   (1,) * (vr.ndim - axis - 2))
+            return jnp.take_along_axis(vr, idx, axis=axis + 1).reshape(shape)
 
         def reorder_state(st):
             out = {}
@@ -273,8 +301,8 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                 elif key.endswith(carried):
                     # 'stack_*' = scanned decode caches [L, B*K, ...]:
                     # the batch axis is axis 1
-                    out[key] = (v[:, flat_src] if key.startswith("stack_")
-                                else v[flat_src])
+                    out[key] = beam_rows(v, 1 if key.startswith("stack_")
+                                         else 0)
                 else:  # cross K/V / encoder context are beam-invariant
                     out[key] = v
             return out
@@ -371,11 +399,14 @@ class BeamSearch:
         if key not in self._jitted:
             model, weights = self.model, tuple(self.weights)
 
+            mesh = self.mesh
+
             def fn(params_list, src_ids, src_mask, shortlist=None,
                    sample_key=None, prefix=None):
                 return beam_search_jit(model, list(params_list), weights, cfg,
                                        src_ids, src_mask, shortlist,
-                                       sample_key=sample_key, prefix=prefix)
+                                       sample_key=sample_key, prefix=prefix,
+                                       mesh=mesh)
 
             self._jitted[key] = jax.jit(fn, static_argnames=())
         return self._jitted[key]

@@ -87,6 +87,87 @@ class TestMeshDecode:
             assert h1[0]["tokens"] == h8[0]["tokens"]
             assert h1[0]["tokens"][0] == 7
 
+    @pytest.mark.slow
+    def test_sampling_topk_mesh_parity_and_collective_free(self):
+        """--output-sampling topk under the mesh: same samples as
+        single-device (counter-based PRNG → placement-independent) and
+        no tensor-sized collectives from the [B,K,V] top-k filter."""
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from marian_tpu.parallel.collectives import collective_stats
+        from marian_tpu.translator.beam_search import BeamConfig
+        vocab = 19
+        model, params, opts = tiny_model(vocab=vocab)
+        ids, mask = _batch(vocab, b=8)
+        res = {}
+        for nd in (1, 8):
+            bs = BeamSearch(
+                model, [params], None,
+                opts.with_(**{"beam-size": 2, "num-devices": nd, "seed": 11,
+                              "output-sampling": ["topk", "5", "0.8"]}),
+                vocab)
+            res[nd] = bs.search(ids, mask)
+            if nd == 8:
+                cfg = BeamConfig.from_options(bs.options, 12)
+                fn = bs._get_fn(cfg, has_shortlist=False)
+
+                def _dev(x):
+                    return jax.device_put(
+                        jnp.asarray(x), NamedSharding(
+                            bs.mesh,
+                            P("data", *([None] * (np.ndim(x) - 1)))))
+                txt = fn.lower(tuple(bs.params_list), _dev(ids), _dev(mask),
+                               shortlist=None,
+                               sample_key=jax.random.key(5),
+                               prefix=None).compile().as_text()
+                for k, v in collective_stats(txt).items():
+                    if k in ("all-gather", "all-reduce", "reduce-scatter",
+                             "all-to-all", "collective-permute"):
+                        assert v["max_elems"] <= 64, (k, v)
+        for h1, h8 in zip(res[1], res[8]):
+            assert [h["tokens"] for h in h1] == [h["tokens"] for h in h8]
+
+    @pytest.mark.slow
+    def test_mesh_decode_is_collective_free(self):
+        """Batch-dim-sharded beam search is embarrassingly parallel: the
+        compiled 8-device program must contain NO cross-device data
+        collectives (an accidental replicated intermediate or a sharding
+        constraint regression would surface as all-gathers GSPMD inserts
+        silently — the decode analogue of TestZero1CollectivePattern)."""
+        import jax.numpy as jnp
+        from marian_tpu.parallel.collectives import collective_stats
+        from marian_tpu.translator.beam_search import BeamConfig, \
+            beam_search_jit
+        vocab = 19
+        model, params, opts = tiny_model(vocab=vocab)
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 2, "num-devices": 8}),
+                        vocab)
+        assert bs.mesh is not None
+        cfg = BeamConfig.from_options(bs.options.with_(**{"beam-size": 2}),
+                                      12)
+        fn = bs._get_fn(cfg, has_shortlist=False)
+        ids, mask = _batch(vocab, b=8)
+
+        def _dev(x):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.device_put(
+                jnp.asarray(x),
+                NamedSharding(bs.mesh, P("data",
+                                         *([None] * (np.ndim(x) - 1)))))
+        txt = fn.lower(tuple(bs.params_list), _dev(ids), _dev(mask),
+                       shortlist=None, sample_key=None,
+                       prefix=None).compile().as_text()
+        stats = collective_stats(txt)
+        data_moving = {k: v for k, v in stats.items()
+                       if k in ("all-gather", "all-reduce",
+                                "reduce-scatter", "all-to-all",
+                                "collective-permute") and v["count"] > 0}
+        # tolerate only scalar/tiny control traffic (e.g. an
+        # all-finished early-exit reduction), never tensor-sized moves
+        for k, v in data_moving.items():
+            assert v["max_elems"] <= 64, (k, v)
+
     def test_mesh_divisible_batch_no_padding(self):
         vocab = 19
         model, params, opts = tiny_model(vocab=vocab)
