@@ -22,6 +22,12 @@ Env knobs:
                              search in shortlist coordinates — the
                              reference's decode-speed headline combo
                              (intgemm + --shortlist)
+  MARIAN_DECBENCH_SSRU       SSRU decoder (--transformer-decoder-autoreg
+                             rnn --dec-cell ssru): the reference's
+                             production fast-decode architecture — no
+                             self-attn KV cache; composes with INT8
+  MARIAN_DECBENCH_PROFILE    directory → jax.profiler trace of the
+                             timed window
 """
 
 import json
@@ -63,6 +69,12 @@ def main():
         batch, src_len, max_len = 8, 12, 16
         n_sents = min(n_sents, 32)
 
+    # MARIAN_DECBENCH_SSRU=1: the reference's production fast-decode
+    # decoder (--transformer-decoder-autoreg rnn --dec-cell ssru, the
+    # WNGT-2019 student config): the self-attention KV cache — whose
+    # per-step reorder+read traffic dominates the standard decode step —
+    # is replaced by one [B*K, d] recurrent state per layer
+    ssru = bool(os.environ.get("MARIAN_DECBENCH_SSRU"))
     opts = Options({
         "type": "transformer",
         "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
@@ -71,11 +83,14 @@ def main():
         "tied-embeddings-all": True, "transformer-ffn-activation": "relu",
         "precision": ["bfloat16", "float32"], "max-length": max_len,
         "seed": 17,
+        **({"transformer-decoder-autoreg": "rnn", "dec-cell": "ssru"}
+           if ssru else {}),
     })
     model = create_model(opts, dims["vocab"], dims["vocab"],
                          inference=True)
     params = model.init(jax.random.key(17))
-    metric = "beam6_sentences_per_sec"
+    metric = "beam6_ssru_sentences_per_sec" if ssru \
+        else "beam6_sentences_per_sec"
     if os.environ.get("MARIAN_DECBENCH_INT8"):
         # config #5 (int8 student decode): quantize offline like
         # marian-conv int8tpu, then pair values+scales into QTensor
@@ -87,7 +102,7 @@ def main():
         params = wrap_quantized(
             {k: jnp.asarray(v)
              for k, v in quantize_params(params).items()})
-        metric = "beam6_int8_sentences_per_sec"
+        metric = metric.replace("sentences", "int8_sentences")
     # the REAL translator path: BeamSearch's jit cache + host-side
     # n-best extraction, exactly what marian_decoder runs per batch
     bopts = Options({"beam-size": 6, "normalize": 0.6,

@@ -127,6 +127,10 @@ ladder() {
     stage_decode decode_int8_sl MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1 \
                                 MARIAN_DECBENCH_SHORTLIST=1
+    # the reference's production fast-decode config (SSRU decoder — no
+    # self-attn KV cache, whose reorder dominates the standard step)
+    stage_decode decode_ssru    MARIAN_DECBENCH_PRESET=$PRESET \
+                                MARIAN_DECBENCH_SSRU=1
     # 3/4 — train A/Bs (cache already warm for the base shapes). Every
     # A/B leg pins the cheap historical baseline config (2 buckets, no
     # dispatch window) so its lever stays the ONLY variable vs `train`;
