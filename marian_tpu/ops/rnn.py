@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..layers import initializers as inits
-from .ops import layer_norm
+from .ops import affine, layer_norm
 
 Params = Dict[str, jax.Array]
 State = Dict[str, jax.Array]
@@ -105,19 +105,16 @@ class GRU(Cell):
         b = params[f"{prefix}_b"]
         if x is None or self.dim_in == 0:
             return b
-        xp = jnp.dot(x, params[f"{prefix}_W"].astype(x.dtype),
-                     preferred_element_type=x.dtype)
+        xp = affine(x, params[f"{prefix}_W"])
         xp = _ln(xp, params, f"{prefix}_W_ln_scale", self.ln)
         return xp + b.astype(xp.dtype)
 
     def step(self, params, prefix, xp, state):
         h = state["h"]
         d = self.dim
-        hu = jnp.dot(h, params[f"{prefix}_U"].astype(h.dtype),
-                     preferred_element_type=h.dtype)
+        hu = affine(h, params[f"{prefix}_U"])
         hu = _ln(hu, params, f"{prefix}_U_ln_scale", self.ln)
-        hx = jnp.dot(h, params[f"{prefix}_Ux"].astype(h.dtype),
-                     preferred_element_type=h.dtype)
+        hx = affine(h, params[f"{prefix}_Ux"])
         hx = _ln(hx, params, f"{prefix}_Ux_ln_scale", self.ln)
         xz, xr, xh = xp[..., :d], xp[..., d:2 * d], xp[..., 2 * d:]
         hz, hr = hu[..., :d], hu[..., d:]
@@ -151,16 +148,14 @@ class LSTM(Cell):
         b = params[f"{prefix}_b"]
         if x is None or self.dim_in == 0:
             return b
-        xp = jnp.dot(x, params[f"{prefix}_W"].astype(x.dtype),
-                     preferred_element_type=x.dtype)
+        xp = affine(x, params[f"{prefix}_W"])
         xp = _ln(xp, params, f"{prefix}_W_ln_scale", self.ln)
         return xp + b.astype(xp.dtype)
 
     def step(self, params, prefix, xp, state):
         h, c = state["h"], state["c"]
         d = self.dim
-        hu = jnp.dot(h, params[f"{prefix}_U"].astype(h.dtype),
-                     preferred_element_type=h.dtype)
+        hu = affine(h, params[f"{prefix}_U"])
         hu = _ln(hu, params, f"{prefix}_U_ln_scale", self.ln)
         g = xp + hu
         i = jax.nn.sigmoid(g[..., :d])
@@ -200,13 +195,10 @@ class SSRU(Cell):
     def x_proj(self, params, prefix, x):
         if x is None or self.dim_in == 0:
             x = jnp.zeros((1, self.dim), params[f"{prefix}_bf"].dtype)
-        xw = jnp.dot(x, params[f"{prefix}_W"].astype(x.dtype),
-                     preferred_element_type=x.dtype)
+        xw = affine(x, params[f"{prefix}_W"])
         xw = _ln(xw, params, f"{prefix}_W_ln_scale", self.ln)
-        f = jax.nn.sigmoid(
-            jnp.dot(x, params[f"{prefix}_Wf"].astype(x.dtype),
-                    preferred_element_type=x.dtype)
-            + params[f"{prefix}_bf"].astype(x.dtype))
+        f = jax.nn.sigmoid(affine(x, params[f"{prefix}_Wf"],
+                                  params[f"{prefix}_bf"]))
         return jnp.concatenate([f, (1.0 - f) * xw], axis=-1)
 
     def step(self, params, prefix, xp, state):
