@@ -1,6 +1,9 @@
 """Record a bench result into the repo's committed artifact files.
 
 ``python scripts/record_bench.py <stage> <result.json>``
+``python scripts/record_bench.py --rebuild``   (regenerate BENCH_SELF.json
+from the existing history without appending — e.g. after a best-selection
+rule change)
 
 Appends the result (stamped with UTC time + stage) to BENCH_HISTORY.jsonl
 and regenerates BENCH_SELF.json as the latest result per metric — the
@@ -17,8 +20,18 @@ import sys
 
 
 def main():
-    stage, path = sys.argv[1], sys.argv[2]
+    stage = sys.argv[1]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if stage == "--rebuild":
+        # regenerate BENCH_SELF.json from the history without appending
+        if not os.path.exists(os.path.join(root, "BENCH_HISTORY.jsonl")):
+            print("record_bench: no BENCH_HISTORY.jsonl — nothing to "
+                  "rebuild", file=sys.stderr)
+            return 1
+        _write_self(root)
+        print("record_bench: BENCH_SELF.json rebuilt")
+        return 0
+    path = sys.argv[2]
     with open(path) as fh:
         text = fh.read().strip()
     if not text:
@@ -43,22 +56,51 @@ def main():
     hist = os.path.join(root, "BENCH_HISTORY.jsonl")
     with open(hist, "a") as fh:
         fh.write(json.dumps(result) + "\n")
-    # latest result per (metric, stage-qualifier) — the sweep stages keep
-    # their own rows so BENCH_SELF.json shows the headline AND the A/Bs
+    _write_self(root)
+    print(f"record_bench: {stage} → {result.get('metric')}="
+          f"{result.get('value')} {result.get('unit')}")
+    return 0
+
+
+def _write_self(root: str) -> None:
+    """Regenerate BENCH_SELF.json: latest result per (metric, stage) —
+    the sweep stages keep their own rows so the table shows the headline
+    AND the A/Bs. The tunnel degrades under sustained load (r4:
+    final_sync_s 48-63s rows at ~1/10 the healthy number), so each entry
+    also carries best_value/best_ts: a degraded late re-run must not
+    HIDE a healthy measurement from the at-a-glance table. Degradation
+    evidence stays visible in the latest row's own final_sync_s.
+    Rows marked suspect — or with mfu above physical peak, the same
+    condition applied retroactively to rows predating the marker —
+    never become best."""
     latest = {}
-    with open(hist) as fh:
+    best = {}
+    with open(os.path.join(root, "BENCH_HISTORY.jsonl")) as fh:
         for line in fh:
             try:
                 r = json.loads(line)
             except ValueError:
                 continue
-            latest[(r.get("metric"), r.get("stage"))] = r
+            k = (r.get("metric"), r.get("stage"))
+            latest[k] = r
+            try:
+                v = float(r.get("value"))
+            except (TypeError, ValueError):
+                continue
+            mfu = r.get("mfu")
+            impossible = isinstance(mfu, (int, float)) and mfu > 0.95
+            if "suspect" not in r and not impossible \
+                    and (k not in best or v > float(best[k]["value"])):
+                best[k] = r
+    rows = []
+    for k, r in latest.items():
+        b = best.get(k)
+        if b is not None and b is not r:
+            r = dict(r, best_value=b.get("value"), best_ts=b.get("ts"))
+        rows.append(r)
     with open(os.path.join(root, "BENCH_SELF.json"), "w") as fh:
-        json.dump(sorted(latest.values(), key=lambda r: r.get("ts", "")),
+        json.dump(sorted(rows, key=lambda r: r.get("ts", "")),
                   fh, indent=1)
-    print(f"record_bench: {stage} → {result.get('metric')}="
-          f"{result.get('value')} {result.get('unit')}")
-    return 0
 
 
 if __name__ == "__main__":

@@ -68,3 +68,54 @@ def test_empty_or_metricless_output_fails_loudly(repo):
     assert _run_in(repo, "s", '{"no_metric": true}').returncode == 1
     # and neither wrote artifacts
     assert not (repo / "BENCH_SELF.json").exists()
+
+def test_best_annotation_survives_degraded_rerun(repo):
+    """A degraded late re-run (the r4 tunnel failure mode) stays the
+    LATEST row but must not hide the healthy number: best_value/best_ts
+    point back at it."""
+    _run_in(repo, "train", '{"metric": "m", "value": 39000.0, "unit": "u"}')
+    _run_in(repo, "train",
+            '{"metric": "m", "value": 3000.0, "unit": "u", '
+            '"final_sync_s": 48.5}')
+    rows = json.loads((repo / "BENCH_SELF.json").read_text())
+    (row,) = rows
+    assert row["value"] == 3000.0             # honest latest
+    assert row["best_value"] == 39000.0       # healthy number visible
+    assert "best_ts" in row
+
+
+def test_suspect_and_impossible_mfu_never_best(repo):
+    """Rows marked suspect — or with mfu above physical peak, the rule
+    applied retroactively to rows predating the marker — are excluded
+    from best selection."""
+    _run_in(repo, "t", '{"metric": "m", "value": 278000.0, "unit": "u", '
+                       '"mfu": 1.79}')                    # pre-marker row
+    _run_in(repo, "t", '{"metric": "m", "value": 500000.0, "unit": "u", '
+                       '"suspect": "mfu>0.95"}')
+    _run_in(repo, "t", '{"metric": "m", "value": 39000.0, "unit": "u", '
+                       '"mfu": 0.25}')
+    _run_in(repo, "t", '{"metric": "m", "value": 3000.0, "unit": "u", '
+                       '"mfu": 0.02}')
+    (row,) = json.loads((repo / "BENCH_SELF.json").read_text())
+    assert row["value"] == 3000.0
+    assert row["best_value"] == 39000.0       # not 278k, not 500k
+
+
+def test_rebuild_regenerates_without_appending(repo):
+    _run_in(repo, "train", '{"metric": "m", "value": 1.0, "unit": "u"}')
+    hist = (repo / "BENCH_HISTORY.jsonl").read_text()
+    (repo / "BENCH_SELF.json").unlink()
+    r = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "record_bench.py"),
+         "--rebuild"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert (repo / "BENCH_HISTORY.jsonl").read_text() == hist  # no append
+    assert json.loads((repo / "BENCH_SELF.json").read_text())
+
+
+def test_rebuild_without_history_fails_loudly(repo):
+    r = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "record_bench.py"),
+         "--rebuild"], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "nothing to rebuild" in r.stderr
