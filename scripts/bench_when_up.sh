@@ -68,6 +68,31 @@ commit_artifacts() {  # $1 = message
     git diff --cached --quiet || git commit -q -m "$1"
 }
 
+# The tunnel DEGRADES under sustained load before it dies (r4: healthy
+# rows until transfer_full/buckets_full came back at ~1/10 speed with
+# 48-63s final_sync_s bursts — block_until_ready returning early while
+# the chip limped). Measuring on a limping chip wastes hours recording
+# garbage latest-rows, so any stage whose row shows a sync burst sets
+# TUNNEL_DEGRADED and the ladder backs off to probing.
+check_degraded() {  # $1 = name, $2 = result file
+    if python - "$2" <<'PY'
+import json, sys
+row = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            pass
+sys.exit(0 if row and float(row.get("final_sync_s") or 0) > 5.0 else 1)
+PY
+    then
+        echo "stage $1: tunnel degraded (final_sync_s burst) — backing off"
+        TUNNEL_DEGRADED=1
+    fi
+}
+
 stage() {  # $1 = name, $2 = timeout_s, rest = env assignments
     local name="$1" tmo="$2"; shift 2
     local out; out=$(mktemp)
@@ -77,6 +102,7 @@ stage() {  # $1 = name, $2 = timeout_s, rest = env assignments
     if [ "$rc" = 0 ]; then
         python scripts/record_bench.py "$name" "$out"
         commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
+        check_degraded "$name" "$out"
         return 0
     fi
     # capture rc BEFORE any other command: the old `if env …; then` form
@@ -95,6 +121,7 @@ stage_decode() {  # $1 = name, rest = env assignments
     if [ "$rc" = 0 ]; then
         python scripts/record_bench.py "$name" "$out"
         commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
+        check_degraded "$name" "$out"
         return 0
     fi
     echo "stage $name failed rc=$rc — $(tail -2 "$out.err" 2>/dev/null | head -c 300)"
@@ -102,6 +129,7 @@ stage_decode() {  # $1 = name, rest = env assignments
 }
 
 ladder() {
+    TUNNEL_DEGRADED=0
     export MARIAN_BENCH_PARTIAL=BENCH_PARTIAL.json
     local PRESET=big WORDS_AB=16384
     BACKEND_TAG=TPU
@@ -119,18 +147,24 @@ ladder() {
     stage train 5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=1 \
                           || return 1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage headline 7200 MARIAN_BENCH_PRESET=$PRESET
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # 2 — decode family
     stage_decode decode_float   MARIAN_DECBENCH_PRESET=$PRESET
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage_decode decode_int8    MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage_decode decode_int8_sl MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1 \
                                 MARIAN_DECBENCH_SHORTLIST=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # the reference's production fast-decode config (SSRU decoder — no
     # self-attn KV cache, whose reorder dominates the standard step)
     stage_decode decode_ssru    MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_SSRU=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # 3/4 — train A/Bs (cache already warm for the base shapes). Every
     # A/B leg pins the cheap historical baseline config (2 buckets, no
     # dispatch window) so its lever stays the ONLY variable vs `train`;
@@ -140,28 +174,36 @@ ladder() {
     # slower per step on v5e), so the A/B leg is now scan ON; stacked
     # storage structurally requires the scanned stack.
     stage scan_on    5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" MARIAN_BENCH_SCAN=on
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage stacked    5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_STACKED=1 MARIAN_BENCH_SCAN=on
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_WORDS=$WORDS_AB
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_OPT_DTYPE=bfloat16
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # compact host→device transfer OFF (default is on): isolates how much
     # of the step the tunnel's per-batch id/mask bytes cost
     stage transfer_full 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_COMPACT=0
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # --dispatch-window: K full updates per jitted dispatch. THE lever for
     # a dispatch-latency-bound chip (the r4 train row showed 19% MFU with
     # ~53ms ideal compute in a ~280ms step — tunnel dispatch suspected)
     stage dispatch_8  5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=8
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage dispatch_32 5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=32
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # 32k tokens needs remat headroom; if it OOMs the stage fails
     # gracefully and the ladder continues
     stage words_32k_remat 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_WORDS=$((WORDS_AB * 2)) \
                           MARIAN_BENCH_REMAT=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # long-context: doc-concatenation lengths with the Pallas flash
     # kernel on vs off (the long-sequence story measured on silicon)
     local SEQ=2048
@@ -172,9 +214,11 @@ ladder() {
     stage longseq_flash 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_SEQLEN=$SEQ MARIAN_BENCH_FUSED=on \
                           MARIAN_BENCH_REMAT=1 MARIAN_BENCH_FLASH=on
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage longseq_dense 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_SEQLEN=$SEQ MARIAN_BENCH_FUSED=on \
                           MARIAN_BENCH_REMAT=1 MARIAN_BENCH_FLASH=off
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # 5 — profile-directed trace, summarized to a committed text artifact
     # (summarize into a temp file first: a failed/empty summary must not
     # truncate-and-commit over a previous good one)
@@ -196,6 +240,7 @@ ladder() {
     # full+window config is the `headline` stage)
     stage buckets_full 7200 MARIAN_BENCH_PRESET=$PRESET \
                             MARIAN_BENCH_BUCKETS=full MARIAN_BENCH_DISPATCH=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     return 0
 }
 
