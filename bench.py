@@ -331,16 +331,14 @@ def main():
             arrays = batch_to_arrays(probe, compact=compact, vocab_sizes=vsz)
             for i in range(2):                       # compile + settle
                 retry_compile(
-                    lambda i=i: g.update(dict(arrays), i + 1,
-                                         jax.random.fold_in(train_key, i)),
+                    lambda i=i: g.update(dict(arrays), i + 1, train_key),
                     f"fused-CE probe ({mode})",
                     reset=lambda: g.initialize(
                         prng.stream(key, prng.STREAM_INIT)))
             jax.block_until_ready(g.params)
             t0 = time.perf_counter()
             for i in range(6):
-                g.update(dict(arrays), i + 3,
-                         jax.random.fold_in(train_key, i))
+                g.update(dict(arrays), i + 3, train_key)
             jax.block_until_ready(g.params)
             times[mode] = time.perf_counter() - t0
             del g
@@ -392,7 +390,7 @@ def main():
         retry_compile(
             lambda: gg.update(batch_to_arrays(b, compact=compact,
                                               vocab_sizes=vsz), step + 1,
-                              jax.random.fold_in(train_key, step)),
+                              train_key),
             f"shape {sk}",
             reset=lambda: gg.initialize(prng.stream(key, prng.STREAM_INIT)))
         jax.block_until_ready(gg.params)
@@ -442,8 +440,8 @@ def main():
     progress.update(phase="warmup")
     for _ in range(warmup):
         b = timed_batches[step % len(timed_batches)]
-        gg.update(batch_to_arrays(b, compact=compact, vocab_sizes=vsz), step + 1,
-                  jax.random.fold_in(train_key, step))
+        gg.update(batch_to_arrays(b, compact=compact, vocab_sizes=vsz),
+                  step + 1, train_key)
         step += 1
     jax.block_until_ready(gg.params)
 
@@ -480,7 +478,7 @@ def main():
                     last_out = gg.update(
                         batch_to_arrays(b, compact=compact,
                                         vocab_sizes=vsz),
-                        step + 1, jax.random.fold_in(train_key, step))
+                        step + 1, train_key)
                     step += 1
         jax.block_until_ready(gg.params)
         dt += time.perf_counter() - t0

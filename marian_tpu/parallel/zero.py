@@ -366,6 +366,20 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
     g_specs = machinery.g_specs
 
     def one_update(p, opt_state, batch, step, rng):
+        # rng is the RAW training stream key; the per-step fold happens
+        # HERE, on device, by the absolute step number — the host used to
+        # dispatch a separate tiny _threefry_fold_in program every step
+        # (visible as ~2 extra dispatches/step in the r4 TPU trace). Key
+        # derivation is bit-identical to the old host-side
+        # fold_in(train_key, step-1). GraphGroup passes step as int32 so
+        # the fold index is EXACT at any step count; a float step (legacy
+        # direct callers) is tolerated but its fold saturates f32's 2^24
+        # integer range.
+        step = jnp.asarray(step)
+        step_i = (step if jnp.issubdtype(step.dtype, jnp.integer)
+                  else step.astype(jnp.int32))
+        rng = jax.random.fold_in(rng, step_i - 1)
+        step = step_i.astype(jnp.float32)     # schedule/metrics math
         batch = expand_compact_batch(batch)
         grads, ce_sum, labels = machinery.grads(p, batch, rng)
 
@@ -395,18 +409,19 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
         step_fn = one_update
     else:
         def step_fn(p, opt_state, batch, step, rng):
-            # rng here is the RAW training stream key (callers fold it on
-            # the host for the single-step path); sub-update i folds by the
-            # ABSOLUTE step number step+i-1 in-scan, so the windowed
-            # trajectory is bit-identical to sequential update() calls
-            # regardless of how updates group into windows. f32→i32 step
-            # cast is exact below 2^24 updates.
+            # rng is the RAW training stream key; one_update folds it by
+            # the absolute step number step+i-1 internally, so the
+            # windowed trajectory is bit-identical to sequential update()
+            # calls regardless of how updates group into windows. Int
+            # steps keep sub-step indices exact at any count.
+            step = jnp.asarray(step)
+            step_i = (step if jnp.issubdtype(step.dtype, jnp.integer)
+                      else step.astype(jnp.int32))
+
             def body(carry, xs):
                 pp, oo = carry
                 b, i = xs
-                k = jax.random.fold_in(rng, step.astype(jnp.int32) + i - 1)
-                np_, no_, m = one_update(pp, oo, b,
-                                         step + i.astype(jnp.float32), k)
+                np_, no_, m = one_update(pp, oo, b, step_i + i, rng)
                 return (np_, no_), m
             (p, opt_state), metrics = jax.lax.scan(
                 body, (p, opt_state), (batch, jnp.arange(n_updates)))

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..common import logging as log
@@ -266,20 +267,28 @@ class GraphGroup:
 
     # -- one (macro-)update --------------------------------------------------
     def update(self, batches, step: int, rng) -> TrainOutput:
-        """batches: one batch dict, or a list of `delay` micro-batch dicts."""
+        """batches: one batch dict, or a list of `delay` micro-batch
+        dicts. `rng` is the RAW training stream key — the per-step fold
+        (by absolute step number, fold_in(rng, step-1)) happens inside
+        the jitted step, saving 2-3 tiny host dispatches per step (the
+        r4 TPU trace showed separate _threefry_fold_in +
+        convert_element_type programs between steps). The plain np.int32
+        step scalar avoids a compiled scalar-convert dispatch and keeps
+        the fold index exact at any step count."""
         if isinstance(batches, dict):
             batches = [batches]
+        # int32 step: the in-jit rng fold index stays exact at any step
+        # count (a f32 step would saturate fold indices past 2^24)
+        step_f = np.int32(step)
         if len(batches) == 1:
             b = M.shard_batch(batches[0], self.mesh)
             if self._dump_hlo:
                 from ..common.profiling import dump_lowered
                 dump_lowered(self._dump_hlo, self._fused.lower(
-                    self.params, self.opt_state, b,
-                    jnp.asarray(step, jnp.float32), rng))
+                    self.params, self.opt_state, b, step_f, rng))
                 self._dump_hlo = None
             self.params, self.opt_state, metrics = self._fused(
-                self.params, self.opt_state, b,
-                jnp.asarray(step, jnp.float32), rng)
+                self.params, self.opt_state, b, step_f, rng)
             return TrainOutput(metrics["ce_sum"], metrics["labels"],
                                metrics["gnorm"])
         if (self._fused_delay is not None and len(batches) == self.delay
@@ -296,19 +305,20 @@ class GraphGroup:
             if self._dump_hlo:
                 from ..common.profiling import dump_lowered
                 dump_lowered(self._dump_hlo, self._fused_delay.lower(
-                    self.params, self.opt_state, stacked,
-                    jnp.asarray(step, jnp.float32), rng))
+                    self.params, self.opt_state, stacked, step_f, rng))
                 self._dump_hlo = None
             self.params, self.opt_state, metrics = self._fused_delay(
-                self.params, self.opt_state, stacked,
-                jnp.asarray(step, jnp.float32), rng)
+                self.params, self.opt_state, stacked, step_f, rng)
             return TrainOutput(metrics["ce_sum"], metrics["labels"],
                                metrics["gnorm"])
         total_loss = total_labels = 0.0
         n_sents = 0.0
         grads_acc = None
+        # heterogeneous-shape host loop: reproduce the fused paths' key
+        # derivation (fold by absolute step, then by micro index)
+        base_key = jax.random.fold_in(rng, step - 1)
         for i, b in enumerate(batches):
-            r = jax.random.fold_in(rng, i)
+            r = jax.random.fold_in(base_key, i)
             if self._dump_hlo:
                 # delay>1 path: dump the gradient step (the compute-heavy
                 # half of the accumulation cycle)
@@ -326,8 +336,7 @@ class GraphGroup:
             grads_acc = grads if grads_acc is None else \
                 jax.tree_util.tree_map(jnp.add, grads_acc, grads)
         self.params, self.opt_state, gnorm, _lr = self._update_fn(
-            self.params, self.opt_state, grads_acc,
-            jnp.asarray(step, jnp.float32),
+            self.params, self.opt_state, grads_acc, np.float32(step),
             jnp.asarray(total_labels, jnp.float32),
             jnp.asarray(n_sents, jnp.float32))
         return TrainOutput(total_loss, total_labels, gnorm)
@@ -352,12 +361,10 @@ class GraphGroup:
         if self._dump_hlo:
             from ..common.profiling import dump_lowered
             dump_lowered(self._dump_hlo, self._fused_window.lower(
-                self.params, self.opt_state, stacked,
-                jnp.asarray(step, jnp.float32), rng))
+                self.params, self.opt_state, stacked, np.int32(step), rng))
             self._dump_hlo = None
         self.params, self.opt_state, metrics = self._fused_window(
-            self.params, self.opt_state, stacked,
-            jnp.asarray(step, jnp.float32), rng)
+            self.params, self.opt_state, stacked, np.int32(step), rng)
         return [TrainOutput(metrics["ce_sum"][i], metrics["labels"][i],
                             metrics["gnorm"][i])
                 for i in range(self.window)]

@@ -326,8 +326,7 @@ class Train:
                 pairs = []
                 for idx, (a, b) in enumerate(win):
                     s0 = state.batches + 1 + idx
-                    pairs.append((gg.update(
-                        a, s0, jax.random.fold_in(train_key, s0 - 1)), b))
+                    pairs.append((gg.update(a, s0, train_key), b))
             win.clear()
             win_key.clear()
             before_b, before_l = state.batches, state.labels_total
@@ -383,9 +382,7 @@ class Train:
                                               vocab_sizes=vocab_sizes)
                               for b in micro]
                     trace.tick(state.batches + 1)
-                    out = gg.update(arrays, state.batches + 1,
-                                    jax.random.fold_in(train_key,
-                                                       state.batches))
+                    out = gg.update(arrays, state.batches + 1, train_key)
                     rc = _after_update(out, micro)
                     micro = []
                 if rc == "exit":

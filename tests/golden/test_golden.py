@@ -215,8 +215,7 @@ def _train(name):
         bg = BatchGenerator(corpus, opts, prefetch=False)
         for batch in bg:
             arrays = batch_to_arrays(batch)
-            out = gg.update(arrays, step + 1,
-                            jax.random.fold_in(train_key, step))
+            out = gg.update(arrays, step + 1, train_key)
             losses.append(out.loss_sum / max(out.labels, 1.0))
             step += 1
             if step >= N_UPDATES:

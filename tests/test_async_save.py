@@ -50,7 +50,7 @@ class TestAsyncSave:
         opts, gg = _tiny_gg()
         key = prng.stream(prng.root_key(7), prng.STREAM_DROPOUT)
         for i in range(3):
-            gg.update(_batch(i), i + 1, jax.random.fold_in(key, i))
+            gg.update(_batch(i), i + 1, key)
         state = TrainingState()
         state.batches = 3
         saver = AsyncSaver()
@@ -88,7 +88,7 @@ class TestAsyncSave:
                         async_saver=saver)
         # keep training BEFORE waiting: donation reuses the old buffers
         for i in range(1, 4):
-            gg.update(_batch(i), i + 1, jax.random.fold_in(key, i))
+            gg.update(_batch(i), i + 1, key)
         saver.wait()
 
         with np.load(ap) as z:

@@ -188,8 +188,7 @@ def test_raw_text_to_train_to_decode_e2e(tmp_path):
     n_updates = 40
     while step < n_updates:
         for batch in BatchGenerator(corpus, opts, prefetch=False):
-            out = gg.update(batch_to_arrays(batch), step + 1,
-                            jax.random.fold_in(key, step))
+            out = gg.update(batch_to_arrays(batch), step + 1, key)
             losses.append(out.loss_sum / max(out.labels, 1.0))
             step += 1
             if step >= n_updates:

@@ -56,7 +56,9 @@ class TestDispatchWindow:
 
         gg_s = GraphGroup(model, opts, donate=False)
         gg_s.initialize(key)
-        seq = [gg_s.update(dict(b), 1 + i, jax.random.fold_in(rng, i))
+        # update() folds the raw stream key by step-1 in-jit, so passing
+        # rng to both paths yields identical sub-step keys
+        seq = [gg_s.update(dict(b), 1 + i, rng)
                for i, b in enumerate(batches)]
 
         # per-sub-update metrics line up with the sequential trajectory
@@ -94,7 +96,7 @@ class TestDispatchWindow:
         gg_s = GraphGroup(model, opts, donate=False)
         gg_s.initialize(key)
         for i, b in enumerate(batches):
-            gg_s.update(dict(b), 1 + i, jax.random.fold_in(rng, i))
+            gg_s.update(dict(b), 1 + i, rng)
 
         sm_w, sm_s = gg_w.smoothed(), gg_s.smoothed()
         for k in sm_s:

@@ -68,7 +68,7 @@ class TestStateDtype:
             gg = _gg(dt)
             ls = []
             for i in range(5):
-                out = gg.update(_batch(i), i + 1, jax.random.fold_in(key, i))
+                out = gg.update(_batch(i), i + 1, key)
                 ls.append(float(out.loss_sum) / max(float(out.labels), 1.0))
             losses[dt] = ls
         np.testing.assert_allclose(losses["bfloat16"], losses["float32"],

@@ -63,7 +63,7 @@ class TestStackedParams:
             gg = _gg(**{"stacked-params": flag, "scan-layers": True})
             ls = []
             for i in range(4):
-                out = gg.update(_batch(i), i + 1, jax.random.fold_in(key, i))
+                out = gg.update(_batch(i), i + 1, key)
                 ls.append(float(out.loss_sum))
             losses[flag] = ls
         assert losses[True] == losses[False]

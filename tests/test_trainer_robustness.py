@@ -44,7 +44,7 @@ def _params_delta(gg_kwargs, steps=1):
     key = prng.stream(prng.root_key(21), prng.STREAM_DROPOUT)
     out = None
     for i in range(steps):
-        out = gg.update(_batch(i), i + 1, jax.random.fold_in(key, i))
+        out = gg.update(_batch(i), i + 1, key)
     after = gg.export_params()
     delta = sum(float(np.abs(np.asarray(after[k]) - before[k]).sum())
                 for k in before)
@@ -123,10 +123,10 @@ class TestDynamicGradientScaling:
             gg = _gg(**kwargs)
             key = prng.stream(prng.root_key(21), prng.STREAM_DROPOUT)
             for i in range(3):          # warmup: statistics fill, no scaling
-                gg.update(_batch(i), i + 1, jax.random.fold_in(key, i))
+                gg.update(_batch(i), i + 1, key)
             snap = {k: np.asarray(v) for k, v in gg.export_params().items()}
             for i in range(3, 10):
-                gg.update(_batch(i), i + 1, jax.random.fold_in(key, i))
+                gg.update(_batch(i), i + 1, key)
             after = gg.export_params()
             return sum(float(np.abs(np.asarray(after[k]) - snap[k]).sum())
                        for k in snap)
