@@ -348,8 +348,9 @@ class GraphGroup:
         padded shape (the train loop groups by bucket). `rng` is the RAW
         training stream key — sub-update i folds it in-scan by the
         absolute step number step+i-1, exactly matching sequential
-        update(b, s, fold_in(rng, s-1)) calls, so the trajectory is
-        bitwise independent of window grouping. Returns one TrainOutput
+        update(b, s, rng) calls (update() folds the same raw key by s-1
+        internally), so the trajectory is bitwise independent of window
+        grouping. Returns one TrainOutput
         per sub-update (lazy [K]-stacked device scalars — no host sync
         here)."""
         assert self.window > 1 and len(batches) == self.window

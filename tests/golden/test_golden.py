@@ -130,6 +130,17 @@ CONFIGS = {
     # dp×tp×sp step (Megatron-style TP shardings + ring sequence
     # parallelism) and a dp×pipe×expert step (depth-stacked layer params
     # + expert-sharded MoE tables), trajectories and decode both.
+    # the reference's production fast-decode architecture (WNGT-2019
+    # students): SSRU autoregression instead of decoder self-attention.
+    # Equivalence tests exist (test_decoder_autoreg); this pins the
+    # TRAJECTORY + beam decode of the config the decode_ssru bench stage
+    # measures.
+    "ssru-transformer": {
+        "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
+        "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,
+        "tied-embeddings-all": True,
+        "transformer-decoder-autoreg": "rnn", "dec-cell": "ssru",
+    },
     "tp-sp-transformer": {
         "type": "transformer", "dim-emb": 32, "transformer-heads": 4,
         "transformer-dim-ffn": 64, "enc-depth": 2, "dec-depth": 2,

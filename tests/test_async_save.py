@@ -79,7 +79,7 @@ class TestAsyncSave:
         taken at the same moment, not the post-update weights."""
         opts, gg = _tiny_gg()
         key = prng.stream(prng.root_key(7), prng.STREAM_DROPOUT)
-        gg.update(_batch(0), 1, jax.random.fold_in(key, 0))
+        gg.update(_batch(0), 1, key)
         ref = {k: np.asarray(v) for k, v in gg.export_params().items()}
 
         saver = AsyncSaver()

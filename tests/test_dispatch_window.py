@@ -41,7 +41,8 @@ def _fixed_batches(src, tgt, n):
 class TestDispatchWindow:
     def test_window_equals_sequential_updates(self, tmp_corpus, tmp_path):
         """K=3 scanned updates must reproduce 3 sequential update() calls
-        exactly (same step numbers, same fold_in(rng, i) sub-keys)."""
+        exactly (same step numbers; both paths derive sub-step keys from
+        the same raw stream key by absolute step)."""
         src, tgt, _ = tmp_corpus
         opts = train_options(tmp_path, src, tgt)
         (vs, vt), batches = _fixed_batches(src, tgt, 3)

@@ -80,7 +80,7 @@ class TestStateDtype:
         the configured dtype on load."""
         key = prng.stream(prng.root_key(11), prng.STREAM_DROPOUT)
         gg = _gg("bfloat16")
-        gg.update(_batch(0), 1, jax.random.fold_in(key, 0))
+        gg.update(_batch(0), 1, key)
         flat = gg.optimizer_arrays()
         m_keys = [k for k in flat if k.startswith("m:")]
         assert m_keys and all(flat[k].dtype == np.float32 for k in m_keys)
