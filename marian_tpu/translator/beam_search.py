@@ -375,9 +375,23 @@ class BeamSearch:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec
             self.mesh = Mesh(np.array(local[:nd]), ("data",))
             rep = NamedSharding(self.mesh, PartitionSpec())
+
+            def _replicate(v):
+                # multiprocess: a GLOBAL-mesh array (training params at a
+                # validation decode) cannot device_put onto the local
+                # mesh directly — jax treats it as a cross-host transfer
+                # even when a replica is addressable; hop via the local
+                # replica on host
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    # the extracted local replica is a fully-addressable
+                    # single-device array — replicating THAT is a
+                    # device-to-device copy, no host round-trip
+                    v = v.addressable_data(0)
+                return jax.device_put(v, rep)
+
             # scorer params replicate to every device once, up front
-            # (device_put maps over the pytree, incl. QTensor leaves)
-            self.params_list = [jax.device_put(p, rep)
+            # (tree_map covers QTensor leaves)
+            self.params_list = [jax.tree_util.tree_map(_replicate, p)
                                 for p in self.params_list]
 
     @staticmethod

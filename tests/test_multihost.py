@@ -75,10 +75,25 @@ WORKER = textwrap.dedent("""
     p2, o2, metrics = step(params, opt_state, batch,
                            jnp.asarray(1.0, jnp.float32), jax.random.key(1))
     jax.block_until_ready(p2)
+
+    # data-parallel DECODE under multiprocess: the translator's mesh must
+    # use only this process's ADDRESSABLE devices (4 of the 8 global) —
+    # per-host independent decode, the reference's per-worker translator
+    # decomposition. Both processes decode the same rows and must agree
+    # exactly (placement-independent beam search).
+    from marian_tpu.translator.beam_search import BeamSearch
+    imodel = create_model(opts, 31, 31, inference=True)
+    bs = BeamSearch(imodel, [params], None,
+                    opts.with_(**{"beam-size": 2, "max-length": 12}), 31)
+    assert bs.mesh is not None and bs.mesh.shape["data"] == 4, bs.mesh
+    nb = bs.search(host["src_ids"][:5], host["src_mask"][:5])
+    dec = [h[0]["tokens"] for h in nb]
+
     print("RESULT " + json.dumps({
         "pid": pid,
         "ce": float(metrics["ce_sum"]),
         "gnorm": float(metrics["gnorm"]),
+        "decode": dec,
         "n_dev": len(jax.devices()),
         "n_proc": jax.process_count()}))
 """)
@@ -114,5 +129,8 @@ def test_two_process_dp_step(tmp_path):
     # the loss/gnorm are global psums — both hosts must agree exactly
     assert results[0]["ce"] == results[1]["ce"]
     assert results[0]["gnorm"] == results[1]["gnorm"]
+    # per-host decode (local 4-device mesh each) agrees bitwise
+    assert results[0]["decode"] == results[1]["decode"]
+    assert len(results[0]["decode"]) == 5
     import numpy as np
     assert np.isfinite(results[0]["ce"])
