@@ -697,12 +697,53 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
             return _split_heads(affine(x, w, b), h)
         return _proj_heads(x, w, b, h)
 
-    q = proj(q_in, f"{prefix}_Wq", f"{prefix}_bq")
+    def proj_many(x, names):
+        """G projections of the SAME input as ONE widened GEMM
+        ('bte,eghd->gbhtd'): the r4 TPU trace showed the per-projection
+        dots (54/step at ~100µs each) running far under MXU efficiency —
+        tripling N amortizes the tiling. Output columns are concatenated
+        per projection, so each slice is element-identical to its
+        separate _proj_heads dot's contraction; biases go through the
+        same _bias_add_bhtd custom-VJP as the unfused path. The runtime
+        weight concat costs one 3d² read+write (~0.1 ms/step at
+        transformer-big) against the GEMM win; int8 QTensor weights
+        fall back to per-projection affine."""
+        ws = [params[f"{prefix}_W{n}"] for n in names]
+        if any(isinstance(w, QTensor) for w in ws):
+            return [proj(x, f"{prefix}_W{n}", f"{prefix}_b{n}")
+                    for n in names]
+        g, e = len(ws), ws[0].shape[0]
+        dh = ws[0].shape[1] // h
+        w = jnp.concatenate(ws, axis=1).reshape(e, g, h, dh)
+        y = jnp.einsum("bte,eghd->gbhtd", x, w,
+                       preferred_element_type=x.dtype)
+        return [_bias_add_bhtd(
+                    y[i], params[f"{prefix}_b{n}"].reshape(
+                        1, h, 1, dh).astype(y.dtype))
+                for i, n in enumerate(names)]
+
+    # fuse only where it wins: full-sequence shapes (the t=1 cached decode
+    # step is weight-bandwidth-bound — a runtime 3d² concat would DOUBLE
+    # its attention weight traffic) and no 'model' (TP) axis (the concat
+    # crosses the Megatron column split, and GSPMD cannot push P(None,
+    # 'model') through the (e,3,h,dh) reshape's major g dim — it would
+    # replicate the weights every step)
+    n_model_tp = (cfg.seq_mesh.shape.get("model", 1)
+                  if cfg.seq_mesh is not None else 1)
+    fuse = n_model_tp <= 1 and q_in.shape[-2] > 1
     if static_kv and cache is not None:
+        q = proj(q_in, f"{prefix}_Wq", f"{prefix}_bq")
         k_, v_ = cache["k"], cache["v"]
+    elif fuse and q_in is kv_in:
+        q, k_, v_ = proj_many(q_in, ("q", "k", "v"))    # self-attention
+    elif fuse:
+        q = proj(q_in, f"{prefix}_Wq", f"{prefix}_bq")
+        k_, v_ = proj_many(kv_in, ("k", "v"))           # uncached cross
     else:
+        q = proj(q_in, f"{prefix}_Wq", f"{prefix}_bq")
         k_ = proj(kv_in, f"{prefix}_Wk", f"{prefix}_bk")
         v_ = proj(kv_in, f"{prefix}_Wv", f"{prefix}_bv")
+    if not (static_kv and cache is not None):
         if cache is not None and cache_pos is not None:
             # write this step's K/V into the fixed-size cache at position pos
             k_ = jax.lax.dynamic_update_slice(
