@@ -26,6 +26,8 @@ Env knobs:
                              rnn --dec-cell ssru): the reference's
                              production fast-decode architecture — no
                              self-attn KV cache; composes with INT8
+  MARIAN_DECBENCH_BEAM       beam size (default 6; 1 = greedy — the
+                             production student serving config)
   MARIAN_DECBENCH_PROFILE    directory → jax.profiler trace of the
                              timed window
 """
@@ -105,7 +107,10 @@ def main():
         metric = metric.replace("sentences", "int8_sentences")
     # the REAL translator path: BeamSearch's jit cache + host-side
     # n-best extraction, exactly what marian_decoder runs per batch
-    bopts = Options({"beam-size": 6, "normalize": 0.6,
+    beam = int(os.environ.get("MARIAN_DECBENCH_BEAM", "6") or 6)
+    if beam != 6:
+        metric = metric.replace("beam6", f"beam{beam}")
+    bopts = Options({"beam-size": beam, "normalize": 0.6,
                      "max-length": max_len, "seed": 17})
     vocab = DefaultVocab.build(
         [" ".join(f"w{i}" for i in range(dims["vocab"] - 2))])

@@ -165,6 +165,15 @@ ladder() {
     stage_decode decode_ssru    MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_SSRU=1
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    # beam-1 SSRU (float): the production-student ARCHITECTURE at
+    # greedy serving settings. Marian's full student combo adds
+    # int8+shortlist, but both measured FLAT on this chip at batch 64
+    # (r4 decode trio; DECODE_ROOFLINE defaults decision) — float is our
+    # serving default, so this is the honest serving row.
+    stage_decode decode_ssru_b1 MARIAN_DECBENCH_PRESET=$PRESET \
+                                MARIAN_DECBENCH_SSRU=1 \
+                                MARIAN_DECBENCH_BEAM=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # 3/4 — train A/Bs (cache already warm for the base shapes). Every
     # A/B leg pins the cheap historical baseline config (2 buckets, no
     # dispatch window) so its lever stays the ONLY variable vs `train`;
