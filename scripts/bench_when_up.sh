@@ -259,11 +259,11 @@ while :; do
             [ "$ONCE" = 1 ] && exit 0
             # full ladder landed — re-run only every ~3h to pick up code
             # improvements without thrashing the chip all round
-            sleep 10800
+            sleep 10800 9>&-   # close the lock fd: an orphaned sleep must not hold it
             continue
         fi
     else
         echo "$(date -u +%H:%M:%SZ) tunnel down — next probe in ${INTERVAL}s"
     fi
-    sleep "$INTERVAL"
+    sleep "$INTERVAL" 9>&-
 done
