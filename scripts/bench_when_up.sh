@@ -15,13 +15,17 @@
 #
 # Ladder (in strictly decreasing value-per-tunnel-minute, so the most
 # important number lands first):
-#   1. train bench (headline src-tok/s/chip + MFU; fused-CE A/B inside)
-#   2. decode float / int8 / int8+shortlist (BASELINE's second metric)
-#   3. scan-layers OFF A/B        (VERDICT r2 weak #3)
-#   4. 16k-word token budget      (VERDICT r2 next-step #2)
-#   5. profile trace → committed text summary (VERDICT r2 missing #4)
-#   6. full 18-bucket table (padding tax; VERDICT r2 weak #6 — most new
-#      compiles, so last)
+#   1. train   — pinned historical 32,64-bucket/K=1 trend leg (the gate)
+#   2. headline — bench.py defaults: full buckets + dispatch-window 8
+#      (the combined measured-best config, what the driver records)
+#   3. decode float / int8 / int8+shortlist / SSRU / SSRU-beam1
+#   4. train A/Bs, one lever each off the pinned baseline: scan_on,
+#      stacked, 16k/32k words(+remat), bf16 moments, full transfer,
+#      dispatch 8/32, long-seq flash vs dense
+#   5. profile trace → committed text summary
+#   6. buckets_full (padding-tax A/B at K=1; most new compiles — last)
+# Any stage whose row shows a final_sync_s burst flags the tunnel
+# DEGRADED and the ladder backs off to probing.
 set -u
 cd "$(dirname "$0")/.."
 ONCE=0; INTERVAL=1200
