@@ -120,12 +120,52 @@ def summarize(trace_dir: str, top_n: int = 25) -> int:
     return 0
 
 
+def by_source(trace_dir: str, top_n: int = 25) -> int:
+    """Aggregate op durations by HLO METADATA source (the `tf_op` /
+    `long_name` trace arg: e.g. 'jit(one_update)/jvp(bte,ehd->bhtd)/
+    dot_general') instead of opaque fusion.N names — the view that
+    attributes time to model-code operations. This is what identified
+    the per-projection attention dots behind the r4 fused-QKV change."""
+    paths = sorted(_find_traces(trace_dir))
+    if not paths:
+        print(f"no *.trace.json[.gz] under {trace_dir}", file=sys.stderr)
+        return 1
+    tot = defaultdict(float)
+    cnt = defaultdict(int)
+    n_ev = n_meta = 0
+    for path in paths:
+        op_ = gzip.open if path.endswith(".gz") else open
+        with op_(path, "rb") as fh:
+            d = json.load(fh)
+        for e in d.get("traceEvents", []):
+            a = e.get("args") or {}
+            src = a.get("tf_op") or a.get("long_name") or ""
+            n_ev += 1
+            if not src:
+                continue
+            n_meta += 1
+            key = src[:110]
+            tot[key] += e.get("dur", 0)
+            cnt[key] += 1
+    total = sum(tot.values()) or 1.0
+    print(f"events: {n_ev}, with source metadata: {n_meta}; "
+          f"Σ attributed {total/1e3:.1f} ms")
+    print(f"{'total ms':>10} {'mean us':>9} {'count':>7} {'%Σ':>6}  source op")
+    for k, t in sorted(tot.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"{t/1e3:10.2f} {t/cnt[k]:9.1f} {cnt[k]:7d} "
+              f"{100*t/total:6.2f}  {k}")
+    return 0
+
+
 def main():
     if len(sys.argv) < 2:
         print(__doc__, file=sys.stderr)
         raise SystemExit(2)
-    top = int(sys.argv[2]) if len(sys.argv) > 2 else 25
-    raise SystemExit(summarize(sys.argv[1], top))
+    args = [a for a in sys.argv[1:] if a != "--by-source"]
+    top = int(args[1]) if len(args) > 1 else 25
+    if "--by-source" in sys.argv:
+        raise SystemExit(by_source(args[0], top))
+    raise SystemExit(summarize(args[0], top))
 
 
 if __name__ == "__main__":
