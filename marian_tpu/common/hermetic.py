@@ -82,11 +82,15 @@ def force_cpu_devices(n_devices: int):
     return jax
 
 
-def watchdog_devices(timeout_s: int = 120, label: str = "bench"):
+def watchdog_devices(timeout_s: int = 120, label: str = "bench",
+                     on_timeout=None):
     """jax.devices() with a hard watchdog: the axon TPU tunnel can hang
     device enumeration forever during outages, in a native RPC wait that
     starves signal handlers — only a timer thread + os._exit gets out.
-    Returns the device list or exits the process with code 3."""
+    Returns the device list or exits the process with code 3.
+    `on_timeout` (optional) runs just before the exit and may return an
+    exit code to use instead (bench uses this to emit a last-known-good
+    stale row so the driver's artifact is never null during an outage)."""
     import os
     import sys
     import threading
@@ -94,7 +98,16 @@ def watchdog_devices(timeout_s: int = 120, label: str = "bench"):
     def _die():
         print(f"{label}: TPU device enumeration hung >{timeout_s}s "
               f"(tunnel outage?) — aborting", file=sys.stderr, flush=True)
-        os._exit(3)
+        code = 3
+        if on_timeout is not None:
+            try:
+                rc = on_timeout()
+                if isinstance(rc, int):
+                    code = rc
+            except Exception as e:  # the watchdog must still exit
+                print(f"{label}: on_timeout hook failed: {e}",
+                      file=sys.stderr, flush=True)
+        os._exit(code)
 
     timer = threading.Timer(timeout_s, _die)
     timer.daemon = True

@@ -165,6 +165,11 @@ class TransformerConfig:
     # device mesh the shard_map'd attention runs on (closed over, not traced).
     sequence_parallel: str = "none"
     seq_mesh: Any = None
+    # size of the 'model' (tensor-parallel) mesh axis, parsed from the
+    # --mesh spec itself: seq_mesh is only built when --sequence-parallel
+    # is active, so a plain TP run must not rely on it (the fused-QKV gate
+    # must see the Megatron column split either way)
+    n_model_tp: int = 1
     compute_dtype: Any = jnp.bfloat16
     guided_alignment_layer: str = "last"
     # factored-vocab metadata (layers/logits.py FactorTables): one entry per
@@ -189,6 +194,16 @@ class TransformerConfig:
     @property
     def dec_ffn_d(self) -> int:
         return self.dec_ffn_depth or self.ffn_depth
+
+
+def _mesh_axis_size(g, axis: str) -> int:
+    """Axis size straight from the --mesh spec strings (``model:4`` etc.),
+    via the ONE canonical parser (parallel.mesh.parse_mesh_spec).
+    Deliberately independent of seq_mesh, which only exists under
+    --sequence-parallel: config gates (fused QKV vs the Megatron column
+    split) need the axis size on EVERY mesh run."""
+    from ..parallel.mesh import parse_mesh_spec
+    return max(1, parse_mesh_spec(g("mesh", []) or []).get(axis, 1))
 
 
 def _resolve_scan_layers(g) -> bool:
@@ -304,6 +319,7 @@ def config_from_options(options, src_vocab, trg_vocab: int,
                                 and bool(g("gradient-checkpointing", False))),
         sequence_parallel=str(g("sequence-parallel", "none") or "none"),
         seq_mesh=seq_mesh,
+        n_model_tp=_mesh_axis_size(g, "model"),
         compute_dtype=dtype,
         guided_alignment_layer=str(g("transformer-guided-alignment-layer", "last")),
         src_factors=src_factors,
@@ -728,8 +744,9 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
     # crosses the Megatron column split, and GSPMD cannot push P(None,
     # 'model') through the (e,3,h,dh) reshape's major g dim — it would
     # replicate the weights every step)
-    n_model_tp = (cfg.seq_mesh.shape.get("model", 1)
-                  if cfg.seq_mesh is not None else 1)
+    n_model_tp = max(cfg.n_model_tp,
+                     cfg.seq_mesh.shape.get("model", 1)
+                     if cfg.seq_mesh is not None else 1)
     fuse = n_model_tp <= 1 and q_in.shape[-2] > 1
     if static_kv and cache is not None:
         q = proj(q_in, f"{prefix}_Wq", f"{prefix}_bq")

@@ -54,6 +54,20 @@ class Scheduler:
         # display accumulators
         self._cost_sum = 0.0
         self._label_sum = 0.0
+        self._max_labels_update = 0   # largest single-update label count seen
+        # config-derived UPPER bound on per-update labels, for the
+        # --after Nt window cap: max observed alone is not conservative
+        # when bucket sizes vary (a later long-bucket update can carry
+        # far more labels than anything seen so far)
+        delay = max(1, int(options.get("optimizer-delay", 1) or 1))
+        mbw = int(options.get("mini-batch-words", 0) or 0)
+        if mbw:
+            self._labels_update_bound = mbw * delay
+        else:
+            mb = int(options.get("mini-batch", 0) or 0)
+            ml = int(options.get("max-length", 0) or 0)
+            self._labels_update_bound = (mb * (ml + 1) * delay
+                                         if mb and ml else 0)
         self._words_sum = 0.0
         self._sent_sum = 0
         self._timer = time.perf_counter()
@@ -124,6 +138,7 @@ class Scheduler:
         s.batches_epoch += 1
         s.samples_epoch += sentences
         s.labels_total += int(labels)
+        self._max_labels_update = max(self._max_labels_update, int(labels))
         if lr is not None:
             s.eta = float(lr)
         self._cost_sum += loss_sum
@@ -248,6 +263,23 @@ class Scheduler:
             limits.append(self.after_batches)
         if self.after and self.after.unit == SchedulingUnit.UPDATES:
             limits.append(self.after.n)
+        if self.after and self.after.unit == SchedulingUnit.TRG_LABELS:
+            # labels-counted limit (--after Nt): conservative updates
+            # estimate, so the window cannot overshoot the labels stop
+            # by more than one update (the unwindowed loop's own
+            # guarantee). Divisor = the config-derived per-update label
+            # UPPER bound (token budget × delay, or mini-batch ×
+            # max-length) — max-observed alone under-estimates when a
+            # later long-bucket update carries more labels than any
+            # seen. No bound derivable (fresh start, sentence batching
+            # without max-length) → cap the fill at one update.
+            rem_labels = self.after.n - self.state.labels_total
+            bound = max(self._labels_update_bound, self._max_labels_update)
+            if bound <= 0:
+                est = 1
+            else:
+                est = -(-max(0, rem_labels) // bound)
+            limits.append(self.state.batches + est)
         if not limits:
             return None
         return max(0, min(limits) - self.state.batches)

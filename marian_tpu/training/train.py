@@ -353,6 +353,14 @@ class Train:
                     # whole new window of batches to assemble
                     if signal_handling.signal_flag() \
                             or not scheduler.keep_going():
+                        if signal_handling.signal_flag() and \
+                                opts.get("sigterm", "save-and-exit") \
+                                == "exit-immediately":
+                            # drop the undispatched window: exit-
+                            # immediately must not do up to K more
+                            # updates of work the unwindowed path skips
+                            win.clear()
+                            win_key.clear()
                         rc = _drain_window() or _check_stop()
                         if rc == "exit":
                             return

@@ -104,7 +104,13 @@ stage() {  # $1 = name, $2 = timeout_s, rest = env assignments
     local rc=0
     env "$@" timeout "$tmo" python bench.py >"$out" 2>"$out.err" || rc=$?
     if [ "$rc" = 0 ]; then
-        python scripts/record_bench.py "$name" "$out"
+        # record_bench rc matters: it REFUSES stale fallback rows (bench.py
+        # exits 0 on an outage so the DRIVER's artifact is never null, but
+        # the ladder must still back off instead of burning the window)
+        if ! python scripts/record_bench.py "$name" "$out"; then
+            echo "stage $name: result refused (stale fallback row?) — backing off"
+            return 1
+        fi
         commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
         check_degraded "$name" "$out"
         return 0
@@ -123,7 +129,10 @@ stage_decode() {  # $1 = name, rest = env assignments
     local rc=0
     env "$@" timeout 3600 python bench_decode.py >"$out" 2>"$out.err" || rc=$?
     if [ "$rc" = 0 ]; then
-        python scripts/record_bench.py "$name" "$out"
+        if ! python scripts/record_bench.py "$name" "$out"; then
+            echo "stage $name: result refused (stale fallback row?) — backing off"
+            return 1
+        fi
         commit_artifacts "bench: $name result (${BACKEND_TAG:-TPU}, bench_when_up)"
         check_degraded "$name" "$out"
         return 0

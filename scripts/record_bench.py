@@ -50,6 +50,16 @@ def main():
     if result is None or "metric" not in result:
         print(f"record_bench: no metric JSON in {path}", file=sys.stderr)
         return 1
+    if result.get("stale"):
+        # bench.py's outage fallback row (emit_stale_row): valid as the
+        # DRIVER's artifact, but it is a re-print of an old measurement —
+        # appending it to the history would stamp a fresh ts + this
+        # stage's name onto the global-best row, corrupting per-stage
+        # latest/best. Refuse, and fail the stage so the ladder backs off.
+        print(f"record_bench: {stage} produced a STALE fallback row "
+              f"(source ts {result.get('stale_source_ts')}) — not "
+              f"recording; tunnel is down", file=sys.stderr)
+        return 1
     result["stage"] = stage
     result["ts"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")
@@ -60,6 +70,30 @@ def main():
     print(f"record_bench: {stage} → {result.get('metric')}="
           f"{result.get('value')} {result.get('unit')}")
     return 0
+
+
+# the ONE definition of "physically impossible" for best-row selection —
+# bench.py's outage fallback imports this module so the rule cannot drift
+IMPOSSIBLE_MFU = 0.95
+
+
+def row_is_valid(r: dict) -> bool:
+    """A history row eligible to be 'best' / a fallback source: not
+    marked suspect, not itself a stale fallback re-print, and not
+    faster than physics (mfu above the chip-peak threshold)."""
+    mfu = r.get("mfu")
+    return ("suspect" not in r and not r.get("stale")
+            and not (isinstance(mfu, (int, float)) and mfu > IMPOSSIBLE_MFU))
+
+
+def _lower_is_better(r: dict) -> bool:
+    """Metric direction for best-row selection: every current metric is
+    a throughput (higher wins), but latency-shaped metrics/units must
+    not pin their WORST run as best."""
+    m = str(r.get("metric", "")).lower()
+    u = str(r.get("unit", "")).lower()
+    return ("latency" in m or m.endswith("_ms") or m.endswith("_s")
+            or u in ("ms", "s", "us", "ms/step", "s/step", "ms/sentence"))
 
 
 def _write_self(root: str) -> None:
@@ -87,10 +121,10 @@ def _write_self(root: str) -> None:
                 v = float(r.get("value"))
             except (TypeError, ValueError):
                 continue
-            mfu = r.get("mfu")
-            impossible = isinstance(mfu, (int, float)) and mfu > 0.95
-            if "suspect" not in r and not impossible \
-                    and (k not in best or v > float(best[k]["value"])):
+            better = (v < float(best[k]["value"])
+                      if _lower_is_better(r) else
+                      v > float(best[k]["value"])) if k in best else True
+            if row_is_valid(r) and better:
                 best[k] = r
     rows = []
     for k, r in latest.items():

@@ -159,3 +159,34 @@ class TestDispatchWindow:
         Train(opts).run()
         st = TrainingState.load(str(tmp_path / "model.npz.progress.yml"))
         assert st.batches >= 6
+
+
+class TestLabelsLimitWindowCap:
+    """--after Nt (labels-counted) must cap the dispatch-window fill:
+    r4-advisor finding (window could overshoot a labels stop by K-1
+    updates) + r5 review (first window, before any per-update label
+    count is observed, must cap at ONE update)."""
+
+    def _sched(self, after):
+        from marian_tpu.common.options import Options
+        from marian_tpu.training.scheduler import Scheduler
+        from marian_tpu.training.training_state import TrainingState
+        opts = Options({"after": after, "disp-freq": "1000u",
+                        "learn-rate": 1e-3})
+        return Scheduler(opts, TrainingState())
+
+    def test_first_window_caps_at_one_update(self):
+        s = self._sched("300t")
+        assert s.updates_remaining() == 1
+
+    def test_estimate_tracks_max_labels_per_update(self):
+        s = self._sched("300t")
+        for _ in range(3):
+            s.update(0.0, labels=50, sentences=4)
+        # 150 labels consumed, 150 remain, max 50/update → 3 updates
+        assert s.updates_remaining() == 3
+
+    def test_no_labels_limit_returns_none(self):
+        s = self._sched("0e")
+        s.update(0.0, labels=50, sentences=4)
+        assert s.updates_remaining() is None

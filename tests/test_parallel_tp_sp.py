@@ -222,3 +222,26 @@ class TestGraftEntry:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         mod.dryrun_multichip(8)
+
+
+class TestFusedQKVGateOnTPMesh:
+    """r4-advisor medium finding: a plain ``--mesh model:N`` run (no
+    --sequence-parallel, so seq_mesh is None) must still see the 'model'
+    axis and NOT fuse Q/K/V — the runtime concat crosses the Megatron
+    column split and GSPMD would replicate the attention weights."""
+
+    def test_plain_tp_mesh_sets_n_model_tp(self):
+        from marian_tpu.models import transformer as TT
+        cfg = TT.config_from_options(_options(["model:2"]), VOCAB, VOCAB)
+        assert cfg.seq_mesh is None          # the advisor's exact case
+        assert cfg.n_model_tp == 2
+
+    def test_no_mesh_keeps_fusion_eligible(self):
+        from marian_tpu.models import transformer as TT
+        cfg = TT.config_from_options(_options(), VOCAB, VOCAB)
+        assert cfg.n_model_tp == 1
+
+    def test_data_only_mesh_keeps_fusion_eligible(self):
+        from marian_tpu.models import transformer as TT
+        cfg = TT.config_from_options(_options(["data:8"]), VOCAB, VOCAB)
+        assert cfg.n_model_tp == 1

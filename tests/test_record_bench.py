@@ -119,3 +119,29 @@ def test_rebuild_without_history_fails_loudly(repo):
          "--rebuild"], capture_output=True, text=True)
     assert r.returncode == 1
     assert "nothing to rebuild" in r.stderr
+
+
+def test_stale_fallback_row_refused(repo):
+    """bench.py's outage fallback (emit_stale_row) must NOT enter the
+    history: it is a re-print of an old measurement, and appending it
+    would stamp a fresh ts + this stage's name onto the global-best row
+    (corrupting per-stage latest/best). The nonzero rc also makes the
+    ladder treat the stage as failed and back off."""
+    r = _run_in(repo, "scan_on",
+                '{"metric": "m", "value": 43377.3, "unit": "u", '
+                '"stale": true, "stale_source_ts": "2026-07-31T05:13:57"}')
+    assert r.returncode == 1
+    assert "STALE" in r.stderr
+    assert not (repo / "BENCH_HISTORY.jsonl").exists()
+
+
+def test_lower_is_better_metrics_pin_min_as_best(repo):
+    _run_in(repo, "t", '{"metric": "decode_latency_ms", "value": 12.0, '
+                       '"unit": "ms/sentence"}')
+    _run_in(repo, "t", '{"metric": "decode_latency_ms", "value": 8.0, '
+                       '"unit": "ms/sentence"}')
+    _run_in(repo, "t", '{"metric": "decode_latency_ms", "value": 20.0, '
+                       '"unit": "ms/sentence"}')
+    (row,) = json.loads((repo / "BENCH_SELF.json").read_text())
+    assert row["value"] == 20.0               # latest
+    assert row["best_value"] == 8.0           # min, not max
