@@ -28,6 +28,14 @@ Env knobs:
                              self-attn KV cache; composes with INT8
   MARIAN_DECBENCH_BEAM       beam size (default 6; 1 = greedy — the
                              production student serving config)
+  MARIAN_DECBENCH_BATCH      sentences per batch (default 64). The
+                             weight-bound decode regime lives at small
+                             row counts (batch×beam rows ≲ 64, where
+                             DECODE_ROOFLINE predicts int8/shortlist
+                             pay); batch 64 × beam 6 = 384 rows is
+                             compute/cache-bound and measured those
+                             levers FLAT — this knob reaches the
+                             regime they were designed for
   MARIAN_DECBENCH_PROFILE    directory → jax.profiler trace of the
                              timed window
 """
@@ -70,6 +78,13 @@ def main():
         dims = dict(emb=64, ffn=128, heads=4, depth=2, vocab=512)
         batch, src_len, max_len = 8, 12, 16
         n_sents = min(n_sents, 32)
+    batch_env = os.environ.get("MARIAN_DECBENCH_BATCH")
+    if batch_env:
+        try:
+            batch = max(1, int(batch_env))
+        except ValueError:
+            print(f"bench_decode: bad MARIAN_DECBENCH_BATCH={batch_env!r}"
+                  f" — keeping {batch}", file=sys.stderr, flush=True)
 
     # MARIAN_DECBENCH_SSRU=1: the reference's production fast-decode
     # decoder (--transformer-decoder-autoreg rnn --dec-cell ssru, the
@@ -200,6 +215,8 @@ def main():
         "vs_baseline": None,
         "chip": jax.devices()[0].device_kind,
         "preset": preset,
+        "batch": batch,
+        "beam": beam,
     }))
 
 

@@ -66,7 +66,8 @@ commit_artifacts() {  # $1 = message
     # every pre-profile stage commit in the first dry-run)
     local f
     for f in BENCH_SELF.json BENCH_HISTORY.jsonl BENCH_PARTIAL.json \
-             docs/tpu_profile_r03.txt docs/tpu_profile_r04.txt; do
+             docs/tpu_profile_r03.txt docs/tpu_profile_r04.txt \
+             docs/tpu_profile_r05.txt docs/decode_profile_r05.txt; do
         [ -e "$f" ] && git add "$f"
     done
     git diff --cached --quiet || git commit -q -m "$1"
@@ -187,6 +188,49 @@ ladder() {
                                 MARIAN_DECBENCH_SSRU=1 \
                                 MARIAN_DECBENCH_BEAM=1
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    # weight-bound regime (VERDICT r4 missing #4): DECODE_ROOFLINE
+    # predicts int8 2.67×/1.97× at 8-64 rows, but the only silicon
+    # measurement was 384 rows (batch 64 × beam 6) where everything is
+    # flat. batch 8 × beam 6 = 48 rows, batch 8 × beam 1 = 8 rows —
+    # the operating points config #5 (int8+shortlist student serving)
+    # was designed for. Validates or falsifies the roofline's wins side.
+    stage_decode decode_float_b8   MARIAN_DECBENCH_PRESET=$PRESET \
+                                   MARIAN_DECBENCH_BATCH=8
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    stage_decode decode_int8_b8    MARIAN_DECBENCH_PRESET=$PRESET \
+                                   MARIAN_DECBENCH_BATCH=8 \
+                                   MARIAN_DECBENCH_INT8=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    stage_decode decode_int8_sl_b8 MARIAN_DECBENCH_PRESET=$PRESET \
+                                   MARIAN_DECBENCH_BATCH=8 \
+                                   MARIAN_DECBENCH_INT8=1 \
+                                   MARIAN_DECBENCH_SHORTLIST=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    stage_decode decode_float_g8   MARIAN_DECBENCH_PRESET=$PRESET \
+                                   MARIAN_DECBENCH_BATCH=8 \
+                                   MARIAN_DECBENCH_BEAM=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    stage_decode decode_int8_g8    MARIAN_DECBENCH_PRESET=$PRESET \
+                                   MARIAN_DECBENCH_BATCH=8 \
+                                   MARIAN_DECBENCH_BEAM=1 \
+                                   MARIAN_DECBENCH_INT8=1
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    # decode trace (VERDICT r4 next-step #2): where the unattributed
+    # ~8 ms/step of the standard beam-6 step actually sits. Committed as
+    # a text artifact like the train trace.
+    local dtmp=/tmp/decode_trace_$$ dsum=/tmp/decode_trace_summary_$$
+    if MARIAN_DECBENCH_PRESET=$PRESET MARIAN_DECBENCH_PROFILE=$dtmp \
+            timeout 3600 python bench_decode.py \
+            >/tmp/prof_decode.json 2>/tmp/prof_decode.err; then
+        if python -m marian_tpu.cli.profile_summary "$dtmp" 40 --by-source \
+                >"$dsum" && [ -s "$dsum" ]; then
+            mkdir -p docs
+            mv "$dsum" docs/decode_profile_r05.txt
+            commit_artifacts "bench: decode trace summary (beam-6 by-source)"
+        else
+            echo "decode profile summary failed — trace left in $dtmp"
+        fi
+    fi
     # 3/4 — train A/Bs (cache already warm for the base shapes). Every
     # A/B leg pins the cheap historical baseline config (2 buckets, no
     # dispatch window) so its lever stays the ONLY variable vs `train`;
@@ -248,10 +292,10 @@ ladder() {
     if MARIAN_BENCH_PRESET=$PRESET MARIAN_BENCH_PROFILE=$ptmp \
             timeout 3600 python bench.py \
             >/tmp/prof_bench.json 2>/tmp/prof_bench.err; then
-        if python -m marian_tpu.cli.profile_summary "$ptmp" 40 >"$psum" \
-                && [ -s "$psum" ]; then
+        if python -m marian_tpu.cli.profile_summary "$ptmp" 40 --by-source \
+                >"$psum" && [ -s "$psum" ]; then
             mkdir -p docs
-            mv "$psum" docs/tpu_profile_r04.txt
+            mv "$psum" docs/tpu_profile_r05.txt
             commit_artifacts "bench: TPU profile trace summary (top ops)"
         else
             echo "profile summary failed — trace left in $ptmp"
