@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..layers import initializers as inits
-from ..ops.ops import dropout as _dropout, layer_norm
+from ..ops.ops import dropout as _dropout, layer_norm, logits_matmul
 from ..ops import rnn as R
 from .transformer import cast_params  # same flat-dict convention
 
@@ -397,16 +397,15 @@ def _output_logits(cfg: S2SConfig, params: Params, state: jax.Array,
         # shortlist lives in WORD space, so it applies inside
         # factored_log_probs, never to the unit-space w/b
         from ..layers.logits import factored_log_probs
-        units = jnp.dot(t, w.astype(t.dtype),
-                        preferred_element_type=jnp.float32)
-        units = units.astype(jnp.float32) + b.astype(jnp.float32)
+        units = logits_matmul(t, w.astype(t.dtype))
+        units = units + b.astype(jnp.float32)
         return factored_log_probs(units, cfg.trg_factors, shortlist,
                                   cfg.factor_weight)
     if shortlist is not None:
         w = w[:, shortlist]
         b = b[:, shortlist]
-    y = jnp.dot(t, w.astype(t.dtype), preferred_element_type=jnp.float32)
-    return y.astype(jnp.float32) + b.astype(jnp.float32)
+    y = logits_matmul(t, w.astype(t.dtype))
+    return y + b.astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

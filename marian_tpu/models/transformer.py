@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from ..layers import initializers as inits
-from ..ops.ops import (activation, affine, dropout, layer_norm)
+from ..ops.ops import (activation, affine, dropout, layer_norm,
+                       logits_matmul)
 from ..ops.attention import (attention, causal_mask,
                              dense_attention_with_weights)
 
@@ -1530,16 +1531,15 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
         if cfg.lemma_dim_emb > 0:
             units = _lemma_conditioned_units(cfg, params, x, w, b)
         else:
-            units = jnp.dot(x, w.astype(x.dtype),
-                            preferred_element_type=jnp.float32)
-            units = units.astype(jnp.float32) + b.astype(jnp.float32)
+            units = logits_matmul(x, w.astype(x.dtype))
+            units = units + b.astype(jnp.float32)
         return factored_log_probs(units, cfg.trg_factors, shortlist,
                                       cfg.factor_weight)
     if shortlist is not None:
         w = w[:, shortlist]
         b = b[:, shortlist]
-    y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
-    return y.astype(jnp.float32) + b.astype(jnp.float32)
+    y = logits_matmul(x, w.astype(x.dtype))
+    return y + b.astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

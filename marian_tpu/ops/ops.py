@@ -84,6 +84,49 @@ def activation(name: str):
         raise ValueError(f"Unknown activation '{name}'") from None
 
 
+@jax.custom_vjp
+def logits_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """x @ w emitting f32 (softmax/CE wants f32 logits) whose BACKWARD
+    GEMMs run at the bf16 MXU rate.
+
+    Without this, the [.., V] f32 logits cotangent forces the two VJP
+    transpose dots (dx and dW — the largest GEMMs in the whole step, V
+    = 32k wide) to run as f32xf32 matmuls: ~1/4 the MXU rate on v5e.
+    The r5 HLO audit (scripts/audit_backward_dots.py) measured exactly
+    two f32xf32 dots at 10.4% of step FLOPs ≈ ~30% of ideal step time —
+    the bulk of VERDICT r4's "backward GEMMs at ~20% of roofline".
+
+    The fix: round the cotangent to the compute dtype (bf16) once,
+    then both backward dots are bf16xbf16 with f32 MXU accumulation.
+    One extra rounding of the gradient signal (~2^-9 relative) against
+    a 4x throughput win on the step's biggest GEMMs — the standard
+    mixed-precision discipline (grads round through bf16 anyway
+    wherever they cross a cast_params boundary).
+
+    x: [.., d] compute dtype; w: [d, V] compute dtype. Out: [.., V] f32.
+    """
+    return jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _logits_matmul_fwd(x, w):
+    return logits_matmul(x, w), (x, w)
+
+
+def _logits_matmul_bwd(res, g):
+    x, w = res
+    g16 = g.astype(x.dtype)
+    dx = jax.lax.dot_general(g16, w, (((g16.ndim - 1,), (1,)), ((), ())),
+                             preferred_element_type=x.dtype)
+    lead = tuple(range(x.ndim - 1))
+    dw = jax.lax.dot_general(x, g16, ((lead, lead), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return dx, dw.astype(w.dtype)
+
+
+logits_matmul.defvjp(_logits_matmul_fwd, _logits_matmul_bwd)
+
+
 def affine(x: jax.Array, w, b: Optional[jax.Array] = None) -> jax.Array:
     """x @ w + b (reference: gpu::Affine / cublasLt fused bias). XLA fuses the
     bias add; weights stored [in, out] like Marian. Quantized (QTensor)

@@ -278,20 +278,39 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         scores = top_scores
 
         # reorder each scorer's KV caches: rows are b*k, new row j takes
-        # old row (batch*k + beam_idx). The gather is written BATCH-LOCAL
-        # — reshape [.., B*K, ..] → [.., B, K, ..] and take_along_axis on
-        # the beam axis — so GSPMD partitions it along B under the decode
-        # mesh; the flat v[b*k+idx] form is an opaque cross-row gather
-        # that all-gathered the ENTIRE cache every step (~600 MB/step at
-        # transformer-big scale; test_mesh_decode_is_collective_free).
+        # old row (batch*k + beam_idx). Written as a one-hot [B,K,K]
+        # batched MATMUL, not a gather: the r5 decode trace measured the
+        # row-gather form at 15 gathers/step x 207us = 3.1ms of the
+        # 11.4ms step (~4x under HBM bandwidth — gathers on the tiled
+        # row dim take a slow path), while a batched GEMM streams the
+        # cache through the MXU at bandwidth. Bitwise-exact: each output
+        # row sums exactly one nonzero product (1.0 x value; f32 MXU
+        # accumulation rounds back to the input dtype losslessly).
+        # Batch-local (contracts only the beam axis), so GSPMD
+        # partitions it along B under the decode mesh — the flat
+        # v[b*k+idx] form instead all-gathered the ENTIRE cache every
+        # step (test_mesh_decode_is_collective_free pins this).
         carried = model.beam_carried_suffixes
 
         def beam_rows(v, axis):
             shape = v.shape
-            vr = v.reshape(shape[:axis] + (b, k) + shape[axis + 1:])
-            idx = beam_idx.reshape((1,) * axis + (b, k) +
-                                   (1,) * (vr.ndim - axis - 2))
-            return jnp.take_along_axis(vr, idx, axis=axis + 1).reshape(shape)
+            if not jnp.issubdtype(v.dtype, jnp.floating):
+                # integer carried state (rare): batch-local gather —
+                # exactness of int matmuls is backend-dependent
+                vr = v.reshape(shape[:axis] + (b, k) + shape[axis + 1:])
+                idx = beam_idx.reshape((1,) * axis + (b, k) +
+                                       (1,) * (vr.ndim - axis - 2))
+                return jnp.take_along_axis(vr, idx,
+                                           axis=axis + 1).reshape(shape)
+            onehot = (beam_idx[:, :, None] ==
+                      jnp.arange(k)[None, None, :]).astype(v.dtype)
+            vr = v.reshape(shape[:axis] + (b, k, -1))
+            # HIGHEST: exact f32 on the MXU (default precision would
+            # truncate f32 operands to bf16, breaking the exactness
+            # claim above); bf16 inputs are native single-pass either way
+            out = jnp.einsum("bij,...bjf->...bif", onehot, vr,
+                             precision=jax.lax.Precision.HIGHEST)
+            return out.reshape(shape)
 
         def reorder_state(st):
             out = {}
