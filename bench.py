@@ -36,6 +36,9 @@ Env knobs:
   MARIAN_BENCH_FLASH    force --transformer-flash-attention on/off/auto
   MARIAN_BENCH_COMPACT  0 disables the uint16+lengths host→device
                         transfer (transfer_full A/B stage)
+  MARIAN_BENCH_GRAD_DTYPE  --gradient-dtype: float32 (default) |
+                        bfloat16 (bf16 backward grad writes + ZeRO-1
+                        collectives; g_bf16 A/B stage)
   MARIAN_BENCH_DISPATCH --dispatch-window: K full updates per jitted
                         dispatch (lax.scan over same-bucket batches) —
                         amortizes per-dispatch host/tunnel latency over
@@ -293,6 +296,7 @@ def main():
     fused_mode = os.environ.get("MARIAN_BENCH_FUSED", "tune")
 
     opt_dtype = os.environ.get("MARIAN_BENCH_OPT_DTYPE", "float32")
+    grad_dtype = os.environ.get("MARIAN_BENCH_GRAD_DTYPE", "float32")
     # uint16-token + row-length host→device transfer (default on; the
     # bench device sits behind a network tunnel in some deployments, so
     # per-step transfer bytes are a first-class lever — A/B with 0)
@@ -337,6 +341,7 @@ def main():
         "learn-rate": 2e-4, "lr-warmup": "8000", "lr-decay-inv-sqrt": ["8000"],
         "optimizer": "adam", "optimizer-params": [0.9, 0.98, 1e-9],
         "optimizer-state-dtype": opt_dtype,
+        "gradient-dtype": grad_dtype,
         "gradient-checkpointing": remat,
         "stacked-params": stacked,
         "clip-norm": 0.0, "exponential-smoothing": 1e-4,
@@ -588,6 +593,7 @@ def main():
         "fused_ce": fused_mode,
         "scan_layers": scan_env or "default",
         "opt_state_dtype": opt_dtype,
+        "grad_dtype": grad_dtype,
         "remat": remat,
         "stacked_params": stacked,
         "words_budget": words,

@@ -167,6 +167,7 @@ _TRAINING = [
     _f("dynamic-gradient-scaling", str, [], "FACTOR ['log']: scale outlier gradients down to FACTOR x the windowed average (log-)norm", "training", "*"),
     _f("gradient-norm-average-window", int, 100, "Window for the running gradient-norm average used by --dynamic-gradient-scaling", "training"),
     _f("optimizer-state-dtype", str, "float32", "Storage dtype for Adam's first moment: float32 | bfloat16 (halves m's HBM footprint and per-step traffic; math stays f32, v stays f32; beyond the reference)", "training"),
+    _f("gradient-dtype", str, "float32", "Dtype gradients are produced, reduce-scattered, and stored in until the optimizer's in-register f32 upcast: float32 | bfloat16 (halves backward gradient HBM writes and ZeRO-1 collective bytes — the analogue of Marian's fp16 gradient communication; requires matching bfloat16 compute --precision, otherwise ignored with a warning)", "training"),
     _f("async-save", bool, False, "Overlap checkpoint writes with training: device snapshots on the train thread, numpy+disk IO on a background worker (beyond the reference, whose Train::save blocks the update loop). Needs transient HBM headroom for one device copy of params+EMA+optimizer state at save time", "training"),
     _f("compact-transfer", bool, True, "Ship training batches as uint16 tokens + per-row lengths instead of int32 ids + float masks (~4x less host-to-device traffic per step; ids/masks are rebuilt inside the jitted step — beyond the reference)", "training"),
     _f("tensorboard", str, None, "Write train/valid scalars (cost, words/s, learn rate, validation metrics) as TensorBoard events to this directory (beyond the reference, which logs text only)", "training", "?"),

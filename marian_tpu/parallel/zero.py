@@ -121,11 +121,20 @@ class _GradMachinery:
     two paths fold dropout keys and reduce gradients identically."""
 
     def __init__(self, model, mesh: Mesh, params: Params, delay: int = 1,
-                 frozen=(), dim_emb: int = 0, force_gspmd: bool = False):
+                 frozen=(), dim_emb: int = 0, force_gspmd: bool = False,
+                 grad_dtype=None):
         """``force_gspmd`` routes even pure-DP meshes through the GSPMD
         annotation path — test hook so the two gradient paths can be
         compared head-to-head on the same mesh
-        (tests/test_distributed.py::test_manual_and_gspmd_paths_agree)."""
+        (tests/test_distributed.py::test_manual_and_gspmd_paths_agree).
+
+        ``grad_dtype`` (--gradient-dtype): dtype gradients are produced,
+        reduce-scattered, and stored in until the optimizer's f32 upcast
+        (apply_update reads g.astype(f32) in-register). bfloat16 halves
+        the backward pass's gradient HBM writes and the ZeRO-1 collective
+        bytes — the analogue of Marian's fp16 gradient communication
+        (SURVEY: NCCLCommunicator fp16 path); the update math itself
+        stays f32. None/float32 = exact current behavior."""
         self.mesh = mesh
         self.delay = delay
         self.n_data = mesh.shape["data"]
@@ -144,6 +153,19 @@ class _GradMachinery:
             for k, shape in self._shapes.items()}
         self.frozen_set = frozenset(frozen)
         self.model = model
+        gd = None if grad_dtype in (None, "float32") else jnp.dtype(grad_dtype)
+        if gd is not None and gd == jnp.dtype(jnp.float32):
+            gd = None
+        cd = getattr(getattr(model, "cfg", None), "compute_dtype", None)
+        if gd is not None and cd is not None and jnp.dtype(cd) != gd:
+            # pre-casting params to grad_dtype would silently change the
+            # COMPUTE dtype too (model.loss's cast becomes identity) —
+            # refuse rather than corrupt f32-precision training
+            from ..common import logging as log
+            log.warn("--gradient-dtype {} ignored: compute precision is "
+                     "{} (set --precision accordingly)", gd, jnp.dtype(cd))
+            gd = None
+        self.grad_dtype = gd
 
     def grads(self, p, batch, rng):
         """(grads, ce_sum, labels) — grads globally reduced and ZeRO-1
@@ -164,6 +186,18 @@ class _GradMachinery:
             for k, shape in self._shapes.items()}
 
     def _grads_of(self, p, b, rng):
+        if self.grad_dtype is not None:
+            # differentiate wrt the ALREADY-cast params: model.loss's
+            # internal cast_params is then an identity, so the cotangents
+            # come out in grad_dtype directly — the backward dots WRITE
+            # bf16 (half the HBM bytes) instead of writing f32 through
+            # the cast boundary's convert
+            from ..ops.quantization import QTensor
+            p = {k: (v.astype(self.grad_dtype)
+                     if not isinstance(v, QTensor)
+                     and jnp.issubdtype(v.dtype, jnp.floating) else v)
+                 for k, v in p.items()}
+
         def loss_fn(pp, bb, r):
             return self.model.loss(pp, bb, r, train=True)
         (_, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(p, b, rng)
@@ -308,7 +342,7 @@ class _GradMachinery:
 
 
 def build_grad_fn(model, mesh: Mesh, params: Params, frozen=(),
-                  dim_emb: int = 0):
+                  dim_emb: int = 0, grad_dtype=None):
     """Jitted (params, batch, rng) → (grads, aux) for the heterogeneous-
     delay host loop (GraphGroup._grad_fn): the SAME gradient machinery as
     the fused step — per-device backward, explicit scatter-reduce, matching
@@ -316,7 +350,7 @@ def build_grad_fn(model, mesh: Mesh, params: Params, frozen=(),
     numerically interchangeable. Gradients come out ZeRO-1 sharded, ready
     for the sharded update tail."""
     m = _GradMachinery(model, mesh, params, delay=1, frozen=frozen,
-                       dim_emb=dim_emb)
+                       dim_emb=dim_emb, grad_dtype=grad_dtype)
 
     def grad_step(p, batch, rng):
         batch = expand_compact_batch(batch)
@@ -330,7 +364,7 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                      mesh: Mesh, params: Params, opt_state,
                      delay: int = 1, donate: bool = True, shardings=None,
                      frozen=(), force_gspmd: bool = False,
-                     n_updates: int = 1):
+                     n_updates: int = 1, grad_dtype=None):
     """Returns a jitted fn(params, opt_state, batch, step) →
     (params, opt_state, metrics) with SyncGraphGroup semantics.
 
@@ -362,7 +396,8 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
                          "--optimizer-delay accumulation only via the "
                          "host loop; use one or the other")
     machinery = _GradMachinery(model, mesh, params, delay=delay,
-                               frozen=frozen, force_gspmd=force_gspmd)
+                               frozen=frozen, force_gspmd=force_gspmd,
+                               grad_dtype=grad_dtype)
     g_specs = machinery.g_specs
 
     def one_update(p, opt_state, batch, step, rng):

@@ -210,11 +210,13 @@ class GraphGroup:
 
         # fused single-batch step (the hot path; delay==1)
         frozen = self._frozen_names()
+        grad_dtype = self.options.get("gradient-dtype", "float32")
         self._fused = build_train_step(model, opt_cfg, schedule,
                                        self.cost_type, mesh, self.params,
                                        self.opt_state, delay=1,
                                        donate=self._donate,
-                                       shardings=(p_sh, o_sh), frozen=frozen)
+                                       shardings=(p_sh, o_sh), frozen=frozen,
+                                       grad_dtype=grad_dtype)
         self._fused_delay = None
         # K updates per dispatch (build_train_step n_updates>1) — built
         # LAZILY on the first update_window call so paths that never fill
@@ -223,7 +225,8 @@ class GraphGroup:
         self._window_build = lambda: build_train_step(
             model, opt_cfg, schedule, self.cost_type, mesh,
             self.params, self.opt_state, delay=1, donate=self._donate,
-            shardings=(p_sh, o_sh), frozen=frozen, n_updates=self.window)
+            shardings=(p_sh, o_sh), frozen=frozen, n_updates=self.window,
+            grad_dtype=grad_dtype)
         if self.delay > 1:
             # in-jit micro-batch accumulation (one dispatch, one gradient
             # accumulator in HBM) for the common case of shape-uniform
@@ -231,7 +234,8 @@ class GraphGroup:
             self._fused_delay = build_train_step(
                 model, opt_cfg, schedule, self.cost_type, mesh,
                 self.params, self.opt_state, delay=self.delay,
-                donate=self._donate, shardings=(p_sh, o_sh), frozen=frozen)
+                donate=self._donate, shardings=(p_sh, o_sh), frozen=frozen,
+                grad_dtype=grad_dtype)
 
         # split path for --optimizer-delay with heterogeneous batch shapes.
         # Batches arrive committed via M.shard_batch (per-leaf name-aware
@@ -242,7 +246,7 @@ class GraphGroup:
         # sharded for the sharded update tail.
         from ..parallel.zero import build_grad_fn
         self._grad_fn = build_grad_fn(model, mesh, self.params,
-                                      frozen=frozen)
+                                      frozen=frozen, grad_dtype=grad_dtype)
 
         def update_step(p, opt_state, grads, step, labels, n_sents):
             if self.cost_type in ("ce-mean-words", "perplexity"):
@@ -333,8 +337,17 @@ class GraphGroup:
             # carry trg_tok/trg_len instead of trg_ids/trg_mask)
             trg = b["trg_ids"] if "trg_ids" in b else b["trg_tok"]
             n_sents += int(trg.shape[0])
-            grads_acc = grads if grads_acc is None else \
-                jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+            # f32 accumulation regardless of --gradient-dtype: the in-jit
+            # delay paths accumulate into explicit f32 accumulators, and
+            # the two delay paths must stay numerically interchangeable
+            # (bf16 adds would absorb late micro-batches' small terms)
+            grads_acc = (
+                jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
+                if grads_acc is None else
+                jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32),
+                    grads_acc, grads))
         self.params, self.opt_state, gnorm, _lr = self._update_fn(
             self.params, self.opt_state, grads_acc, np.float32(step),
             jnp.asarray(total_labels, jnp.float32),

@@ -25,6 +25,7 @@ shortlist coordinates, tokens mapped back through the per-batch index set).
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -291,25 +292,55 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         # v[b*k+idx] form instead all-gathered the ENTIRE cache every
         # step (test_mesh_decode_is_collective_free pins this).
         carried = model.beam_carried_suffixes
+        # A/B hook for the silicon ladder (r5): "onehot" (default),
+        # "take" (batch-local take_along_axis), "gather" (flat row
+        # gather — fastest measured single-device form but opaque to
+        # GSPMD: it all-gathers the cache under a decode mesh, so it is
+        # only selectable, never the mesh default). Measured beam-6
+        # transformer-big sent/s on v5e: gather 87.7, onehot 67.9
+        # (with f32-HIGHEST precision), take 53.5.
+        reorder_impl = os.environ.get("MARIAN_BEAM_REORDER", "auto")
 
         def beam_rows(v, axis):
             shape = v.shape
-            if not jnp.issubdtype(v.dtype, jnp.floating):
-                # integer carried state (rare): batch-local gather —
-                # exactness of int matmuls is backend-dependent
+
+            def take():
                 vr = v.reshape(shape[:axis] + (b, k) + shape[axis + 1:])
                 idx = beam_idx.reshape((1,) * axis + (b, k) +
                                        (1,) * (vr.ndim - axis - 2))
                 return jnp.take_along_axis(vr, idx,
                                            axis=axis + 1).reshape(shape)
+
+            if not jnp.issubdtype(v.dtype, jnp.floating):
+                # integer carried state (rare): batch-local gather —
+                # exactness of int matmuls is backend-dependent
+                return take()
+            if reorder_impl == "take":
+                return take()
+            if reorder_impl == "gather":
+                if mesh is None:
+                    flat_src = (jnp.arange(b)[:, None] * k
+                                + beam_idx).reshape(-1)
+                    return v[:, flat_src] if axis == 1 else v[flat_src]
+                from ..common.logging import log
+                log.warn("MARIAN_BEAM_REORDER=gather is single-device "
+                         "only (the flat gather all-gathers the cache "
+                         "under a decode mesh) — using onehot")
             onehot = (beam_idx[:, :, None] ==
                       jnp.arange(k)[None, None, :]).astype(v.dtype)
             vr = v.reshape(shape[:axis] + (b, k, -1))
-            # HIGHEST: exact f32 on the MXU (default precision would
-            # truncate f32 operands to bf16, breaking the exactness
-            # claim above); bf16 inputs are native single-pass either way
+            # one-hot matmul: exact (one nonzero 1.0 term per output,
+            # f32 MXU accumulation) and GSPMD-partitionable along B.
+            # bf16 runs native single-pass at DEFAULT precision (exact
+            # for one-hot); f32 needs HIGHEST — default would truncate
+            # the operands to bf16 — at the cost of an upcast pass,
+            # which is also why bf16 must NOT use HIGHEST (it upcasts
+            # the whole cache stream).
+            prec = (jax.lax.Precision.HIGHEST
+                    if v.dtype == jnp.float32 else
+                    jax.lax.Precision.DEFAULT)
             out = jnp.einsum("bij,...bjf->...bif", onehot, vr,
-                             precision=jax.lax.Precision.HIGHEST)
+                             precision=prec)
             return out.reshape(shape)
 
         def reorder_state(st):

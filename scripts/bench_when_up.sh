@@ -250,6 +250,11 @@ ladder() {
     stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_OPT_DTYPE=bfloat16
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    # --gradient-dtype bfloat16: backward writes + ZeRO collective bytes
+    # halve; update math stays f32 (r5 flag)
+    stage g_bf16     5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
+                          MARIAN_BENCH_GRAD_DTYPE=bfloat16
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # compact host→device transfer OFF (default is on): isolates how much
     # of the step the tunnel's per-batch id/mask bytes cost
     stage transfer_full 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \

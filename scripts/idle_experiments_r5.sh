@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# One-shot round-5 idle-window experiment queue: waits until the bench
+# ladder finishes its pass (lock still held by the sleeping loop, so we
+# watch for the post-pass sleep by polling the log tail), then runs the
+# chip experiments back-to-back and commits artifacts.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/ladder_r05b.log}
+
+# wait until the ladder's last stage (buckets_full) has recorded or the
+# ladder died; poll every 2 min, give up after 3h
+for i in $(seq 1 90); do
+    if ! pgrep -f bench_when_up >/dev/null; then break; fi
+    if grep -q "record_bench: buckets_full" "$LOG" 2>/dev/null; then break; fi
+    sleep 120
+done
+
+OUT=/tmp/idle_r5
+mkdir -p "$OUT"
+
+# 1. decode beam-reorder A/B on silicon (warm cache; ~2-3 min each)
+for impl in gather onehot take; do
+    MARIAN_BEAM_REORDER=$impl MARIAN_DECBENCH_PRESET=big \
+        timeout 2400 python bench_decode.py \
+        >"$OUT/reorder_$impl.json" 2>"$OUT/reorder_$impl.err" \
+        && echo "reorder $impl: $(cat "$OUT/reorder_$impl.json")"
+done
+
+# 2. quality probe at transformer-base dims on the chip
+MARIAN_QPROBE_PRESET=base MARIAN_QPROBE_UPDATES=2000 \
+    MARIAN_QPROBE_RECORD=1 \
+    timeout 5400 python scripts/quality_probe.py \
+    >"$OUT/qprobe.json" 2>"$OUT/qprobe.err" \
+    && echo "qprobe: $(cat "$OUT/qprobe.json")"
+
+echo "idle experiments done: $OUT"
