@@ -279,69 +279,83 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
         scores = top_scores
 
         # reorder each scorer's KV caches: rows are b*k, new row j takes
-        # old row (batch*k + beam_idx). Written as a one-hot [B,K,K]
-        # batched MATMUL, not a gather: the r5 decode trace measured the
-        # row-gather form at 15 gathers/step x 207us = 3.1ms of the
-        # 11.4ms step (~4x under HBM bandwidth — gathers on the tiled
-        # row dim take a slow path), while a batched GEMM streams the
-        # cache through the MXU at bandwidth. Bitwise-exact: each output
-        # row sums exactly one nonzero product (1.0 x value; f32 MXU
-        # accumulation rounds back to the input dtype losslessly).
-        # Batch-local (contracts only the beam axis), so GSPMD
-        # partitions it along B under the decode mesh — the flat
-        # v[b*k+idx] form instead all-gathered the ENTIRE cache every
-        # step (test_mesh_decode_is_collective_free pins this).
+        # old row (batch*k + beam_idx). Implementations A/B'd on silicon
+        # (r5, beam-6 transformer-big sent/s on v5e): flat LEADING-row
+        # gather 88.5 — the only gather form the tiled cache layout runs
+        # at bandwidth — vs one-hot matmul 61.6 (even unflattened, the
+        # tiny-contraction dot relayouts the cache) vs take_along_axis
+        # 46-53. The flat gather is opaque to GSPMD (it all-gathers the
+        # whole cache per step under a decode mesh), so the mesh path
+        # runs the SAME flat gather per batch shard inside a manual
+        # 'data' shard_map — collective-free by construction
+        # (test_mesh_decode_is_collective_free pins it).
+        # MARIAN_BEAM_REORDER={gather,onehot,take} forces a form for
+        # A/Bs (gather = the GSPMD-opaque global form, only meaningful
+        # off-mesh).
         carried = model.beam_carried_suffixes
-        # A/B hook for the silicon ladder (r5): "onehot" (default),
-        # "take" (batch-local take_along_axis), "gather" (flat row
-        # gather — fastest measured single-device form but opaque to
-        # GSPMD: it all-gathers the cache under a decode mesh, so it is
-        # only selectable, never the mesh default). Measured beam-6
-        # transformer-big sent/s on v5e: gather 87.7, onehot 67.9
-        # (with f32-HIGHEST precision), take 53.5.
         reorder_impl = os.environ.get("MARIAN_BEAM_REORDER", "auto")
 
         def beam_rows(v, axis):
             shape = v.shape
 
+            def split_rows():
+                # [.., B*K, ..] -> [.., B, K, ..]: single-dim split,
+                # layout-free (tiling lives on the last two dims)
+                return v.reshape(shape[:axis] + (b, k) + shape[axis + 1:])
+
             def take():
-                vr = v.reshape(shape[:axis] + (b, k) + shape[axis + 1:])
                 idx = beam_idx.reshape((1,) * axis + (b, k) +
-                                       (1,) * (vr.ndim - axis - 2))
-                return jnp.take_along_axis(vr, idx,
+                                       (1,) * (v.ndim - axis - 1))
+                return jnp.take_along_axis(split_rows(), idx,
                                            axis=axis + 1).reshape(shape)
 
-            if not jnp.issubdtype(v.dtype, jnp.floating):
-                # integer carried state (rare): batch-local gather —
-                # exactness of int matmuls is backend-dependent
+            if reorder_impl == "take" or (
+                    reorder_impl == "onehot"
+                    and not jnp.issubdtype(v.dtype, jnp.floating)):
+                # take also covers integer carried state under the onehot
+                # override: int x int einsum exactness is backend-
+                # dependent; the gather forms are dtype-agnostic
                 return take()
-            if reorder_impl == "take":
-                return take()
-            if reorder_impl == "gather":
-                if mesh is None:
-                    flat_src = (jnp.arange(b)[:, None] * k
-                                + beam_idx).reshape(-1)
-                    return v[:, flat_src] if axis == 1 else v[flat_src]
-                from ..common.logging import log
-                log.warn("MARIAN_BEAM_REORDER=gather is single-device "
-                         "only (the flat gather all-gathers the cache "
-                         "under a decode mesh) — using onehot")
-            onehot = (beam_idx[:, :, None] ==
-                      jnp.arange(k)[None, None, :]).astype(v.dtype)
-            vr = v.reshape(shape[:axis] + (b, k, -1))
-            # one-hot matmul: exact (one nonzero 1.0 term per output,
-            # f32 MXU accumulation) and GSPMD-partitionable along B.
-            # bf16 runs native single-pass at DEFAULT precision (exact
-            # for one-hot); f32 needs HIGHEST — default would truncate
-            # the operands to bf16 — at the cost of an upcast pass,
-            # which is also why bf16 must NOT use HIGHEST (it upcasts
-            # the whole cache stream).
-            prec = (jax.lax.Precision.HIGHEST
-                    if v.dtype == jnp.float32 else
-                    jax.lax.Precision.DEFAULT)
-            out = jnp.einsum("bij,...bjf->...bif", onehot, vr,
-                             precision=prec)
-            return out.reshape(shape)
+
+            def flat_gather(vv, idx):
+                # rows (axis 0 or 1) indexed by a flat [rows] vector —
+                # the ONLY gather form the tiled cache layout runs at
+                # bandwidth (leading-row gather)
+                bl = idx.shape[0]
+                fs = (jnp.arange(bl)[:, None] * k + idx).reshape(-1)
+                return vv[:, fs] if axis == 1 else vv[fs]
+
+            if reorder_impl == "gather" or (mesh is None
+                                            and reorder_impl != "onehot"):
+                return flat_gather(v, beam_idx)
+            if reorder_impl == "onehot":
+                # one-hot matmul: exact (single 1.0 term per output, f32
+                # MXU accumulation), partitionable — kept as an A/B
+                # alternative; the shard_map gather below measured faster
+                prec = (jax.lax.Precision.HIGHEST
+                        if v.dtype == jnp.float32 else
+                        jax.lax.Precision.DEFAULT)
+                onehot = (beam_idx[:, :, None] ==
+                          jnp.arange(k)[None, None, :]).astype(v.dtype)
+                eq = "bij,bj...->bi..." if axis == 0 else "bij,lbj...->lbi..."
+                return jnp.einsum(eq, onehot, split_rows(),
+                                  precision=prec).reshape(shape)
+            # decode mesh: the SAME fast flat gather, run PER BATCH SHARD
+            # under a manual 'data' shard_map — beam_idx is batch-local
+            # (source-beam index within each sentence's own beam), so the
+            # local gather touches only local rows: collective-free by
+            # construction (test_mesh_decode_is_collective_free), at the
+            # single-device gather's measured speed per shard. Left to
+            # GSPMD, the flat global gather all-gathers the entire cache
+            # every step instead.
+            from ..parallel.mesh import compat_shard_map
+            row_axis_spec = ["data" if d == axis else None
+                             for d in range(v.ndim)]
+            spec_v = P(*row_axis_spec)
+            return compat_shard_map(
+                lambda vv, idx: flat_gather(vv, idx), mesh,
+                in_specs=(spec_v, P("data")),
+                out_specs=spec_v)(v, beam_idx)
 
         def reorder_state(st):
             out = {}
