@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# Round-5 combined-lever train experiments, health-gated: probe until the
+# tunnel answers AND a cheap canary bench run comes back with a sane
+# final_sync_s, then run the combined-config legs and record each.
+# Coexists with bench_when_up.sh (runs between its passes; the flock is
+# per-script). One-shot.
+set -u
+cd "$(dirname "$0")/.."
+export TPU_ACCELERATOR_TYPE="${TPU_ACCELERATOR_TYPE:-v5litepod-1}"
+
+healthy() {
+    # canary: cheapest pinned leg (2 buckets, K=1, warm cache); healthy =
+    # rc 0 and final_sync_s < 5
+    local out; out=$(mktemp)
+    MARIAN_BENCH_PRESET=big MARIAN_BENCH_BUCKETS=32,64 \
+        MARIAN_BENCH_DISPATCH=1 timeout 2400 python bench.py \
+        >"$out" 2>/dev/null || return 1
+    python - "$out" <<'PY'
+import json, sys
+row = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            pass
+sys.exit(0 if row and not row.get("stale")
+         and float(row.get("final_sync_s") or 99) < 5.0 else 1)
+PY
+}
+
+run_leg() {  # $1 = stage name, rest = env
+    local name="$1"; shift
+    local out; out=$(mktemp)
+    echo "== leg $name =="
+    if env "$@" timeout 5400 python bench.py >"$out" 2>"$out.err"; then
+        python scripts/record_bench.py "$name" "$out" || return 1
+        for f in BENCH_SELF.json BENCH_HISTORY.jsonl; do git add "$f"; done
+        git diff --cached --quiet || git commit -q -m "bench: $name (r5 combined-lever leg)"
+        # degradation guard between legs
+        python - "$out" <<'PY' || return 1
+import json, sys
+row = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            pass
+sys.exit(0 if row and float(row.get("final_sync_s") or 99) < 5.0 else 1)
+PY
+    else
+        echo "leg $name failed"
+        return 1
+    fi
+}
+
+for i in $(seq 1 40); do
+    if pgrep -f "python bench" >/dev/null; then
+        # the standing ladder owns the chip right now — don't contend
+        echo "$(date -u +%H:%M:%SZ) ladder active — next probe in 900s"
+        sleep 900
+        continue
+    fi
+    if healthy; then
+        echo "$(date -u +%H:%M:%SZ) tunnel healthy — running combined legs"
+        run_leg headline_gbf16 MARIAN_BENCH_PRESET=big \
+            MARIAN_BENCH_GRAD_DTYPE=bfloat16 || { sleep 900; continue; }
+        run_leg headline_gbf16_mbf16 MARIAN_BENCH_PRESET=big \
+            MARIAN_BENCH_GRAD_DTYPE=bfloat16 \
+            MARIAN_BENCH_OPT_DTYPE=bfloat16 || { sleep 900; continue; }
+        run_leg headline_w12k MARIAN_BENCH_PRESET=big \
+            MARIAN_BENCH_WORDS=12288 || { sleep 900; continue; }
+        echo "all legs done"
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%SZ) tunnel degraded/down — next probe in 900s"
+    sleep 900
+done
