@@ -158,8 +158,13 @@ ladder() {
     # headline config): `train` pins the historical 32,64/K=1 leg;
     # `headline` = bench.py defaults (full buckets + dispatch-window 8 —
     # the measured-best r4 config, what the driver's plain run records).
+    # train = the pinned HISTORICAL trend leg: 2 buckets, K=1, f32
+    # dtypes — bench DEFAULTS moved to bf16 grad/moment in r5, so the
+    # f32 pins keep this leg comparable across rounds
     stage train 5400 MARIAN_BENCH_PRESET=$PRESET \
                           MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=1 \
+                          MARIAN_BENCH_OPT_DTYPE=float32 \
+                          MARIAN_BENCH_GRAD_DTYPE=float32 \
                           || return 1
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage headline 7200 MARIAN_BENCH_PRESET=$PRESET
@@ -235,7 +240,11 @@ ladder() {
     # A/B leg pins the cheap historical baseline config (2 buckets, no
     # dispatch window) so its lever stays the ONLY variable vs `train`;
     # `headline` alone carries the combined best config.
-    local -a AB=(MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=1)
+    # every A/B leg pins the historical f32-dtype baseline so its lever
+    # stays the ONLY variable vs `train` (bench defaults are bf16 since r5)
+    local -a AB=(MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=1
+                 MARIAN_BENCH_OPT_DTYPE=float32
+                 MARIAN_BENCH_GRAD_DTYPE=float32)
     # scan-layers defaults OFF since r4 (the r4 A/B measured scan 25-33%
     # slower per step on v5e), so the A/B leg is now scan ON; stacked
     # storage structurally requires the scanned stack.
@@ -247,11 +256,12 @@ ladder() {
     stage words_16k  5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_WORDS=$WORDS_AB
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    # dtype legs: one lever each over the f32-pinned AB baseline (the
+    # combined bf16 pair is what bench DEFAULTS — and so `headline` —
+    # measure since r5)
     stage m_bf16     5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_OPT_DTYPE=bfloat16
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
-    # --gradient-dtype bfloat16: backward writes + ZeRO collective bytes
-    # halve; update math stays f32 (r5 flag)
     stage g_bf16     5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
                           MARIAN_BENCH_GRAD_DTYPE=bfloat16
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
