@@ -36,9 +36,15 @@ Env knobs:
   MARIAN_BENCH_FLASH    force --transformer-flash-attention on/off/auto
   MARIAN_BENCH_COMPACT  0 disables the uint16+lengths host→device
                         transfer (transfer_full A/B stage)
-  MARIAN_BENCH_GRAD_DTYPE  --gradient-dtype: float32 (default) |
-                        bfloat16 (bf16 backward grad writes + ZeRO-1
-                        collectives; g_bf16 A/B stage)
+  MARIAN_BENCH_GRAD_DTYPE  --gradient-dtype. DEFAULT bfloat16 (the
+                        bench measures the throughput config — bf16
+                        backward grad writes + ZeRO-1 collectives;
+                        rows carry grad_dtype provenance; the TRAINER
+                        default stays float32). Set float32 for the
+                        f32-pinned A/B legs
+  MARIAN_BENCH_OPT_DTYPE  --optimizer-state-dtype (Adam first moment).
+                        DEFAULT bfloat16 at the bench (trainer default
+                        float32); rows carry opt_state_dtype
   MARIAN_BENCH_DISPATCH --dispatch-window: K full updates per jitted
                         dispatch (lax.scan over same-bucket batches) —
                         amortizes per-dispatch host/tunnel latency over

@@ -273,11 +273,11 @@ ladder() {
     # --dispatch-window: K full updates per jitted dispatch. THE lever for
     # a dispatch-latency-bound chip (the r4 train row showed 19% MFU with
     # ~53ms ideal compute in a ~280ms step — tunnel dispatch suspected)
-    stage dispatch_8  5400 MARIAN_BENCH_PRESET=$PRESET \
-                          MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=8
+    stage dispatch_8  5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
+                          MARIAN_BENCH_DISPATCH=8
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
-    stage dispatch_32 5400 MARIAN_BENCH_PRESET=$PRESET \
-                          MARIAN_BENCH_BUCKETS=32,64 MARIAN_BENCH_DISPATCH=32
+    stage dispatch_32 5400 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
+                          MARIAN_BENCH_DISPATCH=32
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     # 32k tokens needs remat headroom; if it OOMs the stage fails
     # gracefully and the ladder continues
@@ -328,8 +328,8 @@ ladder() {
     # 6 — padding tax at the full bucket table (many cold compiles: last)
     # padding-tax A/B vs `train`: full table at K=1 (the combined
     # full+window config is the `headline` stage)
-    stage buckets_full 7200 MARIAN_BENCH_PRESET=$PRESET \
-                            MARIAN_BENCH_BUCKETS=full MARIAN_BENCH_DISPATCH=1
+    stage buckets_full 7200 MARIAN_BENCH_PRESET=$PRESET "${AB[@]}" \
+                            MARIAN_BENCH_BUCKETS=full
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     return 0
 }
