@@ -348,7 +348,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     kv_mask: Optional[jax.Array] = None,
                     causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """softmax(scale * Q K^T + mask) V, never materializing the score matrix.
 
@@ -362,6 +363,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         scale = 1.0 / (dh ** 0.5)
     if interpret is None:
         interpret = _interpret_default()
+    # block-size sweep knobs (r5 longseq tuning; read at trace time —
+    # defaults 256/512 are the shipped values)
+    import os as _os
+    if block_q is None:
+        block_q = int(_os.environ.get("MARIAN_FLASH_BLOCK_Q", 256) or 256)
+    if block_k is None:
+        block_k = int(_os.environ.get("MARIAN_FLASH_BLOCK_K", 512) or 512)
 
     bq = min(block_q, _round_up(tq, _LANES))
     bk = min(block_k, _round_up(tk, _LANES))

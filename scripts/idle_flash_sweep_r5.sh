@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Flash block-size sweep at seq 2048 (VERDICT r4 next-step #5): waits for
+# the current ladder pass to finish (buckets_full recorded in $1), then
+# runs the longseq_flash_noremat config at several (block_q, block_k)
+# pairs and records each. One-shot.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/ladder_r05d.log}
+export TPU_ACCELERATOR_TYPE="${TPU_ACCELERATOR_TYPE:-v5litepod-1}"
+
+for i in $(seq 1 120); do
+    grep -q "record_bench: buckets_full" "$LOG" 2>/dev/null && break
+    pgrep -f bench_when_up >/dev/null || break
+    sleep 120
+done
+# don't start while a bench run is still on the chip
+while pgrep -f "python bench" >/dev/null; do sleep 60; done
+
+run() {  # $1 stage, $2 bq, $3 bk
+    local out; out=$(mktemp)
+    echo "== flash sweep $1 (bq=$2 bk=$3) =="
+    if MARIAN_BENCH_PRESET=big MARIAN_BENCH_BUCKETS=32,64 \
+        MARIAN_BENCH_DISPATCH=1 MARIAN_BENCH_OPT_DTYPE=float32 \
+        MARIAN_BENCH_GRAD_DTYPE=float32 MARIAN_BENCH_SEQLEN=2048 \
+        MARIAN_BENCH_FUSED=on MARIAN_BENCH_FLASH=on \
+        MARIAN_FLASH_BLOCK_Q="$2" MARIAN_FLASH_BLOCK_K="$3" \
+        timeout 5400 python bench.py >"$out" 2>"$out.err"; then
+        python scripts/record_bench.py "$1" "$out" || return 1
+        git add BENCH_SELF.json BENCH_HISTORY.jsonl
+        git diff --cached --quiet || git commit -q -m "bench: $1 (flash block sweep)"
+        # stop the sweep on degradation
+        python - "$out" <<'PY' || return 1
+import json, sys
+row = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            pass
+sys.exit(0 if row and float(row.get("final_sync_s") or 99) < 5.0 else 1)
+PY
+    else
+        echo "leg $1 failed: $(tail -1 "$out.err" | head -c 200)"
+        return 1
+    fi
+}
+
+run lsq_flash_128_128 128 128 || exit 1
+run lsq_flash_512_512 512 512 || exit 1
+run lsq_flash_256_1024 256 1024 || exit 1
+run lsq_flash_512_2048 512 2048 || exit 1
+echo "flash sweep done"
