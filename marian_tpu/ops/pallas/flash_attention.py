@@ -363,16 +363,35 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         scale = 1.0 / (dh ** 0.5)
     if interpret is None:
         interpret = _interpret_default()
-    # block-size sweep knobs (r5 longseq tuning; read at trace time —
-    # defaults 256/512 are the shipped values)
+    # Default blocks 512/2048, from the r5 silicon sweep at seq 2048
+    # (tok/s: 128/128 5,441 · 256/512 13,625 · 512/512 15,373 ·
+    # 256/1024 15,929 · **512/2048 18,039** · 1024/2048 VMEM-OOM in the
+    # dq kernel at 19.09M vs the 16M scoped stack limit). Bigger k
+    # blocks cut online-softmax rescale passes; both clamp to the
+    # actual sequence below, so short-seq shapes are unaffected.
+    # MARIAN_FLASH_BLOCK_Q/K override at trace time for sweeps.
     import os as _os
     if block_q is None:
-        block_q = int(_os.environ.get("MARIAN_FLASH_BLOCK_Q", 256) or 256)
+        block_q = int(_os.environ.get("MARIAN_FLASH_BLOCK_Q", 512) or 512)
     if block_k is None:
-        block_k = int(_os.environ.get("MARIAN_FLASH_BLOCK_K", 512) or 512)
+        block_k = int(_os.environ.get("MARIAN_FLASH_BLOCK_K", 2048) or 2048)
 
-    bq = min(block_q, _round_up(tq, _LANES))
-    bk = min(block_k, _round_up(tk, _LANES))
+    def _pick_block(limit: int, t: int) -> int:
+        # biggest block <= limit whose grid padding wastes <= 25% of t:
+        # big blocks cut online-softmax rescale passes (the r5 sweep
+        # win), but a 2048 block on t=2176 would pad to 4096 and run
+        # the fully-masked blocks through every kernel — padded k/q
+        # blocks are NOT skipped (the causal `live` test is
+        # position-only)
+        b = _round_up(min(limit, _round_up(t, _LANES)), _LANES)
+        while b > _LANES:
+            if _round_up(t, b) - t <= max(t // 4, _LANES):
+                return b
+            b = (b // 2 // _LANES) * _LANES
+        return _LANES
+
+    bq = _pick_block(block_q, tq)
+    bk = _pick_block(block_k, tk)
     tq_p, tk_p = _round_up(tq, bq), _round_up(tk, bk)
 
     if kv_mask is None:
