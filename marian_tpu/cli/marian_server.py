@@ -1,4 +1,13 @@
-"""marian-server entry point (reference: src/command/marian_server.cpp)."""
+"""marian-server entry point (reference: src/command/marian_server.cpp).
+
+Serves the Marian WebSocket protocol (or the dependency-free TCP framing
+when ``websockets`` is unavailable) through the production serving
+subsystem: continuous token-budget batching (``--batch-token-budget``),
+admission control (``--max-queue``), per-request deadlines
+(``--request-timeout``), and Prometheus metrics / health endpoints
+(``--metrics-port``). SIGTERM/SIGINT drain gracefully. See docs/USAGE.md
+"Server" and docs/ARCHITECTURE.md "Serving".
+"""
 
 
 def main(argv=None):

@@ -70,7 +70,10 @@ def force_cpu_devices(n_devices: int):
     # XLA_FLAGS may already have been parsed by an earlier client creation;
     # the config state is the reliable knob (its validator only rejects
     # changes while backends are initialized, and we just cleared them).
-    if jax.config.jax_num_cpu_devices < want:
+    # Older jax (< 0.5) has no jax_num_cpu_devices config — there the
+    # XLA_FLAGS value set above is re-read at client creation, and the
+    # device-count check below still catches under-provisioning.
+    if getattr(jax.config, "jax_num_cpu_devices", want) < want:
         jax.config.update("jax_num_cpu_devices", want)
 
     devs = jax.devices()

@@ -235,4 +235,17 @@ class StepTimer:
             line = " ".join(f"{k}={v:.2f}s({100*v/total:.0f}%)"
                             for k, v in out.items())
             log.info("Step phases: {}", line)
+            # mirror the phase totals into the process-wide metrics
+            # registry (serving/metrics.py — ISSUE 1): with --metrics-port
+            # a Prometheus scrape sees where train-loop wall-clock goes
+            # (data vs dispatch vs host) without grepping logs
+            try:
+                from ..serving import metrics as msm
+                g = msm.gauge("marian_step_phase_seconds",
+                              "Host wall-clock per train-loop phase since "
+                              "the last report", labels=("phase",))
+                for k, v in out.items():
+                    g.labels(k).set(v)
+            except Exception:  # noqa: BLE001 — observability is optional
+                pass
         return out

@@ -50,6 +50,20 @@ def bucket_batch_size(n: int, multiple: int = 8) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def padded_batch_cost(n_rows: int, max_len: int,
+                      length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS,
+                      batch_multiple: int = 8) -> int:
+    """Device cost (padded tokens) of a batch of ``n_rows`` sentences whose
+    longest member has ``max_len`` tokens, under the bucketed static-shape
+    table. This is the ONE cost model shared by the training-side token
+    budget (_split_maxi flushes on ``rows * bucket_length``) and the serving
+    scheduler (serving/scheduler.py) — serve-time batches must land on the
+    same (rows, width) grid the jit cache was warmed on, or every odd batch
+    costs a fresh XLA compile."""
+    return (bucket_batch_size(n_rows, batch_multiple)
+            * bucket_length(max_len, length_buckets))
+
+
 @dataclasses.dataclass
 class SubBatch:
     """One stream of a batch (reference: SubBatch: indices + mask)."""

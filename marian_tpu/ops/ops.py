@@ -103,6 +103,13 @@ def logits_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
     mixed-precision discipline (grads round through bf16 anyway
     wherever they cross a cast_params boundary).
 
+    NOTE: this cotangent rounding follows the COMPUTE dtype (x.dtype)
+    and applies regardless of --gradient-dtype — with bf16 compute,
+    ``--gradient-dtype float32`` still sees the logits cotangent round
+    through bf16 here (the flag only controls the dtype gradients are
+    STORED/reduced in downstream). Documented in the --gradient-dtype
+    help and docs/PERFORMANCE.md.
+
     x: [.., d] compute dtype; w: [d, V] compute dtype. Out: [.., V] f32.
     """
     return jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())),

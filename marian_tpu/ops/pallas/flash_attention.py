@@ -47,6 +47,25 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _env_block(name: str, default: int) -> int:
+    """Parse a MARIAN_FLASH_BLOCK_* sweep override: positive int, or the
+    default with a warning on anything malformed."""
+    import os as _os
+    raw = _os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+        if v <= 0:
+            raise ValueError("must be positive")
+    except ValueError:
+        from ...common import logging as log
+        log.warn("{}={!r} is not a positive integer — using the default "
+                 "block size {}", name, raw, default)
+        return default
+    return v
+
+
 def _vmem(shape, dtype):
     if _HAS_PLTPU:
         return pltpu.VMEM(shape, dtype)
@@ -369,12 +388,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # dq kernel at 19.09M vs the 16M scoped stack limit). Bigger k
     # blocks cut online-softmax rescale passes; both clamp to the
     # actual sequence below, so short-seq shapes are unaffected.
-    # MARIAN_FLASH_BLOCK_Q/K override at trace time for sweeps.
-    import os as _os
+    # MARIAN_FLASH_BLOCK_Q/K override at trace time for sweeps; malformed
+    # values fall back to the defaults with a warning (this runs at TRACE
+    # time — an uncaught ValueError here would take down a whole training
+    # job over a typo'd sweep variable).
     if block_q is None:
-        block_q = int(_os.environ.get("MARIAN_FLASH_BLOCK_Q", 512) or 512)
+        block_q = _env_block("MARIAN_FLASH_BLOCK_Q", 512)
     if block_k is None:
-        block_k = int(_os.environ.get("MARIAN_FLASH_BLOCK_K", 2048) or 2048)
+        # dq-kernel VMEM scales with block_k x dh and the sweep validated
+        # 2048 only at dh=64 — the DEFAULT halves for larger heads so
+        # big-head configs don't hit the 1024/2048-style VMEM OOM
+        # (advisor finding). Explicit values (arg or a well-formed env
+        # override) are respected verbatim — a sweep's recorded block
+        # size must be the block size that actually ran.
+        default_k = 2048 if dh <= 64 else 1024
+        block_k = _env_block("MARIAN_FLASH_BLOCK_K", default_k)
 
     def _pick_block(limit: int, t: int) -> int:
         # biggest block <= limit whose grid padding wastes <= 25% of t:

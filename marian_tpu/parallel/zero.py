@@ -134,7 +134,10 @@ class _GradMachinery:
         the backward pass's gradient HBM writes and the ZeRO-1 collective
         bytes — the analogue of Marian's fp16 gradient communication
         (SURVEY: NCCLCommunicator fp16 path); the update math itself
-        stays f32. None/float32 = exact current behavior."""
+        stays f32. None/float32 keeps gradients f32 end to end EXCEPT
+        through the logits backward, which always rounds its cotangent to
+        the compute dtype (ops/ops.py logits_matmul — the bf16 MXU-rate
+        fix applies regardless of this setting; docs/PERFORMANCE.md)."""
         self.mesh = mesh
         self.delay = delay
         self.n_data = mesh.shape["data"]
@@ -157,7 +160,19 @@ class _GradMachinery:
         if gd is not None and gd == jnp.dtype(jnp.float32):
             gd = None
         cd = getattr(getattr(model, "cfg", None), "compute_dtype", None)
-        if gd is not None and cd is not None and jnp.dtype(cd) != gd:
+        if gd is not None and cd is None:
+            # FAIL CLOSED: without a determinable compute dtype the safety
+            # check below cannot run, and pre-casting params to grad_dtype
+            # could silently change the COMPUTE dtype of an f32-precision
+            # model (model.loss's cast becomes identity) — the one outcome
+            # this check exists to prevent
+            from ..common import logging as log
+            log.warn("--gradient-dtype {} ignored: the model's compute "
+                     "dtype could not be determined (no model.cfg."
+                     "compute_dtype) — failing closed to float32 gradients",
+                     gd)
+            gd = None
+        elif gd is not None and jnp.dtype(cd) != gd:
             # pre-casting params to grad_dtype would silently change the
             # COMPUTE dtype too (model.loss's cast becomes identity) —
             # refuse rather than corrupt f32-precision training

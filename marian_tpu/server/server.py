@@ -1,26 +1,39 @@
-"""marian-server: translation service on a WebSocket port (reference:
-src/command/marian_server.cpp + vendored simple-websocket-server).
+"""marian-server: translation service (reference: src/command/marian_server.cpp
++ vendored simple-websocket-server), fronted by the production serving
+subsystem (marian_tpu/serving/ — ISSUE 1).
 
 Protocol kept Marian-compatible: client sends newline-joined source
 sentences as a text frame, server replies with newline-joined translations.
-Uses the `websockets` package (gated — a clear error if unavailable).
+Transports:
 
-Beyond the reference: concurrent requests are funneled through ONE
-worker with a short dynamic-batching window — sentences from requests
-arriving within ~5 ms translate as one device batch (better MXU
-utilization than per-request batches), and the single worker also
-serializes access to the shared Translate driver (whose jit caches and
-prefix state are not re-entrant). The reference serves each connection
-on its own thread against per-thread graphs; one TPU program shared by
-all clients replaces that design.
+- WebSocket (the Marian protocol) via the ``websockets`` package, gated —
+  when unavailable the server falls back to
+- a dependency-free length-prefixed TCP framing (``MTPU <nbytes>\\n`` +
+  UTF-8 payload, replies framed the same way) that ``scripts/loadgen.py``
+  speaks. Both transports share one ServingApp, so admission, scheduling,
+  and metrics behave identically.
+
+Beyond the reference (which serves each connection on its own thread
+against per-thread graphs): ALL requests flow through ONE continuous
+token-budget batching scheduler (serving/scheduler.py) that packs
+sentences from concurrent clients into bucketed static-shape device
+batches, behind bounded-queue admission control (serving/admission.py),
+with Prometheus metrics + health endpoints (serving/metrics.py,
+``--metrics-port``). Error replies are explicit: a shed request gets
+``!!SERVER-OVERLOADED ...``, an expired one ``!!SERVER-TIMEOUT ...`` —
+never a silent hang.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from ..common import logging as log
+from ..data.batch_generator import bucket_length
+from ..serving import metrics as msm
+from ..serving.admission import AdmissionController, Overloaded
+from ..serving.scheduler import ContinuousScheduler, RequestTimeout
 
 try:
     import websockets
@@ -28,9 +41,9 @@ try:
 except ImportError:  # pragma: no cover
     HAVE_WS = False
 
-# dynamic-batching window: long enough to coalesce a burst of concurrent
-# clients, far below human-visible latency
-BATCH_WINDOW_S = 0.005
+# graceful-drain budget on shutdown: long enough for a queued maximal batch
+# to finish decoding, far below any orchestrator's kill timeout
+DRAIN_TIMEOUT_S = 30.0
 
 
 class TranslationService:
@@ -58,113 +71,269 @@ class TranslationService:
         return "\n".join(self.translate_lines(text.split("\n")))
 
 
-async def _batching_worker(queue: "asyncio.Queue[Tuple[str, asyncio.Future]]",
-                           translate_lines) -> None:
-    """Drain the request queue into dynamic batches: block for the first
-    request, then coalesce everything arriving inside the window; one
-    translate_lines call per batch (in an executor — the device work
-    must not block the event loop); per-request replies by line count.
+def resolve_token_budget(options) -> int:
+    """--batch-token-budget, or derived from --mini-batch x the bucketed
+    --max-length when unset — the derived value reproduces the sentence-
+    count batching the pre-serving server did, so the flagless command
+    line keeps its old capacity."""
+    budget = int(options.get("batch-token-budget", 0) or 0)
+    if budget > 0:
+        return budget
+    mb = max(1, int(options.get("mini-batch", 1) or 1))
+    ml = max(1, int(options.get("max-length", 50) or 50))
+    return mb * bucket_length(ml + 1)
 
-    Failure isolation: a failing BATCH is retried per request, so one
-    client's bad input fails only that client (the per-request error
-    domain of the unbatched design). The worker itself survives any
-    exception short of cancellation — a dead worker would hang every
-    future request on an unresolved future."""
-    loop = asyncio.get_event_loop()
 
-    async def _reply(batch):
-        lines: List[str] = []
-        counts: List[int] = []
-        for t, _f in batch:
-            ls = t.split("\n")
-            counts.append(len(ls))
-            lines.extend(ls)
-        out = await loop.run_in_executor(None, translate_lines, lines)
-        i = 0
-        for (_t, f), c in zip(batch, counts):
-            if not f.cancelled():
-                f.set_result("\n".join(out[i:i + c]))
-            i += c
+class ServingApp:
+    """One serving stack: TranslationService (or an injected
+    translate_lines — tests, load generators) + continuous scheduler +
+    admission control + metrics endpoint. Shared by every transport."""
 
-    while True:
+    def __init__(self, options, translate_lines=None,
+                 registry: Optional[msm.Registry] = None):
+        self.options = options
+        self.registry = registry if registry is not None else msm.REGISTRY
+        budget = resolve_token_budget(options)
+        if translate_lines is None:
+            # align the Translate-internal batcher with the scheduler's
+            # groups: one scheduler batch == one device batch, hitting the
+            # bucket table's warm jit shapes. All three knobs matter: the
+            # token budget governs splitting, and maxi-batch x mini-batch
+            # is the maxi-WINDOW cap in sentences (translate-mode
+            # mini-batch defaults to 1 — left alone, the window cap of 1
+            # would shred every scheduler batch back into single-sentence
+            # device batches). Rows per batch can never exceed
+            # budget / min-bucket-width, so the budget itself is a safe
+            # window cap.
+            options.set("mini-batch-words", budget)
+            options.set("mini-batch", budget)
+            options.set("maxi-batch", 1)
+            service = TranslationService(options)
+            translate_lines = service.translate_lines
+            self.service: Optional[TranslationService] = service
+        else:
+            self.service = None
+        self.scheduler = ContinuousScheduler(
+            translate_lines, token_budget=budget, registry=self.registry)
+        self.admission = AdmissionController(
+            int(options.get("max-queue", 512) or 0),
+            self.scheduler.queued_units, registry=self.registry)
+        self.request_timeout = float(options.get("request-timeout", 0) or 0)
+        self.metrics_server: Optional[msm.MetricsServer] = None
+        self._started = False
+
+    def ready(self) -> bool:
+        """/readyz: accepting traffic (started, not draining)."""
+        return self._started and not self.admission.draining
+
+    async def start(self) -> None:
+        self.scheduler.start()
+        self.metrics_server = msm.maybe_start_metrics_server(
+            self.options, ready_fn=self.ready)
+        self._started = True
+        log.info("Serving: token budget {} padded tokens/batch, queue "
+                 "limit {} sentences, request timeout {}",
+                 self.scheduler.token_budget,
+                 self.admission.max_queue_units or "unbounded",
+                 f"{self.request_timeout}s" if self.request_timeout
+                 else "none")
+
+    async def handle_text(self, text: str, priority: int = 0) -> str:
+        """One protocol frame in, one reply frame out — the transport-
+        agnostic request path (admission -> scheduler -> reply)."""
+        lines = text.split("\n")
         try:
-            text, fut = await queue.get()
-            batch = [(text, fut)]
-            # Coalesce the burst with sleep + get_nowait, NOT
-            # wait_for(queue.get()): cancelling a waiting get() (what
-            # wait_for does on timeout, Python < 3.12) can consume a
-            # just-enqueued item and drop it — the client would await an
-            # unresolved future forever (ADVICE r3).
-            await asyncio.sleep(BATCH_WINDOW_S)
-            while True:
-                try:
-                    batch.append(queue.get_nowait())
-                except asyncio.QueueEmpty:
-                    break
-            try:
-                await _reply(batch)
-            except Exception as e:  # noqa: BLE001
-                if len(batch) == 1:
-                    log.error("translation error: {}", e)
-                    if not batch[0][1].cancelled():
-                        batch[0][1].set_exception(RuntimeError(str(e)))
-                else:
-                    # isolate the failure: one bad request must not fail
-                    # the whole coalesced batch
-                    log.error("batch translation error ({} requests — "
-                              "retrying individually): {}", len(batch), e)
-                    for entry in batch:
-                        try:
-                            await _reply([entry])
-                        except Exception as e1:  # noqa: BLE001
-                            log.error("translation error: {}", e1)
-                            if not entry[1].cancelled():
-                                entry[1].set_exception(
-                                    RuntimeError(str(e1)))
+            self.admission.admit(len(lines))
+        except Overloaded as e:
+            return f"!!SERVER-OVERLOADED {e}"
+        fut = self.scheduler.submit(
+            lines, priority=priority,
+            timeout=self.request_timeout or None)
+        try:
+            out = await fut
+        except RequestTimeout as e:
+            return f"!!SERVER-TIMEOUT {e}"
         except asyncio.CancelledError:
             raise
-        except Exception as e:  # noqa: BLE001 — supervision: never die
-            log.error("server worker error (recovered): {}", e)
+        except Exception:  # error already logged by the scheduler
+            return ""
+        return "\n".join(out)
+
+    async def shutdown(self, drain_timeout: float = DRAIN_TIMEOUT_S) -> bool:
+        """Drain-on-shutdown: stop admitting (readyz flips to 503 so load
+        balancers stop routing here), finish queued work, then stop."""
+        self.admission.begin_drain()
+        queued = self.scheduler.queued_units()
+        if queued:
+            log.info("Draining {} queued sentences (up to {}s)", queued,
+                     drain_timeout)
+        ok = await self.scheduler.drain(drain_timeout)
+        if not ok:
+            log.warn("Drain timed out after {}s — queued requests failed",
+                     drain_timeout)
+        # the scheduler resolving the last futures and the per-connection
+        # handler tasks WRITING those replies are separate loop steps — a
+        # short grace lets the handlers flush before the transport (and
+        # then the loop) tears down, else drained work still resets
+        # client connections
+        await asyncio.sleep(0.2)
+        self.close_nowait()
+        return ok
+
+    def close_nowait(self) -> None:
+        """Synchronous hard cleanup (cancelled contexts, test teardown)."""
+        self._started = False
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
 
-def _make_handler(queue: "asyncio.Queue[Tuple[str, asyncio.Future]]"):
-    """The per-connection protocol, shared by _serve and the tests (so
-    the real wiring is what gets exercised)."""
+def _make_ws_handler(app: ServingApp):
+    """The per-connection WebSocket protocol, shared by _serve and the
+    tests (so the real wiring is what gets exercised). A dropped
+    connection cancels the handler task mid-await, which cancels the
+    request future — the scheduler then discards its queued sentences
+    before they cost device time (cancellation propagation)."""
     async def handler(ws):
         async for message in ws:
-            fut = asyncio.get_event_loop().create_future()
-            await queue.put((message, fut))
-            try:
-                reply = await fut
-            except Exception:  # error already logged by the worker
-                reply = ""
-            await ws.send(reply)
+            await ws.send(await app.handle_text(message))
     return handler
+
+
+def _make_tcp_handler(app: ServingApp):
+    """Length-prefixed TCP framing: ``MTPU <nbytes>\\n`` + payload, both
+    directions. Dependency-free stand-in for the ws transport (same
+    ServingApp path) — used by scripts/loadgen.py and the serving tests.
+
+    Cancellation parity with the ws transport: while a reply is pending,
+    the connection is watched for EOF — a client that disconnects cancels
+    its request, so the scheduler drops the queued sentences before they
+    cost device time (same guarantee the ws path gets from the handler
+    task being cancelled on close)."""
+    async def on_connection(reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter):
+        # at most one byte read ahead by the EOF watch of a pipelining
+        # client; prepended to the next header
+        leftover = b""
+        try:
+            while True:
+                header = leftover + await reader.readline()
+                leftover = b""
+                if not header:
+                    break
+                parts = header.split()
+                if len(parts) != 2 or parts[0] != b"MTPU":
+                    writer.write(b"MTPU 24\n!!SERVER-ERROR bad frame")
+                    await writer.drain()
+                    break
+                nbytes = int(parts[1])
+                payload = await reader.readexactly(nbytes)
+                reply_t = asyncio.ensure_future(
+                    app.handle_text(payload.decode("utf-8")))
+                watch = asyncio.ensure_future(reader.read(1))
+                await asyncio.wait({reply_t, watch},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if not reply_t.done():
+                    data = watch.result()
+                    if not data:            # EOF: client gone mid-request
+                        reply_t.cancel()
+                        try:
+                            await reply_t
+                        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                            pass
+                        break
+                    leftover = data         # pipelined client: keep byte
+                    reply = await reply_t
+                else:
+                    if watch.done():
+                        leftover = watch.result()
+                        if not leftover:    # EOF raced the reply
+                            break
+                    else:
+                        # cancelling an un-fired read() consumes nothing
+                        watch.cancel()
+                        try:
+                            await watch
+                        except asyncio.CancelledError:
+                            pass
+                    reply = reply_t.result()
+                out = reply.encode("utf-8")
+                writer.write(b"MTPU %d\n" % len(out) + out)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
+            pass                     # client went away / malformed frame
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+    return on_connection
 
 
 async def _serve(options, ready: Optional[asyncio.Future] = None) -> None:
     """Serve forever. `ready` (tests): resolved with the bound port once
     listening — pass --port 0 to bind an ephemeral port."""
-    service = TranslationService(options)
+    app = ServingApp(options)
+    await app.start()
     port = int(options.get("port", 8080))
-    queue: "asyncio.Queue[Tuple[str, asyncio.Future]]" = asyncio.Queue()
-    worker = asyncio.ensure_future(
-        _batching_worker(queue, service.translate_lines))
+
+    def _announce(bound: int, transport: str) -> None:
+        log.info("Server is listening on port {} ({})", bound, transport)
+        if ready is not None and not ready.cancelled():
+            ready.set_result(bound)
+
+    async def _serve_until_cancelled() -> None:
+        """Runs INSIDE the transport's serve context so the graceful
+        drain completes while client connections are still open — in-
+        flight clients get their replies before the listener (and with
+        it every connection) is torn down on context exit."""
+        try:
+            await asyncio.Future()
+        except asyncio.CancelledError:
+            # shielded from the cancellation already delivered to this
+            # task: finish queued work before going down
+            await asyncio.shield(app.shutdown())
+            raise
 
     try:
-        async with websockets.serve(_make_handler(queue), "0.0.0.0",
-                                    port) as server:
-            bound = next(iter(server.sockets)).getsockname()[1]
-            log.info("Server is listening on port {}", bound)
-            if ready is not None and not ready.cancelled():
-                ready.set_result(bound)
-            await asyncio.Future()
+        if HAVE_WS:
+            async with websockets.serve(_make_ws_handler(app), "0.0.0.0",
+                                        port) as server:
+                _announce(next(iter(server.sockets)).getsockname()[1],
+                          "websocket")
+                await _serve_until_cancelled()
+        else:
+            log.warn("the 'websockets' package is unavailable — serving "
+                     "the length-prefixed TCP framing instead (Marian ws "
+                     "clients cannot connect; scripts/loadgen.py "
+                     "--transport tcp speaks it)")
+            server = await asyncio.start_server(
+                _make_tcp_handler(app), "0.0.0.0", port)
+            async with server:
+                _announce(server.sockets[0].getsockname()[1], "tcp")
+                await _serve_until_cancelled()
     finally:
-        worker.cancel()
+        app.close_nowait()
 
 
 def serve_main(options) -> None:
-    if not HAVE_WS:
-        raise RuntimeError(
-            "marian-server needs the 'websockets' package (not installed)")
-    asyncio.run(_serve(options))
+    async def _main():
+        import signal
+        loop = asyncio.get_event_loop()
+        task = asyncio.ensure_future(_serve(options))
+        # SIGTERM (orchestrator shutdown) and SIGINT both route through
+        # _serve's cancellation path: drain, then exit
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, task.cancel)
+            except (NotImplementedError, RuntimeError):  # pragma: no cover
+                pass                         # non-Unix / nested loop
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    try:
+        asyncio.run(_main())
+    except KeyboardInterrupt:  # signal handler could not be installed
+        pass

@@ -1,0 +1,18 @@
+"""Production serving subsystem (ISSUE 1): continuous token-budget batching
+scheduler, admission control/backpressure, and the dependency-free metrics
+registry + /metrics /healthz /readyz endpoints shared by serve, train, and
+translate."""
+
+from .admission import AdmissionController, Overloaded
+from .metrics import (Counter, Gauge, Histogram, MetricsServer, Registry,
+                      REGISTRY, counter, gauge, histogram,
+                      maybe_start_metrics_server)
+from .scheduler import ContinuousScheduler, RequestTimeout
+
+__all__ = [
+    "AdmissionController", "Overloaded",
+    "Counter", "Gauge", "Histogram", "MetricsServer", "Registry",
+    "REGISTRY", "counter", "gauge", "histogram",
+    "maybe_start_metrics_server",
+    "ContinuousScheduler", "RequestTimeout",
+]

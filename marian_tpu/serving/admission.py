@@ -1,0 +1,93 @@
+"""Admission control & backpressure for the serving subsystem (ISSUE 1).
+
+The reference marian-server accepts every connection and every frame; under
+sustained overload the request queue (and the per-request futures behind
+it) grows without bound until the host OOMs, while every client sees
+unbounded latency. Production serving wants the opposite failure mode:
+a bounded queue, an EXPLICIT cheap rejection ("shed") the client can retry
+against another replica, and a drain mode that lets in-flight work finish
+while a load balancer (watching /readyz) routes new traffic elsewhere.
+
+Units are SENTENCES, not requests — a 1-sentence request and a 500-sentence
+request occupy very different amounts of queue, and the device batch former
+thinks in sentences too, so the bound composes with the scheduler's token
+budget instead of fighting it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from . import metrics as msm
+
+
+class Overloaded(RuntimeError):
+    """Request shed by admission control (queue full or draining).
+
+    Transports turn this into an explicit error reply / status — never a
+    silent hang. ``retriable`` distinguishes "try again shortly / another
+    replica" (queue full) from "this replica is going away" (draining)."""
+
+    def __init__(self, message: str, retriable: bool = True):
+        super().__init__(message)
+        self.retriable = retriable
+
+
+class AdmissionController:
+    """Bounded-queue gate in front of the scheduler.
+
+    ``depth_fn`` reports the scheduler's current queued sentence count so
+    the bound tracks reality (units leave the queue when batches dispatch,
+    not when requests resolve). ``max_queue_units <= 0`` disables the bound
+    (the reference's behavior, kept reachable for benchmarking the
+    difference)."""
+
+    def __init__(self, max_queue_units: int,
+                 depth_fn: Callable[[], int],
+                 registry: Optional[msm.Registry] = None):
+        self.max_queue_units = int(max_queue_units)
+        self.depth_fn = depth_fn
+        self._draining = False
+        self._drain_started: Optional[float] = None
+        r = registry if registry is not None else msm.REGISTRY
+        self.m_admitted = r.counter(
+            "marian_serving_admitted_sentences_total",
+            "Sentences admitted into the scheduler queue")
+        self.m_shed = r.counter(
+            "marian_serving_shed_total",
+            "Requests rejected by admission control", labels=("reason",))
+        self.m_queue_limit = r.gauge(
+            "marian_serving_queue_limit_sentences",
+            "Configured admission bound in sentences (0 = unbounded)")
+        self.m_queue_limit.set(self.max_queue_units)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def admit(self, n_units: int) -> None:
+        """Gate one request of ``n_units`` sentences; raises Overloaded
+        instead of queueing when the bound would be exceeded or the server
+        is draining. Admission is all-or-nothing per request — partial
+        admission would split one client's reply across a shed boundary."""
+        if self._draining:
+            self.m_shed.labels("draining").inc()
+            raise Overloaded("server is draining (shutting down); "
+                             "retry against another replica",
+                             retriable=False)
+        if self.max_queue_units > 0:
+            depth = int(self.depth_fn())
+            if depth + n_units > self.max_queue_units:
+                self.m_shed.labels("queue_full").inc()
+                raise Overloaded(
+                    f"queue full ({depth}/{self.max_queue_units} sentences "
+                    f"queued, request adds {n_units}); retry later")
+        self.m_admitted.inc(n_units)
+
+    def begin_drain(self) -> None:
+        """Stop admitting; /readyz flips to 503 via the owner's ready_fn.
+        Idempotent."""
+        if not self._draining:
+            self._draining = True
+            self._drain_started = time.time()

@@ -1,0 +1,384 @@
+"""Dependency-free metrics registry + Prometheus text exposition + health
+endpoints (the observability layer of the serving subsystem — ISSUE 1).
+
+The reference ships no serving metrics at all (marian_server.cpp logs
+connections and nothing else); production traffic needs queue depth, batch
+fill, shed counts and latency percentiles scrapeable by any Prometheus-
+compatible collector. Everything here is stdlib-only — http.server for the
+endpoint, threading.Lock for safety across the asyncio loop, the device
+executor thread, and the scraping thread — so the registry is importable
+from ANY layer (training/scheduler.py and translator/translator.py emit
+through the same types as the server; one metrics vocabulary end to end).
+
+Exposition format: https://prometheus.io/docs/instrumenting/exposition_formats/
+(text format 0.0.4 — the stable plain-text one).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..common import logging as log
+
+# Default histogram buckets: latency-shaped (seconds), 1ms..60s. Chosen so
+# one bucket table serves both the ~5ms coalescing window and multi-second
+# device batches under load.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+# Ratio-shaped buckets (fill ratios, waste fractions) in [0, 1].
+RATIO_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers without exponent, floats as
+    repr (Go-parseable); +Inf for the histogram top bucket."""
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(
+        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
+        for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+class _Metric:
+    """Base: name, help, optional label names; children per label values."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "",
+                 labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], "_Metric"] = {}
+
+    def labels(self, *values: str) -> "_Metric":
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {values}")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._child()
+                self._children[key] = child
+            return child
+
+    def _child(self) -> "_Metric":
+        raise NotImplementedError
+
+    def _sample_lines(self, label_values: Tuple[str, ...]) -> List[str]:
+        raise NotImplementedError
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            children = dict(self._children)
+        if self.label_names:
+            for key, child in sorted(children.items()):
+                lines.extend(child._sample_lines(key))
+        else:
+            lines.extend(self._sample_lines(()))
+        return lines
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (requests, sheds, timeouts...)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str = "",
+                 labels: Sequence[str] = ()):
+        super().__init__(name, help_, labels)
+        self._value = 0.0
+
+    def _child(self) -> "Counter":
+        return Counter(self.name, self.help, labels=self.label_names)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _sample_lines(self, lv: Tuple[str, ...]) -> List[str]:
+        return [f"{self.name}{_label_str(self.label_names, lv)} "
+                f"{_fmt(self.value)}"]
+
+
+class Gauge(_Metric):
+    """A value that goes up and down (queue depth, inflight batches...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str = "",
+                 labels: Sequence[str] = ()):
+        super().__init__(name, help_, labels)
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def _child(self) -> "Gauge":
+        return Gauge(self.name, self.help, labels=self.label_names)
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Sample a callable at scrape time (e.g. live queue depth)."""
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001 — a scrape must never raise
+                return float("nan")
+        with self._lock:
+            return self._value
+
+    def _sample_lines(self, lv: Tuple[str, ...]) -> List[str]:
+        return [f"{self.name}{_label_str(self.label_names, lv)} "
+                f"{_fmt(self.value)}"]
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (latency, batch fill ratio...)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str = "",
+                 labels: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help_, labels)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)   # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def _child(self) -> "Histogram":
+        return Histogram(self.name, self.help, labels=self.label_names,
+                         buckets=self.buckets)
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def _sample_lines(self, lv: Tuple[str, ...]) -> List[str]:
+        with self._lock:
+            counts, total, s = list(self._counts), self._count, self._sum
+        lines = []
+        cum = 0
+        edges = list(self.buckets) + [float("inf")]
+        for c, edge in zip(counts, edges):
+            cum += c
+            le = _label_str(self.label_names + ("le",), lv + (_fmt(edge),))
+            lines.append(f"{self.name}_bucket{le} {cum}")
+        ls = _label_str(self.label_names, lv)
+        lines.append(f"{self.name}_sum{ls} {_fmt(s)}")
+        lines.append(f"{self.name}_count{ls} {total}")
+        return lines
+
+
+class Registry:
+    """Named metric collection; get-or-create semantics so any layer can
+    declare its series idempotently (re-instantiating a Scheduler or a
+    Translate in one process must not collide)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help_: str, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(m).__name__}, requested {cls.__name__}")
+                return m
+            m = cls(name, help_, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_, labels=labels)
+
+    def gauge(self, name: str, help_: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_, labels=labels)
+
+    def histogram(self, name: str, help_: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help_, labels=labels,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: List[str] = []
+        for m in metrics:
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+
+# The process-wide default registry: train, translate, and serve all emit
+# here, so one /metrics endpoint exposes the whole process.
+REGISTRY = Registry()
+
+
+def counter(name: str, help_: str = "", labels: Sequence[str] = ()) -> Counter:
+    return REGISTRY.counter(name, help_, labels)
+
+
+def gauge(name: str, help_: str = "", labels: Sequence[str] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help_, labels)
+
+
+def histogram(name: str, help_: str = "", labels: Sequence[str] = (),
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help_, labels, buckets)
+
+
+class MetricsServer:
+    """/metrics + /healthz + /readyz on a ThreadingHTTPServer daemon thread.
+
+    - /metrics — Prometheus text of the given registry.
+    - /healthz — 200 as long as the process serves HTTP (liveness).
+    - /readyz  — 200 only while ``ready_fn()`` is truthy (readiness: model
+      loaded, scheduler running, not draining); 503 otherwise, so load
+      balancers stop routing to a draining replica before shutdown.
+
+    Port 0 binds an ephemeral port (tests); ``.port`` reports the bound one.
+    """
+
+    def __init__(self, port: int, registry: Optional[Registry] = None,
+                 ready_fn: Optional[Callable[[], bool]] = None,
+                 host: str = "0.0.0.0"):
+        self.registry = registry if registry is not None else REGISTRY
+        self.ready_fn = ready_fn or (lambda: True)
+        self._started = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = outer.registry.render().encode("utf-8")
+                    self._send(200, body,
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    self._send(200, b"ok\n", "text/plain")
+                elif path == "/readyz":
+                    try:
+                        ready = bool(outer.ready_fn())
+                    except Exception:  # noqa: BLE001
+                        ready = False
+                    self._send(200 if ready else 503,
+                               b"ready\n" if ready else b"not ready\n",
+                               "text/plain")
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are not log-worthy
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="metrics-http")
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        log.info("Metrics endpoint on port {} (/metrics /healthz /readyz)",
+                 self.port)
+        return self
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+
+def maybe_start_metrics_server(options,
+                               ready_fn: Optional[Callable[[], bool]] = None
+                               ) -> Optional[MetricsServer]:
+    """--metrics-port PORT (0 = off): start the scrape endpoint for any
+    long-running entry point (server, training). Failure to bind degrades
+    to a warning — observability must never take down the serving path."""
+    port = int(options.get("metrics-port", 0) or 0)
+    if port <= 0:
+        return None
+    try:
+        return MetricsServer(port, ready_fn=ready_fn).start()
+    except OSError as e:
+        log.warn("--metrics-port {}: failed to bind ({}); metrics endpoint "
+                 "disabled", port, e)
+        return None

@@ -1,0 +1,413 @@
+"""Continuous token-budget batching scheduler — the host-side layer that
+keeps the one jitted static-shape beam program fed under real load
+(ISSUE 1 tentpole; replaces server/server.py :: _batching_worker's fixed
+5 ms window + unbounded per-request batches).
+
+Design (the serving-time mirror of data/batch_generator's maxi-batching,
+which the reference applies only at training time):
+
+- Requests split into SENTENCE UNITS; the scheduler packs units from many
+  concurrent requests into one device batch by PADDED-TOKEN BUDGET against
+  the same bucketed length table training uses (data/batch_generator
+  bucket_length / padded_batch_cost) — batches land on warm jit-cache
+  shapes instead of minting new ones per traffic pattern.
+- CONTINUOUS: the worker loops as long as units are queued; a new batch
+  forms the moment the device frees up, seeded by the oldest unit (no
+  starvation), topped up with whatever else fits the budget.
+- Per-request deadlines (--request-timeout) resolve expired requests with
+  an explicit error even while queued; cancellation (client disconnect)
+  propagates — a cancelled request's units are dropped before they cost
+  device time.
+- Priority lanes: higher-priority units always pack first.
+- Retry-with-bisection on batch failure: one poison request costs
+  O(log batch) retries to isolate, not the whole batch (upgrade over the
+  previous one-by-one retry, O(batch) device calls).
+
+Transport-agnostic and model-agnostic: ``translate_lines`` is any callable
+``List[str] -> List[str]``; tests drive it with stubs under
+JAX_PLATFORMS=cpu, the server wires in TranslationService, and the same
+scheduler could front a scorer or embedder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+from ..common import logging as log
+from ..data.batch_generator import (DEFAULT_LENGTH_BUCKETS, bucket_length,
+                                    padded_batch_cost)
+from . import metrics as msm
+
+
+class RequestTimeout(RuntimeError):
+    """--request-timeout deadline expired before the request completed."""
+
+
+def default_length_fn(line: str) -> int:
+    """Whitespace token estimate (+1 for EOS) — the budget packer only
+    needs bucket-resolution accuracy; the translator re-measures with real
+    vocab encodings when it builds the device batch."""
+    return len(line.split()) + 1
+
+
+class _Request:
+    __slots__ = ("lines", "future", "priority", "arrival", "deadline",
+                 "results", "remaining", "queued", "first_dispatch",
+                 "timeout_handle")
+
+    def __init__(self, lines: List[str], future: "asyncio.Future",
+                 priority: int, arrival: float, deadline: Optional[float]):
+        self.lines = lines
+        self.future = future
+        self.priority = priority
+        self.arrival = arrival
+        self.deadline = deadline
+        self.results: List[Optional[str]] = [None] * len(lines)
+        self.remaining = len(lines)
+        self.queued = len(lines)        # units currently sitting in lanes
+        self.first_dispatch: Optional[float] = None
+        self.timeout_handle = None
+
+
+class _Unit:
+    """One sentence of one request — the scheduling granule."""
+
+    __slots__ = ("req", "idx", "text", "tokens")
+
+    def __init__(self, req: _Request, idx: int, text: str, tokens: int):
+        self.req = req
+        self.idx = idx
+        self.text = text
+        self.tokens = tokens
+
+
+class ContinuousScheduler:
+    def __init__(self, translate_lines: Callable[[List[str]], List[str]],
+                 token_budget: int = 4096,
+                 length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS,
+                 batch_multiple: int = 8,
+                 window_s: float = 0.002,
+                 scan_limit: int = 512,
+                 length_fn: Callable[[str], int] = default_length_fn,
+                 registry: Optional[msm.Registry] = None,
+                 executor: Optional[concurrent.futures.Executor] = None):
+        self.translate_lines = translate_lines
+        self.token_budget = max(1, int(token_budget))
+        self.length_buckets = length_buckets
+        self.batch_multiple = batch_multiple
+        # short coalescing pause before the FIRST batch of an idle period:
+        # lets a burst of concurrent clients land in one device batch
+        # (successor of the old fixed 5 ms window; once the queue is
+        # non-empty the loop never sleeps — the device sets the cadence)
+        self.window_s = window_s
+        # bound on units examined per batch-forming pass, so one pass is
+        # O(scan_limit) regardless of backlog depth
+        self.scan_limit = scan_limit
+        self.length_fn = length_fn
+        # ONE device worker thread: the Translate driver's jit caches and
+        # prefix state are not re-entrant, and the TPU program is serial
+        # anyway — concurrency comes from batching, not threads.
+        self._executor = executor or concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-device")
+        self._own_executor = executor is None
+        # priority lanes: lane per priority value, highest served first
+        self._lanes: Dict[int, Deque[_Unit]] = collections.defaultdict(
+            collections.deque)
+        self._queued = 0
+        # units in lanes whose request already resolved (timed out /
+        # cancelled / failed): still physically queued until the next
+        # forming pass sweeps them, but DEAD — admission must not shed
+        # live traffic against them (a timeout storm would otherwise
+        # convert directly into a shed storm while a long device batch
+        # keeps the worker busy)
+        self._dead = 0
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self._draining = False
+        self._inflight = 0
+
+        r = registry if registry is not None else msm.REGISTRY
+        self.m_requests = r.counter(
+            "marian_serving_requests_total", "Requests submitted")
+        self.m_queue_depth = r.gauge(
+            "marian_serving_queue_depth_sentences",
+            "Sentences currently queued (not yet in a device batch)")
+        self.m_queue_depth.set_function(self.queued_units)
+        self.m_batches = r.counter(
+            "marian_serving_batches_total", "Device batches dispatched")
+        self.m_batch_rows = r.histogram(
+            "marian_serving_batch_rows", "Real sentences per device batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        self.m_fill = r.histogram(
+            "marian_serving_batch_fill_ratio",
+            "Real tokens / padded batch capacity per device batch",
+            buckets=msm.RATIO_BUCKETS)
+        self.m_waste = r.histogram(
+            "marian_serving_padding_waste_ratio",
+            "Padded tokens wasted per device batch (1 - fill ratio)",
+            buckets=msm.RATIO_BUCKETS)
+        self.m_ttfb = r.histogram(
+            "marian_serving_time_to_first_batch_seconds",
+            "Queue wait from request arrival to its first device batch")
+        self.m_latency = r.histogram(
+            "marian_serving_request_latency_seconds",
+            "End-to-end request latency (submit to resolve)")
+        self.m_timeouts = r.counter(
+            "marian_serving_timeouts_total",
+            "Requests failed by --request-timeout deadline expiry")
+        self.m_cancelled = r.counter(
+            "marian_serving_cancelled_total",
+            "Requests cancelled by the client before completion")
+        self.m_failures = r.counter(
+            "marian_serving_failures_total",
+            "Requests failed by translation errors")
+        self.m_bisections = r.counter(
+            "marian_serving_retry_bisections_total",
+            "Failed-batch bisection retries (device calls re-issued)")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        """Start the worker on the RUNNING loop (call from a coroutine)."""
+        if self._task is None:
+            self._stopping = False
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        """Hard stop: cancel the worker; queued requests fail."""
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        for lane in self._lanes.values():
+            for u in lane:
+                if not u.req.future.done():
+                    u.req.future.set_exception(
+                        RuntimeError("server shut down"))
+            lane.clear()
+        self._queued = 0
+        if self._own_executor:
+            self._executor.shutdown(wait=False)
+
+    async def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: finish everything queued/in flight, then
+        stop. Pair with AdmissionController.begin_drain() so nothing new
+        arrives. Returns True when fully drained, False on timeout."""
+        self._draining = True
+        loop = asyncio.get_event_loop()
+        dl = loop.time() + timeout if timeout is not None else None
+
+        def _done() -> bool:
+            return self._queued == 0 and self._inflight == 0
+
+        while not _done():
+            if dl is not None and loop.time() >= dl:
+                await self.stop()
+                return False
+            self._wake.set()           # keep the worker moving
+            await asyncio.sleep(0.005)
+        await self.stop()
+        return True
+
+    # -- submission ---------------------------------------------------------
+    def queued_units(self) -> int:
+        """LIVE queued sentences — what admission and the depth gauge see.
+        Dead units (resolved requests not yet swept from the lanes) are
+        excluded, so expired backlog never sheds live traffic."""
+        return max(0, self._queued - self._dead)
+
+    def submit(self, lines: List[str], priority: int = 0,
+               timeout: Optional[float] = None) -> "asyncio.Future":
+        """Enqueue one request (a list of sentences); returns a future
+        resolving to the list of translations in input order. Must be
+        called from the event-loop thread (transports live there).
+        Cancel the future to cancel the request."""
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        now = loop.time()
+        deadline = now + timeout if timeout and timeout > 0 else None
+        req = _Request(lines, fut, priority, now, deadline)
+        self.m_requests.inc()
+        for i, text in enumerate(lines):
+            u = _Unit(req, i, text, max(1, int(self.length_fn(text))))
+            self._lanes[priority].append(u)
+            self._queued += 1
+        if deadline is not None:
+            # the deadline fires even if the unit is buried deep in the
+            # backlog — a timed-out client gets its error ON TIME, and the
+            # worker drops the dead units before they cost device work
+            req.timeout_handle = loop.call_at(
+                deadline, self._expire_request, req, loop)
+        fut.add_done_callback(
+            lambda f, _req=req: self._on_request_done(f, _req))
+        self._wake.set()
+        return fut
+
+    def _expire_request(self, req: _Request, loop) -> None:
+        if not req.future.done():
+            self.m_timeouts.inc()
+            req.future.set_exception(RequestTimeout(
+                f"request deadline expired after "
+                f"{(loop.time() - req.arrival):.3f}s "
+                f"({req.remaining}/{len(req.lines)} sentences unfinished)"))
+
+    def _on_request_done(self, fut: "asyncio.Future", req: _Request) -> None:
+        if fut.cancelled():
+            self.m_cancelled.inc()
+        # any units of this request still sitting in lanes are dead until
+        # the next forming pass physically sweeps them — discount them
+        # from the admission-visible depth IMMEDIATELY (a normal
+        # completion has req.queued == 0, so this is a no-op there)
+        self._dead += req.queued
+
+    # -- worker -------------------------------------------------------------
+    async def _run(self) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            try:
+                was_idle = False
+                while self._queued == 0:
+                    self._wake.clear()
+                    was_idle = True
+                    await self._wake.wait()
+                if was_idle and self.window_s > 0:
+                    # idle-edge coalescing pause only; under sustained load
+                    # the previous batch's device time IS the window
+                    await asyncio.sleep(self.window_s)
+                batch = self._form_batch(loop.time())
+                if not batch:
+                    continue
+                await self._dispatch(batch, loop)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — supervision: never die
+                log.error("serving scheduler error (recovered): {}", e)
+
+    def _form_batch(self, now: float) -> List[_Unit]:
+        """Pack one device batch: seed with the oldest live unit of the
+        highest non-empty priority lane, then top up with queued units
+        (same lane order) that fit the padded-token budget. Units of
+        already-resolved requests (cancelled / timed out / failed) are
+        discarded here, before they cost device time."""
+        batch: List[_Unit] = []
+        width = 0
+        scanned = 0
+        skipped: List[_Unit] = []
+        for prio in sorted(self._lanes.keys(), reverse=True):
+            lane = self._lanes[prio]
+            while lane and scanned < self.scan_limit:
+                u = lane.popleft()
+                self._queued -= 1
+                u.req.queued -= 1
+                if u.req.future.done():
+                    self._dead -= 1              # dead request: drop unit
+                    continue
+                scanned += 1
+                new_width = max(width,
+                                bucket_length(u.tokens, self.length_buckets))
+                # fit check on UNPADDED rows x bucketed width — the exact
+                # budget semantics of training's _split_maxi, so serving
+                # batches land on the shape grid the jit cache was warmed
+                # on. Row snap-up to batch_multiple can pad the realized
+                # device batch past the budget by < batch_multiple rows
+                # (same as training; --mini-batch-words has always meant
+                # real rows, not padded rows).
+                if batch and (len(batch) + 1) * new_width > self.token_budget:
+                    # does not fit — keep scanning: a shorter unit further
+                    # back may still fit this batch's width
+                    skipped.append(u)
+                    continue
+                batch.append(u)
+                width = new_width
+            if scanned >= self.scan_limit:
+                break
+        # skipped units go back to the FRONT of their lanes in order, so
+        # FIFO is preserved for the next batch
+        for u in reversed(skipped):
+            self._lanes[u.req.priority].appendleft(u)
+            self._queued += 1
+            u.req.queued += 1
+        return batch
+
+    async def _dispatch(self, units: List[_Unit], loop) -> None:
+        self._inflight += 1
+        try:
+            now = loop.time()
+            rows = len(units)
+            real_tokens = sum(u.tokens for u in units)
+            width = max(bucket_length(u.tokens, self.length_buckets)
+                        for u in units)
+            capacity = padded_batch_cost(rows, width, self.length_buckets,
+                                         self.batch_multiple)
+            fill = min(1.0, real_tokens / max(capacity, 1))
+            self.m_batches.inc()
+            self.m_batch_rows.observe(rows)
+            self.m_fill.observe(fill)
+            self.m_waste.observe(1.0 - fill)
+            for u in units:
+                if u.req.first_dispatch is None:
+                    u.req.first_dispatch = now
+                    self.m_ttfb.observe(now - u.req.arrival)
+            await self._translate_units(units, loop)
+        finally:
+            self._inflight -= 1
+
+    async def _translate_units(self, units: List[_Unit], loop) -> None:
+        """One device call for the batch; on failure, bisect: split in two
+        and retry each half, recursively, until single-unit batches isolate
+        the poison request(s). Cost per poison unit: O(log batch) extra
+        device calls against the old worker's O(batch) one-by-one retry."""
+        # requests can die (deadline / cancel / a sibling batch's failure)
+        # while this batch waited its turn — especially inside bisection
+        # retries. Re-filter here so dead sentences never cost a device
+        # call whose result would only be discarded.
+        units = [u for u in units if not u.req.future.done()]
+        if not units:
+            return
+        try:
+            lines = [u.text for u in units]
+            out = await loop.run_in_executor(
+                self._executor, self.translate_lines, lines)
+            if len(out) != len(lines):
+                raise RuntimeError(
+                    f"translator returned {len(out)} lines for "
+                    f"{len(lines)} inputs — reply routing would misalign")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            if len(units) == 1:
+                u = units[0]
+                if not u.req.future.done():
+                    self.m_failures.inc()
+                    log.error("translation error: {}", e)
+                    u.req.future.set_exception(RuntimeError(str(e)))
+                return
+            self.m_bisections.inc()
+            log.error("batch translation error ({} sentences — bisecting "
+                      "to isolate): {}", len(units), e)
+            mid = len(units) // 2
+            await self._translate_units(units[:mid], loop)
+            await self._translate_units(units[mid:], loop)
+            return
+        for u, line in zip(units, out):
+            self._complete_unit(u, line, loop)
+
+    def _complete_unit(self, u: _Unit, line: str, loop) -> None:
+        req = u.req
+        if req.future.done():
+            return                    # cancelled/timed out while in flight
+        req.results[u.idx] = line
+        req.remaining -= 1
+        if req.remaining == 0:
+            if req.timeout_handle is not None:
+                req.timeout_handle.cancel()
+            req.future.set_result([r if r is not None else ""
+                                   for r in req.results])
+            self.m_latency.observe(loop.time() - req.arrival)

@@ -72,6 +72,23 @@ class Scheduler:
         self._sent_sum = 0
         self._timer = time.perf_counter()
         self._disp_count = 0
+        # serving-grade observability (serving/metrics.py — ISSUE 1): the
+        # trainer emits into the same process-wide registry the server
+        # scrapes, so a training job started with --metrics-port exposes
+        # live cost/throughput to Prometheus with zero extra deps. Get-or-
+        # create semantics make repeated Scheduler construction safe.
+        from ..serving import metrics as msm
+        self._m_cost = msm.gauge(
+            "marian_train_cost", "Displayed training cost (per cost-type)")
+        self._m_wps = msm.gauge(
+            "marian_train_words_per_second",
+            "Training throughput over the last display window")
+        self._m_lr = msm.gauge(
+            "marian_train_learn_rate", "Current learning rate")
+        self._m_updates = msm.counter(
+            "marian_train_updates_total", "Optimizer updates applied")
+        self._m_labels = msm.counter(
+            "marian_train_labels_total", "Target labels consumed")
         # --tensorboard DIR (TPU extension; the reference logs text only):
         # train/valid scalars via torch's SummaryWriter (baked-in). Never
         # a hard dependency — unavailable writer degrades to a warning.
@@ -138,6 +155,8 @@ class Scheduler:
         s.batches_epoch += 1
         s.samples_epoch += sentences
         s.labels_total += int(labels)
+        self._m_updates.inc()
+        self._m_labels.inc(int(labels))
         self._max_labels_update = max(self._max_labels_update, int(labels))
         if lr is not None:
             s.eta = float(lr)
@@ -202,6 +221,9 @@ class Scheduler:
         self._tb_scalar("train/cost", cost, s.batches)
         self._tb_scalar("train/words_per_sec", wps, s.batches)
         self._tb_scalar("train/learn_rate", s.eta, s.batches)
+        self._m_cost.set(cost)
+        self._m_wps.set(wps)
+        self._m_lr.set(s.eta)
         try:
             # same number the text line shows (1-based; honors
             # --logical-epoch's fractional display)

@@ -250,6 +250,11 @@ class Train:
         from ..common.profiling import (TraceWindow,
                                         maybe_start_profile_server)
         maybe_start_profile_server(opts)
+        # --metrics-port: Prometheus scrape of the train-side series the
+        # Scheduler/StepTimer publish (serving/metrics.py — same registry
+        # and types as marian-server, one metrics vocabulary end to end)
+        from ..serving.metrics import maybe_start_metrics_server
+        maybe_start_metrics_server(opts)
         trace = TraceWindow(opts)
         train_key = prng.stream(key, prng.STREAM_DROPOUT)
         # --compact-transfer: ship uint16 tokens + row lengths instead of

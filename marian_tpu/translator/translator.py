@@ -93,6 +93,19 @@ class Translate:
         self.shortlist_gen = parse_shortlist_options(
             self.options.get("shortlist", []), self.src_vocab, self.trg_vocab)
         self.printer = OutputPrinter(self.options, self.trg_vocab)
+        # decode-side observability (serving/metrics.py — ISSUE 1): the
+        # same metric types the server exposes, so a marian-server scrape
+        # sees device-batch geometry (fill/waste over the BUCKETED padded
+        # shape) alongside the scheduler's queueing series
+        from ..serving import metrics as msm
+        self._m_batches = msm.counter(
+            "marian_translate_batches_total", "Device batches decoded")
+        self._m_sentences = msm.counter(
+            "marian_translate_sentences_total", "Sentences decoded")
+        self._m_fill = msm.histogram(
+            "marian_translate_batch_fill_ratio",
+            "Real source tokens / padded device-batch capacity",
+            buckets=msm.RATIO_BUCKETS)
         self._roofline_hint()
 
     def _roofline_hint(self):
@@ -210,6 +223,11 @@ class Translate:
 
         def _dispatch(batch):
             real = batch.size
+            self._m_batches.inc()
+            self._m_sentences.inc(real)
+            self._m_fill.observe(
+                batch.src_words
+                / max(batch.src.batch_size * batch.src.batch_width, 1))
             if len(self.src_vocab_list) > 1:
                 src_ids = tuple(sb.ids for sb in batch.sub)
                 src_mask = tuple(sb.mask for sb in batch.sub)

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Micro load generator for marian-server (ISSUE 1 CI/tooling satellite;
+bench_when_up use).
+
+Drives N concurrent clients against a running server, each sending R
+requests of S sentences, and reports client-side p50/p99/mean latency and
+throughput plus — when ``--metrics-port`` is given — the server-side batch
+fill ratio, batches, and shed/timeout counts scraped from /metrics (delta
+over the run, so a long-lived server's history doesn't pollute the numbers).
+
+Transports: ``ws`` (the Marian WebSocket protocol, needs the ``websockets``
+package) or ``tcp`` (the dependency-free ``MTPU <nbytes>\\n`` framing the
+server falls back to without websockets). ``auto`` picks ws when available.
+
+Example (CPU-backed acceptance run):
+
+    python -m marian_tpu.cli.marian_server --models m.npz \\
+        --vocabs v.yml v.yml --port 8765 --metrics-port 9090 \\
+        --batch-token-budget 1024 --max-queue 256 &
+    python scripts/loadgen.py --port 8765 --metrics-port 9090 \\
+        --clients 8 --requests 4 --sentences 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import statistics
+import sys
+import time
+import urllib.request
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+async def _request_tcp(host: str, port: int, text: str) -> str:
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        payload = text.encode("utf-8")
+        writer.write(b"MTPU %d\n" % len(payload) + payload)
+        await writer.drain()
+        header = await reader.readline()
+        if not header.startswith(b"MTPU "):
+            raise RuntimeError(f"bad reply frame: {header!r}")
+        return (await reader.readexactly(
+            int(header.split()[1]))).decode("utf-8")
+    finally:
+        writer.close()
+
+
+async def _request_ws(host: str, port: int, text: str) -> str:
+    import websockets
+    async with websockets.connect(f"ws://{host}:{port}") as ws:
+        await ws.send(text)
+        return await ws.recv()
+
+
+# ---------------------------------------------------------------------------
+# /metrics scraping (minimal Prometheus text parsing)
+# ---------------------------------------------------------------------------
+
+def scrape(host: str, port: int) -> dict:
+    """name -> summed value across label children (enough for counters,
+    and for histogram _sum/_count series)."""
+    url = f"http://{host}:{port}/metrics"
+    out: dict = {}
+    with urllib.request.urlopen(url, timeout=5) as fh:
+        for raw in fh.read().decode("utf-8").splitlines():
+            if not raw or raw.startswith("#"):
+                continue
+            try:
+                key, val = raw.rsplit(" ", 1)
+                name = key.split("{", 1)[0]
+                out[name] = out.get(name, 0.0) + float(val)
+            except ValueError:
+                continue
+    return out
+
+
+def _delta(before: dict, after: dict, name: str) -> float:
+    return after.get(name, 0.0) - before.get(name, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def make_sentence(client: int, req: int, sent: int, words: int) -> str:
+    return " ".join(f"w{(client * 7 + req * 3 + sent + w) % 20}"
+                    for w in range(words))
+
+
+async def run_clients(args, request_fn):
+    latencies: list = []
+    errors = {"overloaded": 0, "timeout": 0, "other": 0}
+
+    async def one_client(cid: int):
+        for r in range(args.requests):
+            text = "\n".join(
+                make_sentence(cid, r, s, args.words)
+                for s in range(args.sentences))
+            t0 = time.perf_counter()
+            try:
+                reply = await request_fn(args.host, args.port, text)
+            except Exception as e:  # noqa: BLE001
+                errors["other"] += 1
+                print(f"client {cid} req {r}: {e}", file=sys.stderr)
+                continue
+            dt = time.perf_counter() - t0
+            if reply.startswith("!!SERVER-OVERLOADED"):
+                errors["overloaded"] += 1
+            elif reply.startswith("!!SERVER-TIMEOUT"):
+                errors["timeout"] += 1
+            else:
+                latencies.append(dt)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[one_client(c) for c in range(args.clients)])
+    wall = time.perf_counter() - t0
+    return latencies, errors, wall
+
+
+def pct(vals, q):
+    if not vals:
+        return float("nan")
+    vals = sorted(vals)
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--transport", choices=("auto", "ws", "tcp"),
+                    default="auto")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="concurrent clients")
+    ap.add_argument("--requests", type=int, default=4,
+                    help="sequential requests per client")
+    ap.add_argument("--sentences", type=int, default=4,
+                    help="sentences per request")
+    ap.add_argument("--words", type=int, default=6,
+                    help="words per sentence")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="scrape /metrics before+after and report deltas")
+    args = ap.parse_args(argv)
+
+    transport = args.transport
+    if transport == "auto":
+        try:
+            import websockets  # noqa: F401
+            transport = "ws"
+        except ImportError:
+            transport = "tcp"
+    request_fn = _request_ws if transport == "ws" else _request_tcp
+
+    before = scrape(args.host, args.metrics_port) if args.metrics_port \
+        else {}
+    latencies, errors, wall = asyncio.run(run_clients(args, request_fn))
+    after = scrape(args.host, args.metrics_port) if args.metrics_port \
+        else {}
+
+    n_ok = len(latencies)
+    n_req = args.clients * args.requests
+    print(f"transport={transport} clients={args.clients} "
+          f"requests={n_req} sentences/request={args.sentences}")
+    print(f"ok={n_ok} shed={errors['overloaded']} "
+          f"timeout={errors['timeout']} other_errors={errors['other']}")
+    if latencies:
+        print(f"latency p50={pct(latencies, 0.50) * 1e3:.1f}ms "
+              f"p99={pct(latencies, 0.99) * 1e3:.1f}ms "
+              f"mean={statistics.mean(latencies) * 1e3:.1f}ms")
+        print(f"throughput {n_ok / wall:.2f} req/s "
+              f"{n_ok * args.sentences / wall:.2f} sentences/s "
+              f"(wall {wall:.2f}s)")
+    if before or after:
+        batches = _delta(before, after, "marian_serving_batches_total")
+        fill_sum = _delta(before, after,
+                          "marian_serving_batch_fill_ratio_sum")
+        fill_n = _delta(before, after,
+                        "marian_serving_batch_fill_ratio_count")
+        shed = _delta(before, after, "marian_serving_shed_total")
+        timeouts = _delta(before, after, "marian_serving_timeouts_total")
+        sent = _delta(before, after,
+                      "marian_serving_admitted_sentences_total")
+        print(f"server: batches={batches:.0f} "
+              f"sentences/batch={sent / batches if batches else 0:.2f} "
+              f"mean_fill={fill_sum / fill_n if fill_n else 0:.3f} "
+              f"shed={shed:.0f} timeouts={timeouts:.0f}")
+    return 0 if n_ok and not errors["other"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

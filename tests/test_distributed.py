@@ -365,3 +365,22 @@ class TestGradientDtype:
         mesh = M.make_mesh(None, jax.devices()[:1])
         m = _GradMachinery(model, mesh, params, grad_dtype="bfloat16")
         assert m.grad_dtype is None
+
+
+class TestGradientDtypeFailClosed:
+    """The compute-dtype safety check fails CLOSED: a model whose compute
+    dtype cannot be determined (no model.cfg) must not silently get bf16
+    grads applied — it could be an f32-precision model (ISSUE 1
+    satellite)."""
+
+    def test_undeterminable_compute_dtype_forces_f32_grads(self):
+        from marian_tpu.parallel.zero import _GradMachinery
+
+        class NoCfgModel:          # e.g. a custom/legacy model family
+            pass
+
+        params = {"w": jnp.zeros((4, 4), jnp.float32)}
+        mesh = M.make_mesh(None, jax.devices()[:1])
+        m = _GradMachinery(NoCfgModel(), mesh, params,
+                           grad_dtype="bfloat16")
+        assert m.grad_dtype is None

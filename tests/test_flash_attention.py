@@ -124,3 +124,48 @@ def test_bf16_inputs(rng):
     np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
                                np.asarray(ref, dtype=np.float32),
                                rtol=2e-2, atol=2e-2)
+
+
+class TestBlockEnvOverrides:
+    """MARIAN_FLASH_BLOCK_Q/K sweep overrides: malformed values fall back
+    to the 512/2048 defaults with a warning instead of raising at trace
+    time, and block_k is clamped (halved) for heads wider than the
+    dh=64 the defaults were validated at (ISSUE 1 satellite)."""
+
+    def test_env_block_parses_and_falls_back(self):
+        from marian_tpu.ops.pallas.flash_attention import _env_block
+        import os
+        for bad in ("banana", "12.5", "-64", "0", " "):
+            os.environ["MARIAN_FLASH_BLOCK_Q"] = bad
+            try:
+                assert _env_block("MARIAN_FLASH_BLOCK_Q", 512) == 512
+            finally:
+                del os.environ["MARIAN_FLASH_BLOCK_Q"]
+        os.environ["MARIAN_FLASH_BLOCK_Q"] = "256"
+        try:
+            assert _env_block("MARIAN_FLASH_BLOCK_Q", 512) == 256
+        finally:
+            del os.environ["MARIAN_FLASH_BLOCK_Q"]
+        assert _env_block("MARIAN_FLASH_BLOCK_Q", 512) == 512  # unset
+
+    def test_malformed_env_does_not_break_trace(self, rng, monkeypatch):
+        monkeypatch.setenv("MARIAN_FLASH_BLOCK_Q", "not-a-number")
+        monkeypatch.setenv("MARIAN_FLASH_BLOCK_K", "")
+        q = _rand(rng, 1, 2, 16, 8)
+        k = _rand(rng, 1, 2, 16, 8)
+        v = _rand(rng, 1, 2, 16, 8)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_wide_head_runs_with_halved_default_k_block(self, rng):
+        # dh=128 > 64: the default k block is halved (VMEM headroom);
+        # numerics must be unchanged
+        q = _rand(rng, 1, 1, 16, 128)
+        k = _rand(rng, 1, 1, 16, 128)
+        v = _rand(rng, 1, 1, 16, 128)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)

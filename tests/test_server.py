@@ -1,6 +1,11 @@
-"""marian-server: WebSocket protocol + dynamic request batching
-(server/server.py — reference src/command/marian_server.cpp; the
-batching across concurrent requests is beyond-reference)."""
+"""marian-server: end-to-end protocol tests against the REAL _serve wiring
+(server/server.py — reference src/command/marian_server.cpp; the serving
+subsystem behind it is unit-tested in tests/test_serving.py).
+
+Two transports, one ServingApp: the Marian WebSocket protocol (gated on the
+``websockets`` package) and the dependency-free length-prefixed TCP framing
+the server falls back to without it — so a real-model round trip is
+exercised in every environment."""
 
 import asyncio
 
@@ -10,76 +15,12 @@ import pytest
 from marian_tpu.common import Options
 from marian_tpu.data.vocab import DefaultVocab
 
-websockets = pytest.importorskip("websockets")
 
-
-class TestBatchingWorker:
-    def _run(self, coro):
-        return asyncio.run(coro)
-
-    def test_coalesces_concurrent_requests_one_device_batch(self):
-        from marian_tpu.server.server import _batching_worker
-
-        calls = []
-
-        def fake_translate(lines):
-            calls.append(list(lines))
-            return [f"T({l})" for l in lines]
-
-        async def scenario():
-            q = asyncio.Queue()
-            worker = asyncio.ensure_future(_batching_worker(q, fake_translate))
-            loop = asyncio.get_event_loop()
-            futs = []
-            # three requests land inside one batching window
-            for text in ("a\nb", "c", "d\ne\nf"):
-                f = loop.create_future()
-                await q.put((text, f))
-                futs.append(f)
-            replies = await asyncio.gather(*futs)
-            worker.cancel()
-            return replies
-
-        replies = self._run(scenario())
-        assert replies == ["T(a)\nT(b)", "T(c)", "T(d)\nT(e)\nT(f)"]
-        # one translate call served all three requests
-        assert calls == [["a", "b", "c", "d", "e", "f"]]
-
-    def test_error_propagates_without_killing_worker(self):
-        from marian_tpu.server.server import _batching_worker
-
-        state = {"fail": True}
-
-        def flaky(lines):
-            if state["fail"]:
-                state["fail"] = False
-                raise ValueError("boom")
-            return [l.upper() for l in lines]
-
-        async def scenario():
-            q = asyncio.Queue()
-            worker = asyncio.ensure_future(_batching_worker(q, flaky))
-            loop = asyncio.get_event_loop()
-            f1 = loop.create_future()
-            await q.put(("x", f1))
-            with pytest.raises(RuntimeError, match="boom"):
-                await f1
-            # the worker survives and serves the next request
-            f2 = loop.create_future()
-            await q.put(("ok", f2))
-            out = await f2
-            worker.cancel()
-            return out
-
-        assert self._run(scenario()) == "OK"
-
-
-def test_server_e2e_websocket(tmp_path):
-    """Real model, real websocket round trip, two concurrent clients."""
+def _tiny_server_options(tmp_path, seed=2):
+    """Build + save a tiny real model; returns server-mode Options."""
     import jax
     from marian_tpu.common import io as mio
     from marian_tpu.models.encoder_decoder import create_model
-    from marian_tpu.server import server as srv
 
     words = [f"w{i}" for i in range(20)]
     vocab = DefaultVocab.build([" ".join(words)])
@@ -89,42 +30,86 @@ def test_server_e2e_websocket(tmp_path):
                     "transformer-heads": 2, "transformer-dim-ffn": 32,
                     "enc-depth": 1, "dec-depth": 1,
                     "tied-embeddings-all": True, "max-length": 16,
-                    "precision": ["float32", "float32"], "seed": 2})
+                    "precision": ["float32", "float32"], "seed": seed})
     model = create_model(opts, len(vocab), len(vocab), inference=True)
-    params = model.init(jax.random.key(2))
+    params = model.init(jax.random.key(seed))
     mpath = tmp_path / "m.npz"
     mio.save_model(str(mpath), {k: np.asarray(v) for k, v in params.items()},
                    opts.as_yaml())
+    return Options({"models": [str(mpath)], "vocabs": [str(vpath),
+                                                       str(vpath)],
+                    "beam-size": 2, "max-length": 16, "port": 0,
+                    "mini-batch": 8, "max-queue": 64,
+                    "batch-token-budget": 128})
 
-    sopts = Options({"models": [str(mpath)], "vocabs": [str(vpath),
-                                                        str(vpath)],
-                     "beam-size": 2, "max-length": 16, "port": 0,
-                     "mini-batch": 8})
 
-    async def scenario():
-        # drive the REAL _serve wiring (worker startup, handler, queue)
-        # on an ephemeral port announced via the ready future
-        loop = asyncio.get_event_loop()
-        ready = loop.create_future()
-        server_task = asyncio.ensure_future(srv._serve(sopts, ready=ready))
-        port = await asyncio.wait_for(ready, 60)
+async def _drive_serve(sopts, client_fn):
+    """Start the REAL _serve (scheduler, admission, transport) on an
+    ephemeral port, run client_fn(port), tear down."""
+    from marian_tpu.server import server as srv
+    loop = asyncio.get_event_loop()
+    ready = loop.create_future()
+    server_task = asyncio.ensure_future(srv._serve(sopts, ready=ready))
+    port = await asyncio.wait_for(ready, 60)
+    try:
+        return await client_fn(port)
+    finally:
+        server_task.cancel()
+        try:
+            await server_task
+        except (asyncio.CancelledError, Exception):
+            pass
 
+
+async def _tcp_request(port: int, text: str) -> str:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = text.encode("utf-8")
+    writer.write(b"MTPU %d\n" % len(payload) + payload)
+    await writer.drain()
+    header = await reader.readline()
+    assert header.startswith(b"MTPU ")
+    reply = await reader.readexactly(int(header.split()[1]))
+    writer.close()
+    return reply.decode("utf-8")
+
+
+def test_server_e2e_websocket(tmp_path):
+    """Real model, real websocket round trip, two concurrent clients."""
+    websockets = pytest.importorskip("websockets")
+    from marian_tpu.server import server as srv
+    if not srv.HAVE_WS:  # pragma: no cover — importorskip above covers it
+        pytest.skip("server module loaded without websockets")
+
+    sopts = _tiny_server_options(tmp_path)
+
+    async def clients(port):
         async def client(text):
             async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
                 await ws.send(text)
                 return await ws.recv()
 
-        try:
-            r1, r2 = await asyncio.gather(client("w3 w4 w5"),
-                                          client("w6 w7\nw8 w9"))
-        finally:
-            server_task.cancel()
-            try:
-                await server_task
-            except (asyncio.CancelledError, Exception):
-                pass
-        return r1, r2
+        return await asyncio.gather(client("w3 w4 w5"),
+                                    client("w6 w7\nw8 w9"))
 
-    r1, r2 = asyncio.run(scenario())
+    r1, r2 = asyncio.run(_drive_serve(sopts, clients))
     assert isinstance(r1, str)
     assert r2.count("\n") == 1          # two sentences → two reply lines
+
+
+def test_server_e2e_tcp_fallback(tmp_path, monkeypatch):
+    """Real model over the dependency-free TCP framing — the transport
+    _serve falls back to without websockets (forced here so the test is
+    deterministic in every environment)."""
+    from marian_tpu.server import server as srv
+    monkeypatch.setattr(srv, "HAVE_WS", False)
+
+    sopts = _tiny_server_options(tmp_path)
+
+    async def clients(port):
+        return await asyncio.gather(
+            _tcp_request(port, "w3 w4 w5"),
+            _tcp_request(port, "w6 w7\nw8 w9"))
+
+    r1, r2 = asyncio.run(_drive_serve(sopts, clients))
+    assert isinstance(r1, str)
+    assert r2.count("\n") == 1

@@ -1,0 +1,625 @@
+"""Serving subsystem (marian_tpu/serving/ — ISSUE 1): continuous
+token-budget batching scheduler, admission control, metrics registry +
+endpoints. Everything runs under JAX_PLATFORMS=cpu with stub translate
+functions — no model, no websockets, no device."""
+
+import asyncio
+import threading
+import urllib.request
+
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.batch_generator import bucket_length
+from marian_tpu.serving import metrics as msm
+from marian_tpu.serving.admission import AdmissionController, Overloaded
+from marian_tpu.serving.scheduler import ContinuousScheduler, RequestTimeout
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + exposition
+# ---------------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_render(self):
+        r = msm.Registry()
+        c = r.counter("t_requests_total", "requests")
+        c.inc()
+        c.inc(2)
+        g = r.gauge("t_depth", "queue depth")
+        g.set(7)
+        h = r.histogram("t_latency_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = r.render()
+        assert "# TYPE t_requests_total counter" in text
+        assert "t_requests_total 3" in text
+        assert "t_depth 7" in text
+        assert 't_latency_seconds_bucket{le="0.1"} 1' in text
+        assert 't_latency_seconds_bucket{le="1"} 2' in text
+        assert 't_latency_seconds_bucket{le="+Inf"} 3' in text
+        assert "t_latency_seconds_count 3" in text
+
+    def test_labels_and_get_or_create_idempotent(self):
+        r = msm.Registry()
+        c1 = r.counter("t_shed_total", "sheds", labels=("reason",))
+        c1.labels("queue_full").inc()
+        c1.labels("queue_full").inc()
+        c1.labels("draining").inc()
+        # same name returns the same metric (safe re-instantiation)
+        c2 = r.counter("t_shed_total", "sheds", labels=("reason",))
+        assert c2 is c1
+        text = r.render()
+        assert 't_shed_total{reason="queue_full"} 2' in text
+        assert 't_shed_total{reason="draining"} 1' in text
+
+    def test_type_conflict_raises(self):
+        r = msm.Registry()
+        r.counter("t_x", "")
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("t_x", "")
+
+    def test_gauge_function_sampled_at_scrape(self):
+        r = msm.Registry()
+        state = {"v": 3}
+        g = r.gauge("t_live", "")
+        g.set_function(lambda: state["v"])
+        assert "t_live 3" in r.render()
+        state["v"] = 9
+        assert "t_live 9" in r.render()
+
+    def test_counters_only_go_up(self):
+        with pytest.raises(ValueError):
+            msm.Registry().counter("t_c", "").inc(-1)
+
+
+class TestMetricsEndpoint:
+    def test_scrape_health_ready(self):
+        r = msm.Registry()
+        r.counter("t_up", "").inc()
+        ready = {"ok": False}
+        srv = msm.MetricsServer(0, registry=r,
+                                ready_fn=lambda: ready["ok"]).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(base + "/metrics").read().decode()
+            assert "t_up 1" in body
+            assert urllib.request.urlopen(base + "/healthz").status == 200
+            # not ready -> 503; ready -> 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/readyz")
+            assert ei.value.code == 503
+            ready["ok"] = True
+            assert urllib.request.urlopen(base + "/readyz").status == 200
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# continuous scheduler
+# ---------------------------------------------------------------------------
+
+class TestContinuousScheduler:
+    def test_coalesces_concurrent_requests_one_device_batch(self):
+        calls = []
+
+        def fake(lines):
+            calls.append(list(lines))
+            return [f"T({l})" for l in lines]
+
+        async def scenario():
+            s = ContinuousScheduler(fake, token_budget=256,
+                                    registry=msm.Registry())
+            s.start()
+            futs = [s.submit(["a b", "c"]), s.submit(["d"]),
+                    s.submit(["e f g"])]
+            out = await asyncio.gather(*futs)
+            await s.stop()
+            return out
+
+        out = run(scenario())
+        assert out == [["T(a b)", "T(c)"], ["T(d)"], ["T(e f g)"]]
+        assert calls == [["a b", "c", "d", "e f g"]]
+
+    def test_token_budget_splits_batches(self):
+        calls = []
+
+        def fake(lines):
+            calls.append(list(lines))
+            return list(lines)
+
+        async def scenario():
+            # each 3-word line buckets to width 8; budget 16 -> <=2 rows
+            s = ContinuousScheduler(fake, token_budget=16,
+                                    registry=msm.Registry())
+            s.start()
+            futs = [s.submit([f"w{i} x y"]) for i in range(6)]
+            await asyncio.gather(*futs)
+            await s.stop()
+
+        run(scenario())
+        assert len(calls) >= 3
+        for call in calls:
+            width = max(bucket_length(len(l.split()) + 1) for l in call)
+            assert len(call) * width <= 16
+
+    def test_fill_ratio_improves_over_single_request(self):
+        """The acceptance-criterion property, at unit level: concurrent
+        single-sentence requests coalesce into batches whose fill ratio
+        beats the 1-request baseline."""
+        def fake(lines):
+            return list(lines)
+
+        def mean_fill(n_concurrent):
+            reg = msm.Registry()
+
+            async def scenario():
+                s = ContinuousScheduler(fake, token_budget=512,
+                                        batch_multiple=8, registry=reg)
+                s.start()
+                futs = [s.submit(["a b c d e f g"])
+                        for _ in range(n_concurrent)]
+                await asyncio.gather(*futs)
+                await s.stop()
+
+            run(scenario())
+            h = reg.get("marian_serving_batch_fill_ratio")
+            return h.mean()
+
+        assert mean_fill(16) > mean_fill(1)
+
+    def test_deadline_expiry_while_queued(self):
+        release = threading.Event()
+
+        def blocking(lines):
+            release.wait(5)
+            return list(lines)
+
+        async def scenario():
+            reg = msm.Registry()
+            s = ContinuousScheduler(blocking, token_budget=64,
+                                    window_s=0.0, registry=reg)
+            s.start()
+            f1 = s.submit(["first"])                  # occupies the device
+            await asyncio.sleep(0.05)
+            f2 = s.submit(["second"], timeout=0.05)   # expires while queued
+            with pytest.raises(RequestTimeout, match="deadline expired"):
+                await f2
+            release.set()
+            await f1
+            await s.stop()
+            return reg.get("marian_serving_timeouts_total").value
+
+        try:
+            assert run(scenario()) == 1
+        finally:
+            release.set()
+
+    def test_cancellation_mid_queue_drops_units(self):
+        release = threading.Event()
+        calls = []
+
+        def blocking(lines):
+            calls.append(list(lines))
+            release.wait(5)
+            return list(lines)
+
+        async def scenario():
+            reg = msm.Registry()
+            s = ContinuousScheduler(blocking, token_budget=64,
+                                    window_s=0.0, registry=reg)
+            s.start()
+            f1 = s.submit(["first"])
+            await asyncio.sleep(0.05)                 # device now busy
+            f2 = s.submit(["cancel me"])
+            f2.cancel()
+            release.set()
+            await f1
+            # another request proves the worker moved on past the
+            # cancelled units
+            f3 = s.submit(["third"])
+            await f3
+            await s.stop()
+            return reg.get("marian_serving_cancelled_total").value
+
+        try:
+            cancelled = run(scenario())
+        finally:
+            release.set()
+        assert cancelled == 1
+        assert ["cancel me"] not in calls
+        assert not any("cancel me" in c for c in calls)
+
+    def test_bisection_isolates_poison_request(self):
+        calls = []
+
+        def poison_translate(lines):
+            calls.append(list(lines))
+            if any("POISON" in l for l in lines):
+                raise ValueError("poison sentence")
+            return [l.upper() for l in lines]
+
+        async def scenario():
+            reg = msm.Registry()
+            s = ContinuousScheduler(poison_translate, token_budget=256,
+                                    registry=reg)
+            s.start()
+            good1 = s.submit(["alpha"])
+            bad = s.submit(["POISON"])
+            good2 = s.submit(["beta"])
+            r1 = await good1
+            with pytest.raises(RuntimeError, match="poison"):
+                await bad
+            r2 = await good2
+            await s.stop()
+            return r1, r2, reg
+
+        r1, r2, reg = run(scenario())
+        assert r1 == ["ALPHA"] and r2 == ["BETA"]
+        # the first batch coalesced all three and failed; bisection then
+        # isolated the poison without failing the good requests
+        assert len(calls[0]) == 3
+        assert reg.get("marian_serving_retry_bisections_total").value >= 1
+        assert reg.get("marian_serving_failures_total").value == 1
+
+    def test_priority_lane_packs_first(self):
+        release = threading.Event()
+        calls = []
+
+        def blocking(lines):
+            calls.append(list(lines))
+            if len(calls) == 1:
+                release.wait(5)
+            return list(lines)
+
+        async def scenario():
+            s = ContinuousScheduler(blocking, token_budget=256,
+                                    window_s=0.0, registry=msm.Registry())
+            s.start()
+            f0 = s.submit(["warmup"])
+            await asyncio.sleep(0.05)                 # device busy
+            flow = s.submit(["low lane"], priority=0)
+            fhigh = s.submit(["high lane"], priority=5)
+            release.set()
+            await asyncio.gather(f0, flow, fhigh)
+            await s.stop()
+
+        try:
+            run(scenario())
+        finally:
+            release.set()
+        assert calls[1][0] == "high lane"   # high priority packed first
+
+    def test_worker_survives_translate_errors(self):
+        state = {"fail": True}
+
+        def flaky(lines):
+            if state["fail"]:
+                state["fail"] = False
+                raise ValueError("boom")
+            return [l.upper() for l in lines]
+
+        async def scenario():
+            s = ContinuousScheduler(flaky, token_budget=64,
+                                    registry=msm.Registry())
+            s.start()
+            f1 = s.submit(["x"])
+            with pytest.raises(RuntimeError, match="boom"):
+                await f1
+            f2 = s.submit(["ok"])
+            out = await f2
+            await s.stop()
+            return out
+
+        assert run(scenario()) == ["OK"]
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_queue_full_sheds_with_explicit_error(self):
+        reg = msm.Registry()
+        depth = {"v": 0}
+        adm = AdmissionController(10, lambda: depth["v"], registry=reg)
+        adm.admit(8)
+        depth["v"] = 8
+        with pytest.raises(Overloaded, match="queue full"):
+            adm.admit(3)
+        assert reg.get("marian_serving_shed_total") \
+                  .labels("queue_full").value == 1
+        adm.admit(2)          # exactly at the bound still admits
+
+    def test_zero_limit_is_unbounded(self):
+        adm = AdmissionController(0, lambda: 10**9,
+                                  registry=msm.Registry())
+        adm.admit(10**6)      # no shed
+
+    def test_drain_stops_admission_and_finishes_queued(self):
+        def fake(lines):
+            return list(lines)
+
+        async def scenario():
+            reg = msm.Registry()
+            s = ContinuousScheduler(fake, token_budget=64, registry=reg)
+            adm = AdmissionController(100, s.queued_units, registry=reg)
+            s.start()
+            futs = [s.submit([f"s{i}"]) for i in range(5)]
+            adm.begin_drain()
+            with pytest.raises(Overloaded, match="draining") as ei:
+                adm.admit(1)
+            assert ei.value.retriable is False
+            drained = await s.drain(timeout=5.0)
+            out = await asyncio.gather(*futs)
+            return drained, out
+
+        drained, out = run(scenario())
+        assert drained is True
+        assert out == [[f"s{i}"] for i in range(5)]
+
+
+# ---------------------------------------------------------------------------
+# ServingApp over the dependency-free TCP framing (the real server wiring
+# minus the model and minus websockets)
+# ---------------------------------------------------------------------------
+
+async def _tcp_request(port: int, text: str) -> str:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = text.encode("utf-8")
+    writer.write(b"MTPU %d\n" % len(payload) + payload)
+    await writer.drain()
+    header = await reader.readline()
+    assert header.startswith(b"MTPU ")
+    reply = await reader.readexactly(int(header.split()[1]))
+    writer.close()
+    return reply.decode("utf-8")
+
+
+def _make_app(translate, **opt):
+    from marian_tpu.server.server import ServingApp
+    base = {"batch-token-budget": 256, "max-queue": 64,
+            "request-timeout": 0.0, "metrics-port": 0}
+    base.update(opt)
+    return ServingApp(Options(base), translate_lines=translate,
+                      registry=msm.Registry())
+
+
+def test_serving_smoke():
+    """Fast tier-1 smoke: concurrent TCP clients -> admission ->
+    continuous scheduler -> stub translate -> framed replies, plus the
+    documented metric series present after traffic."""
+    from marian_tpu.server.server import _make_tcp_handler
+
+    calls = []
+
+    def fake(lines):
+        calls.append(list(lines))
+        return [f"T({l})" for l in lines]
+
+    async def scenario():
+        app = _make_app(fake)
+        await app.start()
+        server = await asyncio.start_server(_make_tcp_handler(app),
+                                            "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            r1, r2, r3 = await asyncio.gather(
+                _tcp_request(port, "a b\nc d"),
+                _tcp_request(port, "e"),
+                _tcp_request(port, "f g h"))
+        finally:
+            server.close()
+            await server.wait_closed()
+            await app.shutdown(drain_timeout=2.0)
+        return r1, r2, r3, app
+
+    r1, r2, r3, app = run(scenario())
+    assert r1 == "T(a b)\nT(c d)"
+    assert r2 == "T(e)"
+    assert r3 == "T(f g h)"
+    # concurrent requests coalesced (fewer device calls than requests)
+    assert len(calls) < 3
+    # every documented series is present in a scrape of the app registry
+    text = app.registry.render()
+    for series in ("marian_serving_requests_total",
+                   "marian_serving_queue_depth_sentences",
+                   "marian_serving_batches_total",
+                   "marian_serving_batch_rows",
+                   "marian_serving_batch_fill_ratio",
+                   "marian_serving_padding_waste_ratio",
+                   "marian_serving_time_to_first_batch_seconds",
+                   "marian_serving_request_latency_seconds",
+                   "marian_serving_timeouts_total",
+                   "marian_serving_cancelled_total",
+                   "marian_serving_failures_total",
+                   "marian_serving_retry_bisections_total",
+                   "marian_serving_shed_total",
+                   "marian_serving_admitted_sentences_total",
+                   "marian_serving_queue_limit_sentences"):
+        assert series in text, f"missing metric series {series}"
+
+
+def test_app_overload_reply_not_hang():
+    release = threading.Event()
+
+    def blocking(lines):
+        release.wait(5)
+        return list(lines)
+
+    async def scenario():
+        app = _make_app(blocking, **{"max-queue": 2})
+        await app.start()
+        # first request fills the queue bound while the device blocks
+        t1 = asyncio.ensure_future(app.handle_text("s1\ns2"))
+        await asyncio.sleep(0.05)
+        # second request must be shed with an explicit error, instantly
+        reply = await asyncio.wait_for(app.handle_text("s3\ns4\ns5"), 1.0)
+        release.set()
+        await t1
+        await app.shutdown(drain_timeout=2.0)
+        return reply
+
+    try:
+        reply = run(scenario())
+    finally:
+        release.set()
+    assert reply.startswith("!!SERVER-OVERLOADED")
+    assert "queue full" in reply
+
+
+def test_app_timeout_reply():
+    release = threading.Event()
+
+    def blocking(lines):
+        release.wait(5)
+        return list(lines)
+
+    async def scenario():
+        app = _make_app(blocking, **{"request-timeout": 0.05})
+        await app.start()
+        t1 = asyncio.ensure_future(app.handle_text("hold"))
+        await asyncio.sleep(0.05)          # device now busy with t1
+        reply = await asyncio.wait_for(app.handle_text("late"), 1.0)
+        release.set()
+        await t1
+        await app.shutdown(drain_timeout=2.0)
+        return reply
+
+    try:
+        reply = run(scenario())
+    finally:
+        release.set()
+    assert reply.startswith("!!SERVER-TIMEOUT")
+
+
+def test_resolve_token_budget_defaults():
+    from marian_tpu.server.server import resolve_token_budget
+    # explicit flag wins
+    assert resolve_token_budget(Options({"batch-token-budget": 999})) == 999
+    # derived: mini-batch x bucketed (max-length + 1)
+    got = resolve_token_budget(Options({"mini-batch": 8, "max-length": 50}))
+    assert got == 8 * bucket_length(51)
+
+
+def test_dead_queue_depth_not_counted_for_admission():
+    """A timeout storm must not become a shed storm: expired requests'
+    units still physically in the lanes (worker busy on a long device
+    batch) are excluded from the admission-visible depth immediately."""
+    release = threading.Event()
+
+    def blocking(lines):
+        release.wait(5)
+        return list(lines)
+
+    async def scenario():
+        reg = msm.Registry()
+        s = ContinuousScheduler(blocking, token_budget=64,
+                                window_s=0.0, registry=reg)
+        s.start()
+        f1 = s.submit(["first"])               # occupies the device
+        await asyncio.sleep(0.05)
+        f2 = s.submit(["a", "b", "c"], timeout=0.05)
+        assert s.queued_units() == 3
+        with pytest.raises(RequestTimeout):
+            await f2
+        # expired units are still in the lanes (device busy) but the
+        # live depth — what AdmissionController sheds against — is 0
+        assert s.queued_units() == 0
+        release.set()
+        await f1
+        await s.stop()
+
+    try:
+        run(scenario())
+    finally:
+        release.set()
+
+
+def test_bisection_skips_dead_units():
+    """Requests that die while a failed batch bisects must not be
+    re-translated just to discard the result."""
+    calls = []
+    release = threading.Event()
+    state = {"first": True}
+
+    def translate(lines):
+        calls.append(list(lines))
+        if state["first"]:
+            state["first"] = False
+            release.wait(5)
+            raise ValueError("first call fails")
+        return [l.upper() for l in lines]
+
+    async def scenario():
+        s = ContinuousScheduler(translate, token_budget=256,
+                                registry=msm.Registry())
+        s.start()
+        f1 = s.submit(["alpha"])
+        f2 = s.submit(["omega"])
+        await asyncio.sleep(0.05)   # batch [alpha, omega] now in flight
+        f2.cancel()                 # dies while the batch is failing
+        release.set()
+        out = await f1
+        await s.stop()
+        return out
+
+    try:
+        out = run(scenario())
+    finally:
+        release.set()
+    assert out == ["ALPHA"]
+    assert calls[0] == ["alpha", "omega"]
+    # bisection retried alpha but never re-dispatched the dead omega
+    assert all("omega" not in c for c in calls[1:])
+
+
+def test_tcp_disconnect_cancels_request():
+    """TCP cancellation parity with ws: a client that drops mid-request
+    has its queued sentences cancelled before they cost device time."""
+    from marian_tpu.server.server import _make_tcp_handler
+    release = threading.Event()
+    calls = []
+
+    def blocking(lines):
+        calls.append(list(lines))
+        release.wait(5)
+        return list(lines)
+
+    async def scenario():
+        app = _make_app(blocking)
+        await app.start()
+        server = await asyncio.start_server(_make_tcp_handler(app),
+                                            "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        # first request occupies the device
+        hold = asyncio.ensure_future(_tcp_request(port, "hold"))
+        await asyncio.sleep(0.05)
+        # second client sends a frame and drops the connection
+        _, w = await asyncio.open_connection("127.0.0.1", port)
+        p = b"goner one\ngoner two"
+        w.write(b"MTPU %d\n" % len(p) + p)
+        await w.drain()
+        await asyncio.sleep(0.05)
+        w.close()
+        await asyncio.sleep(0.1)               # EOF watch fires, cancels
+        cancelled = app.registry.get(
+            "marian_serving_cancelled_total").value
+        release.set()
+        await hold
+        server.close()
+        await server.wait_closed()
+        await app.shutdown(drain_timeout=2.0)
+        return cancelled
+
+    try:
+        cancelled = run(scenario())
+    finally:
+        release.set()
+    assert cancelled == 1
+    assert all("goner" not in l for c in calls for l in c)
