@@ -1,0 +1,23 @@
+"""mtlint — JAX/TPU-aware static analysis for marian-tpu (ISSUE 2).
+
+Six rule families over stdlib `ast`, no third-party deps and no import of
+the linted code:
+
+  trace-safety  MT-TRACE-COND/-CAST/-NUMPY   concretization & recompiles
+                                             inside jit/pjit/shard_map
+  host-sync     MT-SYNC-TIMER/-TRANSFER      un-synced timing + implicit
+                                             device->host copies in hot dirs
+  donation      MT-DONATE-READ               use-after-donate_argnums
+  dtype         MT-DTYPE-LITERAL/-ARRAY      bf16-upcast hazards in ops/layers
+  guarded-by    MT-LOCK-GUARD/-UNKNOWN       `# guarded-by: <lock>` race lint
+                                             for the threaded serving layer
+  metrics       MT-METRIC-UNUSED/-UNREG      Prometheus registry vs emission
+
+Run `python -m marian_tpu.analysis` (or scripts/mtlint.py); the checked-in
+baseline marian_tpu/analysis/baseline.json makes the pass a hard tier-1
+gate (tests/test_mtlint.py). Full docs: docs/STATIC_ANALYSIS.md.
+"""
+
+from .core import (Config, Finding, Source, apply_baseline,  # noqa: F401
+                   load_baseline, run_lint, write_baseline)
+from .cli import main  # noqa: F401

@@ -1,0 +1,8 @@
+"""`python -m marian_tpu.analysis` — the mtlint CLI."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""mtlint command line.
+
+    python -m marian_tpu.analysis [paths...] [options]
+    scripts/mtlint.py             [paths...] [options]
+
+Exit codes: 0 = clean (no non-baselined findings), 1 = findings, 2 = usage
+or parse errors. The tier-1 gate (tests/test_mtlint.py) is this command
+with the checked-in baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .core import (Config, apply_baseline, load_baseline, run_lint,
+                   write_baseline)
+
+DEFAULT_BASELINE = "marian_tpu/analysis/baseline.json"
+
+
+def find_root(start: Path) -> Path:
+    """Nearest ancestor with a pyproject.toml (where [tool.mtlint] and
+    baseline paths are anchored); falls back to cwd."""
+    cur = start.resolve()
+    for cand in (cur, *cur.parents):
+        if (cand / "pyproject.toml").exists():
+            return cand
+    return start
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mtlint",
+        description="JAX/TPU-aware static analysis for marian-tpu "
+                    "(trace-safety, host-sync, donation, dtype, guarded-by, "
+                    "metrics hygiene)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint (default: marian_tpu/)")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="suppress findings recorded in FILE "
+                        f"(default when present: {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline; report everything")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline with all current findings "
+                        "and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", metavar="FAMILIES", default=None,
+                   help="comma-separated rule families to run (default all): "
+                        "trace-safety,host-sync,donation,dtype,guarded-by,"
+                        "metrics")
+    p.add_argument("--root", metavar="DIR", default=None,
+                   help="project root (default: nearest pyproject.toml)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule families and ids, then exit")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings the baseline suppresses")
+    return p
+
+
+def _list_rules() -> int:
+    from .rules import all_rules
+    for rule in all_rules():
+        print(f"{rule.family:14s} {', '.join(rule.ids)}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+
+    root = Path(args.root) if args.root else find_root(Path.cwd())
+    config = Config.load(root)
+    paths = [Path(p) for p in (args.paths or [root / "marian_tpu"])]
+    for p in paths:
+        if not p.exists():
+            print(f"mtlint: path does not exist: {p}", file=sys.stderr)
+            return 2
+    rule_filter = ([f.strip() for f in args.rules.split(",") if f.strip()]
+                   if args.rules else None)
+
+    errors: List[str] = []
+    findings = run_lint(paths, config, rule_filter=rule_filter,
+                        errors=errors)
+    for e in errors:
+        print(f"mtlint: {e}", file=sys.stderr)
+
+    baseline_path: Optional[Path] = None
+    if not args.no_baseline:
+        if args.baseline:
+            baseline_path = Path(args.baseline)
+        elif (root / DEFAULT_BASELINE).exists() or args.update_baseline:
+            baseline_path = root / DEFAULT_BASELINE
+
+    if args.update_baseline:
+        if baseline_path is None:
+            baseline_path = root / DEFAULT_BASELINE
+        baseline_path.parent.mkdir(parents=True, exist_ok=True)
+        write_baseline(findings, baseline_path)
+        print(f"mtlint: baseline written: {baseline_path} "
+              f"({len(findings)} findings)")
+        return 0
+
+    baseline = load_baseline(baseline_path) if baseline_path else None
+    if baseline is not None:
+        new, old = apply_baseline(findings, baseline)
+    else:
+        new, old = list(findings), []
+
+    if args.format == "json":
+        payload = {
+            "findings": [f.to_json() for f in new],
+            "baselined": len(old),
+            "errors": errors,
+        }
+        if args.show_baselined:
+            payload["baselined_findings"] = [f.to_json() for f in old]
+        print(json.dumps(payload, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if args.show_baselined:
+            for f in old:
+                print(f"[baselined] {f.render()}")
+        summary = f"mtlint: {len(new)} finding(s)"
+        if old:
+            summary += f", {len(old)} baselined"
+        print(summary, file=sys.stderr)
+
+    if errors:
+        return 2
+    return 1 if new else 0

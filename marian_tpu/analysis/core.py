@@ -1,0 +1,475 @@
+"""mtlint core: findings, parsed sources, configuration, baseline.
+
+The analysis layer is stdlib-only on purpose — `python -m marian_tpu.analysis`
+must run (and the tier-1 gate must fail fast) on a box with no jax installed,
+and importing the linted package would execute it. Everything works on `ast`
+trees plus the token stream (for comments: `# guarded-by:` annotations and
+`# mtlint:` suppressions live there).
+
+Baseline semantics: a finding is identified by (rule, path, stripped source
+line) rather than line NUMBER, so unrelated edits above a pre-existing
+finding don't resurrect it; duplicate keys are counted, so adding a SECOND
+violation identical to a baselined one is still reported.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import tokenize
+from collections import Counter
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_TAG = "mtlint:"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # rule id, e.g. "MT-LOCK-GUARD"
+    path: str          # posix path relative to the project root
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    hint: str = ""
+    code: str = ""     # stripped source line — the baseline identity
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.code)
+
+    def render(self) -> str:
+        s = f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+        if self.hint:
+            s += f" [hint: {self.hint}]"
+        return s
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class SourceError(Exception):
+    """A file that should lint but cannot even be parsed."""
+
+
+class Source:
+    """One parsed Python file: AST with parent links, raw lines, and the
+    comment text per line (end-of-line comments carry annotations)."""
+
+    def __init__(self, path: Path, rel: str, text: Optional[str] = None):
+        self.path = path
+        self.rel = rel.replace("\\", "/")
+        if text is None:
+            text = path.read_text(encoding="utf-8")
+        self.text = text
+        self.lines = text.splitlines()
+        try:
+            self.tree = ast.parse(text, filename=str(path))
+        except SyntaxError as e:
+            raise SourceError(f"{rel}: syntax error at line {e.lineno}: "
+                              f"{e.msg}") from e
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._mtlint_parent = parent  # type: ignore[attr-defined]
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except tokenize.TokenError:
+            pass  # trailing-garbage tolerable; the ast parse already passed
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                hint: str = "") -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.rel, line=line, col=col,
+                       message=message, hint=hint,
+                       code=self.line_text(line))
+
+    def suppressed(self, finding: Finding) -> bool:
+        """`# mtlint: ok` / `# mtlint: disable=MT-XXX[,MT-YYY]` on the
+        finding's line (an optional trailing reason after ' -- ' is for
+        humans). Family prefixes work: disable=MT-DTYPE covers both
+        MT-DTYPE-LITERAL and MT-DTYPE-ARRAY."""
+        comment = self.comments.get(finding.line, "")
+        if not comment.startswith(SUPPRESS_TAG):
+            return False
+        body = comment[len(SUPPRESS_TAG):].split("--", 1)[0].strip()
+        if body == "ok" or body.startswith("ok "):
+            return True
+        if body.startswith("disable="):
+            rules = [r.strip() for r in body[len("disable="):].split(",")]
+            return any(finding.rule == r or finding.rule.startswith(r + "-")
+                       for r in rules if r)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rules
+# ---------------------------------------------------------------------------
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_mtlint_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    p = parent(node)
+    while p is not None:
+        yield p
+        p = parent(p)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def names_in(node: ast.AST) -> set:
+    """All bare Name identifiers read anywhere under `node`."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Literal int / tuple-or-list-of-ints, e.g. donate_argnums=(0, 1)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None
+
+
+def const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# configuration — pyproject.toml [tool.mtlint]
+# ---------------------------------------------------------------------------
+
+# Directory scoping defaults (overridable from pyproject): rules whose cost/
+# noise profile only makes sense on specific layers run only there.
+DEFAULT_RULE_DIRS: Dict[str, List[str]] = {
+    # host-sync: files "marked hot" — the decode/train/op layers where an
+    # accidental device->host transfer costs a pipeline stall
+    "host-sync": ["marian_tpu/ops", "marian_tpu/translator",
+                  "marian_tpu/training"],
+    # dtype hygiene: bf16 compute paths
+    "dtype": ["marian_tpu/ops", "marian_tpu/layers"],
+    # guarded-by: the threaded layers
+    "guarded-by": ["marian_tpu/serving", "marian_tpu/training"],
+    # everywhere: trace-safety, donation, metrics
+    "trace-safety": [],
+    "donation": [],
+    "metrics": [],
+}
+
+DEFAULT_EXCLUDE = ["marian_tpu/analysis"]
+
+
+@dataclasses.dataclass
+class Config:
+    root: Path
+    exclude: List[str] = dataclasses.field(
+        default_factory=lambda: list(DEFAULT_EXCLUDE))
+    rule_dirs: Dict[str, List[str]] = dataclasses.field(
+        default_factory=lambda: {k: list(v)
+                                 for k, v in DEFAULT_RULE_DIRS.items()})
+    disabled: List[str] = dataclasses.field(default_factory=list)
+
+    def family_enabled(self, family: str) -> bool:
+        return family not in self.disabled
+
+    def family_applies(self, family: str, rel: str) -> bool:
+        if not self.family_enabled(family):
+            return False
+        dirs = self.rule_dirs.get(family, [])
+        if not dirs:
+            return True
+        rel = rel.replace("\\", "/")
+        return any(rel == d or rel.startswith(d.rstrip("/") + "/")
+                   for d in dirs)
+
+    def excluded(self, rel: str) -> bool:
+        rel = rel.replace("\\", "/")
+        return any(rel == d or rel.startswith(d.rstrip("/") + "/")
+                   for d in self.exclude)
+
+    @classmethod
+    def load(cls, root: Path) -> "Config":
+        cfg = cls(root=root)
+        pyproject = root / "pyproject.toml"
+        if not pyproject.exists():
+            return cfg
+        data = _read_toml_tables(pyproject.read_text(encoding="utf-8"))
+        top = data.get("tool.mtlint", {})
+        if "exclude" in top:
+            cfg.exclude = list(top["exclude"])
+        if "disable" in top:
+            cfg.disabled = list(top["disable"])
+        # per-directory rule enablement: [tool.mtlint.rules.<family>]
+        # dirs = [...] limits the family to those directory prefixes
+        # (empty list = run everywhere); enabled = false turns it off.
+        for table, kv in data.items():
+            prefix = "tool.mtlint.rules."
+            if not table.startswith(prefix):
+                continue
+            family = table[len(prefix):]
+            if kv.get("enabled") is False and family not in cfg.disabled:
+                cfg.disabled.append(family)
+            if "dirs" in kv:
+                cfg.rule_dirs[family] = list(kv["dirs"])
+        return cfg
+
+
+def _read_toml_tables(text: str) -> Dict[str, Dict]:
+    """Minimal TOML-subset reader (this tree runs Python 3.10 — no tomllib,
+    and mtlint must stay dependency-free). Supports [table.headers] and
+    `key = value` with string / bool / int / float / array-of-strings
+    values, including multi-line arrays. Unknown value shapes are skipped,
+    never fatal — mtlint only consumes the [tool.mtlint*] tables."""
+    tables: Dict[str, Dict] = {}
+    current: Optional[Dict] = None
+    pending_key: Optional[str] = None
+    pending_buf = ""
+    for raw in text.splitlines():
+        line = raw.strip()
+        if pending_key is not None:
+            pending_buf += " " + line
+            if _brackets_balanced(pending_buf):
+                if current is not None:
+                    val = _parse_toml_value(pending_buf)
+                    if val is not None:
+                        current[pending_key] = val
+                pending_key, pending_buf = None, ""
+            continue
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            name = line.strip("[]").strip().strip('"')
+            current = tables.setdefault(name, {})
+            continue
+        if current is None or "=" not in line:
+            continue
+        key, _, rhs = line.partition("=")
+        key, rhs = key.strip().strip('"'), rhs.strip()
+        if rhs.startswith("[") and not _brackets_balanced(rhs):
+            pending_key, pending_buf = key, rhs
+            continue
+        val = _parse_toml_value(rhs)
+        if val is not None:
+            current[key] = val
+    return tables
+
+
+def _brackets_balanced(s: str) -> bool:
+    depth = 0
+    in_str: Optional[str] = None
+    for ch in s:
+        if in_str:
+            if ch == in_str:
+                in_str = None
+        elif ch in "\"'":
+            in_str = ch
+        elif ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        elif ch == "#" and depth == 0:
+            break
+    return depth <= 0
+
+
+def _parse_toml_value(rhs: str):
+    rhs = rhs.strip()
+    if rhs.startswith("["):
+        end = rhs.rfind("]")
+        if end < 0:
+            return None
+        items = []
+        for piece in _split_toml_array(rhs[1:end]):
+            piece = piece.strip()
+            if not piece:
+                continue
+            v = _parse_toml_value(piece)
+            if v is None:
+                return None
+            items.append(v)
+        return items
+    if rhs[:1] in "\"'":
+        q = rhs[0]
+        end = rhs.find(q, 1)
+        return rhs[1:end] if end > 0 else None
+    word = rhs.split("#", 1)[0].strip()
+    if word == "true":
+        return True
+    if word == "false":
+        return False
+    try:
+        return int(word)
+    except ValueError:
+        pass
+    try:
+        return float(word)
+    except ValueError:
+        return None
+
+
+def _split_toml_array(body: str) -> List[str]:
+    parts, buf, in_str = [], "", None
+    for ch in body:
+        if in_str:
+            buf += ch
+            if ch == in_str:
+                in_str = None
+        elif ch in "\"'":
+            in_str = ch
+            buf += ch
+        elif ch == ",":
+            parts.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    parts.append(buf)
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: Path) -> Counter:
+    """Baseline file -> Counter of finding keys (duplicates counted)."""
+    if not path.exists():
+        return Counter()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    keys: Counter = Counter()
+    for item in data.get("findings", []):
+        keys[(item["rule"], item["path"], item.get("code", ""))] += 1
+    return keys
+
+
+def write_baseline(findings: Sequence[Finding], path: Path) -> None:
+    items = [{"rule": f.rule, "path": f.path, "line": f.line,
+              "code": f.code, "message": f.message}
+             for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))]
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": "Pre-existing mtlint findings suppressed from the tier-1 "
+                   "gate. Regenerate with scripts/mtlint.py --update-baseline "
+                   "(see docs/STATIC_ANALYSIS.md). Fix entries out of this "
+                   "file; never add to it to get a PR green.",
+        "findings": items,
+    }
+    path.write_text(json.dumps(payload, indent=1) + "\n", encoding="utf-8")
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Counter) -> Tuple[List[Finding], List[Finding]]:
+    """-> (new findings, baselined findings). Each baseline entry absorbs at
+    most as many findings as it was recorded times."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+def collect_sources(paths: Sequence[Path], config: Config,
+                    errors: Optional[List[str]] = None) -> List[Source]:
+    files: List[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    sources: List[Source] = []
+    seen = set()
+    for f in files:
+        try:
+            rel = f.resolve().relative_to(config.root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        if rel in seen or config.excluded(rel):
+            continue
+        seen.add(rel)
+        try:
+            sources.append(Source(f, rel))
+        except (SourceError, OSError, UnicodeDecodeError) as e:
+            if errors is not None:
+                errors.append(str(e))
+    return sources
+
+
+def run_lint(paths: Sequence[Path], config: Config,
+             rule_filter: Optional[Sequence[str]] = None,
+             errors: Optional[List[str]] = None) -> List[Finding]:
+    """Run every registered rule over the given files/dirs; returns findings
+    sorted by location with inline-suppressed ones removed."""
+    from .rules import all_rules
+    sources = collect_sources(paths, config, errors=errors)
+    by_rel = {s.rel: s for s in sources}
+    findings: List[Finding] = []
+    for rule in all_rules():
+        if rule_filter and rule.family not in rule_filter:
+            continue
+        if not config.family_enabled(rule.family):
+            continue
+        if rule.scope == "project":
+            scoped = [s for s in sources
+                      if config.family_applies(rule.family, s.rel)]
+            findings.extend(rule.check_project(scoped, config))
+        else:
+            for src in sources:
+                if config.family_applies(rule.family, src.rel):
+                    findings.extend(rule.check(src, config))
+    findings = [f for f in findings
+                if not (f.path in by_rel and by_rel[f.path].suppressed(f))]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

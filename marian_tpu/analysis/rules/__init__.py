@@ -1,0 +1,52 @@
+"""mtlint rule registry. A rule family is one module exporting Rule
+subclasses registered with @register; `all_rules()` imports every family
+module once and returns the instances (stable order: registration order)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Config, Finding, Source
+
+
+class Rule:
+    """Base class. `family` groups ids for config scoping ("trace-safety",
+    "host-sync", "donation", "dtype", "guarded-by", "metrics"); `scope` is
+    "file" (check per Source) or "project" (check_project over all in-scope
+    sources at once — cross-file rules like metrics hygiene)."""
+
+    family: str = ""
+    ids: tuple = ()           # rule ids this family can emit (docs/tests)
+    scope: str = "file"
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        return []
+
+    def check_project(self, sources: List[Source],
+                      config: Config) -> List[Finding]:
+        return []
+
+
+_RULES: List[Rule] = []
+
+
+def register(cls):
+    _RULES.append(cls())
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    _load()
+    return list(_RULES)
+
+
+_loaded = False
+
+
+def _load() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from . import (trace_safety, host_sync, donation,  # noqa: F401
+                   dtype_hygiene, guarded_by, metrics_hygiene)

@@ -1,0 +1,159 @@
+"""donation (MT-DONATE-READ): use-after-donate.
+
+`jax.jit(..., donate_argnums=(i,))` hands argument i's device buffer to the
+compiled program — after the call the caller's reference is a deleted
+buffer, and touching it raises (or, on some backends, silently reads
+garbage). The classic bug shape:
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    new_params = step(params, batch)
+    log_norm(params)          # <- donated buffer
+
+The pass maps names bound to jit-wrapped callables with literal
+donate_argnums, then flags reads of a donated (dotted) argument name after
+the call in the same function body, unless the name was reassigned first —
+the standard `params = step(params, ...)` rebinding is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (Config, Finding, Source, call_name, const_int_tuple,
+                    dotted_name, parent)
+from . import Rule, register
+
+
+def _donating_bindings(tree: ast.Module) -> Dict[str, Set[int]]:
+    """name -> donated positions, for `X = jax.jit(f, donate_argnums=...)`
+    and `X = pjit(f, donate_argnums=...)` bindings (incl. self.X)."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        fn = call_name(node.value) or ""
+        if fn.split(".")[-1] not in ("jit", "pjit"):
+            continue
+        donated: Set[int] = set()
+        for kw in node.value.keywords:
+            if kw.arg == "donate_argnums":
+                vals = const_int_tuple(kw.value)
+                if vals is None and isinstance(kw.value, ast.IfExp):
+                    # `donate_argnums=(0, 1) if flag else ()` — take the
+                    # donating branch: a MAY-donate read is still a bug
+                    vals = (const_int_tuple(kw.value.body)
+                            or const_int_tuple(kw.value.orelse))
+                donated.update(vals or ())
+        if not donated:
+            continue
+        for t in node.targets:
+            name = dotted_name(t)
+            if name:
+                out[name] = donated
+    return out
+
+
+def _enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    p = parent(node)
+    while p is not None and not isinstance(
+            p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        p = parent(p)
+    return p
+
+
+def _assign_targets(stmt: ast.AST) -> Set[str]:
+    targets: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        tlist = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        tlist = [stmt.target]
+    else:
+        return targets
+    for t in tlist:
+        for n in ast.walk(t):
+            d = dotted_name(n)
+            if d:
+                targets.add(d)
+    return targets
+
+
+@register
+class DonationRule(Rule):
+    family = "donation"
+    ids = ("MT-DONATE-READ",)
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        donating = _donating_bindings(src.tree)
+        if not donating:
+            return []
+        findings: List[Finding] = []
+        for fn in ast.walk(src.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(src, fn, donating))
+        return findings
+
+    def _check_fn(self, src: Source, fn: ast.AST,
+                  donating: Dict[str, Set[int]]) -> List[Finding]:
+        # donated-arg call sites in this function:
+        # (call END line — a multi-line call's own args are not "after" it,
+        #  arg name, callee)
+        donated_at: List[Tuple[int, str, str]] = []
+        for node in ast.walk(fn):
+            if _enclosing_function(node) is not fn:
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee not in donating:
+                continue
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for pos in donating[callee]:
+                if pos < len(node.args):
+                    arg = dotted_name(node.args[pos])
+                    if arg:
+                        # `x = step(x, ...)` rebinding in the same statement
+                        stmt = parent(node)
+                        while stmt is not None and not isinstance(
+                                stmt, ast.stmt):
+                            stmt = parent(stmt)
+                        if stmt is not None and arg in _assign_targets(stmt):
+                            continue
+                        donated_at.append((end, arg, callee))
+        if not donated_at:
+            return []
+        # reassignment lines per dotted name, read lines per dotted name
+        reassigned: Dict[str, List[int]] = {}
+        reads: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(fn):
+            if _enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for name in _assign_targets(node):
+                    reassigned.setdefault(name, []).append(node.lineno)
+            d = dotted_name(node)
+            if d and isinstance(getattr(node, "ctx", None), ast.Load):
+                reads.setdefault(d, []).append(node)
+        out: List[Finding] = []
+        flagged = set()
+        for call_line, arg, callee in donated_at:
+            for read in reads.get(arg, []):
+                if read.lineno <= call_line:
+                    continue
+                # a reassignment between the call and the read cleans it
+                if any(call_line <= ln <= read.lineno
+                       for ln in reassigned.get(arg, [])):
+                    continue
+                key = (arg, read.lineno)
+                if key in flagged:
+                    continue
+                flagged.add(key)
+                out.append(src.finding(
+                    "MT-DONATE-READ", read,
+                    f"`{arg}` read after being passed to `{callee}` in a "
+                    f"donate_argnums position (line {call_line}) — the "
+                    f"buffer was donated to the compiled program",
+                    hint="rebind the result over the donated name, or drop "
+                         "the argument from donate_argnums"))
+        return out

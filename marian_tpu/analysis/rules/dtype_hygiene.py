@@ -1,0 +1,212 @@
+"""dtype-hygiene (MT-DTYPE-*): bf16-upcast hazards in the compute layers.
+
+On TPU the compute dtype is bf16 wherever we can get away with it; one
+f32-dtyped operand silently promotes the whole surrounding expression and
+the MXU runs at 1/4 rate (see docs/PERFORMANCE.md). Two statically
+detectable shapes, checked in the configured dtype dirs (ops/, layers/):
+
+- MT-DTYPE-LITERAL: arithmetic mixing a bare Python float literal with an
+  array whose dtype is not locally pinned. JAX's weak typing makes
+  `0.5 * x` harmless when `x` really is bf16 — the hazard is that nothing
+  in the expression says what `x` is, so an upstream f32 (a mask built with
+  a float32 default, a numpy leak) upcasts the whole chain unnoticed. An
+  operand whose dtype is locally evident (`x.astype(d)`, `jnp.zeros(...,
+  dtype=d)`, a value assigned from either) is exempt: the literal then
+  provably follows the pinned dtype.
+
+- MT-DTYPE-ARRAY: `jnp.array/zeros/ones/full/empty(...)` without an
+  explicit dtype — these default to f32 (or weak int), and a f32 constant
+  table multiplied into a bf16 activation is exactly the silent upcast.
+
+The inference is per-function and flow-insensitive on purpose: it must
+never claim more than the source text shows a reader.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Config, Finding, Source, ancestors, call_name
+from . import Rule, register
+
+# classification lattice values
+SCALAR = "scalar"
+ARRAY = "array"          # array-typed, dtype not locally evident
+PINNED = "pinned"        # array-typed, dtype locally pinned
+UNKNOWN = "unknown"
+
+# jnp constructors with a positional dtype slot (index into args)
+CTOR_DTYPE_SLOT = {"array": 1, "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                   "asarray": 1}
+# constructors MT-DTYPE-ARRAY requires an explicit dtype on (asarray is
+# exempt: passing an existing array through preserves its dtype by design)
+CTOR_REQUIRE_DTYPE = {"array", "zeros", "ones", "empty", "full"}
+# calls that follow their argument's dtype
+LIKE_CTORS = {"zeros_like", "ones_like", "full_like", "empty_like"}
+SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "complex"}
+
+
+def _dtype_given(node: ast.Call, tail: str) -> bool:
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return True
+    slot = CTOR_DTYPE_SLOT.get(tail)
+    return slot is not None and len(node.args) > slot
+
+
+class _Classifier:
+    def __init__(self, env: Dict[str, str]):
+        self.env = env
+
+    def classify(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant):
+            return SCALAR
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self.classify(node.value)
+        if isinstance(node, ast.UnaryOp):
+            return self.classify(node.operand)
+        if isinstance(node, ast.BinOp):
+            left, right = self.classify(node.left), self.classify(node.right)
+            if PINNED in (left, right):
+                return PINNED
+            if ARRAY in (left, right):
+                return ARRAY
+            if left == right == SCALAR:
+                return SCALAR
+            return UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._classify_call(node)
+        if isinstance(node, ast.Attribute):
+            # x.T / x.dtype etc: attribute of an array is not itself known
+            return UNKNOWN
+        return UNKNOWN
+
+    def _classify_call(self, node: ast.Call) -> str:
+        name = call_name(node) or ""
+        parts = name.split(".")
+        if parts[-1] == "astype":
+            return PINNED
+        root, tail = parts[0], parts[-1]
+        if root in ("jnp", "jax"):
+            if _dtype_given(node, tail):
+                return PINNED
+            if tail in LIKE_CTORS and node.args:
+                return self.classify(node.args[0])
+            # elementwise/reduction jnp ops preserve a pinned operand
+            if any(self.classify(a) == PINNED for a in node.args):
+                return PINNED
+            return ARRAY
+        return UNKNOWN
+
+
+def _annotation_class(ann: Optional[ast.AST]) -> str:
+    if ann is None:
+        return UNKNOWN
+    src = ast.dump(ann)
+    if any(f"'{t}'" in src for t in SCALAR_ANNOTATIONS) \
+            and "Array" not in src:
+        return SCALAR
+    if "Array" in src or "'jnp'" in src or "'jax'" in src:
+        return ARRAY
+    return UNKNOWN
+
+
+def _build_env(fn: ast.AST, classifier_env: Dict[str, str]) -> Dict[str, str]:
+    env = dict(classifier_env)
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+        for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]:
+            env[p.arg] = _annotation_class(p.annotation)
+    cls = _Classifier(env)
+    # two passes: simple forward propagation through assignments
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                env[node.targets[0].id] = cls.classify(node.value)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                c = _annotation_class(node.annotation)
+                if c == UNKNOWN and node.value is not None:
+                    c = cls.classify(node.value)
+                env[node.target.id] = c
+    return env
+
+
+def _under_astype(node: ast.AST) -> bool:
+    """Literal arithmetic that is immediately recast (`(...).astype(d)`)
+    cannot leak its promoted dtype downstream."""
+    for anc in ancestors(node):
+        if isinstance(anc, ast.Attribute) and anc.attr == "astype":
+            return True
+        if isinstance(anc, ast.stmt):
+            break
+    return False
+
+
+@register
+class DtypeHygieneRule(Rule):
+    family = "dtype"
+    ids = ("MT-DTYPE-LITERAL", "MT-DTYPE-ARRAY")
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_ctors(src))
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_literals(src, node))
+        return findings
+
+    def _check_ctors(self, src: Source) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            parts = name.split(".")
+            if parts[0] != "jnp" or parts[-1] not in CTOR_REQUIRE_DTYPE:
+                continue
+            if not _dtype_given(node, parts[-1]):
+                out.append(src.finding(
+                    "MT-DTYPE-ARRAY", node,
+                    f"`{name}(...)` without an explicit dtype — defaults to "
+                    f"f32 and silently upcasts any bf16 arithmetic it "
+                    f"touches",
+                    hint="pass dtype= (the compute dtype, or the operand's "
+                         "x.dtype)"))
+        return out
+
+    def _check_literals(self, src: Source, fn: ast.AST) -> List[Finding]:
+        env = _build_env(fn, {})
+        cls = _Classifier(env)
+        out: List[Finding] = []
+        seen = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.BinOp):
+                continue
+            sides = [(node.left, node.right), (node.right, node.left)]
+            for lit, other in sides:
+                if not (isinstance(lit, ast.Constant)
+                        and isinstance(lit.value, float)):
+                    continue
+                if cls.classify(other) != ARRAY:
+                    continue
+                if _under_astype(node):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(src.finding(
+                    "MT-DTYPE-LITERAL", node,
+                    f"float literal `{lit.value}` in arithmetic with an "
+                    f"array of locally-unknown dtype — if the array is ever "
+                    f"f32 (mask default, numpy leak) the whole chain "
+                    f"upcasts off the bf16 path",
+                    hint="pin the array operand's dtype in this expression "
+                         "(x.astype(d) / a dtype= constructor), or "
+                         "`# mtlint: ok -- <why the dtype is safe>`"))
+                break
+        return out

@@ -1,0 +1,130 @@
+"""guarded-by (MT-LOCK-*): a lightweight static race detector for the
+threaded layers (serving/, training/).
+
+Convention (docs/STATIC_ANALYSIS.md): an instance attribute whose
+assignment line carries
+
+    self._queued = 0            # guarded-by: _state_lock
+
+may only be touched inside `with self._state_lock:` anywhere in the class.
+`__init__` is exempt (construction happens-before publication to other
+threads). A helper that is documented to be called with the lock already
+held declares it on its `def` line (or the line above):
+
+    def _sweep_locked(self):    # mtlint: holds _state_lock
+
+MT-LOCK-GUARD fires on any other access. MT-LOCK-UNKNOWN fires when an
+annotation names a lock the class never assigns — a stale annotation is
+worse than none.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import Config, Finding, Source, ancestors, dotted_name
+from . import Rule, register
+
+GUARD_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+HOLDS_RE = re.compile(r"mtlint:\s*holds\s+([A-Za-z_][A-Za-z0-9_]*)")
+EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _held_locks(src: Source, fn: ast.FunctionDef) -> Set[str]:
+    held: Set[str] = set()
+    for line in (fn.lineno, fn.lineno - 1):
+        m = HOLDS_RE.search(src.comments.get(line, ""))
+        if m:
+            held.add(m.group(1))
+    return held
+
+
+def _locks_in_scope(node: ast.AST, fn: ast.AST) -> Set[str]:
+    """Locks held at `node` by lexically-enclosing with-blocks inside fn."""
+    held: Set[str] = set()
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                d = dotted_name(item.context_expr)
+                if d and d.startswith("self."):
+                    held.add(d[len("self."):])
+        if anc is fn:
+            break
+    return held
+
+
+@register
+class GuardedByRule(Rule):
+    family = "guarded-by"
+    ids = ("MT-LOCK-GUARD", "MT-LOCK-UNKNOWN")
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(src, node))
+        return findings
+
+    def _check_class(self, src: Source, cls: ast.ClassDef) -> List[Finding]:
+        guarded: Dict[str, str] = {}       # attr -> lock name
+        assigned_attrs: Set[str] = set()
+        annotation_nodes: Dict[str, ast.AST] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    assigned_attrs.add(attr)
+                    m = GUARD_RE.search(src.comments.get(node.lineno, ""))
+                    if m:
+                        guarded[attr] = m.group(1)
+                        annotation_nodes[attr] = node
+        if not guarded:
+            return []
+        findings: List[Finding] = []
+        for attr, lock in guarded.items():
+            if lock not in assigned_attrs:
+                findings.append(src.finding(
+                    "MT-LOCK-UNKNOWN", annotation_nodes[attr],
+                    f"`{attr}` is annotated guarded-by: {lock}, but the "
+                    f"class never assigns `self.{lock}`",
+                    hint="fix the annotation or create the lock in "
+                         "__init__"))
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in EXEMPT_METHODS:
+                continue
+            declared_held = _held_locks(src, fn)
+            for node in ast.walk(fn):
+                attr = _self_attr(node)
+                if attr is None or attr not in guarded:
+                    continue
+                lock = guarded[attr]
+                if lock in declared_held:
+                    continue
+                if lock in _locks_in_scope(node, fn):
+                    continue
+                access = ("write" if isinstance(getattr(node, "ctx", None),
+                                                (ast.Store, ast.Del))
+                          else "read")
+                findings.append(src.finding(
+                    "MT-LOCK-GUARD", node,
+                    f"{access} of `self.{attr}` in `{fn.name}` outside "
+                    f"`with self.{lock}:` (annotated guarded-by: {lock})",
+                    hint=f"wrap the access in `with self.{lock}:`, or mark "
+                         f"the method `# mtlint: holds {lock}` if every "
+                         f"caller provably holds it"))
+        return findings

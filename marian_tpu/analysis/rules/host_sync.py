@@ -1,0 +1,133 @@
+"""host-sync (MT-SYNC-*): hidden host<->device synchronization in hot files.
+
+Two patterns, both restricted to directories marked hot in [tool.mtlint]
+(ops/, translator/, training/ by default):
+
+- MT-SYNC-TIMER: a function brackets work between two wall-clock reads
+  (`time.perf_counter` / `time.time` / `time.monotonic`) but never calls
+  `block_until_ready`. Under JAX's async dispatch the second read fires
+  when the work is ENQUEUED, not done — the timer measures dispatch, and
+  the first later sync silently absorbs the real device time. (A function
+  that deliberately measures wall-clock across a deferred-sync window
+  should say so with `# mtlint: ok -- reason`.)
+
+- MT-SYNC-TRANSFER: implicit device->host transfers on the hot path:
+  `np.asarray(x)` / `np.array(x)` on a non-literal, `.tolist()`, and
+  `print(...)` of non-constant values. Each is a blocking round-trip that
+  stalls the dispatch pipeline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Config, Finding, Source, call_name
+from . import Rule, register
+
+TIMER_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "perf_counter", "monotonic"}
+TRANSFER_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+SYNC_MARKERS = ("block_until_ready",)
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes lexically in `fn` EXCLUDING nested def/async-def subtrees —
+    nested functions get their own visit, and their timer reads / sync
+    calls must not be attributed to the enclosing function (ast.walk
+    alone cannot prune a subtree)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_literalish(node: ast.AST) -> bool:
+    """Constants and (nested) tuples/lists of constants — np.array on these
+    is host-side data prep, not a device transfer."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literalish(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literalish(node.operand)
+    return False
+
+
+@register
+class HostSyncRule(Rule):
+    family = "host-sync"
+    ids = ("MT-SYNC-TIMER", "MT-SYNC-TRANSFER")
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_timers(src, node))
+        findings.extend(self._check_transfers(src))
+        return findings
+
+    def _check_timers(self, src: Source,
+                      fn: ast.FunctionDef) -> List[Finding]:
+        timer_calls = []
+        other_call_lines = []
+        synced = False
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name in TIMER_CALLS:
+                    timer_calls.append(node)
+                elif any(m in name for m in SYNC_MARKERS) or \
+                        (isinstance(node.func, ast.Attribute)
+                         and node.func.attr in SYNC_MARKERS):
+                    synced = True
+                else:
+                    other_call_lines.append(node.lineno)
+        if synced or len(timer_calls) < 2:
+            return []
+        timer_calls.sort(key=lambda n: n.lineno)
+        first, last = timer_calls[0].lineno, timer_calls[-1].lineno
+        if not any(first < ln < last for ln in other_call_lines):
+            return []  # nothing measured between the reads
+        return [src.finding(
+            "MT-SYNC-TIMER", timer_calls[-1],
+            f"`{fn.name}` times work between wall-clock reads without "
+            f"block_until_ready — async dispatch makes this measure "
+            f"enqueue time, not device time",
+            hint="jax.block_until_ready(result) before the closing read, "
+                 "or annotate a deliberate deferred-sync window with "
+                 "`# mtlint: ok -- reason`")]
+
+    def _check_transfers(self, src: Source) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            if name in TRANSFER_NP and node.args \
+                    and not _is_literalish(node.args[0]):
+                out.append(src.finding(
+                    "MT-SYNC-TRANSFER", node,
+                    f"`{name}(...)` on the hot path — if the argument is a "
+                    f"device array this is a blocking device->host copy",
+                    hint="keep hot-path data in jnp, or move the transfer "
+                         "behind an explicit sync boundary"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "tolist":
+                out.append(src.finding(
+                    "MT-SYNC-TRANSFER", node,
+                    "`.tolist()` on the hot path — blocking device->host "
+                    "transfer plus Python object materialization",
+                    hint="use np.asarray at an explicit sync point instead"))
+            elif name == "print" and node.args \
+                    and not all(_is_literalish(a) for a in node.args):
+                out.append(src.finding(
+                    "MT-SYNC-TRANSFER", node,
+                    "`print(...)` of computed values on the hot path — "
+                    "printing a device array blocks on its result",
+                    hint="log at a sync boundary (common.logging), or print "
+                         "only host scalars"))
+        return out

@@ -1,0 +1,138 @@
+"""metrics-hygiene (MT-METRIC-*): the Prometheus registry and the code that
+emits into it must agree — project-scoped (cross-file) analysis.
+
+- MT-METRIC-UNUSED: a metric registered via a Registry factory
+  (`.counter("name", ...)` / `.gauge` / `.histogram`, or the module-level
+  conveniences) whose binding is never emitted into anywhere in the tree
+  (no .inc/.dec/.set/.observe/.set_function/.labels). Dead series still
+  render on every /metrics scrape and rot into dashboards nobody can
+  populate.
+
+- MT-METRIC-UNREG: an emission on a metric-shaped binding (`m_*` / `_m_*`
+  naming convention) that was never bound from a Registry factory —
+  including direct `Counter(...)` construction, which bypasses the registry
+  so the series silently never appears on /metrics.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Config, Finding, Source, call_name, dotted_name, parent
+from . import Rule, register
+
+FACTORY_METHODS = {"counter", "gauge", "histogram"}
+EMIT_METHODS = {"inc", "dec", "set", "observe", "set_function", "labels"}
+DIRECT_CLASSES = {"Counter", "Gauge", "Histogram"}
+
+
+def _binding_segment(call: ast.Call) -> Optional[str]:
+    """Last attribute/name segment the call result is assigned to
+    (`self._m_fill = r.histogram(...)` -> "_m_fill")."""
+    stmt = parent(call)
+    if isinstance(stmt, ast.Assign) and stmt.value is call:
+        for t in stmt.targets:
+            d = dotted_name(t)
+            if d:
+                return d.split(".")[-1]
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is call:
+        d = dotted_name(stmt.target)
+        if d:
+            return d.split(".")[-1]
+    return None
+
+
+def _emission_receiver(node: ast.Call) -> Optional[str]:
+    """Receiver segment of `<recv>.inc()` — follows one `.labels(...)`
+    chain link (`self.m_shed.labels("x").inc()` -> "m_shed")."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    recv = node.func.value
+    if isinstance(recv, ast.Call) and isinstance(recv.func, ast.Attribute) \
+            and recv.func.attr == "labels":
+        recv = recv.func.value
+    d = dotted_name(recv)
+    if d is None:
+        return None
+    return d.split(".")[-1]
+
+
+def _metric_shaped(segment: str) -> bool:
+    return segment.startswith("m_") or segment.startswith("_m_")
+
+
+@register
+class MetricsHygieneRule(Rule):
+    family = "metrics"
+    ids = ("MT-METRIC-UNUSED", "MT-METRIC-UNREG")
+    scope = "project"
+
+    def check_project(self, sources: List[Source],
+                      config: Config) -> List[Finding]:
+        # metric name -> [(source, call node, binding segment)]
+        registrations: Dict[str, List[Tuple[Source, ast.Call,
+                                            Optional[str]]]] = {}
+        emitted_segments: Set[str] = set()
+        emissions: List[Tuple[Source, ast.Call, str]] = []
+        direct_bound: Set[str] = set()
+
+        for src in sources:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node) or ""
+                tail = name.split(".")[-1]
+                if tail in FACTORY_METHODS and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    metric = node.args[0].value
+                    registrations.setdefault(metric, []).append(
+                        (src, node, _binding_segment(node)))
+                elif tail in DIRECT_CLASSES and name == tail:
+                    seg = _binding_segment(node)
+                    if seg:
+                        direct_bound.add(seg)
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in EMIT_METHODS:
+                    seg = _emission_receiver(node)
+                    if seg:
+                        emitted_segments.add(seg)
+                        emissions.append((src, node, seg))
+
+        registered_segments = {seg for regs in registrations.values()
+                               for (_s, _n, seg) in regs if seg}
+        findings: List[Finding] = []
+        for metric, regs in sorted(registrations.items()):
+            segments = [seg for (_s, _n, seg) in regs if seg]
+            if any(seg in emitted_segments for seg in segments):
+                continue
+            src, node, _seg = regs[0]
+            what = ("its binding is never emitted into"
+                    if segments else "its result is discarded")
+            findings.append(src.finding(
+                "MT-METRIC-UNUSED", node,
+                f"metric '{metric}' is registered but {what} — a dead "
+                f"series on every /metrics scrape",
+                hint="emit it (.inc/.observe/.set/.set_function) or delete "
+                     "the registration"))
+        seen: Set[Tuple[str, str, int]] = set()
+        for src, node, seg in emissions:
+            if not _metric_shaped(seg):
+                continue
+            if seg in registered_segments:
+                continue
+            key = (src.rel, seg, node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            why = ("bound by direct construction, bypassing the registry"
+                   if seg in direct_bound else
+                   "never bound from a registry factory")
+            findings.append(src.finding(
+                "MT-METRIC-UNREG", node,
+                f"emission on metric-shaped `{seg}` which is {why} — the "
+                f"series will never appear on /metrics",
+                hint="register it via Registry.counter/gauge/histogram "
+                     "(get-or-create) instead"))
+        return findings

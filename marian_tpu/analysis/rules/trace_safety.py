@@ -1,0 +1,253 @@
+"""trace-safety (MT-TRACE-*): Python control flow and host casts on traced
+values inside jit-compiled functions.
+
+Inside a function compiled by `jax.jit` / `pjit` / `shard_map`, the
+arguments are tracers: `if x > 0`, `while`, `int(x)`, `bool(x)`, `.item()`
+all force a concrete value — a ConcretizationTypeError at best, and at
+worst (when the value happens to be concrete at trace time, e.g. a captured
+constant) a silent RETRACE per distinct value, which is the classic
+accidental-recompile bug. `np.*` calls on traced values bounce the
+computation through the host.
+
+The analysis is a lightweight per-function taint pass: non-static
+parameters are tainted; assignments whose RHS mentions a tainted name
+propagate. Conservative where it must be (static_argnums/static_argnames
+literals are honored; `x is None` tests and isinstance() are trace-safe and
+skipped).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..core import (Config, Finding, Source, call_name, const_int_tuple,
+                    const_str_tuple, dotted_name, names_in, parent)
+from . import Rule, register
+
+JIT_TAILS = {"jit", "pjit", "shard_map"}
+
+# np.<attr> access that is trace-safe (dtypes/constants, not computation)
+NP_SAFE_ATTRS = {
+    "float32", "float64", "float16", "bfloat16", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_", "complex64",
+    "complex128", "dtype", "ndarray", "newaxis", "pi", "e", "inf", "nan",
+    "finfo", "iinfo", "issubdtype", "floating", "integer", "generic",
+}
+
+CAST_FUNCS = {"int", "float", "bool", "complex"}
+CAST_METHODS = {"item", "tolist", "__float__", "__int__"}
+
+# attributes of a tracer that are static metadata, not traced data:
+# `if x.ndim == 2` or `int(x.shape[0])` are trace-safe and idiomatic
+STATIC_ATTRS = {"dtype", "shape", "ndim", "size", "sharding", "aval",
+                "weak_type"}
+
+
+def _jit_decorator_info(dec: ast.AST) -> Optional[Tuple[Set[int], Set[str]]]:
+    """If `dec` marks the function as jit-compiled, return the static
+    argument (positions, names); else None."""
+    name = dotted_name(dec)
+    if name and name.split(".")[-1] in JIT_TAILS:
+        return set(), set()
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn is None:
+            return None
+        tail = fn.split(".")[-1]
+        if tail in JIT_TAILS:
+            return _static_args(dec)
+        if tail == "partial" and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner and inner.split(".")[-1] in JIT_TAILS:
+                return _static_args(dec)
+    return None
+
+
+def _static_args(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums.update(const_int_tuple(kw.value) or ())
+        elif kw.arg == "static_argnames":
+            names.update(const_str_tuple(kw.value) or ())
+    return nums, names
+
+
+def _wrapped_jit_functions(tree: ast.Module):
+    """`step = jax.jit(fn, ...)` at any level: map function NAME ->
+    (static positions, static names) so the def itself is checked."""
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = call_name(node)
+        if fn is None or fn.split(".")[-1] not in JIT_TAILS:
+            continue
+        if node.args and isinstance(node.args[0], ast.Name):
+            out[node.args[0].id] = _static_args(node)
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+def _tainted_params(fn: ast.FunctionDef, static_nums: Set[int],
+                    static_names: Set[str]) -> Set[str]:
+    params = _param_names(fn)
+    tainted = set()
+    for i, p in enumerate(params):
+        if i in static_nums or p in static_names or p in ("self", "cls"):
+            continue
+        # params annotated as Python scalars/strings are static by contract
+        ann = ([*fn.args.posonlyargs, *fn.args.args,
+                *fn.args.kwonlyargs][i].annotation)
+        if ann is not None:
+            ann_src = ast.dump(ann)
+            if any(f"'{t}'" in ann_src
+                   for t in ("int", "float", "bool", "str")) \
+                    and "Array" not in ann_src:
+                continue
+        tainted.add(p)
+    return tainted
+
+
+def _propagate(fn: ast.FunctionDef, tainted: Set[str]) -> Set[str]:
+    """Fixpoint over simple assignments and for-targets."""
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+                if value is None or not (names_in(value) & tainted):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+            elif isinstance(node, ast.For):
+                if names_in(node.iter) & tainted:
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+    return tainted
+
+
+def _traced_uses(node: ast.AST, tainted: Set[str]) -> bool:
+    """True if a tainted name is used as traced DATA under `node` — uses
+    that only read static metadata (`x.shape`, `x.dtype`, ...) don't
+    count."""
+    for n in ast.walk(node):
+        if not (isinstance(n, ast.Name) and n.id in tainted):
+            continue
+        p = parent(n)
+        if isinstance(p, ast.Attribute) and p.attr in STATIC_ATTRS:
+            continue
+        return True
+    return False
+
+
+def _test_is_trace_safe(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` and isinstance() branch on static
+    structure, not on traced values."""
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return True
+    if isinstance(test, ast.Call):
+        fn = call_name(test)
+        if fn in ("isinstance", "hasattr", "callable", "len"):
+            return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _test_is_trace_safe(test.operand)
+    if isinstance(test, ast.BoolOp):
+        return all(_test_is_trace_safe(v) for v in test.values)
+    return False
+
+
+@register
+class TraceSafetyRule(Rule):
+    family = "trace-safety"
+    ids = ("MT-TRACE-COND", "MT-TRACE-CAST", "MT-TRACE-NUMPY")
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        wrapped = _wrapped_jit_functions(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            statics: Optional[Tuple[Set[int], Set[str]]] = None
+            for dec in node.decorator_list:
+                statics = _jit_decorator_info(dec)
+                if statics is not None:
+                    break
+            if statics is None and node.name in wrapped:
+                statics = wrapped[node.name]
+            if statics is None:
+                continue
+            findings.extend(self._check_jitted(src, node, *statics))
+        return findings
+
+    def _check_jitted(self, src: Source, fn: ast.FunctionDef,
+                      static_nums: Set[int],
+                      static_names: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        tainted = _propagate(fn, _tainted_params(fn, static_nums,
+                                                 static_names))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                if _test_is_trace_safe(node.test):
+                    continue
+                if _traced_uses(node.test, tainted):
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    out.append(src.finding(
+                        "MT-TRACE-COND", node.test,
+                        f"Python `{kw}` on a value traced through "
+                        f"jit-compiled `{fn.name}` — concretizes the tracer "
+                        f"(error) or retraces per value (recompile storm)",
+                        hint="use jnp.where/lax.cond/lax.while_loop, or mark "
+                             "the argument static"))
+            elif isinstance(node, ast.IfExp):
+                if not _test_is_trace_safe(node.test) \
+                        and _traced_uses(node.test, tainted):
+                    out.append(src.finding(
+                        "MT-TRACE-COND", node.test,
+                        f"conditional expression on a traced value inside "
+                        f"jit-compiled `{fn.name}`",
+                        hint="use jnp.where(cond, a, b)"))
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in CAST_FUNCS and node.args \
+                        and _traced_uses(node.args[0], tainted):
+                    out.append(src.finding(
+                        "MT-TRACE-CAST", node,
+                        f"`{name}()` on a traced value inside jit-compiled "
+                        f"`{fn.name}` — forces host concretization",
+                        hint="keep it on-device (jnp cast) or mark static"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in CAST_METHODS \
+                        and _traced_uses(node.func.value, tainted):
+                    out.append(src.finding(
+                        "MT-TRACE-CAST", node,
+                        f"`.{node.func.attr}()` on a traced value inside "
+                        f"jit-compiled `{fn.name}` — host sync under trace",
+                        hint="return the array and convert outside jit"))
+                elif name is not None and name.split(".")[0] in ("np",
+                                                                 "numpy"):
+                    attr = name.split(".", 1)[1] if "." in name else ""
+                    if attr.split(".")[0] not in NP_SAFE_ATTRS:
+                        out.append(src.finding(
+                            "MT-TRACE-NUMPY", node,
+                            f"`{name}(...)` inside jit-compiled `{fn.name}` "
+                            f"— numpy executes on host at trace time "
+                            f"(constant-folded or concretization error)",
+                            hint="use the jnp equivalent"))
+        return out

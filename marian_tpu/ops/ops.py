@@ -156,9 +156,13 @@ def masked_log_softmax(logits: jax.Array, mask: Optional[jax.Array] = None,
 
 def masked_softmax(logits: jax.Array, mask: Optional[jax.Array] = None,
                    axis: int = -1) -> jax.Array:
-    """Softmax with additive log-mask (reference: gpu::Softmax with mask)."""
+    """Softmax with additive log-mask (reference: gpu::Softmax with mask).
+    The mask is pinned to the logits dtype before the arithmetic: masks
+    are routinely built f32 (causal_mask's default), and an f32 mask would
+    silently promote the whole bf16 softmax chain (mtlint MT-DTYPE-LITERAL;
+    0/1 mask values are exact in every dtype, so the cast is lossless)."""
     if mask is not None:
-        logits = logits + (1.0 - mask) * NEG_INF
+        logits = logits + (1.0 - mask.astype(logits.dtype)) * NEG_INF
     return jax.nn.softmax(logits, axis=axis)
 
 

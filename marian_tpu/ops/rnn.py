@@ -121,7 +121,7 @@ class GRU(Cell):
         z = jax.nn.sigmoid(xz + hz)
         r = jax.nn.sigmoid(xr + hr)
         hh = jnp.tanh(xh + r * hx)
-        h2 = z * h + (1.0 - z) * hh
+        h2 = z * h + (1.0 - z) * hh   # mtlint: ok -- z is sigmoid(h-chain): same dtype as h by construction; the weak literal follows it
         return h2, {"h": h2}
 
 
@@ -199,7 +199,7 @@ class SSRU(Cell):
         xw = _ln(xw, params, f"{prefix}_W_ln_scale", self.ln)
         f = jax.nn.sigmoid(affine(x, params[f"{prefix}_Wf"],
                                   params[f"{prefix}_bf"]))
-        return jnp.concatenate([f, (1.0 - f) * xw], axis=-1)
+        return jnp.concatenate([f, (1.0 - f) * xw], axis=-1)  # mtlint: ok -- f is sigmoid(affine(x)): same dtype as xw by construction
 
     def step(self, params, prefix, xp, state):
         d = self.dim

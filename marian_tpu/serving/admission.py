@@ -16,6 +16,7 @@ budget instead of fighting it.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Optional
 
@@ -48,8 +49,13 @@ class AdmissionController:
                  registry: Optional[msm.Registry] = None):
         self.max_queue_units = int(max_queue_units)
         self.depth_fn = depth_fn
-        self._draining = False
-        self._drain_started: Optional[float] = None
+        # drain state crosses threads: transports admit() on the event-loop
+        # thread, begin_drain() fires from a signal handler / main thread,
+        # and /readyz reads `draining` from the metrics scrape thread —
+        # lock discipline enforced by mtlint's guarded-by checker
+        self._lock = threading.Lock()
+        self._draining = False                  # guarded-by: _lock
+        self._drain_started: Optional[float] = None   # guarded-by: _lock
         r = registry if registry is not None else msm.REGISTRY
         self.m_admitted = r.counter(
             "marian_serving_admitted_sentences_total",
@@ -64,14 +70,15 @@ class AdmissionController:
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._lock:
+            return self._draining
 
     def admit(self, n_units: int) -> None:
         """Gate one request of ``n_units`` sentences; raises Overloaded
         instead of queueing when the bound would be exceeded or the server
         is draining. Admission is all-or-nothing per request — partial
         admission would split one client's reply across a shed boundary."""
-        if self._draining:
+        if self.draining:
             self.m_shed.labels("draining").inc()
             raise Overloaded("server is draining (shutting down); "
                              "retry against another replica",
@@ -88,6 +95,7 @@ class AdmissionController:
     def begin_drain(self) -> None:
         """Stop admitting; /readyz flips to 503 via the owner's ready_fn.
         Idempotent."""
-        if not self._draining:
-            self._draining = True
-            self._drain_started = time.time()
+        with self._lock:
+            if not self._draining:
+                self._draining = True
+                self._drain_started = time.time()

@@ -34,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import concurrent.futures
+import threading
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 from ..common import logging as log
@@ -56,7 +57,7 @@ def default_length_fn(line: str) -> int:
 class _Request:
     __slots__ = ("lines", "future", "priority", "arrival", "deadline",
                  "results", "remaining", "queued", "first_dispatch",
-                 "timeout_handle")
+                 "timeout_handle", "dead_accounted")
 
     def __init__(self, lines: List[str], future: "asyncio.Future",
                  priority: int, arrival: float, deadline: Optional[float]):
@@ -70,6 +71,12 @@ class _Request:
         self.queued = len(lines)        # units currently sitting in lanes
         self.first_dispatch: Optional[float] = None
         self.timeout_handle = None
+        # True once _on_request_done added this request's leftover queued
+        # units to the scheduler's dead count. future.done() flips at
+        # set_exception time but done-CALLBACKS run via call_soon — the
+        # forming pass can sweep units in that gap, and must only deduct
+        # from the dead count what the callback actually added.
+        self.dead_accounted = False
 
 
 class _Unit:
@@ -113,22 +120,30 @@ class ContinuousScheduler:
         self._executor = executor or concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="serve-device")
         self._own_executor = executor is None
-        # priority lanes: lane per priority value, highest served first
+        # priority lanes: lane per priority value, highest served first.
+        # Lanes are event-loop-thread-only; the COUNTERS below cross
+        # threads (the metrics HTTP scrape thread samples queued_units via
+        # the depth gauge's set_function) and carry a lock discipline that
+        # mtlint's guarded-by checker enforces (docs/STATIC_ANALYSIS.md).
         self._lanes: Dict[int, Deque[_Unit]] = collections.defaultdict(
             collections.deque)
-        self._queued = 0
+        self._state_lock = threading.Lock()
+        self._queued = 0                  # guarded-by: _state_lock
         # units in lanes whose request already resolved (timed out /
         # cancelled / failed): still physically queued until the next
         # forming pass sweeps them, but DEAD — admission must not shed
         # live traffic against them (a timeout storm would otherwise
         # convert directly into a shed storm while a long device batch
         # keeps the worker busy)
-        self._dead = 0
+        self._dead = 0                    # guarded-by: _state_lock
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
-        self._stopping = False
-        self._draining = False
         self._inflight = 0
+        # units currently on (or headed to) the device — loop-thread-only.
+        # stop() fails their futures: a cancelled worker never returns
+        # results for them, and their units left the lanes at forming
+        # time, so the lane sweep alone would leave their clients hanging.
+        self._inflight_units: List[_Unit] = []
 
         r = registry if registry is not None else msm.REGISTRY
         self.m_requests = r.counter(
@@ -173,12 +188,14 @@ class ContinuousScheduler:
     def start(self) -> None:
         """Start the worker on the RUNNING loop (call from a coroutine)."""
         if self._task is None:
-            self._stopping = False
             self._task = asyncio.ensure_future(self._run())
 
     async def stop(self) -> None:
-        """Hard stop: cancel the worker; queued requests fail."""
-        self._stopping = True
+        """Hard stop: cancel the worker; queued AND in-flight requests
+        fail explicitly (never a silent hang)."""
+        # capture before cancelling: _dispatch's finally clears the list
+        # while the cancellation unwinds during `await self._task`
+        pending = list(self._inflight_units)
         if self._task is not None:
             self._task.cancel()
             try:
@@ -186,13 +203,26 @@ class ContinuousScheduler:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
             self._task = None
+        for u in pending:
+            if not u.req.future.done():
+                u.req.future.set_exception(
+                    RuntimeError("server shut down mid-batch"))
         for lane in self._lanes.values():
             for u in lane:
+                # the unit leaves the lanes HERE: zero the request's
+                # queued count so the set_exception done-callback (which
+                # runs via call_soon AFTER stop returns and adds
+                # req.queued to the dead count) cannot re-inflate the
+                # counters we zero below — a reused scheduler would
+                # otherwise under-report depth to admission forever
+                u.req.queued = 0
                 if not u.req.future.done():
                     u.req.future.set_exception(
                         RuntimeError("server shut down"))
             lane.clear()
-        self._queued = 0
+        with self._state_lock:
+            self._queued = 0
+            self._dead = 0
         if self._own_executor:
             self._executor.shutdown(wait=False)
 
@@ -200,12 +230,11 @@ class ContinuousScheduler:
         """Graceful shutdown: finish everything queued/in flight, then
         stop. Pair with AdmissionController.begin_drain() so nothing new
         arrives. Returns True when fully drained, False on timeout."""
-        self._draining = True
         loop = asyncio.get_event_loop()
         dl = loop.time() + timeout if timeout is not None else None
 
         def _done() -> bool:
-            return self._queued == 0 and self._inflight == 0
+            return self._queue_size() == 0 and self._inflight == 0
 
         while not _done():
             if dl is not None and loop.time() >= dl:
@@ -218,10 +247,17 @@ class ContinuousScheduler:
 
     # -- submission ---------------------------------------------------------
     def queued_units(self) -> int:
-        """LIVE queued sentences — what admission and the depth gauge see.
-        Dead units (resolved requests not yet swept from the lanes) are
-        excluded, so expired backlog never sheds live traffic."""
-        return max(0, self._queued - self._dead)
+        """LIVE queued sentences — what admission and the depth gauge see
+        (the gauge samples this from the metrics scrape THREAD, hence the
+        lock). Dead units (resolved requests not yet swept from the lanes)
+        are excluded, so expired backlog never sheds live traffic."""
+        with self._state_lock:
+            return max(0, self._queued - self._dead)
+
+    def _queue_size(self) -> int:
+        """Raw queued-unit count (live + dead) under the state lock."""
+        with self._state_lock:
+            return self._queued
 
     def submit(self, lines: List[str], priority: int = 0,
                timeout: Optional[float] = None) -> "asyncio.Future":
@@ -235,10 +271,11 @@ class ContinuousScheduler:
         deadline = now + timeout if timeout and timeout > 0 else None
         req = _Request(lines, fut, priority, now, deadline)
         self.m_requests.inc()
-        for i, text in enumerate(lines):
-            u = _Unit(req, i, text, max(1, int(self.length_fn(text))))
-            self._lanes[priority].append(u)
-            self._queued += 1
+        with self._state_lock:
+            for i, text in enumerate(lines):
+                u = _Unit(req, i, text, max(1, int(self.length_fn(text))))
+                self._lanes[priority].append(u)
+                self._queued += 1
         if deadline is not None:
             # the deadline fires even if the unit is buried deep in the
             # backlog — a timed-out client gets its error ON TIME, and the
@@ -264,8 +301,13 @@ class ContinuousScheduler:
         # any units of this request still sitting in lanes are dead until
         # the next forming pass physically sweeps them — discount them
         # from the admission-visible depth IMMEDIATELY (a normal
-        # completion has req.queued == 0, so this is a no-op there)
-        self._dead += req.queued
+        # completion has req.queued == 0, so this is a no-op there).
+        # req.queued is read inside the lock: a forming pass that swept
+        # units between set_exception and this callback already lowered
+        # it, so the count added here is exactly the units still in lanes.
+        with self._state_lock:
+            req.dead_accounted = True
+            self._dead += req.queued
 
     # -- worker -------------------------------------------------------------
     async def _run(self) -> None:
@@ -273,7 +315,7 @@ class ContinuousScheduler:
         while True:
             try:
                 was_idle = False
-                while self._queued == 0:
+                while self._queue_size() == 0:
                     self._wake.clear()
                     was_idle = True
                     await self._wake.wait()
@@ -295,49 +337,66 @@ class ContinuousScheduler:
         highest non-empty priority lane, then top up with queued units
         (same lane order) that fit the padded-token budget. Units of
         already-resolved requests (cancelled / timed out / failed) are
-        discarded here, before they cost device time."""
+        discarded here, before they cost device time.
+
+        Runs entirely under the state lock: one forming pass is bounded
+        CPU-only work (O(scan_limit), no awaits), and the counters it
+        rebalances must never be observed mid-pass by the metrics scrape
+        thread or admission."""
         batch: List[_Unit] = []
         width = 0
         scanned = 0
         skipped: List[_Unit] = []
-        for prio in sorted(self._lanes.keys(), reverse=True):
-            lane = self._lanes[prio]
-            while lane and scanned < self.scan_limit:
-                u = lane.popleft()
-                self._queued -= 1
-                u.req.queued -= 1
-                if u.req.future.done():
-                    self._dead -= 1              # dead request: drop unit
-                    continue
-                scanned += 1
-                new_width = max(width,
-                                bucket_length(u.tokens, self.length_buckets))
-                # fit check on UNPADDED rows x bucketed width — the exact
-                # budget semantics of training's _split_maxi, so serving
-                # batches land on the shape grid the jit cache was warmed
-                # on. Row snap-up to batch_multiple can pad the realized
-                # device batch past the budget by < batch_multiple rows
-                # (same as training; --mini-batch-words has always meant
-                # real rows, not padded rows).
-                if batch and (len(batch) + 1) * new_width > self.token_budget:
-                    # does not fit — keep scanning: a shorter unit further
-                    # back may still fit this batch's width
-                    skipped.append(u)
-                    continue
-                batch.append(u)
-                width = new_width
-            if scanned >= self.scan_limit:
-                break
-        # skipped units go back to the FRONT of their lanes in order, so
-        # FIFO is preserved for the next batch
-        for u in reversed(skipped):
-            self._lanes[u.req.priority].appendleft(u)
-            self._queued += 1
-            u.req.queued += 1
+        with self._state_lock:
+            for prio in sorted(self._lanes.keys(), reverse=True):
+                lane = self._lanes[prio]
+                while lane and scanned < self.scan_limit:
+                    u = lane.popleft()
+                    # dead sweeps count toward the scan bound too: a
+                    # timeout storm in unbounded-queue mode must not turn
+                    # one forming pass into an O(backlog) stall under the
+                    # state lock
+                    scanned += 1
+                    self._queued -= 1
+                    u.req.queued -= 1
+                    if u.req.future.done():
+                        if u.req.dead_accounted:
+                            # drop a dead unit the done-callback counted;
+                            # if the callback hasn't run yet it will see
+                            # the already-lowered req.queued instead
+                            self._dead -= 1
+                        continue
+                    new_width = max(width, bucket_length(u.tokens,
+                                                         self.length_buckets))
+                    # fit check on UNPADDED rows x bucketed width — the
+                    # exact budget semantics of training's _split_maxi, so
+                    # serving batches land on the shape grid the jit cache
+                    # was warmed on. Row snap-up to batch_multiple can pad
+                    # the realized device batch past the budget by
+                    # < batch_multiple rows (same as training;
+                    # --mini-batch-words has always meant real rows, not
+                    # padded rows).
+                    if batch and (len(batch) + 1) * new_width \
+                            > self.token_budget:
+                        # does not fit — keep scanning: a shorter unit
+                        # further back may still fit this batch's width
+                        skipped.append(u)
+                        continue
+                    batch.append(u)
+                    width = new_width
+                if scanned >= self.scan_limit:
+                    break
+            # skipped units go back to the FRONT of their lanes in order,
+            # so FIFO is preserved for the next batch
+            for u in reversed(skipped):
+                self._lanes[u.req.priority].appendleft(u)
+                self._queued += 1
+                u.req.queued += 1
         return batch
 
     async def _dispatch(self, units: List[_Unit], loop) -> None:
         self._inflight += 1
+        self._inflight_units = list(units)
         try:
             now = loop.time()
             rows = len(units)
@@ -358,6 +417,7 @@ class ContinuousScheduler:
             await self._translate_units(units, loop)
         finally:
             self._inflight -= 1
+            self._inflight_units = []
 
     async def _translate_units(self, units: List[_Unit], loop) -> None:
         """One device call for the batch; on failure, bisect: split in two

@@ -187,9 +187,14 @@ class Scheduler:
 
     def _display(self) -> None:
         s = self.state
-        dt = max(time.perf_counter() - self._timer, 1e-9)
         cost_type = self.options.get("cost-type", "ce-sum")
         self._cost_sum = float(self._cost_sum)   # the one deferred sync
+        # clock read AFTER the cost sync (mtlint MT-SYNC-TIMER): forcing
+        # the accumulated device scalar completes every update in the
+        # display window, so words/s divides by real execution time.
+        # Pre-fix the delta was read before the sync — under async
+        # dispatch that clocked ENQUEUE time and overstated throughput.
+        dt = max(time.perf_counter() - self._timer, 1e-9)
         if not math.isfinite(self._cost_sum):
             # divergence surfaces here, at the display boundary — the hot
             # loop never syncs per step (reference: --throw-on-divergence
@@ -233,7 +238,7 @@ class Scheduler:
         self._cost_sum = self._label_sum = self._words_sum = 0.0
         self._sent_sum = 0
         self._disp_count = 0
-        self._timer = time.perf_counter()
+        self._timer = time.perf_counter()  # mtlint: ok -- float(cost_sum) above is this window's sync fence; a block_until_ready here would stall the dispatch-ahead hot loop
 
     def _epoch_display(self):
         s = self.state

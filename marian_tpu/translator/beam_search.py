@@ -635,7 +635,7 @@ class _SearchHandle:
         def _h(x):
             if x is None:
                 return None
-            x = np.asarray(x)
+            x = np.asarray(x)  # mtlint: ok -- collect() IS the designed sync boundary; depth-1 pipelining hides it behind the next batch's device work
             return x[:self._n] if self._n is not None else x
 
         return self._bs._collect(

@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""mtlint entry point runnable from a checkout without installation:
+
+    scripts/mtlint.py [paths...] [--format json|text] [--baseline FILE]
+                      [--update-baseline] [--rules FAMILIES]
+
+Thin wrapper over `python -m marian_tpu.analysis` (same flags, same exit
+codes); see docs/STATIC_ANALYSIS.md.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from marian_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,606 @@
+"""mtlint (marian_tpu/analysis) — per-rule positive/negative snippets,
+suppression + baseline round-trip, CLI exit codes, and THE TIER-1 GATE:
+the analyzer over the real marian_tpu/ tree with the checked-in baseline
+must be clean (ISSUE 2 acceptance).
+
+Snippets are parsed from strings — no fixture files on disk; the analysis
+layer is stdlib-only, so none of this needs jax.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from marian_tpu.analysis.cli import main as mtlint_main
+from marian_tpu.analysis.core import (Config, Source, apply_baseline,
+                                      load_baseline, run_lint,
+                                      write_baseline, _read_toml_tables)
+from marian_tpu.analysis.rules import all_rules
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def lint_text(code: str, rel: str = "marian_tpu/ops/snippet.py",
+              families=None, config: Config = None):
+    """Run rules over one in-memory snippet; returns findings (inline
+    suppressions honored, baseline not applied)."""
+    cfg = config or Config(root=ROOT)
+    src = Source(ROOT / rel, rel, text=code)
+    findings = []
+    for rule in all_rules():
+        if families and rule.family not in families:
+            continue
+        if not cfg.family_applies(rule.family, rel):
+            continue
+        if rule.scope == "project":
+            findings.extend(rule.check_project([src], cfg))
+        else:
+            findings.extend(rule.check(src, cfg))
+    return [f for f in findings if not src.suppressed(f)]
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+
+class TestTraceSafety:
+    def test_if_on_traced_param(self):
+        fs = lint_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n")
+        assert "MT-TRACE-COND" in rule_ids(fs)
+        assert fs[0].line == 4
+
+    def test_while_on_derived_value(self):
+        fs = lint_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    y = x * 2\n"
+            "    while y < 10:\n"
+            "        y = y + 1\n"
+            "    return y\n")
+        assert "MT-TRACE-COND" in rule_ids(fs)
+
+    def test_cast_and_item(self):
+        fs = lint_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    a = int(x)\n"
+            "    b = x.item()\n"
+            "    return a + b\n")
+        assert rule_ids(fs) == ["MT-TRACE-CAST"]
+        assert len(fs) == 2
+
+    def test_numpy_inside_jit(self):
+        fs = lint_text(
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.sum(x)\n")
+        assert "MT-TRACE-NUMPY" in rule_ids(fs)
+
+    def test_np_dtype_constants_ok(self):
+        fs = lint_text(
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.astype(np.float32)\n")
+        assert fs == []
+
+    def test_static_argnums_honored(self):
+        fs = lint_text(
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=(1,))\n"
+            "def f(x, n):\n"
+            "    if n > 0:\n"
+            "        return x * n\n"
+            "    return x\n")
+        assert fs == []
+
+    def test_static_argnames_and_scalar_annotation(self):
+        fs = lint_text(
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('mode',))\n"
+            "def f(x, mode, rate: float = 0.1):\n"
+            "    if mode == 'train' and rate > 0:\n"
+            "        return x * rate\n"
+            "    return x\n")
+        assert fs == []
+
+    def test_shape_and_none_tests_ok(self):
+        fs = lint_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, mask=None):\n"
+            "    if mask is None:\n"
+            "        mask = x\n"
+            "    if x.ndim == 2:\n"
+            "        d = int(x.shape[0])\n"
+            "        return x + d\n"
+            "    return x * mask\n")
+        assert fs == []
+
+    def test_wrapped_jit_binding(self):
+        fs = lint_text(
+            "import jax\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+            "step = jax.jit(f)\n")
+        assert "MT-TRACE-COND" in rule_ids(fs)
+
+    def test_plain_function_untouched(self):
+        fs = lint_text(
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return float(x)\n"
+            "    return 0.0\n")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+class TestHostSync:
+    REL = "marian_tpu/training/snippet.py"
+
+    def test_unsynced_timer(self):
+        fs = lint_text(
+            "import time\n"
+            "def bench(fn, x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = fn(x)\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    return y, dt\n", rel=self.REL, families=["host-sync"])
+        assert rule_ids(fs) == ["MT-SYNC-TIMER"]
+
+    def test_block_until_ready_clears_timer(self):
+        fs = lint_text(
+            "import time, jax\n"
+            "def bench(fn, x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jax.block_until_ready(fn(x))\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    return y, dt\n", rel=self.REL, families=["host-sync"])
+        assert fs == []
+
+    def test_transfers(self):
+        fs = lint_text(
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    a = np.asarray(x)\n"
+            "    b = x.tolist()\n"
+            "    print(x)\n"
+            "    return a, b\n", rel=self.REL, families=["host-sync"])
+        assert rule_ids(fs) == ["MT-SYNC-TRANSFER"]
+        assert len(fs) == 3
+
+    def test_literal_np_array_ok(self):
+        fs = lint_text(
+            "import numpy as np\n"
+            "def f():\n"
+            "    print('loaded')\n"
+            "    return np.array([1, 2, 3])\n",
+            rel=self.REL, families=["host-sync"])
+        assert fs == []
+
+    def test_cold_dirs_not_checked(self):
+        fs = lint_text(
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    return np.asarray(x)\n",
+            rel="marian_tpu/common/snippet.py", families=["host-sync"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+class TestDonation:
+    def test_read_after_donate(self):
+        fs = lint_text(
+            "import jax\n"
+            "def train(p, b):\n"
+            "    return p\n"
+            "step = jax.jit(train, donate_argnums=(0,))\n"
+            "def loop(p, batches):\n"
+            "    for b in batches:\n"
+            "        out = step(p, b)\n"
+            "    return p\n", families=["donation"])
+        assert rule_ids(fs) == ["MT-DONATE-READ"]
+
+    def test_rebinding_is_clean(self):
+        fs = lint_text(
+            "import jax\n"
+            "def train(p, b):\n"
+            "    return p\n"
+            "step = jax.jit(train, donate_argnums=(0,))\n"
+            "def loop(p, batches):\n"
+            "    for b in batches:\n"
+            "        p = step(p, b)\n"
+            "    return p\n", families=["donation"])
+        assert fs == []
+
+    def test_conditional_donation_still_flagged(self):
+        fs = lint_text(
+            "import jax\n"
+            "def train(p, b):\n"
+            "    return p\n"
+            "donate = True\n"
+            "step = jax.jit(train, donate_argnums=(0,) if donate else ())\n"
+            "def once(p, b):\n"
+            "    out = step(p, b)\n"
+            "    return out, p.keys()\n", families=["donation"])
+        assert rule_ids(fs) == ["MT-DONATE-READ"]
+
+
+# ---------------------------------------------------------------------------
+# dtype hygiene
+# ---------------------------------------------------------------------------
+
+class TestDtype:
+    def test_literal_with_unpinned_array(self):
+        fs = lint_text(
+            "import jax\n"
+            "def f(mask: jax.Array):\n"
+            "    return (1.0 - mask) * -1e9\n", families=["dtype"])
+        assert rule_ids(fs) == ["MT-DTYPE-LITERAL"]
+
+    def test_astype_pin_clears_literal(self):
+        fs = lint_text(
+            "import jax\n"
+            "def f(logits: jax.Array, mask: jax.Array):\n"
+            "    return (1.0 - mask.astype(logits.dtype)) * -1e9\n",
+            families=["dtype"])
+        assert fs == []
+
+    def test_scalar_annotation_not_array(self):
+        fs = lint_text(
+            "def f(x: 'jax.Array', rate: float):\n"
+            "    keep = 1.0 - rate\n"
+            "    return x / keep\n", families=["dtype"])
+        assert fs == []
+
+    def test_ctor_without_dtype(self):
+        fs = lint_text(
+            "import jax.numpy as jnp\n"
+            "def f(n):\n"
+            "    return jnp.zeros((n, n)), jnp.array([0.5])\n",
+            families=["dtype"])
+        assert rule_ids(fs) == ["MT-DTYPE-ARRAY"]
+        assert len(fs) == 2
+
+    def test_ctor_with_dtype_ok(self):
+        fs = lint_text(
+            "import jax.numpy as jnp\n"
+            "def f(n, dt):\n"
+            "    a = jnp.zeros((n, n), jnp.float32)\n"
+            "    b = jnp.array([0.5], dtype=dt)\n"
+            "    c = jnp.asarray(n)\n"
+            "    return a, b, c\n", families=["dtype"])
+        assert fs == []
+
+    def test_dtype_dirs_scoped(self):
+        fs = lint_text(
+            "import jax.numpy as jnp\n"
+            "def f(n):\n"
+            "    return jnp.zeros((n, n))\n",
+            rel="marian_tpu/data/snippet.py", families=["dtype"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+GUARDED_CLASS = (
+    "import threading\n"
+    "class Sched:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._queued = 0   # guarded-by: _lock\n"
+    "    def bad_read(self):\n"
+    "        return self._queued\n"
+    "    def good_read(self):\n"
+    "        with self._lock:\n"
+    "            return self._queued\n"
+    "    def held_helper(self):  # mtlint: holds _lock\n"
+    "        self._queued += 1\n")
+
+
+class TestGuardedBy:
+    REL = "marian_tpu/serving/snippet.py"
+
+    def test_unlocked_access_flagged_once(self):
+        fs = lint_text(GUARDED_CLASS, rel=self.REL, families=["guarded-by"])
+        assert rule_ids(fs) == ["MT-LOCK-GUARD"]
+        assert len(fs) == 1 and fs[0].line == 7  # only bad_read
+
+    def test_init_exempt_and_with_block_ok(self):
+        clean = GUARDED_CLASS.replace(
+            "    def bad_read(self):\n        return self._queued\n", "")
+        assert lint_text(clean, rel=self.REL,
+                         families=["guarded-by"]) == []
+
+    def test_unknown_lock(self):
+        fs = lint_text(
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._n = 0   # guarded-by: _missing\n",
+            rel=self.REL, families=["guarded-by"])
+        assert rule_ids(fs) == ["MT-LOCK-UNKNOWN"]
+
+    def test_scoped_to_threaded_dirs(self):
+        fs = lint_text(GUARDED_CLASS, rel="marian_tpu/ops/snippet.py",
+                       families=["guarded-by"])
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# metrics hygiene
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_registered_never_emitted(self):
+        fs = lint_text(
+            "class S:\n"
+            "    def __init__(self, r):\n"
+            "        self.m_used = r.counter('used_total', 'u')\n"
+            "        self.m_dead = r.counter('dead_total', 'd')\n"
+            "    def work(self):\n"
+            "        self.m_used.inc()\n", families=["metrics"])
+        assert rule_ids(fs) == ["MT-METRIC-UNUSED"]
+        assert "dead_total" in fs[0].message
+
+    def test_labels_chain_counts_as_emission(self):
+        fs = lint_text(
+            "class S:\n"
+            "    def __init__(self, r):\n"
+            "        self.m_shed = r.counter('shed_total', 's', "
+            "labels=('reason',))\n"
+            "    def work(self):\n"
+            "        self.m_shed.labels('full').inc()\n",
+            families=["metrics"])
+        assert fs == []
+
+    def test_emitted_never_registered(self):
+        fs = lint_text(
+            "class S:\n"
+            "    def work(self):\n"
+            "        self.m_ghost.inc()\n", families=["metrics"])
+        assert rule_ids(fs) == ["MT-METRIC-UNREG"]
+
+    def test_direct_construction_flagged(self):
+        fs = lint_text(
+            "from marian_tpu.serving.metrics import Counter\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self.m_direct = Counter('direct_total', 'd')\n"
+            "    def work(self):\n"
+            "        self.m_direct.inc()\n", families=["metrics"])
+        assert rule_ids(fs) == ["MT-METRIC-UNREG"]
+        assert "bypassing the registry" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppression, config, baseline, CLI, gate
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_ok_comment(self):
+        fs = lint_text(
+            "import jax.numpy as jnp\n"
+            "def f(n):\n"
+            "    return jnp.zeros((n, n))  # mtlint: ok -- reason here\n",
+            families=["dtype"])
+        assert fs == []
+
+    def test_disable_family_prefix(self):
+        fs = lint_text(
+            "import jax.numpy as jnp\n"
+            "def f(n):\n"
+            "    return jnp.zeros((n, n))  # mtlint: disable=MT-DTYPE\n",
+            families=["dtype"])
+        assert fs == []
+
+    def test_disable_other_rule_does_not_suppress(self):
+        fs = lint_text(
+            "import jax.numpy as jnp\n"
+            "def f(n):\n"
+            "    return jnp.zeros((n, n))  # mtlint: disable=MT-TRACE-COND\n",
+            families=["dtype"])
+        assert rule_ids(fs) == ["MT-DTYPE-ARRAY"]
+
+
+class TestConfig:
+    def test_toml_subset_reader(self):
+        tables = _read_toml_tables(
+            '[tool.mtlint]\nexclude = ["a/b"]\n'
+            '[tool.mtlint.rules.dtype]\ndirs = [\n  "x/y",\n  "z",\n]\n'
+            'enabled = true\n'
+            '[other.section]\nk = "v"  # comment\n')
+        assert tables["tool.mtlint"]["exclude"] == ["a/b"]
+        assert tables["tool.mtlint.rules.dtype"]["dirs"] == ["x/y", "z"]
+        assert tables["tool.mtlint.rules.dtype"]["enabled"] is True
+
+    def test_pyproject_loaded(self):
+        cfg = Config.load(ROOT)
+        assert "marian_tpu/ops" in cfg.rule_dirs["dtype"]
+        assert "marian_tpu/serving" in cfg.rule_dirs["guarded-by"]
+        assert cfg.excluded("marian_tpu/analysis/core.py")
+
+    def test_every_advertised_rule_id_has_an_owner(self):
+        families = {r.family for r in all_rules()}
+        assert families == {"trace-safety", "host-sync", "donation",
+                            "dtype", "guarded-by", "metrics"}
+
+
+BAD_OPS = ("import jax.numpy as jnp\n"
+           "def f(n):\n"
+           "    return jnp.zeros((n, n))\n")
+
+
+def _mini_tree(tmp_path: Path) -> Path:
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.mtlint]\n", encoding="utf-8")
+    pkg = tmp_path / "marian_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(BAD_OPS, encoding="utf-8")
+    return tmp_path
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        root = _mini_tree(tmp_path)
+        cfg = Config(root=root)
+        findings = run_lint([root / "marian_tpu"], cfg)
+        assert rule_ids(findings) == ["MT-DTYPE-ARRAY"]
+        bl_path = root / "baseline.json"
+        write_baseline(findings, bl_path)
+        new, old = apply_baseline(
+            run_lint([root / "marian_tpu"], cfg), load_baseline(bl_path))
+        assert new == [] and len(old) == 1
+
+    def test_baseline_survives_line_shift(self, tmp_path):
+        root = _mini_tree(tmp_path)
+        cfg = Config(root=root)
+        bl_path = root / "baseline.json"
+        write_baseline(run_lint([root / "marian_tpu"], cfg), bl_path)
+        bad = root / "marian_tpu" / "ops" / "bad.py"
+        bad.write_text("import jax.numpy as jnp\n\n\n" + BAD_OPS.split(
+            "\n", 1)[1], encoding="utf-8")
+        new, old = apply_baseline(
+            run_lint([root / "marian_tpu"], cfg), load_baseline(bl_path))
+        assert new == [] and len(old) == 1
+
+    def test_second_identical_violation_not_absorbed(self, tmp_path):
+        root = _mini_tree(tmp_path)
+        cfg = Config(root=root)
+        bl_path = root / "baseline.json"
+        write_baseline(run_lint([root / "marian_tpu"], cfg), bl_path)
+        bad = root / "marian_tpu" / "ops" / "bad.py"
+        bad.write_text(BAD_OPS + "def g(n):\n"
+                       "    return jnp.zeros((n, n))\n", encoding="utf-8")
+        new, old = apply_baseline(
+            run_lint([root / "marian_tpu"], cfg), load_baseline(bl_path))
+        assert len(new) == 1 and len(old) == 1
+
+
+class TestCli:
+    def test_exit_codes_and_update(self, tmp_path, capsys):
+        root = _mini_tree(tmp_path)
+        argv = [str(root / "marian_tpu"), "--root", str(root),
+                "--baseline", str(root / "bl.json")]
+        assert mtlint_main(argv) == 1          # findings, no baseline yet
+        assert mtlint_main(argv + ["--update-baseline"]) == 0
+        assert mtlint_main(argv) == 0          # clean against baseline
+        capsys.readouterr()
+
+    def test_json_format(self, tmp_path, capsys):
+        root = _mini_tree(tmp_path)
+        rc = mtlint_main([str(root / "marian_tpu"), "--root", str(root),
+                          "--format", "json", "--no-baseline"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert payload["findings"][0]["rule"] == "MT-DTYPE-ARRAY"
+        assert payload["findings"][0]["path"] == "marian_tpu/ops/bad.py"
+
+    def test_rules_filter(self, tmp_path, capsys):
+        root = _mini_tree(tmp_path)
+        rc = mtlint_main([str(root / "marian_tpu"), "--root", str(root),
+                          "--rules", "guarded-by", "--no-baseline"])
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_script_entry_point(self, tmp_path):
+        root = _mini_tree(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "scripts" / "mtlint.py"),
+             str(root / "marian_tpu"), "--root", str(root),
+             "--no-baseline", "--format", "json"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        assert json.loads(proc.stdout)["findings"]
+
+
+class TestTier1Gate:
+    """THE gate: the real tree must be clean against the checked-in
+    baseline. A finding here means new code tripped a rule — fix it (or,
+    for a deliberate pattern, annotate `# mtlint: ok -- reason`); do not
+    grow the baseline."""
+
+    def test_tree_clean_against_baseline(self):
+        cfg = Config.load(ROOT)
+        errors = []
+        findings = run_lint([ROOT / "marian_tpu"], cfg, errors=errors)
+        assert errors == [], f"mtlint could not parse: {errors}"
+        baseline = load_baseline(ROOT / "marian_tpu" / "analysis"
+                                 / "baseline.json")
+        assert baseline, "checked-in baseline missing or empty"
+        new, _old = apply_baseline(findings, baseline)
+        assert new == [], (
+            "mtlint found new violations (run `python -m "
+            "marian_tpu.analysis` for details; see "
+            "docs/STATIC_ANALYSIS.md):\n"
+            + "\n".join(f.render() for f in new))
+
+    def test_baseline_not_stale(self):
+        """Every baseline entry still matches a real finding — entries
+        whose code was fixed must be removed (--update-baseline), keeping
+        the debt ledger honest."""
+        cfg = Config.load(ROOT)
+        findings = run_lint([ROOT / "marian_tpu"], cfg)
+        current = {f.key() for f in findings}
+        baseline = load_baseline(ROOT / "marian_tpu" / "analysis"
+                                 / "baseline.json")
+        stale = [k for k in baseline if k not in current]
+        assert stale == [], (
+            f"baseline entries no longer match any finding (fixed code — "
+            f"regenerate with scripts/mtlint.py --update-baseline): {stale}")
+
+
+class TestHostSyncNestedDefs:
+    REL = "marian_tpu/training/snippet.py"
+
+    def test_nested_sync_does_not_clear_outer_timer(self):
+        fs = lint_text(
+            "import time, jax\n"
+            "def bench(fn, x):\n"
+            "    def _later(y):\n"
+            "        return jax.block_until_ready(y)\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = fn(x)\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    return y, dt, _later\n", rel=self.REL,
+            families=["host-sync"])
+        assert rule_ids(fs) == ["MT-SYNC-TIMER"]
+
+    def test_nested_timer_not_attributed_to_outer(self):
+        fs = lint_text(
+            "import time\n"
+            "def outer(fn, x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    def cb():\n"
+            "        return time.perf_counter()\n"
+            "    y = fn(x)\n"
+            "    return y, t0, cb\n", rel=self.REL,
+            families=["host-sync"])
+        assert fs == []

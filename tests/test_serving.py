@@ -623,3 +623,78 @@ def test_tcp_disconnect_cancels_request():
         release.set()
     assert cancelled == 1
     assert all("goner" not in l for c in calls for l in c)
+
+
+# ---------------------------------------------------------------------------
+# scheduler regressions (ISSUE 2 review pass)
+# ---------------------------------------------------------------------------
+
+class TestSchedulerRegressions:
+    def test_dead_count_consistent_when_sweep_beats_done_callback(self):
+        """future.done() flips at cancel/set_exception time, but the
+        done-callback that adds leftover units to the dead count runs via
+        call_soon — a forming pass in that gap must not drive the dead
+        count negative (which would permanently inflate the
+        admission-visible depth and shed live traffic)."""
+        async def scenario():
+            s = ContinuousScheduler(lambda lines: list(lines),
+                                    registry=msm.Registry())
+            fut = s.submit(["a", "b", "c"])
+            fut.cancel()
+            # sweep the lanes BEFORE the done-callback runs, like a worker
+            # resuming ahead of it in the loop's ready queue
+            assert s._form_batch(0.0) == []
+            await asyncio.sleep(0)          # now let _on_request_done run
+            assert s.queued_units() == 0
+            with s._state_lock:
+                assert s._dead == 0
+            await s.stop()
+
+        run(scenario())
+
+    def test_stop_fails_inflight_requests_instead_of_hanging(self):
+        """stop() mid-device-batch: the batch's units already left the
+        lanes, so the lane sweep alone would leave those clients awaiting
+        forever — in-flight futures must fail explicitly."""
+        release = threading.Event()
+
+        def blocking(lines):
+            release.wait(5)
+            return list(lines)
+
+        async def scenario():
+            s = ContinuousScheduler(blocking, window_s=0,
+                                    registry=msm.Registry())
+            s.start()
+            fut = s.submit(["a"])
+            while s._inflight == 0:
+                await asyncio.sleep(0.005)
+            await s.stop()
+            release.set()
+            assert fut.done()
+            with pytest.raises(RuntimeError, match="shut down"):
+                fut.result()
+
+        try:
+            run(scenario())
+        finally:
+            release.set()
+
+    def test_stop_leaves_no_stale_dead_count(self):
+        """The set_exception done-callbacks from stop()'s sweep run AFTER
+        stop returns; they must not re-inflate the zeroed counters, or a
+        reused scheduler under-reports depth to admission forever."""
+        async def scenario():
+            s = ContinuousScheduler(lambda lines: list(lines),
+                                    registry=msm.Registry())
+            fut = s.submit(["a", "b", "c"])
+            await s.stop()
+            await asyncio.sleep(0)          # late done-callbacks fire now
+            assert fut.done()
+            with s._state_lock:
+                assert s._dead == 0 and s._queued == 0
+            s.submit(["x", "y"])
+            assert s.queued_units() == 2
+            await s.stop()
+
+        run(scenario())
