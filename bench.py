@@ -34,6 +34,9 @@ Env knobs:
                         lengths; pairs with MARIAN_BENCH_FLASH for the
                         flash-attention A/B)
   MARIAN_BENCH_FLASH    force --transformer-flash-attention on/off/auto
+  MARIAN_BENCH_PACKED   force --transformer-packed-attention on/off/auto
+                        (r6 head-packed MXU kernel; auto = TPU only —
+                        the packed_off ladder leg isolates its gain)
   MARIAN_BENCH_COMPACT  0 disables the uint16+lengths host→device
                         transfer (transfer_full A/B stage)
   MARIAN_BENCH_GRAD_DTYPE  --gradient-dtype. DEFAULT bfloat16 (the
@@ -121,6 +124,21 @@ def _write_corpus(tmp, vocab_size, n_lines, seed=7, max_words=63):
             fs.write(" ".join(rng.choice(words) for _ in range(n)) + "\n")
             ft.write(" ".join(rng.choice(words) for _ in range(m)) + "\n")
     return src_p, trg_p
+
+
+def tristate_env(name: str):
+    """Parse an on/off/auto A/B env knob; malformed values fall back to
+    None (= model default) with a warning — an unattended ladder's typo
+    must not kill a tunnel-up window."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    v = raw.strip().lower()
+    if v not in ("on", "off", "auto"):
+        print(f"bench: bad {name}={raw!r} (want on/off/auto) — using "
+              f"model default", file=sys.stderr, flush=True)
+        return None
+    return v
 
 
 def retry_compile(fn, what: str, attempts: int = 3, reset=None):
@@ -331,19 +349,15 @@ def main():
             print(f"bench: bad MARIAN_BENCH_SCAN="
                   f"{os.environ['MARIAN_BENCH_SCAN']!r} (want on/off) — "
                   f"using model default", file=sys.stderr, flush=True)
-    flash_env = os.environ.get("MARIAN_BENCH_FLASH")  # on/off/auto A/B
-    if flash_env:
-        flash_env = flash_env.strip().lower()
-        if flash_env not in ("on", "off", "auto"):
-            print(f"bench: bad MARIAN_BENCH_FLASH={flash_env!r} "
-                  f"(want on/off/auto) — using model default",
-                  file=sys.stderr, flush=True)
-            flash_env = None
+    flash_env = tristate_env("MARIAN_BENCH_FLASH")    # on/off/auto A/B
+    packed_env = tristate_env("MARIAN_BENCH_PACKED")  # on/off/auto A/B
     opts = Options({
         "type": "transformer",
         **({"scan-layers": scan_env == "on"} if scan_env else {}),
         **({"dispatch-window": window} if window > 1 else {}),
         **({"transformer-flash-attention": flash_env} if flash_env else {}),
+        **({"transformer-packed-attention": packed_env}
+           if packed_env else {}),
         "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
         "transformer-heads": dims["heads"],
         "enc-depth": dims["depth"], "dec-depth": dims["depth"],
@@ -554,7 +568,19 @@ def main():
                                         vocab_sizes=vsz),
                         step + 1, train_key)
                     step += 1
-        jax.block_until_ready(gg.params)
+        # per-chunk hardened sync: fetch a metric VALUE, not just
+        # block_until_ready(params). The r4 transfer_full row (MFU 1.79,
+        # above physical peak) showed this backend's block_until_ready
+        # can return early on SOME input paths — and the full int32+f32
+        # transfer leg is exactly the path the compact default never
+        # exercises, so the under-sync only surfaced there. A scalar
+        # value fetch cannot lie: it requires the chunk's last update to
+        # have executed, regardless of input dtype path. Rows carry
+        # `sync` provenance so a row timed any other way is identifiable.
+        if last_out is not None:
+            float(last_out.loss_sum)
+        else:  # pragma: no cover — plan is never empty
+            jax.block_until_ready(gg.params)
         dt += time.perf_counter() - t0
         for grp in chunk:
             for b in grp:
@@ -569,18 +595,16 @@ def main():
             tok_per_sec_running=round(src_tokens / dt / max(n_chips, 1), 1),
             timed_steps_done=done)
 
-    # hardened sync: block_until_ready(params) SHOULD imply the whole
-    # chain executed, but the r4 transfer_full row (MFU 1.79 — above the
-    # chip's physical peak) showed the experimental axon backend can
-    # return early on some input paths. Fetching a metric VALUE cannot
-    # lie: it requires the last update's forward pass to have run. Any
-    # residue is time the timed window missed — fold it into dt and
-    # report it so an under-synced row is self-evident. Runs BEFORE
-    # stop_trace: trace collection blocks, and pending work draining
-    # inside it would escape both dt and the residue.
+    # Residue check: the per-chunk value fetches above already fenced
+    # every chunk inside dt, so this final sync should measure ~0 —
+    # anything else means work escaped a chunk fence and the row's
+    # final_sync_s says so. Fences on the PARAMS, not loss_sum: the last
+    # chunk already materialized loss_sum's host value, so re-fetching
+    # that same array would be a host cache hit that can never block.
+    # Runs BEFORE stop_trace: trace collection blocks, and pending work
+    # draining inside it would escape both dt and the residue.
     t_sync = time.perf_counter()
-    if last_out is not None:
-        float(last_out.loss_sum)
+    jax.block_until_ready(gg.params)
     sync_residue = time.perf_counter() - t_sync
     dt += sync_residue
 
@@ -611,10 +635,15 @@ def main():
         "stacked_params": stacked,
         "words_budget": words,
         "dispatch_window": window,
+        # sync provenance (r6, transfer_full close-out): every timed
+        # chunk is fenced by a metric-VALUE fetch, input-dtype-path
+        # independent; final_sync_s is the residue past the last fence
+        "sync": "value-fetch-per-chunk",
         "final_sync_s": round(sync_residue, 3),
         "compact_transfer": compact,
         "seqlen": max_len + 1,
         "flash": flash_env or "default",
+        "packed_attn": packed_env or "default",
     }
     if mfu is not None and mfu > 0.95:
         # faster than the chip's physical peak = the measurement lied

@@ -36,16 +36,75 @@ Env knobs:
                              compute/cache-bound and measured those
                              levers FLAT — this knob reaches the
                              regime they were designed for
+  MARIAN_DECBENCH_FUSED      --transformer-fused-decode-attention
+                             on/off/auto (default auto = TPU only): the
+                             Pallas fused beam-gather + cache-read
+                             kernel (ops/pallas/decode_attention.py) —
+                             the r5 while-body op-count lever
+  MARIAN_DECBENCH_DEVICES    decode device count (default 1). Pinned to
+                             ONE device because (a) the metric is
+                             per-chip sent/s and every recorded row is
+                             single-chip, and (b) a decode mesh vetoes
+                             the fused kernel (GSPMD-opaque pallas
+                             call), which would silently turn the
+                             fused A/B into unfused-vs-unfused on a
+                             multi-chip host
   MARIAN_DECBENCH_PROFILE    directory → jax.profiler trace of the
                              timed window
+
+Every row reports ``while_body_ops``: the op count of the decode loop's
+body in the COMPILED program (the largest while-body computation of the
+optimized HLO). The r5 trace put the standard body at ~690 small ops ×
+~4 µs dispatch each — the floor that made sent/s flat from 384 rows
+down to 8; this field is how the fused kernel's reduction is tracked
+per run instead of per profile session.
 """
 
 import json
 import os
 import random
+import re
 import sys
 import tempfile
 import time
+
+
+def while_body_op_count(jitted, *args, **kwargs) -> "int | None":
+    """Op count of the largest while-loop body in the compiled program.
+
+    Lowers + compiles through the jit object's own cache (the warm call
+    already populated it; on TPU the persistent XLA cache covers the AOT
+    path). Optimized-HLO parse: find each `while(...)` instruction's
+    body= computation, count its instruction lines, return the max —
+    the decode loop dominates every smaller scan/loop in the program.
+    Returns None when anything in the chain is unavailable (the metric
+    is reporting-only; the bench must not die for it)."""
+    try:
+        txt = jitted.lower(*args, **kwargs).compile().as_text()
+    except Exception as e:  # noqa: BLE001 — backend/AOT availability varies
+        print(f"bench_decode: while-body op count unavailable: "
+              f"{type(e).__name__}: {str(e)[:120]}", file=sys.stderr,
+              flush=True)
+        return None
+    bodies = set(re.findall(r"body=%?([\w.\-]+)", txt))
+    if not bodies:
+        return None
+    # computations open with `%name (params) -> type {` or `name (...) {`
+    counts = {}
+    current, n = None, 0
+    for line in txt.splitlines():
+        m = re.match(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*{", line)
+        if m:
+            current, n = m.group(1), 0
+            continue
+        if current is not None:
+            if line.strip().startswith("}"):
+                counts[current] = n
+                current = None
+            elif "=" in line:
+                n += 1
+    hits = [v for k, v in counts.items() if k in bodies]
+    return max(hits) if hits else None
 
 
 def main():
@@ -92,6 +151,8 @@ def main():
     # per-step reorder+read traffic dominates the standard decode step —
     # is replaced by one [B*K, d] recurrent state per layer
     ssru = bool(os.environ.get("MARIAN_DECBENCH_SSRU"))
+    from bench import tristate_env
+    fused_env = tristate_env("MARIAN_DECBENCH_FUSED") or ""
     opts = Options({
         "type": "transformer",
         "dim-emb": dims["emb"], "transformer-dim-ffn": dims["ffn"],
@@ -102,6 +163,8 @@ def main():
         "seed": 17,
         **({"transformer-decoder-autoreg": "rnn", "dec-cell": "ssru"}
            if ssru else {}),
+        **({"transformer-fused-decode-attention": fused_env}
+           if fused_env else {}),
     })
     model = create_model(opts, dims["vocab"], dims["vocab"],
                          inference=True)
@@ -125,8 +188,19 @@ def main():
     beam = int(os.environ.get("MARIAN_DECBENCH_BEAM", "6") or 6)
     if beam != 6:
         metric = metric.replace("beam6", f"beam{beam}")
+    try:
+        ndev = max(1, int(os.environ.get("MARIAN_DECBENCH_DEVICES", "1")))
+    except ValueError:
+        print(f"bench_decode: bad MARIAN_DECBENCH_DEVICES="
+              f"{os.environ['MARIAN_DECBENCH_DEVICES']!r} — using 1",
+              file=sys.stderr, flush=True)
+        ndev = 1
     bopts = Options({"beam-size": beam, "normalize": 0.6,
-                     "max-length": max_len, "seed": 17})
+                     "max-length": max_len, "seed": 17,
+                     # single-device default: the metric is per-chip
+                     # sent/s, and a decode mesh vetoes the fused
+                     # kernel (see MARIAN_DECBENCH_DEVICES above)
+                     "num-devices": ndev})
     vocab = DefaultVocab.build(
         [" ".join(f"w{i}" for i in range(dims["vocab"] - 2))])
     bs = BeamSearch(model, [params], None, bopts, vocab)
@@ -176,12 +250,37 @@ def main():
         flat = [int(x) for x in np.asarray(ids).ravel() if x > 1]
         return sl_gen.generate(flat)
 
+    if fused_env == "on":
+        metric = metric.replace("sentences", "fused_sentences")
+
     # compile + warm (retry transient tunnel remote-compile drops)
     from bench import retry_compile
     ids, mask = make_batch()
-    retry_compile(lambda: bs.search(ids, mask,
-                                    shortlist=shortlist_for(ids)),
+    warm_sl = shortlist_for(ids)
+    retry_compile(lambda: bs.search(ids, mask, shortlist=warm_sl),
                   "beam search")
+
+    # Whether the fused kernel ACTUALLY engaged for this run (the env
+    # knob is a request; mesh/sharded-params/backend gates can veto it)
+    fused_engaged = bs.fused_decode_engaged
+
+    # while-body op count of the program the warm call just compiled:
+    # re-lower through the SAME jit object (trace + persistent-cache
+    # compile; cheap next to the timed window) and parse the body size.
+    # Skipped under a decode mesh: lowering with plain uncommitted
+    # arrays there would trace a SECOND, differently-sharded program —
+    # an extra tunnel compile whose body is not the one being benched.
+    body_ops = None
+    if bs._jitted and bs.mesh is None:
+        jitted = next(iter(bs._jitted.values()))
+        sl_idx = jnp.asarray(warm_sl.indices) if warm_sl is not None else None
+        body_ops = while_body_op_count(
+            jitted, tuple(bs.params_list), jnp.asarray(ids),
+            jnp.asarray(mask), shortlist=sl_idx, sample_key=None,
+            prefix=None)
+    print(f"bench_decode: while-body op count = {body_ops} "
+          f"(fused requested={fused_env or 'auto'}, "
+          f"engaged={fused_engaged})", file=sys.stderr, flush=True)
 
     batches = [make_batch() for _ in range(max(1, n_sents // batch))]
     # shortlist generation is host-side work the real translator does per
@@ -217,6 +316,9 @@ def main():
         "preset": preset,
         "batch": batch,
         "beam": beam,
+        "fused_decode": fused_env or "auto",
+        "fused_decode_engaged": fused_engaged,
+        "while_body_ops": body_ops,
     }))
 
 

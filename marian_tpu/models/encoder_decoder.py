@@ -112,6 +112,14 @@ class EncoderDecoder:
         backpointers); model-family specific (KV caches vs RNN states)."""
         return self._mod.BEAM_CARRIED_SUFFIXES
 
+    @property
+    def fused_decode_reorder(self) -> bool:
+        """True when the fused decode kernel owns the beam reorder of
+        the self-attention caches: the beam search then passes pending
+        backpointers into step() (beam_src) instead of gathering the
+        cache leaves itself (ops/pallas/decode_attention.py)."""
+        return self._mod is T and T.fused_decode_active(self.cfg)
+
     # -- training graph (reference: EncoderDecoder::build + costs.h) --------
     def loss(self, params: Params, batch: Dict[str, jax.Array],
              key: Optional[jax.Array] = None, train: bool = True
@@ -243,10 +251,20 @@ class EncoderDecoder:
                                            want_alignment=want_alignment)
 
     def step(self, params: Params, state, prev_ids, src_mask,
-             shortlist=None, return_alignment: bool = False):
+             shortlist=None, return_alignment: bool = False,
+             beam_src=None, fused_decode=None):
         cparams = T.cast_params(params, self.cfg.compute_dtype)
+        # beam_src / fused_decode only exist for the transformer
+        # family's fused decode kernel — passed through only when set,
+        # so the s2s decode_step signature stays untouched
+        kw = {}
+        if beam_src is not None:
+            kw["beam_src"] = beam_src
+        if fused_decode is not None:
+            kw["fused_decode"] = fused_decode
         return self._mod.decode_step(self.cfg, cparams, state, prev_ids,
-                                     src_mask, shortlist, return_alignment)
+                                     src_mask, shortlist, return_alignment,
+                                     **kw)
 
 
 def create_model(options, src_vocab, trg_vocab,

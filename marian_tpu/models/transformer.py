@@ -160,6 +160,16 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     flash_attention: str = "auto"             # auto | on | off (Pallas kernel)
+    # head-packed short-sequence attention kernel (ops/pallas/
+    # packed_attention.py): fills the 128x128 MXU tile by packing
+    # g = 128//dh heads per pass — the r5 truth-table fix for the
+    # 21.7%/30.6% score/apply einsum geometry. auto = TPU backend only.
+    packed_attention: str = "auto"            # auto | on | off
+    # fused beam-gather + cache-update + attention decode step
+    # (ops/pallas/decode_attention.py): folds the beam reorder into the
+    # kernel's cache read and collapses the reorder/DUS/attention op
+    # chain in the decode while body. auto = TPU backend only.
+    fused_decode_attention: str = "auto"      # auto | on | off
     gradient_checkpointing: bool = False      # jax.checkpoint per layer
     # sequence/context parallelism over the mesh 'seq' axis (TPU extension,
     # parallel/sequence.py): "none" | "ring" | "ulysses". seq_mesh is the
@@ -316,6 +326,9 @@ def config_from_options(options, src_vocab, trg_vocab: int,
             0.01 if g("moe-aux-weight", None) is None
             else g("moe-aux-weight")),
         flash_attention=str(g("transformer-flash-attention", "auto")),
+        packed_attention=str(g("transformer-packed-attention", "auto")),
+        fused_decode_attention=str(
+            g("transformer-fused-decode-attention", "auto")),
         gradient_checkpointing=(not for_inference
                                 and bool(g("gradient-checkpointing", False))),
         sequence_parallel=str(g("sequence-parallel", "none") or "none"),
@@ -690,6 +703,26 @@ def _unproj_heads(x: jax.Array, w, b) -> jax.Array:
     return y
 
 
+def fused_decode_active(cfg: TransformerConfig) -> bool:
+    """Whether the fused gather+attention decode kernel handles the
+    cached self-attention step (--transformer-fused-decode-attention).
+    'auto' engages on the TPU backend only — interpret mode would just
+    be a slower dense step; tests force 'on'. The beam search consults
+    this (via EncoderDecoder.fused_decode_reorder) to hand the kernel
+    the pending backpointers instead of reordering the caches itself."""
+    mode = getattr(cfg, "fused_decode_attention", "off")
+    if mode == "off" or cfg.decoder_autoreg != "self-attention":
+        return False
+    if getattr(cfg, "n_model_tp", 1) > 1:
+        # Megatron TP shards the KV caches over heads on the 'model'
+        # axis; the pallas call is opaque to GSPMD, which would
+        # all-gather every layer's full cache around it each step
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 def _mha(cfg: TransformerConfig, params: Params, prefix: str,
          q_in: jax.Array, kv_in: jax.Array, mask: Optional[jax.Array],
          key, train: bool,
@@ -698,11 +731,20 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
          static_kv: bool = False,
          return_weights: bool = False,
          kv_mask: Optional[jax.Array] = None,
-         causal: bool = False):
+         causal: bool = False,
+         beam_src: Optional[jax.Array] = None,
+         fused_decode: Optional[bool] = None):
     """Multi-head attention with optional decode cache.
 
     cache (self-attn): dict with 'k','v' [B,H,L,Dh]; new K/V written at
     cache_pos. static_kv (cross-attn): K/V precomputed in cache, reused.
+    beam_src [rows] int32: pending beam backpointers (flat source rows)
+    for the fused decode kernel, which folds the beam reorder into its
+    cache read; None = identity (greedy/scoring, or reorder-on-the-
+    outside decoding when the fused kernel is off). fused_decode
+    overrides fused_decode_active(cfg) when the CALLER knows better —
+    the beam search passes False under a decode mesh, where the
+    GSPMD-opaque pallas call would re-replicate the sharded caches.
     """
     from ..ops.quantization import QTensor
 
@@ -761,14 +803,42 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
         q = proj(q_in, f"{prefix}_Wq", f"{prefix}_bq")
         k_ = proj(kv_in, f"{prefix}_Wk", f"{prefix}_bk")
         v_ = proj(kv_in, f"{prefix}_Wv", f"{prefix}_bv")
+    fused_out = None
+    # 'auto' fuses only when there is a beam reorder to fold: with the
+    # identity gather (greedy/scoring pass no beam_src) the kernel still
+    # collapses the DUS+attention op chain but rewrites the FULL cache
+    # per step where the unfused path wrote one position in place —
+    # net extra HBM traffic for no gather saved. Explicit 'on' forces it
+    # either way (tests, A/Bs).
+    if fused_decode is not None:
+        use_fused = fused_decode
+    else:
+        use_fused = fused_decode_active(cfg) and (
+            beam_src is not None
+            or getattr(cfg, "fused_decode_attention", "") == "on")
     if not (static_kv and cache is not None):
         if cache is not None and cache_pos is not None:
-            # write this step's K/V into the fixed-size cache at position pos
-            k_ = jax.lax.dynamic_update_slice(
-                cache["k"], k_.astype(cache["k"].dtype), (0, 0, cache_pos, 0))
-            v_ = jax.lax.dynamic_update_slice(
-                cache["v"], v_.astype(cache["v"].dtype), (0, 0, cache_pos, 0))
-            cache["k"], cache["v"] = k_, v_
+            if use_fused:
+                # fused gather + cache update + attention read: ONE
+                # kernel replaces the beam reorder of this layer's two
+                # cache leaves, the two single-position DUS writes, and
+                # the score/softmax/apply chain (the r5 while-body
+                # op-count lever; ops/pallas/decode_attention.py)
+                from ..ops.pallas.decode_attention import decode_attention
+                fused_out, nk, nv = decode_attention(
+                    q, k_, v_, cache["k"], cache["v"], cache_pos,
+                    src_rows=beam_src)
+                cache["k"], cache["v"] = nk, nv
+            else:
+                # write this step's K/V into the fixed-size cache at
+                # position pos
+                k_ = jax.lax.dynamic_update_slice(
+                    cache["k"], k_.astype(cache["k"].dtype),
+                    (0, 0, cache_pos, 0))
+                v_ = jax.lax.dynamic_update_slice(
+                    cache["v"], v_.astype(cache["v"].dtype),
+                    (0, 0, cache_pos, 0))
+                cache["k"], cache["v"] = k_, v_
     dk = jax.random.fold_in(key, 97) if (key is not None) else None
     # sequence-parallel path: full-sequence attention (training/scoring, not
     # the cached decode step) runs ring/ulysses over the 'seq' mesh axis so
@@ -799,7 +869,9 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
             sp_fallback = "attention dropout is active in training"
         if sp_fallback is not None:
             _warn_sp_fallback(sp_fallback)
-    if sp_wanted and sp_fallback is None:
+    if fused_out is not None:
+        out, weights = fused_out, None
+    elif sp_wanted and sp_fallback is None:
         from ..parallel.sequence import ring_attention_sharded
         out = ring_attention_sharded(cfg.seq_mesh, q, k_, v_,
                                      kv_mask=kv_mask, causal=causal,
@@ -810,7 +882,8 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
             q, k_, v_, mask, kv_mask=kv_mask, causal=causal,
             dropout_rate=cfg.attention_dropout, dropout_key=dk,
             deterministic=not train, return_weights=return_weights,
-            flash=cfg.flash_attention)
+            flash=cfg.flash_attention,
+            packed=getattr(cfg, "packed_attention", "auto"))
     if cfg.no_projection:
         return _merge_heads(out), weights
     wo, bo = params[f"{prefix}_Wo"], params[f"{prefix}_bo"]
@@ -1662,11 +1735,18 @@ def _maybe_lsh_state(cfg: TransformerConfig, params: Params,
 def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
                 prev_ids: jax.Array, src_mask: jax.Array,
                 shortlist: Optional[jax.Array] = None,
-                return_alignment: bool = False):
+                return_alignment: bool = False,
+                beam_src: Optional[jax.Array] = None,
+                fused_decode: Optional[bool] = None):
     """One decode step on [B, 1] previous ids → ([B, V] logits, new state).
 
     All shapes static; `state['pos']` is the traced time index. The self-attn
     mask allows positions <= pos (cache beyond pos is zeros but masked out).
+    `beam_src` [B] int32: pending beam backpointers for the fused decode
+    kernel (see _mha); the beam search passes them instead of reordering
+    the self-attention caches when fused_decode_active(cfg).
+    `fused_decode=False` force-disables the kernel regardless of the
+    config gate (the beam search under a decode mesh — see _mha).
     """
     pos = state["pos"]
     scanned = "stack_self_k" in state
@@ -1714,7 +1794,9 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
             pv = {**params, **{f"decoder_lS_{s}": v
                                for s, v in leaves.items()}}
             x, new_c, _ = _decode_layer(cfg, pv, "decoder_lS", x, pos,
-                                        self_mask, cross_masks, cc, n_enc)
+                                        self_mask, cross_masks, cc, n_enc,
+                                        beam_src=beam_src,
+                                        fused_decode=fused_decode)
             return x, (new_c["self_k"], new_c["self_v"])
 
         x, (new_sk, new_sv) = jax.lax.scan(body, x, (stacked, caches))
@@ -1740,7 +1822,8 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
         want_w = return_alignment and _is_alignment_layer(cfg, l)
         x, new_c, align_l = _decode_layer(
             cfg, params, f"decoder_l{pl}", x, pos, self_mask, cross_masks,
-            caches_l, n_enc, want_w=want_w)
+            caches_l, n_enc, want_w=want_w, beam_src=beam_src,
+            fused_decode=fused_decode)
         for kind in kinds:
             new_state[f"l{l}_{kind}"] = new_c[kind]
         if align_l is not None:
@@ -1756,7 +1839,9 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
 
 def _decode_layer(cfg: TransformerConfig, pv: Params, lp: str, x: jax.Array,
                   pos, self_mask, cross_masks, caches: Dict[str, jax.Array],
-                  n_enc: int, want_w: bool = False):
+                  n_enc: int, want_w: bool = False,
+                  beam_src: Optional[jax.Array] = None,
+                  fused_decode: Optional[bool] = None):
     """One decode-step layer, shared verbatim between the scanned and the
     unrolled stacks (the training path shares dec_layer the same way).
     `caches` holds THIS layer's state leaves keyed by kind ('self_k',
@@ -1786,7 +1871,8 @@ def _decode_layer(cfg: TransformerConfig, pv: Params, lp: str, x: jax.Array,
     else:
         cache = {"k": caches["self_k"], "v": caches["self_v"]}
         out, _ = _mha(cfg, pv, f"{lp}_self", pre, pre, self_mask,
-                      None, False, cache=cache, cache_pos=pos)
+                      None, False, cache=cache, cache_pos=pos,
+                      beam_src=beam_src, fused_decode=fused_decode)
         new_c["self_k"] = cache["k"]
         new_c["self_v"] = cache["v"]
     x = _pre_post(cfg, _strip_dropout(cfg.postprocess), out, x,

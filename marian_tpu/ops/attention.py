@@ -60,30 +60,51 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               deterministic: bool = True,
               return_weights: bool = False,
               flash: str = "auto",
-              flash_min_len: Optional[int] = None):
-    """Attention dispatcher: dense (XLA-fused einsum) vs Pallas flash.
+              flash_min_len: Optional[int] = None,
+              packed: str = "auto",
+              packed_max_len: Optional[int] = None):
+    """Attention dispatcher: dense (XLA-fused einsum) vs the two Pallas
+    kernels — flash (long sequences) and head-packed (short sequences).
 
     `mask` is the general [B,1,Tq,Tk] dense mask; `kv_mask` [B,Tk] + `causal`
-    is the structured form the flash kernel understands. Callers that can,
-    pass both — flash is picked when it is (a) allowed (`flash` = auto|on),
+    is the structured form both Pallas kernels understand. Callers that can,
+    pass both. A kernel is picked when it is (a) allowed (its gate = auto|on),
     (b) applicable (no returned weights, no active attention dropout, a
     structured mask describing the dense one, multi-query step), and (c) for
-    "auto", worth it (sequence long enough that streaming K/V blocks beats
-    one fused dense batch matmul; crossover measured on v5e ~1-2k)."""
+    "auto", worth it on its regime: flash when the sequence is long enough
+    that streaming K/V blocks beats one fused dense batch matmul (crossover
+    measured on v5e ~1-2k); packed when the sequence is SHORT enough that
+    the dh=64-contraction einsums underfill the 128x128 MXU (the r5
+    truth-table 21.7%/30.6% geometry, docs/PERFORMANCE.md) and a head
+    group actually packs (g >= 2, i.e. dh <= 64). Packed 'auto' engages on
+    the TPU backend only — in interpret mode it would just be a slower
+    dense path. Flash owns the overlap: its gate is checked first."""
     if flash_min_len is None:
         # default crossover; --auto-tune rebinds it (ops/auto_tuner.py)
         from .auto_tuner import flash_threshold
         flash_min_len = flash_threshold()
     applicable = (
-        flash != "off"
-        and not return_weights
+        not return_weights
         and (deterministic or dropout_rate == 0.0)
         and q.shape[-2] > 1
         and (kv_mask is not None or causal or mask is None))
-    if applicable and (flash == "on" or
-                       max(q.shape[-2], k.shape[-2]) >= flash_min_len):
+    if applicable and flash != "off" and (
+            flash == "on" or max(q.shape[-2], k.shape[-2]) >= flash_min_len):
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, kv_mask=kv_mask, causal=causal), None
+    if applicable and packed != "off":
+        from .auto_tuner import packed_attention_max_t
+        from .pallas.packed_attention import pack_group
+        dh = q.shape[-1]
+        cap = (packed_max_len if packed_max_len is not None
+               else packed_attention_max_t(dh))
+        fits = max(q.shape[-2], k.shape[-2]) <= cap
+        wins = pack_group(q.shape[1], dh) >= 2 \
+            and jax.default_backend() == "tpu"
+        if fits and (packed == "on" or wins):
+            from .pallas.packed_attention import packed_attention
+            return packed_attention(q, k, v, kv_mask=kv_mask,
+                                    causal=causal), None
     return dense_attention_with_weights(
         q, k, v, mask, dropout_rate, dropout_key, deterministic,
         return_weights)

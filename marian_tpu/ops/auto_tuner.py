@@ -65,6 +65,66 @@ class AutoTuner:
 
 
 # ---------------------------------------------------------------------------
+# Pallas kernel block/capacity registry (r6). One table, one convention:
+# entries are validated at dh=64 (the NMT head width every silicon number
+# was taken at) and HALVE for wider heads — per-cell VMEM scales with
+# dh x the sequence-side block, so oversized heads degrade to smaller
+# blocks (or the callers' fallback paths) instead of a Mosaic VMEM OOM.
+# Same rule the r5 flash_attention dh>64 block_k halving established.
+# ---------------------------------------------------------------------------
+
+# per-kernel base entries at dh<=64: the sequence-side capacity each
+# kernel holds per grid cell (packed: full padded Tq=Tk per (b, head-
+# group) cell; decode: the whole [L, dh] cache row per (row, head) cell)
+KERNEL_BLOCKS = {
+    # packed fwd cell peak ~ g*T x g*T f32 scores + operands; T=256 at
+    # g=2/dh=64 is ~2.5 MB — comfortably under the ~16 MB VMEM budget,
+    # and the target regime (T 48-64) is far below the cap anyway
+    "packed_attention": {"max_t": 256},
+    # decode cell holds 2 x [L, dh] cache blocks + the [1, L] score row;
+    # L=2048 at dh=64 f32 is ~1 MB/cache block
+    "decode_attention": {"max_len": 2048},
+}
+
+
+def _dh_scaled(base: int, dh: int) -> int:
+    """Halve a sequence-side capacity for every doubling of head width
+    past the validated dh=64 (floor: one 64-wide block)."""
+    v = base
+    width = 64
+    while width < dh:
+        v //= 2
+        width *= 2
+    return max(v, 64)
+
+
+def kernel_block(kernel: str, key: str, dh: int) -> int:
+    """Registry lookup with the dh-scaled VMEM convention applied."""
+    return _dh_scaled(KERNEL_BLOCKS[kernel][key], dh)
+
+
+def packed_attention_max_t(dh: int) -> int:
+    """Longest (padded) sequence the packed kernel takes per cell; past
+    it the dispatcher leaves the shape to dense/flash.
+
+    Two VMEM axes bound it: wide heads grow the [T, dh] operand blocks
+    (the halving rule above), and NARROW heads grow the pack group g =
+    128//dh, whose backward kernel materializes [g*T, g*T] f32 blocks —
+    quadratic in g·T. So the cap bounds g*T at the validated point
+    (dh=64: g=2 × T=256 = 512), not T alone: dh=32 → 128, dh=16 → 64.
+    The target regime (T 48-64) stays inside the cap at every dh."""
+    base = kernel_block("packed_attention", "max_t", dh)
+    g = max(1, 128 // max(dh, 1))
+    return max(64, min(base, 512 // g))
+
+
+def decode_attention_max_len(dh: int) -> int:
+    """Longest decode cache the fused kernel holds per cell; past it
+    decode_attention degrades to its unfused jnp reference path."""
+    return kernel_block("decode_attention", "max_len", dh)
+
+
+# ---------------------------------------------------------------------------
 # flash-attention crossover calibration
 # ---------------------------------------------------------------------------
 

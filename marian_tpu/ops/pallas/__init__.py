@@ -4,7 +4,11 @@ The reference's equivalent layer is the hand-written CUDA kernel zoo in
 src/tensors/gpu/ (element.cu, tensor_operators.cu, prod.cpp). Here almost
 all of that collapses into XLA fusion; the kernels that remain are the ones
 where *blockwise scheduling across the memory hierarchy* (HBM->VMEM) is the
-win: flash attention for long sequences.
+win: flash attention for long sequences, head-packed attention for the
+short-sequence MXU-tile-geometry regime, and the fused beam-gather +
+cache-read decode step.
 """
 
+from .decode_attention import decode_attention  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
+from .packed_attention import packed_attention  # noqa: F401

@@ -1,0 +1,297 @@
+"""Head-packed attention as a Pallas TPU kernel — the short-sequence MXU fix.
+
+The r5 GEMM truth table (docs/PERFORMANCE.md) measured the attention
+score/apply einsums at 21.7%/30.6% of MXU peak at bench shapes: a dh=64
+contraction fills only half the 128-deep systolic array, and a T=48-64
+output fills only ~37-50% of its lanes, so XLA's per-(b,h) batched dot
+burns a full 128x128 tile pass per head while using ~a fifth of it. No
+XLA flag changes tile geometry (the TVM line of work, PAPERS.md, shows
+graph compilers don't recover this class automatically) — the fix is to
+PACK head groups into one full tile, which this kernel does with
+block-diagonal operand packing:
+
+  scores, per group of g = 128//dh heads (g=2 at dh=64):
+      [Tq, g*dh] = [q_0 | q_1]          (heads concatenated on contraction)
+      [g*dh, g*Tk] = diag(k_0^T, k_1^T) (block-diagonal keys)
+      one dot -> [Tq, g*Tk] = [s_0 | s_1]: contraction g*dh = 128 (full
+      sublanes), output g*Tk ~ 128 (full lanes)
+  apply:
+      [Tq, g*Tk] = [p_0 | p_1]  @  diag(v_0, v_1) [g*Tk, g*dh]
+      -> [Tq, g*dh] = [o_0 | o_1]: contraction g*Tk = 128, output 128.
+
+The zero blocks double the nominal FLOPs, but the MXU pays per tile PASS,
+not per useful FLOP: two heads per pass at full geometry vs one head per
+pass at ~22% is the win (analytic ~2.3x on the score dot; silicon number
+pending a tunnel window — see PERFORMANCE.md r6). The custom VJP keeps
+the same packed geometry in both backward orientations: dp/dq pack the
+dh- and Tk-contractions exactly like the forward, dk/dv pack the Tq
+contraction by stacking the group's rows (block-diag ds^T/p^T against
+row-stacked q/do).
+
+This kernel owns the T <= packed-cap regime (NMT sentence lengths);
+flash_attention.py owns the long-sequence end. Same structured-mask
+interface as flash: kv_mask [B, Tk] (1.0 = attend) and/or causal.
+Attention dropout and returned weights fall back to the dense path via
+the dispatcher (ops/attention.py).
+
+Shapes: q [B,H,Tq,Dh], k/v [B,H,Tk,Dh] -> out [B,H,Tq,Dh]. Compute is
+f32 on the MXU regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import (MASK_VALUE, _HAS_PLTPU, _interpret_default,
+                              _round_up)
+
+# Sequence dims pad to multiples of 64 so a g=2 pack lands on exactly
+# 128 lanes/sublanes (the MXU tile edge); g>2 packs (dh 32/16) land on
+# multiples of it.
+_PAD = 64
+
+
+def pack_group(heads: int, dh: int) -> int:
+    """Heads per MXU tile: the largest divisor of `heads` with
+    g*dh <= 128. g=1 means packing buys nothing (dh > 64)."""
+    g = max(1, 128 // max(dh, 1))
+    while g > 1 and heads % g:
+        g -= 1
+    return g
+
+
+def _causal_rows(i0: int, bq: int, bk: int):
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i0
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return qpos >= kpos
+
+
+def _packed_scores(qs, ks, kvm, scale, causal, g, bq, bk, dh):
+    """The packed score dot + per-head mask/softmax. qs/ks are length-g
+    lists of [bq, dh]/[bk, dh] f32 blocks; returns (packed probs
+    [bq, g*bk] f32, per-head prob list)."""
+    qc = jnp.concatenate(qs, axis=1)                  # [bq, g*dh]
+    kc = jnp.zeros((g * dh, g * bk), jnp.float32)
+    for j in range(g):
+        kc = jax.lax.dynamic_update_slice(kc, ks[j].T, (j * dh, j * bk))
+    s2 = jax.lax.dot_general(
+        qc, kc, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [bq, g*bk]
+    live = _causal_rows(0, bq, bk) if causal else None
+    ps = []
+    for j in range(g):
+        s = s2[:, j * bk:(j + 1) * bk]                # static lane slice
+        s = s + (1.0 - kvm)[None, :] * MASK_VALUE
+        if causal:
+            s = jnp.where(live, s, MASK_VALUE)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        # l >= 1 always (the row-max key contributes exp(0) even on a
+        # fully-masked row, which then yields UNIFORM probs — exactly
+        # the dense path's softmax-of-all-MASK behavior, and callers
+        # discard those rows), so no zero-divisor guard is needed
+        l = jnp.sum(p, axis=1, keepdims=True)
+        ps.append(p / l)
+    return jnp.concatenate(ps, axis=1), ps
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, *, scale, causal, g,
+                bq, bk, dh):
+    qs = [q_ref[0, j].astype(jnp.float32) for j in range(g)]
+    ks = [k_ref[0, j].astype(jnp.float32) for j in range(g)]
+    kvm = kvm_ref[0].astype(jnp.float32)              # [bk]
+    p2, _ = _packed_scores(qs, ks, kvm, scale, causal, g, bq, bk, dh)
+    vc = jnp.zeros((g * bk, g * dh), jnp.float32)
+    for j in range(g):
+        vc = jax.lax.dynamic_update_slice(
+            vc, v_ref[0, j].astype(jnp.float32), (j * bk, j * dh))
+    o2 = jax.lax.dot_general(
+        p2, vc, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [bq, g*dh]
+    for j in range(g):
+        o_ref[0, j] = o2[:, j * dh:(j + 1) * dh].astype(o_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, *, scale, causal, g, bq, bk, dh):
+    """One pass per (b, head-group): recomputes the packed probs, then
+    runs all four backward dots in packed geometry. dp and dq reuse the
+    forward's dh-/Tk-contraction packing; dk and dv pack the Tq
+    contraction as block-diag(ds_j^T / p_j^T) @ row-stacked (q / do)."""
+    qs = [q_ref[0, j].astype(jnp.float32) for j in range(g)]
+    ks = [k_ref[0, j].astype(jnp.float32) for j in range(g)]
+    dos = [do_ref[0, j].astype(jnp.float32) for j in range(g)]
+    kvm = kvm_ref[0].astype(jnp.float32)
+    _, ps = _packed_scores(qs, ks, kvm, scale, causal, g, bq, bk, dh)
+
+    # dp: [do_0 | do_1] @ diag(v_0^T, v_1^T) — forward-score geometry
+    doc = jnp.concatenate(dos, axis=1)                # [bq, g*dh]
+    vt = jnp.zeros((g * dh, g * bk), jnp.float32)
+    for j in range(g):
+        vt = jax.lax.dynamic_update_slice(
+            vt, v_ref[0, j].astype(jnp.float32).T, (j * dh, j * bk))
+    dp2 = jax.lax.dot_general(
+        doc, vt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [bq, g*bk]
+
+    dss = []
+    for j in range(g):
+        delta = delta_ref[0, j][:, :1]                # [bq, 1]
+        dp = dp2[:, j * bk:(j + 1) * bk]
+        dss.append(ps[j] * (dp - delta) * scale)
+
+    # dq: [ds_0 | ds_1] @ diag(k_0, k_1) — forward-apply geometry
+    ds2 = jnp.concatenate(dss, axis=1)                # [bq, g*bk]
+    kr = jnp.zeros((g * bk, g * dh), jnp.float32)
+    for j in range(g):
+        kr = jax.lax.dynamic_update_slice(kr, ks[j], (j * bk, j * dh))
+    dq2 = jax.lax.dot_general(
+        ds2, kr, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [bq, g*dh]
+    for j in range(g):
+        dq_ref[0, j] = dq2[:, j * dh:(j + 1) * dh].astype(dq_ref.dtype)
+
+    # dk / dv: pack the Tq contraction — diag(ds_j^T / p_j^T) [g*bk, g*bq]
+    # against the group's rows stacked [g*bq, dh]
+    dst = jnp.zeros((g * bk, g * bq), jnp.float32)
+    pt = jnp.zeros((g * bk, g * bq), jnp.float32)
+    for j in range(g):
+        dst = jax.lax.dynamic_update_slice(dst, dss[j].T, (j * bk, j * bq))
+        pt = jax.lax.dynamic_update_slice(pt, ps[j].T, (j * bk, j * bq))
+    qr = jnp.concatenate(qs, axis=0)                  # [g*bq, dh]
+    dor = jnp.concatenate(dos, axis=0)
+    dk2 = jax.lax.dot_general(
+        dst, qr, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [g*bk, dh]
+    dv2 = jax.lax.dot_general(
+        pt, dor, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    for j in range(g):
+        dk_ref[0, j] = dk2[j * bk:(j + 1) * bk].astype(dk_ref.dtype)
+        dv_ref[0, j] = dv2[j * bk:(j + 1) * bk].astype(dv_ref.dtype)
+
+
+def _compiler_params():
+    if not _HAS_PLTPU:  # pragma: no cover
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"))
+
+
+def _specs(b, g, tq, tk, dh):
+    """Block specs shared by fwd and bwd: one (batch, head-group) cell
+    per grid point, full (padded) sequences per cell — the kernel owns
+    the short-T regime, so no k-streaming is needed."""
+    qspec = pl.BlockSpec((1, g, tq, dh), lambda b_, hg: (b_, hg, 0, 0))
+    kspec = pl.BlockSpec((1, g, tk, dh), lambda b_, hg: (b_, hg, 0, 0))
+    mspec = pl.BlockSpec((1, tk), lambda b_, hg: (b_, 0))
+    return qspec, kspec, mspec
+
+
+def _fwd_call(q, k, v, kvm, scale, causal, g, interpret):
+    b, h, tq, dh = q.shape
+    tk = k.shape[2]
+    qspec, kspec, mspec = _specs(b, g, tq, tk, dh)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               g=g, bq=tq, bk=tk, dh=dh)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h // g),
+        in_specs=[qspec, kspec, kspec, mspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, dh), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(q, k, v, kvm)
+
+
+def _bwd_call(q, k, v, kvm, do, delta, scale, causal, g, interpret):
+    b, h, tq, dh = q.shape
+    tk = k.shape[2]
+    qspec, kspec, mspec = _specs(b, g, tq, tk, dh)
+    dspec = pl.BlockSpec((1, g, tq, 1), lambda b_, hg: (b_, hg, 0, 0))
+    kernel = functools.partial(_bwd_kernel, scale=scale, causal=causal,
+                               g=g, bq=tq, bk=tk, dh=dh)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h // g),
+        in_specs=[qspec, kspec, kspec, mspec, qspec, dspec],
+        out_specs=[qspec, kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq, dh), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, dh), k.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, dh), v.dtype),
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else _compiler_params(),
+    )(q, k, v, kvm, do, delta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _packed(q, k, v, kvm, scale, causal, g, interpret):
+    return _fwd_call(q, k, v, kvm, scale, causal, g, interpret)
+
+
+def _packed_fwd(q, k, v, kvm, scale, causal, g, interpret):
+    out = _fwd_call(q, k, v, kvm, scale, causal, g, interpret)
+    return out, (q, k, v, kvm, out)
+
+
+def _packed_bwd(scale, causal, g, interpret, res, do):
+    q, k, v, kvm, out = res
+    # delta = rowsum(do * o) per (b,h,row) — cheap elementwise outside
+    # the kernel (the bwd kernel recomputes probs, flash-style, so no
+    # stats ride the residuals)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)           # [B,H,Tq,1]
+    dq, dk, dv = _bwd_call(q, k, v, kvm, do, delta, scale, causal, g,
+                           interpret)
+    return dq, dk, dv, jnp.zeros_like(kvm)
+
+
+_packed.defvjp(_packed_fwd, _packed_bwd)
+
+
+def packed_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     kv_mask: Optional[jax.Array] = None,
+                     causal: bool = False,
+                     scale: Optional[float] = None,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """softmax(scale * Q K^T + mask) V with head-group-packed MXU tiles.
+
+    q [B,H,Tq,Dh], k/v [B,H,Tk,Dh], kv_mask [B,Tk] (1.0 = attend) or
+    None. Sequence dims pad internally to multiples of 64 (padded keys
+    masked out, padded query rows sliced off; the custom VJP runs on the
+    padded shapes, so cotangents of padded rows are exact zeros).
+    """
+    b, h, tq, dh = q.shape
+    tk = k.shape[2]
+    g = pack_group(h, dh)
+    if scale is None:
+        scale = 1.0 / (dh ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    tq_p, tk_p = _round_up(tq, _PAD), _round_up(tk, _PAD)
+    if kv_mask is None:
+        kvm = jnp.ones((b, tk), jnp.float32)
+    else:
+        kvm = kv_mask.astype(jnp.float32).reshape(b, tk)
+    if tk_p != tk:
+        kvm = jnp.pad(kvm, ((0, 0), (0, tk_p - tk)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, tk_p - tk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, tk_p - tk), (0, 0)))
+    if tq_p != tq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, tq_p - tq), (0, 0)))
+
+    out = _packed(q, k, v, kvm, float(scale), bool(causal), g,
+                  bool(interpret))
+    if tq_p != tq:
+        out = out[:, :, :tq, :]
+    return out

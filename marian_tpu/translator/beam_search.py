@@ -133,7 +133,7 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
                     shortlist: Optional[jax.Array] = None,
                     sample_key: Optional[jax.Array] = None,
                     prefix: Optional[jax.Array] = None,
-                    mesh=None):
+                    mesh=None, allow_fused: bool = True):
     """The jittable core. Returns (tokens [B,K,L], raw_scores [B,K],
     lengths [B,K], norm_scores [B,K], alignments [B,K,L,Ts] or None,
     word_scores [B,K,L] — per-step chosen-token logP, --word-scores).
@@ -145,6 +145,22 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
     k = cfg.beam_size
     L = cfg.max_length
     bk = b * k
+
+    # Fused decode kernel (ops/pallas/decode_attention.py): the beam
+    # reorder of the self-attention caches is folded into the kernel's
+    # cache READ — the loop carries the chosen backpointers as flat
+    # source rows and hands them to the NEXT step instead of gathering
+    # the cache leaves here. Caches lag the beam by exactly one step by
+    # construction; every read goes through the pending map, so results
+    # are identical (tests/test_decode_attention.py pins it). Gated off
+    # under a decode mesh AND when the caller says the params/caches are
+    # already device-sharded (allow_fused=False — TP/pipe-sharded
+    # training params at a validation decode): the pallas call is opaque
+    # to GSPMD, which would re-replicate the sharded caches around it —
+    # those paths keep the manual shard_map'd flat gather
+    # (collective-free pin).
+    fused = (mesh is None and allow_fused
+             and bool(getattr(model, "fused_decode_reorder", False)))
 
     # encoder once per scorer; expand rows to B*K (reference: startState then
     # flattened batch×beam decoding)
@@ -174,25 +190,37 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
 
     def cond(carry):
         (t, _tokens, _scores, finished, _lengths, _prev, _states, _al,
-         _ws) = carry
+         _ws, _src) = carry
         return jnp.logical_and(t < L, ~jnp.all(finished))
 
     def body(carry):
         (t, tokens, scores, finished, lengths, prev, states, aligns,
-         wscores) = carry
+         wscores, src_rows) = carry
         # ensemble log-probs
         logp = None
         align_t = None
         new_states = []
+        if fused:
+            step_kw = {"beam_src": src_rows}
+        elif getattr(model, "fused_decode_reorder", False):
+            # mesh decode with the kernel's config gate on: force it
+            # OFF inside the step too — the GSPMD-opaque pallas call
+            # would re-replicate the sharded caches even with an
+            # identity gather (the reorder itself already fell back to
+            # the shard_map'd flat gather above)
+            step_kw = {"fused_decode": False}
+        else:
+            step_kw = {}
         for params, st, w in zip(params_list, states, weights):
             if cfg.return_alignment:
                 logits, st2, al = model.step(params, st, prev, src_mask_bk,
                                              shortlist=shortlist,
-                                             return_alignment=True)
+                                             return_alignment=True,
+                                             **step_kw)
                 align_t = al if align_t is None else align_t + al
             else:
                 logits, st2 = model.step(params, st, prev, src_mask_bk,
-                                         shortlist=shortlist)
+                                         shortlist=shortlist, **step_kw)
             lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             logp = w * lp if logp is None else logp + w * lp
             new_states.append(st2)
@@ -362,6 +390,11 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
             for key, v in st.items():
                 if key == "pos":
                     out[key] = v
+                elif fused and key.endswith(("_self_k", "_self_v")):
+                    # fused decode kernel: the pending backpointers ride
+                    # the carry and the NEXT step's cache read applies
+                    # them — no gather here
+                    out[key] = v
                 elif key.endswith(carried):
                     # 'stack_*' = scanned decode caches [L, B*K, ...]:
                     # the batch axis is axis 1
@@ -373,15 +406,21 @@ def beam_search_jit(model, params_list: List[Dict[str, jax.Array]],
 
         states2 = tuple(reorder_state(st) for st in new_states)
         prev = tok_full.reshape(bk, 1)
+        if fused:
+            src_rows = (jnp.arange(b, dtype=jnp.int32)[:, None] * k
+                        + beam_idx.astype(jnp.int32)).reshape(bk)
         return (t + 1, tokens, scores, new_finished, lengths, prev, states2,
-                aligns, wscores)
+                aligns, wscores, src_rows)
 
     init = (jnp.zeros((), jnp.int32), tokens0, scores0, finished0, lengths0,
             prev0, tuple(states), aligns0,
             (jnp.zeros((b, k, L), jnp.float32) if cfg.word_scores
-             else jnp.zeros((0,), jnp.float32)))
-    (t, tokens, scores, finished, lengths, prev, states, aligns, wscores) = \
-        jax.lax.while_loop(cond, body, init)
+             else jnp.zeros((0,), jnp.float32)),
+            # pending-backpointer carry: identity before the first top-k
+            (jnp.arange(bk, dtype=jnp.int32) if fused
+             else jnp.zeros((0,), jnp.int32)))
+    (t, tokens, scores, finished, lengths, prev, states, aligns, wscores,
+     _src) = jax.lax.while_loop(cond, body, init)
 
     # unfinished beams at L: length = L
     lengths = jnp.where(finished, lengths, L)
@@ -434,8 +473,12 @@ class BeamSearch:
         nd = int(options.get("num-devices", 0) or 0) or len(local)
         nd = max(1, min(nd, len(local)))
         self.mesh = None
-        if nd > 1 and not any(self._mesh_sharded(p)
-                              for p in self.params_list):
+        # sharded scorer params (TP/pipe training params at a validation
+        # decode) also veto the fused decode kernel: its pallas call is
+        # GSPMD-opaque and would all-gather the sharded caches per step
+        self._sharded_params = any(self._mesh_sharded(p)
+                                   for p in self.params_list)
+        if nd > 1 and not self._sharded_params:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec
             self.mesh = Mesh(np.array(local[:nd]), ("data",))
             rep = NamedSharding(self.mesh, PartitionSpec())
@@ -458,6 +501,17 @@ class BeamSearch:
             self.params_list = [jax.tree_util.tree_map(_replicate, p)
                                 for p in self.params_list]
 
+    @property
+    def fused_decode_engaged(self) -> bool:
+        """Whether beam_search_jit will actually run the fused decode
+        kernel for this instance — the ONE place the gate's terms live
+        (mirrored into beam_search_jit via mesh/allow_fused), so bench
+        provenance fields cannot desynchronize from the compiled
+        program."""
+        return (self.mesh is None and not self._sharded_params
+                and bool(getattr(self.model, "fused_decode_reorder",
+                                 False)))
+
     @staticmethod
     def _mesh_sharded(params) -> bool:
         """True if any param leaf is already non-replicated device-sharded
@@ -478,13 +532,14 @@ class BeamSearch:
             model, weights = self.model, tuple(self.weights)
 
             mesh = self.mesh
+            allow_fused = not self._sharded_params
 
             def fn(params_list, src_ids, src_mask, shortlist=None,
                    sample_key=None, prefix=None):
                 return beam_search_jit(model, list(params_list), weights, cfg,
                                        src_ids, src_mask, shortlist,
                                        sample_key=sample_key, prefix=prefix,
-                                       mesh=mesh)
+                                       mesh=mesh, allow_fused=allow_fused)
 
             self._jitted[key] = jax.jit(fn, static_argnames=())
         return self._jitted[key]

@@ -1,6 +1,11 @@
 """Greedy decoding — the minimal incremental-decode path (used by tests and
 as the beam-size-1 fast path). Runs the same start_state/step API as
-BeamSearch (reference: the b=1 special case of beam_search.cpp)."""
+BeamSearch (reference: the b=1 special case of beam_search.cpp).
+
+There is no beam reorder here, so no beam_src is passed to step(): when
+the fused decode kernel is active (--transformer-fused-decode-attention,
+ops/pallas/decode_attention.py) it runs with the identity gather and
+still collapses the per-layer cache-write + attention-read op chain."""
 
 from __future__ import annotations
 

@@ -169,8 +169,22 @@ ladder() {
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage headline 7200 MARIAN_BENCH_PRESET=$PRESET
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
-    # 2 — decode family
+    # r6 packed-attention kernel A/B: auto engages the kernel on TPU,
+    # so the headline above already runs packed — this leg turns it OFF
+    # to isolate the gain (analytic ~+6 MFU pts at bench shapes,
+    # PERFORMANCE.md r6; if packed_off WINS, the kernel regressed and
+    # the auto default must flip until fixed). The microbench prints
+    # the isolated per-dot table: scripts/attn_microbench.py.
+    stage packed_off 5400 MARIAN_BENCH_PRESET=$PRESET \
+                          MARIAN_BENCH_PACKED=off
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    # 2 — decode family (decode_float runs the r6 fused gather+attention
+    # kernel via its auto gate; decode_unfused is the A/B — compare
+    # sent/s AND the while_body_ops field, the r5-identified op floor)
     stage_decode decode_float   MARIAN_DECBENCH_PRESET=$PRESET
+    [ "$TUNNEL_DEGRADED" = 1 ] && return 1
+    stage_decode decode_unfused MARIAN_DECBENCH_PRESET=$PRESET \
+                                MARIAN_DECBENCH_FUSED=off
     [ "$TUNNEL_DEGRADED" = 1 ] && return 1
     stage_decode decode_int8    MARIAN_DECBENCH_PRESET=$PRESET \
                                 MARIAN_DECBENCH_INT8=1

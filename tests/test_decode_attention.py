@@ -1,0 +1,224 @@
+"""Fused beam-gather + attention decode kernel vs the unfused sequence
+it replaces (tier-1, interpret mode on CPU).
+
+Golden parity at three levels:
+- kernel vs the explicit take_along_axis-style reorder + DUS + masked
+  dense attention read (the exact op chain beam_search/_mha ran before);
+- one _mha decode step with the fused gate on vs off;
+- full beam search / greedy decode with the gate on vs off — the
+  one-step-lagged backpointer contract in translator/beam_search.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.ops.pallas.decode_attention import decode_attention
+
+from tests.test_beam_search import tiny_model
+
+
+def _rand(rng, *shape, dtype=jnp.float32):
+    return jnp.asarray(rng.randn(*shape), dtype)
+
+
+def _unfused_reference(q, k_new, v_new, cache_k, cache_v, pos, src_rows,
+                       scale):
+    """The op chain the kernel replaces, written with take_along_axis —
+    deliberately a DIFFERENT gather form than the kernel's index-map
+    (and than the flat-gather fallback), so the parity check is against
+    independent code."""
+    if src_rows is not None:
+        idx = src_rows.reshape(-1, 1, 1, 1)
+        cache_k = jnp.take_along_axis(cache_k, idx, axis=0)
+        cache_v = jnp.take_along_axis(cache_v, idx, axis=0)
+    cache_k = jax.lax.dynamic_update_slice(
+        cache_k, k_new.astype(cache_k.dtype), (0, 0, pos, 0))
+    cache_v = jax.lax.dynamic_update_slice(
+        cache_v, v_new.astype(cache_v.dtype), (0, 0, pos, 0))
+    s = jnp.einsum("rhqd,rhkd->rhqk", q.astype(jnp.float32),
+                   cache_k.astype(jnp.float32)) * scale
+    steps = jnp.arange(cache_k.shape[2])[None, None, None, :]
+    s = jnp.where(steps <= pos, s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("rhqk,rhkd->rhqd", p,
+                     cache_v.astype(jnp.float32)).astype(q.dtype)
+    return out, cache_k, cache_v
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_matches_take_along_axis_reference(rng, dtype):
+    r, h, L, dh = 6, 2, 16, 8
+    q = _rand(rng, r, h, 1, dh, dtype=dtype)
+    kn = _rand(rng, r, h, 1, dh, dtype=dtype)
+    vn = _rand(rng, r, h, 1, dh, dtype=dtype)
+    ck = _rand(rng, r, h, L, dh, dtype=dtype)
+    cv = _rand(rng, r, h, L, dh, dtype=dtype)
+    src = jnp.asarray(rng.randint(0, r, r), jnp.int32)
+    pos = jnp.asarray(5, jnp.int32)
+    out, nk, nv = decode_attention(q, kn, vn, ck, cv, pos, src_rows=src)
+    ro, rk, rv = _unfused_reference(q, kn, vn, ck, cv, 5, src,
+                                    1.0 / dh ** 0.5)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 2e-5
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ro, np.float32),
+                               rtol=tol, atol=tol)
+    # the materialized caches must be BITWISE the reorder+DUS result —
+    # they are the next step's input state
+    assert (np.asarray(nk) == np.asarray(rk)).all()
+    assert (np.asarray(nv) == np.asarray(rv)).all()
+
+
+def test_identity_gather_and_traced_pos_under_jit(rng):
+    """src_rows=None (greedy/scoring) = identity; pos traced (the decode
+    loop's time index)."""
+    r, h, L, dh = 4, 2, 12, 16
+    q, kn, vn = (_rand(rng, r, h, 1, dh), _rand(rng, r, h, 1, dh),
+                 _rand(rng, r, h, 1, dh))
+    ck, cv = _rand(rng, r, h, L, dh), _rand(rng, r, h, L, dh)
+    fn = jax.jit(lambda pos: decode_attention(q, kn, vn, ck, cv, pos))
+    for pos in (0, 3, L - 1):
+        out, nk, nv = fn(jnp.asarray(pos, jnp.int32))
+        ro, rk, rv = _unfused_reference(q, kn, vn, ck, cv, pos, None,
+                                        1.0 / dh ** 0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
+                                   rtol=2e-5, atol=2e-5)
+        assert (np.asarray(nk) == np.asarray(rk)).all()
+
+
+def test_oversized_cache_degrades_to_reference_path(rng):
+    """Past the auto_tuner VMEM cap the kernel falls back to the jnp
+    reference (degrade, don't OOM) with identical semantics."""
+    from marian_tpu.ops import auto_tuner
+    r, h, L, dh = 3, 2, 96, 8
+    q, kn, vn = (_rand(rng, r, h, 1, dh), _rand(rng, r, h, 1, dh),
+                 _rand(rng, r, h, 1, dh))
+    ck, cv = _rand(rng, r, h, L, dh), _rand(rng, r, h, L, dh)
+    src = jnp.asarray([2, 0, 1], jnp.int32)
+    out_k, nk_k, _ = decode_attention(q, kn, vn, ck, cv, 4, src_rows=src)
+    orig = dict(auto_tuner.KERNEL_BLOCKS["decode_attention"])
+    try:
+        # shrink the entry below L (the registry floors at one 64-wide
+        # block, so L must exceed 64 to cross the cap)
+        auto_tuner.KERNEL_BLOCKS["decode_attention"]["max_len"] = 8
+        assert auto_tuner.decode_attention_max_len(dh) < L
+        out_f, nk_f, _ = decode_attention(q, kn, vn, ck, cv, 4,
+                                          src_rows=src)
+    finally:
+        auto_tuner.KERNEL_BLOCKS["decode_attention"].update(orig)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_f),
+                               rtol=2e-5, atol=2e-5)
+    assert (np.asarray(nk_k) == np.asarray(nk_f)).all()
+
+
+def _toy_batch(vocab, b=3, ts=6, seed=3):
+    rs = np.random.RandomState(seed)
+    ids = np.zeros((b, ts), np.int32)
+    mask = np.zeros((b, ts), np.float32)
+    for i, n in enumerate(rs.randint(3, ts + 1, size=b)):
+        ids[i, :n] = rs.randint(3, vocab, n)
+        mask[i, :n] = 1.0
+    return ids, mask
+
+
+def test_beam_search_fused_matches_unfused(rng):
+    """The beam-reorder fold: fused on vs off must produce identical
+    hypotheses — the pending-backpointer carry + in-kernel gather is
+    exactly the take_along_axis/flat-gather reorder it replaces."""
+    from marian_tpu.translator.beam_search import BeamSearch
+    vocab = 19
+    ids, mask = _toy_batch(vocab)
+    res = {}
+    for mode in ("off", "on"):
+        model, params, opts = tiny_model(
+            vocab=vocab,
+            **{"transformer-fused-decode-attention": mode,
+               "max-length": 12})
+        assert model.fused_decode_reorder == (mode == "on")
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 3, "normalize": 0.6,
+                                      "max-length": 12}), vocab)
+        res[mode] = bs.search(ids, mask)
+    for h0, h1 in zip(res["off"], res["on"]):
+        assert [h["tokens"] for h in h0] == [h["tokens"] for h in h1]
+        np.testing.assert_allclose([h["norm_score"] for h in h0],
+                                   [h["norm_score"] for h in h1],
+                                   rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_greedy_fused_matches_unfused(rng):
+    """Greedy decode (no beam reorder): the fused kernel runs with the
+    identity gather and must not change a single token."""
+    from marian_tpu.translator.greedy import greedy_decode
+    vocab = 19
+    ids, mask = _toy_batch(vocab, seed=5)
+    outs = {}
+    for mode in ("off", "on"):
+        model, params, _ = tiny_model(
+            vocab=vocab, seed=1,
+            **{"transformer-fused-decode-attention": mode})
+        outs[mode] = greedy_decode(model, params, jnp.asarray(ids),
+                                   jnp.asarray(mask), 10)
+    assert (outs["off"] == outs["on"]).all()
+
+
+@pytest.mark.slow
+def test_scanned_stack_fused_matches_unfused(rng):
+    """The lax.scan decode stack slices per-layer caches from the
+    [L, ...] stacked leaves; the kernel must compose with it."""
+    from marian_tpu.translator.beam_search import BeamSearch
+    vocab = 19
+    ids, mask = _toy_batch(vocab, seed=7)
+    res = {}
+    for mode in ("off", "on"):
+        model, params, opts = tiny_model(
+            vocab=vocab,
+            **{"transformer-fused-decode-attention": mode,
+               "scan-layers": True, "enc-depth": 2, "dec-depth": 2,
+               "max-length": 10})
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 2, "max-length": 10}),
+                        vocab)
+        res[mode] = bs.search(ids, mask)
+    for h0, h1 in zip(res["off"], res["on"]):
+        assert [h["tokens"] for h in h0] == [h["tokens"] for h in h1]
+
+
+def test_fused_gate_resolution():
+    """'auto' must stay off outside the TPU backend; 'on' forces; the
+    non-self-attention autoreg modes never fuse (no KV cache to fold)."""
+    from marian_tpu.models import transformer as T
+    model, _, _ = tiny_model()
+    assert T.fused_decode_active(model.cfg) is False          # auto on CPU
+    model_on, _, _ = tiny_model(
+        **{"transformer-fused-decode-attention": "on"})
+    assert T.fused_decode_active(model_on.cfg) is True
+    model_ssru, _, _ = tiny_model(
+        **{"transformer-fused-decode-attention": "on",
+           "transformer-decoder-autoreg": "rnn"})
+    assert T.fused_decode_active(model_ssru.cfg) is False
+    assert model_ssru.fused_decode_reorder is False
+
+
+def test_while_body_op_count_parser():
+    """bench_decode.while_body_op_count's HLO parse on a toy while
+    program: the body computation's op count, not the entry's."""
+    import sys
+    import pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from bench_decode import while_body_op_count
+
+    def f(x):
+        def body(c):
+            i, v = c
+            return i + 1, v * 2.0 + 1.0
+
+        def cond(c):
+            return c[0] < 10
+
+        return jax.lax.while_loop(cond, body, (0, x))
+
+    n = while_body_op_count(jax.jit(f), jnp.ones((4,), jnp.float32))
+    assert n is not None and n >= 2

@@ -65,6 +65,9 @@ class TestMeshDecode:
         bs = BeamSearch(model, [sharded], None,
                         opts.with_(**{"beam-size": 2}), vocab)
         assert bs.mesh is None
+        # sharded params also veto the fused decode kernel (the pallas
+        # call would make GSPMD all-gather the sharded caches per step)
+        assert bs._sharded_params
         ids, mask = _batch(vocab, b=3)
         out = bs.search(ids, mask)       # still decodes correctly
         assert len(out) == 3
@@ -167,6 +170,67 @@ class TestMeshDecode:
         # all-finished early-exit reduction), never tensor-sized moves
         for k, v in data_moving.items():
             assert v["max_elems"] <= 64, (k, v)
+
+    @pytest.mark.slow
+    def test_fused_decode_parity_and_mesh_gate(self):
+        """r6 fused decode kernel × the decode mesh (slow_core): the
+        Pallas call is opaque to GSPMD, so under a 'data' mesh the gate
+        must fall back to the shard_map'd flat-gather reorder — and the
+        fused single-device program must still produce EXACTLY the mesh
+        program's hypotheses (three-way parity: fused-on 1-dev ==
+        unfused 1-dev == 8-dev mesh)."""
+        vocab = 19
+        ids, mask = _batch(vocab)
+        res = {}
+        for name, nd, fused in (("fused", 1, "on"), ("plain", 1, "off"),
+                                ("mesh", 8, "on")):
+            model, params, opts = tiny_model(
+                vocab=vocab,
+                **{"transformer-fused-decode-attention": fused,
+                   "max-length": 12})
+            bs = BeamSearch(model, [params], None,
+                            opts.with_(**{"beam-size": 3, "normalize": 0.6,
+                                          "num-devices": nd}), vocab)
+            assert (bs.mesh is None) == (nd == 1)
+            res[name] = bs.search(ids, mask)
+        for a, b, c in zip(res["fused"], res["plain"], res["mesh"]):
+            assert [h["tokens"] for h in a] == [h["tokens"] for h in b] \
+                == [h["tokens"] for h in c]
+            np.testing.assert_allclose([h["norm_score"] for h in a],
+                                       [h["norm_score"] for h in c],
+                                       rtol=1e-5)
+        # and the gate must hold INSIDE the step too: with the config
+        # gate forced on, the mesh program must still contain no
+        # tensor-sized collectives (the step receives fused_decode=False
+        # — a pallas call left in the sharded program would make GSPMD
+        # re-replicate the caches around it)
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from marian_tpu.parallel.collectives import collective_stats
+        from marian_tpu.translator.beam_search import BeamConfig
+        model, params, opts = tiny_model(
+            vocab=vocab, **{"transformer-fused-decode-attention": "on",
+                            "max-length": 12})
+        bs = BeamSearch(model, [params], None,
+                        opts.with_(**{"beam-size": 3, "normalize": 0.6,
+                                      "num-devices": 8}), vocab)
+        cfg = BeamConfig.from_options(bs.options, 12)
+        fn = bs._get_fn(cfg, has_shortlist=False)
+
+        def _dev(x):
+            return jax.device_put(
+                jnp.asarray(x),
+                NamedSharding(bs.mesh,
+                              P("data", *([None] * (np.ndim(x) - 1)))))
+        ids8, mask8 = _batch(vocab, b=8)
+        txt = fn.lower(tuple(bs.params_list), _dev(ids8), _dev(mask8),
+                       shortlist=None, sample_key=None,
+                       prefix=None).compile().as_text()
+        for kk, vv in collective_stats(txt).items():
+            if kk in ("all-gather", "all-reduce", "reduce-scatter",
+                      "all-to-all", "collective-permute") \
+                    and vv["count"] > 0:
+                assert vv["max_elems"] <= 64, (kk, vv)
 
     def test_mesh_divisible_batch_no_padding(self):
         vocab = 19
