@@ -25,8 +25,11 @@ self-attention caches are no longer reordered after top-k; the chosen
 backpointers ride the carry as flat source rows and are applied by the
 NEXT step's kernel. Caches lag the beam by one step by construction and
 every read goes through the pending map, so the fixpoint is identical.
-Greedy / scoring decode passes src_rows=None (identity gather) and still
-gets the fused write+read.
+src_rows=None runs the identity gather — but with nothing to fold, the
+full-cache write-back is pure extra HBM traffic vs the unfused
+single-position DUS, so 'auto' fuses only when a beam reorder exists
+(beam_src passed); greedy/scoring decode takes the kernel only under an
+explicit --transformer-fused-decode-attention on (A/Bs, tests).
 
 Shapes: q/k_new/v_new [R,H,1,Dh], cache_k/v [R,H,L,Dh], src_rows [R]
 int32, pos scalar int32 -> (out [R,H,1,Dh], new_k, new_v [R,H,L,Dh]).

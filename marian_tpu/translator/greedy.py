@@ -2,10 +2,12 @@
 as the beam-size-1 fast path). Runs the same start_state/step API as
 BeamSearch (reference: the b=1 special case of beam_search.cpp).
 
-There is no beam reorder here, so no beam_src is passed to step(): when
-the fused decode kernel is active (--transformer-fused-decode-attention,
-ops/pallas/decode_attention.py) it runs with the identity gather and
-still collapses the per-layer cache-write + attention-read op chain."""
+There is no beam reorder here, so no beam_src is passed to step() — and
+with no gather to fold, the fused decode kernel's 'auto' gate stays OFF
+for greedy (its full-cache write-back would only add HBM traffic over
+the in-place single-position cache write). An explicit
+--transformer-fused-decode-attention on still forces the kernel
+(ops/pallas/decode_attention.py) with the identity gather."""
 
 from __future__ import annotations
 

@@ -122,10 +122,14 @@ def _toy_batch(vocab, b=3, ts=6, seed=3):
     return ids, mask
 
 
+@pytest.mark.slow
 def test_beam_search_fused_matches_unfused(rng):
-    """The beam-reorder fold: fused on vs off must produce identical
-    hypotheses — the pending-backpointer carry + in-kernel gather is
-    exactly the take_along_axis/flat-gather reorder it replaces."""
+    """The beam-reorder fold at full-beam-search level: fused on vs off
+    must produce identical hypotheses — the pending-backpointer carry +
+    in-kernel gather is exactly the take_along_axis/flat-gather reorder
+    it replaces. (Tier-1 carries the kernel-level take_along_axis
+    parity above; the slow_core mesh test adds the three-way
+    fused/plain/mesh pin.)"""
     from marian_tpu.translator.beam_search import BeamSearch
     vocab = 19
     ids, mask = _toy_batch(vocab)
