@@ -37,7 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
         prog="mtlint",
         description="JAX/TPU-aware static analysis for marian-tpu "
                     "(trace-safety, host-sync, donation, dtype, guarded-by, "
-                    "metrics hygiene)")
+                    "metrics hygiene, fault-point hygiene)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to lint (default: marian_tpu/)")
     p.add_argument("--baseline", metavar="FILE", default=None,
@@ -52,7 +52,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rules", metavar="FAMILIES", default=None,
                    help="comma-separated rule families to run (default all): "
                         "trace-safety,host-sync,donation,dtype,guarded-by,"
-                        "metrics")
+                        "metrics,faults")
     p.add_argument("--root", metavar="DIR", default=None,
                    help="project root (default: nearest pyproject.toml)")
     p.add_argument("--list-rules", action="store_true",

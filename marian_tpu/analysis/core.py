@@ -192,10 +192,11 @@ DEFAULT_RULE_DIRS: Dict[str, List[str]] = {
     "dtype": ["marian_tpu/ops", "marian_tpu/layers"],
     # guarded-by: the threaded layers
     "guarded-by": ["marian_tpu/serving", "marian_tpu/training"],
-    # everywhere: trace-safety, donation, metrics
+    # everywhere: trace-safety, donation, metrics, fault hygiene
     "trace-safety": [],
     "donation": [],
     "metrics": [],
+    "faults": [],
 }
 
 DEFAULT_EXCLUDE = ["marian_tpu/analysis"]

@@ -11,9 +11,10 @@ from ..core import Config, Finding, Source
 
 class Rule:
     """Base class. `family` groups ids for config scoping ("trace-safety",
-    "host-sync", "donation", "dtype", "guarded-by", "metrics"); `scope` is
-    "file" (check per Source) or "project" (check_project over all in-scope
-    sources at once — cross-file rules like metrics hygiene)."""
+    "host-sync", "donation", "dtype", "guarded-by", "metrics", "faults");
+    `scope` is "file" (check per Source) or "project" (check_project over
+    all in-scope sources at once — cross-file rules like metrics
+    hygiene)."""
 
     family: str = ""
     ids: tuple = ()           # rule ids this family can emit (docs/tests)
@@ -49,4 +50,5 @@ def _load() -> None:
         return
     _loaded = True
     from . import (trace_safety, host_sync, donation,  # noqa: F401
-                   dtype_hygiene, guarded_by, metrics_hygiene)
+                   dtype_hygiene, guarded_by, metrics_hygiene,
+                   fault_hygiene)

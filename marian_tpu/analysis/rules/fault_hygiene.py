@@ -1,0 +1,151 @@
+"""fault-hygiene (MT-FAULT-*): the fault-injection catalog and the code
+that crosses it must agree — project-scoped analysis, the crash-safety
+mirror of the metrics-hygiene rule (ISSUE 4).
+
+- MT-FAULT-UNKNOWN: a ``fault_point("name")`` call site whose name is not
+  declared in ``common/faultpoints.py :: CATALOG``. An undeclared point
+  can never be armed from a MARIAN_FAULTS spec (parse_spec validates
+  against the catalog), so it is dead code pretending to be covered.
+
+- MT-FAULT-UNTESTED: a declared fault point that no test ever references
+  (its name appears as a string in no file under ``tests/``). A fault
+  point nobody injects is a crash-safety claim nobody verifies — exactly
+  the rot this registry exists to prevent. scripts/chaos.py randomizes
+  over the catalog at runtime, but the DETERMINISTIC per-point kill/fail
+  coverage must live in the test suite.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Config, Finding, Source, call_name
+from . import Rule, register
+
+FAULTPOINTS_FILE = "faultpoints.py"
+
+
+def _catalog_names(sources: List[Source]) -> Tuple[Optional[Source],
+                                                   Set[str]]:
+    """String keys of the ``CATALOG = {...}`` literal in faultpoints.py."""
+    for src in sources:
+        if not src.rel.endswith(FAULTPOINTS_FILE):
+            continue
+        for node in ast.walk(src.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):   # CATALOG: Dict[...] = {}
+                targets = [node.target]
+            if targets and isinstance(getattr(node, "value", None), ast.Dict) \
+                    and any(isinstance(t, ast.Name) and t.id == "CATALOG"
+                            for t in targets):
+                names = {k.value for k in node.value.keys
+                         if isinstance(k, ast.Constant)
+                         and isinstance(k.value, str)}
+                return src, names
+    return None, set()
+
+
+def _tests_text(config: Config) -> str:
+    """Every STRING CONSTANT in every test file, concatenated — the 'is
+    this point ever injected' corpus. String constants (not raw text)
+    because fault names live inside spec strings ("ckpt.commit=kill@2")
+    which an identifier walk would miss, while a name mentioned only in
+    a comment ('# we deliberately skip ckpt.publish') must NOT count as
+    coverage. Files that fail to parse fall back to raw text — a broken
+    test file should not mass-flag the catalog."""
+    tests_dir = config.root / "tests"
+    chunks: List[str] = []
+    if tests_dir.is_dir():
+        for p in sorted(tests_dir.rglob("*.py")):
+            try:
+                text = p.read_text(encoding="utf-8")
+            except OSError:
+                continue
+            try:
+                tree = ast.parse(text)
+            except SyntaxError:
+                chunks.append(text)
+                continue
+            chunks.extend(n.value for n in ast.walk(tree)
+                          if isinstance(n, ast.Constant)
+                          and isinstance(n.value, str))
+    return "\n".join(chunks)
+
+
+@register
+class FaultHygieneRule(Rule):
+    family = "faults"
+    ids = ("MT-FAULT-UNKNOWN", "MT-FAULT-UNTESTED")
+    scope = "project"
+
+    def check_project(self, sources: List[Source],
+                      config: Config) -> List[Finding]:
+        cat_src, catalog = _catalog_names(sources)
+        # call sites: fault_point("name") / fp.fault_point("name")
+        sites: Dict[str, List[Tuple[Source, ast.Call]]] = {}
+        for src in sources:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node) or ""
+                if name.split(".")[-1] != "fault_point":
+                    continue
+                if not (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                sites.setdefault(node.args[0].value, []).append((src, node))
+
+        findings: List[Finding] = []
+        unknown: Set[str] = set()
+        if catalog:
+            for fname, occs in sorted(sites.items()):
+                if fname in catalog:
+                    continue
+                unknown.add(fname)
+                src, node = occs[0]
+                findings.append(src.finding(
+                    "MT-FAULT-UNKNOWN", node,
+                    f"fault point '{fname}' is not declared in "
+                    f"faultpoints.CATALOG — it can never be armed from a "
+                    f"MARIAN_FAULTS spec",
+                    hint="declare it in CATALOG (with a description) or "
+                         "fix the name"))
+
+        if not sites and cat_src is None:
+            return findings          # tree without the registry: nothing to do
+
+        tests = _tests_text(config)
+        for fname in sorted(set(sites) | catalog):
+            if fname in tests or fname in unknown:   # UNKNOWN already said it
+                continue
+            if fname in sites:
+                src, node = sites[fname][0]
+                findings.append(src.finding(
+                    "MT-FAULT-UNTESTED", node,
+                    f"fault point '{fname}' is never exercised by any "
+                    f"test — an uninjected fault point is a crash-safety "
+                    f"claim nobody verifies",
+                    hint="add a test that arms it (faultpoints.active / "
+                         "MARIAN_FAULTS) and asserts the recovery "
+                         "behavior"))
+            elif cat_src is not None:
+                # declared but never even placed in code — anchor at the
+                # catalog itself
+                node = _catalog_key_node(cat_src, fname)
+                findings.append(cat_src.finding(
+                    "MT-FAULT-UNTESTED", node or cat_src.tree,
+                    f"catalog fault point '{fname}' has no call site and "
+                    f"no test coverage",
+                    hint="thread fault_point() through the code path it "
+                         "describes, or drop the catalog entry"))
+        return findings
+
+
+def _catalog_key_node(src: Source, fname: str) -> Optional[ast.AST]:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Constant) and node.value == fname:
+            return node
+    return None

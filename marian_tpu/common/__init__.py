@@ -1,10 +1,10 @@
 from .options import Options
 from .config_parser import ConfigParser, parse_options
 from .scheduling_parameter import SchedulingParameter, SchedulingUnit
-from . import io, logging, prng, signal_handling, timer
+from . import faultpoints, io, logging, prng, signal_handling, timer
 
 __all__ = [
     "Options", "ConfigParser", "parse_options",
     "SchedulingParameter", "SchedulingUnit",
-    "io", "logging", "prng", "signal_handling", "timer",
+    "faultpoints", "io", "logging", "prng", "signal_handling", "timer",
 ]

@@ -171,6 +171,7 @@ _TRAINING = [
     _f("optimizer-state-dtype", str, "float32", "Storage dtype for Adam's first moment: float32 | bfloat16 (halves m's HBM footprint and per-step traffic; math stays f32, v stays f32; beyond the reference)", "training"),
     _f("gradient-dtype", str, "float32", "Dtype gradients are produced, reduce-scattered, and stored in until the optimizer's in-register f32 upcast: float32 | bfloat16 (halves backward gradient HBM writes and ZeRO-1 collective bytes — the analogue of Marian's fp16 gradient communication; requires matching bfloat16 compute --precision, otherwise ignored with a warning). Note: the logits backward always rounds its cotangent through the COMPUTE dtype (ops/ops.py logits_matmul — the bf16 MXU-rate fix), so float32 here does NOT make bf16-compute backward passes fully f32; see docs/PERFORMANCE.md", "training"),
     _f("async-save", bool, False, "Overlap checkpoint writes with training: device snapshots on the train thread, numpy+disk IO on a background worker (beyond the reference, whose Train::save blocks the update loop). Needs transient HBM headroom for one device copy of params+EMA+optimizer state at save time", "training"),
+    _f("keep-checkpoint-bundles", int, 3, "Crash-safe checkpointing: keep the last N committed checkpoint bundles under <model>.bundles/ (each bundle is the atomic, checksummed model+optimizer+progress unit restore validates and falls back across; see docs/ROBUSTNESS.md). Disk cost is ~N x checkpoint size; minimum 1 (TPU extension)", "training"),
     _f("compact-transfer", bool, True, "Ship training batches as uint16 tokens + per-row lengths instead of int32 ids + float masks (~4x less host-to-device traffic per step; ids/masks are rebuilt inside the jitted step — beyond the reference)", "training"),
     _f("tensorboard", str, None, "Write train/valid scalars (cost, words/s, learn rate, validation metrics) as TensorBoard events to this directory (beyond the reference, which logs text only)", "training", "?"),
     _f("logical-epoch", str, ["1e"], "Logical epoch spec, e.g. 1Gt", "training", "+"),
@@ -328,6 +329,7 @@ _TRANSLATION = [
     _f("request-timeout", float, 0.0, "marian-server per-request deadline in seconds: expired requests get an explicit !!SERVER-TIMEOUT reply (even while queued) instead of waiting forever (0 = no deadline) (TPU extension)", "translate"),
     _f("batch-token-budget", int, 0, "marian-server continuous batching: token budget per device batch against the bucketed static-shape table (data/batch_generator buckets, so serve-time batches hit warm jit-cache shapes). Counted as real rows x bucketed width — the same --mini-batch-words semantics training uses; the realized device batch can exceed it by the row snap-up to the batch multiple. 0 = derive from mini-batch x bucketed max-length (TPU extension)", "translate"),
     _f("metrics-port", int, 0, "Serve Prometheus /metrics + /healthz + /readyz on this port (0 = off): queue depth, batch fill ratio, padding waste, time-to-first-batch, end-to-end latency, shed/timeout counts; train/translate emit into the same registry (TPU extension)", "translate"),
+    _f("dispatch-stall-timeout", float, 0.0, "marian-server liveness watchdog: if one device batch (translate_lines call) runs longer than this many seconds, fail its requests with an explicit retriable !!SERVER-RETRY reply and move the scheduler onto a fresh device worker instead of wedging the whole serving path behind the stuck call (0 = off; set comfortably above the worst legitimate batch decode time; see docs/ROBUSTNESS.md) (TPU extension)", "translate"),
     _f("fuse", bool, False, "(compat; XLA always fuses)", "translate"),
     _f("gemm-type", str, "float32", "float32, bfloat16, int8 (TPU AQT path), intgemm8/packed* map to int8", "translate"),
     _f("quantize-range", float, 0.0, "Quantization clip range in stddevs (0 = absmax)", "translate"),

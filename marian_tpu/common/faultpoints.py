@@ -1,0 +1,293 @@
+"""Deterministic fault injection for crash-safety testing (ISSUE 4).
+
+A FAULT POINT is a named site in production code where a test (or the
+chaos harness, scripts/chaos.py) can inject a failure on demand:
+
+    from ..common import faultpoints as fp
+    ...
+    fp.fault_point("ckpt.commit")      # no-op unless armed
+
+Arming is by environment variable (crosses process boundaries — the
+crash-resume tests kill real trainer subprocesses) or programmatically
+(in-process tests):
+
+    MARIAN_FAULTS="ckpt.commit=kill@2" marian-train ...
+    with fp.active("serving.translate=hang:0.5"): ...
+
+Spec grammar (comma-separated list):
+
+    name=mode[:arg][@hit]
+
+    mode  fail        raise InjectedFault           (simulated IO error)
+          kill        os._exit(FAULT_EXIT_CODE)     (simulated SIGKILL /
+                                                     TPU preemption — no
+                                                     cleanup, no finally)
+          hang:SECS   time.sleep(SECS), then pass   (stall — watchdog food)
+          prob:P      raise with probability P, deterministic from
+                      (seed, name, hit index)
+    @hit  @N   trigger on the Nth hit only (1-based; default @1 —
+               except prob, which defaults to @* so P applies per hit)
+          @N+  trigger on every hit from the Nth on
+          @*   trigger on every hit
+
+Determinism: a given (spec, MARIAN_FAULTS_SEED, call sequence) always
+fires at the same sites — reproducing a chaos-harness failure is
+re-running with the printed spec and seed. Hit counters are per-name and
+process-wide (thread-safe: the AsyncSaver worker, the serving executor
+thread, and the training thread all cross fault points).
+
+Every fault point must be declared in CATALOG below; mtlint's
+fault-hygiene rule (MT-FAULT-UNKNOWN / MT-FAULT-UNTESTED) checks that
+call sites use declared names and that every declared point is exercised
+by at least one test (docs/ROBUSTNESS.md carries the operator-facing
+catalog). Stdlib-only on purpose: importable from any layer, including
+the analysis tooling and subprocess drivers with no jax.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+ENV_SPEC = "MARIAN_FAULTS"
+ENV_SEED = "MARIAN_FAULTS_SEED"
+# distinctive exit code so tests can tell an injected kill from a real crash
+FAULT_EXIT_CODE = 117
+
+# The fault-point catalog: every fault_point() call site must use one of
+# these names (mtlint MT-FAULT-UNKNOWN), and every name must be exercised
+# by at least one test (MT-FAULT-UNTESTED). Keep descriptions in sync with
+# docs/ROBUSTNESS.md.
+CATALOG: Dict[str, str] = {
+    "ckpt.write.model": "before the model member is written into staging",
+    "ckpt.write.optimizer": "before the optimizer member is written",
+    "ckpt.write.progress": "before the progress member is written",
+    "ckpt.write.manifest": "before the bundle manifest is written",
+    "ckpt.commit": "after staging is complete, before the atomic "
+                   "staging->bundle rename (the commit point)",
+    "ckpt.publish": "after commit, before the legacy top-level view "
+                    "(model.npz etc.) is republished",
+    "ckpt.async.worker": "at the start of the AsyncSaver background job",
+    "data.batch.next": "in the batch pipeline, before a batch is yielded",
+    "serving.dispatch": "on the event loop, before a device batch is "
+                        "handed to the executor",
+    "serving.translate": "on the device worker thread, before "
+                         "translate_lines runs (hang mode feeds the "
+                         "dispatch watchdog)",
+}
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed 'fail'/'prob' fault point."""
+
+
+class FaultSpecError(ValueError):
+    """Malformed MARIAN_FAULTS spec or undeclared fault-point name."""
+
+
+class _Spec:
+    __slots__ = ("name", "mode", "arg", "hit", "every_from")
+
+    def __init__(self, name: str, mode: str, arg: Optional[float],
+                 hit: Optional[int], every_from: Optional[int]):
+        self.name = name
+        self.mode = mode
+        self.arg = arg
+        self.hit = hit              # exact hit index (1-based) or None
+        self.every_from = every_from  # fire on every hit >= this, or None
+
+    def matches(self, n: int) -> bool:
+        if self.every_from is not None:
+            return n >= self.every_from
+        return n == (self.hit if self.hit is not None else 1)
+
+
+def _parse_one(piece: str) -> _Spec:
+    if "=" not in piece:
+        raise FaultSpecError(f"fault spec {piece!r}: expected name=mode")
+    name, _, rhs = piece.partition("=")
+    name = name.strip()
+    if name not in CATALOG:
+        raise FaultSpecError(
+            f"unknown fault point {name!r} (catalog: "
+            f"{', '.join(sorted(CATALOG))})")
+    hit: Optional[int] = None
+    every_from: Optional[int] = None
+    if "@" in rhs:
+        rhs, _, hs = rhs.partition("@")
+        hs = hs.strip()
+        try:
+            if hs == "*":
+                every_from = 1
+            elif hs.endswith("+"):
+                every_from = int(hs[:-1])
+            else:
+                hit = int(hs)
+        except ValueError:
+            raise FaultSpecError(
+                f"fault point {name!r}: bad hit selector @{hs!r} "
+                f"(expected @N, @N+, or @*)") from None
+        # hit counters are 1-based: @0 would never match and the drill
+        # would silently inject nothing
+        if (hit is not None and hit < 1) \
+                or (every_from is not None and every_from < 1):
+            raise FaultSpecError(
+                f"fault point {name!r}: hit selector @{hs} must be >= 1")
+    mode, _, argtext = rhs.strip().partition(":")
+    arg: Optional[float] = float(argtext) if argtext else None
+    if mode not in ("fail", "kill", "hang", "prob"):
+        raise FaultSpecError(f"fault point {name!r}: unknown mode {mode!r}")
+    if mode == "prob" and arg is None:
+        raise FaultSpecError(f"fault point {name!r}: prob needs :P")
+    if mode == "prob" and hit is None and every_from is None:
+        # per-hit probability is the whole point of prob — an implicit
+        # @1 would roll the dice exactly once and report a clean drill
+        every_from = 1
+    return _Spec(name, mode, arg, hit, every_from)
+
+
+def parse_spec(text: str) -> Dict[str, _Spec]:
+    specs: Dict[str, _Spec] = {}
+    for piece in text.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        s = _parse_one(piece)
+        specs[s.name] = s
+    return specs
+
+
+class _State:
+    """Process-wide arming state + per-name hit counters."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.specs: Dict[str, _Spec] = {}
+        self.seed = 0
+        self.hits: Dict[str, int] = {}
+        self.env_loaded = False
+
+
+_STATE = _State()
+
+
+def _load_env_locked() -> None:
+    if _STATE.env_loaded:
+        return
+    text = os.environ.get(ENV_SPEC, "")
+    if text:
+        # parse BEFORE marking loaded: a malformed spec must raise at
+        # EVERY crossing, not raise once and silently disarm the drill
+        # (a chaos run with a typo'd spec reporting success would be
+        # worse than no drill at all)
+        try:
+            specs = parse_spec(text)
+        except FaultSpecError as e:
+            _log(f"FAULTPOINT SPEC ERROR in {ENV_SPEC}: {e}")
+            raise
+        _STATE.specs.update(specs)
+        _STATE.seed = int(os.environ.get(ENV_SEED, "0") or "0")
+    _STATE.env_loaded = True
+
+
+def activate(spec: str, seed: int = 0) -> None:
+    """Arm fault points programmatically (replaces any previous arming,
+    including the environment's); resets hit counters."""
+    parsed = parse_spec(spec)
+    with _STATE.lock:
+        _STATE.env_loaded = True        # programmatic arming wins over env
+        _STATE.specs = parsed
+        _STATE.seed = int(seed)
+        _STATE.hits = {}
+
+
+def deactivate() -> None:
+    """Disarm everything and reset hit counters (env spec stays ignored
+    until reset_for_tests)."""
+    with _STATE.lock:
+        _STATE.env_loaded = True
+        _STATE.specs = {}
+        _STATE.hits = {}
+
+
+def reset_for_tests() -> None:
+    """Full reset: disarm AND re-read MARIAN_FAULTS on next hit."""
+    with _STATE.lock:
+        _STATE.specs = {}
+        _STATE.hits = {}
+        _STATE.env_loaded = False
+
+
+class active:
+    """Context manager: arm `spec` inside the block, disarm after."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+
+    def __enter__(self) -> "active":
+        activate(self.spec, seed=self.seed)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        deactivate()
+
+
+def hits(name: str) -> int:
+    """How many times `name` was crossed since the last (re)arming."""
+    with _STATE.lock:
+        return _STATE.hits.get(name, 0)
+
+
+def _log(msg: str) -> None:
+    # plain stderr, not the marian logger: fault points fire in subprocesses
+    # before create_loggers, and the kill path must not depend on handler
+    # state mid-teardown
+    import sys
+    sys.stderr.write(msg + "\n")
+    sys.stderr.flush()
+
+
+def fault_point(name: str) -> None:
+    """Cross the named fault point. No-op (one dict lookup under a lock)
+    unless armed; raises InjectedFault / sleeps / kills the process when
+    the armed spec matches this hit."""
+    with _STATE.lock:
+        _load_env_locked()
+        if name not in CATALOG:
+            raise FaultSpecError(f"fault_point({name!r}) is not in the "
+                                 f"faultpoints.CATALOG")
+        n = _STATE.hits.get(name, 0) + 1
+        _STATE.hits[name] = n
+        spec = _STATE.specs.get(name)
+        if spec is None or not spec.matches(n):
+            return
+        seed = _STATE.seed
+    # act OUTSIDE the lock: hang must not serialize every other fault
+    # point behind a sleeping thread, and kill flushes stderr first
+    if spec.mode == "prob":
+        r = random.Random(f"{seed}:{name}:{n}").random()
+        if r >= float(spec.arg or 0.0):
+            return
+        _log(f"FAULTPOINT {name} hit {n}: injected failure (prob)")
+        raise InjectedFault(f"injected fault at {name} (hit {n}, prob)")
+    if spec.mode == "fail":
+        _log(f"FAULTPOINT {name} hit {n}: injected failure")
+        raise InjectedFault(f"injected fault at {name} (hit {n})")
+    if spec.mode == "hang":
+        secs = float(spec.arg if spec.arg is not None else 3600.0)
+        _log(f"FAULTPOINT {name} hit {n}: hanging {secs}s")
+        time.sleep(secs)
+        return
+    if spec.mode == "kill":
+        _log(f"FAULTPOINT {name} hit {n}: killing process "
+             f"(exit {FAULT_EXIT_CODE})")
+        os._exit(FAULT_EXIT_CODE)
+
+
+def describe() -> Tuple[Tuple[str, str], ...]:
+    """(name, description) rows of the catalog — chaos harness / docs."""
+    return tuple(sorted(CATALOG.items()))

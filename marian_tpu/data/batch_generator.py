@@ -90,7 +90,9 @@ class CorpusBatch:
     sentence_ids: np.ndarray          # [batch] corpus line numbers (-1 = pad row)
     guided_alignment: Optional[np.ndarray] = None  # [batch, trg_len, src_len]
     data_weights: Optional[np.ndarray] = None      # [batch, trg_len] or [batch, 1]
-    corpus_state: Optional[dict] = None            # snapshot for exact resume
+    corpus_state: Optional[dict] = None   # post-window resume snapshot:
+    # where the corpus stands once this batch's whole maxi window has
+    # been applied — what do_save records for crash-safe resume
 
     @property
     def src(self) -> SubBatch:
@@ -303,17 +305,32 @@ class BatchGenerator:
         return batches
 
     def _generate(self) -> Iterator[CorpusBatch]:
+        from ..common import faultpoints as fp
         buf: List[SentenceTuple] = []
         cap = self.maxi_batch * self.mini_batch
         it = iter(self.corpus)
-        state = self.corpus.state.as_dict()
         for t in it:
             buf.append(t)
             if len(buf) >= cap:
-                yield from self._split_maxi(buf, state)
-                buf = []
+                # POST-window snapshot: the corpus position once every
+                # sentence of this maxi window has been consumed. A save
+                # taken after applying this window's batches resumes
+                # HERE — exact at window boundaries, window-granular in
+                # between (docs/ROBUSTNESS.md). The LIVE corpus.state is
+                # no resume point at all: the prefetch thread runs it
+                # arbitrarily far ahead of what training has applied.
                 state = self.corpus.state.as_dict()
-        yield from self._split_maxi(buf, state)
+                for b in self._split_maxi(buf, state):
+                    # chaos harness hook: a corpus/pipeline failure (bad
+                    # shard, fs hiccup) surfaces HERE, mid-epoch — the
+                    # crash-resume protocol must cover it like any kill
+                    fp.fault_point("data.batch.next")
+                    yield b
+                buf = []
+        state = self.corpus.state.as_dict()
+        for b in self._split_maxi(buf, state):
+            fp.fault_point("data.batch.next")
+            yield b
 
     def __iter__(self) -> Iterator[CorpusBatch]:
         if not self.prefetch:

@@ -33,7 +33,8 @@ from ..common import logging as log
 from ..data.batch_generator import bucket_length
 from ..serving import metrics as msm
 from ..serving.admission import AdmissionController, Overloaded
-from ..serving.scheduler import ContinuousScheduler, RequestTimeout
+from ..serving.scheduler import (ContinuousScheduler, DispatchStalled,
+                                 RequestTimeout)
 
 try:
     import websockets
@@ -44,6 +45,10 @@ except ImportError:  # pragma: no cover
 # graceful-drain budget on shutdown: long enough for a queued maximal batch
 # to finish decoding, far below any orchestrator's kill timeout
 DRAIN_TIMEOUT_S = 30.0
+# per-connection cap on bytes the EOF watch may read ahead of the framing
+# parser while a reply is pending — bounds what a flooding pipelined
+# client can make the server buffer
+MAX_READAHEAD = 1 << 20
 
 
 class TranslationService:
@@ -114,7 +119,9 @@ class ServingApp:
         else:
             self.service = None
         self.scheduler = ContinuousScheduler(
-            translate_lines, token_budget=budget, registry=self.registry)
+            translate_lines, token_budget=budget, registry=self.registry,
+            stall_timeout=float(
+                options.get("dispatch-stall-timeout", 0) or 0))
         self.admission = AdmissionController(
             int(options.get("max-queue", 512) or 0),
             self.scheduler.queued_units, registry=self.registry)
@@ -153,6 +160,10 @@ class ServingApp:
             out = await fut
         except RequestTimeout as e:
             return f"!!SERVER-TIMEOUT {e}"
+        except DispatchStalled as e:
+            # watchdog liveness trip: explicitly retriable — the replica
+            # is healthy again (fresh device worker), resend the request
+            return f"!!SERVER-RETRY {e}"
         except asyncio.CancelledError:
             raise
         except Exception:  # error already logged by the scheduler
@@ -209,46 +220,75 @@ def _make_tcp_handler(app: ServingApp):
     the connection is watched for EOF — a client that disconnects cancels
     its request, so the scheduler drops the queued sentences before they
     cost device time (same guarantee the ws path gets from the handler
-    task being cancelled on close)."""
+    task being cancelled on close). The watch is RE-ARMED after every
+    pipelined chunk (PR 8 review fix: it previously stopped at the first
+    byte, so a pipelining client's disconnect was only noticed at
+    reply-write time — its queued sentences still cost device work);
+    read-ahead lands in a buffer the framing reads drain first."""
     async def on_connection(reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter):
-        # at most one byte read ahead by the EOF watch of a pipelining
-        # client; prepended to the next header
-        leftover = b""
+        # bytes read ahead by the EOF watch of a pipelining client —
+        # drained by _readline/_readexactly before touching the socket
+        buf = b""
+
+        async def _readline() -> bytes:
+            nonlocal buf
+            if b"\n" in buf:
+                line, _, rest = buf.partition(b"\n")
+                buf = rest
+                return line + b"\n"
+            line, buf = buf, b""
+            return line + await reader.readline()
+
+        async def _readexactly(n: int) -> bytes:
+            nonlocal buf
+            take, buf = buf[:n], buf[n:]
+            if len(take) < n:
+                take += await reader.readexactly(n - len(take))
+            return take
+
         try:
             while True:
-                header = leftover + await reader.readline()
-                leftover = b""
+                header = await _readline()
                 if not header:
                     break
                 parts = header.split()
-                if len(parts) != 2 or parts[0] != b"MTPU":
+                # the length must be a NON-NEGATIVE integer before it
+                # reaches _readexactly: python slicing with a negative
+                # count would silently mis-slice buffered read-ahead
+                # bytes (the raw StreamReader used to raise for us), and
+                # a non-numeric length deserves the explicit bad-frame
+                # reply, not a silent close
+                nbytes = (int(parts[1])
+                          if len(parts) == 2 and parts[0] == b"MTPU"
+                          and parts[1].isdigit() else -1)
+                if nbytes < 0:
                     writer.write(b"MTPU 24\n!!SERVER-ERROR bad frame")
                     await writer.drain()
                     break
-                nbytes = int(parts[1])
-                payload = await reader.readexactly(nbytes)
+                payload = await _readexactly(nbytes)
                 reply_t = asyncio.ensure_future(
                     app.handle_text(payload.decode("utf-8")))
-                watch = asyncio.ensure_future(reader.read(1))
-                await asyncio.wait({reply_t, watch},
-                                   return_when=asyncio.FIRST_COMPLETED)
-                if not reply_t.done():
-                    data = watch.result()
-                    if not data:            # EOF: client gone mid-request
-                        reply_t.cancel()
-                        try:
-                            await reply_t
-                        except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                            pass
+                eof = False
+                while not reply_t.done():
+                    if len(buf) >= MAX_READAHEAD:
+                        # bounded read-ahead: past the cap, stop reading
+                        # and let TCP backpressure throttle the client
+                        # (a flooding pipeliner must not grow server
+                        # memory while a reply is in flight; EOF in this
+                        # state is noticed at reply-write time, like the
+                        # pre-watch behavior)
+                        await asyncio.wait({reply_t})
                         break
-                    leftover = data         # pipelined client: keep byte
-                    reply = await reply_t
-                else:
+                    watch = asyncio.ensure_future(reader.read(65536))
+                    await asyncio.wait({reply_t, watch},
+                                       return_when=asyncio.FIRST_COMPLETED)
                     if watch.done():
-                        leftover = watch.result()
-                        if not leftover:    # EOF raced the reply
+                        data = watch.result()
+                        if not data:    # EOF: client gone mid-request
+                            eof = True
                             break
+                        buf += data     # pipelined bytes: keep, re-watch
                     else:
                         # cancelling an un-fired read() consumes nothing
                         watch.cancel()
@@ -256,7 +296,14 @@ def _make_tcp_handler(app: ServingApp):
                             await watch
                         except asyncio.CancelledError:
                             pass
-                    reply = reply_t.result()
+                if eof and not reply_t.done():
+                    reply_t.cancel()
+                    try:
+                        await reply_t
+                    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                        pass
+                    break
+                reply = await reply_t
                 out = reply.encode("utf-8")
                 writer.write(b"MTPU %d\n" % len(out) + out)
                 await writer.drain()

@@ -37,6 +37,7 @@ import concurrent.futures
 import threading
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
+from ..common import faultpoints as fp
 from ..common import logging as log
 from ..data.batch_generator import (DEFAULT_LENGTH_BUCKETS, bucket_length,
                                     padded_batch_cost)
@@ -45,6 +46,16 @@ from . import metrics as msm
 
 class RequestTimeout(RuntimeError):
     """--request-timeout deadline expired before the request completed."""
+
+
+class DispatchStalled(RuntimeError):
+    """The dispatch watchdog (--dispatch-stall-timeout) fired: one device
+    batch ran past the stall timeout. The batch's requests fail with THIS
+    retriable error (transports reply !!SERVER-RETRY) and the scheduler
+    moves onto a fresh device worker instead of wedging behind the stuck
+    call."""
+
+    retriable = True
 
 
 def default_length_fn(line: str) -> int:
@@ -100,8 +111,12 @@ class ContinuousScheduler:
                  scan_limit: int = 512,
                  length_fn: Callable[[str], int] = default_length_fn,
                  registry: Optional[msm.Registry] = None,
-                 executor: Optional[concurrent.futures.Executor] = None):
+                 executor: Optional[concurrent.futures.Executor] = None,
+                 stall_timeout: float = 0.0):
         self.translate_lines = translate_lines
+        # --dispatch-stall-timeout: liveness watchdog over each device
+        # call (0 = off). See _translate_units / _trip_watchdog.
+        self.stall_timeout = max(0.0, float(stall_timeout))
         self.token_budget = max(1, int(token_budget))
         self.length_buckets = length_buckets
         self.batch_multiple = batch_multiple
@@ -183,6 +198,10 @@ class ContinuousScheduler:
         self.m_bisections = r.counter(
             "marian_serving_retry_bisections_total",
             "Failed-batch bisection retries (device calls re-issued)")
+        self.m_watchdog = r.counter(
+            "marian_serving_watchdog_trips_total",
+            "Device batches failed by the dispatch stall watchdog "
+            "(--dispatch-stall-timeout)")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -268,6 +287,13 @@ class ContinuousScheduler:
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
         now = loop.time()
+        if not lines:
+            # an empty request has nothing to queue: no unit would ever
+            # complete it, so resolve NOW (PR 8 review: the future
+            # previously hung forever without a timeout)
+            self.m_requests.inc()
+            fut.set_result([])
+            return fut
         deadline = now + timeout if timeout and timeout > 0 else None
         req = _Request(lines, fut, priority, now, deadline)
         self.m_requests.inc()
@@ -419,11 +445,57 @@ class ContinuousScheduler:
             self._inflight -= 1
             self._inflight_units = []
 
+    def _trip_watchdog(self, pending: "asyncio.Future", n_rows: int) -> None:
+        """The in-flight device call exceeded --dispatch-stall-timeout.
+        The stuck call cannot be killed (a thread wedged inside a device
+        runtime has no cancellation point) — what CAN be saved is the
+        scheduler: abandon the wedged worker thread to finish (or not) on
+        its own, log if it ever does, and point the executor handle at a
+        fresh single worker so subsequent batches keep serving."""
+        self.m_watchdog.inc()
+        log.error(
+            "DISPATCH WATCHDOG: device batch ({} sentences) still running "
+            "after {}s — failing its requests with a retriable error and "
+            "replacing the device worker (the stuck thread is abandoned; "
+            "see docs/ROBUSTNESS.md)", n_rows, self.stall_timeout)
+
+        def _late(f) -> None:
+            if f.cancelled():
+                return
+            exc = f.exception()
+            log.warn("watchdog-abandoned device batch eventually {} — "
+                     "its results were discarded",
+                     f"failed: {exc}" if exc else "completed")
+        pending.add_done_callback(_late)
+        old, was_own = self._executor, self._own_executor
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-device")
+        self._own_executor = True
+        if was_own and old is not None:
+            # injected executors stay the caller's to shut down
+            old.shutdown(wait=False)
+            # detach the wedged worker from concurrent.futures' atexit
+            # join: its threads are non-daemon, so without this a
+            # PERMANENTLY stuck device call would hang interpreter
+            # shutdown after an otherwise graceful drain (private API —
+            # degrade to the documented orchestrator-kill backstop if it
+            # moves)
+            try:
+                from concurrent.futures import thread as _cf_thread
+                for t in list(getattr(old, "_threads", ())):
+                    _cf_thread._threads_queues.pop(t, None)
+            except Exception:  # noqa: BLE001
+                pass
+
     async def _translate_units(self, units: List[_Unit], loop) -> None:
         """One device call for the batch; on failure, bisect: split in two
         and retry each half, recursively, until single-unit batches isolate
         the poison request(s). Cost per poison unit: O(log batch) extra
-        device calls against the old worker's O(batch) one-by-one retry."""
+        device calls against the old worker's O(batch) one-by-one retry.
+        A call that exceeds --dispatch-stall-timeout instead fails the
+        WHOLE batch with a retriable DispatchStalled (no bisection — the
+        stall is a liveness event, not a poison sentence) and the
+        scheduler moves on."""
         # requests can die (deadline / cancel / a sibling batch's failure)
         # while this batch waited its turn — especially inside bisection
         # retries. Re-filter here so dead sentences never cost a device
@@ -432,9 +504,32 @@ class ContinuousScheduler:
         if not units:
             return
         try:
+            # inside the try so an injected dispatch failure routes
+            # through the normal failure path (futures fail explicitly —
+            # never a dropped batch with hanging clients)
+            fp.fault_point("serving.dispatch")
             lines = [u.text for u in units]
-            out = await loop.run_in_executor(
-                self._executor, self.translate_lines, lines)
+            translate = self.translate_lines
+
+            def _device_call():
+                fp.fault_point("serving.translate")
+                return translate(lines)
+
+            call = loop.run_in_executor(self._executor, _device_call)
+            if self.stall_timeout > 0:
+                try:
+                    out = await asyncio.wait_for(asyncio.shield(call),
+                                                 self.stall_timeout)
+                except asyncio.TimeoutError:
+                    self._trip_watchdog(call, len(units))
+                    for u in units:
+                        if not u.req.future.done():
+                            u.req.future.set_exception(DispatchStalled(
+                                f"device batch stalled past "
+                                f"{self.stall_timeout}s — retry"))
+                    return
+            else:
+                out = await call
             if len(out) != len(lines):
                 raise RuntimeError(
                     f"translator returned {len(out)} lines for "

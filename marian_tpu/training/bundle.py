@@ -1,0 +1,268 @@
+"""Crash-safe checkpoint bundles (ISSUE 4 tentpole).
+
+A checkpoint is a BUNDLE of files that must be mutually consistent:
+``model.npz`` (+ embedded config), ``model.npz.optimizer.npz``,
+``model.npz.progress.yml`` and optionally ``model.ema.npz``. The legacy
+writer put each file in place independently — a kill between the writes
+left ``model.npz`` newer than its optimizer state, and training resumed
+from a silently inconsistent moment.
+
+Commit protocol (all under ``<model>.bundles/``):
+
+1. every member is written into a private staging directory
+   (``.staging-<pid>-<seq>``) and fsync'd;
+2. ``MANIFEST.json`` (per-member sha256 + byte count) is written last,
+   fsync'd — a staging dir without a complete manifest is by definition
+   torn;
+3. the staging directory is renamed to ``bundle-<seq>`` in one atomic
+   ``os.replace`` — THE commit point — and the root dir is fsync'd;
+4. the legacy top-level view (``model.npz`` etc., what upstream tools and
+   the translator read) is republished via hardlink + rename, per file
+   atomic;
+5. bundles beyond ``--keep-checkpoint-bundles`` are rotated out, stale
+   staging dirs swept.
+
+A crash ANYWHERE leaves either the previous committed bundle or the new
+one — never a torn mix. Restore (``latest_valid_bundle``) walks bundles
+newest-first, validates the manifest and every checksum, and falls back
+to the last good bundle with a loud log line when the newest is damaged
+(disk corruption, partial scp, a torn legacy-layout upgrade).
+
+Fault points (``common/faultpoints.py``) cover every transition so the
+crash-resume tests and scripts/chaos.py can kill a save at each step.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common import faultpoints as fp
+from ..common import logging as log
+
+BUNDLE_SUFFIX = ".bundles"
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_VERSION = 1
+_BUNDLE_RE = re.compile(r"^bundle-(\d{8})$")
+DEFAULT_KEEP = 3
+
+
+class BundleError(RuntimeError):
+    """A bundle operation that cannot proceed (bad root, no parent dir)."""
+
+
+def bundle_root(model_path: str) -> str:
+    return model_path + BUNDLE_SUFFIX
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return                    # platforms without dir fds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def list_bundles(root: str) -> List[str]:
+    """Committed bundle directory names, oldest first."""
+    if not os.path.isdir(root):
+        return []
+    out = [d for d in os.listdir(root) if _BUNDLE_RE.match(d)]
+    return sorted(out)
+
+
+def _next_seq(root: str) -> int:
+    names = list_bundles(root)
+    if not names:
+        return 1
+    return int(_BUNDLE_RE.match(names[-1]).group(1)) + 1
+
+
+def write_bundle(model_path: str,
+                 members: Dict[str, Callable[[str], None]],
+                 keep: int = DEFAULT_KEEP,
+                 meta: Optional[Dict] = None) -> str:
+    """Write one atomic bundle. ``members`` maps a member file name
+    (relative, e.g. ``model.npz``) to a writer called with the absolute
+    staging path. Returns the committed bundle directory.
+
+    ``keep``: rotation depth (last N committed bundles survive; <1 keeps 1).
+    ``meta``: extra JSON recorded in the manifest (update count etc.).
+    """
+    root = bundle_root(model_path)
+    # mkdir, NOT makedirs: a missing parent directory is the same loud
+    # error the legacy writer produced (tests rely on a bad --model path
+    # failing the save, not silently creating the tree)
+    if not os.path.isdir(root):
+        os.mkdir(root)
+    seq = _next_seq(root)
+    stage = os.path.join(root, f".staging-{os.getpid()}-{seq}")
+    shutil.rmtree(stage, ignore_errors=True)
+    os.mkdir(stage)
+    manifest = {
+        "version": MANIFEST_VERSION,
+        "seq": seq,
+        "members": {},
+        "meta": dict(meta or {}),
+    }
+    try:
+        for rel, write in members.items():
+            fp.fault_point(_member_fault_name(rel))
+            abs_path = os.path.join(stage, rel)
+            write(abs_path)
+            _fsync_file(abs_path)
+            manifest["members"][rel] = {
+                "sha256": _sha256(abs_path),
+                "bytes": os.path.getsize(abs_path),
+            }
+            # committed members are immutable: the published top-level
+            # view hardlinks this inode, and read-only is what turns an
+            # external tool's in-place write (which would silently break
+            # the checksum just recorded) into a loud EACCES. Tools that
+            # REPLACE the top-level file (numpy/save_items temp+rename)
+            # are unaffected — they mint a new inode.
+            os.chmod(abs_path, 0o444)
+        fp.fault_point("ckpt.write.manifest")
+        mpath = os.path.join(stage, MANIFEST_NAME)
+        with open(mpath, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        _fsync_dir(stage)
+        fp.fault_point("ckpt.commit")
+        final = os.path.join(root, f"bundle-{seq:08d}")
+        os.replace(stage, final)              # THE commit point
+        _fsync_dir(root)
+    except BaseException:
+        shutil.rmtree(stage, ignore_errors=True)
+        raise
+    fp.fault_point("ckpt.publish")
+    _publish(model_path, final, manifest)
+    rotate(root, keep)
+    return final
+
+
+def _member_fault_name(rel: str) -> str:
+    """Map a member file name onto its catalog fault point."""
+    if rel.endswith(".optimizer.npz"):
+        return "ckpt.write.optimizer"
+    if rel.endswith(".progress.yml"):
+        return "ckpt.write.progress"
+    return "ckpt.write.model"
+
+
+def _publish(model_path: str, bundle_dir: str, manifest: Dict) -> None:
+    """Republish the legacy top-level layout (``model.npz`` + siblings)
+    from a committed bundle: hardlink (copy fallback) + atomic rename per
+    file. The top-level view is a CONVENIENCE for upstream-compatible
+    tools; restore always trusts the bundle first, so a crash mid-publish
+    is harmless."""
+    top_dir = os.path.dirname(os.path.abspath(model_path))
+    for rel in manifest["members"]:
+        src = os.path.join(bundle_dir, rel)
+        dst = os.path.join(top_dir, rel)
+        tmp = dst + ".pub.tmp"
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            try:
+                os.link(src, tmp)
+            except OSError:
+                shutil.copy2(src, tmp)
+            os.replace(tmp, dst)
+        except OSError as e:  # publish must never fail a committed save
+            log.warn("checkpoint publish of {} failed ({}); the committed "
+                     "bundle {} remains authoritative", dst, e,
+                     os.path.basename(bundle_dir))
+
+
+def rotate(root: str, keep: int) -> None:
+    """Delete committed bundles beyond the newest ``keep`` and any stale
+    staging directories left by killed writers (other pids)."""
+    keep = max(1, int(keep))
+    names = list_bundles(root)
+    for name in names[:-keep] if len(names) > keep else []:
+        shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    for d in os.listdir(root) if os.path.isdir(root) else []:
+        if d.startswith(".staging-"):
+            try:
+                pid = int(d.split("-")[1])
+            except (IndexError, ValueError):
+                pid = -1
+            if pid != os.getpid():
+                shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+
+def validate_bundle(bundle_dir: str) -> Tuple[bool, str, Optional[Dict]]:
+    """(ok, why, manifest). Checks manifest presence/shape and every
+    member's byte count + sha256."""
+    mpath = os.path.join(bundle_dir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        return False, "manifest missing", None
+    try:
+        with open(mpath, "r", encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError) as e:
+        return False, f"manifest unreadable ({e})", None
+    members = manifest.get("members")
+    if not isinstance(members, dict) or not members:
+        return False, "manifest has no members", None
+    for rel, info in members.items():
+        p = os.path.join(bundle_dir, rel)
+        if not os.path.isfile(p):
+            return False, f"member {rel} missing", manifest
+        if os.path.getsize(p) != int(info.get("bytes", -1)):
+            return False, f"member {rel} truncated", manifest
+        if _sha256(p) != info.get("sha256"):
+            return False, f"member {rel} checksum mismatch", manifest
+    return True, "", manifest
+
+
+def latest_valid_bundle(model_path: str
+                        ) -> Optional[Tuple[str, Dict]]:
+    """Newest bundle that validates, or None. Logs LOUDLY when it has to
+    skip a damaged newer bundle — an operator grepping the log after an
+    incident must see exactly which checkpoint was sacrificed and why
+    (docs/ROBUSTNESS.md runbook)."""
+    root = bundle_root(model_path)
+    skipped = 0
+    for name in reversed(list_bundles(root)):
+        bdir = os.path.join(root, name)
+        ok, why, manifest = validate_bundle(bdir)
+        if ok:
+            if skipped:
+                log.error(
+                    "CHECKPOINT FALLBACK: {} newer bundle(s) under {} "
+                    "failed validation; resuming from last good bundle "
+                    "{} (meta: {})", skipped, root, name,
+                    manifest.get("meta", {}))
+            return bdir, manifest
+        skipped += 1
+        log.error("checkpoint bundle {} failed validation: {} — ignoring",
+                  bdir, why)
+    return None

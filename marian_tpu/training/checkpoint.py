@@ -2,6 +2,14 @@
 model.npz.progress.yml (reference layout: SURVEY.md §5 checkpoint/resume row;
 src/training/training.h restore logic + OptimizerBase::save/load).
 
+Crash safety (ISSUE 4): the three files are one atomic BUNDLE. Writes go
+through training/bundle.py (stage → fsync → checksummed manifest →
+atomic rename commit → legacy top-level republish → keep-last-N
+rotation); restore prefers the newest VALIDATED bundle and falls back to
+the last good one with a loud log line, so a kill anywhere mid-save —
+TPU preemption, disk-full, SIGKILL — never resumes from a torn mix of
+new params and old optimizer state (docs/ROBUSTNESS.md).
+
 ``--async-save`` (beyond the reference — Train::save blocks the update
 loop while serializing): AsyncSaver overlaps the checkpoint write with
 training. The training thread only makes device-side copies of every
@@ -20,8 +28,10 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from ..common import faultpoints as fp
 from ..common import io as mio
 from ..common import logging as log
+from . import bundle as bdl
 from .training_state import TrainingState
 
 
@@ -84,7 +94,8 @@ def save_checkpoint(model_path: str, params: Dict[str, Any], config_yaml: str,
                     overwrite_checkpoint: bool = True,
                     suffix: str = "",
                     async_saver: Optional[AsyncSaver] = None,
-                    extra_model_suffixes: Tuple[str, ...] = ()) -> None:
+                    extra_model_suffixes: Tuple[str, ...] = (),
+                    keep_bundles: int = bdl.DEFAULT_KEEP) -> None:
     """Save model (+optimizer +progress). `suffix` e.g. '.best-bleu' for
     per-metric best checkpoints (reference: validator keep-best files).
     ``extra_model_suffixes`` writes additional params+config copies (the
@@ -114,16 +125,18 @@ def save_checkpoint(model_path: str, params: Dict[str, Any], config_yaml: str,
         state = copy.deepcopy(state) if state is not None else None
 
         def _write():
+            fp.fault_point("ckpt.async.worker")
             _write_checkpoint(path, params, config_yaml, smooth_params,
                               opt_flat, state, suffix, extra_paths,
-                              consume=True)
+                              consume=True, keep_bundles=keep_bundles)
         async_saver.submit(_write)
         return
 
     opt_flat = (graph_group.optimizer_device_arrays()
                 if graph_group is not None and not suffix else None)
     _write_checkpoint(path, params, config_yaml, smooth_params, opt_flat,
-                      state, suffix, extra_paths)
+                      state, suffix, extra_paths,
+                      keep_bundles=keep_bundles)
 
 
 def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
@@ -131,7 +144,8 @@ def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
                       opt_flat: Optional[Dict[str, Any]],
                       state: Optional[TrainingState], suffix: str,
                       extra_paths: Tuple[str, ...] = (),
-                      consume: bool = False) -> None:
+                      consume: bool = False,
+                      keep_bundles: int = bdl.DEFAULT_KEEP) -> None:
     # consume=True (async path only — the dicts are worker-owned
     # snapshots): np.asarray + pop releases each device-side snapshot
     # copy as soon as the host has the bytes, bounding the transient HBM
@@ -143,25 +157,90 @@ def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
             return {k: np.asarray(tree.pop(k)) for k in list(tree)}
         return {k: np.asarray(v) for k, v in tree.items()}
 
+    if suffix:
+        # per-metric best checkpoints (.best-bleu etc.) are single-file
+        # params+config copies outside the main resume bundle — the
+        # per-file temp+rename in io.save_items keeps each atomic
+        host_params = fetch(params)
+        mio.save_model(path, host_params, config_yaml)
+        if smooth_params is not None:
+            base, ext = os.path.splitext(path)
+            mio.save_model(base + ".ema" + ext, fetch(smooth_params),
+                           config_yaml)
+        log.info("Saved model to {}", path)
+        return
+
     host_params = fetch(params)
-    mio.save_model(path, host_params, config_yaml)
-    for p in extra_paths:
-        mio.save_model(p, host_params, config_yaml)
-        log.info("Saved model to {}", p)
+    members: Dict[str, Any] = {}
+    model_name = os.path.basename(path)
+    members[model_name] = lambda p: mio.save_model(p, host_params,
+                                                   config_yaml)
     if smooth_params is not None:
         base, ext = os.path.splitext(path)
-        mio.save_model(base + ".ema" + ext, fetch(smooth_params),
-                       config_yaml)
-    if opt_flat is not None and not suffix:
-        np.savez(path + ".optimizer.npz", **fetch(opt_flat))
-    if state is not None and not suffix:
-        state.save(path + ".progress.yml")
-    log.info("Saved model to {}", path)
+        ema_name = os.path.basename(base + ".ema" + ext)
+        host_smooth = fetch(smooth_params)
+        members[ema_name] = lambda p: mio.save_model(p, host_smooth,
+                                                     config_yaml)
+    if opt_flat is not None:
+        host_opt = fetch(opt_flat)
+
+        def _write_opt(p):
+            with open(p, "wb") as fh:
+                np.savez(fh, **host_opt)
+        members[model_name + ".optimizer.npz"] = _write_opt
+    if state is not None:
+        members[model_name + ".progress.yml"] = state.save
+    committed = bdl.write_bundle(path, members, keep=keep_bundles,
+                                 meta=_bundle_meta(state))
+    for p in extra_paths:
+        # the no---overwrite '.iterN' copies are permanent numbered
+        # params+config snapshots OUTSIDE rotation — plain atomic files
+        mio.save_model(p, host_params, config_yaml)
+        log.info("Saved model to {}", p)
+    log.info("Saved model to {} (bundle {})", path,
+             os.path.basename(committed))
+
+
+def _bundle_meta(state: Optional[TrainingState]) -> Dict[str, Any]:
+    if state is None:
+        return {}
+    return {"batches": state.batches, "epochs": state.epochs}
 
 
 def load_checkpoint(model_path: str, graph_group=None
                     ) -> Tuple[Dict[str, np.ndarray], Optional[str],
                                Optional[TrainingState]]:
+    """Restore params (+config +optimizer +progress). Prefers the newest
+    VALIDATED bundle under ``<model>.bundles/`` — checksums verified,
+    fallback to the last good bundle on damage (bundle.py logs loudly);
+    the legacy flat layout (pre-bundle checkpoints, hand-copied models)
+    loads as before when no bundle exists."""
+    found = bdl.latest_valid_bundle(model_path)
+    if found is not None:
+        bdir, _manifest = found
+        base = os.path.join(bdir, os.path.basename(model_path))
+        params, config = mio.load_model(base)
+        state = None
+        if os.path.exists(base + ".progress.yml"):
+            state = TrainingState.load(base + ".progress.yml")
+        opt = base + ".optimizer.npz"
+        if graph_group is not None and os.path.exists(opt):
+            with np.load(opt) as z:
+                graph_group.load_optimizer_arrays(
+                    {k: z[k] for k in z.files})
+        return params, config, state
+    if bdl.list_bundles(bdl.bundle_root(model_path)):
+        # committed bundles exist but NONE validates. The flat layout is
+        # no fallback here: it is the published HARDLINK of a rejected
+        # bundle's members — loading it would resume from exactly the
+        # corrupt bytes the checksums just refused. Fail loudly instead.
+        raise bdl.BundleError(
+            f"every checkpoint bundle under "
+            f"{bdl.bundle_root(model_path)} failed validation; the flat "
+            f"layout at {model_path} is the published view of a rejected "
+            f"bundle, not an independent copy — restore a bundle from "
+            f"backup, or remove the .bundles/ directory to force a flat "
+            f"resume; see docs/ROBUSTNESS.md (operator runbook)")
     params, config = mio.load_model(model_path)
     state = None
     prog = model_path + ".progress.yml"

@@ -18,6 +18,7 @@ from ..common import logging as log
 from ..common import prng, signal_handling
 from ..data import BatchGenerator, Corpus, create_vocab
 from ..models.encoder_decoder import batch_to_arrays, create_model
+from . import bundle as bdl
 from .checkpoint import load_checkpoint, save_checkpoint
 from .graph_group import GraphGroup
 from .scheduler import Scheduler
@@ -81,7 +82,13 @@ class Train:
         model_path = opts.get("model", "model.npz")
         state = TrainingState(seed=seed)
         init_params = None
-        if os.path.exists(model_path) and not opts.get("no-reload", False):
+        # a checkpoint exists if the flat layout OR any committed bundle
+        # does — a save killed between bundle commit and top-level publish
+        # leaves only the bundle, and that moment must still resume
+        has_checkpoint = (os.path.exists(model_path) or
+                          bool(bdl.list_bundles(
+                              bdl.bundle_root(model_path))))
+        if has_checkpoint and not opts.get("no-reload", False):
             log.info("Loading model from {}", model_path)
             host_params, _, loaded_state = load_checkpoint(model_path, gg)
             init_params = {k: jnp.asarray(v) for k, v in host_params.items()}
@@ -174,9 +181,20 @@ class Train:
             from .checkpoint import AsyncSaver
             saver = AsyncSaver()
 
+        # resume snapshot of the last APPLIED batch (its post-maxi-window
+        # corpus position), seeded with the PRE-iteration state (restored
+        # position on resume, initial position on a fresh run) so a save
+        # before the first applied update resumes from where this process
+        # started. The live corpus.state is NOT a resume point at any
+        # later moment: the prefetch thread consumes it arbitrarily far
+        # ahead of what training has applied, so saving it used to skip
+        # data (and drift whole epochs) on restart — exposed by the
+        # ISSUE 4 chaos harness.
+        last_corpus_state: List[dict] = [corpus.state.as_dict()]
+
         def do_save(suffix: str = "") -> None:
             state.corpus = (native_bg.state_dict() if native_bg is not None
-                            else corpus.state.as_dict())
+                            else last_corpus_state[0])
             smooth = gg.smoothed() if gg.opt_cfg.smoothing > 0 else None
             # without --overwrite, an iteration-numbered copy of every
             # periodic checkpoint is written in the SAME save unit
@@ -186,7 +204,11 @@ class Train:
             save_checkpoint(model_path, gg.export_params(), config_yaml,
                             gg, state, smooth_params=smooth, suffix=suffix,
                             async_saver=saver,
-                            extra_model_suffixes=extra)
+                            extra_model_suffixes=extra,
+                            keep_bundles=int(
+                                opts.get("keep-checkpoint-bundles",
+                                         bdl.DEFAULT_KEEP)
+                                or bdl.DEFAULT_KEEP))
 
         def do_validate() -> None:
             if saver is not None:
@@ -288,6 +310,8 @@ class Train:
             loss_sum stays a lazy device scalar (sync deferred to the
             display boundary); labels/lr come from host-side math so the
             hot loop never blocks on the device."""
+            if group[-1].corpus_state is not None:
+                last_corpus_state[0] = group[-1].corpus_state
             scheduler.update(out.loss_sum, sum(b.words for b in group),
                              sum(b.size for b in group),
                              src_words=sum(b.src_words for b in group),
@@ -335,6 +359,8 @@ class Train:
             win.clear()
             win_key.clear()
             before_b, before_l = state.batches, state.labels_total
+            if pairs[-1][1].corpus_state is not None:
+                last_corpus_state[0] = pairs[-1][1].corpus_state
             for out, b in pairs:
                 scheduler.update(out.loss_sum, b.words, b.size,
                                  src_words=b.src_words,

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Chaos harness: kill a short training job at randomized fault points,
+restart it, and verify the crash-safety contract end to end (ISSUE 4;
+docs/ROBUSTNESS.md).
+
+Per round: arm one randomly chosen fault point (MARIAN_FAULTS=
+"<point>=kill@<hit>"), run a tiny trainer subprocess until the injected
+kill (exit code 117), then validate
+
+  1. NEVER TORN — every committed bundle under <model>.npz.bundles/
+     passes manifest + checksum validation;
+  2. RESUMABLE — an un-faulted restart finishes the job (exit 0);
+  3. BIT-EXACT — the resumed run's final params, optimizer state, and
+     progress equal an uninterrupted reference run's, byte for byte.
+
+Deterministic: the schedule derives from --seed; re-run with the printed
+seed to reproduce a failure. The parent process is stdlib+numpy only
+(no jax import); each training run is a fresh subprocess, like the real
+preemption it simulates.
+
+Usage:
+    python scripts/chaos.py --workdir /tmp/chaos --rounds 6 --seed 0
+    python scripts/chaos.py ... --keep-going      # survey all failures
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+
+FAULT_EXIT_CODE = 117
+# training-path points only (serving.* fire in marian-server, not here)
+KILLABLE = [
+    "ckpt.write.model", "ckpt.write.optimizer", "ckpt.write.progress",
+    "ckpt.write.manifest", "ckpt.commit", "ckpt.publish",
+    "ckpt.async.worker", "data.batch.next",
+]
+
+LINES = ["a b c d", "b c d e", "c d e f", "d e f g",
+         "e f g a", "f g a b", "g a b c", "a c e g"] * 2
+
+_TRAIN_SNIPPET = r"""
+import json, sys
+from marian_tpu.common import Options
+from marian_tpu.training.train import train_main
+train_main(Options(json.load(open(sys.argv[1]))))
+"""
+
+
+def make_config(d: str, src: str, vocab: str, async_save: bool) -> dict:
+    return {
+        "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+        "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+        "tied-embeddings-all": True, "max-length": 16,
+        "precision": ["float32", "float32"], "seed": 7,
+        "train-sets": [src, src], "vocabs": [vocab, vocab],
+        "model": os.path.join(d, "model.npz"),
+        # maxi-batch 1: one batch per maxi window, so every save-freq
+        # boundary is a window boundary and resume is bit-exact (the
+        # corpus snapshot is window-granular — docs/ROBUSTNESS.md)
+        "mini-batch": 4, "maxi-batch": 1,
+        "after-batches": 4, "save-freq": "2u",
+        "disp-freq": 10, "learn-rate": 0.01, "shuffle": "none",
+        "overwrite": True, "async-save": async_save, "quiet": True,
+    }
+
+
+def run_trainer(cfg: dict, d: str, faults: str = "", timeout: int = 300
+                ) -> int:
+    cfg_path = os.path.join(d, "cfg.json")
+    with open(cfg_path, "w") as fh:
+        json.dump(cfg, fh)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MARIAN_FAULTS", None)
+    if faults:
+        env["MARIAN_FAULTS"] = faults
+    proc = subprocess.run([sys.executable, "-c", _TRAIN_SNIPPET, cfg_path],
+                          env=env, timeout=timeout,
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.PIPE)
+    tail = proc.stderr.decode("utf-8", "replace").strip().splitlines()[-3:]
+    for ln in tail:
+        print(f"      | {ln}")
+    return proc.returncode
+
+
+def build_vocab(d: str) -> str:
+    # plain word-frequency yaml vocab — the DefaultVocab on-disk format,
+    # written by hand so the parent never imports marian_tpu/jax
+    words = sorted({w for ln in LINES for w in ln.split()})
+    vpath = os.path.join(d, "v.yml")
+    with open(vpath, "w") as fh:
+        fh.write('"</s>": 0\n"<unk>": 1\n')
+        for i, w in enumerate(words):
+            fh.write(f'"{w}": {i + 2}\n')
+    return vpath
+
+
+def validate_bundles(model_path: str) -> list:
+    """Inline manifest+checksum validation (mirrors training/bundle.py —
+    deliberately reimplemented stdlib-only so a bug there cannot hide
+    itself from its own checker). Returns a list of violations."""
+    root = model_path + ".bundles"
+    bad = []
+    if not os.path.isdir(root):
+        return bad
+    for name in sorted(os.listdir(root)):
+        if not name.startswith("bundle-"):
+            continue
+        bdir = os.path.join(root, name)
+        mpath = os.path.join(bdir, "MANIFEST.json")
+        if not os.path.isfile(mpath):
+            bad.append(f"{name}: committed without manifest (TORN)")
+            continue
+        manifest = json.load(open(mpath))
+        for rel, info in manifest.get("members", {}).items():
+            p = os.path.join(bdir, rel)
+            if not os.path.isfile(p):
+                bad.append(f"{name}/{rel}: missing member (TORN)")
+                continue
+            h = hashlib.sha256(open(p, "rb").read()).hexdigest()
+            if h != info.get("sha256"):
+                bad.append(f"{name}/{rel}: checksum mismatch (TORN)")
+    return bad
+
+
+def final_digest(model_path: str) -> dict:
+    """Content digest of every published checkpoint artifact, for
+    bit-exactness. Tensor CONTENT is hashed, not npz file bytes —
+    np.savez embeds zip-entry mtimes, so identical checkpoints written
+    at different times differ as files but never as tensors.
+
+    Mirrors tests/test_trainer_robustness.py::_ckpt_digest on purpose
+    (same skip-special:, name|dtype|shape|bytes rules) — this harness
+    must stay runnable with no marian_tpu import in the parent process,
+    and the two implementations double-check the same contract. Change
+    the digest rules in BOTH places or the chaos harness and the test
+    suite verify different bit-exactness claims."""
+    import numpy as np
+    out = {}
+    for suffix in ("", ".optimizer.npz"):
+        p = model_path + suffix
+        if not os.path.isfile(p):
+            out[suffix or "model"] = "MISSING"
+            continue
+        h = hashlib.sha256()
+        with np.load(p) as z:
+            for name in sorted(z.files):
+                if name.startswith("special:"):
+                    # the embedded config text legitimately differs
+                    # between runs (model path, async-save flag) — only
+                    # TENSOR state carries the bit-exactness claim
+                    continue
+                a = z[name]
+                h.update(name.encode())
+                h.update(str(a.dtype).encode())
+                h.update(str(a.shape).encode())
+                h.update(np.ascontiguousarray(a).tobytes())
+        out[suffix or "model"] = h.hexdigest()
+    p = model_path + ".progress.yml"
+    out[".progress.yml"] = (
+        hashlib.sha256(open(p, "rb").read()).hexdigest()
+        if os.path.isfile(p) else "MISSING")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--keep-going", action="store_true",
+                    help="run every round even after a violation")
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    os.makedirs(args.workdir, exist_ok=True)
+    src = os.path.join(args.workdir, "t.src")
+    with open(src, "w") as fh:
+        fh.write("\n".join(LINES) + "\n")
+    vocab = build_vocab(args.workdir)
+
+    print(f"chaos: seed {args.seed}, {args.rounds} rounds")
+    ref_dir = os.path.join(args.workdir, "ref")
+    shutil.rmtree(ref_dir, ignore_errors=True)
+    os.makedirs(ref_dir)
+    print("  [ref] uninterrupted run")
+    rc = run_trainer(make_config(ref_dir, src, vocab, False), ref_dir)
+    if rc != 0:
+        print(f"chaos: reference run failed (exit {rc})")
+        return 2
+    ref = final_digest(os.path.join(ref_dir, "model.npz"))
+
+    failures = 0
+    for r in range(args.rounds):
+        point = rng.choice(KILLABLE)
+        hit = rng.randint(1, 3)
+        async_save = bool(rng.getrandbits(1)) \
+            if not point.startswith("ckpt.async") else True
+        spec = f"{point}=kill@{hit}"
+        d = os.path.join(args.workdir, f"round{r:02d}")
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d)
+        mp = os.path.join(d, "model.npz")
+        cfg = make_config(d, src, vocab, async_save)
+        print(f"  [{r:02d}] {spec} async={async_save}")
+        rc = run_trainer(cfg, d, faults=spec)
+        killed = rc == FAULT_EXIT_CODE
+        print(f"      kill run exit {rc} "
+              f"({'killed as armed' if killed else 'fault not crossed'})")
+        bad = validate_bundles(mp)
+        violations = [f"torn bundle survived the kill: {b}" for b in bad]
+        rc = run_trainer(cfg, d, faults="")
+        if rc != 0:
+            violations.append(f"resume run failed (exit {rc})")
+        else:
+            violations += [
+                f"{k}: resumed {h} != reference {ref[k]}"
+                for k, h in final_digest(mp).items() if h != ref[k]]
+            violations += [f"post-resume: {b}"
+                           for b in validate_bundles(mp)]
+        if violations:
+            failures += 1
+            for v in violations:
+                print(f"      VIOLATION: {v}")
+            if not args.keep_going:
+                break
+        else:
+            print("      ok: never torn, resumed bit-exact")
+    print(f"chaos: {failures} failing round(s) out of {args.rounds} "
+          f"(seed {args.seed})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,11 @@
 """--async-save: overlapped checkpoint writes (training/checkpoint.py ::
 AsyncSaver — beyond the reference, whose Train::save blocks the update
-loop while serializing; reference resume layout per SURVEY §5)."""
+loop while serializing; reference resume layout per SURVEY §5) + the
+crash-safe bundle protocol behind every save (training/bundle.py —
+ISSUE 4: atomic commit, checksummed manifest, keep-last-N rotation,
+restore-time validation with fallback to the last good bundle)."""
 
+import json
 import os
 
 import jax
@@ -9,7 +13,9 @@ import numpy as np
 import pytest
 
 from marian_tpu.common import Options
+from marian_tpu.common import faultpoints as fp
 from marian_tpu.common import prng
+from marian_tpu.training import bundle as bdl
 from marian_tpu.training.checkpoint import (AsyncSaver, load_checkpoint,
                                             save_checkpoint)
 from marian_tpu.training.graph_group import GraphGroup
@@ -143,3 +149,238 @@ class TestAsyncSave:
         params, cfg, state = load_checkpoint(model_path)
         assert len(params) > 0
         assert state is not None and state.batches == 6
+
+
+# ---------------------------------------------------------------------------
+# crash-safe bundle protocol (training/bundle.py — ISSUE 4)
+# ---------------------------------------------------------------------------
+
+class _FakeGG:
+    """Minimal graph-group stand-in: just enough optimizer state for the
+    bundle's .optimizer.npz member, without building a model."""
+
+    def __init__(self):
+        self.arrays = {"t": np.float32(3.0),
+                       "m:w": np.arange(4, dtype=np.float32)}
+        self.loaded = None
+
+    def optimizer_device_arrays(self):
+        return dict(self.arrays)
+
+    def load_optimizer_arrays(self, flat):
+        self.loaded = {k: np.asarray(v) for k, v in flat.items()}
+
+
+def _params(shift=0.0):
+    return {"w": np.arange(6, dtype=np.float32).reshape(2, 3) + shift}
+
+
+def _save(mp, shift=0.0, batches=1, gg=None, **kw):
+    st = TrainingState()
+    st.batches = batches
+    save_checkpoint(mp, _params(shift), "x: 1",
+                    gg if gg is not None else _FakeGG(), st, **kw)
+    return st
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    fp.reset_for_tests()
+    yield
+    fp.reset_for_tests()
+
+
+class TestBundleProtocol:
+    def test_bundle_layout_manifest_and_published_view(self, tmp_path):
+        mp = str(tmp_path / "model.npz")
+        _save(mp, batches=2)
+        root = bdl.bundle_root(mp)
+        names = bdl.list_bundles(root)
+        assert names == ["bundle-00000001"]
+        bdir = os.path.join(root, names[0])
+        manifest = json.load(open(os.path.join(bdir, bdl.MANIFEST_NAME)))
+        assert set(manifest["members"]) == {
+            "model.npz", "model.npz.optimizer.npz",
+            "model.npz.progress.yml"}
+        assert manifest["meta"]["batches"] == 2
+        for rel, info in manifest["members"].items():
+            assert info["sha256"] and info["bytes"] > 0
+            # the published top-level view is byte-identical to the
+            # committed bundle member
+            with open(os.path.join(bdir, rel), "rb") as a, \
+                    open(str(tmp_path / rel), "rb") as b:
+                assert a.read() == b.read(), rel
+        ok, why, _ = bdl.validate_bundle(bdir)
+        assert ok, why
+
+    def test_rotation_keeps_last_n(self, tmp_path):
+        mp = str(tmp_path / "model.npz")
+        for i in range(5):
+            _save(mp, shift=float(i), batches=i + 1, keep_bundles=2)
+        names = bdl.list_bundles(bdl.bundle_root(mp))
+        assert names == ["bundle-00000004", "bundle-00000005"]
+        params, _, st = load_checkpoint(mp)
+        np.testing.assert_array_equal(params["w"], _params(4.0)["w"])
+        assert st.batches == 5
+
+    def test_corrupt_newest_falls_back_to_last_good(self, tmp_path):
+        mp = str(tmp_path / "model.npz")
+        _save(mp, shift=0.0, batches=1)
+        _save(mp, shift=9.0, batches=2)
+        root = bdl.bundle_root(mp)
+        newest = os.path.join(root, bdl.list_bundles(root)[-1])
+        target = os.path.join(newest, "model.npz")
+        os.chmod(target, 0o644)   # members are read-only once committed;
+        # bit rot / a misbehaving root process doesn't ask permission
+        with open(target, "r+b") as fh:
+            fh.seek(12)
+            fh.write(b"\xde\xad\xbe\xef")
+        gg = _FakeGG()
+        params, _, st = load_checkpoint(mp, gg)
+        np.testing.assert_array_equal(params["w"], _params(0.0)["w"])
+        assert st.batches == 1
+        # the optimizer member restored from the SAME bundle as params —
+        # the consistency the flat layout could not guarantee
+        np.testing.assert_array_equal(gg.loaded["m:w"],
+                                      np.arange(4, dtype=np.float32))
+
+    def test_truncated_member_detected(self, tmp_path):
+        mp = str(tmp_path / "model.npz")
+        _save(mp, batches=1)
+        _save(mp, shift=1.0, batches=2)
+        root = bdl.bundle_root(mp)
+        newest = os.path.join(root, bdl.list_bundles(root)[-1])
+        target = os.path.join(newest, "model.npz.optimizer.npz")
+        os.chmod(target, 0o644)
+        with open(target, "r+b") as fh:
+            fh.truncate(os.path.getsize(target) // 2)
+        ok, why, _ = bdl.validate_bundle(newest)
+        assert not ok and "truncated" in why
+        _, _, st = load_checkpoint(mp)
+        assert st.batches == 1
+
+    def test_all_bundles_bad_and_no_flat_layout_is_loud(self, tmp_path):
+        mp = str(tmp_path / "model.npz")
+        _save(mp, batches=1)
+        root = bdl.bundle_root(mp)
+        for name in bdl.list_bundles(root):
+            os.remove(os.path.join(root, name, bdl.MANIFEST_NAME))
+        for rel in ("model.npz", "model.npz.optimizer.npz",
+                    "model.npz.progress.yml"):
+            os.remove(str(tmp_path / rel))
+        with pytest.raises(bdl.BundleError, match="failed validation"):
+            load_checkpoint(mp)
+
+    def test_all_bundles_bad_never_falls_back_to_flat_view(self, tmp_path):
+        """The flat layout is the published HARDLINK of a bundle's
+        members — when every bundle fails validation, 'falling back' to
+        it would resume from exactly the corrupt bytes the checksums
+        refused. Must be a loud BundleError even though model.npz
+        exists."""
+        mp = str(tmp_path / "model.npz")
+        _save(mp, batches=1)
+        root = bdl.bundle_root(mp)
+        bdir = os.path.join(root, bdl.list_bundles(root)[0])
+        target = os.path.join(bdir, "model.npz")
+        os.chmod(target, 0o644)
+        with open(target, "r+b") as fh:     # bit rot on the shared inode
+            fh.seek(12)
+            fh.write(b"\xde\xad")
+        assert os.path.exists(mp)           # flat view is present...
+        with pytest.raises(bdl.BundleError,
+                           match="published view of a rejected bundle"):
+            load_checkpoint(mp)             # ...and still refused
+
+    def test_committed_members_are_readonly(self, tmp_path):
+        """The published top-level view hardlinks the committed bundle
+        member (one inode). Read-only mode is what turns an external
+        tool's in-place edit of the 'convenience' copy — which would
+        silently break the recorded checksum — into a loud EACCES."""
+        mp = str(tmp_path / "model.npz")
+        _save(mp, batches=1)
+        root = bdl.bundle_root(mp)
+        bdir = os.path.join(root, bdl.list_bundles(root)[0])
+        for rel in ("model.npz", "model.npz.optimizer.npz",
+                    "model.npz.progress.yml"):
+            member = os.path.join(bdir, rel)
+            assert os.stat(member).st_mode & 0o777 == 0o444, rel
+            top = str(tmp_path / rel)
+            # same inode: the published view shares the protection
+            assert os.path.samefile(member, top), rel
+        # a REPLACING rewrite of the top-level file (temp+rename, what
+        # numpy/save_items do) still works and leaves the bundle intact
+        from marian_tpu.common import io as mio
+        mio.save_model(mp, _params(9.0), "x: 2")
+        ok, why, _ = bdl.validate_bundle(bdir)
+        assert ok, why
+
+    def test_legacy_flat_layout_still_loads(self, tmp_path):
+        """Pre-bundle checkpoints (hand-copied models, upstream Marian
+        exports) keep loading without a .bundles/ dir."""
+        from marian_tpu.common import io as mio
+        mp = str(tmp_path / "legacy.npz")
+        mio.save_model(mp, _params(), "x: 1")
+        st = TrainingState()
+        st.batches = 7
+        st.save(mp + ".progress.yml")
+        params, cfg, state = load_checkpoint(mp)
+        np.testing.assert_array_equal(params["w"], _params()["w"])
+        assert state.batches == 7 and cfg == "x: 1"
+
+
+FAIL_POINTS = ("ckpt.write.model", "ckpt.write.optimizer",
+               "ckpt.write.progress", "ckpt.write.manifest", "ckpt.commit")
+
+
+class TestInjectedSaveFailures:
+    @pytest.mark.parametrize("point", FAIL_POINTS)
+    def test_fail_mid_save_never_tears_previous_bundle(self, tmp_path,
+                                                       point):
+        """An injected IO failure at EVERY stage of the bundle write
+        leaves the previous committed bundle fully valid, no staging
+        litter behind, and restore returns the previous moment."""
+        mp = str(tmp_path / "model.npz")
+        _save(mp, shift=0.0, batches=1)
+        with fp.active(f"{point}=fail"):
+            with pytest.raises(fp.InjectedFault):
+                _save(mp, shift=5.0, batches=2)
+        root = bdl.bundle_root(mp)
+        assert bdl.list_bundles(root) == ["bundle-00000001"]
+        assert not [d for d in os.listdir(root)
+                    if d.startswith(".staging")]
+        params, _, st = load_checkpoint(mp)
+        np.testing.assert_array_equal(params["w"], _params(0.0)["w"])
+        assert st.batches == 1
+
+    def test_publish_failure_does_not_lose_the_commit(self, tmp_path):
+        """ckpt.publish fires AFTER the atomic rename: the save errors,
+        the top-level view is stale, but the committed bundle is the new
+        moment and restore sees it."""
+        mp = str(tmp_path / "model.npz")
+        _save(mp, shift=0.0, batches=1)
+        with fp.active("ckpt.publish=fail"):
+            with pytest.raises(fp.InjectedFault):
+                _save(mp, shift=5.0, batches=2)
+        assert len(bdl.list_bundles(bdl.bundle_root(mp))) == 2
+        params, _, st = load_checkpoint(mp)
+        np.testing.assert_array_equal(params["w"], _params(5.0)["w"])
+        assert st.batches == 2
+        # the stale top-level file was NOT half-replaced
+        flat, _ = __import__("marian_tpu.common.io",
+                             fromlist=["io"]).load_model(mp)
+        np.testing.assert_array_equal(flat["w"], _params(0.0)["w"])
+
+    def test_async_worker_failure_raises_on_wait(self, tmp_path):
+        """ckpt.async.worker fires on the AsyncSaver thread; wait() must
+        re-raise it on the training thread and leave no bundle behind."""
+        mp = str(tmp_path / "model.npz")
+        saver = AsyncSaver()
+        with fp.active("ckpt.async.worker=fail"):
+            _save(mp, batches=1, async_saver=saver)
+            with pytest.raises(fp.InjectedFault):
+                saver.wait()
+        assert bdl.list_bundles(bdl.bundle_root(mp)) == []
+        # saver reusable after the injected failure
+        _save(mp, batches=1, async_saver=saver)
+        saver.wait()
+        assert len(bdl.list_bundles(bdl.bundle_root(mp))) == 1

@@ -1,0 +1,178 @@
+"""Fault-injection registry (common/faultpoints.py — ISSUE 4): spec
+parsing, deterministic triggering by seed + hit-count, every mode, env
+activation across a process boundary. Stdlib-only layer — no jax, no
+model; the fault points' *placement* is exercised by the checkpoint /
+serving / trainer tests and audited by mtlint's fault-hygiene rule."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from marian_tpu.common import faultpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fp.reset_for_tests()
+    os.environ.pop(fp.ENV_SPEC, None)
+    yield
+    fp.reset_for_tests()
+    os.environ.pop(fp.ENV_SPEC, None)
+
+
+class TestSpecParsing:
+    def test_modes_and_hits(self):
+        specs = fp.parse_spec(
+            "ckpt.commit=kill@2, ckpt.write.model=fail,"
+            "serving.translate=hang:0.5@*, data.batch.next=prob:0.25@3+")
+        assert specs["ckpt.commit"].mode == "kill"
+        assert specs["ckpt.commit"].matches(2)
+        assert not specs["ckpt.commit"].matches(1)
+        assert specs["ckpt.write.model"].matches(1)       # default @1
+        assert not specs["ckpt.write.model"].matches(2)
+        assert specs["serving.translate"].arg == 0.5
+        assert all(specs["serving.translate"].matches(n)
+                   for n in (1, 5, 100))                   # @*
+        assert specs["data.batch.next"].matches(3)
+        assert specs["data.batch.next"].matches(9)         # @3+
+        assert not specs["data.batch.next"].matches(2)
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(fp.FaultSpecError, match="unknown fault point"):
+            fp.parse_spec("no.such.point=fail")
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(fp.FaultSpecError, match="unknown mode"):
+            fp.parse_spec("ckpt.commit=explode")
+
+    def test_prob_needs_probability(self):
+        with pytest.raises(fp.FaultSpecError, match="prob needs"):
+            fp.parse_spec("ckpt.commit=prob")
+
+    def test_bare_prob_applies_per_hit(self):
+        """prob without a hit selector means per-hit probability (@*) —
+        an implicit @1 would roll the dice once and report a clean
+        drill."""
+        spec = fp.parse_spec("data.batch.next=prob:0.5")["data.batch.next"]
+        assert all(spec.matches(n) for n in (1, 2, 50))
+        fired = 0
+        with fp.active("data.batch.next=prob:0.5", seed=3):
+            for _ in range(32):
+                try:
+                    fp.fault_point("data.batch.next")
+                except fp.InjectedFault:
+                    fired += 1
+        assert fired > 1                      # not a one-shot
+
+    def test_bad_hit_selectors_rejected(self):
+        """@x and @0 must be spec errors: a selector that can never
+        match would silently disarm the drill."""
+        with pytest.raises(fp.FaultSpecError, match="bad hit selector"):
+            fp.parse_spec("ckpt.commit=kill@x")
+        with pytest.raises(fp.FaultSpecError, match="must be >= 1"):
+            fp.parse_spec("ckpt.commit=kill@0")
+        with pytest.raises(fp.FaultSpecError, match="must be >= 1"):
+            fp.parse_spec("ckpt.commit=fail@0+")
+
+    def test_catalog_described(self):
+        rows = dict(fp.describe())
+        assert set(rows) == set(fp.CATALOG)
+        assert all(desc for desc in rows.values())
+
+
+class TestTriggering:
+    def test_unarmed_is_noop_but_counts(self):
+        fp.activate("")                       # armed with nothing
+        fp.fault_point("ckpt.commit")
+        fp.fault_point("ckpt.commit")
+        assert fp.hits("ckpt.commit") == 2
+
+    def test_fail_on_exact_hit(self):
+        with fp.active("ckpt.commit=fail@2"):
+            fp.fault_point("ckpt.commit")     # hit 1: passes
+            with pytest.raises(fp.InjectedFault, match="ckpt.commit"):
+                fp.fault_point("ckpt.commit")  # hit 2: fires
+            fp.fault_point("ckpt.commit")     # hit 3: passes again
+
+    def test_context_manager_disarms(self):
+        with fp.active("ckpt.commit=fail"):
+            pass
+        fp.fault_point("ckpt.commit")         # disarmed: no raise
+
+    def test_undeclared_call_site_is_loud(self):
+        with pytest.raises(fp.FaultSpecError, match="CATALOG"):
+            fp.fault_point("not.in.catalog")
+
+    def test_hang_sleeps(self):
+        with fp.active("serving.translate=hang:0.1"):
+            t0 = time.monotonic()
+            fp.fault_point("serving.translate")
+            assert time.monotonic() - t0 >= 0.1
+
+    def test_prob_deterministic_by_seed(self):
+        def fire_pattern(seed, n=32):
+            out = []
+            with fp.active("data.batch.next=prob:0.5@*", seed=seed):
+                for _ in range(n):
+                    try:
+                        fp.fault_point("data.batch.next")
+                        out.append(0)
+                    except fp.InjectedFault:
+                        out.append(1)
+            return out
+
+        a, b = fire_pattern(7), fire_pattern(7)
+        assert a == b                         # same seed: same schedule
+        assert 0 < sum(a) < 32                # actually probabilistic
+        assert fire_pattern(8) != a           # another seed: another one
+
+    def test_activate_resets_hits(self):
+        fp.activate("ckpt.commit=fail@5")
+        fp.fault_point("ckpt.commit")
+        assert fp.hits("ckpt.commit") == 1
+        fp.activate("ckpt.commit=fail@5")
+        assert fp.hits("ckpt.commit") == 0
+
+
+class TestProcessBoundary:
+    def test_env_arms_and_kill_exits_with_fault_code(self):
+        """MARIAN_FAULTS crosses the process boundary and kill is a real
+        no-cleanup death — the mechanism the crash-resume trainer tests
+        and scripts/chaos.py are built on."""
+        code = ("from marian_tpu.common import faultpoints as fp\n"
+                "fp.fault_point('ckpt.commit')\n"
+                "fp.fault_point('ckpt.commit')\n"
+                "print('SURVIVED')\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MARIAN_FAULTS="ckpt.commit=kill@2")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, timeout=120)
+        assert proc.returncode == fp.FAULT_EXIT_CODE
+        assert b"SURVIVED" not in proc.stdout
+        assert b"FAULTPOINT ckpt.commit hit 2" in proc.stderr
+
+    def test_env_spec_ignored_after_programmatic_arming(self):
+        os.environ[fp.ENV_SPEC] = "ckpt.commit=fail"
+        fp.reset_for_tests()
+        fp.activate("")                       # programmatic wins
+        fp.fault_point("ckpt.commit")         # env spec must NOT fire
+
+    def test_env_spec_loads_on_first_hit(self):
+        os.environ[fp.ENV_SPEC] = "ckpt.commit=fail"
+        fp.reset_for_tests()
+        with pytest.raises(fp.InjectedFault):
+            fp.fault_point("ckpt.commit")
+
+    def test_malformed_env_spec_raises_every_crossing(self):
+        """A typo'd MARIAN_FAULTS must keep failing loudly — raising once
+        and then silently disarming would let a chaos drill inject
+        nothing and report success."""
+        os.environ[fp.ENV_SPEC] = "ckpt.comit=kill"      # typo'd name
+        fp.reset_for_tests()
+        for _ in range(3):
+            with pytest.raises(fp.FaultSpecError,
+                               match="unknown fault point"):
+                fp.fault_point("data.batch.next")

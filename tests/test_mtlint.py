@@ -404,6 +404,91 @@ class TestMetrics:
 # suppression, config, baseline, CLI, gate
 # ---------------------------------------------------------------------------
 
+class TestFaultHygiene:
+    """MT-FAULT-* (fault_hygiene.py — ISSUE 4): every fault_point() call
+    site uses a declared catalog name, and every declared point is
+    exercised by at least one test (mirrors the metrics-hygiene shape)."""
+
+    CATALOG = ("from typing import Dict\n"
+               "CATALOG: Dict[str, str] = {\n"
+               "    'ckpt.commit': 'the commit point',\n"
+               "    'data.batch.next': 'pipeline',\n"
+               "}\n")
+    SITES = ("from marian_tpu.common import faultpoints as fp\n"
+             "def save():\n"
+             "    fp.fault_point('ckpt.commit')\n")
+
+    def _lint(self, tmp_path, files, tests=None):
+        cfg = Config(root=tmp_path)
+        tdir = tmp_path / "tests"
+        tdir.mkdir(exist_ok=True)
+        for name, content in (tests or {}).items():
+            (tdir / name).write_text(content, encoding="utf-8")
+        srcs = [Source(tmp_path / rel, rel, text=code)
+                for rel, code in files.items()]
+        rule = next(r for r in all_rules() if r.family == "faults")
+        return rule.check_project(srcs, cfg)
+
+    def test_unknown_call_site_flagged(self, tmp_path):
+        fs = self._lint(tmp_path, {
+            "marian_tpu/common/faultpoints.py": self.CATALOG,
+            "marian_tpu/x.py":
+                "def f():\n    fault_point('no.such.name')\n"},
+            tests={"test_x.py": "ckpt.commit data.batch.next"})
+        assert [f.rule for f in fs] == ["MT-FAULT-UNKNOWN"]
+        assert "no.such.name" in fs[0].message
+
+    def test_untested_call_site_flagged(self, tmp_path):
+        fs = self._lint(tmp_path, {
+            "marian_tpu/common/faultpoints.py": self.CATALOG,
+            "marian_tpu/ckpt.py": self.SITES},
+            tests={"test_x.py": "only data.batch.next is exercised"})
+        assert [f.rule for f in fs] == ["MT-FAULT-UNTESTED"]
+        assert "ckpt.commit" in fs[0].message
+        assert fs[0].path == "marian_tpu/ckpt.py"   # anchored at the site
+
+    def test_catalog_entry_without_site_or_test_flagged(self, tmp_path):
+        fs = self._lint(tmp_path, {
+            "marian_tpu/common/faultpoints.py": self.CATALOG,
+            "marian_tpu/ckpt.py": self.SITES},
+            tests={"test_x.py": "arms ckpt.commit=kill@2"})
+        assert [f.rule for f in fs] == ["MT-FAULT-UNTESTED"]
+        assert "data.batch.next" in fs[0].message
+        assert fs[0].path.endswith("faultpoints.py")  # anchored at catalog
+
+    def test_fully_covered_tree_is_clean(self, tmp_path):
+        fs = self._lint(tmp_path, {
+            "marian_tpu/common/faultpoints.py": self.CATALOG,
+            "marian_tpu/ckpt.py": self.SITES,
+            "marian_tpu/data.py":
+                "from marian_tpu.common import faultpoints as fp\n"
+                "def g():\n    fp.fault_point('data.batch.next')\n"},
+            tests={"test_x.py":
+                   "MARIAN_FAULTS='ckpt.commit=kill@2,"
+                   "data.batch.next=fail'"})
+        assert fs == []
+
+    def test_name_in_comment_is_not_coverage(self, tmp_path):
+        """Only string constants in test files count as exercising a
+        fault point — '# we deliberately skip ckpt.commit' must not
+        satisfy the rule."""
+        fs = self._lint(tmp_path, {
+            "marian_tpu/common/faultpoints.py": self.CATALOG,
+            "marian_tpu/ckpt.py": self.SITES},
+            tests={"test_x.py":
+                   "# we deliberately do not drill ckpt.commit\n"
+                   "X = 'data.batch.next=fail'\n"})
+        assert [f.rule for f in fs] == ["MT-FAULT-UNTESTED"]
+        assert "ckpt.commit" in fs[0].message
+
+    def test_snippet_without_registry_is_silent(self, tmp_path):
+        """Trees with no fault registry at all (every other rule's
+        snippet tests) must not drown in fault findings."""
+        fs = self._lint(tmp_path,
+                        {"marian_tpu/ops/x.py": "def f():\n    pass\n"})
+        assert fs == []
+
+
 class TestSuppression:
     def test_ok_comment(self):
         fs = lint_text(
@@ -450,7 +535,7 @@ class TestConfig:
     def test_every_advertised_rule_id_has_an_owner(self):
         families = {r.family for r in all_rules()}
         assert families == {"trace-safety", "host-sync", "donation",
-                            "dtype", "guarded-by", "metrics"}
+                            "dtype", "guarded-by", "metrics", "faults"}
 
 
 BAD_OPS = ("import jax.numpy as jnp\n"

@@ -10,10 +10,12 @@ import urllib.request
 import pytest
 
 from marian_tpu.common import Options
+from marian_tpu.common import faultpoints as fp
 from marian_tpu.data.batch_generator import bucket_length
 from marian_tpu.serving import metrics as msm
 from marian_tpu.serving.admission import AdmissionController, Overloaded
-from marian_tpu.serving.scheduler import ContinuousScheduler, RequestTimeout
+from marian_tpu.serving.scheduler import (ContinuousScheduler,
+                                          DispatchStalled, RequestTimeout)
 
 
 def run(coro):
@@ -439,6 +441,7 @@ def test_serving_smoke():
                    "marian_serving_cancelled_total",
                    "marian_serving_failures_total",
                    "marian_serving_retry_bisections_total",
+                   "marian_serving_watchdog_trips_total",
                    "marian_serving_shed_total",
                    "marian_serving_admitted_sentences_total",
                    "marian_serving_queue_limit_sentences"):
@@ -579,6 +582,118 @@ def test_bisection_skips_dead_units():
     assert all("omega" not in c for c in calls[1:])
 
 
+# ---------------------------------------------------------------------------
+# dispatch watchdog + serving fault points (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+class TestDispatchWatchdog:
+    def test_stalled_batch_fails_retriable_and_scheduler_survives(self):
+        """The acceptance-criterion property: a hung translate_lines call
+        trips the watchdog — its requests fail with a RETRIABLE error —
+        and the scheduler keeps serving subsequent batches on a fresh
+        device worker instead of wedging forever."""
+        release = threading.Event()
+
+        def translate(lines):
+            if lines == ["stall"]:
+                release.wait(10)        # wedged device call
+            return [l.upper() for l in lines]
+
+        async def scenario():
+            reg = msm.Registry()
+            s = ContinuousScheduler(translate, window_s=0, registry=reg,
+                                    stall_timeout=0.1)
+            s.start()
+            f1 = s.submit(["stall"])
+            with pytest.raises(DispatchStalled, match="retry"):
+                await asyncio.wait_for(f1, 5)
+            assert DispatchStalled.retriable
+            # the scheduler is alive: a new request completes while the
+            # abandoned thread is still wedged
+            out = await asyncio.wait_for(s.submit(["after"]), 5)
+            trips = reg.get("marian_serving_watchdog_trips_total").value
+            # the abandoned worker thread must be detached from
+            # concurrent.futures' atexit join — a PERMANENTLY wedged
+            # device call must not hang interpreter shutdown after an
+            # otherwise graceful drain
+            from concurrent.futures import thread as cf_thread
+            wedged = [t for t in cf_thread._threads_queues
+                      if t.name.startswith("serve-device")
+                      and t.is_alive()]
+            release.set()
+            await s.stop()
+            return out, trips, wedged
+
+        try:
+            out, trips, wedged = run(scenario())
+        finally:
+            release.set()
+        assert out == ["AFTER"]
+        assert trips == 1
+        # only the replacement executor's (responsive) worker may remain
+        # registered for the exit join; the wedged one was detached
+        assert len(wedged) <= 1
+
+    def test_injected_hang_trips_watchdog(self):
+        """serving.translate=hang — the fault-injection route to the same
+        stall (what scripts/chaos.py and operators use to drill it)."""
+        async def scenario():
+            reg = msm.Registry()
+            s = ContinuousScheduler(lambda lines: list(lines), window_s=0,
+                                    registry=reg, stall_timeout=0.05)
+            s.start()
+            with fp.active("serving.translate=hang:0.4"):
+                with pytest.raises(DispatchStalled):
+                    await asyncio.wait_for(s.submit(["x"]), 5)
+            out = await asyncio.wait_for(s.submit(["ok"]), 5)
+            await s.stop()
+            return out, reg.get(
+                "marian_serving_watchdog_trips_total").value
+
+        out, trips = run(scenario())
+        assert out == ["ok"] and trips == 1
+
+    def test_injected_dispatch_failure_fails_loudly_not_silently(self):
+        """serving.dispatch=fail: the batch's futures fail explicitly
+        (never a dropped batch with hanging clients) and the worker
+        survives."""
+        async def scenario():
+            s = ContinuousScheduler(lambda lines: list(lines), window_s=0,
+                                    registry=msm.Registry())
+            s.start()
+            with fp.active("serving.dispatch=fail"):
+                with pytest.raises(RuntimeError, match="injected fault"):
+                    await asyncio.wait_for(s.submit(["x"]), 5)
+            out = await asyncio.wait_for(s.submit(["ok"]), 5)
+            await s.stop()
+            return out
+
+        assert run(scenario()) == ["ok"]
+
+    def test_app_replies_server_retry_on_stall(self):
+        """Transport level: a watchdog trip becomes an explicit
+        !!SERVER-RETRY reply, not an empty string or a hang."""
+        release = threading.Event()
+
+        def blocking(lines):
+            release.wait(10)
+            return list(lines)
+
+        async def scenario():
+            app = _make_app(blocking, **{"dispatch-stall-timeout": 0.1})
+            await app.start()
+            reply = await asyncio.wait_for(app.handle_text("hold"), 5)
+            release.set()
+            await app.shutdown(drain_timeout=2.0)
+            return reply
+
+        try:
+            reply = run(scenario())
+        finally:
+            release.set()
+        assert reply.startswith("!!SERVER-RETRY")
+
+
 def test_tcp_disconnect_cancels_request():
     """TCP cancellation parity with ws: a client that drops mid-request
     has its queued sentences cancelled before they cost device time."""
@@ -623,6 +738,154 @@ def test_tcp_disconnect_cancels_request():
         release.set()
     assert cancelled == 1
     assert all("goner" not in l for c in calls for l in c)
+
+
+def test_tcp_pipelined_disconnect_cancels_request():
+    """The PR 8 review regression: once a PIPELINED byte arrived, the old
+    handler stopped watching for EOF — a client that then disconnected
+    while queued was only noticed at reply-write time, after its
+    sentences had already cost device work. The watch must re-arm."""
+    from marian_tpu.server.server import _make_tcp_handler
+    release = threading.Event()
+    calls = []
+
+    def blocking(lines):
+        calls.append(list(lines))
+        release.wait(5)
+        return list(lines)
+
+    async def scenario():
+        app = _make_app(blocking)
+        await app.start()
+        server = await asyncio.start_server(_make_tcp_handler(app),
+                                            "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        hold = asyncio.ensure_future(_tcp_request(port, "hold"))
+        await asyncio.sleep(0.05)              # device busy on "hold"
+        # second client: frame, then a PIPELINED next frame, then drops
+        _, w = await asyncio.open_connection("127.0.0.1", port)
+        p = b"goner one\ngoner two"
+        w.write(b"MTPU %d\n" % len(p) + p)
+        await w.drain()
+        await asyncio.sleep(0.05)
+        w.write(b"MTPU 4\n")                   # pipelined read-ahead bytes
+        await w.drain()
+        await asyncio.sleep(0.05)              # old code stops watching HERE
+        w.close()
+        await asyncio.sleep(0.1)               # re-armed watch sees EOF
+        cancelled = app.registry.get(
+            "marian_serving_cancelled_total").value
+        release.set()
+        await hold
+        server.close()
+        await server.wait_closed()
+        await app.shutdown(drain_timeout=2.0)
+        return cancelled
+
+    try:
+        cancelled = run(scenario())
+    finally:
+        release.set()
+    assert cancelled == 1
+    assert all("goner" not in l for c in calls for l in c)
+
+
+@pytest.mark.parametrize("header", [b"MTPU -3\n", b"MTPU abc\n"])
+def test_tcp_invalid_frame_length_rejected(header):
+    """'MTPU -3' / 'MTPU abc' must be refused as a bad frame: the
+    buffered _readexactly would python-slice the read-ahead buffer with
+    a negative count and desync the protocol (the raw StreamReader used
+    to raise ValueError for free), and a non-numeric length deserves the
+    explicit reply, not a silent close."""
+    from marian_tpu.server.server import _make_tcp_handler
+
+    async def scenario():
+        app = _make_app(lambda lines: list(lines))
+        await app.start()
+        server = await asyncio.start_server(_make_tcp_handler(app),
+                                            "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(header + b"ABCDEFGH")
+            await w.drain()
+            hdr = await asyncio.wait_for(r.readline(), 5)
+            reply = await asyncio.wait_for(
+                r.readexactly(int(hdr.split()[1])), 5)
+            # server replies bad-frame and closes — no mis-sliced
+            # 'payload' ever reaches the scheduler
+            eof = await asyncio.wait_for(r.read(1), 5)
+            w.close()
+            return reply, eof
+        finally:
+            server.close()
+            await server.wait_closed()
+            await app.shutdown(drain_timeout=2.0)
+
+    reply, eof = run(scenario())
+    assert reply.startswith(b"!!SERVER-ERROR bad frame")
+    assert eof == b""
+
+
+def test_tcp_flooding_pipeliner_bounded_readahead():
+    """A client flooding pipelined bytes while its reply is in flight
+    must not grow the server's read-ahead buffer without bound — past
+    MAX_READAHEAD the watch stops reading (TCP backpressure throttles
+    the sender) and the framing still parses everything afterwards."""
+    from marian_tpu.server import server as srv
+    release = threading.Event()
+
+    def blocking(lines):
+        if lines == ["hold"]:
+            release.wait(5)
+        return [l.upper() for l in lines]
+
+    async def scenario(monkey_cap):
+        old_cap = srv.MAX_READAHEAD
+        srv.MAX_READAHEAD = monkey_cap
+        app = _make_app(blocking)
+        await app.start()
+        server = await asyncio.start_server(srv._make_tcp_handler(app),
+                                            "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            p = b"hold"
+            w.write(b"MTPU %d\n" % len(p) + p)
+            await w.drain()
+            await asyncio.sleep(0.05)
+            # flood far past the cap while the reply is pending, ending
+            # in a complete second frame
+            flood = b"x" * (monkey_cap * 4)
+            frame2 = b"MTPU 2\nok"
+            w.write(b"MTPU %d\n" % (len(flood) + 2) + flood + b"ok")
+            w.write(frame2)
+            await w.drain()
+            await asyncio.sleep(0.05)
+            release.set()
+
+            async def read_reply():
+                hdr = await r.readline()
+                return await r.readexactly(int(hdr.split()[1]))
+
+            r1 = await asyncio.wait_for(read_reply(), 5)
+            r2 = await asyncio.wait_for(read_reply(), 5)
+            r3 = await asyncio.wait_for(read_reply(), 5)
+            w.close()
+            return r1, r2, r3
+        finally:
+            server.close()
+            await server.wait_closed()
+            await app.shutdown(drain_timeout=2.0)
+            srv.MAX_READAHEAD = old_cap
+
+    try:
+        r1, r2, r3 = run(scenario(4096))
+    finally:
+        release.set()
+    assert r1 == b"HOLD"
+    assert r2.endswith(b"OK") and len(r2) == 4096 * 4 + 2
+    assert r3 == b"OK"
 
 
 # ---------------------------------------------------------------------------
@@ -679,6 +942,23 @@ class TestSchedulerRegressions:
             run(scenario())
         finally:
             release.set()
+
+    def test_submit_empty_resolves_immediately(self):
+        """submit([]) must resolve NOW with [] — no unit would ever
+        complete it, so it previously returned a future that hung
+        forever without a timeout (deferred from the PR 8 review)."""
+        async def scenario():
+            s = ContinuousScheduler(lambda lines: list(lines),
+                                    registry=msm.Registry())
+            fut = s.submit([])
+            assert fut.done() and fut.result() == []
+            # and the counters saw nothing to queue
+            assert s.queued_units() == 0
+            out = await asyncio.wait_for(fut, 0.1)
+            await s.stop()
+            return out
+
+        assert run(scenario()) == []
 
     def test_stop_leaves_no_stale_dead_count(self):
         """The set_exception done-callbacks from stop()'s sweep run AFTER

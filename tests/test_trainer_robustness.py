@@ -1,6 +1,20 @@
-"""Trainer robustness flags (parallel/zero.py step_fn — reference:
-training/graph_group.cpp): --normalize-gradient, --check-gradient-nan,
---dynamic-gradient-scaling + --gradient-norm-average-window."""
+"""Trainer robustness (reference: training/graph_group.cpp + ISSUE 4).
+
+Gradient-side flags (parallel/zero.py step_fn): --normalize-gradient,
+--check-gradient-nan, --dynamic-gradient-scaling +
+--gradient-norm-average-window.
+
+Crash-resume (ISSUE 4 acceptance): a trainer SUBPROCESS killed by an
+injected fault at every stage of the checkpoint save (MARIAN_FAULTS=
+"<point>=kill@N" — a real os._exit, no cleanup) restarts and resumes
+BIT-EXACTLY — params, optimizer state, and progress equal to an
+uninterrupted run — from a validated bundle, never a torn one."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -10,6 +24,7 @@ import pytest
 from marian_tpu.common import Options
 from marian_tpu.common import prng
 from marian_tpu.models.encoder_decoder import create_model
+from marian_tpu.training import bundle as bdl
 from marian_tpu.training.graph_group import GraphGroup
 
 
@@ -157,3 +172,138 @@ class TestDynamicGradientScaling:
         gg2 = _gg(**{"dynamic-gradient-scaling": ["2", "log"]})
         gg2.load_optimizer_arrays(flat)
         assert float(np.asarray(gg2.opt_state["gstat"]["n"])) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# crash-resume under injected kills (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+_TRAIN_SNIPPET = (
+    "import json, sys\n"
+    "from marian_tpu.common import Options\n"
+    "from marian_tpu.training.train import train_main\n"
+    "train_main(Options(json.load(open(sys.argv[1]))))\n")
+
+
+def _crash_config(d, src, vocab):
+    return {
+        "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+        "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+        "tied-embeddings-all": True, "max-length": 16,
+        "precision": ["float32", "float32"], "seed": 7,
+        "train-sets": [src, src], "vocabs": [vocab, vocab],
+        "model": os.path.join(d, "model.npz"),
+        # maxi-batch 1 aligns every save-freq boundary with a maxi-window
+        # boundary, where the corpus resume snapshot is exact
+        "mini-batch": 4, "maxi-batch": 1, "after-batches": 4,
+        "save-freq": "2u", "disp-freq": 10, "learn-rate": 0.01,
+        "shuffle": "none", "overwrite": True, "quiet": True,
+    }
+
+
+def _run_inprocess(cfg):
+    from marian_tpu.training.train import train_main
+    train_main(Options(dict(cfg)))
+
+
+def _run_killed(cfg, d, faults):
+    cfg_path = os.path.join(d, "cfg.json")
+    with open(cfg_path, "w") as fh:
+        json.dump(cfg, fh)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MARIAN_FAULTS=faults)
+    return subprocess.run(
+        [sys.executable, "-c", _TRAIN_SNIPPET, cfg_path], env=env,
+        timeout=300, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _ckpt_digest(model_path):
+    """Content digest of params + optimizer + progress. Tensor content,
+    not npz bytes (zip entries carry mtimes); the embedded config text is
+    skipped (it names per-run paths). Mirrors scripts/chaos.py::
+    final_digest on purpose — change the rules in BOTH places."""
+    out = {}
+    for suffix in ("", ".optimizer.npz"):
+        h = hashlib.sha256()
+        with np.load(model_path + suffix) as z:
+            for name in sorted(z.files):
+                if name.startswith("special:"):
+                    continue
+                a = z[name]
+                h.update(f"{name}|{a.dtype}|{a.shape}".encode())
+                h.update(np.ascontiguousarray(a).tobytes())
+        out[suffix or "model"] = h.hexdigest()
+    with open(model_path + ".progress.yml", "rb") as fh:
+        out["progress"] = hashlib.sha256(fh.read()).hexdigest()
+    return out
+
+
+def _assert_never_torn(model_path):
+    root = bdl.bundle_root(model_path)
+    names = bdl.list_bundles(root)
+    for name in names:
+        ok, why, _ = bdl.validate_bundle(os.path.join(root, name))
+        assert ok, f"torn bundle survived the kill: {name}: {why}"
+    return names
+
+
+@pytest.fixture(scope="module")
+def crash_env(tmp_path_factory):
+    """Shared corpus + vocab + an uninterrupted reference run."""
+    base = tmp_path_factory.mktemp("crash_resume")
+    lines = ["a b c d", "b c d e", "c d e f", "d e f g",
+             "e f g a", "f g a b", "g a b c", "a c e g"] * 2
+    src = str(base / "t.src")
+    with open(src, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    from marian_tpu.data.vocab import DefaultVocab
+    vocab = str(base / "v.yml")
+    DefaultVocab.build(lines).save(vocab)
+    ref_dir = str(base / "ref")
+    os.mkdir(ref_dir)
+    _run_inprocess(_crash_config(ref_dir, src, vocab))
+    ref = _ckpt_digest(os.path.join(ref_dir, "model.npz"))
+    return {"base": base, "src": src, "vocab": vocab, "ref": ref}
+
+
+def _kill_resume_roundtrip(crash_env, name, faults, extra_cfg=None):
+    d = str(crash_env["base"] / name)
+    os.mkdir(d)
+    cfg = _crash_config(d, crash_env["src"], crash_env["vocab"])
+    cfg.update(extra_cfg or {})
+    mp = os.path.join(d, "model.npz")
+    proc = _run_killed(cfg, d, faults)
+    from marian_tpu.common.faultpoints import FAULT_EXIT_CODE
+    assert proc.returncode == FAULT_EXIT_CODE, (
+        f"expected injected kill, got exit {proc.returncode}:\n"
+        + proc.stderr.decode("utf-8", "replace")[-2000:])
+    _assert_never_torn(mp)          # the kill left no torn bundle behind
+    _run_inprocess(cfg)             # restart: resume to completion
+    assert _ckpt_digest(mp) == crash_env["ref"], (
+        f"resume after {faults} is not bit-exact vs the uninterrupted run")
+    _assert_never_torn(mp)
+
+
+class TestCrashResume:
+    """Tier-1: kill at the two highest-stakes stages — the optimizer
+    member write (the original torn-bundle bug: model newer than its
+    optimizer state) and the commit rename itself. The remaining fault
+    points ride in the slow tier (same harness, full catalog)."""
+
+    @pytest.mark.parametrize("faults", ["ckpt.write.optimizer=kill@2",
+                                        "ckpt.commit=kill@2"])
+    def test_kill_mid_save_resumes_bitexact(self, crash_env, faults):
+        name = "t1_" + faults.split("=")[0].replace(".", "_")
+        _kill_resume_roundtrip(crash_env, name, faults)
+
+    @pytest.mark.parametrize("faults,extra", [
+        ("ckpt.write.model=kill@2", None),
+        ("ckpt.write.progress=kill@2", None),
+        ("ckpt.write.manifest=kill@2", None),
+        ("ckpt.publish=kill@2", None),
+        ("ckpt.async.worker=kill@2", {"async-save": True}),
+        ("data.batch.next=kill@3", None),
+    ])
+    def test_kill_at_remaining_fault_points_resumes_bitexact(
+            self, crash_env, faults, extra):
+        name = "slow_" + faults.split("=")[0].replace(".", "_")
+        _kill_resume_roundtrip(crash_env, name, faults, extra_cfg=extra)
