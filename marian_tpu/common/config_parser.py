@@ -330,6 +330,12 @@ _TRANSLATION = [
     _f("batch-token-budget", int, 0, "marian-server continuous batching: token budget per device batch against the bucketed static-shape table (data/batch_generator buckets, so serve-time batches hit warm jit-cache shapes). Counted as real rows x bucketed width — the same --mini-batch-words semantics training uses; the realized device batch can exceed it by the row snap-up to the batch multiple. 0 = derive from mini-batch x bucketed max-length (TPU extension)", "translate"),
     _f("metrics-port", int, 0, "Serve Prometheus /metrics + /healthz + /readyz on this port (0 = off): queue depth, batch fill ratio, padding waste, time-to-first-batch, end-to-end latency, shed/timeout counts; train/translate emit into the same registry (TPU extension)", "translate"),
     _f("dispatch-stall-timeout", float, 0.0, "marian-server liveness watchdog: if one device batch (translate_lines call) runs longer than this many seconds, fail its requests with an explicit retriable !!SERVER-RETRY reply and move the scheduler onto a fresh device worker instead of wedging the whole serving path behind the stuck call (0 = off; set comfortably above the worst legitimate batch decode time; see docs/ROBUSTNESS.md) (TPU extension)", "translate"),
+    _f("model-watch", float, 0.0, "marian-server zero-downtime lifecycle: poll <model>.bundles/ every N seconds for newly committed checkpoint bundles and hot-swap to them after an off-path warmup (compat check, load, jit compile, golden smoke) with no dropped requests; in-flight batches finish on the old model (0 = off; see docs/DEPLOYMENT.md) (TPU extension)", "translate"),
+    _f("canary-fraction", float, 0.0, "With --model-watch: route this fraction of device batches to a freshly warmed candidate (state 'canary') before promoting it to live; per-version error/latency metrics (marian_model_*) record both sides, and a canary whose failure rate or p99 regresses is auto-rolled-back (0 = swap immediately after warmup) (TPU extension)", "translate"),
+    _f("rollback-error-rate", float, 0.5, "With --model-watch: auto-rollback threshold on the windowed device-batch failure rate — a canary (or a freshly swapped live version with a retained rollback target) exceeding this rate is rolled back to the previous live version (docs/DEPLOYMENT.md) (TPU extension)", "translate"),
+    _f("rollback-p99-factor", float, 0.0, "With --model-watch: auto-rollback a canary whose p99 batch latency exceeds this factor x the live version's p99 (both over a recent-sample window; 0 = latency check off) (TPU extension)", "translate"),
+    _f("canary-min-batches", int, 8, "With --model-watch and --canary-fraction > 0: promote the canary to live after this many canary batches without tripping a rollback threshold (TPU extension)", "translate"),
+    _f("warmup-golden", str, "", "With --model-watch: file of golden source sentences (one per line) each candidate model must translate during off-path warmup before it can serve — forces jit compilation of the serving shapes and proves the checkpoint decodes (empty = a built-in probe set) (TPU extension)", "translate"),
     _f("fuse", bool, False, "(compat; XLA always fuses)", "translate"),
     _f("gemm-type", str, "float32", "float32, bfloat16, int8 (TPU AQT path), intgemm8/packed* map to int8", "translate"),
     _f("quantize-range", float, 0.0, "Quantization clip range in stddevs (0 = absmax)", "translate"),

@@ -77,6 +77,17 @@ CATALOG: Dict[str, str] = {
     "serving.translate": "on the device worker thread, before "
                          "translate_lines runs (hang mode feeds the "
                          "dispatch watchdog)",
+    "lifecycle.watch": "on the bundle-watcher thread, after a new "
+                       "committed bundle is discovered, before it is "
+                       "handed to the lifecycle controller",
+    "lifecycle.warmup": "before the candidate executor is built and "
+                        "golden-smoked (model load + jit compile happen "
+                        "past this point)",
+    "lifecycle.swap": "after a successful warmup, before dispatch is "
+                      "re-pointed at the warmed executor (the hot-swap "
+                      "commit point)",
+    "lifecycle.rollback": "before a canary/live rollback re-points "
+                          "dispatch at the previous live version",
 }
 
 

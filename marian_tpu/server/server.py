@@ -27,7 +27,9 @@ never a silent hang.
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional
+import json
+import os
+from typing import Dict, List, Optional
 
 from ..common import logging as log
 from ..data.batch_generator import bucket_length
@@ -35,6 +37,7 @@ from ..serving import metrics as msm
 from ..serving.admission import AdmissionController, Overloaded
 from ..serving.scheduler import (ContinuousScheduler, DispatchStalled,
                                  RequestTimeout)
+from ..training import bundle as bdl
 
 try:
     import websockets
@@ -92,10 +95,14 @@ def resolve_token_budget(options) -> int:
 class ServingApp:
     """One serving stack: TranslationService (or an injected
     translate_lines — tests, load generators) + continuous scheduler +
-    admission control + metrics endpoint. Shared by every transport."""
+    admission control + metrics endpoint + (with ``--model-watch``) the
+    zero-downtime model lifecycle (serving/lifecycle/ — ISSUE 5: bundle
+    watcher, warmed hot-swap, canary routing, auto-rollback). Shared by
+    every transport."""
 
     def __init__(self, options, translate_lines=None,
-                 registry: Optional[msm.Registry] = None):
+                 registry: Optional[msm.Registry] = None,
+                 executor_factory=None):
         self.options = options
         self.registry = registry if registry is not None else msm.REGISTRY
         budget = resolve_token_budget(options)
@@ -128,15 +135,174 @@ class ServingApp:
         self.request_timeout = float(options.get("request-timeout", 0) or 0)
         self.metrics_server: Optional[msm.MetricsServer] = None
         self._started = False
+        # zero-downtime lifecycle (--model-watch SECONDS): registry +
+        # watcher + warmup + swap controller over <model>.bundles/
+        self.lifecycle = None
+        self.watcher = None
+        watch_s = float(options.get("model-watch", 0) or 0)
+        if watch_s > 0:
+            self._init_lifecycle(watch_s, translate_lines,
+                                 executor_factory)
+
+    def _model_path(self) -> str:
+        models = self.options.get("models", []) or []
+        return str(models[0] if models
+                   else self.options.get("model", "") or "")
+
+    @staticmethod
+    def _adopt_boot_bundle(model_path: str, valid):
+        """Which committed bundle IS the flat (published) model file?
+        Same inode in the normal hardlink-publish case; otherwise ONE
+        content hash of the flat file compared against each manifest's
+        recorded member sha256 (copy-fallback publish). None when it
+        matches no bundle (stale publish, hand-copied model)."""
+        base = os.path.basename(model_path)
+        for b in reversed(valid):
+            try:
+                if os.path.samefile(model_path,
+                                    os.path.join(b.bundle_dir, base)):
+                    return b
+            except OSError:
+                continue
+        try:
+            flat_sha = bdl.file_sha256(model_path)
+        except OSError:
+            return None
+        for b in reversed(valid):
+            rec = (b.manifest or {}).get("members", {}).get(base) or {}
+            if rec.get("sha256") == flat_sha:
+                return b
+        return None
+
+    def _init_lifecycle(self, interval: float, boot_translate,
+                        executor_factory) -> None:
+        from ..serving.lifecycle import (BundleWatcher, SwapController,
+                                         load_golden, scan_bundles)
+        model_path = self._model_path()
+        if not model_path:
+            log.warn("--model-watch: no model path to watch; lifecycle "
+                     "disabled")
+            return
+        factory = executor_factory or self._bundle_executor_factory
+        self.lifecycle = SwapController(
+            executor_factory=factory,
+            metrics_registry=self.registry,
+            canary_fraction=float(
+                self.options.get("canary-fraction", 0) or 0),
+            rollback_error_rate=float(
+                self.options.get("rollback-error-rate", 0.5) or 0.5),
+            rollback_p99_factor=float(
+                self.options.get("rollback-p99-factor", 0) or 0),
+            canary_min_batches=int(
+                self.options.get("canary-min-batches", 8) or 8),
+            golden=load_golden(
+                self.options.get("warmup-golden", "") or None))
+        # seed the boot model as the live version. The flat model file is
+        # NORMALLY the published view of the newest valid bundle — but
+        # only when it verifiably IS that bundle's member (a crash
+        # between bundle commit and flat publish, or a hand-copied
+        # model, leaves the flat file older). Adopt the seq of the
+        # bundle the flat file actually matches, so the watcher warms +
+        # swaps to anything newer instead of silently serving stale
+        # weights labeled with the newest bundle's name.
+        boot_seq, boot_name, boot_compat = 0, "boot", None
+        valid = [b for b in scan_bundles(model_path) if b.ok]
+        adopted = self._adopt_boot_bundle(model_path, valid)
+        if adopted is not None:
+            boot_seq = adopted.seq
+            boot_name = os.path.basename(adopted.bundle_dir)
+            boot_compat = bdl.manifest_compat(adopted.manifest)
+            if adopted is not valid[-1]:
+                log.warn("--model-watch: boot model {} matches {} but "
+                         "newer committed bundles exist (stale publish?); "
+                         "the watcher will hot-swap to the newest",
+                         model_path, boot_name)
+        elif valid:
+            # valid bundles exist but the flat file matches none of them:
+            # seed one seq below the newest so the watcher ingests it
+            boot_seq = valid[-1].seq - 1
+            log.warn("--model-watch: boot model {} matches no committed "
+                     "bundle; seeding as '{}' (seq {}) so the newest "
+                     "bundle is warmed and swapped in", model_path,
+                     boot_name, boot_seq)
+        if boot_compat is None and self.service is not None:
+            opts = self.service.translator.options
+            boot_compat = bdl.compat_block(
+                opts, list(opts.get("vocabs", None) or []))
+        self.lifecycle.seed_live(boot_seq, boot_name, boot_translate,
+                                 compat=boot_compat)
+        self.scheduler.translate_lines = self.lifecycle.route
+        self.scheduler.version_fn = self.lifecycle.live_version_name
+        self.watcher = BundleWatcher(bdl.bundle_root(model_path),
+                                     self.lifecycle.ingest,
+                                     interval=interval,
+                                     last_seq=boot_seq)
+        # same-process trainer (online learning): commits push the
+        # watcher instead of waiting out the poll interval
+        bdl.add_commit_hook(self._on_bundle_commit)
+
+    def _on_bundle_commit(self, model_path: str, bundle_dir: str,
+                          manifest) -> None:
+        if self.watcher is not None \
+                and os.path.dirname(os.path.abspath(bundle_dir)) \
+                == os.path.abspath(self.watcher.root):
+            self.watcher.notify()
+
+    def _bundle_executor_factory(self, bundle_dir: str, manifest):
+        """Build a fresh TranslationService against a bundle's model
+        member (jit caches and all — warmed off the serving path, then
+        swapped in whole)."""
+        member = os.path.basename(self._model_path())
+        bopts = self.options.with_(
+            models=[os.path.join(bundle_dir, member)])
+        return TranslationService(bopts).translate_lines
+
+    def _admin_routes(self) -> Dict:
+        """Lifecycle endpoints on the metrics port: GET /lifecyclez
+        (version table + health), POST /admin/pin | /admin/unpin |
+        /admin/rollback (operator verbs; docs/DEPLOYMENT.md)."""
+        lc = self.lifecycle
+
+        def _lifecyclez(method: str, query: str):
+            body = json.dumps(lc.status(), indent=1).encode() + b"\n"
+            return 200, body, "application/json"
+
+        def _verb(fn, name):
+            def handler(method: str, query: str):
+                if method != "POST":
+                    return (405, b"POST only\n", "text/plain")
+                ok = fn()
+                ok = True if ok is None else bool(ok)
+                body = json.dumps({"ok": ok, "verb": name,
+                                   "live": lc.live_version_name()}
+                                  ).encode() + b"\n"
+                return (200 if ok else 409, body, "application/json")
+            return handler
+
+        return {
+            "/lifecyclez": _lifecyclez,
+            "/admin/pin": _verb(lc.pin, "pin"),
+            "/admin/unpin": _verb(lc.unpin, "unpin"),
+            "/admin/rollback": _verb(lc.rollback, "rollback"),
+        }
 
     def ready(self) -> bool:
-        """/readyz: accepting traffic (started, not draining)."""
-        return self._started and not self.admission.draining
+        """/readyz: accepting traffic (started, not draining, and — with
+        the lifecycle — a warmed live version is routing; a replica
+        still warming its first model reads 503 so load balancers hold
+        traffic)."""
+        if not self._started or self.admission.draining:
+            return False
+        return self.lifecycle is None or self.lifecycle.has_live()
 
     async def start(self) -> None:
         self.scheduler.start()
+        routes = self._admin_routes() if self.lifecycle is not None \
+            else None
         self.metrics_server = msm.maybe_start_metrics_server(
-            self.options, ready_fn=self.ready)
+            self.options, ready_fn=self.ready, routes=routes)
+        if self.watcher is not None:
+            self.watcher.start()
         self._started = True
         log.info("Serving: token budget {} padded tokens/batch, queue "
                  "limit {} sentences, request timeout {}",
@@ -194,6 +360,10 @@ class ServingApp:
     def close_nowait(self) -> None:
         """Synchronous hard cleanup (cancelled contexts, test teardown)."""
         self._started = False
+        if self.watcher is not None:
+            bdl.remove_commit_hook(self._on_bundle_commit)
+            self.watcher.stop()
+            self.watcher = None
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None

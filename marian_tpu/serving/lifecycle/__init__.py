@@ -1,0 +1,33 @@
+"""Zero-downtime model lifecycle (ISSUE 5): the deployment control plane
+between the trainer's committed checkpoint bundles (training/bundle.py)
+and the continuous-batching scheduler (serving/scheduler.py).
+
+    train ──commit──► bundle ──watch──► warmup ──swap──► serve
+                        ▲                (off-path)  │
+                        └────────── rollback ◄───────┘
+
+- ``registry``   — ModelRegistry: per-version state machine
+  (staged → warming → canary → live → retired, + rejected/failed)
+- ``watcher``    — BundleWatcher: seq+mtime polling thread, no inotify
+- ``warmup``     — compat refusal, executor load, golden-set smoke
+- ``controller`` — SwapController: atomic between-batch re-pointing,
+  --canary-fraction routing, failure-rate/p99 auto-rollback, admin verbs
+
+Operator runbook: docs/DEPLOYMENT.md.
+"""
+
+from .controller import SwapController
+from .registry import (CANARY, FAILED, LIVE, REJECTED, RETIRED, STAGED,
+                       WARMING, BundleInfo, LifecycleError, ModelRegistry,
+                       ModelVersion, scan_bundles)
+from .warmup import (DEFAULT_GOLDEN, CompatMismatch, WarmupError,
+                     load_golden)
+from .watcher import BundleWatcher
+
+__all__ = [
+    "SwapController", "BundleWatcher",
+    "ModelRegistry", "ModelVersion", "BundleInfo", "LifecycleError",
+    "scan_bundles",
+    "STAGED", "WARMING", "CANARY", "LIVE", "RETIRED", "FAILED", "REJECTED",
+    "CompatMismatch", "WarmupError", "DEFAULT_GOLDEN", "load_golden",
+]

@@ -1,0 +1,115 @@
+"""Warmup pipeline — turn a committed bundle into a serving-ready
+executor OFF the serving path (ISSUE 5 tentpole).
+
+Order of operations, cheapest refusal first:
+
+1. **Compat check** (no weights touched): the candidate manifest's
+   ``compat`` block (vocab sha256 + model-geometry config hash, written
+   by training/bundle.py since manifest v2) must match the live
+   version's. A mismatched vocabulary or geometry would serve garbage
+   tokens or crash inside the jitted step mid-traffic — refuse here,
+   while the refusal costs a dict comparison. v1 manifests carry no
+   compat block and are accepted with a warning (documented fallback).
+2. **Load**: ``executor_factory(bundle_dir, manifest)`` builds a fresh
+   ``TranslationService``-style ``translate_lines`` callable against the
+   bundle's members (the server's factory re-reads model.npz; tests
+   inject stubs).
+3. **Golden smoke**: the executor translates the golden set
+   (``--warmup-golden`` file, or a built-in probe). This forces jit
+   compilation of the serving shapes AND proves the model actually
+   decodes — a checkpoint that loads but cannot run must never reach
+   dispatch. Output arity is checked against the input (the scheduler's
+   reply-routing invariant).
+
+Everything runs on the caller's thread (the watcher thread in the real
+wiring), so a multi-second model load + compile never stalls a batch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from ...common import faultpoints as fp
+from ...common import logging as log
+from ...training import bundle as bdl
+
+# Built-in golden probe when --warmup-golden is unset: short sentences in
+# the bucket widths serving traffic most commonly lands on. Unknown
+# tokens are fine — warmup proves the decode path runs, not quality.
+DEFAULT_GOLDEN = [
+    "hello",
+    "a b c d",
+    "the quick brown fox jumps over the lazy dog",
+]
+
+
+class WarmupError(RuntimeError):
+    """The candidate could not be warmed (load error, golden smoke
+    failure, bad output arity)."""
+
+
+class CompatMismatch(WarmupError):
+    """Refused before loading weights: the candidate's compat block
+    contradicts the live version's."""
+
+
+def load_golden(path: Optional[str]) -> List[str]:
+    """Golden source sentences from --warmup-golden (one per line, blank
+    lines dropped); the built-in probe set when unset. An unreadable
+    file is a hard error — a typo'd path silently warming with the
+    default would void the operator's golden-set contract."""
+    if not path:
+        return list(DEFAULT_GOLDEN)
+    with open(path, "r", encoding="utf-8") as fh:
+        lines = [ln.strip() for ln in fh]
+    lines = [ln for ln in lines if ln]
+    if not lines:
+        raise WarmupError(f"--warmup-golden {path} contains no sentences")
+    return lines
+
+
+def check_compat(candidate: Optional[Dict], live: Optional[Dict],
+                 name: str) -> None:
+    """Raise CompatMismatch on a declared mismatch; log the permissive
+    v1-manifest fallback so an operator can see an unchecked swap."""
+    ok, why = bdl.compat_ok(candidate, live)
+    if not ok:
+        raise CompatMismatch(f"bundle {name} is incompatible with the "
+                             f"live model: {why}")
+    if why:
+        log.warn("model lifecycle: {} — swap proceeds unchecked ({})",
+                 why, name)
+
+
+def warm_executor(bundle_dir: str, manifest: Optional[Dict],
+                  executor_factory: Callable[[str, Optional[Dict]],
+                                             Callable[[List[str]],
+                                                      List[str]]],
+                  golden: List[str]
+                  ) -> Callable[[List[str]], List[str]]:
+    """Steps 2+3: build the executor and golden-smoke it. Returns the
+    warmed ``translate_lines``; raises WarmupError on any failure."""
+    fp.fault_point("lifecycle.warmup")
+    t0 = time.perf_counter()
+    try:
+        executor = executor_factory(bundle_dir, manifest)
+    except Exception as e:  # noqa: BLE001 — any load error refuses the swap
+        raise WarmupError(f"executor load failed for {bundle_dir}: "
+                          f"{e}") from e
+    t_load = time.perf_counter()
+    try:
+        out = executor(list(golden))
+    except Exception as e:  # noqa: BLE001
+        raise WarmupError(f"golden-set smoke translation failed for "
+                          f"{bundle_dir}: {e}") from e
+    if not isinstance(out, (list, tuple)) or len(out) != len(golden):
+        raise WarmupError(
+            f"golden-set smoke returned {len(out) if isinstance(out, (list, tuple)) else type(out).__name__} "
+            f"outputs for {len(golden)} inputs ({bundle_dir}) — reply "
+            f"routing would misalign")
+    t_done = time.perf_counter()
+    log.info("model lifecycle: warmed {} (load {:.2f}s, golden smoke of "
+             "{} sentences {:.2f}s)", bundle_dir, t_load - t0,
+             len(golden), t_done - t_load)
+    return executor

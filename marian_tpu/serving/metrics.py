@@ -302,23 +302,37 @@ class MetricsServer:
     - /metrics — Prometheus text of the given registry.
     - /healthz — 200 as long as the process serves HTTP (liveness).
     - /readyz  — 200 only while ``ready_fn()`` is truthy (readiness: model
-      loaded, scheduler running, not draining); 503 otherwise, so load
-      balancers stop routing to a draining replica before shutdown.
+      loaded, warmed and live, scheduler running, not draining); 503
+      otherwise, so load balancers stop routing to a replica that is
+      draining — or still warming a model (ISSUE 5).
+    - ``routes`` — extra path handlers (the lifecycle's /lifecyclez state
+      dump and /admin/* verbs): ``path -> fn(method, query) ->
+      (status, body_bytes, content_type)``. GET and POST both dispatch
+      here; a raising handler is a 500, never a dead endpoint thread.
+      POST (the mutating admin verbs) is accepted from LOOPBACK peers
+      only — the scrape port is routinely opened cluster-wide for
+      Prometheus, and rollback/pin must not be a network-wide control
+      surface; operators ssh/port-forward to the replica
+      (docs/DEPLOYMENT.md).
 
     Port 0 binds an ephemeral port (tests); ``.port`` reports the bound one.
     """
 
     def __init__(self, port: int, registry: Optional[Registry] = None,
                  ready_fn: Optional[Callable[[], bool]] = None,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0",
+                 routes: Optional[Dict[str, Callable[[str, str],
+                                                     Tuple[int, bytes,
+                                                           str]]]] = None):
         self.registry = registry if registry is not None else REGISTRY
         self.ready_fn = ready_fn or (lambda: True)
+        self.routes = dict(routes or {})
         self._started = time.time()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = outer.registry.render().encode("utf-8")
                     self._send(200, body,
@@ -333,8 +347,29 @@ class MetricsServer:
                     self._send(200 if ready else 503,
                                b"ready\n" if ready else b"not ready\n",
                                "text/plain")
+                elif path in outer.routes:
+                    self._route(path, "GET", query)
                 else:
                     self._send(404, b"not found\n", "text/plain")
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                path, _, query = self.path.partition("?")
+                if self.client_address[0] not in ("127.0.0.1", "::1",
+                                                  "::ffff:127.0.0.1"):
+                    self._send(403, b"admin verbs are loopback-only\n",
+                               "text/plain")
+                elif path in outer.routes:
+                    self._route(path, "POST", query)
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+            def _route(self, path: str, method: str, query: str) -> None:
+                try:
+                    code, body, ctype = outer.routes[path](method, query)
+                except Exception as e:  # noqa: BLE001 — endpoint stays up
+                    code, body, ctype = (500, f"error: {e}\n".encode(),
+                                         "text/plain")
+                self._send(code, body, ctype)
 
             def _send(self, code: int, body: bytes, ctype: str) -> None:
                 self.send_response(code)
@@ -368,7 +403,8 @@ class MetricsServer:
 
 
 def maybe_start_metrics_server(options,
-                               ready_fn: Optional[Callable[[], bool]] = None
+                               ready_fn: Optional[Callable[[], bool]] = None,
+                               routes: Optional[Dict] = None
                                ) -> Optional[MetricsServer]:
     """--metrics-port PORT (0 = off): start the scrape endpoint for any
     long-running entry point (server, training). Failure to bind degrades
@@ -377,7 +413,7 @@ def maybe_start_metrics_server(options,
     if port <= 0:
         return None
     try:
-        return MetricsServer(port, ready_fn=ready_fn).start()
+        return MetricsServer(port, ready_fn=ready_fn, routes=routes).start()
     except OSError as e:
         log.warn("--metrics-port {}: failed to bind ({}); metrics endpoint "
                  "disabled", port, e)

@@ -112,8 +112,14 @@ class ContinuousScheduler:
                  length_fn: Callable[[str], int] = default_length_fn,
                  registry: Optional[msm.Registry] = None,
                  executor: Optional[concurrent.futures.Executor] = None,
-                 stall_timeout: float = 0.0):
+                 stall_timeout: float = 0.0,
+                 version_fn: Optional[Callable[[], str]] = None):
         self.translate_lines = translate_lines
+        # model-version label source for the outcome counter; the
+        # lifecycle SwapController installs its live_version_name here
+        # so dashboards can pin an outcome shift to the exact hot-swap
+        # that caused it (ISSUE 5). Read on the event-loop thread only.
+        self.version_fn = version_fn or (lambda: "unversioned")
         # --dispatch-stall-timeout: liveness watchdog over each device
         # call (0 = off). See _translate_units / _trip_watchdog.
         self.stall_timeout = max(0.0, float(stall_timeout))
@@ -202,6 +208,11 @@ class ContinuousScheduler:
             "marian_serving_watchdog_trips_total",
             "Device batches failed by the dispatch stall watchdog "
             "(--dispatch-stall-timeout)")
+        self.m_outcomes = r.counter(
+            "marian_serving_request_outcomes_total",
+            "Requests resolved, by outcome and the model version live at "
+            "resolution time (ok|failure|timeout|cancelled|stalled)",
+            labels=("outcome", "model_version"))
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -293,6 +304,7 @@ class ContinuousScheduler:
             # previously hung forever without a timeout)
             self.m_requests.inc()
             fut.set_result([])
+            self._outcome("ok")
             return fut
         deadline = now + timeout if timeout and timeout > 0 else None
         req = _Request(lines, fut, priority, now, deadline)
@@ -313,9 +325,19 @@ class ContinuousScheduler:
         self._wake.set()
         return fut
 
+    def _outcome(self, outcome: str) -> None:
+        """One request resolved; label with the live model version so a
+        swap-correlated outcome shift is visible per version."""
+        try:
+            version = str(self.version_fn())
+        except Exception:  # noqa: BLE001 — labeling must never fail a reply
+            version = "unknown"
+        self.m_outcomes.labels(outcome, version).inc()
+
     def _expire_request(self, req: _Request, loop) -> None:
         if not req.future.done():
             self.m_timeouts.inc()
+            self._outcome("timeout")
             req.future.set_exception(RequestTimeout(
                 f"request deadline expired after "
                 f"{(loop.time() - req.arrival):.3f}s "
@@ -324,6 +346,7 @@ class ContinuousScheduler:
     def _on_request_done(self, fut: "asyncio.Future", req: _Request) -> None:
         if fut.cancelled():
             self.m_cancelled.inc()
+            self._outcome("cancelled")
         # any units of this request still sitting in lanes are dead until
         # the next forming pass physically sweeps them — discount them
         # from the admission-visible depth IMMEDIATELY (a normal
@@ -524,6 +547,7 @@ class ContinuousScheduler:
                     self._trip_watchdog(call, len(units))
                     for u in units:
                         if not u.req.future.done():
+                            self._outcome("stalled")
                             u.req.future.set_exception(DispatchStalled(
                                 f"device batch stalled past "
                                 f"{self.stall_timeout}s — retry"))
@@ -541,6 +565,7 @@ class ContinuousScheduler:
                 u = units[0]
                 if not u.req.future.done():
                     self.m_failures.inc()
+                    self._outcome("failure")
                     log.error("translation error: {}", e)
                     u.req.future.set_exception(RuntimeError(str(e)))
                 return
@@ -566,3 +591,4 @@ class ContinuousScheduler:
             req.future.set_result([r if r is not None else ""
                                    for r in req.results])
             self.m_latency.observe(loop.time() - req.arrival)
+            self._outcome("ok")

@@ -30,6 +30,16 @@ to the last good bundle with a loud log line when the newest is damaged
 
 Fault points (``common/faultpoints.py``) cover every transition so the
 crash-resume tests and scripts/chaos.py can kill a save at each step.
+
+Manifest v2 (ISSUE 5) adds a ``compat`` block — vocab file names+sha256
+and a hash over the model-geometry config keys — so the serving lifecycle
+(serving/lifecycle/) can refuse an incompatible hot-swap WITHOUT loading
+weights. v1 manifests (no ``compat``) still validate and load; consumers
+get ``manifest_compat() -> None`` and must treat compatibility as
+unknown (serving warns instead of refusing — documented read-side
+fallback). ``add_commit_hook`` lets an in-process consumer (a serving
+lifecycle sharing the trainer's process in an online-learning setup) be
+notified of each committed bundle without polling the directory.
 """
 
 from __future__ import annotations
@@ -46,9 +56,24 @@ from ..common import logging as log
 
 BUNDLE_SUFFIX = ".bundles"
 MANIFEST_NAME = "MANIFEST.json"
-MANIFEST_VERSION = 1
+# v2: + "compat" block (vocab sha256 + geometry config hash). Readers
+# accept 1..MANIFEST_VERSION; see manifest_compat for the v1 fallback.
+MANIFEST_VERSION = 2
 _BUNDLE_RE = re.compile(r"^bundle-(\d{8})$")
 DEFAULT_KEEP = 3
+
+# Model-geometry keys hashed into compat["config_hash"]: two checkpoints
+# that differ in ANY of these cannot share one jitted serving program /
+# parameter tree, so a hot-swap between them must be refused up front.
+# Training hyperparameters (learn-rate, dropout...) deliberately excluded:
+# they change freely between bundles of one run.
+GEOMETRY_KEYS = (
+    "type", "dim-emb", "dim-rnn", "enc-depth", "dec-depth",
+    "transformer-heads", "transformer-dim-ffn",
+    "transformer-decoder-autoreg", "transformer-tied-layers",
+    "tied-embeddings", "tied-embeddings-src", "tied-embeddings-all",
+    "dim-vocabs",
+)
 
 
 class BundleError(RuntimeError):
@@ -80,12 +105,107 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def _sha256(path: str) -> str:
+def file_sha256(path: str) -> str:
+    """Chunked sha256 of a file — THE digest recorded in manifests;
+    consumers comparing against manifest hashes must use this (not a
+    reimplementation that could drift)."""
     h = hashlib.sha256()
     with open(path, "rb") as fh:
         for chunk in iter(lambda: fh.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+_sha256 = file_sha256          # internal call sites
+
+
+def compat_block(cfg, vocab_paths: Optional[List[str]] = None) -> Dict:
+    """Build the manifest ``compat`` block from a config mapping (any
+    object with ``.get(key, default)`` — a yaml dict or an Options).
+
+    ``config_hash`` covers GEOMETRY_KEYS only; ``vocabs`` records each
+    vocab file's basename + content sha256 (the PATH may legitimately
+    differ between the training and serving hosts — identity is the
+    bytes). A vocab file that does not exist on this host is recorded
+    without a hash and compared permissively."""
+    geo = {}
+    for k in GEOMETRY_KEYS:
+        v = cfg.get(k, None)
+        if v is not None:
+            geo[k] = v
+    cfg_hash = hashlib.sha256(
+        json.dumps(geo, sort_keys=True, default=str).encode()).hexdigest()
+    paths = vocab_paths if vocab_paths is not None \
+        else list(cfg.get("vocabs", None) or [])
+    vocabs = []
+    for p in paths:
+        entry: Dict = {"name": os.path.basename(str(p))}
+        if p and os.path.isfile(p):
+            entry["sha256"] = _sha256(p)
+        vocabs.append(entry)
+    return {"config_hash": cfg_hash, "vocabs": vocabs}
+
+
+def compat_hash(compat: Optional[Dict]) -> str:
+    """Short stable digest of a compat block — the ``marian_model_info``
+    label value dashboards correlate swaps with. 'none' for v1 manifests."""
+    if not compat:
+        return "none"
+    return hashlib.sha256(
+        json.dumps(compat, sort_keys=True).encode()).hexdigest()[:12]
+
+
+def manifest_compat(manifest: Optional[Dict]) -> Optional[Dict]:
+    """The compat block of a manifest, or None for v1 manifests (written
+    before MANIFEST_VERSION 2) — callers must treat None as 'unknown
+    compatibility', not as a mismatch (the documented v1 fallback)."""
+    if not manifest:
+        return None
+    return manifest.get("compat") or None
+
+
+def compat_ok(candidate: Optional[Dict], live: Optional[Dict]
+              ) -> Tuple[bool, str]:
+    """(compatible?, why). Either side unknown (v1 manifest / seeded boot
+    model without compat info) compares permissively with a stated
+    reason; a declared mismatch is a hard refusal."""
+    if candidate is None or live is None:
+        return True, "compat unknown on one side (v1 manifest) — " \
+                     "accepted permissively"
+    if candidate.get("config_hash") != live.get("config_hash"):
+        return False, "model-geometry config hash mismatch " \
+                      f"({compat_hash(candidate)} vs {compat_hash(live)})"
+    c_vocabs = candidate.get("vocabs") or []
+    l_vocabs = live.get("vocabs") or []
+    if len(c_vocabs) != len(l_vocabs):
+        return False, f"vocab count mismatch ({len(c_vocabs)} vs " \
+                      f"{len(l_vocabs)})"
+    for i, (cv, lv) in enumerate(zip(c_vocabs, l_vocabs)):
+        cs, ls = cv.get("sha256"), lv.get("sha256")
+        if cs and ls and cs != ls:
+            return False, f"vocab {i} ({cv.get('name')}) content differs " \
+                          f"(sha256 {cs[:12]} vs {ls[:12]})"
+    return True, ""
+
+
+# Commit notification hooks: called as hook(model_path, bundle_dir,
+# manifest) after a bundle is committed AND published. Lets an in-process
+# serving lifecycle ingest new bundles push-style instead of polling the
+# directory (the cross-process path stays the BundleWatcher's poll). A
+# raising hook is logged and skipped — a broken observer must never fail
+# a committed save.
+_COMMIT_HOOKS: List[Callable[[str, str, Dict], None]] = []
+
+
+def add_commit_hook(hook: Callable[[str, str, Dict], None]) -> None:
+    _COMMIT_HOOKS.append(hook)
+
+
+def remove_commit_hook(hook: Callable[[str, str, Dict], None]) -> None:
+    try:
+        _COMMIT_HOOKS.remove(hook)
+    except ValueError:
+        pass
 
 
 def list_bundles(root: str) -> List[str]:
@@ -106,13 +226,16 @@ def _next_seq(root: str) -> int:
 def write_bundle(model_path: str,
                  members: Dict[str, Callable[[str], None]],
                  keep: int = DEFAULT_KEEP,
-                 meta: Optional[Dict] = None) -> str:
+                 meta: Optional[Dict] = None,
+                 compat: Optional[Dict] = None) -> str:
     """Write one atomic bundle. ``members`` maps a member file name
     (relative, e.g. ``model.npz``) to a writer called with the absolute
     staging path. Returns the committed bundle directory.
 
     ``keep``: rotation depth (last N committed bundles survive; <1 keeps 1).
     ``meta``: extra JSON recorded in the manifest (update count etc.).
+    ``compat``: the v2 compatibility block (build with ``compat_block``) —
+    what serving/lifecycle/ checks before accepting a hot-swap.
     """
     root = bundle_root(model_path)
     # mkdir, NOT makedirs: a missing parent directory is the same loud
@@ -130,6 +253,8 @@ def write_bundle(model_path: str,
         "members": {},
         "meta": dict(meta or {}),
     }
+    if compat:
+        manifest["compat"] = compat
     try:
         for rel, write in members.items():
             fp.fault_point(_member_fault_name(rel))
@@ -164,6 +289,12 @@ def write_bundle(model_path: str,
     fp.fault_point("ckpt.publish")
     _publish(model_path, final, manifest)
     rotate(root, keep)
+    for hook in list(_COMMIT_HOOKS):
+        try:
+            hook(model_path, final, manifest)
+        except Exception as e:  # noqa: BLE001 — observers never fail a save
+            log.warn("bundle commit hook {} failed: {}",
+                     getattr(hook, "__name__", hook), e)
     return final
 
 
@@ -229,6 +360,12 @@ def validate_bundle(bundle_dir: str) -> Tuple[bool, str, Optional[Dict]]:
             manifest = json.load(fh)
     except (OSError, ValueError) as e:
         return False, f"manifest unreadable ({e})", None
+    version = int(manifest.get("version", 0) or 0)
+    if version < 1 or version > MANIFEST_VERSION:
+        # older readers must not half-understand a future layout; v1 (no
+        # compat block) stays fully readable — manifest_compat() → None
+        return False, (f"manifest version {version} unsupported "
+                       f"(this reader handles 1..{MANIFEST_VERSION})"), None
     members = manifest.get("members")
     if not isinstance(members, dict) or not members:
         return False, "manifest has no members", None

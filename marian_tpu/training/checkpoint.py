@@ -191,7 +191,8 @@ def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
     if state is not None:
         members[model_name + ".progress.yml"] = state.save
     committed = bdl.write_bundle(path, members, keep=keep_bundles,
-                                 meta=_bundle_meta(state))
+                                 meta=_bundle_meta(state),
+                                 compat=_compat_from_yaml(config_yaml))
     for p in extra_paths:
         # the no---overwrite '.iterN' copies are permanent numbered
         # params+config snapshots OUTSIDE rotation — plain atomic files
@@ -205,6 +206,25 @@ def _bundle_meta(state: Optional[TrainingState]) -> Dict[str, Any]:
     if state is None:
         return {}
     return {"batches": state.batches, "epochs": state.epochs}
+
+
+def _compat_from_yaml(config_yaml: str) -> Optional[Dict[str, Any]]:
+    """Manifest v2 compat block from the checkpoint-embedded config text
+    (geometry hash + vocab checksums — what serving/lifecycle/ checks
+    before accepting a hot-swap). A config that fails to parse degrades
+    to no compat block (a v1-style manifest), never a failed save."""
+    if not config_yaml:
+        return None
+    try:
+        import yaml
+        cfg = yaml.safe_load(config_yaml)
+        if not isinstance(cfg, dict):
+            return None
+        return bdl.compat_block(cfg)
+    except Exception as e:  # noqa: BLE001
+        log.warn("could not derive checkpoint compat block ({}); manifest "
+                 "will carry none", e)
+        return None
 
 
 def load_checkpoint(model_path: str, graph_group=None

@@ -21,6 +21,19 @@ preemption it simulates.
 Usage:
     python scripts/chaos.py --workdir /tmp/chaos --rounds 6 --seed 0
     python scripts/chaos.py ... --keep-going      # survey all failures
+
+Swap schedule (``--swap``, ISSUE 5): drills the SERVING side of the same
+contract. Per round: commit a base bundle, boot a real marian-server
+(TCP transport) with ``--model-watch`` armed to die at a randomized
+lifecycle fault point (watch / warmup / swap), commit a second bundle so
+the hot-swap path crosses the armed point, then verify
+
+  1. the kill landed (exit 117) while the server was serving;
+  2. NEVER TORN — every committed bundle still validates (the server
+     never writes bundles, but a torn read would surface here);
+  3. CLEAN RESTART — an un-faulted server restart comes up ready,
+     serves, and its live version is the newest committed bundle
+     (/lifecyclez agrees).
 """
 
 from __future__ import annotations
@@ -41,6 +54,10 @@ KILLABLE = [
     "ckpt.write.manifest", "ckpt.commit", "ckpt.publish",
     "ckpt.async.worker", "data.batch.next",
 ]
+# lifecycle points the --swap schedule kills a serving process at
+# (lifecycle.rollback is drilled in-process by tests/test_lifecycle.py —
+# a healthy swap never crosses it, so a kill there would never land here)
+KILLABLE_SWAP = ["lifecycle.watch", "lifecycle.warmup", "lifecycle.swap"]
 
 LINES = ["a b c d", "b c d e", "c d e f", "d e f g",
          "e f g a", "f g a b", "g a b c", "a c e g"] * 2
@@ -170,6 +187,271 @@ def final_digest(model_path: str) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# --swap mode: kill a serving process mid-hot-swap (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+_MAKE_MODEL_SNIPPET = r"""
+import sys
+import numpy as np
+import jax
+from marian_tpu.common import Options
+from marian_tpu.common import io as mio
+from marian_tpu.data.vocab import DefaultVocab
+from marian_tpu.models.encoder_decoder import create_model
+
+d = sys.argv[1]
+words = [f"w{i}" for i in range(20)]
+vocab = DefaultVocab.build([" ".join(words)])
+vocab.save(d + "/v.yml")
+opts = Options({"type": "transformer", "dim-emb": 16,
+                "transformer-heads": 2, "transformer-dim-ffn": 32,
+                "enc-depth": 1, "dec-depth": 1,
+                "tied-embeddings-all": True, "max-length": 16,
+                "precision": ["float32", "float32"], "seed": 2})
+model = create_model(opts, len(vocab), len(vocab), inference=True)
+params = model.init(jax.random.key(2))
+mio.save_model(d + "/m.npz", {k: np.asarray(v) for k, v in params.items()},
+               opts.as_yaml())
+"""
+
+_COMMIT_SNIPPET = r"""
+import sys
+import numpy as np
+import yaml
+from marian_tpu.common import io as mio
+from marian_tpu.training import bundle as bdl
+
+model_path = sys.argv[1]
+params, cfg_yaml = mio.load_model(model_path)
+# perturb so each committed version is distinguishable content
+params = {k: (v * 1.001 if np.issubdtype(np.asarray(v).dtype,
+                                         np.floating) else v)
+          for k, v in params.items()}
+members = {"m.npz": lambda p: mio.save_model(p, params, cfg_yaml)}
+compat = bdl.compat_block(yaml.safe_load(cfg_yaml) or {})
+print(bdl.write_bundle(model_path, members, compat=compat))
+"""
+
+_SERVER_SNIPPET = r"""
+import json, sys
+from marian_tpu.common import Options
+import marian_tpu.server.server as srv
+srv.HAVE_WS = False          # deterministic TCP transport for the driver
+srv.serve_main(Options(json.load(open(sys.argv[1]))))
+"""
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_snippet(snippet: str, arg: str, faults: str = "",
+                 timeout: int = 300) -> "subprocess.CompletedProcess":
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MARIAN_FAULTS", None)
+    if faults:
+        env["MARIAN_FAULTS"] = faults
+    return subprocess.run([sys.executable, "-c", snippet, arg], env=env,
+                          timeout=timeout, capture_output=True, text=True)
+
+
+def _tcp_request(port: int, text: str, timeout: float = 180.0) -> str:
+    import socket
+    payload = text.encode("utf-8")
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(b"MTPU %d\n" % len(payload) + payload)
+        buf = b""
+        while b"\n" not in buf:
+            chunk = s.recv(4096)
+            if not chunk:   # EOF: a kill point landed mid-request —
+                # surface it instead of busy-looping on b"" forever
+                raise ConnectionError("server closed mid-reply")
+            buf += chunk
+        header, _, rest = buf.partition(b"\n")
+        nbytes = int(header.split()[1])
+        while len(rest) < nbytes:
+            chunk = s.recv(4096)
+            if not chunk:
+                raise ConnectionError("server closed mid-reply")
+            rest += chunk
+    return rest[:nbytes].decode("utf-8")
+
+
+def _http_get(port: int, path: str, timeout: float = 5.0):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as fh:
+            return fh.status, fh.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except OSError:
+        return 0, b""
+
+
+def _wait_ready(proc: "subprocess.Popen", metrics_port: int,
+                deadline_s: float = 300.0) -> bool:
+    import time
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        if proc.poll() is not None:
+            return False
+        code, _ = _http_get(metrics_port, "/readyz", timeout=2)
+        if code == 200:
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def _start_server(d: str, port: int, metrics_port: int,
+                  faults: str = "") -> "subprocess.Popen":
+    cfg = {
+        "models": [os.path.join(d, "m.npz")],
+        "vocabs": [os.path.join(d, "v.yml"), os.path.join(d, "v.yml")],
+        "beam-size": 1, "max-length": 16, "mini-batch": 8,
+        "batch-token-budget": 128, "max-queue": 64,
+        "port": port, "metrics-port": metrics_port,
+        "model-watch": 0.2, "quiet": True,
+    }
+    cfg_path = os.path.join(d, "server.json")
+    with open(cfg_path, "w") as fh:
+        json.dump(cfg, fh)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MARIAN_FAULTS", None)
+    if faults:
+        env["MARIAN_FAULTS"] = faults
+    return subprocess.Popen([sys.executable, "-c", _SERVER_SNIPPET,
+                             cfg_path], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE)
+
+
+def _stop_server(proc: "subprocess.Popen") -> None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(10)
+    if proc.stderr is not None:
+        proc.stderr.close()
+
+
+def swap_round(r: int, point: str, workdir: str) -> list:
+    """One --swap round; returns a list of violation strings."""
+    d = os.path.join(workdir, f"swap{r:02d}")
+    shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d)
+    mp = os.path.join(d, "m.npz")
+    spec = f"{point}=kill@1"
+    print(f"  [{r:02d}] {spec}")
+
+    proc = _run_snippet(_MAKE_MODEL_SNIPPET, d)
+    if proc.returncode != 0:
+        return [f"model build failed: {proc.stderr.strip()[-300:]}"]
+    proc = _run_snippet(_COMMIT_SNIPPET, mp)
+    if proc.returncode != 0:
+        return [f"base bundle commit failed: {proc.stderr.strip()[-300:]}"]
+
+    port, metrics_port = _free_port(), _free_port()
+    server = _start_server(d, port, metrics_port, faults=spec)
+    violations = []
+    try:
+        if not _wait_ready(server, metrics_port):
+            return [f"armed server never became ready "
+                    f"(exit {server.poll()})"]
+        try:
+            reply = _tcp_request(port, "w3 w4 w5")
+        except OSError as e:
+            reply = f"!!connection error: {e}"
+        if reply.startswith("!!"):
+            violations.append(f"pre-swap request failed: {reply[:80]}")
+        # commit bundle 2: the watcher ingests it and crosses the armed
+        # lifecycle point — the server must die there (exit 117)
+        proc = _run_snippet(_COMMIT_SNIPPET, mp)
+        if proc.returncode != 0:
+            violations.append(f"swap bundle commit failed: "
+                              f"{proc.stderr.strip()[-300:]}")
+        try:
+            rc = server.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            violations.append("server survived the armed swap point "
+                              "(fault not crossed)")
+            rc = None
+        if rc is not None and rc != FAULT_EXIT_CODE:
+            violations.append(f"server exited {rc}, expected kill "
+                              f"{FAULT_EXIT_CODE}")
+        print(f"      kill run exit {rc}")
+    finally:
+        _stop_server(server)
+
+    violations += [f"torn bundle after mid-swap kill: {b}"
+                   for b in validate_bundles(mp)]
+
+    # clean restart: must come up ready on the newest committed bundle
+    server = _start_server(d, port, metrics_port)
+    try:
+        if not _wait_ready(server, metrics_port):
+            violations.append(f"restart never became ready "
+                              f"(exit {server.poll()})")
+        else:
+            try:
+                reply = _tcp_request(port, "w6 w7")
+            except OSError as e:
+                reply = f"!!connection error: {e}"
+            if reply.startswith("!!") or not reply.strip():
+                violations.append(f"post-restart request failed: "
+                                  f"{reply[:80]!r}")
+            code, body = _http_get(metrics_port, "/lifecyclez")
+            if code != 200:
+                violations.append(f"/lifecyclez returned {code}")
+            else:
+                state = json.loads(body)
+                live = [v for v in state["versions"]
+                        if v["state"] == "live"]
+                newest = max(v["seq"] for v in state["versions"])
+                if not live or live[0]["seq"] != newest:
+                    violations.append(
+                        f"restart live version {live} is not the newest "
+                        f"committed bundle (seq {newest})")
+                else:
+                    print(f"      restart live on bundle seq "
+                          f"{live[0]['seq']} (newest)")
+    finally:
+        _stop_server(server)
+    return violations
+
+
+def swap_main(args) -> int:
+    rng = random.Random(args.seed)
+    os.makedirs(args.workdir, exist_ok=True)
+    print(f"chaos --swap: seed {args.seed}, {args.rounds} rounds")
+    failures = 0
+    for r in range(args.rounds):
+        point = rng.choice(KILLABLE_SWAP)
+        violations = swap_round(r, point, args.workdir)
+        if violations:
+            failures += 1
+            for v in violations:
+                print(f"      VIOLATION: {v}")
+            if not args.keep_going:
+                break
+        else:
+            print("      ok: killed mid-swap, never torn, restarted on "
+                  "the newest bundle")
+    print(f"chaos --swap: {failures} failing round(s) out of "
+          f"{args.rounds} (seed {args.seed})")
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--workdir", required=True)
@@ -177,7 +459,12 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--keep-going", action="store_true",
                     help="run every round even after a violation")
+    ap.add_argument("--swap", action="store_true",
+                    help="serving-side schedule: kill a marian-server at "
+                         "randomized lifecycle points mid-hot-swap")
     args = ap.parse_args(argv)
+    if args.swap:
+        return swap_main(args)
 
     rng = random.Random(args.seed)
     os.makedirs(args.workdir, exist_ok=True)

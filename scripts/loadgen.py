@@ -19,6 +19,25 @@ Example (CPU-backed acceptance run):
         --batch-token-budget 1024 --max-queue 256 &
     python scripts/loadgen.py --port 8765 --metrics-port 9090 \\
         --clients 8 --requests 4 --sentences 4
+
+Streaming mode (``--duration N``, ISSUE 5): constant OPEN-LOOP arrival —
+``--rate`` requests/s are fired on schedule for N seconds regardless of
+completions, so a serving-side stall shows up as queued latency instead
+of quietly throttling the generator (closed-loop clients self-soothe).
+Latency is reported per ``--window``-second window (p50/p99/max), which
+is how a hot-swap under load becomes visible: a swap that costs anything
+shows as a one-window blip instead of averaging away over the run.
+
+Swap-under-load recipe (docs/DEPLOYMENT.md walks through it):
+
+    python -m marian_tpu.cli.marian_server --models m.npz \\
+        --vocabs v.yml v.yml --port 8765 --metrics-port 9090 \\
+        --model-watch 1 &
+    python scripts/loadgen.py --port 8765 --metrics-port 9090 \\
+        --duration 60 --rate 8 &
+    # mid-run: commit a new bundle (e.g. a training save) and watch the
+    # per-window table + the marian_lifecycle_swaps_total delta; zero
+    # failed requests and at most a one-window p99 blip is the contract.
 """
 
 from __future__ import annotations
@@ -129,6 +148,84 @@ def pct(vals, q):
     return vals[min(len(vals) - 1, int(q * len(vals)))]
 
 
+# ---------------------------------------------------------------------------
+# streaming (open-loop) mode: --duration N --rate R
+# ---------------------------------------------------------------------------
+
+async def run_stream(args, request_fn):
+    """Fire requests at a constant --rate for --duration seconds, start
+    times fixed by the schedule (open loop). Returns
+    [(t_start_rel, latency_s, kind)] with kind in ok/overloaded/timeout/
+    retry/other."""
+    results: list = []
+
+    async def fire(i: int):
+        text = "\n".join(make_sentence(i, i >> 3, s, args.words)
+                         for s in range(args.sentences))
+        rel = time.perf_counter() - t0
+        t = time.perf_counter()
+        try:
+            reply = await request_fn(args.host, args.port, text)
+        except Exception as e:  # noqa: BLE001
+            results.append((rel, time.perf_counter() - t, "other"))
+            if args.verbose:
+                print(f"req {i}: {e}", file=sys.stderr)
+            return
+        dt = time.perf_counter() - t
+        if reply.startswith("!!SERVER-OVERLOADED"):
+            kind = "overloaded"
+        elif reply.startswith("!!SERVER-TIMEOUT"):
+            kind = "timeout"
+        elif reply.startswith("!!SERVER-RETRY"):
+            kind = "retry"
+        else:
+            kind = "ok"
+        results.append((rel, dt, kind))
+
+    t0 = time.perf_counter()
+    tasks = []
+    i = 0
+    while True:
+        now = time.perf_counter() - t0
+        if now >= args.duration:
+            break
+        target = i / args.rate
+        if target >= args.duration:
+            break
+        if target > now:
+            await asyncio.sleep(target - now)
+        tasks.append(asyncio.ensure_future(fire(i)))
+        i += 1
+    if tasks:
+        await asyncio.gather(*tasks)
+    return results
+
+
+def report_windows(results, window_s: float) -> None:
+    """Per-window latency table keyed by request START time — a queued
+    request that started before a swap and resolved after it lands in
+    the window where its latency was incurred."""
+    if not results:
+        print("stream: no requests completed")
+        return
+    last = max(r[0] for r in results)
+    n_windows = int(last // window_s) + 1
+    print(f"{'window':>12} {'req':>5} {'ok':>5} {'shed':>5} {'err':>5} "
+          f"{'p50_ms':>8} {'p99_ms':>8} {'max_ms':>8}")
+    for w in range(n_windows):
+        rows = [r for r in results
+                if w * window_s <= r[0] < (w + 1) * window_s]
+        if not rows:
+            continue
+        lat = [dt for _, dt, kind in rows if kind == "ok"]
+        shed = sum(1 for r in rows if r[2] == "overloaded")
+        err = sum(1 for r in rows if r[2] in ("timeout", "retry", "other"))
+        print(f"[{w * window_s:4.0f}-{(w + 1) * window_s:4.0f}s)"
+              f" {len(rows):>5} {len(lat):>5} {shed:>5} {err:>5} "
+              f"{pct(lat, 0.50) * 1e3:>8.1f} {pct(lat, 0.99) * 1e3:>8.1f} "
+              f"{max(lat) * 1e3 if lat else float('nan'):>8.1f}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -145,6 +242,17 @@ def main(argv=None) -> int:
                     help="words per sentence")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="scrape /metrics before+after and report deltas")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="streaming mode: constant open-loop arrival for "
+                         "N seconds (replaces --clients/--requests)")
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="streaming mode arrival rate in requests/s")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="streaming mode: report p50/p99 per N-second "
+                         "window (a hot-swap under load shows as a "
+                         "window blip, not an averaged-away artifact)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print per-request transport errors")
     args = ap.parse_args(argv)
 
     transport = args.transport
@@ -158,6 +266,34 @@ def main(argv=None) -> int:
 
     before = scrape(args.host, args.metrics_port) if args.metrics_port \
         else {}
+    if args.duration > 0:
+        if args.rate <= 0:
+            ap.error("--duration streaming mode requires --rate > 0")
+        results = asyncio.run(run_stream(args, request_fn))
+        after = scrape(args.host, args.metrics_port) if args.metrics_port \
+            else {}
+        latencies = [dt for _, dt, kind in results if kind == "ok"]
+        errors = {"overloaded": sum(1 for r in results
+                                    if r[2] == "overloaded"),
+                  "timeout": sum(1 for r in results if r[2] == "timeout"),
+                  "other": sum(1 for r in results
+                               if r[2] in ("retry", "other"))}
+        wall = args.duration
+        n_ok = len(latencies)
+        print(f"transport={transport} stream duration={args.duration}s "
+              f"rate={args.rate}/s sentences/request={args.sentences}")
+        print(f"ok={n_ok} shed={errors['overloaded']} "
+              f"timeout={errors['timeout']} other_errors={errors['other']}")
+        report_windows(results, args.window)
+        if before or after:
+            swaps = _delta(before, after, "marian_lifecycle_swaps_total")
+            rollbacks = _delta(before, after,
+                               "marian_lifecycle_rollbacks_total")
+            if swaps or rollbacks:
+                print(f"server: swaps={swaps:.0f} rollbacks={rollbacks:.0f} "
+                      f"during the run")
+        _report_server_delta(before, after)
+        return 0 if n_ok and not errors["other"] else 1
     latencies, errors, wall = asyncio.run(run_clients(args, request_fn))
     after = scrape(args.host, args.metrics_port) if args.metrics_port \
         else {}
@@ -175,21 +311,26 @@ def main(argv=None) -> int:
         print(f"throughput {n_ok / wall:.2f} req/s "
               f"{n_ok * args.sentences / wall:.2f} sentences/s "
               f"(wall {wall:.2f}s)")
-    if before or after:
-        batches = _delta(before, after, "marian_serving_batches_total")
-        fill_sum = _delta(before, after,
-                          "marian_serving_batch_fill_ratio_sum")
-        fill_n = _delta(before, after,
-                        "marian_serving_batch_fill_ratio_count")
-        shed = _delta(before, after, "marian_serving_shed_total")
-        timeouts = _delta(before, after, "marian_serving_timeouts_total")
-        sent = _delta(before, after,
-                      "marian_serving_admitted_sentences_total")
-        print(f"server: batches={batches:.0f} "
-              f"sentences/batch={sent / batches if batches else 0:.2f} "
-              f"mean_fill={fill_sum / fill_n if fill_n else 0:.3f} "
-              f"shed={shed:.0f} timeouts={timeouts:.0f}")
+    _report_server_delta(before, after)
     return 0 if n_ok and not errors["other"] else 1
+
+
+def _report_server_delta(before: dict, after: dict) -> None:
+    if not (before or after):
+        return
+    batches = _delta(before, after, "marian_serving_batches_total")
+    fill_sum = _delta(before, after,
+                      "marian_serving_batch_fill_ratio_sum")
+    fill_n = _delta(before, after,
+                    "marian_serving_batch_fill_ratio_count")
+    shed = _delta(before, after, "marian_serving_shed_total")
+    timeouts = _delta(before, after, "marian_serving_timeouts_total")
+    sent = _delta(before, after,
+                  "marian_serving_admitted_sentences_total")
+    print(f"server: batches={batches:.0f} "
+          f"sentences/batch={sent / batches if batches else 0:.2f} "
+          f"mean_fill={fill_sum / fill_n if fill_n else 0:.3f} "
+          f"shed={shed:.0f} timeouts={timeouts:.0f}")
 
 
 if __name__ == "__main__":

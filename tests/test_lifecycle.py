@@ -1,0 +1,1000 @@
+"""Zero-downtime model lifecycle (marian_tpu/serving/lifecycle/ —
+ISSUE 5): registry state machine, bundle watcher, compat refusal, warmed
+hot-swap, canary routing + auto-rollback, admin verbs, and the
+end-to-end swap-under-traffic contract. Everything tier-1 runs with stub
+executors under JAX_PLATFORMS=cpu — no model, no device; the slow tier
+drills a real server subprocess killed mid-swap (scripts/chaos.py
+--swap)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common import faultpoints as fp
+from marian_tpu.serving import metrics as msm
+from marian_tpu.serving.lifecycle import (CANARY, FAILED, LIVE, REJECTED,
+                                          RETIRED, STAGED, WARMING,
+                                          BundleWatcher, LifecycleError,
+                                          ModelRegistry, SwapController,
+                                          WarmupError, load_golden,
+                                          scan_bundles)
+from marian_tpu.serving.scheduler import ContinuousScheduler
+from marian_tpu.training import bundle as bdl
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+GEO_A = {"type": "transformer", "dim-emb": 16, "enc-depth": 1}
+GEO_B = {"type": "transformer", "dim-emb": 32, "enc-depth": 1}
+
+
+def commit_bundle(model_path, tag="x", compat=None, member="m.npz"):
+    """One tiny committed bundle via the real commit protocol."""
+    def write(p):
+        with open(p, "w", encoding="utf-8") as fh:
+            fh.write(tag)
+    return bdl.write_bundle(str(model_path), {member: write},
+                            compat=compat)
+
+
+def tag_stub(tag):
+    def translate(lines):
+        return [f"{tag}:{ln}" for ln in lines]
+    return translate
+
+
+def seq_factory(calls=None):
+    """Executor factory tagging output with the bundle seq (b2:, b3:...)."""
+    def factory(bundle_dir, manifest):
+        if calls is not None:
+            calls.append(bundle_dir)
+        return tag_stub(f"b{manifest['seq']}")
+    return factory
+
+
+# ---------------------------------------------------------------------------
+# manifest v2: compat block + commit hooks (training/bundle.py satellites)
+# ---------------------------------------------------------------------------
+
+class TestManifestCompat:
+    def test_compat_block_and_hash(self, tmp_path):
+        v = tmp_path / "v.yml"
+        v.write_text('"</s>": 0\n')
+        a = bdl.compat_block(dict(GEO_A, vocabs=[str(v)]))
+        assert a["vocabs"][0]["name"] == "v.yml"
+        assert len(a["vocabs"][0]["sha256"]) == 64
+        assert bdl.compat_hash(a) != "none"
+        assert bdl.compat_hash(None) == "none"
+
+    def test_geometry_mismatch_refused(self):
+        ok, why = bdl.compat_ok(bdl.compat_block(GEO_A),
+                                bdl.compat_block(GEO_B))
+        assert not ok and "config hash" in why
+
+    def test_vocab_content_mismatch_refused(self, tmp_path):
+        va, vb = tmp_path / "va.yml", tmp_path / "vb.yml"
+        va.write_text('"</s>": 0\n')
+        vb.write_text('"</s>": 0\n"<unk>": 1\n')
+        a = bdl.compat_block(GEO_A, [str(va)])
+        b = bdl.compat_block(GEO_A, [str(vb)])
+        ok, why = bdl.compat_ok(a, b)
+        assert not ok and "vocab 0" in why
+
+    def test_v1_manifest_fallback_permissive(self):
+        # a v1 manifest has no compat block: manifest_compat -> None and
+        # the comparison is permissive (documented read-side fallback)
+        assert bdl.manifest_compat({"version": 1, "members": {}}) is None
+        ok, why = bdl.compat_ok(None, bdl.compat_block(GEO_A))
+        assert ok and "v1 manifest" in why
+
+    def test_write_records_compat_and_validates(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        compat = bdl.compat_block(GEO_A)
+        bdir = commit_bundle(mp, compat=compat)
+        ok, why, manifest = bdl.validate_bundle(bdir)
+        assert ok, why
+        assert manifest["version"] == bdl.MANIFEST_VERSION == 2
+        assert bdl.manifest_compat(manifest) == compat
+
+    def test_future_manifest_version_refused(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        bdir = commit_bundle(mp)
+        mpath = os.path.join(bdir, bdl.MANIFEST_NAME)
+        manifest = json.load(open(mpath))
+        manifest["version"] = 99
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+        ok, why, _ = bdl.validate_bundle(bdir)
+        assert not ok and "unsupported" in why
+
+    def test_commit_hook_fires_and_raising_hook_is_contained(self,
+                                                             tmp_path):
+        mp = str(tmp_path / "m.npz")
+        seen = []
+
+        def good(model_path, bundle_dir, manifest):
+            seen.append((model_path, bundle_dir, manifest["seq"]))
+
+        def bad(model_path, bundle_dir, manifest):
+            raise RuntimeError("observer bug")
+
+        bdl.add_commit_hook(bad)
+        bdl.add_commit_hook(good)
+        try:
+            bdir = commit_bundle(mp)
+        finally:
+            bdl.remove_commit_hook(bad)
+            bdl.remove_commit_hook(good)
+        assert seen == [(mp, bdir, 1)]   # bad hook contained, save landed
+        assert bdl.validate_bundle(bdir)[0]
+
+    def test_checkpoint_compat_from_yaml(self):
+        from marian_tpu.training.checkpoint import _compat_from_yaml
+        got = _compat_from_yaml("type: transformer\ndim-emb: 16\n")
+        assert got["config_hash"]
+        assert _compat_from_yaml("") is None
+        assert _compat_from_yaml(":::not yaml") is None
+
+
+# ---------------------------------------------------------------------------
+# registry state machine
+# ---------------------------------------------------------------------------
+
+class TestModelRegistry:
+    def test_full_lifecycle_path(self):
+        r = ModelRegistry()
+        r.register(1, "bundle-00000001")
+        for state in (WARMING, CANARY, LIVE, RETIRED, LIVE):
+            r.transition(1, state)
+        assert r.get(1).state == LIVE
+
+    @pytest.mark.parametrize("path,bad", [
+        ((), LIVE),                          # staged -> live skips warming
+        ((WARMING,), RETIRED),               # warming -> retired
+        ((WARMING, CANARY, FAILED), LIVE),   # failed is terminal
+        ((REJECTED,), WARMING),              # rejected is terminal
+        ((WARMING, LIVE, RETIRED), CANARY),  # retired only -> live
+    ])
+    def test_illegal_transitions_raise(self, path, bad):
+        r = ModelRegistry()
+        r.register(1, "b1")
+        for state in path:
+            r.transition(1, state)
+        with pytest.raises(LifecycleError, match="illegal transition"):
+            r.transition(1, bad)
+
+    def test_duplicate_register_raises_until_terminal(self):
+        r = ModelRegistry()
+        r.register(1, "b1")
+        with pytest.raises(LifecycleError, match="already registered"):
+            r.register(1, "b1")
+        r.transition(1, REJECTED)
+        r.register(1, "b1-retry")     # terminal states may be retried
+
+    def test_unknown_version_and_state(self):
+        r = ModelRegistry()
+        with pytest.raises(LifecycleError, match="unknown model version"):
+            r.transition(7, WARMING)
+        r.register(1, "b1")
+        with pytest.raises(LifecycleError, match="unknown lifecycle"):
+            r.transition(1, "zombie")
+
+    def test_snapshot_newest_first(self):
+        r = ModelRegistry()
+        r.register(1, "b1")
+        r.register(2, "b2")
+        rows = r.snapshot()
+        assert [row["seq"] for row in rows] == [2, 1]
+        assert rows[0]["state"] == STAGED
+
+    def test_scan_bundles_flags_damage(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        commit_bundle(mp, tag="one")
+        b2 = commit_bundle(mp, tag="two")
+        victim = os.path.join(b2, "m.npz")
+        os.chmod(victim, 0o644)
+        with open(victim, "w") as fh:
+            fh.write("corrupt")
+        infos = scan_bundles(mp)
+        assert [i.seq for i in infos] == [1, 2]
+        assert infos[0].ok and not infos[1].ok
+
+
+# ---------------------------------------------------------------------------
+# bundle watcher
+# ---------------------------------------------------------------------------
+
+class TestBundleWatcher:
+    def _watch(self, mp, got, **kw):
+        return BundleWatcher(bdl.bundle_root(str(mp)),
+                             lambda bdir, man: got.append((bdir,
+                                                           man["seq"])),
+                             **kw)
+
+    def test_picks_up_fresh_commit_once(self, tmp_path):
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got)
+        assert w.poll_now() is None            # no bundle root yet
+        bdir = commit_bundle(mp)
+        assert w.poll_now() == bdir
+        assert w.poll_now() is None            # no redelivery
+        assert got == [(bdir, 1)]
+
+    def test_newest_wins_across_a_gap(self, tmp_path):
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got)
+        commit_bundle(mp, tag="one")
+        commit_bundle(mp, tag="two")
+        w.poll_now()
+        assert [seq for _, seq in got] == [2]  # intermediate superseded
+
+    def test_damaged_newest_does_not_shadow_valid_older(self, tmp_path):
+        """Two bundles land between polls and the NEWEST is damaged: the
+        valid one below it must still be delivered (newest VALID wins) —
+        and a later higher seq is still picked up."""
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got)
+        b1 = commit_bundle(mp, tag="one")
+        b2 = commit_bundle(mp, tag="two")
+        victim = os.path.join(b2, "m.npz")
+        os.chmod(victim, 0o644)
+        with open(victim, "w") as fh:
+            fh.write("corrupt")
+        assert w.poll_now() == b1              # valid fallback delivered
+        b3 = commit_bundle(mp, tag="three")
+        assert w.poll_now() == b3
+        assert [seq for _, seq in got] == [1, 3]
+
+    def test_invalid_newest_skipped_next_seq_delivered(self, tmp_path):
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got)
+        b1 = commit_bundle(mp, tag="one")
+        victim = os.path.join(b1, "m.npz")
+        os.chmod(victim, 0o644)
+        with open(victim, "w") as fh:
+            fh.write("corrupt")
+        assert w.poll_now() is None            # damaged: skipped loudly
+        b2 = commit_bundle(mp, tag="two")
+        assert w.poll_now() == b2              # higher seq still lands
+        assert got == [(b2, 2)]
+
+    def test_thread_delivers_on_notify(self, tmp_path):
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got, interval=30.0)  # poll too slow to matter
+        w.start()
+        try:
+            commit_bundle(mp)
+            w.notify()
+            for _ in range(200):
+                if got:
+                    break
+                import time
+                time.sleep(0.01)
+        finally:
+            w.stop()
+        assert [seq for _, seq in got] == [1]
+
+    def test_injected_watch_fault_redelivers(self, tmp_path):
+        """lifecycle.watch=fail: a transient discovery failure must not
+        lose the bundle — the next poll re-delivers it."""
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got)
+        bdir = commit_bundle(mp)
+        with fp.active("lifecycle.watch=fail"):
+            with pytest.raises(fp.InjectedFault):
+                w.poll_now()
+        assert got == []
+        assert w.poll_now() == bdir            # re-delivered, not lost
+        assert got == [(bdir, 1)]
+
+    def test_same_tick_commit_not_skipped(self, tmp_path):
+        """A commit landing within the same filesystem-timestamp tick as
+        the recorded root mtime must still be discovered: while the
+        recorded mtime is recent, mtime equality is not trusted."""
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got)
+        commit_bundle(mp, tag="one")
+        w.poll_now()
+        b2 = commit_bundle(mp, tag="two")
+        # force the pathological case: root mtime identical to what the
+        # previous poll recorded (coarse-granularity filesystems)
+        os.utime(bdl.bundle_root(str(mp)),
+                 ns=(w._last_mtime_ns, w._last_mtime_ns))
+        assert w.poll_now() == b2
+        assert [seq for _, seq in got] == [1, 2]
+
+    def test_notify_defeats_stale_mtime_short_circuit(self, tmp_path):
+        """Once the recorded mtime is old, equality IS trusted — unless
+        notify() pushed, which must force a full listing."""
+        import time as _t
+        mp = tmp_path / "m.npz"
+        got = []
+        w = self._watch(mp, got)
+        root = bdl.bundle_root(str(mp))
+        old_ns = _t.time_ns() - 3_600 * 10**9   # an hour ago
+        commit_bundle(mp, tag="one")
+        os.utime(root, ns=(old_ns, old_ns))
+        w.poll_now()
+        b2 = commit_bundle(mp, tag="two")
+        os.utime(root, ns=(old_ns, old_ns))     # mtime looks unchanged
+        assert w.poll_now() is None             # stale + equal: skipped
+        w.notify()
+        assert w.poll_now() == b2               # pushed: full listing
+        assert [seq for _, seq in got] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# warmup + compat refusal + swap controller
+# ---------------------------------------------------------------------------
+
+def make_controller(factory=None, live_tag="v1", compat=None, reg=None,
+                    **kw):
+    ctrl = SwapController(factory or seq_factory(),
+                          metrics_registry=reg or msm.Registry(), **kw)
+    ctrl.seed_live(0, "boot", tag_stub(live_tag), compat=compat)
+    return ctrl
+
+
+class TestWarmupAndSwap:
+    def test_immediate_swap_after_warmup(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        reg = msm.Registry()
+        ctrl = make_controller(reg=reg)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == LIVE
+        assert ctrl.registry.get(0).state == RETIRED
+        assert ctrl.route(["x"]) == ["b1:x"]
+        assert reg.get("marian_lifecycle_swaps_total").value == 1
+        # marian_model_info: new version 1, retired boot version 0
+        text = reg.render()
+        assert 'marian_model_info{model_version="bundle-00000001"' in text
+        assert ctrl.live_version_name() == "bundle-00000001"
+
+    def test_compat_mismatch_refused_without_loading(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        calls = []
+        reg = msm.Registry()
+        ctrl = make_controller(factory=seq_factory(calls), reg=reg,
+                               compat=bdl.compat_block(GEO_A))
+        bdir = commit_bundle(mp, compat=bdl.compat_block(GEO_B))
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == REJECTED and "config hash" in v.error
+        assert calls == []             # refused BEFORE loading weights
+        assert ctrl.route(["x"]) == ["v1:x"]    # live untouched
+        assert reg.get("marian_lifecycle_rejects_total") \
+                  .labels("compat").value == 1
+
+    def test_v1_manifest_swaps_permissively(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        ctrl = make_controller(compat=bdl.compat_block(GEO_A))
+        bdir = commit_bundle(mp)               # no compat block (v1-style)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == LIVE                 # documented fallback
+
+    def test_warmup_failure_keeps_live(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        reg = msm.Registry()
+
+        def broken_factory(bundle_dir, manifest):
+            raise RuntimeError("weights will not load")
+
+        ctrl = make_controller(factory=broken_factory, reg=reg)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == FAILED and "will not load" in v.error
+        assert ctrl.route(["x"]) == ["v1:x"]
+        assert reg.get("marian_lifecycle_rejects_total") \
+                  .labels("warmup").value == 1
+
+    def test_golden_smoke_arity_failure_refuses(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        ctrl = make_controller(factory=lambda b, m: (lambda lines: ["one"]))
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == FAILED and "misalign" in v.error
+
+    def test_injected_warmup_fault_fails_candidate(self, tmp_path):
+        """lifecycle.warmup=fail: the candidate fails, the watcher loop
+        and the live version survive."""
+        mp = str(tmp_path / "m.npz")
+        ctrl = make_controller()
+        bdir = commit_bundle(mp)
+        with fp.active("lifecycle.warmup=fail"):
+            v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == FAILED and "injected fault" in v.error
+        assert ctrl.route(["x"]) == ["v1:x"]
+
+    def test_injected_swap_fault_fails_install_live_survives(self,
+                                                             tmp_path):
+        """lifecycle.swap=fail: a failure at the swap commit point leaves
+        the old live serving; a later bundle still swaps cleanly."""
+        mp = str(tmp_path / "m.npz")
+        reg = msm.Registry()
+        ctrl = make_controller(reg=reg)
+        b1 = commit_bundle(mp, tag="one")
+        with fp.active("lifecycle.swap=fail"):
+            v = ctrl.ingest(b1, bdl.validate_bundle(b1)[2])
+        assert v.state == FAILED
+        assert ctrl.route(["x"]) == ["v1:x"]
+        assert reg.get("marian_lifecycle_rejects_total") \
+                  .labels("install").value == 1
+        b2 = commit_bundle(mp, tag="two")
+        v2 = ctrl.ingest(b2, bdl.validate_bundle(b2)[2])
+        assert v2.state == LIVE
+        assert ctrl.route(["x"]) == ["b2:x"]
+
+    def test_warmup_golden_file_loads_and_empty_refused(self, tmp_path):
+        g = tmp_path / "golden.txt"
+        g.write_text("a b\n\nc d e\n")
+        assert load_golden(str(g)) == ["a b", "c d e"]
+        (tmp_path / "empty.txt").write_text("\n\n")
+        with pytest.raises(WarmupError, match="no sentences"):
+            load_golden(str(tmp_path / "empty.txt"))
+        assert load_golden(None)       # built-in probe set non-empty
+
+
+class TestCanary:
+    def test_canary_promotes_after_healthy_batches(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        reg = msm.Registry()
+        ctrl = make_controller(reg=reg, canary_fraction=0.5,
+                               canary_min_batches=4)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == CANARY
+        outs = [ctrl.route([f"s{i}"])[0] for i in range(16)]
+        assert v.state == LIVE                 # promoted
+        assert any(o.startswith("b1:") for o in outs)
+        assert any(o.startswith("v1:") for o in outs)   # split routing
+        assert ctrl.registry.get(0).state == RETIRED
+        assert reg.get("marian_model_requests_total") \
+                  .labels("bundle-00000001").value >= 4
+
+    def test_high_error_canary_rolls_back_with_zero_client_failures(
+            self, tmp_path):
+        """The acceptance-criterion property at unit level: an injected
+        high-error canary is auto-rolled-back; every batch still returns
+        a live-model answer (failed canary batches are re-served)."""
+        mp = str(tmp_path / "m.npz")
+        reg = msm.Registry()
+
+        def bad_factory(bundle_dir, manifest):
+            calls = {"n": 0}
+
+            def translate(lines):
+                if calls["n"]:          # golden smoke passes, traffic dies
+                    raise RuntimeError("canary decode explodes")
+                calls["n"] += 1
+                return list(lines)
+            return translate
+
+        ctrl = make_controller(factory=bad_factory, reg=reg,
+                               canary_fraction=1.0,
+                               rollback_error_rate=0.5,
+                               rollback_min_batches=2)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == CANARY
+        outs = [ctrl.route([f"s{i}"])[0] for i in range(8)]
+        assert all(o.startswith("v1:") for o in outs)   # zero failures
+        assert v.state == FAILED and "failure rate" in v.error
+        assert reg.get("marian_lifecycle_rollbacks_total").value == 1
+        assert reg.get("marian_model_errors_total") \
+                  .labels("bundle-00000001").value >= 2
+        # rolled back: canary no longer routed
+        assert ctrl.route(["after"])[0] == "v1:after"
+        assert ctrl.status()["canary"] is None
+
+    def test_injected_rollback_fault_retries_next_batch(self, tmp_path):
+        """lifecycle.rollback=fail@1: the first rollback attempt aborts
+        (routing stands), the next canary batch retries and lands it."""
+        mp = str(tmp_path / "m.npz")
+
+        def bad_factory(bundle_dir, manifest):
+            calls = {"n": 0}
+
+            def translate(lines):
+                if calls["n"]:
+                    raise RuntimeError("boom")
+                calls["n"] += 1
+                return list(lines)
+            return translate
+
+        ctrl = make_controller(factory=bad_factory, canary_fraction=1.0,
+                               rollback_min_batches=1)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        with fp.active("lifecycle.rollback=fail@1"):
+            assert ctrl.route(["a"]) == ["v1:a"]   # rollback aborted...
+            assert v.state == CANARY               # ...routing stands
+            assert ctrl.route(["b"]) == ["v1:b"]   # retry lands it
+            assert fp.hits("lifecycle.rollback") == 2
+        assert v.state == FAILED
+
+    def test_p99_regression_rolls_back(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        import time as _t
+
+        def slow_factory(bundle_dir, manifest):
+            calls = {"n": 0}
+
+            def translate(lines):
+                if calls["n"]:
+                    _t.sleep(0.03)       # ~30ms vs the live stub's ~0ms
+                calls["n"] += 1
+                return [f"slow:{ln}" for ln in lines]
+            return translate
+
+        ctrl = make_controller(factory=slow_factory, canary_fraction=0.5,
+                               canary_min_batches=10_000,
+                               rollback_p99_factor=3.0)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        for i in range(90):
+            ctrl.route([f"s{i}"])
+            if v.state == FAILED:
+                break
+        assert v.state == FAILED and "p99" in v.error
+
+    def test_regressed_live_rolls_back_to_previous(self, tmp_path):
+        """Post-swap safety net: a canary-less immediate swap whose new
+        live version starts failing rolls back to the retained previous
+        version (once)."""
+        mp = str(tmp_path / "m.npz")
+
+        def flaky_factory(bundle_dir, manifest):
+            calls = {"n": 0}
+
+            def translate(lines):
+                if calls["n"] >= 3:     # healthy through warmup + 2 batches
+                    raise RuntimeError("late regression")
+                calls["n"] += 1
+                return [f"b{manifest['seq']}:{ln}" for ln in lines]
+            return translate
+
+        ctrl = make_controller(factory=flaky_factory,
+                               rollback_min_batches=2)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == LIVE
+        outs = []
+        for i in range(8):
+            try:
+                outs.append(ctrl.route([f"s{i}"])[0])
+            except RuntimeError:
+                pass                    # failed batches surface normally
+        assert v.state == FAILED and "failure rate" in v.error
+        assert ctrl.registry.get(0).state == LIVE   # rolled back
+        assert ctrl.route(["after"])[0] == "v1:after"
+
+    def test_canary_error_on_promotion_eligible_batch_not_promoted(
+            self, tmp_path):
+        """A canary batch that ERRORS must never promote that canary in
+        the same evaluation — promotion before the re-serve would make
+        the failed canary live and turn the promised transparent retry
+        into a client-visible error."""
+        mp = str(tmp_path / "m.npz")
+
+        def once_bad_factory(bundle_dir, manifest):
+            calls = {"n": 0}
+
+            def translate(lines):
+                calls["n"] += 1
+                if calls["n"] == 2:      # golden smoke ok, 1st batch dies
+                    raise RuntimeError("transient canary failure")
+                return [f"b{manifest['seq']}:{ln}" for ln in lines]
+            return translate
+
+        ctrl = make_controller(factory=once_bad_factory,
+                               canary_fraction=1.0,
+                               canary_min_batches=1,
+                               rollback_error_rate=1.0,
+                               rollback_min_batches=2)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert v.state == CANARY
+        # errored batch: re-served on live, canary NOT promoted even
+        # though it already has canary_min_batches batches
+        assert ctrl.route(["a"]) == ["v1:a"]
+        assert v.state == CANARY
+        # the next HEALTHY batch promotes as usual
+        assert ctrl.route(["b"]) == ["b1:b"]
+        assert v.state == LIVE
+
+    def test_superseded_canary_retired_and_released(self, tmp_path):
+        """A newer candidate arriving mid-canary replaces it: the old
+        canary leaves routing terminally (no two versions reporting
+        marian_model_info=1 as canary) and drops its executor."""
+        mp = str(tmp_path / "m.npz")
+        ctrl = make_controller(canary_fraction=0.5,
+                               canary_min_batches=10_000)
+        b1 = commit_bundle(mp, tag="one")
+        v1 = ctrl.ingest(b1, bdl.validate_bundle(b1)[2])
+        assert v1.state == CANARY
+        b2 = commit_bundle(mp, tag="two")
+        v2 = ctrl.ingest(b2, bdl.validate_bundle(b2)[2])
+        assert v2.state == CANARY
+        assert v1.state == RETIRED and "superseded" in v1.error
+        assert v1.executor is None
+        st = ctrl.status()
+        assert st["canary"] == "bundle-00000002"
+        assert [r for r in st["versions"]
+                if r["state"] == CANARY] == [st["versions"][0]]
+        # routing is intact on both sides of the split
+        outs = {ctrl.route([f"s{i}"])[0].split(":")[0] for i in range(8)}
+        assert outs == {"v1", "b2"}
+
+    def test_executors_released_when_leaving_rollback_set(self, tmp_path):
+        """Only live + canary + the single rollback target stay warm:
+        every hot-swap must NOT leak the previous models' executors
+        (weeks of swaps would otherwise accumulate whole models)."""
+        mp = str(tmp_path / "m.npz")
+        ctrl = make_controller()
+        boot = ctrl.registry.get(0)
+        for tag in ("one", "two", "three"):
+            bdir = commit_bundle(mp, tag=tag)
+            ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert ctrl.registry.get(3).state == LIVE
+        assert ctrl.registry.get(2).state == RETIRED
+        assert ctrl.registry.get(2).executor is not None  # rollback target
+        assert ctrl.registry.get(1).executor is None      # dropped
+        assert boot.executor is None                      # dropped
+        assert ctrl.route(["x"]) == ["b3:x"]
+
+    def test_failed_canary_executor_released(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+
+        def bad_factory(bundle_dir, manifest):
+            calls = {"n": 0}
+
+            def translate(lines):
+                if calls["n"]:
+                    raise RuntimeError("boom")
+                calls["n"] += 1
+                return list(lines)
+            return translate
+
+        ctrl = make_controller(factory=bad_factory, canary_fraction=1.0,
+                               rollback_min_batches=1)
+        bdir = commit_bundle(mp)
+        v = ctrl.ingest(bdir, bdl.validate_bundle(bdir)[2])
+        assert ctrl.route(["a"]) == ["v1:a"]
+        assert v.state == FAILED
+        assert v.executor is None
+
+
+# ---------------------------------------------------------------------------
+# admin verbs + /lifecyclez + readyz (server wiring)
+# ---------------------------------------------------------------------------
+
+def make_app(tmp_path, translate=None, **opt):
+    from marian_tpu.server.server import ServingApp
+    base = {"batch-token-budget": 256, "max-queue": 512,
+            "request-timeout": 0.0, "metrics-port": 0,
+            "models": [str(tmp_path / "m.npz")], "model-watch": 0.05}
+    base.update(opt)
+    return ServingApp(Options(base),
+                      translate_lines=translate or tag_stub("v1"),
+                      registry=msm.Registry(),
+                      executor_factory=seq_factory())
+
+
+class TestAdminAndReadiness:
+    def test_lifecyclez_and_admin_verbs_over_http(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+
+        async def scenario():
+            app = make_app(tmp_path)
+            await app.start()
+            srv = msm.MetricsServer(0, registry=app.registry,
+                                    ready_fn=app.ready,
+                                    routes=app._admin_routes()).start()
+            base = f"http://127.0.0.1:{srv.port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=5) as fh:
+                    return fh.status, fh.read()
+
+            def post(path):
+                req = urllib.request.Request(base + path, data=b"",
+                                             method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=5) as fh:
+                        return fh.status, fh.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+
+            try:
+                code, body = get("/lifecyclez")
+                state = json.loads(body)
+                assert code == 200 and state["live"] == "boot"
+                assert state["versions"][0]["state"] == "live"
+                # GET on a verb is refused
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    get("/admin/pin")
+                assert ei.value.code == 405
+                # nothing to roll back to yet -> 409, not a crash
+                assert post("/admin/rollback")[0] == 409
+                # pin -> a fresh commit is rejected, live unchanged
+                code, body = post("/admin/pin")
+                assert code == 200 and json.loads(body)["ok"]
+                bdir = commit_bundle(mp)
+                app.lifecycle.ingest(bdir, bdl.validate_bundle(bdir)[2])
+                assert app.lifecycle.registry.get(1).state == REJECTED
+                assert json.loads(get("/lifecyclez")[1])["pinned"]
+                # unpin -> the NEXT commit swaps in
+                assert post("/admin/unpin")[0] == 200
+                b2 = commit_bundle(mp, tag="two")
+                app.lifecycle.ingest(b2, bdl.validate_bundle(b2)[2])
+                assert json.loads(get("/lifecyclez")[1])["live"] \
+                    == "bundle-00000002"
+                # manual rollback flips to the retained previous version
+                code, body = post("/admin/rollback")
+                assert code == 200 and json.loads(body)["live"] == "boot"
+                # and is REVERSIBLE: the displaced version stays retained
+                # as the rollback target, so a second verb flips back
+                code, body = post("/admin/rollback")
+                assert code == 200 and json.loads(body)["live"] \
+                    == "bundle-00000002"
+            finally:
+                srv.close()
+                await app.shutdown(drain_timeout=2.0)
+
+        run(scenario())
+
+    def test_readyz_reflects_lifecycle_liveness(self, tmp_path):
+        async def scenario():
+            app = make_app(tmp_path)
+            assert not app.ready()          # not started yet
+            await app.start()
+            assert app.ready()              # seeded live version
+            app.admission.begin_drain()
+            assert not app.ready()
+            await app.shutdown(drain_timeout=2.0)
+
+        run(scenario())
+
+    def test_boot_adopts_newest_bundle_seq(self, tmp_path):
+        mp = str(tmp_path / "m.npz")
+        compat = bdl.compat_block(GEO_A)
+        commit_bundle(mp, tag="one", compat=compat)
+
+        async def scenario():
+            app = make_app(tmp_path)
+            await app.start()
+            try:
+                st = app.lifecycle.status()
+                assert st["live"] == "bundle-00000001"
+                # the watcher must NOT re-ingest the boot bundle
+                assert app.watcher.poll_now() is None
+                # and the boot compat chain came from the manifest
+                assert app.lifecycle.registry.get(1).compat == compat
+            finally:
+                await app.shutdown(drain_timeout=2.0)
+
+        run(scenario())
+
+    def test_boot_with_stale_publish_swaps_to_newest(self, tmp_path):
+        """A crash between bundle commit and flat publish (ckpt.publish)
+        leaves the flat model one version behind the newest bundle. Boot
+        must seed the version the flat file actually IS — not the newest
+        bundle's name — so the watcher warms and swaps to the newest
+        instead of silently serving stale weights with lying telemetry."""
+        mp = str(tmp_path / "m.npz")
+        compat = bdl.compat_block(GEO_A)
+        commit_bundle(mp, tag="one", compat=compat)
+        with fp.active("ckpt.publish=fail"):
+            with pytest.raises(fp.InjectedFault):
+                commit_bundle(mp, tag="two", compat=compat)
+        app = make_app(tmp_path)
+        try:
+            st = app.lifecycle.status()
+            assert st["live"] == "bundle-00000001"   # truthful label
+            assert app.watcher.poll_now() is not None
+            assert app.lifecycle.status()["live"] == "bundle-00000002"
+        finally:
+            app.close_nowait()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: hot swap under continuous traffic, zero failed requests
+# ---------------------------------------------------------------------------
+
+class TestEndToEndHotSwap:
+    def test_swap_under_load_zero_failures_version_flips(self, tmp_path):
+        """THE acceptance criterion: while requests flow continuously,
+        committing a new valid bundle flips the served version with zero
+        failed/shed requests — verified via replies, marian_model_info,
+        and the per-version outcome counters."""
+        mp = str(tmp_path / "m.npz")
+        compat = bdl.compat_block(GEO_A)
+        commit_bundle(mp, tag="one", compat=compat)   # boot bundle (seq 1)
+
+        async def scenario():
+            app = make_app(tmp_path)
+            await app.start()
+            replies, flipped_at = [], None
+            try:
+                for i in range(600):
+                    r = await app.handle_text(f"s{i}")
+                    replies.append(r)
+                    if i == 20:
+                        # the training side commits a new bundle; the
+                        # in-process commit hook nudges the watcher
+                        commit_bundle(mp, tag="two", compat=compat)
+                    if flipped_at is None and r.startswith("b2:"):
+                        flipped_at = i
+                    if flipped_at is not None and i >= flipped_at + 20:
+                        break
+                    await asyncio.sleep(0.002)
+            finally:
+                await app.shutdown(drain_timeout=5.0)
+            return app, replies, flipped_at
+
+        app, replies, flipped_at = run(scenario())
+        # zero failed / shed / empty replies across the swap
+        bad = [r for r in replies if r.startswith("!!") or not r]
+        assert bad == []
+        assert flipped_at is not None, "version never flipped under load"
+        # before the flip the boot model answered; after it, bundle 2
+        assert replies[0].startswith("v1:")
+        assert all(r.startswith("b2:") for r in replies[flipped_at:])
+        text = app.registry.render()
+        assert ('marian_model_info{model_version="bundle-00000002"'
+                in text)
+        # per-version outcome counters: every request resolved ok, and
+        # the post-swap ones carry the new version label
+        assert 'marian_serving_request_outcomes_total{outcome="ok"' \
+            in text
+        assert ('marian_serving_request_outcomes_total{outcome="ok",'
+                'model_version="bundle-00000002"}') in text
+        shed = app.registry.get("marian_serving_shed_total")
+        assert shed.labels("queue_full").value == 0
+        ok_total = sum(
+            c.value for key, c in
+            app.registry.get("marian_serving_request_outcomes_total")
+            ._children.items() if key[0] == "ok")
+        assert ok_total == len(replies)
+
+    def test_canary_swap_under_load_with_injected_failures(self,
+                                                           tmp_path):
+        """Acceptance, canary flavor: a high-error canary under live
+        traffic rolls back automatically; clients never see a failure."""
+        mp = str(tmp_path / "m.npz")
+        compat = bdl.compat_block(GEO_A)
+        commit_bundle(mp, tag="one", compat=compat)
+
+        def bad_factory(bundle_dir, manifest):
+            calls = {"n": 0}
+
+            def translate(lines):
+                if calls["n"]:
+                    raise RuntimeError("canary explodes under traffic")
+                calls["n"] += 1
+                return list(lines)
+            return translate
+
+        from marian_tpu.server.server import ServingApp
+        app = ServingApp(Options({
+            "batch-token-budget": 256, "max-queue": 512,
+            "request-timeout": 0.0, "metrics-port": 0,
+            "models": [mp], "model-watch": 0.05,
+            "canary-fraction": 1.0, "rollback-error-rate": 0.5,
+        }), translate_lines=tag_stub("v1"), registry=msm.Registry(),
+            executor_factory=bad_factory)
+
+        async def scenario():
+            await app.start()
+            replies = []
+            try:
+                for i in range(400):
+                    r = await app.handle_text(f"s{i}")
+                    replies.append(r)
+                    if i == 10:
+                        commit_bundle(mp, tag="two", compat=compat)
+                    if app.registry.get(
+                            "marian_lifecycle_rollbacks_total").value \
+                            and i >= 30:
+                        break
+                    await asyncio.sleep(0.002)
+            finally:
+                await app.shutdown(drain_timeout=5.0)
+            return replies
+
+        replies = run(scenario())
+        assert all(r.startswith("v1:") for r in replies)  # zero failures
+        assert app.registry.get(
+            "marian_lifecycle_rollbacks_total").value == 1
+        assert app.lifecycle.registry.get(2).state == FAILED
+        assert app.lifecycle.live_version_name() == "bundle-00000001"
+
+
+# ---------------------------------------------------------------------------
+# scheduler outcome labels (metrics satellite)
+# ---------------------------------------------------------------------------
+
+class TestOutcomeLabels:
+    def test_outcomes_labeled_with_version(self):
+        reg = msm.Registry()
+        state = {"fail": False}
+
+        def translate(lines):
+            if state["fail"]:
+                raise ValueError("boom")
+            return list(lines)
+
+        async def scenario():
+            s = ContinuousScheduler(translate, window_s=0, registry=reg,
+                                    version_fn=lambda: "vX")
+            s.start()
+            await s.submit(["ok"])
+            state["fail"] = True
+            with pytest.raises(RuntimeError):
+                await s.submit(["bad"])
+            await s.stop()
+
+        run(scenario())
+        text = reg.render()
+        assert ('marian_serving_request_outcomes_total{outcome="ok",'
+                'model_version="vX"} 1') in text
+        assert ('marian_serving_request_outcomes_total{outcome="failure",'
+                'model_version="vX"} 1') in text
+
+    def test_version_fn_failure_never_breaks_resolution(self):
+        reg = msm.Registry()
+
+        def broken_version():
+            raise RuntimeError("label source gone")
+
+        async def scenario():
+            s = ContinuousScheduler(lambda lines: list(lines), window_s=0,
+                                    registry=reg,
+                                    version_fn=broken_version)
+            s.start()
+            out = await s.submit(["x"])
+            await s.stop()
+            return out
+
+        assert run(scenario()) == ["x"]
+        assert ('marian_serving_request_outcomes_total{outcome="ok",'
+                'model_version="unknown"} 1') in reg.render()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: real server killed mid-swap (scripts/chaos.py --swap)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_swap_round_real_server(tmp_path):
+    """One randomized --swap chaos round against a REAL tiny-model server
+    subprocess: armed kill at a lifecycle point mid-hot-swap, bundles
+    never torn, clean restart serving the newest committed bundle."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "chaos.py"),
+         "--swap", "--workdir", str(tmp_path), "--rounds", "1",
+         "--seed", "1"],
+        capture_output=True, text=True, timeout=1500,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, (
+        f"chaos --swap failed:\n{proc.stdout[-3000:]}\n"
+        f"{proc.stderr[-2000:]}")
+    assert "0 failing round(s)" in proc.stdout
