@@ -67,6 +67,13 @@ import sys
 import tempfile
 import time
 
+# A final sync past this is a wedged tunnel, not training: the per-chunk
+# fences already bound legitimate residue to ~one step (~100ms), so
+# anything in the seconds means dt was dominated by a stall (r4 saw
+# 48-63s residues on rows reading ~1/10 the healthy number). Such a
+# round self-poisons its emitted row — see the `poisoned` stamp below.
+FINAL_SYNC_POISON_S = 5.0
+
 
 class Progress:
     """Crash-safe bench progress file: rewritten atomically after every
@@ -649,6 +656,19 @@ def main():
         # faster than the chip's physical peak = the measurement lied
         # somewhere; poison the row visibly rather than publish it
         result["suspect"] = "mfu>0.95: impossible — sync/accounting bug"
+    if sync_residue > FINAL_SYNC_POISON_S:
+        # a wedged final sync (r4 tunnel degradation: 48-63s residues on
+        # rows reading ~1/10 the healthy number) means dt is dominated by
+        # a stall, not by training — the row would skew the trajectory
+        # DOWN and hide real regressions behind "the tunnel was bad that
+        # day". Self-poison it: the driver still gets its artifact, but
+        # record_bench.py refuses to append poisoned rows to
+        # BENCH_HISTORY.jsonl and they can never become best.
+        result["poisoned"] = True
+        result["poisoned_reason"] = (
+            f"final_sync_s {result['final_sync_s']} > "
+            f"{FINAL_SYNC_POISON_S:g}: wedged final sync — round "
+            f"self-poisoned, not trajectory-worthy")
     progress.update(phase="done", result=result)
     if jax.default_backend() == "tpu":
         # every bench shape is now in the persistent cache for THIS

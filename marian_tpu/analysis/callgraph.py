@@ -1,0 +1,921 @@
+"""Project-wide call graph + lock model (ISSUE 6 tentpole).
+
+The per-file rules (PR 7) see one function at a time; the serving control
+plane's invariants — "warmup happens off the serving path", "swap is an
+atomic between-batches re-point", "the controller→registry→scheduler→
+metrics lock lattice is acyclic" — live in call chains ACROSS modules.
+This module builds the whole-program model those rules need, with the
+same zero-dependency discipline as core.py (stdlib ``ast`` only, never
+imports the analyzed code):
+
+- **Name/type index**: modules (dotted names derived from the repo
+  layout), classes (with base resolution), module-level functions and
+  instances, imports (absolute + relative, aliases, symbol imports).
+- **Minimal type inference**, just enough to resolve the receivers the
+  serving layer actually uses: ``self`` attributes assigned in any
+  method from constructor calls / annotated parameters / methods with
+  return annotations / ternaries; annotated parameters; module-level
+  ``NAME = ClassName()`` instances; ``Optional[X]`` and string
+  annotations.
+- **Lock discovery**: ``self.X = threading.Lock()/RLock()`` or
+  ``lockdep.make_lock/make_rlock(...)`` attributes (named
+  ``<OwningClass>.<attr>`` — the class whose method ASSIGNS the attr,
+  so subclasses share the base's lock identity), and module-level
+  ``NAME = threading.Lock()`` (named ``<module>.<NAME>``). The
+  ``lockdep`` name literal is kept for MT-LOCK-NAME cross-checking
+  against the runtime witness (common/lockdep.py).
+- **Per-function facts**: lock acquisitions (``with`` statements) and
+  every call site, each annotated with the LEXICALLY held lock set;
+  ``# mtlint: holds <lock>`` declarations seed entry-held sets.
+  Callable references passed as arguments (``threading.Thread(target=
+  self._run)``, ``loop.call_at(dl, self._expire, req)``,
+  ``run_in_executor(ex, fn)``, ``set_function(self.queued_units)``)
+  become SPAWN edges: reachable for reporting, but the spawning
+  thread's held locks do not propagate into them — the target runs on
+  another thread (or later on this one) where those locks are not held.
+- **Interprocedural held-set propagation**: a fixpoint over call edges
+  computes every function's may-be-held-at-entry lock set, with an
+  example caller chain kept per (function, lock) for diagnostics.
+- **Lock-order graph**: acquiring B while A is held adds edge A→B
+  (reentrant re-acquisition of the same lock name adds nothing — the
+  serving controller's RLock is reentrant by design). Cycles in this
+  graph are static deadlock candidates (MT-LOCK-ORDER); the DOT render
+  is ``python -m marian_tpu.analysis --format dot`` and the committed
+  snapshot docs/lock_order.dot.
+
+Known, documented limits (kept deliberately — each would cost far more
+machinery than its findings are worth in this tree): calls through
+locals bound to callables (``fn = self._foo; fn()``) are spawn edges,
+not inline calls; ``lock.acquire()`` outside a ``with`` is not modeled;
+lambdas contribute no body facts. The runtime lockdep witness exists
+exactly to keep these blind spots honest: an observed acquisition edge
+the static graph missed fails tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Source, ancestors, dotted_name, parent
+
+LOCK_CTORS = {"threading.Lock": "lock", "threading.RLock": "rlock",
+              "Lock": "lock", "RLock": "rlock"}
+LOCKDEP_CTORS = {"make_lock": "lock", "make_rlock": "rlock"}
+
+
+@dataclasses.dataclass
+class LockDecl:
+    qual: str                      # "Class.attr" or "pkg.mod._NAME"
+    kind: str                      # "lock" | "rlock"
+    rel: str
+    lineno: int
+    node: ast.AST
+    lockdep_name: Optional[str] = None   # literal given to lockdep.make_*
+    owner_class: Optional[str] = None
+    attr: Optional[str] = None
+
+
+@dataclasses.dataclass
+class CallSite:
+    node: ast.Call
+    name: str                      # dotted source text of the callee
+    targets: Tuple[str, ...]       # resolved FuncInfo quals (may be empty)
+    held: frozenset                # lexically held lock quals at the site
+    awaited: bool = False
+    spawn: bool = False
+
+
+@dataclasses.dataclass
+class Acquire:
+    lock: str
+    node: ast.AST
+    held: frozenset                # lexically held (excluding this lock)
+
+
+class FuncInfo:
+    __slots__ = ("qual", "node", "rel", "module", "cls", "declared_holds",
+                 "acquires", "calls", "param_types", "nested", "display")
+
+    def __init__(self, qual: str, node, rel: str, module: "ModuleInfo",
+                 cls: Optional["ClassInfo"]):
+        self.qual = qual
+        self.node = node
+        self.rel = rel
+        self.module = module
+        self.cls = cls
+        self.declared_holds: Set[str] = set()
+        self.acquires: List[Acquire] = []
+        self.calls: List[CallSite] = []
+        self.param_types: Dict[str, "ClassInfo"] = {}
+        self.nested: Dict[str, "FuncInfo"] = {}
+        # short human name for diagnostics: "Class.meth" or "func"
+        self.display = qual.split("::", 1)[1] if "::" in qual else qual
+
+
+class ClassInfo:
+    __slots__ = ("name", "rel", "module", "node", "base_names", "bases",
+                 "methods", "attr_types", "lock_attrs")
+
+    def __init__(self, name: str, rel: str, module: "ModuleInfo", node):
+        self.name = name
+        self.rel = rel
+        self.module = module
+        self.node = node
+        self.base_names: List[str] = []
+        self.bases: List["ClassInfo"] = []
+        self.methods: Dict[str, FuncInfo] = {}
+        self.attr_types: Dict[str, "ClassInfo"] = {}
+        self.lock_attrs: Dict[str, LockDecl] = {}
+
+    def mro(self) -> List["ClassInfo"]:
+        out, seen, stack = [], set(), [self]
+        while stack:
+            c = stack.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            out.append(c)
+            stack.extend(c.bases)
+        return out
+
+    def find_method(self, name: str) -> Optional[FuncInfo]:
+        for c in self.mro():
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def find_lock(self, attr: str) -> Optional[LockDecl]:
+        for c in self.mro():
+            if attr in c.lock_attrs:
+                return c.lock_attrs[attr]
+        return None
+
+    def find_attr_type(self, attr: str) -> Optional["ClassInfo"]:
+        for c in self.mro():
+            if attr in c.attr_types:
+                return c.attr_types[attr]
+        return None
+
+
+class ModuleInfo:
+    __slots__ = ("rel", "modname", "src", "classes", "functions",
+                 "instances", "module_locks", "imports")
+
+    def __init__(self, rel: str, modname: str, src: Source):
+        self.rel = rel
+        self.modname = modname
+        self.src = src
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.instances: Dict[str, ClassInfo] = {}   # NAME = ClassName()
+        self.module_locks: Dict[str, LockDecl] = {}
+        # alias -> ("module", dotted) | ("symbol", dotted_module, name)
+        self.imports: Dict[str, Tuple] = {}
+
+
+# the witness's own plumbing (lockdep._WITNESS_LOCK — deliberately
+# unwitnessed, held only around its edge-dict updates) is
+# instrumentation, not part of the modeled lattice: keep its locks out
+# of the graph and the committed docs/lock_order.dot
+_INSTRUMENTATION_MODULES = frozenset({"marian_tpu.common.lockdep"})
+
+
+def _modname(rel: str) -> str:
+    parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclasses.dataclass
+class LockEdge:
+    src: str
+    dst: str
+    rel: str                       # file of the acquire site
+    lineno: int
+    func: str                      # display name of the acquiring function
+    chain: str                     # example "A.m -> B.n" holder chain
+
+
+class CallGraph:
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}       # by dotted name
+        self.functions: Dict[str, FuncInfo] = {}       # by qual
+        self.locks: Dict[str, LockDecl] = {}           # by lock qual
+        # same qual declared by DIFFERENT classes (same class name in two
+        # modules): the graph and the runtime witness would silently fuse
+        # them into one node — MT-LOCK-NAME reports every extra declarant
+        self.lock_collisions: Dict[str, List[LockDecl]] = {}
+        self._entry_held: Optional[Dict[str, Set[str]]] = None
+        self._origin: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def build(cls, sources: Sequence[Source]) -> "CallGraph":
+        g = cls()
+        for src in sources:
+            g._index_module(src)
+        for mod in g.modules.values():
+            g._resolve_bases(mod)
+        # module-level instances first (they only need the class index),
+        # then class attrs (which may reference other modules' instances,
+        # e.g. `msm.REGISTRY`), then instances once more for any that
+        # needed a return annotation resolved via class attrs
+        for mod in g.modules.values():
+            g._infer_module_instances(mod)
+        for mod in g.modules.values():
+            g._infer_class_attrs(mod)
+        for mod in g.modules.values():
+            g._infer_module_instances(mod)
+        for fn in list(g.functions.values()):
+            g._extract_facts(fn)
+        g._propagate()
+        return g
+
+    def _index_module(self, src: Source) -> None:
+        mod = ModuleInfo(src.rel, _modname(src.rel), src)
+        self.modules[mod.modname] = mod
+        for node in src.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(mod, node)
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(mod, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(f"{mod.rel}::{node.name}", node, mod.rel,
+                              mod, None)
+                mod.functions[node.name] = fi
+                self.functions[fi.qual] = fi
+                self._index_nested(fi)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                kind = _lock_ctor_kind(node.value)
+                if kind and mod.modname not in _INSTRUMENTATION_MODULES:
+                    decl = LockDecl(qual=f"{mod.modname}.{name}", kind=kind,
+                                    rel=mod.rel, lineno=node.lineno,
+                                    node=node,
+                                    lockdep_name=_lockdep_literal(node.value))
+                    mod.module_locks[name] = decl
+                    self._register_lock(decl)
+
+    def _register_lock(self, decl: LockDecl) -> None:
+        """Claim a lock identity. Module-level quals embed the module
+        path and cannot collide; a class-attr qual (`Class.attr`) CAN —
+        two same-named classes in different files would merge into one
+        node in the order graph and the witness, turning independent
+        locks into false cycles (or vacuously whitelisting real ones).
+        First declaration wins; every later distinct one is recorded for
+        MT-LOCK-NAME."""
+        prev = self.locks.get(decl.qual)
+        if prev is not None:
+            if (prev.rel, prev.lineno) != (decl.rel, decl.lineno):
+                self.lock_collisions.setdefault(
+                    decl.qual, [prev]).append(decl)
+            return
+        self.locks[decl.qual] = decl
+
+    def _index_import(self, mod: ModuleInfo, node) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".", 1)[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".", 1)[0]
+                mod.imports[name] = ("module", target)
+            return
+        # ImportFrom: resolve the (possibly relative) base package
+        base = node.module or ""
+        if node.level:
+            pkg = mod.modname.split(".")
+            # a module's package is its dotted name minus the leaf;
+            # __init__ modules ARE their package
+            is_pkg = mod.rel.endswith("__init__.py")
+            up = node.level - (1 if is_pkg else 0)
+            pkg_parts = pkg if up == 0 else pkg[:-up] if up <= len(pkg) \
+                else []
+            base = ".".join(pkg_parts + ([base] if base else []))
+        for alias in node.names:
+            name = alias.asname or alias.name
+            dotted = f"{base}.{alias.name}" if base else alias.name
+            # `from a.b import c` may bind module a.b.c or symbol c of a.b
+            mod.imports[name] = ("from", base, alias.name, dotted)
+
+    def _index_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(node.name, mod.rel, mod, node)
+        for b in node.bases:
+            d = dotted_name(b)
+            if d:
+                ci.base_names.append(d)
+        mod.classes[node.name] = ci
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(f"{mod.rel}::{node.name}.{item.name}", item,
+                              mod.rel, mod, ci)
+                ci.methods[item.name] = fi
+                self.functions[fi.qual] = fi
+                self._index_nested(fi)
+
+    def _index_nested(self, parent: FuncInfo) -> None:
+        for item in ast.walk(parent.node):
+            if item is parent.node:
+                continue
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _enclosing_function(item) is parent.node:
+                fi = FuncInfo(f"{parent.qual}.<{item.name}>", item,
+                              parent.rel, parent.module, parent.cls)
+                parent.nested[item.name] = fi
+                self.functions[fi.qual] = fi
+                self._index_nested(fi)
+
+    # -- resolution ---------------------------------------------------------
+    def _lookup_class(self, name: str, mod: ModuleInfo
+                      ) -> Optional[ClassInfo]:
+        """Resolve a dotted class name as seen from `mod`."""
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        if not rest:
+            if head in mod.classes:
+                return mod.classes[head]
+            imp = mod.imports.get(head)
+            if imp and imp[0] == "from":
+                _, base, leaf, _dotted = imp
+                m = self.modules.get(base)
+                if m and leaf in m.classes:
+                    return m.classes[leaf]
+            return None
+        # module-qualified: reg.ModelRegistry, msm.Registry...
+        m = self._lookup_module(head, mod)
+        if m is not None:
+            return self._lookup_class(rest, m) if "." in rest \
+                else m.classes.get(rest)
+        return None
+
+    def _lookup_module(self, alias: str, mod: ModuleInfo
+                       ) -> Optional[ModuleInfo]:
+        imp = mod.imports.get(alias)
+        if imp is None:
+            return None
+        if imp[0] == "module":
+            return self.modules.get(imp[1])
+        _, base, leaf, dotted = imp
+        return self.modules.get(dotted)
+
+    def _resolve_bases(self, mod: ModuleInfo) -> None:
+        for ci in mod.classes.values():
+            for bname in ci.base_names:
+                b = self._lookup_class(bname, mod)
+                if b is not None:
+                    ci.bases.append(b)
+
+    def _resolve_annotation(self, ann, mod: ModuleInfo
+                            ) -> Optional[ClassInfo]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):
+            # Optional[X] / Union[X, None] / "Optional[reg.ModelVersion]"
+            sl = ann.slice
+            elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+            for e in elts:
+                c = self._resolve_annotation(e, mod)
+                if c is not None:
+                    return c
+            return None
+        d = dotted_name(ann)
+        return self._lookup_class(d, mod) if d else None
+
+    def _infer_module_instances(self, mod: ModuleInfo) -> None:
+        for node in mod.src.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = self._expr_type(node.value, mod, None, {}, {})
+                if t is not None:
+                    mod.instances[node.targets[0].id] = t
+
+    def _infer_class_attrs(self, mod: ModuleInfo) -> None:
+        for ci in mod.classes.values():
+            for meth in ci.methods.values():
+                self._infer_attrs_in(ci, meth, mod)
+
+    def _infer_attrs_in(self, ci: ClassInfo, meth: FuncInfo,
+                        mod: ModuleInfo) -> None:
+        """Walk one method in statement order, tracking local variable
+        types as they bind (the metrics pattern is `r = registry or
+        msm.REGISTRY; self.m_x = r.gauge(...)` — `r` must be typed
+        before the attr assignment resolves)."""
+        params = self._param_types(meth)
+        local_types: Dict[str, ClassInfo] = {}
+
+        def handle_assign(node) -> None:
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            rhs = node.value
+            for t in targets:
+                if isinstance(t, ast.Name) and rhs is not None:
+                    ty = self._expr_type(rhs, mod, ci, params, local_types)
+                    if ty is not None:
+                        local_types[t.id] = ty
+                    continue
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                kind = _lock_ctor_kind(rhs) if rhs is not None else None
+                if kind and t.attr not in ci.lock_attrs:
+                    decl = LockDecl(
+                        qual=f"{ci.name}.{t.attr}", kind=kind,
+                        rel=ci.rel, lineno=node.lineno, node=node,
+                        lockdep_name=_lockdep_literal(rhs),
+                        owner_class=ci.name, attr=t.attr)
+                    ci.lock_attrs[t.attr] = decl
+                    self._register_lock(decl)
+                    continue
+                ty = None
+                if isinstance(node, ast.AnnAssign):
+                    ty = self._resolve_annotation(node.annotation, mod)
+                if ty is None and rhs is not None:
+                    ty = self._expr_type(rhs, mod, ci, params, local_types)
+                if ty is not None and t.attr not in ci.attr_types:
+                    ci.attr_types[t.attr] = ty
+
+        def visit(node) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    handle_assign(child)
+                visit(child)
+
+        visit(meth.node)
+
+    def _param_types(self, fn: FuncInfo) -> Dict[str, ClassInfo]:
+        if fn.param_types:
+            return fn.param_types
+        args = fn.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            t = self._resolve_annotation(a.annotation, fn.module)
+            if t is not None:
+                fn.param_types[a.arg] = t
+        return fn.param_types
+
+    def _expr_type(self, expr, mod: ModuleInfo, cls: Optional[ClassInfo],
+                   params: Dict[str, ClassInfo],
+                   local_types: Dict[str, ClassInfo],
+                   depth: int = 0) -> Optional[ClassInfo]:
+        if depth > 6 or expr is None:
+            return None
+        if isinstance(expr, ast.IfExp):
+            return (self._expr_type(expr.body, mod, cls, params,
+                                    local_types, depth + 1)
+                    or self._expr_type(expr.orelse, mod, cls, params,
+                                       local_types, depth + 1))
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                t = self._expr_type(v, mod, cls, params, local_types,
+                                    depth + 1)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and cls is not None:
+                return cls
+            return (local_types.get(expr.id) or params.get(expr.id)
+                    or mod.instances.get(expr.id)
+                    or self._imported_instance(expr.id, mod))
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_type(expr.value, mod, cls, params,
+                                   local_types, depth + 1)
+            if base is not None:
+                return base.find_attr_type(expr.attr)
+            # module attribute: msm.REGISTRY
+            if isinstance(expr.value, ast.Name):
+                m = self._lookup_module(expr.value.id, mod)
+                if m is not None:
+                    return m.instances.get(expr.attr)
+            return None
+        if isinstance(expr, ast.Call):
+            callee = dotted_name(expr.func)
+            if callee:
+                c = self._lookup_class(callee, mod)
+                if c is not None:
+                    return c               # constructor call
+            targets = self._resolve_callable(expr.func, mod, cls, params,
+                                             local_types, depth + 1)
+            for q in targets:
+                f = self.functions.get(q)
+                if f is not None:
+                    ret = getattr(f.node, "returns", None)
+                    t = self._resolve_annotation(ret, f.module)
+                    if t is not None:
+                        return t
+            return None
+        return None
+
+    def _imported_instance(self, name: str, mod: ModuleInfo
+                           ) -> Optional[ClassInfo]:
+        imp = mod.imports.get(name)
+        if imp and imp[0] == "from":
+            _, base, leaf, dotted = imp
+            m = self.modules.get(base)
+            if m is not None:
+                return m.instances.get(leaf)
+        return None
+
+    def _resolve_callable(self, func, mod: ModuleInfo,
+                          cls: Optional[ClassInfo],
+                          params: Dict[str, ClassInfo],
+                          local_types: Dict[str, ClassInfo],
+                          depth: int = 0,
+                          owner: Optional[FuncInfo] = None
+                          ) -> Tuple[str, ...]:
+        """Resolve a callee expression to FuncInfo quals (usually 0-1)."""
+        if depth > 6:
+            return ()
+        if isinstance(func, ast.Name):
+            if owner is not None and func.id in owner.nested:
+                return (owner.nested[func.id].qual,)
+            if func.id in mod.functions:
+                return (mod.functions[func.id].qual,)
+            c = self._lookup_class(func.id, mod)
+            if c is not None:
+                init = c.find_method("__init__")
+                return (init.qual,) if init else ()
+            imp = mod.imports.get(func.id)
+            if imp and imp[0] == "from":
+                _, base, leaf, dotted = imp
+                m = self.modules.get(base)
+                if m and leaf in m.functions:
+                    return (m.functions[leaf].qual,)
+            return ()
+        if isinstance(func, ast.Attribute):
+            # super().__init__ / super().m
+            if isinstance(func.value, ast.Call) \
+                    and dotted_name(func.value.func) == "super" \
+                    and cls is not None and cls.bases:
+                m = cls.bases[0].find_method(func.attr)
+                return (m.qual,) if m else ()
+            base_t = self._expr_type(func.value, mod, cls, params,
+                                     local_types, depth + 1)
+            if base_t is not None:
+                m = base_t.find_method(func.attr)
+                return (m.qual,) if m else ()
+            if isinstance(func.value, ast.Name):
+                m = self._lookup_module(func.value.id, mod)
+                if m is not None:
+                    if func.attr in m.functions:
+                        return (m.functions[func.attr].qual,)
+                    c = m.classes.get(func.attr)
+                    if c is not None:
+                        init = c.find_method("__init__")
+                        return (init.qual,) if init else ()
+            return ()
+        return ()
+
+    # -- per-function fact extraction --------------------------------------
+    def _declared_holds(self, fn: FuncInfo) -> Set[str]:
+        from .rules.guarded_by import HOLDS_RE as holds_re
+        held: Set[str] = set()
+        src = fn.module.src
+        for line in (fn.node.lineno, fn.node.lineno - 1):
+            m = holds_re.search(src.comments.get(line, ""))
+            if m and fn.cls is not None:
+                decl = fn.cls.find_lock(m.group(1))
+                if decl is not None:
+                    held.add(decl.qual)
+        return held
+
+    def _lock_of_with_item(self, expr, fn: FuncInfo) -> Optional[str]:
+        d = dotted_name(expr)
+        if not d:
+            return None
+        mod, cls = fn.module, fn.cls
+        if d.startswith("self.") and cls is not None:
+            decl = cls.find_lock(d[len("self."):])
+            return decl.qual if decl else None
+        head, _, rest = d.partition(".")
+        if not rest:
+            if head in mod.module_locks:
+                return mod.module_locks[head].qual
+            imp = mod.imports.get(head)
+            if imp and imp[0] == "from":
+                _, base, leaf, dotted = imp
+                m = self.modules.get(base)
+                if m and leaf in m.module_locks:
+                    return m.module_locks[leaf].qual
+            return None
+        # obj.lockattr where obj's type is known (e.g. _STATE.lock), or
+        # mod.NAME for an imported module's lock
+        base_t = self._expr_type(ast.Name(id=head), mod, cls,
+                                 self._param_types(fn), {})
+        if base_t is not None and "." not in rest:
+            decl = base_t.find_lock(rest)
+            return decl.qual if decl else None
+        m = self._lookup_module(head, mod)
+        if m is not None and "." not in rest and rest in m.module_locks:
+            return m.module_locks[rest].qual
+        return None
+
+    def _extract_facts(self, fn: FuncInfo) -> None:
+        fn.declared_holds = self._declared_holds(fn)
+        params = self._param_types(fn)
+        mod, cls = fn.module, fn.cls
+        local_types: Dict[str, ClassInfo] = {}
+
+        def visit(node, held: frozenset):
+            for child in ast.iter_child_nodes(node):
+                dispatch(child, held)
+
+        def dispatch(child, held: frozenset):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return              # nested defs are their own FuncInfo
+            if isinstance(child, ast.Lambda):
+                return
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in child.items:
+                    lk = self._lock_of_with_item(item.context_expr, fn)
+                    if lk is not None:
+                        # recorded even when already held: lock_edges
+                        # skips re-acquires (edge-free), but
+                        # self_deadlocks() needs the site to flag a
+                        # plain-Lock re-acquire
+                        fn.acquires.append(
+                            Acquire(lk, item.context_expr,
+                                    frozenset(inner)))
+                        inner.add(lk)
+                    else:
+                        # a non-lock context expression evaluates BEFORE
+                        # later items' locks are acquired — only the
+                        # locks folded in so far are held around it
+                        # (`with open(p) as f, self._lock:` does not
+                        # open the file under the lock)
+                        dispatch(item.context_expr, frozenset(inner))
+                for stmt in child.body:
+                    dispatch(stmt, frozenset(inner))
+                return
+            if isinstance(child, ast.Assign) \
+                    and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                t = self._expr_type(child.value, mod, cls, params,
+                                    local_types)
+                if t is not None:
+                    local_types[child.targets[0].id] = t
+            if isinstance(child, ast.Call):
+                self._record_call(fn, child, held, params, local_types)
+            visit(child, held)
+
+        visit(fn.node, frozenset())
+
+    def _record_call(self, fn: FuncInfo, call: ast.Call, held: frozenset,
+                     params, local_types) -> None:
+        name = dotted_name(call.func) or ""
+        targets = self._resolve_callable(call.func, fn.module, fn.cls,
+                                         params, local_types, owner=fn)
+        awaited = isinstance(parent(call), ast.Await)
+        fn.calls.append(CallSite(node=call, name=name, targets=targets,
+                                 held=held, awaited=awaited))
+        # callable references passed as arguments (Thread targets, timer
+        # callbacks, executor submissions, gauge sample functions...)
+        # become spawn edges: reachable, but the caller's held locks do
+        # not flow in — the target runs on another thread or later
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                spawned = self._resolve_callable(
+                    arg, fn.module, fn.cls, params, local_types, owner=fn)
+                if spawned:
+                    fn.calls.append(CallSite(
+                        node=call, name=dotted_name(arg) or "",
+                        targets=spawned, held=held, spawn=True))
+
+    # -- interprocedural held-set propagation -------------------------------
+    def _propagate(self) -> None:
+        H: Dict[str, Set[str]] = {q: set(f.declared_holds)
+                                  for q, f in self.functions.items()}
+        origin: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for q, f in self.functions.items():
+            for lk in f.declared_holds:
+                origin.setdefault((q, lk), (q, f.node.lineno))
+        changed = True
+        while changed:
+            changed = False
+            for f in self.functions.values():
+                base = H[f.qual]
+                for site in f.calls:
+                    if site.spawn:
+                        continue
+                    contributed = base | set(site.held)
+                    if not contributed:
+                        continue
+                    for t in site.targets:
+                        tH = H.get(t)
+                        if tH is None:
+                            continue
+                        new = contributed - tH
+                        if new:
+                            tH.update(new)
+                            for lk in new:
+                                origin.setdefault(
+                                    (t, lk), (f.qual, site.node.lineno))
+                            changed = True
+        self._entry_held = H
+        self._origin = origin
+
+    def entry_held(self, qual: str) -> Set[str]:
+        assert self._entry_held is not None
+        return self._entry_held.get(qual, set())
+
+    def holder_chain(self, qual: str, lock: str, limit: int = 8) -> str:
+        """Example call chain explaining why `lock` may be held at entry
+        of `qual` — "A.m -> B.n" (empty when held lexically)."""
+        parts: List[str] = []
+        cur = qual
+        seen = set()
+        while limit > 0 and (cur, lock) in self._origin:
+            caller, _line = self._origin[(cur, lock)]
+            if caller == cur or caller in seen:
+                break
+            seen.add(caller)
+            f = self.functions.get(caller)
+            parts.append(f.display if f else caller)
+            cur = caller
+            limit -= 1
+        parts.reverse()
+        return " -> ".join(parts)
+
+    # -- the lock-order graph -----------------------------------------------
+    def lock_edges(self) -> List[LockEdge]:
+        edges: Dict[Tuple[str, str], LockEdge] = {}
+        for f in self.functions.values():
+            entry = self.entry_held(f.qual)
+            for acq in f.acquires:
+                held = entry | set(acq.held)
+                if acq.lock in held:
+                    # reentrant re-acquisition (RLock re-entry): cannot
+                    # block, so it orders nothing — mirror the witness
+                    continue
+                for h in held:
+                    if (h, acq.lock) in edges:
+                        continue
+                    chain = ("" if h in acq.held
+                             else self.holder_chain(f.qual, h))
+                    edges[(h, acq.lock)] = LockEdge(
+                        src=h, dst=acq.lock, rel=f.rel,
+                        lineno=acq.node.lineno, func=f.display,
+                        chain=chain)
+        return sorted(edges.values(), key=lambda e: (e.src, e.dst))
+
+    def self_deadlocks(self) -> List[LockEdge]:
+        """Definite self-deadlocks: re-acquiring a NON-reentrant lock
+        that may already be held. lock_edges treats every re-acquire as
+        edge-free (safe for the RLock re-entry pattern); for a plain
+        Lock the inner acquire can never succeed — the most common
+        Python self-deadlock. Reported as src==dst pseudo-edges."""
+        out: Dict[Tuple[str, str], LockEdge] = {}
+        for f in self.functions.values():
+            entry = self.entry_held(f.qual)
+            for acq in f.acquires:
+                if acq.lock not in (entry | set(acq.held)):
+                    continue
+                decl = self.locks.get(acq.lock)
+                if decl is None or decl.kind != "lock":
+                    continue
+                key = (acq.lock, f.qual)
+                if key in out:
+                    continue
+                chain = ("" if acq.lock in acq.held
+                         else self.holder_chain(f.qual, acq.lock))
+                out[key] = LockEdge(src=acq.lock, dst=acq.lock, rel=f.rel,
+                                    lineno=acq.node.lineno,
+                                    func=f.display, chain=chain)
+        return sorted(out.values(),
+                      key=lambda e: (e.src, e.rel, e.lineno))
+
+    def lock_cycles(self) -> List[List[str]]:
+        """Elementary cycles in the lock-order graph (each reported once,
+        rotated to start at its smallest node)."""
+        adj: Dict[str, List[str]] = {}
+        for e in self.lock_edges():
+            adj.setdefault(e.src, []).append(e.dst)
+        return elementary_cycles(adj)
+
+    def to_dot(self) -> str:
+        """The lock-order graph in Graphviz DOT (deterministic order) —
+        `python -m marian_tpu.analysis --format dot`; the committed
+        snapshot lives at docs/lock_order.dot."""
+        edges = self.lock_edges()
+        connected = {e.src for e in edges} | {e.dst for e in edges}
+        lines = [
+            "// mtlint lock-order graph — acquiring B while A is held",
+            "// draws A -> B. Regenerate:",
+            "//   python -m marian_tpu.analysis --format dot "
+            "> docs/lock_order.dot",
+            "digraph mtlint_lock_order {",
+            '  rankdir=LR;',
+            '  node [shape=box, fontname="monospace", fontsize=10];',
+        ]
+        for q in sorted(self.locks):
+            decl = self.locks[q]
+            style = ', style=bold' if decl.kind == "rlock" else ""
+            free = "" if q in connected else ', color=gray'
+            lines.append(f'  "{q}" [label="{q}\\n({decl.kind})"'
+                         f'{style}{free}];')
+        for e in edges:
+            lines.append(f'  "{e.src}" -> "{e.dst}" '
+                         f'[label="{e.rel.rsplit("/", 1)[-1]}:{e.lineno}"];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+def _enclosing_function(node) -> Optional[ast.AST]:
+    for p in ancestors(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+def _lock_ctor_kind(expr) -> Optional[str]:
+    """'lock'/'rlock' when `expr` constructs a lock — threading.Lock(),
+    threading.RLock(), or lockdep.make_lock/make_rlock("name")."""
+    if not isinstance(expr, ast.Call):
+        return None
+    d = dotted_name(expr.func) or ""
+    leaf = d.rsplit(".", 1)[-1]
+    if d in LOCK_CTORS:
+        return LOCK_CTORS[d]
+    if leaf in LOCKDEP_CTORS and ("lockdep" in d or leaf == d):
+        return LOCKDEP_CTORS[leaf]
+    return None
+
+
+def _lockdep_literal(expr) -> Optional[str]:
+    if isinstance(expr, ast.Call) and expr.args \
+            and isinstance(expr.args[0], ast.Constant) \
+            and isinstance(expr.args[0].value, str):
+        d = dotted_name(expr.func) or ""
+        if d.rsplit(".", 1)[-1] in LOCKDEP_CTORS:
+            return expr.args[0].value
+    return None
+
+
+def elementary_cycles(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Elementary cycles of a directed graph, each reported once and
+    rotated to start at its smallest node. Shared by the static
+    lock-order graph (:meth:`CallGraph.lock_cycles`) and the runtime
+    witness (common/lockdep.py `observed_cycles`), so the two verdicts
+    can never diverge on what counts as a cycle."""
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                i = path.index(min(path))
+                cycles.add(tuple(path[i:] + path[:i]))
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes >= start: each cycle is found
+                # from its smallest node exactly once
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for n in sorted(adj):
+        dfs(n, n, [n], {n})
+    return [list(c) for c in sorted(cycles)]
+
+
+# ---------------------------------------------------------------------------
+# memoized build (the three lock rule families + --format dot + the
+# runtime witness all want the same graph for the same source set)
+# ---------------------------------------------------------------------------
+
+_CACHE: Dict[Tuple, CallGraph] = {}
+
+
+def build_cached(sources: Sequence[Source]) -> CallGraph:
+    key = tuple(sorted((s.rel, hash(s.text)) for s in sources))
+    g = _CACHE.get(key)
+    if g is None:
+        _CACHE.clear()            # keep at most one graph alive
+        g = _CACHE[key] = CallGraph.build(sources)
+    return g
+
+
+def static_lock_graph(root) -> Tuple[Set[str], Set[Tuple[str, str]]]:
+    """(lock nodes, acquisition-order edges) for the repo at `root` —
+    what common/lockdep.py's runtime witness cross-checks observed
+    acquisition orders against."""
+    from pathlib import Path
+
+    from .core import Config, collect_sources
+    root = Path(root)
+    config = Config.load(root)
+    sources = collect_sources([root / "marian_tpu"], config)
+    g = build_cached(sources)
+    return (set(g.locks), {(e.src, e.dst) for e in g.lock_edges()})

@@ -12,12 +12,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 from typing import List, Optional
 
-from .core import (Config, apply_baseline, load_baseline, run_lint,
-                   write_baseline)
+from .core import (DEFAULT_CACHE, Config, apply_baseline, collect_sources,
+                   load_baseline, load_result_cache, run_lint,
+                   save_result_cache, write_baseline)
 
 DEFAULT_BASELINE = "marian_tpu/analysis/baseline.json"
 
@@ -48,11 +50,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline with all current findings "
                         "and exit 0")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "dot"),
+                   default="text",
+                   help="text/json print findings; dot prints the "
+                        "lock-order graph (Graphviz) instead of linting "
+                        "— the committed snapshot is docs/lock_order.dot")
     p.add_argument("--rules", metavar="FAMILIES", default=None,
                    help="comma-separated rule families to run (default all): "
                         "trace-safety,host-sync,donation,dtype,guarded-by,"
-                        "metrics,faults")
+                        "metrics,faults,lock-order,lock-blocking,"
+                        "guard-escape")
+    p.add_argument("--changed", action="store_true",
+                   help="incremental mode (scripts/mtlint-precommit.sh): "
+                        "exit immediately when git reports no dirty .py "
+                        "files under the lint paths, and use the result "
+                        "cache so unchanged files are not re-analyzed "
+                        "(full run stays the CI source of truth)")
+    p.add_argument("--cache", action="store_true",
+                   help="arm the content-hash result cache for file-scope "
+                        "rules (implied by --changed; invalidated on "
+                        "rule-source or config changes)")
+    p.add_argument("--cache-file", metavar="FILE", default=None,
+                   help="result cache location, implies --cache "
+                        f"(default: <root>/{DEFAULT_CACHE})")
     p.add_argument("--root", metavar="DIR", default=None,
                    help="project root (default: nearest pyproject.toml)")
     p.add_argument("--list-rules", action="store_true",
@@ -69,14 +89,56 @@ def _list_rules() -> int:
     return 0
 
 
+def git_dirty_py(root: Path, paths: List[Path],
+                 exts: tuple = (".py",)) -> Optional[List[str]]:
+    """Dirty (staged + unstaged + untracked) files under `paths` with a
+    suffix in `exts`, as git sees them; None when git is unavailable /
+    not a repo (callers fall back to a full run — incremental mode must
+    fail open)."""
+    try:
+        # -uall: without it git collapses a brand-new directory to one
+        # `?? dir/` line whose name fails the suffix check, and a new
+        # subpackage full of .py files would read as "nothing dirty"
+        proc = subprocess.run(
+            ["git", "-C", str(root), "status", "--porcelain",
+             "--untracked-files=all", "--"]
+            + [str(p) for p in paths],
+            capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    dirty: List[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        name = line[3:].strip()
+        if " -> " in name:           # rename: lint the new side
+            name = name.split(" -> ", 1)[1]
+        name = name.strip('"')
+        if name.endswith(exts):
+            dirty.append(name)
+    return dirty
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
         return _list_rules()
 
-    root = Path(args.root) if args.root else find_root(Path.cwd())
+    # resolve: the --changed skip path hands root-relative pathspecs
+    # (pyproject, tests/, baseline, the analysis package) to
+    # `git -C <root>` — with a RELATIVE root they would resolve to
+    # root/root/... and silently match nothing, false-skipping on a
+    # dirty config (same class of bug as `paths` below)
+    root = (Path(args.root).resolve() if args.root
+            else find_root(Path.cwd()))
     config = Config.load(root)
-    paths = [Path(p) for p in (args.paths or [root / "marian_tpu"])]
+    # resolve against the CALLER's cwd now: git_dirty_py hands these to
+    # `git -C <root>`, where a cwd-relative pathspec would silently match
+    # nothing and --changed would skip files the lint phase does see
+    paths = [Path(p).resolve()
+             for p in (args.paths or [root / "marian_tpu"])]
     for p in paths:
         if not p.exists():
             print(f"mtlint: path does not exist: {p}", file=sys.stderr)
@@ -85,8 +147,65 @@ def main(argv: Optional[List[str]] = None) -> int:
                    if args.rules else None)
 
     errors: List[str] = []
+    if args.format == "dot":
+        # render the lock-order graph instead of linting (the committed
+        # snapshot docs/lock_order.dot; freshness is a tier-1 test)
+        from . import callgraph as cg
+        sources = collect_sources(paths, config, errors=errors)
+        for e in errors:
+            print(f"mtlint: {e}", file=sys.stderr)
+        sys.stdout.write(cg.build_cached(sources).to_dot())
+        return 2 if errors else 0
+
+    if args.changed:
+        dirty = git_dirty_py(root, paths)
+        # lint RESULTS also depend on files outside the lint paths:
+        # [tool.mtlint] in pyproject.toml gates rules, the faults family
+        # scans tests/ for coverage, and the EXIT CODE depends on the
+        # baseline. A commit touching only those must still run — only
+        # skip when they are clean too (the result cache's config
+        # fingerprint never engages on the skip path). --update-baseline
+        # is an explicit write request and never skips; --no-baseline
+        # changes the verdict itself (baselined findings resurface), so
+        # "nothing changed since the commit" no longer implies exit 0.
+        if dirty is not None and not dirty \
+                and not args.update_baseline and not args.no_baseline:
+            bl = Path(args.baseline).resolve() if args.baseline \
+                else root / DEFAULT_BASELINE    # resolve: see `paths`
+            # the analysis package itself is a result-changer too: when
+            # this repo lints itself, an edited rule must not be skipped
+            # just because the lint paths are a subset that excludes it
+            # (the ruleset hash only guards the CACHE, which the skip
+            # path never consults; in repos without the package the
+            # pathspec matches nothing and is harmless)
+            extra = git_dirty_py(
+                root, [root / "pyproject.toml", root / "tests", bl,
+                       root / "marian_tpu" / "analysis"],
+                exts=(".py", ".toml", ".json"))
+            if extra is not None and not extra:
+                print("mtlint: no changed Python files under "
+                      f"{', '.join(str(p) for p in paths)} (config, "
+                      f"tests/ and baseline clean) — skipping",
+                      file=sys.stderr)
+                if args.format == "json":
+                    # keep piped consumers parseable on the skip path
+                    print(json.dumps({"findings": [], "baselined": 0,
+                                      "errors": [], "skipped": True}))
+                return 0
+        args.cache = True            # --changed implies the result cache
+
+    cache = cache_path = None
+    if args.cache or args.cache_file:
+        # an explicit file resolves against the CALLER's cwd (like
+        # paths/--baseline); only the default lives under the root
+        cache_path = (Path(args.cache_file).resolve() if args.cache_file
+                      else root / DEFAULT_CACHE)
+        cache = load_result_cache(cache_path, config, rule_filter)
+
     findings = run_lint(paths, config, rule_filter=rule_filter,
-                        errors=errors)
+                        errors=errors, cache=cache)
+    if cache_path is not None:
+        save_result_cache(cache_path, cache)
     for e in errors:
         print(f"mtlint: {e}", file=sys.stderr)
 

@@ -16,14 +16,21 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import json
+import os
 import tokenize
 from collections import Counter
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 SUPPRESS_TAG = "mtlint:"
+
+# Bumped whenever any rule's behavior changes: the incremental result
+# cache (cli --changed / --cache) is dropped wholesale on a mismatch, so
+# a rule upgrade can never serve stale per-file verdicts.
+RULESET_VERSION = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -190,13 +197,18 @@ DEFAULT_RULE_DIRS: Dict[str, List[str]] = {
                   "marian_tpu/training"],
     # dtype hygiene: bf16 compute paths
     "dtype": ["marian_tpu/ops", "marian_tpu/layers"],
-    # guarded-by: the threaded layers
+    # guarded-by + escape analysis: the threaded layers
     "guarded-by": ["marian_tpu/serving", "marian_tpu/training"],
-    # everywhere: trace-safety, donation, metrics, fault hygiene
+    "guard-escape": ["marian_tpu/serving", "marian_tpu/training"],
+    # everywhere: trace-safety, donation, metrics, fault hygiene, and the
+    # call-graph lock rules (lock-order/lock-blocking need the WHOLE tree
+    # — a serving lock can reach a blocking call in common/ via two hops)
     "trace-safety": [],
     "donation": [],
     "metrics": [],
     "faults": [],
+    "lock-order": [],
+    "lock-blocking": [],
 }
 
 DEFAULT_EXCLUDE = ["marian_tpu/analysis"]
@@ -419,6 +431,91 @@ def apply_baseline(findings: Sequence[Finding],
 
 
 # ---------------------------------------------------------------------------
+# incremental result cache (cli --changed / --cache)
+# ---------------------------------------------------------------------------
+#
+# Per-file, content-hash-keyed verdicts for FILE-scope rules only:
+# a file whose bytes did not change since the cached run keeps its cached
+# findings (stored post-inline-suppression — suppression comments are part
+# of the content hash). Project-scope rules (metrics/fault hygiene, the
+# call-graph lock rules) are cross-file by definition and always re-run.
+# The cache invalidates wholesale on a RULESET_VERSION bump or any change
+# to the effective configuration. The full uncached run stays the CI
+# source of truth (tests/test_mtlint.py::TestTier1Gate).
+
+DEFAULT_CACHE = ".mtlint-cache.json"
+
+
+def file_hash(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+_RULESET_HASH: Optional[str] = None
+
+
+def ruleset_hash() -> str:
+    """sha256 over the analysis package's own sources: editing a rule
+    invalidates cached verdicts even when the developer forgets the
+    RULESET_VERSION bump (which stays the documented covenant for
+    behavior changes — this is the mechanical backstop)."""
+    global _RULESET_HASH
+    if _RULESET_HASH is None:
+        h = hashlib.sha256()
+        pkg = Path(__file__).resolve().parent
+        for f in sorted(pkg.rglob("*.py")):
+            h.update(f.relative_to(pkg).as_posix().encode())
+            h.update(f.read_bytes())
+        _RULESET_HASH = h.hexdigest()
+    return _RULESET_HASH
+
+
+def config_fingerprint(config: "Config",
+                       rule_filter: Optional[Sequence[str]]) -> str:
+    return json.dumps({
+        "exclude": sorted(config.exclude),
+        "dirs": {k: sorted(v) for k, v in sorted(config.rule_dirs.items())},
+        "disabled": sorted(config.disabled),
+        "filter": sorted(rule_filter) if rule_filter else None,
+        "rule_sources": ruleset_hash(),
+    }, sort_keys=True)
+
+
+def load_result_cache(path: Path, config: "Config",
+                      rule_filter: Optional[Sequence[str]] = None) -> Dict:
+    fp = config_fingerprint(config, rule_filter)
+    fresh = {"ruleset": RULESET_VERSION, "config": fp, "files": {}}
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return fresh
+    if not isinstance(data, dict) \
+            or data.get("ruleset") != RULESET_VERSION \
+            or data.get("config") != fp \
+            or not isinstance(data.get("files"), dict):
+        return fresh                     # version bump / config change
+    return data
+
+
+def save_result_cache(path: Path, cache: Dict) -> None:
+    # atomic rewrite: a concurrent run (pre-commit racing an editor
+    # lint) or a kill mid-write must never leave a truncated JSON —
+    # load fails open, so a torn cache silently disables incrementality.
+    # pid-unique tmp so two racing runs can't truncate each other's
+    # staging file; last replace wins with a complete cache either way
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    try:
+        tmp.write_text(json.dumps(cache, indent=0) + "\n",
+                       encoding="utf-8")
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+        # a cache is advisory, never fatal
+
+
+# ---------------------------------------------------------------------------
 # engine
 # ---------------------------------------------------------------------------
 
@@ -450,27 +547,70 @@ def collect_sources(paths: Sequence[Path], config: Config,
 
 def run_lint(paths: Sequence[Path], config: Config,
              rule_filter: Optional[Sequence[str]] = None,
-             errors: Optional[List[str]] = None) -> List[Finding]:
+             errors: Optional[List[str]] = None,
+             cache: Optional[Dict] = None) -> List[Finding]:
     """Run every registered rule over the given files/dirs; returns findings
-    sorted by location with inline-suppressed ones removed."""
+    sorted by location with inline-suppressed ones removed. With `cache`
+    (load_result_cache), file-scope rules reuse cached per-file verdicts
+    for files whose content hash is unchanged; project-scope rules always
+    re-run."""
     from .rules import all_rules
     sources = collect_sources(paths, config, errors=errors)
     by_rel = {s.rel: s for s in sources}
+    rules = [r for r in all_rules()
+             if (not rule_filter or r.family in rule_filter)
+             and config.family_enabled(r.family)]
     findings: List[Finding] = []
-    for rule in all_rules():
-        if rule_filter and rule.family not in rule_filter:
+    for rule in rules:
+        if rule.scope != "project":
             continue
-        if not config.family_enabled(rule.family):
-            continue
-        if rule.scope == "project":
-            scoped = [s for s in sources
-                      if config.family_applies(rule.family, s.rel)]
-            findings.extend(rule.check_project(scoped, config))
-        else:
-            for src in sources:
-                if config.family_applies(rule.family, src.rel):
-                    findings.extend(rule.check(src, config))
-    findings = [f for f in findings
-                if not (f.path in by_rel and by_rel[f.path].suppressed(f))]
+        scoped = [s for s in sources
+                  if config.family_applies(rule.family, s.rel)]
+        findings.extend(f for f in rule.check_project(scoped, config)
+                        if not (f.path in by_rel
+                                and by_rel[f.path].suppressed(f)))
+    file_rules = [r for r in rules if r.scope != "project"]
+    for src in sources:
+        h = file_hash(src.text) if cache is not None else None
+        ent = cache["files"].get(src.rel) if cache is not None else None
+        if not isinstance(ent, dict):   # corrupt entry: advisory, not fatal
+            ent = None
+        if ent is not None and ent.get("hash") == h:
+            try:
+                replay = [Finding(**d) for d in ent["findings"]]
+            except (KeyError, TypeError):
+                # schema drift (a Finding field changed without a
+                # RULESET_VERSION bump) or a corrupt entry: the cache is
+                # advisory, never fatal — fall through and re-analyze
+                replay = None
+            if replay is not None:
+                findings.extend(replay)
+                continue
+        fs: List[Finding] = []
+        for rule in file_rules:
+            if config.family_applies(rule.family, src.rel):
+                fs.extend(rule.check(src, config))
+        fs = [f for f in fs if not src.suppressed(f)]
+        if cache is not None:
+            cache["files"][src.rel] = {
+                "hash": h, "findings": [f.to_json() for f in fs]}
+        findings.extend(fs)
+    if cache is not None:
+        # prune entries for files that vanished from the scanned tree
+        # (deleted/renamed), else the cache grows without bound. Only
+        # within the scanned prefixes — a subset run must not evict the
+        # rest of the tree's entries.
+        prefixes = []
+        for p in paths:
+            try:
+                prefixes.append(
+                    p.resolve().relative_to(config.root.resolve()).as_posix())
+            except ValueError:
+                prefixes.append(p.as_posix())
+        for rel in [r for r in cache["files"]
+                    if r not in by_rel and any(
+                        pre == "." or r == pre or r.startswith(pre + "/")
+                        for pre in prefixes)]:
+            del cache["files"][rel]
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -11,10 +11,11 @@ from ..core import Config, Finding, Source
 
 class Rule:
     """Base class. `family` groups ids for config scoping ("trace-safety",
-    "host-sync", "donation", "dtype", "guarded-by", "metrics", "faults");
-    `scope` is "file" (check per Source) or "project" (check_project over
-    all in-scope sources at once — cross-file rules like metrics
-    hygiene)."""
+    "host-sync", "donation", "dtype", "guarded-by", "metrics", "faults",
+    "lock-order", "lock-blocking", "guard-escape"); `scope` is "file"
+    (check per Source) or "project" (check_project over all in-scope
+    sources at once — cross-file rules like metrics hygiene and the
+    call-graph lock rules)."""
 
     family: str = ""
     ids: tuple = ()           # rule ids this family can emit (docs/tests)
@@ -51,4 +52,5 @@ def _load() -> None:
     _loaded = True
     from . import (trace_safety, host_sync, donation,  # noqa: F401
                    dtype_hygiene, guarded_by, metrics_hygiene,
-                   fault_hygiene)
+                   fault_hygiene, lock_order, lock_blocking,
+                   guard_escape)

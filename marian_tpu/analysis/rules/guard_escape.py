@@ -1,0 +1,278 @@
+"""guard-escape (MT-GUARD-ESCAPE): guarded state escaping its lock
+(ISSUE 6 tentpole).
+
+MT-LOCK-GUARD (guarded_by.py) checks that every touch of a
+``# guarded-by:`` attribute sits inside ``with self.<lock>:`` — but a
+lexically-guarded ACCESS can still leak the guarded OBJECT past the
+lock's release:
+
+- **returned**: ``with self._lock: return self._pending`` hands the
+  caller the live container; every mutation the caller makes races the
+  class's own locked writers (an int/bool snapshot is fine — the hazard
+  is the shared mutable, so this fires only for attributes initialized
+  to a dict/list/set/deque);
+- **aliased past the with**: ``with self._lock: snap = self._pending``
+  followed by reads of ``snap`` after the block — the name outlives the
+  lock but still points at the shared container (``snap =
+  dict(self._pending)`` is the fix, and is not flagged; neither is the
+  drain-and-swap idiom ``snap = self._pending; self._pending = {}``,
+  which detaches the container under the lock so the alias is
+  exclusively owned);
+- **captured by a closure**: a lambda / nested def inside the with that
+  reads the guarded attribute runs LATER, on whatever thread calls it,
+  with no lock — lexical nesting satisfies MT-LOCK-GUARD but not the
+  discipline (this fires for any guarded attribute: even an int read is
+  then unsynchronized).
+
+Accesses MT-LOCK-GUARD already flags (outside any with) are not
+re-flagged here — each rule owns its blind spot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Config, Finding, Source, ancestors, parent
+from . import Rule, register
+from .guarded_by import (EXEMPT_METHODS, GUARD_RE, _held_locks,
+                         _locks_in_scope, _self_attr)
+
+CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                   "OrderedDict", "Counter"}
+
+
+def _is_container_init(rhs: Optional[ast.AST]) -> bool:
+    if rhs is None:
+        return False
+    if isinstance(rhs, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(rhs, ast.Call):
+        name = ""
+        f = rhs.func
+        while isinstance(f, ast.Attribute):
+            name = f.attr
+            f = f.value
+        if isinstance(f, ast.Name):
+            name = name or f.id
+        return name in CONTAINER_CTORS
+    return False
+
+
+def _enclosing_closure(node: ast.AST, fn: ast.AST) -> Optional[ast.AST]:
+    """The innermost lambda / nested def strictly between node and fn."""
+    for anc in ancestors(node):
+        if anc is fn:
+            return None
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return anc
+    return None
+
+
+@register
+class GuardEscapeRule(Rule):
+    family = "guard-escape"
+    ids = ("MT-GUARD-ESCAPE",)
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(src, node))
+        return findings
+
+    def _guarded_attrs(self, src: Source, cls: ast.ClassDef
+                       ) -> Dict[str, str]:
+        guarded: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    m = GUARD_RE.search(src.comments.get(node.lineno, ""))
+                    if m:
+                        guarded[attr] = m.group(1)
+        return guarded
+
+    def _container_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None \
+                            and _is_container_init(node.value):
+                        out.add(attr)
+        return out
+
+    def _check_class(self, src: Source,
+                     cls: ast.ClassDef) -> List[Finding]:
+        guarded = self._guarded_attrs(src, cls)
+        if not guarded:
+            return []
+        containers = self._container_attrs(cls)
+        findings: List[Finding] = []
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in EXEMPT_METHODS:
+                continue
+            declared = _held_locks(src, fn)
+            for node in ast.walk(fn):
+                attr = _self_attr(node)
+                if attr is None or attr not in guarded:
+                    continue
+                lock = guarded[attr]
+                locked_here = lock in _locks_in_scope(node, fn) \
+                    or lock in declared
+                if not locked_here:
+                    continue          # MT-LOCK-GUARD's territory
+                closure = _enclosing_closure(node, fn)
+                if closure is not None:
+                    if lock in _locks_in_scope(node, closure):
+                        continue      # the closure re-takes the lock
+                    findings.append(src.finding(
+                        "MT-GUARD-ESCAPE", node,
+                        f"`self.{attr}` (guarded-by: {lock}) captured by "
+                        f"a closure inside `{fn.name}` — the closure runs "
+                        f"later, without the lock",
+                        hint=f"pass a snapshot into the closure, or take "
+                             f"`with self.{lock}:` inside it"))
+                    continue
+                if attr not in containers:
+                    continue          # scalar snapshots are fine
+                p = parent(node)
+                if isinstance(p, ast.Return) and p.value is node:
+                    findings.append(src.finding(
+                        "MT-GUARD-ESCAPE", node,
+                        f"`{fn.name}` returns the guarded container "
+                        f"`self.{attr}` itself (guarded-by: {lock}) — the "
+                        f"caller gets the live object after the lock is "
+                        f"released",
+                        hint=f"return a copy (dict(self.{attr}) / "
+                             f"list(...)) built under the lock"))
+                    continue
+                findings.extend(self._check_alias(src, fn, node, attr,
+                                                  lock))
+        return findings
+
+    def _check_alias(self, src: Source, fn: ast.AST, node: ast.AST,
+                     attr: str, lock: str) -> List[Finding]:
+        """`x = self._attr` inside the with, `x` used after it ends."""
+        p = parent(node)
+        if not (isinstance(p, ast.Assign) and p.value is node
+                and len(p.targets) == 1
+                and isinstance(p.targets[0], ast.Name)):
+            return []
+        alias = p.targets[0].id
+        # the innermost with that holds the guarding lock
+        guard_with = None
+        for anc in ancestors(node):
+            if anc is fn:
+                break
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    d = _self_attr_of(item.context_expr)
+                    if d == lock:
+                        guard_with = anc
+                        break
+                if guard_with is not None:
+                    break
+        if guard_with is None:
+            return []
+        # drain-and-swap: `snap = self._attr` followed by
+        # `self._attr = {}` under the SAME lock detaches the container —
+        # the alias is then exclusively owned, and using it after the
+        # with is the whole point of the idiom (flush without holding
+        # the lock). Only a rebind AFTER the alias counts (rebound
+        # first, the alias would point at the new, still-shared object),
+        # and only a rebind in the with's straight-line body — one
+        # buried in an if/try branch does not dominate the exit, so some
+        # paths leave the alias live.
+        for stmt in guard_with.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                    and stmt.lineno >= p.lineno:
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                if any(_self_attr_of(t) == attr for t in targets):
+                    return []
+        end = getattr(guard_with, "end_lineno", guard_with.lineno)
+        out: List[Finding] = []
+        # a post-with rebind of the alias only detaches it for reads it
+        # DOMINATES: every branch construct enclosing the rebind must
+        # also enclose the read (`if flag: snap = {}` leaves the
+        # flag-false path reading the live container)
+        rebinds: List[Set[int]] = []
+        for use in sorted(
+                (n for n in ast.walk(fn)
+                 if isinstance(n, ast.Name) and n.id == alias
+                 and n.lineno > end),
+                key=lambda n: (n.lineno, n.col_offset)):
+            # an AugAssign target has Store ctx but `snap += ...` READS
+            # and mutates the aliased container in place — a use, not a
+            # detaching rebind
+            aug = isinstance(use.ctx, ast.Store) \
+                and isinstance(parent(use), ast.AugAssign)
+            if isinstance(use.ctx, ast.Store) and not aug:
+                rebinds.append(_branch_ids(use, fn))
+                continue
+            if isinstance(use.ctx, ast.Load) or aug:
+                if any(s <= _branch_ids(use, fn) for s in rebinds):
+                    continue           # rebound on every path to here
+                if lock in _locks_in_scope(use, fn):
+                    continue           # re-acquired around this use —
+                    # same exemption the closure path grants
+                out.append(src.finding(
+                    "MT-GUARD-ESCAPE", use,
+                    f"`{alias}` aliases the guarded container "
+                    f"`self.{attr}` (guarded-by: {lock}) and is used "
+                    f"after the `with self.{lock}:` block ends",
+                    hint=f"alias a copy instead: `{alias} = "
+                         f"dict(self.{attr})` under the lock"))
+                break                  # one finding per alias is enough
+        return out
+
+
+_BRANCHY = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.Try,
+            ast.ExceptHandler, ast.Match)
+
+
+def _branch_ids(node: ast.AST, fn: ast.AST) -> Set[tuple]:
+    """(id, arm) of each branch/loop construct between node and fn.
+
+    A Store dominates a lexically-later Load iff every such (construct,
+    arm) enclosing the Store also encloses the Load — then the Store
+    sits in straight-line flow relative to the Load and runs first on
+    every path that reaches it. The arm matters: a rebind in an
+    if-body does not cover a read in the orelse.
+    """
+    out: Set[tuple] = set()
+    child: ast.AST = node
+    for anc in ancestors(node):
+        if anc is fn:
+            break
+        if isinstance(anc, _BRANCHY):
+            arm = ""
+            for field, value in ast.iter_fields(anc):
+                if value is child or (isinstance(value, list)
+                                      and child in value):
+                    arm = field
+                    break
+            out.add((id(anc), arm))
+        child = anc
+    return out
+
+
+def _self_attr_of(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None

@@ -1,0 +1,134 @@
+"""lock-blocking (MT-LOCK-BLOCKING): blocking operations reachable while
+a lock is held (ISSUE 6 tentpole).
+
+The serving invariants "warmup happens off the serving path" and "swap
+is an atomic between-batches re-point" are really claims that nothing
+slow ever runs under the control-plane locks: a model load, a jit
+compile, a file read, an untimed ``future.result()`` under
+``SwapController._lock`` stalls ``route()`` — and with it every device
+batch — for the duration. This rule makes the claim checkable: using the
+call graph's interprocedural held-set propagation (the same machinery as
+MT-LOCK-ORDER), any call classified as blocking that executes while ANY
+known lock may be held is a finding, anchored at the blocking call with
+an example holder chain in the message.
+
+Blocking classification (the host-sync rule's call-table approach,
+extended):
+
+- named calls: ``time.sleep``, ``open``, ``subprocess.run/call/
+  check_call/check_output/Popen``, ``urllib.request.urlopen``,
+  ``socket.create_connection``, ``np.load/save/savez``,
+  ``jax.block_until_ready`` / ``jax.device_put`` (device sync /
+  transfer), and ``warm_executor`` (model load + jit compile + golden
+  smoke — THE warmup-off-the-serving-path sentinel);
+- zero-argument ``.result()`` / ``.join()`` / ``.wait()`` / ``.get()``
+  attribute calls: without a timeout these block forever (a
+  zero-argument ``dict.get()`` is a TypeError, so the no-arg form really
+  is the queue/future/thread one);
+- ``await``-ed calls are exempt: an awaited coroutine yields the event
+  loop instead of wedging the thread (and asyncio code holds no
+  threading locks across awaits in this tree).
+
+Deliberate blocking-under-lock (the native library's one-time lazy
+g++ build, fault injection's hang mode) is acknowledged inline with
+``# mtlint: ok -- reason`` at the blocking site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import callgraph as cg
+from ..core import Config, Finding, Source
+from . import Rule, register
+
+BLOCKING_NAMED = {
+    "time.sleep": "time.sleep",
+    "open": "file open",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "urllib.request.urlopen": "network request",
+    "socket.create_connection": "network connect",
+    "np.load": "file IO",
+    "numpy.load": "file IO",
+    "np.save": "file IO",
+    "np.savez": "file IO",
+    "numpy.savez": "file IO",
+    "os.fsync": "fsync",
+    "jax.block_until_ready": "device sync",
+    "jax.device_put": "device transfer",
+    "warm_executor": "model warmup (load + jit compile + golden smoke)",
+}
+
+# zero-argument forms of these attribute calls block without a timeout
+BLOCKING_NOARG_ATTRS = {
+    "result": "future.result() without timeout",
+    "join": "join() without timeout",
+    "wait": "wait() without timeout",
+    "get": "blocking get() without timeout",
+}
+
+
+def classify(site: "cg.CallSite") -> Optional[str]:
+    """A human label when the call site is a blocking operation."""
+    if site.awaited or site.spawn:
+        return None
+    name = site.name
+    if name in BLOCKING_NAMED:
+        return BLOCKING_NAMED[name]
+    node = site.node
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in BLOCKING_NOARG_ATTRS \
+            and not node.args and not node.keywords:
+        return BLOCKING_NOARG_ATTRS[node.func.attr]
+    return None
+
+
+@register
+class LockBlockingRule(Rule):
+    family = "lock-blocking"
+    ids = ("MT-LOCK-BLOCKING",)
+    scope = "project"
+
+    def check_project(self, sources: List[Source],
+                      config: Config) -> List[Finding]:
+        graph = cg.build_cached(sources)
+        by_rel = {s.rel: s for s in sources}
+        findings: List[Finding] = []
+        for qual in sorted(graph.functions):
+            fn = graph.functions[qual]
+            src = by_rel.get(fn.rel)
+            if src is None:
+                continue
+            entry = graph.entry_held(fn.qual)
+            seen_lines = set()
+            for site in fn.calls:
+                label = classify(site)
+                if label is None:
+                    continue
+                held = entry | set(site.held)
+                if not held or site.node.lineno in seen_lines:
+                    continue
+                seen_lines.add(site.node.lineno)
+                lock = sorted(held)[0]
+                if lock in site.held:
+                    how = "held here"
+                else:
+                    chain = graph.holder_chain(fn.qual, lock)
+                    how = (f"held by caller chain {chain} -> {fn.display}"
+                           if chain else "held at entry")
+                more = f" (+{len(held) - 1} more)" if len(held) > 1 else ""
+                findings.append(src.finding(
+                    "MT-LOCK-BLOCKING", site.node,
+                    f"blocking {label} reachable while `{lock}`{more} is "
+                    f"{how} — everything contending that lock stalls for "
+                    f"the duration",
+                    hint="move the blocking work outside the lock "
+                         "(snapshot under the lock, act after release), "
+                         "add a timeout, or acknowledge a deliberate "
+                         "stall with `# mtlint: ok -- reason`"))
+        return findings

@@ -1,0 +1,140 @@
+"""lock-order (MT-LOCK-ORDER / MT-LOCK-NAME): a static deadlock detector
+for the serving control plane's lock lattice (ISSUE 6 tentpole).
+
+Built on the project call graph (analysis/callgraph.py): each function's
+may-be-held-at-entry lock set is computed interprocedurally — seeded from
+``with self._lock:`` blocks and ``# mtlint: holds <lock>`` declarations —
+and every acquisition of lock B while lock A may be held adds edge A→B to
+a global lock-acquisition-order graph. A CYCLE in that graph is two call
+chains that can acquire the same pair of locks in opposite orders: a
+deadlock waiting for the right thread interleaving. Reentrant
+re-acquisition of the same lock (the SwapController RLock pattern) adds
+no edge.
+
+MT-LOCK-NAME keeps the runtime witness honest: a lock created through
+``lockdep.make_lock("<name>")`` must name itself exactly
+``<OwningClass>.<attr>`` (or ``<module>.<NAME>`` at module level) — the
+identity the static graph uses — or the witness would compare apples to
+oranges (common/lockdep.py, docs/STATIC_ANALYSIS.md).
+
+The graph itself is inspectable: ``python -m marian_tpu.analysis
+--format dot`` renders it (snapshot: docs/lock_order.dot), and the
+runtime lockdep witness (``MARIAN_LOCKDEP=1`` in the tier-1 serving +
+lifecycle suites) fails tier-1 on any OBSERVED acquisition edge the
+static graph missed.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import callgraph as cg
+from ..core import Config, Finding, Source
+from . import Rule, register
+
+
+@register
+class LockOrderRule(Rule):
+    family = "lock-order"
+    ids = ("MT-LOCK-ORDER", "MT-LOCK-NAME")
+    scope = "project"
+
+    def check_project(self, sources: List[Source],
+                      config: Config) -> List[Finding]:
+        graph = cg.build_cached(sources)
+        by_rel = {s.rel: s for s in sources}
+        findings: List[Finding] = []
+
+        edges = {(e.src, e.dst): e for e in graph.lock_edges()}
+        for cycle in graph.lock_cycles():
+            # anchor the finding at the acquire site of the cycle's
+            # first edge; render the full ring + one example chain per
+            # edge so the report is actionable without re-running
+            ring = " -> ".join(cycle + [cycle[0]])
+            steps = []
+            anchor = None
+            for a, b in zip(cycle, cycle[1:] + [cycle[0]]):
+                e = edges.get((a, b))
+                if e is None:
+                    continue
+                if anchor is None:
+                    anchor = e
+                via = f" via {e.chain} -> {e.func}" if e.chain \
+                    else f" in {e.func}"
+                steps.append(f"{a} then {b} at "
+                             f"{e.rel}:{e.lineno}{via}")
+            if anchor is None:
+                continue
+            src = by_rel.get(anchor.rel)
+            if src is None:
+                continue
+            findings.append(src.finding(
+                "MT-LOCK-ORDER", _node_at(anchor),
+                f"lock-order cycle {ring}: opposite acquisition orders "
+                f"can deadlock ({'; '.join(steps)})",
+                hint="pick one global order for these locks and release "
+                     "before acquiring against it (docs/STATIC_ANALYSIS.md "
+                     "'Lock order')"))
+
+        for e in graph.self_deadlocks():
+            # re-acquiring a plain (non-reentrant) Lock that may already
+            # be held: the inner acquire can never succeed
+            src = by_rel.get(e.rel)
+            if src is None:
+                continue
+            via = (f" (held via {e.chain} -> {e.func})" if e.chain
+                   else f" in {e.func}")
+            findings.append(src.finding(
+                "MT-LOCK-ORDER", _node_at(e),
+                f"re-acquiring non-reentrant lock {e.src} while it is "
+                f"already held{via}: a plain Lock self-deadlocks",
+                hint="use an RLock if re-entry is intended, or release "
+                     "before calling back into the acquiring path"))
+
+        for qual, decl in sorted(graph.locks.items()):
+            if decl.lockdep_name is None or decl.lockdep_name == qual:
+                continue
+            src = by_rel.get(decl.rel)
+            if src is None:
+                continue
+            findings.append(src.finding(
+                "MT-LOCK-NAME", decl.node,
+                f"lockdep lock named {decl.lockdep_name!r} but the static "
+                f"graph knows it as {qual!r} — the runtime witness would "
+                f"cross-check against the wrong node",
+                hint=f"name it {qual!r} (owning class + attribute)"))
+
+        for qual, decls in sorted(graph.lock_collisions.items()):
+            # two same-named classes in different modules declared the
+            # same `Class.attr` identity: the graph (and the witness)
+            # would fuse two unrelated locks into one node — false
+            # cycles, or worse, a real runtime ordering vacuously
+            # whitelisted. The first declaration keeps the identity;
+            # flag every later one at its own site.
+            sites = ", ".join(f"{d.rel}:{d.lineno}" for d in decls)
+            for d in decls[1:]:
+                src = by_rel.get(d.rel)
+                if src is None:
+                    continue
+                findings.append(src.finding(
+                    "MT-LOCK-NAME", d.node,
+                    f"ambiguous lock identity {qual!r}: declared at "
+                    f"{sites} — same-named classes would merge into one "
+                    f"node in the lock-order graph and the runtime "
+                    f"witness",
+                    hint="rename one class (or the lock attribute) so "
+                         "every lock has a unique <Class>.<attr> "
+                         "identity"))
+        return findings
+
+
+class _Anchor:
+    """Minimal node-shaped object for Source.finding anchoring."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.col_offset = 0
+
+
+def _node_at(edge: "cg.LockEdge") -> _Anchor:
+    return _Anchor(edge.lineno)

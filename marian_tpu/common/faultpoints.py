@@ -52,6 +52,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from . import lockdep
+
 ENV_SPEC = "MARIAN_FAULTS"
 ENV_SEED = "MARIAN_FAULTS_SEED"
 # distinctive exit code so tests can tell an injected kill from a real crash
@@ -175,7 +177,7 @@ class _State:
     """Process-wide arming state + per-name hit counters."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = lockdep.make_lock("_State.lock")
         self.specs: Dict[str, _Spec] = {}
         self.seed = 0
         self.hits: Dict[str, int] = {}
@@ -291,7 +293,7 @@ def fault_point(name: str) -> None:
     if spec.mode == "hang":
         secs = float(spec.arg if spec.arg is not None else 3600.0)
         _log(f"FAULTPOINT {name} hit {n}: hanging {secs}s")
-        time.sleep(secs)
+        time.sleep(secs)  # mtlint: ok -- hang mode IS the deliberate stall being drilled (watchdog food)
         return
     if spec.mode == "kill":
         _log(f"FAULTPOINT {name} hit {n}: killing process "

@@ -1,0 +1,303 @@
+"""Runtime lockdep witness: the dynamic half of mtlint's lock analysis.
+
+The static side (marian_tpu/analysis/callgraph.py + the lock-order /
+lock-blocking rule families) reasons about ``with self._lock:`` blocks it
+can SEE. Its documented blind spots — calls through locals bound to
+callables, ``lock.acquire()`` outside a ``with``, dynamic dispatch the
+type inference cannot resolve — are exactly where a real deadlock would
+hide from it. This module keeps the static model honest the same way
+``MARIAN_FAULTS`` keeps the crash-safety story honest (PR 9): measure the
+real thing and cross-check.
+
+Every lock in the threaded layers is created through :func:`make_lock` /
+:func:`make_rlock` with its STATIC identity as the name — the
+``<OwningClass>.<attr>`` (or ``<module>.<NAME>``) string the call-graph
+builder derives for the same declaration site; the MT-LOCK-NAME rule
+fails the build if the two ever disagree. With ``MARIAN_LOCKDEP=1`` in
+the environment (read at lock-construction time; the tier-1 serving +
+lifecycle suites set it) each returned lock is a thin instrumented
+wrapper that records, per thread, the order in which named locks are
+acquired: holding A while acquiring B records the edge A→B, exactly the
+relation the static lock-order graph models. Reentrant re-acquisition of
+the same NAME records nothing — class-level identity is what the static
+graph uses, so instance-vs-instance distinctions are out of scope on
+both sides, symmetrically.
+
+The witness verdict (:func:`check_against_static`, asserted at the end
+of the tier-1 serving and lifecycle suites, and printed loudly at
+process exit for manual runs):
+
+- an observed acquisition edge absent from the static graph → the static
+  model has a blind spot; FAIL (extend callgraph.py, do not baseline);
+- an observed lock name the static graph never discovered → same;
+- a cycle in the observed edges → an actually-interleavable deadlock;
+  FAIL regardless of what the static graph thinks.
+
+Without ``MARIAN_LOCKDEP=1`` the factories return plain
+``threading.Lock``/``RLock`` objects — zero overhead, nothing recorded.
+Stdlib-only, imports nothing from the analyzed layers (common/ is below
+everything that locks), so arming it can never change import order.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_VAR = "MARIAN_LOCKDEP"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+# -- the observed model ------------------------------------------------------
+# Guarded by _WITNESS_LOCK (a plain lock, deliberately NOT witnessed:
+# it is acquired while arbitrary witnessed locks are held and would
+# otherwise show up as a spurious *→witness edge on every first
+# acquisition). Per-thread held stacks live in TLS and need no lock.
+
+_WITNESS_LOCK = threading.Lock()
+_EDGES: Dict[Tuple[str, str], str] = {}     # (held, acquired) -> thread name
+_NODES: Set[str] = set()
+_TLS = threading.local()
+_EXIT_HOOKED = False
+
+
+def _stack() -> List[Tuple[str, int]]:
+    """Per-thread held stack of (static name, id(inner lock)). The name
+    feeds the edge graph (one node per static identity, like the static
+    model); the instance id keys behavior-changing checks — two
+    INSTANCES of the same class's lock may legally nest."""
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def _record_acquire(name: str, inner_id: int) -> None:
+    st = _stack()
+    if any(n == name for n, _ in st):
+        # same static identity already held (true reentrant re-acquire,
+        # or a sibling instance of the same class): the static
+        # name-graph has ONE node per identity, where this is
+        # edge-free — recording held->name would invent a reverse edge
+        # (and a false cycle) for the documented-legal RLock re-entry
+        st.append((name, inner_id))
+        return
+    fresh = [(held, name) for held, _ in st
+             if held != name and (held, name) not in _EDGES]
+    if fresh or name not in _NODES:
+        thread = threading.current_thread().name
+        with _WITNESS_LOCK:
+            _NODES.add(name)
+            for e in fresh:
+                _EDGES.setdefault(e, thread)
+    st.append((name, inner_id))
+
+
+def _record_release(name: str, inner_id: int) -> None:
+    st = _stack()
+    for i in range(len(st) - 1, -1, -1):    # innermost reentrant hold first
+        if st[i] == (name, inner_id):
+            del st[i]
+            return
+    # plain threading.Lock PERMITS releasing on a thread that never
+    # acquired — but that breaks the per-thread held-stack model (the
+    # acquirer's stack would keep the lock forever and every later
+    # acquisition there records phantom edges). The witness's job is to
+    # keep models honest: fail loudly instead of silently corrupting.
+    raise RuntimeError(
+        f"lockdep: {name!r} released on thread "
+        f"{threading.current_thread().name!r}, which does not hold it — "
+        f"cross-thread release breaks the per-thread acquisition-order "
+        f"model; release on the acquiring thread (or don't use this lock "
+        f"as a signal)")
+
+
+class _WitnessedLock:
+    """threading.Lock/RLock wrapper recording acquisition-order edges.
+
+    Supports the full surface this tree uses: ``with``, explicit
+    ``acquire``/``release`` (timeouts included — an edge is recorded only
+    on a SUCCESSFUL acquire), and ``locked()`` where the inner lock has
+    it. Releasing on a thread that never acquired (legal for a plain
+    Lock, poison to the per-thread held-stack model) raises — after the
+    inner lock is actually released."""
+
+    __slots__ = ("_name", "_inner", "_reentrant")
+
+    def __init__(self, name: str, inner, reentrant: bool = False):
+        self._name = name
+        self._inner = inner
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking and timeout < 0 and not self._reentrant \
+                and any(i == id(self._inner) for _, i in _stack()):
+            # an INDEFINITELY-blocking re-acquire of THIS plain Lock
+            # (instance-keyed: a sibling instance of the same class may
+            # legally nest) by the thread that already holds it can
+            # NEVER succeed — fail loudly instead of hanging the
+            # process (static analogue: callgraph.self_deadlocks /
+            # MT-LOCK-ORDER). A timed acquire is recoverable (False
+            # after the timeout) and passes through unchanged — the
+            # witness must not alter program behavior beyond
+            # observation.
+            raise RuntimeError(
+                f"lockdep: blocking re-acquire of non-reentrant lock "
+                f"{self._name!r} on thread "
+                f"{threading.current_thread().name!r}, which already "
+                f"holds it — guaranteed self-deadlock")
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _record_acquire(self._name, id(self._inner))
+        return got
+
+    def release(self) -> None:
+        self._inner.release()     # first: a witness refusal (cross-thread
+        _record_release(self._name, id(self._inner))
+        # ^ after the real release: a witness refusal must not leave the
+        #   inner lock held
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_WitnessedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging nicety
+        return f"<lockdep {self._name} wrapping {self._inner!r}>"
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` named with its static lock-graph identity
+    (``Class.attr`` / ``module.NAME``); witnessed under MARIAN_LOCKDEP=1."""
+    if not enabled():
+        return threading.Lock()
+    _hook_exit_report()
+    return _WitnessedLock(name, threading.Lock())
+
+
+def make_rlock(name: str):
+    """Reentrant variant of :func:`make_lock` (same-name re-acquisition
+    records no edge, matching the static graph's reentrancy rule)."""
+    if not enabled():
+        return threading.RLock()
+    _hook_exit_report()
+    return _WitnessedLock(name, threading.RLock(), reentrant=True)
+
+
+# -- inspection / verdict ----------------------------------------------------
+
+def observed_edges() -> Dict[Tuple[str, str], str]:
+    with _WITNESS_LOCK:
+        return dict(_EDGES)
+
+
+def observed_nodes() -> Set[str]:
+    with _WITNESS_LOCK:
+        return set(_NODES)
+
+
+def reset() -> None:
+    """Forget everything observed so far (tests)."""
+    with _WITNESS_LOCK:
+        _EDGES.clear()
+        _NODES.clear()
+
+
+def observed_cycles() -> List[List[str]]:
+    """Elementary cycles among the observed edges (normally none — a
+    cycle here is a deadlock two threads can actually interleave into).
+    Uses the SAME cycle finder as the static graph (lazy import keeps
+    the runtime layer free of analysis imports unless asked)."""
+    from ..analysis.callgraph import elementary_cycles
+    adj: Dict[str, List[str]] = {}
+    for a, b in observed_edges():
+        adj.setdefault(a, []).append(b)
+    return elementary_cycles(adj)
+
+
+def check(static_nodes: Set[str],
+          static_edges: Set[Tuple[str, str]]) -> List[str]:
+    """Violations of the static model by what actually ran. Empty list =
+    the static lock-order graph covered every observed behavior."""
+    violations: List[str] = []
+    for name in sorted(observed_nodes()):
+        if name not in static_nodes:
+            violations.append(
+                f"observed lock {name!r} is unknown to the static graph — "
+                f"callgraph.py did not discover its declaration (or its "
+                f"lockdep name is stale; MT-LOCK-NAME should have caught "
+                f"that)")
+    for (a, b), thread in sorted(observed_edges().items()):
+        if (a, b) not in static_edges:
+            violations.append(
+                f"observed acquisition edge {a} -> {b} (first seen on "
+                f"thread {thread!r}) is absent from the static lock-order "
+                f"graph — a blind spot in callgraph.py's model; extend the "
+                f"analysis, do not baseline this")
+    for cyc in observed_cycles():
+        ring = " -> ".join(cyc + [cyc[0]])
+        violations.append(
+            f"observed lock-order CYCLE {ring}: two threads can deadlock "
+            f"by interleaving these acquisition orders")
+    return violations
+
+
+def check_against_static(root) -> List[str]:
+    """:func:`check` against the static graph built from the repo at
+    ``root`` (the cross-check the tier-1 serving/lifecycle suites assert
+    on). The analysis layer is stdlib-only, so this never imports jax."""
+    from ..analysis.callgraph import static_lock_graph
+    nodes, edges = static_lock_graph(root)
+    return check(nodes, edges)
+
+
+def _find_root() -> Optional[str]:
+    cur = os.path.dirname(os.path.abspath(__file__))
+    for _ in range(6):
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        cur = os.path.dirname(cur)
+    return None
+
+
+def _exit_report() -> None:  # pragma: no cover — exercised via subprocess
+    """Loud stderr report at process exit for manual MARIAN_LOCKDEP=1
+    runs. The enforcing check is the in-suite assertion (tier-1 serving +
+    lifecycle); at exit it is too late to fail anything politely, so this
+    prints and leaves the exit code alone."""
+    if not observed_nodes():
+        return
+    root = _find_root()
+    if root is None:
+        return
+    try:
+        violations = check_against_static(root)
+    except Exception as e:  # noqa: BLE001 — a report must not mask the exit
+        import sys
+        sys.stderr.write(f"MARIAN-LOCKDEP: exit cross-check failed to "
+                         f"run: {e}\n")
+        return
+    if violations:
+        import sys
+        sys.stderr.write("MARIAN-LOCKDEP: the runtime witness observed "
+                         "behavior the static lock-order graph does not "
+                         "model:\n")
+        for v in violations:
+            sys.stderr.write(f"MARIAN-LOCKDEP:   {v}\n")
+
+
+def _hook_exit_report() -> None:
+    global _EXIT_HOOKED
+    if not _EXIT_HOOKED:
+        _EXIT_HOOKED = True
+        atexit.register(_exit_report)

@@ -20,12 +20,14 @@ import subprocess
 import threading
 from typing import List, Optional
 
+from ..common import lockdep
+
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libmarian_data.so")
 _SRC = os.path.join(_DIR, "data_loader.cpp")
-_LOCK = threading.Lock()
+_LOCK = lockdep.make_lock("marian_tpu.native._LOCK")
 _LIB = None
 
 MAX_STREAMS = 8
@@ -61,7 +63,7 @@ def _build_so(src: str, so: str, force: bool = False) -> str:
             os.path.getmtime(so) >= os.path.getmtime(src):
         return so
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", so, src]
-    proc = subprocess.run(cmd, capture_output=True, text=True)
+    proc = subprocess.run(cmd, capture_output=True, text=True)  # mtlint: ok -- one-time lazy g++ build; _LOCK exists to serialize exactly this
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed: {proc.stderr[-2000:]}")
     return so

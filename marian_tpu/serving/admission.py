@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..common import lockdep
 from . import metrics as msm
 
 
@@ -53,7 +54,7 @@ class AdmissionController:
         # thread, begin_drain() fires from a signal handler / main thread,
         # and /readyz reads `draining` from the metrics scrape thread —
         # lock discipline enforced by mtlint's guarded-by checker
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("AdmissionController._lock")
         self._draining = False                  # guarded-by: _lock
         self._drain_started: Optional[float] = None   # guarded-by: _lock
         r = registry if registry is not None else msm.REGISTRY

@@ -47,6 +47,7 @@ import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ...common import faultpoints as fp
+from ...common import lockdep
 from ...common import logging as log
 from ...training import bundle as bdl
 from .. import metrics as msm
@@ -116,7 +117,7 @@ class SwapController:
         # rollback) holds it end-to-end — decision AND registry
         # transition — so a promotion racing a supersede cannot
         # interleave; readers still take it only for snapshots.
-        self._lock = threading.RLock()
+        self._lock = lockdep.make_rlock("SwapController._lock")
         self._live: Optional[reg.ModelVersion] = None      # guarded-by: _lock
         self._canary: Optional[reg.ModelVersion] = None    # guarded-by: _lock
         # the newest retired version, kept warm as the rollback target

@@ -21,6 +21,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..common import lockdep
 from ..common import logging as log
 
 # Default histogram buckets: latency-shaped (seconds), 1ms..60s. Chosen so
@@ -62,7 +63,7 @@ class _Metric:
         self.name = name
         self.help = help_
         self.label_names = tuple(labels)
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("_Metric._lock")
         self._children: Dict[Tuple[str, ...], "_Metric"] = {}
 
     def labels(self, *values: str) -> "_Metric":
@@ -235,7 +236,7 @@ class Registry:
     Translate in one process must not collide)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("Registry._lock")
         self._metrics: Dict[str, _Metric] = {}
 
     def _get_or_create(self, cls, name: str, help_: str, **kw) -> _Metric:

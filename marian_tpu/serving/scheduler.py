@@ -38,6 +38,7 @@ import threading
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
 from ..common import faultpoints as fp
+from ..common import lockdep
 from ..common import logging as log
 from ..data.batch_generator import (DEFAULT_LENGTH_BUCKETS, bucket_length,
                                     padded_batch_cost)
@@ -148,7 +149,8 @@ class ContinuousScheduler:
         # mtlint's guarded-by checker enforces (docs/STATIC_ANALYSIS.md).
         self._lanes: Dict[int, Deque[_Unit]] = collections.defaultdict(
             collections.deque)
-        self._state_lock = threading.Lock()
+        self._state_lock = lockdep.make_lock(
+            "ContinuousScheduler._state_lock")
         self._queued = 0                  # guarded-by: _state_lock
         # units in lanes whose request already resolved (timed out /
         # cancelled / failed): still physically queued until the next

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, TextIO
 
 import numpy as np
 
+from ..common import lockdep
 from ..data.alignment import hard_alignment_from_soft, WordAlignment
 
 
@@ -22,7 +23,7 @@ class OutputCollector:
         self.stream = stream or sys.stdout
         self._next = 0
         self._pending: Dict[int, str] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.make_lock("OutputCollector._lock")
 
     def write(self, sentence_id: int, text: str) -> None:
         with self._lock:

@@ -1,0 +1,25 @@
+#!/bin/sh
+# mtlint incremental gate for a pre-commit hook (or just a fast local
+# check before pushing):
+#
+#     scripts/mtlint-precommit.sh            # lint only what changed
+#     ln -s ../../scripts/mtlint-precommit.sh .git/hooks/pre-commit
+#
+# `--changed` exits immediately when git reports no dirty .py files under
+# the lint paths (and no dirty pyproject.toml / tests/ / baseline files
+# — those change lint results too), and arms the content-hash result cache
+# (.mtlint-cache.json, gitignored) so unchanged files are not re-analyzed
+# — a typical one-file edit re-runs the file-scope rules on that file
+# plus the project-scope rules (metrics/fault hygiene and the call-graph
+# lock families, which are cross-file by definition and always re-run).
+# The cache invalidates itself on a RULESET_VERSION bump or any config
+# change; the full uncached run in CI (tests/test_mtlint.py tier-1 gate)
+# stays the source of truth.
+set -e
+# git runs hooks from the repo toplevel and $0 may be an unresolved
+# symlink into .git/hooks/ — dirname "$0" would land in .git/. Prefer
+# what git says; fall back to the script's own location for direct runs.
+root="$(git rev-parse --show-toplevel 2>/dev/null)" || \
+    root="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$root"
+exec python scripts/mtlint.py --changed "$@"

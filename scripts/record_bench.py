@@ -60,6 +60,17 @@ def main():
               f"(source ts {result.get('stale_source_ts')}) — not "
               f"recording; tunnel is down", file=sys.stderr)
         return 1
+    if result.get("poisoned"):
+        # bench.py self-poisoned the round (final_sync_s past
+        # FINAL_SYNC_POISON_S: a wedged final sync dominated dt). The
+        # row IS a fresh measurement — the driver keeps its artifact —
+        # but appending it would skew the trajectory down and hide real
+        # regressions behind "the tunnel was bad that day". Skip the
+        # history; the stage itself did not fail.
+        print(f"record_bench: {stage} row is self-POISONED "
+              f"({result.get('poisoned_reason', 'no reason recorded')}) — "
+              f"not appending to BENCH_HISTORY.jsonl", file=sys.stderr)
+        return 0
     result["stage"] = stage
     result["ts"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")
@@ -79,10 +90,13 @@ IMPOSSIBLE_MFU = 0.95
 
 def row_is_valid(r: dict) -> bool:
     """A history row eligible to be 'best' / a fallback source: not
-    marked suspect, not itself a stale fallback re-print, and not
-    faster than physics (mfu above the chip-peak threshold)."""
+    marked suspect, not itself a stale fallback re-print, not
+    self-poisoned (wedged final sync — rows predating the append-time
+    skip may carry the stamp), and not faster than physics (mfu above
+    the chip-peak threshold)."""
     mfu = r.get("mfu")
     return ("suspect" not in r and not r.get("stale")
+            and not r.get("poisoned")
             and not (isinstance(mfu, (int, float)) and mfu > IMPOSSIBLE_MFU))
 
 

@@ -10,6 +10,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Arm the runtime lockdep witness for the whole test process (ISSUE 6):
+# every lock created through common/lockdep.py records its per-thread
+# acquisition order, and the tier-1 serving + lifecycle suites assert at
+# teardown that nothing was observed the STATIC lock-order graph does not
+# model (tests/test_serving.py / test_lifecycle.py `lockdep_witness`).
+# Must be set before any marian_tpu module constructs a lock — metrics.py
+# builds the process-wide REGISTRY at import time — hence module-level
+# here, before the first marian_tpu import below.
+os.environ.setdefault("MARIAN_LOCKDEP", "1")
+
 from marian_tpu.common.hermetic import force_cpu_devices  # noqa: E402
 
 jax = force_cpu_devices(8)
@@ -96,6 +106,26 @@ def pytest_collection_modifyitems(config, items):
         fname = os.path.basename(str(item.fspath))
         if fname in SLOW_CORE_FILES or item.name in SLOW_CORE_IDS:
             item.add_marker(pytest.mark.slow_core)
+
+
+@pytest.fixture(scope="module")
+def lockdep_witness():
+    """Runtime lockdep witness cross-check (ISSUE 6), shared by the
+    tier-1 serving + lifecycle suites (module-scoped autouse aliases
+    there — NOT autouse here: the check rebuilds the static lock-order
+    graph, too slow for every module): at module teardown, every lock
+    acquisition order the witness OBSERVED must be an edge the static
+    graph predicted. A violation is a blind spot in
+    analysis/callgraph.py — extend the model, never baseline it."""
+    yield
+    from marian_tpu.common import lockdep
+    if lockdep.enabled():
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        violations = lockdep.check_against_static(root)
+        assert violations == [], (
+            "runtime lockdep witness contradicts the static lock-order "
+            "graph (docs/STATIC_ANALYSIS.md 'The lockdep witness'):\n"
+            + "\n".join(violations))
 
 
 @pytest.fixture

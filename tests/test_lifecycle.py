@@ -26,9 +26,19 @@ from marian_tpu.serving.lifecycle import (CANARY, FAILED, LIVE, REJECTED,
                                           WarmupError, load_golden,
                                           scan_bundles)
 from marian_tpu.serving.scheduler import ContinuousScheduler
+from marian_tpu.common import lockdep
 from marian_tpu.training import bundle as bdl
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockdep_witness(lockdep_witness):
+    """This suite drives the swap/canary/rollback machinery through its
+    real thread mix; the shared conftest witness (which conftest arms
+    via MARIAN_LOCKDEP=1 process-wide) asserts observed ⊆ static at
+    module teardown."""
+    yield
 
 
 def run(coro):

@@ -1,0 +1,232 @@
+"""Unit tests for the runtime lockdep witness (common/lockdep.py) — the
+dynamic half of mtlint's lock analysis (ISSUE 6).
+
+conftest.py arms MARIAN_LOCKDEP=1 for the whole test process, so
+make_lock/make_rlock here return witnessed wrappers. The witness state is
+process-global (that is the point — it accumulates across a whole suite),
+so every test runs inside a sandbox that snapshots and restores it:
+the serving/lifecycle suites' module-teardown cross-check must still see
+exactly what their own threads did, not this file's synthetic locks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from marian_tpu.common import lockdep
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def sandbox():
+    with lockdep._WITNESS_LOCK:
+        saved_edges = dict(lockdep._EDGES)
+        saved_nodes = set(lockdep._NODES)
+    lockdep.reset()
+    yield
+    with lockdep._WITNESS_LOCK:
+        lockdep._EDGES.clear()
+        lockdep._EDGES.update(saved_edges)
+        lockdep._NODES.clear()
+        lockdep._NODES.update(saved_nodes)
+
+
+class TestFactories:
+    def test_disabled_returns_plain_locks(self, monkeypatch):
+        monkeypatch.delenv(lockdep.ENV_VAR, raising=False)
+        assert not lockdep.enabled()
+        lk = lockdep.make_lock("X.y")
+        rk = lockdep.make_rlock("X.z")
+        assert not isinstance(lk, lockdep._WitnessedLock)
+        assert not isinstance(rk, lockdep._WitnessedLock)
+        with lk, rk:                      # still real locks
+            pass
+
+    def test_enabled_wraps_and_records_node(self, sandbox):
+        assert lockdep.enabled()          # conftest armed it
+        lk = lockdep.make_lock("T.a")
+        assert isinstance(lk, lockdep._WitnessedLock)
+        with lk:
+            pass
+        assert "T.a" in lockdep.observed_nodes()
+
+    def test_cross_thread_release_refused(self, sandbox):
+        # legal for a plain threading.Lock, poison to the per-thread
+        # held-stack model: the acquirer's stack would keep the lock
+        # forever and record phantom edges — fail loudly instead
+        lk = lockdep.make_lock("T.sig")
+        t = threading.Thread(target=lk.acquire)
+        t.start()
+        t.join()
+        with pytest.raises(RuntimeError, match="cross-thread release"):
+            lk.release()
+        assert not lk.locked()        # the inner lock WAS released
+
+    def test_locked_and_explicit_acquire_release(self, sandbox):
+        lk = lockdep.make_lock("T.a")
+        assert lk.acquire(timeout=1)
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+
+
+class TestEdgeRecording:
+    def test_nested_acquisition_records_edge(self, sandbox):
+        a, b = lockdep.make_lock("T.a"), lockdep.make_lock("T.b")
+        with a:
+            with b:
+                pass
+        assert ("T.a", "T.b") in lockdep.observed_edges()
+        assert ("T.b", "T.a") not in lockdep.observed_edges()
+
+    def test_sequential_acquisition_records_nothing(self, sandbox):
+        a, b = lockdep.make_lock("T.a"), lockdep.make_lock("T.b")
+        with a:
+            pass
+        with b:
+            pass
+        assert lockdep.observed_edges() == {}
+
+    def test_reentrant_rlock_no_self_edge(self, sandbox):
+        r = lockdep.make_rlock("T.r")
+        with r:
+            with r:
+                pass
+        assert ("T.r", "T.r") not in lockdep.observed_edges()
+
+    def test_reentrant_reacquire_under_other_lock_no_reverse_edge(
+            self, sandbox):
+        # with a(RLock): with b: with a: — the re-acquire cannot block
+        # (the thread already owns a), so it must not invent b->a, which
+        # with the real a->b would report a false observed CYCLE
+        a = lockdep.make_rlock("T.a")
+        b = lockdep.make_lock("T.b")
+        with a:
+            with b:
+                with a:
+                    pass
+        assert ("T.a", "T.b") in lockdep.observed_edges()
+        assert ("T.b", "T.a") not in lockdep.observed_edges()
+        assert lockdep.observed_cycles() == []
+
+    def test_blocking_reacquire_of_plain_lock_raises(self, sandbox):
+        # a blocking re-acquire of a plain Lock the thread already holds
+        # can never succeed — the witness fails loudly instead of
+        # hanging the process
+        a = lockdep.make_lock("T.a")
+        with a:
+            with pytest.raises(RuntimeError, match="self-deadlock"):
+                a.acquire()
+            assert a.acquire(blocking=False) is False  # legal, no hang
+            # a timed acquire is recoverable (False after the timeout) —
+            # the witness must not turn it into a crash
+            assert a.acquire(timeout=0.01) is False
+        with a:                       # still usable after the refusal
+            pass
+
+    def test_sibling_instance_same_name_nests_without_raising(
+            self, sandbox):
+        # two INSTANCES of the same class's lock share a static identity
+        # but may legally nest — the self-deadlock guard keys on the
+        # lock instance, not the name (and the nesting stays edge-free,
+        # mirroring the one-node-per-identity static model)
+        a1 = lockdep.make_lock("T.s")
+        a2 = lockdep.make_lock("T.s")
+        with a1:
+            with a2:                  # plain Lock, different instance
+                pass
+        assert ("T.s", "T.s") not in lockdep.observed_edges()
+        assert lockdep.observed_cycles() == []
+
+    def test_failed_acquire_records_nothing(self, sandbox):
+        a = lockdep.make_lock("T.a")
+        b = lockdep.make_lock("T.b")
+        b._inner.acquire()                # someone else holds b
+        with a:
+            assert b.acquire(blocking=False) is False
+        b._inner.release()
+        assert ("T.a", "T.b") not in lockdep.observed_edges()
+
+    def test_edges_attributed_to_thread(self, sandbox):
+        a, b = lockdep.make_lock("T.a"), lockdep.make_lock("T.b")
+
+        def work():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=work, name="edge-thread")
+        t.start()
+        t.join()
+        assert lockdep.observed_edges()[("T.a", "T.b")] == "edge-thread"
+
+
+class TestVerdict:
+    def test_observed_cycle_detected(self, sandbox):
+        a, b = lockdep.make_lock("T.a"), lockdep.make_lock("T.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert lockdep.observed_cycles() == [["T.a", "T.b"]]
+        violations = lockdep.check({"T.a", "T.b"},
+                                   {("T.a", "T.b"), ("T.b", "T.a")})
+        assert any("CYCLE" in v for v in violations)
+
+    def test_unknown_node_and_edge_flagged(self, sandbox):
+        a, b = lockdep.make_lock("T.a"), lockdep.make_lock("T.b")
+        with a:
+            with b:
+                pass
+        violations = lockdep.check({"T.a"}, set())
+        assert any("'T.b'" in v and "unknown to the static graph" in v
+                   for v in violations)
+        assert any("T.a -> T.b" in v for v in violations)
+
+    def test_clean_when_static_covers_observed(self, sandbox):
+        a, b = lockdep.make_lock("T.a"), lockdep.make_lock("T.b")
+        with a:
+            with b:
+                pass
+        assert lockdep.check({"T.a", "T.b"}, {("T.a", "T.b")}) == []
+
+
+class TestAgainstRealStaticGraph:
+    """End-to-end contract: locks named with their static identities
+    cross-check against the graph callgraph.py builds from the real
+    tree — the exact mechanism the tier-1 serving/lifecycle witness
+    fixtures assert on."""
+
+    def test_modeled_edge_passes(self, sandbox):
+        # SwapController._lock -> ModelRegistry._lock is a real edge of
+        # the serving lattice (docs/lock_order.dot)
+        outer = lockdep.make_rlock("SwapController._lock")
+        inner = lockdep.make_lock("ModelRegistry._lock")
+        with outer:
+            with inner:
+                pass
+        assert lockdep.check_against_static(ROOT) == []
+
+    def test_unmodeled_edge_fails(self, sandbox):
+        # the REVERSE order is absent from the static graph: the witness
+        # must call it out (and would, were real code ever to do this)
+        outer = lockdep.make_lock("ModelRegistry._lock")
+        inner = lockdep.make_rlock("SwapController._lock")
+        with outer:
+            with inner:
+                pass
+        violations = lockdep.check_against_static(ROOT)
+        assert any("ModelRegistry._lock -> SwapController._lock" in v
+                   for v in violations)
+
+    def test_unknown_lock_name_fails(self, sandbox):
+        with lockdep.make_lock("NoSuchClass._lock"):
+            pass
+        violations = lockdep.check_against_static(ROOT)
+        assert any("NoSuchClass._lock" in v for v in violations)

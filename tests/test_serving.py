@@ -11,11 +11,20 @@ import pytest
 
 from marian_tpu.common import Options
 from marian_tpu.common import faultpoints as fp
+from marian_tpu.common import lockdep
 from marian_tpu.data.batch_generator import bucket_length
 from marian_tpu.serving import metrics as msm
 from marian_tpu.serving.admission import AdmissionController, Overloaded
 from marian_tpu.serving.scheduler import (ContinuousScheduler,
                                           DispatchStalled, RequestTimeout)
+
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockdep_witness(lockdep_witness):
+    """After this suite has run the scheduler/admission/metrics thread
+    mix, the shared conftest witness asserts observed ⊆ static."""
+    yield
 
 
 def run(coro):
