@@ -548,9 +548,9 @@ class CallGraph:
             imp = mod.imports.get(func.id)
             if imp and imp[0] == "from":
                 _, base, leaf, dotted = imp
-                m = self.modules.get(base)
-                if m and leaf in m.functions:
-                    return (m.functions[leaf].qual,)
+                f = self._module_function(base, leaf)
+                if f is not None:
+                    return (f.qual,)
             return ()
         if isinstance(func, ast.Attribute):
             # super().__init__ / super().m
@@ -567,14 +567,36 @@ class CallGraph:
             if isinstance(func.value, ast.Name):
                 m = self._lookup_module(func.value.id, mod)
                 if m is not None:
-                    if func.attr in m.functions:
-                        return (m.functions[func.attr].qual,)
+                    f = self._module_function(m.modname, func.attr)
+                    if f is not None:
+                        return (f.qual,)
                     c = m.classes.get(func.attr)
                     if c is not None:
                         init = c.find_method("__init__")
                         return (init.qual,) if init else ()
             return ()
         return ()
+
+    def _module_function(self, modname: str, name: str,
+                         depth: int = 0) -> Optional[FuncInfo]:
+        """Function ``name`` as exposed by module ``modname``, following
+        re-export chains: a package facade (``marian_tpu/obs/__init__.py``
+        doing ``from .trace import event``) exposes functions it never
+        defines, and calls through it (``obs.event(...)``) must still
+        resolve — the lock-order edges those calls create are exactly
+        what the lockdep witness cross-checks against this graph."""
+        if depth > 4:
+            return None
+        m = self.modules.get(modname)
+        if m is None:
+            return None
+        if name in m.functions:
+            return m.functions[name]
+        imp = m.imports.get(name)
+        if imp and imp[0] == "from":
+            _, base, leaf, _dotted = imp
+            return self._module_function(base, leaf, depth + 1)
+        return None
 
     # -- per-function fact extraction --------------------------------------
     def _declared_holds(self, fn: FuncInfo) -> Set[str]:

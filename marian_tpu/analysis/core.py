@@ -30,7 +30,9 @@ SUPPRESS_TAG = "mtlint:"
 # Bumped whenever any rule's behavior changes: the incremental result
 # cache (cli --changed / --cache) is dropped wholesale on a mismatch, so
 # a rule upgrade can never serve stale per-file verdicts.
-RULESET_VERSION = 3
+# v4: MT-SPAN family (span_hygiene) + callgraph resolves package
+#     re-export calls (obs.event -> Tracer.event lock edges).
+RULESET_VERSION = 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,6 +211,9 @@ DEFAULT_RULE_DIRS: Dict[str, List[str]] = {
     "faults": [],
     "lock-order": [],
     "lock-blocking": [],
+    # span hygiene runs everywhere the tracer API can be used (obs
+    # itself, serving, server, training, scripts)
+    "span": [],
 }
 
 DEFAULT_EXCLUDE = ["marian_tpu/analysis"]

@@ -12,7 +12,7 @@ from ..core import Config, Finding, Source
 class Rule:
     """Base class. `family` groups ids for config scoping ("trace-safety",
     "host-sync", "donation", "dtype", "guarded-by", "metrics", "faults",
-    "lock-order", "lock-blocking", "guard-escape"); `scope` is "file"
+    "lock-order", "lock-blocking", "guard-escape", "span"); `scope` is "file"
     (check per Source) or "project" (check_project over all in-scope
     sources at once — cross-file rules like metrics hygiene and the
     call-graph lock rules)."""
@@ -53,4 +53,4 @@ def _load() -> None:
     from . import (trace_safety, host_sync, donation,  # noqa: F401
                    dtype_hygiene, guarded_by, metrics_hygiene,
                    fault_hygiene, lock_order, lock_blocking,
-                   guard_escape)
+                   guard_escape, span_hygiene)

@@ -1,0 +1,279 @@
+"""span-hygiene (MT-SPAN-*): manual span lifetimes must be airtight
+(ISSUE 8 satellite; mirrors the metrics-hygiene family's role for the
+obs layer).
+
+The span tracer (marian_tpu/obs/trace.py) records a span only when it is
+ENDED — a span opened with ``start_span`` and not closed on every path
+silently vanishes from /tracez and from flight-recorder dumps, exactly
+when the failing path is the one being debugged. And because the ring
+holds a REFERENCE to the span object, mutating its attributes after
+``end`` rewrites recorded history.
+
+- MT-SPAN-UNCLOSED: a local binding ``sp = <tracer>.start_span(...)``
+  with no ``end(sp)`` on all paths through the function.
+  An end inside a ``finally`` counts as unconditional; an ``if`` guard
+  that tests the binding itself (``if sp is not None: ... end(sp)``) is
+  part of the close idiom and does not count as a branch. Bindings that
+  ESCAPE local analysis — returned, stored on an object, passed to
+  another call (other than ``end``/``use``) — are skipped: their
+  lifetime is someone else's contract (the scheduler parks spans on the
+  request object; the server hands them to a done-callback).
+  The safe default is ``with tracer.span(...):``, which cannot leak.
+
+- MT-SPAN-LATE: ``sp.set_attrs(...)`` / ``sp.attrs[...]`` after an
+  unconditional ``end`` in the same suite — the write lands on an
+  already-recorded span.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Config, Finding, Source, call_name, parent
+from . import Rule, register
+
+START_TAIL = "start_span"
+END_TAIL = "end"
+USE_TAIL = "use"
+ATTR_CALL_TAILS = {"set_attrs"}
+
+
+def _tail(name: Optional[str]) -> str:
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _is_end_call(node: ast.Call, var: str) -> bool:
+    """``TRACER.end(sp)`` / ``obs.end(sp)`` — the span as the first
+    positional arg. Deliberately NOT a ``sp.end()`` method form: Span
+    has no end() method (recording is the tracer's job), so blessing it
+    here would approve code that raises AttributeError at runtime."""
+    name = call_name(node) or ""
+    if _tail(name) != END_TAIL:
+        return False
+    if name.split(".")[0] == var:          # sp.end(...): not a close —
+        return False                       # no such method on Span
+    return bool(node.args) and isinstance(node.args[0], ast.Name) \
+        and node.args[0].id == var
+
+
+def _is_use_call(node: ast.Call, var: str) -> bool:
+    if _tail(call_name(node)) != USE_TAIL:
+        return False
+    return any(isinstance(a, ast.Name) and a.id == var
+               for a in list(node.args)
+               + [kw.value for kw in node.keywords])
+
+
+def _is_attr_op(node: ast.AST, var: str) -> bool:
+    """``sp.set_attrs(...)``, ``sp.attrs[...] = ..``, ``sp.attrs.update``."""
+    if isinstance(node, ast.Call):
+        name = call_name(node) or ""
+        parts = name.split(".")
+        if parts[0] == var and (parts[-1] in ATTR_CALL_TAILS
+                                or (len(parts) >= 2 and parts[1] == "attrs")):
+            return True
+    if isinstance(node, ast.Subscript):
+        v = node.value
+        if isinstance(v, ast.Attribute) and v.attr == "attrs" \
+                and isinstance(v.value, ast.Name) and v.value.id == var:
+            return True
+    return False
+
+
+def _field_of(stmt: ast.stmt, owner: ast.AST) -> Optional[str]:
+    """Which block field of ``owner`` holds ``stmt`` (body/orelse/
+    finalbody...) — two statements are same-suite only when both the
+    owner AND the field match (If.body and If.orelse share a parent)."""
+    for field, value in ast.iter_fields(owner):
+        if isinstance(value, list) and stmt in value:
+            return field
+    return None
+
+
+def _stmt_of(node: ast.AST, fn: ast.AST) -> Optional[ast.stmt]:
+    """The statement containing ``node`` whose own parent is a block
+    owner inside ``fn``."""
+    cur: Optional[ast.AST] = node
+    while cur is not None and cur is not fn:
+        p = parent(cur)
+        if isinstance(cur, ast.stmt):
+            return cur
+        cur = p
+    return None
+
+
+def _branch_ancestors(node: ast.AST, fn: ast.AST, var: str
+                      ) -> Optional[Set[int]]:
+    """ids of conditionality-introducing ancestors of ``node`` up to
+    ``fn``: If/While/For bodies, except handlers, nested functions. An
+    ``if`` whose test mentions ``var`` is the close-guard idiom and is
+    not counted. A statement sitting in a Try ``finally`` drops that Try
+    level (the finally always runs). Returns None when ``node`` sits in
+    a lambda/comprehension we cannot reason about (treated conditional).
+    """
+    out: Set[int] = set()
+    cur: ast.AST = node
+    while cur is not fn:
+        p = parent(cur)
+        if p is None:
+            return None
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and p is not fn:
+            out.add(id(p))                 # nested def: may never run
+        elif isinstance(p, ast.If):
+            guard_names = {n.id for n in ast.walk(p.test)
+                           if isinstance(n, ast.Name)}
+            if var not in guard_names:
+                out.add(id(p))
+        elif isinstance(p, (ast.While, ast.For, ast.AsyncFor)):
+            if cur in getattr(p, "body", []) \
+                    or cur in getattr(p, "orelse", []):
+                out.add(id(p))
+        elif isinstance(p, ast.ExceptHandler):
+            out.add(id(p))
+        elif isinstance(p, ast.Try) and cur in p.finalbody:
+            pass                           # finally: unconditional
+        elif isinstance(p, (ast.Lambda, ast.GeneratorExp, ast.ListComp,
+                            ast.SetComp, ast.DictComp)):
+            return None
+        cur = p
+    return out
+
+
+@register
+class SpanHygieneRule(Rule):
+    family = "span"
+    ids = ("MT-SPAN-UNCLOSED", "MT-SPAN-LATE")
+    scope = "file"
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(src.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(src, fn))
+        return findings
+
+    def _check_function(self, src: Source, fn: ast.AST) -> List[Finding]:
+        # local Name bindings of start_span results, innermost-owner
+        # only (a binding inside a nested def belongs to that def's pass)
+        bindings: Dict[str, ast.Call] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and _tail(call_name(node.value)) == START_TAIL \
+                    and self._owner(node, fn) is fn:
+                bindings[node.targets[0].id] = node.value
+        findings: List[Finding] = []
+        for var, start_call in bindings.items():
+            findings.extend(self._check_binding(src, fn, var, start_call))
+        return findings
+
+    @staticmethod
+    def _owner(node: ast.AST, fn: ast.AST) -> Optional[ast.AST]:
+        cur = parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parent(cur)
+        return None
+
+    def _check_binding(self, src: Source, fn: ast.AST, var: str,
+                       start_call: ast.Call) -> List[Finding]:
+        ends: List[ast.Call] = []
+        attr_ops: List[ast.AST] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if node is start_call:
+                    continue
+                if _is_end_call(node, var):
+                    ends.append(node)
+                    continue
+                if _is_use_call(node, var):
+                    continue
+                if _is_attr_op(node, var):
+                    attr_ops.append(node)
+                    continue
+                # any other call receiving the binding: the span escaped
+                # (another owner may close it) — out of scope
+                for a in list(node.args) \
+                        + [kw.value for kw in node.keywords]:
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Name) and n.id == var:
+                            return self._late_only(src, var, ends,
+                                                   attr_ops, fn)
+            elif isinstance(node, ast.Subscript) and _is_attr_op(node, var):
+                attr_ops.append(node)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None:
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name) and n.id == var:
+                        return self._late_only(src, var, ends, attr_ops, fn)
+            elif isinstance(node, ast.Assign) and not (
+                    node.value is start_call):
+                # aliasing / storing the span somewhere else: escaped
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name) and n.id == var:
+                        return self._late_only(src, var, ends, attr_ops, fn)
+
+        findings = self._late_only(src, var, ends, attr_ops, fn)
+        start_stmt = _stmt_of(start_call, fn)
+        start_branches = _branch_ancestors(start_stmt, fn, var) \
+            if start_stmt is not None else None
+        if not ends:
+            findings.append(src.finding(
+                "MT-SPAN-UNCLOSED", start_call,
+                f"span bound to `{var}` is opened but never closed — it "
+                f"will not be recorded to /tracez or flight dumps",
+                hint="end it in a finally, or use `with tracer.span(...)`"))
+            return findings
+        if start_branches is None:
+            return findings
+        for e in ends:
+            stmt = _stmt_of(e, fn)
+            br = _branch_ancestors(stmt, fn, var) if stmt is not None \
+                else None
+            if br is not None and br <= start_branches:
+                return findings           # at least one unconditional end
+        findings.append(src.finding(
+            "MT-SPAN-UNCLOSED", start_call,
+            f"span bound to `{var}` is not closed on all paths (every "
+            f"`end` sits in a conditional branch the open does not)",
+            hint="move the end into a finally covering the open, or use "
+                 "`with tracer.span(...)`"))
+        return findings
+
+    def _late_only(self, src: Source, var: str, ends: List[ast.Call],
+                   attr_ops: List[ast.AST], fn: ast.AST) -> List[Finding]:
+        """MT-SPAN-LATE: an attr write whose statement FOLLOWS, in the
+        same suite, a statement that IS an unconditional end call."""
+        findings: List[Finding] = []
+        end_stmts: List[Tuple[ast.stmt, ast.AST, Optional[str]]] = []
+        for e in ends:
+            stmt = _stmt_of(e, fn)
+            if stmt is not None and isinstance(stmt, ast.Expr) \
+                    and stmt.value is e:
+                own = parent(stmt)
+                end_stmts.append((stmt, own, _field_of(stmt, own)))
+        if not end_stmts:
+            return findings
+        for op in attr_ops:
+            op_stmt = _stmt_of(op, fn)
+            if op_stmt is None:
+                continue
+            op_parent = parent(op_stmt)
+            op_field = _field_of(op_stmt, op_parent) \
+                if op_parent is not None else None
+            for (e_stmt, e_parent, e_field) in end_stmts:
+                if e_parent is op_parent and e_field == op_field \
+                        and op_stmt.lineno > e_stmt.lineno:
+                    findings.append(src.finding(
+                        "MT-SPAN-LATE", op,
+                        f"attribute set on `{var}` after it was ended — "
+                        f"the span is already recorded; this rewrites "
+                        f"history in the ring",
+                        hint="set attributes before end(), or pass them "
+                             "to end(**attrs)"))
+                    break
+        return findings

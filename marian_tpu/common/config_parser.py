@@ -336,6 +336,11 @@ _TRANSLATION = [
     _f("rollback-p99-factor", float, 0.0, "With --model-watch: auto-rollback a canary whose p99 batch latency exceeds this factor x the live version's p99 (both over a recent-sample window; 0 = latency check off) (TPU extension)", "translate"),
     _f("canary-min-batches", int, 8, "With --model-watch and --canary-fraction > 0: promote the canary to live after this many canary batches without tripping a rollback threshold (TPU extension)", "translate"),
     _f("warmup-golden", str, "", "With --model-watch: file of golden source sentences (one per line) each candidate model must translate during off-path warmup before it can serve — forces jit compilation of the serving shapes and proves the checkpoint decodes (empty = a built-in probe set) (TPU extension)", "translate"),
+    # observability (marian_tpu/obs/ — docs/OBSERVABILITY.md)
+    _f("trace", bool, False, "Enable the request-scoped span tracer: every request's path (ingest, admission, queue wait, batch formation, dispatch, translate, reply write — and train-loop phases) is recorded into a bounded in-memory ring, exported as Chrome trace JSON at /tracez on the metrics port (open in Perfetto). Off = zero overhead: no ring allocation, no lock on the hot path (TPU extension)", "translate"),
+    _f("trace-ring", int, 4096, "With --trace: span ring capacity — how many most-recent spans /tracez and flight-recorder dumps can see (TPU extension)", "translate"),
+    _f("trace-dump", str, "", "Arm the crash flight recorder (implies --trace): on a dispatch-watchdog trip, a canary/live auto-rollback, a poison-request isolation, or an injected MARIAN_FAULTS kill, snapshot the span ring + event timeline + /metrics to a timestamped JSON file in this directory (docs/OBSERVABILITY.md runbook) (TPU extension)", "translate"),
+    _f("trace-sync-phases", bool, False, "Honest train-loop phase timing: drain the device (block_until_ready) at every StepTimer phase boundary so async dispatch cannot shift device seconds into whichever later phase blocks first. Serializes host and device — a diagnosis mode, not a throughput config (TPU extension)", "translate"),
     _f("fuse", bool, False, "(compat; XLA always fuses)", "translate"),
     _f("gemm-type", str, "float32", "float32, bfloat16, int8 (TPU AQT path), intgemm8/packed* map to int8", "translate"),
     _f("quantize-range", float, 0.0, "Quantization clip range in stddevs (0 = absmax)", "translate"),

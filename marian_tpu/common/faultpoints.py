@@ -255,6 +255,49 @@ def hits(name: str) -> int:
         return _STATE.hits.get(name, 0)
 
 
+def hit_counts() -> Dict[str, int]:
+    """Copy of every per-name hit counter (flight-recorder dumps)."""
+    with _STATE.lock:
+        return dict(_STATE.hits)
+
+
+# Observer hooks (ISSUE 8): the obs layer records firings onto its event
+# timeline and dumps the flight recorder before an injected kill. Plain
+# lists mutated only at registration time (startup / arm time); firing
+# iterates a snapshot, outside _STATE.lock, and swallows hook errors —
+# instrumentation must never change whether the drill fires.
+_FIRE_HOOKS: list = []     # fn(name, mode, hit) — any armed spec matched
+_KILL_HOOKS: list = []     # fn(name, hit) — about to os._exit
+
+
+def add_fire_hook(fn) -> None:
+    if fn not in _FIRE_HOOKS:
+        _FIRE_HOOKS.append(fn)
+
+
+def add_kill_hook(fn) -> None:
+    if fn not in _KILL_HOOKS:
+        _KILL_HOOKS.append(fn)
+
+
+def remove_fire_hook(fn) -> None:
+    if fn in _FIRE_HOOKS:
+        _FIRE_HOOKS.remove(fn)
+
+
+def remove_kill_hook(fn) -> None:
+    if fn in _KILL_HOOKS:
+        _KILL_HOOKS.remove(fn)
+
+
+def _run_hooks(hooks, *args) -> None:
+    for fn in list(hooks):
+        try:
+            fn(*args)
+        except Exception:  # noqa: BLE001 — observers must not alter drills
+            pass
+
+
 def _log(msg: str) -> None:
     # plain stderr, not the marian logger: fault points fire in subprocesses
     # before create_loggers, and the kill path must not depend on handler
@@ -285,8 +328,10 @@ def fault_point(name: str) -> None:
         r = random.Random(f"{seed}:{name}:{n}").random()
         if r >= float(spec.arg or 0.0):
             return
+        _run_hooks(_FIRE_HOOKS, name, "prob", n)
         _log(f"FAULTPOINT {name} hit {n}: injected failure (prob)")
         raise InjectedFault(f"injected fault at {name} (hit {n}, prob)")
+    _run_hooks(_FIRE_HOOKS, name, spec.mode, n)
     if spec.mode == "fail":
         _log(f"FAULTPOINT {name} hit {n}: injected failure")
         raise InjectedFault(f"injected fault at {name} (hit {n})")
@@ -298,6 +343,9 @@ def fault_point(name: str) -> None:
     if spec.mode == "kill":
         _log(f"FAULTPOINT {name} hit {n}: killing process "
              f"(exit {FAULT_EXIT_CODE})")
+        # last words: let the flight recorder (obs/flight.py) snapshot
+        # the span ring before the simulated SIGKILL erases it
+        _run_hooks(_KILL_HOOKS, name, n)
         os._exit(FAULT_EXIT_CODE)
 
 

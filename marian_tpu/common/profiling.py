@@ -13,14 +13,20 @@ Three surfaces:
 - ``StepTimer``: lightweight host-side wall-clock histogram of the train
   loop phases (data, step dispatch, host bookkeeping) — finds host-bound
   gaps a device trace doesn't show.
+
+``StepTimer`` and ``TraceWindow`` were folded onto the span-tracer API
+(ISSUE 8) and now live in ``marian_tpu/obs/profiling.py`` — the names
+below are re-export shims so existing call sites keep importing from
+here. StepTimer additionally gained the ``sync_fn`` device-sync honesty
+fix (see its module docstring / docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 import os
-import time
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
+from ..obs.profiling import StepTimer, TraceWindow  # noqa: F401 — shims
 from . import logging as log
 
 
@@ -140,50 +146,6 @@ def maybe_start_profile_server(options) -> bool:
     return True
 
 
-class TraceWindow:
-    """Capture a jax.profiler trace for updates [start, stop)."""
-
-    def __init__(self, options):
-        prof = options.get("profile", None)
-        self.dir: Optional[str] = None
-        # bare `--profile` parses to "" (argparse const) — still means ON
-        if prof is not None and prof is not False:
-            self.dir = prof if (isinstance(prof, str) and prof) \
-                else "profile"
-        self.start_update = int(options.get("profile-start", 10) or 10)
-        self.n_updates = int(options.get("profile-updates", 5) or 5)
-        self._active = False
-        self._done = False
-        self._started_at = 0
-
-    def tick(self, update: int) -> None:
-        """Call once per train-loop update with the 1-based update count."""
-        if self.dir is None or self._done:
-            return
-        import jax
-        if not self._active and update >= self.start_update:
-            os.makedirs(self.dir, exist_ok=True)
-            jax.profiler.start_trace(self.dir)
-            self._active = True
-            self._started_at = update
-            log.info("Profiler trace started at update {} → {}", update,
-                     self.dir)
-        elif self._active and update >= self._started_at + self.n_updates:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-            log.info("Profiler trace stopped after update {} ({} updates); "
-                     "view with tensorboard --logdir {}", update,
-                     self.n_updates, self.dir)
-
-    def close(self) -> None:
-        if self._active:
-            import jax
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-
-
 def dump_lowered(path: str, lowered) -> None:
     """Write <path>.hlo.txt (stable HLO) and <path>.hlo_opt.txt (post-
     fusion — what actually runs on the chip) for a lowered jitted call
@@ -197,55 +159,3 @@ def dump_lowered(path: str, lowered) -> None:
     except Exception as e:  # noqa: BLE001
         log.warn("optimized-HLO dump failed: {}", e)
     log.info("Dumped train-step HLO to {}.hlo*.txt", base)
-
-
-class StepTimer:
-    """Host-side phase timer: where does wall-clock go between device
-    steps? Phases are named spans; report() logs a one-line summary."""
-
-    def __init__(self, enabled: bool = True):
-        self.enabled = enabled
-        self.spans: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
-        self._t: Optional[float] = None
-        self._phase: Optional[str] = None
-
-    def phase(self, name: str) -> None:
-        if not self.enabled:
-            return
-        now = time.perf_counter()
-        if self._phase is not None and self._t is not None:
-            self.spans[self._phase] = self.spans.get(self._phase, 0.0) \
-                + (now - self._t)
-            self.counts[self._phase] = self.counts.get(self._phase, 0) + 1
-        self._phase, self._t = name, now
-
-    def stop(self) -> None:
-        self.phase("__end__")
-        self._phase = None
-
-    def report(self) -> Dict[str, float]:
-        total = sum(v for k, v in self.spans.items() if k != "__end__")
-        out = {}
-        for k, v in sorted(self.spans.items(), key=lambda kv: -kv[1]):
-            if k == "__end__":
-                continue
-            out[k] = v
-        if self.enabled and total > 0:
-            line = " ".join(f"{k}={v:.2f}s({100*v/total:.0f}%)"
-                            for k, v in out.items())
-            log.info("Step phases: {}", line)
-            # mirror the phase totals into the process-wide metrics
-            # registry (serving/metrics.py — ISSUE 1): with --metrics-port
-            # a Prometheus scrape sees where train-loop wall-clock goes
-            # (data vs dispatch vs host) without grepping logs
-            try:
-                from ..serving import metrics as msm
-                g = msm.gauge("marian_step_phase_seconds",
-                              "Host wall-clock per train-loop phase since "
-                              "the last report", labels=("phase",))
-                for k, v in out.items():
-                    g.labels(k).set(v)
-            except Exception:  # noqa: BLE001 — observability is optional
-                pass
-        return out

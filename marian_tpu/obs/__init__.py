@@ -1,0 +1,68 @@
+"""marian_tpu.obs — request-scoped tracing, event timeline, and crash
+flight recorder (ISSUE 8 tentpole; docs/OBSERVABILITY.md).
+
+One process-wide :data:`TRACER` records named spans and instant events
+into bounded in-memory rings; one :data:`FLIGHT` recorder snapshots them
+(plus /metrics and fault-point hit counters) to disk when a watchdog
+trip, auto-rollback, poison isolation, or injected kill fires. Exports
+are Chrome trace-event JSON — ``/tracez`` on the metrics port, flight
+dump files, both loadable in Perfetto.
+
+Everything is stdlib-only and OFF by default with zero overhead
+(no ring allocation, no lock acquisition — the tier-1 overhead guard
+asserts it). Enable with ``--trace`` (or ``MARIAN_TRACE=1``), arm dumps
+with ``--trace-dump DIR`` (or ``MARIAN_TRACE_DUMP``).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .flight import FLIGHT, FlightRecorder               # noqa: F401
+from .trace import (NOOP_SPAN, Span, Tracer, TRACER,     # noqa: F401
+                    current, enabled, end, event, new_trace_id, set_attrs,
+                    span, start_span, trace_routes)
+
+ENV_TRACE = "MARIAN_TRACE"
+ENV_DUMP = "MARIAN_TRACE_DUMP"
+
+_FIRE_HOOKED = False
+
+
+def _hook_faultpoints() -> None:
+    """Record every armed fault-point firing onto the event timeline, so
+    a flight dump shows the injected failure next to its victims."""
+    global _FIRE_HOOKED
+    if _FIRE_HOOKED:
+        return
+    _FIRE_HOOKED = True
+    from ..common import faultpoints as fp
+
+    def _on_fire(name: str, mode: str, hit: int) -> None:
+        TRACER.event("fault.fire", point=name, mode=mode, hit=hit)
+
+    fp.add_fire_hook(_on_fire)
+
+
+def configure(options=None) -> bool:
+    """Read the tracing knobs and enable/arm accordingly; returns
+    whether the tracer ended up enabled. Called by ServingApp and the
+    training driver; safe to call more than once.
+
+    - ``--trace`` / ``MARIAN_TRACE=1``: enable span recording.
+    - ``--trace-ring N``: span ring capacity (default 4096).
+    - ``--trace-dump DIR`` / ``MARIAN_TRACE_DUMP``: arm the flight
+      recorder (implies ``--trace`` — a dump without spans is useless).
+    """
+    get = options.get if options is not None else (lambda *_a: None)
+    ring = int(get("trace-ring", 0) or 0)
+    dump = str(get("trace-dump", "") or "") \
+        or os.environ.get(ENV_DUMP, "")
+    on = bool(get("trace", False)) \
+        or os.environ.get(ENV_TRACE, "") == "1" or bool(dump)
+    if on:
+        TRACER.enable(capacity=ring or None)
+        _hook_faultpoints()
+    if dump:
+        FLIGHT.arm(dump)
+    return TRACER.enabled

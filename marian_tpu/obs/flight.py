@@ -1,0 +1,189 @@
+"""Crash flight recorder (ISSUE 8 tentpole — the post-mortem half).
+
+When something goes wrong in production — the dispatch watchdog trips, a
+canary or live version auto-rolls-back, a poison request is isolated, or
+a fault point kills the process — the span ring and the event timeline
+hold exactly the evidence an operator needs, and they are about to be
+lost (process memory). The flight recorder snapshots them, plus the
+current /metrics text and the fault-point hit counters, to a timestamped
+JSON file the moment the trigger fires.
+
+Armed by ``--trace-dump DIR`` (or ``MARIAN_TRACE_DUMP=DIR``); disarmed =
+every trip is a cheap no-op. Trigger sites:
+
+- serving/scheduler.py: watchdog trip, poison-request isolation;
+- serving/lifecycle/controller.py: canary rollback, live rollback,
+  manual rollback;
+- common/faultpoints.py kill mode: a pre-``os._exit`` hook registered at
+  arm time dumps before the simulated SIGKILL lands (the crash case).
+
+Dump shape (docs/OBSERVABILITY.md carries the operator runbook):
+
+    {"reason", "detail", "trace_id", "ts", "pid", "seq",
+     "trace": <Chrome trace JSON — open in Perfetto>,
+     "metrics": <prometheus text>, "faultpoints": {...}}
+
+Locking: ``FlightRecorder._lock`` guards only the armed-dir/sequence
+fields; the file write and every snapshot call run with NO lock held
+(the MT-LOCK-BLOCKING rule would flag IO under a lock, and the lockdep
+witness would flag the unmodeled edges).
+"""
+
+from __future__ import annotations
+
+import atexit
+import datetime
+import json
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+from ..common import faultpoints as fp
+from ..common import lockdep
+from ..common import logging as log
+from .trace import TRACER
+
+_SLUG_RE = re.compile(r"[^a-z0-9-]+")
+
+
+def _slug(reason: str) -> str:
+    return _SLUG_RE.sub("-", reason.lower()).strip("-") or "trip"
+
+
+class FlightRecorder:
+    def __init__(self):
+        self._lock = lockdep.make_lock("FlightRecorder._lock")
+        self._dir: Optional[str] = None     # guarded-by: _lock
+        self._seq = 0                       # guarded-by: _lock
+        self._kill_hooked = False           # guarded-by: _lock
+
+    def arm(self, dump_dir: str) -> None:
+        """Point dumps at ``dump_dir`` (created if missing) and hook the
+        fault-point kill path so an injected crash dumps before dying."""
+        dump_dir = os.path.abspath(dump_dir)
+        os.makedirs(dump_dir, exist_ok=True)
+        hook = False
+        with self._lock:
+            self._dir = dump_dir
+            if not self._kill_hooked:
+                self._kill_hooked = True
+                hook = True
+        if hook:
+            fp.add_kill_hook(self._on_kill)
+            # normal/abnormal interpreter exit (uncaught exception,
+            # SIGTERM-driven shutdown) also leaves a final snapshot —
+            # the kill hook only covers the os._exit fast path
+            atexit.register(self._on_exit)
+        log.info("Flight recorder armed: dumps to {}", dump_dir)
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._dir = None
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return self._dir is not None
+
+    def trip_async(self, reason: str, trace_id: Optional[str] = None,
+                   detail: str = "", extra: Optional[Dict] = None) -> None:
+        """Fire-and-forget :meth:`trip` on a background thread — for
+        callers on the asyncio event loop (the scheduler's watchdog and
+        poison paths): a dump serializes the whole span ring + /metrics
+        and writes a file, which must not freeze every connection at the
+        exact moment of the incident. The ring snapshot happens on the
+        dump thread, microseconds later — the victims' spans are already
+        recorded by then (callers end them first)."""
+        with self._lock:
+            armed = self._dir is not None
+        if not armed:
+            return
+        threading.Thread(
+            target=self.trip, args=(reason,),
+            kwargs={"trace_id": trace_id, "detail": detail, "extra": extra,
+                    # incident-time counters: by the time the dump
+                    # thread runs, a test/drill may have disarmed
+                    "fault_hits": fp.hit_counts()},
+            name="flight-dump", daemon=True).start()
+
+    def _on_kill(self, name: str, hit: int) -> None:
+        self.trip("fault-kill", detail=f"fault point {name} (hit {hit}) "
+                  f"is killing the process")
+
+    def _on_exit(self) -> None:  # pragma: no cover — atexit timing
+        spans, events = TRACER.snapshot()
+        if spans or events:      # nothing recorded = nothing to keep
+            self.trip("exit", detail="process exit — final span-ring "
+                      "snapshot (atexit)")
+
+    def trip(self, reason: str, trace_id: Optional[str] = None,
+             detail: str = "", extra: Optional[Dict] = None,
+             fault_hits: Optional[Dict] = None) -> Optional[str]:
+        """Snapshot everything to a new dump file; returns its path, or
+        None when disarmed (the cheap common case). Never raises — a
+        failing dump must not worsen the incident being recorded."""
+        with self._lock:
+            d = self._dir
+            if d is None:
+                return None
+            self._seq += 1
+            seq = self._seq
+        try:
+            return self._write(d, seq, reason, trace_id, detail, extra,
+                               fault_hits)
+        except Exception as e:  # noqa: BLE001 — post-mortem best effort
+            log.warn("flight recorder: dump for {!r} failed: {}", reason, e)
+            return None
+
+    def _write(self, d: str, seq: int, reason: str,
+               trace_id: Optional[str], detail: str,
+               extra: Optional[Dict],
+               fault_hits: Optional[Dict] = None) -> str:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        payload: Dict = {
+            "reason": reason,
+            "detail": detail,
+            "trace_id": trace_id or "",
+            "ts": now.isoformat(timespec="milliseconds"),
+            "pid": os.getpid(),
+            "thread": threading.current_thread().name,
+            "seq": seq,
+            "trace": TRACER.chrome_trace(),
+        }
+        if extra:
+            payload["extra"] = dict(extra)
+        try:
+            from ..serving import metrics as msm   # lazy: no import cycle
+            payload["metrics"] = msm.REGISTRY.render()
+        except Exception as e:  # noqa: BLE001 — metrics are best effort
+            payload["metrics"] = f"unavailable: {e}"
+        payload["faultpoints"] = {
+            "spec": os.environ.get(fp.ENV_SPEC, ""),
+            "hits": fault_hits if fault_hits is not None
+            else fp.hit_counts(),
+        }
+        fname = (f"flight-{now.strftime('%Y%m%dT%H%M%S')}-"
+                 f"{os.getpid()}-{seq:03d}-{_slug(reason)}.json")
+        path = os.path.join(d, fname)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1)
+        os.replace(tmp, path)
+        try:
+            from ..serving import metrics as msm
+            m_dumps = msm.counter(
+                "marian_flight_dumps_total",
+                "Flight-recorder dumps written, by trigger reason",
+                labels=("reason",))
+            m_dumps.labels(reason).inc()
+        except Exception:  # noqa: BLE001
+            pass
+        log.error("FLIGHT RECORDER: {} — dumped span ring + timeline + "
+                  "metrics to {} (open the 'trace' member in Perfetto; "
+                  "docs/OBSERVABILITY.md)", reason, path)
+        return path
+
+
+# Process-wide instance, like TRACER / the metrics REGISTRY.
+FLIGHT = FlightRecorder()

@@ -29,8 +29,10 @@ from __future__ import annotations
 import asyncio
 import json
 import os
-from typing import Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
+from .. import obs
 from ..common import logging as log
 from ..data.batch_generator import bucket_length
 from ..serving import metrics as msm
@@ -48,6 +50,30 @@ except ImportError:  # pragma: no cover
 # graceful-drain budget on shutdown: long enough for a queued maximal batch
 # to finish decoding, far below any orchestrator's kill timeout
 DRAIN_TIMEOUT_S = 30.0
+
+# Request-tracing protocol extension (ISSUE 8, backwards-compatible): a
+# client MAY make the first line of its frame `#trace:<id>` (id: up to 64
+# alnum/-/_ chars — scripts/loadgen.py generates 16-hex ones). The server
+# strips it, labels the request's span tree with the id, and prepends a
+# `#trace:<id> outcome=.. queue_ms=.. service_ms=.. model_version=..`
+# metadata line to the reply, so the client can attribute latency to
+# queue wait vs device service (swap/canary blips become attributable
+# client-side). Clients that send no header see the exact old protocol.
+TRACE_PREFIX = "#trace:"
+_MAX_TRACE_ID = 64
+
+
+def split_trace_header(text: str) -> Tuple[Optional[str], str]:
+    """(trace_id | None, body) — see TRACE_PREFIX above. A malformed id
+    is treated as payload, never an error (the header is advisory)."""
+    if not text.startswith(TRACE_PREFIX):
+        return None, text
+    first, sep, rest = text.partition("\n")
+    tid = first[len(TRACE_PREFIX):].strip()
+    if not tid or len(tid) > _MAX_TRACE_ID \
+            or not all(c.isalnum() or c in "-_" for c in tid):
+        return None, text
+    return tid, rest if sep else ""
 # per-connection cap on bytes the EOF watch may read ahead of the framing
 # parser while a reply is pending — bounds what a flooding pipelined
 # client can make the server buffer
@@ -105,6 +131,10 @@ class ServingApp:
                  executor_factory=None):
         self.options = options
         self.registry = registry if registry is not None else msm.REGISTRY
+        # observability (ISSUE 8): --trace enables the span tracer,
+        # --trace-dump arms the flight recorder; /tracez rides the
+        # metrics port (start() below)
+        obs.configure(options)
         budget = resolve_token_budget(options)
         if translate_lines is None:
             # align the Translate-internal batcher with the scheduler's
@@ -297,8 +327,12 @@ class ServingApp:
 
     async def start(self) -> None:
         self.scheduler.start()
-        routes = self._admin_routes() if self.lifecycle is not None \
-            else None
+        # /tracez is always routed (it reports "tracer disabled" rather
+        # than 404 — operators should not have to guess); admin verbs
+        # only exist with the lifecycle
+        routes = obs.trace_routes()
+        if self.lifecycle is not None:
+            routes.update(self._admin_routes())
         self.metrics_server = msm.maybe_start_metrics_server(
             self.options, ready_fn=self.ready, routes=routes)
         if self.watcher is not None:
@@ -313,28 +347,88 @@ class ServingApp:
 
     async def handle_text(self, text: str, priority: int = 0) -> str:
         """One protocol frame in, one reply frame out — the transport-
-        agnostic request path (admission -> scheduler -> reply)."""
-        lines = text.split("\n")
+        agnostic request path (admission -> scheduler -> reply).
+        Convenience over :meth:`handle_frame` for callers that don't
+        report the reply-write moment."""
+        reply, done = await self.handle_frame(text, priority)
+        done(len(reply.encode("utf-8")))   # nbytes means BYTES everywhere
+        return reply
+
+    async def handle_frame(self, text: str, priority: int = 0
+                           ) -> Tuple[str, Callable[[int], None]]:
+        """(reply, done) — the transports call ``done(nbytes)`` after
+        the reply bytes hit the socket, which closes the request's root
+        span with a ``reply.write`` child covering the write (ISSUE 8:
+        the span tree spans ingest → … → reply write). ``done`` is a
+        no-op when tracing is off."""
+        trace_id, body = split_trace_header(text)
+        lines = body.split("\n")
+        span = None
+        if obs.enabled():
+            span = obs.start_span("request", trace_id=trace_id or None,
+                                  n_sentences=len(lines),
+                                  priority=priority)
+        # reply metadata (queue vs service breakdown) is collected iff
+        # the client asked for it by sending a trace header
+        meta: Optional[Dict] = {} if trace_id is not None else None
         try:
-            self.admission.admit(len(lines))
+            # admit inside the span context so a shed's timeline event
+            # inherits the trace id (flight dumps tie it to the victim)
+            with obs.TRACER.use(span):
+                self.admission.admit(len(lines))
         except Overloaded as e:
-            return f"!!SERVER-OVERLOADED {e}"
-        fut = self.scheduler.submit(
-            lines, priority=priority,
-            timeout=self.request_timeout or None)
+            return self._finish_frame(trace_id, meta, span, "shed",
+                                      f"!!SERVER-OVERLOADED {e}")
+        with obs.TRACER.use(span):
+            fut = self.scheduler.submit(
+                lines, priority=priority,
+                timeout=self.request_timeout or None,
+                meta=meta, trace_id=trace_id)
         try:
             out = await fut
         except RequestTimeout as e:
-            return f"!!SERVER-TIMEOUT {e}"
+            return self._finish_frame(trace_id, meta, span, "timeout",
+                                      f"!!SERVER-TIMEOUT {e}")
         except DispatchStalled as e:
             # watchdog liveness trip: explicitly retriable — the replica
             # is healthy again (fresh device worker), resend the request
-            return f"!!SERVER-RETRY {e}"
+            return self._finish_frame(trace_id, meta, span, "stalled",
+                                      f"!!SERVER-RETRY {e}")
         except asyncio.CancelledError:
+            # client abort: record the root span before unwinding — an
+            # aborted request is exactly what an operator inspects later,
+            # and an un-ended span never reaches the ring
+            obs.end(span, outcome="cancelled")
             raise
         except Exception:  # error already logged by the scheduler
-            return ""
-        return "\n".join(out)
+            return self._finish_frame(trace_id, meta, span, "failure", "")
+        return self._finish_frame(trace_id, meta, span, "ok",
+                                  "\n".join(out))
+
+    @staticmethod
+    def _finish_frame(trace_id: Optional[str], meta: Optional[Dict],
+                      span, outcome: str, reply: str
+                      ) -> Tuple[str, Callable[[int], None]]:
+        """Prepend the reply-metadata header for tracing clients and
+        build the ``done`` callback that records the write + ends the
+        root span."""
+        if trace_id is not None:
+            m = meta or {}
+            reply = (f"{TRACE_PREFIX}{trace_id} "
+                     f"outcome={m.get('outcome', outcome)} "
+                     f"queue_ms={m.get('queue_s', 0.0) * 1e3:.1f} "
+                     f"service_ms={m.get('service_s', 0.0) * 1e3:.1f} "
+                     f"model_version={m.get('model_version', '-')}"
+                     + "\n" + reply)
+        if span is None:
+            return reply, lambda nbytes=0: None
+        t_reply = time.perf_counter()
+
+        def done(nbytes: int = 0) -> None:
+            obs.TRACER.record("reply.write", t_reply, time.perf_counter(),
+                              parent=span, nbytes=nbytes)
+            obs.end(span, outcome=outcome)
+        return reply, done
 
     async def shutdown(self, drain_timeout: float = DRAIN_TIMEOUT_S) -> bool:
         """Drain-on-shutdown: stop admitting (readyz flips to 503 so load
@@ -377,7 +471,17 @@ def _make_ws_handler(app: ServingApp):
     before they cost device time (cancellation propagation)."""
     async def handler(ws):
         async for message in ws:
-            await ws.send(await app.handle_text(message))
+            reply, done = await app.handle_frame(message)
+            nbytes = 0
+            try:
+                await ws.send(reply)
+                # UTF-8 byte count, matching the TCP path — the trace
+                # attribute must mean the same thing on both transports
+                nbytes = len(reply.encode("utf-8"))
+            finally:
+                # root span must close even when the send fails (client
+                # abort is exactly the case an operator inspects later)
+                done(nbytes)
     return handler
 
 
@@ -438,7 +542,7 @@ def _make_tcp_handler(app: ServingApp):
                     break
                 payload = await _readexactly(nbytes)
                 reply_t = asyncio.ensure_future(
-                    app.handle_text(payload.decode("utf-8")))
+                    app.handle_frame(payload.decode("utf-8")))
                 eof = False
                 while not reply_t.done():
                     if len(buf) >= MAX_READAHEAD:
@@ -473,10 +577,18 @@ def _make_tcp_handler(app: ServingApp):
                     except (asyncio.CancelledError, Exception):  # noqa: BLE001
                         pass
                     break
-                reply = await reply_t
+                reply, reply_done = await reply_t
                 out = reply.encode("utf-8")
-                writer.write(b"MTPU %d\n" % len(out) + out)
-                await writer.drain()
+                nbytes = 0
+                try:
+                    writer.write(b"MTPU %d\n" % len(out) + out)
+                    await writer.drain()
+                    nbytes = len(out)
+                finally:
+                    # close the root span even when the write fails —
+                    # a mid-write disconnect must not drop the request's
+                    # span tree from /tracez and flight dumps
+                    reply_done(nbytes)
         except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             pass                     # client went away / malformed frame
         finally:

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import obs
 from ..common import lockdep
 from . import metrics as msm
 
@@ -81,6 +82,10 @@ class AdmissionController:
         admission would split one client's reply across a shed boundary."""
         if self.draining:
             self.m_shed.labels("draining").inc()
+            # shed decisions land on the obs timeline so a flight dump
+            # shows them next to the victims (ISSUE 8); the admit-OK hot
+            # path records nothing
+            obs.event("admission.shed", reason="draining", units=n_units)
             raise Overloaded("server is draining (shutting down); "
                              "retry against another replica",
                              retriable=False)
@@ -88,6 +93,8 @@ class AdmissionController:
             depth = int(self.depth_fn())
             if depth + n_units > self.max_queue_units:
                 self.m_shed.labels("queue_full").inc()
+                obs.event("admission.shed", reason="queue_full",
+                          units=n_units, depth=depth)
                 raise Overloaded(
                     f"queue full ({depth}/{self.max_queue_units} sentences "
                     f"queued, request adds {n_units}); retry later")
@@ -96,7 +103,11 @@ class AdmissionController:
     def begin_drain(self) -> None:
         """Stop admitting; /readyz flips to 503 via the owner's ready_fn.
         Idempotent."""
+        fresh = False
         with self._lock:
             if not self._draining:
                 self._draining = True
                 self._drain_started = time.time()
+                fresh = True
+        if fresh:                       # timeline event OUTSIDE the lock
+            obs.event("admission.drain_started")

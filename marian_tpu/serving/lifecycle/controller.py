@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from ... import obs
 from ...common import faultpoints as fp
 from ...common import lockdep
 from ...common import logging as log
@@ -198,16 +199,19 @@ class SwapController:
             self.registry.transition(seq, reg.REJECTED,
                                      "registry pinned by operator")
             self.m_rejects.labels("pinned").inc()
+            obs.event("lifecycle.rejected", version=name, reason="pinned")
             return v
         try:
             check_compat(v.compat, live.compat if live else None, name)
         except CompatMismatch as e:
             self.registry.transition(seq, reg.REJECTED, str(e))
             self.m_rejects.labels("compat").inc()
+            obs.event("lifecycle.rejected", version=name, reason="compat")
             log.error("model lifecycle: REFUSED incompatible bundle: {}", e)
             return v
         self.registry.transition(seq, reg.WARMING)
         self.m_warming.set(1)
+        obs.event("lifecycle.warming", version=name)
         try:
             executor = warm_executor(bundle_dir, manifest,
                                      self.executor_factory,
@@ -216,6 +220,8 @@ class SwapController:
             # ANY warmup error fails the candidate, never the watcher loop
             self.registry.transition(seq, reg.FAILED, str(e))
             self.m_rejects.labels("warmup").inc()
+            obs.event("lifecycle.warmup_failed", version=name,
+                      error=str(e)[:200])
             log.error("model lifecycle: candidate {} failed warmup: {}",
                       name, e)
             return v
@@ -281,12 +287,15 @@ class SwapController:
             if superseded is not None:
                 self._set_info(superseded)
             self._set_info(v)
+            obs.event("lifecycle.canary", version=v.name,
+                      fraction=self.canary_fraction)
             log.info("model lifecycle: {} serving as canary "
                      "({}% of batches; promotes after {} healthy ones)",
                      v.name, round(self.canary_fraction * 100, 1),
                      self.canary_min_batches)
         else:
             self._swap_to_live(v)
+            obs.event("lifecycle.swap", version=v.name)
 
     def _swap_to_live(self, v: reg.ModelVersion) -> None:
         """THE swap: re-point dispatch at ``v`` between batches. The old
@@ -309,6 +318,11 @@ class SwapController:
             self._set_info(old)
         self._set_info(v)
         self.m_swaps.inc()
+        # NOTE: no DIRECT obs call here — the canary-promote path runs
+        # this whole method under _lock, and the only obs-under-_lock
+        # edge the static graph models is the registry.transition
+        # timeline event (see registry.py); callers emit the
+        # lifecycle.swap event at their unlocked sites
         log.info("model lifecycle: SWAP — {} is now live{}", v.name,
                  f" (rollback target: {old.name})" if old else "")
 
@@ -320,6 +334,11 @@ class SwapController:
         ver, fn, is_canary = self._pick()
         if ver is None or fn is None:
             raise RuntimeError("no live model version to dispatch to")
+        # stamp the routing decision onto the scheduler's serve.translate
+        # span (this thread's current span — the scheduler set it before
+        # calling us), so every span tree carries its model_version
+        if obs.enabled():
+            obs.set_attrs(model_version=ver.name, canary=is_canary)
         t0 = time.perf_counter()
         try:
             out = fn(lines)
@@ -368,6 +387,9 @@ class SwapController:
         if live is None or live is failed_canary or fn is None:
             raise RuntimeError("canary batch failed and no live version "
                                "can re-serve it")
+        if obs.enabled():
+            obs.set_attrs(model_version=live.name,
+                          re_served_after=failed_canary.name)
         t0 = time.perf_counter()
         try:
             out = fn(lines)
@@ -444,6 +466,8 @@ class SwapController:
                              "{} batches (failure rate {:.2f}) — "
                              "promoting", canary.name, n, err_rate)
                     self._swap_to_live(canary)
+                obs.event("lifecycle.swap", version=canary.name,
+                          promoted=True)
         except Exception as e:  # noqa: BLE001 — a raced transition or an
             # injected swap/rollback fault aborts THIS evaluation only;
             # routing stands and the next canary batch re-evaluates
@@ -462,6 +486,13 @@ class SwapController:
         self.m_rollbacks.inc()
         log.error("model lifecycle: ROLLBACK — canary {} failed ({}); "
                   "dispatch stays on the live version", canary.name, reason)
+        # post-mortem snapshot (ISSUE 8): the span ring still holds the
+        # canary batches that tripped the threshold — dump them before
+        # they rotate out. Outside the lock, like every obs call here.
+        obs.event("lifecycle.rollback", version=canary.name,
+                  reason=reason, kind="canary")
+        obs.FLIGHT.trip("canary-rollback", detail=reason,
+                        extra={"version": canary.name})
 
     def _maybe_rollback_live(self, live: reg.ModelVersion) -> None:
         """Post-swap safety net: a regressed NEW live rolls back to the
@@ -473,6 +504,7 @@ class SwapController:
             return
         reason = (f"live failure rate {err_rate:.2f} > "
                   f"{self.rollback_error_rate:.2f}")
+        rolled_to = None
         try:
             with self._lock:
                 if self._live is not live:
@@ -481,10 +513,19 @@ class SwapController:
                 if prev is None or prev.executor is None:
                     return                   # boot model: nothing to roll to
                 self._rollback_to(prev, live, reason, auto=True)
+                rolled_to = prev
         except Exception as e:  # noqa: BLE001 — the caller is already on
             # a batch-failure path; a raced/injected rollback error must
             # not mask the original batch exception
             log.warn("model lifecycle: live rollback aborted ({})", e)
+        if rolled_to is not None:
+            # flight dump AFTER the lock is released — dump IO must
+            # never run under control-plane locks (MT-LOCK-BLOCKING)
+            obs.event("lifecycle.rollback", version=live.name,
+                      to=rolled_to.name, reason=reason, kind="live")
+            obs.FLIGHT.trip("live-rollback", detail=reason,
+                            extra={"from": live.name,
+                                   "to": rolled_to.name})
 
     def _rollback_to(self, prev: reg.ModelVersion,
                      cur: reg.ModelVersion, reason: str,
@@ -531,6 +572,10 @@ class SwapController:
                 return False
             self._rollback_to(prev, cur, "manual rollback (admin verb)",
                               auto=False)
+        obs.event("lifecycle.rollback", version=cur.name, to=prev.name,
+                  kind="manual")
+        obs.FLIGHT.trip("manual-rollback",
+                        detail=f"{cur.name} -> {prev.name} (admin verb)")
         return True
 
     def has_live(self) -> bool:

@@ -37,6 +37,7 @@ import os
 import threading
 from typing import Callable, Dict, List, NamedTuple, Optional
 
+from ... import obs
 from ...common import lockdep
 from ...common import logging as log
 from ...training import bundle as bdl
@@ -166,13 +167,23 @@ class ModelRegistry:
                 raise LifecycleError(
                     f"illegal transition {v.state} -> {new_state} "
                     f"for version {v.name} (seq {seq})")
+            old_state = v.state
             log.info("model lifecycle: {} (seq {}) {} -> {}{}",
                      v.name, seq, v.state, new_state,
                      f" ({error})" if error else "")
             v.state = new_state
             if error:
                 v.error = error
-            return v
+        # timeline event after releasing the REGISTRY lock: every
+        # state-machine edge lands on the timeline, so a flight dump
+        # shows the lifecycle history leading up to the trip (ISSUE 8).
+        # NB: callers (SwapController) legally hold the CONTROLLER lock
+        # here — that SwapController._lock -> Tracer._lock edge is the
+        # one modeled obs-under-lock edge in the static graph; do not
+        # add others without extending docs/lock_order.dot's lattice.
+        obs.event("lifecycle.transition", version=v.name, seq=seq,
+                  frm=old_state, to=new_state, reason=error)
+        return v
 
     def in_state(self, *states: str) -> List[ModelVersion]:
         with self._lock:

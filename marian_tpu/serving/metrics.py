@@ -82,19 +82,20 @@ class _Metric:
     def _child(self) -> "_Metric":
         raise NotImplementedError
 
-    def _sample_lines(self, label_values: Tuple[str, ...]) -> List[str]:
+    def _sample_lines(self, label_values: Tuple[str, ...],
+                      exemplars: bool = False) -> List[str]:
         raise NotImplementedError
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             children = dict(self._children)
         if self.label_names:
             for key, child in sorted(children.items()):
-                lines.extend(child._sample_lines(key))
+                lines.extend(child._sample_lines(key, exemplars))
         else:
-            lines.extend(self._sample_lines(()))
+            lines.extend(self._sample_lines((), exemplars))
         return lines
 
 
@@ -122,7 +123,8 @@ class Counter(_Metric):
         with self._lock:
             return self._value
 
-    def _sample_lines(self, lv: Tuple[str, ...]) -> List[str]:
+    def _sample_lines(self, lv: Tuple[str, ...],
+                      exemplars: bool = False) -> List[str]:
         return [f"{self.name}{_label_str(self.label_names, lv)} "
                 f"{_fmt(self.value)}"]
 
@@ -167,13 +169,23 @@ class Gauge(_Metric):
         with self._lock:
             return self._value
 
-    def _sample_lines(self, lv: Tuple[str, ...]) -> List[str]:
+    def _sample_lines(self, lv: Tuple[str, ...],
+                      exemplars: bool = False) -> List[str]:
         return [f"{self.name}{_label_str(self.label_names, lv)} "
                 f"{_fmt(self.value)}"]
 
 
 class Histogram(_Metric):
-    """Cumulative-bucket histogram (latency, batch fill ratio...)."""
+    """Cumulative-bucket histogram (latency, batch fill ratio...).
+
+    ``observe(v, trace_id=...)`` additionally keeps the LAST trace id
+    observed per bucket as an exemplar (ISSUE 8): scraping
+    ``/metrics?exemplars=1`` renders OpenMetrics-style ``# {trace_id=..}``
+    suffixes on the bucket series, so a p99 outlier links straight to
+    its span tree on ``/tracez`` / in a flight dump. The default
+    exposition stays plain text-format 0.0.4 (exemplar suffixes would
+    break strict 0.0.4 parsers, including scripts/loadgen.py's scraper).
+    """
 
     kind = "histogram"
 
@@ -183,6 +195,9 @@ class Histogram(_Metric):
         super().__init__(name, help_, labels)
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)   # +1 for +Inf
+        # last (value, trace_id, unix_ts) per bucket — see class docstring
+        self._exemplars: List[Optional[Tuple[float, str, float]]] = \
+            [None] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
 
@@ -190,15 +205,20 @@ class Histogram(_Metric):
         return Histogram(self.name, self.help, labels=self.label_names,
                          buckets=self.buckets)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         with self._lock:
             self._sum += v
             self._count += 1
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     self._counts[i] += 1
+                    if trace_id:
+                        self._exemplars[i] = (float(v), str(trace_id),
+                                              time.time())
                     return
             self._counts[-1] += 1
+            if trace_id:
+                self._exemplars[-1] = (float(v), str(trace_id), time.time())
 
     @property
     def count(self) -> int:
@@ -214,16 +234,23 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum / self._count if self._count else 0.0
 
-    def _sample_lines(self, lv: Tuple[str, ...]) -> List[str]:
+    def _sample_lines(self, lv: Tuple[str, ...],
+                      exemplars: bool = False) -> List[str]:
         with self._lock:
             counts, total, s = list(self._counts), self._count, self._sum
+            exs = list(self._exemplars) if exemplars else None
         lines = []
         cum = 0
         edges = list(self.buckets) + [float("inf")]
-        for c, edge in zip(counts, edges):
+        for i, (c, edge) in enumerate(zip(counts, edges)):
             cum += c
             le = _label_str(self.label_names + ("le",), lv + (_fmt(edge),))
-            lines.append(f"{self.name}_bucket{le} {cum}")
+            line = f"{self.name}_bucket{le} {cum}"
+            if exs is not None and exs[i] is not None:
+                ev, etid, ets = exs[i]
+                line += (f' # {{trace_id="{etid}"}} {_fmt(ev)} '
+                         f"{ets:.3f}")
+            lines.append(line)
         ls = _label_str(self.label_names, lv)
         lines.append(f"{self.name}_sum{ls} {_fmt(s)}")
         lines.append(f"{self.name}_count{ls} {total}")
@@ -270,12 +297,12 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         out: List[str] = []
         for m in metrics:
-            out.extend(m.render())
+            out.extend(m.render(exemplars))
         return "\n".join(out) + "\n"
 
 
@@ -335,7 +362,12 @@ class MetricsServer:
             def do_GET(self):  # noqa: N802 — http.server API
                 path, _, query = self.path.partition("?")
                 if path == "/metrics":
-                    body = outer.registry.render().encode("utf-8")
+                    # ?exemplars=1: OpenMetrics-style trace-id exemplar
+                    # suffixes on histogram buckets (ISSUE 8) — opt-in,
+                    # the default stays strict text-format 0.0.4
+                    ex = "exemplars=1" in query
+                    body = outer.registry.render(
+                        exemplars=ex).encode("utf-8")
                     self._send(200, body,
                                "text/plain; version=0.0.4; charset=utf-8")
                 elif path == "/healthz":

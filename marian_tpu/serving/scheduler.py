@@ -22,6 +22,13 @@ which the reference applies only at training time):
 - Retry-with-bisection on batch failure: one poison request costs
   O(log batch) retries to isolate, not the whole batch (upgrade over the
   previous one-by-one retry, O(batch) device calls).
+- Observability (ISSUE 8, docs/OBSERVABILITY.md): with the span tracer
+  enabled, every request grows a serve.request → serve.queue /
+  serve.dispatch tree and every device batch a serve.batch →
+  serve.translate span; watchdog trips and poison isolation fire the
+  flight recorder. Tracer off = zero overhead on this hot path (no
+  ring, no lock — tier-1 guarded). The reply-metadata breakdown
+  (``submit(meta=...)``) is tracing-independent: plain timestamps.
 
 Transport-agnostic and model-agnostic: ``translate_lines`` is any callable
 ``List[str] -> List[str]``; tests drive it with stubs under
@@ -35,8 +42,10 @@ import asyncio
 import collections
 import concurrent.futures
 import threading
+import time
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
+from .. import obs
 from ..common import faultpoints as fp
 from ..common import lockdep
 from ..common import logging as log
@@ -69,7 +78,8 @@ def default_length_fn(line: str) -> int:
 class _Request:
     __slots__ = ("lines", "future", "priority", "arrival", "deadline",
                  "results", "remaining", "queued", "first_dispatch",
-                 "timeout_handle", "dead_accounted")
+                 "timeout_handle", "dead_accounted", "trace_id", "span",
+                 "own_root", "q_span", "d_span", "meta")
 
     def __init__(self, lines: List[str], future: "asyncio.Future",
                  priority: int, arrival: float, deadline: Optional[float]):
@@ -89,6 +99,16 @@ class _Request:
         # forming pass can sweep units in that gap, and must only deduct
         # from the dead count what the callback actually added.
         self.dead_accounted = False
+        # observability (ISSUE 8): the request's trace id (client-given
+        # or generated), its span tree handles (root/queue/dispatch —
+        # None with the tracer disabled), and the caller's reply-metadata
+        # dict (queue-wait vs service breakdown, filled at resolution)
+        self.trace_id = ""
+        self.span = None
+        self.own_root = False       # this scheduler opened the root span
+        self.q_span = None
+        self.d_span = None
+        self.meta: Optional[dict] = None
 
 
 class _Unit:
@@ -292,11 +312,21 @@ class ContinuousScheduler:
             return self._queued
 
     def submit(self, lines: List[str], priority: int = 0,
-               timeout: Optional[float] = None) -> "asyncio.Future":
+               timeout: Optional[float] = None,
+               meta: Optional[dict] = None,
+               trace_id: Optional[str] = None) -> "asyncio.Future":
         """Enqueue one request (a list of sentences); returns a future
         resolving to the list of translations in input order. Must be
         called from the event-loop thread (transports live there).
-        Cancel the future to cancel the request."""
+        Cancel the future to cancel the request.
+
+        ``meta`` (optional dict) is filled at resolution time with the
+        request's queue-wait vs service-time breakdown, outcome, model
+        version and trace id — the transport prepends it to the reply
+        for clients that asked (#trace protocol header; loadgen's
+        client-side swap-blip attribution). ``trace_id`` labels the
+        request's span tree; with the tracer enabled and no id given,
+        one is generated (or inherited from the context's span)."""
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
         now = loop.time()
@@ -310,6 +340,23 @@ class ContinuousScheduler:
             return fut
         deadline = now + timeout if timeout and timeout > 0 else None
         req = _Request(lines, fut, priority, now, deadline)
+        req.meta = meta
+        req.trace_id = trace_id or ""
+        if obs.enabled():
+            # span tree: reuse the context's request-root span when the
+            # transport opened one (server.handle_frame); open our own
+            # root for direct scheduler callers (tests, embedders)
+            parent = obs.current()
+            if parent is None:
+                req.span = obs.start_span(
+                    "serve.request", trace_id=trace_id or None,
+                    n_sentences=len(lines), priority=priority)
+                req.own_root = True
+            else:
+                req.span = parent
+            req.trace_id = req.span.trace_id
+            req.q_span = obs.start_span("serve.queue", parent=req.span,
+                                        n_sentences=len(lines))
         self.m_requests.inc()
         with self._state_lock:
             for i, text in enumerate(lines):
@@ -327,19 +374,46 @@ class ContinuousScheduler:
         self._wake.set()
         return fut
 
-    def _outcome(self, outcome: str) -> None:
+    def _outcome(self, outcome: str, req: Optional[_Request] = None,
+                 now: Optional[float] = None) -> None:
         """One request resolved; label with the live model version so a
-        swap-correlated outcome shift is visible per version."""
+        swap-correlated outcome shift is visible per version. With
+        ``req``, also finish its span tree and fill its reply-metadata
+        dict (queue-wait vs service breakdown)."""
         try:
             version = str(self.version_fn())
         except Exception:  # noqa: BLE001 — labeling must never fail a reply
             version = "unknown"
         self.m_outcomes.labels(outcome, version).inc()
+        if req is None:
+            return
+        if now is None:
+            try:
+                now = asyncio.get_event_loop().time()
+            except RuntimeError:  # pragma: no cover — loop gone at teardown
+                now = req.arrival
+        fd = req.first_dispatch
+        queue_s = max(0.0, (fd if fd is not None else now) - req.arrival)
+        service_s = max(0.0, now - fd) if fd is not None else 0.0
+        if req.meta is not None:
+            req.meta.update(trace_id=req.trace_id, outcome=outcome,
+                            model_version=version,
+                            queue_s=round(queue_s, 6),
+                            service_s=round(service_s, 6))
+        if req.d_span is not None:
+            obs.end(req.d_span, outcome=outcome, model_version=version)
+            req.d_span = None
+        if req.q_span is not None:       # resolved while still queued
+            obs.end(req.q_span, outcome=outcome)
+            req.q_span = None
+        if req.own_root and req.span is not None:
+            obs.end(req.span, outcome=outcome, model_version=version)
+            req.span = None
 
     def _expire_request(self, req: _Request, loop) -> None:
         if not req.future.done():
             self.m_timeouts.inc()
-            self._outcome("timeout")
+            self._outcome("timeout", req, loop.time())
             req.future.set_exception(RequestTimeout(
                 f"request deadline expired after "
                 f"{(loop.time() - req.arrival):.3f}s "
@@ -348,7 +422,7 @@ class ContinuousScheduler:
     def _on_request_done(self, fut: "asyncio.Future", req: _Request) -> None:
         if fut.cancelled():
             self.m_cancelled.inc()
-            self._outcome("cancelled")
+            self._outcome("cancelled", req)
         # any units of this request still sitting in lanes are dead until
         # the next forming pass physically sweeps them — discount them
         # from the admission-visible depth IMMEDIATELY (a normal
@@ -374,10 +448,15 @@ class ContinuousScheduler:
                     # idle-edge coalescing pause only; under sustained load
                     # the previous batch's device time IS the window
                     await asyncio.sleep(self.window_s)
+                t_form = time.perf_counter() if obs.enabled() else 0.0
                 batch = self._form_batch(loop.time())
                 if not batch:
                     continue
-                await self._dispatch(batch, loop)
+                # batch-formation cost rides the batch span as an attr
+                # (the forming pass runs under the state lock — no spans
+                # from inside it; timed from out here instead)
+                form_s = (time.perf_counter() - t_form) if t_form else 0.0
+                await self._dispatch(batch, loop, form_s)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — supervision: never die
@@ -445,9 +524,11 @@ class ContinuousScheduler:
                 u.req.queued += 1
         return batch
 
-    async def _dispatch(self, units: List[_Unit], loop) -> None:
+    async def _dispatch(self, units: List[_Unit], loop,
+                        form_s: float = 0.0) -> None:
         self._inflight += 1
         self._inflight_units = list(units)
+        bspan = None
         try:
             now = loop.time()
             rows = len(units)
@@ -461,12 +542,41 @@ class ContinuousScheduler:
             self.m_batch_rows.observe(rows)
             self.m_fill.observe(fill)
             self.m_waste.observe(1.0 - fill)
+            if obs.enabled():
+                # batch-level span: its OWN trace (a batch serves many
+                # requests); member request trace ids ride as attrs and
+                # each member's serve.dispatch span back-references the
+                # batch span id, so the tree is walkable both ways
+                bspan = obs.start_span(
+                    "serve.batch", rows=rows, width=width,
+                    fill=round(fill, 4),
+                    form_ms=round(form_s * 1e3, 3),
+                    traces=sorted({u.req.trace_id for u in units
+                                   if u.req.trace_id}))
+            seen: set = set()
             for u in units:
+                if id(u.req) in seen:     # one request, many sentences
+                    continue
+                seen.add(id(u.req))
                 if u.req.first_dispatch is None:
                     u.req.first_dispatch = now
-                    self.m_ttfb.observe(now - u.req.arrival)
-            await self._translate_units(units, loop)
+                    self.m_ttfb.observe(now - u.req.arrival,
+                                        trace_id=u.req.trace_id or None)
+                    if u.req.q_span is not None:
+                        obs.end(u.req.q_span)
+                        u.req.q_span = None
+                        u.req.d_span = obs.start_span(
+                            "serve.dispatch", parent=u.req.span,
+                            batch_span=bspan.span_id if bspan else "",
+                            rows=rows)
+                elif bspan is not None and u.req.d_span is not None:
+                    # a LATER batch of a request split across batches
+                    u.req.d_span.attrs["batches"] = \
+                        u.req.d_span.attrs.get("batches", 1) + 1
+            await self._translate_units(units, loop, bspan)
         finally:
+            if bspan is not None:
+                obs.end(bspan)
             self._inflight -= 1
             self._inflight_units = []
 
@@ -512,7 +622,8 @@ class ContinuousScheduler:
             except Exception:  # noqa: BLE001
                 pass
 
-    async def _translate_units(self, units: List[_Unit], loop) -> None:
+    async def _translate_units(self, units: List[_Unit], loop,
+                               bspan=None) -> None:
         """One device call for the batch; on failure, bisect: split in two
         and retry each half, recursively, until single-unit batches isolate
         the poison request(s). Cost per poison unit: O(log batch) extra
@@ -520,7 +631,9 @@ class ContinuousScheduler:
         A call that exceeds --dispatch-stall-timeout instead fails the
         WHOLE batch with a retriable DispatchStalled (no bisection — the
         stall is a liveness event, not a poison sentence) and the
-        scheduler moves on."""
+        scheduler moves on. ``bspan`` is the enclosing serve.batch span
+        (None when tracing is off); device calls and bisection retries
+        hang their spans under it."""
         # requests can die (deadline / cancel / a sibling batch's failure)
         # while this batch waited its turn — especially inside bisection
         # retries. Re-filter here so dead sentences never cost a device
@@ -538,7 +651,22 @@ class ContinuousScheduler:
 
             def _device_call():
                 fp.fault_point("serving.translate")
-                return translate(lines)
+                if bspan is None:
+                    return translate(lines)
+                # explicit parent handoff: this runs on the device
+                # worker thread, outside the event loop's context; the
+                # lifecycle SwapController stamps model_version onto
+                # this span from inside route() (TRACER.set_attrs)
+                sp = obs.start_span("serve.translate", parent=bspan,
+                                    rows=len(lines))
+                with obs.TRACER.use(sp):
+                    try:
+                        return translate(lines)
+                    except BaseException as e:
+                        sp.attrs.setdefault("error", repr(e))
+                        raise
+                    finally:
+                        obs.end(sp)
 
             call = loop.run_in_executor(self._executor, _device_call)
             if self.stall_timeout > 0:
@@ -547,12 +675,29 @@ class ContinuousScheduler:
                                                  self.stall_timeout)
                 except asyncio.TimeoutError:
                     self._trip_watchdog(call, len(units))
+                    victims = sorted({u.req.trace_id for u in units
+                                      if u.req.trace_id})
+                    now = loop.time()
                     for u in units:
                         if not u.req.future.done():
-                            self._outcome("stalled")
+                            self._outcome("stalled", u.req, now)
                             u.req.future.set_exception(DispatchStalled(
                                 f"device batch stalled past "
                                 f"{self.stall_timeout}s — retry"))
+                    # spans are ended ABOVE so the dump holds each
+                    # victim's complete ingest→dispatch→failure tree
+                    obs.event("serve.watchdog_trip", rows=len(units),
+                              stall_timeout=self.stall_timeout,
+                              traces=victims)
+                    # async: this coroutine runs ON the event loop, and
+                    # a dump (ring JSON + metrics render + file write)
+                    # must not freeze every connection mid-incident
+                    obs.FLIGHT.trip_async(
+                        "watchdog",
+                        trace_id=victims[0] if victims else None,
+                        detail=f"device batch ({len(units)} sentences) "
+                               f"stalled past {self.stall_timeout}s",
+                        extra={"traces": victims})
                     return
             else:
                 out = await call
@@ -567,16 +712,25 @@ class ContinuousScheduler:
                 u = units[0]
                 if not u.req.future.done():
                     self.m_failures.inc()
-                    self._outcome("failure")
+                    now = loop.time()
+                    self._outcome("failure", u.req, now)
                     log.error("translation error: {}", e)
                     u.req.future.set_exception(RuntimeError(str(e)))
+                    # the poison request is isolated (bisection endpoint
+                    # or a single-sentence batch): record the victim and
+                    # snapshot — the span ring still holds its tree
+                    obs.event("serve.poison_isolated",
+                              trace_id=u.req.trace_id, error=str(e)[:200])
+                    obs.FLIGHT.trip_async(   # off the event loop thread
+                        "poison", trace_id=u.req.trace_id or None,
+                        detail=f"request failed in isolation: {e}")
                 return
             self.m_bisections.inc()
             log.error("batch translation error ({} sentences — bisecting "
                       "to isolate): {}", len(units), e)
             mid = len(units) // 2
-            await self._translate_units(units[:mid], loop)
-            await self._translate_units(units[mid:], loop)
+            await self._translate_units(units[:mid], loop, bspan)
+            await self._translate_units(units[mid:], loop, bspan)
             return
         for u, line in zip(units, out):
             self._complete_unit(u, line, loop)
@@ -592,5 +746,9 @@ class ContinuousScheduler:
                 req.timeout_handle.cancel()
             req.future.set_result([r if r is not None else ""
                                    for r in req.results])
-            self.m_latency.observe(loop.time() - req.arrival)
-            self._outcome("ok")
+            now = loop.time()
+            # trace-id exemplar: a p99 outlier on /metrics?exemplars=1
+            # links straight to this request's span tree (ISSUE 8)
+            self.m_latency.observe(now - req.arrival,
+                                   trace_id=req.trace_id or None)
+            self._outcome("ok", req, now)

@@ -269,14 +269,28 @@ class Train:
                      "first {} updates", wu_n)
 
         # -- epoch loop ------------------------------------------------------
-        from ..common.profiling import (TraceWindow,
+        from ..common.profiling import (StepTimer, TraceWindow,
                                         maybe_start_profile_server)
         maybe_start_profile_server(opts)
+        # observability (ISSUE 8): --trace records train-loop phase spans
+        # into the same process-wide tracer serving uses; --trace-dump
+        # arms the flight recorder (a MARIAN_FAULTS kill dumps the ring)
+        from .. import obs
+        obs.configure(opts)
         # --metrics-port: Prometheus scrape of the train-side series the
         # Scheduler/StepTimer publish (serving/metrics.py — same registry
-        # and types as marian-server, one metrics vocabulary end to end)
+        # and types as marian-server, one metrics vocabulary end to end);
+        # /tracez rides the same port, like marian-server
         from ..serving.metrics import maybe_start_metrics_server
-        maybe_start_metrics_server(opts)
+        maybe_start_metrics_server(opts, routes=obs.trace_routes())
+        # unified phase timer (data wait vs device dispatch vs host
+        # bookkeeping). --trace-sync-phases drains the device at every
+        # boundary so async dispatch cannot shift device seconds into
+        # whichever later phase blocks first — the honest-but-slower
+        # diagnosis mode (obs/profiling.py docstring).
+        stimer = StepTimer(
+            sync_fn=(lambda: jax.block_until_ready(gg.params))
+            if opts.get("trace-sync-phases", False) else None)
         trace = TraceWindow(opts)
         train_key = prng.stream(key, prng.STREAM_DROPOUT)
         # --compact-transfer: ship uint16 tokens + row lengths instead of
@@ -346,6 +360,7 @@ class Train:
             equal to the updates baked into the params."""
             if not win:
                 return None
+            stimer.phase("dispatch")
             trace.tick(state.batches + 1)
             if len(win) == window:
                 outs = gg.update_window([a for a, _ in win],
@@ -358,6 +373,7 @@ class Train:
                     pairs.append((gg.update(a, s0, train_key), b))
             win.clear()
             win_key.clear()
+            stimer.phase("host")
             before_b, before_l = state.batches, state.labels_total
             if pairs[-1][1].corpus_state is not None:
                 last_corpus_state[0] = pairs[-1][1].corpus_state
@@ -369,6 +385,7 @@ class Train:
                 do_validate()
             if scheduler.should_save_since(before_b, before_l):
                 do_save()
+            stimer.phase("data")
             return _check_stop()
 
         while scheduler.keep_going() and not stop:
@@ -377,6 +394,7 @@ class Train:
                                     budget_scale=budget_scale)
             micro: List = []
             rc = None
+            stimer.phase("data")
             for batch in bg:
                 if window > 1:
                     # cheap host-side check per batch: a SIGTERM (or a
@@ -417,13 +435,16 @@ class Train:
                     micro.append(batch)
                     if len(micro) < delay:
                         continue
+                    stimer.phase("dispatch")
                     arrays = [batch_to_arrays(b, compact=compact,
                                               vocab_sizes=vocab_sizes)
                               for b in micro]
                     trace.tick(state.batches + 1)
                     out = gg.update(arrays, state.batches + 1, train_key)
+                    stimer.phase("host")
                     rc = _after_update(out, micro)
                     micro = []
+                    stimer.phase("data")
                 if rc == "exit":
                     return
                 if rc is not None:
@@ -438,6 +459,8 @@ class Train:
                 else:
                     scheduler.new_epoch()
         trace.close()
+        stimer.stop()
+        stimer.report()         # phase breakdown + metrics mirror
         scheduler.close()       # flush buffered TensorBoard scalars
         log.info("Training finished")
         do_save()

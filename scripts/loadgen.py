@@ -38,16 +38,64 @@ Swap-under-load recipe (docs/DEPLOYMENT.md walks through it):
     # mid-run: commit a new bundle (e.g. a training save) and watch the
     # per-window table + the marian_lifecycle_swaps_total delta; zero
     # failed requests and at most a one-window p99 blip is the contract.
+
+Request tracing (ISSUE 8, default ON — ``--no-trace`` to disable): each
+request carries a ``#trace:<id>`` header; the server's reply metadata
+splits latency into queue wait vs device service per request, reported
+as an overall breakdown (closed-loop mode) and as q_p50/q_p99 +
+svc_p50/svc_p99 window columns (streaming mode) — so a swap blip is
+attributable client-side, and any request's id can be looked up on the
+server's ``/tracez`` or in a flight-recorder dump
+(docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import os
+import random
 import statistics
 import sys
 import time
 import urllib.request
+
+# ---------------------------------------------------------------------------
+# request tracing (ISSUE 8): unless --no-trace, every request carries a
+# `#trace:<id>` first line; the server strips it, labels the request's
+# span tree with the id, and prepends a reply-metadata line
+#   #trace:<id> outcome=.. queue_ms=.. service_ms=.. model_version=..
+# so the client can split its measured latency into queue wait vs device
+# service — a swap/canary blip becomes attributable CLIENT-side (is the
+# p99 bump queueing behind the warmup, or slower decodes on the canary?)
+# and the id links to the server's /tracez span tree / flight dumps.
+# ---------------------------------------------------------------------------
+
+TRACE_PREFIX = "#trace:"
+
+
+def make_trace_id(i: int) -> str:
+    return f"lg{os.getpid() % 100000:05d}{i:06d}{random.getrandbits(24):06x}"
+
+
+def split_reply_meta(reply: str):
+    """(meta dict | None, body) — parse the server's reply-metadata line.
+    queue/service come back in seconds (floats) under 'queue_s'/
+    'service_s'; other keys stay strings."""
+    if not reply.startswith(TRACE_PREFIX):
+        return None, reply
+    first, _, body = reply.partition("\n")
+    meta = {"trace_id": first.split()[0][len(TRACE_PREFIX):]}
+    for part in first.split()[1:]:
+        k, _, v = part.partition("=")
+        if k in ("queue_ms", "service_ms"):
+            try:
+                meta[k[:-3] + "_s"] = float(v) / 1e3
+            except ValueError:
+                pass
+        else:
+            meta[k] = v
+    return meta, body
 
 
 # ---------------------------------------------------------------------------
@@ -113,13 +161,20 @@ def make_sentence(client: int, req: int, sent: int, words: int) -> str:
 
 async def run_clients(args, request_fn):
     latencies: list = []
+    queue_waits: list = []
+    service_times: list = []
     errors = {"overloaded": 0, "timeout": 0, "other": 0}
+    trace = not args.no_trace
 
     async def one_client(cid: int):
         for r in range(args.requests):
             text = "\n".join(
                 make_sentence(cid, r, s, args.words)
                 for s in range(args.sentences))
+            if trace:
+                text = (TRACE_PREFIX
+                        + make_trace_id(cid * args.requests + r)
+                        + "\n" + text)
             t0 = time.perf_counter()
             try:
                 reply = await request_fn(args.host, args.port, text)
@@ -128,17 +183,21 @@ async def run_clients(args, request_fn):
                 print(f"client {cid} req {r}: {e}", file=sys.stderr)
                 continue
             dt = time.perf_counter() - t0
+            meta, reply = split_reply_meta(reply)
             if reply.startswith("!!SERVER-OVERLOADED"):
                 errors["overloaded"] += 1
             elif reply.startswith("!!SERVER-TIMEOUT"):
                 errors["timeout"] += 1
             else:
                 latencies.append(dt)
+                if meta and "queue_s" in meta:
+                    queue_waits.append(meta["queue_s"])
+                    service_times.append(meta.get("service_s", 0.0))
 
     t0 = time.perf_counter()
     await asyncio.gather(*[one_client(c) for c in range(args.clients)])
     wall = time.perf_counter() - t0
-    return latencies, errors, wall
+    return latencies, errors, wall, queue_waits, service_times
 
 
 def pct(vals, q):
@@ -155,23 +214,32 @@ def pct(vals, q):
 async def run_stream(args, request_fn):
     """Fire requests at a constant --rate for --duration seconds, start
     times fixed by the schedule (open loop). Returns
-    [(t_start_rel, latency_s, kind)] with kind in ok/overloaded/timeout/
-    retry/other."""
+    [(t_start_rel, latency_s, kind, queue_s, service_s)] with kind in
+    ok/overloaded/timeout/retry/other; queue_s/service_s are None
+    without reply metadata (--no-trace). NOTE: the #trace header is an
+    extension of THIS repo's server — against a server without it, the
+    header line would be translated as an extra sentence; pass
+    --no-trace there."""
     results: list = []
+    trace = not args.no_trace
 
     async def fire(i: int):
         text = "\n".join(make_sentence(i, i >> 3, s, args.words)
                          for s in range(args.sentences))
+        if trace:
+            text = TRACE_PREFIX + make_trace_id(i) + "\n" + text
         rel = time.perf_counter() - t0
         t = time.perf_counter()
         try:
             reply = await request_fn(args.host, args.port, text)
         except Exception as e:  # noqa: BLE001
-            results.append((rel, time.perf_counter() - t, "other"))
+            results.append((rel, time.perf_counter() - t, "other",
+                            None, None))
             if args.verbose:
                 print(f"req {i}: {e}", file=sys.stderr)
             return
         dt = time.perf_counter() - t
+        meta, reply = split_reply_meta(reply)
         if reply.startswith("!!SERVER-OVERLOADED"):
             kind = "overloaded"
         elif reply.startswith("!!SERVER-TIMEOUT"):
@@ -180,7 +248,9 @@ async def run_stream(args, request_fn):
             kind = "retry"
         else:
             kind = "ok"
-        results.append((rel, dt, kind))
+        results.append((rel, dt, kind,
+                        meta.get("queue_s") if meta else None,
+                        meta.get("service_s") if meta else None))
 
     t0 = time.perf_counter()
     tasks = []
@@ -204,26 +274,43 @@ async def run_stream(args, request_fn):
 def report_windows(results, window_s: float) -> None:
     """Per-window latency table keyed by request START time — a queued
     request that started before a swap and resolved after it lands in
-    the window where its latency was incurred."""
+    the window where its latency was incurred. With reply metadata
+    (tracing on), each window also splits latency into queue wait vs
+    device service, so a swap blip is attributable at a glance: q_p99
+    jumping = queued behind the swap; svc_p99 jumping = the new version
+    decodes slower."""
     if not results:
         print("stream: no requests completed")
         return
     last = max(r[0] for r in results)
     n_windows = int(last // window_s) + 1
-    print(f"{'window':>12} {'req':>5} {'ok':>5} {'shed':>5} {'err':>5} "
-          f"{'p50_ms':>8} {'p99_ms':>8} {'max_ms':>8}")
+    have_meta = any(r[3] is not None for r in results)
+    hdr = (f"{'window':>12} {'req':>5} {'ok':>5} {'shed':>5} {'err':>5} "
+           f"{'p50_ms':>8} {'p99_ms':>8} {'max_ms':>8}")
+    if have_meta:
+        hdr += f" {'q_p50':>7} {'q_p99':>7} {'svc_p50':>7} {'svc_p99':>7}"
+    print(hdr)
     for w in range(n_windows):
         rows = [r for r in results
                 if w * window_s <= r[0] < (w + 1) * window_s]
         if not rows:
             continue
-        lat = [dt for _, dt, kind in rows if kind == "ok"]
+        lat = [r[1] for r in rows if r[2] == "ok"]
         shed = sum(1 for r in rows if r[2] == "overloaded")
         err = sum(1 for r in rows if r[2] in ("timeout", "retry", "other"))
-        print(f"[{w * window_s:4.0f}-{(w + 1) * window_s:4.0f}s)"
-              f" {len(rows):>5} {len(lat):>5} {shed:>5} {err:>5} "
-              f"{pct(lat, 0.50) * 1e3:>8.1f} {pct(lat, 0.99) * 1e3:>8.1f} "
-              f"{max(lat) * 1e3 if lat else float('nan'):>8.1f}")
+        line = (f"[{w * window_s:4.0f}-{(w + 1) * window_s:4.0f}s)"
+                f" {len(rows):>5} {len(lat):>5} {shed:>5} {err:>5} "
+                f"{pct(lat, 0.50) * 1e3:>8.1f} "
+                f"{pct(lat, 0.99) * 1e3:>8.1f} "
+                f"{max(lat) * 1e3 if lat else float('nan'):>8.1f}")
+        if have_meta:
+            qs = [r[3] for r in rows if r[2] == "ok" and r[3] is not None]
+            ss = [r[4] for r in rows if r[2] == "ok" and r[4] is not None]
+            line += (f" {pct(qs, 0.50) * 1e3:>7.1f}"
+                     f" {pct(qs, 0.99) * 1e3:>7.1f}"
+                     f" {pct(ss, 0.50) * 1e3:>7.1f}"
+                     f" {pct(ss, 0.99) * 1e3:>7.1f}")
+        print(line)
 
 
 def main(argv=None) -> int:
@@ -253,6 +340,13 @@ def main(argv=None) -> int:
                          "window blip, not an averaged-away artifact)")
     ap.add_argument("--verbose", action="store_true",
                     help="print per-request transport errors")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="do not send #trace request ids (drops the "
+                         "queue-wait vs service-time breakdown the "
+                         "server's reply metadata provides). REQUIRED "
+                         "against servers without this repo's #trace "
+                         "protocol extension — they would translate the "
+                         "header as an extra sentence")
     args = ap.parse_args(argv)
 
     transport = args.transport
@@ -272,7 +366,7 @@ def main(argv=None) -> int:
         results = asyncio.run(run_stream(args, request_fn))
         after = scrape(args.host, args.metrics_port) if args.metrics_port \
             else {}
-        latencies = [dt for _, dt, kind in results if kind == "ok"]
+        latencies = [r[1] for r in results if r[2] == "ok"]
         errors = {"overloaded": sum(1 for r in results
                                     if r[2] == "overloaded"),
                   "timeout": sum(1 for r in results if r[2] == "timeout"),
@@ -294,7 +388,8 @@ def main(argv=None) -> int:
                       f"during the run")
         _report_server_delta(before, after)
         return 0 if n_ok and not errors["other"] else 1
-    latencies, errors, wall = asyncio.run(run_clients(args, request_fn))
+    latencies, errors, wall, queue_waits, service_times = asyncio.run(
+        run_clients(args, request_fn))
     after = scrape(args.host, args.metrics_port) if args.metrics_port \
         else {}
 
@@ -311,6 +406,13 @@ def main(argv=None) -> int:
         print(f"throughput {n_ok / wall:.2f} req/s "
               f"{n_ok * args.sentences / wall:.2f} sentences/s "
               f"(wall {wall:.2f}s)")
+    if queue_waits:
+        # server-reported split of the latency above (reply metadata):
+        # how much was queueing vs device service
+        print(f"breakdown queue p50={pct(queue_waits, 0.50) * 1e3:.1f}ms "
+              f"p99={pct(queue_waits, 0.99) * 1e3:.1f}ms | "
+              f"service p50={pct(service_times, 0.50) * 1e3:.1f}ms "
+              f"p99={pct(service_times, 0.99) * 1e3:.1f}ms")
     _report_server_delta(before, after)
     return 0 if n_ok and not errors["other"] else 1
 

@@ -402,6 +402,122 @@ class TestMetrics:
         assert "bypassing the registry" in fs[0].message
 
 
+class TestSpanHygiene:
+    """MT-SPAN-* (span_hygiene.py — ISSUE 8): manual start_span/end
+    pairs must close on all paths, and no attributes after close."""
+
+    def test_never_closed_flagged(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    do_work()\n", families=["span"])
+        assert rule_ids(fs) == ["MT-SPAN-UNCLOSED"]
+        assert "never closed" in fs[0].message
+
+    def test_conditional_close_flagged(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f(ok):\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    if ok:\n"
+            "        TRACER.end(sp)\n", families=["span"])
+        assert rule_ids(fs) == ["MT-SPAN-UNCLOSED"]
+        assert "all paths" in fs[0].message
+
+    def test_finally_close_ok(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        TRACER.end(sp)\n", families=["span"])
+        assert fs == []
+
+    def test_straight_line_close_ok(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    work()\n"
+            "    TRACER.end(sp)\n", families=["span"])
+        assert fs == []
+
+    def test_nonexistent_method_end_is_not_a_close(self):
+        """Span has no end() method — `sp.end()` raises AttributeError
+        at runtime, so the lint must NOT count it as a close."""
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    sp.end()\n", families=["span"])
+        assert rule_ids(fs) == ["MT-SPAN-UNCLOSED"]
+
+    def test_self_guard_close_ok(self):
+        """`if sp is not None: end(sp)` is the close idiom, not a branch
+        (the scheduler's bspan pattern)."""
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER, enabled\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x') if enabled() else None\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        if sp is not None:\n"
+            "            TRACER.end(sp)\n", families=["span"])
+        assert fs == []
+
+    def test_escaped_span_skipped(self):
+        """Returned / stored / passed-on spans have their lifetime owned
+        elsewhere — out of local-analysis scope (server.handle_frame)."""
+        for tail in ("    return sp\n",
+                     "    self.sp = sp\n",
+                     "    finish(sp)\n"):
+            fs = lint_text(
+                "from marian_tpu.obs import TRACER\n"
+                "def f(self):\n"
+                "    sp = TRACER.start_span('x')\n" + tail,
+                families=["span"])
+            assert fs == [], tail
+
+    def test_attr_after_close_flagged(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    TRACER.end(sp)\n"
+            "    sp.set_attrs(late=1)\n", families=["span"])
+        assert "MT-SPAN-LATE" in rule_ids(fs)
+
+    def test_attrs_subscript_after_close_flagged(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    TRACER.end(sp)\n"
+            "    sp.attrs['late'] = 1\n", families=["span"])
+        assert "MT-SPAN-LATE" in rule_ids(fs)
+
+    def test_attr_before_close_ok(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    sp.set_attrs(early=1)\n"
+            "    TRACER.end(sp)\n", families=["span"])
+        assert fs == []
+
+    def test_with_span_cm_ok(self):
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    with TRACER.span('x') as sp:\n"
+            "        sp.set_attrs(k=1)\n", families=["span"])
+        assert fs == []
+
+
 # ---------------------------------------------------------------------------
 # suppression, config, baseline, CLI, gate
 # ---------------------------------------------------------------------------
@@ -538,7 +654,8 @@ class TestConfig:
         families = {r.family for r in all_rules()}
         assert families == {"trace-safety", "host-sync", "donation",
                             "dtype", "guarded-by", "metrics", "faults",
-                            "lock-order", "lock-blocking", "guard-escape"}
+                            "lock-order", "lock-blocking", "guard-escape",
+                            "span"}
 
 
 BAD_OPS = ("import jax.numpy as jnp\n"
