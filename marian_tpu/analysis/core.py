@@ -32,7 +32,10 @@ SUPPRESS_TAG = "mtlint:"
 # a rule upgrade can never serve stale per-file verdicts.
 # v4: MT-SPAN family (span_hygiene) + callgraph resolves package
 #     re-export calls (obs.event -> Tracer.event lock edges).
-RULESET_VERSION = 4
+# v5: MT-METRIC-UNTESTED (every registered metric name must be exercised
+#     by tests/ — the metrics mirror of MT-FAULT-UNTESTED) +
+#     MT-SPAN-UNCLOSED recognizes the keyword close form `end(span=sp)`.
+RULESET_VERSION = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,6 +187,48 @@ def const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
             vals.append(elt.value)
         return tuple(vals)
     return None
+
+
+_TESTS_CORPUS_CACHE: Dict[str, str] = {}
+
+
+def tests_string_corpus(config: "Config") -> str:
+    """Every STRING CONSTANT in every file under ``<root>/tests``,
+    newline-joined — the "is this name ever exercised by a test" corpus
+    shared by the fault- and metrics-hygiene UNTESTED rules. String
+    constants (not raw text) so a name mentioned only in a comment does
+    not count as coverage; a file that fails to parse falls back to raw
+    text so one broken test file cannot mass-flag a catalog.
+
+    Memoized per root for the life of the process: both UNTESTED rules
+    call it on every project run, and re-parsing the whole tests/ tree
+    twice per lint would grow pre-commit latency with every PR. (A CLI
+    run is one-shot; in a long-lived process edits to tests/ after the
+    first lint are not picked up — acceptable for an advisory corpus.)
+    """
+    key = str(config.root.resolve())
+    cached = _TESTS_CORPUS_CACHE.get(key)
+    if cached is not None:
+        return cached
+    tests_dir = config.root / "tests"
+    chunks: List[str] = []
+    if tests_dir.is_dir():
+        for p in sorted(tests_dir.rglob("*.py")):
+            try:
+                text = p.read_text(encoding="utf-8")
+            except OSError:
+                continue
+            try:
+                tree = ast.parse(text)
+            except SyntaxError:
+                chunks.append(text)
+                continue
+            chunks.extend(n.value for n in ast.walk(tree)
+                          if isinstance(n, ast.Constant)
+                          and isinstance(n.value, str))
+    corpus = "\n".join(chunks)
+    _TESTS_CORPUS_CACHE[key] = corpus
+    return corpus
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import Config, Finding, Source, call_name
+from ..core import Config, Finding, Source, call_name, tests_string_corpus
 from . import Rule, register
 
 FAULTPOINTS_FILE = "faultpoints.py"
@@ -49,30 +49,12 @@ def _catalog_names(sources: List[Source]) -> Tuple[Optional[Source],
 
 
 def _tests_text(config: Config) -> str:
-    """Every STRING CONSTANT in every test file, concatenated — the 'is
-    this point ever injected' corpus. String constants (not raw text)
-    because fault names live inside spec strings ("ckpt.commit=kill@2")
-    which an identifier walk would miss, while a name mentioned only in
-    a comment ('# we deliberately skip ckpt.publish') must NOT count as
-    coverage. Files that fail to parse fall back to raw text — a broken
-    test file should not mass-flag the catalog."""
-    tests_dir = config.root / "tests"
-    chunks: List[str] = []
-    if tests_dir.is_dir():
-        for p in sorted(tests_dir.rglob("*.py")):
-            try:
-                text = p.read_text(encoding="utf-8")
-            except OSError:
-                continue
-            try:
-                tree = ast.parse(text)
-            except SyntaxError:
-                chunks.append(text)
-                continue
-            chunks.extend(n.value for n in ast.walk(tree)
-                          if isinstance(n, ast.Constant)
-                          and isinstance(n.value, str))
-    return "\n".join(chunks)
+    """The 'is this point ever injected' corpus: every string constant
+    under tests/ (core.tests_string_corpus — shared with the metrics
+    UNTESTED rule since RULESET v5). Fault names live inside spec
+    strings ("ckpt.commit=kill@2") which an identifier walk would miss,
+    while a name mentioned only in a comment must NOT count."""
+    return tests_string_corpus(config)
 
 
 @register

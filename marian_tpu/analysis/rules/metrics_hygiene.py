@@ -12,6 +12,12 @@ emits into it must agree — project-scoped (cross-file) analysis.
   naming convention) that was never bound from a Registry factory —
   including direct `Counter(...)` construction, which bypasses the registry
   so the series silently never appears on /metrics.
+
+- MT-METRIC-UNTESTED (RULESET v5, ISSUE 9): a registered metric name
+  that appears in no string constant under ``tests/`` — the metrics
+  mirror of MT-FAULT-UNTESTED. A series nobody scrapes in a test is an
+  observability claim nobody verifies: it can silently stop being
+  emitted, or break the exposition format, without a test going red.
 """
 
 from __future__ import annotations
@@ -19,7 +25,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..core import Config, Finding, Source, call_name, dotted_name, parent
+from ..core import (Config, Finding, Source, call_name, dotted_name,
+                    parent, tests_string_corpus)
 from . import Rule, register
 
 FACTORY_METHODS = {"counter", "gauge", "histogram"}
@@ -65,7 +72,7 @@ def _metric_shaped(segment: str) -> bool:
 @register
 class MetricsHygieneRule(Rule):
     family = "metrics"
-    ids = ("MT-METRIC-UNUSED", "MT-METRIC-UNREG")
+    ids = ("MT-METRIC-UNUSED", "MT-METRIC-UNREG", "MT-METRIC-UNTESTED")
     scope = "project"
 
     def check_project(self, sources: List[Source],
@@ -135,4 +142,19 @@ class MetricsHygieneRule(Rule):
                 f"series will never appear on /metrics",
                 hint="register it via Registry.counter/gauge/histogram "
                      "(get-or-create) instead"))
+        if registrations:
+            tests = tests_string_corpus(config)
+            for metric, regs in sorted(registrations.items()):
+                if metric in tests:
+                    continue
+                src, node, _seg = regs[0]
+                findings.append(src.finding(
+                    "MT-METRIC-UNTESTED", node,
+                    f"metric '{metric}' is exercised by no test (its "
+                    f"name appears in no string under tests/) — a "
+                    f"series nobody scrapes in a test can silently stop "
+                    f"being emitted",
+                    hint="assert the name appears in a real registry "
+                         "render/scrape in a test (the metric-census "
+                         "tests are the usual home)"))
         return findings

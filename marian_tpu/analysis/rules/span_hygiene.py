@@ -44,17 +44,23 @@ def _tail(name: Optional[str]) -> str:
 
 
 def _is_end_call(node: ast.Call, var: str) -> bool:
-    """``TRACER.end(sp)`` / ``obs.end(sp)`` — the span as the first
-    positional arg. Deliberately NOT a ``sp.end()`` method form: Span
-    has no end() method (recording is the tracer's job), so blessing it
-    here would approve code that raises AttributeError at runtime."""
+    """``TRACER.end(sp)`` / ``obs.end(sp)`` / ``end(span=sp)`` — the
+    span as the first positional arg or the ``span=`` keyword (RULESET
+    v5: Tracer.end's parameter is named ``span``, and the keyword form
+    previously read as an escape, silencing the UNCLOSED analysis).
+    Deliberately NOT a ``sp.end()`` method form: Span has no end()
+    method (recording is the tracer's job), so blessing it here would
+    approve code that raises AttributeError at runtime."""
     name = call_name(node) or ""
     if _tail(name) != END_TAIL:
         return False
     if name.split(".")[0] == var:          # sp.end(...): not a close —
         return False                       # no such method on Span
-    return bool(node.args) and isinstance(node.args[0], ast.Name) \
-        and node.args[0].id == var
+    if bool(node.args) and isinstance(node.args[0], ast.Name) \
+            and node.args[0].id == var:
+        return True
+    return any(kw.arg == "span" and isinstance(kw.value, ast.Name)
+               and kw.value.id == var for kw in node.keywords)
 
 
 def _is_use_call(node: ast.Call, var: str) -> bool:

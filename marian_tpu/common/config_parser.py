@@ -341,6 +341,12 @@ _TRANSLATION = [
     _f("trace-ring", int, 4096, "With --trace: span ring capacity — how many most-recent spans /tracez and flight-recorder dumps can see (TPU extension)", "translate"),
     _f("trace-dump", str, "", "Arm the crash flight recorder (implies --trace): on a dispatch-watchdog trip, a canary/live auto-rollback, a poison-request isolation, or an injected MARIAN_FAULTS kill, snapshot the span ring + event timeline + /metrics to a timestamped JSON file in this directory (docs/OBSERVABILITY.md runbook) (TPU extension)", "translate"),
     _f("trace-sync-phases", bool, False, "Honest train-loop phase timing: drain the device (block_until_ready) at every StepTimer phase boundary so async dispatch cannot shift device seconds into whichever later phase blocks first. Serializes host and device — a diagnosis mode, not a throughput config (TPU extension)", "translate"),
+    _f("perf-accounting", bool, True, "Live performance & capacity plane (obs/perf.py): per-batch chip-seconds/token, tokens/s, MFU-vs-analytic-roofline and capacity-headroom gauges on /metrics, plus per-shape-bucket jit-compile telemetry (boot/swap warmup vs steady-state recompiles — a steady-state recompile is a latency incident and lands on the event timeline). One counter update per device batch; `--perf-accounting false` restores the strictly lock-free batch path (TPU extension)", "translate"),
+    _f("warmup-on-boot", bool, False, "marian-server: golden-warm every serving width bucket BEFORE accepting the first request (one jit compile per bucket off the serving path, reported as trigger=boot-warmup compile telemetry) instead of letting the first request of each bucket pay the compile inline (TPU extension)", "translate"),
+    _f("slo-availability", float, 0.0, "Declare an availability SLO (e.g. 0.999): the in-process burn-rate engine (obs/slo.py) evaluates ok-vs-(failure|timeout|stalled) outcomes over fast/slow windows, exports marian_slo_* gauges and GET /sloz, emits timeline events on threshold crossings and fires a flight dump on fast burn (0 = off) (TPU extension)", "translate"),
+    _f("slo-p99-ms", float, 0.0, "Declare a latency SLO: 99% of requests must resolve under this many milliseconds (evaluated against the request-latency histogram buckets, conservatively rounded DOWN to a bucket edge). Same burn-rate machinery and exports as --slo-availability (0 = off) (TPU extension)", "translate"),
+    _f("slo-window", float, 60.0, "SLO engine short (fast-burn) window in seconds; the slow window is 10x this (TPU extension)", "translate"),
+    _f("slo-eval-interval", float, 2.0, "SLO engine evaluation cadence in seconds (its own daemon thread; nothing on the batch path) (TPU extension)", "translate"),
     _f("fuse", bool, False, "(compat; XLA always fuses)", "translate"),
     _f("gemm-type", str, "float32", "float32, bfloat16, int8 (TPU AQT path), intgemm8/packed* map to int8", "translate"),
     _f("quantize-range", float, 0.0, "Quantization clip range in stddevs (0 = absmax)", "translate"),

@@ -53,6 +53,40 @@ def transformer_train_flops(emb: int, ffn: int, enc_depth: int,
     return 3.0 * (enc + dec + logits)
 
 
+def transformer_serve_flops(emb: int, ffn: int, enc_depth: int,
+                            dec_depth: int, vocab: int,
+                            src_tokens: float, trg_tokens: float,
+                            src_width: int, trg_width: int,
+                            beam: int = 1) -> float:
+    """Matmul FLOPs for serving ONE batch: encoder forward over the real
+    source tokens plus incremental beam decode of the real target
+    tokens. The live-MFU companion of :func:`transformer_train_flops`
+    (obs/perf.py — ISSUE 9).
+
+    Conventions as above (real tokens, padded widths for attention
+    spans), plus decode-specifics:
+    - every generated target token is paid ``beam`` times (each beam
+      hypothesis runs the full decoder stack per step);
+    - self-attention over the growing cache is priced at the AVERAGE
+      past length ``trg_width/2`` (the cache grows 0..trg_width);
+    - cross K/V projections are paid once per source token (cached);
+    - the output projection prices the full vocab (no shortlist
+      discount — the gauge should read LOW when a shortlist would
+      help, same reasoning as padding lowering MFU).
+    """
+    d, f = float(emb), float(ffn)
+    enc_tok = 8 * d * d + 4 * d * f + 4 * src_width * d
+    enc = enc_depth * src_tokens * enc_tok
+    dec_tok = (8 * d * d + 4 * (trg_width / 2.0) * d   # self + cache
+               + 4 * d * d + 4 * src_width * d         # cross Q/out+scores
+               + 4 * d * f)                            # FFN
+    rows = max(1, int(beam))
+    dec = dec_depth * (trg_tokens * rows * dec_tok
+                       + 4 * d * d * src_tokens)       # cross K/V once
+    logits = 2 * d * float(vocab) * trg_tokens * rows
+    return enc + dec + logits
+
+
 # Published peak dense bf16 FLOPs/s per JAX DEVICE. On v2/v3 a chip has
 # two TensorCores and jax.devices() lists each core as its own device,
 # so the per-device peak is HALF the published per-chip number; v4

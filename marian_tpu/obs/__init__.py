@@ -19,12 +19,14 @@ from __future__ import annotations
 import os
 
 from .flight import FLIGHT, FlightRecorder               # noqa: F401
+from .perf import PERF, PerfMeter                        # noqa: F401
 from .trace import (NOOP_SPAN, Span, Tracer, TRACER,     # noqa: F401
                     current, enabled, end, event, new_trace_id, set_attrs,
                     span, start_span, trace_routes)
 
 ENV_TRACE = "MARIAN_TRACE"
 ENV_DUMP = "MARIAN_TRACE_DUMP"
+ENV_PERF = "MARIAN_PERF"
 
 _FIRE_HOOKED = False
 
@@ -53,6 +55,11 @@ def configure(options=None) -> bool:
     - ``--trace-ring N``: span ring capacity (default 4096).
     - ``--trace-dump DIR`` / ``MARIAN_TRACE_DUMP``: arm the flight
       recorder (implies ``--trace`` — a dump without spans is useless).
+    - ``--perf-accounting`` / ``MARIAN_PERF=1``: enable the live
+      perf/capacity plane (obs/perf.py — ISSUE 9). The CLI parser
+      defaults this ON for real server/trainer runs; hand-built Options
+      without the key leave it off, so bare test fixtures keep the
+      zero-overhead batch path.
     """
     get = options.get if options is not None else (lambda *_a: None)
     ring = int(get("trace-ring", 0) or 0)
@@ -65,4 +72,8 @@ def configure(options=None) -> bool:
         _hook_faultpoints()
     if dump:
         FLIGHT.arm(dump)
+    if bool(get("perf-accounting", False)) \
+            or os.environ.get(ENV_PERF, "") == "1":
+        PERF.enable()
+        FLIGHT.add_snapshot_provider("perf", PERF.state)
     return TRACER.enabled

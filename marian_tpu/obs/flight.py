@@ -57,6 +57,22 @@ class FlightRecorder:
         self._dir: Optional[str] = None     # guarded-by: _lock
         self._seq = 0                       # guarded-by: _lock
         self._kill_hooked = False           # guarded-by: _lock
+        # extra state snapshotted into every dump (ISSUE 9: the SLO
+        # engine and the perf meter register here, so a post-mortem
+        # shows the promise being broken — burn rates, budget, headroom
+        # — not just the latencies); key -> zero-arg JSON-ready callable
+        self._providers: Dict[str, object] = {}   # guarded-by: _lock
+
+    def add_snapshot_provider(self, key: str, fn) -> None:
+        """Register ``fn()`` to be embedded as payload[key] in every
+        future dump. Re-registering a key replaces it; a raising
+        provider degrades to an error string, never a failed dump."""
+        with self._lock:
+            self._providers[key] = fn
+
+    def remove_snapshot_provider(self, key: str) -> None:
+        with self._lock:
+            self._providers.pop(key, None)
 
     def arm(self, dump_dir: str) -> None:
         """Point dumps at ``dump_dir`` (created if missing) and hook the
@@ -153,6 +169,13 @@ class FlightRecorder:
         }
         if extra:
             payload["extra"] = dict(extra)
+        with self._lock:
+            providers = dict(self._providers)
+        for key, fn in sorted(providers.items()):
+            try:
+                payload[key] = fn()
+            except Exception as e:  # noqa: BLE001 — best-effort snapshot
+                payload[key] = f"unavailable: {e}"
         try:
             from ..serving import metrics as msm   # lazy: no import cycle
             payload["metrics"] = msm.REGISTRY.render()

@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .. import obs
 from ..common import logging as log
 from ..data.batch_generator import bucket_length
+from ..obs import slo as mslo
 from ..serving import metrics as msm
 from ..serving.admission import AdmissionController, Overloaded
 from ..serving.scheduler import (ContinuousScheduler, DispatchStalled,
@@ -165,6 +166,28 @@ class ServingApp:
         self.request_timeout = float(options.get("request-timeout", 0) or 0)
         self.metrics_server: Optional[msm.MetricsServer] = None
         self._started = False
+        # perf/capacity plane (ISSUE 9, obs/perf.py): wire the headroom
+        # gauge's admission-pressure inputs and the MFU geometry; both
+        # no-ops when --perf-accounting is off
+        self._perf_wired = obs.PERF.enabled
+        if obs.PERF.enabled:
+            if self.registry is not msm.REGISTRY:
+                # configure() enabled the plane on the process-global
+                # registry; this app scrapes ITS registry — re-declare
+                # the perf series there so /metrics actually shows them
+                # (the global copies stay registered but un-emitted)
+                obs.PERF.enable(registry=self.registry)
+            obs.PERF.set_capacity_inputs(self.scheduler.queued_units,
+                                         self.admission.max_queue_units)
+            self._set_perf_geometry()
+        # SLO burn-rate engine (obs/slo.py): constructed only when an
+        # objective is declared (--slo-availability / --slo-p99-ms);
+        # it reads the scheduler's existing counters on its own thread —
+        # nothing on the batch path
+        self.slo: Optional[mslo.SloEngine] = \
+            mslo.maybe_build_engine(options, self.registry)
+        if self.slo is not None:
+            obs.FLIGHT.add_snapshot_provider("slo", self.slo.state)
         # zero-downtime lifecycle (--model-watch SECONDS): registry +
         # watcher + warmup + swap controller over <model>.bundles/
         self.lifecycle = None
@@ -173,6 +196,27 @@ class ServingApp:
         if watch_s > 0:
             self._init_lifecycle(watch_s, translate_lines,
                                  executor_factory)
+
+    def _set_perf_geometry(self) -> None:
+        """Feed the live-MFU gauges the real model geometry when a real
+        TranslationService is behind the scheduler; injected stubs
+        (tests, load generators) leave the geometry unset and MFU reads
+        0 rather than a guess."""
+        if self.service is None:
+            return
+        try:
+            cfg = getattr(self.service.translator.model, "cfg", None)
+            if cfg is None or not hasattr(cfg, "dim_ffn"):
+                return            # RNN family: no priced decode path
+            obs.PERF.set_geometry(
+                emb=int(cfg.dim_emb), ffn=int(cfg.dim_ffn),
+                enc_depth=int(getattr(cfg, "enc_depth", 6)),
+                dec_depth=int(getattr(cfg, "dec_depth", 6)),
+                vocab=len(self.service.translator.trg_vocab),
+                beam=int(self.options.get("beam-size", 12) or 12))
+        except Exception as e:  # noqa: BLE001 — observability is optional
+            log.warn("perf accounting: could not derive model geometry "
+                     "({}); MFU gauge stays 0", e)
 
     def _model_path(self) -> str:
         models = self.options.get("models", []) or []
@@ -327,14 +371,22 @@ class ServingApp:
 
     async def start(self) -> None:
         self.scheduler.start()
-        # /tracez is always routed (it reports "tracer disabled" rather
-        # than 404 — operators should not have to guess); admin verbs
-        # only exist with the lifecycle
+        # /tracez and /sloz are always routed (they report "disabled"
+        # rather than 404 — operators should not have to guess); admin
+        # verbs only exist with the lifecycle
         routes = obs.trace_routes()
+        routes.update(mslo.slo_routes(lambda: self.slo))
         if self.lifecycle is not None:
             routes.update(self._admin_routes())
         self.metrics_server = msm.maybe_start_metrics_server(
             self.options, ready_fn=self.ready, routes=routes)
+        if self.slo is not None:
+            self.slo.start()
+        if self.options.get("warmup-on-boot", False):
+            # not gated on the perf plane: the user asked for warm
+            # buckets either way — without --perf-accounting only the
+            # compile TELEMETRY is skipped (warm_bucket no-ops)
+            self._boot_warmup()
         if self.watcher is not None:
             self.watcher.start()
         self._started = True
@@ -344,6 +396,30 @@ class ServingApp:
                  self.admission.max_queue_units or "unbounded",
                  f"{self.request_timeout}s" if self.request_timeout
                  else "none")
+
+    def _boot_warmup(self) -> None:
+        """--warmup-on-boot: per-bucket golden warmup of the boot
+        executor BEFORE the first client lands, reported as
+        trigger=boot-warmup compile telemetry (ISSUE 9) — without it the
+        first request of every width bucket pays the jit inline and
+        shows up as a steady-state recompile incident. Failure degrades
+        to a warning: a cold-but-correct server beats no server."""
+        from ..serving.lifecycle.warmup import (DEFAULT_GOLDEN,
+                                                load_golden, smoke_buckets)
+        try:
+            golden = load_golden(
+                self.options.get("warmup-golden", "") or None) \
+                or list(DEFAULT_GOLDEN)
+            # warm under the EXACT label the scheduler will stamp on
+            # batches (its version_fn — "unversioned" without a
+            # lifecycle): a mismatched label would leave every warmed
+            # bucket reading as a steady-state recompile incident
+            version = self.scheduler._version_label()
+            smoke_buckets(self.scheduler.translate_lines, golden,
+                          version, "boot-warmup", "boot model")
+        except Exception as e:  # noqa: BLE001
+            log.warn("--warmup-on-boot failed ({}); first requests pay "
+                     "the jit compile inline", e)
 
     async def handle_text(self, text: str, priority: int = 0) -> str:
         """One protocol frame in, one reply frame out — the transport-
@@ -454,6 +530,16 @@ class ServingApp:
     def close_nowait(self) -> None:
         """Synchronous hard cleanup (cancelled contexts, test teardown)."""
         self._started = False
+        if self._perf_wired:
+            # unwire the process-global headroom gauge from this app's
+            # scheduler: a scrape after close must not sample a dead
+            # scheduler (or keep its model graph alive via the bound
+            # method)
+            obs.PERF.set_capacity_inputs(None, 0)
+            self._perf_wired = False
+        if self.slo is not None:
+            self.slo.stop()
+            obs.FLIGHT.remove_snapshot_provider("slo")
         if self.watcher is not None:
             bdl.remove_commit_hook(self._on_bundle_commit)
             self.watcher.stop()

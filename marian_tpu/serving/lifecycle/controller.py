@@ -213,9 +213,14 @@ class SwapController:
         self.m_warming.set(1)
         obs.event("lifecycle.warming", version=name)
         try:
+            # version-labeled warmup: the perf plane records per-bucket
+            # compile telemetry under trigger=swap-warmup (ISSUE 9), so
+            # the lifecycle swap test can prove zero steady-state
+            # recompiles after a hot-swap
             executor = warm_executor(bundle_dir, manifest,
                                      self.executor_factory,
-                                     self.golden or list(DEFAULT_GOLDEN))
+                                     self.golden or list(DEFAULT_GOLDEN),
+                                     version=name)
         except Exception as e:  # noqa: BLE001 — incl. injected faults:
             # ANY warmup error fails the candidate, never the watcher loop
             self.registry.transition(seq, reg.FAILED, str(e))

@@ -27,11 +27,14 @@ wiring), so a multi-second model load + compile never stalls a batch.
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Callable, Dict, List, Optional
 
 from ...common import faultpoints as fp
 from ...common import logging as log
+from ...data.batch_generator import DEFAULT_LENGTH_BUCKETS, bucket_length
+from ...obs.perf import PERF, TRIGGER_SWAP, width_bucket_key
 from ...training import bundle as bdl
 
 # Built-in golden probe when --warmup-golden is unset: short sentences in
@@ -82,14 +85,65 @@ def check_compat(candidate: Optional[Dict], live: Optional[Dict],
                  why, name)
 
 
+def golden_buckets(golden: List[str],
+                   length_buckets=DEFAULT_LENGTH_BUCKETS
+                   ) -> "collections.OrderedDict":
+    """Group golden sentences by the width bucket their whitespace
+    token count (+EOS, matching the scheduler's default_length_fn)
+    lands on — one group = one warmup call = one jit shape bucket
+    compiled off the serving path (ISSUE 9)."""
+    groups: "collections.OrderedDict[int, List[str]]" = \
+        collections.OrderedDict()
+    for line in golden:
+        w = bucket_length(len(line.split()) + 1, length_buckets)
+        groups.setdefault(w, []).append(line)
+    return groups
+
+
+def smoke_buckets(executor: Callable[[List[str]], List[str]],
+                  golden: List[str], version: str, trigger: str,
+                  where: str) -> None:
+    """Per-bucket golden smoke with compile telemetry (ISSUE 9): one
+    timed executor call per width bucket, reported to the perf meter as
+    a warmup compilation for (version, bucket) — so steady-state
+    traffic landing on a warmed bucket is provably NOT a recompile, and
+    ROADMAP 5's future AOT cache has a hits-vs-misses ledger to beat.
+    A combined one-call smoke would warm only the WIDEST bucket's jit
+    shape (shorter sentences ride padded), so the split is also what
+    makes warmup actually warm the serving shapes. Raises WarmupError
+    like the single-call smoke."""
+    for width, lines in golden_buckets(golden).items():
+        t0 = time.perf_counter()
+        try:
+            with PERF.compile_context(trigger):
+                out = executor(list(lines))
+        except Exception as e:  # noqa: BLE001
+            raise WarmupError(f"golden-set smoke translation failed for "
+                              f"{where} (bucket w{width}): {e}") from e
+        dt = time.perf_counter() - t0
+        if not isinstance(out, (list, tuple)) or len(out) != len(lines):
+            raise WarmupError(
+                f"golden-set smoke returned "
+                f"{len(out) if isinstance(out, (list, tuple)) else type(out).__name__} "
+                f"outputs for {len(lines)} inputs ({where}, bucket "
+                f"w{width}) — reply routing would misalign")
+        PERF.warm_bucket(version, width_bucket_key(width), dt, trigger)
+
+
 def warm_executor(bundle_dir: str, manifest: Optional[Dict],
                   executor_factory: Callable[[str, Optional[Dict]],
                                              Callable[[List[str]],
                                                       List[str]]],
-                  golden: List[str]
+                  golden: List[str],
+                  version: str = "", trigger: str = TRIGGER_SWAP
                   ) -> Callable[[List[str]], List[str]]:
     """Steps 2+3: build the executor and golden-smoke it. Returns the
-    warmed ``translate_lines``; raises WarmupError on any failure."""
+    warmed ``translate_lines``; raises WarmupError on any failure.
+
+    With the perf plane enabled (``--perf-accounting``), the smoke runs
+    per width bucket and each bucket's compile is reported as warmup
+    telemetry (:func:`smoke_buckets`); otherwise the historical single
+    combined call is kept — same refusal semantics, no telemetry."""
     fp.fault_point("lifecycle.warmup")
     t0 = time.perf_counter()
     try:
@@ -98,16 +152,21 @@ def warm_executor(bundle_dir: str, manifest: Optional[Dict],
         raise WarmupError(f"executor load failed for {bundle_dir}: "
                           f"{e}") from e
     t_load = time.perf_counter()
-    try:
-        out = executor(list(golden))
-    except Exception as e:  # noqa: BLE001
-        raise WarmupError(f"golden-set smoke translation failed for "
-                          f"{bundle_dir}: {e}") from e
-    if not isinstance(out, (list, tuple)) or len(out) != len(golden):
-        raise WarmupError(
-            f"golden-set smoke returned {len(out) if isinstance(out, (list, tuple)) else type(out).__name__} "
-            f"outputs for {len(golden)} inputs ({bundle_dir}) — reply "
-            f"routing would misalign")
+    if PERF.enabled:
+        smoke_buckets(executor, golden, version or bundle_dir, trigger,
+                      bundle_dir)
+    else:
+        try:
+            out = executor(list(golden))
+        except Exception as e:  # noqa: BLE001
+            raise WarmupError(f"golden-set smoke translation failed for "
+                              f"{bundle_dir}: {e}") from e
+        if not isinstance(out, (list, tuple)) or len(out) != len(golden):
+            raise WarmupError(
+                f"golden-set smoke returned "
+                f"{len(out) if isinstance(out, (list, tuple)) else type(out).__name__} "
+                f"outputs for {len(golden)} inputs ({bundle_dir}) — reply "
+                f"routing would misalign")
     t_done = time.perf_counter()
     log.info("model lifecycle: warmed {} (load {:.2f}s, golden smoke of "
              "{} sentences {:.2f}s)", bundle_dir, t_load - t0,

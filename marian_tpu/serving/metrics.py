@@ -16,6 +16,7 @@ Exposition format: https://prometheus.io/docs/instrumenting/exposition_formats/
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -81,6 +82,13 @@ class _Metric:
 
     def _child(self) -> "_Metric":
         raise NotImplementedError
+
+    def children(self) -> Dict[Tuple[str, ...], "_Metric"]:
+        """Snapshot of label-value tuple -> child metric — the public
+        read the SLO engine (obs/slo.py) uses to sum a counter across
+        one label dimension without touching private state."""
+        with self._lock:
+            return dict(self._children)
 
     def _sample_lines(self, label_values: Tuple[str, ...],
                       exemplars: bool = False) -> List[str]:
@@ -234,6 +242,14 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum / self._count if self._count else 0.0
 
+    def snapshot(self) -> Tuple[Tuple[float, ...], List[int], int, float]:
+        """(bucket edges, per-bucket counts incl. the +Inf tail, total
+        count, sum) — one consistent read for burn-rate math
+        (obs/slo.py: how many observations sat at or under a latency
+        objective's bucket edge)."""
+        with self._lock:
+            return self.buckets, list(self._counts), self._count, self._sum
+
     def _sample_lines(self, lv: Tuple[str, ...],
                       exemplars: bool = False) -> List[str]:
         with self._lock:
@@ -309,6 +325,66 @@ class Registry:
 # The process-wide default registry: train, translate, and serve all emit
 # here, so one /metrics endpoint exposes the whole process.
 REGISTRY = Registry()
+
+# process start, anchored at import (close enough to exec for the
+# standard process_start_time_seconds semantics)
+_PROCESS_START = time.time()
+
+
+def _rss_bytes() -> float:
+    """Resident set size. /proc on Linux; ru_maxrss (peak) as the
+    portable fallback — better a labeled approximation than no memory
+    signal at all. ru_maxrss units differ by platform: kilobytes on
+    Linux (where /proc usually wins anyway), BYTES on macOS/BSD — an
+    unconditional *1024 would read 1024x high exactly where the
+    fallback is the path taken."""
+    try:
+        with open("/proc/self/statm", "r", encoding="ascii") as fh:
+            pages = int(fh.read().split()[1])
+        return float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+            import sys
+            scale = 1 if sys.platform == "darwin" else 1024
+            return float(resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * scale)
+        except Exception:  # noqa: BLE001 — a scrape must never raise
+            return float("nan")
+
+
+def _open_fds() -> float:
+    try:
+        return float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        return float("nan")
+
+
+def register_process_metrics(registry: Optional[Registry] = None) -> None:
+    """Standard process self-metrics (ISSUE 9 satellite): the scrape
+    surface previously had no view of host-side health — a leaking
+    server looked identical to a healthy one until the OOM killer said
+    otherwise. Names follow the Prometheus client-library convention so
+    stock dashboards/alerts work unchanged. Idempotent (get-or-create),
+    called by every MetricsServer start."""
+    r = registry if registry is not None else REGISTRY
+    m_start = r.gauge(
+        "process_start_time_seconds",
+        "Unix time the process started (well, imported the metrics "
+        "layer)")
+    m_start.set(_PROCESS_START)
+    m_up = r.gauge(
+        "process_uptime_seconds", "Seconds since process start")
+    m_up.set_function(lambda: time.time() - _PROCESS_START)
+    m_rss = r.gauge(
+        "process_resident_memory_bytes",
+        "Resident set size (NaN where /proc and getrusage are both "
+        "unavailable)")
+    m_rss.set_function(_rss_bytes)
+    m_fds = r.gauge(
+        "process_open_fds",
+        "Open file descriptors (NaN without /proc)")
+    m_fds.set_function(_open_fds)
 
 
 def counter(name: str, help_: str = "", labels: Sequence[str] = ()) -> Counter:
@@ -422,6 +498,9 @@ class MetricsServer:
             name="metrics-http")
 
     def start(self) -> "MetricsServer":
+        # any scrape surface gets the standard process self-metrics
+        # (ISSUE 9 satellite) — host-side health next to the app series
+        register_process_metrics(self.registry)
         self._thread.start()
         log.info("Metrics endpoint on port {} (/metrics /healthz /readyz)",
                  self.port)

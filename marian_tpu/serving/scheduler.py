@@ -374,16 +374,19 @@ class ContinuousScheduler:
         self._wake.set()
         return fut
 
+    def _version_label(self) -> str:
+        try:
+            return str(self.version_fn())
+        except Exception:  # noqa: BLE001 — labeling must never fail a reply
+            return "unknown"
+
     def _outcome(self, outcome: str, req: Optional[_Request] = None,
                  now: Optional[float] = None) -> None:
         """One request resolved; label with the live model version so a
         swap-correlated outcome shift is visible per version. With
         ``req``, also finish its span tree and fill its reply-metadata
         dict (queue-wait vs service breakdown)."""
-        try:
-            version = str(self.version_fn())
-        except Exception:  # noqa: BLE001 — labeling must never fail a reply
-            version = "unknown"
+        version = self._version_label()
         self.m_outcomes.labels(outcome, version).inc()
         if req is None:
             return
@@ -529,6 +532,11 @@ class ContinuousScheduler:
         self._inflight += 1
         self._inflight_units = list(units)
         bspan = None
+        # [device seconds, real target tokens, src tokens delivered] for
+        # this batch, summed across bisection retries on the device
+        # worker thread (ISSUE 9: obs/perf.py — the happens-before is
+        # the executor future)
+        dev_acc = [0.0, 0.0, 0.0] if obs.PERF.enabled else None
         try:
             now = loop.time()
             rows = len(units)
@@ -573,9 +581,22 @@ class ContinuousScheduler:
                     # a LATER batch of a request split across batches
                     u.req.d_span.attrs["batches"] = \
                         u.req.d_span.attrs.get("batches", 1) + 1
-            await self._translate_units(units, loop, bspan)
+            await self._translate_units(units, loop, bspan, dev_acc)
+            if dev_acc is not None:
+                # live perf/capacity accounting (obs/perf.py): device
+                # seconds are measured to the host-side result fence on
+                # the worker thread — translate_lines returns host
+                # strings, so the return IS the drain (the StepTimer
+                # sync-honesty discipline) — and include bisection
+                # retries: poison isolation costs real device time
+                obs.PERF.record_batch(
+                    self._version_label(), rows=rows, width=width,
+                    src_tokens=int(dev_acc[2]), trg_tokens=int(dev_acc[1]),
+                    device_s=dev_acc[0])
         finally:
             if bspan is not None:
+                if dev_acc is not None:
+                    bspan.attrs["device_s"] = round(dev_acc[0], 6)
                 obs.end(bspan)
             self._inflight -= 1
             self._inflight_units = []
@@ -623,7 +644,7 @@ class ContinuousScheduler:
                 pass
 
     async def _translate_units(self, units: List[_Unit], loop,
-                               bspan=None) -> None:
+                               bspan=None, dev_acc=None) -> None:
         """One device call for the batch; on failure, bisect: split in two
         and retry each half, recursively, until single-unit batches isolate
         the poison request(s). Cost per poison unit: O(log batch) extra
@@ -641,6 +662,26 @@ class ContinuousScheduler:
         units = [u for u in units if not u.req.future.done()]
         if not units:
             return
+        # the worker thread writes into its OWN accumulator, merged into
+        # dev_acc only once the call has provably completed (a finished
+        # await) — a watchdog-abandoned worker otherwise races its late
+        # finally against record_batch and double-bills device seconds /
+        # counts discarded outputs. Defined OUTSIDE the try: the generic
+        # except below calls _merge_acc, and an injected serving.dispatch
+        # fault raises before the try body gets this far.
+        # dev_acc slots: [device_s, trg_tokens, src_tokens_done] — src
+        # tokens are credited only for units whose results were
+        # DELIVERED (below), so a stalled or failed call never counts
+        # as throughput (cspt/tokens-per-second must spike, not read
+        # "healthy", during an incident)
+        local_acc = [0.0, 0.0] if dev_acc is not None else None
+
+        def _merge_acc():
+            if dev_acc is not None and local_acc is not None:
+                dev_acc[0] += local_acc[0]
+                dev_acc[1] += local_acc[1]
+                local_acc[0] = local_acc[1] = 0.0
+
         try:
             # inside the try so an injected dispatch failure routes
             # through the normal failure path (futures fail explicitly —
@@ -649,10 +690,24 @@ class ContinuousScheduler:
             lines = [u.text for u in units]
             translate = self.translate_lines
 
+            def _call_translate():
+                # device-time fence: translate_lines returns host-side
+                # strings, so the perf_counter read AFTER it is an
+                # honest device-seconds boundary (obs/perf.py)
+                t0 = time.perf_counter()
+                try:
+                    out_ = translate(lines)
+                finally:
+                    if local_acc is not None:
+                        local_acc[0] += time.perf_counter() - t0
+                if local_acc is not None:
+                    local_acc[1] += sum(len(l.split()) for l in out_)
+                return out_
+
             def _device_call():
                 fp.fault_point("serving.translate")
                 if bspan is None:
-                    return translate(lines)
+                    return _call_translate()
                 # explicit parent handoff: this runs on the device
                 # worker thread, outside the event loop's context; the
                 # lifecycle SwapController stamps model_version onto
@@ -661,7 +716,7 @@ class ContinuousScheduler:
                                     rows=len(lines))
                 with obs.TRACER.use(sp):
                     try:
-                        return translate(lines)
+                        return _call_translate()
                     except BaseException as e:
                         sp.attrs.setdefault("error", repr(e))
                         raise
@@ -674,6 +729,15 @@ class ContinuousScheduler:
                     out = await asyncio.wait_for(asyncio.shield(call),
                                                  self.stall_timeout)
                 except asyncio.TimeoutError:
+                    if dev_acc is not None:
+                        # the wedged call's own timing lands in
+                        # local_acc, which is deliberately NOT merged on
+                        # this path (the abandoned worker may still be
+                        # running), but the device WAS busy for at least
+                        # the stall window — bill that, or repeated
+                        # stalls read as busy≈0/headroom≈1 and the
+                        # autoscaler sees "idle" mid-incident
+                        dev_acc[0] += self.stall_timeout
                     self._trip_watchdog(call, len(units))
                     victims = sorted({u.req.trace_id for u in units
                                       if u.req.trace_id})
@@ -701,6 +765,7 @@ class ContinuousScheduler:
                     return
             else:
                 out = await call
+            _merge_acc()        # the await finished: the worker's write
             if len(out) != len(lines):
                 raise RuntimeError(
                     f"translator returned {len(out)} lines for "
@@ -708,6 +773,10 @@ class ContinuousScheduler:
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001
+            # a raising await still completed the worker future, so its
+            # device seconds are safe to merge (zeroed after, so the
+            # arity-check path above cannot double-merge)
+            _merge_acc()
             if len(units) == 1:
                 u = units[0]
                 if not u.req.future.done():
@@ -729,9 +798,13 @@ class ContinuousScheduler:
             log.error("batch translation error ({} sentences — bisecting "
                       "to isolate): {}", len(units), e)
             mid = len(units) // 2
-            await self._translate_units(units[:mid], loop, bspan)
-            await self._translate_units(units[mid:], loop, bspan)
+            await self._translate_units(units[:mid], loop, bspan, dev_acc)
+            await self._translate_units(units[mid:], loop, bspan, dev_acc)
             return
+        if dev_acc is not None:
+            # results delivered: these units' tokens were really
+            # processed (stall/failure paths never reach here)
+            dev_acc[2] += sum(u.tokens for u in units)
         for u, line in zip(units, out):
             self._complete_unit(u, line, loop)
 

@@ -13,6 +13,7 @@ import math
 import time
 from typing import Dict, List, Optional
 
+from .. import obs
 from ..common import logging as log
 from ..common.scheduling_parameter import SchedulingParameter, SchedulingUnit
 from .training_state import TrainingState
@@ -229,6 +230,13 @@ class Scheduler:
         self._m_cost.set(cost)
         self._m_wps.set(wps)
         self._m_lr.set(s.eta)
+        # live capacity accounting (obs/perf.py — ISSUE 9): this window's
+        # dt is already sync-honest (clocked after the one deferred cost
+        # sync above), so chip-seconds/token here is a real number, not
+        # an enqueue-time artifact
+        obs.PERF.record_train_window(labels=self._label_sum,
+                                     src_words=self._words_sum,
+                                     sentences=self._sent_sum, dt=dt)
         try:
             # same number the text line shows (1-based; honors
             # --logical-epoch's fractional display)

@@ -39,6 +39,14 @@ Swap-under-load recipe (docs/DEPLOYMENT.md walks through it):
     # per-window table + the marian_lifecycle_swaps_total delta; zero
     # failed requests and at most a one-window p99 blip is the contract.
 
+Capacity sweep mode (``--sweep "1,2,4,8"``, ISSUE 9 / ROADMAP 4): step
+through offered rates (open loop, ``--duration`` seconds each) and
+print the capacity table — per-step client p50/p99, shed counts, the
+server's chip-seconds/token delta (``marian_perf_*`` integrals) and the
+``marian_capacity_headroom_ratio`` reading. Requires ``--metrics-port``
+and a server running with ``--perf-accounting`` (the default);
+docs/DEPLOYMENT.md "Capacity & autoscaling" interprets the table.
+
 Request tracing (ISSUE 8, default ON — ``--no-trace`` to disable): each
 request carries a ``#trace:<id>`` header; the server's reply metadata
 splits latency into queue wait vs device service per request, reported
@@ -211,7 +219,7 @@ def pct(vals, q):
 # streaming (open-loop) mode: --duration N --rate R
 # ---------------------------------------------------------------------------
 
-async def run_stream(args, request_fn):
+async def run_stream(args, request_fn, rate=None, duration=None):
     """Fire requests at a constant --rate for --duration seconds, start
     times fixed by the schedule (open loop). Returns
     [(t_start_rel, latency_s, kind, queue_s, service_s)] with kind in
@@ -222,6 +230,8 @@ async def run_stream(args, request_fn):
     --no-trace there."""
     results: list = []
     trace = not args.no_trace
+    rate = args.rate if rate is None else rate
+    duration = args.duration if duration is None else duration
 
     async def fire(i: int):
         text = "\n".join(make_sentence(i, i >> 3, s, args.words)
@@ -257,10 +267,10 @@ async def run_stream(args, request_fn):
     i = 0
     while True:
         now = time.perf_counter() - t0
-        if now >= args.duration:
+        if now >= duration:
             break
-        target = i / args.rate
-        if target >= args.duration:
+        target = i / rate
+        if target >= duration:
             break
         if target > now:
             await asyncio.sleep(target - now)
@@ -269,6 +279,95 @@ async def run_stream(args, request_fn):
     if tasks:
         await asyncio.gather(*tasks)
     return results
+
+
+# ---------------------------------------------------------------------------
+# capacity sweep mode: --sweep "1,2,4,8" (ISSUE 9 / ROADMAP 4)
+# ---------------------------------------------------------------------------
+
+async def run_sweep(args, request_fn, rates):
+    """Step through offered rates (open loop, --duration seconds each),
+    recording per-step client latency AND the server's perf-plane
+    readings: chip-seconds/token (delta of the device-seconds and token
+    integrals over the step) and the capacity headroom gauge after the
+    step. The printed table IS the capacity model ROADMAP 4 describes —
+    per-model chip-seconds/token under increasing load, and where the
+    headroom signal says to scale out."""
+    rows = []
+    for rate in rates:
+        before = scrape(args.host, args.metrics_port)
+        t0 = time.perf_counter()
+        results = await run_stream(args, request_fn, rate=rate,
+                                   duration=args.duration)
+        # run_stream gathers the queue DRAIN too — the device seconds in
+        # the delta happened over this elapsed span, not args.duration;
+        # dividing by the shorter duration would overstate busy and
+        # understate headroom at exactly the rates worth measuring
+        elapsed = max(time.perf_counter() - t0, args.duration, 1e-9)
+        after = scrape(args.host, args.metrics_port)
+        lat = [r[1] for r in results if r[2] == "ok"]
+        dev = _delta(before, after, "marian_perf_device_seconds_total")
+        toks = _delta(before, after, "marian_perf_tokens_total")
+        # device_seconds_total is WALL seconds of the device worker;
+        # chip-seconds scales by the replica's device count (all chips
+        # are reserved while the worker runs) — same factor the
+        # marian_perf_chip_seconds_per_token gauge applies
+        n_dev = after.get("marian_perf_devices", 1.0) or 1.0
+        # STEP-LOCAL headroom from the deltas, not the server's
+        # rolling-window gauge: the gauge averages over its whole
+        # window (60s default), so with short steps the earlier,
+        # lighter rates would contaminate the later steps' readings and
+        # overstate sustainable capacity. Queue pressure at step end
+        # shows up in the shed/err columns instead.
+        busy = min(1.0, dev / elapsed)
+        rows.append({
+            "rate": rate,
+            "offered": len(results),
+            "ok": len(lat),
+            "shed": sum(1 for r in results if r[2] == "overloaded"),
+            "err": sum(1 for r in results
+                       if r[2] in ("timeout", "retry", "other")),
+            "p50_ms": pct(lat, 0.50) * 1e3,
+            "p99_ms": pct(lat, 0.99) * 1e3,
+            "chip_s_per_token": dev * n_dev / toks if toks
+            else float("nan"),
+            "headroom": max(0.0, 1.0 - busy),
+            # the server's rolling-window gauge, read back for
+            # cross-checking (it lags the step-local number by design)
+            "hr_gauge": after.get("marian_capacity_headroom_ratio",
+                                  float("nan")),
+        })
+        # settle between steps so one step's queue does not bleed into
+        # the next step's measurements
+        await asyncio.sleep(min(2.0, args.duration / 4))
+    return rows
+
+
+def report_sweep(rows) -> None:
+    # headroom = step-local (1 - device-busy fraction over the step);
+    # hr_gauge = the server's rolling-window marian_capacity_headroom_
+    # ratio at step end (lags across short steps by design)
+    print(f"{'rate/s':>7} {'offered':>8} {'ok':>6} {'shed':>5} {'err':>5} "
+          f"{'p50_ms':>8} {'p99_ms':>8} {'chip_s/tok':>12} "
+          f"{'headroom':>9} {'hr_gauge':>9}")
+    for r in rows:
+        print(f"{r['rate']:>7g} {r['offered']:>8} {r['ok']:>6} "
+              f"{r['shed']:>5} {r['err']:>5} {r['p50_ms']:>8.1f} "
+              f"{r['p99_ms']:>8.1f} {r['chip_s_per_token']:>12.3e} "
+              f"{r['headroom']:>9.3f} {r['hr_gauge']:>9.3f}")
+    ok_rows = [r for r in rows if r["ok"] and not r["shed"]
+               and not r["err"] and r["headroom"] == r["headroom"]
+               and r["headroom"] > 0.1]
+    if ok_rows:
+        best = max(ok_rows, key=lambda r: r["rate"])
+        print(f"capacity: highest clean rate {best['rate']:g} req/s "
+              f"(headroom {best['headroom']:.2f}, "
+              f"{best['chip_s_per_token']:.3e} chip-s/token); scale out "
+              f"before headroom reaches 0 (docs/DEPLOYMENT.md)")
+    else:
+        print("capacity: no clean step (sheds/errors at every rate, or "
+              "headroom exhausted) — this replica is over capacity at "
+              "the lowest offered rate")
 
 
 def report_windows(results, window_s: float) -> None:
@@ -338,6 +437,15 @@ def main(argv=None) -> int:
                     help="streaming mode: report p50/p99 per N-second "
                          "window (a hot-swap under load shows as a "
                          "window blip, not an averaged-away artifact)")
+    ap.add_argument("--sweep", default="",
+                    help="capacity mode (ISSUE 9 / ROADMAP 4): comma-"
+                         "separated offered rates in req/s (e.g. "
+                         "'1,2,4,8'); each runs open-loop for "
+                         "--duration seconds and the table reports "
+                         "per-step p50/p99, shed counts, the server's "
+                         "chip-seconds/token delta and the capacity "
+                         "headroom gauge. Requires --metrics-port and "
+                         "a server running with --perf-accounting")
     ap.add_argument("--verbose", action="store_true",
                     help="print per-request transport errors")
     ap.add_argument("--no-trace", action="store_true",
@@ -357,6 +465,25 @@ def main(argv=None) -> int:
         except ImportError:
             transport = "tcp"
     request_fn = _request_ws if transport == "ws" else _request_tcp
+
+    if args.sweep:
+        if not args.metrics_port:
+            ap.error("--sweep needs --metrics-port (it reads the "
+                     "chip-seconds/token and headroom gauges back)")
+        try:
+            rates = [float(r) for r in args.sweep.split(",") if r.strip()]
+        except ValueError:
+            ap.error(f"--sweep: unparseable rate list {args.sweep!r}")
+        if not rates or any(r <= 0 for r in rates):
+            ap.error("--sweep rates must be positive")
+        if args.duration <= 0:
+            args.duration = 10.0
+        rows = asyncio.run(run_sweep(args, request_fn, rates))
+        print(f"transport={transport} sweep rates={rates} "
+              f"{args.duration:g}s/step "
+              f"sentences/request={args.sentences}")
+        report_sweep(rows)
+        return 0 if any(r["ok"] for r in rows) else 1
 
     before = scrape(args.host, args.metrics_port) if args.metrics_port \
         else {}

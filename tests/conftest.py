@@ -128,6 +128,21 @@ def lockdep_witness():
             + "\n".join(violations))
 
 
+@pytest.fixture(autouse=True)
+def _reset_perf_plane():
+    """The perf/capacity plane (obs/perf.py — ISSUE 9) is process-wide
+    and the CLI parser defaults --perf-accounting ON, so any test that
+    drives a real CLI in-process (marian_train.main and friends)
+    enables it globally. Left enabled it changes behavior tests rely
+    on — e.g. lifecycle warmup becomes per-bucket (multiple golden
+    calls), breaking call-counting stub executors. Disable it again
+    after every test; suites that want it enable it explicitly."""
+    yield
+    from marian_tpu import obs
+    if obs.PERF.enabled:
+        obs.PERF.reset()
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)

@@ -401,6 +401,46 @@ class TestMetrics:
         assert rule_ids(fs) == ["MT-METRIC-UNREG"]
         assert "bypassing the registry" in fs[0].message
 
+    # -- MT-METRIC-UNTESTED (RULESET v5, ISSUE 9) ---------------------------
+
+    UNTESTED_SNIPPET = (
+        "class S:\n"
+        "    def __init__(self, r):\n"
+        "        self.m_x = r.counter('orphan_series_total', 'x')\n"
+        "    def work(self):\n"
+        "        self.m_x.inc()\n")
+
+    def test_untested_metric_flagged(self, tmp_path):
+        # a root with no tests/ dir: the coverage corpus is empty, so
+        # every registered name is a finding
+        cfg = Config(root=tmp_path)
+        fs = lint_text(self.UNTESTED_SNIPPET, families=["metrics"],
+                       config=cfg)
+        assert rule_ids(fs) == ["MT-METRIC-UNTESTED"]
+        assert "orphan_series_total" in fs[0].message
+
+    def test_untested_metric_covered_by_tests_string(self, tmp_path):
+        (tmp_path / "tests").mkdir()
+        (tmp_path / "tests" / "test_scrape.py").write_text(
+            "def test_scrape(r):\n"
+            "    assert 'orphan_series_total' in r.render()\n",
+            encoding="utf-8")
+        cfg = Config(root=tmp_path)
+        fs = lint_text(self.UNTESTED_SNIPPET, families=["metrics"],
+                       config=cfg)
+        assert fs == []
+
+    def test_untested_name_in_comment_does_not_count(self, tmp_path):
+        (tmp_path / "tests").mkdir()
+        (tmp_path / "tests" / "test_scrape.py").write_text(
+            "# we should cover orphan_series_total some day\n"
+            "def test_nothing():\n"
+            "    pass\n", encoding="utf-8")
+        cfg = Config(root=tmp_path)
+        fs = lint_text(self.UNTESTED_SNIPPET, families=["metrics"],
+                       config=cfg)
+        assert rule_ids(fs) == ["MT-METRIC-UNTESTED"]
+
 
 class TestSpanHygiene:
     """MT-SPAN-* (span_hygiene.py — ISSUE 8): manual start_span/end
@@ -454,6 +494,19 @@ class TestSpanHygiene:
             "    sp = TRACER.start_span('x')\n"
             "    sp.end()\n", families=["span"])
         assert rule_ids(fs) == ["MT-SPAN-UNCLOSED"]
+
+    def test_keyword_end_counts_as_close(self):
+        """RULESET v5: Tracer.end's parameter is named ``span`` —
+        ``end(span=sp)`` is a close, not an escape."""
+        fs = lint_text(
+            "from marian_tpu.obs import TRACER\n"
+            "def f():\n"
+            "    sp = TRACER.start_span('x')\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        TRACER.end(span=sp)\n", families=["span"])
+        assert fs == []
 
     def test_self_guard_close_ok(self):
         """`if sp is not None: end(sp)` is the close idiom, not a branch

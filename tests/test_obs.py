@@ -47,6 +47,7 @@ def _reset_obs():
     yield
     obs.TRACER.reset()
     obs.FLIGHT.disarm()
+    obs.PERF.reset()
     fp.reset_for_tests()
 
 
@@ -238,11 +239,19 @@ class TestSchedulerSpans:
         assert meta["service_s"] > 0.0
 
     def test_disabled_no_ring_no_lock_on_hot_path(self):
-        """The acceptance overhead guard: tracer off ⇒ the per-batch
-        dispatch path allocates no ring and acquires no tracer lock."""
+        """The acceptance overhead guard (extended for ISSUE 9): tracer
+        off AND perf accounting off AND no --slo-* ⇒ the per-batch
+        dispatch path allocates no ring and acquires neither the tracer
+        lock nor the perf meter's lock (the SLO engine is not even
+        constructed without an objective flag, so it has no lock to
+        guard against)."""
         assert not obs.enabled()
+        obs.PERF.reset()
+        assert not obs.PERF.enabled
         saved = obs.TRACER._lock
+        saved_perf = obs.PERF._lock
         obs.TRACER._lock = _RaisingLock()
+        obs.PERF._lock = _RaisingLock()
         try:
             r = msm.Registry()
 
@@ -257,6 +266,7 @@ class TestSchedulerSpans:
             assert run(main()) == ["X Y".lower(), "z"]
         finally:
             obs.TRACER._lock = saved
+            obs.PERF._lock = saved_perf
         assert obs.TRACER._ring is None
         assert obs.TRACER._events is None
 

@@ -63,6 +63,17 @@ def test_pipeline_outputs_in_input_order_and_match_direct(setup,
     got = _translate(setup)
     assert len(got) == len(lines)
 
+    # metric census (mtlint MT-METRIC-UNTESTED): the decode-side series
+    # are emitted by the run above into the process-wide registry
+    from marian_tpu.serving import metrics as msm
+    text = msm.REGISTRY.render()
+    for name in ("marian_translate_batches_total",
+                 "marian_translate_sentences_total",
+                 "marian_translate_batch_fill_ratio"):
+        assert name in text, name
+    assert msm.REGISTRY.get(
+        "marian_translate_sentences_total").value >= len(lines)
+
     # reference: IDENTICAL batch geometry (same padded shapes, same
     # compiled programs) but with the pipeline defeated — search_async
     # collects eagerly, so each batch finishes on-device before the next
