@@ -41,6 +41,22 @@ Env knobs:
                              Pallas fused beam-gather + cache-read
                              kernel (ops/pallas/decode_attention.py) —
                              the r5 while-body op-count lever
+  MARIAN_DECBENCH_PAGED      paged stage (ISSUE 10): greedy decode over
+                             the paged KV pool with rows as slots
+                             (translator/greedy.py::greedy_decode_paged
+                             — finished rows free their pages and LEAVE
+                             the compiled step; active rows bucket).
+                             A/B against the dense cache with the same
+                             batches by also timing plain greedy_decode
+                             (dense_sentences_per_sec field); forces
+                             beam 1. step_ops reports the compiled
+                             per-step program's op count for both paths
+                             (the paged step has no while loop — its
+                             analog of while_body_ops; CPU-interpret
+                             caveat as for the fused stage). A bare
+                             value > 1 overrides the page length
+                             (default 16); rows come from
+                             MARIAN_DECBENCH_BATCH like every stage
   MARIAN_DECBENCH_DEVICES    decode device count (default 1). Pinned to
                              ONE device because (a) the metric is
                              per-chip sent/s and every recorded row is
@@ -69,33 +85,32 @@ import tempfile
 import time
 
 
-def while_body_op_count(jitted, *args, **kwargs) -> "int | None":
-    """Op count of the largest while-loop body in the compiled program.
-
-    Lowers + compiles through the jit object's own cache (the warm call
-    already populated it; on TPU the persistent XLA cache covers the AOT
-    path). Optimized-HLO parse: find each `while(...)` instruction's
-    body= computation, count its instruction lines, return the max —
-    the decode loop dominates every smaller scan/loop in the program.
-    Returns None when anything in the chain is unavailable (the metric
-    is reporting-only; the bench must not die for it)."""
+def _compiled_text(jitted, *args, **kwargs) -> "str | None":
+    """Optimized HLO of the program the jit object's cache holds for
+    these args (the warm call already populated it; on TPU the
+    persistent XLA cache covers the AOT path). None when unavailable —
+    op counts are reporting-only; the bench must not die for them."""
     try:
-        txt = jitted.lower(*args, **kwargs).compile().as_text()
+        return jitted.lower(*args, **kwargs).compile().as_text()
     except Exception as e:  # noqa: BLE001 — backend/AOT availability varies
-        print(f"bench_decode: while-body op count unavailable: "
+        print(f"bench_decode: compiled-HLO op count unavailable: "
               f"{type(e).__name__}: {str(e)[:120]}", file=sys.stderr,
               flush=True)
         return None
-    bodies = set(re.findall(r"body=%?([\w.\-]+)", txt))
-    if not bodies:
-        return None
-    # computations open with `%name (params) -> type {` or `name (...) {`
+
+
+def _computation_counts(txt: str):
+    """(entry_name, {computation -> instruction count}) from HLO text.
+    Computations open with `%name (params) -> type {` or `name (...) {`."""
     counts = {}
+    entry = None
     current, n = None, 0
     for line in txt.splitlines():
-        m = re.match(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*{", line)
+        m = re.match(r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*{", line)
         if m:
-            current, n = m.group(1), 0
+            current, n = m.group(2), 0
+            if m.group(1):
+                entry = current
             continue
         if current is not None:
             if line.strip().startswith("}"):
@@ -103,8 +118,35 @@ def while_body_op_count(jitted, *args, **kwargs) -> "int | None":
                 current = None
             elif "=" in line:
                 n += 1
+    return entry, counts
+
+
+def while_body_op_count(jitted, *args, **kwargs) -> "int | None":
+    """Op count of the largest while-loop body in the compiled program:
+    find each `while(...)` instruction's body= computation, count its
+    instruction lines, return the max — the decode loop dominates every
+    smaller scan/loop in the program."""
+    txt = _compiled_text(jitted, *args, **kwargs)
+    if txt is None:
+        return None
+    bodies = set(re.findall(r"body=%?([\w.\-]+)", txt))
+    if not bodies:
+        return None
+    _, counts = _computation_counts(txt)
     hits = [v for k, v in counts.items() if k in bodies]
     return max(hits) if hits else None
+
+
+def entry_op_count(jitted, *args, **kwargs) -> "int | None":
+    """Op count of the compiled program's ENTRY computation — the paged
+    stage's analog of while_body_ops: its per-step program has no while
+    loop (the step loop lives on the host so rows can join/leave), so
+    the whole entry IS the step body."""
+    txt = _compiled_text(jitted, *args, **kwargs)
+    if txt is None:
+        return None
+    entry, counts = _computation_counts(txt)
+    return counts.get(entry)
 
 
 def main():
@@ -249,6 +291,89 @@ def main():
             return None
         flat = [int(x) for x in np.asarray(ids).ravel() if x > 1]
         return sl_gen.generate(flat)
+
+    paged_env = os.environ.get("MARIAN_DECBENCH_PAGED", "")
+    if paged_env:
+        # paged stage (ISSUE 10): greedy slot decode over the paged KV
+        # pool A/B'd against the dense cache on the SAME batches; forces
+        # beam 1 (the engine is greedy by design) and no shortlist
+        if sl_gen is not None:
+            print("bench_decode: MARIAN_DECBENCH_PAGED ignores the "
+                  "shortlist stage", file=sys.stderr, flush=True)
+        from marian_tpu.translator.greedy import (greedy_decode,
+                                                  greedy_decode_paged)
+        from bench import retry_compile
+        # "1"/"on"/"true" = enable with the default page length; a
+        # bare number > 1 overrides it (rows: MARIAN_DECBENCH_BATCH)
+        page_len = (int(paged_env) if paged_env.isdigit()
+                    and int(paged_env) > 1 else 16)
+        batches = [make_batch() for _ in range(max(1, n_sents // batch))]
+        intro: dict = {}
+        retry_compile(lambda: greedy_decode_paged(
+            model, params, *batches[0], max_len, page_len=page_len,
+            introspect=intro), "paged greedy decode")
+        retry_compile(lambda: greedy_decode(
+            model, params, *batches[0], max_len, introspect=intro),
+            "dense greedy decode")
+
+        t0 = time.perf_counter()
+        for b_ids, b_mask in batches:
+            greedy_decode_paged(model, params, b_ids, b_mask, max_len,
+                                page_len=page_len)
+        dt_paged = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for b_ids, b_mask in batches:
+            greedy_decode(model, params, b_ids, b_mask, max_len)
+        dt_dense = time.perf_counter() - t0
+        # final-sync poison guard (same convention as bench.py): both
+        # loops end on host-side token fetches, so the residue here is
+        # only a wedged-device tripwire
+        import jax as _jax
+        t_sync = time.perf_counter()
+        _jax.block_until_ready(_jax.numpy.zeros(()))
+        final_sync_s = round(time.perf_counter() - t_sync, 3)
+        from bench import FINAL_SYNC_POISON_S
+        sents = batch * len(batches)
+        paged_counts = [c for c in (entry_op_count(fn, *args)
+                                    for (kind, *_r), (fn, args)
+                                    in intro.items()
+                                    if kind == "paged_step")
+                        if c is not None]
+        # None (not 0) when the HLO text is unavailable — a zero-op
+        # step is a claim, unavailability is not
+        paged_ops = max(paged_counts) if paged_counts else None
+        dense_ops = None
+        if ("dense_step",) in intro:
+            fn, args = intro[("dense_step",)]
+            dense_ops = entry_op_count(fn, *args)
+        result = {
+            "metric": "greedy_paged_sentences_per_sec",
+            "value": round(sents / dt_paged, 2),
+            "unit": "sent/sec",
+            "vs_baseline": None,
+            "chip": jax.devices()[0].device_kind,
+            "preset": preset,
+            "batch": batch,
+            "beam": 1,
+            "page_len": page_len,
+            "dense_sentences_per_sec": round(sents / dt_dense, 2),
+            # per-step compiled op counts (entry computation — the
+            # paged step loop lives on the host, so there is no while
+            # body; CPU-interpret numbers are NOT TPU claims, same
+            # caveat as the fused stage)
+            "step_ops": paged_ops,
+            "dense_step_ops": dense_ops,
+            "while_body_ops": None,
+            "final_sync_s": final_sync_s,
+        }
+        if final_sync_s > FINAL_SYNC_POISON_S:
+            result["poisoned"] = True
+            result["poisoned_reason"] = (
+                f"final_sync_s {final_sync_s} > {FINAL_SYNC_POISON_S:g}: "
+                f"wedged final sync — round self-poisoned, not "
+                f"trajectory-worthy")
+        print(json.dumps(result))
+        return
 
     if fused_env == "on":
         metric = metric.replace("sentences", "fused_sentences")

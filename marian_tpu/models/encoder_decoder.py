@@ -250,6 +250,21 @@ class EncoderDecoder:
                                            src_mask, max_len,
                                            want_alignment=want_alignment)
 
+    def start_paged_state(self, params: Params, enc_out, src_mask,
+                          n_pages: int, page_len: int, max_pages: int):
+        """Decode state over a paged KV pool (iteration-level batching;
+        transformer family only — see T.init_paged_decode_state). The
+        returned state's ``page_table``/``pos`` leaves are PER-ROW and
+        owned by the caller's slot engine (translator/iteration.py)."""
+        if self._mod is not T:
+            raise ValueError("the paged KV pool is implemented for the "
+                             "transformer family (s2s decoders keep "
+                             "their recurrent states)")
+        cparams = T.cast_params(params, self.cfg.compute_dtype)
+        return T.init_paged_decode_state(self.cfg, cparams, enc_out,
+                                         src_mask, n_pages, page_len,
+                                         max_pages)
+
     def step(self, params: Params, state, prev_ids, src_mask,
              shortlist=None, return_alignment: bool = False,
              beam_src=None, fused_decode=None):

@@ -733,7 +733,8 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
          kv_mask: Optional[jax.Array] = None,
          causal: bool = False,
          beam_src: Optional[jax.Array] = None,
-         fused_decode: Optional[bool] = None):
+         fused_decode: Optional[bool] = None,
+         page_table: Optional[jax.Array] = None):
     """Multi-head attention with optional decode cache.
 
     cache (self-attn): dict with 'k','v' [B,H,L,Dh]; new K/V written at
@@ -745,6 +746,10 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
     overrides fused_decode_active(cfg) when the CALLER knows better —
     the beam search passes False under a decode mesh, where the
     GSPMD-opaque pallas call would re-replicate the sharded caches.
+    page_table [rows, max_pages] int32 (iteration-level decode): cache
+    is a PAGED POOL ({'k','v'} = [n_pages,H,page_len,Dh]) and cache_pos
+    is a per-row [rows] position vector — the paged kernel
+    (ops/pallas/kv_pool.py) owns the whole cached-attention step.
     """
     from ..ops.quantization import QTensor
 
@@ -818,7 +823,16 @@ def _mha(cfg: TransformerConfig, params: Params, prefix: str,
             or getattr(cfg, "fused_decode_attention", "") == "on")
     if not (static_kv and cache is not None):
         if cache is not None and cache_pos is not None:
-            if use_fused:
+            if page_table is not None:
+                # paged pool (iteration-level decode): page-table read +
+                # one new-token insert, per-row positions — no beam
+                # reorder exists here (the page table IS row identity)
+                from ..ops.pallas.kv_pool import paged_decode_attention
+                fused_out, nk, nv = paged_decode_attention(
+                    q, k_, v_, cache["k"], cache["v"], page_table,
+                    cache_pos)
+                cache["k"], cache["v"] = nk, nv
+            elif use_fused:
                 # fused gather + cache update + attention read: ONE
                 # kernel replaces the beam reorder of this layer's two
                 # cache leaves, the two single-position DUS writes, and
@@ -1117,10 +1131,30 @@ def _word_dropout(cfg: TransformerConfig, x: jax.Array, rate: float, key,
 def _add_pos(cfg: TransformerConfig, params: Params, x: jax.Array,
              start_pos=0) -> jax.Array:
     t = x.shape[-2]
+    start = jnp.asarray(start_pos)
+    if start.ndim == 1:
+        # per-row positions (iteration-level decode: rows of different
+        # ages share one step) — x is [R, t, d], offsets are [R]
+        pos_ids = (jnp.arange(t)[None, :] + start[:, None]).astype(jnp.int32)
+        if cfg.train_position_embeddings:
+            return x + params["Wpos"][jnp.maximum(pos_ids, 0)].astype(x.dtype)
+        return x + _sinusoidal_rows(pos_ids, cfg.dim_emb).astype(x.dtype)
     if cfg.train_position_embeddings:
         pos_ids = (jnp.arange(t) + start_pos).astype(jnp.int32)
         return x + params["Wpos"][pos_ids].astype(x.dtype)
     return x + sinusoidal_positions_dynamic(t, cfg.dim_emb, start_pos).astype(x.dtype)
+
+
+def _sinusoidal_rows(pos_ids: jax.Array, dim: int) -> jax.Array:
+    """Sinusoidal embeddings for an arbitrary [R, t] position grid —
+    identical per-position values to sinusoidal_positions_dynamic (same
+    inv_freq expression), vectorized over rows."""
+    pos = pos_ids.astype(jnp.float32)[..., None]            # [R, t, 1]
+    half = dim // 2
+    inv_freq = jnp.exp(-jnp.arange(half, dtype=jnp.float32)
+                       * (math.log(10000.0) / max(half - 1, 1)))
+    angles = pos * inv_freq[None, None, :]                  # [R, t, half]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
 
 
 def _ulr_embed(cfg: TransformerConfig, params: Params, ids: jax.Array,
@@ -1712,6 +1746,42 @@ def init_decode_state(cfg: TransformerConfig, params: Params,
     return state
 
 
+def init_paged_decode_state(cfg: TransformerConfig, params: Params,
+                            enc_out, src_mask, n_pages: int,
+                            page_len: int, max_pages: int
+                            ) -> Dict[str, Any]:
+    """Decode state for iteration-level (continuous) batching: the dense
+    per-row self-attention caches are replaced by per-layer PAGE POOLS
+    ``[n_pages, H, page_len, dh]`` shared across all rows, one page
+    table ``[rows, max_pages]`` (all layers write the same positions, so
+    one table serves every layer — page 0 is the reserved trash page)
+    and a per-row position vector. Cross-attention K/V stay dense
+    per-row (computed once per sentence at join time). Unrolled layout
+    only: rows join and leave individually, which the host-side slot
+    engine (translator/iteration.py) manages between steps.
+    """
+    if cfg.decoder_autoreg != "self-attention":
+        raise ValueError("the paged KV pool requires the self-attention "
+                         "autoreg decoder (AAN/SSRU keep O(1) states — "
+                         "there is no cache to page)")
+    # want_alignment=True forces the UNROLLED state layout (per-layer
+    # cross keys); the tiny [b,h,1,dh] dense self caches it allocates
+    # are dropped below in favor of the pools
+    state = init_decode_state(cfg, params, enc_out, src_mask, max_len=1,
+                              want_alignment=True)
+    b = src_mask.shape[0] if cfg.lm else _as_tuple(enc_out)[0].shape[0]
+    h, dh = cfg.heads, cfg.dim_head
+    for l in range(1, cfg.dec_depth + 1):
+        del state[f"l{l}_self_k"], state[f"l{l}_self_v"]
+        state[f"l{l}_pool_k"] = jnp.zeros((n_pages, h, page_len, dh),
+                                          cfg.compute_dtype)
+        state[f"l{l}_pool_v"] = jnp.zeros((n_pages, h, page_len, dh),
+                                          cfg.compute_dtype)
+    state["page_table"] = jnp.zeros((b, max_pages), jnp.int32)
+    state["pos"] = jnp.zeros((b,), jnp.int32)
+    return state
+
+
 def _maybe_lsh_state(cfg: TransformerConfig, params: Params,
                      state: Dict[str, Any]) -> None:
     if not cfg.output_approx_knn:
@@ -1749,23 +1819,46 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
     config gate (the beam search under a decode mesh — see _mha).
     """
     pos = state["pos"]
+    # paged iteration-level decode (ops/pallas/kv_pool.py): the state
+    # carries a shared page table + per-layer pools instead of dense
+    # per-row caches, and pos is a PER-ROW [R] vector (rows of
+    # different ages share one step; pos < 0 marks an inactive slot)
+    page_table = state.get("page_table")
+    paged = page_table is not None
     scanned = "stack_self_k" in state
-    if cfg.decoder_autoreg == "self-attention":
+    if paged:
+        if cfg.decoder_autoreg != "self-attention":
+            raise ValueError("paged decode state requires the "
+                             "self-attention autoreg decoder")
+        if return_alignment:
+            raise ValueError("alignment output is not supported with a "
+                             "paged decode state")
+        max_len = page_table.shape[1] * state["l1_pool_k"].shape[2]
+    elif cfg.decoder_autoreg == "self-attention":
         max_len = (state["stack_self_k"].shape[3] if scanned
                    else state["l1_self_k"].shape[2])
     else:
         max_len = 0
     we = _embed_words(cfg, params, prev_ids, "trg")
-    # step 0 uses the zero embedding (Marian's no-BOS decoder start)
-    we = jnp.where(pos == 0, jnp.zeros_like(we), we)
+    # step 0 uses the zero embedding (Marian's no-BOS decoder start);
+    # per-row pos: each row applies its OWN step-0 rule (<= covers the
+    # inactive pos=-1 slots with deterministic zeros)
+    start0 = (pos <= 0)[:, None, None] if paged else (pos == 0)
+    we = jnp.where(start0, jnp.zeros_like(we), we)
     x = _add_pos(cfg, params, we, pos)
     x = _pre_post(cfg, _strip_dropout(cfg.postprocess_emb), x, None,
                   "decoder_emb", params, None, False)
-    # self mask: [1,1,1,max_len] — attend to steps 0..pos
+    # self mask: [1,1,1,max_len] — attend to steps 0..pos (per-row
+    # [R,1,1,max_len] when pos is a vector; the paged kernel applies
+    # its own equivalent mask — this one feeds any dense fallback)
     if cfg.decoder_autoreg == "self-attention":
         steps = jnp.arange(max_len)
-        self_mask = (steps <= pos).astype(
-            cfg.compute_dtype)[None, None, None, :]
+        if paged:
+            self_mask = (steps[None, :] <= pos[:, None]).astype(
+                cfg.compute_dtype)[:, None, None, :]
+        else:
+            self_mask = (steps <= pos).astype(
+                cfg.compute_dtype)[None, None, None, :]
     else:
         self_mask = None                 # AAN/SSRU need no attention mask
     cross_masks = [m[:, None, None, :] for m in _as_tuple(src_mask)]
@@ -1813,6 +1906,7 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
         pl = _tied(cfg, l)               # parameter-owning layer
         kinds = (("aan_sum",) if cfg.decoder_autoreg == "average-attention"
                  else ("rnn_c",) if cfg.decoder_autoreg == "rnn"
+                 else ("pool_k", "pool_v") if paged
                  else ("self_k", "self_v"))
         caches_l = {kind: state[f"l{l}_{kind}"] for kind in kinds}
         for i in range(n_enc):
@@ -1823,7 +1917,7 @@ def decode_step(cfg: TransformerConfig, params: Params, state: Dict[str, Any],
         x, new_c, align_l = _decode_layer(
             cfg, params, f"decoder_l{pl}", x, pos, self_mask, cross_masks,
             caches_l, n_enc, want_w=want_w, beam_src=beam_src,
-            fused_decode=fused_decode)
+            fused_decode=fused_decode, page_table=page_table)
         for kind in kinds:
             new_state[f"l{l}_{kind}"] = new_c[kind]
         if align_l is not None:
@@ -1841,12 +1935,15 @@ def _decode_layer(cfg: TransformerConfig, pv: Params, lp: str, x: jax.Array,
                   pos, self_mask, cross_masks, caches: Dict[str, jax.Array],
                   n_enc: int, want_w: bool = False,
                   beam_src: Optional[jax.Array] = None,
-                  fused_decode: Optional[bool] = None):
+                  fused_decode: Optional[bool] = None,
+                  page_table: Optional[jax.Array] = None):
     """One decode-step layer, shared verbatim between the scanned and the
     unrolled stacks (the training path shares dec_layer the same way).
     `caches` holds THIS layer's state leaves keyed by kind ('self_k',
-    'aan_sum', 'rnn_c', 'cross_k{sfx}', ...); returns (x, updated caches,
-    head-averaged cross-attention row when want_w)."""
+    'aan_sum', 'rnn_c', 'pool_k'/'pool_v' with `page_table` (paged
+    iteration-level decode; `pos` is then per-row), 'cross_k{sfx}', ...);
+    returns (x, updated caches, head-averaged cross-attention row when
+    want_w)."""
     new_c: Dict[str, jax.Array] = {}
     align = None
     pre = _pre_post(cfg, _strip_dropout(cfg.preprocess), x, None,
@@ -1868,6 +1965,14 @@ def _decode_layer(cfg: TransformerConfig, pv: Params, lp: str, x: jax.Array,
         if cfg.rnn_projection:
             out = affine(out, pv[f"{lp}_rnn_Wo"], pv[f"{lp}_rnn_bo"])
         new_c["rnn_c"] = c2.astype(caches["rnn_c"].dtype)
+    elif page_table is not None:
+        # paged self-attention: this layer's slice of the shared pool
+        cache = {"k": caches["pool_k"], "v": caches["pool_v"]}
+        out, _ = _mha(cfg, pv, f"{lp}_self", pre, pre, self_mask,
+                      None, False, cache=cache, cache_pos=pos,
+                      page_table=page_table)
+        new_c["pool_k"] = cache["k"]
+        new_c["pool_v"] = cache["v"]
     else:
         cache = {"k": caches["self_k"], "v": caches["self_v"]}
         out, _ = _mha(cfg, pv, f"{lp}_self", pre, pre, self_mask,

@@ -305,12 +305,17 @@ class PerfMeter:
     def set_capacity_inputs(self, depth_fn: Optional[Callable[[], int]],
                             max_queue_units: int) -> None:
         """Wire the admission-pressure half of the headroom gauge: the
-        scheduler's live queued-sentence count and the admission bound
+        scheduler's live queue depth and the admission bound
         (0 = unbounded — pressure is then queue debt in device-seconds
-        relative to the rolling window). Pass ``None`` to unwire (a
-        closed ServingApp must not leave the process-global gauge
-        sampling a dead scheduler — and keeping its whole object graph
-        alive through the bound method)."""
+        relative to the rolling window). The UNITS follow the batching
+        mode: sentences against --max-queue in request mode, KV-pool
+        PAGES against --max-queue-pages in iteration mode (the ratio
+        math is identical; dashboards read the mode off
+        marian_serving_queue_depth_pages being live — see
+        docs/DEPLOYMENT.md). Pass ``None`` to unwire (a closed
+        ServingApp must not leave the process-global gauge sampling a
+        dead scheduler — and keeping its whole object graph alive
+        through the bound method)."""
         self._depth_fn = depth_fn
         self._max_queue = int(max_queue_units)
 

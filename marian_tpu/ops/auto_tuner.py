@@ -84,6 +84,11 @@ KERNEL_BLOCKS = {
     # decode cell holds 2 x [L, dh] cache blocks + the [1, L] score row;
     # L=2048 at dh=64 f32 is ~1 MB/cache block
     "decode_attention": {"max_len": 2048},
+    # paged-pool cell accumulates 2 x [max_pages*page_len, dh] VMEM
+    # scratch rows (ops/pallas/kv_pool.py) — same per-row footprint as
+    # the dense decode cell, so the same 2048-token cap applies; the
+    # page-table granularity only changes WHICH HBM lines feed it
+    "kv_pool": {"max_tokens": 2048},
 }
 
 
@@ -122,6 +127,13 @@ def decode_attention_max_len(dh: int) -> int:
     """Longest decode cache the fused kernel holds per cell; past it
     decode_attention degrades to its unfused jnp reference path."""
     return kernel_block("decode_attention", "max_len", dh)
+
+
+def kv_pool_max_tokens(dh: int) -> int:
+    """Longest per-row paged span (max_pages x page_len) the paged
+    decode kernel assembles in VMEM scratch; past it
+    paged_decode_attention degrades to its jnp gather reference."""
+    return kernel_block("kv_pool", "max_tokens", dh)
 
 
 # ---------------------------------------------------------------------------

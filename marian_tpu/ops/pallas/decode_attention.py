@@ -54,7 +54,10 @@ else:  # pragma: no cover — CPU-only envs without TPU lowering registration
 
 def _kernel(src_ref, pos_ref, q_ref, kn_ref, vn_ref, ck_ref, cv_ref,
             o_ref, nk_ref, nv_ref, *, scale, max_len):
-    pos = pos_ref[0]
+    # pos is per-row ([R] scalar-prefetch vector — scalar callers are
+    # broadcast before the call): rows of different ages can share one
+    # step, the contract the paged iteration path (kv_pool.py) relies on
+    pos = pos_ref[pl.program_id(0)]
     # the gathered source row arrived via the block index map; fold the
     # new position in and materialize the reordered cache in one write
     kc = jax.lax.dynamic_update_slice(
@@ -81,19 +84,30 @@ def _kernel(src_ref, pos_ref, q_ref, kn_ref, vn_ref, ck_ref, cv_ref,
 def _reference(q, k_new, v_new, cache_k, cache_v, pos, src_rows, scale):
     """Pure-jnp fallback (oversized caches past the VMEM cap, or a
     backend without pltpu): the exact unfused sequence the kernel
-    replaces — flat row gather, DUS at pos, masked softmax read."""
+    replaces — flat row gather, DUS at pos, masked softmax read.
+    ``pos`` may be a scalar or a per-row [R] vector."""
     if src_rows is not None:
         cache_k = cache_k[src_rows]
         cache_v = cache_v[src_rows]
-    cache_k = jax.lax.dynamic_update_slice(
-        cache_k, k_new.astype(cache_k.dtype), (0, 0, pos, 0))
-    cache_v = jax.lax.dynamic_update_slice(
-        cache_v, v_new.astype(cache_v.dtype), (0, 0, pos, 0))
+    pos_arr = jnp.asarray(pos, jnp.int32)
+    if pos_arr.ndim == 0:
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k_new.astype(cache_k.dtype), (0, 0, pos, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v_new.astype(cache_v.dtype), (0, 0, pos, 0))
+        pos_b = pos
+    else:
+        def dus(c, n, p):
+            return jax.lax.dynamic_update_slice(c, n.astype(c.dtype),
+                                                (0, p, 0))
+        cache_k = jax.vmap(dus)(cache_k, k_new, pos_arr)
+        cache_v = jax.vmap(dus)(cache_v, v_new, pos_arr)
+        pos_b = pos_arr[:, None, None, None]
     s = jnp.einsum("rhqd,rhkd->rhqk", q.astype(jnp.float32),
                    cache_k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
     steps = jnp.arange(cache_k.shape[2])[None, None, None, :]
-    s = jnp.where(steps <= pos, s, MASK_VALUE)
+    s = jnp.where(steps <= pos_b, s, MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("rhqk,rhkd->rhqd", p, cache_v.astype(jnp.float32),
                      preferred_element_type=jnp.float32).astype(q.dtype)
@@ -108,7 +122,9 @@ def decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One fused decode-attention step; see module docstring.
 
-    `pos` may be a traced scalar (the decode loop's time index);
+    `pos` may be a traced scalar (the decode loop's time index) or a
+    per-row [R] vector (iteration-level decoding: rows of different ages
+    share one step — the dense comparator for the paged pool path);
     `src_rows` is the pending beam backpointer map as FLAT source rows
     (None = identity, the greedy/scoring case). Returns
     (context [R,H,1,Dh], new_cache_k, new_cache_v).
@@ -129,7 +145,10 @@ def decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
 
     if src_rows is None:
         src_rows = jnp.arange(r, dtype=jnp.int32)
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    # per-row positions in the scalar-prefetch slot; scalar callers
+    # broadcast (bitwise-identical: the kernel reads pos_ref[row])
+    pos_arr = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (r,))
 
     import functools
     kernel = functools.partial(_kernel, scale=float(scale),

@@ -1,0 +1,375 @@
+"""Paged KV-cache pool for iteration-level (continuous) batching.
+
+The dense decode cache is a per-batch tensor ``[rows, H, L, dh]`` whose
+row count and length are fixed for the LIFETIME of the batch: a sentence
+admitted mid-decode waits for the whole batch to drain, and every row
+pays L positions of HBM even when it finished at position 9. This module
+replaces it with a POOL of fixed-size pages:
+
+- ``pool_k`` / ``pool_v``: ``[n_pages, H, page_len, dh]`` — one shared
+  allocation sized to a byte budget, not to any batch;
+- a per-row PAGE TABLE ``[rows, max_pages]`` int32 mapping each row's
+  logical positions ``[j*page_len, (j+1)*page_len)`` to a physical page;
+- per-row positions ``row_pos`` int32 — rows decode at their OWN time
+  index, so a sentence can join a running decode step at position 0
+  while its neighbors are at position 40.
+
+Page 0 is RESERVED as the trash page: it is never handed out by the
+allocator, table entries of unclaimed slots point at it, and inactive
+rows (``row_pos < 0``) write zeros into it — so scatter collisions
+between idle rows write identical values and stay deterministic (the
+join/evict replay test pins this).
+
+``paged_decode_attention`` extends the fused decode kernel's
+scalar-prefetch index map (ops/pallas/decode_attention.py) from beam
+backpointers to page-table lookups: grid cell ``(row, head, page)``
+pulls physical page ``page_table[row, page]`` through the block index
+map, accumulates the row's K/V pages into VMEM scratch, and on the last
+page runs EXACTLY the dense kernel's one-shot masked softmax over the
+assembled ``[max_pages*page_len, dh]`` block — the op order is kept
+identical to the dense kernel on purpose, so paged-vs-dense parity is
+BITWISE in interpret mode (tests/test_kv_pool.py pins it), not just
+allclose.
+
+Update discipline: the dense fused kernel wrote the WHOLE reordered
+cache back once per step because the beam reorder demanded it. Here the
+reorder is a page-table remap (host-side int32 rows), so the per-step
+pool update shrinks to ONE scatter of the new token's K/V into its page
+(``pool_insert``) — the kernel reads the pool and writes nothing back.
+
+Shapes stay static for the TPU compilation model: page counts come from
+``auto_tuner.KERNEL_BLOCKS``-style capacity tables and active-row
+counts round up to ``ROW_BUCKETS`` (the iteration engine slices a
+bucket-sized prefix of its slot state per step).
+"""
+
+from __future__ import annotations
+
+import bisect
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import MASK_VALUE, _HAS_PLTPU, _interpret_default
+
+if _HAS_PLTPU:
+    from jax.experimental.pallas import tpu as pltpu
+else:  # pragma: no cover — CPU-only envs without TPU lowering registration
+    pltpu = None
+
+
+# ---------------------------------------------------------------------------
+# static-shape bucket tables (cf. auto_tuner.KERNEL_BLOCKS: shapes must
+# come from a small closed set so serving stays on warm jit caches)
+# ---------------------------------------------------------------------------
+
+# active-row buckets for the iteration engine's per-step compiled shapes:
+# n_active rounds UP to the next entry (one jit specialization per bucket)
+ROW_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+# tokens per page. 16 × dh=64 × 4 B = 4 KiB per (page, head) K block —
+# several HBM bursts per block read, small enough that a 10-token
+# sentence wastes at most one mostly-empty page (docs/DECODE_ROOFLINE.md
+# r7 discusses the trade)
+DEFAULT_PAGE_LEN = 16
+
+
+def pages_for_tokens(n_tokens: int, page_len: int) -> int:
+    """Pages a row needs to hold ``n_tokens`` positions."""
+    return max(1, -(-int(n_tokens) // max(1, int(page_len))))
+
+
+def bucket_rows(n: int, buckets: Sequence[int] = ROW_BUCKETS) -> int:
+    """Smallest row bucket >= n (the largest bucket caps it)."""
+    buckets = sorted(buckets)
+    i = bisect.bisect_left(buckets, max(1, int(n)))
+    return buckets[min(i, len(buckets) - 1)]
+
+
+def state_key_groups(state_keys) -> Tuple[Tuple[str, ...], Tuple[str, ...],
+                                          Tuple[str, ...]]:
+    """Classify a paged decode state's leaves for the per-step closures
+    (ONE definition of the contract — translator/iteration.py's engine
+    and translator/greedy.py's paged A/B comparator both consume it, so
+    a state-layout change cannot silently diverge them):
+
+    - row keys (cross-attention K/V): row-indexed, sliced to the step's
+      bucket prefix;
+    - pool keys (the paged K/V pools): rewritten by every step;
+    - whole keys (beam-invariant extras like LSH tables): pass through.
+
+    ``pos``/``page_table`` are the host-owned leaves and belong to
+    neither group.
+    """
+    keys = tuple(state_keys)
+    row_keys = tuple(k for k in keys if "_cross_" in k)
+    pool_keys = tuple(k for k in keys if "_pool_" in k)
+    whole_keys = tuple(k for k in keys
+                       if k not in row_keys and k not in pool_keys
+                       and k not in ("pos", "page_table"))
+    return row_keys, pool_keys, whole_keys
+
+
+# ---------------------------------------------------------------------------
+# host-side page allocator
+# ---------------------------------------------------------------------------
+
+class PoolExhausted(RuntimeError):
+    """A claim could not be satisfied — callers must treat this as an
+    admission decision (defer/shed the sentence), never as a reason to
+    stall a decode step that other rows are waiting on."""
+
+
+class KVPool:
+    """Free-list page allocator over the device pool's index space.
+
+    Pure host bookkeeping (the device arrays live with the decode state);
+    claims are all-or-nothing per owner so a sentence either holds every
+    page its decode cap needs or none — mid-decode exhaustion is
+    impossible by construction, which is what keeps the decode step
+    deadlock-free when the pool runs dry (admission defers instead).
+
+    Cross-thread: the device worker claims/releases while the metrics
+    scrape thread samples the gauges — hence the lock discipline.
+    """
+
+    def __init__(self, n_pages: int, page_len: int = DEFAULT_PAGE_LEN,
+                 max_pages_per_row: int = 0):
+        if n_pages < 2:
+            raise ValueError(f"KVPool needs >= 2 pages (page 0 is the "
+                             f"reserved trash page); got {n_pages}")
+        from ...common import lockdep
+        self.n_pages = int(n_pages)
+        self.page_len = int(page_len)
+        self.max_pages_per_row = int(max_pages_per_row) or (n_pages - 1)
+        self._lock = lockdep.make_lock("KVPool._lock")
+        # LIFO free list, low pages first out — keeps early tests and
+        # replays deterministic and dense near the pool's base
+        self._free: List[int] = list(range(self.n_pages - 1, 0, -1))
+        self._claims: Dict[object, List[int]] = {}  # guarded-by: _lock
+
+    @property
+    def usable_pages(self) -> int:
+        """Allocatable pages (total minus the reserved trash page)."""
+        return self.n_pages - 1
+
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def used_pages(self) -> int:
+        with self._lock:
+            return self.n_pages - 1 - len(self._free)
+
+    def claim(self, owner, n: int) -> List[int]:
+        """Claim ``n`` pages for ``owner`` (all-or-nothing); raises
+        :class:`PoolExhausted` when the free list is short."""
+        n = int(n)
+        if n > self.max_pages_per_row:
+            raise PoolExhausted(
+                f"row needs {n} pages but the page table holds "
+                f"{self.max_pages_per_row} (raise --kv-page-len or the "
+                f"pool budget)")
+        with self._lock:
+            if owner in self._claims:
+                raise ValueError(f"owner {owner!r} already holds pages")
+            if n > len(self._free):
+                raise PoolExhausted(
+                    f"pool exhausted: {n} pages requested, "
+                    f"{len(self._free)} free of {self.n_pages - 1}")
+            pages = [self._free.pop() for _ in range(n)]
+            self._claims[owner] = pages
+            return list(pages)
+
+    def release(self, owner) -> int:
+        """Free every page ``owner`` holds; returns how many."""
+        with self._lock:
+            pages = self._claims.pop(owner, [])
+            # freed pages return in reverse so a release+reclaim of the
+            # same count yields the same page ids (replay determinism)
+            self._free.extend(reversed(pages))
+            return len(pages)
+
+    def pages_of(self, owner) -> List[int]:
+        with self._lock:
+            return list(self._claims.get(owner, []))
+
+
+# ---------------------------------------------------------------------------
+# device-side pool ops
+# ---------------------------------------------------------------------------
+
+def pool_insert(pool_k: jax.Array, pool_v: jax.Array,
+                k_new: jax.Array, v_new: jax.Array,
+                page_table: jax.Array, row_pos: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Write each active row's new-token K/V into its page at
+    ``row_pos`` — the paged pool's ONE write per step (the dense fused
+    kernel's full write-back existed only to apply the beam reorder; the
+    page table absorbs that, so only the new token moves).
+
+    ``row_pos < 0`` marks an inactive row: its write is redirected to
+    the trash page (0) offset 0 with a ZERO payload, so idle-row scatter
+    collisions write identical values and the result is deterministic.
+    """
+    page_len = pool_k.shape[2]
+    mp = page_table.shape[1]
+    pos = jnp.asarray(row_pos, jnp.int32)
+    active = pos >= 0
+    # clamp into the table's span: a multi-step scan round can step a
+    # row past its cap before the host sees the EOS and evicts it — the
+    # overshoot lands on the row's own last slot (a position the host
+    # has already cut at), never out of bounds
+    posc = jnp.where(active, jnp.minimum(pos, mp * page_len - 1), 0)
+    slot = posc // page_len                                   # [R]
+    pidx = jnp.take_along_axis(jnp.asarray(page_table, jnp.int32),
+                               slot[:, None], axis=1)[:, 0]   # [R]
+    pidx = jnp.where(active, pidx, 0)
+    off = jnp.where(active, posc % page_len, 0)
+    kv = []
+    for pool, new in ((pool_k, k_new), (pool_v, v_new)):
+        payload = new[:, :, 0, :].astype(pool.dtype)          # [R,H,dh]
+        payload = jnp.where(active[:, None, None], payload,
+                            jnp.zeros_like(payload))
+        kv.append(pool.at[pidx, :, off, :].set(payload))
+    return kv[0], kv[1]
+
+
+def _reference(q, pool_k, pool_v, page_table, row_pos, scale):
+    """Pure-jnp paged attention read (backends without pltpu, or rows
+    past the VMEM token cap). Gathers each row's pages and then runs the
+    EXACT op sequence of the dense reference (decode_attention._reference)
+    over the assembled [R, H, MP*PL, dh] view — elementwise-identical
+    inputs at unmasked positions + identical ops = bitwise-identical
+    outputs vs a dense cache of length MP*PL (tests pin this)."""
+    r, mp = page_table.shape
+    page_len = pool_k.shape[2]
+    h, dh = pool_k.shape[1], pool_k.shape[3]
+
+    def gather(pool):
+        g = pool[page_table]                          # [R, MP, H, PL, dh]
+        return g.transpose(0, 2, 1, 3, 4).reshape(r, h, mp * page_len, dh)
+
+    k_full, v_full = gather(pool_k), gather(pool_v)
+    s = jnp.einsum("rhqd,rhkd->rhqk", q.astype(jnp.float32),
+                   k_full.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    steps = jnp.arange(mp * page_len)[None, None, None, :]
+    s = jnp.where(steps <= row_pos[:, None, None, None], s, MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("rhqk,rhkd->rhqd", p, v_full.astype(jnp.float32),
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _kernel(pt_ref, pos_ref, q_ref, pk_ref, pv_ref, o_ref, ks_ref, vs_ref,
+            *, scale, page_len, n_pages_row):
+    """Grid (R, H, MP): cells p = 0..MP-1 stage the row's pages into
+    VMEM scratch (the physical page arrived via the scalar-prefetch
+    block index map); the LAST cell runs the dense kernel's one-shot
+    masked softmax over the assembled row — op order kept identical to
+    decode_attention._kernel so parity is bitwise in interpret mode."""
+    # program ids hoisted to the top level: the interpret-mode lowering
+    # only rewrites program_id in the kernel's own trace, not inside a
+    # pl.when branch (same hoist the flash kernels do)
+    r = pl.program_id(0)
+    p = pl.program_id(2)
+    ks_ref[pl.ds(p * page_len, page_len), :] = pk_ref[0, 0]
+    vs_ref[pl.ds(p * page_len, page_len), :] = pv_ref[0, 0]
+
+    @pl.when(p == n_pages_row - 1)
+    def _finish():
+        pos = pos_ref[r]
+        max_len = n_pages_row * page_len
+        qv = q_ref[0, 0].astype(jnp.float32)              # [1, dh]
+        s = jax.lax.dot_general(
+            qv, ks_ref[:].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [1, L]
+        steps = jax.lax.broadcasted_iota(jnp.int32, (1, max_len), 1)
+        s = jnp.where(steps <= pos, s, MASK_VALUE)
+        m = jnp.max(s, axis=1, keepdims=True)
+        pr = jnp.exp(s - m)
+        pr = pr / jnp.sum(pr, axis=1, keepdims=True)
+        o = jax.lax.dot_general(
+            pr, vs_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [1, dh]
+        o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                           pool_k: jax.Array, pool_v: jax.Array,
+                           page_table: jax.Array, row_pos: jax.Array,
+                           scale: Optional[float] = None,
+                           interpret: Optional[bool] = None
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One paged decode-attention step; see module docstring.
+
+    q/k_new/v_new ``[R, H, 1, dh]``; pool_k/pool_v
+    ``[n_pages, H, page_len, dh]``; page_table ``[R, max_pages]`` int32;
+    row_pos ``[R]`` int32 per-row write positions (< 0 = inactive row —
+    no pool write, deterministic-garbage output the caller masks).
+    Returns ``(context [R,H,1,dh], new_pool_k, new_pool_v)`` — the new
+    pools hold the inserted tokens (ONE scatter; no full write-back).
+    """
+    r, h, _, dh = q.shape
+    mp = page_table.shape[1]
+    page_len = pool_k.shape[2]
+    if scale is None:
+        scale = 1.0 / (dh ** 0.5)
+    row_pos = jnp.asarray(row_pos, jnp.int32)
+    page_table = jnp.asarray(page_table, jnp.int32)
+
+    new_k, new_v = pool_insert(pool_k, pool_v, k_new, v_new,
+                               page_table, row_pos)
+
+    from ..auto_tuner import kv_pool_max_tokens
+    if interpret is None:
+        # default gate mirrors the fused decode kernel's 'auto': the
+        # kernel only pays on the TPU backend — interpret mode
+        # emulates every (row, head, page) grid cell sequentially
+        # (seconds per step at serving widths), and the jnp gather
+        # reference is BITWISE-identical anyway (tests pin it; tests
+        # pass interpret=True explicitly to exercise the kernel)
+        interpret = _interpret_default()
+        if interpret:
+            out = _reference(q, new_k, new_v, page_table, row_pos,
+                             float(scale))
+            return out, new_k, new_v
+    if not _HAS_PLTPU or mp * page_len > kv_pool_max_tokens(dh):
+        # degrade, don't OOM: the scratch row [MP*PL, dh] x2 must fit
+        # the VMEM budget (auto_tuner scales the cap down for wide heads)
+        out = _reference(q, new_k, new_v, page_table, row_pos,
+                         float(scale))
+        return out, new_k, new_v
+
+    kernel = functools.partial(_kernel, scale=float(scale),
+                               page_len=page_len, n_pages_row=mp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r, h, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, dh), lambda r_, h_, p_, t, s: (r_, h_, 0, 0)),
+            # the page-table gather: pool blocks come from the PHYSICAL
+            # page the row's table names for logical page p
+            pl.BlockSpec((1, 1, page_len, dh),
+                         lambda r_, h_, p_, t, s: (t[r_, p_], h_, 0, 0)),
+            pl.BlockSpec((1, 1, page_len, dh),
+                         lambda r_, h_, p_, t, s: (t[r_, p_], h_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, dh), lambda r_, h_, p_, t, s: (r_, h_, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((mp * page_len, dh), pool_k.dtype),
+            pltpu.VMEM((mp * page_len, dh), pool_v.dtype),
+        ],
+    )
+    out, = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((r, h, 1, dh), q.dtype)],
+        interpret=bool(interpret),
+    )(page_table, row_pos, q, new_k, new_v)
+    return out, new_k, new_v

@@ -129,7 +129,7 @@ class ServingApp:
 
     def __init__(self, options, translate_lines=None,
                  registry: Optional[msm.Registry] = None,
-                 executor_factory=None):
+                 executor_factory=None, engine=None):
         self.options = options
         self.registry = registry if registry is not None else msm.REGISTRY
         # observability (ISSUE 8): --trace enables the span tracer,
@@ -137,6 +137,15 @@ class ServingApp:
         # metrics port (start() below)
         obs.configure(options)
         budget = resolve_token_budget(options)
+        # --batching-mode iteration (ISSUE 10): scheduling moves INSIDE
+        # the decode loop over a paged KV pool — sentences join a
+        # running decode each step and leave the step they finish
+        # (translator/iteration.py; docs/DEPLOYMENT.md "Iteration-level
+        # batching"). `engine` injects a prebuilt engine (tests).
+        self.batching_mode = str(
+            options.get("batching-mode", "request") or "request")
+        if self.batching_mode == "iteration":
+            self._validate_iteration_options(options)
         if translate_lines is None:
             # align the Translate-internal batcher with the scheduler's
             # groups: one scheduler batch == one device batch, hitting the
@@ -156,13 +165,43 @@ class ServingApp:
             self.service: Optional[TranslationService] = service
         else:
             self.service = None
+        engine_factory = None
+        if self.batching_mode == "iteration":
+            if engine is None:
+                if self.service is None:
+                    raise ValueError(
+                        "--batching-mode iteration with an injected "
+                        "translate_lines needs an injected engine too "
+                        "(the paged engine drives the model directly)")
+                engine_factory = self._build_engine
+                engine = engine_factory()
+            # admission prices queue debt in PAGES: default bound is
+            # 4x the pool (a full pool of backlog ahead of you is
+            # already seconds of queueing; --max-queue-pages overrides)
+            self.max_queue_pages = int(
+                options.get("max-queue-pages", 0) or 0) \
+                or 4 * engine.pool.usable_pages
+            # resolved THROUGH the scheduler at call time: a watchdog
+            # trip rebuilds scheduler.engine, and a method bound to the
+            # dead engine would both misprice admission and keep its
+            # whole device-side pool alive (the retention class
+            # PERF.set_capacity_inputs's docstring warns about)
+            self._pages_for_text = \
+                lambda text: self.scheduler.engine.pages_for_text(text)
+        else:
+            self._pages_for_text = None
+            self.max_queue_pages = 0
         self.scheduler = ContinuousScheduler(
             translate_lines, token_budget=budget, registry=self.registry,
             stall_timeout=float(
-                options.get("dispatch-stall-timeout", 0) or 0))
+                options.get("dispatch-stall-timeout", 0) or 0),
+            batching_mode=self.batching_mode, engine=engine,
+            engine_factory=engine_factory)
         self.admission = AdmissionController(
             int(options.get("max-queue", 512) or 0),
-            self.scheduler.queued_units, registry=self.registry)
+            self.scheduler.queued_units, registry=self.registry,
+            max_queue_pages=self.max_queue_pages,
+            pages_fn=self.scheduler.queued_pages)
         self.request_timeout = float(options.get("request-timeout", 0) or 0)
         self.metrics_server: Optional[msm.MetricsServer] = None
         self._started = False
@@ -177,8 +216,16 @@ class ServingApp:
                 # the perf series there so /metrics actually shows them
                 # (the global copies stay registered but un-emitted)
                 obs.PERF.enable(registry=self.registry)
-            obs.PERF.set_capacity_inputs(self.scheduler.queued_units,
-                                         self.admission.max_queue_units)
+            if self.batching_mode == "iteration":
+                # the headroom gauge's queue-pressure units become
+                # PAGES (docs/DEPLOYMENT.md): queued page debt against
+                # the page bound is what predicts pool saturation
+                obs.PERF.set_capacity_inputs(self.scheduler.queued_pages,
+                                             self.max_queue_pages)
+            else:
+                obs.PERF.set_capacity_inputs(
+                    self.scheduler.queued_units,
+                    self.admission.max_queue_units)
             self._set_perf_geometry()
         # SLO burn-rate engine (obs/slo.py): constructed only when an
         # objective is declared (--slo-availability / --slo-p99-ms);
@@ -196,6 +243,64 @@ class ServingApp:
         if watch_s > 0:
             self._init_lifecycle(watch_s, translate_lines,
                                  executor_factory)
+
+    @staticmethod
+    def _validate_iteration_options(options) -> None:
+        """--batching-mode iteration composes with a restricted option
+        surface (docs/DEPLOYMENT.md): the paged engine is a greedy
+        single-model decoder, and the lifecycle's swap plane does not
+        yet quiesce at step boundaries — fail LOUDLY at boot rather
+        than serving something subtly different from what was asked."""
+        problems = []
+        if float(options.get("model-watch", 0) or 0) > 0:
+            problems.append(
+                "--model-watch (hot-swap needs a step-boundary quiesce "
+                "with an empty join set — ROADMAP item; use "
+                "--batching-mode request for the lifecycle plane)")
+        if int(options.get("beam-size", 6) or 6) != 1:
+            problems.append("--beam-size must be 1 (the paged engine "
+                            "decodes greedily; beam>1 iteration needs "
+                            "copy-on-write page sharing — ROADMAP)")
+        models = list(options.get("models", []) or [])
+        if len(models) > 1:
+            problems.append("--models ensembles are not supported")
+        for flag, why in (("n-best", "n-best output"),
+                          ("output-sampling", "sampling"),
+                          ("alignment", "alignment output"),
+                          ("force-decode", "forced prefixes"),
+                          ("shortlist", "lexical shortlists"),
+                          ("word-scores", "per-word scores")):
+            v = options.get(flag, None)
+            if v not in (None, False, [], "", 0):
+                problems.append(f"--{flag} ({why})")
+        if int(options.get("num-devices", 0) or 0) > 1:
+            problems.append("--num-devices > 1 (the paged pallas call "
+                            "is GSPMD-opaque, like the fused decode "
+                            "kernel)")
+        if problems:
+            raise ValueError(
+                "--batching-mode iteration does not support: "
+                + "; ".join(problems))
+
+    def _build_engine(self):
+        """Fresh PagedDecodeEngine over the boot TranslationService's
+        model (also the scheduler's rebuild hook after a watchdog trip —
+        the wedged worker thread owns the old engine's device state)."""
+        from ..translator.iteration import PagedDecodeEngine
+        tr = self.service.translator
+        opts = self.options
+        ml = max(1, int(opts.get("max-length", 50) or 50))
+        return PagedDecodeEngine(
+            tr.model, tr.params_list[0], tr.src_vocab, tr.trg_vocab,
+            max_rows=int(opts.get("iteration-rows", 32) or 32),
+            page_len=int(opts.get("kv-page-len", 16) or 16),
+            pool_bytes=int(opts.get("kv-pool-bytes", 0) or 0),
+            src_len_cap=bucket_length(ml + 1),
+            max_length_cap=ml,
+            max_length_factor=float(
+                opts.get("max-length-factor", 3.0) or 3.0),
+            steps_per_round=int(opts.get("iteration-steps", 1) or 1),
+            registry=self.registry)
 
     def _set_perf_geometry(self) -> None:
         """Feed the live-MFU gauges the real model geometry when a real
@@ -447,11 +552,13 @@ class ServingApp:
         # reply metadata (queue vs service breakdown) is collected iff
         # the client asked for it by sending a trace header
         meta: Optional[Dict] = {} if trace_id is not None else None
+        n_pages = (sum(self._pages_for_text(l) for l in lines)
+                   if self._pages_for_text is not None else 0)
         try:
             # admit inside the span context so a shed's timeline event
             # inherits the trace id (flight dumps tie it to the victim)
             with obs.TRACER.use(span):
-                self.admission.admit(len(lines))
+                self.admission.admit(len(lines), n_pages=n_pages)
         except Overloaded as e:
             return self._finish_frame(trace_id, meta, span, "shed",
                                       f"!!SERVER-OVERLOADED {e}")

@@ -48,9 +48,18 @@ class AdmissionController:
 
     def __init__(self, max_queue_units: int,
                  depth_fn: Callable[[], int],
-                 registry: Optional[msm.Registry] = None):
+                 registry: Optional[msm.Registry] = None,
+                 max_queue_pages: int = 0,
+                 pages_fn: Optional[Callable[[], int]] = None):
         self.max_queue_units = int(max_queue_units)
         self.depth_fn = depth_fn
+        # iteration mode (--batching-mode iteration): queue debt is
+        # ALSO priced in KV-pool PAGES — a 500-token sentence owes far
+        # more pool time than a 5-token one, which the sentence bound
+        # cannot see. pages_fn reports the scheduler's live queued-page
+        # debt; requests add their own page estimate at admit time.
+        self.max_queue_pages = int(max_queue_pages)
+        self.pages_fn = pages_fn
         # drain state crosses threads: transports admit() on the event-loop
         # thread, begin_drain() fires from a signal handler / main thread,
         # and /readyz reads `draining` from the metrics scrape thread —
@@ -75,11 +84,13 @@ class AdmissionController:
         with self._lock:
             return self._draining
 
-    def admit(self, n_units: int) -> None:
-        """Gate one request of ``n_units`` sentences; raises Overloaded
-        instead of queueing when the bound would be exceeded or the server
-        is draining. Admission is all-or-nothing per request — partial
-        admission would split one client's reply across a shed boundary."""
+    def admit(self, n_units: int, n_pages: int = 0) -> None:
+        """Gate one request of ``n_units`` sentences (owing ``n_pages``
+        KV-pool pages in iteration mode); raises Overloaded instead of
+        queueing when a bound would be exceeded or the server is
+        draining. Admission is all-or-nothing per request — partial
+        admission would split one client's reply across a shed
+        boundary."""
         if self.draining:
             self.m_shed.labels("draining").inc()
             # shed decisions land on the obs timeline so a flight dump
@@ -98,6 +109,16 @@ class AdmissionController:
                 raise Overloaded(
                     f"queue full ({depth}/{self.max_queue_units} sentences "
                     f"queued, request adds {n_units}); retry later")
+        if self.max_queue_pages > 0 and self.pages_fn is not None:
+            pages = int(self.pages_fn())
+            if pages + n_pages > self.max_queue_pages:
+                self.m_shed.labels("pages_full").inc()
+                obs.event("admission.shed", reason="pages_full",
+                          units=n_units, pages=pages)
+                raise Overloaded(
+                    f"queue page debt full ({pages}/"
+                    f"{self.max_queue_pages} KV-pool pages owed, request "
+                    f"adds {n_pages}); retry later")
         self.m_admitted.inc(n_units)
 
     def begin_drain(self) -> None:

@@ -77,9 +77,10 @@ def default_length_fn(line: str) -> int:
 
 class _Request:
     __slots__ = ("lines", "future", "priority", "arrival", "deadline",
-                 "results", "remaining", "queued", "first_dispatch",
-                 "timeout_handle", "dead_accounted", "trace_id", "span",
-                 "own_root", "q_span", "d_span", "meta")
+                 "results", "remaining", "queued", "queued_pages",
+                 "first_dispatch", "timeout_handle", "dead_accounted",
+                 "trace_id", "span", "own_root", "q_span", "d_span",
+                 "meta")
 
     def __init__(self, lines: List[str], future: "asyncio.Future",
                  priority: int, arrival: float, deadline: Optional[float]):
@@ -91,6 +92,7 @@ class _Request:
         self.results: List[Optional[str]] = [None] * len(lines)
         self.remaining = len(lines)
         self.queued = len(lines)        # units currently sitting in lanes
+        self.queued_pages = 0           # page debt of those units (iteration)
         self.first_dispatch: Optional[float] = None
         self.timeout_handle = None
         # True once _on_request_done added this request's leftover queued
@@ -114,13 +116,17 @@ class _Request:
 class _Unit:
     """One sentence of one request — the scheduling granule."""
 
-    __slots__ = ("req", "idx", "text", "tokens")
+    __slots__ = ("req", "idx", "text", "tokens", "pages")
 
-    def __init__(self, req: _Request, idx: int, text: str, tokens: int):
+    def __init__(self, req: _Request, idx: int, text: str, tokens: int,
+                 pages: int = 0):
         self.req = req
         self.idx = idx
         self.text = text
         self.tokens = tokens
+        # KV-pool pages this sentence will claim (iteration mode's
+        # admission currency; 0 in request mode)
+        self.pages = pages
 
 
 class ContinuousScheduler:
@@ -134,8 +140,29 @@ class ContinuousScheduler:
                  registry: Optional[msm.Registry] = None,
                  executor: Optional[concurrent.futures.Executor] = None,
                  stall_timeout: float = 0.0,
-                 version_fn: Optional[Callable[[], str]] = None):
+                 version_fn: Optional[Callable[[], str]] = None,
+                 batching_mode: str = "request",
+                 engine=None,
+                 engine_factory: Optional[Callable[[], object]] = None):
         self.translate_lines = translate_lines
+        # --batching-mode (ISSUE 10): 'request' packs whole requests
+        # into device batches (the PR 6 scheduler); 'iteration' moves
+        # scheduling INSIDE the decode loop — the forming pass runs
+        # every decode step against the paged KV pool's free pages, so
+        # sentences join a RUNNING decode and finished ones leave it
+        # (engine = translator/iteration.py::PagedDecodeEngine).
+        if batching_mode not in ("request", "iteration"):
+            raise ValueError(f"--batching-mode must be request or "
+                             f"iteration, got {batching_mode!r}")
+        if batching_mode == "iteration" and engine is None:
+            raise ValueError("--batching-mode iteration needs a "
+                             "PagedDecodeEngine (translate_lines alone "
+                             "cannot join rows mid-decode)")
+        self.batching_mode = batching_mode
+        self.engine = engine
+        # rebuilds the engine after a liveness trip (the wedged worker
+        # thread owns the old engine's device state)
+        self.engine_factory = engine_factory
         # model-version label source for the outcome counter; the
         # lifecycle SwapController installs its live_version_name here
         # so dashboards can pin an outcome shift to the exact hot-swap
@@ -172,6 +199,11 @@ class ContinuousScheduler:
         self._state_lock = lockdep.make_lock(
             "ContinuousScheduler._state_lock")
         self._queued = 0                  # guarded-by: _state_lock
+        # queue debt in KV-pool PAGES (iteration mode's admission
+        # currency — a 500-token sentence owes more pool than a
+        # 5-token one, which sentence counts cannot express)
+        self._queued_pages = 0            # guarded-by: _state_lock
+        self._dead_pages = 0              # guarded-by: _state_lock
         # units in lanes whose request already resolved (timed out /
         # cancelled / failed): still physically queued until the next
         # forming pass sweeps them, but DEAD — admission must not shed
@@ -187,6 +219,9 @@ class ContinuousScheduler:
         # results for them, and their units left the lanes at forming
         # time, so the lane sweep alone would leave their clients hanging.
         self._inflight_units: List[_Unit] = []
+        # iteration mode: units currently decoding in engine slots
+        # (loop-thread-only; the engine holds the device-side rows)
+        self._active_units: Dict[_Unit, None] = {}
 
         r = registry if registry is not None else msm.REGISTRY
         self.m_requests = r.counter(
@@ -235,6 +270,33 @@ class ContinuousScheduler:
             "Requests resolved, by outcome and the model version live at "
             "resolution time (ok|failure|timeout|cancelled|stalled)",
             labels=("outcome", "model_version"))
+        # iteration-mode series (--batching-mode iteration): joins and
+        # evictions happen PER DECODE STEP, not per batch — these are
+        # the counters that prove mid-decode admission actually ran
+        # (the loadgen A/B reads their deltas)
+        self.m_joins = r.counter(
+            "marian_serving_joins_total",
+            "Sentences that joined a decode (iteration mode)")
+        self.m_mid_joins = r.counter(
+            "marian_serving_mid_decode_joins_total",
+            "Sentences that joined a RUNNING decode step beside already-"
+            "decoding rows (iteration mode)")
+        self.m_evictions = r.counter(
+            "marian_serving_evictions_total",
+            "Mid-decode evictions of dead rows (request cancelled / "
+            "timed out while its sentence was decoding; iteration mode)")
+        self.m_steps = r.counter(
+            "marian_serving_decode_steps_total",
+            "Decode steps run by the iteration-mode worker")
+        self.m_step_rows = r.histogram(
+            "marian_serving_step_active_rows",
+            "Active decode rows per iteration-mode step (pre-bucket)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        self.m_queued_pages = r.gauge(
+            "marian_serving_queue_depth_pages",
+            "KV-pool pages owed by queued sentences (iteration mode's "
+            "admission currency)")
+        self.m_queued_pages.set_function(self.queued_pages)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -247,7 +309,8 @@ class ContinuousScheduler:
         fail explicitly (never a silent hang)."""
         # capture before cancelling: _dispatch's finally clears the list
         # while the cancellation unwinds during `await self._task`
-        pending = list(self._inflight_units)
+        pending = list(self._inflight_units) + list(self._active_units)
+        self._active_units.clear()
         if self._task is not None:
             self._task.cancel()
             try:
@@ -268,6 +331,7 @@ class ContinuousScheduler:
                 # counters we zero below — a reused scheduler would
                 # otherwise under-report depth to admission forever
                 u.req.queued = 0
+                u.req.queued_pages = 0
                 if not u.req.future.done():
                     u.req.future.set_exception(
                         RuntimeError("server shut down"))
@@ -275,6 +339,8 @@ class ContinuousScheduler:
         with self._state_lock:
             self._queued = 0
             self._dead = 0
+            self._queued_pages = 0
+            self._dead_pages = 0
         if self._own_executor:
             self._executor.shutdown(wait=False)
 
@@ -286,7 +352,8 @@ class ContinuousScheduler:
         dl = loop.time() + timeout if timeout is not None else None
 
         def _done() -> bool:
-            return self._queue_size() == 0 and self._inflight == 0
+            return (self._queue_size() == 0 and self._inflight == 0
+                    and not self._active_units)
 
         while not _done():
             if dl is not None and loop.time() >= dl:
@@ -305,6 +372,14 @@ class ContinuousScheduler:
         are excluded, so expired backlog never sheds live traffic."""
         with self._state_lock:
             return max(0, self._queued - self._dead)
+
+    def queued_pages(self) -> int:
+        """LIVE queue debt in KV-pool pages (iteration mode; 0 in
+        request mode) — what page-priced admission and the headroom
+        gauge's queue-pressure input see. Sampled from the metrics
+        scrape thread, hence the lock."""
+        with self._state_lock:
+            return max(0, self._queued_pages - self._dead_pages)
 
     def _queue_size(self) -> int:
         """Raw queued-unit count (live + dead) under the state lock."""
@@ -358,11 +433,17 @@ class ContinuousScheduler:
             req.q_span = obs.start_span("serve.queue", parent=req.span,
                                         n_sentences=len(lines))
         self.m_requests.inc()
+        iteration = self.batching_mode == "iteration"
         with self._state_lock:
             for i, text in enumerate(lines):
-                u = _Unit(req, i, text, max(1, int(self.length_fn(text))))
+                pages = (self.engine.pages_for_text(text) if iteration
+                         else 0)
+                u = _Unit(req, i, text, max(1, int(self.length_fn(text))),
+                          pages=pages)
                 self._lanes[priority].append(u)
                 self._queued += 1
+                self._queued_pages += pages
+                req.queued_pages += pages
         if deadline is not None:
             # the deadline fires even if the unit is buried deep in the
             # backlog — a timed-out client gets its error ON TIME, and the
@@ -436,9 +517,13 @@ class ContinuousScheduler:
         with self._state_lock:
             req.dead_accounted = True
             self._dead += req.queued
+            self._dead_pages += req.queued_pages
 
     # -- worker -------------------------------------------------------------
     async def _run(self) -> None:
+        if self.batching_mode == "iteration":
+            await self._run_iteration()
+            return
         loop = asyncio.get_event_loop()
         while True:
             try:
@@ -491,13 +576,16 @@ class ContinuousScheduler:
                     # state lock
                     scanned += 1
                     self._queued -= 1
+                    self._queued_pages -= u.pages
                     u.req.queued -= 1
+                    u.req.queued_pages -= u.pages
                     if u.req.future.done():
                         if u.req.dead_accounted:
                             # drop a dead unit the done-callback counted;
                             # if the callback hasn't run yet it will see
                             # the already-lowered req.queued instead
                             self._dead -= 1
+                            self._dead_pages -= u.pages
                         continue
                     new_width = max(width, bucket_length(u.tokens,
                                                          self.length_buckets))
@@ -524,8 +612,256 @@ class ContinuousScheduler:
             for u in reversed(skipped):
                 self._lanes[u.req.priority].appendleft(u)
                 self._queued += 1
+                self._queued_pages += u.pages
                 u.req.queued += 1
+                u.req.queued_pages += u.pages
         return batch
+
+    # -- iteration mode (ISSUE 10) ------------------------------------------
+    async def _run_iteration(self) -> None:
+        """Scheduling INSIDE the decode loop: every round is one decode
+        step of the paged engine, preceded by a join pass that admits
+        queued sentences against the pool's free pages. Finished rows
+        resolve per step; the device never idles behind a draining
+        batch, and a sentence never waits for one."""
+        loop = asyncio.get_event_loop()
+        while True:
+            try:
+                was_idle = False
+                while self._queue_size() == 0 and not self._active_units:
+                    self._wake.clear()
+                    was_idle = True
+                    await self._wake.wait()
+                if was_idle and self.window_s > 0:
+                    await asyncio.sleep(self.window_s)
+                await self._iteration_round(loop)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — supervision: never die
+                log.error("serving scheduler error (recovered): {}", e)
+
+    def _form_join_set(self) -> List[_Unit]:
+        """The iteration-mode forming pass: it runs EVERY decode step
+        and packs against the pool's free pages + slots, not a token
+        budget — a sentence joins the moment capacity exists. Same lane
+        order, dead-unit sweep and scan bound as _form_batch."""
+        joins: List[_Unit] = []
+        budget_pages = self.engine.free_pages()
+        budget_slots = self.engine.free_slots()
+        scanned = 0
+        skipped: List[_Unit] = []
+        with self._state_lock:
+            for prio in sorted(self._lanes.keys(), reverse=True):
+                lane = self._lanes[prio]
+                while lane and scanned < self.scan_limit:
+                    u = lane.popleft()
+                    scanned += 1
+                    self._queued -= 1
+                    self._queued_pages -= u.pages
+                    u.req.queued -= 1
+                    u.req.queued_pages -= u.pages
+                    if u.req.future.done():
+                        if u.req.dead_accounted:
+                            self._dead -= 1
+                            self._dead_pages -= u.pages
+                        continue
+                    if u.pages > self.engine.pool.usable_pages:
+                        # estimate says this sentence can NEVER fit the
+                        # pool: hand it to the engine anyway (outside
+                        # the budget) — it re-measures with the real
+                        # vocab encoding and either admits or FATALLY
+                        # rejects. Skipping it here would park it at
+                        # the queue head forever (livelock).
+                        joins.append(u)
+                        continue
+                    if len(joins) >= budget_slots \
+                            or u.pages > budget_pages:
+                        skipped.append(u)
+                        continue
+                    budget_pages -= u.pages
+                    joins.append(u)
+                if scanned >= self.scan_limit:
+                    break
+            for u in reversed(skipped):
+                self._lanes[u.req.priority].appendleft(u)
+                self._queued += 1
+                self._queued_pages += u.pages
+                u.req.queued += 1
+                u.req.queued_pages += u.pages
+        return joins
+
+    def _requeue_front(self, u: _Unit) -> None:
+        """Return a join-rejected unit to the FRONT of its lane (the
+        engine's claim re-check lost a capacity race — FIFO preserved)."""
+        with self._state_lock:
+            self._lanes[u.req.priority].appendleft(u)
+            self._queued += 1
+            self._queued_pages += u.pages
+            u.req.queued += 1
+            u.req.queued_pages += u.pages
+            if u.req.future.done() and u.req.dead_accounted:
+                # died between pop and requeue: restore the dead count
+                # the done-callback could no longer see
+                self._dead += 1
+                self._dead_pages += u.pages
+
+    def _fail_unit(self, u: _Unit, loop, message: str) -> None:
+        if u.req.future.done():
+            return
+        self.m_failures.inc()
+        self._outcome("failure", u.req, loop.time())
+        log.error("iteration admission: {}", message)
+        u.req.future.set_exception(RuntimeError(message))
+
+    def _mark_joined(self, u: _Unit, now: float, rows_before: int) -> None:
+        """A sentence entered the decode. queue_ms STOPS HERE — at join
+        time, not at some enclosing batch's dispatch time: a sentence
+        joining a running decode must not inherit the running rows'
+        deadline/queue accounting (ISSUE 10 small fix; the #trace
+        breakdown regression test pins it)."""
+        self._active_units[u] = None
+        self.m_joins.inc()
+        if rows_before > 0:
+            self.m_mid_joins.inc()
+        req = u.req
+        if req.first_dispatch is None:
+            req.first_dispatch = now
+            self.m_ttfb.observe(now - req.arrival,
+                                trace_id=req.trace_id or None)
+            if req.q_span is not None:
+                obs.end(req.q_span)
+                req.q_span = None
+                req.d_span = obs.start_span(
+                    "serve.dispatch", parent=req.span,
+                    joined_mid_decode=rows_before > 0)
+
+    async def _iteration_round(self, loop) -> None:
+        """One join-pass + decode-step round on the device worker."""
+        engine = self.engine
+        joins = self._form_join_set()
+        evicts = [u for u in list(self._active_units)
+                  if u.req.future.done()]
+        rows_before = engine.active_rows()
+        # queue_ms stops at JOIN time: stamp accepted units with the
+        # round's start, not with a post-step timestamp that would bill
+        # the step (and any jit warmup) as queueing
+        t_round = loop.time()
+        self._inflight += 1
+        try:
+            fp.fault_point("serving.dispatch")
+            payload = [(u, u.text) for u in joins]
+
+            def _round():
+                fp.fault_point("serving.translate")
+                return engine.admit_and_step(payload, evicts)
+
+            call = loop.run_in_executor(self._executor, _round)
+            if self.stall_timeout > 0:
+                try:
+                    res = await asyncio.wait_for(asyncio.shield(call),
+                                                 self.stall_timeout)
+                except asyncio.TimeoutError:
+                    self._iteration_stalled(call, joins, loop)
+                    return
+            else:
+                res = await call
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            # an engine-round failure has no per-sentence bisection (the
+            # step computes all rows jointly): fail the round's requests
+            # explicitly and rebuild the engine if a factory was given
+            self._iteration_failed(joins, loop, e)
+            return
+        finally:
+            self._inflight -= 1
+        for u in evicts:
+            if u in self._active_units:
+                del self._active_units[u]
+                self.m_evictions.inc()
+        for u in res.accepted:
+            self._mark_joined(u, t_round, rows_before)
+        from ..translator.iteration import FATAL_REASONS
+        requeue: List[_Unit] = []
+        for u, why in res.rejected:
+            if why in FATAL_REASONS:
+                self._fail_unit(
+                    u, loop,
+                    f"sentence cannot be admitted ({why}): exceeds the "
+                    f"engine's source cap or the whole KV pool")
+            else:
+                requeue.append(u)
+        # appendleft in REVERSE so the lane keeps FIFO order across
+        # rejection rounds (same discipline as _form_batch's skipped
+        # path) — forward order would swap same-priority units every
+        # round and starve the earliest request under pool pressure
+        for u in reversed(requeue):
+            self._requeue_front(u)
+        src_done = 0
+        for u, text in res.finished:
+            self._active_units.pop(u, None)
+            src_done += u.tokens
+            self._complete_unit(u, text, loop)
+        if res.rows:
+            self.m_steps.inc(max(1, res.steps))
+            self.m_step_rows.observe(res.rows)
+            self.m_batches.inc()     # a step IS the device-batch unit here
+            self.m_batch_rows.observe(res.rows)
+            if obs.PERF.enabled:
+                # PER-STEP device-seconds attribution: rows of different
+                # ages share a step, so chip-seconds/token integrates
+                # step cost over the tokens THIS step emitted (src
+                # tokens credit at sentence completion, like request
+                # mode credits on delivery)
+                obs.PERF.record_batch(
+                    self._version_label(), rows=res.rows,
+                    width=res.bucket, src_tokens=src_done,
+                    trg_tokens=res.tokens, device_s=res.device_s)
+
+    def _iteration_stalled(self, call, joins: List[_Unit], loop) -> None:
+        """The engine round exceeded --dispatch-stall-timeout. Fail every
+        involved request retriably, abandon the wedged worker (with the
+        old engine's device state) and rebuild via engine_factory.
+        (The caller's finally still runs — inflight bookkeeping stays
+        with the caller.)"""
+        victims = list(self._active_units) + joins
+        self._active_units.clear()
+        self._trip_watchdog(call, len(victims))
+        now = loop.time()
+        for u in victims:
+            if not u.req.future.done():
+                self._outcome("stalled", u.req, now)
+                u.req.future.set_exception(DispatchStalled(
+                    f"decode step stalled past {self.stall_timeout}s — "
+                    f"retry"))
+        obs.event("serve.watchdog_trip", rows=len(victims),
+                  stall_timeout=self.stall_timeout, mode="iteration")
+        obs.FLIGHT.trip_async(
+            "watchdog",
+            detail=f"iteration decode step ({len(victims)} sentences) "
+                   f"stalled past {self.stall_timeout}s")
+        if self.engine_factory is not None:
+            try:
+                self.engine = self.engine_factory()
+            except Exception as e:  # noqa: BLE001
+                log.error("engine rebuild after stall failed: {}", e)
+
+    def _iteration_failed(self, joins: List[_Unit], loop, exc) -> None:
+        victims = list(self._active_units) + joins
+        self._active_units.clear()
+        log.error("iteration decode round failed ({} sentences): {}",
+                  len(victims), exc)
+        now = loop.time()
+        for u in victims:
+            if not u.req.future.done():
+                self.m_failures.inc()
+                self._outcome("failure", u.req, now)
+                u.req.future.set_exception(RuntimeError(str(exc)))
+        if self.engine_factory is not None:
+            try:
+                self.engine = self.engine_factory()
+            except Exception as e:  # noqa: BLE001
+                log.error("engine rebuild after failure failed: {}", e)
 
     async def _dispatch(self, units: List[_Unit], loop,
                         form_s: float = 0.0) -> None:

@@ -7,11 +7,20 @@ with no gather to fold, the fused decode kernel's 'auto' gate stays OFF
 for greedy (its full-cache write-back would only add HBM traffic over
 the in-place single-position cache write). An explicit
 --transformer-fused-decode-attention on still forces the kernel
-(ops/pallas/decode_attention.py) with the identity gather."""
+(ops/pallas/decode_attention.py) with the identity gather.
+
+``greedy_decode_paged`` is the row-as-slot restructuring of the same
+loop (ISSUE 10): the dense per-batch cache becomes a paged pool, every
+row carries its OWN position, and a finished row frees its pages and
+LEAVES the step — the active-row count rounds down through the bucket
+table as rows finish instead of the whole batch decoding at the width
+of its slowest member. It is the library-call face of
+translator/iteration.py's serving engine (and the dense A/B comparator
+bench_decode's ``paged`` stage drives)."""
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,9 +29,20 @@ import numpy as np
 from ..data.vocab import EOS_ID
 
 
+def _abstract(*args):
+    """Args as ShapeDtypeStructs (for jitted.lower introspection without
+    keeping — or touching — real buffers; bench_decode op counting)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+        args)
+
+
 def greedy_decode(model, params, src_ids: jnp.ndarray, src_mask: jnp.ndarray,
-                  max_len: int) -> np.ndarray:
-    """Returns [B, max_len] int32 output ids, EOS-padded after finish."""
+                  max_len: int, introspect: Optional[dict] = None
+                  ) -> np.ndarray:
+    """Returns [B, max_len] int32 output ids, EOS-padded after finish.
+    ``introspect`` (bench_decode): receives {('dense_step',): (jitted,
+    args)} so the caller can count the compiled step program's ops."""
     b = src_ids.shape[0]
     enc_out = model.encode_for_decode(params, src_ids, src_mask)
     state = model.start_state(params, enc_out, src_mask, max_len)
@@ -30,6 +50,9 @@ def greedy_decode(model, params, src_ids: jnp.ndarray, src_mask: jnp.ndarray,
     finished = jnp.zeros((b,), bool)
     outs = []
     step_fn = jax.jit(lambda p, s, pr: model.step(p, s, pr, src_mask))
+    if introspect is not None:
+        introspect.setdefault(("dense_step",),
+                              (step_fn, _abstract(params, state, prev)))
     for _ in range(max_len):
         logits, state = step_fn(params, state, prev)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -40,3 +63,91 @@ def greedy_decode(model, params, src_ids: jnp.ndarray, src_mask: jnp.ndarray,
         if bool(jnp.all(finished)):
             break
     return np.asarray(jnp.stack(outs, axis=1))  # mtlint: ok -- terminal materialization; the per-step bool(all(finished)) above already synced every step (greedy is the simple reference path, not the serving one)
+
+
+def greedy_decode_paged(model, params, src_ids: jnp.ndarray,
+                        src_mask: jnp.ndarray, max_len: int,
+                        page_len: int = 0,
+                        row_buckets=None,
+                        introspect: Optional[dict] = None) -> np.ndarray:
+    """Greedy decode over a PAGED KV pool with rows as slots: every row
+    decodes at its own position, and a finished row releases its pages
+    and leaves the compiled step (active rows round up through the
+    bucket table, so the step shrinks as the batch drains instead of
+    running at full width until the slowest row finishes).
+
+    Same outputs as :func:`greedy_decode` (tests pin token equality);
+    returns [B, max_len] int32, EOS-padded after finish.
+    """
+    from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, KVPool,
+                                      ROW_BUCKETS, bucket_rows,
+                                      pages_for_tokens)
+    b = src_ids.shape[0]
+    page_len = int(page_len) or DEFAULT_PAGE_LEN
+    buckets = tuple(sorted(set(min(x, b) for x in
+                               (row_buckets or ROW_BUCKETS))))
+    mp = pages_for_tokens(max_len, page_len)
+    pool = KVPool(1 + b * mp, page_len, max_pages_per_row=mp)
+    enc = model.encode_for_decode(params, src_ids, src_mask)
+    state = model.start_paged_state(params, enc, src_mask,
+                                    1 + b * mp, page_len, mp)
+    table = np.zeros((b, mp), np.int32)
+    for r in range(b):
+        table[r, :] = pool.claim(r, mp)
+    pos = np.zeros((b,), np.int32)
+    prev = np.zeros((b, 1), np.int32)
+    alive = np.ones((b,), bool)
+    out = np.full((b, max_len), EOS_ID, np.int32)
+
+    step_jits: Dict[int, object] = {}
+    # static key classification OUTSIDE the jitted closure (its body
+    # must stay free of Python conditionals); ONE shared contract with
+    # the serving engine (kv_pool.state_key_groups)
+    from ..ops.pallas.kv_pool import state_key_groups
+    row_keys, pool_keys, whole_keys = state_key_groups(state)
+
+    def step_fn(rb: int):
+        fn = step_jits.get(rb)
+        if fn is None:
+            def stp(st, sm, p, pr, po, tb):
+                sub = {k: st[k][:rb] for k in row_keys}
+                for k in whole_keys + pool_keys:
+                    sub[k] = st[k]
+                sub["pos"] = po
+                sub["page_table"] = tb
+                logits, new_sub = model.step(p, sub, pr, sm[:rb])
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                new_st = dict(st)
+                for k in pool_keys:
+                    new_st[k] = new_sub[k]
+                return nxt, new_st
+            fn = jax.jit(stp, donate_argnums=(0,))
+            step_jits[rb] = fn
+        return fn
+
+    for t in range(max_len):
+        if not alive.any():
+            break
+        top = int(np.nonzero(alive)[0].max())
+        rb = bucket_rows(top + 1, buckets)
+        po = np.where(alive[:rb], pos[:rb], -1).astype(np.int32)
+        step_args = (state, src_mask, params, jnp.asarray(prev[:rb]),
+                     jnp.asarray(po), jnp.asarray(table[:rb]))
+        if introspect is not None and ("paged_step", rb) not in introspect:
+            # abstract shapes only — the call below DONATES the state
+            introspect[("paged_step", rb)] = (step_fn(rb),
+                                              _abstract(*step_args))
+        nxt_dev, state = step_fn(rb)(*step_args)
+        nxt = np.asarray(nxt_dev)  # mtlint: ok -- per-step sync by design: rows leave the compiled step the moment they finish (the slot-bucket lever this path exists for)
+        for r in range(rb):
+            if not alive[r]:
+                continue
+            tok = int(nxt[r])
+            out[r, pos[r]] = tok
+            pos[r] += 1
+            prev[r, 0] = tok
+            if tok == EOS_ID or pos[r] >= max_len:
+                alive[r] = False
+                pool.release(r)        # the slot lever: pages free NOW
+                table[r, :] = 0
+    return out

@@ -1,0 +1,496 @@
+"""Iteration-level (continuous) decoding over a paged KV pool.
+
+Request-mode serving (serving/scheduler.py default) packs whole requests
+into device batches: a sentence admitted mid-decode waits for the
+current batch to drain, and the dense per-batch cache makes every row
+pay the longest member's decode length. This module turns decode rows
+into SLOTS over one shared paged KV pool (ops/pallas/kv_pool.py):
+
+- a sentence JOINS a running decode at any step boundary, claiming a
+  slot and enough pages for its own decode cap, and starts at its own
+  position 0 while its neighbors are at position 40;
+- a finished sentence LEAVES at the step it emits EOS, releasing its
+  pages immediately — capacity returns to the admission plane per
+  sentence, not per batch;
+- each step runs one jitted decode over the occupied slot prefix,
+  rounded UP to a ROW BUCKET (ops/pallas/kv_pool.ROW_BUCKETS) so every
+  step lands on one of a small closed set of compiled shapes — the TPU
+  static-shape compilation model is preserved by bucketing, never by
+  dynamic shapes.
+
+The engine is GREEDY (beam 1) — the production high-throughput serving
+config (cf. bench_decode's MARIAN_DECBENCH_BEAM=1 "student serving"
+note). Beam>1 iteration decoding needs copy-on-write page sharing
+across beams and is an open ROADMAP item; the server validates the
+combination loudly (server/server.py).
+
+Threading contract: every device-touching method (``admit_and_step``)
+runs on the serving scheduler's single device worker thread. The
+metrics scrape thread reads only the counters guarded by
+``PagedDecodeEngine._lock`` and the pool's own lock.
+
+Determinism: joins are applied in caller order onto the LOWEST free
+slot, page claims pop a deterministic free list, idle slots write only
+zeros into the reserved trash page — replaying an identical join/evict
+schedule yields bitwise-identical outputs (tests/test_iteration.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import lockdep
+from ..data.vocab import EOS_ID
+from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, KVPool, PoolExhausted,
+                                  ROW_BUCKETS, bucket_rows, pages_for_tokens)
+
+# fatal join-rejection reasons: the sentence can NEVER be admitted (the
+# scheduler fails its request explicitly instead of re-queueing — this
+# is what keeps a drained pool from deadlocking the step loop behind an
+# unadmittable head-of-line sentence)
+FATAL_REASONS = ("src_too_long", "too_large")
+
+
+@dataclass
+class StepResult:
+    """One admit+step round on the device worker thread."""
+    accepted: List[object] = field(default_factory=list)
+    # key -> reason; reasons in FATAL_REASONS are permanent
+    rejected: List[Tuple[object, str]] = field(default_factory=list)
+    finished: List[Tuple[object, str]] = field(default_factory=list)
+    rows: int = 0                 # active rows this round (before finishes)
+    bucket: int = 0               # compiled row bucket the round ran at
+    tokens: int = 0               # target tokens consumed this round
+    steps: int = 0                # decode steps the round advanced
+    device_s: float = 0.0         # admit+step wall on the worker thread
+    mid_decode_joins: int = 0     # joins that landed beside running rows
+
+
+class _Slot:
+    __slots__ = ("key", "tokens", "pos", "cap", "prev", "src_tokens")
+
+    def __init__(self, key, cap: int, src_tokens: int):
+        self.key = key
+        self.tokens: List[int] = []
+        self.pos = 0                # next write position
+        self.cap = cap              # decode cap (max positions)
+        self.prev = 0               # previous token id (0 at pos 0)
+        self.src_tokens = src_tokens
+
+
+class PagedDecodeEngine:
+    """Slot-based continuous greedy decoder over a paged KV pool."""
+
+    # encode-at-join batch buckets (one compiled encoder shape per entry)
+    JOIN_BUCKETS = (1, 2, 4, 8)
+
+    def __init__(self, model, params, src_vocab, trg_vocab,
+                 max_rows: int = 32,
+                 page_len: int = DEFAULT_PAGE_LEN,
+                 pool_bytes: int = 0,
+                 src_len_cap: int = 64,
+                 max_length_cap: int = 256,
+                 max_length_factor: float = 3.0,
+                 row_buckets: Sequence[int] = ROW_BUCKETS,
+                 steps_per_round: int = 1,
+                 registry=None):
+        cfg = getattr(model, "cfg", None)
+        if cfg is None or getattr(cfg, "decoder_autoreg", "") \
+                != "self-attention":
+            raise ValueError("iteration-level decoding requires a "
+                             "transformer with the self-attention "
+                             "autoreg decoder")
+        if getattr(cfg, "n_encoders", 1) != 1:
+            raise ValueError("iteration-level decoding supports a single "
+                             "source stream")
+        self.model = model
+        self.params = params
+        self.src_vocab = src_vocab
+        self.trg_vocab = trg_vocab
+        self.max_rows = int(max_rows)
+        self.page_len = int(page_len)
+        self.src_cap = int(src_len_cap)
+        self.max_length_cap = int(max_length_cap)
+        self.max_length_factor = float(max_length_factor)
+        self.row_buckets = tuple(sorted(set(
+            min(b, self.max_rows) for b in row_buckets)))
+        self.max_pages = pages_for_tokens(self.max_length_cap,
+                                          self.page_len)
+        # decode steps per round, run as ONE jitted lax.scan: joins are
+        # still admitted every round, so admission granularity is
+        # steps_per_round steps (default 1 = pure iteration-level).
+        # >1 amortizes per-call dispatch/transfer on host-bound
+        # backends; a row finishing mid-scan self-feeds until the host
+        # cuts at its EOS — those few wasted row-steps are the price of
+        # the amortization (docs/DEPLOYMENT.md)
+        self.steps_per_round = max(1, int(steps_per_round))
+
+        h, dh, depth = cfg.heads, cfg.dim_head, cfg.dec_depth
+        self._dtype = cfg.compute_dtype
+        dtype_bytes = jnp.dtype(self._dtype).itemsize
+        # bytes one PAGE costs across the whole decoder: K+V, all layers
+        self.page_bytes = 2 * depth * h * self.page_len * dh * dtype_bytes
+        if pool_bytes and pool_bytes > 0:
+            n_pages = 1 + max(1, int(pool_bytes) // self.page_bytes)
+        else:
+            # default: every slot can hold a full-cap row (the pool is
+            # then never the constraint — shrink --kv-pool-bytes to make
+            # admission page-bound)
+            n_pages = 1 + self.max_rows * self.max_pages
+        self.pool = KVPool(n_pages, self.page_len,
+                           max_pages_per_row=self.max_pages)
+
+        # device state: model paged state (pools + cross caches) plus
+        # the per-slot source mask; owned by the worker thread
+        d = cfg.dim_emb
+        enc0 = jnp.zeros((self.max_rows, self.src_cap, d), self._dtype)
+        mask0 = np.zeros((self.max_rows, self.src_cap), np.float32)
+        mask0[:, 0] = 1.0       # idle rows keep one live source position
+        self._src_mask = jnp.asarray(mask0)
+        self._state = model.start_paged_state(
+            params, enc0, self._src_mask, n_pages, self.page_len,
+            self.max_pages)
+
+        # host slot bookkeeping (worker thread); the COUNTERS cross to
+        # the metrics scrape thread and ride the lock
+        self._slots: List[Optional[_Slot]] = [None] * self.max_rows
+        self._by_key: Dict[object, int] = {}
+        self._lock = lockdep.make_lock("PagedDecodeEngine._lock")
+        self._n_active = 0              # guarded-by: _lock
+        self._used_tokens = 0           # guarded-by: _lock
+        self._ever_stepped = False
+
+        self._step_jit: Dict[int, object] = {}
+        self._install_jit: Dict[int, object] = {}
+
+        if registry is not None:
+            self._declare_metrics(registry)
+
+    # -- metrics ------------------------------------------------------------
+    def _declare_metrics(self, r) -> None:
+        self.m_pool_pages = r.gauge(
+            "marian_serving_kv_pool_pages",
+            "Paged KV pool size in allocatable pages (page 0 reserved)")
+        self.m_pool_pages.set(self.pool.usable_pages)
+        self.m_pool_free = r.gauge(
+            "marian_serving_kv_pool_pages_free",
+            "Paged KV pool pages currently free")
+        self.m_pool_free.set_function(self.pool.free_pages)
+        self.m_pool_frag = r.gauge(
+            "marian_serving_kv_pool_fragmentation_ratio",
+            "Internal fragmentation of claimed pages: 1 - written "
+            "tokens / (claimed pages x page_len)")
+        self.m_pool_frag.set_function(self.fragmentation)
+        self.m_active_rows = r.gauge(
+            "marian_serving_active_rows",
+            "Decode slots occupied by live sentences (iteration mode)")
+        self.m_active_rows.set_function(self.active_rows)
+
+    # -- capacity (any thread) ----------------------------------------------
+    def active_rows(self) -> int:
+        with self._lock:
+            return self._n_active
+
+    def fragmentation(self) -> float:
+        used_pages = self.pool.used_pages()
+        if used_pages == 0:
+            return 0.0
+        with self._lock:
+            used_tokens = self._used_tokens
+        return max(0.0, 1.0 - used_tokens
+                   / float(used_pages * self.page_len))
+
+    def free_pages(self) -> int:
+        return self.pool.free_pages()
+
+    def free_slots(self) -> int:
+        with self._lock:
+            return self.max_rows - self._n_active
+
+    def idle(self) -> bool:
+        return self.active_rows() == 0
+
+    def decode_cap(self, n_src_tokens: int) -> int:
+        """Static decode cap for a sentence (mirrors BeamSearch's
+        max-length-factor rule so both modes price work the same)."""
+        return int(min(self.max_length_cap,
+                       max(8, round(self.max_length_factor
+                                    * max(1, n_src_tokens)))))
+
+    def pages_for_text(self, text: str) -> int:
+        """Pages one sentence will claim (admission pricing: queue debt
+        in PAGES, serving/admission.py). Token estimate only — the join
+        re-measures with the real vocab encoding."""
+        n_src = len(text.split()) + 1
+        return pages_for_tokens(self.decode_cap(n_src), self.page_len)
+
+    # -- the admit + step round (device worker thread only) -----------------
+    def admit_and_step(self, joins: Sequence[Tuple[object, str]],
+                       evicts: Sequence[object] = ()) -> StepResult:
+        """Apply evictions (dead requests), admit what fits, run ONE
+        decode step over the occupied slots. Never blocks on pool
+        space: a join that does not fit is rejected back to the caller
+        (reason ``no_slot``/``no_pages`` = retry later; FATAL_REASONS =
+        fail the request)."""
+        t0 = time.perf_counter()
+        res = StepResult()
+        for key in evicts:
+            self._evict(key)
+        rows_before = self.active_rows()
+        joiners: List[Tuple[object, List[int], int]] = []
+        for key, text in joins:
+            why = self._try_claim(key, text, joiners)
+            if why is None:
+                res.accepted.append(key)
+            else:
+                res.rejected.append((key, why))
+        if joiners:
+            self._install(joiners)
+            if rows_before > 0:
+                res.mid_decode_joins = len(joiners)
+        if self.active_rows() > 0:
+            self._step(res)
+        res.device_s = time.perf_counter() - t0  # mtlint: ok -- the step's per-token fetch (np.asarray in _step) IS the result fence; this window closes host-side after it
+        return res
+
+    def _try_claim(self, key, text: str,
+                   joiners: List) -> Optional[str]:
+        ids = self.src_vocab.encode(text, add_eos=True, inference=True)
+        if len(ids) > self.src_cap:
+            return "src_too_long"
+        cap = self.decode_cap(len(ids))
+        n_pages = pages_for_tokens(cap, self.page_len)
+        if n_pages > self.pool.max_pages_per_row:
+            return "too_large"
+        with self._lock:
+            if self._n_active >= self.max_rows:
+                return "no_slot"
+        try:
+            pages = self.pool.claim(key, n_pages)
+        except PoolExhausted:
+            # retriable only if the pool could EVER satisfy it
+            if n_pages > self.pool.usable_pages:
+                return "too_large"
+            return "no_pages"
+        # lowest free slot (deterministic; keeps the occupied prefix —
+        # and with it the compiled row bucket — tight)
+        with self._lock:
+            slot = next(i for i, s in enumerate(self._slots) if s is None)
+            self._slots[slot] = _Slot(key, cap, len(ids))
+            self._by_key[key] = slot
+            self._n_active += 1
+        # page table row on the host mirror; device copy goes with the
+        # next step's table upload
+        self._table[slot, :] = 0
+        self._table[slot, :len(pages)] = pages
+        joiners.append((key, ids, slot))
+        return None
+
+    def _evict(self, key) -> bool:
+        with self._lock:
+            slot = self._by_key.pop(key, None)
+            if slot is None:
+                return False
+            s = self._slots[slot]
+            self._slots[slot] = None
+            self._n_active -= 1
+            self._used_tokens -= s.pos
+        self.pool.release(key)
+        self._table[slot, :] = 0
+        return True
+
+    # host mirrors (worker thread only): allocated lazily so __init__
+    # stays importable without numpy churn
+    @property
+    def _table(self) -> np.ndarray:
+        t = getattr(self, "_table_np", None)
+        if t is None:
+            t = np.zeros((self.max_rows, self.max_pages), np.int32)
+            self._table_np = t
+        return t
+
+    def _install(self, joiners: List[Tuple[object, List[int], int]]) -> None:
+        """Encode the joiners (one bucketed device call) and scatter
+        their cross-attention K/V + source masks into their slots."""
+        jb = next((b for b in self.JOIN_BUCKETS if b >= len(joiners)),
+                  self.JOIN_BUCKETS[-1])
+        for base in range(0, len(joiners), jb):
+            chunk = joiners[base:base + jb]
+            ids_np = np.zeros((jb, self.src_cap), np.int32)
+            mask_np = np.zeros((jb, self.src_cap), np.float32)
+            slot_np = np.zeros((jb,), np.int32)
+            for i in range(jb):
+                # padding rows duplicate joiner 0: their writes land on
+                # the same slot with identical content (deterministic)
+                key, ids, slot = chunk[min(i, len(chunk) - 1)]
+                ids_np[i, :len(ids)] = ids
+                mask_np[i, :len(ids)] = 1.0
+                slot_np[i] = slot
+            fn = self._install_jit.get(0)
+            if fn is None:
+                # one jit object; its own cache specializes per jb shape
+                fn = self._make_install()
+                self._install_jit[0] = fn
+            self._state, self._src_mask = fn(
+                self._state, self._src_mask, self.params,
+                jnp.asarray(ids_np), jnp.asarray(mask_np),
+                jnp.asarray(slot_np))
+
+    def _state_key_groups(self):
+        """Static key classification, computed OUTSIDE the jitted
+        closures (their bodies must stay free of Python conditionals);
+        the contract lives in ops/pallas/kv_pool.state_key_groups,
+        shared with greedy_decode_paged's comparator."""
+        from ..ops.pallas.kv_pool import state_key_groups
+        return state_key_groups(self._state)
+
+    def _make_install(self):
+        model = self.model
+        row_keys, _, _ = self._state_key_groups()
+
+        def install(state, src_mask, params, ids, mask, slot_idx):
+            enc = model.encode_for_decode(params, ids, mask)
+            # want_alignment=True forces the unrolled cross-K/V layout,
+            # matching the paged state's keys; the tiny dense self
+            # caches it allocates are simply not copied
+            st = model.start_state(params, enc, mask, 1,
+                                   want_alignment=True)
+            new_state = dict(state)
+            for k in row_keys:
+                new_state[k] = state[k].at[slot_idx].set(
+                    st[k].astype(state[k].dtype))
+            new_mask = src_mask.at[slot_idx].set(
+                mask.astype(src_mask.dtype))
+            return new_state, new_mask
+
+        return jax.jit(install, donate_argnums=(0, 1))
+
+    def _make_step(self, rb: int):
+        model = self.model
+        k_steps = self.steps_per_round
+        row_keys, pool_keys, whole_keys = self._state_key_groups()
+
+        def step(state, src_mask, params, prev, pos, table):
+            # row-indexed leaves run at the bucket prefix; pools and
+            # beam-invariant leaves (lsh) stay whole
+            sub = {k: state[k][:rb] for k in row_keys}
+            for k in whole_keys:
+                sub[k] = state[k]
+            sm = src_mask[:rb]
+
+            def body(carry, _):
+                pools, prev_t, pos_t = carry
+                st = dict(sub)
+                st.update(pools)
+                st["pos"] = pos_t
+                st["page_table"] = table
+                logits, new_sub = model.step(params, st, prev_t, sm)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                new_pools = {k: new_sub[k] for k in pool_keys}
+                return (new_pools, nxt[:, None], pos_t + 1), nxt
+
+            init = ({k: state[k] for k in pool_keys}, prev, pos)
+            (pools, _, _), toks = jax.lax.scan(body, init, None,
+                                               length=k_steps)
+            new_state = dict(state)
+            new_state.update(pools)
+            return toks, new_state          # toks [k_steps, rb]
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _step(self, res: StepResult) -> None:
+        # the occupied prefix, rounded up to a compiled row bucket
+        top = max(i for i, s in enumerate(self._slots) if s is not None)
+        rb = bucket_rows(top + 1, self.row_buckets)
+        pos_np = np.full((rb,), -1, np.int32)
+        prev_np = np.zeros((rb, 1), np.int32)
+        for i in range(rb):
+            s = self._slots[i]
+            if s is not None:
+                pos_np[i] = s.pos
+                prev_np[i, 0] = s.prev
+        fn = self._step_jit.get(rb)
+        if fn is None:
+            fn = self._make_step(rb)
+            self._step_jit[rb] = fn
+        toks_dev, self._state = fn(
+            self._state, self._src_mask, self.params,
+            jnp.asarray(prev_np), jnp.asarray(pos_np),
+            jnp.asarray(self._table[:rb]))
+        # the per-round host sync IS the design: the join/evict schedule
+        # runs on the host between rounds (the serving scheduler's
+        # iteration loop), so each round's tokens must land host-side
+        toks = np.asarray(toks_dev)  # mtlint: ok -- iteration-level decode syncs once per round by design; admission runs host-side between rounds
+        self._ever_stepped = True
+        k_steps = toks.shape[0]
+        emitted = 0
+        consumed = 0
+        finishes: List[_Slot] = []
+        for i in range(rb):
+            s = self._slots[i]
+            if s is None:
+                continue
+            emitted += 1
+            done = False
+            for j in range(k_steps):
+                tok = int(toks[j, i])
+                s.pos += 1
+                s.prev = tok
+                consumed += 1
+                done = tok == EOS_ID or s.pos >= s.cap
+                if tok != EOS_ID:
+                    s.tokens.append(tok)
+                if done:
+                    # a row finishing mid-scan self-fed to the end of
+                    # the round on device; the host cuts HERE — the
+                    # overshoot tokens are discarded and its cache
+                    # positions past the cut are never read again
+                    finishes.append(s)
+                    break
+        # ONE locked add per round (not per token — this loop runs on
+        # the device-worker hot path against the metrics scrape
+        # thread), and it must land BEFORE the evictions below subtract
+        # each finished slot's full s.pos: the invariant is
+        # _used_tokens == sum(s.pos) over active slots
+        with self._lock:
+            self._used_tokens += consumed
+        for s in finishes:
+            text = self.trg_vocab.decode(s.tokens, ignore_eos=True)
+            res.finished.append((s.key, text))
+            self._evict(s.key)
+        res.rows = emitted
+        res.bucket = rb
+        res.tokens = consumed
+        res.steps += k_steps
+
+    # -- direct (non-serving) decoding: tests, benches ----------------------
+    def decode_texts(self, texts: Sequence[str]) -> List[str]:
+        """Decode a list of sentences to completion through the slot
+        machinery (joins as capacity frees up) — the library-call
+        equivalent of the serving loop, used by tests and bench A/Bs."""
+        pending = list(enumerate(texts))
+        out: Dict[int, str] = {}
+        guard = 0
+        while pending or not self.idle():
+            joins = []
+            while pending and len(joins) < self.max_rows:
+                joins.append(pending[0])
+                pending.pop(0)
+            res = self.admit_and_step(joins)
+            for key, why in res.rejected:
+                if why in FATAL_REASONS:
+                    raise ValueError(
+                        f"sentence {key} rejected: {why}")
+                pending.insert(0, (key, texts[key]))
+            for key, text in res.finished:
+                out[key] = text
+            guard += 1
+            if guard > 100000:
+                raise RuntimeError("iteration decode failed to converge")
+        return [out[i] for i in range(len(texts))]

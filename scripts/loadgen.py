@@ -162,6 +162,36 @@ def _delta(before: dict, after: dict, name: str) -> float:
 # driver
 # ---------------------------------------------------------------------------
 
+def parse_len_mix(raw: str):
+    """--len-mix 'short:long[:p_short]' → (short, long, p_short) or None.
+    Bimodal sentence lengths so a mixed-length open-loop run actually
+    exercises iteration mode's mid-decode join path: short sentences
+    finish and leave a running decode while long ones keep it running,
+    so the next arrival joins mid-decode (ISSUE 10 A/B)."""
+    if not raw:
+        return None
+    parts = raw.split(":")
+    if len(parts) not in (2, 3):
+        raise ValueError(f"--len-mix wants short:long[:p_short], got "
+                         f"{raw!r}")
+    short, long_ = int(parts[0]), int(parts[1])
+    p_short = float(parts[2]) if len(parts) == 3 else 0.7
+    if short <= 0 or long_ <= 0 or not 0.0 <= p_short <= 1.0:
+        raise ValueError(f"--len-mix values out of range: {raw!r}")
+    return short, long_, p_short
+
+
+def mixed_words(i: int, words: int, len_mix) -> int:
+    """Deterministic bimodal length for request i (no RNG state — the
+    A/B's two runs see the same traffic)."""
+    if len_mix is None:
+        return words
+    short, long_, p_short = len_mix
+    # low-discrepancy threshold draw keyed by i: reproducible mix
+    u = ((i * 2654435761) % 1000) / 1000.0
+    return short if u < p_short else long_
+
+
 def make_sentence(client: int, req: int, sent: int, words: int) -> str:
     return " ".join(f"w{(client * 7 + req * 3 + sent + w) % 20}"
                     for w in range(words))
@@ -233,8 +263,11 @@ async def run_stream(args, request_fn, rate=None, duration=None):
     rate = args.rate if rate is None else rate
     duration = args.duration if duration is None else duration
 
+    len_mix = parse_len_mix(getattr(args, "len_mix", ""))
+
     async def fire(i: int):
-        text = "\n".join(make_sentence(i, i >> 3, s, args.words)
+        words = mixed_words(i, args.words, len_mix)
+        text = "\n".join(make_sentence(i, i >> 3, s, words)
                          for s in range(args.sentences))
         if trace:
             text = TRACE_PREFIX + make_trace_id(i) + "\n" + text
@@ -389,6 +422,15 @@ def report_windows(results, window_s: float) -> None:
     if have_meta:
         hdr += f" {'q_p50':>7} {'q_p99':>7} {'svc_p50':>7} {'svc_p99':>7}"
     print(hdr)
+    ttfj = [r[3] for r in results if r[2] == "ok" and r[3] is not None]
+    if ttfj:
+        # time-to-first-join: the server stamps queue_ms at the moment
+        # the request's first sentence ENTERED a decode (join time in
+        # iteration mode, first batch dispatch in request mode) — the
+        # client-visible number mid-decode admission improves
+        print(f"time-to-first-join p50={pct(ttfj, 0.50) * 1e3:.1f}ms "
+              f"p99={pct(ttfj, 0.99) * 1e3:.1f}ms "
+              f"max={max(ttfj) * 1e3:.1f}ms")
     for w in range(n_windows):
         rows = [r for r in results
                 if w * window_s <= r[0] < (w + 1) * window_s]
@@ -437,6 +479,16 @@ def main(argv=None) -> int:
                     help="streaming mode: report p50/p99 per N-second "
                          "window (a hot-swap under load shows as a "
                          "window blip, not an averaged-away artifact)")
+    ap.add_argument("--len-mix", default="",
+                    help="streaming mode: bimodal sentence lengths "
+                         "'short:long[:p_short]' (e.g. '4:24:0.7') — "
+                         "mixed-length traffic is what exercises "
+                         "iteration mode's mid-decode join path "
+                         "(--batching-mode iteration A/B; the server's "
+                         "marian_serving_mid_decode_joins_total delta "
+                         "proves joins happened). Deterministic per "
+                         "request index, so A/B runs see identical "
+                         "traffic")
     ap.add_argument("--sweep", default="",
                     help="capacity mode (ISSUE 9 / ROADMAP 4): comma-"
                          "separated offered rates in req/s (e.g. "
@@ -560,6 +612,18 @@ def _report_server_delta(before: dict, after: dict) -> None:
           f"sentences/batch={sent / batches if batches else 0:.2f} "
           f"mean_fill={fill_sum / fill_n if fill_n else 0:.3f} "
           f"shed={shed:.0f} timeouts={timeouts:.0f}")
+    joins = _delta(before, after, "marian_serving_joins_total")
+    if joins:
+        # iteration-mode deltas: mid-decode joins are the proof that
+        # sentences actually entered RUNNING decodes (the ISSUE 10 A/B
+        # acceptance reads this line)
+        print(f"server: joins={joins:.0f} "
+              f"mid_decode_joins="
+              f"{_delta(before, after, 'marian_serving_mid_decode_joins_total'):.0f} "
+              f"evictions="
+              f"{_delta(before, after, 'marian_serving_evictions_total'):.0f} "
+              f"decode_steps="
+              f"{_delta(before, after, 'marian_serving_decode_steps_total'):.0f}")
 
 
 if __name__ == "__main__":

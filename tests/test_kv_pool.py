@@ -1,0 +1,234 @@
+"""Paged KV-cache pool (ops/pallas/kv_pool.py — ISSUE 10): allocator
+semantics, the one-scatter insert, and BITWISE interpret-mode parity of
+the paged attention read against the dense decode path it replaces —
+including rows that joined mid-decode (younger positions) and a row
+that freed its pages early (inactive slot)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from marian_tpu.ops import auto_tuner
+from marian_tpu.ops.pallas.decode_attention import decode_attention
+from marian_tpu.ops.pallas.decode_attention import _reference as dense_ref
+from marian_tpu.ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, KVPool,
+                                           PoolExhausted, ROW_BUCKETS,
+                                           bucket_rows, pages_for_tokens,
+                                           paged_decode_attention,
+                                           pool_insert)
+
+
+# ---------------------------------------------------------------------------
+# allocator + bucket tables
+# ---------------------------------------------------------------------------
+
+class TestKVPoolAllocator:
+    def test_page_zero_reserved_and_counts(self):
+        p = KVPool(9, page_len=4)
+        assert p.usable_pages == 8
+        assert p.free_pages() == 8
+        got = p.claim("a", 3)
+        assert 0 not in got and len(got) == 3
+        assert p.free_pages() == 5 and p.used_pages() == 3
+
+    def test_all_or_nothing_and_exhaustion(self):
+        p = KVPool(5, page_len=4)          # 4 usable
+        p.claim("a", 3)
+        with pytest.raises(PoolExhausted):
+            p.claim("b", 2)                # only 1 free: nothing granted
+        assert p.free_pages() == 1
+        assert p.release("a") == 3
+        assert p.free_pages() == 4
+        # releasing an unknown owner is a no-op, never an error
+        assert p.release("ghost") == 0
+
+    def test_oversized_claim_names_the_table_bound(self):
+        p = KVPool(64, page_len=4, max_pages_per_row=4)
+        with pytest.raises(PoolExhausted):
+            p.claim("a", 5)
+
+    def test_double_claim_refused(self):
+        p = KVPool(8, page_len=4)
+        p.claim("a", 1)
+        with pytest.raises(ValueError):
+            p.claim("a", 1)
+
+    def test_claim_release_reclaim_is_deterministic(self):
+        """Replay determinism: the same claim/release schedule yields
+        the same physical pages (the join/evict replay test upstream
+        relies on it)."""
+        def schedule():
+            p = KVPool(9, page_len=4)
+            seq = [tuple(p.claim("a", 2)), tuple(p.claim("b", 3))]
+            p.release("a")
+            seq.append(tuple(p.claim("c", 2)))
+            return seq
+        assert schedule() == schedule()
+
+    def test_bucket_and_page_math(self):
+        assert pages_for_tokens(1, 16) == 1
+        assert pages_for_tokens(16, 16) == 1
+        assert pages_for_tokens(17, 16) == 2
+        assert bucket_rows(1) == 1
+        assert bucket_rows(3) == 4
+        assert bucket_rows(9, (2, 8, 32)) == 32
+        # past the largest bucket, the largest caps it
+        assert bucket_rows(10_000) == ROW_BUCKETS[-1]
+
+    def test_auto_tuner_registry_entry(self):
+        assert auto_tuner.kv_pool_max_tokens(64) == 2048
+        # dh-halving convention shared with the other kernels
+        assert auto_tuner.kv_pool_max_tokens(128) == 1024
+
+
+# ---------------------------------------------------------------------------
+# paged attention: bitwise parity vs the dense decode path
+# ---------------------------------------------------------------------------
+
+def _build_pool(rng, R, H, dh, PL, MP, pos):
+    """A dense per-row cache and the equivalent paged pool holding the
+    same history (row r has pos[r] written positions)."""
+    L = PL * MP
+    ck = np.zeros((R, H, L, dh), np.float32)
+    cv = np.zeros((R, H, L, dh), np.float32)
+    for r in range(R):
+        n = max(0, pos[r])
+        ck[r, :, :n] = rng.randn(H, n, dh)
+        cv[r, :, :n] = rng.randn(H, n, dh)
+    P = 1 + R * MP
+    table = np.zeros((R, MP), np.int32)
+    pk = np.zeros((P, H, PL, dh), np.float32)
+    pv = np.zeros((P, H, PL, dh), np.float32)
+    nxt = 1
+    for r in range(R):
+        for j in range(MP):
+            table[r, j] = nxt
+            pk[nxt] = ck[r, :, j * PL:(j + 1) * PL]
+            pv[nxt] = cv[r, :, j * PL:(j + 1) * PL]
+            nxt += 1
+    return ck, cv, table, pk, pv
+
+
+class TestPagedDecodeParity:
+    R, H, dh, PL, MP = 5, 2, 8, 4, 4
+
+    def _case(self, rng, pos):
+        R, H, dh, PL, MP = self.R, self.H, self.dh, self.PL, self.MP
+        q = jnp.asarray(rng.randn(R, H, 1, dh), jnp.float32)
+        kn = jnp.asarray(rng.randn(R, H, 1, dh), jnp.float32)
+        vn = jnp.asarray(rng.randn(R, H, 1, dh), jnp.float32)
+        ck, cv, table, pk, pv = _build_pool(rng, R, H, dh, PL, MP, pos)
+        return q, kn, vn, ck, cv, table, pk, pv
+
+    # per-row positions: row 1 JOINED MID-DECODE (pos 0 while its
+    # neighbors are deep in), row 4 near a page boundary
+    POS = np.array([7, 0, 15, 3, 11], np.int32)
+
+    def test_kernel_bitwise_vs_dense_reference(self, rng):
+        q, kn, vn, ck, cv, table, pk, pv = self._case(rng, self.POS)
+        pos = jnp.asarray(self.POS)
+        out, nk, nv = paged_decode_attention(
+            q, kn, vn, jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(table), pos, interpret=True)
+        ro, rk, rv = dense_ref(q, kn, vn, jnp.asarray(ck),
+                               jnp.asarray(cv), pos, None,
+                               1.0 / self.dh ** 0.5)
+        # BITWISE: the paged kernel assembles the row in VMEM scratch
+        # and then runs the dense op order verbatim
+        assert (np.asarray(out) == np.asarray(ro)).all()
+        # every live cache position matches the dense cache bitwise
+        # (including this step's inserted token)
+        for r in range(self.R):
+            for t in range(self.POS[r] + 1):
+                j, off = t // self.PL, t % self.PL
+                assert (np.asarray(nk)[table[r, j], :, off]
+                        == np.asarray(rk)[r, :, t]).all()
+                assert (np.asarray(nv)[table[r, j], :, off]
+                        == np.asarray(rv)[r, :, t]).all()
+
+    def test_reference_fallback_bitwise(self, rng):
+        """Past the VMEM token cap the jnp gather fallback must be
+        bitwise-identical to the kernel's output too. The registry
+        floors at one 64-wide block, so the span must exceed 64."""
+        R, H, dh, PL, MP = 3, 2, 8, 16, 8          # span 128 > floor 64
+        pos = np.array([7, 40, 100], np.int32)
+        q = jnp.asarray(rng.randn(R, H, 1, dh), jnp.float32)
+        kn = jnp.asarray(rng.randn(R, H, 1, dh), jnp.float32)
+        vn = jnp.asarray(rng.randn(R, H, 1, dh), jnp.float32)
+        _, _, table, pk, pv = _build_pool(rng, R, H, dh, PL, MP, pos)
+        args = (q, kn, vn, jnp.asarray(pk), jnp.asarray(pv),
+                jnp.asarray(table), jnp.asarray(pos))
+        out_k, _, _ = paged_decode_attention(*args, interpret=True)
+        orig = dict(auto_tuner.KERNEL_BLOCKS["kv_pool"])
+        try:
+            auto_tuner.KERNEL_BLOCKS["kv_pool"]["max_tokens"] = 8
+            assert auto_tuner.kv_pool_max_tokens(dh) < MP * PL
+            out_f, _, _ = paged_decode_attention(*args, interpret=True)
+        finally:
+            auto_tuner.KERNEL_BLOCKS["kv_pool"].update(orig)
+        assert (np.asarray(out_k) == np.asarray(out_f)).all()
+
+    def test_vs_dense_kernel_vector_pos(self, rng):
+        """Against the dense KERNEL with the same per-row positions:
+        cache CONTENTS bitwise (both materialize the same next-step
+        state); outputs allclose (the dense kernel's own output is
+        1-2 ulp from its reference — repo precedent, see
+        test_decode_attention)."""
+        q, kn, vn, ck, cv, table, pk, pv = self._case(rng, self.POS)
+        pos = jnp.asarray(self.POS)
+        op, nk, nv = paged_decode_attention(
+            q, kn, vn, jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(table), pos, interpret=True)
+        od, dk, dv = decode_attention(q, kn, vn, jnp.asarray(ck),
+                                      jnp.asarray(cv), pos,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(op), np.asarray(od),
+                                   rtol=2e-5, atol=2e-5)
+        for r in range(self.R):
+            for t in range(self.POS[r] + 1):
+                j, off = t // self.PL, t % self.PL
+                assert (np.asarray(nk)[table[r, j], :, off]
+                        == np.asarray(dk)[r, :, t]).all()
+
+    def test_early_freed_row_is_inactive_and_deterministic(self, rng):
+        """A row that freed its pages early (pos = -1, table -> trash
+        page): no pool write outside the trash page, and the whole step
+        is deterministic across replays (idle-row scatter collisions
+        write identical zeros)."""
+        pos = np.array([7, -1, 15, 3, 11], np.int32)
+        q, kn, vn, ck, cv, table, pk, pv = self._case(rng, pos)
+        table[1, :] = 0                        # freed: points at trash
+        args = (q, kn, vn, jnp.asarray(pk), jnp.asarray(pv),
+                jnp.asarray(table), jnp.asarray(pos))
+        o1, k1, v1 = paged_decode_attention(*args, interpret=True)
+        o2, k2, v2 = paged_decode_attention(*args, interpret=True)
+        assert (np.asarray(o1) == np.asarray(o2)).all()
+        assert (np.asarray(k1) == np.asarray(k2)).all()
+        assert (np.asarray(v1) == np.asarray(v2)).all()
+        # ACTIVE rows still bitwise vs dense, with the freed row gone
+        ro, _, _ = dense_ref(q, kn, vn, jnp.asarray(ck),
+                             jnp.asarray(cv), jnp.asarray(pos), None,
+                             1.0 / self.dh ** 0.5)
+        for r in (0, 2, 3, 4):
+            assert (np.asarray(o1)[r] == np.asarray(ro)[r]).all()
+        # only page 0 (trash) differs from the no-write expectation
+        changed = np.nonzero((np.asarray(k1) != pk).any(axis=(1, 2, 3)))[0]
+        live = {int(table[r, pos[r] // self.PL])
+                for r in (0, 2, 3, 4)} | {0}
+        assert set(changed.tolist()) <= live
+
+    def test_pool_insert_places_the_new_token(self, rng):
+        pos = np.array([0, 5, 12, 3, 15], np.int32)
+        q, kn, vn, ck, cv, table, pk, pv = self._case(rng, pos)
+        nk, nv = pool_insert(jnp.asarray(pk), jnp.asarray(pv), kn, vn,
+                             jnp.asarray(table), jnp.asarray(pos))
+        for r in range(self.R):
+            j, off = pos[r] // self.PL, pos[r] % self.PL
+            assert (np.asarray(nk)[table[r, j], :, off]
+                    == np.asarray(kn)[r, :, 0]).all()
+            assert (np.asarray(nv)[table[r, j], :, off]
+                    == np.asarray(vn)[r, :, 0]).all()
+
+    def test_default_page_len_sane(self):
+        assert DEFAULT_PAGE_LEN >= 1
