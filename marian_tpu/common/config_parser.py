@@ -336,6 +336,14 @@ _TRANSLATION = [
     _f("max-queue-pages", int, 0, "With --batching-mode iteration: admission bound on queued KV-pool PAGE debt — requests are shed with !!SERVER-OVERLOADED when the queue already owes this many pages (0 = 4x the pool's allocatable pages) (TPU extension)", "translate"),
     _f("metrics-port", int, 0, "Serve Prometheus /metrics + /healthz + /readyz on this port (0 = off): queue depth, batch fill ratio, padding waste, time-to-first-batch, end-to-end latency, shed/timeout counts; train/translate emit into the same registry (TPU extension)", "translate"),
     _f("dispatch-stall-timeout", float, 0.0, "marian-server liveness watchdog: if one device batch (translate_lines call) runs longer than this many seconds, fail its requests with an explicit retriable !!SERVER-RETRY reply and move the scheduler onto a fresh device worker instead of wedging the whole serving path behind the stuck call (0 = off; set comfortably above the worst legitimate batch decode time; see docs/ROBUSTNESS.md) (TPU extension)", "translate"),
+    _f("quiesce-deadline", float, 2.0, "With --batching-mode iteration and --model-watch: drain budget in seconds for a lifecycle quiesce (swap/canary/rollback). Joins pause and active decode rows drain naturally; rows still decoding at the deadline are evicted with a retriable !!SERVER-RETRY (pages freed, counted in marian_serving_quiesce_evictions_total) so a swap is never held hostage by one long sentence; the engine is re-pointed at a step boundary with an empty join set (docs/ROBUSTNESS.md) (TPU extension)", "translate"),
+    _f("brownout", bool, False, "marian-server brownout ladder: under sustained overload (capacity headroom at/below --brownout-headroom, or the SLO fast-burn threshold) step through explicit degradation levels — 1 tighten per-row decode caps, 2 evict lowest-priority/longest-remaining rows with retriable !!SERVER-RETRY, 3 shed admissions below --brownout-min-priority — so high-priority traffic keeps a bounded p99 while low lanes degrade predictably; every transition is a timeline event + marian_brownout_level move (docs/ROBUSTNESS.md) (TPU extension)", "translate"),
+    _f("brownout-headroom", float, 0.1, "Brownout overload signal: escalate while marian_capacity_headroom_ratio stays at or below this floor (TPU extension)", "translate"),
+    _f("brownout-burn", float, 0.0, "Brownout overload signal: escalate while the SLO engine's fast-window burn rate stays at or above this (0 = use the SLO fast-burn factor when an SLO is declared, else the burn signal is off and headroom drives the ladder alone) (TPU extension)", "translate"),
+    _f("brownout-hold", float, 5.0, "Seconds the overload signal must persist before the ladder escalates one level (each rung needs its own sustained hold) (TPU extension)", "translate"),
+    _f("brownout-cool", float, 15.0, "Seconds of continuous health before the ladder de-escalates one level (TPU extension)", "translate"),
+    _f("brownout-cap-factor", float, 0.5, "Brownout level 1: scale factor applied to NEW rows' decode caps (shorter rows claim fewer KV pages and leave sooner; possible truncation of the longest outputs is the explicit trade) (TPU extension)", "translate"),
+    _f("brownout-min-priority", int, 1, "Brownout level 3: admission sheds requests whose priority lane is below this (clients set a lane with the '#priority:N' protocol header; default lane is 0) (TPU extension)", "translate"),
     _f("model-watch", float, 0.0, "marian-server zero-downtime lifecycle: poll <model>.bundles/ every N seconds for newly committed checkpoint bundles and hot-swap to them after an off-path warmup (compat check, load, jit compile, golden smoke) with no dropped requests; in-flight batches finish on the old model (0 = off; see docs/DEPLOYMENT.md) (TPU extension)", "translate"),
     _f("canary-fraction", float, 0.0, "With --model-watch: route this fraction of device batches to a freshly warmed candidate (state 'canary') before promoting it to live; per-version error/latency metrics (marian_model_*) record both sides, and a canary whose failure rate or p99 regresses is auto-rolled-back (0 = swap immediately after warmup) (TPU extension)", "translate"),
     _f("rollback-error-rate", float, 0.5, "With --model-watch: auto-rollback threshold on the windowed device-batch failure rate — a canary (or a freshly swapped live version with a retained rollback target) exceeding this rate is rolled back to the previous live version (docs/DEPLOYMENT.md) (TPU extension)", "translate"),

@@ -90,6 +90,19 @@ CATALOG: Dict[str, str] = {
                       "commit point)",
     "lifecycle.rollback": "before a canary/live rollback re-points "
                           "dispatch at the previous live version",
+    "serving.quiesce": "on the event loop, at the quiesce boundary — "
+                       "active rows drained/evicted, before the paged "
+                       "engine is re-pointed at the new executor (kill "
+                       "= the kill-mid-quiesce chaos schedule)",
+    "pool.double_free": "detection drill: an armed 'fail' makes the KV "
+                        "pool re-free a still-claimed row's pages (the "
+                        "double-free bug class) so the pool auditor is "
+                        "proven against REAL corrupted state, not a "
+                        "mocked report",
+    "pool.table_corrupt": "detection drill: an armed 'fail' scribbles a "
+                          "wrong physical page id into one active row's "
+                          "page table so the auditor's table/claim "
+                          "cross-check is proven against real corruption",
 }
 
 

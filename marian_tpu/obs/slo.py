@@ -131,6 +131,9 @@ class SloEngine:
         self._t0: Optional[float] = None        # guarded-by: _lock
         self._base: Dict[str, Tuple[float, float]] = {}  # guarded-by: _lock
         self._alerting: Dict[Tuple[str, str], bool] = {}  # guarded-by: _lock
+        # newest tick's max fast-window burn across objectives — the
+        # brownout ladder's cheap signal read (serving/brownout.py)
+        self._last_fast_burn = 0.0              # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -202,6 +205,9 @@ class SloEngine:
             while self._samples and now - self._samples[0][0] > horizon:
                 self._samples.popleft()
             state = self._evaluate(now, cum)
+            self._last_fast_burn = max(
+                (st["burn"][self._wl(False)]
+                 for st in state["objectives"].values()), default=0.0)
             # rising/falling edges, recorded under the lock so two racing
             # ticks cannot double-fire; the events/dump emit OUTSIDE it
             for o in self.objectives:
@@ -297,6 +303,12 @@ class SloEngine:
             "objectives": objectives,
         }
 
+    def fast_burn(self) -> float:
+        """Max fast-window burn rate across objectives, as of the last
+        tick — the brownout ladder's overload signal (any thread)."""
+        with self._lock:
+            return self._last_fast_burn
+
     # -- public state (flight dumps, /sloz) ---------------------------------
     def state(self) -> Dict:
         now = self.clock()
@@ -355,18 +367,25 @@ def maybe_build_engine(options, registry=None) -> Optional[SloEngine]:
         or DEFAULT_EVAL_INTERVAL_S)
 
 
-def slo_routes(engine_fn: Callable[[], Optional[SloEngine]]) -> Dict:
+def slo_routes(engine_fn: Callable[[], Optional[SloEngine]],
+               brownout_fn: Optional[Callable[[], object]] = None) -> Dict:
     """``GET /sloz`` for serving/metrics.py's MetricsServer: the SLO
-    state plus the perf plane's snapshot. Like /tracez, the route always
-    answers — a disabled engine reports ``enabled: false`` rather than
-    404, so operators never have to guess."""
+    state plus the perf plane's snapshot and — when the ladder is armed
+    — the brownout level (ISSUE 11: an on-call reading /sloz during an
+    incident must see which degradation rung they are on). Like
+    /tracez, the route always answers — a disabled engine reports
+    ``enabled: false`` rather than 404, so operators never have to
+    guess."""
 
     def _sloz(method: str, query: str):
         engine = engine_fn()
+        brownout = brownout_fn() if brownout_fn is not None else None
         body = {
             "slo": engine.state() if engine is not None
             else {"enabled": False},
             "perf": PERF.state(),
+            "brownout": brownout.state() if brownout is not None
+            else {"enabled": False},
         }
         return (200, json.dumps(body, indent=1).encode() + b"\n",
                 "application/json")

@@ -123,6 +123,16 @@ class PoolExhausted(RuntimeError):
     stall a decode step that other rows are waiting on."""
 
 
+class PoolCorruption(RuntimeError):
+    """The pool auditor found an invariant violation (double-freed page,
+    page-table/claim mismatch, leaked pages). ``retriable``: the engine
+    holding the pool is rebuilt from scratch by the serving scheduler,
+    so the evicted rows' requests can be retried against the fresh
+    engine — clients see ``!!SERVER-RETRY``, never silent corruption."""
+
+    retriable = True
+
+
 class KVPool:
     """Free-list page allocator over the device pool's index space.
 
@@ -196,6 +206,83 @@ class KVPool:
     def pages_of(self, owner) -> List[int]:
         with self._lock:
             return list(self._claims.get(owner, []))
+
+    def owners(self) -> List[object]:
+        with self._lock:
+            return list(self._claims.keys())
+
+    # -- invariant auditor (ISSUE 11) ---------------------------------------
+    def audit(self) -> List[str]:
+        """Cross-check the free list against the claims table; returns a
+        list of human-readable violations (empty = clean). The checks
+        are exactly the bug classes a paged allocator grows over time:
+
+        - a page on the free list twice, or both free and claimed
+          (double-free);
+        - a page claimed by two owners, or out of the pool's index
+          range, or the reserved trash page 0 handed out;
+        - pages accounted to neither side (leak).
+
+        Runs on snapshots taken under the lock, so it never blocks the
+        device worker for more than two dict copies; callers run it at
+        every quiesce boundary and per round under MARIAN_POOL_AUDIT=1.
+        """
+        with self._lock:
+            free = list(self._free)
+            claims = {k: list(v) for k, v in self._claims.items()}
+        v: List[str] = []
+        where: Dict[int, str] = {}
+        for p in free:
+            if p == 0:
+                v.append("free list holds the reserved trash page 0")
+                continue
+            if not 1 <= p < self.n_pages:
+                v.append(f"free list holds out-of-range page {p}")
+                continue
+            if p in where:
+                v.append(f"page {p} appears twice in the free list "
+                         f"(double-free)")
+            where[p] = "free"
+        for owner, pages in claims.items():
+            for p in pages:
+                if p == 0 or not 1 <= p < self.n_pages:
+                    v.append(f"claim {owner!r} holds invalid page {p}")
+                    continue
+                prev = where.get(p)
+                if prev == "free":
+                    v.append(f"page {p} is both free and claimed by "
+                             f"{owner!r} (double-free)")
+                elif prev is not None:
+                    v.append(f"page {p} is claimed by both {prev} and "
+                             f"{owner!r}")
+                else:
+                    where[p] = f"claim {owner!r}"
+        if not v:
+            total = len(free) + sum(len(p) for p in claims.values())
+            if total != self.usable_pages:
+                v.append(f"{self.usable_pages - total} page(s) leaked: "
+                         f"{len(free)} free + {total - len(free)} "
+                         f"claimed of {self.usable_pages} allocatable")
+        return v
+
+    def chaos_double_free(self) -> None:
+        """Cross the ``pool.double_free`` detection drill. The catalog
+        point's 'fail' mode does not model an exception here: it makes
+        this helper re-free one still-claimed row's pages — the real
+        double-free state — so the auditor's claim to catch that bug
+        class is tested against actual corruption, never a mocked
+        report (docs/ROBUSTNESS.md "Auditor drills"). Unarmed, this is
+        one dict lookup under the faultpoint lock; kill/hang modes
+        behave as at any other crossing."""
+        from ...common import faultpoints as fp
+        try:
+            fp.fault_point("pool.double_free")
+        except fp.InjectedFault:
+            with self._lock:
+                for pages in self._claims.values():
+                    if pages:
+                        self._free.extend(reversed(pages))
+                        break
 
 
 # ---------------------------------------------------------------------------

@@ -39,7 +39,7 @@ from ..obs import slo as mslo
 from ..serving import metrics as msm
 from ..serving.admission import AdmissionController, Overloaded
 from ..serving.scheduler import (ContinuousScheduler, DispatchStalled,
-                                 RequestTimeout)
+                                 RequestTimeout, RowEvicted)
 from ..training import bundle as bdl
 
 try:
@@ -75,6 +75,33 @@ def split_trace_header(text: str) -> Tuple[Optional[str], str]:
             or not all(c.isalnum() or c in "-_" for c in tid):
         return None, text
     return tid, rest if sep else ""
+
+
+# Priority-lane protocol extension (ISSUE 11, backwards-compatible like
+# #trace): a client MAY make the first body line `#priority:<int>`; the
+# server strips it and admits/schedules the request in that lane. Under
+# brownout level 3 the low lanes are shed explicitly while high lanes
+# keep serving (serving/brownout.py). Headers stack: #trace first, then
+# #priority. A malformed value is payload, never an error. The value is
+# CLAMPED to [PRIORITY_MIN, PRIORITY_MAX]: the scheduler keeps one lane
+# per distinct priority forever, so an unclamped client-controlled int
+# would let any client grow the lane table (and its per-round sort)
+# without bound.
+PRIORITY_PREFIX = "#priority:"
+PRIORITY_MIN, PRIORITY_MAX = -9, 9
+
+
+def split_priority_header(text: str) -> Tuple[Optional[int], str]:
+    """(clamped priority | None, body) — see PRIORITY_PREFIX above."""
+    if not text.startswith(PRIORITY_PREFIX):
+        return None, text
+    first, sep, rest = text.partition("\n")
+    raw = first[len(PRIORITY_PREFIX):].strip()
+    try:
+        prio = int(raw)
+    except ValueError:
+        return None, text
+    return max(PRIORITY_MIN, min(PRIORITY_MAX, prio)), rest if sep else ""
 # per-connection cap on bytes the EOF watch may read ahead of the framing
 # parser while a reply is pending — bounds what a flooding pipelined
 # client can make the server buffer
@@ -173,8 +200,11 @@ class ServingApp:
                         "--batching-mode iteration with an injected "
                         "translate_lines needs an injected engine too "
                         "(the paged engine drives the model directly)")
-                engine_factory = self._build_engine
-                engine = engine_factory()
+                # rebuild hook resolves THROUGH the lifecycle when one
+                # is attached: after a watchdog trip the fresh engine
+                # must serve the CURRENT live version, not the boot one
+                engine_factory = self._rebuild_live_engine
+                engine = self._build_engine()
             # admission prices queue debt in PAGES: default bound is
             # 4x the pool (a full pool of backlog ahead of you is
             # already seconds of queueing; --max-queue-pages overrides)
@@ -235,6 +265,47 @@ class ServingApp:
             mslo.maybe_build_engine(options, self.registry)
         if self.slo is not None:
             obs.FLIGHT.add_snapshot_provider("slo", self.slo.state)
+        # brownout ladder (--brownout, ISSUE 11; serving/brownout.py):
+        # signal-driven degradation levels over the SLO burn-rate and
+        # capacity-headroom signals the obs plane already maintains
+        self.brownout = None
+        self._brownout_cap_factor = float(
+            options.get("brownout-cap-factor", 0.5) or 0.5)
+        self._brownout_min_priority = int(
+            options.get("brownout-min-priority", 1) or 1)
+        if options.get("brownout", False):
+            from ..serving.brownout import BrownoutController
+            burn_thr = float(options.get("brownout-burn", 0) or 0)
+            if burn_thr <= 0:
+                # default to the SLO engine's fast-burn factor; with no
+                # SLO declared the burn signal is off and headroom
+                # drives the ladder alone
+                burn_thr = self.slo.fast_factor \
+                    if self.slo is not None else 0.0
+            self.brownout = BrownoutController(
+                apply_fn=self._apply_brownout,
+                headroom_fn=obs.PERF.headroom if obs.PERF.enabled
+                else None,
+                burn_fn=self.slo.fast_burn if self.slo is not None
+                else None,
+                registry=self.registry,
+                headroom_floor=float(
+                    options.get("brownout-headroom", 0.1) or 0.1),
+                burn_threshold=burn_thr,
+                hold_s=float(options.get("brownout-hold", 5.0) or 5.0),
+                cool_s=float(options.get("brownout-cool", 15.0) or 15.0))
+            obs.FLIGHT.add_snapshot_provider("brownout",
+                                             self.brownout.state)
+            if not obs.PERF.enabled and burn_thr <= 0:
+                # both signals dead: headroom_fn is None (reads 1.0,
+                # never at the floor) and the burn guard is off — the
+                # ladder would tick forever without ever escalating
+                # while the operator believes overload protection is on
+                log.warn("--brownout is armed but BOTH of its signals "
+                         "are disabled (--perf-accounting off and no "
+                         "--slo-* objective declared): the ladder will "
+                         "never escalate. Enable --perf-accounting or "
+                         "declare an SLO (or set --brownout-burn > 0).")
         # zero-downtime lifecycle (--model-watch SECONDS): registry +
         # watcher + warmup + swap controller over <model>.bundles/
         self.lifecycle = None
@@ -248,15 +319,12 @@ class ServingApp:
     def _validate_iteration_options(options) -> None:
         """--batching-mode iteration composes with a restricted option
         surface (docs/DEPLOYMENT.md): the paged engine is a greedy
-        single-model decoder, and the lifecycle's swap plane does not
-        yet quiesce at step boundaries — fail LOUDLY at boot rather
-        than serving something subtly different from what was asked."""
+        single-model decoder — fail LOUDLY at boot rather than serving
+        something subtly different from what was asked. --model-watch
+        DOES compose since ISSUE 11: swaps/canaries/rollbacks re-point
+        the engine through the quiesce protocol at a step boundary with
+        an empty join set (--quiesce-deadline bounds the drain)."""
         problems = []
-        if float(options.get("model-watch", 0) or 0) > 0:
-            problems.append(
-                "--model-watch (hot-swap needs a step-boundary quiesce "
-                "with an empty join set — ROADMAP item; use "
-                "--batching-mode request for the lifecycle plane)")
         if int(options.get("beam-size", 6) or 6) != 1:
             problems.append("--beam-size must be 1 (the paged engine "
                             "decodes greedily; beam>1 iteration needs "
@@ -284,10 +352,12 @@ class ServingApp:
 
     def _build_engine(self):
         """Fresh PagedDecodeEngine over the boot TranslationService's
-        model (also the scheduler's rebuild hook after a watchdog trip —
-        the wedged worker thread owns the old engine's device state)."""
+        model."""
+        return self._engine_for(self.service, self.registry)
+
+    def _engine_for(self, service, registry):
         from ..translator.iteration import PagedDecodeEngine
-        tr = self.service.translator
+        tr = service.translator
         opts = self.options
         ml = max(1, int(opts.get("max-length", 50) or 50))
         return PagedDecodeEngine(
@@ -300,7 +370,60 @@ class ServingApp:
             max_length_factor=float(
                 opts.get("max-length-factor", 3.0) or 3.0),
             steps_per_round=int(opts.get("iteration-steps", 1) or 1),
-            registry=self.registry)
+            registry=registry)
+
+    def _bundle_engine_factory(self, bundle_dir: str, manifest):
+        """executor_factory for iteration mode (ISSUE 11): a warmed
+        candidate is a whole PagedDecodeEngine (model + its own device
+        page pool) over a fresh TranslationService built against the
+        bundle's model member. The EngineExecutor wrapper is callable
+        for the golden smoke (warm_executor drives the engine's real
+        install/step jits off the serving path) and carries ``.engine``
+        for the quiesce re-point. Candidate engines declare no gauges —
+        the pool gauges re-point to whichever engine installs
+        (scheduler.install_engine)."""
+        from ..translator.iteration import EngineExecutor
+        member = os.path.basename(self._model_path())
+        bopts = self.options.with_(
+            models=[os.path.join(bundle_dir, member)])
+        return EngineExecutor(
+            self._engine_for(TranslationService(bopts), registry=None))
+
+    def _rebuild_live_engine(self):
+        """The scheduler's engine_factory (watchdog-trip rebuild — the
+        wedged worker thread owns the old engine's device state): a
+        fresh engine for the CURRENT live version. With the lifecycle
+        attached, rebuild from the live version's bundle and hand the
+        controller the replacement executor so round attribution and
+        rollbacks track the engine actually serving.
+
+        The bundle case loads a whole model ON THE EVENT LOOP — a
+        bounded (seconds) stall of every connection, paid only on a
+        watchdog trip / unrecovered round failure. The alternative
+        (deferring the build to a thread) would let queued sentences
+        join the known-broken engine in the meantime, which is worse
+        than a rare bounded stall."""
+        from ..translator.iteration import EngineExecutor
+        lc = self.lifecycle
+        if lc is not None:
+            v = lc.live_version()
+            if v is not None and getattr(v, "bundle_dir", ""):
+                ex = self._bundle_engine_factory(v.bundle_dir,
+                                                 v.manifest or {})
+                lc.adopt_live_executor(ex)
+                return ex.engine
+        engine = self._build_engine()
+        if lc is not None:
+            lc.adopt_live_executor(EngineExecutor(engine))
+        return engine
+
+    def _apply_brownout(self, level: int) -> None:
+        """BrownoutController's effect hook: push the level into the
+        scheduler (cap tightening + row eviction) and admission (lane
+        shedding)."""
+        self.scheduler.set_brownout_level(
+            level, cap_factor=self._brownout_cap_factor)
+        self.admission.set_brownout(level, self._brownout_min_priority)
 
     def _set_perf_geometry(self) -> None:
         """Feed the live-MFU gauges the real model geometry when a real
@@ -362,7 +485,10 @@ class ServingApp:
             log.warn("--model-watch: no model path to watch; lifecycle "
                      "disabled")
             return
-        factory = executor_factory or self._bundle_executor_factory
+        iteration = self.batching_mode == "iteration"
+        factory = executor_factory or (
+            self._bundle_engine_factory if iteration
+            else self._bundle_executor_factory)
         self.lifecycle = SwapController(
             executor_factory=factory,
             metrics_registry=self.registry,
@@ -408,9 +534,21 @@ class ServingApp:
             opts = self.service.translator.options
             boot_compat = bdl.compat_block(
                 opts, list(opts.get("vocabs", None) or []))
-        self.lifecycle.seed_live(boot_seq, boot_name, boot_translate,
-                                 compat=boot_compat)
-        self.scheduler.translate_lines = self.lifecycle.route
+        if iteration:
+            # the boot "executor" in iteration mode wraps the engine the
+            # scheduler is already running; the quiesce protocol re-
+            # points at successors' engines (ISSUE 11)
+            from ..translator.iteration import EngineExecutor
+            self.lifecycle.seed_live(
+                boot_seq, boot_name, EngineExecutor(self.scheduler.engine),
+                compat=boot_compat)
+            self.lifecycle.attach_iteration(
+                self.scheduler,
+                float(self.options.get("quiesce-deadline", 2.0) or 2.0))
+        else:
+            self.lifecycle.seed_live(boot_seq, boot_name, boot_translate,
+                                     compat=boot_compat)
+            self.scheduler.translate_lines = self.lifecycle.route
         self.scheduler.version_fn = self.lifecycle.live_version_name
         self.watcher = BundleWatcher(bdl.bundle_root(model_path),
                                      self.lifecycle.ingest,
@@ -480,13 +618,16 @@ class ServingApp:
         # rather than 404 — operators should not have to guess); admin
         # verbs only exist with the lifecycle
         routes = obs.trace_routes()
-        routes.update(mslo.slo_routes(lambda: self.slo))
+        routes.update(mslo.slo_routes(lambda: self.slo,
+                                      lambda: self.brownout))
         if self.lifecycle is not None:
             routes.update(self._admin_routes())
         self.metrics_server = msm.maybe_start_metrics_server(
             self.options, ready_fn=self.ready, routes=routes)
         if self.slo is not None:
             self.slo.start()
+        if self.brownout is not None:
+            self.brownout.start()
         if self.options.get("warmup-on-boot", False):
             # not gated on the perf plane: the user asked for warm
             # buckets either way — without --perf-accounting only the
@@ -543,6 +684,9 @@ class ServingApp:
         the span tree spans ingest → … → reply write). ``done`` is a
         no-op when tracing is off."""
         trace_id, body = split_trace_header(text)
+        hdr_priority, body = split_priority_header(body)
+        if hdr_priority is not None:
+            priority = hdr_priority
         lines = body.split("\n")
         span = None
         if obs.enabled():
@@ -558,7 +702,8 @@ class ServingApp:
             # admit inside the span context so a shed's timeline event
             # inherits the trace id (flight dumps tie it to the victim)
             with obs.TRACER.use(span):
-                self.admission.admit(len(lines), n_pages=n_pages)
+                self.admission.admit(len(lines), n_pages=n_pages,
+                                     priority=priority)
         except Overloaded as e:
             return self._finish_frame(trace_id, meta, span, "shed",
                                       f"!!SERVER-OVERLOADED {e}")
@@ -576,6 +721,12 @@ class ServingApp:
             # watchdog liveness trip: explicitly retriable — the replica
             # is healthy again (fresh device worker), resend the request
             return self._finish_frame(trace_id, meta, span, "stalled",
+                                      f"!!SERVER-RETRY {e}")
+        except RowEvicted as e:
+            # quiesce-deadline / brownout / recoverable-engine-failure
+            # eviction (ISSUE 11): pages freed, replica healthy or about
+            # to be — explicitly retriable, counted, never silent
+            return self._finish_frame(trace_id, meta, span, "evicted",
                                       f"!!SERVER-RETRY {e}")
         except asyncio.CancelledError:
             # client abort: record the root span before unwinding — an
@@ -647,6 +798,10 @@ class ServingApp:
         if self.slo is not None:
             self.slo.stop()
             obs.FLIGHT.remove_snapshot_provider("slo")
+        if self.brownout is not None:
+            self.brownout.stop()
+            obs.FLIGHT.remove_snapshot_provider("brownout")
+            self.brownout = None
         if self.watcher is not None:
             bdl.remove_commit_hook(self._on_bundle_commit)
             self.watcher.stop()

@@ -67,6 +67,12 @@ class AdmissionController:
         self._lock = lockdep.make_lock("AdmissionController._lock")
         self._draining = False                  # guarded-by: _lock
         self._drain_started: Optional[float] = None   # guarded-by: _lock
+        # brownout ladder (serving/brownout.py, ISSUE 11): at level >= 3
+        # requests below the configured priority are shed explicitly —
+        # the top rung of the degradation ladder. Written by the
+        # brownout evaluator thread, read at every admit.
+        self._brownout_level = 0                # guarded-by: _lock
+        self._brownout_min_priority = 1         # guarded-by: _lock
         r = registry if registry is not None else msm.REGISTRY
         self.m_admitted = r.counter(
             "marian_serving_admitted_sentences_total",
@@ -84,14 +90,32 @@ class AdmissionController:
         with self._lock:
             return self._draining
 
-    def admit(self, n_units: int, n_pages: int = 0) -> None:
+    def set_brownout(self, level: int, min_priority: int = 1) -> None:
+        """Arm/disarm the ladder's admission rung (brownout evaluator
+        thread): at ``level >= 3`` requests with priority below
+        ``min_priority`` are shed with an explicit, retriable
+        !!SERVER-OVERLOADED — low lanes degrade predictably while high
+        lanes keep their queue."""
+        with self._lock:
+            self._brownout_level = max(0, int(level))
+            self._brownout_min_priority = int(min_priority)
+
+    def _gate_state(self):
+        with self._lock:
+            return (self._draining, self._brownout_level,
+                    self._brownout_min_priority)
+
+    def admit(self, n_units: int, n_pages: int = 0,
+              priority: int = 0) -> None:
         """Gate one request of ``n_units`` sentences (owing ``n_pages``
         KV-pool pages in iteration mode); raises Overloaded instead of
-        queueing when a bound would be exceeded or the server is
-        draining. Admission is all-or-nothing per request — partial
+        queueing when a bound would be exceeded, the server is
+        draining, or the brownout ladder sheds the request's priority
+        lane. Admission is all-or-nothing per request — partial
         admission would split one client's reply across a shed
         boundary."""
-        if self.draining:
+        draining, b_level, b_minp = self._gate_state()
+        if draining:
             self.m_shed.labels("draining").inc()
             # shed decisions land on the obs timeline so a flight dump
             # shows them next to the victims (ISSUE 8); the admit-OK hot
@@ -100,6 +124,14 @@ class AdmissionController:
             raise Overloaded("server is draining (shutting down); "
                              "retry against another replica",
                              retriable=False)
+        if b_level >= 3 and priority < b_minp:
+            self.m_shed.labels("brownout").inc()
+            obs.event("admission.shed", reason="brownout", units=n_units,
+                      priority=priority, level=b_level)
+            raise Overloaded(
+                f"brownout level {b_level}: priority-{priority} lane is "
+                f"shed under sustained overload (lanes >= {b_minp} keep "
+                f"serving); retry later or against another replica")
         if self.max_queue_units > 0:
             depth = int(self.depth_fn())
             if depth + n_units > self.max_queue_units:

@@ -126,6 +126,12 @@ class SwapController:
         self._pinned = False                               # guarded-by: _lock
         self._batch_n = 0                                  # guarded-by: _lock
         self._stats: Dict[int, _Stats] = {}                # guarded-by: _lock
+        # iteration-mode composition (ISSUE 11): set by attach_iteration.
+        # Swap/canary/rollback re-point the scheduler's paged engine
+        # through its quiesce protocol instead of relying on route()'s
+        # per-batch executor read (which iteration mode never calls).
+        self._sched = None
+        self._quiesce_deadline = 2.0
 
         r = metrics_registry if metrics_registry is not None \
             else msm.REGISTRY
@@ -176,6 +182,97 @@ class SwapController:
             self._live = v
         self._set_info(v)
         return v
+
+    # -- iteration-mode composition (ISSUE 11) ------------------------------
+    def attach_iteration(self, scheduler, quiesce_deadline: float = 2.0
+                         ) -> None:
+        """Compose with ``--batching-mode iteration``: executors are
+        EngineExecutor-shaped (callable for the golden smoke, ``.engine``
+        for dispatch), swaps re-point the scheduler's paged engine via
+        its quiesce protocol (stop joins → drain under
+        ``quiesce_deadline`` → evict the overdue with retriable errors →
+        install at a step boundary with an empty join set → resume), and
+        per-round health flows back through ``round_observer`` so canary
+        evaluation and live auto-rollback keep working.
+
+        CANARY SEMANTICS DIFFER by necessity: the decode is ONE joint
+        program, so a canary cannot take an f-fraction of batches — it
+        takes ALL joins for its evaluation window (temporal canary)
+        while the previous live engine stays warm for a cheap rollback.
+        ``--canary-fraction > 0`` enables the canary phase; the fraction
+        itself is ignored (docs/DEPLOYMENT.md)."""
+        self._sched = scheduler
+        self._quiesce_deadline = float(quiesce_deadline)
+        scheduler.round_observer = self._note_round
+        if self.canary_fraction > 0:
+            log.info("model lifecycle: iteration mode — canary is "
+                     "TEMPORAL (all joins route to the canary during "
+                     "evaluation; --canary-fraction {} is ignored)",
+                     self.canary_fraction)
+
+    def _repoint(self, v: reg.ModelVersion, kind: str, wait: bool):
+        """Re-point dispatch at ``v``'s engine through the scheduler's
+        quiesce protocol (iteration mode; request mode is a no-op —
+        route() reads ``_live`` per batch). MUST be called with the
+        controller lock RELEASED: with ``wait=True`` this blocks on the
+        event loop draining the engine, and the loop's rounds take the
+        lock via version_fn/_note_round — holding it here would
+        deadlock. ``wait=False`` is mandatory when the CALLER is the
+        event-loop thread (the rollback paths driven by _note_round):
+        the loop cannot wait on work only it can perform."""
+        sched = self._sched
+        if sched is None:
+            return None
+        engine = getattr(v.executor, "engine", None)
+        if engine is None:
+            log.error("model lifecycle: cannot re-point the paged "
+                      "engine at {} — its executor has no .engine "
+                      "(iteration mode needs EngineExecutor-shaped "
+                      "executors)", v.name)
+            return None
+        return sched.request_quiesce(
+            lambda: sched.install_engine(engine),
+            self._quiesce_deadline, f"{kind} -> {v.name}", wait=wait)
+
+    def _note_round(self, error: bool, dt: float) -> None:
+        """Iteration-mode health hook (event-loop thread, once per
+        engine round): attribute the round to the version whose engine
+        actually served it — during a quiesce the registry may already
+        name the incoming version while the outgoing engine drains, so
+        attribution follows ENGINE IDENTITY, not registry state."""
+        sched = self._sched
+        if sched is None:
+            return
+        eng = getattr(sched, "engine", None)
+        with self._lock:
+            ver: Optional[reg.ModelVersion] = None
+            is_canary = False
+            for v, c in ((self._canary, True), (self._live, False),
+                         (self._previous, False)):
+                if v is not None \
+                        and getattr(v.executor, "engine", None) is eng:
+                    ver, is_canary = v, c
+                    break
+        if ver is None:
+            return
+        self._record(ver, dt, error=error)
+        if is_canary:
+            self._evaluate_canary(ver, allow_promote=not error)
+        elif error:
+            self._maybe_rollback_live(ver)
+
+    def adopt_live_executor(self, executor) -> None:
+        """The scheduler rebuilt the live engine after a watchdog trip
+        (the wedged thread owns the old one): point the live version's
+        executor at the replacement so round attribution and future
+        rollbacks see the engine actually serving."""
+        with self._lock:
+            if self._live is not None:
+                self._live.executor = executor
+
+    def live_version(self) -> Optional[reg.ModelVersion]:
+        with self._lock:
+            return self._live
 
     # -- ingestion (watcher thread) -----------------------------------------
     def ingest(self, bundle_dir: str, manifest: Dict
@@ -264,9 +361,23 @@ class SwapController:
 
     def _install(self, v: reg.ModelVersion) -> None:
         """A warmed candidate enters service: as a canary when canary
-        routing is on and a live version exists, else by immediate swap."""
+        routing is on and a live version exists, else by immediate swap.
+        In iteration mode the engine re-point happens FIRST, through the
+        quiesce protocol (watcher thread, blocking until the drain
+        completes): the registry only flips once the candidate's engine
+        is verifiably serving — a failed install leaves the old engine
+        and the old registry state untouched."""
         with self._lock:
             has_live = self._live is not None
+        if self._sched is not None and has_live:
+            op = self._repoint(
+                v, "canary" if self.canary_fraction > 0 else "swap",
+                wait=True)
+            if op is not None \
+                    and not (op.event.is_set() and op.install_ok):
+                raise WarmupError(
+                    f"quiesce install of {v.name} did not complete "
+                    f"(the previous engine keeps serving)")
         if self.canary_fraction > 0 and has_live:
             with self._lock:
                 self.registry.transition(v.seq, reg.CANARY)
@@ -483,10 +594,19 @@ class SwapController:
                          reason: str) -> None:
         fp.fault_point("lifecycle.rollback")
         with self._lock:
+            live = self._live
             self.registry.transition(canary.seq, reg.FAILED, reason)
             if self._canary is canary:
                 self._canary = None
             self._release(canary)
+        if live is not None:
+            # iteration mode: the temporal canary's engine is the one
+            # serving — re-point back at the live engine via quiesce.
+            # wait=False: this runs on the event-loop thread
+            # (_note_round), which is the thread that executes the
+            # quiesce; waiting here would deadlock. no-op in request
+            # mode (route() already routes to live).
+            self._repoint(live, "rollback", wait=False)
         self._set_info(canary)
         self.m_rollbacks.inc()
         log.error("model lifecycle: ROLLBACK — canary {} failed ({}); "
@@ -524,6 +644,11 @@ class SwapController:
             # not mask the original batch exception
             log.warn("model lifecycle: live rollback aborted ({})", e)
         if rolled_to is not None:
+            # iteration mode: enqueue the engine re-point (wait=False —
+            # this path runs on the event-loop thread via _note_round;
+            # the quiesce executes over the NEXT rounds). Request mode:
+            # no-op, route() reads the flipped _live per batch.
+            self._repoint(rolled_to, "rollback", wait=False)
             # flight dump AFTER the lock is released — dump IO must
             # never run under control-plane locks (MT-LOCK-BLOCKING)
             obs.event("lifecycle.rollback", version=live.name,
@@ -577,6 +702,9 @@ class SwapController:
                 return False
             self._rollback_to(prev, cur, "manual rollback (admin verb)",
                               auto=False)
+        # iteration mode: blocking re-point is safe here — admin verbs
+        # run on the metrics HTTP thread, not the event loop
+        self._repoint(prev, "rollback", wait=True)
         obs.event("lifecycle.rollback", version=cur.name, to=prev.name,
                   kind="manual")
         obs.FLIGHT.trip("manual-rollback",

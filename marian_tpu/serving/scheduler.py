@@ -68,6 +68,45 @@ class DispatchStalled(RuntimeError):
     retriable = True
 
 
+class RowEvicted(RuntimeError):
+    """A decoding row was evicted with its pages freed — the quiesce
+    deadline expired mid-swap, brownout pressure reclaimed its capacity
+    for a higher-priority lane, or a recoverable engine failure dropped
+    the round (ISSUE 11). Retriable by contract: the server replies
+    ``!!SERVER-RETRY`` and the replica is (or is about to be) healthy —
+    a rolled-back / rebuilt engine serves the resend."""
+
+    retriable = True
+
+
+class _QuiesceOp:
+    """One pending quiesce: stop admitting joins, drain active rows
+    under ``deadline_s`` (evict the overdue with RowEvicted), run the
+    pool audit, then ``install()`` re-points the engine at a step
+    boundary with an empty join set. ``event`` releases the waiting
+    caller (watcher / admin thread)."""
+
+    __slots__ = ("install", "deadline_s", "reason", "deadline", "event",
+                 "ok", "install_ok", "cancelled", "evicted", "t0")
+
+    def __init__(self, install: Callable[[], None], deadline_s: float,
+                 reason: str):
+        self.install = install
+        self.deadline_s = max(0.0, float(deadline_s))
+        self.reason = reason
+        self.deadline: Optional[float] = None   # set on first round seen
+        self.event = threading.Event()
+        self.ok = False            # install ran AND both audits clean
+        self.install_ok = False    # install() returned without raising
+        # a waiter that timed out CANCELS the op (cancel_quiesce): its
+        # install must never run late — the caller has already treated
+        # the re-point as failed (e.g. the lifecycle released the
+        # candidate), so a late install would serve a dead executor
+        self.cancelled = False
+        self.evicted = 0
+        self.t0 = 0.0
+
+
 def default_length_fn(line: str) -> int:
     """Whitespace token estimate (+1 for EOS) — the budget packer only
     needs bucket-resolution accuracy; the translator re-measures with real
@@ -213,7 +252,24 @@ class ContinuousScheduler:
         self._dead = 0                    # guarded-by: _state_lock
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
+        self._loop = None        # captured at start(); request_quiesce
+        #                          wakes the worker cross-thread via it
         self._inflight = 0
+        # pending quiesce operations (ISSUE 11), processed one at a
+        # time by the iteration worker at round boundaries; appended
+        # from any thread (the lifecycle watcher, admin verbs), hence
+        # the lock
+        self._quiesce_q: Deque[_QuiesceOp] = collections.deque()
+        #                                   # guarded-by: _state_lock
+        # brownout ladder effects (serving/brownout.py): the level is
+        # written by the brownout evaluator thread and read per round;
+        # a single int with no coupled invariant — no lock
+        self._brownout_level = 0
+        self._brownout_cap_factor = 0.5
+        # lifecycle health hook (iteration mode): called after every
+        # engine round with (error, device_s) so SwapController can
+        # window per-version round health without owning the round loop
+        self.round_observer: Optional[Callable[[bool, float], None]] = None
         # units currently on (or headed to) the device — loop-thread-only.
         # stop() fails their futures: a cancelled worker never returns
         # results for them, and their units left the lanes at forming
@@ -224,6 +280,7 @@ class ContinuousScheduler:
         self._active_units: Dict[_Unit, None] = {}
 
         r = registry if registry is not None else msm.REGISTRY
+        self._registry = r       # install_engine re-declares pool gauges
         self.m_requests = r.counter(
             "marian_serving_requests_total", "Requests submitted")
         self.m_queue_depth = r.gauge(
@@ -268,7 +325,11 @@ class ContinuousScheduler:
         self.m_outcomes = r.counter(
             "marian_serving_request_outcomes_total",
             "Requests resolved, by outcome and the model version live at "
-            "resolution time (ok|failure|timeout|cancelled|stalled)",
+            "resolution time (ok|failure|timeout|cancelled|stalled|"
+            "evicted — evicted is retriable row eviction: quiesce "
+            "deadline, brownout, recoverable engine failure; excluded "
+            "from the availability SLO like cancelled, because the "
+            "client is told to retry and the retry's outcome counts)",
             labels=("outcome", "model_version"))
         # iteration-mode series (--batching-mode iteration): joins and
         # evictions happen PER DECODE STEP, not per batch — these are
@@ -283,8 +344,10 @@ class ContinuousScheduler:
             "decoding rows (iteration mode)")
         self.m_evictions = r.counter(
             "marian_serving_evictions_total",
-            "Mid-decode evictions of dead rows (request cancelled / "
-            "timed out while its sentence was decoding; iteration mode)")
+            "Mid-decode row evictions, all causes (request cancelled / "
+            "timed out while decoding, quiesce deadline, brownout — the "
+            "latter two also count in their dedicated series; iteration "
+            "mode)")
         self.m_steps = r.counter(
             "marian_serving_decode_steps_total",
             "Decode steps run by the iteration-mode worker")
@@ -297,11 +360,32 @@ class ContinuousScheduler:
             "KV-pool pages owed by queued sentences (iteration mode's "
             "admission currency)")
         self.m_queued_pages.set_function(self.queued_pages)
+        # quiesce + brownout series (ISSUE 11)
+        self.m_quiesces = r.counter(
+            "marian_serving_quiesces_total",
+            "Quiesce operations completed (joins stopped, rows drained "
+            "or evicted, engine re-pointed at a step boundary)")
+        self.m_quiesce_evictions = r.counter(
+            "marian_serving_quiesce_evictions_total",
+            "Rows evicted with retriable !!SERVER-RETRY because the "
+            "--quiesce-deadline expired before they drained")
+        self.m_quiescing = r.gauge(
+            "marian_serving_quiescing",
+            "Quiesce operations pending/draining (joins are paused "
+            "while this is > 0; back-to-back lifecycle verbs can queue "
+            "more than one)")
+        self.m_quiescing.set_function(self._quiesce_depth)
+        self.m_brownout_evictions = r.counter(
+            "marian_serving_brownout_evictions_total",
+            "Rows evicted with retriable !!SERVER-RETRY by the brownout "
+            "ladder (level >= 2) to free capacity for a higher-priority "
+            "lane")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         """Start the worker on the RUNNING loop (call from a coroutine)."""
         if self._task is None:
+            self._loop = asyncio.get_event_loop()
             self._task = asyncio.ensure_future(self._run())
 
     async def stop(self) -> None:
@@ -341,6 +425,13 @@ class ContinuousScheduler:
             self._dead = 0
             self._queued_pages = 0
             self._dead_pages = 0
+            dangling = list(self._quiesce_q)
+            self._quiesce_q.clear()
+        for op in dangling:
+            # release any thread blocked in request_quiesce(wait=True):
+            # the loop is gone, the install will never run
+            op.ok = False
+            op.event.set()
         if self._own_executor:
             self._executor.shutdown(wait=False)
 
@@ -385,6 +476,97 @@ class ContinuousScheduler:
         """Raw queued-unit count (live + dead) under the state lock."""
         with self._state_lock:
             return self._queued
+
+    # -- quiesce protocol (ISSUE 11; iteration mode) ------------------------
+    def _quiesce_depth(self) -> int:
+        with self._state_lock:
+            return len(self._quiesce_q)
+
+    def _peek_quiesce(self) -> Optional[_QuiesceOp]:
+        with self._state_lock:
+            while self._quiesce_q and self._quiesce_q[0].cancelled:
+                self._quiesce_q.popleft().event.set()
+            return self._quiesce_q[0] if self._quiesce_q else None
+
+    def cancel_quiesce(self, op: _QuiesceOp) -> None:
+        """Withdraw a pending quiesce whose waiter gave up (wait budget
+        exceeded): its install must not run late — the caller has
+        already declared the re-point failed and may have released the
+        target executor. A cancelled head is dropped at the next peek;
+        an op already past its install cannot be recalled (the caller's
+        event was set then)."""
+        with self._state_lock:
+            op.cancelled = True
+
+    def request_quiesce(self, install: Callable[[], None],
+                        deadline_s: float, reason: str,
+                        wait: bool = True,
+                        timeout: Optional[float] = None) -> _QuiesceOp:
+        """Enqueue a quiesce: the iteration worker stops admitting joins,
+        drains active rows until ``deadline_s`` (rows past it are evicted
+        with retriable ``!!SERVER-RETRY`` and their pages freed), runs
+        the pool audit, then calls ``install()`` at a step boundary with
+        an empty join set (the only legal moment to re-point the engine)
+        and resumes joins. Callable from ANY thread except — with
+        ``wait=True`` — the event-loop thread itself (the loop is what
+        executes the quiesce; waiting on it there would deadlock, which
+        is why the lifecycle's rollback paths pass ``wait=False``).
+        Returns the op; ``op.event``/``op.ok`` report completion."""
+        op = _QuiesceOp(install, deadline_s, reason)
+        with self._state_lock:
+            self._quiesce_q.append(op)
+        loop = self._loop
+        if loop is not None:
+            try:
+                loop.call_soon_threadsafe(self._wake.set)
+            except RuntimeError:   # loop already closed: stop() cleans up
+                pass
+        if wait:
+            # bounded: drain deadline + generous slack for the install's
+            # own work; a dead loop must not wedge the watcher forever
+            op.event.wait(timeout if timeout is not None
+                          else op.deadline_s + 30.0)
+            if not op.event.is_set():
+                # withdraw it: the caller will treat the re-point as
+                # failed, so a LATE install (serving loop catching up
+                # after the caller released the target) must not run
+                self.cancel_quiesce(op)
+                log.error("quiesce ({}) did not complete within its "
+                          "wait budget — withdrawn; the serving loop "
+                          "may be down", reason)
+        return op
+
+    def install_engine(self, engine) -> None:
+        """Re-point the paged engine (the quiesce install callback is
+        the only legitimate caller — loop thread, empty join set, zero
+        active rows). Re-declares the pool gauges so the scrape tracks
+        the NEW engine's pool, and re-applies the current brownout cap
+        scale (a swap must not silently reset an active brownout)."""
+        self.engine = engine
+        decl = getattr(engine, "_declare_metrics", None)
+        if decl is not None:
+            decl(self._registry)
+        scale_fn = getattr(engine, "set_cap_scale", None)
+        if scale_fn is not None:
+            scale_fn(self._brownout_cap_factor
+                     if self._brownout_level >= 1 else 1.0)
+
+    # -- brownout ladder effects (ISSUE 11; serving/brownout.py) ------------
+    def set_brownout_level(self, level: int,
+                           cap_factor: Optional[float] = None) -> None:
+        """Apply one brownout level (called by the BrownoutController's
+        evaluator thread): >= 1 tightens the decode cap of future joins,
+        >= 2 arms the per-round priority eviction pass, >= 3 is enforced
+        at admission (AdmissionController.set_brownout)."""
+        if cap_factor is not None:
+            self._brownout_cap_factor = float(cap_factor)
+        self._brownout_level = max(0, int(level))
+        engine = self.engine
+        scale_fn = getattr(engine, "set_cap_scale", None) \
+            if engine is not None else None
+        if scale_fn is not None:
+            scale_fn(self._brownout_cap_factor
+                     if self._brownout_level >= 1 else 1.0)
 
     def submit(self, lines: List[str], priority: int = 0,
                timeout: Optional[float] = None,
@@ -628,7 +810,8 @@ class ContinuousScheduler:
         while True:
             try:
                 was_idle = False
-                while self._queue_size() == 0 and not self._active_units:
+                while self._queue_size() == 0 and not self._active_units \
+                        and self._quiesce_depth() == 0:
                     self._wake.clear()
                     was_idle = True
                     await self._wake.wait()
@@ -736,12 +919,49 @@ class ContinuousScheduler:
                     joined_mid_decode=rows_before > 0)
 
     async def _iteration_round(self, loop) -> None:
-        """One join-pass + decode-step round on the device worker."""
+        """One join-pass + decode-step round on the device worker. With
+        a quiesce pending (ISSUE 11) the join set is EMPTY: active rows
+        drain until the deadline, overdue rows are evicted with
+        retriable errors, and once the engine is empty the op's install
+        re-points it before joins resume."""
         engine = self.engine
-        joins = self._form_join_set()
+        q = self._peek_quiesce()
+        if q is not None and q.deadline is None:
+            q.t0 = loop.time()
+            q.deadline = q.t0 + q.deadline_s
+            obs.event("quiesce.begin", reason=q.reason,
+                      rows=len(self._active_units),
+                      deadline_s=q.deadline_s)
+            log.info("quiesce ({}): joins paused, draining {} active "
+                     "row(s) under a {}s deadline", q.reason,
+                     len(self._active_units), q.deadline_s)
+        joins = [] if q is not None else self._form_join_set()
         evicts = [u for u in list(self._active_units)
                   if u.req.future.done()]
+        if q is None and self._brownout_level >= 2:
+            evicts.extend(self._brownout_victims(loop, evicts))
+        if q is not None and loop.time() >= q.deadline:
+            # quiesce deadline expired: the rows still decoding leave
+            # NOW with a retriable error (their pages are freed by the
+            # eviction below) — a swap is never held hostage by one
+            # long sentence
+            for u in list(self._active_units):
+                if u in evicts:
+                    continue
+                self._evict_with_retry(
+                    u, loop,
+                    f"row evicted at the quiesce deadline "
+                    f"({q.reason})")
+                self.m_quiesce_evictions.inc()
+                q.evicted += 1
+                evicts.append(u)
         rows_before = engine.active_rows()
+        if q is not None and not joins and not evicts \
+                and not self._active_units:
+            # drained (or never had rows): complete the quiesce without
+            # burning a device round
+            self._finish_quiesce(q, loop)
+            return
         # queue_ms stops at JOIN time: stamp accepted units with the
         # round's start, not with a post-step timestamp that would bill
         # the step (and any jit warmup) as queueing
@@ -785,10 +1005,15 @@ class ContinuousScheduler:
         requeue: List[_Unit] = []
         for u, why in res.rejected:
             if why in FATAL_REASONS:
+                # operator-actionable rejection: the engine computed the
+                # page requirement — the error must say it, not leave
+                # the operator guessing which knob to turn (ISSUE 11)
+                detail = res.reject_detail.get(
+                    u, "exceeds the engine's source cap or the whole "
+                       "KV pool")
                 self._fail_unit(
                     u, loop,
-                    f"sentence cannot be admitted ({why}): exceeds the "
-                    f"engine's source cap or the whole KV pool")
+                    f"sentence cannot be admitted ({why}): {detail}")
             else:
                 requeue.append(u)
         # appendleft in REVERSE so the lane keeps FIFO order across
@@ -817,6 +1042,131 @@ class ContinuousScheduler:
                     self._version_label(), rows=res.rows,
                     width=res.bucket, src_tokens=src_done,
                     trg_tokens=res.tokens, device_s=res.device_s)
+        self._notify_round(False, res.device_s)
+        if q is not None and not self._active_units:
+            self._finish_quiesce(q, loop)
+
+    def _finish_quiesce(self, q: _QuiesceOp, loop) -> None:
+        """The engine reached an empty join set with zero active rows:
+        audit the outgoing engine (zero leaked pages is the contract),
+        run the install (which may re-point self.engine), audit the
+        incoming engine, resume joins. The serving.quiesce fault point
+        sits BEFORE the install — kill mode is the kill-mid-quiesce
+        chaos schedule (scripts/chaos.py --iteration)."""
+        fp.fault_point("serving.quiesce")
+        if q.cancelled:
+            # the waiter gave up and withdrew the op mid-drain: do NOT
+            # install (the target may already be released); just resume
+            with self._state_lock:
+                if self._quiesce_q and self._quiesce_q[0] is q:
+                    self._quiesce_q.popleft()
+            obs.event("quiesce.cancelled", reason=q.reason,
+                      evicted=q.evicted)
+            q.event.set()
+            self._wake.set()
+            return
+        old = self.engine
+        pre = self._audit_engine(old, "quiesce-drain")
+        install_ok = True
+        try:
+            q.install()
+        except Exception as e:  # noqa: BLE001 — a failed install keeps
+            # the drained (but healthy) old engine serving; the caller
+            # learns via op.ok and decides (the lifecycle fails the
+            # candidate)
+            install_ok = False
+            log.error("quiesce ({}): install failed ({}); the previous "
+                      "engine keeps serving", q.reason, e)
+        post = [] if self.engine is old \
+            else self._audit_engine(self.engine, "quiesce-install")
+        q.install_ok = install_ok
+        q.ok = install_ok and not pre and not post
+        with self._state_lock:
+            if self._quiesce_q and self._quiesce_q[0] is q:
+                self._quiesce_q.popleft()
+        self.m_quiesces.inc()
+        obs.event("quiesce.complete", reason=q.reason, ok=q.ok,
+                  evicted=q.evicted, install_ok=install_ok,
+                  audit_violations=len(pre) + len(post),
+                  duration_ms=round((loop.time() - q.t0) * 1e3, 1))
+        log.info("quiesce ({}): complete in {:.0f}ms — {} row(s) "
+                 "evicted with retry, audit {} ({} violation(s))",
+                 q.reason, (loop.time() - q.t0) * 1e3, q.evicted,
+                 "clean" if not (pre or post) else "FAILED",
+                 len(pre) + len(post))
+        q.event.set()
+        self._wake.set()           # joins resume immediately
+
+    @staticmethod
+    def _audit_engine(engine, context: str) -> List[str]:
+        """Run the engine's pool auditor if it has one (stub engines in
+        tests may not); violations are already reported by the engine."""
+        audit = getattr(engine, "audit", None)
+        if audit is None:
+            return []
+        try:
+            return list(audit(context=context))
+        except TypeError:
+            return list(audit())
+
+    def _evict_with_retry(self, u: _Unit, loop, msg: str) -> None:
+        """Fail one decoding row's request with the retriable RowEvicted
+        (transports reply !!SERVER-RETRY); the row itself leaves the
+        engine via the caller's evict list, freeing its pages."""
+        if u.req.future.done():
+            return
+        self._outcome("evicted", u.req, loop.time())
+        u.req.future.set_exception(RowEvicted(msg + " — retry"))
+
+    def _notify_round(self, error: bool, device_s: float) -> None:
+        """Report one engine round's health to the lifecycle observer
+        (SwapController windows these per version for canary promotion
+        and live auto-rollback in iteration mode)."""
+        fn = self.round_observer
+        if fn is None:
+            return
+        try:
+            fn(error, device_s)
+        except Exception as e:  # noqa: BLE001 — health accounting must
+            log.warn("round observer failed: {}", e)   # never kill rounds
+
+    def _brownout_victims(self, loop, exclude: List[_Unit]) -> List[_Unit]:
+        """Brownout level >= 2: when queued work outranks a decoding
+        row and could not join this round, evict the lowest-priority
+        active row (tie-break: longest remaining decode) with a
+        retriable error — one per round, so the ladder degrades
+        gradually and predictably rather than mass-evicting."""
+        if self.queued_units() <= 0:
+            return []
+        with self._state_lock:
+            top = max((p for p, lane in self._lanes.items() if lane),
+                      default=None)
+        if top is None:
+            return []
+        victims = [u for u in self._active_units
+                   if u not in exclude and not u.req.future.done()
+                   and u.req.priority < top]
+        if not victims:
+            return []
+
+        def score(u: _Unit):
+            prog = None
+            fn = getattr(self.engine, "row_progress", None)
+            if fn is not None:
+                prog = fn(u)
+            remaining = (prog[1] - prog[0]) if prog else 0
+            return (u.req.priority, -remaining)
+
+        worst = min(victims, key=score)
+        self._evict_with_retry(
+            worst, loop,
+            f"row evicted under brownout (level "
+            f"{self._brownout_level}) to free capacity for priority "
+            f"{top} traffic")
+        self.m_brownout_evictions.inc()
+        obs.event("brownout.evict", victim_priority=worst.req.priority,
+                  queued_priority=top)
+        return [worst]
 
     def _iteration_stalled(self, call, joins: List[_Unit], loop) -> None:
         """The engine round exceeded --dispatch-stall-timeout. Fail every
@@ -840,9 +1190,14 @@ class ContinuousScheduler:
             "watchdog",
             detail=f"iteration decode step ({len(victims)} sentences) "
                    f"stalled past {self.stall_timeout}s")
+        self._notify_round(True, self.stall_timeout)
         if self.engine_factory is not None:
             try:
-                self.engine = self.engine_factory()
+                # install_engine, not a bare assignment: the rebuilt
+                # engine must inherit the brownout cap scale and take
+                # over the pool gauges (the wedged engine's pool would
+                # otherwise keep feeding the scrape)
+                self.install_engine(self.engine_factory())
             except Exception as e:  # noqa: BLE001
                 log.error("engine rebuild after stall failed: {}", e)
 
@@ -852,14 +1207,33 @@ class ContinuousScheduler:
         log.error("iteration decode round failed ({} sentences): {}",
                   len(victims), exc)
         now = loop.time()
+        # with a recovery path armed (engine_factory rebuild, or the
+        # lifecycle observer that can roll back to a warm engine) the
+        # victims' requests are retriable by construction — a resend
+        # lands on a healthy engine. Without one, fail loud (the
+        # documented no-bisection iteration contract).
+        retriable = bool(getattr(exc, "retriable", False)) \
+            or self.engine_factory is not None \
+            or self.round_observer is not None
         for u in victims:
             if not u.req.future.done():
-                self.m_failures.inc()
-                self._outcome("failure", u.req, now)
-                u.req.future.set_exception(RuntimeError(str(exc)))
-        if self.engine_factory is not None:
+                if retriable:
+                    self._evict_with_retry(
+                        u, loop,
+                        f"row evicted: decode round failed ({exc})")
+                else:
+                    self.m_failures.inc()
+                    self._outcome("failure", u.req, now)
+                    u.req.future.set_exception(RuntimeError(str(exc)))
+        self._notify_round(True, 0.0)
+        if self.engine_factory is not None and self._quiesce_depth() == 0:
+            # the observer may have just initiated recovery itself (a
+            # lifecycle rollback enqueues a quiesce re-point to the warm
+            # previous engine) — rebuilding on top of that would load a
+            # whole model on the event loop only to be replaced one
+            # round later
             try:
-                self.engine = self.engine_factory()
+                self.install_engine(self.engine_factory())
             except Exception as e:  # noqa: BLE001
                 log.error("engine rebuild after failure failed: {}", e)
 

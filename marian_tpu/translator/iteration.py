@@ -37,6 +37,7 @@ schedule yields bitwise-identical outputs (tests/test_iteration.py).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -45,10 +46,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
+from ..common import faultpoints as fp
 from ..common import lockdep
+from ..common import logging as log
 from ..data.vocab import EOS_ID
-from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, KVPool, PoolExhausted,
-                                  ROW_BUCKETS, bucket_rows, pages_for_tokens)
+from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, KVPool, PoolCorruption,
+                                  PoolExhausted, ROW_BUCKETS, bucket_rows,
+                                  pages_for_tokens)
+
+# continuous pool auditing: with MARIAN_POOL_AUDIT=1 every admit+step
+# round ends with a full invariant audit (tests/conftest.py arms it for
+# the whole tier-1 run); without it the audit runs only at quiesce
+# boundaries and the cheap row-exit leak check stays always-on
+ENV_POOL_AUDIT = "MARIAN_POOL_AUDIT"
 
 # fatal join-rejection reasons: the sentence can NEVER be admitted (the
 # scheduler fails its request explicitly instead of re-queueing — this
@@ -63,6 +74,10 @@ class StepResult:
     accepted: List[object] = field(default_factory=list)
     # key -> reason; reasons in FATAL_REASONS are permanent
     rejected: List[Tuple[object, str]] = field(default_factory=list)
+    # key -> operator-actionable detail for FATAL rejections (the
+    # computed page requirement vs the pool's capacity — ISSUE 11: the
+    # error a client sees must tell the operator which knob to turn)
+    reject_detail: Dict[object, str] = field(default_factory=dict)
     finished: List[Tuple[object, str]] = field(default_factory=list)
     rows: int = 0                 # active rows this round (before finishes)
     bucket: int = 0               # compiled row bucket the round ran at
@@ -165,6 +180,13 @@ class PagedDecodeEngine:
         self._n_active = 0              # guarded-by: _lock
         self._used_tokens = 0           # guarded-by: _lock
         self._ever_stepped = False
+        # brownout level 1 (serving/brownout.py): NEW joins claim a
+        # scaled-down decode cap so each row costs fewer pages/steps
+        # under sustained overload. Written by the brownout thread,
+        # read on the worker thread — a single float, no invariant
+        # couples it to other state, so it rides no lock.
+        self._cap_scale = 1.0
+        self._audit_always = os.environ.get(ENV_POOL_AUDIT, "") == "1"
 
         self._step_jit: Dict[int, object] = {}
         self._install_jit: Dict[int, object] = {}
@@ -191,6 +213,14 @@ class PagedDecodeEngine:
             "marian_serving_active_rows",
             "Decode slots occupied by live sentences (iteration mode)")
         self.m_active_rows.set_function(self.active_rows)
+        self.m_audits = r.counter(
+            "marian_serving_pool_audits_total",
+            "Pool invariant audits run (quiesce boundaries; every round "
+            "under MARIAN_POOL_AUDIT=1)")
+        self.m_audit_failures = r.counter(
+            "marian_serving_pool_audit_failures_total",
+            "Pool invariant audits that found violations (double-free, "
+            "table/claim mismatch, leaked pages, row-exit leak)")
 
     # -- capacity (any thread) ----------------------------------------------
     def active_rows(self) -> int:
@@ -218,10 +248,29 @@ class PagedDecodeEngine:
 
     def decode_cap(self, n_src_tokens: int) -> int:
         """Static decode cap for a sentence (mirrors BeamSearch's
-        max-length-factor rule so both modes price work the same)."""
-        return int(min(self.max_length_cap,
-                       max(8, round(self.max_length_factor
-                                    * max(1, n_src_tokens)))))
+        max-length-factor rule so both modes price work the same).
+        Brownout level >= 1 scales it down for NEW joins — shorter rows
+        claim fewer pages and leave sooner (serving/brownout.py)."""
+        base = min(self.max_length_cap,
+                   max(8, round(self.max_length_factor
+                                * max(1, n_src_tokens))))
+        return int(max(8, round(base * self._cap_scale)))
+
+    def set_cap_scale(self, scale: float) -> None:
+        """Brownout level 1: scale the decode cap of FUTURE joins (rows
+        already decoding keep the cap they claimed pages for). Clamped
+        so the cap never collapses below the 8-token floor's reach."""
+        self._cap_scale = min(1.0, max(0.05, float(scale)))
+
+    def row_progress(self, key) -> Optional[Tuple[int, int]]:
+        """(pos, cap) of an active row, or None — the brownout eviction
+        policy's 'longest remaining' tiebreak reads this (any thread)."""
+        with self._lock:
+            slot = self._by_key.get(key)
+            if slot is None:
+                return None
+            s = self._slots[slot]
+            return (s.pos, s.cap) if s is not None else None
 
     def pages_for_text(self, text: str) -> int:
         """Pages one sentence will claim (admission pricing: queue debt
@@ -240,12 +289,17 @@ class PagedDecodeEngine:
         fail the request)."""
         t0 = time.perf_counter()
         res = StepResult()
+        # corruption-detection drills (no-ops unless the pool.* catalog
+        # points are armed): they corrupt real state so the audit below
+        # is proven against the bug classes it claims to catch
+        self.pool.chaos_double_free()
+        self._chaos_table_corrupt()
         for key in evicts:
             self._evict(key)
         rows_before = self.active_rows()
         joiners: List[Tuple[object, List[int], int]] = []
         for key, text in joins:
-            why = self._try_claim(key, text, joiners)
+            why = self._try_claim(key, text, joiners, res.reject_detail)
             if why is None:
                 res.accepted.append(key)
             else:
@@ -256,17 +310,37 @@ class PagedDecodeEngine:
                 res.mid_decode_joins = len(joiners)
         if self.active_rows() > 0:
             self._step(res)
+        if self._audit_always:
+            bad = self.audit(context="round")
+            if bad:
+                # fail the round loudly: the scheduler evicts the
+                # round's rows with a retriable error and rebuilds the
+                # engine — corrupted page state must never serve
+                # another token (docs/ROBUSTNESS.md)
+                raise PoolCorruption(
+                    "pool audit failed: " + "; ".join(bad[:4]))
         res.device_s = time.perf_counter() - t0  # mtlint: ok -- the step's per-token fetch (np.asarray in _step) IS the result fence; this window closes host-side after it
         return res
 
-    def _try_claim(self, key, text: str,
-                   joiners: List) -> Optional[str]:
+    def _try_claim(self, key, text: str, joiners: List,
+                   detail: Optional[Dict[object, str]] = None
+                   ) -> Optional[str]:
         ids = self.src_vocab.encode(text, add_eos=True, inference=True)
         if len(ids) > self.src_cap:
+            if detail is not None:
+                detail[key] = (f"source encodes to {len(ids)} tokens but "
+                               f"the engine's source cap is "
+                               f"{self.src_cap} (raise --max-length)")
             return "src_too_long"
         cap = self.decode_cap(len(ids))
         n_pages = pages_for_tokens(cap, self.page_len)
         if n_pages > self.pool.max_pages_per_row:
+            if detail is not None:
+                detail[key] = (
+                    f"decode cap {cap} tokens needs {n_pages} KV pages "
+                    f"of {self.page_len} tokens but the page table "
+                    f"holds {self.pool.max_pages_per_row}/row (raise "
+                    f"--kv-page-len or --kv-pool-bytes)")
             return "too_large"
         with self._lock:
             if self._n_active >= self.max_rows:
@@ -276,6 +350,13 @@ class PagedDecodeEngine:
         except PoolExhausted:
             # retriable only if the pool could EVER satisfy it
             if n_pages > self.pool.usable_pages:
+                if detail is not None:
+                    detail[key] = (
+                        f"decode cap {cap} tokens needs {n_pages} KV "
+                        f"pages but the whole pool holds only "
+                        f"{self.pool.usable_pages} allocatable pages "
+                        f"of {self.page_len} tokens (raise "
+                        f"--kv-pool-bytes or lower --max-length)")
                 return "too_large"
             return "no_pages"
         # lowest free slot (deterministic; keeps the occupied prefix —
@@ -301,9 +382,104 @@ class PagedDecodeEngine:
             self._slots[slot] = None
             self._n_active -= 1
             self._used_tokens -= s.pos
-        self.pool.release(key)
+        released = self.pool.release(key)
+        # row-exit leak detector (always on — one comparison): the row
+        # must give back exactly the pages its decode cap claimed; any
+        # drift means the claim table and the slot state diverged
+        expected = pages_for_tokens(s.cap, self.page_len)
+        if released != expected:
+            self._report_audit(
+                [f"row exit released {released} page(s) for key "
+                 f"{key!r}, expected {expected} (cap {s.cap})"],
+                context="row-exit")
         self._table[slot, :] = 0
         return True
+
+    # -- pool invariant auditor (ISSUE 11) ----------------------------------
+    def audit(self, context: str = "quiesce") -> List[str]:
+        """Cross-check free-list / page-table / per-row position
+        consistency plus leaked claims; returns violations (empty =
+        clean) and reports them (log + timeline event + flight dump +
+        counter). Run at every quiesce boundary, and after every round
+        under ``MARIAN_POOL_AUDIT=1`` (tier-1 arms it process-wide).
+
+        Called only from threads that own the engine state between
+        rounds (the device worker, or the event loop at a quiesce
+        boundary with no round in flight) — the snapshots below are
+        taken under the engine lock only for the metrics-thread
+        counters' sake."""
+        with self._lock:
+            slots = list(self._slots)
+            by_key = dict(self._by_key)
+            n_active = self._n_active
+            used_tokens = self._used_tokens
+        v = self.pool.audit()
+        active = [(i, s) for i, s in enumerate(slots) if s is not None]
+        if n_active != len(active):
+            v.append(f"active-row counter {n_active} != {len(active)} "
+                     f"occupied slots")
+        pos_sum = sum(s.pos for _, s in active)
+        if used_tokens != pos_sum:
+            v.append(f"used-token counter {used_tokens} != sum of row "
+                     f"positions {pos_sum}")
+        table = getattr(self, "_table_np", None)
+        for i, s in active:
+            if by_key.get(s.key) != i:
+                v.append(f"slot {i} key {s.key!r} missing from the "
+                         f"key index (maps to {by_key.get(s.key)})")
+            if s.pos > s.cap:
+                v.append(f"slot {i} position {s.pos} past its decode "
+                         f"cap {s.cap}")
+            pages = self.pool.pages_of(s.key)
+            want = pages_for_tokens(s.cap, self.page_len)
+            if len(pages) != want:
+                v.append(f"slot {i} holds {len(pages)} claimed pages, "
+                         f"cap {s.cap} needs {want}")
+            if table is not None:
+                row = table[i]
+                if list(row[:len(pages)]) != pages \
+                        or any(int(p) != 0 for p in row[len(pages):]):
+                    v.append(f"slot {i} page-table row "
+                             f"{[int(p) for p in row]} does not match "
+                             f"its claim {pages} (table corruption)")
+        for owner in self.pool.owners():
+            if owner not in by_key:
+                v.append(f"pool claim for {owner!r} has no active row "
+                         f"(pages leaked at row exit)")
+        if hasattr(self, "m_audits"):    # registry-less engines: no series
+            self.m_audits.inc()
+        if v:
+            self._report_audit(v, context)
+        return v
+
+    def _report_audit(self, violations: List[str], context: str) -> None:
+        """One audit failure: loud log, timeline event, flight dump
+        naming the fault, counter — the post-mortem must show WHAT was
+        corrupted, not just that a round failed."""
+        log.error("POOL AUDIT FAILED ({}): {} violation(s): {}", context,
+                  len(violations), "; ".join(violations[:4]))
+        if hasattr(self, "m_audit_failures"):
+            self.m_audit_failures.inc()
+        obs.event("pool.audit_failed", context=context,
+                  violations=list(violations[:8]))
+        obs.FLIGHT.trip_async(
+            "pool-audit",
+            detail=f"{context}: " + "; ".join(violations[:4]))
+
+    def _chaos_table_corrupt(self) -> None:
+        """``pool.table_corrupt`` detection drill (see
+        KVPool.chaos_double_free): an armed 'fail' redirects one active
+        row's first page-table entry to the trash page while its claim
+        still names the real page — the audit's table/claim cross-check
+        must catch exactly this."""
+        try:
+            fp.fault_point("pool.table_corrupt")
+        except fp.InjectedFault:
+            with self._lock:
+                slot = next((i for i, s in enumerate(self._slots)
+                             if s is not None), None)
+            if slot is not None:
+                self._table[slot, 0] = 0
 
     # host mirrors (worker thread only): allocated lazily so __init__
     # stays importable without numpy churn
@@ -469,7 +645,7 @@ class PagedDecodeEngine:
         res.tokens = consumed
         res.steps += k_steps
 
-    # -- direct (non-serving) decoding: tests, benches ----------------------
+    # -- direct (non-serving) decoding: tests, benches, warmup smoke --------
     def decode_texts(self, texts: Sequence[str]) -> List[str]:
         """Decode a list of sentences to completion through the slot
         machinery (joins as capacity frees up) — the library-call
@@ -494,3 +670,19 @@ class PagedDecodeEngine:
             if guard > 100000:
                 raise RuntimeError("iteration decode failed to converge")
         return [out[i] for i in range(len(texts))]
+
+
+class EngineExecutor:
+    """The lifecycle plane's executor shape for iteration mode
+    (ISSUE 11): a warmed candidate is a whole PagedDecodeEngine (model +
+    params + its own device-side page pool), not a ``translate_lines``
+    closure. Callable so ``warm_executor``'s golden smoke drives the
+    engine's real install/step jits off the serving path; ``.engine`` is
+    what the quiesce protocol re-points the scheduler at
+    (SwapController._repoint)."""
+
+    def __init__(self, engine: PagedDecodeEngine):
+        self.engine = engine
+
+    def __call__(self, lines: List[str]) -> List[str]:
+        return self.engine.decode_texts(lines)

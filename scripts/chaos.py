@@ -58,6 +58,13 @@ KILLABLE = [
 # (lifecycle.rollback is drilled in-process by tests/test_lifecycle.py —
 # a healthy swap never crosses it, so a kill there would never land here)
 KILLABLE_SWAP = ["lifecycle.watch", "lifecycle.warmup", "lifecycle.swap"]
+# --swap --iteration (ISSUE 11): the same schedule against a server in
+# --batching-mode iteration with a DELIBERATELY tiny KV pool (so the
+# armed point is crossed under pool-exhaustion pressure), plus the
+# kill-mid-quiesce point — the process dies after the drain/evict pass,
+# before the engine re-point. The restart check additionally asserts
+# zero leaked pool pages and zero audit failures.
+KILLABLE_ITER = KILLABLE_SWAP + ["serving.quiesce"]
 
 LINES = ["a b c d", "b c d e", "c d e f", "d e f g",
          "e f g a", "f g a b", "g a b c", "a c e g"] * 2
@@ -311,7 +318,8 @@ def _wait_ready(proc: "subprocess.Popen", metrics_port: int,
 
 
 def _start_server(d: str, port: int, metrics_port: int,
-                  faults: str = "") -> "subprocess.Popen":
+                  faults: str = "",
+                  iteration: bool = False) -> "subprocess.Popen":
     cfg = {
         "models": [os.path.join(d, "m.npz")],
         "vocabs": [os.path.join(d, "v.yml"), os.path.join(d, "v.yml")],
@@ -320,6 +328,14 @@ def _start_server(d: str, port: int, metrics_port: int,
         "port": port, "metrics-port": metrics_port,
         "model-watch": 0.2, "quiet": True,
     }
+    if iteration:
+        # tiny pool on purpose: ~2 rows' worth of pages for the tiny
+        # model (2 KiB/page at dim-emb 16 / heads 2 / depth 1 / page 16)
+        # so the armed kill point is crossed while admission is
+        # pool-bound — the pool-exhaust half of the schedule
+        cfg.update({"batching-mode": "iteration", "iteration-rows": 4,
+                    "kv-pool-bytes": 2 * 2048,
+                    "quiesce-deadline": 1.0})
     cfg_path = os.path.join(d, "server.json")
     with open(cfg_path, "w") as fh:
         json.dump(cfg, fh)
@@ -345,14 +361,50 @@ def _stop_server(proc: "subprocess.Popen") -> None:
         proc.stderr.close()
 
 
-def swap_round(r: int, point: str, workdir: str) -> list:
+def _scrape_gauges(metrics_port: int) -> dict:
+    """name -> summed value from /metrics (labels collapsed)."""
+    code, body = _http_get(metrics_port, "/metrics")
+    out: dict = {}
+    if code != 200:
+        return out
+    for raw in body.decode("utf-8", "replace").splitlines():
+        if not raw or raw.startswith("#"):
+            continue
+        try:
+            key, val = raw.rsplit(" ", 1)
+            name = key.split("{", 1)[0]
+            out[name] = out.get(name, 0.0) + float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _pool_clean(metrics_port: int) -> list:
+    """Iteration mode: zero leaked pages + zero audit failures after
+    the server went idle (the ISSUE 11 restart contract)."""
+    g = _scrape_gauges(metrics_port)
+    bad = []
+    pages = g.get("marian_serving_kv_pool_pages")
+    free = g.get("marian_serving_kv_pool_pages_free")
+    if pages is None or free is None:
+        bad.append("pool gauges missing from /metrics")
+    elif free != pages:
+        bad.append(f"pool leaked pages after restart: {free:.0f} free "
+                   f"of {pages:.0f}")
+    if g.get("marian_serving_pool_audit_failures_total", 0.0) > 0:
+        bad.append("pool audit failures recorded after restart")
+    return bad
+
+
+def swap_round(r: int, point: str, workdir: str,
+               iteration: bool = False) -> list:
     """One --swap round; returns a list of violation strings."""
     d = os.path.join(workdir, f"swap{r:02d}")
     shutil.rmtree(d, ignore_errors=True)
     os.makedirs(d)
     mp = os.path.join(d, "m.npz")
     spec = f"{point}=kill@1"
-    print(f"  [{r:02d}] {spec}")
+    print(f"  [{r:02d}] {spec}{' (iteration)' if iteration else ''}")
 
     proc = _run_snippet(_MAKE_MODEL_SNIPPET, d)
     if proc.returncode != 0:
@@ -362,8 +414,10 @@ def swap_round(r: int, point: str, workdir: str) -> list:
         return [f"base bundle commit failed: {proc.stderr.strip()[-300:]}"]
 
     port, metrics_port = _free_port(), _free_port()
-    server = _start_server(d, port, metrics_port, faults=spec)
+    server = _start_server(d, port, metrics_port, faults=spec,
+                           iteration=iteration)
     violations = []
+    pressure = []
     try:
         if not _wait_ready(server, metrics_port):
             return [f"armed server never became ready "
@@ -374,6 +428,24 @@ def swap_round(r: int, point: str, workdir: str) -> list:
             reply = f"!!connection error: {e}"
         if reply.startswith("!!"):
             violations.append(f"pre-swap request failed: {reply[:80]}")
+        if iteration:
+            # pool-exhaust pressure: background long requests keep the
+            # tiny pool near exhaustion while the armed point is
+            # crossed, so the kill lands with rows mid-decode and pages
+            # claimed (the state the restart contract is about)
+            import threading
+
+            def _bg(i: int) -> None:
+                try:
+                    _tcp_request(port, " ".join(f"w{(i + j) % 20}"
+                                                for j in range(12)),
+                                 timeout=120)
+                except OSError:
+                    pass        # expected: the server dies under us
+            pressure = [threading.Thread(target=_bg, args=(i,),
+                                         daemon=True) for i in range(3)]
+            for t in pressure:
+                t.start()
         # commit bundle 2: the watcher ingests it and crosses the armed
         # lifecycle point — the server must die there (exit 117)
         proc = _run_snippet(_COMMIT_SNIPPET, mp)
@@ -392,12 +464,14 @@ def swap_round(r: int, point: str, workdir: str) -> list:
         print(f"      kill run exit {rc}")
     finally:
         _stop_server(server)
+        for t in pressure:
+            t.join(timeout=5)
 
     violations += [f"torn bundle after mid-swap kill: {b}"
                    for b in validate_bundles(mp)]
 
     # clean restart: must come up ready on the newest committed bundle
-    server = _start_server(d, port, metrics_port)
+    server = _start_server(d, port, metrics_port, iteration=iteration)
     try:
         if not _wait_ready(server, metrics_port):
             violations.append(f"restart never became ready "
@@ -410,6 +484,8 @@ def swap_round(r: int, point: str, workdir: str) -> list:
             if reply.startswith("!!") or not reply.strip():
                 violations.append(f"post-restart request failed: "
                                   f"{reply[:80]!r}")
+            if iteration:
+                violations += _pool_clean(metrics_port)
             code, body = _http_get(metrics_port, "/lifecyclez")
             if code != 200:
                 violations.append(f"/lifecyclez returned {code}")
@@ -433,11 +509,14 @@ def swap_round(r: int, point: str, workdir: str) -> list:
 def swap_main(args) -> int:
     rng = random.Random(args.seed)
     os.makedirs(args.workdir, exist_ok=True)
-    print(f"chaos --swap: seed {args.seed}, {args.rounds} rounds")
+    mode = "--swap --iteration" if args.iteration else "--swap"
+    print(f"chaos {mode}: seed {args.seed}, {args.rounds} rounds")
     failures = 0
     for r in range(args.rounds):
-        point = rng.choice(KILLABLE_SWAP)
-        violations = swap_round(r, point, args.workdir)
+        point = rng.choice(KILLABLE_ITER if args.iteration
+                           else KILLABLE_SWAP)
+        violations = swap_round(r, point, args.workdir,
+                                iteration=args.iteration)
         if violations:
             failures += 1
             for v in violations:
@@ -446,8 +525,9 @@ def swap_main(args) -> int:
                 break
         else:
             print("      ok: killed mid-swap, never torn, restarted on "
-                  "the newest bundle")
-    print(f"chaos --swap: {failures} failing round(s) out of "
+                  "the newest bundle"
+                  + (", pool clean" if args.iteration else ""))
+    print(f"chaos {mode}: {failures} failing round(s) out of "
           f"{args.rounds} (seed {args.seed})")
     return 1 if failures else 0
 
@@ -462,7 +542,16 @@ def main(argv=None) -> int:
     ap.add_argument("--swap", action="store_true",
                     help="serving-side schedule: kill a marian-server at "
                          "randomized lifecycle points mid-hot-swap")
+    ap.add_argument("--iteration", action="store_true",
+                    help="with --swap: run the server in --batching-mode "
+                         "iteration with a deliberately tiny KV pool and "
+                         "background traffic, adding the kill-mid-quiesce "
+                         "point (serving.quiesce) — the restart check "
+                         "also asserts zero leaked pool pages and zero "
+                         "audit failures (ISSUE 11)")
     args = ap.parse_args(argv)
+    if args.iteration and not args.swap:
+        ap.error("--iteration requires --swap")
     if args.swap:
         return swap_main(args)
 

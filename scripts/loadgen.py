@@ -47,6 +47,14 @@ server's chip-seconds/token delta (``marian_perf_*`` integrals) and the
 and a server running with ``--perf-accounting`` (the default);
 docs/DEPLOYMENT.md "Capacity & autoscaling" interprets the table.
 
+Retries (``--retries N``, ISSUE 11, default 0 = old behavior): a
+``!!SERVER-RETRY`` reply (watchdog trip, quiesce-deadline or brownout
+row eviction) is resent with capped jittered exponential backoff;
+retry/evicted counts are reported per stream window and in the summary.
+``--priority N`` sends every request in that lane via the
+``#priority:N`` header (brownout level 3 sheds lanes below the server's
+``--brownout-min-priority`` first).
+
 Request tracing (ISSUE 8, default ON — ``--no-trace`` to disable): each
 request carries a ``#trace:<id>`` header; the server's reply metadata
 splits latency into queue wait vs device service per request, reported
@@ -84,6 +92,39 @@ TRACE_PREFIX = "#trace:"
 
 def make_trace_id(i: int) -> str:
     return f"lg{os.getpid() % 100000:05d}{i:06d}{random.getrandbits(24):06x}"
+
+
+PRIORITY_PREFIX = "#priority:"
+
+RETRY_CAP_S = 2.0       # backoff ceiling per attempt
+
+
+def retry_backoff_s(attempt: int, base_s: float = 0.1,
+                    jitter=random.random) -> float:
+    """Capped, jittered exponential backoff for attempt N (0-based):
+    base * 2^N, capped at RETRY_CAP_S, scaled by a uniform [0.5, 1.5)
+    jitter so a fleet of retrying clients doesn't stampede the replica
+    that just evicted them."""
+    return min(RETRY_CAP_S, base_s * (2 ** attempt)) * (0.5 + jitter())
+
+
+async def send_with_retries(request_fn, host: str, port: int, text: str,
+                            retries: int, base_s: float = 0.1):
+    """Send one request, honoring the server's retriable ``!!SERVER-
+    RETRY`` reply (watchdog trip, quiesce-deadline or brownout row
+    eviction — ISSUE 11) with capped jittered backoff. Returns
+    ``(final_reply, n_retries)`` where n_retries counts the RETRY
+    replies received (== resends attempted when the budget allows);
+    with ``retries=0`` (the default) behavior is exactly the old
+    single-shot send."""
+    n_retries = 0
+    while True:
+        reply = await request_fn(host, port, text)
+        _, body = split_reply_meta(reply)
+        if not body.startswith("!!SERVER-RETRY") or n_retries >= retries:
+            return reply, n_retries
+        await asyncio.sleep(retry_backoff_s(n_retries, base_s))
+        n_retries += 1
 
 
 def split_reply_meta(reply: str):
@@ -197,25 +238,33 @@ def make_sentence(client: int, req: int, sent: int, words: int) -> str:
                     for w in range(words))
 
 
+def _apply_headers(args, text: str, i: int) -> str:
+    """Stack the protocol headers this run asked for: #trace outermost
+    (the server strips it first), then #priority."""
+    if getattr(args, "priority", None) is not None:
+        text = f"{PRIORITY_PREFIX}{args.priority}\n" + text
+    if not args.no_trace:
+        text = TRACE_PREFIX + make_trace_id(i) + "\n" + text
+    return text
+
+
 async def run_clients(args, request_fn):
     latencies: list = []
     queue_waits: list = []
     service_times: list = []
     errors = {"overloaded": 0, "timeout": 0, "other": 0}
-    trace = not args.no_trace
 
     async def one_client(cid: int):
         for r in range(args.requests):
             text = "\n".join(
                 make_sentence(cid, r, s, args.words)
                 for s in range(args.sentences))
-            if trace:
-                text = (TRACE_PREFIX
-                        + make_trace_id(cid * args.requests + r)
-                        + "\n" + text)
+            text = _apply_headers(args, text, cid * args.requests + r)
             t0 = time.perf_counter()
             try:
-                reply = await request_fn(args.host, args.port, text)
+                reply, _ = await send_with_retries(
+                    request_fn, args.host, args.port, text,
+                    args.retries, args.retry_base_ms / 1e3)
             except Exception as e:  # noqa: BLE001
                 errors["other"] += 1
                 print(f"client {cid} req {r}: {e}", file=sys.stderr)
@@ -226,6 +275,10 @@ async def run_clients(args, request_fn):
                 errors["overloaded"] += 1
             elif reply.startswith("!!SERVER-TIMEOUT"):
                 errors["timeout"] += 1
+            elif reply.startswith("!!SERVER-RETRY"):
+                # --retries budget exhausted: a failed request, not a
+                # latency sample (run_stream's 'retry' kind, mirrored)
+                errors["other"] += 1
             else:
                 latencies.append(dt)
                 if meta and "queue_s" in meta:
@@ -259,7 +312,6 @@ async def run_stream(args, request_fn, rate=None, duration=None):
     header line would be translated as an extra sentence; pass
     --no-trace there."""
     results: list = []
-    trace = not args.no_trace
     rate = args.rate if rate is None else rate
     duration = args.duration if duration is None else duration
 
@@ -269,15 +321,20 @@ async def run_stream(args, request_fn, rate=None, duration=None):
         words = mixed_words(i, args.words, len_mix)
         text = "\n".join(make_sentence(i, i >> 3, s, words)
                          for s in range(args.sentences))
-        if trace:
-            text = TRACE_PREFIX + make_trace_id(i) + "\n" + text
+        text = _apply_headers(args, text, i)
         rel = time.perf_counter() - t0
         t = time.perf_counter()
         try:
-            reply = await request_fn(args.host, args.port, text)
+            # --retries: a retriable eviction (!!SERVER-RETRY — quiesce
+            # deadline, brownout, watchdog) is resent with capped
+            # jittered backoff; the measured latency is the CLIENT-
+            # VISIBLE one, backoff included
+            reply, n_retries = await send_with_retries(
+                request_fn, args.host, args.port, text,
+                args.retries, args.retry_base_ms / 1e3)
         except Exception as e:  # noqa: BLE001
             results.append((rel, time.perf_counter() - t, "other",
-                            None, None))
+                            None, None, 0))
             if args.verbose:
                 print(f"req {i}: {e}", file=sys.stderr)
             return
@@ -288,12 +345,13 @@ async def run_stream(args, request_fn, rate=None, duration=None):
         elif reply.startswith("!!SERVER-TIMEOUT"):
             kind = "timeout"
         elif reply.startswith("!!SERVER-RETRY"):
-            kind = "retry"
+            kind = "retry"          # retriable but budget exhausted
         else:
             kind = "ok"
         results.append((rel, dt, kind,
                         meta.get("queue_s") if meta else None,
-                        meta.get("service_s") if meta else None))
+                        meta.get("service_s") if meta else None,
+                        n_retries))
 
     t0 = time.perf_counter()
     tasks = []
@@ -417,8 +475,16 @@ def report_windows(results, window_s: float) -> None:
     last = max(r[0] for r in results)
     n_windows = int(last // window_s) + 1
     have_meta = any(r[3] is not None for r in results)
+    # retry column (ISSUE 11): !!SERVER-RETRY replies received per
+    # window — the client-visible count of evict-with-retry events
+    # (quiesce deadline, brownout, watchdog) plus any that exhausted
+    # the --retries budget
+    have_retries = any(len(r) > 5 and (r[5] or r[2] == "retry")
+                       for r in results)
     hdr = (f"{'window':>12} {'req':>5} {'ok':>5} {'shed':>5} {'err':>5} "
            f"{'p50_ms':>8} {'p99_ms':>8} {'max_ms':>8}")
+    if have_retries:
+        hdr += f" {'retry':>6}"
     if have_meta:
         hdr += f" {'q_p50':>7} {'q_p99':>7} {'svc_p50':>7} {'svc_p99':>7}"
     print(hdr)
@@ -444,6 +510,10 @@ def report_windows(results, window_s: float) -> None:
                 f"{pct(lat, 0.50) * 1e3:>8.1f} "
                 f"{pct(lat, 0.99) * 1e3:>8.1f} "
                 f"{max(lat) * 1e3 if lat else float('nan'):>8.1f}")
+        if have_retries:
+            n_retry = sum((r[5] if len(r) > 5 else 0)
+                          + (1 if r[2] == "retry" else 0) for r in rows)
+            line += f" {n_retry:>6}"
         if have_meta:
             qs = [r[3] for r in rows if r[2] == "ok" and r[3] is not None]
             ss = [r[4] for r in rows if r[2] == "ok" and r[4] is not None]
@@ -498,6 +568,23 @@ def main(argv=None) -> int:
                          "chip-seconds/token delta and the capacity "
                          "headroom gauge. Requires --metrics-port and "
                          "a server running with --perf-accounting")
+    ap.add_argument("--retries", type=int, default=0,
+                    help="resend a request up to N times when the "
+                         "server replies !!SERVER-RETRY (retriable row "
+                         "eviction: quiesce deadline, brownout, "
+                         "watchdog trip), with capped jittered "
+                         "exponential backoff. 0 (default) keeps the "
+                         "old single-shot behavior; retry/evicted "
+                         "counts are reported per stream window")
+    ap.add_argument("--retry-base-ms", type=float, default=100.0,
+                    help="base backoff before the first retry "
+                         "(doubles per attempt, capped at 2s, jittered "
+                         "x[0.5,1.5))")
+    ap.add_argument("--priority", type=int, default=None,
+                    help="send every request in this priority lane via "
+                         "the '#priority:N' protocol header (this "
+                         "repo's server; brownout level 3 sheds lanes "
+                         "below --brownout-min-priority first)")
     ap.add_argument("--verbose", action="store_true",
                     help="print per-request transport errors")
     ap.add_argument("--no-trace", action="store_true",
@@ -557,6 +644,14 @@ def main(argv=None) -> int:
               f"rate={args.rate}/s sentences/request={args.sentences}")
         print(f"ok={n_ok} shed={errors['overloaded']} "
               f"timeout={errors['timeout']} other_errors={errors['other']}")
+        retried = sum(r[5] for r in results if len(r) > 5)
+        if retried or any(r[2] == "retry" for r in results):
+            retried_ok = sum(1 for r in results
+                             if len(r) > 5 and r[5] and r[2] == "ok")
+            exhausted = sum(1 for r in results if r[2] == "retry")
+            print(f"retries: {retried} resends after !!SERVER-RETRY "
+                  f"(evictions), {retried_ok} requests ok after retry, "
+                  f"{exhausted} exhausted the --retries budget")
         report_windows(results, args.window)
         if before or after:
             swaps = _delta(before, after, "marian_lifecycle_swaps_total")

@@ -20,6 +20,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # here, before the first marian_tpu import below.
 os.environ.setdefault("MARIAN_LOCKDEP", "1")
 
+# Continuous KV-pool invariant auditing (ISSUE 11): every iteration-mode
+# admit+step round in the suite ends with a full free-list / page-table /
+# position audit — a pool bug fails tier-1 loudly at the round that
+# introduced it, not at some later quiesce boundary. Read at engine
+# construction time (translator/iteration.py), so module-level here.
+os.environ.setdefault("MARIAN_POOL_AUDIT", "1")
+
 from marian_tpu.common.hermetic import force_cpu_devices  # noqa: E402
 
 jax = force_cpu_devices(8)

@@ -1,0 +1,774 @@
+"""Quiesce-based lifecycle + brownout degradation for paged iteration
+serving (ISSUE 11): the scheduler's quiesce protocol (stop joins, drain
+under --quiesce-deadline, evict-with-retry, re-point the engine at a
+step boundary), SwapController composition in iteration mode
+(swap-under-load, temporal canary, auto-rollback), the brownout ladder
+(signal-driven degradation levels), the KV-pool invariant auditor with
+its corruption drills, the watchdog-trip mid-round contract, and the
+loadgen retry satellite. Runs under JAX_PLATFORMS=cpu with the tiny
+real transformer (MARIAN_POOL_AUDIT=1 is armed process-wide by
+conftest, so every engine round here is audited)."""
+
+import asyncio
+import importlib.util
+import os
+import threading
+
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common import faultpoints as fp
+from marian_tpu.ops.pallas.kv_pool import KVPool, PoolCorruption
+from marian_tpu.serving import metrics as msm
+from marian_tpu.serving.admission import AdmissionController, Overloaded
+from marian_tpu.serving.brownout import BrownoutController
+from marian_tpu.serving.lifecycle import LIVE, SwapController
+from marian_tpu.serving.scheduler import (ContinuousScheduler,
+                                          DispatchStalled, RowEvicted)
+from marian_tpu.training import bundle as bdl
+from marian_tpu.translator.iteration import (EngineExecutor,
+                                             PagedDecodeEngine)
+
+from tests.test_beam_search import tiny_model
+from tests.test_iteration import TEXTS, make_engine, tiny  # noqa: F401
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one page of the tiny engine (page_len 4): 2 (K+V) x dec_depth 2 x
+# heads 2 x page_len 4 x dh 8 x 4 bytes
+PAGE_BYTES = 2 * 2 * 2 * 4 * 8 * 4
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockdep_witness(lockdep_witness):
+    """Quiesce/brownout cross the watcher, loop, worker, brownout and
+    metrics threads; the shared witness asserts every observed lock
+    acquisition order stays inside the static lattice."""
+    yield
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_sched(tiny, registry=None, engine=None, **kw):
+    reg = registry if registry is not None else msm.Registry()
+    eng = engine if engine is not None else make_engine(tiny,
+                                                        registry=reg)
+    sched = ContinuousScheduler(None, registry=reg,
+                                batching_mode="iteration", engine=eng,
+                                window_s=0.0, **kw)
+    return sched, eng, reg
+
+
+def solo_outputs(tiny, texts):
+    return [make_engine(tiny, max_rows=1).decode_texts([t])[0]
+            for t in texts]
+
+
+async def wait_for(pred, timeout=20.0, interval=0.01):
+    loop = asyncio.get_event_loop()
+    dl = loop.time() + timeout
+    while not pred():
+        if loop.time() >= dl:
+            return False
+        await asyncio.sleep(interval)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the pool invariant auditor (tentpole piece 3)
+# ---------------------------------------------------------------------------
+
+class TestPoolAuditor:
+    def test_kvpool_audit_clean_and_violations(self):
+        p = KVPool(8, 4)
+        assert p.audit() == []
+        p.claim("a", 2)
+        p.claim("b", 3)
+        assert p.audit() == []
+        p.release("a")
+        assert p.audit() == []
+        # leak: drop a claim without returning its pages
+        p._claims.pop("b")
+        bad = p.audit()
+        assert bad and "leaked" in bad[0]
+        # double-free: a page both free and claimed
+        p2 = KVPool(8, 4)
+        pages = p2.claim("a", 2)
+        p2._free.extend(reversed(pages))
+        bad = p2.audit()
+        assert any("double-free" in v for v in bad)
+
+    def test_engine_audit_clean_through_decode(self, tiny):
+        eng = make_engine(tiny)
+        assert eng.audit(context="test") == []
+        eng.admit_and_step([(0, TEXTS[0]), (1, TEXTS[1])])
+        assert eng.audit(context="test") == []
+        guard = 0
+        while not eng.idle():
+            eng.admit_and_step([])
+            guard += 1
+            assert guard < 100
+        assert eng.audit(context="test") == []
+        assert eng.pool.free_pages() == eng.pool.usable_pages
+
+    def test_double_free_drill_detected(self, tiny):
+        """The pool.double_free catalog point corrupts REAL pool state;
+        the continuous audit (MARIAN_POOL_AUDIT=1) must catch it and
+        fail the round with the retriable PoolCorruption."""
+        reg = msm.Registry()
+        eng = make_engine(tiny, registry=reg)
+        eng.admit_and_step([(0, TEXTS[0])])       # an active row to corrupt
+        with fp.active("pool.double_free=fail@1"):
+            with pytest.raises(PoolCorruption, match="audit failed"):
+                eng.admit_and_step([])
+        assert PoolCorruption.retriable
+        assert reg.get(
+            "marian_serving_pool_audit_failures_total").value >= 1
+        assert reg.get("marian_serving_pool_audits_total").value >= 1
+
+    def test_table_corrupt_drill_detected(self, tiny):
+        eng = make_engine(tiny)
+        eng.admit_and_step([(0, TEXTS[0])])
+        with fp.active("pool.table_corrupt=fail@1"):
+            with pytest.raises(PoolCorruption,
+                               match="table corruption"):
+                eng.admit_and_step([])
+
+    def test_row_exit_leak_detector(self, tiny, monkeypatch):
+        """The always-on leak check at row exit: a release that returns
+        the wrong page count is reported even without MARIAN_POOL_AUDIT."""
+        reg = msm.Registry()
+        eng = make_engine(tiny, registry=reg)
+        eng.admit_and_step([(0, TEXTS[0])])
+        real_release = eng.pool.release
+        monkeypatch.setattr(eng.pool, "release",
+                            lambda key: real_release(key) - 1)
+        eng._evict(0)
+        assert reg.get(
+            "marian_serving_pool_audit_failures_total").value >= 1
+
+    def test_fatal_reject_names_page_requirement(self, tiny):
+        """ISSUE 11 satellite: the never-fitting FATAL reject must
+        report the computed page requirement vs the pool's capacity —
+        operator-actionable, not opaque."""
+        eng = make_engine(tiny, pool_bytes=1 * PAGE_BYTES)
+        assert eng.pool.usable_pages == 1
+        res = eng.admit_and_step([(0, TEXTS[0])])   # cap 12 -> 3 pages
+        assert res.rejected[0][1] == "too_large"
+        detail = res.reject_detail[0]
+        assert "3 KV" in detail and "1 allocatable" in detail
+        assert "--kv-pool-bytes" in detail
+
+    def test_fatal_reject_detail_reaches_the_client(self, tiny):
+        eng = make_engine(tiny, pool_bytes=1 * PAGE_BYTES)
+        sched, eng, reg = make_sched(tiny, engine=eng)
+
+        async def main():
+            sched.start()
+            f = sched.submit([TEXTS[0]])
+            with pytest.raises(RuntimeError,
+                               match=r"cannot be admitted.*3 KV"):
+                await asyncio.wait_for(f, timeout=20)
+            await sched.stop()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# the quiesce protocol (tentpole piece 1, scheduler level)
+# ---------------------------------------------------------------------------
+
+class TestQuiesce:
+    def test_drain_then_install_swaps_engine(self, tiny):
+        """A quiesce with a generous deadline drains every active row
+        on the OLD engine (zero client-visible failures), audits it
+        clean, installs the new engine at an empty-join-set boundary,
+        and resumes joins on the new engine."""
+        sched, eng_a, reg = make_sched(tiny)
+        eng_b = make_engine(tiny)
+        holder = {}
+
+        async def main():
+            sched.start()
+            f1 = sched.submit(TEXTS[:2])
+            await asyncio.sleep(0.05)
+            op = sched.request_quiesce(
+                lambda: sched.install_engine(eng_b), 30.0,
+                "test-swap", wait=False)
+            holder["r1"] = await f1
+            assert await wait_for(op.event.is_set)
+            holder["op"] = op
+            f2 = sched.submit([TEXTS[2]])
+            holder["r2"] = await f2
+            await sched.stop()
+
+        run(main())
+        op = holder["op"]
+        assert op.ok and op.install_ok and op.evicted == 0
+        assert sched.engine is eng_b
+        solo = solo_outputs(tiny, TEXTS[:3])
+        assert holder["r1"] == solo[:2]          # drained on the old engine
+        assert holder["r2"] == [solo[2]]         # served by the new engine
+        # the old engine exited with zero leaked pages
+        assert eng_a.pool.free_pages() == eng_a.pool.usable_pages
+        assert sched.m_quiesces.value == 1
+        assert sched.m_quiesce_evictions.value == 0
+        text = reg.render()
+        assert "marian_serving_quiesces_total 1" in text
+        assert "marian_serving_quiescing 0" in text
+
+    def test_deadline_evicts_with_retry_and_frees_pages(self, tiny):
+        """Rows past --quiesce-deadline are evicted with the retriable
+        RowEvicted (!!SERVER-RETRY), their pages freed; the install
+        still happens and the resend succeeds on the new engine."""
+        sched, eng_a, reg = make_sched(tiny)
+        eng_b = make_engine(tiny)
+        holder = {}
+
+        async def main():
+            sched.start()
+            f1 = sched.submit([TEXTS[4]])
+            # wait until the row actually JOINED (compile included)
+            assert await wait_for(lambda: sched.m_joins.value >= 1)
+            op = sched.request_quiesce(
+                lambda: sched.install_engine(eng_b), 0.0,
+                "test-evict", wait=False)
+            with pytest.raises(RowEvicted, match="quiesce deadline"):
+                await asyncio.wait_for(f1, timeout=20)
+            assert await wait_for(op.event.is_set)
+            holder["op"] = op
+            holder["r2"] = await sched.submit([TEXTS[4]])
+            await sched.stop()
+
+        run(main())
+        assert holder["op"].evicted >= 1
+        assert holder["op"].install_ok
+        assert sched.engine is eng_b
+        assert RowEvicted.retriable
+        assert eng_a.pool.free_pages() == eng_a.pool.usable_pages
+        assert eng_a.audit(context="test") == []
+        assert sched.m_quiesce_evictions.value >= 1
+        assert holder["r2"] == solo_outputs(tiny, [TEXTS[4]])
+        # the evicted request resolved with the 'evicted' outcome label
+        out = reg.get("marian_serving_request_outcomes_total")
+        assert any(k[0] == "evicted" and c.value >= 1
+                   for k, c in out.children().items())
+
+    def test_kill_mid_quiesce_faultpoint_recovers(self, tiny):
+        """serving.quiesce sits at the quiesce boundary; a 'fail' there
+        aborts ONE completion attempt (supervision recovers and the
+        next round finishes the quiesce). kill mode is the chaos
+        schedule's kill-mid-quiesce drill (scripts/chaos.py
+        --iteration)."""
+        sched, eng_a, reg = make_sched(tiny)
+        eng_b = make_engine(tiny)
+
+        async def main():
+            sched.start()
+            with fp.active("serving.quiesce=fail@1"):
+                op = sched.request_quiesce(
+                    lambda: sched.install_engine(eng_b), 5.0,
+                    "test-kill", wait=False)
+                assert await wait_for(op.event.is_set)
+                assert fp.hits("serving.quiesce") >= 2
+            await sched.stop()
+            return op
+
+        op = run(main())
+        assert op.ok and sched.engine is eng_b
+
+    def test_cancelled_quiesce_never_installs(self, tiny):
+        """A waiter that gives up withdraws its op (cancel_quiesce —
+        request_quiesce does this on wait-budget expiry): the install
+        must never run late against a possibly-released target; joins
+        resume on the old engine."""
+        sched, eng_a, reg = make_sched(tiny)
+        eng_b = make_engine(tiny)
+
+        async def main():
+            sched.start()
+            op = sched.request_quiesce(
+                lambda: sched.install_engine(eng_b), 30.0,
+                "withdrawn", wait=False)
+            sched.cancel_quiesce(op)
+            r = await asyncio.wait_for(sched.submit([TEXTS[1]]),
+                                       timeout=30)
+            assert await wait_for(op.event.is_set)
+            await sched.stop()
+            return r
+
+        r = run(main())
+        assert sched.engine is eng_a       # install never ran
+        assert r == solo_outputs(tiny, [TEXTS[1]])
+        assert sched.m_quiesces.value == 0
+
+    def test_stop_releases_pending_quiesce_waiters(self, tiny):
+        sched, eng_a, reg = make_sched(tiny)
+
+        async def main():
+            sched.start()
+            await sched.stop()
+            # worker gone: a pending op must still release its waiter
+            op = sched.request_quiesce(lambda: None, 0.1, "dangling",
+                                       wait=False)
+            await sched.stop()
+            return op
+
+        op = run(main())
+        assert op.event.is_set() and not op.ok
+
+
+# ---------------------------------------------------------------------------
+# SwapController x PagedDecodeEngine composition (tentpole piece 1)
+# ---------------------------------------------------------------------------
+
+def commit_bundle(model_path, tag="x", member="m.npz"):
+    def write(p):
+        with open(p, "w", encoding="utf-8") as fh:
+            fh.write(tag)
+    return bdl.write_bundle(str(model_path), {member: write})
+
+
+def make_iter_controller(tiny, sched, reg, built=None, **kw):
+    """SwapController wired for iteration mode over real tiny engines:
+    the factory builds a fresh engine per bundle (content ignored — the
+    quiesce/health machinery under test is model-agnostic)."""
+    def factory(bundle_dir, manifest):
+        ex = EngineExecutor(make_engine(tiny))
+        if built is not None:
+            built.append(ex)
+        return ex
+
+    ctrl = SwapController(factory, metrics_registry=reg,
+                          golden=["w1 w2"], **kw)
+    ctrl.seed_live(0, "boot", EngineExecutor(sched.engine))
+    ctrl.attach_iteration(sched, quiesce_deadline=20.0)
+    sched.version_fn = ctrl.live_version_name
+    return ctrl
+
+
+def ingest_in_thread(ctrl, bdir):
+    manifest = bdl.validate_bundle(bdir)[2]
+    t = threading.Thread(target=ctrl.ingest, args=(bdir, manifest),
+                         daemon=True)
+    t.start()
+    return t
+
+
+class TestLifecycleIteration:
+    def test_swap_under_load_zero_failures(self, tiny, tmp_path):
+        """The acceptance shape in miniature: requests decoding while a
+        bundle is ingested on the watcher thread; the swap quiesces at
+        a step boundary, every in-flight request completes (deadline is
+        generous — zero evictions), the live version flips, and the old
+        engine exits audit-clean with zero leaked pages."""
+        reg = msm.Registry()
+        sched, eng_a, _ = make_sched(tiny, registry=reg)
+        ctrl = make_iter_controller(tiny, sched, reg)
+        mp = str(tmp_path / "m.npz")
+        holder = {}
+
+        async def main():
+            sched.start()
+            futs = [sched.submit([TEXTS[i]]) for i in range(3)]
+            assert await wait_for(lambda: sched.m_joins.value >= 1)
+            t = ingest_in_thread(ctrl, commit_bundle(mp))
+            holder["results"] = await asyncio.gather(
+                *futs, return_exceptions=True)
+            assert await wait_for(lambda: not t.is_alive(), timeout=60)
+            holder["r2"] = await sched.submit([TEXTS[0]])
+            await sched.stop()
+
+        run(main())
+        # zero client-visible failures: every request resolved ok
+        solo = solo_outputs(tiny, TEXTS[:3])
+        assert holder["results"] == [[s] for s in solo]
+        assert holder["r2"] == [solo[0]]
+        assert ctrl.live_version_name() == "bundle-00000001"
+        live = ctrl.live_version()
+        assert live.state == LIVE
+        assert sched.engine is live.executor.engine
+        assert sched.engine is not eng_a
+        # the drained boot engine leaked nothing
+        assert eng_a.pool.free_pages() == eng_a.pool.usable_pages
+        assert eng_a.audit(context="test") == []
+        assert reg.get("marian_lifecycle_swaps_total").value == 1
+        assert sched.m_quiesces.value == 1
+
+    def test_auto_rollback_on_round_failures(self, tiny, tmp_path):
+        """A regressed NEW live engine: rounds fail, victims are
+        evicted RETRIABLY (a warm rollback target exists), the
+        controller's windowed health trips, and dispatch quiesce-rolls
+        back to the previous engine — the resend succeeds there."""
+        reg = msm.Registry()
+        sched, eng_a, _ = make_sched(tiny, registry=reg)
+        built = []
+        ctrl = make_iter_controller(tiny, sched, reg, built=built,
+                                    rollback_min_batches=2)
+        mp = str(tmp_path / "m.npz")
+        holder = {}
+
+        async def main():
+            sched.start()
+            t = ingest_in_thread(ctrl, commit_bundle(mp))
+            assert await wait_for(lambda: not t.is_alive(), timeout=60)
+            assert ctrl.live_version_name() == "bundle-00000001"
+            # break the new live engine: every round now raises
+            bad = built[-1].engine
+
+            def boom(*a, **k):
+                raise RuntimeError("regressed weights")
+            bad.admit_and_step = boom
+            evicted = []
+            for _ in range(3):
+                try:
+                    await asyncio.wait_for(sched.submit([TEXTS[1]]),
+                                           timeout=20)
+                except RowEvicted as e:
+                    evicted.append(e)
+                if ctrl.live_version_name() == "boot":
+                    break
+            assert await wait_for(
+                lambda: ctrl.live_version_name() == "boot"
+                and sched.engine is eng_a, timeout=20)
+            holder["evicted"] = evicted
+            holder["r"] = await asyncio.wait_for(
+                sched.submit([TEXTS[1]]), timeout=30)
+            await sched.stop()
+
+        run(main())
+        assert holder["evicted"]          # retriable, not hard failures
+        assert holder["r"] == solo_outputs(tiny, [TEXTS[1]])
+        assert reg.get("marian_lifecycle_rollbacks_total").value == 1
+
+    def test_temporal_canary_promotes_in_place(self, tiny, tmp_path):
+        """Iteration-mode canary is TEMPORAL: the candidate takes all
+        joins for its evaluation window (one quiesce), healthy rounds
+        promote it in place — no second engine re-point."""
+        reg = msm.Registry()
+        sched, eng_a, _ = make_sched(tiny, registry=reg)
+        built = []
+        ctrl = make_iter_controller(tiny, sched, reg, built=built,
+                                    canary_fraction=0.25,
+                                    canary_min_batches=3)
+        mp = str(tmp_path / "m.npz")
+
+        async def main():
+            sched.start()
+            t = ingest_in_thread(ctrl, commit_bundle(mp))
+            assert await wait_for(lambda: not t.is_alive(), timeout=60)
+            # the canary engine serves ALL joins during evaluation
+            assert sched.engine is built[-1].engine
+            r = await asyncio.wait_for(sched.submit([TEXTS[0]]),
+                                       timeout=30)
+            assert r == solo_outputs(tiny, [TEXTS[0]])
+            # enough healthy rounds ran while decoding: promoted
+            assert await wait_for(
+                lambda: ctrl.live_version_name() == "bundle-00000001",
+                timeout=20)
+            await sched.stop()
+
+        run(main())
+        assert sched.engine is built[-1].engine
+        assert sched.m_quiesces.value == 1       # promote = registry flip only
+        assert reg.get("marian_lifecycle_swaps_total").value == 1
+
+
+# ---------------------------------------------------------------------------
+# the brownout ladder (tentpole piece 2)
+# ---------------------------------------------------------------------------
+
+class TestBrownoutLadder:
+    def test_escalates_holds_and_cools(self):
+        """Unit ladder walk with a fake clock: sustained pressure
+        escalates one rung per hold window; sustained health cools one
+        rung per cool window; every transition applies + counts."""
+        reg = msm.Registry()
+        applied = []
+        hr = [1.0]
+        bc = BrownoutController(apply_fn=applied.append,
+                                headroom_fn=lambda: hr[0],
+                                burn_fn=None, registry=reg,
+                                headroom_floor=0.2, burn_threshold=0.0,
+                                hold_s=10.0, cool_s=20.0,
+                                clock=lambda: 0.0)
+        assert bc.tick(0.0) == 0
+        hr[0] = 0.05
+        assert bc.tick(1.0) == 0          # pressure starts, not held yet
+        assert bc.tick(11.0) == 1         # held 10s -> tighten
+        assert bc.tick(12.0) == 1         # next rung needs its own hold
+        assert bc.tick(21.0) == 2         # -> evict
+        assert bc.tick(31.0) == 3         # -> shed
+        assert bc.tick(41.0) == 3         # max level holds
+        hr[0] = 0.9
+        assert bc.tick(42.0) == 3         # healthy starts
+        assert bc.tick(62.0) == 2         # cooled 20s -> down one
+        assert bc.tick(82.0) == 1
+        assert bc.tick(102.0) == 0
+        assert applied == [1, 2, 3, 2, 1, 0]
+        text = reg.render()
+        assert "marian_brownout_level 0" in text
+        assert 'marian_brownout_transitions_total{direction="up"} 3' \
+            in text
+        assert 'marian_brownout_transitions_total{direction="down"} 3' \
+            in text
+        st = bc.state()
+        assert st["level"] == 0 and st["name"] == "normal"
+
+    def test_burn_signal_escalates(self):
+        burn = [0.0]
+        bc = BrownoutController(apply_fn=lambda lvl: None,
+                                headroom_fn=lambda: 1.0,
+                                burn_fn=lambda: burn[0],
+                                registry=msm.Registry(),
+                                burn_threshold=14.4, hold_s=5.0)
+        assert bc.tick(0.0) == 0
+        burn[0] = 20.0
+        bc.tick(1.0)
+        assert bc.tick(6.5) == 1
+
+    def test_stop_resets_level(self):
+        applied = []
+        bc = BrownoutController(apply_fn=applied.append,
+                                headroom_fn=lambda: 0.0,
+                                registry=msm.Registry(), hold_s=0.0)
+        bc.tick(0.0)
+        bc.tick(1.0)
+        assert bc.level() >= 1
+        bc.stop()
+        assert bc.level() == 0 and applied[-1] == 0
+
+    def test_admission_sheds_low_priority_at_level3(self):
+        reg = msm.Registry()
+        adm = AdmissionController(0, lambda: 0, registry=reg)
+        adm.set_brownout(3, min_priority=1)
+        adm.admit(1, priority=1)          # high lane keeps serving
+        with pytest.raises(Overloaded, match="brownout"):
+            adm.admit(1, priority=0)
+        assert reg.get("marian_serving_shed_total") \
+                  .labels("brownout").value == 1
+        adm.set_brownout(0)
+        adm.admit(1, priority=0)          # ladder off: lane admitted
+
+    def test_cap_scale_applied_at_level1(self, tiny):
+        sched, eng, reg = make_sched(tiny)
+        base = eng.decode_cap(4)
+        sched.set_brownout_level(1, cap_factor=0.5)
+        assert eng.decode_cap(4) < base
+        sched.set_brownout_level(0)
+        assert eng.decode_cap(4) == base
+
+    def test_level2_evicts_low_priority_for_queued_high(self, tiny):
+        """The eviction rung: a low-priority row holding the whole pool
+        is evicted (retriably) so queued high-priority work can join.
+        The victim's decode cap is deliberately deep (48 steps) so its
+        row is reliably still mid-decode when the high lane queues."""
+        eng = make_engine(tiny, pool_bytes=12 * PAGE_BYTES,
+                          max_length_cap=48, max_length_factor=8.0)
+        assert eng.pool.usable_pages == 12        # exactly one 48-cap row
+        sched, eng, reg = make_sched(tiny, engine=eng)
+        holder = {}
+
+        async def main():
+            sched.start()
+            f_low = sched.submit([TEXTS[4]], priority=0)
+            assert await wait_for(lambda: sched.m_joins.value >= 1,
+                                  interval=0.001)
+            sched.set_brownout_level(2)
+            f_high = sched.submit([TEXTS[1]], priority=5)
+            with pytest.raises(RowEvicted, match="brownout"):
+                await asyncio.wait_for(f_low, timeout=20)
+            holder["high"] = await asyncio.wait_for(f_high, timeout=20)
+            await sched.stop()
+
+        run(main())
+        assert holder["high"]
+        assert sched.m_brownout_evictions.value >= 1
+
+
+# ---------------------------------------------------------------------------
+# watchdog trip mid-round (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+class TestWatchdogMidRound:
+    def test_stall_evicts_rows_retriably_and_rebuild_is_clean(self, tiny):
+        """Pins the fate of IN-FLIGHT rows across an engine_factory
+        rebuild (only the rebuild itself was tested before): rows are
+        evicted with a retriable error, the replacement engine starts
+        with a fully free pool and a clean audit, and the next request
+        decodes normally."""
+        rebuilt = []
+
+        def factory():
+            e = make_engine(tiny)
+            rebuilt.append(e)
+            return e
+
+        sched, eng_a, reg = make_sched(tiny, engine_factory=factory)
+        holder = {}
+
+        async def main():
+            sched.start()
+            warm = await sched.submit([TEXTS[0]])   # jits compiled
+            assert warm == solo_outputs(tiny, [TEXTS[0]])
+            # arm the watchdog only past the first-compile round — a
+            # cold jit legitimately exceeds any useful stall timeout
+            # (the victim stays in the warmed row bucket for the same
+            # reason: a NEW bucket would compile, not stall)
+            sched.stall_timeout = 1.0
+            f1 = sched.submit([TEXTS[4]])           # row mid-decode
+            assert await wait_for(lambda: sched.m_joins.value >= 2,
+                                  interval=0.001)
+            fp.activate("serving.translate=hang:8")
+            try:
+                with pytest.raises(DispatchStalled):
+                    await asyncio.wait_for(f1, timeout=20)
+            finally:
+                fp.deactivate()
+            assert rebuilt
+            # the REBUILT engine compiles its jits on first use, which
+            # would legitimately exceed the tight test timeout — disarm
+            # (operators size --dispatch-stall-timeout above worst-case
+            # compile; see docs/ROBUSTNESS.md)
+            sched.stall_timeout = 0.0
+            holder["r2"] = await asyncio.wait_for(
+                sched.submit([TEXTS[1]]), timeout=30)
+            await sched.stop()
+
+        run(main())
+        assert DispatchStalled.retriable
+        new = rebuilt[-1]
+        assert sched.engine is new
+        # the replacement engine: all pages free, audit clean
+        assert new.pool.free_pages() == new.pool.usable_pages
+        assert new.audit(context="test") == []
+        assert holder["r2"] == solo_outputs(tiny, [TEXTS[1]])
+        assert sched.m_watchdog.value == 1
+
+
+# ---------------------------------------------------------------------------
+# loadgen --retries (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "loadgen_quiesce", os.path.join(ROOT, "scripts", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestLoadgenRetry:
+    def test_backoff_is_capped_and_jittered(self):
+        lg = _load_loadgen()
+        # deterministic jitter: base * 2^n, x[0.5, 1.5)
+        assert lg.retry_backoff_s(0, 0.1, jitter=lambda: 0.0) \
+            == pytest.approx(0.05)
+        assert lg.retry_backoff_s(1, 0.1, jitter=lambda: 0.5) \
+            == pytest.approx(0.2)
+        # the cap bounds any attempt index
+        assert lg.retry_backoff_s(10, 0.1, jitter=lambda: 0.5) \
+            == pytest.approx(lg.RETRY_CAP_S)
+
+    def test_send_with_retries_counts_and_succeeds(self):
+        lg = _load_loadgen()
+        replies = ["!!SERVER-RETRY evicted", "!!SERVER-RETRY evicted",
+                   "translated"]
+
+        async def fake(host, port, text):
+            return replies.pop(0)
+
+        reply, n = run(lg.send_with_retries(fake, "h", 0, "t",
+                                            retries=3, base_s=0.001))
+        assert reply == "translated" and n == 2
+
+    def test_send_with_retries_budget_exhausted(self):
+        lg = _load_loadgen()
+
+        async def always_retry(host, port, text):
+            return "#trace:t1 outcome=evicted queue_ms=0.0 " \
+                   "service_ms=0.0 model_version=v\n!!SERVER-RETRY x"
+
+        reply, n = run(lg.send_with_retries(always_retry, "h", 0, "t",
+                                            retries=2, base_s=0.001))
+        # meta header is stripped for the retry decision, preserved in
+        # the final reply; the budget bounds the attempts
+        assert n == 2 and "!!SERVER-RETRY" in reply
+
+    def test_default_is_single_shot(self):
+        lg = _load_loadgen()
+        calls = []
+
+        async def fake(host, port, text):
+            calls.append(text)
+            return "!!SERVER-RETRY x"
+
+        reply, n = run(lg.send_with_retries(fake, "h", 0, "t",
+                                            retries=0))
+        assert len(calls) == 1 and n == 0
+
+
+# ---------------------------------------------------------------------------
+# server surface: priority header, validation, metric census
+# ---------------------------------------------------------------------------
+
+class TestServerSurface:
+    def test_priority_header_parses_and_stacks(self):
+        from marian_tpu.server.server import (split_priority_header,
+                                              split_trace_header)
+        assert split_priority_header("#priority:3\nhello") == (3, "hello")
+        assert split_priority_header("#priority:-1\nx") == (-1, "x")
+        # clamped: a client-controlled int must not mint unbounded lanes
+        assert split_priority_header("#priority:5000\nx") == (9, "x")
+        assert split_priority_header("#priority:-5000\nx") == (-9, "x")
+        assert split_priority_header("hello") == (None, "hello")
+        malformed = "#priority:high\nx"
+        assert split_priority_header(malformed) == (None, malformed)
+        tid, body = split_trace_header("#trace:abc\n#priority:2\nhi")
+        assert tid == "abc"
+        prio, body = split_priority_header(body)
+        assert prio == 2 and body == "hi"
+
+    def test_iteration_composes_with_model_watch(self):
+        """ISSUE 11: --model-watch is no longer refused in iteration
+        mode (the quiesce protocol is what made it composable); the
+        rest of the restricted surface still fails loudly."""
+        from marian_tpu.server.server import ServingApp
+        ServingApp._validate_iteration_options(Options({
+            "batching-mode": "iteration", "beam-size": 1,
+            "model-watch": 1.0}))
+        with pytest.raises(ValueError, match="beam-size"):
+            ServingApp._validate_iteration_options(Options({
+                "batching-mode": "iteration", "beam-size": 2}))
+
+    def test_metric_census(self, tiny):
+        """Every ISSUE 11 series is declared and scrapeable
+        (MT-METRIC-UNTESTED keeps this census honest)."""
+        reg = msm.Registry()
+        make_sched(tiny, registry=reg)
+        BrownoutController(apply_fn=lambda lvl: None, registry=reg)
+        text = reg.render()
+        for name in ("marian_serving_quiesces_total",
+                     "marian_serving_quiesce_evictions_total",
+                     "marian_serving_quiescing",
+                     "marian_serving_brownout_evictions_total",
+                     "marian_serving_pool_audits_total",
+                     "marian_serving_pool_audit_failures_total",
+                     "marian_brownout_level",
+                     "marian_brownout_transitions_total"):
+            assert name in text, name
+
+    def test_sloz_includes_brownout_state(self):
+        from marian_tpu.obs import slo as mslo
+        bc = BrownoutController(apply_fn=lambda lvl: None,
+                                registry=msm.Registry())
+        routes = mslo.slo_routes(lambda: None, lambda: bc)
+        code, body, ctype = routes["/sloz"]("GET", "")
+        assert code == 200 and b'"brownout"' in body \
+            and b'"level": 0' in body
+        # and the always-answers contract without a ladder
+        routes = mslo.slo_routes(lambda: None)
+        code, body, _ = routes["/sloz"]("GET", "")
+        assert code == 200 and b'"enabled": false' in body
