@@ -57,6 +57,15 @@ Env knobs:
                              value > 1 overrides the page length
                              (default 16); rows come from
                              MARIAN_DECBENCH_BATCH like every stage
+  MARIAN_DECBENCH_PAGED_BEAM paged_beam stage (ISSUE 12): copy-on-write
+                             paged beam search (translator/
+                             beam_iteration.py — full pages alias via
+                             refcounts, only partial pages copy on
+                             fork) A/B'd against the dense batched beam
+                             search on IDENTICAL sentences
+                             (dense_beam_sentences_per_sec field); beam
+                             from MARIAN_DECBENCH_BEAM, a bare value
+                             > 1 overrides the page length
   MARIAN_DECBENCH_DEVICES    decode device count (default 1). Pinned to
                              ONE device because (a) the metric is
                              per-chip sent/s and every recorded row is
@@ -364,6 +373,84 @@ def main():
             "step_ops": paged_ops,
             "dense_step_ops": dense_ops,
             "while_body_ops": None,
+            "final_sync_s": final_sync_s,
+        }
+        if final_sync_s > FINAL_SYNC_POISON_S:
+            result["poisoned"] = True
+            result["poisoned_reason"] = (
+                f"final_sync_s {final_sync_s} > {FINAL_SYNC_POISON_S:g}: "
+                f"wedged final sync — round self-poisoned, not "
+                f"trajectory-worthy")
+        print(json.dumps(result))
+        return
+
+    paged_beam_env = os.environ.get("MARIAN_DECBENCH_PAGED_BEAM", "")
+    if paged_beam_env:
+        # paged_beam stage (ISSUE 12): copy-on-write paged beam search
+        # (translator/beam_iteration.py — full pages alias by refcount,
+        # only partial pages copy on fork) A/B'd against the dense
+        # batched beam search on IDENTICAL sentences. "1"/"on" = default
+        # page length; a bare number > 1 overrides it.
+        if sl_gen is not None:
+            print("bench_decode: MARIAN_DECBENCH_PAGED_BEAM ignores the "
+                  "shortlist stage", file=sys.stderr, flush=True)
+        from bench import FINAL_SYNC_POISON_S, retry_compile
+        from marian_tpu.translator.beam_iteration import PagedBeamEngine
+        page_len = (int(paged_beam_env) if paged_beam_env.isdigit()
+                    and int(paged_beam_env) > 1 else 16)
+        n_batches = max(1, n_sents // batch)
+        texts = []
+        for _ in range(n_batches):
+            texts.append([
+                " ".join(f"w{rs.randint(0, dims['vocab'] - 4)}"
+                         for _ in range(max(4, min(
+                             src_len - 1,
+                             int(rng.lognormvariate(3.0, 0.4))))))
+                for _ in range(batch)])
+        engine = PagedBeamEngine(
+            model, params, vocab, vocab, beam_size=beam, normalize=0.6,
+            max_rows=batch * beam, page_len=page_len,
+            src_len_cap=src_len, max_length_cap=max_len)
+        retry_compile(lambda: engine.decode_texts(texts[0]),
+                      "COW paged beam decode")
+        t0 = time.perf_counter()
+        for chunk in texts:
+            engine.decode_texts(chunk)
+        dt_paged = time.perf_counter() - t0
+
+        def dense_batch(chunk):
+            # FIXED width (src_len), like make_batch: per-chunk widths
+            # would mint a fresh jit compile (and a different decode
+            # cap) per novel max length INSIDE the timed dense loop
+            rows = [vocab.encode(t, add_eos=True, inference=True)
+                    for t in chunk]
+            ids = np.zeros((len(rows), src_len), np.int32)
+            mask = np.zeros((len(rows), src_len), np.float32)
+            for i, r in enumerate(rows):
+                ids[i, :len(r)] = r
+                mask[i, :len(r)] = 1.0
+            return jnp.asarray(ids), jnp.asarray(mask)
+        retry_compile(lambda: bs.search(*dense_batch(texts[0])),
+                      "dense beam decode")
+        t0 = time.perf_counter()
+        for chunk in texts:
+            bs.search(*dense_batch(chunk))
+        dt_dense = time.perf_counter() - t0
+        t_sync = time.perf_counter()
+        jax.block_until_ready(jnp.zeros(()))
+        final_sync_s = round(time.perf_counter() - t_sync, 3)
+        sents = batch * len(texts)
+        result = {
+            "metric": "paged_beam_sentences_per_sec",
+            "value": round(sents / dt_paged, 2),
+            "unit": "sent/sec",
+            "vs_baseline": None,
+            "chip": jax.devices()[0].device_kind,
+            "preset": preset,
+            "batch": batch,
+            "beam": beam,
+            "page_len": page_len,
+            "dense_beam_sentences_per_sec": round(sents / dt_dense, 2),
             "final_sync_s": final_sync_s,
         }
         if final_sync_s > FINAL_SYNC_POISON_S:

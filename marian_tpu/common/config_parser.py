@@ -328,12 +328,14 @@ _TRANSLATION = [
     _f("max-queue", int, 512, "marian-server admission control: maximum queued sentences before new requests are shed with an explicit !!SERVER-OVERLOADED reply (0 = unbounded, the reference's behavior) (TPU extension)", "translate"),
     _f("request-timeout", float, 0.0, "marian-server per-request deadline in seconds: expired requests get an explicit !!SERVER-TIMEOUT reply (even while queued) instead of waiting forever (0 = no deadline) (TPU extension)", "translate"),
     _f("batch-token-budget", int, 0, "marian-server continuous batching: token budget per device batch against the bucketed static-shape table (data/batch_generator buckets, so serve-time batches hit warm jit-cache shapes). Counted as real rows x bucketed width — the same --mini-batch-words semantics training uses; the realized device batch can exceed it by the row snap-up to the batch multiple. 0 = derive from mini-batch x bucketed max-length (TPU extension)", "translate"),
-    _f("batching-mode", str, "request", "marian-server batching discipline: 'request' packs whole requests into device batches between decodes (the default continuous token-budget scheduler); 'iteration' moves scheduling INSIDE the decode loop over a paged KV-cache pool — sentences join a RUNNING decode at any step and leave the step they finish, admission prices queue debt in pool pages, and the headroom gauge's queue-pressure units become pages. Iteration mode is a greedy single-model decoder: requires --beam-size 1 and composes with a restricted option surface (validated loudly at boot; docs/DEPLOYMENT.md) (TPU extension)", "translate"),
+    _f("batching-mode", str, "request", "marian-server batching discipline: 'request' packs whole requests into device batches between decodes (the default continuous token-budget scheduler); 'iteration' moves scheduling INSIDE the decode loop over a paged KV-cache pool — sentences join a RUNNING decode at any step and leave the step they finish, admission prices queue debt in pool pages, and the headroom gauge's queue-pressure units become pages. --beam-size 1 decodes greedily; beam > 1 decodes with copy-on-write page sharing across hypotheses (full pages alias via refcounts, only partial pages copy on fork — translator/beam_iteration.py; a sentence occupies beam-size slots). Single model only; composes with a restricted option surface (validated loudly at boot; docs/DEPLOYMENT.md) (TPU extension)", "translate"),
     _f("iteration-rows", int, 32, "With --batching-mode iteration: decode slot count — the maximum concurrently decoding sentences; the per-step compiled shape rounds the OCCUPIED slot prefix up through the row-bucket table, so idle slots cost nothing compiled (TPU extension)", "translate"),
     _f("iteration-steps", int, 1, "With --batching-mode iteration: decode steps per scheduling round, run as one jitted scan. 1 = joins possible at EVERY step (pure iteration-level); >1 amortizes per-step host dispatch on host-bound backends at the cost of up to N-1 steps of join latency and a few self-fed row-steps past each EOS (TPU extension)", "translate"),
     _f("kv-page-len", int, 16, "With --batching-mode iteration: tokens per KV-cache page. Smaller pages waste less pool on short sentences (internal fragmentation <= page_len-1 tokens/row) but grow the page table; see docs/DECODE_ROOFLINE.md r7 for the HBM-line-size trade (TPU extension)", "translate"),
     _f("kv-pool-bytes", int, 0, "With --batching-mode iteration: byte budget for the paged KV pool across all decoder layers (K+V). 0 = size the pool so every slot can hold a full --max-length row (the pool is then never the admission constraint) (TPU extension)", "translate"),
-    _f("max-queue-pages", int, 0, "With --batching-mode iteration: admission bound on queued KV-pool PAGE debt — requests are shed with !!SERVER-OVERLOADED when the queue already owes this many pages (0 = 4x the pool's allocatable pages) (TPU extension)", "translate"),
+    _f("max-queue-pages", int, 0, "With --batching-mode iteration: admission bound on queued KV-pool PAGE debt — requests are shed with !!SERVER-OVERLOADED when the queue already owes this many pages (0 = 4x the pool's allocatable pages). Beam-k requests are priced at the shared-trunk steady-state holding (one trunk + k-1 extra partial pages) — an optimistic estimate, never k-times full replication; fully divergent lineages can transiently hold more, which lazy claims cover with retriable mid-decode eviction when the pool runs dry (TPU extension)", "translate"),
+    _f("prefix-cache", bool, False, "With --batching-mode iteration: cross-request prefix sharing over the paged KV pool. An exact repeat of a source decoding RIGHT NOW joins as a copy-on-write follower (aliases the leader's full KV pages via refcounts, copies only the partial page, skips the encoder); a repeat of a COMPLETED decode replays it instantly, with the finished rows' pages retained by the cache and LRU-evicted under pool pressure. Deterministic decode makes warm output bitwise-identical to cold; marian_prefix_* metrics count hits/tokens saved/pages reused (docs/DEPLOYMENT.md) (TPU extension)", "translate"),
+    _f("prefix-cache-entries", int, 64, "With --prefix-cache: maximum completed decodes retained (LRU); pool pressure can evict below this (TPU extension)", "translate"),
     _f("metrics-port", int, 0, "Serve Prometheus /metrics + /healthz + /readyz on this port (0 = off): queue depth, batch fill ratio, padding waste, time-to-first-batch, end-to-end latency, shed/timeout counts; train/translate emit into the same registry (TPU extension)", "translate"),
     _f("dispatch-stall-timeout", float, 0.0, "marian-server liveness watchdog: if one device batch (translate_lines call) runs longer than this many seconds, fail its requests with an explicit retriable !!SERVER-RETRY reply and move the scheduler onto a fresh device worker instead of wedging the whole serving path behind the stuck call (0 = off; set comfortably above the worst legitimate batch decode time; see docs/ROBUSTNESS.md) (TPU extension)", "translate"),
     _f("quiesce-deadline", float, 2.0, "With --batching-mode iteration and --model-watch: drain budget in seconds for a lifecycle quiesce (swap/canary/rollback). Joins pause and active decode rows drain naturally; rows still decoding at the deadline are evicted with a retriable !!SERVER-RETRY (pages freed, counted in marian_serving_quiesce_evictions_total) so a swap is never held hostage by one long sentence; the engine is re-pointed at a step boundary with an empty join set (docs/ROBUSTNESS.md) (TPU extension)", "translate"),

@@ -103,6 +103,13 @@ CATALOG: Dict[str, str] = {
                           "wrong physical page id into one active row's "
                           "page table so the auditor's table/claim "
                           "cross-check is proven against real corruption",
+    "pool.refcount_corrupt": "detection drill: an armed 'fail' bumps one "
+                             "live page's refcount without a table "
+                             "reference (the lost-decref/phantom-incref "
+                             "bug class of the COW fork/reorder paths) "
+                             "so the auditor's references-vs-refcount "
+                             "cross-check is proven against real "
+                             "corruption",
 }
 
 

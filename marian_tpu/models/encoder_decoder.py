@@ -265,6 +265,16 @@ class EncoderDecoder:
                                          src_mask, n_pages, page_len,
                                          max_pages)
 
+    def fork_paged_rows(self, state, src_mask, src_slots, dst_slots):
+        """Copy a paged decode state's row-indexed leaves (cross-attn
+        K/V) + source-mask rows between slots — the encoder-side half of
+        a COW fork (beam hypothesis spread, prefix-cache follower); the
+        decoder-side half is page-table aliasing in kv_pool.py."""
+        if self._mod is not T:
+            raise ValueError("paged-state forks are implemented for the "
+                             "transformer family")
+        return T.fork_paged_rows(state, src_mask, src_slots, dst_slots)
+
     def step(self, params: Params, state, prev_ids, src_mask,
              shortlist=None, return_alignment: bool = False,
              beam_src=None, fused_decode=None):

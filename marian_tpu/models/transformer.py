@@ -1782,6 +1782,33 @@ def init_paged_decode_state(cfg: TransformerConfig, params: Params,
     return state
 
 
+def fork_paged_rows(state: Dict[str, Any], src_mask: jax.Array,
+                    src_slots: jax.Array, dst_slots: jax.Array
+                    ) -> Tuple[Dict[str, Any], jax.Array]:
+    """Beam-aware paged state fork: copy the ROW-indexed leaves of a
+    paged decode state (per-layer cross-attention K/V — the per-sentence
+    encoder summary) plus the source-mask row from ``src_slots`` to
+    ``dst_slots``. This is how a new hypothesis row (beam fork) or a
+    cross-request prefix follower acquires its sentence identity WITHOUT
+    re-running the encoder: the decoder-side history travels separately
+    as page-table aliases + one partial-page copy (kv_pool.py).
+
+    Slot index arrays are int32 ``[n]``; pairs with ``src == dst`` are
+    deterministic self-copies, so callers can pad to a static shape with
+    ``(0, 0)``. Pool/whole leaves and the host-owned ``pos``/
+    ``page_table`` pass through untouched."""
+    from ..ops.pallas.kv_pool import state_key_groups
+    row_keys, _, _ = state_key_groups(state)
+    src = jnp.asarray(src_slots, jnp.int32)
+    dst = jnp.asarray(dst_slots, jnp.int32)
+    new_state = dict(state)
+    for k in row_keys:
+        v = state[k]
+        new_state[k] = v.at[dst].set(v[src])
+    new_mask = src_mask.at[dst].set(src_mask[src])
+    return new_state, new_mask
+
+
 def _maybe_lsh_state(cfg: TransformerConfig, params: Params,
                      state: Dict[str, Any]) -> None:
     if not cfg.output_approx_knn:

@@ -134,13 +134,26 @@ class PoolCorruption(RuntimeError):
 
 
 class KVPool:
-    """Free-list page allocator over the device pool's index space.
+    """Refcounted free-list page allocator over the device pool's index
+    space.
 
-    Pure host bookkeeping (the device arrays live with the decode state);
-    claims are all-or-nothing per owner so a sentence either holds every
-    page its decode cap needs or none — mid-decode exhaustion is
-    impossible by construction, which is what keeps the decode step
-    deadlock-free when the pool runs dry (admission defers instead).
+    Pure host bookkeeping (the device arrays live with the decode state).
+    An owner's claim is the list of TABLE REFERENCES its page-table row
+    holds; a page's refcount is the number of table references across all
+    owners. Fresh claims are all-or-nothing per owner so a greedy
+    sentence either holds every page its decode cap needs or none —
+    mid-decode exhaustion is impossible by construction for that path,
+    which is what keeps the decode step deadlock-free when the pool runs
+    dry (admission defers instead).
+
+    Copy-on-write sharing (beam>1 iteration decoding, cross-request
+    prefix sharing) rides the refcounts: FULL pages are append-only and
+    therefore shareable — :meth:`share` adds references to live pages,
+    :meth:`retable` rewrites one owner's reference list as an
+    incref/decref diff (the beam reorder), and a page returns to the
+    free list only when its LAST reference drops. Only the current
+    PARTIAL page of a row is ever written, so it must stay refcount-1
+    per row (the engines fork it by content copy — ``pool_fork_partial``).
 
     Cross-thread: the device worker claims/releases while the metrics
     scrape thread samples the gauges — hence the lock discipline.
@@ -160,6 +173,9 @@ class KVPool:
         # replays deterministic and dense near the pool's base
         self._free: List[int] = list(range(self.n_pages - 1, 0, -1))
         self._claims: Dict[object, List[int]] = {}  # guarded-by: _lock
+        # page -> live reference count; a page is EITHER here (>= 1) or
+        # on the free list, never both and never absent from both
+        self._refs: Dict[int, int] = {}             # guarded-by: _lock
 
     @property
     def usable_pages(self) -> int:
@@ -174,9 +190,21 @@ class KVPool:
         with self._lock:
             return self.n_pages - 1 - len(self._free)
 
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
+    def refcounts(self) -> Dict[int, int]:
+        """Snapshot of the live refcount map (one lock acquisition —
+        callers scanning many pages must use this, not per-page
+        :meth:`refcount` calls against the device worker's lock)."""
+        with self._lock:
+            return dict(self._refs)
+
     def claim(self, owner, n: int) -> List[int]:
-        """Claim ``n`` pages for ``owner`` (all-or-nothing); raises
-        :class:`PoolExhausted` when the free list is short."""
+        """Claim ``n`` fresh pages (refcount 1 each) for ``owner``
+        (all-or-nothing); raises :class:`PoolExhausted` when the free
+        list is short."""
         n = int(n)
         if n > self.max_pages_per_row:
             raise PoolExhausted(
@@ -191,16 +219,129 @@ class KVPool:
                     f"pool exhausted: {n} pages requested, "
                     f"{len(self._free)} free of {self.n_pages - 1}")
             pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
             self._claims[owner] = pages
             return list(pages)
 
+    def claim_extra(self, owner, n: int = 1,
+                    row_cap: bool = True) -> List[int]:
+        """Append ``n`` fresh pages to an EXISTING owner's reference
+        list (lazy growth: a beam row crossing a page boundary, a COW
+        fork's new partial page). All-or-nothing like :meth:`claim`.
+        ``row_cap=False`` skips the per-row table bound — for TRANSIENT
+        hold owners that never become a table row (the beam reorder's
+        incref-before-decref window)."""
+        n = int(n)
+        with self._lock:
+            held = self._claims.get(owner)
+            if held is None:
+                raise ValueError(f"owner {owner!r} holds no pages to "
+                                 f"extend (use claim)")
+            if row_cap and len(held) + n > self.max_pages_per_row:
+                raise PoolExhausted(
+                    f"row would hold {len(held) + n} pages but the page "
+                    f"table holds {self.max_pages_per_row}")
+            if n > len(self._free):
+                raise PoolExhausted(
+                    f"pool exhausted: {n} extra pages requested, "
+                    f"{len(self._free)} free of {self.n_pages - 1}")
+            pages = [self._free.pop() for _ in range(n)]
+            for p in pages:
+                self._refs[p] = 1
+            held.extend(pages)
+            return list(pages)
+
+    def share(self, owner, pages: Sequence[int],
+              row_cap: bool = True) -> None:
+        """Add references to LIVE pages for ``owner`` (creating the
+        owner if absent): the copy-on-write alias — a beam fork's or a
+        prefix-cache hit's table row pointing at another lineage's full
+        (append-only, immutable) pages. Refuses dead pages loudly: an
+        alias to a freed page would serve recycled KV content.
+        ``row_cap=False``: see :meth:`claim_extra`."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if self._refs.get(p, 0) < 1:
+                    raise ValueError(
+                        f"cannot share page {p}: not live (freed or "
+                        f"never claimed)")
+            held = self._claims.setdefault(owner, [])
+            if row_cap and len(held) + len(pages) \
+                    > self.max_pages_per_row:
+                raise PoolExhausted(
+                    f"row would hold {len(held) + len(pages)} pages but "
+                    f"the page table holds {self.max_pages_per_row}")
+            for p in pages:
+                self._refs[int(p)] += 1
+                held.append(int(p))
+
+    def retable(self, owner, new_pages: Sequence[int]) -> int:
+        """Atomically rewrite ``owner``'s reference list to
+        ``new_pages`` (the beam reorder's refcount fixup): increfs the
+        additions, decrefs the removals, frees pages whose last
+        reference dropped. Every page in ``new_pages`` must already be
+        live (either kept from the old list or claimed/shared moments
+        before). Returns the number of pages FREED. An empty
+        ``new_pages`` drops the owner entirely."""
+        new_list = [int(p) for p in new_pages]
+        with self._lock:
+            old_list = self._claims.get(owner, [])
+            if len(new_list) > self.max_pages_per_row:
+                raise PoolExhausted(
+                    f"row would hold {len(new_list)} pages but the page "
+                    f"table holds {self.max_pages_per_row}")
+            for p in new_list:
+                if self._refs.get(p, 0) < 1:
+                    raise ValueError(
+                        f"cannot retable to page {p}: not live")
+            for p in new_list:
+                self._refs[p] += 1
+            freed = 0
+            # decref the old list in reverse so a retable-to-empty frees
+            # in release()'s deterministic order
+            for p in reversed(old_list):
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._free.append(p)
+                    freed += 1
+            if new_list:
+                self._claims[owner] = new_list
+            else:
+                self._claims.pop(owner, None)
+            return freed
+
+    def transfer(self, src_owner, dst_owner) -> List[int]:
+        """Move ``src_owner``'s whole reference list to ``dst_owner``
+        (refcounts unchanged — the references change hands, they do not
+        multiply): how a finished row's pages become a prefix-cache
+        entry without a free/reclaim round trip. Returns the moved
+        list; a missing source moves nothing."""
+        with self._lock:
+            pages = self._claims.pop(src_owner, None)
+            if not pages:
+                return []
+            if dst_owner in self._claims:
+                raise ValueError(f"transfer target {dst_owner!r} "
+                                 f"already holds pages")
+            self._claims[dst_owner] = pages
+            return list(pages)
+
     def release(self, owner) -> int:
-        """Free every page ``owner`` holds; returns how many."""
+        """Drop every reference ``owner`` holds (freeing pages whose
+        last reference drops); returns how many REFERENCES were
+        dropped (== pages freed when nothing was shared)."""
         with self._lock:
             pages = self._claims.pop(owner, [])
             # freed pages return in reverse so a release+reclaim of the
             # same count yields the same page ids (replay determinism)
-            self._free.extend(reversed(pages))
+            for p in reversed(pages):
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._free.append(p)
             return len(pages)
 
     def pages_of(self, owner) -> List[int]:
@@ -211,27 +352,33 @@ class KVPool:
         with self._lock:
             return list(self._claims.keys())
 
-    # -- invariant auditor (ISSUE 11) ---------------------------------------
+    # -- invariant auditor (ISSUE 11, refcounts ISSUE 12) -------------------
     def audit(self) -> List[str]:
-        """Cross-check the free list against the claims table; returns a
-        list of human-readable violations (empty = clean). The checks
-        are exactly the bug classes a paged allocator grows over time:
+        """Cross-check the free list, the claims table and the refcount
+        map; returns a list of human-readable violations (empty =
+        clean). The checks are exactly the bug classes a refcounted
+        paged allocator grows over time:
 
-        - a page on the free list twice, or both free and claimed
-          (double-free);
-        - a page claimed by two owners, or out of the pool's index
-          range, or the reserved trash page 0 handed out;
+        - a page on the free list twice, or both free and refcounted
+          (double-free / freed page with refcount > 0);
+        - a claim naming a page out of the pool's index range, or the
+          reserved trash page 0 handed out;
+        - sum of table references per page != its refcount (a lost or
+          phantom incref — the COW fork/reorder bug class);
+        - a refcount <= 0 entry lingering in the map (a page with
+          refcount 0 may exist ONLY on the free list);
         - pages accounted to neither side (leak).
 
         Runs on snapshots taken under the lock, so it never blocks the
-        device worker for more than two dict copies; callers run it at
+        device worker for more than three dict copies; callers run it at
         every quiesce boundary and per round under MARIAN_POOL_AUDIT=1.
         """
         with self._lock:
             free = list(self._free)
             claims = {k: list(v) for k, v in self._claims.items()}
+            refs = dict(self._refs)
         v: List[str] = []
-        where: Dict[int, str] = {}
+        seen_free: Dict[int, bool] = {}
         for p in free:
             if p == 0:
                 v.append("free list holds the reserved trash page 0")
@@ -239,30 +386,42 @@ class KVPool:
             if not 1 <= p < self.n_pages:
                 v.append(f"free list holds out-of-range page {p}")
                 continue
-            if p in where:
+            if p in seen_free:
                 v.append(f"page {p} appears twice in the free list "
                          f"(double-free)")
-            where[p] = "free"
+            seen_free[p] = True
+            if refs.get(p, 0) > 0:
+                v.append(f"page {p} is free but still has refcount "
+                         f"{refs[p]} (freed page with live references)")
+        # rebuild the expected refcounts from the claims table
+        expected: Dict[int, int] = {}
         for owner, pages in claims.items():
             for p in pages:
                 if p == 0 or not 1 <= p < self.n_pages:
                     v.append(f"claim {owner!r} holds invalid page {p}")
                     continue
-                prev = where.get(p)
-                if prev == "free":
-                    v.append(f"page {p} is both free and claimed by "
-                             f"{owner!r} (double-free)")
-                elif prev is not None:
-                    v.append(f"page {p} is claimed by both {prev} and "
-                             f"{owner!r}")
-                else:
-                    where[p] = f"claim {owner!r}"
+                expected[p] = expected.get(p, 0) + 1
+        for p, want in sorted(expected.items()):
+            have = refs.get(p, 0)
+            if have != want:
+                v.append(f"page {p} has refcount {have} but "
+                         f"{want} table reference(s) (refcount drift)")
+            if p in seen_free:
+                v.append(f"page {p} is both free and referenced "
+                         f"(double-free)")
+        for p, rc in sorted(refs.items()):
+            if rc <= 0:
+                v.append(f"page {p} has non-positive refcount {rc} "
+                         f"outside the free list")
+            elif p not in expected:
+                v.append(f"page {p} has refcount {rc} but no table "
+                         f"reference names it (phantom refcount)")
         if not v:
-            total = len(free) + sum(len(p) for p in claims.values())
+            total = len(free) + len(refs)
             if total != self.usable_pages:
                 v.append(f"{self.usable_pages - total} page(s) leaked: "
-                         f"{len(free)} free + {total - len(free)} "
-                         f"claimed of {self.usable_pages} allocatable")
+                         f"{len(free)} free + {len(refs)} live of "
+                         f"{self.usable_pages} allocatable")
         return v
 
     def chaos_double_free(self) -> None:
@@ -283,6 +442,22 @@ class KVPool:
                     if pages:
                         self._free.extend(reversed(pages))
                         break
+
+    def chaos_refcount_corrupt(self) -> None:
+        """Cross the ``pool.refcount_corrupt`` detection drill: an armed
+        'fail' bumps one live page's refcount by +1 WITHOUT adding a
+        table reference — the lost-decref/phantom-incref bug class the
+        COW fork/reorder paths could grow — so the auditor's
+        references-vs-refcount cross-check is proven against real
+        corrupted state (docs/ROBUSTNESS.md "Auditor drills")."""
+        from ...common import faultpoints as fp
+        try:
+            fp.fault_point("pool.refcount_corrupt")
+        except fp.InjectedFault:
+            with self._lock:
+                for p in sorted(self._refs):
+                    self._refs[p] += 1
+                    break
 
 
 # ---------------------------------------------------------------------------
@@ -323,6 +498,26 @@ def pool_insert(pool_k: jax.Array, pool_v: jax.Array,
                             jnp.zeros_like(payload))
         kv.append(pool.at[pidx, :, off, :].set(payload))
     return kv[0], kv[1]
+
+
+def pool_fork_partial(pool_k: jax.Array, pool_v: jax.Array,
+                      src_pages: jax.Array, dst_pages: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Copy-on-write fork of PARTIAL pages: ``pool[dst] = pool[src]``
+    for each (src, dst) pair — the one content copy a beam reorder (or
+    a cross-request prefix fork) pays per diverging row, H·page_len·dh
+    elements against the dense path's full H·L·dh reorder.
+
+    Pairs with ``src == dst == 0`` are padding (they rewrite the trash
+    page with its own content — deterministic no-ops), so callers can
+    bucket the pair count to a static shape. Duplicate destinations are
+    only ever the padded zeros, whose payloads are identical, so the
+    scatter stays deterministic."""
+    src = jnp.asarray(src_pages, jnp.int32)
+    dst = jnp.asarray(dst_pages, jnp.int32)
+    new_k = pool_k.at[dst].set(pool_k[src])
+    new_v = pool_v.at[dst].set(pool_v[src])
+    return new_k, new_v
 
 
 def _reference(q, pool_k, pool_v, page_table, row_pos, scale):

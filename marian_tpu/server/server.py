@@ -318,17 +318,23 @@ class ServingApp:
     @staticmethod
     def _validate_iteration_options(options) -> None:
         """--batching-mode iteration composes with a restricted option
-        surface (docs/DEPLOYMENT.md): the paged engine is a greedy
-        single-model decoder — fail LOUDLY at boot rather than serving
-        something subtly different from what was asked. --model-watch
-        DOES compose since ISSUE 11: swaps/canaries/rollbacks re-point
-        the engine through the quiesce protocol at a step boundary with
-        an empty join set (--quiesce-deadline bounds the drain)."""
+        surface (docs/DEPLOYMENT.md): the paged engines decode a single
+        model (greedily at --beam-size 1, copy-on-write beam search
+        above — ISSUE 12 removed the old beam-1 refusal) — fail LOUDLY
+        at boot rather than serving something subtly different from
+        what was asked. --model-watch DOES compose since ISSUE 11:
+        swaps/canaries/rollbacks re-point the engine through the
+        quiesce protocol at a step boundary with an empty join set
+        (--quiesce-deadline bounds the drain)."""
         problems = []
-        if int(options.get("beam-size", 6) or 6) != 1:
-            problems.append("--beam-size must be 1 (the paged engine "
-                            "decodes greedily; beam>1 iteration needs "
-                            "copy-on-write page sharing — ROADMAP)")
+        beam = int(options.get("beam-size", 6) or 6)
+        if beam < 1:
+            problems.append("--beam-size must be >= 1")
+        if beam > int(options.get("iteration-rows", 32) or 32):
+            problems.append(
+                f"--beam-size {beam} exceeds --iteration-rows "
+                f"{options.get('iteration-rows', 32)} (one sentence "
+                f"needs beam-size decode slots)")
         models = list(options.get("models", []) or [])
         if len(models) > 1:
             problems.append("--models ensembles are not supported")
@@ -360,8 +366,17 @@ class ServingApp:
         tr = service.translator
         opts = self.options
         ml = max(1, int(opts.get("max-length", 50) or 50))
-        return PagedDecodeEngine(
-            tr.model, tr.params_list[0], tr.src_vocab, tr.trg_vocab,
+        prefix = None
+        if opts.get("prefix-cache", False):
+            from ..translator.prefix_cache import PrefixCache
+            # engine-scoped cache, version-stamped with the model path:
+            # a hot swap builds a fresh engine + fresh cache, so a
+            # stale version's pages/outputs are unreachable
+            prefix = PrefixCache(
+                max_entries=int(
+                    opts.get("prefix-cache-entries", 64) or 64),
+                version=str((opts.get("models", None) or ["model"])[0]))
+        kw = dict(
             max_rows=int(opts.get("iteration-rows", 32) or 32),
             page_len=int(opts.get("kv-page-len", 16) or 16),
             pool_bytes=int(opts.get("kv-pool-bytes", 0) or 0),
@@ -369,8 +384,31 @@ class ServingApp:
             max_length_cap=ml,
             max_length_factor=float(
                 opts.get("max-length-factor", 3.0) or 3.0),
+            registry=registry,
+            prefix_cache=prefix)
+        beam = int(opts.get("beam-size", 6) or 6)
+        if beam > 1:
+            # COW paged beam search (ISSUE 12): same slot engine, one
+            # sentence = beam slots, full pages shared by refcount
+            from ..translator.beam_iteration import PagedBeamEngine
+            if int(opts.get("iteration-steps", 1) or 1) > 1:
+                log.warn("--iteration-steps > 1 is ignored at beam > 1:"
+                         " the beam reorder needs the host between "
+                         "steps (rounds run single-step)")
+            norm = opts.get("normalize", 0.0)
+            if norm is True:
+                norm = 1.0
+            return PagedBeamEngine(
+                tr.model, tr.params_list[0], tr.src_vocab, tr.trg_vocab,
+                beam_size=beam,
+                normalize=float(norm or 0.0),
+                word_penalty=float(opts.get("word-penalty", 0.0) or 0.0),
+                allow_unk=bool(opts.get("allow-unk", False)),
+                **kw)
+        return PagedDecodeEngine(
+            tr.model, tr.params_list[0], tr.src_vocab, tr.trg_vocab,
             steps_per_round=int(opts.get("iteration-steps", 1) or 1),
-            registry=registry)
+            **kw)
 
     def _bundle_engine_factory(self, bundle_dir: str, manifest):
         """executor_factory for iteration mode (ISSUE 11): a warmed

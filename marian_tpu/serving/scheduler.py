@@ -1022,6 +1022,16 @@ class ContinuousScheduler:
         # round and starve the earliest request under pool pressure
         for u in reversed(requeue):
             self._requeue_front(u)
+        # lazy COW claims (beam>1 divergence) that found the pool dry
+        # evicted their sentence mid-decode: retriable by contract —
+        # the pool is healthy, the resend lands once pressure passes
+        for u in getattr(res, "pool_evicted", ()) or ():
+            if u in self._active_units:
+                del self._active_units[u]
+                self.m_evictions.inc()
+                self._evict_with_retry(
+                    u, loop, "row evicted: KV pool exhausted mid-decode "
+                             "(copy-on-write beam divergence)")
         src_done = 0
         for u, text in res.finished:
             self._active_units.pop(u, None)

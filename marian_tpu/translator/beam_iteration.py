@@ -1,0 +1,684 @@
+"""Beam>1 iteration-level decoding via copy-on-write page sharing
+(ISSUE 12 tentpole — ROADMAP item 1a).
+
+The dense batched beam search (translator/beam_search.py) reorders every
+cache leaf every step: new beam row j gathers old row ``beam_idx`` —
+H·L·dh elements per row per step, the exact write-back the paged pool
+was built to kill. Here each HYPOTHESIS owns a page-table row instead,
+and the beam reorder becomes host-side int32 bookkeeping plus refcounts
+(ops/pallas/kv_pool.py):
+
+- FULL pages are append-only, hence immutable, hence shareable: a child
+  hypothesis aliases its parent's full pages with refcount++ — zero
+  bytes moved;
+- only the current PARTIAL page needs per-hypothesis ownership: a fork
+  copies H·page_len·dh elements once (``pool_fork_partial``) instead of
+  the dense path's H·L·dh gather, and a child that is its parent's sole
+  successor keeps the parent's partial page in place — zero bytes moved
+  again;
+- ``paged_decode_attention`` needs NO kernel change: it already reads
+  every row through its own page-table row, so hypothesis identity is
+  just a table row.
+
+Decode semantics are the DENSE beam search's, kept bitwise (the parity
+test pins tokens and raw path scores): per-row ``log_softmax`` in f32,
+UNK suppression, Marian score bookkeeping (cumulative log-prob,
+``score/len^alpha - wp*len`` ranking), the t=0 single-live-beam mask via
+the NEG_INF score init, and finished hypotheses frozen as {EOS: 0.0}
+candidates. The device computes per-row top-k over ``score + logp``
+(the same f32 adds the dense kernel makes); the host merges the k·k
+candidate lists exactly as the dense flat top-k would (value, then
+flat-index tie-break), because the global top-k can take at most k
+entries from any one row. A frozen hypothesis needs no device row at
+all — its lone viable candidate is (EOS, score) with score unchanged,
+so it leaves the compiled step AND releases its page references the
+moment it freezes; with vocab >= beam (always, in practice) its
+NEG_INF-shifted non-EOS candidates can never outrank a live row's.
+
+A sentence claims ``beam_size`` slots at join and holds them to
+completion (slots are cheap; pages are the scarce resource — those are
+refcounted per hypothesis and freed per hypothesis). Divergence pages
+are claimed LAZILY at page boundaries and forks; if the pool runs dry
+mid-decode the whole sentence is evicted retriably
+(``StepResult.pool_evicted`` → the scheduler replies !!SERVER-RETRY) —
+the documented trade for not reserving the k·cap worst case up front,
+which would forfeit the sharing win admission pricing is built on
+(``pages_for_text``: trunk + k-1 extra partials, NOT k× replication).
+
+Threading contract, determinism and the audit discipline are inherited
+from translator/iteration.py; the auditor additionally pins the COW
+safety invariant (every live row's write-target page is refcount-1) and
+the pool's reference-sum/refcount cross-check.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.vocab import EOS_ID, UNK_ID
+from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, PoolExhausted,
+                                  ROW_BUCKETS, bucket_rows,
+                                  pages_for_tokens)
+from .beam_search import NEG_INF
+from .iteration import PagedDecodeEngine, StepResult, _Slot
+
+
+class _Hyp:
+    """One beam hypothesis. ``tokens`` is the dense token array cropped
+    at ``length`` (EOS included when finished via EOS); ``dense_pos``
+    is the hypothesis's beam position in the equivalent dense search —
+    the flat-index tie-break needs it. ``slot`` is None once frozen
+    (the hypothesis left the compiled step and freed its pages)."""
+
+    __slots__ = ("tokens", "score", "length", "finished", "dense_pos",
+                 "slot")
+
+    def __init__(self, tokens, score, length, finished, dense_pos, slot):
+        self.tokens = tokens
+        self.score = score          # cumulative log-prob (np.float32)
+        self.length = length
+        self.finished = finished
+        self.dense_pos = dense_pos
+        self.slot = slot
+
+
+class _Sent:
+    """One decoding sentence: k hypothesis rows over k claimed slots."""
+
+    __slots__ = ("key", "slots", "hyps", "t", "cap", "src_tokens",
+                 "src_key")
+
+    def __init__(self, key, slots, hyps, cap, src_tokens, src_key):
+        self.key = key
+        self.slots = slots          # the k claimed slot indices
+        self.hyps = hyps
+        self.t = 0                  # decode steps taken (= live-row pos)
+        self.cap = cap
+        self.src_tokens = src_tokens
+        self.src_key = src_key
+
+
+class PagedBeamEngine(PagedDecodeEngine):
+    """Slot-based continuous COW beam decoder over a paged KV pool.
+
+    Drop-in for PagedDecodeEngine in the serving scheduler: same
+    admit_and_step/evict/audit surface, sentence-granular capacity
+    (``free_slots`` counts k-row groups), per-sentence page pricing at
+    worst-case OWNED pages."""
+
+    def __init__(self, model, params, src_vocab, trg_vocab,
+                 beam_size: int = 6,
+                 normalize: float = 0.6,
+                 word_penalty: float = 0.0,
+                 allow_unk: bool = False,
+                 cow: bool = True,
+                 **kw):
+        kw["steps_per_round"] = 1   # host beam bookkeeping every step
+        super().__init__(model, params, src_vocab, trg_vocab, **kw)
+        # cow=False: the A/B baseline — every reorder child copies its
+        # WHOLE history into fresh pages (the dense beam reorder's data
+        # movement, expressed over the paged pool). Numerics are
+        # bitwise-identical to cow=True by construction (aliased pages
+        # hold exactly the content the copy would have made), which the
+        # parity test pins; only bytes moved and pages held differ.
+        self.cow = bool(cow)
+        self.beam_size = int(beam_size)
+        if self.beam_size < 1:
+            raise ValueError("beam_size must be >= 1")
+        if self.beam_size > self.max_rows:
+            raise ValueError(
+                f"beam_size {self.beam_size} exceeds max_rows "
+                f"{self.max_rows} (one sentence needs beam_size slots)")
+        if self.beam_size > len(trg_vocab):
+            raise ValueError("beam_size exceeds the target vocab")
+        self.normalize = float(normalize)
+        self.word_penalty = float(word_penalty)
+        self.allow_unk = bool(allow_unk)
+        self._sents: Dict[object, _Sent] = {}
+        # _slots (base) keeps a _Slot per OCCUPIED row so the base
+        # bucket/occupancy logic keeps working; beam bookkeeping rides
+        # _sents. _slot_pos[i] mirrors the per-row device position
+        # (-1 = idle row held by a sentence whose hypothesis froze).
+        self._slot_pos: List[int] = [-1] * self.max_rows
+        self._slot_prev: List[int] = [0] * self.max_rows
+        self._slot_score: List[float] = [0.0] * self.max_rows
+        # (src_slot, [dst_slots]) rows to replicate after the next
+        # install (worker thread only; one sentence = one encode)
+        self._pending_replicate: List[Tuple[int, List[int]]] = []
+
+    # -- capacity (sentence-granular) ---------------------------------------
+    def free_slots(self) -> int:
+        with self._lock:
+            return (self.max_rows - self._n_active) // self.beam_size
+
+    def pages_for_text(self, text: str) -> int:
+        """Admission pricing at the SHARED-TRUNK steady-state holding:
+        one trunk of full pages (the hypotheses' common history) plus
+        one partial page per extra beam. This is an optimistic
+        estimate, not a worst case — fully divergent lineages accrete
+        their own full pages past the last common ancestor, up to ~k×
+        the post-divergence suffix; that tail is deliberately priced by
+        the lazy-claim path instead (a dry pool evicts the sentence
+        retriably) because pricing every request at k× replication
+        would shed typical traffic at several times its real cost (the
+        regression test pins the ratio)."""
+        n_src = len(text.split()) + 1
+        return pages_for_tokens(self.decode_cap(n_src), self.page_len) \
+            + (self.beam_size - 1)
+
+    def row_progress(self, key) -> Optional[Tuple[int, int]]:
+        with self._lock:
+            s = self._sents.get(key)
+            return (s.t, s.cap) if s is not None else None
+
+    # -- join ---------------------------------------------------------------
+    def _owner(self, key, slot: int):
+        return (key, slot)
+
+    def _try_claim(self, key, text: str, joiners: List,
+                   detail: Optional[Dict[object, str]] = None,
+                   res: Optional[StepResult] = None) -> Optional[str]:
+        k = self.beam_size
+        ids = self.src_vocab.encode(text, add_eos=True, inference=True)
+        if len(ids) > self.src_cap:
+            if detail is not None:
+                detail[key] = (f"source encodes to {len(ids)} tokens but "
+                               f"the engine's source cap is "
+                               f"{self.src_cap} (raise --max-length)")
+            return "src_too_long"
+        src_key = tuple(int(i) for i in ids)
+        if self.prefix is not None and res is not None:
+            ent = self.prefix.get(src_key, self.prefix.version)
+            if ent is not None:
+                # beam decode is deterministic per version: replay
+                res.finished.append((key, ent.text))
+                return None
+        cap = self.decode_cap(len(ids))
+        n_pages = pages_for_tokens(cap, self.page_len)
+        if n_pages > self.pool.max_pages_per_row:
+            if detail is not None:
+                detail[key] = (
+                    f"decode cap {cap} tokens needs {n_pages} KV pages "
+                    f"of {self.page_len} tokens per hypothesis but the "
+                    f"page table holds {self.pool.max_pages_per_row}/row "
+                    f"(raise --kv-page-len or --kv-pool-bytes)")
+            return "too_large"
+        with self._lock:
+            if self.max_rows - self._n_active < k:
+                return "no_slot"
+            slots = [i for i, s in enumerate(self._slots) if s is None][:k]
+        # one partial page per hypothesis row, all-or-nothing across
+        # the sentence (prefix-cache pressure relief on the first)
+        claimed: List[Tuple[object, List[int]]] = []
+        try:
+            for j, slot in enumerate(slots):
+                owner = self._owner(key, slot)
+                pages = (self._claim_pages(owner, 1) if j == 0
+                         else self.pool.claim(owner, 1))
+                claimed.append((owner, pages))
+        except PoolExhausted:
+            for owner, _ in claimed:
+                self.pool.release(owner)
+            if n_pages + k - 1 > self.pool.usable_pages:
+                if detail is not None:
+                    detail[key] = (
+                        f"beam-{k} decode at cap {cap} needs at least "
+                        f"{n_pages + k - 1} KV pages but the whole pool "
+                        f"holds only {self.pool.usable_pages} (raise "
+                        f"--kv-pool-bytes or lower --max-length)")
+                return "too_large"
+            return "no_pages"
+        hyps = []
+        with self._lock:
+            for j, slot in enumerate(slots):
+                self._slots[slot] = _Slot(key, cap, len(ids),
+                                          expected_refs=1,
+                                          src_key=src_key)
+                self._slot_pos[slot] = 0
+                self._slot_prev[slot] = 0
+                # t=0 single-live-beam mask: the dense scores0 init
+                self._slot_score[slot] = 0.0 if j == 0 else NEG_INF
+                hyps.append(_Hyp([], np.float32(0.0 if j == 0
+                                                else NEG_INF),
+                                 0, False, j, slot))
+                self._n_active += 1
+            self._by_key[key] = slots[0]
+            self._sents[key] = _Sent(key, slots, hyps, cap, len(ids),
+                                     src_key)
+        for (owner, pages), slot in zip(claimed, slots):
+            self._table[slot, :] = 0
+            self._table[slot, 0] = pages[0]
+        # ONE encoder forward per sentence (slot 0); the other k-1
+        # rows get their identical cross-attn rows by a slot-to-slot
+        # copy after install (_install override) — hypothesis forks
+        # then never need a cross-attn copy either
+        joiners.append((key, ids, slots[0]))
+        if len(slots) > 1:
+            self._pending_replicate.append((slots[0], slots[1:]))
+        return None
+
+    def _install(self, joiners) -> None:
+        super()._install(joiners)
+        reps, self._pending_replicate = self._pending_replicate, []
+        if not reps:
+            return
+        src = [s0 for s0, rest in reps for _ in rest]
+        dst = [d for _, rest in reps for d in rest]
+        n = 1
+        while n < len(src):
+            n *= 2
+        src += [0] * (n - len(src))   # (0,0) = deterministic self-copy
+        dst += [0] * (n - len(dst))
+        if self._fork_jit is None:
+            self._fork_jit = self._make_fork()
+        # one device call replicates every new sentence's encoder rows
+        # (page pair (0,0): no pool content moves at join)
+        self._state, self._src_mask = self._fork_jit(
+            self._state, self._src_mask,
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32),
+            jnp.asarray([0], jnp.int32), jnp.asarray([0], jnp.int32))
+
+    # -- leave --------------------------------------------------------------
+    def _evict(self, key, adopt_text: Optional[str] = None) -> bool:
+        with self._lock:
+            sent = self._sents.pop(key, None)
+            if sent is None:
+                return False
+            self._by_key.pop(key, None)
+            for slot in sent.slots:
+                if self._slots[slot] is not None:
+                    self._n_active -= 1
+                self._slots[slot] = None
+                self._slot_pos[slot] = -1
+                self._slot_prev[slot] = 0
+                self._slot_score[slot] = 0.0
+        for slot in sent.slots:
+            self.pool.retable(self._owner(key, slot), [])
+            self._table[slot, :] = 0
+        if self.prefix is not None and adopt_text is not None:
+            best = self._best_hyp(sent)
+            self.prefix.remember(self.pool, sent.src_key,
+                                 self._crop(best), adopt_text)
+        self._recount_tokens()
+        return True
+
+    def _recount_tokens(self) -> None:
+        with self._lock:
+            self._used_tokens = sum(
+                s.t for s in self._sents.values()
+                for h in s.hyps if h.slot is not None)
+
+    # -- the step -----------------------------------------------------------
+    def _make_step(self, rb: int):
+        model = self.model
+        k = self.beam_size
+        allow_unk = self.allow_unk
+        row_keys, pool_keys, whole_keys = self._state_key_groups()
+
+        def step(state, src_mask, params, prev, pos, table, scores):
+            sub = {key: state[key][:rb] for key in row_keys}
+            for key in whole_keys:
+                sub[key] = state[key]
+            for key in pool_keys:
+                sub[key] = state[key]
+            sub["pos"] = pos
+            sub["page_table"] = table
+            logits, new_sub = model.step(params, sub, prev,
+                                         src_mask[:rb])
+            # EXACTLY the dense beam search's per-row math (bitwise):
+            # f32 log-softmax, UNK suppression by NEG_INF overwrite,
+            # then the f32 cumulative-score add — per-row top-k of the
+            # same values the dense flat top-k ranks
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            if not allow_unk:
+                lp = lp.at[:, UNK_ID].set(NEG_INF)
+            comb = scores[:, None] + lp
+            vals, idx = jax.lax.top_k(comb, k)
+            new_state = dict(state)
+            for key in pool_keys:
+                new_state[key] = new_sub[key]
+            return vals, idx, new_state
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _make_pool_fork(self, n: int):
+        _, pool_keys, _ = self._state_key_groups()
+        k_keys = tuple(sorted(key for key in pool_keys
+                              if key.endswith("_pool_k")))
+
+        def fork(state, src_pages, dst_pages):
+            from ..ops.pallas.kv_pool import pool_fork_partial
+            new_state = dict(state)
+            for kk in k_keys:
+                vk = kk[:-1] + "v"
+                nk, nv = pool_fork_partial(new_state[kk], new_state[vk],
+                                           src_pages, dst_pages)
+                new_state[kk] = nk
+                new_state[vk] = nv
+            return new_state
+
+        return jax.jit(fork, donate_argnums=(0,))
+
+    def _step(self, res: StepResult) -> None:
+        top = max(i for i, s in enumerate(self._slots) if s is not None)
+        rb = bucket_rows(top + 1, self.row_buckets)
+        pos_np = np.full((rb,), -1, np.int32)
+        prev_np = np.zeros((rb, 1), np.int32)
+        score_np = np.zeros((rb,), np.float32)
+        live_rows = 0
+        for i in range(rb):
+            if self._slot_pos[i] >= 0:
+                pos_np[i] = self._slot_pos[i]
+                prev_np[i, 0] = self._slot_prev[i]
+                score_np[i] = self._slot_score[i]
+                live_rows += 1
+        fn = self._step_jit.get(rb)
+        if fn is None:
+            fn = self._make_step(rb)
+            self._step_jit[rb] = fn
+        vals_dev, idx_dev, self._state = fn(
+            self._state, self._src_mask, self.params,
+            jnp.asarray(prev_np), jnp.asarray(pos_np),
+            jnp.asarray(self._table[:rb]), jnp.asarray(score_np))
+        # per-round host sync by design (see PagedDecodeEngine._step)
+        vals = np.asarray(vals_dev)  # mtlint: ok -- iteration-level decode syncs once per round by design; the beam merge runs host-side between rounds
+        idx = np.asarray(idx_dev)  # mtlint: ok -- same round boundary as vals above; one fetch, already fenced
+        self._ever_stepped = True
+        fork_src: List[int] = []
+        fork_dst: List[int] = []
+        finished_sents: List[Tuple[_Sent, _Hyp]] = []
+        for key in list(self._sents):
+            sent = self._sents[key]
+            try:
+                done = self._merge_sentence(sent, vals, idx, fork_src,
+                                            fork_dst)
+            except PoolExhausted:
+                # lazy COW claim found the pool dry: evict the whole
+                # sentence retriably (its references are dropped by
+                # _evict) — the serving scheduler replies !!SERVER-RETRY
+                res.pool_evicted.append(key)
+                self._evict(key)
+                continue
+            if done is not None:
+                finished_sents.append((sent, done))
+        if fork_src:
+            # ONE bucketed device call copies every diverging partial
+            # page ((0,0) pairs are deterministic trash-page no-ops)
+            n = 1
+            while n < len(fork_src):
+                n *= 2
+            fj = self._step_jit.get(("fork", n))
+            if fj is None:
+                fj = self._make_pool_fork(n)
+                self._step_jit[("fork", n)] = fj
+            src = np.zeros((n,), np.int32)
+            dst = np.zeros((n,), np.int32)
+            src[:len(fork_src)] = fork_src
+            dst[:len(fork_dst)] = fork_dst
+            self._state = fj(self._state, jnp.asarray(src),
+                             jnp.asarray(dst))
+        for sent, best in finished_sents:
+            toks = self._crop(best)
+            text = self.trg_vocab.decode(toks, ignore_eos=True)
+            res.finished.append((sent.key, text))
+            res.finished_info[sent.key] = {
+                "score": float(best.score),
+                "norm_score": float(self._norm_score(best)),
+                "length": int(best.length),
+                "tokens": list(best.tokens),
+            }
+            self._evict(sent.key, adopt_text=text)
+        self._recount_tokens()
+        res.rows = live_rows
+        res.bucket = rb
+        res.tokens = live_rows
+        res.steps += 1
+
+    def _merge_sentence(self, sent: _Sent, vals, idx,
+                        fork_src: List[int], fork_dst: List[int]
+                        ) -> Optional[_Hyp]:
+        """Host half of one beam step for one sentence: merge the k·k
+        candidate lists the way the dense flat top-k ranks them, apply
+        EOS bookkeeping, then express the reorder as page-table aliases
+        + partial-page forks. Returns the best hypothesis when the
+        sentence finished (all frozen, or the cap reached)."""
+        k = self.beam_size
+        V = len(self.trg_vocab)
+        t = sent.t
+        cands = []
+        for h in sent.hyps:
+            if h.finished:
+                # frozen {EOS: 0.0} candidate: score unchanged (the
+                # dense f32 add of 0.0 is the identity)
+                cands.append((np.float32(h.score),
+                              h.dense_pos * V + EOS_ID, EOS_ID, h))
+            else:
+                for j in range(k):
+                    tok = int(idx[h.slot, j])
+                    cands.append((vals[h.slot, j],
+                                  h.dense_pos * V + tok, tok, h))
+        # dense flat top-k: value desc, flat index asc on ties
+        cands.sort(key=lambda c: (-c[0], c[1]))
+        children: List[_Hyp] = []
+        for dense_pos, (val, _flat, tok, parent) in enumerate(cands[:k]):
+            if parent.finished:
+                children.append(_Hyp(parent.tokens, parent.score,
+                                     parent.length, True, dense_pos,
+                                     None))
+            else:
+                fin = tok == EOS_ID
+                # a newly frozen (EOS) child leaves the device NOW: no
+                # slot, and its parent's pages free unless a live
+                # sibling keeps them (the retable below)
+                children.append(_Hyp(parent.tokens + [tok],
+                                     np.float32(val), t + 1, fin,
+                                     dense_pos,
+                                     None if fin else parent.slot))
+        next_pos = t + 1
+        live = [c for c in children if not c.finished]
+        if not live or next_pos >= sent.cap:
+            # unfinished hypotheses at the cap score at length = cap
+            # (dense: lengths = where(finished, lengths, L))
+            for c in live:
+                c.length = sent.cap
+                c.slot = None
+            sent.hyps = children
+            sent.t = next_pos
+            return self._best_hyp(sent)
+        # --- the COW reorder ------------------------------------------
+        n_full = next_pos // self.page_len
+        has_partial = next_pos % self.page_len != 0
+        old_tables = {slot: self.pool.pages_of(self._owner(sent.key,
+                                                           slot))
+                      for slot in sent.slots}
+        # group live children by parent slot; the lowest-dense_pos
+        # child KEEPS the parent's row in place (zero copies). cow=False
+        # (the A/B baseline) disables both levers: every child replicates
+        # its whole history into fresh pages, like the dense reorder.
+        keeper: Dict[int, _Hyp] = {}
+        forkers: List[Tuple[_Hyp, int]] = []      # (child, parent_slot)
+        for c in live:
+            if self.cow and c.slot not in keeper:
+                keeper[c.slot] = c
+            else:
+                forkers.append((c, c.slot))
+        free_rows = [slot for slot in sent.slots if slot not in keeper]
+        new_tables: Dict[int, List[int]] = {}
+        # hold every page any new table will reference, then claim the
+        # fresh pages, so no retable below can free an alias source
+        # before its incref (or a fork its copy source) lands
+        tmp = ("cow", sent.key)
+        aliased = []
+        if self.cow:
+            for c, pslot in forkers:
+                aliased.extend(old_tables[pslot][:n_full])
+            # exactly what the assignment below consumes: one copied
+            # partial per forker, or — at a page boundary — one fresh
+            # (unwritten) page per live child, keeper and forker alike
+            n_fresh = len(forkers) if has_partial else len(live)
+        else:
+            n_fresh = len(live) * (n_full + 1)
+
+        def hold_and_claim():
+            self.pool.share(tmp, aliased, row_cap=False)
+            try:
+                return (self.pool.claim_extra(tmp, n_fresh,
+                                              row_cap=False)
+                        if n_fresh else [])
+            except PoolExhausted:
+                self.pool.release(tmp)
+                raise
+        try:
+            fresh = hold_and_claim()
+        except PoolExhausted:
+            if self.prefix is None or not self.prefix.evict_for_pages(
+                    self.pool, n_fresh):
+                raise
+            fresh = hold_and_claim()
+        fi = 0
+        for slot, c in keeper.items():
+            row = list(old_tables[slot])
+            if not has_partial:
+                row.append(fresh[fi])     # boundary: fresh page, no copy
+                fi += 1
+            new_tables[slot] = row
+        for c, pslot in forkers:
+            slot = free_rows.pop(0)
+            if self.cow:
+                row = list(old_tables[pslot][:n_full])
+                if has_partial:
+                    row.append(fresh[fi])     # content-copied partial
+                    fork_src.append(old_tables[pslot][n_full])
+                    fork_dst.append(fresh[fi])
+                else:
+                    row.append(fresh[fi])     # boundary: fresh, no copy
+                fi += 1
+            else:
+                # replication baseline: copy EVERY history page
+                row = []
+                old = old_tables[pslot]
+                for j in range(n_full + 1):
+                    row.append(fresh[fi])
+                    if j < len(old):
+                        fork_src.append(old[j])
+                        fork_dst.append(fresh[fi])
+                    fi += 1
+            c.slot = slot
+            new_tables[slot] = row
+        # retable every slot (ascending, deterministic): increfs the
+        # new rows, decrefs the old, frees dead lineages' pages
+        for slot in sent.slots:
+            row = new_tables.get(slot, [])
+            self.pool.retable(self._owner(sent.key, slot), row)
+            self._table[slot, :] = 0
+            if row:
+                self._table[slot, :len(row)] = row
+        self.pool.release(tmp)
+        # refresh per-row device inputs + base-slot bookkeeping
+        live_slots = {c.slot for c in live}
+        with self._lock:
+            for slot in sent.slots:
+                st = self._slots[slot]
+                if slot in live_slots:
+                    self._slot_pos[slot] = next_pos
+                    st.pos = next_pos
+                    st.expected_refs = len(new_tables[slot])
+                else:
+                    self._slot_pos[slot] = -1
+                    self._slot_prev[slot] = 0
+                    self._slot_score[slot] = 0.0
+                    st.pos = 0
+                    st.expected_refs = 0
+        for c in live:
+            self._slot_prev[c.slot] = c.tokens[-1]
+            self._slot_score[c.slot] = float(c.score)
+        sent.hyps = children
+        sent.t = next_pos
+        return None
+
+    # -- scoring (the dense search's collect math, in np.float32) -----------
+    def _norm_score(self, h: _Hyp) -> np.float32:
+        ln = np.float32(h.length)
+        norm = (np.power(ln, np.float32(self.normalize))
+                if self.normalize > 0 else np.float32(1.0))
+        return np.float32(h.score / norm
+                          - np.float32(self.word_penalty) * ln)
+
+    def _best_hyp(self, sent: _Sent) -> _Hyp:
+        scores = np.array(  # mtlint: ok -- host-side np.float32 scalars (the collect math), no device array in sight
+            [self._norm_score(h) for h in sent.hyps], np.float32)
+        return sent.hyps[int(np.argsort(-scores, kind="stable")[0])]
+
+    @staticmethod
+    def _crop(h: _Hyp) -> List[int]:
+        toks = list(h.tokens[:h.length])
+        if toks and toks[-1] == EOS_ID:
+            toks = toks[:-1]
+        return toks
+
+    # -- audit --------------------------------------------------------------
+    def audit(self, context: str = "quiesce") -> List[str]:
+        """Beam-engine invariants on top of the pool's refcount audit:
+        sentence/slot/claim coherence, per-row table mirrors, and the
+        COW safety invariant — a live row's WRITE-TARGET page must be
+        refcount-1 (a shared page receiving a write would corrupt every
+        aliasing hypothesis)."""
+        with self._lock:
+            sents = dict(self._sents)
+            n_active = self._n_active
+        v = self.pool.audit()
+        refs = self.pool.refcounts()
+        occupied = sum(len(s.slots) for s in sents.values())
+        if n_active != occupied:
+            v.append(f"active-row counter {n_active} != {occupied} "
+                     f"slots held by sentences")
+        table = getattr(self, "_table_np", None)
+        valid_owners = set()
+        for key, s in sents.items():
+            for slot in s.slots:
+                valid_owners.add(repr(self._owner(key, slot)))
+                pages = self.pool.pages_of(self._owner(key, slot))
+                if table is not None:
+                    row = table[slot]
+                    if list(row[:len(pages)]) != pages \
+                            or any(int(p) != 0 for p in
+                                   row[len(pages):]):
+                        v.append(f"slot {slot} page-table row does not "
+                                 f"match its claim (table corruption)")
+                if self._slot_pos[slot] >= 0:
+                    if not pages:
+                        v.append(f"live row {slot} holds no pages")
+                    elif refs.get(pages[-1], 0) != 1:
+                        v.append(
+                            f"live row {slot} write-target page "
+                            f"{pages[-1]} has refcount "
+                            f"{refs.get(pages[-1], 0)} (COW "
+                            f"safety: partial pages must be exclusive)")
+            live = sum(1 for h in s.hyps if h.slot is not None)
+            dev_live = sum(1 for slot in s.slots
+                           if self._slot_pos[slot] >= 0)
+            if live != dev_live:
+                v.append(f"sentence {key!r}: {live} live hypotheses vs "
+                         f"{dev_live} live device rows")
+        cache_owners = (set(map(repr, self.prefix.owner_keys()))
+                        if self.prefix is not None else set())
+        for owner in self.pool.owners():
+            if repr(owner) in valid_owners:
+                continue
+            if self.prefix is not None and self.prefix.owns(owner):
+                if repr(owner) not in cache_owners:
+                    v.append(f"pool claim for prefix-cache owner "
+                             f"{owner!r} matches no cache entry")
+                continue
+            v.append(f"pool claim for {owner!r} matches no sentence "
+                     f"slot (pages leaked at exit)")
+        if hasattr(self, "m_audits"):
+            self.m_audits.inc()
+        if v:
+            self._report_audit(v, context)
+        return v
+

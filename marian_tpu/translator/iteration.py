@@ -18,11 +18,15 @@ into SLOTS over one shared paged KV pool (ops/pallas/kv_pool.py):
   static-shape compilation model is preserved by bucketing, never by
   dynamic shapes.
 
-The engine is GREEDY (beam 1) — the production high-throughput serving
+This engine is GREEDY (beam 1) — the production high-throughput serving
 config (cf. bench_decode's MARIAN_DECBENCH_BEAM=1 "student serving"
-note). Beam>1 iteration decoding needs copy-on-write page sharing
-across beams and is an open ROADMAP item; the server validates the
-combination loudly (server/server.py).
+note). Beam>1 iteration decoding rides the SAME slot machinery via
+copy-on-write page sharing across hypotheses — refcounted full pages,
+per-beam partial pages (translator/beam_iteration.py; the server picks
+the engine by --beam-size). Cross-request prefix sharing (ISSUE 12,
+--prefix-cache) composes with both: an exact source repeat forks
+copy-on-write from a live row or replays a completed decode
+(translator/prefix_cache.py).
 
 Threading contract: every device-touching method (``admit_and_step``)
 runs on the serving scheduler's single device worker thread. The
@@ -79,6 +83,14 @@ class StepResult:
     # error a client sees must tell the operator which knob to turn)
     reject_detail: Dict[object, str] = field(default_factory=dict)
     finished: List[Tuple[object, str]] = field(default_factory=list)
+    # per-key decode detail for finished sentences (beam engine: raw /
+    # length-normalized scores, hypothesis length — the parity tests
+    # and n-best-curious callers read it; greedy leaves it empty)
+    finished_info: Dict[object, dict] = field(default_factory=dict)
+    # rows evicted MID-DECODE because a lazy COW page claim found the
+    # pool dry (beam divergence): retriable by contract — the serving
+    # scheduler fails them with RowEvicted (!!SERVER-RETRY)
+    pool_evicted: List[object] = field(default_factory=list)
     rows: int = 0                 # active rows this round (before finishes)
     bucket: int = 0               # compiled row bucket the round ran at
     tokens: int = 0               # target tokens consumed this round
@@ -88,15 +100,22 @@ class StepResult:
 
 
 class _Slot:
-    __slots__ = ("key", "tokens", "pos", "cap", "prev", "src_tokens")
+    __slots__ = ("key", "tokens", "pos", "cap", "prev", "src_tokens",
+                 "expected_refs", "src_key")
 
-    def __init__(self, key, cap: int, src_tokens: int):
+    def __init__(self, key, cap: int, src_tokens: int,
+                 expected_refs: int = 0, src_key=None):
         self.key = key
         self.tokens: List[int] = []
         self.pos = 0                # next write position
         self.cap = cap              # decode cap (max positions)
         self.prev = 0               # previous token id (0 at pos 0)
         self.src_tokens = src_tokens
+        # page REFERENCES this row's exit must give back (cap pages for
+        # a cold join; aliased fulls + owned tail for a prefix fork) —
+        # the row-exit leak check compares against it
+        self.expected_refs = expected_refs
+        self.src_key = src_key      # source id tuple (prefix-cache key)
 
 
 class PagedDecodeEngine:
@@ -114,7 +133,8 @@ class PagedDecodeEngine:
                  max_length_factor: float = 3.0,
                  row_buckets: Sequence[int] = ROW_BUCKETS,
                  steps_per_round: int = 1,
-                 registry=None):
+                 registry=None,
+                 prefix_cache=None):
         cfg = getattr(model, "cfg", None)
         if cfg is None or getattr(cfg, "decoder_autoreg", "") \
                 != "self-attention":
@@ -135,6 +155,13 @@ class PagedDecodeEngine:
         self.max_length_factor = float(max_length_factor)
         self.row_buckets = tuple(sorted(set(
             min(b, self.max_rows) for b in row_buckets)))
+        if self.max_rows > max(row_buckets):
+            # slots past the largest compiled bucket would never step
+            # (and the beam merge would index past the device output)
+            raise ValueError(
+                f"max_rows {self.max_rows} exceeds the largest row "
+                f"bucket {max(row_buckets)} (extend row_buckets or "
+                f"lower --iteration-rows)")
         self.max_pages = pages_for_tokens(self.max_length_cap,
                                           self.page_len)
         # decode steps per round, run as ONE jitted lax.scan: joins are
@@ -187,9 +214,14 @@ class PagedDecodeEngine:
         # couples it to other state, so it rides no lock.
         self._cap_scale = 1.0
         self._audit_always = os.environ.get(ENV_POOL_AUDIT, "") == "1"
+        # cross-request prefix sharing (--prefix-cache; ISSUE 12):
+        # engine-scoped — a hot swap builds a fresh engine with a fresh
+        # cache, so stale-version pages are unreachable by construction
+        self.prefix = prefix_cache
 
         self._step_jit: Dict[int, object] = {}
         self._install_jit: Dict[int, object] = {}
+        self._fork_jit = None
 
         if registry is not None:
             self._declare_metrics(registry)
@@ -220,7 +252,10 @@ class PagedDecodeEngine:
         self.m_audit_failures = r.counter(
             "marian_serving_pool_audit_failures_total",
             "Pool invariant audits that found violations (double-free, "
-            "table/claim mismatch, leaked pages, row-exit leak)")
+            "table/claim mismatch, refcount drift, leaked pages, "
+            "row-exit leak)")
+        if self.prefix is not None:
+            self.prefix._declare_metrics(r)
 
     # -- capacity (any thread) ----------------------------------------------
     def active_rows(self) -> int:
@@ -233,11 +268,21 @@ class PagedDecodeEngine:
             return 0.0
         with self._lock:
             used_tokens = self._used_tokens
+        if self.prefix is not None:
+            # cache-held pages hold real (reusable) tokens — retention
+            # must not read as waste
+            used_tokens += self.prefix.held_tokens()
         return max(0.0, 1.0 - used_tokens
                    / float(used_pages * self.page_len))
 
     def free_pages(self) -> int:
-        return self.pool.free_pages()
+        """Free pages PLUS what evicting the prefix cache would free
+        right now — page-priced admission sees relievable pressure, and
+        the claim path relieves it before failing (_claim_pages)."""
+        free = self.pool.free_pages()
+        if self.prefix is not None:
+            free += self.prefix.reclaimable_pages(self.pool)
+        return free
 
     def free_slots(self) -> int:
         with self._lock:
@@ -293,13 +338,15 @@ class PagedDecodeEngine:
         # points are armed): they corrupt real state so the audit below
         # is proven against the bug classes it claims to catch
         self.pool.chaos_double_free()
+        self.pool.chaos_refcount_corrupt()
         self._chaos_table_corrupt()
         for key in evicts:
             self._evict(key)
         rows_before = self.active_rows()
         joiners: List[Tuple[object, List[int], int]] = []
         for key, text in joins:
-            why = self._try_claim(key, text, joiners, res.reject_detail)
+            why = self._try_claim(key, text, joiners, res.reject_detail,
+                                  res=res)
             if why is None:
                 res.accepted.append(key)
             else:
@@ -307,7 +354,9 @@ class PagedDecodeEngine:
         if joiners:
             self._install(joiners)
             if rows_before > 0:
-                res.mid_decode_joins = len(joiners)
+                # distinct keys, not joiner rows: a beam-k sentence
+                # installs k hypothesis rows but is ONE mid-decode join
+                res.mid_decode_joins = len({k for k, _, _ in joiners})
         if self.active_rows() > 0:
             self._step(res)
         if self._audit_always:
@@ -323,8 +372,8 @@ class PagedDecodeEngine:
         return res
 
     def _try_claim(self, key, text: str, joiners: List,
-                   detail: Optional[Dict[object, str]] = None
-                   ) -> Optional[str]:
+                   detail: Optional[Dict[object, str]] = None,
+                   res: Optional[StepResult] = None) -> Optional[str]:
         ids = self.src_vocab.encode(text, add_eos=True, inference=True)
         if len(ids) > self.src_cap:
             if detail is not None:
@@ -332,6 +381,17 @@ class PagedDecodeEngine:
                                f"the engine's source cap is "
                                f"{self.src_cap} (raise --max-length)")
             return "src_too_long"
+        src_key = tuple(int(i) for i in ids)
+        # cross-request prefix sharing (ISSUE 12): an exact repeat of a
+        # COMPLETED decode resolves instantly (greedy decode is
+        # deterministic, so the cached tokens are bitwise what a cold
+        # decode would emit); a repeat of a sentence decoding RIGHT NOW
+        # forks from it copy-on-write below
+        if self.prefix is not None and res is not None:
+            ent = self.prefix.get(src_key, self.prefix.version)
+            if ent is not None:
+                res.finished.append((key, ent.text))
+                return None
         cap = self.decode_cap(len(ids))
         n_pages = pages_for_tokens(cap, self.page_len)
         if n_pages > self.pool.max_pages_per_row:
@@ -345,8 +405,13 @@ class PagedDecodeEngine:
         with self._lock:
             if self._n_active >= self.max_rows:
                 return "no_slot"
+        if self.prefix is not None:
+            forked = self._try_fork(key, src_key, cap, n_pages, len(ids))
+            if forked is not None:
+                return None if forked else "no_pages"
+            self.prefix.note_miss()
         try:
-            pages = self.pool.claim(key, n_pages)
+            pages = self._claim_pages(key, n_pages)
         except PoolExhausted:
             # retriable only if the pool could EVER satisfy it
             if n_pages > self.pool.usable_pages:
@@ -363,9 +428,13 @@ class PagedDecodeEngine:
         # and with it the compiled row bucket — tight)
         with self._lock:
             slot = next(i for i, s in enumerate(self._slots) if s is None)
-            self._slots[slot] = _Slot(key, cap, len(ids))
+            self._slots[slot] = _Slot(key, cap, len(ids),
+                                      expected_refs=n_pages,
+                                      src_key=src_key)
             self._by_key[key] = slot
             self._n_active += 1
+        if self.prefix is not None:
+            self.prefix.register_live(src_key, key)
         # page table row on the host mirror; device copy goes with the
         # next step's table upload
         self._table[slot, :] = 0
@@ -373,7 +442,115 @@ class PagedDecodeEngine:
         joiners.append((key, ids, slot))
         return None
 
-    def _evict(self, key) -> bool:
+    def _claim_pages(self, key, n: int):
+        """Fresh-page claim with prefix-cache pressure relief: when the
+        free list is short, LRU cache entries are evicted (their held
+        references dropped) and the claim retried once."""
+        try:
+            return self.pool.claim(key, n)
+        except PoolExhausted:
+            if self.prefix is None \
+                    or not self.prefix.evict_for_pages(self.pool, n):
+                raise
+            return self.pool.claim(key, n)
+
+    def _try_fork(self, key, src_key, cap: int, n_pages: int,
+                  n_src: int) -> Optional[bool]:
+        """Copy-on-write fork from a LIVE row with the same source:
+        alias its full (append-only) pages with refcount++, content-copy
+        only its current partial page, copy its cross-attention rows
+        slot-to-slot (no encoder forward), and resume at its position.
+        Returns True (joined), False (fork viable but pool dry —
+        caller defers), or None (no fork source; caller takes the cold
+        path)."""
+        leader_key = self.prefix.leader(src_key)
+        if leader_key is None or leader_key == key:
+            return None
+        with self._lock:
+            slot_l = self._by_key.get(leader_key)
+            s_l = self._slots[slot_l] if slot_l is not None else None
+            # the leader must have stepped at least once (its encoder
+            # rows are installed) and price work identically (a brownout
+            # cap change between the two joins vetoes the fork)
+            if s_l is None or s_l.pos <= 0 or s_l.cap != cap:
+                return None
+            pos_l, prev_l, toks_l = s_l.pos, s_l.prev, list(s_l.tokens)
+        n_full = pos_l // self.page_len
+        has_partial = pos_l % self.page_len != 0
+        leader_pages = self.pool.pages_of(leader_key)
+        fulls = leader_pages[:n_full]
+        own_needed = n_pages - n_full
+
+        def build():
+            self.pool.share(key, fulls)
+            try:
+                return self.pool.claim_extra(key, own_needed)
+            except PoolExhausted:
+                self.pool.release(key)
+                raise
+        try:
+            own = build()
+        except PoolExhausted:
+            if not self.prefix.evict_for_pages(self.pool, own_needed):
+                return False
+            try:
+                own = build()
+            except PoolExhausted:
+                return False
+        with self._lock:
+            slot = next(i for i, s in enumerate(self._slots) if s is None)
+            s = _Slot(key, cap, n_src,
+                      expected_refs=n_full + own_needed, src_key=src_key)
+            s.tokens = toks_l
+            s.pos = pos_l
+            s.prev = prev_l
+            self._slots[slot] = s
+            self._by_key[key] = slot
+            self._n_active += 1
+            # invariant: _used_tokens == sum of active row positions
+            self._used_tokens += pos_l
+        self.prefix.register_live(src_key, key)
+        row = fulls + own
+        self._table[slot, :] = 0
+        self._table[slot, :len(row)] = row
+        # device half: cross-attn rows + source mask slot copy, plus the
+        # partial page's content (pairs of (0,0) are deterministic
+        # no-ops, used when the leader sat exactly on a page boundary)
+        src_page = leader_pages[n_full] if has_partial else 0
+        dst_page = own[0] if has_partial else 0
+        if self._fork_jit is None:
+            self._fork_jit = self._make_fork()
+        self._state, self._src_mask = self._fork_jit(
+            self._state, self._src_mask,
+            jnp.asarray([slot_l], jnp.int32),
+            jnp.asarray([slot], jnp.int32),
+            jnp.asarray([src_page], jnp.int32),
+            jnp.asarray([dst_page], jnp.int32))
+        self.prefix.note_fork(tokens_saved=pos_l, pages_reused=n_full)
+        return True
+
+    def _make_fork(self):
+        model = self.model
+        _, pool_keys, _ = self._state_key_groups()
+        k_keys = tuple(sorted(k for k in pool_keys
+                              if k.endswith("_pool_k")))
+
+        def fork(state, src_mask, src_slot, dst_slot,
+                 src_page, dst_page):
+            from ..ops.pallas.kv_pool import pool_fork_partial
+            new_state, new_mask = model.fork_paged_rows(
+                state, src_mask, src_slot, dst_slot)
+            for kk in k_keys:
+                vk = kk[:-1] + "v"
+                nk, nv = pool_fork_partial(new_state[kk], new_state[vk],
+                                           src_page, dst_page)
+                new_state[kk] = nk
+                new_state[vk] = nv
+            return new_state, new_mask
+
+        return jax.jit(fork, donate_argnums=(0, 1))
+
+    def _evict(self, key, adopt_text: Optional[str] = None) -> bool:
         with self._lock:
             slot = self._by_key.pop(key, None)
             if slot is None:
@@ -382,15 +559,29 @@ class PagedDecodeEngine:
             self._slots[slot] = None
             self._n_active -= 1
             self._used_tokens -= s.pos
-        released = self.pool.release(key)
+        if self.prefix is not None and s.src_key is not None:
+            self.prefix.unregister_live(s.src_key, key)
+        # normal finish with the prefix cache armed: the row's page
+        # references TRANSFER to the cache (refcounts unchanged) along
+        # with its decode, instead of a release — an exact repeat then
+        # replays the decode as a page-table hit (ISSUE 12)
+        released = 0
+        if adopt_text is not None and self.prefix is not None \
+                and s.src_key is not None:
+            released = self.prefix.adopt(self.pool, s.src_key, key,
+                                         s.tokens, adopt_text)
+        if released == 0:
+            released = self.pool.release(key)
         # row-exit leak detector (always on — one comparison): the row
-        # must give back exactly the pages its decode cap claimed; any
-        # drift means the claim table and the slot state diverged
-        expected = pages_for_tokens(s.cap, self.page_len)
+        # must give back exactly the page references it held (cap pages
+        # cold, aliased fulls + owned tail after a fork); any drift
+        # means the claim table and the slot state diverged
+        expected = s.expected_refs or pages_for_tokens(s.cap,
+                                                       self.page_len)
         if released != expected:
             self._report_audit(
-                [f"row exit released {released} page(s) for key "
-                 f"{key!r}, expected {expected} (cap {s.cap})"],
+                [f"row exit released {released} page reference(s) for "
+                 f"key {key!r}, expected {expected} (cap {s.cap})"],
                 context="row-exit")
         self._table[slot, :] = 0
         return True
@@ -414,6 +605,7 @@ class PagedDecodeEngine:
             n_active = self._n_active
             used_tokens = self._used_tokens
         v = self.pool.audit()
+        refs = self.pool.refcounts()
         active = [(i, s) for i, s in enumerate(slots) if s is not None]
         if n_active != len(active):
             v.append(f"active-row counter {n_active} != {len(active)} "
@@ -431,10 +623,23 @@ class PagedDecodeEngine:
                 v.append(f"slot {i} position {s.pos} past its decode "
                          f"cap {s.cap}")
             pages = self.pool.pages_of(s.key)
-            want = pages_for_tokens(s.cap, self.page_len)
+            want = s.expected_refs or pages_for_tokens(s.cap,
+                                                       self.page_len)
             if len(pages) != want:
-                v.append(f"slot {i} holds {len(pages)} claimed pages, "
-                         f"cap {s.cap} needs {want}")
+                v.append(f"slot {i} holds {len(pages)} page "
+                         f"reference(s), expected {want} (cap {s.cap})")
+            if pages:
+                # COW write safety (shared with the beam audit): the
+                # page this row WRITES — the one holding position pos —
+                # must be refcount-1; prefix forks alias only FULL
+                # pages, so a shared write target means the fork
+                # mis-split full/partial and every aliasing row's KV is
+                # being corrupted
+                wt = pages[min(s.pos // self.page_len, len(pages) - 1)]
+                if refs.get(wt, 0) != 1:
+                    v.append(f"slot {i} write-target page {wt} has "
+                             f"refcount {refs.get(wt, 0)} (COW safety: "
+                             f"partial pages must be exclusive)")
             if table is not None:
                 row = table[i]
                 if list(row[:len(pages)]) != pages \
@@ -442,10 +647,19 @@ class PagedDecodeEngine:
                     v.append(f"slot {i} page-table row "
                              f"{[int(p) for p in row]} does not match "
                              f"its claim {pages} (table corruption)")
+        cache_owners = (set(map(repr, self.prefix.owner_keys()))
+                        if self.prefix is not None else set())
         for owner in self.pool.owners():
-            if owner not in by_key:
-                v.append(f"pool claim for {owner!r} has no active row "
-                         f"(pages leaked at row exit)")
+            if owner in by_key:
+                continue
+            if self.prefix is not None and self.prefix.owns(owner):
+                if repr(owner) not in cache_owners:
+                    v.append(f"pool claim for prefix-cache owner "
+                             f"{owner!r} matches no cache entry "
+                             f"(stale cache claim)")
+                continue
+            v.append(f"pool claim for {owner!r} has no active row "
+                     f"(pages leaked at row exit)")
         if hasattr(self, "m_audits"):    # registry-less engines: no series
             self.m_audits.inc()
         if v:
@@ -493,12 +707,26 @@ class PagedDecodeEngine:
 
     def _install(self, joiners: List[Tuple[object, List[int], int]]) -> None:
         """Encode the joiners (one bucketed device call) and scatter
-        their cross-attention K/V + source masks into their slots."""
+        their cross-attention K/V + source masks into their slots. The
+        encode runs at the chunk's own LENGTH BUCKET, not the engine's
+        src_cap — a 5-token sentence must not pay a max-length-wide
+        encoder forward at every join (the cross K/V rows are zero-
+        padded to src_cap at scatter time; padded positions are masked,
+        so the decode is unchanged)."""
         jb = next((b for b in self.JOIN_BUCKETS if b >= len(joiners)),
                   self.JOIN_BUCKETS[-1])
         for base in range(0, len(joiners), jb):
             chunk = joiners[base:base + jb]
-            ids_np = np.zeros((jb, self.src_cap), np.int32)
+            # halving widths only (src_cap, /2, /4, ...): a handful of
+            # compiled encode shapes per join bucket, not one per
+            # length bucket — the same closed-shape-set discipline as
+            # ROW_BUCKETS (each extra shape is a multi-second inline
+            # jit the first join of that shape pays)
+            need = max(len(ids) for _, ids, _ in chunk)
+            w = self.src_cap
+            while w // 2 >= need and w // 2 >= 8:
+                w //= 2
+            ids_np = np.zeros((jb, w), np.int32)
             mask_np = np.zeros((jb, self.src_cap), np.float32)
             slot_np = np.zeros((jb,), np.int32)
             for i in range(jb):
@@ -510,7 +738,8 @@ class PagedDecodeEngine:
                 slot_np[i] = slot
             fn = self._install_jit.get(0)
             if fn is None:
-                # one jit object; its own cache specializes per jb shape
+                # one jit object; its own cache specializes per
+                # (jb, w) shape pair
                 fn = self._make_install()
                 self._install_jit[0] = fn
             self._state, self._src_mask = fn(
@@ -531,16 +760,27 @@ class PagedDecodeEngine:
         row_keys, _, _ = self._state_key_groups()
 
         def install(state, src_mask, params, ids, mask, slot_idx):
-            enc = model.encode_for_decode(params, ids, mask)
+            # ids arrive at the chunk's length bucket w <= src_cap;
+            # mask at full src_cap width (zeros past w)
+            w = ids.shape[1]
+            enc = model.encode_for_decode(params, ids, mask[:, :w])
             # want_alignment=True forces the unrolled cross-K/V layout,
             # matching the paged state's keys; the tiny dense self
             # caches it allocates are simply not copied
-            st = model.start_state(params, enc, mask, 1,
+            st = model.start_state(params, enc, mask[:, :w], 1,
                                    want_alignment=True)
             new_state = dict(state)
             for k in row_keys:
-                new_state[k] = state[k].at[slot_idx].set(
-                    st[k].astype(state[k].dtype))
+                v = st[k].astype(state[k].dtype)
+                # zero-pad the source axis out to src_cap: the padded
+                # positions are mask-dead, so attention never reads
+                # them (deterministic zeros, like the trash page).
+                # pad is SHAPE arithmetic (static at trace time); a
+                # 0-width pad is a no-op
+                pad = state[k].shape[-2] - v.shape[-2]
+                v = jnp.pad(v, [(0, 0)] * (v.ndim - 2)
+                            + [(0, pad), (0, 0)])
+                new_state[k] = state[k].at[slot_idx].set(v)
             new_mask = src_mask.at[slot_idx].set(
                 mask.astype(src_mask.dtype))
             return new_state, new_mask
@@ -639,7 +879,7 @@ class PagedDecodeEngine:
         for s in finishes:
             text = self.trg_vocab.decode(s.tokens, ignore_eos=True)
             res.finished.append((s.key, text))
-            self._evict(s.key)
+            self._evict(s.key, adopt_text=text)
         res.rows = emitted
         res.bucket = rb
         res.tokens = consumed
@@ -663,6 +903,10 @@ class PagedDecodeEngine:
                 if why in FATAL_REASONS:
                     raise ValueError(
                         f"sentence {key} rejected: {why}")
+                pending.insert(0, (key, texts[key]))
+            for key in res.pool_evicted:
+                # serving retries these against the (healthy) engine
+                # after the pressure passes; the library call does too
                 pending.insert(0, (key, texts[key]))
             for key, text in res.finished:
                 out[key] = text

@@ -1,0 +1,299 @@
+"""Cross-request prefix sharing over the paged KV pool (ISSUE 12).
+
+The heavy-traffic north star serves highly redundant traffic: doc-level
+translation re-sends overlapping sources, templated requests differ by a
+slot or two, and client retries re-send the whole sentence. Request-mode
+serving recomputes every one of them from scratch. This module turns an
+exact repeat of a source's token sequence into a PAGE-TABLE HIT instead
+of repeated compute, using the same refcount machinery that copy-on-
+write beam forking rides (ops/pallas/kv_pool.py):
+
+- LIVE fork: a request whose source matches a sentence that is decoding
+  RIGHT NOW joins as a follower — its cross-attention rows are copied
+  slot-to-slot (no encoder forward), its page table aliases the
+  leader's full (append-only, immutable) pages with refcount++, and
+  only the leader's current partial page is content-copied
+  (``pool_fork_partial``). The follower resumes at the leader's
+  position: the leader's decoded steps are compute the follower never
+  pays.
+- DONE entry: a finished sentence's pages transfer to the cache (owner
+  ``("prefix", key)``, refcounts unchanged) together with its decoded
+  tokens. A later exact repeat resolves instantly — greedy decode is
+  deterministic, so the cached tokens ARE what a cold decode would
+  produce (the bitwise-identity acceptance test pins this), and the
+  held pages are what the hit did NOT have to recompute and rewrite.
+- LRU under pool pressure: when a fresh claim cannot be satisfied, the
+  engine evicts least-recently-used entries (preferring those whose
+  pages are refcount-1 — actually freeable now) until the claim fits.
+
+Keys are the EXACT source token sequence (tuple of vocab ids). The
+encoder is bidirectional, so a strict token *prefix* of a different
+source does not share encoder states — exact match is the correctness
+boundary; "shared prefixes" in the traffic sense (retries, templates,
+doc re-sends) are exact duplicates at the sentence level, which is what
+loadgen ``--prefix-mix`` generates. Entries are stamped with the
+engine's model version and each engine owns its own cache, so a hot
+swap can never serve stale-version pages (the version-isolation test
+pins it).
+
+Threading: mutations happen on the serving scheduler's device worker
+thread; the metrics scrape thread reads the gauges — hence the lock.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..common import lockdep
+
+
+class PrefixEntry:
+    __slots__ = ("key", "tokens", "text", "pages", "version")
+
+    def __init__(self, key, tokens: List[int], text: str,
+                 pages: List[int], version: str):
+        self.key = key
+        self.tokens = tokens        # decoded target ids (no EOS)
+        self.text = text
+        self.pages = pages          # cache-held pool references
+        self.version = version
+
+
+class PrefixCache:
+    """(model_version, source-token-sequence) -> shared decode results
+    + refcounted KV pages. One instance per engine (per model version).
+    """
+
+    def __init__(self, max_entries: int = 64,
+                 version: str = "unversioned",
+                 registry=None):
+        self.max_entries = max(1, int(max_entries))
+        self.version = str(version)
+        self._lock = lockdep.make_lock("PrefixCache._lock")
+        # insertion-ordered: move_to_end on touch makes it the LRU list
+        self._done: "collections.OrderedDict[tuple, PrefixEntry]" = \
+            collections.OrderedDict()           # guarded-by: _lock
+        # src key -> leader row key while that sentence is decoding
+        self._live: Dict[tuple, object] = {}    # guarded-by: _lock
+        self._held_tokens = 0                   # guarded-by: _lock
+        self._declared = False
+        if registry is not None:
+            self._declare_metrics(registry)
+
+    # -- metrics ------------------------------------------------------------
+    def _declare_metrics(self, r) -> None:
+        self.m_hits = r.counter(
+            "marian_prefix_hits_total",
+            "Prefix-cache hits (live forks + completed-entry replays)")
+        self.m_misses = r.counter(
+            "marian_prefix_misses_total",
+            "Prefix-cache lookups that found no shareable source")
+        self.m_tokens_saved = r.counter(
+            "marian_prefix_tokens_saved_total",
+            "Decode steps NOT recomputed thanks to prefix sharing "
+            "(leader position at fork time; full decode length on a "
+            "completed-entry replay)")
+        self.m_pages_reused = r.counter(
+            "marian_prefix_pages_reused_total",
+            "KV pages served by table aliasing / cache retention "
+            "instead of being recomputed and rewritten")
+        self.m_evictions = r.counter(
+            "marian_prefix_evictions_total",
+            "Prefix-cache entries evicted (LRU capacity or pool "
+            "pressure); their page references were dropped")
+        self.m_entries = r.gauge(
+            "marian_prefix_entries",
+            "Completed decodes currently held by the prefix cache")
+        self.m_entries.set_function(self.entries)
+        self._declared = True
+
+    def _note_hit(self, tokens_saved: int, pages_reused: int) -> None:
+        if self._declared:
+            self.m_hits.inc()
+            if tokens_saved:
+                self.m_tokens_saved.inc(tokens_saved)
+            if pages_reused:
+                self.m_pages_reused.inc(pages_reused)
+
+    def note_miss(self) -> None:
+        if self._declared:
+            self.m_misses.inc()
+
+    # -- capacity / introspection (any thread) ------------------------------
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._done)
+
+    def held_tokens(self) -> int:
+        """Tokens resident in cache-held pages (the fragmentation gauge
+        folds these in so retained entries don't read as waste)."""
+        with self._lock:
+            return self._held_tokens
+
+    def owner(self, key: tuple):
+        return ("prefix", self.version, key)
+
+    def owner_keys(self) -> List[object]:
+        with self._lock:
+            return [self.owner(k) for k in self._done]
+
+    def owns(self, owner) -> bool:
+        return (isinstance(owner, tuple) and len(owner) == 3
+                and owner[0] == "prefix" and owner[1] == self.version)
+
+    def reclaimable_pages(self, pool) -> int:
+        """Pages evicting the whole cache would free RIGHT NOW (held
+        references whose page refcount is 1) — the engine adds this to
+        its free-page report so page-priced admission knows pressure can
+        be relieved before a claim actually fails. One refcount
+        snapshot, not a lock acquisition per page (this runs per
+        admission decision and per metrics scrape)."""
+        with self._lock:
+            pages = [p for e in self._done.values() for p in e.pages]
+        if not pages:
+            return 0
+        refs = pool.refcounts()
+        return sum(1 for p in pages if refs.get(p, 0) == 1)
+
+    # -- lookups (device worker thread) -------------------------------------
+    # Lock discipline throughout: PrefixCache._lock guards only the
+    # cache's own maps and is NEVER held across a pool or metrics call
+    # (mutations all happen on the single device worker thread, so the
+    # split windows race nothing; the lockdep witness pins the absence
+    # of nested acquisition).
+
+    def get(self, key: tuple, version: str) -> Optional[PrefixEntry]:
+        """Completed-entry lookup; touches LRU on hit. ``version`` must
+        match the entry's stamp — a stale-version entry is never served
+        (belt to the per-engine-cache braces)."""
+        with self._lock:
+            e = self._done.get(key)
+            if e is None or e.version != version:
+                return None
+            self._done.move_to_end(key)
+        self._note_hit(len(e.tokens) + 1, len(e.pages))
+        return e
+
+    def leader(self, key: tuple) -> Optional[object]:
+        """Row key of a live sentence with this exact source, if one is
+        decoding (the fork source). The caller verifies the row still
+        exists and counts the hit itself (fork setup can still fall
+        through to a cold join under pool pressure)."""
+        with self._lock:
+            return self._live.get(key)
+
+    def note_fork(self, tokens_saved: int, pages_reused: int) -> None:
+        self._note_hit(tokens_saved, pages_reused)
+
+    def register_live(self, key: tuple, row_key) -> None:
+        with self._lock:
+            self._live.setdefault(key, row_key)
+
+    def unregister_live(self, key: tuple, row_key) -> None:
+        with self._lock:
+            if self._live.get(key) is row_key or \
+                    self._live.get(key) == row_key:
+                del self._live[key]
+
+    # -- adoption + eviction (device worker thread) -------------------------
+    def adopt(self, pool, key: tuple, row_key, tokens: List[int],
+              text: str) -> int:
+        """A row with source ``key`` finished normally: transfer its
+        page references to the cache (refcounts unchanged) and remember
+        its decode. Returns the number of references adopted — 0 (the
+        caller releases normally) when an entry already exists or the
+        transfer moved nothing."""
+        with self._lock:
+            if key in self._done:
+                return 0
+        pages = pool.transfer(row_key, self.owner(key))
+        if not pages:
+            return 0
+        with self._lock:
+            self._done[key] = PrefixEntry(key, list(tokens), text,
+                                          pages, self.version)
+            self._held_tokens += len(tokens) + 1
+        self._trim_lru(pool)
+        return len(pages)
+
+    def remember(self, pool, key: tuple, tokens: List[int],
+                 text: str) -> bool:
+        """Pageless completed entry (the beam engine's replay memo: its
+        hypotheses' pages are released at finalize — the decode RESULT
+        is still deterministic per version, so an exact repeat replays
+        it without a decode). Shares the LRU/eviction/version plumbing
+        with page-backed entries."""
+        with self._lock:
+            if key in self._done:
+                return False
+            self._done[key] = PrefixEntry(key, list(tokens), text,
+                                          [], self.version)
+        self._trim_lru(pool)
+        return True
+
+    def _pop_entry(self, key: tuple) -> Optional[PrefixEntry]:
+        with self._lock:
+            e = self._done.pop(key, None)
+            if e is not None and e.pages:   # pageless memos held none
+                self._held_tokens -= len(e.tokens) + 1
+        return e
+
+    def _release_entry(self, pool, key: tuple,
+                       e: Optional[PrefixEntry]) -> bool:
+        if e is None:
+            return False
+        if e.pages:
+            pool.release(self.owner(key))
+        if self._declared:
+            self.m_evictions.inc()
+        return True
+
+    def _trim_lru(self, pool) -> None:
+        while True:
+            with self._lock:
+                if len(self._done) <= self.max_entries:
+                    return
+                key = next(iter(self._done))
+                e = self._done.pop(key)
+                if e.pages:
+                    self._held_tokens -= len(e.tokens) + 1
+            self._release_entry(pool, key, e)
+
+    def evict_for_pages(self, pool, n_needed: int) -> int:
+        """Pool pressure: drop LRU entries until ``n_needed`` pages are
+        free or the cache is empty — refcount-1 holdings first (those
+        actually free pages now; shared ones merely decref). Returns
+        entries evicted."""
+        evicted = 0
+        while pool.free_pages() < n_needed:
+            with self._lock:
+                # page-BACKED entries only: evicting a pageless memo
+                # (beam replay entries) frees nothing — without this
+                # filter one dry claim would wipe the whole replay
+                # cache for zero pages
+                items = [(k, list(e.pages))
+                         for k, e in self._done.items() if e.pages]
+            if not items:
+                break
+            refs = pool.refcounts()
+            key = next((k for k, pages in items
+                        if all(refs.get(p, 0) <= 1 for p in pages)),
+                       items[0][0])
+            if self._release_entry(pool, key, self._pop_entry(key)):
+                evicted += 1
+        return evicted
+
+    def drop_all(self, pool) -> int:
+        """Release every entry (engine teardown / tests)."""
+        n = 0
+        while True:
+            with self._lock:
+                key = next(iter(self._done), None)
+            if key is None:
+                break
+            if self._release_entry(pool, key, self._pop_entry(key)):
+                n += 1
+        with self._lock:
+            self._live.clear()
+        return n

@@ -238,6 +238,30 @@ def make_sentence(client: int, req: int, sent: int, words: int) -> str:
                     for w in range(words))
 
 
+# --prefix-mix: shared-source pool size. Small on purpose — redundant
+# traffic (doc re-sends, templated requests, retries) repeats a handful
+# of sources many times; that's the regime prefix sharing targets.
+PREFIX_POOL = 4
+
+
+def request_text(args, i: int, words: int) -> str:
+    """Body of request ``i``. With --prefix-mix P, a deterministic
+    fraction P of requests draw their sentences from a small SHARED
+    pool (exact repeats across the run) — the traffic shape the
+    server's --prefix-cache turns into page-table hits. Deterministic
+    per request index, so A/B runs (cold vs warm cache) see identical
+    traffic and must produce identical translations."""
+    p = float(getattr(args, "prefix_mix", 0.0) or 0.0)
+    if p > 0.0:
+        u = ((i * 1103515245 + 12345) % 1000) / 1000.0
+        if u < p:
+            j = i % PREFIX_POOL
+            return "\n".join(make_sentence(991, j, s, words)
+                             for s in range(args.sentences))
+    return "\n".join(make_sentence(i, i >> 3, s, words)
+                     for s in range(args.sentences))
+
+
 def _apply_headers(args, text: str, i: int) -> str:
     """Stack the protocol headers this run asked for: #trace outermost
     (the server strips it first), then #priority."""
@@ -256,9 +280,8 @@ async def run_clients(args, request_fn):
 
     async def one_client(cid: int):
         for r in range(args.requests):
-            text = "\n".join(
-                make_sentence(cid, r, s, args.words)
-                for s in range(args.sentences))
+            text = request_text(args, cid * args.requests + r,
+                                args.words)
             text = _apply_headers(args, text, cid * args.requests + r)
             t0 = time.perf_counter()
             try:
@@ -319,8 +342,7 @@ async def run_stream(args, request_fn, rate=None, duration=None):
 
     async def fire(i: int):
         words = mixed_words(i, args.words, len_mix)
-        text = "\n".join(make_sentence(i, i >> 3, s, words)
-                         for s in range(args.sentences))
+        text = request_text(args, i, words)
         text = _apply_headers(args, text, i)
         rel = time.perf_counter() - t0
         t = time.perf_counter()
@@ -559,6 +581,15 @@ def main(argv=None) -> int:
                          "proves joins happened). Deterministic per "
                          "request index, so A/B runs see identical "
                          "traffic")
+    ap.add_argument("--prefix-mix", type=float, default=0.0,
+                    help="fraction of requests drawn from a small "
+                         "SHARED sentence pool (exact repeats — the "
+                         "redundant-traffic shape --prefix-cache turns "
+                         "into page-table hits). Deterministic per "
+                         "request index, so cold-vs-warm A/B runs see "
+                         "identical traffic; with --metrics-port the "
+                         "summary adds the server's prefix hit rate, "
+                         "tokens saved and pages reused")
     ap.add_argument("--sweep", default="",
                     help="capacity mode (ISSUE 9 / ROADMAP 4): comma-"
                          "separated offered rates in req/s (e.g. "
@@ -707,6 +738,21 @@ def _report_server_delta(before: dict, after: dict) -> None:
           f"sentences/batch={sent / batches if batches else 0:.2f} "
           f"mean_fill={fill_sum / fill_n if fill_n else 0:.3f} "
           f"shed={shed:.0f} timeouts={timeouts:.0f}")
+    hits = _delta(before, after, "marian_prefix_hits_total")
+    misses = _delta(before, after, "marian_prefix_misses_total")
+    if hits or misses:
+        # prefix-sharing column (ISSUE 12): the --prefix-mix acceptance
+        # reads this line — hits > 0 and pages_reused > 0 prove repeats
+        # became page-table hits instead of recompute
+        print(f"server: prefix_hit_rate="
+              f"{hits / (hits + misses) if hits + misses else 0:.3f} "
+              f"prefix_hits={hits:.0f} "
+              f"tokens_saved="
+              f"{_delta(before, after, 'marian_prefix_tokens_saved_total'):.0f} "
+              f"pages_reused="
+              f"{_delta(before, after, 'marian_prefix_pages_reused_total'):.0f} "
+              f"prefix_evictions="
+              f"{_delta(before, after, 'marian_prefix_evictions_total'):.0f}")
     joins = _delta(before, after, "marian_serving_joins_total")
     if joins:
         # iteration-mode deltas: mid-decode joins are the proof that

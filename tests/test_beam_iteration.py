@@ -1,0 +1,563 @@
+"""COW paged beam search + cross-request prefix sharing (ISSUE 12):
+refcounted page sharing in the KV pool, the beam iteration engine's
+bitwise equivalence to full replication (and token parity vs the dense
+beam search), worst-case-owned admission pricing, the prefix cache's
+hit/miss/eviction/version-isolation semantics, the refcount-corruption
+drill, and the metric census for every new series. Runs under
+JAX_PLATFORMS=cpu with a tiny real transformer; MARIAN_POOL_AUDIT=1
+(conftest) audits every round."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import faultpoints as fp
+from marian_tpu.data.vocab import DefaultVocab, EOS_ID
+from marian_tpu.ops.pallas.kv_pool import (KVPool, PoolCorruption,
+                                           PoolExhausted)
+from marian_tpu.serving import metrics as msm
+from marian_tpu.serving.scheduler import ContinuousScheduler, RowEvicted
+from marian_tpu.translator.beam_iteration import PagedBeamEngine
+from marian_tpu.translator.beam_search import BeamConfig, beam_search_jit
+from marian_tpu.translator.iteration import PagedDecodeEngine
+from marian_tpu.translator.prefix_cache import PrefixCache
+
+from tests.test_beam_search import tiny_model
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockdep_witness(lockdep_witness):
+    """KVPool._lock / PrefixCache._lock / engine locks cross the device
+    worker and the metrics scrape thread here; the shared witness pins
+    the observed acquisition orders inside the static lattice."""
+    yield
+
+
+VOCAB_WORDS = [" ".join(f"w{i}" for i in range(35))]
+TEXTS = ["w3 w4 w5", "w6 w7", "w8 w9 w10 w11", "w2 w3",
+         "w4 w4 w4 w4 w4"]
+K = 3
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    vocab = DefaultVocab.build(VOCAB_WORDS)
+    model, params, _ = tiny_model(vocab=len(vocab), seed=7,
+                                  **{"dec-depth": 2, "enc-depth": 2})
+    return model, params, vocab
+
+
+def make_beam_engine(tiny, registry=None, prefix=None, **kw):
+    model, params, vocab = tiny
+    args = dict(beam_size=K, normalize=0.6, max_rows=2 * K, page_len=4,
+                src_len_cap=8, max_length_cap=12, registry=registry,
+                prefix_cache=prefix)
+    args.update(kw)
+    return PagedBeamEngine(model, params, vocab, vocab, **args)
+
+
+def make_greedy_engine(tiny, registry=None, prefix=None, **kw):
+    model, params, vocab = tiny
+    args = dict(max_rows=4, page_len=4, src_len_cap=8,
+                max_length_cap=12, registry=registry,
+                prefix_cache=prefix)
+    args.update(kw)
+    return PagedDecodeEngine(model, params, vocab, vocab, **args)
+
+
+def drive(eng, texts):
+    """Decode texts through the slot machinery, retrying deferred and
+    pool-evicted sentences; returns (texts-by-key, info-by-key)."""
+    outs, infos = {}, {}
+    pending = list(enumerate(texts))
+    guard = 0
+    while pending or not eng.idle():
+        joins = []
+        while pending and len(joins) < max(1, eng.free_slots()):
+            joins.append(pending.pop(0))
+        res = eng.admit_and_step(joins)
+        for key, why in res.rejected:
+            assert why in ("no_slot", "no_pages"), (key, why)
+            pending.insert(0, (key, texts[key]))
+        for key in res.pool_evicted:
+            pending.insert(0, (key, texts[key]))
+        outs.update(dict(res.finished))
+        infos.update(res.finished_info)
+        guard += 1
+        assert guard < 1000, "beam decode failed to converge"
+    assert eng.audit(context="test") == []
+    return outs, infos
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# refcounted pool (satellite: audit invariants + drill)
+# ---------------------------------------------------------------------------
+
+class TestRefcountedPool:
+    def test_share_retable_release_refcounts(self):
+        p = KVPool(9, page_len=4)
+        a = p.claim("a", 3)
+        p.share("b", a[:2])
+        own = p.claim_extra("b", 1)
+        assert p.refcount(a[0]) == 2 and p.refcount(a[2]) == 1
+        assert p.audit() == []
+        assert p.release("a") == 3          # references dropped, not
+        assert p.refcount(a[0]) == 1        # pages: b still holds them
+        assert p.free_pages() == 8 - 3      # only a's exclusive page
+        freed = p.retable("b", [own[0]])    # drop the aliases: with
+        assert freed == 2                   # a gone, their last refs
+        assert p.refcount(a[0]) == 0        # drop and the pages free
+        assert p.free_pages() == 8 - 1
+        assert p.audit() == []
+        p.release("b")
+        assert p.free_pages() == 8 and p.audit() == []
+
+    def test_transfer_moves_references(self):
+        p = KVPool(9, page_len=4)
+        a = p.claim("row", 2)
+        assert p.transfer("row", ("prefix", "v", "k")) == a
+        assert p.pages_of("row") == []
+        assert p.pages_of(("prefix", "v", "k")) == a
+        assert p.audit() == []
+
+    def test_share_dead_page_refused(self):
+        p = KVPool(9, page_len=4)
+        a = p.claim("a", 1)
+        p.release("a")
+        with pytest.raises(ValueError, match="not live"):
+            p.share("b", a)
+
+    def test_audit_refcount_invariants(self):
+        """The three satellite invariants: reference-sum == refcount,
+        no freed page with refcount > 0, no refcount-0 page outside
+        the free list."""
+        p = KVPool(9, page_len=4)
+        a = p.claim("a", 2)
+        p.share("b", a[:1])
+        # (1) refcount drift vs table references
+        p._refs[a[0]] += 1
+        bad = p.audit()
+        assert any("refcount drift" in v or "refcount" in v
+                   for v in bad), bad
+        p._refs[a[0]] -= 1
+        assert p.audit() == []
+        # (2) freed page with live refcount
+        p._free.append(a[1])
+        bad = p.audit()
+        assert any("free but still has refcount" in v
+                   or "double-free" in v for v in bad), bad
+        p._free.pop()
+        # (3) phantom refcount: no table reference names it
+        ghost = p._free[-1]
+        p._refs[ghost] = 1
+        p._free.pop()
+        bad = p.audit()
+        assert any("phantom" in v for v in bad), bad
+
+    def test_refcount_corrupt_drill_detected(self, tiny):
+        """The pool.refcount_corrupt catalog point bumps a REAL live
+        refcount without a table reference; the continuous audit must
+        catch it and fail the round with the retriable PoolCorruption."""
+        reg = msm.Registry()
+        eng = make_beam_engine(tiny, registry=reg)
+        eng.admit_and_step([(0, TEXTS[0])])
+        with fp.active("pool.refcount_corrupt=fail@1"):
+            with pytest.raises(PoolCorruption, match="audit failed"):
+                eng.admit_and_step([])
+        assert reg.get(
+            "marian_serving_pool_audit_failures_total").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# COW beam: bitwise vs replication, token parity vs dense beam search
+# ---------------------------------------------------------------------------
+
+class TestBeamParity:
+    def _dense_best(self, tiny, text):
+        model, params, vocab = tiny
+        ids = vocab.encode(text, add_eos=True, inference=True)
+        L = int(min(12, max(8, round(3.0 * len(ids)))))
+        cfg = BeamConfig(beam_size=K, normalize=0.6, max_length=L)
+        src = jnp.asarray(np.array([ids], np.int32))
+        mask = jnp.ones((1, len(ids)), jnp.float32)
+        toks, scores, lengths, norm, _, _ = beam_search_jit(
+            model, [params], [1.0], cfg, src, mask)
+        toks, scores, lengths, norm = map(
+            np.asarray, (toks, scores, lengths, norm))
+        j = np.argsort(-norm[0], kind="stable")[0]
+        ln = int(lengths[0, j])
+        tl = toks[0, j, :ln].tolist()
+        if tl and tl[-1] == EOS_ID:
+            tl = tl[:-1]
+        return tl, float(scores[0, j]), ln
+
+    def test_cow_bitwise_equals_replication(self, tiny):
+        """THE COW correctness property: aliasing full pages + forking
+        only partials produces BITWISE the tokens and raw path scores
+        of full per-child page replication (the dense reorder's data
+        movement over the same pool) — mid-decode forks included, since
+        every reorder with two live children of one parent is one."""
+        cow_o, cow_i = drive(make_beam_engine(tiny, cow=True), TEXTS)
+        eng = make_beam_engine(tiny, cow=False)
+        rep = make_beam_engine(tiny, cow=False,
+                               pool_bytes=64 * eng.page_bytes)
+        rep_o, rep_i = drive(rep, TEXTS)
+        assert cow_o == rep_o
+        for k in cow_i:
+            assert cow_i[k]["tokens"] == rep_i[k]["tokens"]
+            assert np.float32(cow_i[k]["score"]) \
+                == np.float32(rep_i[k]["score"])
+
+    def test_freed_then_reforked_rows_stay_bitwise(self, tiny):
+        """Rows freed mid-decode and reforked onto RECYCLED pages stay
+        bitwise: (a) a sentence evicted mid-decode (pages freed) and
+        rejoined re-decodes onto the just-freed pages identically; (b)
+        a long-lived engine whose every sentence reuses its
+        predecessors' pages (LIFO free list) matches fresh engines."""
+        eng = make_beam_engine(tiny, max_rows=K)
+        eng.admit_and_step([(0, TEXTS[4])])
+        for _ in range(4):
+            eng.admit_and_step([])
+        eng.admit_and_step([], evicts=[0])    # freed mid-decode
+        assert eng.pool.free_pages() == eng.pool.usable_pages
+        assert eng.audit(context="test") == []
+        re_o, re_i = drive(eng, [TEXTS[4]])   # refork onto freed pages
+        fresh_o, fresh_i = drive(make_beam_engine(tiny, max_rows=K),
+                                 [TEXTS[4]])
+        assert re_o == fresh_o
+        assert np.float32(re_i[0]["score"]) \
+            == np.float32(fresh_i[0]["score"])
+        # (b): sequential reuse of one engine's recycled pages
+        for i, t in enumerate(TEXTS):
+            o, inf = drive(eng, [t])
+            f_o, f_i = drive(make_beam_engine(tiny, max_rows=K), [t])
+            assert o == f_o, i
+            assert np.float32(inf[0]["score"]) \
+                == np.float32(f_i[0]["score"]), i
+
+    def test_token_parity_vs_dense_beam_search(self, tiny):
+        """End-to-end vs translator/beam_search.py: identical winning
+        tokens and hypothesis lengths; raw scores agree to accumulated-
+        ULP tolerance (the paged attention read and the dense cache
+        path order a handful of f32 ops differently — the same
+        tolerance class the greedy paged parity lives with; the
+        BITWISE pin for the COW machinery itself is the replication
+        test above)."""
+        _, infos = drive(make_beam_engine(tiny), TEXTS)
+        for i, t in enumerate(TEXTS):
+            tl, score, ln = self._dense_best(tiny, t)
+            mine = infos[i]
+            crop = mine["tokens"][:mine["length"]]
+            if crop and crop[-1] == EOS_ID:
+                crop = crop[:-1]
+            assert crop == tl, (i, crop, tl)
+            assert mine["length"] == ln
+            assert abs(mine["score"] - score) < 1e-4
+
+    def test_mid_decode_join_beside_running_beam(self, tiny):
+        eng = make_beam_engine(tiny)
+        r0 = eng.admit_and_step([(0, TEXTS[0])])
+        assert r0.accepted == [0] and r0.mid_decode_joins == 0
+        for _ in range(3):
+            eng.admit_and_step([])
+        r1 = eng.admit_and_step([(1, TEXTS[1])])
+        assert r1.accepted == [1] and r1.mid_decode_joins == 1
+        outs = dict(r0.finished + r1.finished)
+        guard = 0
+        while not eng.idle():
+            outs.update(dict(eng.admit_and_step([]).finished))
+            guard += 1
+            assert guard < 200
+        solo0, _ = drive(make_beam_engine(tiny, max_rows=K), [TEXTS[0]])
+        solo1, _ = drive(make_beam_engine(tiny, max_rows=K), [TEXTS[1]])
+        assert outs[0] == solo0[0] and outs[1] == solo1[0]
+        assert eng.pool.free_pages() == eng.pool.usable_pages
+
+
+# ---------------------------------------------------------------------------
+# admission pricing (satellite: worst-case OWNED pages, not kx)
+# ---------------------------------------------------------------------------
+
+class TestBeamPricing:
+    def test_beam_priced_at_owned_pages_not_k_times(self, tiny):
+        greedy = make_greedy_engine(tiny)
+        beam6 = make_beam_engine(tiny, beam_size=6, max_rows=6)
+        text = TEXTS[0]
+        base = greedy.pages_for_text(text)
+        priced = beam6.pages_for_text(text)
+        assert priced == base + 5            # trunk + (k-1) partials
+        assert priced < 6 * base             # never kx replication
+
+    def test_beam6_request_not_shed_at_6x(self, tiny):
+        """Regression: a beam-6 request against a page bound sized for
+        trunk+partials admission must NOT shed as if it replicated its
+        trunk 6x."""
+        from marian_tpu.serving.admission import AdmissionController
+        beam6 = make_beam_engine(tiny, beam_size=6, max_rows=6)
+        reg = msm.Registry()
+        sched = ContinuousScheduler(None, registry=reg,
+                                    batching_mode="iteration",
+                                    engine=beam6, window_s=0.0)
+        priced = beam6.pages_for_text(TEXTS[0])
+        adm = AdmissionController(0, sched.queued_units, registry=reg,
+                                  max_queue_pages=priced,
+                                  pages_fn=sched.queued_pages)
+        adm.admit(1, n_pages=priced)         # fits exactly: admitted
+        naive = 6 * make_greedy_engine(tiny).pages_for_text(TEXTS[0])
+        assert naive > priced                # the old pricing would shed
+
+
+# ---------------------------------------------------------------------------
+# serving: beam engine through the iteration scheduler (+ quiesce)
+# ---------------------------------------------------------------------------
+
+def make_sched(tiny, registry=None, engine=None, **kw):
+    reg = registry if registry is not None else msm.Registry()
+    eng = engine if engine is not None else make_beam_engine(
+        tiny, registry=reg)
+    sched = ContinuousScheduler(None, registry=reg,
+                                batching_mode="iteration", engine=eng,
+                                window_s=0.0, **kw)
+    return sched, eng, reg
+
+
+class TestBeamServing:
+    def test_end_to_end_beam_serving(self, tiny):
+        sched, eng, reg = make_sched(tiny)
+
+        async def main():
+            sched.start()
+            f1 = sched.submit(TEXTS[:2])
+            await asyncio.sleep(0.05)
+            f2 = sched.submit([TEXTS[2]])     # lands mid-decode
+            r1, r2 = await f1, await f2
+            await sched.stop()
+            return r1, r2
+
+        r1, r2 = run(main())
+        solo = {}
+        for i in range(3):
+            o, _ = drive(make_beam_engine(tiny, max_rows=K),
+                         [TEXTS[i]])
+            solo[i] = o[0]
+        assert r1 == [solo[0], solo[1]] and r2 == [solo[2]]
+        assert sched.m_joins.value == 3
+        assert eng.audit(context="test") == []
+        assert eng.pool.free_pages() == eng.pool.usable_pages
+
+    def test_cancel_mid_decode_frees_refcounted_rows(self, tiny):
+        sched, eng, reg = make_sched(tiny)
+
+        async def main():
+            sched.start()
+            f1 = sched.submit([TEXTS[4]])
+            await asyncio.sleep(0.05)
+            f1.cancel()
+            f2 = sched.submit([TEXTS[1]])
+            await f2
+            for _ in range(50):
+                if sched.m_evictions.value:
+                    break
+                await asyncio.sleep(0.01)
+            await sched.stop()
+
+        run(main())
+        assert sched.m_evictions.value >= 1
+        assert eng.idle()
+        assert eng.audit(context="test") == []
+        assert eng.pool.free_pages() == eng.pool.usable_pages
+
+    def test_pool_evicted_rows_fail_retriably(self, tiny):
+        """Mid-decode COW exhaustion resolves the victim with the
+        retriable RowEvicted — never a hang, never silent corruption."""
+        roomy = make_beam_engine(tiny, max_rows=K)
+        tight = make_beam_engine(tiny, max_rows=2 * K,
+                                 pool_bytes=8 * roomy.page_bytes)
+        sched, eng, reg = make_sched(tiny, engine=tight)
+
+        async def main():
+            sched.start()
+            futs = [sched.submit([t]) for t in TEXTS]
+            evicted = 0
+            for f in futs:
+                try:
+                    await asyncio.wait_for(f, timeout=120)
+                except RowEvicted:
+                    evicted += 1
+            await sched.stop()
+            return evicted
+
+        evicted = run(main())
+        # under this pool some sentence must have been pool-evicted OR
+        # deferred-and-served; either way the pool ends clean
+        assert evicted >= 0
+        assert tight.audit(context="test") == []
+        assert tight.pool.free_pages() == tight.pool.usable_pages
+
+    def test_quiesce_with_refcounted_rows(self, tiny):
+        """A quiesce mid-beam-decode drains/evicts refcounted rows,
+        audits both engines clean, and re-points at the new beam
+        engine (the ISSUE 12 acceptance's swap-mid-run leg)."""
+        sched, eng, reg = make_sched(tiny)
+        new_eng = make_beam_engine(tiny)
+
+        async def main():
+            sched.start()
+            f1 = sched.submit([TEXTS[4]])
+            await asyncio.sleep(0.05)         # decoding now
+            loop = asyncio.get_event_loop()
+            op = await loop.run_in_executor(
+                None, lambda: sched.request_quiesce(
+                    lambda: sched.install_engine(new_eng),
+                    deadline_s=0.0, reason="test-swap", wait=True,
+                    timeout=60))
+            try:
+                await f1
+            except RowEvicted:
+                pass                          # deadline 0: evicted
+            f2 = sched.submit([TEXTS[1]])
+            r2 = await f2
+            await sched.stop()
+            return op, r2
+
+        op, r2 = run(main())
+        assert op.ok and op.install_ok
+        assert sched.engine is new_eng
+        solo, _ = drive(make_beam_engine(tiny, max_rows=K), [TEXTS[1]])
+        assert r2 == [solo[0]]
+        assert eng.audit(context="test") == []
+        assert new_eng.audit(context="test") == []
+        assert eng.pool.free_pages() == eng.pool.usable_pages
+
+
+# ---------------------------------------------------------------------------
+# cross-request prefix sharing
+# ---------------------------------------------------------------------------
+
+class TestPrefixCache:
+    def test_live_fork_and_done_replay_bitwise_vs_cold(self, tiny):
+        """The acceptance identity: with >= 50% shared-prefix traffic,
+        warm-cache outputs are bitwise the cold-cache outputs, hit
+        metrics count pages reused > 0, and audits stay clean."""
+        cold = make_greedy_engine(tiny).decode_texts(TEXTS)
+        reg = msm.Registry()
+        cache = PrefixCache(max_entries=8, version="v1", registry=reg)
+        eng = make_greedy_engine(tiny, registry=reg, prefix=cache)
+        # leader decodes a few rounds, then an exact repeat forks live
+        r = eng.admit_and_step([(0, TEXTS[4])])
+        assert r.accepted == [0]
+        for _ in range(5):
+            eng.admit_and_step([])
+        r2 = eng.admit_and_step([(1, TEXTS[4])])
+        assert r2.accepted == [1]
+        assert cache.m_hits.value == 1
+        assert cache.m_pages_reused.value >= 1
+        assert cache.m_tokens_saved.value >= 1
+        outs = {}
+        guard = 0
+        while not eng.idle():
+            outs.update(dict(eng.admit_and_step([]).finished))
+            guard += 1
+            assert guard < 200
+        assert outs[0] == outs[1] == cold[4]
+        # completed-entry replay: instant, no decode, same text
+        r3 = eng.admit_and_step([(2, TEXTS[4])])
+        assert r3.accepted == [2]
+        assert dict(r3.finished)[2] == cold[4]
+        assert cache.m_hits.value == 2
+        assert eng.audit(context="test") == []
+
+    def test_fifty_percent_shared_traffic_identical_to_cold(self, tiny):
+        traffic = [TEXTS[4], TEXTS[0], TEXTS[4], TEXTS[1], TEXTS[4],
+                   TEXTS[0], TEXTS[4], TEXTS[0]]
+        cold = make_greedy_engine(tiny).decode_texts(traffic)
+        cache = PrefixCache(max_entries=8, version="v1")
+        warm = make_greedy_engine(tiny, prefix=cache).decode_texts(
+            traffic)
+        assert warm == cold
+        assert cache.entries() > 0
+
+    def test_pool_pressure_evicts_lru_entries(self, tiny):
+        """Cache-held pages yield to live claims: a join that would
+        fail claims pages back from LRU entries instead of deferring
+        forever."""
+        reg = msm.Registry()
+        cache = PrefixCache(max_entries=8, version="v1", registry=reg)
+        # pool fits exactly one sentence's 3 pages
+        eng = make_greedy_engine(tiny, registry=reg, prefix=cache,
+                                 max_rows=2,
+                                 pool_bytes=3 * 2 * 2 * 2 * 4 * 8 * 4)
+        assert eng.pool.usable_pages == 3
+        outs = eng.decode_texts([TEXTS[0]])
+        assert cache.entries() == 1          # pages now cache-held
+        assert eng.pool.free_pages() == 0
+        assert eng.free_pages() == 3         # reclaimable counts
+        outs2 = eng.decode_texts([TEXTS[1]])  # forces the eviction
+        assert cache.m_evictions.value >= 1
+        assert outs2 == [make_greedy_engine(tiny).decode_texts(
+            [TEXTS[1]])[0]]
+        assert eng.audit(context="test") == []
+
+    def test_version_isolation_across_swap(self, tiny):
+        """A swap must not serve stale-version pages/outputs: engines
+        are cache-scoped, and even a (hypothetically) shared cache
+        refuses entries stamped with another version."""
+        cache_a = PrefixCache(max_entries=8, version="vA")
+        eng_a = make_greedy_engine(tiny, prefix=cache_a)
+        eng_a.decode_texts([TEXTS[0]])
+        assert cache_a.entries() == 1
+        key = next(iter(cache_a._done))
+        # belt: version-stamped entries don't cross versions
+        assert cache_a.get(key, "vB") is None
+        assert cache_a.get(key, "vA") is not None
+        # braces: the swapped-in engine owns a FRESH cache — no hits
+        reg_b = msm.Registry()
+        cache_b = PrefixCache(max_entries=8, version="vB",
+                              registry=reg_b)
+        eng_b = make_greedy_engine(tiny, registry=reg_b,
+                                   prefix=cache_b)
+        out_b = eng_b.decode_texts([TEXTS[0]])
+        assert cache_b.m_hits.value == 0
+        assert cache_b.m_misses.value >= 1
+        assert out_b == make_greedy_engine(tiny).decode_texts(
+            [TEXTS[0]])
+
+    def test_beam_engine_replays_completed_decodes(self, tiny):
+        reg = msm.Registry()
+        cache = PrefixCache(max_entries=8, version="v1", registry=reg)
+        eng = make_beam_engine(tiny, registry=reg, prefix=cache)
+        first, _ = drive(eng, [TEXTS[3]])
+        assert cache.entries() == 1
+        r = eng.admit_and_step([(1, TEXTS[3])])
+        assert r.accepted == [1]
+        assert dict(r.finished)[1] == first[0]
+        assert cache.m_hits.value == 1
+        assert eng.audit(context="test") == []
+
+
+# ---------------------------------------------------------------------------
+# metric census (every new series is declared and scrapeable)
+# ---------------------------------------------------------------------------
+
+class TestMetricCensus:
+    def test_prefix_and_beam_series_render(self, tiny):
+        reg = msm.Registry()
+        cache = PrefixCache(max_entries=4, version="v1", registry=reg)
+        eng = make_greedy_engine(tiny, registry=reg, prefix=cache)
+        eng.decode_texts([TEXTS[0], TEXTS[0]])
+        text = reg.render()
+        for name in ("marian_prefix_hits_total",
+                     "marian_prefix_misses_total",
+                     "marian_prefix_tokens_saved_total",
+                     "marian_prefix_pages_reused_total",
+                     "marian_prefix_evictions_total",
+                     "marian_prefix_entries"):
+            assert name in text, name
+        from marian_tpu.serving.promlint import lint_metrics_text
+        assert lint_metrics_text(text) == []

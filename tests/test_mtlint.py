@@ -701,7 +701,21 @@ class TestConfig:
         cfg = Config.load(ROOT)
         assert "marian_tpu/ops" in cfg.rule_dirs["dtype"]
         assert "marian_tpu/serving" in cfg.rule_dirs["guarded-by"]
+        # ISSUE 12 pin: the paged engines + prefix cache live in
+        # translator/ — their locks must stay inside the race gate
+        assert "marian_tpu/translator" in cfg.rule_dirs["guarded-by"]
         assert cfg.excluded("marian_tpu/analysis/core.py")
+
+    def test_prefix_cache_lock_discovered(self):
+        """ISSUE 12 satellite: the static analysis discovers the new
+        PrefixCache._lock (lockdep witness + lock_order.dot depend on
+        it) and the committed graph names it."""
+        dot = (ROOT / "docs" / "lock_order.dot").read_text()
+        assert '"PrefixCache._lock"' in dot
+        src = (ROOT / "marian_tpu" / "translator"
+               / "prefix_cache.py").read_text()
+        assert 'lockdep.make_lock("PrefixCache._lock")' in src
+        assert "guarded-by: _lock" in src
 
     def test_every_advertised_rule_id_has_an_owner(self):
         families = {r.family for r in all_rules()}

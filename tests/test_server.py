@@ -113,3 +113,35 @@ def test_server_e2e_tcp_fallback(tmp_path, monkeypatch):
     r1, r2 = asyncio.run(_drive_serve(sopts, clients))
     assert isinstance(r1, str)
     assert r2.count("\n") == 1
+
+
+def test_server_e2e_iteration_beam_with_prefix_cache(tmp_path, monkeypatch):
+    """ISSUE 12 acceptance leg: the server no longer refuses beam>1 in
+    iteration mode — COW-paged beam serving works end-to-end on the
+    real CPU server (TCP framing), with --prefix-cache turning an
+    exact repeat into a hit whose reply is identical to the cold one
+    (deterministic decode)."""
+    from marian_tpu.server import server as srv
+    monkeypatch.setattr(srv, "HAVE_WS", False)
+
+    # seed 3 decodes short nonempty outputs WITH a mid-decode EOS (one
+    # hypothesis freezes while its sibling continues — the COW path's
+    # page-free-at-freeze leg runs on the real server)
+    base = _tiny_server_options(tmp_path, seed=3)
+    dense = srv.TranslationService(base).translate_lines(["w3 w4 w5"])
+    sopts = base.with_(**{
+        "batching-mode": "iteration", "beam-size": 2,
+        "iteration-rows": 8, "kv-page-len": 4,
+        "prefix-cache": True})
+
+    async def clients(port):
+        cold = await _tcp_request(port, "w3 w4 w5")
+        warm = await _tcp_request(port, "w3 w4 w5")   # exact repeat
+        multi = await _tcp_request(port, "w6 w7\nw8 w9")
+        return cold, warm, multi
+
+    cold, warm, multi = asyncio.run(_drive_serve(sopts, clients))
+    assert cold and not cold.startswith("!!SERVER-")
+    assert cold == dense[0]              # paged beam == dense beam
+    assert warm == cold                  # prefix replay == cold decode
+    assert multi.count("\n") == 1
