@@ -20,6 +20,7 @@ import os
 
 from .flight import FLIGHT, FlightRecorder               # noqa: F401
 from .perf import PERF, PerfMeter                        # noqa: F401
+from .poolz import pool_routes                           # noqa: F401
 from .trace import (NOOP_SPAN, Span, Tracer, TRACER,     # noqa: F401
                     current, enabled, end, event, new_trace_id, set_attrs,
                     span, start_span, trace_routes)

@@ -176,6 +176,16 @@ class KVPool:
         # page -> live reference count; a page is EITHER here (>= 1) or
         # on the free list, never both and never absent from both
         self._refs: Dict[int, int] = {}             # guarded-by: _lock
+        # cumulative traffic counters (ISSUE 14 pool telemetry):
+        #  claimed — fresh pages popped off the free list;
+        #  freed   — pages returned to the free list (last ref dropped);
+        #  aliased — references added to ALREADY-LIVE pages (the
+        #            copy-on-write shares: beam forks, prefix hits,
+        #            retable increfs of newly shared pages).
+        # The engines read round deltas of these for the serve.round
+        # span and the pages_*_total series.
+        self._stats = {"claimed": 0, "freed": 0,
+                       "aliased": 0}                # guarded-by: _lock
 
     @property
     def usable_pages(self) -> int:
@@ -222,6 +232,7 @@ class KVPool:
             for p in pages:
                 self._refs[p] = 1
             self._claims[owner] = pages
+            self._stats["claimed"] += n
             return list(pages)
 
     def claim_extra(self, owner, n: int = 1,
@@ -250,6 +261,7 @@ class KVPool:
             for p in pages:
                 self._refs[p] = 1
             held.extend(pages)
+            self._stats["claimed"] += n
             return list(pages)
 
     def share(self, owner, pages: Sequence[int],
@@ -276,6 +288,7 @@ class KVPool:
             for p in pages:
                 self._refs[int(p)] += 1
                 held.append(int(p))
+            self._stats["aliased"] += len(pages)
 
     def retable(self, owner, new_pages: Sequence[int]) -> int:
         """Atomically rewrite ``owner``'s reference list to
@@ -296,8 +309,14 @@ class KVPool:
                 if self._refs.get(p, 0) < 1:
                     raise ValueError(
                         f"cannot retable to page {p}: not live")
+            old_set = set(old_list)
             for p in new_list:
                 self._refs[p] += 1
+                if p not in old_set:
+                    # a reference this owner did not already hold: a
+                    # genuinely new alias (kept pages incref+decref and
+                    # must not read as COW traffic)
+                    self._stats["aliased"] += 1
             freed = 0
             # decref the old list in reverse so a retable-to-empty frees
             # in release()'s deterministic order
@@ -307,6 +326,7 @@ class KVPool:
                     del self._refs[p]
                     self._free.append(p)
                     freed += 1
+            self._stats["freed"] += freed
             if new_list:
                 self._claims[owner] = new_list
             else:
@@ -342,6 +362,7 @@ class KVPool:
                 if self._refs[p] == 0:
                     del self._refs[p]
                     self._free.append(p)
+                    self._stats["freed"] += 1
             return len(pages)
 
     def pages_of(self, owner) -> List[int]:
@@ -351,6 +372,35 @@ class KVPool:
     def owners(self) -> List[object]:
         with self._lock:
             return list(self._claims.keys())
+
+    def claims(self) -> Dict[object, List[int]]:
+        """Snapshot of the whole claims table (owner -> held page
+        references) in one lock acquisition — the /poolz page map
+        inverts this into per-page owner lists (ISSUE 14)."""
+        with self._lock:
+            return {k: list(v) for k, v in self._claims.items()}
+
+    def stats(self) -> Dict[str, int]:
+        """Cumulative claimed/freed/aliased counters (see __init__);
+        the engines diff two snapshots for per-round accounting."""
+        with self._lock:
+            return dict(self._stats)
+
+    def alias_stats(self) -> Dict[str, int]:
+        """One-lock refcount-distribution summary for the pool gauges:
+        ``live`` pages holding references, ``shared`` pages with
+        refcount >= 2 (COW-aliased), total ``refs`` and the ``max``
+        refcount. The COW alias ratio is (refs - live) / refs — the
+        fraction of table references that are aliases rather than sole
+        ownership."""
+        with self._lock:
+            refs = self._refs
+            return {
+                "live": len(refs),
+                "shared": sum(1 for c in refs.values() if c > 1),
+                "refs": sum(refs.values()),
+                "max": max(refs.values(), default=0),
+            }
 
     # -- invariant auditor (ISSUE 11, refcounts ISSUE 12) -------------------
     def audit(self) -> List[str]:

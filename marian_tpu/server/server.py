@@ -218,15 +218,25 @@ class ServingApp:
             # PERF.set_capacity_inputs's docstring warns about)
             self._pages_for_text = \
                 lambda text: self.scheduler.engine.pages_for_text(text)
+            self._pool_provider = True
         else:
             self._pages_for_text = None
             self.max_queue_pages = 0
+            self._pool_provider = False
         self.scheduler = ContinuousScheduler(
             translate_lines, token_budget=budget, registry=self.registry,
             stall_timeout=float(
                 options.get("dispatch-stall-timeout", 0) or 0),
             batching_mode=self.batching_mode, engine=engine,
             engine_factory=engine_factory)
+        if self._pool_provider:
+            # every flight dump (pool.audit_failed, failed quiesce,
+            # brownout escalation, watchdog, poison...) embeds the KV
+            # page map at incident time (ISSUE 14). Resolved through
+            # the scheduler so swaps/rebuilds dump the live engine.
+            from ..obs import poolz as mpoolz
+            obs.FLIGHT.add_snapshot_provider(
+                "pool", lambda: mpoolz.snapshot(self.scheduler))
         self.admission = AdmissionController(
             int(options.get("max-queue", 512) or 0),
             self.scheduler.queued_units, registry=self.registry,
@@ -658,6 +668,9 @@ class ServingApp:
         routes = obs.trace_routes()
         routes.update(mslo.slo_routes(lambda: self.slo,
                                       lambda: self.brownout))
+        # /poolz rides the metrics port like /tracez and /sloz: always
+        # routed, request-mode servers answer enabled:false (ISSUE 14)
+        routes.update(obs.pool_routes(lambda: self.scheduler))
         if self.lifecycle is not None:
             routes.update(self._admin_routes())
         self.metrics_server = msm.maybe_start_metrics_server(
@@ -786,12 +799,20 @@ class ServingApp:
         root span."""
         if trace_id is not None:
             m = meta or {}
-            reply = (f"{TRACE_PREFIX}{trace_id} "
-                     f"outcome={m.get('outcome', outcome)} "
-                     f"queue_ms={m.get('queue_s', 0.0) * 1e3:.1f} "
-                     f"service_ms={m.get('service_s', 0.0) * 1e3:.1f} "
-                     f"model_version={m.get('model_version', '-')}"
-                     + "\n" + reply)
+            line = (f"{TRACE_PREFIX}{trace_id} "
+                    f"outcome={m.get('outcome', outcome)} "
+                    f"queue_ms={m.get('queue_s', 0.0) * 1e3:.1f} "
+                    f"service_ms={m.get('service_s', 0.0) * 1e3:.1f} "
+                    f"model_version={m.get('model_version', '-')}")
+            if "rounds" in m:
+                # iteration-mode row breakdown (ISSUE 14): decode
+                # rounds participated, time-to-first-join (-1 = never
+                # joined), prefix-cache hit flag, retriable evictions
+                line += (f" rounds={m['rounds']} "
+                         f"ttfj_ms={m.get('ttfj_ms', -1.0):.1f} "
+                         f"prefix_hit={m.get('prefix_hit', 0)} "
+                         f"evictions={m.get('evictions', 0)}")
+            reply = line + "\n" + reply
         if span is None:
             return reply, lambda nbytes=0: None
         t_reply = time.perf_counter()
@@ -833,6 +854,9 @@ class ServingApp:
             # method)
             obs.PERF.set_capacity_inputs(None, 0)
             self._perf_wired = False
+        if self._pool_provider:
+            obs.FLIGHT.remove_snapshot_provider("pool")
+            self._pool_provider = False
         if self.slo is not None:
             self.slo.stop()
             obs.FLIGHT.remove_snapshot_provider("slo")

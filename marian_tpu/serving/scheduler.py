@@ -119,7 +119,7 @@ class _Request:
                  "results", "remaining", "queued", "queued_pages",
                  "first_dispatch", "timeout_handle", "dead_accounted",
                  "trace_id", "span", "own_root", "q_span", "d_span",
-                 "meta")
+                 "meta", "rounds", "prefix_hits", "evictions_n")
 
     def __init__(self, lines: List[str], future: "asyncio.Future",
                  priority: int, arrival: float, deadline: Optional[float]):
@@ -150,12 +150,21 @@ class _Request:
         self.q_span = None
         self.d_span = None
         self.meta: Optional[dict] = None
+        # iteration-mode row breakdown (ISSUE 14), aggregated across
+        # this request's rows and reported in the #trace reply
+        # metadata: decode rounds participated (max over rows),
+        # prefix-cache hits (replays + live forks), rows evicted with
+        # a retriable error. Tracing-independent, like queue_s.
+        self.rounds = 0
+        self.prefix_hits = 0
+        self.evictions_n = 0
 
 
 class _Unit:
     """One sentence of one request — the scheduling granule."""
 
-    __slots__ = ("req", "idx", "text", "tokens", "pages")
+    __slots__ = ("req", "idx", "text", "tokens", "pages", "row_span",
+                 "rounds", "evict_reason")
 
     def __init__(self, req: _Request, idx: int, text: str, tokens: int,
                  pages: int = 0):
@@ -166,6 +175,13 @@ class _Unit:
         # KV-pool pages this sentence will claim (iteration mode's
         # admission currency; 0 in request mode)
         self.pages = pages
+        # per-row decode tracing (ISSUE 14, iteration mode): the
+        # serve.row span opened at join (None with tracing off), the
+        # decode rounds this row participated in, and — when evicted —
+        # why (quiesce / brownout / pool_exhausted / cancelled)
+        self.row_span = None
+        self.rounds = 0
+        self.evict_reason: Optional[str] = None
 
 
 class ContinuousScheduler:
@@ -666,6 +682,17 @@ class ContinuousScheduler:
                             model_version=version,
                             queue_s=round(queue_s, 6),
                             service_s=round(service_s, 6))
+            if self.batching_mode == "iteration":
+                # the row breakdown (ISSUE 14): rounds participated
+                # (max over this request's rows), time-to-first-join
+                # (-1 = never joined a decode), prefix-cache hit flag,
+                # retriable row evictions suffered
+                req.meta.update(
+                    rounds=req.rounds,
+                    ttfj_ms=round(queue_s * 1e3, 1) if fd is not None
+                    else -1.0,
+                    prefix_hit=int(req.prefix_hits > 0),
+                    evictions=req.evictions_n)
         if req.d_span is not None:
             obs.end(req.d_span, outcome=outcome, model_version=version)
             req.d_span = None
@@ -896,7 +923,8 @@ class ContinuousScheduler:
         log.error("iteration admission: {}", message)
         u.req.future.set_exception(RuntimeError(message))
 
-    def _mark_joined(self, u: _Unit, now: float, rows_before: int) -> None:
+    def _mark_joined(self, u: _Unit, now: float, rows_before: int,
+                     bucket: int = 0) -> None:
         """A sentence entered the decode. queue_ms STOPS HERE — at join
         time, not at some enclosing batch's dispatch time: a sentence
         joining a running decode must not inherit the running rows'
@@ -917,6 +945,31 @@ class ContinuousScheduler:
                 req.d_span = obs.start_span(
                     "serve.dispatch", parent=req.span,
                     joined_mid_decode=rows_before > 0)
+        if obs.enabled():
+            # per-row lifecycle span (ISSUE 14): one serve.row per
+            # sentence under the request root, opened at join with the
+            # time-to-first-join and the compiled bucket the joining
+            # round ran at; closed at EOS / evict / cancel with the
+            # rounds count (serve.round spans cross-link back via
+            # their `traces` attr)
+            u.row_span = obs.start_span(
+                "serve.row", parent=req.span,
+                trace_id=req.trace_id or None,
+                sentence=u.idx, bucket=bucket,
+                mid_decode=rows_before > 0,
+                ttfj_ms=round((now - req.arrival) * 1e3, 2))
+
+    def _end_row_span(self, u: _Unit, outcome: str, **attrs) -> None:
+        """Close one row's lifecycle: fold its rounds count into the
+        request aggregate (the #trace row breakdown) and end its
+        serve.row span if one was opened."""
+        req = u.req
+        if u.rounds > req.rounds:
+            req.rounds = u.rounds
+        sp = u.row_span
+        if sp is not None:
+            u.row_span = None
+            obs.end(sp, outcome=outcome, rounds=u.rounds, **attrs)
 
     async def _iteration_round(self, loop) -> None:
         """One join-pass + decode-step round on the device worker. With
@@ -948,6 +1001,7 @@ class ContinuousScheduler:
             for u in list(self._active_units):
                 if u in evicts:
                     continue
+                u.evict_reason = "quiesce"
                 self._evict_with_retry(
                     u, loop,
                     f"row evicted at the quiesce deadline "
@@ -966,6 +1020,15 @@ class ContinuousScheduler:
         # round's start, not with a post-step timestamp that would bill
         # the step (and any jit warmup) as queueing
         t_round = loop.time()
+        # serve.round span (ISSUE 14): one per engine round, its OWN
+        # trace (a round serves many rows); participating rows cross-
+        # link via the `traces` attr set at end, like serve.batch
+        rspan = None
+        if obs.enabled():
+            rspan = obs.start_span(
+                "serve.round", rows_before=rows_before,
+                joins=len(joins), evicts=len(evicts),
+                quiescing=q is not None)
         self._inflight += 1
         try:
             fp.fault_point("serving.dispatch")
@@ -982,16 +1045,19 @@ class ContinuousScheduler:
                                                  self.stall_timeout)
                 except asyncio.TimeoutError:
                     self._iteration_stalled(call, joins, loop)
+                    obs.end(rspan, outcome="stalled")
                     return
             else:
                 res = await call
         except asyncio.CancelledError:
+            obs.end(rspan, outcome="cancelled")
             raise
         except Exception as e:  # noqa: BLE001
             # an engine-round failure has no per-sentence bisection (the
             # step computes all rows jointly): fail the round's requests
             # explicitly and rebuild the engine if a factory was given
             self._iteration_failed(joins, loop, e)
+            obs.end(rspan, outcome="failed", error=str(e)[:200])
             return
         finally:
             self._inflight -= 1
@@ -999,8 +1065,34 @@ class ContinuousScheduler:
             if u in self._active_units:
                 del self._active_units[u]
                 self.m_evictions.inc()
+                self._end_row_span(u, u.evict_reason or "cancelled",
+                                   retriable=u.evict_reason is not None)
         for u in res.accepted:
-            self._mark_joined(u, t_round, rows_before)
+            self._mark_joined(u, t_round, rows_before, res.bucket)
+        if res.rows:
+            # count the round for every row that rode this device step
+            # (rows finishing this round are still active here). The
+            # request aggregate updates HERE, not only at row end: an
+            # eviction fills the reply metadata via _outcome before the
+            # row's span closes, and must see the rounds already run
+            for u in self._active_units:
+                u.rounds += 1
+                if u.rounds > u.req.rounds:
+                    u.req.rounds = u.rounds
+        # per-row lifecycle instants the engine reported (prefix hits /
+        # COW forks — ISSUE 14): fold into the request's reply-metadata
+        # counters always, and onto the timeline/row spans when tracing
+        for key, name, attrs in res.row_events:
+            u = key if isinstance(key, _Unit) else None
+            if u is not None:
+                if name.startswith("prefix."):
+                    u.req.prefix_hits += 1
+                if name == "prefix.fork" and u.row_span is not None:
+                    u.row_span.set_attrs(prefix_fork=True, **attrs)
+                if obs.enabled():
+                    obs.event(name, trace=u.req.trace_id, **attrs)
+            elif obs.enabled():
+                obs.event(name, **attrs)
         from ..translator.iteration import FATAL_REASONS
         requeue: List[_Unit] = []
         for u, why in res.rejected:
@@ -1029,6 +1121,8 @@ class ContinuousScheduler:
             if u in self._active_units:
                 del self._active_units[u]
                 self.m_evictions.inc()
+                u.evict_reason = "pool_exhausted"
+                self._end_row_span(u, "pool_exhausted", retriable=True)
                 self._evict_with_retry(
                     u, loop, "row evicted: KV pool exhausted mid-decode "
                              "(copy-on-write beam divergence)")
@@ -1036,6 +1130,7 @@ class ContinuousScheduler:
         for u, text in res.finished:
             self._active_units.pop(u, None)
             src_done += u.tokens
+            self._end_row_span(u, "eos")
             self._complete_unit(u, text, loop)
         if res.rows:
             self.m_steps.inc(max(1, res.steps))
@@ -1052,6 +1147,24 @@ class ContinuousScheduler:
                     self._version_label(), rows=res.rows,
                     width=res.bucket, src_tokens=src_done,
                     trg_tokens=res.tokens, device_s=res.device_s)
+        if rspan is not None:
+            # rows that finished this round already left _active_units;
+            # their trace ids still belong on the round's cross-links
+            traces = {u.req.trace_id for u in self._active_units
+                      if u.req.trace_id}
+            traces.update(u.req.trace_id for u, _ in res.finished
+                          if u.req.trace_id)
+            obs.end(
+                rspan, outcome="ok", rows=res.rows, bucket=res.bucket,
+                steps=res.steps, tokens=res.tokens,
+                joined=len(res.accepted), left=len(res.finished),
+                pool_evicted=len(res.pool_evicted),
+                pages_claimed=res.pages_claimed,
+                pages_freed=res.pages_freed,
+                pages_aliased=res.pages_aliased,
+                pages_copied=res.pages_copied,
+                device_s=round(res.device_s, 6),
+                traces=sorted(traces))
         self._notify_round(False, res.device_s)
         if q is not None and not self._active_units:
             self._finish_quiesce(q, loop)
@@ -1099,6 +1212,16 @@ class ContinuousScheduler:
                   evicted=q.evicted, install_ok=install_ok,
                   audit_violations=len(pre) + len(post),
                   duration_ms=round((loop.time() - q.t0) * 1e3, 1))
+        if not q.ok:
+            # an unhealthy quiesce (failed install or audit violations)
+            # is a pool incident: dump — the flight recorder's `pool`
+            # provider embeds the page map at this exact moment
+            # (ISSUE 14)
+            obs.FLIGHT.trip_async(
+                "quiesce",
+                detail=f"quiesce ({q.reason}) completed unhealthily: "
+                       f"install_ok={install_ok}, "
+                       f"{len(pre) + len(post)} audit violation(s)")
         log.info("quiesce ({}): complete in {:.0f}ms — {} row(s) "
                  "evicted with retry, audit {} ({} violation(s))",
                  q.reason, (loop.time() - q.t0) * 1e3, q.evicted,
@@ -1125,6 +1248,9 @@ class ContinuousScheduler:
         engine via the caller's evict list, freeing its pages."""
         if u.req.future.done():
             return
+        # count the eviction BEFORE _outcome fills the reply metadata,
+        # so the client's row breakdown includes this one (ISSUE 14)
+        u.req.evictions_n += 1
         self._outcome("evicted", u.req, loop.time())
         u.req.future.set_exception(RowEvicted(msg + " — retry"))
 
@@ -1168,6 +1294,7 @@ class ContinuousScheduler:
             return (u.req.priority, -remaining)
 
         worst = min(victims, key=score)
+        worst.evict_reason = "brownout"
         self._evict_with_retry(
             worst, loop,
             f"row evicted under brownout (level "
@@ -1189,6 +1316,7 @@ class ContinuousScheduler:
         self._trip_watchdog(call, len(victims))
         now = loop.time()
         for u in victims:
+            self._end_row_span(u, "stalled", retriable=True)
             if not u.req.future.done():
                 self._outcome("stalled", u.req, now)
                 u.req.future.set_exception(DispatchStalled(
@@ -1226,6 +1354,7 @@ class ContinuousScheduler:
             or self.engine_factory is not None \
             or self.round_observer is not None
         for u in victims:
+            self._end_row_span(u, "round_failed", retriable=retriable)
             if not u.req.future.done():
                 if retriable:
                     self._evict_with_retry(

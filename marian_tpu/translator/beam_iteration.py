@@ -196,6 +196,10 @@ class PagedBeamEngine(PagedDecodeEngine):
             if ent is not None:
                 # beam decode is deterministic per version: replay
                 res.finished.append((key, ent.text))
+                res.row_events.append((key, "prefix.hit",
+                                       {"kind": "replay",
+                                        "tokens": len(ent.tokens)}))
+                self._count("prefix_hits")
                 return None
         cap = self.decode_cap(len(ids))
         n_pages = pages_for_tokens(cap, self.page_len)
@@ -408,6 +412,7 @@ class PagedBeamEngine(PagedDecodeEngine):
         if fork_src:
             # ONE bucketed device call copies every diverging partial
             # page ((0,0) pairs are deterministic trash-page no-ops)
+            self._round_copied += len(fork_src)
             n = 1
             while n < len(fork_src):
                 n *= 2
@@ -578,6 +583,11 @@ class PagedBeamEngine(PagedDecodeEngine):
             if row:
                 self._table[slot, :len(row)] = row
         self.pool.release(tmp)
+        if forkers:
+            # each forker is one COW fork off its parent's lineage
+            self._count("forks", len(forkers))
+            if self._metrics_declared:
+                self.m_forks.inc(len(forkers))
         # refresh per-row device inputs + base-slot bookkeeping
         live_slots = {c.slot for c in live}
         with self._lock:
@@ -676,9 +686,31 @@ class PagedBeamEngine(PagedDecodeEngine):
                 continue
             v.append(f"pool claim for {owner!r} matches no sentence "
                      f"slot (pages leaked at exit)")
-        if hasattr(self, "m_audits"):
-            self.m_audits.inc()
-        if v:
-            self._report_audit(v, context)
+        self._note_audit(v, context)
         return v
+
+    # -- /poolz (ISSUE 14) --------------------------------------------------
+    def _slot_owner(self, slot: int, s):
+        return self._owner(s.key, slot)
+
+    def pool_state(self) -> dict:
+        """The base page/slot maps plus the beam view: per-sentence
+        hypothesis rows and beam geometry (slot ``pos`` in the base map
+        is the device-row position; frozen hypotheses read pos 0)."""
+        state = super().pool_state()
+        with self._lock:
+            sents = [{
+                "key": self._owner_label(s.key),
+                "trace_id": getattr(getattr(s.key, "req", None),
+                                    "trace_id", ""),
+                "slots": list(s.slots),
+                "t": int(s.t),
+                "cap": int(s.cap),
+                "live_hyps": sum(1 for h in s.hyps
+                                 if h.slot is not None),
+                "frozen_hyps": sum(1 for h in s.hyps if h.finished),
+            } for s in self._sents.values()]
+        state["beam"] = {"beam_size": self.beam_size, "cow": self.cow,
+                         "sentences": sents}
+        return state
 

@@ -97,6 +97,20 @@ class StepResult:
     steps: int = 0                # decode steps the round advanced
     device_s: float = 0.0         # admit+step wall on the worker thread
     mid_decode_joins: int = 0     # joins that landed beside running rows
+    # per-row lifecycle instants this round (ISSUE 14): (key, name,
+    # attrs) tuples — prefix-cache hits/forks, COW events — that the
+    # serving scheduler turns into timeline events tagged with the
+    # row's trace id (the engine never learns trace ids) and into the
+    # #trace reply-metadata row breakdown. Always populated (the reply
+    # metadata is tracing-independent); tiny and rare, never per-token.
+    row_events: List[Tuple[object, str, dict]] = field(default_factory=list)
+    # pool page traffic THIS round (deltas of KVPool.stats + the
+    # engine's fork-copy count) — the serve.round span attrs and the
+    # marian_serving_kv_pool_pages_*_total series read these
+    pages_claimed: int = 0
+    pages_freed: int = 0
+    pages_aliased: int = 0
+    pages_copied: int = 0
 
 
 class _Slot:
@@ -214,6 +228,19 @@ class PagedDecodeEngine:
         # couples it to other state, so it rides no lock.
         self._cap_scale = 1.0
         self._audit_always = os.environ.get(ENV_POOL_AUDIT, "") == "1"
+        # engine round counters + last-audit verdict for the /poolz
+        # inspector (ISSUE 14): plain ints written on the worker thread,
+        # read by the metrics/poolz HTTP threads — hence the lock
+        self._counters: Dict[str, int] = {
+            "rounds": 0, "joins": 0, "mid_decode_joins": 0,
+            "prefix_hits": 0, "forks": 0, "pool_evictions": 0,
+            "pages_copied": 0, "audits": 0,
+            "audit_failures": 0}            # guarded-by: _lock
+        self._last_audit: Optional[dict] = None   # guarded-by: _lock
+        # fork-copied pages in the CURRENT round (worker thread only;
+        # reset at the top of admit_and_step, folded into res at its end)
+        self._round_copied = 0
+        self._metrics_declared = False
         # cross-request prefix sharing (--prefix-cache; ISSUE 12):
         # engine-scoped — a hot swap builds a fresh engine with a fresh
         # cache, so stale-version pages are unreachable by construction
@@ -254,13 +281,106 @@ class PagedDecodeEngine:
             "Pool invariant audits that found violations (double-free, "
             "table/claim mismatch, refcount drift, leaked pages, "
             "row-exit leak)")
+        # pool occupancy / COW telemetry (ISSUE 14): live gauges the
+        # scrape thread samples, plus cumulative page-traffic counters
+        # fed per round by admit_and_step. The gauges re-point to the
+        # engine actually serving on every install_engine re-declare.
+        self.m_pool_occupancy = r.gauge(
+            "marian_serving_kv_pool_occupancy_ratio",
+            "Claimed pages / allocatable pages of the paged KV pool")
+        self.m_pool_occupancy.set_function(self.occupancy)
+        self.m_pool_shared = r.gauge(
+            "marian_serving_kv_pool_pages_shared",
+            "Pages currently COW-aliased (refcount >= 2): held by more "
+            "than one hypothesis/row/cache entry")
+        self.m_pool_shared.set_function(
+            lambda: self.pool.alias_stats()["shared"])
+        self.m_pool_refmax = r.gauge(
+            "marian_serving_kv_pool_refcount_max",
+            "Highest live page refcount (refcount-distribution summary; "
+            "1 = no sharing at all right now)")
+        self.m_pool_refmax.set_function(
+            lambda: self.pool.alias_stats()["max"])
+        self.m_pool_alias_ratio = r.gauge(
+            "marian_serving_kv_pool_cow_alias_ratio",
+            "Fraction of live page-table references that are COW "
+            "aliases rather than sole ownership: (refs - live pages) / "
+            "refs. 0 = no sharing; rises with beam forks and prefix "
+            "hits")
+        self.m_pool_alias_ratio.set_function(self.cow_alias_ratio)
+        self.m_rounds = r.counter(
+            "marian_serving_engine_rounds_total",
+            "Admit+step rounds the paged engine ran (>= decode steps "
+            "at --iteration-steps 1; each round is one device dispatch)")
+        self.m_pages_claimed = r.counter(
+            "marian_serving_kv_pool_pages_claimed_total",
+            "Fresh pages claimed off the pool free list (cold joins, "
+            "lazy COW growth, fork partials)")
+        self.m_pages_freed = r.counter(
+            "marian_serving_kv_pool_pages_freed_total",
+            "Pages returned to the pool free list (row exits, beam "
+            "reorders dropping dead lineages, cache evictions)")
+        self.m_pages_aliased = r.counter(
+            "marian_serving_kv_pool_pages_aliased_total",
+            "Copy-on-write references added to already-live pages "
+            "(beam forks, prefix hits, reorder shares) — pages served "
+            "by aliasing instead of recompute or copy")
+        self.m_pages_copied = r.counter(
+            "marian_serving_kv_pool_pages_copied_total",
+            "Partial pages content-copied by pool_fork_partial (the "
+            "one copy a COW fork pays; cow=False replication copies "
+            "full histories here too)")
+        self.m_bytes_copied = r.counter(
+            "marian_serving_kv_pool_bytes_copied_total",
+            "Bytes moved by pool_fork_partial copies "
+            "(pages_copied x the whole-decoder page cost)")
+        self.m_bytes_aliased = r.counter(
+            "marian_serving_kv_pool_bytes_aliased_total",
+            "Bytes served by COW page aliasing instead of being copied "
+            "(pages_aliased x the whole-decoder page cost) — the "
+            "data-movement win the reorder/prefix sharing buys")
+        self.m_forks = r.counter(
+            "marian_serving_cow_forks_total",
+            "Copy-on-write forks performed (prefix-cache live forks + "
+            "beam-reorder child hypotheses that left their parent's "
+            "row)")
         if self.prefix is not None:
             self.prefix._declare_metrics(r)
+            m_held = r.gauge(
+                "marian_prefix_held_pages",
+                "KV pages currently held by prefix-cache entries "
+                "(retained decodes an exact repeat replays for free)")
+            m_held.set_function(self.prefix.held_pages)
+            m_recl = r.gauge(
+                "marian_prefix_reclaimable_pages",
+                "Pages evicting the whole prefix cache would free "
+                "RIGHT NOW (held references with page refcount 1) — "
+                "the pressure-relief headroom admission already counts")
+            m_recl.set_function(
+                lambda: self.prefix.reclaimable_pages(self.pool))
+        self._metrics_declared = True
 
     # -- capacity (any thread) ----------------------------------------------
     def active_rows(self) -> int:
         with self._lock:
             return self._n_active
+
+    def occupancy(self) -> float:
+        """Claimed / allocatable pages (any thread)."""
+        return self.pool.used_pages() / float(self.pool.usable_pages)
+
+    def cow_alias_ratio(self) -> float:
+        """(references - live pages) / references — see the gauge help
+        and KVPool.alias_stats (any thread)."""
+        st = self.pool.alias_stats()
+        return (st["refs"] - st["live"]) / st["refs"] if st["refs"] \
+            else 0.0
+
+    def _count(self, name: str, n: int = 1) -> None:
+        """Bump one /poolz round counter (worker thread writes, the
+        HTTP threads read the dict under the same lock)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
 
     def fragmentation(self) -> float:
         used_pages = self.pool.used_pages()
@@ -334,6 +454,12 @@ class PagedDecodeEngine:
         fail the request)."""
         t0 = time.perf_counter()
         res = StepResult()
+        # page-traffic accounting (ISSUE 14): diff the pool's cumulative
+        # counters across the round — two dict copies under the pool
+        # lock, nothing on the tracer (the zero-overhead guard covers
+        # this path with tracing disabled)
+        stats0 = self.pool.stats()
+        self._round_copied = 0
         # corruption-detection drills (no-ops unless the pool.* catalog
         # points are armed): they corrupt real state so the audit below
         # is proven against the bug classes it claims to catch
@@ -368,6 +494,31 @@ class PagedDecodeEngine:
                 # another token (docs/ROBUSTNESS.md)
                 raise PoolCorruption(
                     "pool audit failed: " + "; ".join(bad[:4]))
+        stats1 = self.pool.stats()
+        res.pages_claimed = stats1["claimed"] - stats0["claimed"]
+        res.pages_freed = stats1["freed"] - stats0["freed"]
+        res.pages_aliased = stats1["aliased"] - stats0["aliased"]
+        res.pages_copied = self._round_copied
+        with self._lock:
+            self._counters["rounds"] += 1
+            self._counters["joins"] += len(res.accepted)
+            self._counters["mid_decode_joins"] += res.mid_decode_joins
+            self._counters["pool_evictions"] += len(res.pool_evicted)
+            self._counters["pages_copied"] += res.pages_copied
+        if self._metrics_declared:
+            self.m_rounds.inc()
+            if res.pages_claimed:
+                self.m_pages_claimed.inc(res.pages_claimed)
+            if res.pages_freed:
+                self.m_pages_freed.inc(res.pages_freed)
+            if res.pages_aliased:
+                self.m_pages_aliased.inc(res.pages_aliased)
+                self.m_bytes_aliased.inc(res.pages_aliased
+                                         * self.page_bytes)
+            if res.pages_copied:
+                self.m_pages_copied.inc(res.pages_copied)
+                self.m_bytes_copied.inc(res.pages_copied
+                                        * self.page_bytes)
         res.device_s = time.perf_counter() - t0  # mtlint: ok -- the step's per-token fetch (np.asarray in _step) IS the result fence; this window closes host-side after it
         return res
 
@@ -391,6 +542,10 @@ class PagedDecodeEngine:
             ent = self.prefix.get(src_key, self.prefix.version)
             if ent is not None:
                 res.finished.append((key, ent.text))
+                res.row_events.append((key, "prefix.hit",
+                                       {"kind": "replay",
+                                        "tokens": len(ent.tokens)}))
+                self._count("prefix_hits")
                 return None
         cap = self.decode_cap(len(ids))
         n_pages = pages_for_tokens(cap, self.page_len)
@@ -406,7 +561,8 @@ class PagedDecodeEngine:
             if self._n_active >= self.max_rows:
                 return "no_slot"
         if self.prefix is not None:
-            forked = self._try_fork(key, src_key, cap, n_pages, len(ids))
+            forked = self._try_fork(key, src_key, cap, n_pages, len(ids),
+                                    res=res)
             if forked is not None:
                 return None if forked else "no_pages"
             self.prefix.note_miss()
@@ -455,7 +611,8 @@ class PagedDecodeEngine:
             return self.pool.claim(key, n)
 
     def _try_fork(self, key, src_key, cap: int, n_pages: int,
-                  n_src: int) -> Optional[bool]:
+                  n_src: int, res: Optional[StepResult] = None
+                  ) -> Optional[bool]:
         """Copy-on-write fork from a LIVE row with the same source:
         alias its full (append-only) pages with refcount++, content-copy
         only its current partial page, copy its cross-attention rows
@@ -527,6 +684,17 @@ class PagedDecodeEngine:
             jnp.asarray([src_page], jnp.int32),
             jnp.asarray([dst_page], jnp.int32))
         self.prefix.note_fork(tokens_saved=pos_l, pages_reused=n_full)
+        if has_partial:
+            self._round_copied += 1
+        self._count("forks")
+        self._count("prefix_hits")
+        if self._metrics_declared:
+            self.m_forks.inc()
+        if res is not None:
+            res.row_events.append((key, "prefix.fork",
+                                   {"kind": "live", "pos": pos_l,
+                                    "aliased": n_full,
+                                    "copied": int(has_partial)}))
         return True
 
     def _make_fork(self):
@@ -660,11 +828,133 @@ class PagedDecodeEngine:
                 continue
             v.append(f"pool claim for {owner!r} has no active row "
                      f"(pages leaked at row exit)")
+        self._note_audit(v, context)
+        return v
+
+    def _note_audit(self, violations: List[str], context: str) -> None:
+        """Record the audit pass into the /poolz counters and the
+        last-audit verdict (ISSUE 14), then report failures the usual
+        loud way. Shared by both engines' auditors."""
+        with self._lock:
+            self._counters["audits"] += 1
+            self._last_audit = {
+                "context": context,
+                "clean": not violations,
+                "violations": list(violations[:8]),
+                "ts": time.time(),
+            }
         if hasattr(self, "m_audits"):    # registry-less engines: no series
             self.m_audits.inc()
-        if v:
-            self._report_audit(v, context)
-        return v
+        if violations:
+            self._report_audit(violations, context)
+
+    # -- /poolz live inspector (ISSUE 14) ------------------------------------
+    def _slot_owner(self, slot: int, s: "_Slot"):
+        """The pool-claim owner of an occupied slot (the beam engine's
+        owners are (key, slot) pairs — it overrides this)."""
+        return s.key
+
+    @staticmethod
+    def _owner_label(owner) -> str:
+        """Human/JSON-safe label for a claim owner: serving units carry
+        their request's trace id, prefix-cache owners their tag; bare
+        keys (library/test callers) fall back to repr."""
+        probe = owner
+        if isinstance(owner, tuple) and len(owner) == 2:
+            probe = owner[0]              # beam (key, slot) pair
+        tid = getattr(getattr(probe, "req", None), "trace_id", "")
+        if tid:
+            base = f"trace:{tid}"
+            return base if probe is owner else f"{base}#{owner[1]}"
+        if isinstance(owner, tuple) and len(owner) == 3 \
+                and owner[0] == "prefix":
+            return "prefix-cache"
+        return repr(owner)[:96]
+
+    def pool_state(self) -> dict:
+        """JSON-ready snapshot of the whole paged-serving data plane:
+        the per-page map (refcount + owning rows/cache entries), the
+        per-slot table (trace id, pos, cap, pages held), the engine
+        round counters and the last audit verdict — the ``/poolz``
+        document and the flight recorder's ``pool`` member. Snapshot
+        semantics: each map is taken under its own lock (never nested);
+        a round committing mid-snapshot can skew adjacent maps by one
+        row, which the auditor (not this inspector) is the consistency
+        oracle for."""
+        refs = self.pool.refcounts()
+        claims = self.pool.claims()
+        alias = self.pool.alias_stats()
+        stats = self.pool.stats()
+        with self._lock:
+            slots_snap = list(self._slots)
+            counters = dict(self._counters)
+            last_audit = dict(self._last_audit) if self._last_audit \
+                else None
+            n_active = self._n_active
+            used_tokens = self._used_tokens
+        owners_by_page: Dict[int, List[str]] = {}
+        for owner, pages in claims.items():
+            label = self._owner_label(owner)
+            for p in pages:
+                owners_by_page.setdefault(int(p), []).append(label)
+        page_map = {
+            str(p): {"refs": int(rc),
+                     "owners": sorted(owners_by_page.get(p, []))}
+            for p, rc in sorted(refs.items())}
+        slot_rows = []
+        for i, s in enumerate(slots_snap):
+            if s is None:
+                continue
+            owner = self._slot_owner(i, s)
+            slot_rows.append({
+                "slot": i,
+                "owner": self._owner_label(owner),
+                "trace_id": getattr(getattr(s.key, "req", None),
+                                    "trace_id", ""),
+                "pos": int(s.pos),
+                "cap": int(s.cap),
+                "src_tokens": int(s.src_tokens),
+                "pages": [int(p) for p in self.pool.pages_of(owner)],
+            })
+        state = {
+            "enabled": True,
+            "engine": type(self).__name__,
+            "pool": {
+                "n_pages": self.pool.n_pages,
+                "usable_pages": self.pool.usable_pages,
+                "free_pages": self.pool.free_pages(),
+                "used_pages": self.pool.used_pages(),
+                "occupancy": round(self.occupancy(), 4),
+                "page_len": self.page_len,
+                "page_bytes": self.page_bytes,
+                "max_pages_per_row": self.pool.max_pages_per_row,
+                "live_pages": alias["live"],
+                "shared_pages": alias["shared"],
+                "refs": alias["refs"],
+                "refcount_max": alias["max"],
+                "cow_alias_ratio": round(self.cow_alias_ratio(), 4),
+                "traffic": stats,
+            },
+            "pages": page_map,
+            "rows": {
+                "active": n_active,
+                "max_rows": self.max_rows,
+                "used_tokens": used_tokens,
+                "fragmentation": round(self.fragmentation(), 4),
+                "slots": slot_rows,
+            },
+            "counters": counters,
+            "last_audit": last_audit,
+        }
+        if self.prefix is not None:
+            state["prefix_cache"] = {
+                "entries": self.prefix.entries(),
+                "held_tokens": self.prefix.held_tokens(),
+                "held_pages": self.prefix.held_pages(),
+                "reclaimable_pages":
+                    self.prefix.reclaimable_pages(self.pool),
+            }
+        return state
 
     def _report_audit(self, violations: List[str], context: str) -> None:
         """One audit failure: loud log, timeline event, flight dump
@@ -672,6 +962,7 @@ class PagedDecodeEngine:
         corrupted, not just that a round failed."""
         log.error("POOL AUDIT FAILED ({}): {} violation(s): {}", context,
                   len(violations), "; ".join(violations[:4]))
+        self._count("audit_failures")
         if hasattr(self, "m_audit_failures"):
             self.m_audit_failures.inc()
         obs.event("pool.audit_failed", context=context,

@@ -131,6 +131,13 @@ class PrefixCache:
         with self._lock:
             return self._held_tokens
 
+    def held_pages(self) -> int:
+        """Page references currently held by completed entries — the
+        marian_prefix_held_pages gauge and the /poolz prefix block
+        (ISSUE 14). One lock acquisition, any thread."""
+        with self._lock:
+            return sum(len(e.pages) for e in self._done.values())
+
     def owner(self, key: tuple):
         return ("prefix", self.version, key)
 

@@ -137,7 +137,9 @@ def split_reply_meta(reply: str):
     meta = {"trace_id": first.split()[0][len(TRACE_PREFIX):]}
     for part in first.split()[1:]:
         k, _, v = part.partition("=")
-        if k in ("queue_ms", "service_ms"):
+        if k.endswith("_ms"):
+            # queue_ms/service_ms, and the iteration-mode row breakdown's
+            # ttfj_ms (ISSUE 14) — all land as seconds under *_s
             try:
                 meta[k[:-3] + "_s"] = float(v) / 1e3
             except ValueError:
@@ -325,7 +327,8 @@ def pct(vals, q):
 # streaming (open-loop) mode: --duration N --rate R
 # ---------------------------------------------------------------------------
 
-async def run_stream(args, request_fn, rate=None, duration=None):
+async def run_stream(args, request_fn, rate=None, duration=None,
+                     pool_samples=None):
     """Fire requests at a constant --rate for --duration seconds, start
     times fixed by the schedule (open loop). Returns
     [(t_start_rel, latency_s, kind, queue_s, service_s)] with kind in
@@ -333,7 +336,15 @@ async def run_stream(args, request_fn, rate=None, duration=None):
     without reply metadata (--no-trace). NOTE: the #trace header is an
     extension of THIS repo's server — against a server without it, the
     header line would be translated as an extra sentence; pass
-    --no-trace there."""
+    --no-trace there.
+
+    ``pool_samples`` (ISSUE 14): a list to receive ~1 Hz
+    ``(t_rel, occupancy, cow_alias_ratio)`` scrapes of the server's KV
+    pool gauges during the run — the per-window report prints them next
+    to the latency percentiles, so a swap/brownout p99 blip is
+    attributable to pool pressure from the CLIENT side. Requires
+    --metrics-port; gauges absent (request mode) sample as NaN and the
+    columns are suppressed."""
     results: list = []
     rate = args.rate if rate is None else rate
     duration = args.duration if duration is None else duration
@@ -376,6 +387,27 @@ async def run_stream(args, request_fn, rate=None, duration=None):
                         n_retries))
 
     t0 = time.perf_counter()
+
+    async def sample_pool():
+        # blocking urllib scrape on a worker thread so sampling never
+        # skews the open-loop firing schedule
+        loop = asyncio.get_event_loop()
+        while time.perf_counter() - t0 < duration:
+            try:
+                vals = await loop.run_in_executor(
+                    None, scrape, args.host, args.metrics_port)
+            except Exception:  # noqa: BLE001 — sampling is best-effort
+                vals = {}
+            pool_samples.append((
+                time.perf_counter() - t0,
+                vals.get("marian_serving_kv_pool_occupancy_ratio",
+                         float("nan")),
+                vals.get("marian_serving_kv_pool_cow_alias_ratio",
+                         float("nan"))))
+            await asyncio.sleep(1.0)
+
+    sampler = asyncio.ensure_future(sample_pool()) \
+        if pool_samples is not None and args.metrics_port else None
     tasks = []
     i = 0
     while True:
@@ -391,6 +423,12 @@ async def run_stream(args, request_fn, rate=None, duration=None):
         i += 1
     if tasks:
         await asyncio.gather(*tasks)
+    if sampler is not None:
+        sampler.cancel()
+        try:
+            await sampler
+        except asyncio.CancelledError:
+            pass
     return results
 
 
@@ -483,20 +521,28 @@ def report_sweep(rows) -> None:
               "the lowest offered rate")
 
 
-def report_windows(results, window_s: float) -> None:
+def report_windows(results, window_s: float, pool_samples=None) -> None:
     """Per-window latency table keyed by request START time — a queued
     request that started before a swap and resolved after it lands in
     the window where its latency was incurred. With reply metadata
     (tracing on), each window also splits latency into queue wait vs
     device service, so a swap blip is attributable at a glance: q_p99
     jumping = queued behind the swap; svc_p99 jumping = the new version
-    decodes slower."""
+    decodes slower. With pool samples (ISSUE 14: --metrics-port against
+    an iteration-mode server), pool%/cow% columns print the window's
+    mean KV-pool occupancy and COW alias ratio, so a p99/evict blip is
+    attributable to pool pressure at a glance."""
     if not results:
         print("stream: no requests completed")
         return
     last = max(r[0] for r in results)
     n_windows = int(last // window_s) + 1
     have_meta = any(r[3] is not None for r in results)
+    # pool columns only when at least one sample carried the gauges
+    # (a request-mode server exports neither — all-NaN suppresses them)
+    pool_samples = [s for s in (pool_samples or [])
+                    if s[1] == s[1]]                     # drop NaN
+    have_pool = bool(pool_samples)
     # retry column (ISSUE 11): !!SERVER-RETRY replies received per
     # window — the client-visible count of evict-with-retry events
     # (quiesce deadline, brownout, watchdog) plus any that exhausted
@@ -509,6 +555,8 @@ def report_windows(results, window_s: float) -> None:
         hdr += f" {'retry':>6}"
     if have_meta:
         hdr += f" {'q_p50':>7} {'q_p99':>7} {'svc_p50':>7} {'svc_p99':>7}"
+    if have_pool:
+        hdr += f" {'pool%':>6} {'cow%':>6}"
     print(hdr)
     ttfj = [r[3] for r in results if r[2] == "ok" and r[3] is not None]
     if ttfj:
@@ -543,6 +591,15 @@ def report_windows(results, window_s: float) -> None:
                      f" {pct(qs, 0.99) * 1e3:>7.1f}"
                      f" {pct(ss, 0.50) * 1e3:>7.1f}"
                      f" {pct(ss, 0.99) * 1e3:>7.1f}")
+        if have_pool:
+            ws = [s for s in pool_samples
+                  if w * window_s <= s[0] < (w + 1) * window_s]
+            if ws:
+                occ = 100.0 * sum(s[1] for s in ws) / len(ws)
+                cow = 100.0 * sum(s[2] for s in ws) / len(ws)
+                line += f" {occ:>6.1f} {cow:>6.1f}"
+            else:
+                line += f" {'-':>6} {'-':>6}"
         print(line)
 
 
@@ -660,7 +717,9 @@ def main(argv=None) -> int:
     if args.duration > 0:
         if args.rate <= 0:
             ap.error("--duration streaming mode requires --rate > 0")
-        results = asyncio.run(run_stream(args, request_fn))
+        pool_samples: list = [] if args.metrics_port else None
+        results = asyncio.run(run_stream(args, request_fn,
+                                         pool_samples=pool_samples))
         after = scrape(args.host, args.metrics_port) if args.metrics_port \
             else {}
         latencies = [r[1] for r in results if r[2] == "ok"]
@@ -683,7 +742,7 @@ def main(argv=None) -> int:
             print(f"retries: {retried} resends after !!SERVER-RETRY "
                   f"(evictions), {retried_ok} requests ok after retry, "
                   f"{exhausted} exhausted the --retries budget")
-        report_windows(results, args.window)
+        report_windows(results, args.window, pool_samples=pool_samples)
         if before or after:
             swaps = _delta(before, after, "marian_lifecycle_swaps_total")
             rollbacks = _delta(before, after,

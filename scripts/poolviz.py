@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""poolviz — render a /poolz snapshot as an ASCII page-map/occupancy
+table for post-mortems (ISSUE 14 CI/tooling satellite).
+
+Input is either a LIVE server or a flight-recorder dump:
+
+    # live (the /poolz endpoint on the metrics port):
+    python scripts/poolviz.py http://127.0.0.1:9090/poolz
+
+    # post-mortem (a flight dump embeds the page map under "pool",
+    # raw /poolz JSON works too):
+    python scripts/poolviz.py dumps/flight-...-pool-audit.json
+
+Output: an occupancy header, the page map as a character grid (one
+character per allocatable page: `.` free, `1`-`9` the refcount, `+`
+refcount >= 10), the per-slot decode table (trace id, pos/cap, pages
+held), the engine round counters, the prefix-cache holdings, and the
+last audit verdict.
+
+``--check`` additionally re-derives the auditor's page-accounting
+invariants from the document itself (marian_tpu/obs/poolz.py ::
+check_consistency) and exits 1 on any discrepancy — the post-mortem
+question "did the exported page map even agree with itself?" answered
+without a live process.
+
+Stdlib-only, like scripts/loadgen.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from marian_tpu.obs.poolz import check_consistency  # noqa: E402
+
+PAGES_PER_LINE = 64
+
+
+def load_state(source: str) -> dict:
+    """/poolz JSON from a URL or a file; a flight dump's embedded
+    "pool" member is unwrapped automatically."""
+    if source.startswith("http://") or source.startswith("https://"):
+        if not source.rstrip("/").endswith("/poolz"):
+            source = source.rstrip("/") + "/poolz"
+        with urllib.request.urlopen(source, timeout=5) as fh:
+            doc = json.load(fh)
+    else:
+        with open(source, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    if "enabled" in doc:
+        return doc                  # raw /poolz (enabled true OR false)
+    if isinstance(doc.get("pool"), dict):
+        return doc["pool"]          # flight dump: page map under "pool"
+    return doc
+
+
+def page_grid(state: dict) -> str:
+    """One character per allocatable page (page ids start at 1; the
+    reserved trash page 0 is not drawn): `.` free, digits = refcount,
+    `+` for refcounts past 9."""
+    pool = state["pool"]
+    pages = state.get("pages", {})
+    refs = {int(p): ent["refs"] for p, ent in pages.items()}
+    lines = []
+    for base in range(1, pool["n_pages"], PAGES_PER_LINE):
+        row = []
+        for p in range(base, min(base + PAGES_PER_LINE,
+                                 pool["n_pages"])):
+            rc = refs.get(p, 0)
+            row.append("." if rc == 0 else str(rc) if rc <= 9 else "+")
+        lines.append(f"{base:>6} {''.join(row)}")
+    return "\n".join(lines)
+
+
+def render(state: dict, out=sys.stdout) -> None:
+    w = out.write
+    if not state.get("enabled"):
+        w(f"poolz: disabled ({state.get('reason', 'unknown')}, "
+          f"mode={state.get('batching_mode', '-')})\n")
+        return
+    pool = state["pool"]
+    w(f"engine {state.get('engine', '?')}: "
+      f"{pool['used_pages']}/{pool['usable_pages']} pages claimed "
+      f"({100 * pool['occupancy']:.1f}%), {pool['free_pages']} free, "
+      f"page_len {pool['page_len']} "
+      f"({pool['page_bytes'] / 1024:.1f} KiB/page)\n")
+    w(f"COW: {pool['shared_pages']} shared page(s), alias ratio "
+      f"{100 * pool['cow_alias_ratio']:.1f}%, max refcount "
+      f"{pool['refcount_max']}; lifetime traffic "
+      f"claimed={pool['traffic']['claimed']} "
+      f"freed={pool['traffic']['freed']} "
+      f"aliased={pool['traffic']['aliased']}\n")
+    beam = state.get("beam")
+    if beam:
+        w(f"beam: size {beam['beam_size']} "
+          f"({'COW' if beam['cow'] else 'replication baseline'}), "
+          f"{len(beam['sentences'])} sentence(s) decoding\n")
+    w("\npage map (`.` free, digit = refcount, `+` >= 10):\n")
+    w(page_grid(state) + "\n")
+    rows = state.get("rows", {})
+    slots = rows.get("slots", [])
+    w(f"\nslots: {rows.get('active', 0)}/{rows.get('max_rows', 0)} "
+      f"active, {rows.get('used_tokens', 0)} tokens resident, "
+      f"fragmentation {100 * rows.get('fragmentation', 0):.1f}%\n")
+    if slots:
+        w(f"{'slot':>5} {'pos/cap':>9} {'pages':>6}  owner\n")
+        for s in slots:
+            w(f"{s['slot']:>5} {s['pos']:>4}/{s['cap']:<4} "
+              f"{len(s['pages']):>6}  "
+              f"{s.get('trace_id') or s['owner']}\n")
+    pc = state.get("prefix_cache")
+    if pc:
+        w(f"prefix cache: {pc['entries']} entr(ies), "
+          f"{pc['held_pages']} held page(s) "
+          f"({pc['reclaimable_pages']} reclaimable now), "
+          f"{pc['held_tokens']} tokens retained\n")
+    counters = state.get("counters", {})
+    if counters:
+        w("counters: " + " ".join(f"{k}={v}" for k, v in
+                                  sorted(counters.items())) + "\n")
+    la = state.get("last_audit")
+    if la:
+        verdict = "clean" if la.get("clean") else "FAILED"
+        w(f"last audit ({la.get('context', '?')}): {verdict}")
+        if not la.get("clean"):
+            w(" — " + "; ".join(la.get("violations", [])[:4]))
+        w("\n")
+    else:
+        w("last audit: none recorded yet\n")
+    sched = state.get("scheduler")
+    if sched:
+        w(f"scheduler: {sched['queued_units']} queued sentence(s) "
+          f"({sched['queued_pages']} pages owed), "
+          f"quiescing={sched['quiescing']}, "
+          f"brownout_level={sched['brownout_level']}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("source",
+                    help="/poolz URL (http://host:metrics-port/poolz) "
+                         "or a flight-dump / raw JSON file")
+    ap.add_argument("--check", action="store_true",
+                    help="re-derive the auditor's page-accounting "
+                         "invariants from the document; exit 1 on any "
+                         "discrepancy")
+    args = ap.parse_args(argv)
+    state = load_state(args.source)
+    render(state)
+    if args.check:
+        bad = check_consistency(state)
+        if bad:
+            print(f"\nCONSISTENCY: {len(bad)} discrepanc(ies):")
+            for b in bad:
+                print(f"  - {b}")
+            return 1
+        print("\nCONSISTENCY: page map agrees with itself")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,638 @@
+"""Paged-serving observability plane (ISSUE 14): per-row serve.row /
+serve.round span tracing, KV-pool occupancy telemetry, the /poolz live
+inspector and its flight-recorder embedding, the #trace reply-metadata
+row breakdown, and the zero-overhead raising-lock guard extended over
+the engine round path. Runs under JAX_PLATFORMS=cpu with the tiny real
+transformer (MARIAN_POOL_AUDIT=1 is armed process-wide by conftest, so
+every engine round here is audited).
+
+The acceptance-critical properties covered tier-1:
+- a mid-decode-joining request's /tracez tree shows join→rounds→EOS
+  (serve.row under the serve.request root) and an evicted request shows
+  join→evict with a retriable outcome, with trace-id cross-links to the
+  serve.round spans;
+- with tracing disabled, the engine round path acquires no tracer/perf
+  lock and allocates no ring (the ISSUE 8 contract, extended);
+- the /poolz page map agrees with the pool auditor's view under live
+  traffic and across a quiesce (zero discrepancies), and a pool-audit
+  flight dump embeds it;
+- metric census + promlint over a REAL /metrics scrape with every new
+  pool/row/round series (MT-METRIC-UNTESTED stays green).
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from marian_tpu import obs
+from marian_tpu.common import Options
+from marian_tpu.common import faultpoints as fp
+from marian_tpu.obs.poolz import check_consistency, pool_routes, snapshot
+from marian_tpu.serving import metrics as msm
+from marian_tpu.serving.promlint import lint_metrics_text
+from marian_tpu.server.server import ServingApp
+from marian_tpu.translator.beam_iteration import PagedBeamEngine
+from marian_tpu.translator.prefix_cache import PrefixCache
+
+from tests.test_iteration import TEXTS, make_engine, tiny  # noqa: F401
+from tests.test_quiesce import make_sched, wait_for
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one page of the tiny engine (page_len 4): 2 (K+V) x dec_depth 2 x
+# heads 2 x page_len 4 x dh 8 x 4 bytes (test_quiesce.PAGE_BYTES)
+PAGE_BYTES = 2 * 2 * 2 * 4 * 8 * 4
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockdep_witness(lockdep_witness):
+    """pool_state/poolz snapshots read KVPool._lock and
+    PagedDecodeEngine._lock from the HTTP threads while the worker
+    mutates; the shared witness pins the observed acquisition orders
+    inside the static lattice."""
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs():
+    yield
+    obs.TRACER.reset()
+    obs.FLIGHT.disarm()
+    obs.PERF.reset()
+    fp.reset_for_tests()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_beam_engine(tiny, registry=None, prefix=None, **kw):
+    model, params, vocab = tiny
+    args = dict(max_rows=4, page_len=4, src_len_cap=8,
+                max_length_cap=12, registry=registry,
+                prefix_cache=prefix, beam_size=2)
+    args.update(kw)
+    return PagedBeamEngine(model, params, vocab, vocab, **args)
+
+
+class _RaisingLock:
+    """Any acquisition fails the test (the ISSUE 8 proof object)."""
+
+    def __enter__(self):
+        raise AssertionError("lock acquired on the disabled-tracer "
+                             "engine round path")
+
+    def __exit__(self, *exc):
+        pass
+
+    def acquire(self, *a, **kw):
+        raise AssertionError("lock acquired on the disabled-tracer "
+                             "engine round path")
+
+    def release(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# pool_state / /poolz vs the auditor (tentpole piece 2+3)
+# ---------------------------------------------------------------------------
+
+class TestPoolState:
+    def test_page_map_agrees_with_auditor_under_traffic(self, tiny):
+        """The acceptance cross-check: mid-decode, the exported page
+        map must satisfy the same accounting invariants the auditor
+        enforces — and the auditor itself must agree the pool is
+        clean."""
+        eng = make_engine(tiny)
+        eng.admit_and_step([(0, TEXTS[0]), (1, TEXTS[1])])
+        eng.admit_and_step([(2, TEXTS[2])])
+        assert eng.audit(context="test") == []
+        st = eng.pool_state()
+        assert st["enabled"] and st["engine"] == "PagedDecodeEngine"
+        assert check_consistency(st) == []
+        # the map reflects the live claims: 3 rows x 3 pages each
+        assert st["rows"]["active"] == 3
+        assert sum(len(r["pages"]) for r in st["rows"]["slots"]) \
+            == st["pool"]["used_pages"]
+        assert st["pool"]["occupancy"] == pytest.approx(
+            st["pool"]["used_pages"] / st["pool"]["usable_pages"])
+        # refcount summary: fresh claims are all sole-owner
+        assert st["pool"]["cow_alias_ratio"] == 0.0
+        assert st["pool"]["refcount_max"] == 1
+        # counters + last audit verdict rode along
+        assert st["counters"]["rounds"] == 2
+        assert st["counters"]["joins"] == 3
+        assert st["counters"]["mid_decode_joins"] == 1
+        assert st["counters"]["audits"] >= 2
+        assert st["last_audit"]["clean"] is True
+        json.dumps(st)              # must be JSON-serializable as-is
+
+    def test_beam_cow_page_map_shows_sharing(self, tiny):
+        """Beam COW state: aliased full pages appear with refcount >= 2
+        and two owners; the map still reconciles with the auditor."""
+        eng = make_beam_engine(tiny)
+        eng.admit_and_step([(0, TEXTS[0])])
+        # step until a full page exists and hypotheses alias it
+        for _ in range(6):
+            if eng.idle():
+                break
+            eng.admit_and_step([])
+        st = eng.pool_state()
+        assert check_consistency(st) == []
+        assert eng.audit(context="test") == []
+        assert st["beam"]["beam_size"] == 2 and st["beam"]["cow"]
+        if not eng.idle():
+            shared = [e for e in st["pages"].values() if e["refs"] >= 2]
+            assert st["pool"]["shared_pages"] == len(shared)
+            for e in shared:
+                assert len(e["owners"]) == e["refs"]
+        # fork traffic was counted
+        assert st["counters"]["forks"] >= 1
+        assert st["counters"]["pages_copied"] >= 1
+
+    def test_consistency_checker_catches_drift(self, tiny):
+        """check_consistency is a real oracle, not a rubber stamp: a
+        doctored page map (the export-side mirror of refcount drift)
+        is flagged."""
+        eng = make_engine(tiny)
+        eng.admit_and_step([(0, TEXTS[0])])
+        st = eng.pool_state()
+        assert check_consistency(st) == []
+        page = next(iter(st["pages"]))
+        st["pages"][page]["refs"] += 1
+        bad = check_consistency(st)
+        assert bad and "owner reference" in bad[0]
+
+    def test_snapshot_reports_disabled_cleanly(self, tiny):
+        assert snapshot(None)["enabled"] is False
+
+        class _ReqSched:
+            batching_mode = "request"
+        assert snapshot(_ReqSched())["enabled"] is False
+        assert snapshot(_ReqSched())["batching_mode"] == "request"
+
+    def test_poolz_route_roundtrip_and_quiesce_agreement(self, tiny):
+        """/poolz over real HTTP against a live iteration scheduler:
+        the page map cross-checks against KVPool.audit under traffic,
+        and stays in agreement across a quiesce re-point (the
+        acceptance's zero-discrepancies clause)."""
+        sched, eng, reg = make_sched(tiny)
+        srv = msm.MetricsServer(0, registry=reg,
+                                routes=pool_routes(lambda: sched)).start()
+
+        def poolz(query=""):
+            return json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/poolz{query}").read())
+
+        try:
+            async def main():
+                sched.start()
+                f1 = sched.submit(TEXTS[:2])
+                await asyncio.sleep(0.05)
+                mid = poolz("?check=1")      # scraped MID-decode
+                f2 = sched.submit([TEXTS[2]])
+                r1, r2 = await f1, await f2
+                # quiesce re-point onto a fresh engine, then re-scrape
+                eng2 = make_engine(tiny)
+                op = sched.request_quiesce(
+                    lambda: sched.install_engine(eng2),
+                    deadline_s=5.0, reason="test-swap", wait=False)
+                assert await wait_for(op.event.is_set)
+                assert op.ok
+                post = poolz("?check=1")
+                await sched.stop()
+                return mid, post, eng2
+
+            mid, post, eng2 = run(main())
+            assert mid["enabled"] is True
+            assert mid["consistency"] == []
+            assert mid["rows"]["active"] >= 1
+            assert mid["scheduler"]["quiescing"] == 0
+            # post-quiesce: the route resolves THROUGH the scheduler —
+            # it must now report the fresh engine's (empty) pool, and
+            # that view must agree with the fresh engine's auditor
+            assert post["consistency"] == []
+            assert post["rows"]["active"] == 0
+            assert post["pool"]["used_pages"] == 0
+            assert eng2.audit(context="test") == []
+            assert post["last_audit"] is None \
+                or post["last_audit"]["clean"]
+        finally:
+            srv.close()
+
+    def test_pool_audit_flight_dump_embeds_page_map(self, tiny,
+                                                    tmp_path):
+        """Acceptance: a pool-audit flight dump embeds the page map at
+        incident time. Wire a real ServingApp (iteration mode) so the
+        `pool` snapshot provider registration is what gets tested, then
+        fire the refcount-corruption drill so the auditor trips for
+        real."""
+        obs.TRACER.enable()
+        obs.FLIGHT.arm(str(tmp_path))
+        eng = make_engine(tiny)
+        app = ServingApp(Options({"metrics-port": 0, "port": 0,
+                                  "batching-mode": "iteration",
+                                  "beam-size": 1}),
+                         translate_lines=lambda lines: list(lines),
+                         engine=eng)
+        try:
+            async def main():
+                await app.start()
+                # a row must be decoding BEFORE the drill fires — the
+                # corruption needs live refcounts to corrupt
+                f = app.scheduler.submit([TEXTS[4]])
+                await wait_for(
+                    lambda: app.scheduler.m_joins.value >= 1)
+                with fp.active("pool.refcount_corrupt=fail:1"):
+                    with pytest.raises(Exception):
+                        await f
+                await app.scheduler.stop()
+
+            run(main())
+            deadline = time.time() + 5.0
+            dumps = []
+            while time.time() < deadline:
+                dumps = sorted(p for p in os.listdir(tmp_path)
+                               if p.startswith("flight-")
+                               and "pool-audit" in p)
+                if dumps:
+                    break
+                time.sleep(0.02)
+            assert dumps, "no pool-audit flight dump written"
+            payload = json.loads((tmp_path / dumps[0]).read_text())
+            pool = payload["pool"]
+            assert pool["enabled"] is True
+            assert "pages" in pool and "counters" in pool
+            assert pool["last_audit"]["clean"] is False
+            # the injected corruption is visible in the embedded map's
+            # own cross-check — exactly what a post-mortem needs
+            assert check_consistency(pool) != []
+        finally:
+            app.close_nowait()
+
+    def test_poolviz_renders_and_checks(self, tiny, capsys):
+        spec = importlib.util.spec_from_file_location(
+            "poolviz", os.path.join(ROOT, "scripts", "poolviz.py"))
+        pv = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pv)
+        eng = make_engine(tiny)
+        eng.admit_and_step([(0, TEXTS[0]), (1, TEXTS[1])])
+        eng.audit(context="test")
+        st = eng.pool_state()
+        path = os.path.join(ROOT, "/tmp", "poolz.json")
+        path = "/tmp/poolviz_test.json"
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(st, fh)
+        assert pv.main([path, "--check"]) == 0
+        out = capsys.readouterr().out
+        assert "pages claimed" in out
+        assert "page map" in out
+        assert "last audit (test): clean" in out
+        assert "agrees with itself" in out
+        # a doctored dump exits 1 (the post-mortem discrepancy path)
+        st["pages"][next(iter(st["pages"]))]["refs"] += 3
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(st, fh)
+        assert pv.main([path, "--check"]) == 1
+        os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+# per-row lifecycle tracing (tentpole piece 1)
+# ---------------------------------------------------------------------------
+
+class TestRowSpans:
+    def test_mid_decode_join_tree_and_round_crosslinks(self, tiny):
+        """Acceptance: a mid-decode-joining request's tree shows
+        join→rounds→EOS (serve.row under the serve.request root, with
+        ttfj/bucket/rounds), serve.round spans cross-link the row's
+        trace id, and serve.queue ends at JOIN time (the PR 14
+        queue_ms fix, now pinned at the span level too)."""
+        obs.TRACER.enable()
+        sched, eng, reg = make_sched(tiny)
+
+        async def main():
+            sched.start()
+            f1 = sched.submit([TEXTS[4]], trace_id="rowaaa1")
+            await wait_for(lambda: sched.m_joins.value >= 1)
+            f2 = sched.submit([TEXTS[1]], trace_id="rowbbb2")
+            await f1
+            await f2
+            await sched.stop()
+
+        run(main())
+        spans, _ = obs.TRACER.snapshot()
+        rows = {s.trace_id: s for s in spans if s.name == "serve.row"}
+        assert set(rows) >= {"rowaaa1", "rowbbb2"}
+        roots = {s.trace_id: s for s in spans
+                 if s.name == "serve.request"}
+        for tid in ("rowaaa1", "rowbbb2"):
+            r = rows[tid]
+            assert r.parent_id == roots[tid].span_id
+            assert r.attrs["outcome"] == "eos"
+            assert r.attrs["rounds"] >= 1
+            assert r.attrs["ttfj_ms"] >= 0.0
+            assert r.attrs["bucket"] >= 1
+        # the second request joined a RUNNING decode
+        assert rows["rowbbb2"].attrs["mid_decode"] is True
+        assert rows["rowaaa1"].attrs["mid_decode"] is False
+        # serve.round spans cross-link their rows' trace ids, and the
+        # page traffic attrs are present
+        rounds = [s for s in spans if s.name == "serve.round"]
+        assert rounds
+        linked = [s for s in rounds
+                  if "rowbbb2" in s.attrs.get("traces", [])]
+        assert linked, "no serve.round cross-links the joining row"
+        shared = [s for s in linked
+                  if "rowaaa1" in s.attrs.get("traces", [])]
+        assert shared, "no round shows both rows decoding together"
+        for s in rounds:
+            assert {"rows", "bucket", "steps", "tokens",
+                    "pages_claimed", "pages_freed",
+                    "pages_copied"} <= set(s.attrs)
+        # joining rounds account the joiner's pages as claimed
+        join_round = next(s for s in rounds if s.attrs["joined"] >= 1)
+        assert join_round.attrs["pages_claimed"] >= 1
+        # serve.queue ends at JOIN: the row span STARTS when the queue
+        # span ends (regression: inheriting the running decode's
+        # dispatch accounting would stretch queue past the join)
+        for tid in ("rowaaa1", "rowbbb2"):
+            q = next(s for s in spans if s.name == "serve.queue"
+                     and s.trace_id == tid)
+            assert q.end_t is not None
+            assert q.end_t <= rows[tid].start + 0.050
+            # and the queue did NOT swallow the decode: the row decoded
+            # for multiple rounds after the queue span closed
+            assert rows[tid].duration() > 0.0
+
+    def test_evicted_request_tree_and_meta_breakdown(self, tiny):
+        """Acceptance (evict half): a quiesce-deadline eviction shows
+        join→evict with a retriable outcome on the row span, and the
+        reply metadata carries the row breakdown (rounds, ttfj_ms,
+        prefix_hit, evictions)."""
+        obs.TRACER.enable()
+        sched, eng, reg = make_sched(tiny)
+        meta = {}
+
+        async def main():
+            sched.start()
+            f = sched.submit([TEXTS[4]], meta=meta, trace_id="evict01")
+            await wait_for(lambda: sched.m_joins.value >= 1)
+            eng2 = make_engine(tiny)
+            op = sched.request_quiesce(
+                lambda: sched.install_engine(eng2),
+                deadline_s=0.0, reason="test-evict", wait=False)
+            with pytest.raises(Exception) as ei:
+                await f
+            assert "retry" in str(ei.value)
+            assert await wait_for(op.event.is_set)
+            await sched.stop()
+
+        run(main())
+        spans, _ = obs.TRACER.snapshot()
+        row = next(s for s in spans if s.name == "serve.row"
+                   and s.trace_id == "evict01")
+        assert row.attrs["outcome"] == "quiesce"
+        assert row.attrs["retriable"] is True
+        assert meta["outcome"] == "evicted"
+        assert meta["evictions"] == 1
+        assert meta["rounds"] >= 1
+        assert meta["prefix_hit"] == 0
+        assert meta["ttfj_ms"] >= 0.0
+        assert sched.m_quiesce_evictions.value == 1
+
+    def test_prefix_hit_flag_and_fork_event(self, tiny):
+        """A prefix-cache replay marks prefix_hit in the metadata
+        without a join; a live COW fork joins AND flags it, with the
+        prefix.fork instant on the timeline."""
+        obs.TRACER.enable()
+        model, params, vocab = tiny
+        cache = PrefixCache(max_entries=8, version="v1")
+        eng = make_engine(tiny, prefix_cache=cache)
+        sched, eng, reg = make_sched(tiny, engine=eng)
+        meta_cold, meta_fork, meta_hit = {}, {}, {}
+
+        async def main():
+            sched.start()
+            f1 = sched.submit([TEXTS[0]], meta=meta_cold,
+                              trace_id="pcold01")
+            await wait_for(lambda: sched.m_joins.value >= 1)
+            # same source while the leader decodes: live COW fork
+            f2 = sched.submit([TEXTS[0]], meta=meta_fork,
+                              trace_id="pfork01")
+            await f1
+            await f2
+            # exact repeat after completion: replay hit, no decode
+            f3 = sched.submit([TEXTS[0]], meta=meta_hit,
+                              trace_id="phit001")
+            await f3
+            await sched.stop()
+
+        run(main())
+        assert meta_cold["prefix_hit"] == 0
+        assert meta_hit["prefix_hit"] == 1
+        assert meta_hit["rounds"] == 0          # replay: no decode round
+        _, events = obs.TRACER.snapshot()
+        names = [e["name"] for e in events]
+        assert "prefix.hit" in names
+        if meta_fork["prefix_hit"]:             # fork raced the finish
+            assert "prefix.fork" in names
+            spans, _ = obs.TRACER.snapshot()
+            frow = next(s for s in spans if s.name == "serve.row"
+                        and s.trace_id == "pfork01")
+            assert frow.attrs.get("prefix_fork") is True
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead contract, extended over the engine round path
+# ---------------------------------------------------------------------------
+
+class TestRoundPathOverheadGuard:
+    def test_disabled_no_ring_no_lock_on_round_path(self, tiny):
+        """ISSUE 14 acceptance: with tracing disabled (and no perf
+        accounting), a full iteration round — join, decode steps, EOS,
+        page telemetry accounting — acquires neither the tracer lock
+        nor the perf meter's lock and allocates no ring. The pool/
+        engine locks are the round's own concurrency discipline and
+        deliberately NOT under this guard."""
+        assert not obs.enabled()
+        obs.PERF.reset()
+        assert not obs.PERF.enabled
+        saved, saved_perf = obs.TRACER._lock, obs.PERF._lock
+        obs.TRACER._lock = _RaisingLock()
+        obs.PERF._lock = _RaisingLock()
+        try:
+            sched, eng, reg = make_sched(tiny)
+            meta = {}
+
+            async def main():
+                sched.start()
+                f1 = sched.submit(TEXTS[:2], meta=meta)
+                await asyncio.sleep(0.05)
+                f2 = sched.submit([TEXTS[2]])   # mid-decode join
+                r1, r2 = await f1, await f2
+                await sched.stop()
+                return r1, r2
+
+            r1, r2 = run(main())
+            assert len(r1) == 2 and len(r2) == 1
+            # the tracing-independent reply metadata still filled in
+            assert meta["outcome"] == "ok" and meta["rounds"] >= 1
+        finally:
+            obs.TRACER._lock = saved
+            obs.PERF._lock = saved_perf
+        assert obs.TRACER._ring is None
+        assert obs.TRACER._events is None
+
+
+# ---------------------------------------------------------------------------
+# reply-protocol row breakdown through the real server frame path
+# ---------------------------------------------------------------------------
+
+class TestReplyRowBreakdown:
+    def test_trace_header_reply_carries_row_breakdown(self, tiny):
+        eng = make_engine(tiny)
+        app = ServingApp(Options({"metrics-port": 0, "port": 0,
+                                  "batching-mode": "iteration",
+                                  "beam-size": 1}),
+                         translate_lines=lambda lines: list(lines),
+                         engine=eng)
+
+        async def main():
+            await app.start()
+            try:
+                return await app.handle_text(
+                    "#trace:rowmeta1\n" + TEXTS[0])
+            finally:
+                await app.shutdown(drain_timeout=5)
+
+        reply = run(main())
+        meta_line, _, body = reply.partition("\n")
+        assert meta_line.startswith("#trace:rowmeta1 ")
+        assert "outcome=ok" in meta_line
+        assert "rounds=" in meta_line
+        assert "ttfj_ms=" in meta_line
+        assert "prefix_hit=0" in meta_line
+        assert "evictions=0" in meta_line
+        assert body  # the translation came back
+        # loadgen's parser understands the extended line
+        spec = importlib.util.spec_from_file_location(
+            "loadgen", os.path.join(ROOT, "scripts", "loadgen.py"))
+        lg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lg)
+        meta, _ = lg.split_reply_meta(reply)
+        assert meta["trace_id"] == "rowmeta1"
+        assert "ttfj_s" in meta and "queue_s" in meta
+        assert int(meta["rounds"]) >= 1
+
+
+# ---------------------------------------------------------------------------
+# metric census + promlint over a REAL /metrics scrape
+# ---------------------------------------------------------------------------
+
+class TestMetricCensus:
+    # every series this PR added (MT-METRIC-UNTESTED's corpus)
+    NEW_SERIES = (
+        "marian_serving_kv_pool_occupancy_ratio",
+        "marian_serving_kv_pool_pages_shared",
+        "marian_serving_kv_pool_refcount_max",
+        "marian_serving_kv_pool_cow_alias_ratio",
+        "marian_serving_kv_pool_pages_claimed_total",
+        "marian_serving_kv_pool_pages_freed_total",
+        "marian_serving_kv_pool_pages_aliased_total",
+        "marian_serving_kv_pool_pages_copied_total",
+        "marian_serving_kv_pool_bytes_copied_total",
+        "marian_serving_kv_pool_bytes_aliased_total",
+        "marian_serving_cow_forks_total",
+        "marian_serving_engine_rounds_total",
+        "marian_prefix_held_pages",
+        "marian_prefix_reclaimable_pages",
+    )
+
+    def test_census_and_promlint_over_real_scrape(self, tiny):
+        """Every new pool/row/round series is declared, emitted and
+        scrapeable over real HTTP, and the whole exposition passes
+        promlint with the new series present. The beam engine +
+        prefix cache drive the COW/alias/copied series with real
+        nonzero traffic."""
+        reg = msm.Registry()
+        cache = PrefixCache(max_entries=8, version="v1", registry=reg)
+        eng = make_beam_engine(tiny, registry=reg, prefix=cache)
+        eng.decode_texts([TEXTS[0], TEXTS[1]])
+        eng.decode_texts([TEXTS[0]])            # replay hit
+        srv = msm.MetricsServer(0, registry=reg).start()
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+        finally:
+            srv.close()
+        assert lint_metrics_text(text) == []
+        for name in self.NEW_SERIES:
+            assert name in text, name
+        # the COW plane saw real traffic, not just declared series
+        assert "marian_serving_cow_forks_total 0\n" not in text
+        assert "marian_serving_kv_pool_pages_copied_total 0\n" \
+            not in text
+        assert "marian_serving_kv_pool_pages_aliased_total 0\n" \
+            not in text
+
+    def test_byte_counters_price_pages_in_page_bytes(self, tiny):
+        reg = msm.Registry()
+        eng = make_beam_engine(tiny, registry=reg)
+        eng.decode_texts([TEXTS[0]])
+        copied = reg.get(
+            "marian_serving_kv_pool_pages_copied_total").value
+        bytes_copied = reg.get(
+            "marian_serving_kv_pool_bytes_copied_total").value
+        assert copied >= 1
+        assert bytes_copied == copied * eng.page_bytes
+        assert eng.page_bytes == PAGE_BYTES
+
+    def test_occupancy_and_alias_gauges_track_live_state(self, tiny):
+        reg = msm.Registry()
+        eng = make_beam_engine(tiny, registry=reg)
+        assert reg.get(
+            "marian_serving_kv_pool_occupancy_ratio").value == 0.0
+        eng.admit_and_step([(0, TEXTS[0])])
+        occ = reg.get("marian_serving_kv_pool_occupancy_ratio").value
+        assert occ == pytest.approx(
+            eng.pool.used_pages() / eng.pool.usable_pages)
+        for _ in range(6):
+            if eng.idle():
+                break
+            eng.admit_and_step([])
+        if not eng.idle():
+            # full pages are aliased across the 2 hypotheses by now
+            assert reg.get(
+                "marian_serving_kv_pool_cow_alias_ratio").value \
+                == pytest.approx(eng.cow_alias_ratio())
+        while not eng.idle():
+            eng.admit_and_step([])
+        assert reg.get(
+            "marian_serving_kv_pool_pages_shared").value == 0
+
+
+# ---------------------------------------------------------------------------
+# static-analysis pins (mtlint span-family scope over the engines)
+# ---------------------------------------------------------------------------
+
+class TestStaticAnalysisPins:
+    def test_span_family_covers_translator_engines(self):
+        """ISSUE 14 satellite: the span-hygiene family's scope covers
+        marian_tpu/translator/ (the paged engines) and the serving
+        scheduler — a future dirs= narrowing must not silently drop
+        the row/round span code out of the MT-SPAN gates."""
+        from marian_tpu.analysis.core import Config
+        from pathlib import Path
+        cfg = Config.load(Path(ROOT))
+        for rel in ("marian_tpu/translator/iteration.py",
+                    "marian_tpu/translator/beam_iteration.py",
+                    "marian_tpu/serving/scheduler.py",
+                    "marian_tpu/obs/poolz.py"):
+            assert cfg.family_applies("span", rel), rel
+            assert not cfg.excluded(rel), rel
