@@ -175,11 +175,13 @@ class ModuleInfo:
         self.imports: Dict[str, Tuple] = {}
 
 
-# the witness's own plumbing (lockdep._WITNESS_LOCK — deliberately
-# unwitnessed, held only around its edge-dict updates) is
-# instrumentation, not part of the modeled lattice: keep its locks out
-# of the graph and the committed docs/lock_order.dot
-_INSTRUMENTATION_MODULES = frozenset({"marian_tpu.common.lockdep"})
+# the witnesses' own plumbing (lockdep._WITNESS_LOCK and
+# ownwit._WITNESS_LOCK — deliberately unwitnessed, held only around
+# their record-dict updates) is instrumentation, not part of the
+# modeled lattice: keep its locks out of the graph and the committed
+# docs/lock_order.dot
+_INSTRUMENTATION_MODULES = frozenset({"marian_tpu.common.lockdep",
+                                      "marian_tpu.common.ownwit"})
 
 
 def _modname(rel: str) -> str:

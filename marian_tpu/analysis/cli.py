@@ -50,16 +50,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline with all current findings "
                         "and exit 0")
-    p.add_argument("--format", choices=("text", "json", "dot"),
+    p.add_argument("--format",
+                   choices=("text", "json", "sarif", "dot",
+                            "ownership-dot"),
                    default="text",
-                   help="text/json print findings; dot prints the "
-                        "lock-order graph (Graphviz) instead of linting "
-                        "— the committed snapshot is docs/lock_order.dot")
+                   help="text/json/sarif print findings (sarif = SARIF "
+                        "2.1.0, renders as code annotations in CI and "
+                        "editors); dot prints the lock-order graph "
+                        "(Graphviz) instead of linting — the committed "
+                        "snapshot is docs/lock_order.dot; ownership-dot "
+                        "prints the resource-ownership graph — the "
+                        "committed snapshot is docs/ownership.dot")
     p.add_argument("--rules", metavar="FAMILIES", default=None,
                    help="comma-separated rule families to run (default all): "
                         "trace-safety,host-sync,donation,dtype,guarded-by,"
                         "metrics,faults,lock-order,lock-blocking,"
-                        "guard-escape")
+                        "guard-escape,span,ownership")
     p.add_argument("--changed", action="store_true",
                    help="incremental mode (scripts/mtlint-precommit.sh): "
                         "exit immediately when git reports no dirty .py "
@@ -121,6 +127,55 @@ def git_dirty_py(root: Path, paths: List[Path],
     return dirty
 
 
+def _sarif(findings, errors: List[str]) -> dict:
+    """SARIF 2.1.0 log for the given findings — uploadable to GitHub
+    code scanning / renderable as inline annotations in editors (the
+    CI satellite of ISSUE 15). Non-baselined findings only, matching
+    the text/json verdicts; parse errors become toolExecution
+    notifications."""
+    from .core import RULESET_VERSION
+    from .rules import all_rules
+    rules_meta = [
+        {"id": rid,
+         "properties": {"family": rule.family}}
+        for rule in all_rules() for rid in rule.ids]
+    results = []
+    for f in findings:
+        text = f.message + (f" [hint: {f.hint}]" if f.hint else "")
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": text},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mtlint",
+                "version": f"{RULESET_VERSION}",
+                "rules": rules_meta,
+            }},
+            "results": results,
+            "invocations": [{
+                "executionSuccessful": not errors,
+                "toolExecutionNotifications": [
+                    {"level": "error", "message": {"text": e}}
+                    for e in errors],
+            }],
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
@@ -155,6 +210,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         for e in errors:
             print(f"mtlint: {e}", file=sys.stderr)
         sys.stdout.write(cg.build_cached(sources).to_dot())
+        return 2 if errors else 0
+    if args.format == "ownership-dot":
+        # the resource-ownership graph (ISSUE 15): acquire/release/
+        # transfer sites + pairable edges, snapshotted at
+        # docs/ownership.dot (freshness is a tier-1 test) and
+        # cross-checked by the runtime witness (common/ownwit.py)
+        from .ownership import OwnershipGraph
+        sources = collect_sources(paths, config, errors=errors)
+        for e in errors:
+            print(f"mtlint: {e}", file=sys.stderr)
+        sys.stdout.write(OwnershipGraph.build(sources).to_dot())
         return 2 if errors else 0
 
     if args.changed:
@@ -240,6 +306,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.show_baselined:
             payload["baselined_findings"] = [f.to_json() for f in old]
         print(json.dumps(payload, indent=1))
+    elif args.format == "sarif":
+        print(json.dumps(_sarif(new, errors), indent=1))
     else:
         for f in new:
             print(f.render())

@@ -35,7 +35,11 @@ SUPPRESS_TAG = "mtlint:"
 # v5: MT-METRIC-UNTESTED (every registered metric name must be exercised
 #     by tests/ — the metrics mirror of MT-FAULT-UNTESTED) +
 #     MT-SPAN-UNCLOSED recognizes the keyword close form `end(span=sp)`.
-RULESET_VERSION = 5
+# v6: MT-OWN family (ownership) — static resource-ownership & leak
+#     analysis over the KVPool/prefix-cache/executor/engine/file verb
+#     registry, with the `# owns: caller|callee` / `# mtlint: transfers`
+#     annotation vocabulary (validated at runtime by common/ownwit.py).
+RULESET_VERSION = 6
 
 
 @dataclasses.dataclass(frozen=True)
@@ -259,6 +263,10 @@ DEFAULT_RULE_DIRS: Dict[str, List[str]] = {
     # span hygiene runs everywhere the tracer API can be used (obs
     # itself, serving, server, training, scripts)
     "span": [],
+    # resource ownership (MT-OWN-*): everywhere — the KVPool verb
+    # surface lives in translator/, but executors/threads/engines/file
+    # handles are acquired across the whole tree
+    "ownership": [],
 }
 
 DEFAULT_EXCLUDE = ["marian_tpu/analysis"]

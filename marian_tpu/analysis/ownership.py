@@ -1,0 +1,392 @@
+"""Resource-ownership model for mtlint (ISSUE 15 tentpole).
+
+PR 16 made page ownership the correctness substrate of the serving
+plane: refcounted ``KVPool`` claims flow through ``claim``/
+``claim_extra``/``share``/``retable``/``transfer``/``release`` across
+the beam engine, the prefix cache, quiesce, and brownout eviction — and
+until now the only line of defense was the RUNTIME auditor, which
+catches a leak after it happened, on a path traffic happened to
+exercise. This module is the static half of the same discipline the
+lock analysis already follows (PR 11): enumerate the dynamic behavior
+statically, and keep the enumeration honest with a runtime witness
+(common/ownwit.py) that fails tier-1 when reality exercises a pairing
+the model never derived.
+
+Three things live here, shared by the rule family
+(rules/ownership.py), the CLI's ``--format ownership-dot`` render, and
+the witness cross-check:
+
+- **The verb registry** (:data:`REGISTRY`): which method calls acquire,
+  release, or transfer which RESOURCE CLASS, and where the owner handle
+  sits in the argument list. Covered classes: ``kv-pages`` (KVPool
+  claims + the prefix cache's holdings — ``adopt`` transfers a row's
+  references into the cache), ``span`` (Tracer.start_span/end — the
+  static lifetime rules for spans stay with the MT-SPAN family; the
+  sites are registered here so the ownership graph knows them),
+  ``executor`` (ThreadPoolExecutor construction → ``shutdown``),
+  ``worker`` (non-daemon ``threading.Thread`` → ``join``; daemon
+  threads are released by process exit and are not a resource),
+  ``engine`` (PagedDecodeEngine/PagedBeamEngine construction — an
+  engine owns a device page pool; whoever holds one must hand it to the
+  lifecycle plane or quiesce it away), and ``file`` (``open`` outside a
+  ``with`` → ``close`` — the faultpoint-armed handle discipline).
+
+- **The annotation vocabulary**, mirroring ``# guarded-by:``:
+  ``# owns: caller`` on a ``def`` line blesses a function that hands a
+  still-held resource to its caller (the ``_claim_pages`` wrapper
+  shape); ``# owns: callee`` blesses a function that releases or
+  transfers a handle it received from its caller (the ``_evict`` /
+  ``adopt`` shape); ``# mtlint: transfers`` on a statement blesses an
+  owned handle moving into a longer-lived structure (the engine's
+  claims-table holdings). MT-OWN-TRANSFER / MT-OWN-ESCAPE fire at
+  unannotated boundaries.
+
+- **The ownership graph**: per resource class, every acquire/release/
+  transfer SITE (identified ``<rel>::<function>`` — exactly what a
+  runtime stack frame resolves to), with an edge acquire→release for
+  every pair the model considers PAIRABLE: the two sites share a common
+  ancestor in the project call graph (callgraph.py, spawn edges
+  included — a brownout-thread eviction legitimately releases what the
+  device worker acquired). The runtime witness asserts observed
+  pairings ⊆ this graph; the committed snapshot is
+  ``docs/ownership.dot`` (freshness-tested) next to ``lock_order.dot``.
+
+Documented limits (deliberate, witness-kept-honest): calls through
+locals bound to callables pair only via spawn edges; owner handles
+built from expressions (``self._owner(key, slot)``) are modeled as
+sites but not tracked as per-function obligations; exception edges are
+modeled for registered acquire verbs (which document ``PoolExhausted``)
+and explicit ``raise`` — an arbitrary call that throws mid-hold is the
+auditor's and the witness's job to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Source, dotted_name
+
+# -- annotation vocabulary ---------------------------------------------------
+
+OWNS_RE = re.compile(r"owns:\s*(caller|callee)\b")
+TRANSFERS_TAG = "transfers"
+
+
+def owns_annotation(src: Source, fn: ast.AST) -> Optional[str]:
+    """'caller' / 'callee' from an ``# owns:`` comment on the def line
+    or the line above it (mirrors ``# mtlint: holds``)."""
+    for line in (fn.lineno, fn.lineno - 1):
+        m = OWNS_RE.search(src.comments.get(line, ""))
+        if m:
+            return m.group(1)
+    return None
+
+
+def line_transfers(src: Source, lineno: int) -> bool:
+    """``# mtlint: transfers [-- reason]`` on the statement's line: the
+    owned handle deliberately moves into a longer-lived structure."""
+    comment = src.comments.get(lineno, "")
+    if not comment.startswith("mtlint:"):
+        return False
+    body = comment[len("mtlint:"):].split("--", 1)[0].strip()
+    return body == TRANSFERS_TAG or body.startswith(TRANSFERS_TAG + " ")
+
+
+# -- the verb registry -------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Verb:
+    cls: str                 # resource class
+    kind: str                # "acquire" | "release" | "transfer"
+    owner_arg: Optional[int]  # owner-handle arg index; None = the call
+    #                          RESULT is the handle (binding style)
+    receivers: Tuple[str, ...] = ()   # acceptable receiver leaf names
+    #                                   (empty = any receiver / ctor)
+    may_raise: bool = False  # documented raiser (PoolExhausted): the
+    #                          exception edge the leak rule models
+
+
+# KVPool's verb surface (ops/pallas/kv_pool.py). The receiver filter is
+# the attribute component directly before the verb — `self.pool.claim`,
+# `pool.release`, `engine.pool.share` all match; `lock.release` or
+# `str.join` never do.
+_POOL_RECV = ("pool",)
+_PREFIX_RECV = ("prefix", "cache")
+_TRACER_RECV = ("TRACER", "tracer", "obs")
+
+REGISTRY: Dict[str, Tuple[Verb, ...]] = {
+    "claim": (Verb("kv-pages", "acquire", 0, _POOL_RECV, may_raise=True),),
+    "claim_extra": (Verb("kv-pages", "acquire", 0, _POOL_RECV,
+                         may_raise=True),),
+    "share": (Verb("kv-pages", "acquire", 0, _POOL_RECV, may_raise=True),),
+    "release": (Verb("kv-pages", "release", 0, _POOL_RECV),),
+    "retable": (Verb("kv-pages", "transfer", 0, _POOL_RECV),),
+    "transfer": (Verb("kv-pages", "transfer", 0, _POOL_RECV),),
+    # PrefixCache adoption: a finished row's references change hands
+    # into the cache (owner handle = the row key, arg 2 of
+    # adopt(pool, key, row_key, tokens, text))
+    "adopt": (Verb("kv-pages", "transfer", 2, _PREFIX_RECV),),
+    # span begin/end — the MT-SPAN family owns the per-function
+    # lifetime rules; these entries make the sites part of the
+    # ownership site registry (and the witness's span class, were it
+    # armed) without double-reporting
+    "start_span": (Verb("span", "acquire", None, _TRACER_RECV),),
+    "end": (Verb("span", "release", 0, _TRACER_RECV),),
+    # executors / workers / engines / files: binding-style handles
+    "ThreadPoolExecutor": (Verb("executor", "acquire", None),),
+    "shutdown": (Verb("executor", "release", None),),
+    "Thread": (Verb("worker", "acquire", None, ("threading",)),),
+    "join": (Verb("worker", "release", None),),
+    "PagedDecodeEngine": (Verb("engine", "acquire", None),),
+    "PagedBeamEngine": (Verb("engine", "acquire", None),),
+    "open": (Verb("file", "acquire", None),),
+    "close": (Verb("file", "release", None),),
+}
+
+# classes whose per-function obligations the MT-OWN rules track (span
+# stays with MT-SPAN; worker/file/executor/engine are binding-style)
+OWNER_KEYED_CLASSES = frozenset({"kv-pages"})
+BINDING_CLASSES = frozenset({"executor", "worker", "engine", "file"})
+
+# classes rendered into the ownership graph / checked by the runtime
+# witness (common/ownwit.py instruments exactly these)
+GRAPH_CLASSES = ("kv-pages",)
+
+EXTERNAL_SITE = "<external>"
+
+
+def _tail(name: Optional[str]) -> str:
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def match_verb(call: ast.Call) -> Optional[Verb]:
+    """The registry entry a call site matches, or None."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    verbs = REGISTRY.get(parts[-1])
+    if not verbs:
+        return None
+    recv = parts[-2] if len(parts) >= 2 else ""
+    for v in verbs:
+        if v.receivers and recv not in v.receivers:
+            continue
+        if parts[-1] == "Thread" and _thread_is_daemon(call):
+            # daemon threads are released by process exit — not a
+            # resource; non-daemon threads must be joined
+            return None
+        return v
+    return None
+
+
+def _thread_is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def owner_expr(call: ast.Call, verb: Verb) -> Optional[ast.AST]:
+    """The owner-handle argument expression of an owner-keyed verb call
+    (positional or the conventional keyword), or None."""
+    if verb.owner_arg is None:
+        return None
+    if len(call.args) > verb.owner_arg:
+        return call.args[verb.owner_arg]
+    names_by_idx = {0: ("owner", "src_owner", "span"), 2: ("row_key",)}
+    for kw in call.keywords:
+        if kw.arg in names_by_idx.get(verb.owner_arg, ()):
+            return kw.value
+    return None
+
+
+# -- static site extraction --------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SiteInfo:
+    cls: str
+    kind: str                 # acquire | release | transfer
+    site: str                 # "<rel>::<function-co_name>"
+    rel: str
+    lineno: int
+    func_qual: Optional[str]  # callgraph qual of the enclosing function
+
+
+def _enclosing_funcname(node: ast.AST) -> str:
+    from .core import ancestors
+    for p in ancestors(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p.name
+        if isinstance(p, ast.Lambda):
+            return "<lambda>"
+    return "<module>"
+
+
+def collect_sites(sources: Sequence[Source],
+                  classes: Optional[Sequence[str]] = None
+                  ) -> List[SiteInfo]:
+    """Every registered verb call site in ``sources`` (deterministic
+    order). Site identity ``<rel>::<function name>`` — the same pair a
+    runtime frame's ``(co_filename, co_name)`` resolves to, which is
+    how the ownership witness matches what it observed back to this
+    model."""
+    out: List[SiteInfo] = []
+    want = set(classes) if classes is not None else None
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            v = match_verb(node)
+            if v is None or (want is not None and v.cls not in want):
+                continue
+            fname = _enclosing_funcname(node)
+            out.append(SiteInfo(
+                cls=v.cls, kind=v.kind,
+                site=f"{src.rel}::{fname}", rel=src.rel,
+                lineno=node.lineno, func_qual=None))
+    out.sort(key=lambda s: (s.cls, s.rel, s.lineno, s.kind))
+    return out
+
+
+# -- the ownership graph -----------------------------------------------------
+
+class OwnershipGraph:
+    """Per resource class: acquire/release/transfer sites + the
+    pairable acquire→release edges (see module docstring)."""
+
+    def __init__(self):
+        # cls -> site -> set of kinds seen at that site
+        self.sites: Dict[str, Dict[str, Set[str]]] = {}
+        # cls -> {(acquire_site, release_site)}
+        self.pairs: Dict[str, Set[Tuple[str, str]]] = {}
+
+    def acquire_sites(self, cls: str) -> Set[str]:
+        return {s for s, kinds in self.sites.get(cls, {}).items()
+                if kinds & {"acquire", "transfer"}}
+
+    def release_sites(self, cls: str) -> Set[str]:
+        return {s for s, kinds in self.sites.get(cls, {}).items()
+                if kinds & {"release", "transfer"}}
+
+    @classmethod
+    def build(cls, sources: Sequence[Source]) -> "OwnershipGraph":
+        from . import callgraph as cg
+        g = cls()
+        graph = cg.build_cached(sources)
+        sites = collect_sites(sources, classes=GRAPH_CLASSES)
+        # attach each site to its callgraph function (by rel + name;
+        # nested defs and methods both match on the leaf name, which is
+        # what a runtime frame's co_name carries)
+        by_key: Dict[Tuple[str, str], List[str]] = {}
+        for q, f in graph.functions.items():
+            by_key.setdefault((f.rel, f.node.name), []).append(q)
+        # override dispatch: a call the type inference resolves to
+        # Base.m may run Sub.m at runtime (the beam engine overrides
+        # the greedy engine's _try_claim/_evict and is driven through
+        # the inherited admit_and_step) — every resolved target also
+        # reaches its subclass overrides
+        overrides: Dict[str, List[str]] = {}
+        for mod in graph.modules.values():
+            for ci in mod.classes.values():
+                for base in ci.mro()[1:]:
+                    for name, meth in ci.methods.items():
+                        if name in base.methods:
+                            overrides.setdefault(
+                                base.methods[name].qual,
+                                []).append(meth.qual)
+        # forward adjacency (spawn edges included: a watcher/brownout
+        # thread legitimately releases what the worker acquired)
+        adj: Dict[str, Set[str]] = {}
+        for q, f in graph.functions.items():
+            outs = adj.setdefault(q, set())
+            for site in f.calls:
+                for t in site.targets:
+                    outs.add(t)
+                    outs.update(overrides.get(t, ()))
+        rev: Dict[str, Set[str]] = {}
+        for q, outs in adj.items():
+            for t in outs:
+                rev.setdefault(t, set()).add(q)
+
+        def ancestors_of(quals: List[str]) -> frozenset:
+            seen: Set[str] = set(quals)
+            stack = list(quals)
+            while stack:
+                cur = stack.pop()
+                for p in rev.get(cur, ()):
+                    if p not in seen:
+                        seen.add(p)
+                        stack.append(p)
+            return frozenset(seen)
+
+        anc_cache: Dict[Tuple[str, str], frozenset] = {}
+        site_anc: Dict[str, frozenset] = {}
+        for s in sites:
+            g.sites.setdefault(s.cls, {}).setdefault(s.site,
+                                                     set()).add(s.kind)
+            rel, _, fname = s.site.partition("::")
+            key = (rel, fname)
+            if key not in anc_cache:
+                anc_cache[key] = ancestors_of(by_key.get(key, []))
+            # a site is always its own ancestor scope, even when the
+            # callgraph never indexed its function (module level)
+            site_anc[s.site] = anc_cache[key] | {s.site}
+        for rcls in g.sites:
+            acq = sorted(g.acquire_sites(rcls))
+            rel_sites = sorted(g.release_sites(rcls))
+            pairs = g.pairs.setdefault(rcls, set())
+            for a in acq:
+                for r in rel_sites:
+                    if a == r or (site_anc[a] & site_anc[r]):
+                        pairs.add((a, r))
+        return g
+
+    def to_dot(self) -> str:
+        """Graphviz render — the committed snapshot is
+        ``docs/ownership.dot`` (freshness-tested like lock_order.dot).
+        Regenerate:
+        ``python -m marian_tpu.analysis --format ownership-dot``."""
+        lines = [
+            "// mtlint ownership graph — for each resource class, every",
+            "// acquire/release/transfer site, with an edge A -> R for",
+            "// every pairing the static model derives (the runtime",
+            "// ownership witness asserts observed pairings are a subset).",
+            "// Regenerate:",
+            "//   python -m marian_tpu.analysis --format ownership-dot "
+            "> docs/ownership.dot",
+            "digraph mtlint_ownership {",
+            "  rankdir=LR;",
+            '  node [shape=box, fontname="monospace", fontsize=10];',
+        ]
+        for rcls in sorted(self.sites):
+            for site in sorted(self.sites[rcls]):
+                kinds = "+".join(sorted(self.sites[rcls][site]))
+                style = ""
+                if "transfer" in kinds:
+                    style = ", style=bold"
+                elif kinds == "release":
+                    style = ", color=gray40"
+                lines.append(f'  "{rcls}:{site}" '
+                             f'[label="{site}\\n({rcls}: {kinds})"{style}];')
+            for a, r in sorted(self.pairs.get(rcls, ())):
+                lines.append(f'  "{rcls}:{a}" -> "{rcls}:{r}";')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+def static_ownership_graph(root) -> OwnershipGraph:
+    """The ownership graph for the repo at ``root`` — what the runtime
+    witness (common/ownwit.py) cross-checks observed (acquire-site →
+    release-site) pairings against. Stdlib-only, never imports the
+    analyzed code."""
+    from pathlib import Path
+
+    from .core import Config, collect_sources
+    root = Path(root)
+    config = Config.load(root)
+    sources = collect_sources([root / "marian_tpu"], config)
+    return OwnershipGraph.build(sources)

@@ -53,4 +53,4 @@ def _load() -> None:
     from . import (trace_safety, host_sync, donation,  # noqa: F401
                    dtype_hygiene, guarded_by, metrics_hygiene,
                    fault_hygiene, lock_order, lock_blocking,
-                   guard_escape, span_hygiene)
+                   guard_escape, span_hygiene, ownership)

@@ -1,0 +1,585 @@
+"""ownership (MT-OWN-*): static resource-ownership & leak analysis
+(ISSUE 15 tentpole — the lock lattice's sibling for resource lifetimes).
+
+The verb registry, annotation vocabulary, and the ownership graph live
+in ``analysis/ownership.py``; this module is the per-function
+path-sensitive acquire/release dataflow over it:
+
+- **MT-OWN-LEAK** — a resource acquired in a function (a ``KVPool``
+  claim, an executor/engine/file/thread handle) reaches a function exit
+  — including exception edges (a later registered acquire can raise
+  ``PoolExhausted`` while this is held; explicit ``raise``) and early
+  returns — with no release/transfer on some path, and no boundary
+  annotation blessing the handoff.
+- **MT-OWN-DOUBLE** — a release/transfer of the same owner reachable
+  twice on one path: the second call decrefs references the owner no
+  longer holds (and ``KVPool.release`` of a gone owner is now a loud
+  ``ValueError``).
+- **MT-OWN-ESCAPE** — an owned handle aliased into a structure that
+  outlives the owner (a ``self.*`` attribute, a ``self.*`` container,
+  a closure) without a ``# mtlint: transfers`` annotation stating the
+  handoff is deliberate.
+- **MT-OWN-TRANSFER** — ownership crossing a function boundary through
+  an unannotated door: a function that exits still holding what it
+  acquired (the ``_claim_pages`` wrapper shape) must say
+  ``# owns: caller``; a function that releases/transfers a handle its
+  caller passed in (the ``_evict``/``adopt`` shape) must say
+  ``# owns: callee`` — mirroring ``# guarded-by:``.
+
+Two obligation styles (see ownership.REGISTRY): **owner-keyed**
+(kv-pages — the verb's first argument IS the handle; the owner name
+flowing through unrelated code is free, only registered verbs move
+ownership; an owner name rebound by a loop/plain assignment denotes
+different owners over time and is exempt from DOUBLE) and **binding**
+(executor/worker/engine/file — the call RESULT is the handle; passing
+it to another callee hands the lifetime to someone else and ends local
+analysis, the span-rule precedent). The ``span`` class's per-function
+lifetime rules stay with the MT-SPAN family — registering its sites
+here without checking them twice.
+
+The rules are deliberately cheap where the runtime side is strong: the
+pool auditor catches a leak at runtime, the ownership witness
+(common/ownwit.py) fails tier-1 when reality exercises a pairing this
+model never derived — "the auditor catches it at runtime, mtlint
+proves it can't happen" (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Config, Finding, Source, dotted_name, parent
+from ..ownership import (BINDING_CLASSES, OWNER_KEYED_CLASSES,
+                         line_transfers, match_verb, owner_expr,
+                         owns_annotation)
+from . import Rule, register
+
+# obligation state: (held, releases) with releases capped at 2
+State = Tuple[int, int]
+
+
+def _owner_fn(node: ast.AST) -> Optional[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parent(cur)
+    return None
+
+
+def _fn_params(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in (list(a.posonlyargs) + list(a.args)
+                             + list(a.kwonlyargs))}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _assigned_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                for nn in ast.walk(t):
+                    if isinstance(nn, ast.Name):
+                        out.add(nn.id)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for nn in ast.walk(n.target):
+                if isinstance(nn, ast.Name):
+                    out.add(nn.id)
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    for nn in ast.walk(item.optional_vars):
+                        if isinstance(nn, ast.Name):
+                            out.add(nn.id)
+    return out
+
+
+class _Obligation:
+    __slots__ = ("cls", "owner", "style", "acquire_node", "is_boundary",
+                 "rebound")
+
+    def __init__(self, cls: str, owner: str, style: str,
+                 acquire_node: ast.Call):
+        self.cls = cls
+        self.owner = owner          # owner dotted name / binding var
+        self.style = style          # "owner" | "binding"
+        self.acquire_node = acquire_node
+        self.is_boundary = False    # owner is a param or free variable
+        self.rebound = False        # owner name rebound by non-verb code
+
+
+class _Walk:
+    """Path-sensitive execution of one function body against ONE
+    obligation. States are tiny (held, releases) tuples; joins are set
+    unions, loops run to a bounded fixpoint, Try routes the raise
+    channel through handlers and finally."""
+
+    def __init__(self, rule: "OwnershipRule", src: Source, fn: ast.AST,
+                 ob: _Obligation, findings: List[Finding]):
+        self.rule = rule
+        self.src = src
+        self.fn = fn
+        self.ob = ob
+        self.findings = findings
+        self.reported: Set[Tuple[str, int]] = set()
+        # exception states that escape the function (exception edges)
+        self.fn_raise: Set[State] = set()
+        self.fn_ret: Set[State] = set()
+
+    # -- channels -----------------------------------------------------------
+    @staticmethod
+    def _ch(fall=frozenset()):
+        return {"fall": set(fall), "raise": set(), "ret": set(),
+                "brk": set(), "cont": set()}
+
+    @staticmethod
+    def _merge(dst, src_ch, skip=("fall",)):
+        for k in ("raise", "ret", "brk", "cont"):
+            if k not in skip:
+                dst[k] |= src_ch[k]
+
+    def _report(self, rule_id: str, node: ast.AST, message: str,
+                hint: str = "") -> None:
+        key = (rule_id, getattr(node, "lineno", 0))
+        if key in self.reported:
+            return
+        self.reported.add(key)
+        self.findings.append(self.src.finding(rule_id, node, message, hint))
+
+    # -- effects of one statement's expressions ------------------------------
+    def _events(self, node: ast.AST):
+        """(sort_key, kind, astnode, verb) events inside ``node`` in
+        source order. Kinds: acquire/release/transfer for this
+        obligation; 'mayraise' for registered raisers affecting any
+        obligation; binding-style escapes."""
+        ob = self.ob
+        events = []
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue          # nested defs are their own pass
+            if isinstance(n, ast.Call):
+                v = match_verb(n)
+                if v is not None and v.may_raise:
+                    events.append(((n.lineno, n.col_offset, 0),
+                                   "mayraise", n, v))
+                if v is None or v.cls != ob.cls:
+                    if ob.style == "binding":
+                        ev = self._binding_escape_in_call(n)
+                        if ev:
+                            events.append(((n.lineno, n.col_offset, 1),
+                                           ev, n, None))
+                    continue
+                if ob.style == "owner":
+                    oe = owner_expr(n, v)
+                    if oe is None or dotted_name(oe) != ob.owner:
+                        continue
+                    events.append(((n.lineno, n.col_offset, 1),
+                                   v.kind, n, v))
+                else:
+                    # binding style: acquire only via THE binding
+                    # assignment; release via `var.close()` etc.
+                    if n is ob.acquire_node:
+                        events.append(((n.lineno, n.col_offset, 1),
+                                       "acquire", n, v))
+                    elif v.kind in ("release", "transfer") \
+                            and self._recv_base(n) == ob.owner:
+                        events.append(((n.lineno, n.col_offset, 1),
+                                       v.kind, n, v))
+        events.sort(key=lambda e: e[0])
+        return events
+
+    @staticmethod
+    def _recv_base(call: ast.Call) -> Optional[str]:
+        d = dotted_name(call.func)
+        return d.split(".")[0] if d else None
+
+    @staticmethod
+    def _handle_escapes_in(node: ast.AST, var: str) -> bool:
+        """True when the HANDLE itself appears in value position under
+        ``node``. A Name that is an Attribute receiver (`fh.read()`,
+        `ex.submit`) is a use of the handle, not an escape of it."""
+        return any(isinstance(n, ast.Name) and n.id == var
+                   and not isinstance(parent(n), ast.Attribute)
+                   for n in ast.walk(node))
+
+    def _binding_escape_in_call(self, call: ast.Call) -> Optional[str]:
+        """The binding handle passed to an unregistered callee: its
+        lifetime is someone else's contract (span-rule precedent) —
+        silently ends tracking, EXCEPT when the callee is a self-owned
+        container/method (`self._x.append(fh)`), which is the
+        aliased-into-an-outliving-structure case MT-OWN-ESCAPE names."""
+        var = self.ob.owner
+        hit = any(self._handle_escapes_in(sub, var)
+                  for sub in (list(call.args)
+                              + [kw.value for kw in call.keywords]))
+        if not hit:
+            return None
+        callee = dotted_name(call.func) or ""
+        return "escape-store" if callee.startswith("self.") \
+            else "escape-silent"
+
+    def _apply(self, node: ast.AST, S: Set[State],
+               raise_sink: Set[State]) -> Set[State]:
+        """Run ``node``'s events over the state set."""
+        ob = self.ob
+        for _, kind, n, _v in self._events(node):
+            if kind == "mayraise":
+                raise_sink |= set(S)      # pre-call states escape
+                continue                  # a same-call acquire/release
+                #                           effect arrives as its own event
+            if kind == "acquire":
+                S = {(1, rel) for (_h, rel) in S}
+            elif kind in ("release", "transfer"):
+                for (h, rel) in S:
+                    if h == 0 and rel >= 1 and not ob.rebound:
+                        self._report(
+                            "MT-OWN-DOUBLE", n,
+                            f"`{ob.owner}` ({ob.cls}) is released/"
+                            f"transferred twice on one path — the second "
+                            f"call drops references the owner no longer "
+                            f"holds",
+                            hint="release exactly once per acquire; a "
+                                 "transferred owner is gone")
+                S = {(0, min(2, rel + 1)) for (_h, rel) in S}
+            elif kind == "escape-store":
+                if not line_transfers(self.src, n.lineno):
+                    self._report(
+                        "MT-OWN-ESCAPE", n,
+                        f"owned handle `{ob.owner}` ({ob.cls}) is aliased "
+                        f"into a structure that outlives this owner "
+                        f"without a `# mtlint: transfers` annotation",
+                        hint="annotate the deliberate handoff with "
+                             "`# mtlint: transfers -- reason`, or release "
+                             "before storing")
+                S = {(0, rel) for (_h, rel) in S}
+            elif kind == "escape-silent":
+                S = {(0, rel) for (_h, rel) in S}
+        return S
+
+    def _stores_handle(self, stmt: ast.Assign) -> bool:
+        """Binding handle stored into an attribute/subscript target."""
+        var = self.ob.owner
+        reads = any(isinstance(n, ast.Name) and n.id == var
+                    for n in ast.walk(stmt.value))
+        if not reads:
+            return False
+        return any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in stmt.targets)
+
+    # -- statement walk -----------------------------------------------------
+    def exec_block(self, stmts, S: Set[State]):
+        ch = self._ch(S)
+        for stmt in stmts:
+            if not ch["fall"]:
+                break
+            sub = self.exec_stmt(stmt, ch["fall"])
+            ch["fall"] = sub["fall"]
+            self._merge(ch, sub)
+        return ch
+
+    def exec_stmt(self, stmt, S: Set[State]):
+        ob = self.ob
+        ch = self._ch()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            ch["fall"] = set(S)
+            return ch
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                S = self._apply(stmt.value, S, ch["raise"])
+                if ob.style == "binding" and self._handle_escapes_in(
+                        stmt.value, ob.owner):
+                    # returning the handle: ownership crosses to the
+                    # caller — blessed by `# owns: caller`
+                    if owns_annotation(self.src, self.fn) != "caller":
+                        self._report(
+                            "MT-OWN-TRANSFER", stmt,
+                            f"ownership of `{ob.owner}` ({ob.cls}) is "
+                            f"returned to the caller without an "
+                            f"`# owns: caller` annotation on the def",
+                            hint="annotate the def line: "
+                                 "`# owns: caller -- reason`")
+                    S = {(0, rel) for (_h, rel) in S}
+            ch["ret"] = set(S)
+            return ch
+        if isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                S = self._apply(stmt.exc, S, ch["raise"])
+            ch["raise"] |= S
+            return ch
+        if isinstance(stmt, ast.Break):
+            ch["brk"] = self._apply(stmt, S, ch["raise"])
+            return ch
+        if isinstance(stmt, ast.Continue):
+            ch["cont"] = set(S)
+            return ch
+        if isinstance(stmt, ast.If):
+            S = self._apply(stmt.test, S, ch["raise"])
+            b = self.exec_block(stmt.body, S)
+            o = self.exec_block(stmt.orelse, S)
+            ch["fall"] = b["fall"] | o["fall"]
+            self._merge(ch, b)
+            self._merge(ch, o)
+            return ch
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+            S = self._apply(head, S, ch["raise"])
+            cur, seen = set(S), set(S)
+            brk: Set[State] = set()
+            for _ in range(4):                     # bounded fixpoint
+                body = self.exec_block(stmt.body, cur)
+                self._merge(ch, body, skip=("fall", "brk", "cont"))
+                brk |= body["brk"]
+                nxt = body["fall"] | body["cont"]
+                if nxt <= seen:
+                    break
+                seen |= nxt
+                cur = nxt
+            o = self.exec_block(stmt.orelse, seen)
+            self._merge(ch, o)
+            ch["fall"] = o["fall"] | brk
+            return ch
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                S = self._apply(item.context_expr, S, ch["raise"])
+            body = self.exec_block(stmt.body, S)
+            ch["fall"] = body["fall"]
+            self._merge(ch, body)
+            return ch
+        if isinstance(stmt, ast.Try):
+            body = self.exec_block(stmt.body, S)
+            raised = body["raise"]
+            fall = body["fall"]
+            self._merge(ch, body, skip=("fall", "raise"))
+            if stmt.handlers:
+                # handlers consume the raised states (over-approx: any
+                # handler may see any raise; only re-raises escape)
+                for h in stmt.handlers:
+                    hch = self.exec_block(h.body, set(raised))
+                    fall |= hch["fall"]
+                    self._merge(ch, hch)
+                raised = set()
+            o = self.exec_block(stmt.orelse, fall) if stmt.orelse \
+                else self._ch(fall)
+            fall = o["fall"]
+            self._merge(ch, o)
+            if stmt.finalbody:
+                def through_final(states):
+                    if not states:
+                        return set()
+                    return self.exec_block(stmt.finalbody, states)["fall"]
+                fall = through_final(fall)
+                ch["raise"] = through_final(ch["raise"] | raised)
+                ch["ret"] = through_final(ch["ret"])
+                ch["brk"] = through_final(ch["brk"])
+                ch["cont"] = through_final(ch["cont"])
+            else:
+                ch["raise"] |= raised
+            ch["fall"] = fall
+            return ch
+        # Assign / AugAssign / AnnAssign / Expr / everything else
+        if isinstance(stmt, ast.Assign) and ob.style == "binding" \
+                and self._stores_handle(stmt):
+            S = self._apply(stmt.value, S, ch["raise"])
+            if not line_transfers(self.src, stmt.lineno):
+                self._report(
+                    "MT-OWN-ESCAPE", stmt,
+                    f"owned handle `{ob.owner}` ({ob.cls}) is stored "
+                    f"into a longer-lived structure without a "
+                    f"`# mtlint: transfers` annotation",
+                    hint="annotate the deliberate handoff with "
+                         "`# mtlint: transfers -- reason`")
+            ch["fall"] = {(0, rel) for (_h, rel) in S}
+            return ch
+        ch["fall"] = self._apply(stmt, S, ch["raise"])
+        return ch
+
+    # -- verdict ------------------------------------------------------------
+    def run(self) -> None:
+        ob = self.ob
+        ch = self.exec_block(self.fn.body, {(0, 0)})
+        owns = owns_annotation(self.src, self.fn)
+        exits = [("fall", ch["fall"]), ("ret", ch["ret"]),
+                 ("raise", ch["raise"])]
+        held_normal = any(h for kind, states in exits[:2]
+                          for (h, _r) in states)
+        held_raise = any(h for (h, _r) in ch["raise"])
+        if not (held_normal or held_raise):
+            return
+        if owns == "caller":
+            return          # acquisitions outlive this function by design
+        if ob.is_boundary:
+            self._report(
+                "MT-OWN-TRANSFER", ob.acquire_node,
+                f"resource acquired for caller-provided owner "
+                f"`{ob.owner}` ({ob.cls}) is still held at function exit "
+                f"— ownership crosses the boundary without an "
+                f"`# owns: caller` annotation",
+                hint="annotate the def line `# owns: caller -- reason`, "
+                     "or release/transfer before returning")
+            return
+        where = ("some path to function exit" if held_normal
+                 else "an exception path (a registered acquire can raise "
+                      "while this is held)")
+        self._report(
+            "MT-OWN-LEAK", ob.acquire_node,
+            f"resource `{ob.owner}` ({ob.cls}) acquired here is not "
+            f"released or transferred on {where}",
+            hint="release/transfer in a finally (or an except that "
+                 "re-raises), annotate the def `# owns: caller`, or mark "
+                 "a deliberate handoff `# mtlint: transfers`")
+
+
+@register
+class OwnershipRule(Rule):
+    family = "ownership"
+    ids = ("MT-OWN-LEAK", "MT-OWN-DOUBLE", "MT-OWN-ESCAPE",
+           "MT-OWN-TRANSFER")
+    scope = "file"
+
+    def check(self, src: Source, config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(src.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(src, fn, findings)
+        return findings
+
+    def _check_function(self, src: Source, fn: ast.AST,
+                        findings: List[Finding]) -> None:
+        params = _fn_params(fn)
+        assigned = _assigned_names(fn)
+        obligations: Dict[Tuple[str, str], _Obligation] = {}
+        released_only: Dict[Tuple[str, str], ast.Call] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or _owner_fn(node) is not fn:
+                continue
+            v = match_verb(node)
+            if v is None or v.cls == "span":
+                continue          # span lifetimes: the MT-SPAN family
+            if v.cls in OWNER_KEYED_CLASSES:
+                oe = owner_expr(node, v)
+                owner = dotted_name(oe) if oe is not None else None
+                if not owner:
+                    continue      # expression-built owner: site only
+                key = (v.cls, owner)
+                if v.kind == "acquire":
+                    ob = obligations.get(key)
+                    if ob is None:
+                        ob = _Obligation(v.cls, owner, "owner", node)
+                        root = owner.split(".")[0]
+                        ob.is_boundary = (root in params
+                                          or (root != "self"
+                                              and root not in assigned))
+                        obligations[key] = ob
+                elif owner.split(".")[0] in params:
+                    released_only.setdefault(key, node)
+            elif v.cls in BINDING_CLASSES and v.kind == "acquire":
+                stmt = parent(node)
+                # only direct `var = <ctor>(...)` bindings and direct
+                # `self.x = <ctor>(...)` stores create obligations;
+                # with-items own their handle, chained/unbound ctors
+                # are out of local scope
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and stmt.value is node:
+                    t = stmt.targets[0]
+                    if isinstance(t, ast.Name):
+                        key = (v.cls, t.id)
+                        if key not in obligations:
+                            obligations[key] = _Obligation(
+                                v.cls, t.id, "binding", node)
+                    elif isinstance(t, (ast.Attribute, ast.Subscript)) \
+                            and not line_transfers(src, stmt.lineno):
+                        findings.append(src.finding(
+                            "MT-OWN-ESCAPE", node,
+                            f"{v.cls} handle constructed directly into a "
+                            f"longer-lived structure without a "
+                            f"`# mtlint: transfers` annotation",
+                            hint="annotate the deliberate handoff: "
+                                 "`# mtlint: transfers -- who releases it "
+                                 "and when`"))
+
+        # callee-side boundary: releasing/transferring a caller's handle
+        for (cls, owner), node in released_only.items():
+            if (cls, owner) in obligations:
+                continue
+            if owns_annotation(src, fn) != "callee":
+                findings.append(src.finding(
+                    "MT-OWN-TRANSFER", node,
+                    f"releases/transfers `{owner}` ({cls}) received from "
+                    f"the caller without an `# owns: callee` annotation "
+                    f"on the def",
+                    hint="annotate the def line: "
+                         "`# owns: callee -- reason`"))
+
+        for ob in obligations.values():
+            if ob.style == "owner":
+                root = ob.owner.split(".")[0]
+                ob.rebound = root in self._loop_or_reassigned(fn, ob)
+            if ob.style == "binding" \
+                    and self._captured_by_closure(fn, ob.owner):
+                if not line_transfers(src, ob.acquire_node.lineno):
+                    findings.append(src.finding(
+                        "MT-OWN-ESCAPE", ob.acquire_node,
+                        f"owned handle `{ob.owner}` ({ob.cls}) is "
+                        f"captured by a closure that outlives this "
+                        f"owner without `# mtlint: transfers`",
+                        hint="annotate the handoff, or keep the handle "
+                             "out of the closure"))
+                continue          # closure may release it: untrackable
+            _Walk(self, src, fn, ob, findings).run()
+
+    @staticmethod
+    def _loop_or_reassigned(fn: ast.AST, ob: _Obligation) -> Set[str]:
+        """Names whose binding is ITERATION-SCOPED — For targets,
+        assignments inside loop bodies, or names assigned more than
+        once: the owner name denotes different owners over time (the
+        beam `for owner, _ in claimed: release(owner)` cleanup shape),
+        so a second release along the merged loop path is not a DOUBLE.
+        A single identity-creating assignment (`owner = object()`)
+        keeps the obligation fully trackable."""
+        out: Set[str] = set()
+        assign_count: Dict[str, int] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.For, ast.AsyncFor)):
+                for nn in ast.walk(n.target):
+                    if isinstance(nn, ast.Name):
+                        out.add(nn.id)
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            for nn in ast.walk(t):
+                                if isinstance(nn, ast.Name):
+                                    out.add(nn.id)
+            elif isinstance(n, ast.While):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            for nn in ast.walk(t):
+                                if isinstance(nn, ast.Name):
+                                    out.add(nn.id)
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for nn in ast.walk(t):
+                        if isinstance(nn, ast.Name):
+                            assign_count[nn.id] = \
+                                assign_count.get(nn.id, 0) + 1
+        out.update(name for name, c in assign_count.items() if c >= 2)
+        return out
+
+    @staticmethod
+    def _captured_by_closure(fn: ast.AST, var: str) -> bool:
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and n is not fn:
+                if any(isinstance(nn, ast.Name) and nn.id == var
+                       for nn in ast.walk(n)):
+                    return True
+        return False

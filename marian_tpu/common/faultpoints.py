@@ -110,6 +110,13 @@ CATALOG: Dict[str, str] = {
                              "so the auditor's references-vs-refcount "
                              "cross-check is proven against real "
                              "corruption",
+    "pool.release_drop": "detection drill (ISSUE 15): an armed 'fail' "
+                         "makes KVPool.release silently do nothing — "
+                         "the suppressed-release leak bug class — so "
+                         "the runtime ownership witness "
+                         "(common/ownwit.py) and the pool auditors are "
+                         "proven to catch a REAL seeded leak, never a "
+                         "mocked report",
 }
 
 

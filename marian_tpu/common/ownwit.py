@@ -1,0 +1,269 @@
+"""Runtime ownership witness: the dynamic half of mtlint's
+resource-ownership analysis (ISSUE 15) — the lockdep move, applied to
+resource lifetimes.
+
+The static side (marian_tpu/analysis/ownership.py + the MT-OWN rule
+family) enumerates the acquire/release/transfer SITES of the refcounted
+``KVPool`` and derives which (acquire-site → release-site) pairings are
+possible. Its documented blind spots — owners built from expressions,
+calls through locals, exception edges outside the modeled raisers — are
+exactly where a page leak would hide from it. This module keeps the
+model honest the same way ``MARIAN_LOCKDEP=1`` keeps the lock lattice
+honest: record what actually ran, and cross-check.
+
+With ``MARIAN_OWNWIT=1`` in the environment (read at pool-construction
+time; tests/conftest.py arms it for the whole tier-1 process), every
+``KVPool`` acquire/release/transfer records the CALL SITE that drove it
+— the nearest stack frame inside ``marian_tpu/`` outside the
+instrumented modules, identified ``<rel>::<co_name>``, exactly the
+identity the static site scan derives. A successful release/transfer of
+an owner records the pairing (its acquire sites → this release site).
+
+The verdict (:func:`check_against_static`, asserted at module teardown
+of the tier-1 serving/iteration/beam/prefix suites):
+
+- an observed acquire or release site the static registry never
+  modeled → blind spot; FAIL (extend analysis/ownership.py, never
+  baseline it);
+- an observed (acquire-site → release-site) pairing absent from the
+  static ownership graph → same.
+
+Sites outside ``marian_tpu/`` (tests driving a pool directly) record as
+``<external>`` and are exempt from the cross-check — the static
+analysis does not model test code either; engine-driven traffic is what
+the witness audits. Leak detection is separate from the pairing check
+(live resources mid-suite are normal): :func:`live_owners` /
+:func:`check_balanced` report owners still holding references — the
+``pool.release_drop`` faultpoint drill suppresses one real release and
+the drill test asserts the witness (and the pool auditor) catch it.
+
+Without ``MARIAN_OWNWIT=1`` nothing is recorded and the pool pays one
+attribute read per verb. Stdlib-only; imports nothing from the analyzed
+layers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_VAR = "MARIAN_OWNWIT"
+
+EXTERNAL_SITE = "<external>"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+_TOKENS = itertools.count(1)
+
+
+def new_token() -> int:
+    """Process-unique container identity for the live-owner table. A
+    raw ``id(pool)`` can be REUSED after the pool is collected — a
+    stale live entry would then pair an old pool's acquire site with a
+    new pool's release site and fabricate a witness violation."""
+    return next(_TOKENS)
+
+
+# -- observed model ----------------------------------------------------------
+# Guarded by _WITNESS_LOCK — a plain lock, deliberately NOT lockdep-
+# witnessed and excluded from lock discovery (callgraph
+# _INSTRUMENTATION_MODULES): it is taken while KVPool._lock-adjacent
+# code runs and is instrumentation, not part of the modeled lattice.
+
+_WITNESS_LOCK = threading.Lock()
+# cls -> {(acquire_site, release_site) -> thread name (first observer)}
+_PAIRS: Dict[str, Dict[Tuple[str, str], str]] = {}
+_ACQ_SITES: Dict[str, Set[str]] = {}
+_REL_SITES: Dict[str, Set[str]] = {}
+# (cls, id(container), owner-repr) -> set of acquire sites still live
+_LIVE: Dict[Tuple[str, int, str], Set[str]] = {}
+
+# frames inside these files are instrumentation, not call sites
+_SKIP_SUFFIXES = ("common/ownwit.py", "common\\ownwit.py",
+                  "ops/pallas/kv_pool.py", "ops\\pallas\\kv_pool.py")
+
+_ROOT: Optional[str] = None
+
+
+def _find_root() -> Optional[str]:
+    global _ROOT
+    if _ROOT is None:
+        cur = os.path.dirname(os.path.abspath(__file__))
+        for _ in range(6):
+            if os.path.exists(os.path.join(cur, "pyproject.toml")):
+                _ROOT = cur
+                break
+            cur = os.path.dirname(cur)
+    return _ROOT
+
+
+def _site() -> str:
+    """The acting call site: nearest non-instrumentation frame. Frames
+    under <root>/marian_tpu resolve to '<rel>::<co_name>' (the static
+    model's site identity); anything else — tests, library callers —
+    is EXTERNAL_SITE, exempt from the cross-check."""
+    root = _find_root()
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename
+        norm = fname.replace("\\", "/")
+        if not norm.endswith(_SKIP_SUFFIXES[0]) \
+                and not norm.endswith(_SKIP_SUFFIXES[2]):
+            if root is not None:
+                try:
+                    rel = os.path.relpath(fname, root).replace("\\", "/")
+                except ValueError:          # different drive (windows)
+                    rel = ""
+                if rel.startswith("marian_tpu/"):
+                    return f"{rel}::{f.f_code.co_name}"
+            return EXTERNAL_SITE
+        f = f.f_back
+    return EXTERNAL_SITE
+
+
+def _key(cls: str, container, owner) -> Tuple[str, int, str]:
+    tok = container if isinstance(container, int) else id(container)
+    return (cls, tok, repr(owner))
+
+
+def note_acquire(cls: str, container, owner) -> None:
+    """A fresh or extended claim for ``owner`` (claim/claim_extra/share,
+    or a retable that created/extended the owner)."""
+    site = _site()
+    with _WITNESS_LOCK:
+        _ACQ_SITES.setdefault(cls, set()).add(site)
+        _LIVE.setdefault(_key(cls, container, owner), set()).add(site)
+
+
+def note_release(cls: str, container, owner) -> None:
+    """Owner dropped every reference (release, retable-to-empty):
+    records the (acquire-site → release-site) pairings."""
+    site = _site()
+    thread = threading.current_thread().name
+    with _WITNESS_LOCK:
+        _REL_SITES.setdefault(cls, set()).add(site)
+        acq = _LIVE.pop(_key(cls, container, owner), None) or set()
+        pairs = _PAIRS.setdefault(cls, {})
+        for a in acq:
+            pairs.setdefault((a, site), thread)
+
+
+def note_transfer(cls: str, container, src_owner, dst_owner) -> None:
+    """References changed hands (KVPool.transfer): pairs the source's
+    acquire sites with this site, and the destination becomes live as
+    acquired HERE — the prefix-cache adoption shape."""
+    site = _site()
+    thread = threading.current_thread().name
+    with _WITNESS_LOCK:
+        _REL_SITES.setdefault(cls, set()).add(site)
+        _ACQ_SITES.setdefault(cls, set()).add(site)
+        acq = _LIVE.pop(_key(cls, container, src_owner), None) or set()
+        pairs = _PAIRS.setdefault(cls, {})
+        for a in acq:
+            pairs.setdefault((a, site), thread)
+        _LIVE.setdefault(_key(cls, container, dst_owner), set()).add(site)
+
+
+def drop_container(cls: str, container) -> None:
+    """A whole pool is being discarded (engine teardown): forget its
+    live owners — their lifetime ends with the container, which is not
+    a leak the witness should carry across tests."""
+    cid = container if isinstance(container, int) else id(container)
+    with _WITNESS_LOCK:
+        for k in [k for k in _LIVE if k[0] == cls and k[1] == cid]:
+            del _LIVE[k]
+
+
+# -- inspection / verdict ----------------------------------------------------
+
+def observed_pairs(cls: str) -> Dict[Tuple[str, str], str]:
+    with _WITNESS_LOCK:
+        return dict(_PAIRS.get(cls, {}))
+
+
+def observed_sites(cls: str) -> Tuple[Set[str], Set[str]]:
+    with _WITNESS_LOCK:
+        return (set(_ACQ_SITES.get(cls, set())),
+                set(_REL_SITES.get(cls, set())))
+
+
+def live_owners(cls: str) -> List[Tuple[str, List[str]]]:
+    """(owner repr, acquire sites) for every owner still holding
+    references — the leak-drill surface (a suppressed release leaves
+    its owner here)."""
+    with _WITNESS_LOCK:
+        return sorted((k[2], sorted(sites))
+                      for k, sites in _LIVE.items() if k[0] == cls)
+
+
+def check_balanced(cls: str) -> List[str]:
+    """Violations for resources still live — used by the seeded-leak
+    drill and by scopes that expect a drained pool; NOT part of the
+    suite-teardown cross-check (live resources mid-suite are normal)."""
+    return [f"{cls} owner {owner} acquired at "
+            f"{', '.join(sites) or EXTERNAL_SITE} was never "
+            f"released or transferred (leak)"
+            for owner, sites in live_owners(cls)]
+
+
+def reset() -> None:
+    """Forget everything observed so far (tests)."""
+    with _WITNESS_LOCK:
+        _PAIRS.clear()
+        _ACQ_SITES.clear()
+        _REL_SITES.clear()
+        _LIVE.clear()
+
+
+def check(graph) -> List[str]:
+    """Violations of the static model by what actually ran, against an
+    ``analysis.ownership.OwnershipGraph``. Empty list = every observed
+    site and pairing is modeled. ``<external>`` sites (direct library
+    use from tests) are exempt by design."""
+    violations: List[str] = []
+    from ..analysis.ownership import GRAPH_CLASSES
+    for cls in GRAPH_CLASSES:
+        static_acq = graph.acquire_sites(cls)
+        static_rel = graph.release_sites(cls)
+        obs_acq, obs_rel = observed_sites(cls)
+        for s in sorted(obs_acq - {EXTERNAL_SITE}):
+            if s not in static_acq:
+                violations.append(
+                    f"observed {cls} ACQUIRE site {s} is unknown to the "
+                    f"static ownership model — analysis/ownership.py's "
+                    f"verb registry or site scan has a blind spot; "
+                    f"extend the model, do not baseline this")
+        for s in sorted(obs_rel - {EXTERNAL_SITE}):
+            if s not in static_rel:
+                violations.append(
+                    f"observed {cls} RELEASE site {s} is unknown to the "
+                    f"static ownership model — extend "
+                    f"analysis/ownership.py, do not baseline this")
+        static_pairs = graph.pairs.get(cls, set())
+        for (a, r), thread in sorted(observed_pairs(cls).items()):
+            if a == EXTERNAL_SITE or r == EXTERNAL_SITE:
+                continue
+            if a not in static_acq or r not in static_rel:
+                continue          # already reported as an unknown site
+            if (a, r) not in static_pairs:
+                violations.append(
+                    f"observed {cls} ownership pairing {a} -> {r} (first "
+                    f"seen on thread {thread!r}) is absent from the "
+                    f"static ownership graph — the model never derived "
+                    f"this handoff; extend analysis/ownership.py")
+    return violations
+
+
+def check_against_static(root) -> List[str]:
+    """:func:`check` against the ownership graph built from the repo at
+    ``root`` — the cross-check the tier-1 serving/iteration/beam/prefix
+    suites assert at module teardown. The analysis layer is
+    stdlib-only, so this never imports jax."""
+    from ..analysis.ownership import static_ownership_graph
+    return check(static_ownership_graph(root))

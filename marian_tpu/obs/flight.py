@@ -189,7 +189,10 @@ class FlightRecorder:
         fname = (f"flight-{now.strftime('%Y%m%dT%H%M%S')}-"
                  f"{os.getpid()}-{seq:03d}-{_slug(reason)}.json")
         path = os.path.join(d, fname)
-        tmp = path + ".tmp"
+        # dot-prefixed so a consumer polling the dump directory for
+        # `flight-*` (operators, tests) can never pick up the
+        # half-written file the os.replace below makes atomic
+        tmp = os.path.join(d, "." + fname + ".tmp")
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(payload, fh, indent=1)
         os.replace(tmp, path)

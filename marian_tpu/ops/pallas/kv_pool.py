@@ -165,6 +165,14 @@ class KVPool:
             raise ValueError(f"KVPool needs >= 2 pages (page 0 is the "
                              f"reserved trash page); got {n_pages}")
         from ...common import lockdep
+        from ...common import ownwit
+        # runtime ownership witness (ISSUE 15): with MARIAN_OWNWIT=1
+        # every acquire/release/transfer records its acting call site,
+        # and tier-1 asserts observed pairings ⊆ the static ownership
+        # graph. Read once at construction: one attribute check per
+        # verb when disarmed.
+        self._ownwit = ownwit.enabled()
+        self._ownwit_tok = ownwit.new_token() if self._ownwit else 0
         self.n_pages = int(n_pages)
         self.page_len = int(page_len)
         self.max_pages_per_row = int(max_pages_per_row) or (n_pages - 1)
@@ -233,7 +241,10 @@ class KVPool:
                 self._refs[p] = 1
             self._claims[owner] = pages
             self._stats["claimed"] += n
-            return list(pages)
+        if self._ownwit:
+            from ...common import ownwit
+            ownwit.note_acquire("kv-pages", self._ownwit_tok, owner)
+        return list(pages)
 
     def claim_extra(self, owner, n: int = 1,
                     row_cap: bool = True) -> List[int]:
@@ -262,7 +273,10 @@ class KVPool:
                 self._refs[p] = 1
             held.extend(pages)
             self._stats["claimed"] += n
-            return list(pages)
+        if self._ownwit:
+            from ...common import ownwit
+            ownwit.note_acquire("kv-pages", self._ownwit_tok, owner)
+        return list(pages)
 
     def share(self, owner, pages: Sequence[int],
               row_cap: bool = True) -> None:
@@ -289,6 +303,9 @@ class KVPool:
                 self._refs[int(p)] += 1
                 held.append(int(p))
             self._stats["aliased"] += len(pages)
+        if self._ownwit:
+            from ...common import ownwit
+            ownwit.note_acquire("kv-pages", self._ownwit_tok, owner)
 
     def retable(self, owner, new_pages: Sequence[int]) -> int:
         """Atomically rewrite ``owner``'s reference list to
@@ -300,6 +317,7 @@ class KVPool:
         ``new_pages`` drops the owner entirely."""
         new_list = [int(p) for p in new_pages]
         with self._lock:
+            owner_existed = owner in self._claims
             old_list = self._claims.get(owner, [])
             if len(new_list) > self.max_pages_per_row:
                 raise PoolExhausted(
@@ -331,7 +349,15 @@ class KVPool:
                 self._claims[owner] = new_list
             else:
                 self._claims.pop(owner, None)
-            return freed
+        if self._ownwit:
+            from ...common import ownwit
+            if new_list:
+                # kept or created: the retable site holds references now
+                ownwit.note_acquire("kv-pages", self._ownwit_tok, owner)
+            elif owner_existed:
+                # retable-to-empty IS the beam engine's release verb
+                ownwit.note_release("kv-pages", self._ownwit_tok, owner)
+        return freed
 
     def transfer(self, src_owner, dst_owner) -> List[int]:
         """Move ``src_owner``'s whole reference list to ``dst_owner``
@@ -340,21 +366,49 @@ class KVPool:
         entry without a free/reclaim round trip. Returns the moved
         list; a missing source moves nothing."""
         with self._lock:
-            pages = self._claims.pop(src_owner, None)
-            if not pages:
-                return []
             if dst_owner in self._claims:
                 raise ValueError(f"transfer target {dst_owner!r} "
                                  f"already holds pages")
+            pages = self._claims.pop(src_owner, None)
+            if not pages:
+                return []
             self._claims[dst_owner] = pages
-            return list(pages)
+        if self._ownwit:
+            from ...common import ownwit
+            ownwit.note_transfer("kv-pages", self._ownwit_tok, src_owner, dst_owner)
+        return list(pages)
 
     def release(self, owner) -> int:
         """Drop every reference ``owner`` holds (freeing pages whose
         last reference drops); returns how many REFERENCES were
-        dropped (== pages freed when nothing was shared)."""
+        dropped (== pages freed when nothing was shared).
+
+        An owner that holds NOTHING — released twice, or released after
+        its references were transferred away (the prefix-cache adoption
+        path) — is a loud ``ValueError``, never a silent no-op: a
+        double release means some other owner's refcounts are about to
+        be wrong, and the caller's bookkeeping has already diverged
+        from the pool's (ISSUE 15; MT-OWN-DOUBLE is the static half).
+        An owner holding an empty reference list (a zero-page share)
+        releases normally."""
+        from ...common import faultpoints as fp
+        try:
+            # the seeded-leak drill (ISSUE 15): an armed 'fail' makes
+            # this release silently do NOTHING — the suppressed-release
+            # bug class — so the ownership witness's and the auditors'
+            # claims to catch a real leak are proven against one
+            # (tests/test_ownwit.py; docs/ROBUSTNESS.md "Auditor
+            # drills"). Unarmed: one dict lookup.
+            fp.fault_point("pool.release_drop")
+        except fp.InjectedFault:
+            return 0
         with self._lock:
-            pages = self._claims.pop(owner, [])
+            pages = self._claims.pop(owner, None)
+            if pages is None:
+                raise ValueError(
+                    f"release of owner {owner!r} which holds no pages — "
+                    f"released twice, or released after its references "
+                    f"were transferred away")
             # freed pages return in reverse so a release+reclaim of the
             # same count yields the same page ids (replay determinism)
             for p in reversed(pages):
@@ -363,7 +417,10 @@ class KVPool:
                     del self._refs[p]
                     self._free.append(p)
                     self._stats["freed"] += 1
-            return len(pages)
+        if self._ownwit:
+            from ...common import ownwit
+            ownwit.note_release("kv-pages", self._ownwit_tok, owner)
+        return len(pages)
 
     def pages_of(self, owner) -> List[int]:
         with self._lock:

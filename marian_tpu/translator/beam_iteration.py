@@ -179,7 +179,7 @@ class PagedBeamEngine(PagedDecodeEngine):
     def _owner(self, key, slot: int):
         return (key, slot)
 
-    def _try_claim(self, key, text: str, joiners: List,
+    def _try_claim(self, key, text: str, joiners: List,  # owns: caller -- hypothesis rows join the engine's slot machinery; _evict retables them away
                    detail: Optional[Dict[object, str]] = None,
                    res: Optional[StepResult] = None) -> Optional[str]:
         k = self.beam_size
@@ -528,7 +528,7 @@ class PagedBeamEngine(PagedDecodeEngine):
         else:
             n_fresh = len(live) * (n_full + 1)
 
-        def hold_and_claim():
+        def hold_and_claim():  # owns: caller -- the transient hold owner; _reorder releases it after every retable landed
             self.pool.share(tmp, aliased, row_cap=False)
             try:
                 return (self.pool.claim_extra(tmp, n_fresh,

@@ -93,7 +93,7 @@ def greedy_decode_paged(model, params, src_ids: jnp.ndarray,
                                     1 + b * mp, page_len, mp)
     table = np.zeros((b, mp), np.int32)
     for r in range(b):
-        table[r, :] = pool.claim(r, mp)
+        table[r, :] = pool.claim(r, mp)  # mtlint: ok -- every row releases at EOS or max_len below; the loop bound guarantees it
     pos = np.zeros((b,), np.int32)
     prev = np.zeros((b, 1), np.int32)
     alive = np.ones((b,), bool)

@@ -58,6 +58,7 @@ from ..data.vocab import EOS_ID
 from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, KVPool, PoolCorruption,
                                   PoolExhausted, ROW_BUCKETS, bucket_rows,
                                   pages_for_tokens)
+from .prefix_cache import PrefixCache
 
 # continuous pool auditing: with MARIAN_POOL_AUDIT=1 every admit+step
 # round ends with a full invariant audit (tests/conftest.py arms it for
@@ -148,7 +149,11 @@ class PagedDecodeEngine:
                  row_buckets: Sequence[int] = ROW_BUCKETS,
                  steps_per_round: int = 1,
                  registry=None,
-                 prefix_cache=None):
+                 prefix_cache: Optional[PrefixCache] = None):
+        # the annotation is load-bearing beyond documentation: the
+        # static callgraph types self.prefix from it, which is what
+        # links the engine's claim sites to the cache's adopt/release
+        # sites in the ownership graph (ISSUE 15)
         cfg = getattr(model, "cfg", None)
         if cfg is None or getattr(cfg, "decoder_autoreg", "") \
                 != "self-attention":
@@ -598,7 +603,7 @@ class PagedDecodeEngine:
         joiners.append((key, ids, slot))
         return None
 
-    def _claim_pages(self, key, n: int):
+    def _claim_pages(self, key, n: int):  # owns: caller -- the claim joins the engine's slot machinery; _evict gives it back
         """Fresh-page claim with prefix-cache pressure relief: when the
         free list is short, LRU cache entries are evicted (their held
         references dropped) and the claim retried once."""
@@ -638,7 +643,7 @@ class PagedDecodeEngine:
         fulls = leader_pages[:n_full]
         own_needed = n_pages - n_full
 
-        def build():
+        def build():  # owns: caller -- a successful fork's references live in the forked row; _evict gives them back
             self.pool.share(key, fulls)
             try:
                 return self.pool.claim_extra(key, own_needed)
@@ -718,7 +723,7 @@ class PagedDecodeEngine:
 
         return jax.jit(fork, donate_argnums=(0, 1))
 
-    def _evict(self, key, adopt_text: Optional[str] = None) -> bool:
+    def _evict(self, key, adopt_text: Optional[str] = None) -> bool:  # owns: callee -- the row exit: releases (or adopts into the prefix cache) what _try_claim acquired
         with self._lock:
             slot = self._by_key.pop(key, None)
             if slot is None:

@@ -204,7 +204,7 @@ class PrefixCache:
                 del self._live[key]
 
     # -- adoption + eviction (device worker thread) -------------------------
-    def adopt(self, pool, key: tuple, row_key, tokens: List[int],
+    def adopt(self, pool, key: tuple, row_key, tokens: List[int],  # owns: callee -- the finished row's references change hands into the cache
               text: str) -> int:
         """A row with source ``key`` finished normally: transfer its
         page references to the cache (refcounts unchanged) and remember

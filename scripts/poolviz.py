@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -150,7 +151,17 @@ def main(argv=None) -> int:
                          "invariants from the document; exit 1 on any "
                          "discrepancy")
     args = ap.parse_args(argv)
-    state = load_state(args.source)
+    try:
+        state = load_state(args.source)
+    except (urllib.error.URLError, OSError, json.JSONDecodeError,
+            ValueError) as e:
+        # an unreachable server / missing file / non-JSON body is a
+        # usage-level failure: exit 2 with ONE clear line, never a
+        # traceback (exit 1 stays reserved for --check finding real
+        # page-map discrepancies)
+        print(f"poolviz: cannot load {args.source}: {e}",
+              file=sys.stderr)
+        return 2
     render(state)
     if args.check:
         bad = check_consistency(state)

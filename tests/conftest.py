@@ -27,6 +27,14 @@ os.environ.setdefault("MARIAN_LOCKDEP", "1")
 # construction time (translator/iteration.py), so module-level here.
 os.environ.setdefault("MARIAN_POOL_AUDIT", "1")
 
+# Arm the runtime OWNERSHIP witness (ISSUE 15): every KVPool
+# acquire/release/transfer records its acting call site, and the tier-1
+# serving/iteration/beam/prefix suites assert at teardown that every
+# observed (acquire-site -> release-site) pairing is one the static
+# ownership graph derived (tests use the shared `ownership_witness`
+# fixture below). Read at pool-construction time, so module-level here.
+os.environ.setdefault("MARIAN_OWNWIT", "1")
+
 from marian_tpu.common.hermetic import force_cpu_devices  # noqa: E402
 
 jax = force_cpu_devices(8)
@@ -132,6 +140,28 @@ def lockdep_witness():
         assert violations == [], (
             "runtime lockdep witness contradicts the static lock-order "
             "graph (docs/STATIC_ANALYSIS.md 'The lockdep witness'):\n"
+            + "\n".join(violations))
+
+
+@pytest.fixture(scope="module")
+def ownership_witness():
+    """Runtime ownership witness cross-check (ISSUE 15), shared by the
+    tier-1 serving/iteration/beam/prefix suites (module-scoped autouse
+    aliases there, mirroring `lockdep_witness`): at module teardown,
+    every (acquire-site -> release-site) pairing the witness OBSERVED
+    on the refcounted KV pool must be one the static ownership graph
+    (analysis/ownership.py) derived. A violation is a blind spot in the
+    verb registry or the pairing model — extend the analysis, never
+    baseline it ("the auditor catches it at runtime, mtlint proves it
+    can't happen")."""
+    yield
+    from marian_tpu.common import ownwit
+    if ownwit.enabled():
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        violations = ownwit.check_against_static(root)
+        assert violations == [], (
+            "runtime ownership witness contradicts the static ownership "
+            "graph (docs/STATIC_ANALYSIS.md 'The ownership witness'):\n"
             + "\n".join(violations))
 
 

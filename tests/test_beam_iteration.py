@@ -35,6 +35,14 @@ def _lockdep_witness(lockdep_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _ownership_witness(ownership_witness):
+    """The beam reorder's retable diff and the prefix cache's adoption
+    path are exactly the handoffs the ownership witness audits; the
+    shared fixture asserts observed pairings ⊆ the static graph."""
+    yield
+
+
 VOCAB_WORDS = [" ".join(f"w{i}" for i in range(35))]
 TEXTS = ["w3 w4 w5", "w6 w7", "w8 w9 w10 w11", "w2 w3",
          "w4 w4 w4 w4 w4"]

@@ -31,6 +31,14 @@ def _lockdep_witness(lockdep_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _ownership_witness(ownership_witness):
+    """Every page this suite's engines claim/release/adopt records its
+    acting call site; the shared witness asserts observed ownership
+    pairings stay inside the static ownership graph (ISSUE 15)."""
+    yield
+
+
 VOCAB_WORDS = [" ".join(f"w{i}" for i in range(35))]
 
 

@@ -40,8 +40,10 @@ class TestKVPoolAllocator:
         assert p.free_pages() == 1
         assert p.release("a") == 3
         assert p.free_pages() == 4
-        # releasing an unknown owner is a no-op, never an error
-        assert p.release("ghost") == 0
+        # releasing an unknown owner is LOUD (ISSUE 15): the caller's
+        # bookkeeping has already diverged from the pool's
+        with pytest.raises(ValueError, match="holds no pages"):
+            p.release("ghost")
 
     def test_oversized_claim_names_the_table_bound(self):
         p = KVPool(64, page_len=4, max_pages_per_row=4)
@@ -80,6 +82,65 @@ class TestKVPoolAllocator:
         assert auto_tuner.kv_pool_max_tokens(64) == 2048
         # dh-halving convention shared with the other kernels
         assert auto_tuner.kv_pool_max_tokens(128) == 1024
+
+
+class TestTransferEdgeCases:
+    """ISSUE 15 satellite: the ``transfer`` edge cases the ownership
+    witness exercises — the handoff verb must refuse every shape that
+    would silently corrupt the claims table."""
+
+    def test_transfer_to_owner_already_holding_refused(self):
+        p = KVPool(9, page_len=4)
+        p.claim("row", 2)
+        p.claim("cache", 1)
+        with pytest.raises(ValueError, match="already holds pages"):
+            p.transfer("row", "cache")
+        # refused atomically: the source still owns its pages
+        assert len(p.pages_of("row")) == 2
+        assert len(p.pages_of("cache")) == 1
+        assert p.audit() == []
+
+    def test_transfer_of_freed_then_reforked_owner_moves_nothing(self):
+        """An owner released and its pages recycled to a NEW owner: a
+        late transfer of the ORIGINAL owner must move nothing — the
+        recycled pages belong to the new lineage now."""
+        p = KVPool(9, page_len=4)
+        a = p.claim("row", 2)
+        p.release("row")
+        b = p.claim("refork", 2)
+        assert a == b                    # deterministic recycle
+        assert p.transfer("row", ("prefix", "v", "k")) == []
+        assert p.pages_of(("prefix", "v", "k")) == []
+        assert p.pages_of("refork") == b
+        assert p.audit() == []
+
+    def test_release_after_transfer_is_loud(self):
+        """The references changed hands: a late release of the source
+        owner is a ValueError, never a silent no-op that would decref
+        the cache's pages out from under it."""
+        p = KVPool(9, page_len=4)
+        p.claim("row", 2)
+        p.transfer("row", ("prefix", "v", "k"))
+        with pytest.raises(ValueError, match="transferred away"):
+            p.release("row")
+        assert len(p.pages_of(("prefix", "v", "k"))) == 2
+        assert p.audit() == []
+
+    def test_double_release_is_loud(self):
+        p = KVPool(9, page_len=4)
+        p.claim("a", 1)
+        assert p.release("a") == 1
+        with pytest.raises(ValueError, match="released twice"):
+            p.release("a")
+
+    def test_zero_page_share_owner_releases_normally(self):
+        """An owner holding an EMPTY reference list (a zero-page share,
+        the beam reorder's transient-hold shape at a page boundary) is
+        a real owner and releases without error."""
+        p = KVPool(9, page_len=4)
+        p.share("tmp", [], row_cap=False)
+        assert p.release("tmp") == 0
+        assert p.audit() == []
 
 
 # ---------------------------------------------------------------------------

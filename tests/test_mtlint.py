@@ -572,6 +572,315 @@ class TestSpanHygiene:
 
 
 # ---------------------------------------------------------------------------
+# ownership (MT-OWN-*) — ISSUE 15
+# ---------------------------------------------------------------------------
+
+OWN_PREAMBLE = "class E:\n"
+
+
+class TestOwnershipLeak:
+    def lint(self, body):
+        return lint_text(OWN_PREAMBLE + body, families=["ownership"])
+
+    def test_acquired_never_released_flagged(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 2)\n"
+            "        self.work()\n")
+        assert rule_ids(fs) == ["MT-OWN-LEAK"]
+        assert "not released or transferred" in fs[0].message
+
+    def test_release_on_every_path_clean(self):
+        fs = self.lint(
+            "    def f(self, ok):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 2)\n"
+            "        if ok:\n"
+            "            self.pool.release(owner)\n"
+            "        else:\n"
+            "            self.pool.release(owner)\n")
+        assert fs == []
+
+    def test_early_return_path_flagged(self):
+        fs = self.lint(
+            "    def f(self, ok):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 2)\n"
+            "        if ok:\n"
+            "            return None\n"
+            "        self.pool.release(owner)\n")
+        assert rule_ids(fs) == ["MT-OWN-LEAK"]
+
+    def test_exception_edge_leak_flagged(self):
+        # a later registered acquire can raise PoolExhausted while the
+        # share's references are held — the exception edge leaks
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.share(owner, self.fulls)\n"
+            "        self.pool.claim_extra(owner, 1)\n"
+            "        self.pool.release(owner)\n")
+        assert rule_ids(fs) == ["MT-OWN-LEAK"]
+        assert "exception path" in fs[0].message
+
+    def test_except_release_and_reraise_clean(self):
+        # the engines' fork idiom: the handler gives the references
+        # back before re-raising
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.share(owner, self.fulls)\n"
+            "        try:\n"
+            "            self.pool.claim_extra(owner, 1)\n"
+            "        except PoolExhausted:\n"
+            "            self.pool.release(owner)\n"
+            "            raise\n"
+            "        self.pool.release(owner)\n")
+        assert fs == []
+
+    def test_finally_release_clean(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 1)\n"
+            "        try:\n"
+            "            self.step()\n"
+            "        finally:\n"
+            "            self.pool.release(owner)\n")
+        assert fs == []
+
+    def test_explicit_raise_while_held_flagged(self):
+        fs = self.lint(
+            "    def f(self, bad):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 1)\n"
+            "        if bad:\n"
+            "            raise ValueError('bad')\n"
+            "        self.pool.release(owner)\n")
+        assert rule_ids(fs) == ["MT-OWN-LEAK"]
+
+    def test_inline_ok_suppresses(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 2)  "
+            "# mtlint: ok -- released by the loop below\n")
+        assert fs == []
+
+    def test_unbound_file_handle_flagged_with_form_clean(self):
+        fs = lint_text(
+            "def f(p):\n"
+            "    fh = open(p)\n"
+            "    return fh.read()\n", families=["ownership"])
+        assert rule_ids(fs) == ["MT-OWN-LEAK"]
+        fs = lint_text(
+            "def f(p):\n"
+            "    with open(p) as fh:\n"
+            "        return fh.read()\n", families=["ownership"])
+        assert fs == []
+        fs = lint_text(
+            "def f(p):\n"
+            "    fh = open(p)\n"
+            "    try:\n"
+            "        return fh.read()\n"
+            "    finally:\n"
+            "        fh.close()\n", families=["ownership"])
+        assert fs == []
+
+    def test_nondaemon_thread_must_join_daemon_exempt(self):
+        fs = lint_text(
+            "import threading\n"
+            "def f(w):\n"
+            "    t = threading.Thread(target=w)\n"
+            "    t.start()\n", families=["ownership"])
+        assert rule_ids(fs) == ["MT-OWN-LEAK"]
+        fs = lint_text(
+            "import threading\n"
+            "def f(w):\n"
+            "    t = threading.Thread(target=w, daemon=True)\n"
+            "    t.start()\n", families=["ownership"])
+        assert fs == []
+        fs = lint_text(
+            "import threading\n"
+            "def f(w):\n"
+            "    t = threading.Thread(target=w)\n"
+            "    t.start()\n"
+            "    t.join()\n", families=["ownership"])
+        assert fs == []
+
+
+class TestOwnershipDouble:
+    def lint(self, body):
+        return lint_text(OWN_PREAMBLE + body, families=["ownership"])
+
+    def test_double_release_flagged(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 1)\n"
+            "        self.pool.release(owner)\n"
+            "        self.pool.release(owner)\n")
+        assert rule_ids(fs) == ["MT-OWN-DOUBLE"]
+        assert fs[0].line == 6        # the SECOND release
+
+    def test_release_after_transfer_flagged(self):
+        # the static mirror of KVPool.release's loud runtime error:
+        # a transferred owner is gone
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 1)\n"
+            "        self.pool.transfer(owner, self.dst)\n"
+            "        self.pool.release(owner)\n")
+        assert rule_ids(fs) == ["MT-OWN-DOUBLE"]
+
+    def test_branch_exclusive_releases_clean(self):
+        fs = self.lint(
+            "    def f(self, ok):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 1)\n"
+            "        if ok:\n"
+            "            self.pool.release(owner)\n"
+            "        else:\n"
+            "            self.pool.transfer(owner, self.dst)\n")
+        assert fs == []
+
+    def test_loop_scoped_owner_cleanup_not_double(self):
+        # the beam exception-cleanup shape: `owner` names a DIFFERENT
+        # owner each iteration — releasing per iteration is not DOUBLE
+        fs = self.lint(
+            "    def f(self, claimed):\n"
+            "        for owner, _ in claimed:\n"
+            "            self.pool.release(owner)\n")
+        assert fs == []
+
+
+class TestOwnershipEscape:
+    def lint(self, body):
+        return lint_text(OWN_PREAMBLE + body, families=["ownership"])
+
+    def test_store_into_self_flagged(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        ex = ThreadPoolExecutor(max_workers=2)\n"
+            "        self._ex = ex\n")
+        assert rule_ids(fs) == ["MT-OWN-ESCAPE"]
+
+    def test_store_with_transfers_annotation_clean(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        ex = ThreadPoolExecutor(max_workers=2)\n"
+            "        self._ex = ex  # mtlint: transfers -- closed in "
+            "close()\n")
+        assert fs == []
+
+    def test_direct_ctor_store_flagged_and_annotatable(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        self._ex = ThreadPoolExecutor(max_workers=2)\n")
+        assert rule_ids(fs) == ["MT-OWN-ESCAPE"]
+        fs = self.lint(
+            "    def f(self):\n"
+            "        self._ex = ThreadPoolExecutor(max_workers=2)  "
+            "# mtlint: transfers -- shut down in close()\n")
+        assert fs == []
+
+    def test_closure_capture_flagged(self):
+        fs = self.lint(
+            "    def f(self, submit):\n"
+            "        ex = ThreadPoolExecutor(max_workers=2)\n"
+            "        submit(lambda: ex.submit(self.work))\n")
+        assert rule_ids(fs) == ["MT-OWN-ESCAPE"]
+        assert "closure" in fs[0].message
+
+    def test_shutdown_before_exit_clean(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        ex = ThreadPoolExecutor(max_workers=2)\n"
+            "        try:\n"
+            "            self.work(ex.submit)\n"
+            "        finally:\n"
+            "            ex.shutdown()\n")
+        assert fs == []
+
+
+class TestOwnershipTransfer:
+    def lint(self, body):
+        return lint_text(OWN_PREAMBLE + body, families=["ownership"])
+
+    def test_exit_held_for_caller_owner_flagged(self):
+        # the _claim_pages wrapper shape: acquired for the caller's
+        # owner, still held at return
+        fs = self.lint(
+            "    def get(self, key):\n"
+            "        return self.pool.claim(key, 2)\n")
+        assert rule_ids(fs) == ["MT-OWN-TRANSFER"]
+        assert "owns: caller" in fs[0].message
+
+    def test_owns_caller_annotation_clean(self):
+        fs = self.lint(
+            "    def get(self, key):  # owns: caller -- joins the "
+            "claims table\n"
+            "        return self.pool.claim(key, 2)\n")
+        assert fs == []
+
+    def test_release_of_callers_handle_flagged(self):
+        # the _evict shape: releasing what the caller handed in
+        fs = self.lint(
+            "    def drop(self, key):\n"
+            "        self.pool.release(key)\n")
+        assert rule_ids(fs) == ["MT-OWN-TRANSFER"]
+        assert "owns: callee" in fs[0].message
+
+    def test_owns_callee_annotation_clean(self):
+        fs = self.lint(
+            "    def drop(self, key):  # owns: callee -- the row "
+            "exit\n"
+            "        self.pool.release(key)\n")
+        assert fs == []
+
+    def test_retable_reorder_diff_no_false_positive(self):
+        """The beam reorder's drain-and-swap/transfer idiom verbatim
+        (condensed): transient hold owner, exception-safe claim,
+        retable incref/decref diffs on table-held owners, final
+        release of the hold — must be CLEAN."""
+        fs = self.lint(
+            "    def reorder(self, key, rows):\n"
+            "        tmp = ('cow', key)\n"
+            "        self.pool.share(tmp, self.aliased, row_cap=False)\n"
+            "        try:\n"
+            "            fresh = self.pool.claim_extra(tmp, 2,\n"
+            "                                          row_cap=False)\n"
+            "        except PoolExhausted:\n"
+            "            self.pool.release(tmp)\n"
+            "            raise\n"
+            "        for slot, row in rows:\n"
+            "            self.pool.retable(self.owner_of(key, slot), row)\n"
+            "        self.pool.release(tmp)\n")
+        assert fs == []
+
+    def test_prefix_adoption_path_no_false_positive(self):
+        """The prefix-cache adoption shape: transfer-or-release under
+        the `# owns: callee` annotation — must be CLEAN."""
+        fs = self.lint(
+            "    def finish(self, key, row_key):  # owns: callee -- "
+            "adoption\n"
+            "        if self.prefix.adopt(self.pool, key, row_key,\n"
+            "                             [], 't') == 0:\n"
+            "            self.pool.release(row_key)\n")
+        assert fs == []
+
+    def test_transfer_of_local_then_done_clean(self):
+        fs = self.lint(
+            "    def f(self):\n"
+            "        owner = object()\n"
+            "        self.pool.claim(owner, 1)\n"
+            "        self.pool.transfer(owner, self.cache_owner)\n")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # suppression, config, baseline, CLI, gate
 # ---------------------------------------------------------------------------
 
@@ -722,7 +1031,7 @@ class TestConfig:
         assert families == {"trace-safety", "host-sync", "donation",
                             "dtype", "guarded-by", "metrics", "faults",
                             "lock-order", "lock-blocking", "guard-escape",
-                            "span"}
+                            "span", "ownership"}
 
 
 BAD_OPS = ("import jax.numpy as jnp\n"
@@ -794,6 +1103,64 @@ class TestCli:
         assert rc == 1
         assert payload["findings"][0]["rule"] == "MT-DTYPE-ARRAY"
         assert payload["findings"][0]["path"] == "marian_tpu/ops/bad.py"
+
+    def test_sarif_format(self, tmp_path, capsys):
+        """ISSUE 15 satellite: SARIF 2.1.0 output — the shape GitHub
+        code scanning ingests to render findings as inline annotations
+        (ruleId + physicalLocation with 1-based startColumn, rule
+        metadata carrying the owning family)."""
+        root = _mini_tree(tmp_path)
+        rc = mtlint_main([str(root / "marian_tpu"), "--root", str(root),
+                          "--format", "sarif", "--no-baseline"])
+        log = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert log["version"] == "2.1.0"
+        run = log["runs"][0]
+        assert run["tool"]["driver"]["name"] == "mtlint"
+        results = run["results"]
+        assert results, "findings must surface as SARIF results"
+        r0 = results[0]
+        assert r0["ruleId"] == "MT-DTYPE-ARRAY"
+        loc = r0["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "marian_tpu/ops/bad.py"
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1      # SARIF is 1-based
+        declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {r["ruleId"] for r in results} <= declared
+        assert run["invocations"][0]["executionSuccessful"] is True
+
+    def test_sarif_clean_tree_and_parse_errors(self, tmp_path, capsys):
+        root = _mini_tree(tmp_path)
+        (root / "marian_tpu" / "ops" / "bad.py").write_text(
+            "x = 1\n", encoding="utf-8")
+        rc = mtlint_main([str(root / "marian_tpu"), "--root", str(root),
+                          "--format", "sarif", "--no-baseline"])
+        log = json.loads(capsys.readouterr().out)
+        assert rc == 0 and log["runs"][0]["results"] == []
+        # a parse error must fail the invocation, not vanish
+        (root / "marian_tpu" / "ops" / "broken.py").write_text(
+            "def f(:\n", encoding="utf-8")
+        rc = mtlint_main([str(root / "marian_tpu"), "--root", str(root),
+                          "--format", "sarif", "--no-baseline"])
+        out = capsys.readouterr().out
+        log = json.loads(out)
+        inv = log["runs"][0]["invocations"][0]
+        assert rc == 2
+        assert inv["executionSuccessful"] is False
+        assert inv["toolExecutionNotifications"]
+
+    def test_sarif_respects_baseline(self, tmp_path, capsys):
+        """Baselined findings stay out of the SARIF results — CI
+        annotations show only NEW debt, matching text/json verdicts."""
+        root = _mini_tree(tmp_path)
+        argv = [str(root / "marian_tpu"), "--root", str(root),
+                "--baseline", str(root / "bl.json")]
+        assert mtlint_main(argv + ["--update-baseline"]) == 0
+        capsys.readouterr()
+        rc = mtlint_main(argv + ["--format", "sarif"])
+        log = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert log["runs"][0]["results"] == []
 
     def test_rules_filter(self, tmp_path, capsys):
         root = _mini_tree(tmp_path)
@@ -1635,6 +2002,40 @@ class TestLockGraphArtifacts:
             "docs/lock_order.dot is stale — regenerate: python -m "
             "marian_tpu.analysis --format dot > docs/lock_order.dot")
 
+    def test_ownership_dot_snapshot_fresh(self, capsys):
+        """ISSUE 15 acceptance: docs/ownership.dot must match what the
+        CLI renders today — regenerate with `python -m
+        marian_tpu.analysis --format ownership-dot > docs/ownership.dot`
+        after changing any KVPool/prefix-cache verb usage."""
+        rc = mtlint_main(["--format", "ownership-dot", "--root",
+                          str(ROOT)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        snapshot = (ROOT / "docs" / "ownership.dot").read_text(
+            encoding="utf-8")
+        assert out == snapshot, (
+            "docs/ownership.dot is stale — regenerate: python -m "
+            "marian_tpu.analysis --format ownership-dot > "
+            "docs/ownership.dot")
+
+    def test_ownership_graph_models_the_serving_plane(self):
+        """The committed graph is not vacuous: the engines' claim
+        wrapper, both _evict overrides, and the prefix-cache adoption
+        path are all sites, and the wrapper pairs with the eviction
+        release the way real traffic exercises it (the exact pairings
+        the runtime witness observes in tier-1)."""
+        from marian_tpu.analysis.ownership import static_ownership_graph
+        g = static_ownership_graph(ROOT)
+        sites = g.sites["kv-pages"]
+        claim = "marian_tpu/translator/iteration.py::_claim_pages"
+        evict = "marian_tpu/translator/iteration.py::_evict"
+        adopt = "marian_tpu/translator/prefix_cache.py::adopt"
+        assert {"acquire"} == sites[claim]
+        assert "transfer" in sites[adopt]
+        assert {"release", "transfer"} == sites[evict]
+        assert (claim, evict) in g.pairs["kv-pages"]
+        assert (claim, adopt) in g.pairs["kv-pages"]
+
 
 # ---------------------------------------------------------------------------
 # baseline ratchet: the debt ledger may only shrink — ISSUE 6
@@ -1644,7 +2045,18 @@ class TestBaselineRatchet:
     # Entry count per rule family as of ISSUE 6. Lower these when debt is
     # paid down (and ONLY lower them): a new deliberate finding gets an
     # inline `# mtlint: ok -- reason` at the site, never a baseline entry.
-    CEILING = {"host-sync": 16}
+    CEILING = {"host-sync": 16, "ownership": 2}
+    # ISSUE 15: within the ownership family the ledger is ALSO capped per
+    # rule — the two baselined MT-OWN-ESCAPE entries are the long-lived
+    # executor handles (serving scheduler, checkpoint writer) whose
+    # shutdown lives with the owning object's close(); leaks, doubles,
+    # and unannotated boundary crossings may never be baselined at all.
+    RULE_CEILING = {
+        "MT-OWN-LEAK": 0,
+        "MT-OWN-DOUBLE": 0,
+        "MT-OWN-ESCAPE": 2,
+        "MT-OWN-TRANSFER": 0,
+    }
 
     def test_baseline_never_grows(self):
         data = json.loads(
@@ -1663,3 +2075,25 @@ class TestBaselineRatchet:
                 f"{self.CEILING.get(fam, 0)} — fix the finding or "
                 f"acknowledge it inline with `# mtlint: ok -- reason`; "
                 f"the baseline is shrink-only")
+
+    def test_ownership_baseline_never_grows_per_rule(self):
+        """ISSUE 15: per-rule ceilings for the ownership family — every
+        MT-OWN rule id has an explicit ceiling here, so a new baselined
+        leak/double/transfer can never ride in under the family total."""
+        data = json.loads(
+            (ROOT / "marian_tpu" / "analysis" / "baseline.json").read_text(
+                encoding="utf-8"))
+        own_ids = {rid for r in all_rules() if r.family == "ownership"
+                   for rid in r.ids}
+        assert own_ids == set(self.RULE_CEILING), \
+            "RULE_CEILING must name every MT-OWN rule id exactly"
+        counts = {}
+        for f in data["findings"]:
+            if f["rule"] in own_ids:
+                counts[f["rule"]] = counts.get(f["rule"], 0) + 1
+        for rid, n in sorted(counts.items()):
+            assert n <= self.RULE_CEILING[rid], (
+                f"baseline grew: {n} {rid} entries vs per-rule ceiling "
+                f"{self.RULE_CEILING[rid]} — fix the finding; ownership "
+                f"debt is shrink-only per rule")
+        assert sum(counts.values()) <= self.CEILING["ownership"]

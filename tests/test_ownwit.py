@@ -1,0 +1,213 @@
+"""Unit tests for the runtime ownership witness (common/ownwit.py) —
+the dynamic half of mtlint's resource-ownership analysis (ISSUE 15).
+
+conftest.py arms MARIAN_OWNWIT=1 for the whole test process, so every
+KVPool constructed here records its acquire/release/transfer sites. The
+witness state is process-global (it accumulates across a whole suite),
+so every test runs inside a sandbox that snapshots and restores it —
+the serving/iteration/beam/prefix suites' module-teardown cross-check
+must still see exactly what their own engines did, not this file's
+synthetic records.
+
+Includes THE SEEDED-LEAK DRILL (ISSUE 15 acceptance): the
+``pool.release_drop`` faultpoint suppresses one real ``KVPool.release``
+inside a real engine's row exit, and the test asserts the suite-level
+detectors actually fire — the engine's row-exit/round auditors raise
+``PoolCorruption`` (the suite fails), and the witness's live-owner
+table still names the leaked owner with its real acquire site.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from marian_tpu.common import faultpoints as fp
+from marian_tpu.common import ownwit
+from marian_tpu.analysis.ownership import OwnershipGraph
+from marian_tpu.ops.pallas.kv_pool import KVPool, PoolCorruption
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def sandbox():
+    with ownwit._WITNESS_LOCK:
+        saved = (dict(ownwit._PAIRS), dict(ownwit._ACQ_SITES),
+                 dict(ownwit._REL_SITES), dict(ownwit._LIVE))
+    ownwit.reset()
+    yield
+    with ownwit._WITNESS_LOCK:
+        for store, snap in zip((ownwit._PAIRS, ownwit._ACQ_SITES,
+                                ownwit._REL_SITES, ownwit._LIVE), saved):
+            store.clear()
+            store.update(snap)
+
+
+def _graph(sites=None, pairs=None) -> OwnershipGraph:
+    g = OwnershipGraph()
+    g.sites["kv-pages"] = {s: set(kinds)
+                           for s, kinds in (sites or {}).items()}
+    g.pairs["kv-pages"] = set(pairs or [])
+    return g
+
+
+SA = "marian_tpu/translator/x.py::acq"
+SR = "marian_tpu/translator/x.py::rel"
+
+
+def _record(acq=SA, rel=SR):
+    """Plant one observed pairing directly (the public note_* API
+    resolves real stack frames, which for a test file is always
+    <external> — by design)."""
+    with ownwit._WITNESS_LOCK:
+        ownwit._ACQ_SITES.setdefault("kv-pages", set()).add(acq)
+        ownwit._REL_SITES.setdefault("kv-pages", set()).add(rel)
+        ownwit._PAIRS.setdefault("kv-pages", {}).setdefault(
+            (acq, rel), "main")
+
+
+class TestRecording:
+    def test_disabled_pool_records_nothing(self, sandbox, monkeypatch):
+        monkeypatch.delenv(ownwit.ENV_VAR, raising=False)
+        assert not ownwit.enabled()
+        p = KVPool(5, page_len=4)
+        p.claim("a", 1)
+        p.release("a")
+        assert ownwit.observed_sites("kv-pages") == (set(), set())
+        assert ownwit.observed_pairs("kv-pages") == {}
+
+    def test_direct_test_use_records_external_sites(self, sandbox):
+        assert ownwit.enabled()          # conftest armed it
+        p = KVPool(5, page_len=4)
+        p.claim("a", 2)
+        p.release("a")
+        acq, rel = ownwit.observed_sites("kv-pages")
+        assert acq == {ownwit.EXTERNAL_SITE}
+        assert rel == {ownwit.EXTERNAL_SITE}
+        # external pairings are exempt from the cross-check by design:
+        # the static analysis does not model test code either
+        assert ownwit.check(_graph()) == []
+
+    def test_transfer_re_owns_at_the_transfer_site(self, sandbox):
+        p = KVPool(5, page_len=4)
+        p.claim("row", 1)
+        p.transfer("row", ("prefix", "v", "k"))
+        assert not any("row" in owner
+                       for owner, _ in ownwit.live_owners("kv-pages"))
+        assert any("prefix" in owner
+                   for owner, _ in ownwit.live_owners("kv-pages"))
+
+    def test_live_owner_reported_until_released(self, sandbox):
+        p = KVPool(5, page_len=4)
+        p.claim("held", 1)
+        assert any("held" in owner
+                   for owner, _ in ownwit.live_owners("kv-pages"))
+        assert ownwit.check_balanced("kv-pages") != []
+        p.release("held")
+        assert ownwit.check_balanced("kv-pages") == []
+
+    def test_two_pools_same_owner_value_do_not_collide(self, sandbox):
+        p1, p2 = KVPool(5, page_len=4), KVPool(5, page_len=4)
+        p1.claim("a", 1)
+        p2.claim("a", 1)
+        p1.release("a")
+        # p2's owner is still live under its own container token
+        assert any(owner == "'a'"
+                   for owner, _ in ownwit.live_owners("kv-pages"))
+
+
+class TestVerdict:
+    def test_unknown_sites_flagged(self, sandbox):
+        _record()
+        violations = ownwit.check(_graph())
+        assert any("ACQUIRE site" in v and SA in v for v in violations)
+        assert any("RELEASE site" in v and SR in v for v in violations)
+
+    def test_unmodeled_pairing_flagged(self, sandbox):
+        _record()
+        g = _graph(sites={SA: ("acquire",), SR: ("release",)}, pairs=[])
+        violations = ownwit.check(g)
+        assert any("pairing" in v and SA in v and SR in v
+                   for v in violations)
+
+    def test_clean_when_modeled(self, sandbox):
+        _record()
+        g = _graph(sites={SA: ("acquire",), SR: ("release",)},
+                   pairs=[(SA, SR)])
+        assert ownwit.check(g) == []
+
+    def test_transfer_site_counts_both_ways(self, sandbox):
+        # a transfer site is a valid release target AND acquire source
+        st = "marian_tpu/translator/x.py::adopt"
+        _record(rel=st)
+        _record(acq=st)
+        g = _graph(sites={SA: ("acquire",), SR: ("release",),
+                          st: ("transfer",)},
+                   pairs=[(SA, st), (st, SR)])
+        assert ownwit.check(g) == []
+
+
+class TestAgainstRealStaticGraph:
+    def test_real_engine_traffic_is_modeled(self, sandbox, tiny):
+        """End-to-end contract: a real engine decode's observed
+        pairings are a subset of the graph analysis/ownership.py builds
+        from the real tree — the exact mechanism the tier-1
+        serving/iteration/beam/prefix witness fixtures assert on."""
+        from tests.test_iteration import TEXTS, make_engine
+        eng = make_engine(tiny)
+        outs = eng.decode_texts(TEXTS[:3])
+        assert len(outs) == 3
+        acq, _rel = ownwit.observed_sites("kv-pages")
+        assert "marian_tpu/translator/iteration.py::_claim_pages" in acq
+        assert ownwit.check_against_static(ROOT) == []
+
+    def test_fabricated_pairing_fails_against_real_graph(self, sandbox):
+        # release at a site the real model knows, acquire at one it
+        # does not: the cross-check must call it out
+        _record(acq="marian_tpu/serving/scheduler.py::submit",
+                rel="marian_tpu/translator/iteration.py::_evict")
+        violations = ownwit.check_against_static(ROOT)
+        assert any("scheduler.py::submit" in v for v in violations)
+
+
+class TestSeededLeakDrill:
+    def test_suppressed_release_fails_the_suite_and_names_the_owner(
+            self, sandbox, tiny):
+        """THE drill: arm `pool.release_drop=fail@1` so the first real
+        release inside the engine's row exit silently does nothing —
+        the suppressed-release leak bug class. The suite must FAIL
+        (row-exit auditor + the armed per-round audit raise
+        PoolCorruption), and the ownership witness must still hold the
+        leaked owner with its real acquire site."""
+        from tests.test_iteration import TEXTS, make_engine
+        eng = make_engine(tiny)
+        with fp.active("pool.release_drop=fail@1"):
+            with pytest.raises(PoolCorruption, match="leaked"):
+                eng.decode_texts([TEXTS[0]])
+        leaks = ownwit.check_balanced("kv-pages")
+        assert any("_claim_pages" in v for v in leaks), leaks
+        live = ownwit.live_owners("kv-pages")
+        assert any("marian_tpu/translator/iteration.py::_claim_pages"
+                   in sites for _owner, sites in live)
+
+    def test_unarmed_drill_point_is_free_and_balanced(self, sandbox,
+                                                      tiny):
+        from tests.test_iteration import TEXTS, make_engine
+        eng = make_engine(tiny)
+        eng.decode_texts([TEXTS[0]])
+        # every engine-side acquire was released (no prefix cache) —
+        # the live table holds nothing for a drained pool
+        assert ownwit.check_balanced("kv-pages") == []
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from marian_tpu.data.vocab import DefaultVocab
+    from tests.test_beam_search import tiny_model
+    from tests.test_iteration import VOCAB_WORDS
+    vocab = DefaultVocab.build(VOCAB_WORDS)
+    model, params, _ = tiny_model(vocab=len(vocab), seed=7,
+                                  **{"dec-depth": 2, "enc-depth": 2})
+    return model, params, vocab

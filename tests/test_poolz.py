@@ -58,6 +58,14 @@ def _lockdep_witness(lockdep_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _ownership_witness(ownership_witness):
+    """The /poolz traffic in this suite drives real claims/releases;
+    the shared witness asserts the observed ownership pairings stay
+    inside the static graph (ISSUE 15)."""
+    yield
+
+
 @pytest.fixture(autouse=True)
 def _reset_obs():
     yield
@@ -259,6 +267,7 @@ class TestPoolState:
             while time.time() < deadline:
                 dumps = sorted(p for p in os.listdir(tmp_path)
                                if p.startswith("flight-")
+                               and p.endswith(".json")
                                and "pool-audit" in p)
                 if dumps:
                     break
@@ -300,6 +309,30 @@ class TestPoolState:
             json.dump(st, fh)
         assert pv.main([path, "--check"]) == 1
         os.unlink(path)
+
+    def test_poolviz_unreachable_url_exits_2_without_traceback(
+            self, capsys):
+        """ISSUE 15 satellite: `poolviz --check` against a dead server
+        must exit 2 with one clear error line, not a traceback (exit 1
+        stays reserved for real page-map discrepancies)."""
+        spec = importlib.util.spec_from_file_location(
+            "poolviz", os.path.join(ROOT, "scripts", "poolviz.py"))
+        pv = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pv)
+        # a port nothing listens on: bind-then-close reserves one
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        rc = pv.main([f"http://127.0.0.1:{port}/poolz", "--check"])
+        captured = capsys.readouterr()
+        assert rc == 2
+        assert "poolviz: cannot load" in captured.err
+        assert "Traceback" not in captured.err
+        # a missing file takes the same loud-exit path
+        assert pv.main(["/no/such/poolz-dump.json"]) == 2
+        assert "cannot load" in capsys.readouterr().err
 
 
 # ---------------------------------------------------------------------------

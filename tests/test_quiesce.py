@@ -47,6 +47,14 @@ def _lockdep_witness(lockdep_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _ownership_witness(ownership_witness):
+    """Quiesce drains/evictions release what joins acquired; the shared
+    witness asserts those observed pairings stay inside the static
+    ownership graph (ISSUE 15)."""
+    yield
+
+
 def run(coro):
     return asyncio.run(coro)
 

@@ -27,6 +27,14 @@ def _lockdep_witness(lockdep_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _ownership_witness(ownership_witness):
+    """Iteration-mode scheduler tests here claim/release pool pages;
+    the shared conftest witness asserts observed pairings ⊆ the static
+    ownership graph (ISSUE 15)."""
+    yield
+
+
 def run(coro):
     return asyncio.run(coro)
 
