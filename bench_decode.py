@@ -21,7 +21,12 @@ Env knobs:
                              LexicalShortlistGenerator.generate → beam
                              search in shortlist coordinates — the
                              reference's decode-speed headline combo
-                             (intgemm + --shortlist)
+                             (intgemm + --shortlist). A/B stage (ISSUE
+                             16): the IDENTICAL batches also run through
+                             the full-vocab output GEMM (shortlist=None)
+                             and the sibling full_vocab_sentences_per_sec
+                             field records the pair — the output-
+                             projection shrink isolated on one run
   MARIAN_DECBENCH_SSRU       SSRU decoder (--transformer-decoder-autoreg
                              rnn --dec-cell ssru): the reference's
                              production fast-decode architecture — no
@@ -519,7 +524,33 @@ def main():
     nbests = results[-1]
     assert len(nbests) == batch
     sents = batch * len(batches)
-    print(json.dumps({
+
+    full_vocab_sps = None
+    if sl_gen is not None:
+        # shortlist A/B: the IDENTICAL batches back through the
+        # full-vocab output GEMM (shortlist=None) — the pair isolates
+        # the 32k→~4k output-projection shrink, which is the whole
+        # economics --shortlist banks on. Kept OUT of the shortlisted
+        # window above so the per-batch shortlist host work stays a
+        # shortlist-side cost, as in the real translator.
+        retry_compile(lambda: bs.search(ids, mask),
+                      "full-vocab beam search")
+        t0 = time.perf_counter()
+        pipelined(batches,
+                  lambda b: bs.search_async(b[0], b[1]),
+                  lambda b, h: h.collect())
+        dt_full = time.perf_counter() - t0
+        full_vocab_sps = round(sents / dt_full, 2)
+
+    # final-sync poison guard (record_bench.py convention): the timed
+    # loops end on host-side n-best collects, so residue here is only a
+    # wedged-device tripwire — but a poisoned round must say so instead
+    # of entering the trajectory as a fast number
+    t_sync = time.perf_counter()
+    jax.block_until_ready(jnp.zeros(()))
+    final_sync_s = round(time.perf_counter() - t_sync, 3)
+    from bench import FINAL_SYNC_POISON_S
+    result = {
         "metric": metric,
         "value": round(sents / dt, 2),
         "unit": "sent/sec",
@@ -531,7 +562,17 @@ def main():
         "fused_decode": fused_env or "auto",
         "fused_decode_engaged": fused_engaged,
         "while_body_ops": body_ops,
-    }))
+        "final_sync_s": final_sync_s,
+    }
+    if full_vocab_sps is not None:
+        result["full_vocab_sentences_per_sec"] = full_vocab_sps
+    if final_sync_s > FINAL_SYNC_POISON_S:
+        result["poisoned"] = True
+        result["poisoned_reason"] = (
+            f"final_sync_s {final_sync_s} > {FINAL_SYNC_POISON_S:g}: "
+            f"wedged final sync — round self-poisoned, not "
+            f"trajectory-worthy")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1594,6 +1594,15 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
     over the word axis, which only shifts scores by a constant per
     position)."""
     from ..ops.quantization import QTensor, int8_logits
+    # Per-row shortlist (iteration serving, ISSUE 16): a 2-D [R, K]
+    # index set — every decode row carries its OWN sentence union, so
+    # the slice is a batched gather, not one [d, K] column slice. Only
+    # the plain-tensor path supports it; int8 / factored decodes keep
+    # the batch-wide 1-D contract.
+    per_row = shortlist is not None and getattr(shortlist, "ndim", 1) == 2
+    if per_row and x.ndim != 2:
+        raise ValueError("per-row [R, K] shortlist needs [R, d] "
+                         "activations (single decode position)")
     if cfg.tied_embeddings_all:
         table = params["Wemb"]
     elif cfg.tied_embeddings:
@@ -1606,6 +1615,13 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
     b = params.get("decoder_ff_logit_out_b")
     if b is None:
         b = jnp.zeros((1, _trg_rows(cfg)), x.dtype)
+    if per_row and (cfg.trg_factors is not None
+                    or isinstance(table, QTensor)
+                    or (table is None and isinstance(
+                        params.get("decoder_ff_logit_out_W"), QTensor))):
+        raise NotImplementedError(
+            "per-row shortlists are not supported with int8 or factored "
+            "output layers; decode with a float, unfactored model")
     if table is not None and isinstance(table, QTensor):
         # tied quantized table [V, d], per-row scales → int8 x @ table.T
         if cfg.trg_factors is not None:
@@ -1642,6 +1658,13 @@ def output_logits(cfg: TransformerConfig, params: Params, x: jax.Array,
             units = units + b.astype(jnp.float32)
         return factored_log_probs(units, cfg.trg_factors, shortlist,
                                       cfg.factor_weight)
+    if per_row:
+        # [R, K, d] gather of each row's output columns, then a batched
+        # row-vector matmul — the per-row twin of the [d, K] slice below
+        wg = jnp.take(w.T, shortlist, axis=0).astype(x.dtype)  # [R, K, d]
+        y = jnp.einsum("rd,rkd->rk", x, wg,
+                       preferred_element_type=jnp.float32)
+        return y + b[0, shortlist].astype(jnp.float32)
     if shortlist is not None:
         w = w[:, shortlist]
         b = b[:, shortlist]

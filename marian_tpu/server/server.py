@@ -102,6 +102,34 @@ def split_priority_header(text: str) -> Tuple[Optional[int], str]:
     except ValueError:
         return None, text
     return max(PRIORITY_MIN, min(PRIORITY_MAX, prio)), rest if sep else ""
+
+
+# Streaming protocol extension (ISSUE 16, backwards-compatible like
+# #trace / #priority; headers stack in that order, #stream last): a
+# client MAY send `#stream:1` — the server then delivers partial target
+# text as the decode progresses, one `#partial:<sentence_idx> <text>`
+# frame per engine round per still-decoding sentence, followed by the
+# normal final reply frame (which for tracing clients carries the
+# #trace metadata line, and on retriable eviction is the usual
+# !!SERVER-RETRY — i.e. the stream closes retriably). Greedy partials
+# are append-only prefixes of the final text; beam partials are the
+# CURRENT best hypothesis and may retract earlier text when the beam
+# reranks. Only iteration mode produces partials; a request-mode server
+# accepts the header and simply never emits any (clients NaN-suppress
+# ttft, like loadgen). A malformed value is payload, never an error.
+STREAM_PREFIX = "#stream:"
+PARTIAL_PREFIX = "#partial:"
+
+
+def split_stream_header(text: str) -> Tuple[Optional[bool], str]:
+    """(stream | None, body) — see STREAM_PREFIX above."""
+    if not text.startswith(STREAM_PREFIX):
+        return None, text
+    first, sep, rest = text.partition("\n")
+    raw = first[len(STREAM_PREFIX):].strip()
+    if raw not in ("0", "1"):
+        return None, text
+    return raw == "1", rest if sep else ""
 # per-connection cap on bytes the EOF watch may read ahead of the framing
 # parser while a reply is pending — bounds what a flooding pipelined
 # client can make the server buffer
@@ -325,17 +353,43 @@ class ServingApp:
             self._init_lifecycle(watch_s, translate_lines,
                                  executor_factory)
 
-    @staticmethod
-    def _validate_iteration_options(options) -> None:
+    # The decode-output-shaping flags iteration mode must take a
+    # position on, and that position (ISSUE 16). True = lifted into the
+    # paged engines (translator/decode_features.py); a string = why the
+    # paged path still refuses it. EVERY flag in DECODE_SURFACE_FLAGS
+    # must appear here: a set flag with no entry is refused as
+    # UNCLASSIFIED rather than silently decoded without its feature —
+    # no flag may fall through to wrong output (the regression test in
+    # tests/test_decode_features.py pins exactly that).
+    DECODE_SURFACE_FLAGS = ("n-best", "output-sampling", "force-decode",
+                            "shortlist", "alignment", "word-scores",
+                            "output-approx-knn")
+    ITERATION_DECODE_SURFACE = {
+        "n-best": True,
+        "output-sampling": True,
+        "force-decode": True,
+        "shortlist": True,
+        "alignment": "alignment output — the paged step keeps no "
+                     "per-row attention tap",
+        "word-scores": "per-word scores — the paged step keeps no "
+                       "per-token logp trail",
+        "output-approx-knn": "approximate-knn output layers — the LSH "
+                             "projection is batch-shaped, not per-row",
+    }
+
+    @classmethod
+    def _validate_iteration_options(cls, options) -> None:
         """--batching-mode iteration composes with a restricted option
-        surface (docs/DEPLOYMENT.md): the paged engines decode a single
-        model (greedily at --beam-size 1, copy-on-write beam search
-        above — ISSUE 12 removed the old beam-1 refusal) — fail LOUDLY
-        at boot rather than serving something subtly different from
-        what was asked. --model-watch DOES compose since ISSUE 11:
-        swaps/canaries/rollbacks re-point the engine through the
-        quiesce protocol at a step boundary with an empty join set
-        (--quiesce-deadline bounds the drain)."""
+        surface (docs/DEPLOYMENT.md "decode-surface matrix"): the paged
+        engines decode a single model (greedily at --beam-size 1,
+        copy-on-write beam search above) and — since ISSUE 16 — carry
+        the per-row decode-feature plane (shortlist, sampling, n-best,
+        force-decode). What remains unsupported fails LOUDLY at boot
+        via ITERATION_DECODE_SURFACE above, rather than serving
+        something subtly different from what was asked. --model-watch
+        DOES compose since ISSUE 11: swaps/canaries/rollbacks re-point
+        the engine through the quiesce protocol at a step boundary with
+        an empty join set (--quiesce-deadline bounds the drain)."""
         problems = []
         beam = int(options.get("beam-size", 6) or 6)
         if beam < 1:
@@ -348,15 +402,26 @@ class ServingApp:
         models = list(options.get("models", []) or [])
         if len(models) > 1:
             problems.append("--models ensembles are not supported")
-        for flag, why in (("n-best", "n-best output"),
-                          ("output-sampling", "sampling"),
-                          ("alignment", "alignment output"),
-                          ("force-decode", "forced prefixes"),
-                          ("shortlist", "lexical shortlists"),
-                          ("word-scores", "per-word scores")):
+        set_flags = []
+        for flag in cls.DECODE_SURFACE_FLAGS:
             v = options.get(flag, None)
-            if v not in (None, False, [], "", 0):
-                problems.append(f"--{flag} ({why})")
+            if v in (None, False, [], "", 0):
+                continue
+            set_flags.append(flag)
+            verdict = cls.ITERATION_DECODE_SURFACE.get(flag)
+            if verdict is True:
+                continue
+            if not verdict:
+                verdict = ("UNCLASSIFIED decode flag — add it to "
+                           "ITERATION_DECODE_SURFACE before serving it "
+                           "in iteration mode")
+            problems.append(f"--{flag} ({verdict})")
+        if "shortlist" in set_flags and "force-decode" in set_flags:
+            # same refusal the FeaturePlane constructor makes — caught
+            # here so the operator sees it at boot, not at first claim
+            problems.append(
+                "--shortlist together with --force-decode (forced "
+                "prefix ids are full-vocab, shortlisted logits are not)")
         if int(options.get("num-devices", 0) or 0) > 1:
             problems.append("--num-devices > 1 (the paged pallas call "
                             "is GSPMD-opaque, like the fused decode "
@@ -376,6 +441,16 @@ class ServingApp:
         tr = service.translator
         opts = self.options
         ml = max(1, int(opts.get("max-length", 50) or 50))
+        # per-row decode-feature plane (ISSUE 16): shortlist / sampling
+        # / n-best / force-decode, parsed from the SAME flags the dense
+        # request-mode path reads; None when no feature is on (engines
+        # keep their exact pre-feature compiled step)
+        from ..translator.decode_features import FeaturePlane
+        plane = FeaturePlane.from_options(opts, tr.src_vocab,
+                                          tr.trg_vocab)
+        if plane is not None:
+            log.info("iteration decode-feature plane: {}",
+                     plane.describe())
         prefix = None
         if opts.get("prefix-cache", False):
             from ..translator.prefix_cache import PrefixCache
@@ -386,6 +461,14 @@ class ServingApp:
                 max_entries=int(
                     opts.get("prefix-cache-entries", 64) or 64),
                 version=str((opts.get("models", None) or ["model"])[0]))
+            if plane is not None and plane.n_best:
+                # a cached reply would bake in the ORIGINAL request's
+                # sentence numbering (the n-best block carries sids) —
+                # replaying it to another request mislabels every line
+                log.info("--n-best disables the prefix cache: cached "
+                         "n-best replies would carry another request's "
+                         "sentence ids")
+                prefix = None
         kw = dict(
             max_rows=int(opts.get("iteration-rows", 32) or 32),
             page_len=int(opts.get("kv-page-len", 16) or 16),
@@ -395,9 +478,11 @@ class ServingApp:
             max_length_factor=float(
                 opts.get("max-length-factor", 3.0) or 3.0),
             registry=registry,
-            prefix_cache=prefix)
+            prefix_cache=prefix,
+            features=plane)
         beam = int(opts.get("beam-size", 6) or 6)
-        if beam > 1:
+        use_beam = beam > 1 or (plane is not None and plane.n_best)
+        if use_beam:
             # COW paged beam search (ISSUE 12): same slot engine, one
             # sentence = beam slots, full pages shared by refcount
             from ..translator.beam_iteration import PagedBeamEngine
@@ -722,22 +807,35 @@ class ServingApp:
         """One protocol frame in, one reply frame out — the transport-
         agnostic request path (admission -> scheduler -> reply).
         Convenience over :meth:`handle_frame` for callers that don't
-        report the reply-write moment."""
+        report the reply-write moment (or stream partials)."""
         reply, done = await self.handle_frame(text, priority)
         done(len(reply.encode("utf-8")))   # nbytes means BYTES everywhere
         return reply
 
-    async def handle_frame(self, text: str, priority: int = 0
+    async def handle_frame(self, text: str, priority: int = 0,
+                           send_partial: Optional[
+                               Callable[[str], None]] = None
                            ) -> Tuple[str, Callable[[int], None]]:
         """(reply, done) — the transports call ``done(nbytes)`` after
         the reply bytes hit the socket, which closes the request's root
         span with a ``reply.write`` child covering the write (ISSUE 8:
         the span tree spans ingest → … → reply write). ``done`` is a
-        no-op when tracing is off."""
+        no-op when tracing is off.
+
+        ``send_partial`` is the transport's partial-frame writer for
+        #stream: clients (called on the event-loop thread, in order,
+        strictly before this coroutine returns the final reply); None
+        means the transport cannot stream — the header is then ignored,
+        which is also the request-mode behavior."""
         trace_id, body = split_trace_header(text)
         hdr_priority, body = split_priority_header(body)
         if hdr_priority is not None:
             priority = hdr_priority
+        stream, body = split_stream_header(body)
+        on_partial = None
+        if stream and send_partial is not None:
+            def on_partial(idx: int, partial: str, _ntok: int) -> None:
+                send_partial(f"{PARTIAL_PREFIX}{idx} {partial}")
         lines = body.split("\n")
         span = None
         if obs.enabled():
@@ -762,7 +860,7 @@ class ServingApp:
             fut = self.scheduler.submit(
                 lines, priority=priority,
                 timeout=self.request_timeout or None,
-                meta=meta, trace_id=trace_id)
+                meta=meta, trace_id=trace_id, on_partial=on_partial)
         try:
             out = await fut
         except RequestTimeout as e:
@@ -878,20 +976,52 @@ def _make_ws_handler(app: ServingApp):
     tests (so the real wiring is what gets exercised). A dropped
     connection cancels the handler task mid-await, which cancels the
     request future — the scheduler then discards its queued sentences
-    before they cost device time (cancellation propagation)."""
+    before they cost device time (cancellation propagation).
+
+    Streaming (#stream:, ISSUE 16): partial frames are enqueued by the
+    scheduler's round loop while ``handle_frame`` is awaited; a per-
+    connection drainer task sends them in order, and the final reply
+    rides the SAME queue, so a client can never see it before (or
+    interleaved with) its partials."""
     async def handler(ws):
-        async for message in ws:
-            reply, done = await app.handle_frame(message)
-            nbytes = 0
-            try:
-                await ws.send(reply)
-                # UTF-8 byte count, matching the TCP path — the trace
-                # attribute must mean the same thing on both transports
-                nbytes = len(reply.encode("utf-8"))
-            finally:
-                # root span must close even when the send fails (client
-                # abort is exactly the case an operator inspects later)
-                done(nbytes)
+        q: "asyncio.Queue[Optional[str]]" = asyncio.Queue()
+
+        async def _drain():
+            while True:
+                frame = await q.get()
+                try:
+                    await ws.send(frame)
+                finally:
+                    q.task_done()
+
+        drainer = asyncio.ensure_future(_drain())
+        try:
+            async for message in ws:
+                reply, done = await app.handle_frame(
+                    message, send_partial=q.put_nowait)
+                nbytes = 0
+                try:
+                    q.put_nowait(reply)
+                    flushed = asyncio.ensure_future(q.join())
+                    # a dead drainer (send failed: client gone) leaves
+                    # queue items un-acked forever — never await join
+                    # unguarded
+                    await asyncio.wait({flushed, drainer},
+                                       return_when=asyncio.FIRST_COMPLETED)
+                    if not flushed.done():
+                        flushed.cancel()
+                        drainer.result()     # surface the send error
+                    # UTF-8 byte count, matching the TCP path — the trace
+                    # attribute must mean the same thing on both
+                    # transports
+                    nbytes = len(reply.encode("utf-8"))
+                finally:
+                    # root span must close even when the send fails
+                    # (client abort is exactly the case an operator
+                    # inspects later)
+                    done(nbytes)
+        finally:
+            drainer.cancel()
     return handler
 
 
@@ -951,8 +1081,19 @@ def _make_tcp_handler(app: ServingApp):
                     await writer.drain()
                     break
                 payload = await _readexactly(nbytes)
+
+                def _send_partial(frame: str) -> None:
+                    # one MTPU frame per partial (#stream:, ISSUE 16),
+                    # written on the event-loop thread in delivery
+                    # order, always before the final reply frame below;
+                    # TCP backpressure is absorbed by the writer buffer
+                    # and drained with the final reply
+                    b = frame.encode("utf-8")
+                    writer.write(b"MTPU %d\n" % len(b) + b)
+
                 reply_t = asyncio.ensure_future(
-                    app.handle_frame(payload.decode("utf-8")))
+                    app.handle_frame(payload.decode("utf-8"),
+                                     send_partial=_send_partial))
                 eof = False
                 while not reply_t.done():
                     if len(buf) >= MAX_READAHEAD:

@@ -119,7 +119,8 @@ class _Request:
                  "results", "remaining", "queued", "queued_pages",
                  "first_dispatch", "timeout_handle", "dead_accounted",
                  "trace_id", "span", "own_root", "q_span", "d_span",
-                 "meta", "rounds", "prefix_hits", "evictions_n")
+                 "meta", "rounds", "prefix_hits", "evictions_n",
+                 "on_partial", "ttft")
 
     def __init__(self, lines: List[str], future: "asyncio.Future",
                  priority: int, arrival: float, deadline: Optional[float]):
@@ -158,13 +159,18 @@ class _Request:
         self.rounds = 0
         self.prefix_hits = 0
         self.evictions_n = 0
+        # streaming (ISSUE 16): transport callback for partial-token
+        # delivery (#stream: clients; None = no streaming), and the
+        # request's time-to-first-token, stamped at its FIRST partial
+        self.on_partial: Optional[Callable[[int, str, int], None]] = None
+        self.ttft: Optional[float] = None
 
 
 class _Unit:
     """One sentence of one request — the scheduling granule."""
 
     __slots__ = ("req", "idx", "text", "tokens", "pages", "row_span",
-                 "rounds", "evict_reason")
+                 "rounds", "evict_reason", "partials_sent")
 
     def __init__(self, req: _Request, idx: int, text: str, tokens: int,
                  pages: int = 0):
@@ -182,6 +188,9 @@ class _Unit:
         self.row_span = None
         self.rounds = 0
         self.evict_reason: Optional[str] = None
+        # streamed partial frames delivered for this row (#stream:);
+        # the first one stamps ttft on the serve.row span
+        self.partials_sent = 0
 
 
 class ContinuousScheduler:
@@ -396,6 +405,17 @@ class ContinuousScheduler:
             "Rows evicted with retriable !!SERVER-RETRY by the brownout "
             "ladder (level >= 2) to free capacity for a higher-priority "
             "lane")
+        # streaming series (ISSUE 16): #stream: clients get partial
+        # target tokens as engine rounds complete (iteration mode)
+        self.m_stream_partials = r.counter(
+            "marian_stream_partials_total",
+            "Partial-token frames delivered to streaming clients "
+            "(#stream: protocol header, iteration mode)")
+        self.m_stream_ttft = r.histogram(
+            "marian_stream_ttft_seconds",
+            "Time from request arrival to its first streamed partial "
+            "token (#stream: clients; the streaming twin of "
+            "time_to_first_batch, which measures join, not delivery)")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -587,7 +607,9 @@ class ContinuousScheduler:
     def submit(self, lines: List[str], priority: int = 0,
                timeout: Optional[float] = None,
                meta: Optional[dict] = None,
-               trace_id: Optional[str] = None) -> "asyncio.Future":
+               trace_id: Optional[str] = None,
+               on_partial: Optional[Callable[[int, str, int], None]]
+               = None) -> "asyncio.Future":
         """Enqueue one request (a list of sentences); returns a future
         resolving to the list of translations in input order. Must be
         called from the event-loop thread (transports live there).
@@ -599,7 +621,13 @@ class ContinuousScheduler:
         for clients that asked (#trace protocol header; loadgen's
         client-side swap-blip attribution). ``trace_id`` labels the
         request's span tree; with the tracer enabled and no id given,
-        one is generated (or inherited from the context's span)."""
+        one is generated (or inherited from the context's span).
+
+        ``on_partial`` (iteration mode, #stream: clients) is called on
+        the event-loop thread as ``on_partial(sentence_idx, text_so_far,
+        n_tokens)`` every engine round a row of this request is still
+        decoding; the future's resolution remains the FINAL reply. Never
+        called after the future is done."""
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
         now = loop.time()
@@ -615,6 +643,7 @@ class ContinuousScheduler:
         req = _Request(lines, fut, priority, now, deadline)
         req.meta = meta
         req.trace_id = trace_id or ""
+        req.on_partial = on_partial
         if obs.enabled():
             # span tree: reuse the context's request-root span when the
             # transport opened one (server.handle_frame); open our own
@@ -1032,7 +1061,13 @@ class ContinuousScheduler:
         self._inflight += 1
         try:
             fp.fault_point("serving.dispatch")
-            payload = [(u, u.text) for u in joins]
+            # per-row join metadata rides into the engine's claim: the
+            # request-local sentence id (n-best numbering) and whether
+            # the client asked for streamed partials (#stream:)
+            payload = [(u, u.text,
+                        {"sid": u.idx,
+                         "stream": u.req.on_partial is not None})
+                       for u in joins]
 
             def _round():
                 fp.fault_point("serving.translate")
@@ -1126,6 +1161,31 @@ class ContinuousScheduler:
                 self._evict_with_retry(
                     u, loop, "row evicted: KV pool exhausted mid-decode "
                              "(copy-on-write beam divergence)")
+        # streaming fan-out (ISSUE 16): every still-decoding row of a
+        # #stream: request delivers its text-so-far as one partial
+        # frame per round; the FIRST partial stamps ttft. Rows that
+        # finished this round are not in res.partials — the final
+        # reply below is always the last frame a client sees.
+        for u, text, ntok in getattr(res, "partials", ()) or ():
+            req = getattr(u, "req", None)
+            if req is None or req.future.done() \
+                    or req.on_partial is None:
+                continue
+            now_p = loop.time()
+            if u.partials_sent == 0 and u.row_span is not None:
+                u.row_span.set_attrs(
+                    ttft_ms=round((now_p - req.arrival) * 1e3, 2))
+            if req.ttft is None:
+                req.ttft = now_p - req.arrival
+                self.m_stream_ttft.observe(
+                    req.ttft, trace_id=req.trace_id or None)
+            u.partials_sent += 1
+            self.m_stream_partials.inc()
+            try:
+                req.on_partial(u.idx, text, ntok)
+            except Exception as e:  # noqa: BLE001 — a broken client
+                log.warn("stream partial delivery failed: {}", e)
+                req.on_partial = None     # stream must never kill rounds
         src_done = 0
         for u, text in res.finished:
             self._active_units.pop(u, None)

@@ -90,9 +90,10 @@ class _Sent:
     """One decoding sentence: k hypothesis rows over k claimed slots."""
 
     __slots__ = ("key", "slots", "hyps", "t", "cap", "src_tokens",
-                 "src_key")
+                 "src_key", "feat")
 
-    def __init__(self, key, slots, hyps, cap, src_tokens, src_key):
+    def __init__(self, key, slots, hyps, cap, src_tokens, src_key,
+                 feat=None):
         self.key = key
         self.slots = slots          # the k claimed slot indices
         self.hyps = hyps
@@ -100,6 +101,7 @@ class _Sent:
         self.cap = cap
         self.src_tokens = src_tokens
         self.src_key = src_key
+        self.feat = feat            # RowFeatures (decode_features.py)
 
 
 class PagedBeamEngine(PagedDecodeEngine):
@@ -109,6 +111,8 @@ class PagedBeamEngine(PagedDecodeEngine):
     admit_and_step/evict/audit surface, sentence-granular capacity
     (``free_slots`` counts k-row groups), per-sentence page pricing at
     worst-case OWNED pages."""
+
+    _SUPPORTS_NBEST = True
 
     def __init__(self, model, params, src_vocab, trg_vocab,
                  beam_size: int = 6,
@@ -181,8 +185,14 @@ class PagedBeamEngine(PagedDecodeEngine):
 
     def _try_claim(self, key, text: str, joiners: List,  # owns: caller -- hypothesis rows join the engine's slot machinery; _evict retables them away
                    detail: Optional[Dict[object, str]] = None,
-                   res: Optional[StepResult] = None) -> Optional[str]:
+                   res: Optional[StepResult] = None,
+                   meta: Optional[dict] = None) -> Optional[str]:
         k = self.beam_size
+        plane = self.features
+        forced: List[int] = []
+        if plane is not None and plane.force_decode:
+            # iteration force-decode line convention: source<TAB>prefix
+            text, forced = plane.split_forced(text, self.trg_vocab)
         ids = self.src_vocab.encode(text, add_eos=True, inference=True)
         if len(ids) > self.src_cap:
             if detail is not None:
@@ -191,10 +201,15 @@ class PagedBeamEngine(PagedDecodeEngine):
                                f"{self.src_cap} (raise --max-length)")
             return "src_too_long"
         src_key = tuple(int(i) for i in ids)
+        if plane is not None:
+            src_key = plane.cache_key(src_key, forced)
         if self.prefix is not None and res is not None:
             ent = self.prefix.get(src_key, self.prefix.version)
             if ent is not None:
-                # beam decode is deterministic per version: replay
+                # beam decode is deterministic per version: replay.
+                # n-best replies are NOT cached (the memo keeps only
+                # the best hypothesis) — _engine_for disables the cache
+                # when --n-best is on, so this path never serves one.
                 res.finished.append((key, ent.text))
                 res.row_events.append((key, "prefix.hit",
                                        {"kind": "replay",
@@ -202,6 +217,15 @@ class PagedBeamEngine(PagedDecodeEngine):
                 self._count("prefix_hits")
                 return None
         cap = self.decode_cap(len(ids))
+        if forced:
+            if len(forced) + 8 > self.max_length_cap:
+                if detail is not None:
+                    detail[key] = (
+                        f"forced target prefix is {len(forced)} tokens "
+                        f"but the engine's decode cap is "
+                        f"{self.max_length_cap} (raise --max-length)")
+                return "too_large"
+            cap = min(self.max_length_cap, max(cap, len(forced) + 8))
         n_pages = pages_for_tokens(cap, self.page_len)
         if n_pages > self.pool.max_pages_per_row:
             if detail is not None:
@@ -236,23 +260,36 @@ class PagedBeamEngine(PagedDecodeEngine):
                         f"--kv-pool-bytes or lower --max-length)")
                 return "too_large"
             return "no_pages"
+        stream = bool(meta.get("stream")) if meta else False
+        sid = int(meta.get("sid", 0)) if meta else 0
+        feat = None
+        if plane is not None:
+            feat = plane.row_features(ids, forced=forced,
+                                      lane=self._lane_ctr,
+                                      stream=stream, sid=sid)
+        elif stream or sid:
+            from .decode_features import RowFeatures
+            feat = RowFeatures(stream=stream, sid=sid)
+        # sampling: every beam is an independent sample trajectory from
+        # t=0 (dense twin: scores0 = zeros, beam_idx = identity) — no
+        # single-live-beam mask, no cross-beam merge
+        sampled = bool(plane is not None and plane.sampling)
         hyps = []
         with self._lock:
             for j, slot in enumerate(slots):
                 self._slots[slot] = _Slot(key, cap, len(ids),
                                           expected_refs=1,
-                                          src_key=src_key)
+                                          src_key=src_key, feat=feat)
                 self._slot_pos[slot] = 0
                 self._slot_prev[slot] = 0
                 # t=0 single-live-beam mask: the dense scores0 init
-                self._slot_score[slot] = 0.0 if j == 0 else NEG_INF
-                hyps.append(_Hyp([], np.float32(0.0 if j == 0
-                                                else NEG_INF),
-                                 0, False, j, slot))
+                s0 = 0.0 if (j == 0 or sampled) else NEG_INF
+                self._slot_score[slot] = s0
+                hyps.append(_Hyp([], np.float32(s0), 0, False, j, slot))
                 self._n_active += 1
             self._by_key[key] = slots[0]
             self._sents[key] = _Sent(key, slots, hyps, cap, len(ids),
-                                     src_key)
+                                     src_key, feat=feat)
         for (owner, pages), slot in zip(claimed, slots):
             self._table[slot, :] = 0
             self._table[slot, 0] = pages[0]
@@ -263,6 +300,11 @@ class PagedBeamEngine(PagedDecodeEngine):
         joiners.append((key, ids, slots[0]))
         if len(slots) > 1:
             self._pending_replicate.append((slots[0], slots[1:]))
+        self._row_admitted(feat)
+        if self.features is not None:
+            # sampling lanes are per HYPOTHESIS row (k independent
+            # trajectories); _row_admitted advanced one, take the rest
+            self._lane_ctr += k - 1
         return None
 
     def _install(self, joiners) -> None:
@@ -322,8 +364,19 @@ class PagedBeamEngine(PagedDecodeEngine):
         k = self.beam_size
         allow_unk = self.allow_unk
         row_keys, pool_keys, whole_keys = self._state_key_groups()
+        # feature plane (ISSUE 16): static per-engine — which extras the
+        # jit takes and which branch it returns never varies per round
+        plane = self.features
+        has_sl = plane is not None and plane.shortlist_gen is not None
+        sampling = tuple(plane.sampling) if plane is not None else ()
+        has_force = plane is not None and plane.force_decode
+        temp = max(float(sampling[-1]), 1e-6) if sampling else 1.0
+        topn = int(sampling[1]) if sampling and sampling[0] == "topk" \
+            else 0
+        seed = int(plane.seed) if plane is not None else 0
 
-        def step(state, src_mask, params, prev, pos, table, scores):
+        def step(state, src_mask, params, prev, pos, table, scores,
+                 *extras):
             sub = {key: state[key][:rb] for key in row_keys}
             for key in whole_keys:
                 sub[key] = state[key]
@@ -331,20 +384,60 @@ class PagedBeamEngine(PagedDecodeEngine):
                 sub[key] = state[key]
             sub["pos"] = pos
             sub["page_table"] = table
+            it = iter(extras)
+            sl = next(it) if has_sl else None          # [rb, K] full ids
+            sl_len = next(it) if has_sl else None      # [rb] true width
+            lane = next(it) if sampling else None      # [rb] RNG lane
+            ctr = next(it) if sampling else None       # [rb] step count
+            forced = next(it) if has_force else None   # [rb] token / -1
             logits, new_sub = model.step(params, sub, prev,
-                                         src_mask[:rb])
+                                         src_mask[:rb], shortlist=sl)
             # EXACTLY the dense beam search's per-row math (bitwise):
             # f32 log-softmax, UNK suppression by NEG_INF overwrite,
             # then the f32 cumulative-score add — per-row top-k of the
             # same values the dense flat top-k ranks
-            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            if not allow_unk:
+            lg = logits.astype(jnp.float32)
+            if has_sl:
+                # engine padding past the row's true (dense-padded)
+                # width leaves the softmax before it happens — the
+                # normalizer over the surviving coords is the dense one
+                coords = jnp.arange(lg.shape[-1])[None, :]
+                lg = jnp.where(coords < sl_len[:, None], lg, NEG_INF)
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            if not allow_unk and not has_sl:
+                # dense twin: UNK suppression only without a shortlist
+                # (the shortlist already curates the candidate set)
                 lp = lp.at[:, UNK_ID].set(NEG_INF)
-            comb = scores[:, None] + lp
-            vals, idx = jax.lax.top_k(comb, k)
+            if has_force:
+                # forced trunk: NEG_INF everywhere but the forced token,
+                # which keeps its TRUE logp (dense: the prefix gate) —
+                # scores of a forced decode match the dense run
+                gate = (forced >= 0)[:, None]
+                hot = jax.nn.one_hot(jnp.maximum(forced, 0),
+                                     lp.shape[-1], dtype=bool)
+                lp = jnp.where(gate & ~hot, NEG_INF, lp)
             new_state = dict(state)
             for key in pool_keys:
                 new_state[key] = new_sub[key]
+            if sampling:
+                # k independent gumbel-max trajectories (dense twin:
+                # sampled search with beam_idx = identity); the chosen
+                # token's TRUE logp accumulates into the path score
+                slp = lp / temp
+                if topn:
+                    kth = jax.lax.top_k(slp, topn)[0][..., -1:]
+                    slp = jnp.where(slp < kth, NEG_INF, slp)
+                keys = jax.vmap(lambda l, c: jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(seed), l),
+                    c))(lane, ctr)
+                g = jax.vmap(lambda kk: jax.random.gumbel(
+                    kk, slp.shape[-1:], jnp.float32))(keys)
+                tok = jnp.argmax(slp + g, axis=-1).astype(jnp.int32)
+                val = scores + jnp.take_along_axis(
+                    lp, tok[:, None], axis=1)[:, 0]
+                return val, tok, new_state
+            comb = scores[:, None] + lp
+            vals, idx = jax.lax.top_k(comb, k)
             return vals, idx, new_state
 
         return jax.jit(step, donate_argnums=(0,))
@@ -367,6 +460,49 @@ class PagedBeamEngine(PagedDecodeEngine):
 
         return jax.jit(fork, donate_argnums=(0,))
 
+    def _feature_args(self, rb: int) -> Tuple[object, ...]:
+        """Beam variant of the per-row feature arrays: every hypothesis
+        row of a sentence shares the sentence's shortlist and forced
+        trunk, but gets its OWN sampling lane (``feat.lane + j`` for the
+        j-th slot — k independent trajectories), and ``forced`` is a
+        single step wide (steps_per_round is forced to 1)."""
+        plane = self.features
+        if plane is None:
+            return ()
+        extras: List[object] = []
+        if plane.shortlist_gen is not None:
+            kst = plane.k_static
+            sl_np = np.zeros((rb, kst), np.int32)
+            len_np = np.full((rb,), kst, np.int32)
+        if plane.sampling:
+            lane_np = np.zeros((rb,), np.int32)
+            ctr_np = np.zeros((rb,), np.int32)
+        if plane.force_decode:
+            forced_np = np.full((rb,), -1, np.int32)
+        for sent in self._sents.values():
+            f = sent.feat
+            if f is None:
+                continue
+            for j, slot in enumerate(sent.slots):
+                if slot >= rb or self._slot_pos[slot] < 0:
+                    continue
+                if plane.shortlist_gen is not None \
+                        and f.shortlist is not None:
+                    sl_np[slot, :] = f.shortlist
+                    len_np[slot] = f.sl_len
+                if plane.sampling:
+                    lane_np[slot] = f.lane + j
+                    ctr_np[slot] = self._slot_pos[slot]
+                if plane.force_decode and f.forced:
+                    forced_np[slot] = f.forced_at(self._slot_pos[slot])
+        if plane.shortlist_gen is not None:
+            extras += [jnp.asarray(sl_np), jnp.asarray(len_np)]
+        if plane.sampling:
+            extras += [jnp.asarray(lane_np), jnp.asarray(ctr_np)]
+        if plane.force_decode:
+            extras.append(jnp.asarray(forced_np))
+        return tuple(extras)
+
     def _step(self, res: StepResult) -> None:
         top = max(i for i, s in enumerate(self._slots) if s is not None)
         rb = bucket_rows(top + 1, self.row_buckets)
@@ -387,19 +523,25 @@ class PagedBeamEngine(PagedDecodeEngine):
         vals_dev, idx_dev, self._state = fn(
             self._state, self._src_mask, self.params,
             jnp.asarray(prev_np), jnp.asarray(pos_np),
-            jnp.asarray(self._table[:rb]), jnp.asarray(score_np))
+            jnp.asarray(self._table[:rb]), jnp.asarray(score_np),
+            *self._feature_args(rb))
         # per-round host sync by design (see PagedDecodeEngine._step)
         vals = np.asarray(vals_dev)  # mtlint: ok -- iteration-level decode syncs once per round by design; the beam merge runs host-side between rounds
         idx = np.asarray(idx_dev)  # mtlint: ok -- same round boundary as vals above; one fetch, already fenced
         self._ever_stepped = True
+        sampled = self.features is not None \
+            and bool(self.features.sampling)
         fork_src: List[int] = []
         fork_dst: List[int] = []
         finished_sents: List[Tuple[_Sent, _Hyp]] = []
         for key in list(self._sents):
             sent = self._sents[key]
             try:
-                done = self._merge_sentence(sent, vals, idx, fork_src,
-                                            fork_dst)
+                if sampled:
+                    done = self._merge_sentence_sampled(sent, vals, idx)
+                else:
+                    done = self._merge_sentence(sent, vals, idx,
+                                                fork_src, fork_dst)
             except PoolExhausted:
                 # lazy COW claim found the pool dry: evict the whole
                 # sentence retriably (its references are dropped by
@@ -426,17 +568,47 @@ class PagedBeamEngine(PagedDecodeEngine):
             dst[:len(fork_dst)] = fork_dst
             self._state = fj(self._state, jnp.asarray(src),
                              jnp.asarray(dst))
+        plane = self.features
         for sent, best in finished_sents:
             toks = self._crop(best)
             text = self.trg_vocab.decode(toks, ignore_eos=True)
-            res.finished.append((sent.key, text))
-            res.finished_info[sent.key] = {
+            info = {
                 "score": float(best.score),
                 "norm_score": float(self._norm_score(best)),
                 "length": int(best.length),
                 "tokens": list(best.tokens),
             }
+            if plane is not None and plane.n_best:
+                # the whole ranked beam, formatted through the SAME
+                # OutputPrinter as the dense driver ("id ||| text |||
+                # Score= cum norm" per hypothesis, byte parity)
+                norms = np.array(  # mtlint: ok -- host-side collect math over np.float32 scalars
+                    [self._norm_score(h) for h in sent.hyps], np.float32)
+                order = np.argsort(-norms, kind="stable")
+                nbest = [{"tokens": list(sent.hyps[i].tokens
+                                         [:sent.hyps[i].length]),
+                          "score": float(sent.hyps[i].score),
+                          "norm_score":
+                              float(self._norm_score(sent.hyps[i]))}
+                         for i in order]
+                sid = sent.feat.sid if sent.feat is not None else 0
+                text = plane.format_nbest(sid, nbest)
+                info["nbest"] = nbest
+            res.finished.append((sent.key, text))
+            res.finished_info[sent.key] = info
             self._evict(sent.key, adopt_text=text)
+        # streaming: the current BEST hypothesis per live sentence. A
+        # later round may rerank the beam, so a beam partial can
+        # retract earlier text — documented stream semantics (greedy
+        # partials are append-only; beam partials are best-so-far).
+        for sent in self._sents.values():
+            if sent.feat is not None and sent.feat.stream:
+                cur = self._best_hyp(sent)
+                res.partials.append(
+                    (sent.key,
+                     self.trg_vocab.decode(self._crop(cur),
+                                           ignore_eos=True),
+                     sent.t))
         self._recount_tokens()
         res.rows = live_rows
         res.bucket = rb
@@ -452,20 +624,28 @@ class PagedBeamEngine(PagedDecodeEngine):
         + partial-page forks. Returns the best hypothesis when the
         sentence finished (all frozen, or the cap reached)."""
         k = self.beam_size
-        V = len(self.trg_vocab)
         t = sent.t
+        # shortlisted rows emit COORDS; the host maps back to vocab ids
+        # here, exactly as the dense search does. The flat tie-break
+        # then ranks in coord space — the dense shortlisted flat top-k's
+        # own index space (EOS sits at coord 0 by construction).
+        sl = sent.feat.shortlist if sent.feat is not None else None
+        W = self.features.k_static if sl is not None \
+            else len(self.trg_vocab)
+        eos_flat = 0 if sl is not None else EOS_ID
         cands = []
         for h in sent.hyps:
             if h.finished:
                 # frozen {EOS: 0.0} candidate: score unchanged (the
                 # dense f32 add of 0.0 is the identity)
                 cands.append((np.float32(h.score),
-                              h.dense_pos * V + EOS_ID, EOS_ID, h))
+                              h.dense_pos * W + eos_flat, EOS_ID, h))
             else:
                 for j in range(k):
-                    tok = int(idx[h.slot, j])
+                    coord = int(idx[h.slot, j])
+                    tok = int(sl[coord]) if sl is not None else coord
                     cands.append((vals[h.slot, j],
-                                  h.dense_pos * V + tok, tok, h))
+                                  h.dense_pos * W + coord, tok, h))
         # dense flat top-k: value desc, flat index asc on ties
         cands.sort(key=lambda c: (-c[0], c[1]))
         children: List[_Hyp] = []
@@ -609,6 +789,76 @@ class PagedBeamEngine(PagedDecodeEngine):
         sent.hyps = children
         sent.t = next_pos
         return None
+
+    def _merge_sentence_sampled(self, sent: _Sent, vals, toks  # owns: caller -- boundary pages join the row's slot machinery; _release_row/_evict retable them away
+                                ) -> Optional[_Hyp]:
+        """Sampled beam step: k INDEPENDENT gumbel-max trajectories
+        (dense twin: sampled search keeps ``beam_idx`` = identity — no
+        cross-beam merge), so there is no reorder and therefore no COW
+        fork: each row appends its sampled token to its own lineage.
+        ``vals`` is the [rb] updated cumulative score, ``toks`` the
+        [rb] sampled token. Pages never alias across rows here, which
+        keeps the audit's write-target refcount-1 invariant trivially.
+        """
+        next_pos = sent.t + 1
+        for h in sent.hyps:
+            if h.slot is None:
+                continue
+            slot = h.slot
+            tok = int(toks[slot])
+            h.tokens = h.tokens + [tok]
+            h.score = np.float32(vals[slot])
+            h.length = next_pos
+            if tok == EOS_ID:
+                h.finished = True
+                self._release_row(sent, h)
+                continue
+            owner = self._owner(sent.key, slot)
+            if next_pos % self.page_len == 0 and next_pos < sent.cap:
+                # lazy page claim at the boundary — but not at the cap,
+                # where the row leaves this round and the page would
+                # never be written (a cap that is an exact page multiple
+                # would otherwise demand pages_for(cap)+1 > the row
+                # table's width). A dry pool raises PoolExhausted up to
+                # _step's retriable-evict handler (the prefix cache is
+                # off under sampling, so there is no cache pressure to
+                # relieve first).
+                self.pool.claim_extra(owner, 1)
+                pages = self.pool.pages_of(owner)
+                self._table[slot, :] = 0
+                self._table[slot, :len(pages)] = pages
+                with self._lock:
+                    self._slots[slot].expected_refs = len(pages)
+            with self._lock:
+                self._slots[slot].pos = next_pos
+            self._slot_pos[slot] = next_pos
+            self._slot_prev[slot] = tok
+            self._slot_score[slot] = float(h.score)
+        sent.t = next_pos
+        live = [h for h in sent.hyps if h.slot is not None]
+        if not live or next_pos >= sent.cap:
+            for h in live:
+                h.length = sent.cap
+                h.slot = None
+            return self._best_hyp(sent)
+        return None
+
+    def _release_row(self, sent: _Sent, h: _Hyp) -> None:
+        """Freeze a hypothesis out of the compiled step: drop its page
+        references and idle its device row (the slot itself stays held
+        by the sentence until the sentence leaves, as everywhere else).
+        """
+        slot = h.slot
+        self.pool.retable(self._owner(sent.key, slot), [])
+        self._table[slot, :] = 0
+        with self._lock:
+            st = self._slots[slot]
+            st.pos = 0
+            st.expected_refs = 0
+            self._slot_pos[slot] = -1
+            self._slot_prev[slot] = 0
+            self._slot_score[slot] = 0.0
+        h.slot = None
 
     # -- scoring (the dense search's collect math, in np.float32) -----------
     def _norm_score(self, h: _Hyp) -> np.float32:

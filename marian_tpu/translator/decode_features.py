@@ -1,0 +1,229 @@
+"""The per-row decode-feature plane for paged iteration serving (ISSUE 16).
+
+Request-mode decoding (beam_search.py) ships the full Marian decode
+surface — lexical shortlist, output sampling, n-best, force-decode —
+as PER-BATCH state: one shortlist per device batch, one sample key per
+search, one prefix matrix per dispatch. Iteration mode has no batches:
+rows join and leave a resident decode mid-flight, so every one of those
+features has to become PER-ROW state that rides in engine slots and is
+indexed into the compiled step alongside pos/prev/page_table.
+
+This module is that state:
+
+  FeaturePlane  — engine-wide configuration, parsed once from the same
+                  server options the dense path reads (--shortlist,
+                  --output-sampling, --n-best, --force-decode), so a
+                  flag means the same thing on both paths.  Validates
+                  the composition rules up front (see DECODE_SURFACE in
+                  server.py for the serving-side table).
+  RowFeatures   — one row's slice of the plane, built at JOIN: the
+                  row's shortlist index set (dense twin: the per-batch
+                  union `beam_search` slices the output GEMM with),
+                  its sampling RNG lane, and its forced target trunk.
+
+Parity contract with the dense twin, feature by feature:
+
+  shortlist    The row's index set is EXACTLY what the dense generator
+               produces for a single-sentence batch: sorted unique
+               union, EOS-padded to a multiple of k_multiple
+               (data/shortlist.py).  The engine pads every row to one
+               static K (so the compiled step has one shape) and masks
+               the coords past the row's true padded length to NEG_INF
+               *before* the (log_)softmax: exp(NEG_INF - max)
+               underflows to exact 0.0 in f32, so the normalizer — and
+               therefore every live coord's logp — is bitwise the
+               dense value.  Dense keeps its own EOS-pad duplicates
+               live inside its padded length, so ours stay live too.
+  sampling     Dense samples gumbel-max over logp/temperature with one
+               folded key per batch step.  Rows in an iteration engine
+               have no common step clock, so each row gets an RNG
+               *lane*: fold_in(fold_in(key(seed), lane), step) where
+               lane is the row's join ordinal.  Fixed seed + same join
+               schedule ⇒ identical output (the replay pin); two
+               identical requests in one engine sample differently
+               (distinct lanes), exactly as two dense batches do
+               (per-batch call counter).
+  n-best       Collected from the beam engine's existing hypothesis
+               bookkeeping and formatted through the SAME
+               OutputPrinter the dense driver uses — the n-best block
+               is byte-identical to request mode's.
+  force-decode The forced trunk masks logp to NEG_INF everywhere but
+               the forced token, which keeps its TRUE logp (dense:
+               beam_search's prefix gate) — scores of a forced decode
+               match the dense run.  A forced trunk is appended to the
+               prefix-cache key (prefix_cache.py is key-agnostic), so
+               repeated CAT/post-editing prefixes become COW forks and
+               exact replays, not conflicts.
+
+Composition rules (mirroring the dense path's refusals):
+  - shortlist + force-decode is refused: forced ids are full-vocab,
+    shortlist logits are not (beam_search.py refuses the same pair).
+  - sampling disables the prefix cache for the engine: a sampled decode
+    is not a function of the source, so replaying or forking it would
+    serve another request's dice roll as a cached "translation".
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.shortlist import parse_shortlist_options
+from .beam_search import _parse_sampling
+from .output_collector import OutputPrinter
+
+
+class RowFeatures:
+    """One decode row's feature state, built at JOIN, carried in the
+    engine slot beside pos/cap/tokens."""
+
+    __slots__ = ("shortlist", "sl_len", "forced", "lane", "stream", "sid")
+
+    def __init__(self, shortlist: Optional[np.ndarray] = None,
+                 sl_len: int = 0, forced: Optional[List[int]] = None,
+                 lane: int = 0, stream: bool = False, sid: int = 0):
+        self.shortlist = shortlist   # [k_static] int32 full-vocab ids
+        self.sl_len = sl_len         # the row's TRUE padded length (dense K)
+        self.forced = forced or []   # forced target trunk (full-vocab ids)
+        self.lane = lane             # sampling RNG lane (join ordinal)
+        self.stream = stream         # scheduler wants per-round partials
+        self.sid = sid               # request-local sentence id (n-best)
+
+    def forced_at(self, pos: int) -> int:
+        """Forced token at target position pos, -1 past the trunk."""
+        return self.forced[pos] if pos < len(self.forced) else -1
+
+
+class FeaturePlane:
+    """Engine-wide decode-feature configuration + per-row state factory.
+
+    Constructed once where the engine is built (server._engine_for, or a
+    test) from the same options namespace the dense Translate driver
+    reads; `row_features` is then called at every JOIN.
+    """
+
+    def __init__(self, shortlist_gen=None, sampling: tuple = (),
+                 seed: int = 1234, n_best: bool = False,
+                 force_decode: bool = False, k_static: int = 1024,
+                 printer: Optional[OutputPrinter] = None):
+        if shortlist_gen is not None and force_decode:
+            # dense twin refuses the same pair (beam_search.search_async:
+            # prefix ids are full-vocab, shortlist logits are not)
+            raise ValueError("--shortlist does not compose with "
+                             "--force-decode: forced prefix ids are "
+                             "full-vocab, shortlisted logits are not")
+        self.shortlist_gen = shortlist_gen
+        self.sampling = tuple(sampling or ())
+        self.seed = int(seed)
+        self.n_best = bool(n_best)
+        self.force_decode = bool(force_decode)
+        self.printer = printer
+        if self.n_best and self.printer is None:
+            raise ValueError("n_best FeaturePlane needs an OutputPrinter "
+                             "(use FeaturePlane.from_options)")
+        # ONE static K for the compiled step. Rows pad up to it with EOS
+        # (masked past their true length), rows whose union exceeds it
+        # are truncated — same escape hatch as the generator's max_k.
+        if shortlist_gen is not None:
+            mult = max(1, int(getattr(shortlist_gen, "k_multiple", 128)))
+            self.k_static = max(mult, -(-int(k_static) // mult) * mult)
+        else:
+            self.k_static = 0
+
+    # ---------------------------------------------------------- options
+    @classmethod
+    def from_options(cls, options, src_vocab, trg_vocab,
+                     k_static: int = 1024) -> Optional["FeaturePlane"]:
+        """Build the plane from a server/translator options namespace.
+        Returns None when no decode-surface feature is on, so engines
+        keep their exact pre-feature compiled step."""
+        gen = parse_shortlist_options(
+            options.get("shortlist", []) or [], src_vocab, trg_vocab)
+        sampling = _parse_sampling(options.get("output-sampling", None))
+        n_best = bool(options.get("n-best", False))
+        force = bool(options.get("force-decode", False))
+        if gen is None and not sampling and not n_best and not force:
+            return None
+        # same default-seed convention as BeamSearch
+        seed = int(options.get("seed", 0) or 0) or 1234
+        printer = OutputPrinter(options, trg_vocab) if n_best else None
+        return cls(shortlist_gen=gen, sampling=sampling, seed=seed,
+                   n_best=n_best, force_decode=force, k_static=k_static,
+                   printer=printer)
+
+    # ------------------------------------------------------------- rows
+    def split_forced(self, text: str, trg_vocab) -> Tuple[str, List[int]]:
+        """Split one request line into (source, forced target trunk).
+
+        Iteration serving's force-decode line convention is
+        ``source<TAB>target-prefix`` — the wire twin of the dense
+        driver's two --input files (source + prefix, translator.py).
+        No TAB (or an empty prefix) means unconstrained; the prefix is
+        encoded WITHOUT EOS so the hypothesis continues past it.
+        """
+        if not self.force_decode or "\t" not in text:
+            return text, []
+        src, _, pfx = text.partition("\t")
+        if not pfx.strip():
+            return src, []
+        return src, [int(t) for t in trg_vocab.encode(pfx, add_eos=False)]
+
+    def row_shortlist(self, src_ids: Sequence[int]
+                      ) -> Tuple[Optional[np.ndarray], int]:
+        """The row's shortlist: dense single-sentence union, EOS-padded
+        to its dense K (the row's live length), then to k_static."""
+        if self.shortlist_gen is None:
+            return None, 0
+        sl = self.shortlist_gen.generate(
+            np.unique(np.asarray(src_ids, np.int32)))  # mtlint: ok -- join-time host math over python int ids, no device array in sight
+        idx = np.asarray(sl.indices, np.int32)  # mtlint: ok -- same join-time host path; the generator returns np arrays
+        true_k = int(idx.shape[0])
+        if true_k > self.k_static:
+            idx, true_k = idx[:self.k_static], self.k_static
+        row = np.full((self.k_static,), int(idx[0]), np.int32)  # EOS pad
+        row[:true_k] = idx
+        return row, true_k
+
+    def row_features(self, src_ids: Sequence[int],
+                     forced: Optional[List[int]] = None, lane: int = 0,
+                     stream: bool = False, sid: int = 0) -> RowFeatures:
+        row, true_k = self.row_shortlist(src_ids)
+        return RowFeatures(shortlist=row, sl_len=true_k,
+                           forced=list(forced or []), lane=lane,
+                           stream=stream, sid=sid)
+
+    # ----------------------------------------------------- cache compose
+    def cache_key(self, src_key: tuple, forced: Sequence[int]) -> tuple:
+        """Prefix-cache / fork key for a row: the source token tuple,
+        salted with the forced trunk when one is present — a constrained
+        prefix IS a shareable trunk, but only among requests constrained
+        the same way."""
+        if forced:
+            return (src_key, ("forced",) + tuple(int(t) for t in forced))
+        return src_key
+
+    @property
+    def cacheable(self) -> bool:
+        """Sampling makes decodes non-deterministic functions of the
+        source — the prefix cache must not replay or fork them."""
+        return not self.sampling
+
+    # ------------------------------------------------------------ n-best
+    def format_nbest(self, sid: int, nbest: List[dict]) -> str:
+        """Format a finished row's ranked hypotheses through the SAME
+        OutputPrinter as the dense driver (byte-parity with request
+        mode's n-best block)."""
+        return self.printer.line(sid, nbest)
+
+    def describe(self) -> str:
+        on = []
+        if self.shortlist_gen is not None:
+            on.append(f"shortlist(k_static={self.k_static})")
+        if self.sampling:
+            on.append("sampling=" + "/".join(str(p) for p in self.sampling))
+        if self.n_best:
+            on.append("n-best")
+        if self.force_decode:
+            on.append("force-decode")
+        return "+".join(on) or "none"

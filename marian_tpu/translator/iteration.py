@@ -58,6 +58,7 @@ from ..data.vocab import EOS_ID
 from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, KVPool, PoolCorruption,
                                   PoolExhausted, ROW_BUCKETS, bucket_rows,
                                   pages_for_tokens)
+from .decode_features import RowFeatures
 from .prefix_cache import PrefixCache
 
 # continuous pool auditing: with MARIAN_POOL_AUDIT=1 every admit+step
@@ -105,6 +106,12 @@ class StepResult:
     # #trace reply-metadata row breakdown. Always populated (the reply
     # metadata is tracing-independent); tiny and rare, never per-token.
     row_events: List[Tuple[object, str, dict]] = field(default_factory=list)
+    # streaming (ISSUE 16): per-round partial target text for rows whose
+    # join meta asked for it — (key, text_so_far, tokens_so_far) for
+    # STILL-DECODING rows; a finishing row's last text arrives via
+    # ``finished`` as always. The serving scheduler fans these out to
+    # #stream: clients between rounds.
+    partials: List[Tuple[object, str, int]] = field(default_factory=list)
     # pool page traffic THIS round (deltas of KVPool.stats + the
     # engine's fork-copy count) — the serve.round span attrs and the
     # marian_serving_kv_pool_pages_*_total series read these
@@ -116,10 +123,10 @@ class StepResult:
 
 class _Slot:
     __slots__ = ("key", "tokens", "pos", "cap", "prev", "src_tokens",
-                 "expected_refs", "src_key")
+                 "expected_refs", "src_key", "feat")
 
     def __init__(self, key, cap: int, src_tokens: int,
-                 expected_refs: int = 0, src_key=None):
+                 expected_refs: int = 0, src_key=None, feat=None):
         self.key = key
         self.tokens: List[int] = []
         self.pos = 0                # next write position
@@ -131,6 +138,7 @@ class _Slot:
         # the row-exit leak check compares against it
         self.expected_refs = expected_refs
         self.src_key = src_key      # source id tuple (prefix-cache key)
+        self.feat = feat            # RowFeatures (decode_features.py)
 
 
 class PagedDecodeEngine:
@@ -138,6 +146,8 @@ class PagedDecodeEngine:
 
     # encode-at-join batch buckets (one compiled encoder shape per entry)
     JOIN_BUCKETS = (1, 2, 4, 8)
+    # n-best needs per-hypothesis score bookkeeping (PagedBeamEngine)
+    _SUPPORTS_NBEST = False
 
     def __init__(self, model, params, src_vocab, trg_vocab,
                  max_rows: int = 32,
@@ -149,7 +159,8 @@ class PagedDecodeEngine:
                  row_buckets: Sequence[int] = ROW_BUCKETS,
                  steps_per_round: int = 1,
                  registry=None,
-                 prefix_cache: Optional[PrefixCache] = None):
+                 prefix_cache: Optional[PrefixCache] = None,
+                 features=None):
         # the annotation is load-bearing beyond documentation: the
         # static callgraph types self.prefix from it, which is what
         # links the engine's claim sites to the cache's adopt/release
@@ -246,9 +257,26 @@ class PagedDecodeEngine:
         # reset at the top of admit_and_step, folded into res at its end)
         self._round_copied = 0
         self._metrics_declared = False
+        # per-row decode-feature plane (ISSUE 16, decode_features.py):
+        # None keeps the exact pre-feature compiled step signature
+        self.features = features
+        if features is not None and features.n_best \
+                and not self._SUPPORTS_NBEST:
+            raise ValueError("n-best needs beam bookkeeping — the server "
+                             "routes it to PagedBeamEngine (any beam "
+                             "size)")
+        # sampling RNG lane allocator: each admitted row gets the next
+        # ordinal, so a replayed join schedule replays its dice
+        self._lane_ctr = 0
         # cross-request prefix sharing (--prefix-cache; ISSUE 12):
         # engine-scoped — a hot swap builds a fresh engine with a fresh
         # cache, so stale-version pages are unreachable by construction
+        if features is not None and not features.cacheable \
+                and prefix_cache is not None:
+            log.info("iteration engine: --output-sampling disables the "
+                     "prefix cache (sampled decodes must not be "
+                     "replayed or forked)")
+            prefix_cache = None
         self.prefix = prefix_cache
 
         self._step_jit: Dict[int, object] = {}
@@ -363,6 +391,21 @@ class PagedDecodeEngine:
                 "the pressure-relief headroom admission already counts")
             m_recl.set_function(
                 lambda: self.prefix.reclaimable_pages(self.pool))
+        if self.features is not None \
+                and self.features.shortlist_gen is not None:
+            # lexical-shortlist series (ISSUE 16): how many rows decode
+            # through a sliced output GEMM and how wide their slices are
+            # — the operator's check that --shortlist actually shrinks
+            # the [rows, vocab] projection (PAPER.md's serving trick)
+            self.m_shortlist_rows = r.counter(
+                "marian_shortlist_rows_total",
+                "Decode rows admitted with a per-row lexical shortlist "
+                "(iteration mode)")
+            self.m_shortlist_width = r.histogram(
+                "marian_shortlist_width_tokens",
+                "Per-row shortlist width (the row's true padded index "
+                "count — the output GEMM runs at the engine's static K)",
+                buckets=(128, 256, 384, 512, 768, 1024, 2048, 4096))
         self._metrics_declared = True
 
     # -- capacity (any thread) ----------------------------------------------
@@ -475,9 +518,15 @@ class PagedDecodeEngine:
             self._evict(key)
         rows_before = self.active_rows()
         joiners: List[Tuple[object, List[int], int]] = []
-        for key, text in joins:
+        # joins arrive as (key, text) or (key, text, meta) — the meta
+        # dict carries serving-side per-row flags (stream, sid) the
+        # engine keys its feature plane off (ISSUE 16); 2-tuples keep
+        # every pre-feature caller working unchanged
+        for j in joins:
+            key, text = j[0], j[1]
+            meta = j[2] if len(j) > 2 else None
             why = self._try_claim(key, text, joiners, res.reject_detail,
-                                  res=res)
+                                  res=res, meta=meta)
             if why is None:
                 res.accepted.append(key)
             else:
@@ -529,7 +578,13 @@ class PagedDecodeEngine:
 
     def _try_claim(self, key, text: str, joiners: List,
                    detail: Optional[Dict[object, str]] = None,
-                   res: Optional[StepResult] = None) -> Optional[str]:
+                   res: Optional[StepResult] = None,
+                   meta: Optional[dict] = None) -> Optional[str]:
+        plane = self.features
+        forced: List[int] = []
+        if plane is not None and plane.force_decode:
+            # iteration force-decode line convention: source<TAB>prefix
+            text, forced = plane.split_forced(text, self.trg_vocab)
         ids = self.src_vocab.encode(text, add_eos=True, inference=True)
         if len(ids) > self.src_cap:
             if detail is not None:
@@ -538,6 +593,11 @@ class PagedDecodeEngine:
                                f"{self.src_cap} (raise --max-length)")
             return "src_too_long"
         src_key = tuple(int(i) for i in ids)
+        if plane is not None:
+            # a forced trunk salts the cache/fork key: a constrained
+            # prefix is a shareable trunk, but only among requests
+            # constrained the SAME way (decode_features.cache_key)
+            src_key = plane.cache_key(src_key, forced)
         # cross-request prefix sharing (ISSUE 12): an exact repeat of a
         # COMPLETED decode resolves instantly (greedy decode is
         # deterministic, so the cached tokens are bitwise what a cold
@@ -553,6 +613,26 @@ class PagedDecodeEngine:
                 self._count("prefix_hits")
                 return None
         cap = self.decode_cap(len(ids))
+        if forced:
+            # the cap must cover the forced trunk plus continuation
+            # headroom (dense twin: beam_search pads L to plen + 8)
+            if len(forced) + 8 > self.max_length_cap:
+                if detail is not None:
+                    detail[key] = (
+                        f"forced target prefix is {len(forced)} tokens "
+                        f"but the engine's decode cap is "
+                        f"{self.max_length_cap} (raise --max-length)")
+                return "too_large"
+            cap = min(self.max_length_cap, max(cap, len(forced) + 8))
+        stream = bool(meta.get("stream")) if meta else False
+        sid = int(meta.get("sid", 0)) if meta else 0
+        feat = None
+        if plane is not None:
+            feat = plane.row_features(ids, forced=forced,
+                                      lane=self._lane_ctr,
+                                      stream=stream, sid=sid)
+        elif stream or sid:
+            feat = RowFeatures(stream=stream, sid=sid)
         n_pages = pages_for_tokens(cap, self.page_len)
         if n_pages > self.pool.max_pages_per_row:
             if detail is not None:
@@ -567,9 +647,12 @@ class PagedDecodeEngine:
                 return "no_slot"
         if self.prefix is not None:
             forked = self._try_fork(key, src_key, cap, n_pages, len(ids),
-                                    res=res)
+                                    res=res, feat=feat)
             if forked is not None:
-                return None if forked else "no_pages"
+                if forked:
+                    self._row_admitted(feat)
+                    return None
+                return "no_pages"
             self.prefix.note_miss()
         try:
             pages = self._claim_pages(key, n_pages)
@@ -591,7 +674,7 @@ class PagedDecodeEngine:
             slot = next(i for i, s in enumerate(self._slots) if s is None)
             self._slots[slot] = _Slot(key, cap, len(ids),
                                       expected_refs=n_pages,
-                                      src_key=src_key)
+                                      src_key=src_key, feat=feat)
             self._by_key[key] = slot
             self._n_active += 1
         if self.prefix is not None:
@@ -601,7 +684,20 @@ class PagedDecodeEngine:
         self._table[slot, :] = 0
         self._table[slot, :len(pages)] = pages
         joiners.append((key, ids, slot))
+        self._row_admitted(feat)
         return None
+
+    def _row_admitted(self, feat) -> None:
+        """Post-admission feature bookkeeping: advance the sampling lane
+        allocator (so a replayed join schedule replays its lanes) and
+        feed the shortlist series."""
+        if self.features is None:
+            return
+        self._lane_ctr += 1
+        if feat is not None and feat.shortlist is not None:
+            if hasattr(self, "m_shortlist_rows"):
+                self.m_shortlist_rows.inc()
+                self.m_shortlist_width.observe(feat.sl_len)
 
     def _claim_pages(self, key, n: int):  # owns: caller -- the claim joins the engine's slot machinery; _evict gives it back
         """Fresh-page claim with prefix-cache pressure relief: when the
@@ -616,8 +712,8 @@ class PagedDecodeEngine:
             return self.pool.claim(key, n)
 
     def _try_fork(self, key, src_key, cap: int, n_pages: int,
-                  n_src: int, res: Optional[StepResult] = None
-                  ) -> Optional[bool]:
+                  n_src: int, res: Optional[StepResult] = None,
+                  feat=None) -> Optional[bool]:
         """Copy-on-write fork from a LIVE row with the same source:
         alias its full (append-only) pages with refcount++, content-copy
         only its current partial page, copy its cross-attention rows
@@ -662,7 +758,8 @@ class PagedDecodeEngine:
         with self._lock:
             slot = next(i for i, s in enumerate(self._slots) if s is None)
             s = _Slot(key, cap, n_src,
-                      expected_refs=n_full + own_needed, src_key=src_key)
+                      expected_refs=n_full + own_needed, src_key=src_key,
+                      feat=feat)
             s.tokens = toks_l
             s.pos = pos_l
             s.prev = prev_l
@@ -1087,34 +1184,125 @@ class PagedDecodeEngine:
         model = self.model
         k_steps = self.steps_per_round
         row_keys, pool_keys, whole_keys = self._state_key_groups()
+        # feature plane (ISSUE 16): which per-row extras this engine's
+        # compiled step takes is STATIC — a plane-less engine keeps the
+        # exact pre-feature jit signature and computation
+        plane = self.features
+        has_sl = plane is not None and plane.shortlist_gen is not None
+        sampling = tuple(plane.sampling) if plane is not None else ()
+        has_force = plane is not None and plane.force_decode
+        temp = max(float(sampling[-1]), 1e-6) if sampling else 1.0
+        topn = int(sampling[1]) if sampling and sampling[0] == "topk" \
+            else 0
+        seed = int(plane.seed) if plane is not None else 0
+        from .beam_search import NEG_INF
 
-        def step(state, src_mask, params, prev, pos, table):
+        def step(state, src_mask, params, prev, pos, table, *extras):
             # row-indexed leaves run at the bucket prefix; pools and
             # beam-invariant leaves (lsh) stay whole
             sub = {k: state[k][:rb] for k in row_keys}
             for k in whole_keys:
                 sub[k] = state[k]
             sm = src_mask[:rb]
+            # positional extras, in feature order (host side: _step)
+            it = iter(extras)
+            sl = next(it) if has_sl else None          # [rb, K] full ids
+            sl_len = next(it) if has_sl else None      # [rb] true width
+            lane = next(it) if sampling else None      # [rb] RNG lane
+            ctr = next(it) if sampling else None       # [rb] step counter
+            forced = next(it) if has_force else None   # [rb, k_steps]
 
-            def body(carry, _):
+            def body(carry, j):
                 pools, prev_t, pos_t = carry
                 st = dict(sub)
                 st.update(pools)
                 st["pos"] = pos_t
                 st["page_table"] = table
-                logits, new_sub = model.step(params, st, prev_t, sm)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                logits, new_sub = model.step(params, st, prev_t, sm,
+                                             shortlist=sl)
+                if has_sl:
+                    # coords past the row's true (dense-padded) width
+                    # are engine padding, not the dense twin's — mask
+                    # them out of the argmax/softmax
+                    coords = jnp.arange(logits.shape[-1])[None, :]
+                    logits = jnp.where(coords < sl_len[:, None],
+                                       logits, NEG_INF)
+                if sampling:
+                    # gumbel-max over logp/temperature, one folded RNG
+                    # lane per row (dense twin: beam_search's sampled
+                    # top-k; lanes replace the per-batch call counter)
+                    lp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32), axis=-1)
+                    slp = lp / temp
+                    if topn:
+                        kth = jax.lax.top_k(slp, topn)[0][..., -1:]
+                        slp = jnp.where(slp < kth, NEG_INF, slp)
+                    keys = jax.vmap(lambda l, c: jax.random.fold_in(
+                        jax.random.fold_in(jax.random.key(seed), l),
+                        c))(lane, ctr + j)
+                    g = jax.vmap(lambda kk: jax.random.gumbel(
+                        kk, slp.shape[-1:], jnp.float32))(keys)
+                    nxt = jnp.argmax(slp + g, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if has_sl:
+                    # shortlist coords → full-vocab ids ON DEVICE: the
+                    # next scan step embeds this token, so the map-back
+                    # cannot wait for the host
+                    nxt = jnp.take_along_axis(
+                        sl, nxt[:, None], axis=1)[:, 0]
+                if has_force:
+                    f = forced[:, j]
+                    nxt = jnp.where(f >= 0, f, nxt)
                 new_pools = {k: new_sub[k] for k in pool_keys}
                 return (new_pools, nxt[:, None], pos_t + 1), nxt
 
             init = ({k: state[k] for k in pool_keys}, prev, pos)
-            (pools, _, _), toks = jax.lax.scan(body, init, None,
-                                               length=k_steps)
+            (pools, _, _), toks = jax.lax.scan(
+                body, init, jnp.arange(k_steps))
             new_state = dict(state)
             new_state.update(pools)
             return toks, new_state          # toks [k_steps, rb]
 
         return jax.jit(step, donate_argnums=(0,))
+
+    def _feature_args(self, rb: int) -> Tuple[object, ...]:
+        """Per-row feature arrays for the compiled step, in the extras
+        order _make_step unpacks. Idle rows get neutral values (full
+        width, lane 0, unconstrained) — their outputs are discarded."""
+        plane = self.features
+        if plane is None:
+            return ()
+        extras: List[object] = []
+        if plane.shortlist_gen is not None:
+            k = plane.k_static
+            sl_np = np.zeros((rb, k), np.int32)
+            len_np = np.full((rb,), k, np.int32)
+            for i in range(rb):
+                s = self._slots[i]
+                if s is not None and s.feat is not None \
+                        and s.feat.shortlist is not None:
+                    sl_np[i, :] = s.feat.shortlist
+                    len_np[i] = s.feat.sl_len
+            extras += [jnp.asarray(sl_np), jnp.asarray(len_np)]
+        if plane.sampling:
+            lane_np = np.zeros((rb,), np.int32)
+            ctr_np = np.zeros((rb,), np.int32)
+            for i in range(rb):
+                s = self._slots[i]
+                if s is not None and s.feat is not None:
+                    lane_np[i] = s.feat.lane
+                    ctr_np[i] = s.pos
+            extras += [jnp.asarray(lane_np), jnp.asarray(ctr_np)]
+        if plane.force_decode:
+            forced_np = np.full((rb, self.steps_per_round), -1, np.int32)
+            for i in range(rb):
+                s = self._slots[i]
+                if s is not None and s.feat is not None and s.feat.forced:
+                    for j in range(self.steps_per_round):
+                        forced_np[i, j] = s.feat.forced_at(s.pos + j)
+            extras.append(jnp.asarray(forced_np))
+        return tuple(extras)
 
     def _step(self, res: StepResult) -> None:
         # the occupied prefix, rounded up to a compiled row bucket
@@ -1134,7 +1322,7 @@ class PagedDecodeEngine:
         toks_dev, self._state = fn(
             self._state, self._src_mask, self.params,
             jnp.asarray(prev_np), jnp.asarray(pos_np),
-            jnp.asarray(self._table[:rb]))
+            jnp.asarray(self._table[:rb]), *self._feature_args(rb))
         # the per-round host sync IS the design: the join/evict schedule
         # runs on the host between rounds (the serving scheduler's
         # iteration loop), so each round's tokens must land host-side
@@ -1176,6 +1364,16 @@ class PagedDecodeEngine:
             text = self.trg_vocab.decode(s.tokens, ignore_eos=True)
             res.finished.append((s.key, text))
             self._evict(s.key, adopt_text=text)
+        # streaming rows still decoding emit their text-so-far each
+        # round (#stream:, ISSUE 16); finishing rows already delivered
+        # their final text above
+        for i in range(rb):
+            s = self._slots[i]
+            if s is not None and s.feat is not None and s.feat.stream:
+                res.partials.append(
+                    (s.key,
+                     self.trg_vocab.decode(s.tokens, ignore_eos=True),
+                     s.pos))
         res.rows = emitted
         res.bucket = rb
         res.tokens = consumed

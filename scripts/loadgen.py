@@ -96,6 +96,15 @@ def make_trace_id(i: int) -> str:
 
 PRIORITY_PREFIX = "#priority:"
 
+# streaming (ISSUE 16): --stream sends the `#stream:1` header; the
+# server then delivers `#partial:<idx> <text>` frames as the decode
+# progresses, before the normal final reply frame. The client-side
+# time-to-first-token (send → first partial) is reported next to ttfj;
+# against a non-streaming server no partial ever arrives and the ttft
+# columns are NaN-suppressed, mirroring the pool%/cow% convention.
+STREAM_PREFIX = "#stream:"
+PARTIAL_PREFIX = "#partial:"
+
 RETRY_CAP_S = 2.0       # backoff ceiling per attempt
 
 
@@ -113,16 +122,18 @@ async def send_with_retries(request_fn, host: str, port: int, text: str,
     """Send one request, honoring the server's retriable ``!!SERVER-
     RETRY`` reply (watchdog trip, quiesce-deadline or brownout row
     eviction — ISSUE 11) with capped jittered backoff. Returns
-    ``(final_reply, n_retries)`` where n_retries counts the RETRY
-    replies received (== resends attempted when the budget allows);
-    with ``retries=0`` (the default) behavior is exactly the old
-    single-shot send."""
+    ``(final_reply, n_retries, ttft_s)`` where n_retries counts the
+    RETRY replies received (== resends attempted when the budget
+    allows) and ttft_s is the streaming time-to-first-token of the
+    FINAL attempt (None without --stream or against a non-streaming
+    server); with ``retries=0`` (the default) behavior is exactly the
+    old single-shot send."""
     n_retries = 0
     while True:
-        reply = await request_fn(host, port, text)
+        reply, ttft = await request_fn(host, port, text)
         _, body = split_reply_meta(reply)
         if not body.startswith("!!SERVER-RETRY") or n_retries >= retries:
-            return reply, n_retries
+            return reply, n_retries, ttft
         await asyncio.sleep(retry_backoff_s(n_retries, base_s))
         n_retries += 1
 
@@ -153,26 +164,46 @@ def split_reply_meta(reply: str):
 # transports
 # ---------------------------------------------------------------------------
 
-async def _request_tcp(host: str, port: int, text: str) -> str:
+async def _request_tcp(host: str, port: int, text: str):
+    """(final_reply, ttft_s | None). With --stream the server sends
+    `#partial:` frames before the final reply; the first one stamps the
+    client-side time-to-first-token. A non-streaming reply is one
+    frame, exactly the old protocol."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
         payload = text.encode("utf-8")
+        t_send = time.perf_counter()
         writer.write(b"MTPU %d\n" % len(payload) + payload)
         await writer.drain()
-        header = await reader.readline()
-        if not header.startswith(b"MTPU "):
-            raise RuntimeError(f"bad reply frame: {header!r}")
-        return (await reader.readexactly(
-            int(header.split()[1]))).decode("utf-8")
+        ttft = None
+        while True:
+            header = await reader.readline()
+            if not header.startswith(b"MTPU "):
+                raise RuntimeError(f"bad reply frame: {header!r}")
+            frame = (await reader.readexactly(
+                int(header.split()[1]))).decode("utf-8")
+            if frame.startswith(PARTIAL_PREFIX):
+                if ttft is None:
+                    ttft = time.perf_counter() - t_send
+                continue
+            return frame, ttft
     finally:
         writer.close()
 
 
-async def _request_ws(host: str, port: int, text: str) -> str:
+async def _request_ws(host: str, port: int, text: str):
     import websockets
     async with websockets.connect(f"ws://{host}:{port}") as ws:
+        t_send = time.perf_counter()
         await ws.send(text)
-        return await ws.recv()
+        ttft = None
+        while True:
+            frame = await ws.recv()
+            if isinstance(frame, str) and frame.startswith(PARTIAL_PREFIX):
+                if ttft is None:
+                    ttft = time.perf_counter() - t_send
+                continue
+            return frame, ttft
 
 
 # ---------------------------------------------------------------------------
@@ -252,7 +283,23 @@ def request_text(args, i: int, words: int) -> str:
     pool (exact repeats across the run) — the traffic shape the
     server's --prefix-cache turns into page-table hits. Deterministic
     per request index, so A/B runs (cold vs warm cache) see identical
-    traffic and must produce identical translations."""
+    traffic and must produce identical translations. With --force-mix F
+    (checked first), a fraction F are ``source<TAB>prefix`` force-decode
+    lines from the same pool — exact (source, trunk) repeats for
+    --force-decode + --prefix-cache servers."""
+    f = float(getattr(args, "force_mix", 0.0) or 0.0)
+    if f > 0.0:
+        u = ((i * 69069 + 1) % 1000) / 1000.0
+        if u < f:
+            # force-decode lines (ISSUE 16): source<TAB>target-prefix,
+            # both drawn from the shared pool so (source, trunk) pairs
+            # repeat exactly — a --prefix-cache server shares/replays
+            # the constrained trunk (the /poolz "forced" cache keys)
+            j = i % PREFIX_POOL
+            return "\n".join(
+                make_sentence(991, j, s, words) + "\t"
+                + make_sentence(991, j, s, 2)
+                for s in range(args.sentences))
     p = float(getattr(args, "prefix_mix", 0.0) or 0.0)
     if p > 0.0:
         u = ((i * 1103515245 + 12345) % 1000) / 1000.0
@@ -266,7 +313,9 @@ def request_text(args, i: int, words: int) -> str:
 
 def _apply_headers(args, text: str, i: int) -> str:
     """Stack the protocol headers this run asked for: #trace outermost
-    (the server strips it first), then #priority."""
+    (the server strips it first), then #priority, then #stream."""
+    if getattr(args, "stream", False):
+        text = f"{STREAM_PREFIX}1\n" + text
     if getattr(args, "priority", None) is not None:
         text = f"{PRIORITY_PREFIX}{args.priority}\n" + text
     if not args.no_trace:
@@ -287,7 +336,7 @@ async def run_clients(args, request_fn):
             text = _apply_headers(args, text, cid * args.requests + r)
             t0 = time.perf_counter()
             try:
-                reply, _ = await send_with_retries(
+                reply, _, _ = await send_with_retries(
                     request_fn, args.host, args.port, text,
                     args.retries, args.retry_base_ms / 1e3)
             except Exception as e:  # noqa: BLE001
@@ -331,9 +380,11 @@ async def run_stream(args, request_fn, rate=None, duration=None,
                      pool_samples=None):
     """Fire requests at a constant --rate for --duration seconds, start
     times fixed by the schedule (open loop). Returns
-    [(t_start_rel, latency_s, kind, queue_s, service_s)] with kind in
-    ok/overloaded/timeout/retry/other; queue_s/service_s are None
-    without reply metadata (--no-trace). NOTE: the #trace header is an
+    [(t_start_rel, latency_s, kind, queue_s, service_s, n_retries,
+    ttft_s)] with kind in ok/overloaded/timeout/retry/other;
+    queue_s/service_s are None without reply metadata (--no-trace);
+    ttft_s is the streaming time-to-first-token (None without --stream
+    or when the server sent no partials). NOTE: the #trace header is an
     extension of THIS repo's server — against a server without it, the
     header line would be translated as an extra sentence; pass
     --no-trace there.
@@ -362,12 +413,12 @@ async def run_stream(args, request_fn, rate=None, duration=None,
             # deadline, brownout, watchdog) is resent with capped
             # jittered backoff; the measured latency is the CLIENT-
             # VISIBLE one, backoff included
-            reply, n_retries = await send_with_retries(
+            reply, n_retries, ttft = await send_with_retries(
                 request_fn, args.host, args.port, text,
                 args.retries, args.retry_base_ms / 1e3)
         except Exception as e:  # noqa: BLE001
             results.append((rel, time.perf_counter() - t, "other",
-                            None, None, 0))
+                            None, None, 0, None))
             if args.verbose:
                 print(f"req {i}: {e}", file=sys.stderr)
             return
@@ -384,7 +435,7 @@ async def run_stream(args, request_fn, rate=None, duration=None,
         results.append((rel, dt, kind,
                         meta.get("queue_s") if meta else None,
                         meta.get("service_s") if meta else None,
-                        n_retries))
+                        n_retries, ttft))
 
     t0 = time.perf_counter()
 
@@ -549,12 +600,18 @@ def report_windows(results, window_s: float, pool_samples=None) -> None:
     # the --retries budget
     have_retries = any(len(r) > 5 and (r[5] or r[2] == "retry")
                        for r in results)
+    # ttft columns only when at least one request saw a #partial: frame
+    # (a non-streaming server, or a run without --stream, sends none —
+    # all-None suppresses them, mirroring the pool%/cow% convention)
+    have_ttft = any(len(r) > 6 and r[6] is not None for r in results)
     hdr = (f"{'window':>12} {'req':>5} {'ok':>5} {'shed':>5} {'err':>5} "
            f"{'p50_ms':>8} {'p99_ms':>8} {'max_ms':>8}")
     if have_retries:
         hdr += f" {'retry':>6}"
     if have_meta:
         hdr += f" {'q_p50':>7} {'q_p99':>7} {'svc_p50':>7} {'svc_p99':>7}"
+    if have_ttft:
+        hdr += f" {'ttft50':>7} {'ttft99':>7}"
     if have_pool:
         hdr += f" {'pool%':>6} {'cow%':>6}"
     print(hdr)
@@ -567,6 +624,16 @@ def report_windows(results, window_s: float, pool_samples=None) -> None:
         print(f"time-to-first-join p50={pct(ttfj, 0.50) * 1e3:.1f}ms "
               f"p99={pct(ttfj, 0.99) * 1e3:.1f}ms "
               f"max={max(ttfj) * 1e3:.1f}ms")
+    if have_ttft:
+        # time-to-first-TOKEN: client-side stamp at the first #partial:
+        # frame of the FINAL (successful) attempt — the streaming
+        # latency a user actually perceives, ttfj + one engine round
+        ttft = [r[6] for r in results
+                if len(r) > 6 and r[6] is not None and r[2] == "ok"]
+        if ttft:
+            print(f"time-to-first-token p50={pct(ttft, 0.50) * 1e3:.1f}ms "
+                  f"p99={pct(ttft, 0.99) * 1e3:.1f}ms "
+                  f"max={max(ttft) * 1e3:.1f}ms")
     for w in range(n_windows):
         rows = [r for r in results
                 if w * window_s <= r[0] < (w + 1) * window_s]
@@ -591,6 +658,14 @@ def report_windows(results, window_s: float, pool_samples=None) -> None:
                      f" {pct(qs, 0.99) * 1e3:>7.1f}"
                      f" {pct(ss, 0.50) * 1e3:>7.1f}"
                      f" {pct(ss, 0.99) * 1e3:>7.1f}")
+        if have_ttft:
+            ts = [r[6] for r in rows
+                  if len(r) > 6 and r[6] is not None and r[2] == "ok"]
+            if ts:
+                line += (f" {pct(ts, 0.50) * 1e3:>7.1f}"
+                         f" {pct(ts, 0.99) * 1e3:>7.1f}")
+            else:
+                line += f" {'-':>7} {'-':>7}"
         if have_pool:
             ws = [s for s in pool_samples
                   if w * window_s <= s[0] < (w + 1) * window_s]
@@ -647,6 +722,16 @@ def main(argv=None) -> int:
                          "identical traffic; with --metrics-port the "
                          "summary adds the server's prefix hit rate, "
                          "tokens saved and pages reused")
+    ap.add_argument("--force-mix", type=float, default=0.0,
+                    help="fraction of requests sent as force-decode "
+                         "lines ('source<TAB>target-prefix', ISSUE 16 "
+                         "iteration servers with --force-decode), "
+                         "drawn from the same small shared pool as "
+                         "--prefix-mix so a --prefix-cache server "
+                         "sees exact (source, forced-trunk) repeats — "
+                         "the traffic shape that makes constrained "
+                         "prefixes share pages. Deterministic per "
+                         "request index")
     ap.add_argument("--sweep", default="",
                     help="capacity mode (ISSUE 9 / ROADMAP 4): comma-"
                          "separated offered rates in req/s (e.g. "
@@ -673,6 +758,15 @@ def main(argv=None) -> int:
                          "the '#priority:N' protocol header (this "
                          "repo's server; brownout level 3 sheds lanes "
                          "below --brownout-min-priority first)")
+    ap.add_argument("--stream", action="store_true",
+                    help="send the '#stream:1' protocol header (this "
+                         "repo's server, iteration mode): the server "
+                         "pushes '#partial:<idx> <text>' frames per "
+                         "engine round before the final reply; the "
+                         "client stamps time-to-first-token at the "
+                         "first partial and reports ttft p50/p99 next "
+                         "to ttfj (columns suppressed when no partials "
+                         "arrive, e.g. a request-mode server)")
     ap.add_argument("--verbose", action="store_true",
                     help="print per-request transport errors")
     ap.add_argument("--no-trace", action="store_true",

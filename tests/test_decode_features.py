@@ -1,0 +1,568 @@
+"""ISSUE 16: the per-row decode-feature plane + streaming delivery.
+
+Per-feature token-parity pins vs the dense request-mode twin
+(translator/beam_search.py): lexical shortlist, fixed-seed sampling
+determinism + replay, n-best, force-decode (incl. the prefix-cache
+COW-fork case), plus the #stream: delivery path (engine partials,
+scheduler fan-out + ttft, server e2e) and the decode-surface validation
+table (an UNCLASSIFIED set flag must refuse loudly — no flag may
+silently fall through to wrong output)."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.data.shortlist import LexicalShortlistGenerator
+from marian_tpu.data.vocab import DefaultVocab, EOS_ID
+from marian_tpu.serving import metrics as msm
+from marian_tpu.serving.promlint import lint_metrics_text
+from marian_tpu.serving.scheduler import ContinuousScheduler
+from marian_tpu.translator.beam_iteration import PagedBeamEngine
+from marian_tpu.translator.beam_search import (BeamConfig, BeamSearch,
+                                               beam_search_jit)
+from marian_tpu.translator.decode_features import FeaturePlane
+from marian_tpu.translator.iteration import PagedDecodeEngine
+from marian_tpu.translator.prefix_cache import PrefixCache
+
+from tests.test_beam_search import tiny_model
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_witness(lockdep_witness):
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _ownership_witness(ownership_witness):
+    """Feature rows ride the same claim/share/retable handoffs the
+    ownership witness audits; the plane must not mint new pairings."""
+    yield
+
+
+VOCAB_WORDS = [" ".join(f"w{i}" for i in range(35))]
+TEXTS = ["w3 w4 w5", "w6 w7", "w8 w9 w10 w11", "w2 w3"]
+K = 2
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    vocab = DefaultVocab.build(VOCAB_WORDS)
+    model, params, _ = tiny_model(vocab=len(vocab), seed=7,
+                                  **{"dec-depth": 2, "enc-depth": 2})
+    return model, params, vocab
+
+
+@pytest.fixture(scope="module")
+def sl_gen(tiny, tmp_path_factory):
+    """A small REAL lexical table: every source word maps to 6 clustered
+    target ids, so a sentence's union is a strict subset of the vocab
+    and k_multiple=8 keeps the padded widths small."""
+    _, _, vocab = tiny
+    n = len(vocab)
+    srcs, trgs, probs = [], [], []
+    for s in range(2, n):
+        for j in range(6):
+            srcs.append(s)
+            trgs.append(2 + (s * 5 + j * 3) % (n - 2))
+            probs.append(1.0 / (j + 1))
+    path = tmp_path_factory.mktemp("sl") / "lex.npz"
+    np.savez(path, srcs=np.array(srcs, np.int32),
+             trgs=np.array(trgs, np.int32),
+             probs=np.array(probs, np.float32))
+    return LexicalShortlistGenerator(str(path), vocab, vocab,
+                                     first=4, best=6, k_multiple=8)
+
+
+def make_greedy(tiny, registry=None, prefix=None, features=None, **kw):
+    model, params, vocab = tiny
+    args = dict(max_rows=4, page_len=4, src_len_cap=8,
+                max_length_cap=12, registry=registry,
+                prefix_cache=prefix, features=features)
+    args.update(kw)
+    return PagedDecodeEngine(model, params, vocab, vocab, **args)
+
+
+def make_beam(tiny, registry=None, prefix=None, features=None, **kw):
+    model, params, vocab = tiny
+    args = dict(beam_size=K, normalize=0.6, max_rows=2 * K, page_len=4,
+                src_len_cap=8, max_length_cap=12, registry=registry,
+                prefix_cache=prefix, features=features)
+    args.update(kw)
+    return PagedBeamEngine(model, params, vocab, vocab, **args)
+
+
+def drive(eng, texts, metas=None):
+    """Decode texts through the slot machinery, retrying deferred and
+    pool-evicted sentences; returns (texts-by-key, info-by-key)."""
+    outs, infos = {}, {}
+    pending = list(enumerate(texts))
+    guard = 0
+    while pending or not eng.idle():
+        joins = []
+        while pending and len(joins) < max(1, eng.free_slots()):
+            key, text = pending.pop(0)
+            if metas is not None:
+                joins.append((key, text, metas[key]))
+            else:
+                joins.append((key, text))
+        res = eng.admit_and_step(joins)
+        for key, why in res.rejected:
+            assert why in ("no_slot", "no_pages"), (key, why)
+            pending.insert(0, (key, texts[key]))
+        for key in res.pool_evicted:
+            pending.insert(0, (key, texts[key]))
+        outs.update(dict(res.finished))
+        infos.update(res.finished_info)
+        guard += 1
+        assert guard < 1000, "decode failed to converge"
+    assert eng.audit(context="test") == []
+    return outs, infos
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _dense_nbest(tiny, text, beam=K, normalize=0.6, shortlist=None,
+                 forced=None):
+    """The dense request-mode twin: one sentence through beam_search_jit
+    with the engine's own cap rule, returning ranked (tokens, score)
+    with EOS cropped — what drive()'s infos should reproduce."""
+    model, params, vocab = tiny
+    ids = vocab.encode(text, add_eos=True, inference=True)
+    L = int(min(12, max(8, round(3.0 * len(ids)))))
+    pfx = None
+    if forced:
+        L = max(L, min(12, len(forced) + 8))
+        pfx = np.full((1, L), -1, np.int32)
+        pfx[0, :len(forced)] = forced
+        pfx = jnp.asarray(pfx)
+    cfg = BeamConfig(beam_size=beam, normalize=normalize, max_length=L)
+    src = jnp.asarray(np.array([ids], np.int32))
+    mask = jnp.ones((1, len(ids)), jnp.float32)
+    sl_idx = jnp.asarray(shortlist.indices) if shortlist is not None \
+        else None
+    toks, scores, lengths, norm, _, _ = beam_search_jit(
+        model, [params], [1.0], cfg, src, mask, sl_idx, prefix=pfx)
+    toks, scores, lengths, norm = map(
+        np.asarray, (toks, scores, lengths, norm))
+    order = np.argsort(-norm[0], kind="stable")
+    out = []
+    for j in order:
+        ln = int(lengths[0, j])
+        tl = toks[0, j, :ln].tolist()
+        if tl and tl[-1] == EOS_ID:
+            tl = tl[:-1]
+        out.append((tl, float(scores[0, j]), float(norm[0, j])))
+    return out
+
+
+def _crop_eos(tokens, length):
+    tl = list(tokens[:length])
+    if tl and tl[-1] == EOS_ID:
+        tl = tl[:-1]
+    return tl
+
+
+# ---------------------------------------------------------------------------
+# the plane itself
+# ---------------------------------------------------------------------------
+
+class TestFeaturePlane:
+    def test_from_options_none_without_features(self, tiny):
+        _, _, vocab = tiny
+        assert FeaturePlane.from_options(
+            Options({"beam-size": 2}), vocab, vocab) is None
+
+    def test_from_options_parses_features_and_seed(self, tiny):
+        _, _, vocab = tiny
+        p = FeaturePlane.from_options(
+            Options({"output-sampling": ["topk", "5", "0.7"],
+                     "n-best": True, "beam-size": 2}), vocab, vocab)
+        assert p.sampling == ("topk", 5, 0.7)
+        assert p.n_best and p.printer is not None
+        assert p.seed == 1234          # dense twin's default-seed rule
+        assert not p.cacheable         # sampling forbids replay/fork
+
+    def test_shortlist_refuses_force_decode(self, sl_gen):
+        with pytest.raises(ValueError, match="force-decode"):
+            FeaturePlane(shortlist_gen=sl_gen, force_decode=True)
+
+    def test_split_forced_tab_convention(self, tiny):
+        _, _, vocab = tiny
+        p = FeaturePlane(force_decode=True)
+        src, forced = p.split_forced("w3 w4\tw5 w6", vocab)
+        assert src == "w3 w4"
+        assert forced == [int(t) for t in
+                          vocab.encode("w5 w6", add_eos=False)]
+        assert p.split_forced("w3 w4", vocab) == ("w3 w4", [])
+        assert p.split_forced("w3 w4\t ", vocab) == ("w3 w4", [])
+
+    def test_cache_key_salted_by_forced_trunk(self):
+        p = FeaturePlane(force_decode=True)
+        base = (3, 4, 0)
+        assert p.cache_key(base, []) == base
+        assert p.cache_key(base, [5, 6]) != base
+        assert p.cache_key(base, [5, 6]) == p.cache_key(base, [5, 6])
+        assert p.cache_key(base, [5, 6]) != p.cache_key(base, [5, 7])
+
+
+# ---------------------------------------------------------------------------
+# shortlist: token parity vs the dense shortlisted beam search
+# ---------------------------------------------------------------------------
+
+class TestShortlistParity:
+    def test_greedy_token_parity_vs_dense(self, tiny, sl_gen):
+        """Greedy engine rows decode in shortlist coords and map back —
+        tokens must equal the dense beam-1 search over the SAME
+        per-sentence shortlist (beam-1 == greedy; normalization cannot
+        reorder a single hypothesis). The same drive also pins
+        containment: every emitted token is inside the row's shortlist
+        (one engine build covers both — jit compiles dominate tier-1)."""
+        _, _, vocab = tiny
+        plane = FeaturePlane(shortlist_gen=sl_gen, k_static=24)
+        outs, _ = drive(make_greedy(tiny, features=plane), TEXTS)
+        for i, t in enumerate(TEXTS):
+            ids = vocab.encode(t, add_eos=True, inference=True)
+            sl = sl_gen.generate(np.unique(np.asarray(ids, np.int32)))
+            tl, _, _ = _dense_nbest(tiny, t, beam=1, normalize=0.0,
+                                    shortlist=sl)[0]
+            assert outs[i] == vocab.decode(tl), (i, outs[i])
+            allowed = set(sl.indices.tolist())
+            got = set(int(x) for x in
+                      vocab.encode(outs[i], add_eos=False)) \
+                if outs[i] else set()
+            assert got <= allowed, (i, got - allowed)
+
+    def test_beam_token_parity_vs_dense(self, tiny, sl_gen):
+        """COW beam engine with per-row shortlists vs the dense
+        shortlisted beam search: identical winning tokens."""
+        _, _, vocab = tiny
+        plane = FeaturePlane(shortlist_gen=sl_gen, k_static=24)
+        _, infos = drive(make_beam(tiny, features=plane), TEXTS[:2])
+        for i, t in enumerate(TEXTS[:2]):
+            ids = vocab.encode(t, add_eos=True, inference=True)
+            sl = sl_gen.generate(np.unique(np.asarray(ids, np.int32)))
+            tl, score, _ = _dense_nbest(tiny, t, shortlist=sl)[0]
+            mine = infos[i]
+            assert _crop_eos(mine["tokens"], mine["length"]) == tl, (i, t)
+            assert abs(mine["score"] - score) < 1e-4
+
+    def test_shortlist_metrics_census(self, tiny, sl_gen):
+        reg = msm.Registry()
+        plane = FeaturePlane(shortlist_gen=sl_gen, k_static=24)
+        eng = make_greedy(tiny, registry=reg, features=plane)
+        drive(eng, TEXTS[:2])
+        text = reg.render()
+        assert "marian_shortlist_rows_total" in text
+        assert "marian_shortlist_width_tokens" in text
+        assert reg.get("marian_shortlist_rows_total").value >= 2
+        assert lint_metrics_text(text) == []
+
+
+# ---------------------------------------------------------------------------
+# sampling: fixed-seed determinism + replay, lanes, cache interaction
+# ---------------------------------------------------------------------------
+
+class TestSampling:
+    def test_fixed_seed_replay_greedy(self, tiny):
+        """Fixed seed + same join schedule ⇒ identical sampled output
+        across FRESH engines (per-row lane + per-step counter keys,
+        nothing hidden in engine lifetime)."""
+        def one_run():
+            plane = FeaturePlane(sampling=("full", 1.0), seed=77)
+            return drive(make_greedy(tiny, features=plane), TEXTS[:2])[0]
+        a, b = one_run(), one_run()
+        assert a == b
+
+    def test_fixed_seed_replay_beam_sampled(self, tiny):
+        """Sampled beam: every hypothesis is an independent trajectory
+        on its own lane (feat.lane + j); replay is exact."""
+        def one_run():
+            plane = FeaturePlane(sampling=("topk", 5, 0.8), seed=31)
+            _, infos = drive(make_beam(tiny, features=plane), TEXTS[:2])
+            return {k: (v["tokens"], np.float32(v["score"]))
+                    for k, v in infos.items()}
+        a, b = one_run(), one_run()
+        assert a == b
+
+    def test_duplicate_requests_get_distinct_lanes(self, tiny):
+        """Two identical sentences in one engine must sample on
+        different RNG lanes — exactly as two dense batches fold
+        different call counters."""
+        plane = FeaturePlane(sampling=("full", 1.0), seed=77)
+        eng = make_greedy(tiny, features=plane)
+        drive(eng, [TEXTS[0], TEXTS[0]])
+        assert eng._lane_ctr == 2      # one lane per admitted row
+
+    def test_sampling_disables_prefix_cache(self, tiny):
+        plane = FeaturePlane(sampling=("full", 1.0), seed=77)
+        eng = make_greedy(tiny, features=plane,
+                          prefix=PrefixCache(max_entries=8, version="v"))
+        assert eng.prefix is None      # a dice roll must not be replayed
+
+
+# ---------------------------------------------------------------------------
+# force-decode: parity, caps, prefix-cache composition
+# ---------------------------------------------------------------------------
+
+class TestForceDecode:
+    def test_forced_prefix_respected_and_parity_vs_dense(self, tiny):
+        _, _, vocab = tiny
+        plane = FeaturePlane(force_decode=True)
+        lines = ["w3 w4 w5\tw6 w7", "w6 w7\tw2"]
+        _, infos = drive(make_beam(tiny, features=plane), lines)
+        for i, line in enumerate(lines):
+            src, pfx = line.split("\t")
+            forced = [int(t) for t in vocab.encode(pfx, add_eos=False)]
+            got = _crop_eos(infos[i]["tokens"], infos[i]["length"])
+            assert got[:len(forced)] == forced, (i, got, forced)
+            tl, score, _ = _dense_nbest(tiny, src, forced=forced)[0]
+            assert got == tl, (i, got, tl)
+            assert abs(infos[i]["score"] - score) < 1e-4
+
+    def test_unconstrained_line_decodes_normally(self, tiny):
+        """No TAB = no constraint: output matches a plane-less engine."""
+        plane = FeaturePlane(force_decode=True)
+        a, _ = drive(make_greedy(tiny, features=plane), [TEXTS[0]])
+        b, _ = drive(make_greedy(tiny), [TEXTS[0]])
+        assert a == b
+
+    def test_oversized_forced_prefix_is_fatal(self, tiny):
+        plane = FeaturePlane(force_decode=True)
+        eng = make_greedy(tiny, features=plane)
+        long_pfx = " ".join(["w4"] * 6)   # 6 + 8 > max_length_cap 12
+        res = eng.admit_and_step([(0, f"w3\t{long_pfx}")])
+        assert res.rejected == [(0, "too_large")]
+        assert "forced target prefix" in res.reject_detail[0]
+
+    def test_prefix_cache_replay_and_cow_fork_salted_by_trunk(self, tiny):
+        """A constrained prefix IS a shareable trunk: (a) an exact
+        repeat of a COMPLETED forced decode replays from the cache; (b)
+        a repeat arriving while the first is LIVE forks it copy-on-
+        write; (c) the same source under a DIFFERENT forced trunk must
+        do neither (the trunk salts the key)."""
+        plane = FeaturePlane(force_decode=True)
+        eng = make_greedy(tiny, features=plane,
+                          prefix=PrefixCache(max_entries=8, version="v"))
+        line = "w3 w4 w5\tw6 w7"
+        outs, _ = drive(eng, [line])
+        # (a) completed-decode replay
+        res = eng.admit_and_step([(1, line)])
+        assert dict(res.finished)[1] == outs[0]
+        assert any(ev == "prefix.hit" and d.get("kind") == "replay"
+                   for _, ev, d in res.row_events)
+        # (b) COW fork off a LIVE forced decode (a line not yet cached)
+        line2 = "w6 w7\tw3 w4"
+        eng.admit_and_step([(2, line2)])          # live row, mid-decode
+        res = eng.admit_and_step([(3, line2)])
+        assert any(ev == "prefix.fork" for _, ev, d in res.row_events), \
+            res.row_events
+        fork_outs, _ = drive(eng, [])             # drain both rows
+        assert fork_outs[2] == fork_outs[3]
+        # (c) different trunk, same source: a MISS, decoded fresh
+        hits_before = eng._counters["prefix_hits"]
+        other = "w3 w4 w5\tw2"
+        other_outs, _ = drive(eng, [other])
+        assert eng._counters["prefix_hits"] == hits_before
+        assert other_outs[0] != outs[0]
+        assert eng.audit(context="test") == []
+
+
+# ---------------------------------------------------------------------------
+# n-best: collected from beam bookkeeping, dense-printer parity
+# ---------------------------------------------------------------------------
+
+class TestNBest:
+    def test_nbest_matches_dense_twin(self, tiny):
+        """The engine's n-best block is formatted through the SAME
+        OutputPrinter as the dense driver: same shape (`sid ||| text
+        ||| Score= cum norm` per rank), same texts in the same rank
+        order, scores within the paged-vs-dense ULP tolerance."""
+        _, _, vocab = tiny
+        opts = Options({"n-best": True, "beam-size": K,
+                        "normalize": 0.6})
+        plane = FeaturePlane.from_options(opts, vocab, vocab)
+        outs, infos = drive(make_beam(tiny, features=plane), TEXTS[:2])
+        for i, t in enumerate(TEXTS[:2]):
+            dense = _dense_nbest(tiny, t)
+            lines = outs[i].split("\n")
+            assert len(lines) == K
+            assert infos[i]["nbest"], "collect must carry the raw n-best"
+            for rank, line in enumerate(lines):
+                fields = line.split(" ||| ")
+                assert fields[0] == "0"            # join-key sid
+                d_toks, d_score, d_norm = dense[rank]
+                assert fields[1] == vocab.decode(d_toks), (i, rank)
+                assert fields[2].startswith("Score= ")
+                assert abs(float(fields[2].split()[1]) - d_score) < 1e-4
+                assert abs(float(fields[3]) - d_norm) < 1e-4
+
+    def test_greedy_engine_refuses_nbest(self, tiny):
+        _, _, vocab = tiny
+        opts = Options({"n-best": True, "beam-size": 1})
+        plane = FeaturePlane.from_options(opts, vocab, vocab)
+        with pytest.raises(ValueError, match="n-best"):
+            make_greedy(tiny, features=plane)
+
+
+# ---------------------------------------------------------------------------
+# streaming: engine partials -> scheduler fan-out -> metrics
+# ---------------------------------------------------------------------------
+
+class TestStreaming:
+    def test_engine_partials_append_only(self, tiny):
+        """A greedy streaming row reports its text-so-far each round
+        (append-only prefixes of the final text); non-streaming rows
+        never appear in res.partials."""
+        eng = make_greedy(tiny)
+        seen = {0: [], 1: []}
+        res = eng.admit_and_step([(0, TEXTS[2], {"stream": True}),
+                                  (1, TEXTS[0])])
+        guard = 0
+        while not eng.idle():
+            for key, text, ntok in res.partials:
+                seen[key].append((text, ntok))
+            res = eng.admit_and_step([])
+            guard += 1
+            assert guard < 100
+        assert not seen[1], "non-streaming row leaked partials"
+        texts = [t for t, _ in seen[0]]
+        assert texts, "streaming row produced no partials"
+        for a, b in zip(texts, texts[1:]):
+            assert b.startswith(a), (a, b)
+        toks = [n for _, n in seen[0]]
+        assert toks == sorted(toks)
+
+    def test_scheduler_stream_partials_and_ttft(self, tiny):
+        """submit(on_partial=...) fans engine partials out per round,
+        stamps ttft once, and counts both new series; the final reply
+        is unchanged by streaming."""
+        reg = msm.Registry()
+        eng = make_greedy(tiny, registry=reg)
+        sched = ContinuousScheduler(None, registry=reg,
+                                    batching_mode="iteration",
+                                    engine=eng, window_s=0.0)
+        got = []
+
+        async def main():
+            sched.start()
+            f = sched.submit([TEXTS[2]],
+                             on_partial=lambda idx, text, ntok:
+                             got.append((idx, text, ntok)))
+            plain = sched.submit([TEXTS[2]])
+            r = await f
+            p = await plain
+            await sched.stop()
+            return r, p
+
+        r, p = run(main())
+        assert r == p                        # streaming changes delivery,
+        assert got, "no partials delivered"  # never the translation
+        assert all(idx == 0 for idx, _, _ in got)
+        assert r[0].startswith(got[-1][1]) or got[-1][1] == r[0]
+        assert reg.get("marian_stream_partials_total").value == len(got)
+        hist = reg.get("marian_stream_ttft_seconds")
+        assert hist is not None and hist._count == 1
+        assert lint_metrics_text(reg.render()) == []
+
+    def test_server_e2e_stream_tcp(self, tmp_path, monkeypatch):
+        """#stream:1 over the dependency-free TCP framing against the
+        real iteration-mode server: partial frames then the final reply,
+        final text identical to a non-streaming request."""
+        from marian_tpu.server import server as srv
+        from tests.test_server import (_drive_serve, _tcp_request,
+                                       _tiny_server_options)
+        monkeypatch.setattr(srv, "HAVE_WS", False)
+        sopts = _tiny_server_options(tmp_path).with_(**{
+            "batching-mode": "iteration", "beam-size": 1,
+            "iteration-rows": 8, "kv-page-len": 4,
+            "iteration-steps": 1})
+
+        async def stream_request(port, text):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            payload = f"#stream:1\n{text}".encode("utf-8")
+            writer.write(b"MTPU %d\n" % len(payload) + payload)
+            await writer.drain()
+            partials = []
+            while True:
+                header = await reader.readline()
+                assert header.startswith(b"MTPU ")
+                frame = (await reader.readexactly(
+                    int(header.split()[1]))).decode("utf-8")
+                if frame.startswith(srv.PARTIAL_PREFIX):
+                    partials.append(frame)
+                else:
+                    writer.close()
+                    return partials, frame
+
+        async def clients(port):
+            plain = await _tcp_request(port, "w3 w4 w5 w6 w7")
+            streamed = await stream_request(port, "w3 w4 w5 w6 w7")
+            return plain, streamed
+
+        plain, (partials, final) = asyncio.run(
+            _drive_serve(sopts, clients))
+        assert final == plain
+        assert partials, "streaming reply carried no #partial: frames"
+        for f in partials:
+            idx, _, text = f[len(srv.PARTIAL_PREFIX):].partition(" ")
+            assert idx == "0"
+        # greedy partials are append-only prefixes of the final reply
+        last = partials[-1]
+        assert final.startswith(
+            last[len(srv.PARTIAL_PREFIX):].partition(" ")[2])
+
+
+# ---------------------------------------------------------------------------
+# decode-surface validation: lifted flags pass, the rest refuse LOUDLY
+# ---------------------------------------------------------------------------
+
+class TestDecodeSurfaceValidation:
+    BASE = {"batching-mode": "iteration", "beam-size": 2,
+            "iteration-rows": 8}
+
+    def _validate(self, **extra):
+        from marian_tpu.server.server import ServingApp
+        ServingApp._validate_iteration_options(
+            Options({**self.BASE, **extra}))
+
+    def test_lifted_features_now_accepted(self):
+        self._validate(**{"n-best": True})
+        self._validate(**{"output-sampling": ["full", "0.8"]})
+        self._validate(**{"force-decode": True})
+        self._validate(**{"shortlist": ["lex.npz"]})
+        self._validate(**{"n-best": True,
+                          "output-sampling": ["topk", "10"]})
+
+    def test_unsupported_flags_still_refused(self):
+        for flag, val in (("alignment", "soft"),
+                          ("word-scores", True),
+                          ("output-approx-knn", [8, 128])):
+            with pytest.raises(ValueError, match=flag):
+                self._validate(**{flag: val})
+
+    def test_shortlist_with_force_decode_refused_at_boot(self):
+        with pytest.raises(ValueError, match="full-vocab"):
+            self._validate(**{"shortlist": ["lex.npz"],
+                              "force-decode": True})
+
+    def test_unknown_decode_flag_refuses_loudly(self, monkeypatch):
+        """THE regression pin: a decode-surface flag that exists but has
+        no verdict in ITERATION_DECODE_SURFACE must refuse as
+        UNCLASSIFIED, never fall through to silently-wrong output."""
+        from marian_tpu.server.server import ServingApp
+        monkeypatch.setattr(
+            ServingApp, "DECODE_SURFACE_FLAGS",
+            ServingApp.DECODE_SURFACE_FLAGS + ("frobnicate",))
+        assert "frobnicate" not in ServingApp.ITERATION_DECODE_SURFACE
+        with pytest.raises(ValueError, match="UNCLASSIFIED"):
+            self._validate(frobnicate=True)
+        # every classified flag has a verdict — the census that keeps
+        # the UNCLASSIFIED branch from ever firing on shipped flags
+        for flag in ServingApp.DECODE_SURFACE_FLAGS[:-1]:
+            assert flag in ServingApp.ITERATION_DECODE_SURFACE, flag

@@ -667,8 +667,12 @@ class TestSlowTranslateDrivesFastBurn:
             deadline = time.time() + 5
             dumps = []
             while not dumps and time.time() < deadline:
+                # .json only: the recorder writes a .<name>.json.tmp
+                # and os.replace()s it into place — matching the tmp
+                # name races the rename and read_text() gets ENOENT
                 dumps = [f for f in os.listdir(tmp_path)
-                         if "slo-fast-burn" in f]
+                         if "slo-fast-burn" in f
+                         and f.endswith(".json")]
                 time.sleep(0.02)
             assert dumps
             payload = json.loads((tmp_path / dumps[0]).read_text())

@@ -251,12 +251,14 @@ class TestPoolState:
         try:
             async def main():
                 await app.start()
-                # a row must be decoding BEFORE the drill fires — the
-                # corruption needs live refcounts to corrupt
-                f = app.scheduler.submit([TEXTS[4]])
-                await wait_for(
-                    lambda: app.scheduler.m_joins.value >= 1)
-                with fp.active("pool.refcount_corrupt=fail:1"):
+                # arm for EVERY round (@*) before the row even joins:
+                # the drill no-ops while no refcount is live, then
+                # corrupts the first round that has one. Arming after
+                # the join (the old fail@1) raced the engine thread on
+                # a loaded box — the row could finish before the single
+                # hit landed on live state.
+                with fp.active("pool.refcount_corrupt=fail@*"):
+                    f = app.scheduler.submit([TEXTS[4]])
                     with pytest.raises(Exception):
                         await f
                 await app.scheduler.stop()

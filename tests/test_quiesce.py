@@ -696,21 +696,23 @@ class TestLoadgenRetry:
                    "translated"]
 
         async def fake(host, port, text):
-            return replies.pop(0)
+            # transports return (reply, ttft_s) since --stream (ISSUE 16)
+            return replies.pop(0), None
 
-        reply, n = run(lg.send_with_retries(fake, "h", 0, "t",
-                                            retries=3, base_s=0.001))
-        assert reply == "translated" and n == 2
+        reply, n, ttft = run(lg.send_with_retries(fake, "h", 0, "t",
+                                                  retries=3, base_s=0.001))
+        assert reply == "translated" and n == 2 and ttft is None
 
     def test_send_with_retries_budget_exhausted(self):
         lg = _load_loadgen()
 
         async def always_retry(host, port, text):
-            return "#trace:t1 outcome=evicted queue_ms=0.0 " \
-                   "service_ms=0.0 model_version=v\n!!SERVER-RETRY x"
+            return ("#trace:t1 outcome=evicted queue_ms=0.0 "
+                    "service_ms=0.0 model_version=v\n!!SERVER-RETRY x",
+                    None)
 
-        reply, n = run(lg.send_with_retries(always_retry, "h", 0, "t",
-                                            retries=2, base_s=0.001))
+        reply, n, _ = run(lg.send_with_retries(always_retry, "h", 0, "t",
+                                               retries=2, base_s=0.001))
         # meta header is stripped for the retry decision, preserved in
         # the final reply; the budget bounds the attempts
         assert n == 2 and "!!SERVER-RETRY" in reply
@@ -721,10 +723,10 @@ class TestLoadgenRetry:
 
         async def fake(host, port, text):
             calls.append(text)
-            return "!!SERVER-RETRY x"
+            return "!!SERVER-RETRY x", None
 
-        reply, n = run(lg.send_with_retries(fake, "h", 0, "t",
-                                            retries=0))
+        reply, n, _ = run(lg.send_with_retries(fake, "h", 0, "t",
+                                               retries=0))
         assert len(calls) == 1 and n == 0
 
 
