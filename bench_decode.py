@@ -88,6 +88,16 @@ optimized HLO). The r5 trace put the standard body at ~690 small ops ×
 ~4 µs dispatch each — the floor that made sent/s flat from 384 rows
 down to 8; this field is how the fused kernel's reduction is tracked
 per run instead of per profile session.
+
+Every row also reports ``compile_s``: the backend-compile seconds the
+stage's warm block actually paid, summed from the shared
+``jax.monitoring`` backend-compile listener (common/jitwit.py — the
+same event stream the perf plane's compile telemetry and the jit
+retrace witness ride). A/B stages report the dense side separately
+(``dense_compile_s`` / ``full_vocab_compile_s``): a paged-vs-dense
+throughput pair is only comparable if neither side smuggled a
+recompile into its warm. Null (not 0) when the listener is
+unavailable or explicitly disarmed (``MARIAN_JITWIT=0``).
 """
 
 import json
@@ -163,6 +173,17 @@ def entry_op_count(jitted, *args, **kwargs) -> "int | None":
     return counts.get(entry)
 
 
+def _warm_compile_s(window, armed: bool) -> "float | None":
+    """Summed backend-compile seconds a stage's warm block paid, from a
+    jitwit strict window (common/jitwit.py) over the jax.monitoring
+    backend-compile event stream. None (not 0.0) when the listener is
+    unavailable or disarmed — a zero-compile warm is a claim,
+    an unobserved one is not."""
+    if not armed:
+        return None
+    return round(sum(s for _site, s in window.compiles), 3)
+
+
 def main():
     preset = os.environ.get("MARIAN_DECBENCH_PRESET", "big")
     n_sents = int(os.environ.get("MARIAN_DECBENCH_SENTS", 256))
@@ -178,6 +199,14 @@ def main():
 
     from marian_tpu.common.profiling import enable_compilation_cache
     enable_compilation_cache()
+    # per-stage compile accounting (ISSUE 17 satellite): arm the jit
+    # retrace witness's jax.monitoring listener so every stage's warm
+    # block reports the backend-compile seconds it paid (compile_s and
+    # the A/B siblings). setdefault respects an explicit
+    # MARIAN_JITWIT=0; the listener re-checks the env per event.
+    from marian_tpu.common import jitwit
+    os.environ.setdefault(jitwit.ENV_VAR, "1")
+    jw_armed = jitwit.install() and jitwit.enabled()
     from marian_tpu.common.options import Options
     from marian_tpu.data.vocab import DefaultVocab
     from marian_tpu.models.encoder_decoder import create_model
@@ -323,12 +352,14 @@ def main():
                     and int(paged_env) > 1 else 16)
         batches = [make_batch() for _ in range(max(1, n_sents // batch))]
         intro: dict = {}
-        retry_compile(lambda: greedy_decode_paged(
-            model, params, *batches[0], max_len, page_len=page_len,
-            introspect=intro), "paged greedy decode")
-        retry_compile(lambda: greedy_decode(
-            model, params, *batches[0], max_len, introspect=intro),
-            "dense greedy decode")
+        with jitwit.strict() as w_paged:
+            retry_compile(lambda: greedy_decode_paged(
+                model, params, *batches[0], max_len, page_len=page_len,
+                introspect=intro), "paged greedy decode")
+        with jitwit.strict() as w_dense:
+            retry_compile(lambda: greedy_decode(
+                model, params, *batches[0], max_len, introspect=intro),
+                "dense greedy decode")
 
         t0 = time.perf_counter()
         for b_ids, b_mask in batches:
@@ -378,6 +409,11 @@ def main():
             "step_ops": paged_ops,
             "dense_step_ops": dense_ops,
             "while_body_ops": None,
+            # what each side's warm ACTUALLY compiled: the A/B is only
+            # honest if neither path recompiles inside the timed loop,
+            # and the warm cost here is the whole compile budget
+            "compile_s": _warm_compile_s(w_paged, jw_armed),
+            "dense_compile_s": _warm_compile_s(w_dense, jw_armed),
             "final_sync_s": final_sync_s,
         }
         if final_sync_s > FINAL_SYNC_POISON_S:
@@ -416,8 +452,9 @@ def main():
             model, params, vocab, vocab, beam_size=beam, normalize=0.6,
             max_rows=batch * beam, page_len=page_len,
             src_len_cap=src_len, max_length_cap=max_len)
-        retry_compile(lambda: engine.decode_texts(texts[0]),
-                      "COW paged beam decode")
+        with jitwit.strict() as w_paged:
+            retry_compile(lambda: engine.decode_texts(texts[0]),
+                          "COW paged beam decode")
         t0 = time.perf_counter()
         for chunk in texts:
             engine.decode_texts(chunk)
@@ -435,8 +472,9 @@ def main():
                 ids[i, :len(r)] = r
                 mask[i, :len(r)] = 1.0
             return jnp.asarray(ids), jnp.asarray(mask)
-        retry_compile(lambda: bs.search(*dense_batch(texts[0])),
-                      "dense beam decode")
+        with jitwit.strict() as w_dense:
+            retry_compile(lambda: bs.search(*dense_batch(texts[0])),
+                          "dense beam decode")
         t0 = time.perf_counter()
         for chunk in texts:
             bs.search(*dense_batch(chunk))
@@ -456,6 +494,8 @@ def main():
             "beam": beam,
             "page_len": page_len,
             "dense_beam_sentences_per_sec": round(sents / dt_dense, 2),
+            "compile_s": _warm_compile_s(w_paged, jw_armed),
+            "dense_compile_s": _warm_compile_s(w_dense, jw_armed),
             "final_sync_s": final_sync_s,
         }
         if final_sync_s > FINAL_SYNC_POISON_S:
@@ -474,8 +514,9 @@ def main():
     from bench import retry_compile
     ids, mask = make_batch()
     warm_sl = shortlist_for(ids)
-    retry_compile(lambda: bs.search(ids, mask, shortlist=warm_sl),
-                  "beam search")
+    with jitwit.strict() as w_warm:
+        retry_compile(lambda: bs.search(ids, mask, shortlist=warm_sl),
+                      "beam search")
 
     # Whether the fused kernel ACTUALLY engaged for this run (the env
     # knob is a request; mesh/sharded-params/backend gates can veto it)
@@ -526,6 +567,7 @@ def main():
     sents = batch * len(batches)
 
     full_vocab_sps = None
+    full_vocab_compile_s = None
     if sl_gen is not None:
         # shortlist A/B: the IDENTICAL batches back through the
         # full-vocab output GEMM (shortlist=None) — the pair isolates
@@ -533,8 +575,10 @@ def main():
         # economics --shortlist banks on. Kept OUT of the shortlisted
         # window above so the per-batch shortlist host work stays a
         # shortlist-side cost, as in the real translator.
-        retry_compile(lambda: bs.search(ids, mask),
-                      "full-vocab beam search")
+        with jitwit.strict() as w_full:
+            retry_compile(lambda: bs.search(ids, mask),
+                          "full-vocab beam search")
+        full_vocab_compile_s = _warm_compile_s(w_full, jw_armed)
         t0 = time.perf_counter()
         pipelined(batches,
                   lambda b: bs.search_async(b[0], b[1]),
@@ -562,10 +606,12 @@ def main():
         "fused_decode": fused_env or "auto",
         "fused_decode_engaged": fused_engaged,
         "while_body_ops": body_ops,
+        "compile_s": _warm_compile_s(w_warm, jw_armed),
         "final_sync_s": final_sync_s,
     }
     if full_vocab_sps is not None:
         result["full_vocab_sentences_per_sec"] = full_vocab_sps
+        result["full_vocab_compile_s"] = full_vocab_compile_s
     if final_sync_s > FINAL_SYNC_POISON_S:
         result["poisoned"] = True
         result["poisoned_reason"] = (

@@ -175,13 +175,14 @@ class ModuleInfo:
         self.imports: Dict[str, Tuple] = {}
 
 
-# the witnesses' own plumbing (lockdep._WITNESS_LOCK and
-# ownwit._WITNESS_LOCK — deliberately unwitnessed, held only around
+# the witnesses' own plumbing (lockdep._WITNESS_LOCK, ownwit's and
+# jitwit's _WITNESS_LOCK — deliberately unwitnessed, held only around
 # their record-dict updates) is instrumentation, not part of the
 # modeled lattice: keep its locks out of the graph and the committed
 # docs/lock_order.dot
 _INSTRUMENTATION_MODULES = frozenset({"marian_tpu.common.lockdep",
-                                      "marian_tpu.common.ownwit"})
+                                      "marian_tpu.common.ownwit",
+                                      "marian_tpu.common.jitwit"})
 
 
 def _modname(rel: str) -> str:

@@ -65,7 +65,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule families to run (default all): "
                         "trace-safety,host-sync,donation,dtype,guarded-by,"
                         "metrics,faults,lock-order,lock-blocking,"
-                        "guard-escape,span,ownership")
+                        "guard-escape,span,ownership,jit")
     p.add_argument("--changed", action="store_true",
                    help="incremental mode (scripts/mtlint-precommit.sh): "
                         "exit immediately when git reports no dirty .py "
@@ -135,10 +135,20 @@ def _sarif(findings, errors: List[str]) -> dict:
     notifications."""
     from .core import RULESET_VERSION
     from .rules import all_rules
-    rules_meta = [
-        {"id": rid,
-         "properties": {"family": rule.family}}
-        for rule in all_rules() for rid in rule.ids]
+    rules_meta = []
+    for rule in all_rules():
+        for rid in rule.ids:
+            meta = {"id": rid,
+                    "properties": {"family": rule.family}}
+            desc = rule.descriptions.get(rid)
+            if desc:
+                # rule metadata renders in code-scanning rule pages;
+                # families that declare descriptions (jit) get them
+                meta["name"] = rid.replace("MT-", "").title() \
+                    .replace("-", "")
+                meta["shortDescription"] = {"text": desc}
+                meta["defaultConfiguration"] = {"level": "warning"}
+            rules_meta.append(meta)
     results = []
     for f in findings:
         text = f.message + (f" [hint: {f.hint}]" if f.hint else "")

@@ -39,7 +39,11 @@ SUPPRESS_TAG = "mtlint:"
 #     analysis over the KVPool/prefix-cache/executor/engine/file verb
 #     registry, with the `# owns: caller|callee` / `# mtlint: transfers`
 #     annotation vocabulary (validated at runtime by common/ownwit.py).
-RULESET_VERSION = 6
+# v7: MT-JIT family (jit) — static compile-cache analysis over every
+#     jax.jit/pjit/shard_map/lax.scan boundary: compile-key domains,
+#     the `# buckets: <REGISTRY>` annotation vocabulary, and warmup
+#     reachability (validated at runtime by common/jitwit.py).
+RULESET_VERSION = 7
 
 
 @dataclasses.dataclass(frozen=True)
@@ -267,6 +271,10 @@ DEFAULT_RULE_DIRS: Dict[str, List[str]] = {
     # surface lives in translator/, but executors/threads/engines/file
     # handles are acquired across the whole tree
     "ownership": [],
+    # compile-cache hygiene (MT-JIT-*): everywhere — jit boundaries
+    # live in ops/, translator/, training/ and the UNWARMED
+    # reachability walks serving/ -> translator/ across layers
+    "jit": [],
 }
 
 DEFAULT_EXCLUDE = ["marian_tpu/analysis"]

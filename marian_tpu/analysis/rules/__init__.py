@@ -12,7 +12,8 @@ from ..core import Config, Finding, Source
 class Rule:
     """Base class. `family` groups ids for config scoping ("trace-safety",
     "host-sync", "donation", "dtype", "guarded-by", "metrics", "faults",
-    "lock-order", "lock-blocking", "guard-escape", "span"); `scope` is "file"
+    "lock-order", "lock-blocking", "guard-escape", "span", "ownership",
+    "jit"); `scope` is "file"
     (check per Source) or "project" (check_project over all in-scope
     sources at once — cross-file rules like metrics hygiene and the
     call-graph lock rules)."""
@@ -20,6 +21,8 @@ class Rule:
     family: str = ""
     ids: tuple = ()           # rule ids this family can emit (docs/tests)
     scope: str = "file"
+    descriptions: dict = {}   # optional rule-id -> short description
+    #                           (surfaced as SARIF rule metadata)
 
     def check(self, src: Source, config: Config) -> List[Finding]:
         return []
@@ -53,4 +56,4 @@ def _load() -> None:
     from . import (trace_safety, host_sync, donation,  # noqa: F401
                    dtype_hygiene, guarded_by, metrics_hygiene,
                    fault_hygiene, lock_order, lock_blocking,
-                   guard_escape, span_hygiene, ownership)
+                   guard_escape, span_hygiene, ownership, jit)

@@ -1,0 +1,450 @@
+"""Compile-cache hygiene rules (family "jit", ISSUE 17 tentpole).
+
+The compile-key domain of every jit boundary must be finite and warm
+before steady-state serving touches it — "compile once, serve forever".
+Four rules over the project jit model (analysis/jitgraph.py):
+
+MT-JIT-CLOSURE-VARYING  a traced function captures state that can vary
+    between calls — ``self.<attr>`` reads inside the traced body, or an
+    enclosing-scope local rebound AFTER the jit object was created.
+    Every mutation of such state is a silent full retrace: jax caches
+    on the Python function object, not on what its closure read last
+    time. Hoist the value to a local before creating the jit (the
+    ``_make_step`` idiom: ``model = self.model`` then close over
+    ``model``).
+
+MT-JIT-STATIC-UNBOUNDED  a compile-key axis drawn from an unbounded
+    domain. Two forms: (a) a jit FACTORY parameter (an enclosing-fn
+    param the traced body captures — ``_make_step(rb)``'s ``rb``) with
+    no ``# buckets: <REGISTRY>`` annotation declaring the finite table
+    it is drawn from; (b) a call-site argument in a static position
+    (``static_argnums``/``static_argnames``) built from raw ``len()``,
+    a float literal, or a dict display instead of a bucket helper
+    (``bucket_rows``/``bucket_length``/``pages_for_tokens``) or a
+    declared registry. Also fires on an annotation naming a registry
+    the project scan cannot find — vocabulary stays honest.
+
+MT-JIT-WEAKTYPE  a bare Python scalar literal passed as a TRACED
+    (non-static) argument to a known-jitted callable: weak-typed
+    scalars key the cache differently from committed arrays, and a
+    literal that later becomes a ``jnp.asarray`` at one call site but
+    not another doubles the cache. Wrap in ``jnp.asarray(x, dtype=...)``
+    or make the argument static.
+
+MT-JIT-UNWARMED  (project scope) a jit creation site reachable from the
+    steady-state serving plane (marian_tpu/serving/, minus lifecycle/)
+    but NOT reachable from any warmup root (``warm_executor`` /
+    ``smoke_buckets`` / engine ``warm_grid`` — serving/lifecycle/
+    warmup.py). Such a site compiles on a live request: the lint form
+    of PR 13's ``marian_compile_total{trigger=steady-state}`` incident
+    counter. Never baseline this — warm the site or take it off the
+    serving path.
+
+Reachability uses the shared callgraph with the ownership-style
+override bridge (subclass methods reachable through base quals) plus a
+duck-type bridge for the two dynamic hops the graph cannot see: warmup
+drives ``executor(...)``/``executor.engine.warm_grid()`` and the
+scheduler drives ``self.engine.<method>`` — both resolve to every
+matching method on marian_tpu/translator/ classes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import Rule, register
+from ..core import (Config, Finding, Source, ancestors, call_name,
+                    dotted_name, parent)
+from ..jitgraph import (BUCKET_DERIVERS, JitModel, JitSite,
+                        buckets_annotation, collect_jit_sites,
+                        collect_registries, _enclosing_func,
+                        _func_leafname, _names_read, _param_names,
+                        _traced_fn_for)
+
+WARMUP_REL = "marian_tpu/serving/lifecycle/warmup.py"
+WARM_ROOT_NAMES = ("warm_executor", "smoke_buckets")
+# methods the warmup/scheduler planes reach through dynamic dispatch
+# (executor(...) / self.translate_lines(...) / self.engine.<m>):
+# bridged to translator/ classes ("run" is Translate.run, the
+# request-mode executor the server wires in as translate_lines)
+EXECUTOR_BRIDGE_METHODS = frozenset({
+    "__call__", "translate_lines", "decode_texts", "warm_grid", "run"})
+
+
+def _is_serving_rel(rel: str) -> bool:
+    return rel.startswith("marian_tpu/serving/") and rel != WARMUP_REL
+
+
+def _assignments_after(scope: ast.AST, name: str, lineno: int) -> bool:
+    """Is `name` rebound anywhere in `scope` after `lineno`? (the
+    varying-closure shape: create jit at L, mutate captured local > L)"""
+    for n in ast.walk(scope):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)) and n is not scope:
+            continue
+        tgt_lineno = getattr(n, "lineno", 0)
+        if tgt_lineno <= lineno:
+            continue
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) \
+                else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+    return False
+
+
+def _self_attr_reads(traced: ast.AST) -> List[ast.Attribute]:
+    """Loads of ``self.<attr>`` inside a traced body (each is state
+    that can vary under the jit's feet)."""
+    out = []
+    for n in ast.walk(traced):
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                and isinstance(n.value, ast.Name) \
+                and n.value.id == "self":
+            out.append(n)
+    return out
+
+
+def _is_bucket_derived(expr: ast.AST) -> bool:
+    """Expression provably drawn from a bucket table: a bucket-helper
+    call, a name/attr whose dotted path mentions a *BUCKETS/*BLOCKS
+    registry, or a subscript/min/max/next over such."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            tail = (call_name(n) or "").rsplit(".", 1)[-1]
+            if tail in BUCKET_DERIVERS:
+                return True
+        name = dotted_name(n)
+        if name and any(part.endswith(("BUCKETS", "BLOCKS"))
+                        for part in name.split(".")):
+            return True
+    return False
+
+
+@register
+class JitCompileCacheRule(Rule):
+    """Static compile-key-domain analysis over every jit boundary."""
+
+    family = "jit"
+    ids = ("MT-JIT-CLOSURE-VARYING", "MT-JIT-STATIC-UNBOUNDED",
+           "MT-JIT-WEAKTYPE", "MT-JIT-UNWARMED")
+    scope = "project"
+
+    # SARIF metadata (cli._sarif): per-rule short descriptions + help
+    descriptions = {
+        "MT-JIT-CLOSURE-VARYING":
+            "jitted function closes over state mutated elsewhere — "
+            "each mutation is a silent retrace",
+        "MT-JIT-STATIC-UNBOUNDED":
+            "compile-key axis drawn from an unbounded domain instead "
+            "of a declared # buckets: registry",
+        "MT-JIT-WEAKTYPE":
+            "python scalar literal crosses the trace boundary — "
+            "weak-type retrace",
+        "MT-JIT-UNWARMED":
+            "serving-reachable compile key no warmup path covers — "
+            "compiles on a live request",
+    }
+
+    def check_project(self, sources: Sequence[Source],
+                      config: Config) -> List[Finding]:
+        findings: List[Finding] = []
+        model = JitModel.build(sources)
+        by_rel = {s.rel: s for s in sources}
+        sites = model.sites
+
+        for src in sources:
+            if not config.family_applies(self.family, src.rel):
+                continue
+            findings += self._check_file(src, model)
+
+        findings += self._check_unwarmed(sources, by_rel, sites, config)
+        return findings
+
+    # -- per-file checks ----------------------------------------------------
+
+    def _check_file(self, src: Source, model: JitModel) -> List[Finding]:
+        out: List[Finding] = []
+        from .trace_safety import _jit_decorator_info, \
+            _wrapped_jit_functions
+        wrapped = _wrapped_jit_functions(src.tree)
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                tail = (name or "").rsplit(".", 1)[-1]
+                if tail in ("jit", "pjit", "shard_map") \
+                        and name is not None \
+                        and (name.startswith("jax.") or "." not in name):
+                    out += self._check_creation(src, node, model)
+                elif tail and tail in wrapped:
+                    out += self._check_call_site(
+                        src, node, wrapped[tail], model)
+        return out
+
+    def _check_creation(self, src: Source, call: ast.Call,
+                        model: JitModel) -> List[Finding]:
+        out: List[Finding] = []
+        encl = _enclosing_func(call)
+        traced = _traced_fn_for(call, src)
+
+        # annotation vocabulary honesty: unknown registry name
+        ann_line = call.lineno
+        if encl is not None and not isinstance(encl, ast.Lambda):
+            ann_line = encl.lineno
+        declared = buckets_annotation(src, ann_line)
+        for reg in declared:
+            if not model.known_registry(reg):
+                out.append(src.finding(
+                    "MT-JIT-STATIC-UNBOUNDED", call,
+                    f"# buckets: names unknown registry '{reg}' — the "
+                    "project scan found no such bucket table",
+                    hint="declare the table as an ALL_CAPS *BUCKETS/"
+                         "*BLOCKS constant, or use POW2/HALVING"))
+
+        if traced is not None:
+            out += self._check_closure(src, call, encl, traced)
+
+        # factory axes need a declared domain
+        from ..jitgraph import _factory_axes
+        axes = _factory_axes(encl, traced)
+        if axes and not declared:
+            fname = _func_leafname(encl)
+            out.append(src.finding(
+                "MT-JIT-STATIC-UNBOUNDED", call,
+                f"jit factory {fname}({', '.join(axes)}) bakes "
+                f"{'params' if len(axes) > 1 else 'param'} "
+                f"{', '.join(axes)} into the compile key with no "
+                "declared domain — every new value is a fresh "
+                "trace+compile",
+                hint="annotate the factory def with # buckets: "
+                     "<REGISTRY> (e.g. ROW_BUCKETS, JOIN_BUCKETS, "
+                     "POW2, HALVING) and derive call-site values via "
+                     "bucket_rows()/bucket tables"))
+        return out
+
+    def _check_closure(self, src: Source, call: ast.Call,
+                       encl: Optional[ast.AST],
+                       traced: ast.AST) -> List[Finding]:
+        out: List[Finding] = []
+        # self.<attr> reads inside the traced body vary whenever the
+        # instance mutates — unless self is itself a (static) arg
+        params = set(_param_names(traced)) \
+            if not isinstance(traced, ast.Lambda) \
+            else {a.arg for a in traced.args.args}
+        if "self" not in params:
+            flagged = set()
+            for attr in _self_attr_reads(traced):
+                if attr.attr in flagged:
+                    continue
+                flagged.add(attr.attr)
+                out.append(src.finding(
+                    "MT-JIT-CLOSURE-VARYING", attr,
+                    f"traced function reads self.{attr.attr} through "
+                    "its closure — any mutation of the instance "
+                    "retraces silently (jax caches on the function "
+                    "object, not its captured state)",
+                    hint=f"hoist: {attr.attr} = self.{attr.attr} "
+                         "before creating the jit, close over the "
+                         "local"))
+
+        # enclosing-scope locals rebound AFTER the jit creation
+        if encl is not None and not isinstance(encl, ast.Lambda):
+            captured = _names_read(traced) - params
+            for nm in sorted(captured):
+                if _assignments_after(encl, nm, call.lineno):
+                    out.append(src.finding(
+                        "MT-JIT-CLOSURE-VARYING", call,
+                        f"traced function captures '{nm}', which is "
+                        f"rebound after the jit is created at line "
+                        f"{call.lineno} — the trace saw the old "
+                        "value; later calls silently diverge or "
+                        "retrace",
+                        hint="freeze the value before jit creation, "
+                             "or pass it as an argument"))
+        return out
+
+    def _check_call_site(self, src: Source, call: ast.Call,
+                         statics: Tuple[Sequence[int], Sequence[str]],
+                         model: JitModel) -> List[Finding]:
+        out: List[Finding] = []
+        nums, names = statics
+        has_annotation = bool(buckets_annotation(src, call.lineno))
+
+        def unbounded(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Call) \
+                    and (call_name(expr) or "") == "len":
+                return "raw len()"
+            if isinstance(expr, ast.Constant) \
+                    and isinstance(expr.value, float):
+                return "float literal"
+            if isinstance(expr, ast.Dict):
+                return "dict display"
+            return None
+
+        for i, arg in enumerate(call.args):
+            is_static = i in nums
+            why = unbounded(arg)
+            if is_static and why and not has_annotation \
+                    and not _is_bucket_derived(arg):
+                out.append(src.finding(
+                    "MT-JIT-STATIC-UNBOUNDED", arg,
+                    f"static arg {i} fed from {why} — an unbounded "
+                    "compile-key domain (each distinct value is a "
+                    "fresh compile)",
+                    hint="bucket the value (bucket_rows/bucket_length/"
+                         "pages_for_tokens) or annotate the call with "
+                         "# buckets: <REGISTRY>"))
+            elif not is_static and isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, (int, float)) \
+                    and not isinstance(arg.value, bool):
+                out.append(src.finding(
+                    "MT-JIT-WEAKTYPE", arg,
+                    f"python scalar literal {arg.value!r} passed as a "
+                    "traced argument to a jitted function — weak-typed "
+                    "scalars key the compile cache differently from "
+                    "committed arrays",
+                    hint="wrap in jnp.asarray(..., dtype=...) or make "
+                         "the argument static"))
+        for kw in call.keywords:
+            if kw.arg in names:
+                why = unbounded(kw.value)
+                if why and not has_annotation \
+                        and not _is_bucket_derived(kw.value):
+                    out.append(src.finding(
+                        "MT-JIT-STATIC-UNBOUNDED", kw.value,
+                        f"static kwarg '{kw.arg}' fed from {why} — an "
+                        "unbounded compile-key domain",
+                        hint="bucket the value or annotate with "
+                             "# buckets: <REGISTRY>"))
+        return out
+
+    # -- MT-JIT-UNWARMED (project reachability) -----------------------------
+
+    def _check_unwarmed(self, sources: Sequence[Source],
+                        by_rel: Dict[str, Source],
+                        sites: List[JitSite],
+                        config: Config) -> List[Finding]:
+        from .. import callgraph as cgmod
+        cg = cgmod.build_cached(sources)
+
+        # override dispatch, exactly ownership.py's bridge: a call the
+        # type inference resolves to Base.m may run Sub.m at runtime
+        # (PagedBeamEngine overrides _make_step/_install and is driven
+        # through the inherited admit_and_step)
+        overrides: Dict[str, List[str]] = {}
+        for mod in cg.modules.values():
+            for ci in mod.classes.values():
+                for base in ci.mro()[1:]:
+                    for name, meth in ci.methods.items():
+                        if name in base.methods:
+                            overrides.setdefault(
+                                base.methods[name].qual,
+                                []).append(meth.qual)
+
+        # leaf-name method index over translator/ classes: the
+        # duck-type bridge for the two dynamic hops the callgraph
+        # cannot resolve — warmup drives `executor(...)` and the
+        # scheduler drives `self.engine.<m>`; both land on translator/
+        # class methods whose names the bridge set enumerates
+        translator_methods: Dict[str, List[str]] = {}
+        for qual, f in cg.functions.items():
+            if f.rel.startswith("marian_tpu/translator/") and f.cls:
+                leaf = qual.rsplit(".", 1)[-1]
+                translator_methods.setdefault(leaf, []).append(qual)
+
+        def succ(qual: str) -> List[str]:
+            f = cg.functions.get(qual)
+            if f is None:
+                return []
+            out: List[str] = []
+            for cs in f.calls:
+                if cs.targets:
+                    for t in cs.targets:
+                        out.append(t)
+                        out.extend(overrides.get(t, ()))
+                else:
+                    # unresolved call: bridge ONLY the enumerated
+                    # dynamic-dispatch method names into translator/;
+                    # a bare `executor(...)` (warmup's callable param)
+                    # reaches every executor entry method
+                    leaf = cs.name.rsplit(".", 1)[-1]
+                    if leaf in EXECUTOR_BRIDGE_METHODS:
+                        out.extend(translator_methods.get(leaf, ()))
+                    elif leaf.startswith("executor"):
+                        for m in EXECUTOR_BRIDGE_METHODS:
+                            out.extend(translator_methods.get(m, ()))
+            # nested defs run in the parent's dynamic extent
+            out.extend(f.nested)
+            return out
+
+        def reach(roots: Set[str]) -> Set[str]:
+            seen = set(roots)
+            stack = list(roots)
+            while stack:
+                q = stack.pop()
+                for nxt in succ(q):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            return seen
+
+        warm_roots = {q for q, f in cg.functions.items()
+                      if f.rel == WARMUP_REL}
+        serve_roots = {q for q, f in cg.functions.items()
+                       if _is_serving_rel(f.rel)}
+        warm = reach(warm_roots)
+        serve = reach(serve_roots)
+
+        # map quals -> "<rel>::<leaf co_name>" site ids
+        def site_ids(quals: Set[str]) -> Set[str]:
+            out = set()
+            for q in quals:
+                f = cg.functions.get(q)
+                if f is None:
+                    continue
+                leaf = q.rsplit(".", 1)[-1].strip("<>")
+                out.add(f"{f.rel}::{leaf}")
+            return out
+
+        warm_sites = site_ids(warm)
+        serve_sites = site_ids(serve)
+
+        findings: List[Finding] = []
+        seen_sites: Set[str] = set()
+        for s in sites:
+            if s.kind == "scan":
+                # scan-inside-jit compiles with its enclosing jit; a
+                # bare eager scan is a perf smell other rules own
+                continue
+            if not (s.rel.startswith("marian_tpu/translator/")
+                    or _is_serving_rel(s.rel)):
+                continue
+            if not config.family_applies(self.family, s.rel):
+                continue
+            if s.site in seen_sites:
+                continue
+            if s.site in serve_sites and s.site not in warm_sites:
+                seen_sites.add(s.site)
+                src = by_rel.get(s.rel)
+                node = _FakeNode(s.lineno)
+                findings.append(src.finding(
+                    "MT-JIT-UNWARMED", node,
+                    f"jit site {s.site} is reachable from steady-state "
+                    "serving but from no warmup root — it compiles on "
+                    "a live request (PR 13's steady-state recompile "
+                    "incident, caught statically)",
+                    hint="cover the site from warm_executor/"
+                         "smoke_buckets/warm_grid, or take it off the "
+                         "serving path"))
+        return findings
+
+
+class _FakeNode:
+    """Line anchor for project-scope findings (no single ast node)."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.col_offset = 0

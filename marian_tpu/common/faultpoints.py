@@ -117,6 +117,13 @@ CATALOG: Dict[str, str] = {
                          "(common/ownwit.py) and the pool auditors are "
                          "proven to catch a REAL seeded leak, never a "
                          "mocked report",
+    "jit.closure_vary": "detection drill (ISSUE 17): an armed 'fail' "
+                        "makes the paged engine's next step jit capture "
+                        "a varied closure constant — the silent-retrace "
+                        "bug class (same compile key, different traced "
+                        "program) — so the jit retrace witness "
+                        "(common/jitwit.py) is proven to catch a REAL "
+                        "recompile, never a mocked report",
 }
 
 

@@ -1,0 +1,364 @@
+"""Runtime retrace witness: the dynamic half of mtlint's compile-cache
+analysis (ISSUE 17) — the lockdep/ownwit move, applied to XLA
+compilation.
+
+The static side (marian_tpu/analysis/jitgraph.py + the MT-JIT rule
+family) enumerates every jit boundary and derives each site's
+compile-key domain from the ``# buckets: <REGISTRY>`` vocabulary. Its
+documented blind spots — jit objects reached through dynamic dispatch,
+eager ops compiling per shape, a closure constant that quietly varies —
+are exactly where a compile-cache melt would hide from it. This module
+keeps the model honest the way ``MARIAN_LOCKDEP=1`` keeps the lock
+lattice honest: record what the backend actually compiled, and
+cross-check.
+
+With ``MARIAN_JITWIT=1`` in the environment (tests/conftest.py arms it
+for the whole tier-1 process) and the listener installed
+(:func:`install`, idempotent), every ``backend_compile`` the runtime
+performs is attributed — via the ``jax.monitoring`` event-duration
+hook, which fires synchronously in the compiling thread — to the
+nearest stack frame inside ``marian_tpu/``, identified
+``<rel>::<co_name>``: exactly the identity the static site scan
+derives. Separately, the engines declare their compile keys as they
+create jit objects (:func:`note_compile_key`): the key tuple plus the
+(registry, value) domain pairs each axis is drawn from.
+
+The verdict (:func:`check_against_static`, asserted at module teardown
+of the tier-1 serving/iteration/beam suites):
+
+- an observed backend compile attributed to a site the static model
+  does not mark compile-capable → blind spot; FAIL (extend
+  analysis/jitgraph.py, never baseline it);
+- a compile key NOTED TWICE by the same engine at the same site → a
+  retrace: the jit object for that key was rebuilt, so its previous
+  compile was wasted (the ``jit.closure_vary`` faultpoint drill proves
+  this trips);
+- a noted domain pair naming a registry the static scan cannot find,
+  or a value outside the registry's table (cap-clamped values at or
+  below the table max are in-domain: ``min(b, max_rows)``) → the
+  annotation vocabulary and reality disagree.
+
+Sites outside ``marian_tpu/`` (tests jitting directly) record as
+``<external>`` and are exempt — the static model does not cover test
+code; engine-driven traffic is what the witness audits. The
+closed-shape-set regression (tests/test_iteration.py) additionally
+uses :func:`strict`: a window in which EVERY backend compile is
+captured, so "warmed engine + mixed traffic = zero compiles" is
+directly assertable — the executable form of the paper's "compile
+once, serve forever".
+
+Without ``MARIAN_JITWIT=1`` the listener records nothing and
+``note_compile_key`` is one env read. Stdlib-only; jax is imported
+lazily by :func:`install` alone, so the analysis layer never pulls it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+ENV_VAR = "MARIAN_JITWIT"
+
+EXTERNAL_SITE = "<external>"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+_TOKENS = itertools.count(1)
+
+
+def new_token() -> int:
+    """Process-unique engine identity for the noted-key table. Two
+    engines legitimately note the same (site, key) — a raw ``id()``
+    can be reused after collection and would fabricate a retrace."""
+    return next(_TOKENS)
+
+
+# -- observed model ----------------------------------------------------------
+# Guarded by _WITNESS_LOCK — a plain lock, deliberately NOT lockdep-
+# witnessed and excluded from lock discovery (callgraph
+# _INSTRUMENTATION_MODULES): the monitoring listener runs inside jax's
+# compile path and is instrumentation, not part of the modeled lattice.
+
+_WITNESS_LOCK = threading.Lock()
+# every backend compile: (site, seconds)
+_COMPILES: List[Tuple[str, float]] = []
+_COMPILE_SITES: Set[str] = set()
+# noted compile keys: (site, token, key) -> count (count > 1 = retrace)
+_NOTED: Dict[Tuple[str, int, tuple], int] = {}
+# noted (registry, value) domain pairs per (site, key axis)
+_DOMAINS: List[Tuple[str, str, int]] = []     # (site, registry, value)
+_RETRACES: List[Tuple[str, tuple]] = []       # (site, key)
+_STRICT: List["StrictWindow"] = []
+
+_INSTALLED = False
+
+# frames inside this file are instrumentation, not call sites
+_SKIP_SUFFIXES = ("common/jitwit.py", "common\\jitwit.py")
+
+_ROOT: Optional[str] = None
+
+# per-file cache: does this module hold jax in its globals? (compiles
+# are rare, but the frame walk must stay cheap under repeat events)
+_JAX_GLOBALS: Dict[str, bool] = {}
+
+
+def _frame_uses_jax(f) -> bool:
+    """Runtime mirror of the static model's per-module capability claim
+    (jitgraph ``_imports_jax``): a module with no jax binding in scope
+    cannot ORIGINATE a compile — when its frame is on the stack at
+    compile time it is invoking someone else's computation (the
+    pipelined/scheduler higher-order shape), and attribution belongs to
+    the frame that built it. Module objects named jax/jax.* in the
+    frame's globals count; locals cover the lazy in-function
+    ``import jax`` idiom."""
+    import types
+    fname = f.f_code.co_filename
+    uses = _JAX_GLOBALS.get(fname)
+    if uses is None:
+        uses = any(
+            isinstance(v, types.ModuleType)
+            and (v.__name__ == "jax" or v.__name__.startswith("jax."))
+            for v in list(f.f_globals.values()))
+        _JAX_GLOBALS[fname] = uses
+    if uses:
+        return True
+    return any(
+        isinstance(v, types.ModuleType)
+        and (v.__name__ == "jax" or v.__name__.startswith("jax."))
+        for v in list(f.f_locals.values()))
+
+
+def _find_root() -> Optional[str]:
+    global _ROOT
+    if _ROOT is None:
+        cur = os.path.dirname(os.path.abspath(__file__))
+        for _ in range(6):
+            if os.path.exists(os.path.join(cur, "pyproject.toml")):
+                _ROOT = cur
+                break
+            cur = os.path.dirname(cur)
+    return _ROOT
+
+
+def _site() -> str:
+    """The acting site: nearest stack frame inside ``marian_tpu/``
+    (skipping this module), '<rel>::<co_name>' — the static model's
+    site identity. Unlike ownwit's walk, frames OUTSIDE marian_tpu are
+    stepped over, not terminal: the monitoring listener fires under a
+    stack of jax internals and the attribution target is the marian
+    frame beneath them. Synthetic frames (``<listcomp>``/``<lambda>``)
+    and frames in jax-free marian modules are stepped over too — the
+    static identity is the enclosing FUNCTION, and a module with no
+    jax in scope is running someone else's computation
+    (:func:`_frame_uses_jax`). No attributable marian frame at all →
+    EXTERNAL_SITE (tests jitting directly), exempt from the
+    cross-check."""
+    root = _find_root()
+    if root is None:
+        return EXTERNAL_SITE
+    f = sys._getframe(1)
+    while f is not None:
+        fname = f.f_code.co_filename
+        norm = fname.replace("\\", "/")
+        if not norm.endswith(_SKIP_SUFFIXES[0]) \
+                and not f.f_code.co_name.startswith("<"):
+            # synthetic frames (<listcomp>/<genexpr>/<lambda>/<module>,
+            # pre-3.12 comprehensions get their own frame) are stepped
+            # over: the static model's site identity is the enclosing
+            # FUNCTION — `[enc(x) for x in ...]` compiles in the frame
+            # that wrote the comprehension
+            try:
+                rel = os.path.relpath(fname, root).replace("\\", "/")
+            except ValueError:              # different drive (windows)
+                rel = ""
+            if rel.startswith("marian_tpu/") and _frame_uses_jax(f):
+                return f"{rel}::{f.f_code.co_name}"
+        f = f.f_back
+    return EXTERNAL_SITE
+
+
+# -- the jax.monitoring listener ---------------------------------------------
+
+def install() -> bool:
+    """Register the backend-compile listener (idempotent; returns
+    whether a listener is in place). Imports jax lazily — call sites
+    that must stay stdlib-only guard on :func:`enabled` first. The
+    listener itself re-checks ``enabled()`` per event, so clearing the
+    env var disarms recording without unregistration (jax.monitoring
+    has no public remove)."""
+    global _INSTALLED
+    if _INSTALLED:
+        return True
+    try:
+        import jax.monitoring as jmon
+    except Exception:                        # pragma: no cover - no jax
+        return False
+
+    def _on_event(name: str, secs: float, **_kw) -> None:
+        if not name.endswith("backend_compile_duration"):
+            return
+        if not enabled():
+            return
+        site = _site()
+        with _WITNESS_LOCK:
+            _COMPILES.append((site, secs))
+            _COMPILE_SITES.add(site)
+            for w in _STRICT:
+                w.compiles.append((site, secs))
+
+    jmon.register_event_duration_secs_listener(_on_event)
+    _INSTALLED = True
+    return True
+
+
+# -- engine-side declarations ------------------------------------------------
+
+def note_compile_key(token: int, key: tuple,
+                     domains: Sequence[Tuple[str, int]] = ()) -> None:
+    """Declare that a NEW jit object keyed by ``key`` now exists for
+    the engine identified by ``token`` (placed exactly where engines
+    create/cache jit objects — ``_make_step``, ``_install`` shape
+    admission, fork-pad creation). ``domains`` names the registry each
+    bucketed axis was drawn from, e.g. ``(("ROW_BUCKETS", rb),)``.
+
+    A second note of the same (site, token, key) is a RETRACE: the
+    engine rebuilt a jit object it already paid for — the varying-
+    closure failure mode, and what the ``jit.closure_vary`` drill
+    seeds."""
+    if not enabled():
+        return
+    site = _site()
+    with _WITNESS_LOCK:
+        k = (site, token, key)
+        n = _NOTED.get(k, 0) + 1
+        _NOTED[k] = n
+        if n > 1:
+            _RETRACES.append((site, key))
+        for reg, val in domains:
+            _DOMAINS.append((site, reg, int(val)))
+
+
+class StrictWindow:
+    """Every backend compile observed while the window is open."""
+
+    def __init__(self):
+        self.compiles: List[Tuple[str, float]] = []
+
+    def __enter__(self) -> "StrictWindow":
+        with _WITNESS_LOCK:
+            _STRICT.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _WITNESS_LOCK:
+            if self in _STRICT:
+                _STRICT.remove(self)
+
+
+def strict() -> StrictWindow:
+    """Open a capture window: ``with jitwit.strict() as w: ...`` then
+    assert on ``w.compiles`` — the closed-shape-set regression asserts
+    it stays EMPTY across mixed traffic on a grid-warmed engine."""
+    return StrictWindow()
+
+
+# -- inspection / verdict ----------------------------------------------------
+
+def observed_compiles() -> List[Tuple[str, float]]:
+    with _WITNESS_LOCK:
+        return list(_COMPILES)
+
+
+def observed_compile_sites() -> Set[str]:
+    with _WITNESS_LOCK:
+        return set(_COMPILE_SITES)
+
+
+def noted_keys() -> Dict[Tuple[str, int, tuple], int]:
+    with _WITNESS_LOCK:
+        return dict(_NOTED)
+
+
+def retraces() -> List[Tuple[str, tuple]]:
+    """(site, key) for every duplicate note — the drill surface."""
+    with _WITNESS_LOCK:
+        return list(_RETRACES)
+
+
+def reset() -> None:
+    """Forget everything observed so far (tests). The installed
+    listener stays; state is what resets."""
+    with _WITNESS_LOCK:
+        _COMPILES.clear()
+        _COMPILE_SITES.clear()
+        _NOTED.clear()
+        _DOMAINS.clear()
+        _RETRACES.clear()
+
+
+def _value_in_domain(model, reg: str, val: int) -> bool:
+    if reg == "POW2":
+        return val >= 1 and (val & (val - 1)) == 0
+    if reg == "HALVING":
+        return val >= 1
+    vals = model.registry_values(reg)
+    if vals is None:
+        return False
+    # cap-clamped draws (min(b, max_rows)) land at or below the table
+    # max without being table members — in-domain: the table still
+    # bounds the key count
+    return val in vals or val <= max(vals)
+
+
+def check(model) -> List[str]:
+    """Violations of the static model by what actually compiled,
+    against an ``analysis.jitgraph.JitModel``. Empty list = every
+    observed backend compile originated at a compile-capable site,
+    no noted key was retraced, and every noted domain pair is drawn
+    from a known registry within its table. ``<external>`` compile
+    sites (tests jitting directly) are exempt by design."""
+    violations: List[str] = []
+    for s in sorted(observed_compile_sites() - {EXTERNAL_SITE}):
+        if s not in model.compile_capable:
+            violations.append(
+                f"observed backend compile at site {s}, which the "
+                f"static jit model never predicted — "
+                f"analysis/jitgraph.py's site scan or capability map "
+                f"has a blind spot; extend the model, do not baseline "
+                f"this")
+    for site, key in retraces():
+        violations.append(
+            f"compile key {key!r} at site {site} was noted more than "
+            f"once by the same engine — a RETRACE: the jit object was "
+            f"rebuilt and its previous compile wasted (varying "
+            f"closure / cache eviction); fix the site, do not "
+            f"baseline this")
+    with _WITNESS_LOCK:
+        domains = list(_DOMAINS)
+    for site, reg, val in domains:
+        if not model.known_registry(reg):
+            violations.append(
+                f"site {site} noted compile-key domain registry "
+                f"'{reg}' which the static registry scan cannot find "
+                f"— the # buckets: vocabulary and runtime disagree")
+        elif not _value_in_domain(model, reg, val):
+            violations.append(
+                f"site {site} noted key value {val} as drawn from "
+                f"{reg}, but it is outside that table — the declared "
+                f"domain does not bound this site's compile keys")
+    return violations
+
+
+def check_against_static(root) -> List[str]:
+    """:func:`check` against the jit model built from the repo at
+    ``root`` — the cross-check the tier-1 serving/iteration/beam
+    suites assert at module teardown. The analysis layer is
+    stdlib-only, so this never imports jax."""
+    from ..analysis.jitgraph import static_jit_model
+    return check(static_jit_model(root))

@@ -33,8 +33,13 @@ class Embedder:
         self.model = create_model(options, self.vocabs[0],
                                   self.vocabs[0], inference=True)
 
+        # close over a hoisted local, not self.model: the trace bakes in
+        # whatever the closure reads, and an instance mutation would
+        # silently retrace (MT-JIT-CLOSURE-VARYING)
+        model = self.model
+
         def embed(params, src_ids, src_mask):
-            enc = self.model.encode_for_decode(params, src_ids, src_mask)
+            enc = model.encode_for_decode(params, src_ids, src_mask)
             m = src_mask[..., None]
             return (enc * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
 

@@ -85,6 +85,17 @@ def width_bucket_key(width: int) -> str:
     return f"w{int(width)}"
 
 
+def round_bucket_key(row_bucket: int, encode_width: int, steps: int) -> str:
+    """The compile-telemetry bucket label for one iteration-mode engine
+    round (ISSUE 17): the engine's compile key is the (row bucket,
+    encode width, steps-per-round) triple — a round landing on a triple
+    nobody warmed is a steady-state recompile incident exactly like an
+    unwarmed width in request mode. The lifecycle warmup drives the
+    engine's full grid (PagedDecodeEngine.warm_grid) and registers
+    these keys via ``warm_bucket``."""
+    return f"r{int(row_bucket)}.w{int(encode_width)}.s{int(steps)}"
+
+
 class _Geometry:
     """Model geometry for the analytic MFU estimate (common/flops.py)."""
 
@@ -322,11 +333,17 @@ class PerfMeter:
     # -- serving batch accounting (event-loop thread) -----------------------
     def record_batch(self, model_version: str, rows: int, width: int,
                      src_tokens: int, trg_tokens: int,
-                     device_s: float) -> None:
+                     device_s: float,
+                     bucket_key: Optional[str] = None) -> None:
         """One device batch: integrate counters, refresh the rolling
         gauges, and run the steady-state compile check for the batch's
         width bucket. ``device_s`` must be measured to the result fence
         (the caller's contract — see the module docstring).
+        ``bucket_key`` overrides the default ``width_bucket_key(width)``
+        compile-bucket label — iteration mode passes the engine round's
+        :func:`round_bucket_key` triple so the steady-state recompile
+        check tracks the engine's REAL compile key, not just the padded
+        width.
 
         Attribution caveat: ``model_version`` is the label the CALLER
         stamps (the scheduler's version_fn — the live version at batch
@@ -393,7 +410,8 @@ class PerfMeter:
         if peak > 0 and v_dev > 0:
             mfu = v_flops / (v_dev * peak)
         self.m_mfu.labels(version).set(mfu)
-        self._bucket_seen(version, width_bucket_key(width), device_s)
+        self._bucket_seen(version, bucket_key or width_bucket_key(width),
+                          device_s)
 
     def _prune(self, now: float) -> None:
         """Evict samples older than the window, decrementing the global

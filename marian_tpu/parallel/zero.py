@@ -489,7 +489,9 @@ def build_train_step(model, opt_cfg: OptimizerConfig, schedule, cost_type: str,
     if opt_cfg.check_gradient_nan:
         metrics_shardings["skipped"] = rep
 
-    return jax.jit(
+    return jax.jit(  # mtlint: ok -- built once per training launch:
+        # n_updates is a launch flag (--dispatch-window), not a
+        # per-request key, so the domain is one value per process
         step_fn,
         out_shardings=(p_shardings, o_shardings, metrics_shardings),
         donate_argnums=(0, 1) if donate else ())

@@ -42,15 +42,19 @@ class Rescorer:
         self.model = create_model(options, src_side,
                                   self.vocabs[-1], inference=True)
 
+        # hoisted: the traced fn must not read self.model through its
+        # closure — a rebind would silently retrace (MT-JIT-CLOSURE-VARYING)
+        model = self.model
+
         def per_sentence_ce(params, batch):
             from .models import transformer as T
-            cparams = T.cast_params(params, self.model.cfg.compute_dtype)
-            src_ids, src_mask = self.model._batch_sources(batch)
-            enc = self.model._mod.encode(self.model.cfg, cparams,
-                                         src_ids, src_mask,
-                                         False, None)
-            logits = self.model._mod.decode_train(
-                self.model.cfg, cparams, enc, src_mask,
+            cparams = T.cast_params(params, model.cfg.compute_dtype)
+            src_ids, src_mask = model._batch_sources(batch)
+            enc = model._mod.encode(model.cfg, cparams,
+                                    src_ids, src_mask,
+                                    False, None)
+            logits = model._mod.decode_train(
+                model.cfg, cparams, enc, src_mask,
                 batch["trg_ids"], batch["trg_mask"], train=False)
             ce = cross_entropy(logits, batch["trg_ids"], 0.0)
             ce = ce * batch["trg_mask"]

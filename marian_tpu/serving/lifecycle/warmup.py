@@ -34,7 +34,8 @@ from typing import Callable, Dict, List, Optional
 from ...common import faultpoints as fp
 from ...common import logging as log
 from ...data.batch_generator import DEFAULT_LENGTH_BUCKETS, bucket_length
-from ...obs.perf import PERF, TRIGGER_SWAP, width_bucket_key
+from ...obs.perf import (PERF, TRIGGER_SWAP, round_bucket_key,
+                         width_bucket_key)
 from ...training import bundle as bdl
 
 # Built-in golden probe when --warmup-golden is unset: short sentences in
@@ -130,6 +131,52 @@ def smoke_buckets(executor: Callable[[List[str]], List[str]],
         PERF.warm_bucket(version, width_bucket_key(width), dt, trigger)
 
 
+def smoke_engine_grid(executor, version: str, trigger: str,
+                      where: str) -> None:
+    """Iteration-mode bucket-grid smoke (ISSUE 17 satellite): when the
+    warmed executor wraps a paged decode engine (EngineExecutor), drive
+    the engine's FULL compile-key grid — every row bucket and every
+    halving encode width (PagedDecodeEngine.warm_grid) — and register
+    each (row bucket, encode width, steps) triple in the perf meter's
+    warm ledger under the :func:`round_bucket_key` vocabulary the
+    scheduler reports rounds with. After this, a steady-state round can
+    reach NO round key that was not warmed here, so any
+    ``trigger=steady-state`` compile incident on a round key is a real
+    compile-cache bug (the closed-shape-set claim, asserted end-to-end
+    by the jit retrace witness, common/jitwit.py). The composite grid is
+    registered in full: warm_grid drives each row bucket at one width
+    and each width at one row bucket, but both component jits (step and
+    install) are keyed independently, so every cross pairing is warm by
+    construction — the undriven pairings register at 0.0 s."""
+    engine = getattr(executor, "engine", None)
+    warm_grid = getattr(engine, "warm_grid", None)
+    if warm_grid is None:
+        return
+    try:
+        with PERF.compile_context(trigger):
+            driven = warm_grid()
+    except Exception as e:  # noqa: BLE001
+        raise WarmupError(f"engine bucket-grid smoke failed for "
+                          f"{where}: {e}") from e
+    seen = set()
+    for rb, enc_w, steps, dt in driven:
+        key = round_bucket_key(rb, enc_w, steps)
+        if key in seen:
+            continue
+        seen.add(key)
+        PERF.warm_bucket(version, key, dt, trigger)
+    steps = int(getattr(engine, "steps_per_round", 1))
+    for rb in getattr(engine, "row_buckets", ()):
+        for enc_w in engine.encode_widths():
+            key = round_bucket_key(rb, enc_w, steps)
+            if key not in seen:
+                seen.add(key)
+                PERF.warm_bucket(version, key, 0.0, trigger)
+    log.info("model lifecycle: engine bucket grid warmed for {} — {} "
+             "round keys registered ({} driven)", where, len(seen),
+             len(driven))
+
+
 def warm_executor(bundle_dir: str, manifest: Optional[Dict],
                   executor_factory: Callable[[str, Optional[Dict]],
                                              Callable[[List[str]],
@@ -155,6 +202,8 @@ def warm_executor(bundle_dir: str, manifest: Optional[Dict],
     if PERF.enabled:
         smoke_buckets(executor, golden, version or bundle_dir, trigger,
                       bundle_dir)
+        smoke_engine_grid(executor, version or bundle_dir, trigger,
+                          bundle_dir)
     else:
         try:
             out = executor(list(golden))

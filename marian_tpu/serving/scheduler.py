@@ -1203,10 +1203,16 @@ class ContinuousScheduler:
                 # step cost over the tokens THIS step emitted (src
                 # tokens credit at sentence completion, like request
                 # mode credits on delivery)
+                # the round's compile key is the (row bucket, encode
+                # width, steps) TRIPLE, not the padded width — pass the
+                # round key so an unwarmed engine shape fires the
+                # steady-state recompile incident (ISSUE 17)
                 obs.PERF.record_batch(
                     self._version_label(), rows=res.rows,
                     width=res.bucket, src_tokens=src_done,
-                    trg_tokens=res.tokens, device_s=res.device_s)
+                    trg_tokens=res.tokens, device_s=res.device_s,
+                    bucket_key=obs.perf.round_bucket_key(
+                        res.bucket, res.enc_bucket, res.steps))
         if rspan is not None:
             # rows that finished this round already left _active_units;
             # their trace ids still belong on the round's cross-links

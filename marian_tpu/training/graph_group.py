@@ -248,10 +248,15 @@ class GraphGroup:
         self._grad_fn = build_grad_fn(model, mesh, self.params,
                                       frozen=frozen, grad_dtype=grad_dtype)
 
+        # hoisted: the branch below is resolved AT TRACE TIME, so the
+        # traced fn must not read self.cost_type through its closure — a
+        # later rebind would silently retrace (MT-JIT-CLOSURE-VARYING)
+        cost_type = self.cost_type
+
         def update_step(p, opt_state, grads, step, labels, n_sents):
-            if self.cost_type in ("ce-mean-words", "perplexity"):
+            if cost_type in ("ce-mean-words", "perplexity"):
                 denom = jnp.maximum(labels, 1.0)
-            elif self.cost_type == "ce-mean":
+            elif cost_type == "ce-mean":
                 denom = jnp.maximum(n_sents, 1.0)
             else:
                 denom = jnp.asarray(1.0, jnp.float32)

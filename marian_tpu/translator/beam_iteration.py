@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import jitwit
 from ..data.vocab import EOS_ID, UNK_ID
 from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, PoolExhausted,
                                   ROW_BUCKETS, bucket_rows,
@@ -359,6 +360,7 @@ class PagedBeamEngine(PagedDecodeEngine):
                 for h in s.hyps if h.slot is not None)
 
     # -- the step -----------------------------------------------------------
+    # buckets: ROW_BUCKETS
     def _make_step(self, rb: int):
         model = self.model
         k = self.beam_size
@@ -440,6 +442,9 @@ class PagedBeamEngine(PagedDecodeEngine):
             vals, idx = jax.lax.top_k(comb, k)
             return vals, idx, new_state
 
+        # beam rounds are single-step (steps_per_round forced to 1)
+        jitwit.note_compile_key(self._jitwit_token, ("step", rb, 1),
+                                domains=(("ROW_BUCKETS", rb),))
         return jax.jit(step, donate_argnums=(0,))
 
     def _make_pool_fork(self, n: int):
@@ -458,6 +463,8 @@ class PagedBeamEngine(PagedDecodeEngine):
                 new_state[vk] = nv
             return new_state
 
+        jitwit.note_compile_key(self._jitwit_token, ("pool_fork", n),
+                                domains=(("POW2", n),))
         return jax.jit(fork, donate_argnums=(0,))
 
     def _feature_args(self, rb: int) -> Tuple[object, ...]:
@@ -614,6 +621,7 @@ class PagedBeamEngine(PagedDecodeEngine):
         res.bucket = rb
         res.tokens = live_rows
         res.steps += 1
+        res.enc_bucket = self._enc_w   # round compile key (ISSUE 17)
 
     def _merge_sentence(self, sent: _Sent, vals, idx,
                         fork_src: List[int], fork_dst: List[int]

@@ -106,7 +106,7 @@ def greedy_decode_paged(model, params, src_ids: jnp.ndarray,
     from ..ops.pallas.kv_pool import state_key_groups
     row_keys, pool_keys, whole_keys = state_key_groups(state)
 
-    def step_fn(rb: int):
+    def step_fn(rb: int):  # buckets: ROW_BUCKETS
         fn = step_jits.get(rb)
         if fn is None:
             def stp(st, sm, p, pr, po, tb):

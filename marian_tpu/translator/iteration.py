@@ -52,6 +52,7 @@ import numpy as np
 
 from .. import obs
 from ..common import faultpoints as fp
+from ..common import jitwit
 from ..common import lockdep
 from ..common import logging as log
 from ..data.vocab import EOS_ID
@@ -97,6 +98,10 @@ class StepResult:
     bucket: int = 0               # compiled row bucket the round ran at
     tokens: int = 0               # target tokens consumed this round
     steps: int = 0                # decode steps the round advanced
+    # the engine's last install width (halving encode bucket; 0 before
+    # any install) — with `bucket` and `steps` it forms the round's
+    # steady-state compile key the scheduler reports to obs.PERF
+    enc_bucket: int = 0
     device_s: float = 0.0         # admit+step wall on the worker thread
     mid_decode_joins: int = 0     # joins that landed beside running rows
     # per-row lifecycle instants this round (ISSUE 14): (key, name,
@@ -282,6 +287,17 @@ class PagedDecodeEngine:
         self._step_jit: Dict[int, object] = {}
         self._install_jit: Dict[int, object] = {}
         self._fork_jit = None
+        # retrace witness (common/jitwit.py, ISSUE 17): every jit
+        # object this engine creates is noted under this token, so a
+        # REBUILD of an already-noted compile key is caught as a
+        # retrace at suite teardown. (jb, w) install shapes are noted
+        # on first admission — the install jit's own cache compiles
+        # one kernel per shape pair.
+        self._jitwit_token = jitwit.new_token()
+        self._install_shapes: set = set()    # (jb, w) pairs compiled
+        self._enc_w = 0     # last install width: the round's encode
+        #                     bucket for steady-state recompile keys
+        self._jit_drill_nonce = 0   # jit.closure_vary drill counter
 
         if registry is not None:
             self._declare_metrics(registry)
@@ -818,6 +834,7 @@ class PagedDecodeEngine:
                 new_state[vk] = nv
             return new_state, new_mask
 
+        jitwit.note_compile_key(self._jitwit_token, ("fork",))
         return jax.jit(fork, donate_argnums=(0, 1))
 
     def _evict(self, key, adopt_text: Optional[str] = None) -> bool:  # owns: callee -- the row exit: releases (or adopts into the prefix cache) what _try_claim acquired
@@ -1135,6 +1152,12 @@ class PagedDecodeEngine:
                 # (jb, w) shape pair
                 fn = self._make_install()
                 self._install_jit[0] = fn
+            if (jb, w) not in self._install_shapes:
+                self._install_shapes.add((jb, w))
+                jitwit.note_compile_key(
+                    self._jitwit_token, ("install", jb, w),
+                    domains=(("JOIN_BUCKETS", jb), ("HALVING", w)))
+            self._enc_w = w
             self._state, self._src_mask = fn(
                 self._state, self._src_mask, self.params,
                 jnp.asarray(ids_np), jnp.asarray(mask_np),
@@ -1180,6 +1203,7 @@ class PagedDecodeEngine:
 
         return jax.jit(install, donate_argnums=(0, 1))
 
+    # buckets: ROW_BUCKETS
     def _make_step(self, rb: int):
         model = self.model
         k_steps = self.steps_per_round
@@ -1196,6 +1220,15 @@ class PagedDecodeEngine:
             else 0
         seed = int(plane.seed) if plane is not None else 0
         from .beam_search import NEG_INF
+        # the jit.closure_vary drill's varying closure constant: 0 in
+        # real runs (and folded away); under the armed faultpoint the
+        # nonce changes per rebuild, making each rebuilt step a
+        # genuinely different traced program — the retrace the witness
+        # must catch
+        drill_nonce = self._jit_drill_nonce
+        jitwit.note_compile_key(self._jitwit_token,
+                                ("step", rb, k_steps),
+                                domains=(("ROW_BUCKETS", rb),))
 
         def step(state, src_mask, params, prev, pos, table, *extras):
             # row-indexed leaves run at the bucket prefix; pools and
@@ -1257,7 +1290,8 @@ class PagedDecodeEngine:
                 new_pools = {k: new_sub[k] for k in pool_keys}
                 return (new_pools, nxt[:, None], pos_t + 1), nxt
 
-            init = ({k: state[k] for k in pool_keys}, prev, pos)
+            init = ({k: state[k] for k in pool_keys}, prev,
+                    pos + drill_nonce - drill_nonce)
             (pools, _, _), toks = jax.lax.scan(
                 body, init, jnp.arange(k_steps))
             new_state = dict(state)
@@ -1315,6 +1349,15 @@ class PagedDecodeEngine:
             if s is not None:
                 pos_np[i] = s.pos
                 prev_np[i, 0] = s.prev
+        # seeded retrace drill (jit.closure_vary): discard the cached
+        # step jit and rebuild it around a varying closure constant —
+        # a REAL retrace+recompile of an already-noted key, which the
+        # jitwit must flag (tests/test_jitwit.py)
+        try:
+            fp.fault_point("jit.closure_vary")
+        except fp.InjectedFault:
+            self._jit_drill_nonce += 1
+            self._step_jit.pop(rb, None)
         fn = self._step_jit.get(rb)
         if fn is None:
             fn = self._make_step(rb)
@@ -1378,6 +1421,7 @@ class PagedDecodeEngine:
         res.bucket = rb
         res.tokens = consumed
         res.steps += k_steps
+        res.enc_bucket = self._enc_w
 
     # -- direct (non-serving) decoding: tests, benches, warmup smoke --------
     def decode_texts(self, texts: Sequence[str]) -> List[str]:
@@ -1408,6 +1452,51 @@ class PagedDecodeEngine:
             if guard > 100000:
                 raise RuntimeError("iteration decode failed to converge")
         return [out[i] for i in range(len(texts))]
+
+    def encode_widths(self) -> Tuple[int, ...]:
+        """The halving encode-width chain _install draws from:
+        src_cap, /2, /4, ... down to 8 — the engine's full encode
+        bucket table (descending)."""
+        widths = []
+        w = self.src_cap
+        while True:
+            widths.append(w)
+            if w // 2 < 8:
+                break
+            w //= 2
+        return tuple(widths)
+
+    def warm_grid(self) -> List[Tuple[int, int, int, float]]:
+        """Drive the engine's FULL compile-key grid off the serving
+        path (lifecycle warmup, ISSUE 17 satellite): every row bucket
+        at the narrowest width, then every encode width at one row —
+        after this, steady-state traffic can reach no step or install
+        shape that is not already compiled (the closed-shape-set
+        claim, asserted by tests/test_iteration.py's jitwit strict
+        window). Returns (row_bucket, encode_width, steps, seconds)
+        rows for each driven decode; the lifecycle layer folds them
+        into PERF's warm ledger under the round-key vocabulary."""
+        rows: List[Tuple[int, int, int, float]] = []
+        # joiner counts that reach every runtime-reachable bucket: each
+        # row bucket as an active-row count (step grid) and each join
+        # bucket clamped to capacity (install grid) — the two jit caches
+        # key independently, so the count × width double loop closes
+        # BOTH tables
+        counts = sorted(set(self.row_buckets)
+                        | {min(jb, self.max_rows)
+                           for jb in self.JOIN_BUCKETS})
+        for w in self.encode_widths():
+            # enough source tokens that the halving loop stops at w
+            # (> w/2), within the engine's source cap
+            n_words = max(1, min(w // 2, self.src_cap - 2))
+            text = " ".join(["a"] * n_words)
+            for n in counts:
+                t0 = time.perf_counter()
+                self.decode_texts([text] * n)
+                rows.append((bucket_rows(n, self.row_buckets),
+                             self._enc_w, self.steps_per_round,
+                             time.perf_counter() - t0))  # mtlint: ok -- decode_texts returns host strings: every round already synced, the window is wall-clock warmup cost by design
+        return rows
 
 
 class EngineExecutor:

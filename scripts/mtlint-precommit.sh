@@ -13,8 +13,11 @@
 # plus the project-scope rules (metrics/fault hygiene and the call-graph
 # lock families, which are cross-file by definition and always re-run).
 # The cache invalidates itself on a RULESET_VERSION bump or any config
-# change; the full uncached run in CI (tests/test_mtlint.py tier-1 gate)
-# stays the source of truth.
+# change — that is how new rule families (latest: the MT-JIT
+# compile-cache family, ruleset v7) reach this hook with zero edits
+# here: the bump re-fingerprints every entry and the next run analyzes
+# the whole tree once under the new ruleset. The full uncached run in
+# CI (tests/test_mtlint.py tier-1 gate) stays the source of truth.
 set -e
 # git runs hooks from the repo toplevel and $0 may be an unresolved
 # symlink into .git/hooks/ — dirname "$0" would land in .git/. Prefer

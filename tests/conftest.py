@@ -35,9 +35,26 @@ os.environ.setdefault("MARIAN_POOL_AUDIT", "1")
 # fixture below). Read at pool-construction time, so module-level here.
 os.environ.setdefault("MARIAN_OWNWIT", "1")
 
+# Arm the runtime jit RETRACE witness (ISSUE 17): every backend compile
+# the process performs (jax.monitoring's backend_compile_duration events)
+# is attributed to the nearest marian_tpu frame, and the tier-1
+# serving/iteration/beam suites assert at teardown that every observed
+# compile maps to a site the static jit model (analysis/jitgraph.py)
+# predicted — and that no instrumented compile key was ever traced twice
+# (a silent retrace). Read lazily by common/jitwit.py, but set before the
+# first marian_tpu import for symmetry with the other witnesses.
+os.environ.setdefault("MARIAN_JITWIT", "1")
+
 from marian_tpu.common.hermetic import force_cpu_devices  # noqa: E402
 
 jax = force_cpu_devices(8)
+
+# The compile listener must be registered before the first jit runs so
+# the witness sees EVERY compile in the process, not just post-arming
+# ones (idempotent; no-op when MARIAN_JITWIT is unset).
+from marian_tpu.common import jitwit  # noqa: E402
+
+jitwit.install()
 
 import numpy as np
 import pytest
@@ -163,6 +180,30 @@ def ownership_witness():
             "runtime ownership witness contradicts the static ownership "
             "graph (docs/STATIC_ANALYSIS.md 'The ownership witness'):\n"
             + "\n".join(violations))
+
+
+@pytest.fixture(scope="module")
+def jitwit_witness():
+    """Runtime jit retrace witness cross-check (ISSUE 17), shared by the
+    tier-1 serving/iteration/beam suites (module-scoped autouse aliases
+    there, mirroring `lockdep_witness`/`ownership_witness`): at module
+    teardown, every backend compile the witness OBSERVED must be
+    attributed to a function the static jit model (analysis/jitgraph.py)
+    knows can compile, every instrumented compile key's domain values
+    must come from their declared bucket registries, and NO instrumented
+    key may have been traced twice (a silent retrace — the compile-cache
+    bug class MT-JIT-CLOSURE-VARYING exists to prevent). A violation is
+    a blind spot in the jit model — extend the analysis, never baseline
+    it."""
+    yield
+    from marian_tpu.common import jitwit as jw
+    if jw.enabled():
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        violations = jw.check_against_static(root)
+        assert violations == [], (
+            "runtime jit retrace witness contradicts the static jit "
+            "compile-cache model (docs/STATIC_ANALYSIS.md 'Compile-cache "
+            "hygiene'):\n" + "\n".join(violations))
 
 
 @pytest.fixture(autouse=True)

@@ -43,6 +43,14 @@ def _ownership_witness(ownership_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _jitwit_witness(jitwit_witness):
+    """Beam step / pool-fork jits compiled here must map to sites the
+    static jit model predicts, with no instrumented-key retrace
+    (ISSUE 17)."""
+    yield
+
+
 VOCAB_WORDS = [" ".join(f"w{i}" for i in range(35))]
 TEXTS = ["w3 w4 w5", "w6 w7", "w8 w9 w10 w11", "w2 w3",
          "w4 w4 w4 w4 w4"]

@@ -39,6 +39,14 @@ def _ownership_witness(ownership_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _jitwit_witness(jitwit_witness):
+    """Every backend compile this suite's engines trigger is attributed
+    to its jit site; the shared witness asserts compiles stay inside the
+    static jit model and no instrumented key retraced (ISSUE 17)."""
+    yield
+
+
 VOCAB_WORDS = [" ".join(f"w{i}" for i in range(35))]
 
 
@@ -396,3 +404,104 @@ class TestIterationScheduler:
             assert sched.queued_pages() == 0
 
         run(main())
+
+
+# ---------------------------------------------------------------------------
+# compile-cache hygiene (ISSUE 17): the closed shape set + round-key
+# warmup telemetry
+# ---------------------------------------------------------------------------
+
+class TestClosedShapeSet:
+    def test_grid_warmed_engine_pays_zero_postwarm_compiles(self, tiny):
+        """THE closed-shape-set regression: warm a real engine across
+        its full bucket grid (warm_grid), then drive mixed-length
+        mixed-batch traffic through it — the jit retrace witness must
+        observe ZERO backend compiles in the window. This is the
+        executable form of 'compile once, serve forever': every shape
+        steady-state traffic can reach was already compiled off the
+        serving path."""
+        from marian_tpu.common import jitwit
+        eng = make_engine(tiny)
+        driven = eng.warm_grid()
+        assert driven, "warm_grid drove nothing"
+        with jitwit.strict() as w:
+            out = eng.decode_texts(TEXTS)          # mixed lengths, 5 rows
+            out2 = eng.decode_texts(TEXTS[1:3])    # different mix
+        assert len(out) == len(TEXTS) and len(out2) == 2
+        assert w.compiles == [], (
+            "post-warm traffic recompiled — the warm grid does not "
+            f"close the engine's shape set: {w.compiles}")
+
+    def test_unwarmed_engine_does_compile_in_window(self, tiny):
+        """Sanity for the regression above: the SAME traffic on a cold
+        engine does compile — proving the strict window actually
+        observes this engine's compiles (no vacuous pass)."""
+        from marian_tpu.common import jitwit
+        eng = make_engine(tiny)
+        with jitwit.strict() as w:
+            eng.decode_texts(TEXTS[:2])
+        assert any("translator/iteration.py" in site
+                   for site, _ in w.compiles)
+
+
+class TestRoundKeyWarmup:
+    def test_round_key_vocabulary(self):
+        from marian_tpu.obs.perf import round_bucket_key
+        assert round_bucket_key(4, 16, 2) == "r4.w16.s2"
+
+    def test_engine_grid_smoke_closes_steady_state_rounds(self, tiny):
+        """Satellite 1: lifecycle warmup smokes the engine's bucket
+        grid and registers every (row bucket, encode width, steps)
+        round key as warm — a steady-state round landing on any grid
+        key is NOT a recompile incident, while an off-grid key still
+        fires one (same discipline as request-mode width buckets)."""
+        from marian_tpu import obs
+        from marian_tpu.obs.perf import TRIGGER_SWAP, round_bucket_key
+        from marian_tpu.serving.lifecycle.warmup import smoke_engine_grid
+        from marian_tpu.translator.iteration import EngineExecutor
+
+        reg = msm.Registry()
+        obs.PERF.reset()
+        obs.PERF.enable(registry=reg, hook_jax=False)
+        eng = make_engine(tiny)
+        smoke_engine_grid(EngineExecutor(eng), "vG", TRIGGER_SWAP, "test")
+        # every grid pairing is warm: a round on any (rb, enc_w, steps)
+        # from the engine's own tables is not an incident
+        steps = eng.steps_per_round
+        for rb in eng.row_buckets:
+            for enc_w in eng.encode_widths():
+                obs.PERF.record_batch(
+                    "vG", rows=rb, width=rb, src_tokens=4, trg_tokens=4,
+                    device_s=0.01,
+                    bucket_key=round_bucket_key(rb, enc_w, steps))
+        assert obs.PERF.steady_recompiles() == 0
+        # an off-grid round key is still a steady-state incident
+        obs.PERF.record_batch(
+            "vG", rows=1, width=1, src_tokens=4, trg_tokens=4,
+            device_s=0.01, bucket_key=round_bucket_key(99, 512, 7))
+        assert obs.PERF.steady_recompiles() == 1
+
+    def test_warm_executor_drives_engine_grid(self, tiny):
+        """warm_executor on an iteration-mode executor reaches the
+        engine grid smoke (the lifecycle wiring, not just the helper)."""
+        from marian_tpu import obs
+        from marian_tpu.obs.perf import round_bucket_key
+        from marian_tpu.serving.lifecycle import warmup
+        from marian_tpu.translator.iteration import EngineExecutor
+
+        reg = msm.Registry()
+        obs.PERF.reset()
+        obs.PERF.enable(registry=reg, hook_jax=False)
+        eng = make_engine(tiny)
+        ex = warmup.warm_executor(
+            "bundle-x", None, lambda d, m: EngineExecutor(eng),
+            ["w3 w4"], version="vW")
+        assert ex.engine is eng
+        # a grid round key was registered warm by the smoke
+        obs.PERF.record_batch(
+            "vW", rows=1, width=1, src_tokens=2, trg_tokens=2,
+            device_s=0.01,
+            bucket_key=round_bucket_key(eng.row_buckets[0],
+                                        eng.encode_widths()[0],
+                                        eng.steps_per_round))
+        assert obs.PERF.steady_recompiles() == 0

@@ -1,0 +1,166 @@
+"""Runtime jit retrace witness (common/jitwit.py, ISSUE 17): the
+backend-compile listener, compile-key notes and retrace detection, the
+domain cross-check against the static jit model (analysis/jitgraph.py),
+engine integration over a real PagedDecodeEngine, and THE SEEDED DRILL:
+with the `jit.closure_vary` fault point armed, the engine rebuilds a
+step jit it already paid for — the witness must report the retrace AND
+observe the real backend recompile, proving the detector against a real
+compile-cache bug and never a mocked report."""
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from marian_tpu.common import faultpoints as fp
+from marian_tpu.common import jitwit
+from marian_tpu.data.vocab import DefaultVocab
+from marian_tpu.translator.iteration import PagedDecodeEngine
+
+from tests.test_beam_search import tiny_model
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _jitwit_witness(jitwit_witness):
+    """Module teardown cross-check (the drill test resets the witness
+    state it deliberately dirties, so the shared verdict stays green)."""
+    yield
+
+
+VOCAB_WORDS = [" ".join(f"w{i}" for i in range(35))]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    vocab = DefaultVocab.build(VOCAB_WORDS)
+    model, params, _ = tiny_model(vocab=len(vocab), seed=7,
+                                  **{"dec-depth": 2, "enc-depth": 2})
+    return model, params, vocab
+
+
+def make_engine(tiny, **kw):
+    model, params, vocab = tiny
+    args = dict(max_rows=4, page_len=4, src_len_cap=8, max_length_cap=12)
+    args.update(kw)
+    return PagedDecodeEngine(model, params, vocab, vocab, **args)
+
+
+class TestListener:
+    def test_armed_and_installed(self):
+        assert jitwit.enabled()        # conftest arms MARIAN_JITWIT=1
+        assert jitwit.install()        # idempotent re-install
+
+    def test_strict_window_captures_backend_compile(self):
+        with jitwit.strict() as w:
+            jax.jit(lambda x: x + 1)(jnp.ones((3,)))
+        assert len(w.compiles) >= 1
+        # test-driven compiles attribute to <external>: exempt from the
+        # static cross-check by design (the model covers marian_tpu/)
+        assert all(site == jitwit.EXTERNAL_SITE for site, _ in w.compiles)
+
+    def test_strict_window_closes(self):
+        with jitwit.strict() as w:
+            pass
+        jax.jit(lambda x: x * 2)(jnp.ones((3,)))
+        assert w.compiles == []
+
+
+class TestNotesAndRetraces:
+    def test_duplicate_note_same_engine_is_a_retrace(self):
+        jitwit.reset()
+        tok = jitwit.new_token()
+        jitwit.note_compile_key(tok, ("step", 4, 2),
+                                domains=(("POW2", 4),))
+        assert jitwit.retraces() == []
+        other = jitwit.new_token()
+        # a DIFFERENT engine noting the same key is legitimate
+        jitwit.note_compile_key(other, ("step", 4, 2))
+        assert jitwit.retraces() == []
+        jitwit.note_compile_key(tok, ("step", 4, 2))
+        assert len(jitwit.retraces()) == 1
+        vs = jitwit.check_against_static(ROOT)
+        assert any("RETRACE" in v for v in vs)
+        jitwit.reset()
+
+    def test_unknown_registry_fails_the_verdict(self):
+        jitwit.reset()
+        tok = jitwit.new_token()
+        jitwit.note_compile_key(tok, ("k", 3),
+                                domains=(("NO_SUCH_TABLE", 3),))
+        vs = jitwit.check_against_static(ROOT)
+        assert any("NO_SUCH_TABLE" in v for v in vs)
+        jitwit.reset()
+
+
+class TestDomainValidation:
+    @pytest.fixture(scope="class")
+    def model(self):
+        from marian_tpu.analysis.jitgraph import static_jit_model
+        return static_jit_model(ROOT)
+
+    def test_registries_discovered(self, model):
+        assert model.known_registry("ROW_BUCKETS")
+        assert model.known_registry("JOIN_BUCKETS")
+        assert model.known_registry("POW2")        # virtual
+        assert model.known_registry("HALVING")     # virtual
+        assert not model.known_registry("NO_SUCH_TABLE")
+
+    def test_value_in_domain(self, model):
+        assert jitwit._value_in_domain(model, "POW2", 8)
+        assert not jitwit._value_in_domain(model, "POW2", 6)
+        assert jitwit._value_in_domain(model, "HALVING", 1)
+        assert not jitwit._value_in_domain(model, "HALVING", 0)
+        vals = model.registry_values("ROW_BUCKETS")
+        assert vals and jitwit._value_in_domain(
+            model, "ROW_BUCKETS", max(vals))
+        # cap-clamped draws (min(b, max_rows)) are in-domain
+        assert jitwit._value_in_domain(model, "ROW_BUCKETS", 3)
+        assert not jitwit._value_in_domain(
+            model, "ROW_BUCKETS", max(vals) + 1)
+
+    def test_engine_sites_are_compile_capable(self, model):
+        assert any(
+            s.startswith("marian_tpu/translator/iteration.py::")
+            for s in model.compile_capable)
+
+
+class TestEngineIntegration:
+    def test_engine_notes_its_compile_keys(self, tiny):
+        jitwit.reset()
+        eng = make_engine(tiny)
+        out = eng.decode_texts(["w3 w4"])
+        assert len(out) == 1
+        keys = {key[0] for (_s, _t, key) in jitwit.noted_keys()}
+        assert "install" in keys and "step" in keys
+        sites = {s for (s, _t, _k) in jitwit.noted_keys()}
+        assert any("translator/iteration.py" in s for s in sites)
+        # green path: real engine traffic satisfies the static model
+        assert jitwit.check_against_static(ROOT) == []
+
+    def test_closure_vary_drill_is_caught(self, tiny):
+        """THE SEEDED DRILL: arm `jit.closure_vary` so the engine's
+        next round varies a traced closure constant and rebuilds the
+        step jit for a key it already compiled — the witness must
+        record the duplicate note as a retrace, observe the REAL
+        backend recompile it causes, and fail the verdict."""
+        jitwit.reset()
+        eng = make_engine(tiny)
+        eng.decode_texts(["w3 w4"])            # warm the rb=1 step jit
+        assert jitwit.retraces() == []
+        with fp.active("jit.closure_vary=fail@1"):
+            with jitwit.strict() as w:
+                out = eng.decode_texts(["w3 w4"])
+        assert len(out) == 1                   # traffic still served
+        rts = jitwit.retraces()
+        assert any(key[0] == "step" for (_site, key) in rts), \
+            "drill varied the step closure but no retrace was recorded"
+        # the rebuilt jit really recompiled, attributed to the engine
+        assert any("translator/iteration.py" in site
+                   for site, _ in w.compiles), \
+            "drill retrace produced no observable backend compile"
+        vs = jitwit.check_against_static(ROOT)
+        assert any("RETRACE" in v for v in vs)
+        jitwit.reset()   # leave the module-teardown verdict green

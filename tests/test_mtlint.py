@@ -969,6 +969,174 @@ class TestFaultHygiene:
         assert fs == []
 
 
+# ---------------------------------------------------------------------------
+# jit compile-cache hygiene (MT-JIT-*, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _jit_project(files: dict):
+    """Run the project-scope jit rule over an in-memory multi-file tree
+    (rel -> code); returns unsuppressed findings."""
+    cfg = Config(root=ROOT)
+    srcs = [Source(ROOT / rel, rel, text=code)
+            for rel, code in files.items()]
+    rule = next(r for r in all_rules() if r.family == "jit")
+    by = {s.rel: s for s in srcs}
+    return [f for f in rule.check_project(srcs, cfg)
+            if not by[f.path].suppressed(f)]
+
+
+class TestJitClosure:
+    REL = "marian_tpu/ops/snippet.py"
+
+    def test_self_attr_read_in_traced_body_flagged(self):
+        fs = lint_text(
+            "import jax\n"
+            "class Engine:\n"
+            "    def make(self):\n"
+            "        return jax.jit(lambda p: self.model.step(p))\n",
+            rel=self.REL, families=["jit"])
+        assert rule_ids(fs) == ["MT-JIT-CLOSURE-VARYING"]
+        assert "self.model" in fs[0].message
+
+    def test_hoisted_local_clean(self):
+        fs = lint_text(
+            "import jax\n"
+            "class Engine:\n"
+            "    def make(self):\n"
+            "        model = self.model\n"
+            "        return jax.jit(lambda p: model.step(p))\n",
+            rel=self.REL, families=["jit"])
+        assert fs == []
+
+    def test_capture_rebound_after_creation_flagged(self):
+        fs = lint_text(
+            "import jax\n"
+            "def make():\n"
+            "    k = 1\n"
+            "    fn = jax.jit(lambda x: x + k)\n"
+            "    k = 2\n"
+            "    return fn\n",
+            rel=self.REL, families=["jit"])
+        assert rule_ids(fs) == ["MT-JIT-CLOSURE-VARYING"]
+        assert "'k'" in fs[0].message
+
+
+class TestJitStaticUnbounded:
+    REL = "marian_tpu/ops/snippet.py"
+
+    FACTORY = ("import jax\n"
+               "ROW_BUCKETS = (1, 2, 4)\n"
+               "def make_step(rb):{ann}\n"
+               "    def step(x):\n"
+               "        return x[:rb]\n"
+               "    return jax.jit(step)\n")
+
+    def test_unannotated_factory_axis_flagged(self):
+        fs = lint_text(self.FACTORY.format(ann=""),
+                       rel=self.REL, families=["jit"])
+        assert rule_ids(fs) == ["MT-JIT-STATIC-UNBOUNDED"]
+        assert "make_step(rb)" in fs[0].message
+
+    def test_annotated_factory_clean(self):
+        fs = lint_text(self.FACTORY.format(ann="  # buckets: ROW_BUCKETS"),
+                       rel=self.REL, families=["jit"])
+        assert fs == []
+
+    def test_unknown_registry_name_flagged(self):
+        fs = lint_text(self.FACTORY.format(ann="  # buckets: NO_SUCH_TABLE"),
+                       rel=self.REL, families=["jit"])
+        assert rule_ids(fs) == ["MT-JIT-STATIC-UNBOUNDED"]
+        assert "NO_SUCH_TABLE" in fs[0].message
+
+    def test_virtual_registry_accepted(self):
+        fs = lint_text(self.FACTORY.format(ann="  # buckets: POW2"),
+                       rel=self.REL, families=["jit"])
+        assert fs == []
+
+    def test_static_float_literal_at_call_site_flagged(self):
+        fs = lint_text(
+            "import jax\n"
+            "def step(x, n):\n"
+            "    return x\n"
+            "step = jax.jit(step, static_argnums=(1,))\n"
+            "def drive(z):\n"
+            "    return step(z, 2.5)\n",
+            rel=self.REL, families=["jit"])
+        assert rule_ids(fs) == ["MT-JIT-STATIC-UNBOUNDED"]
+
+    def test_bucket_derived_static_clean(self):
+        fs = lint_text(
+            "import jax\n"
+            "from marian_tpu.ops.pallas.kv_pool import ROW_BUCKETS, "
+            "bucket_rows\n"
+            "def step(x, n):\n"
+            "    return x\n"
+            "step = jax.jit(step, static_argnums=(1,))\n"
+            "def drive(z, rows):\n"
+            "    return step(z, bucket_rows(rows, ROW_BUCKETS))\n",
+            rel=self.REL, families=["jit"])
+        assert fs == []
+
+
+class TestJitWeakType:
+    REL = "marian_tpu/ops/snippet.py"
+
+    def test_traced_scalar_literal_flagged(self):
+        fs = lint_text(
+            "import jax\n"
+            "def step(x, n):\n"
+            "    return x\n"
+            "step = jax.jit(step, static_argnums=(1,))\n"
+            "def drive(n):\n"
+            "    return step(1.5, n)\n",
+            rel=self.REL, families=["jit"])
+        assert rule_ids(fs) == ["MT-JIT-WEAKTYPE"]
+
+    def test_wrapped_scalar_clean(self):
+        fs = lint_text(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def step(x, n):\n"
+            "    return x\n"
+            "step = jax.jit(step, static_argnums=(1,))\n"
+            "def drive(n):\n"
+            "    return step(jnp.asarray(1.5), n)\n",
+            rel=self.REL, families=["jit"])
+        assert fs == []
+
+
+class TestJitUnwarmed:
+    ENGINE = ("import jax\n"
+              "class Eng:\n"
+              "    def decode_texts(self, lines):\n"
+              "        fn = jax.jit(lambda x: x)\n"
+              "        return fn(lines)\n")
+    SERVING = ("def handle(engine):\n"
+               "    return engine.decode_texts(['x'])\n")
+    WARMUP = ("def warm(executor):\n"
+              "    return executor.decode_texts(['x'])\n")
+
+    def test_serving_reachable_unwarmed_flagged(self):
+        fs = _jit_project({
+            "marian_tpu/translator/snip_eng.py": self.ENGINE,
+            "marian_tpu/serving/snip_srv.py": self.SERVING})
+        assert "MT-JIT-UNWARMED" in rule_ids(fs)
+        unw = [f for f in fs if f.rule == "MT-JIT-UNWARMED"]
+        assert len(unw) == 1 and "decode_texts" in unw[0].message
+
+    def test_warmup_covered_site_clean(self):
+        fs = _jit_project({
+            "marian_tpu/translator/snip_eng.py": self.ENGINE,
+            "marian_tpu/serving/snip_srv.py": self.SERVING,
+            "marian_tpu/serving/lifecycle/warmup.py": self.WARMUP})
+        assert [f for f in fs if f.rule == "MT-JIT-UNWARMED"] == []
+
+    def test_site_not_on_serving_path_clean(self):
+        fs = _jit_project({
+            "marian_tpu/translator/snip_eng.py": self.ENGINE})
+        assert [f for f in fs if f.rule == "MT-JIT-UNWARMED"] == []
+
+
 class TestSuppression:
     def test_ok_comment(self):
         fs = lint_text(
@@ -1031,7 +1199,7 @@ class TestConfig:
         assert families == {"trace-safety", "host-sync", "donation",
                             "dtype", "guarded-by", "metrics", "faults",
                             "lock-order", "lock-blocking", "guard-escape",
-                            "span", "ownership"}
+                            "span", "ownership", "jit"}
 
 
 BAD_OPS = ("import jax.numpy as jnp\n"
@@ -2057,6 +2225,18 @@ class TestBaselineRatchet:
         "MT-OWN-ESCAPE": 2,
         "MT-OWN-TRANSFER": 0,
     }
+    # ISSUE 17: the jit family starts — and stays — at zero baselined
+    # debt. MT-JIT-UNWARMED and MT-JIT-CLOSURE-VARYING may NEVER be
+    # baselined (an unwarmed serving jit compiles on a live request; a
+    # varying closure retraces silently — both are incidents, not
+    # debt); the other two are held at zero so the family's ledger can
+    # only be paid at the site (`# mtlint: ok -- reason`), never parked.
+    JIT_RULE_CEILING = {
+        "MT-JIT-CLOSURE-VARYING": 0,
+        "MT-JIT-STATIC-UNBOUNDED": 0,
+        "MT-JIT-WEAKTYPE": 0,
+        "MT-JIT-UNWARMED": 0,
+    }
 
     def test_baseline_never_grows(self):
         data = json.loads(
@@ -2097,3 +2277,20 @@ class TestBaselineRatchet:
                 f"{self.RULE_CEILING[rid]} — fix the finding; ownership "
                 f"debt is shrink-only per rule")
         assert sum(counts.values()) <= self.CEILING["ownership"]
+
+    def test_jit_baseline_never_grows_per_rule(self):
+        """ISSUE 17: the jit family's per-rule ceilings are all zero —
+        every MT-JIT rule id is named explicitly so a baselined
+        compile-cache incident can never ride in at all."""
+        data = json.loads(
+            (ROOT / "marian_tpu" / "analysis" / "baseline.json").read_text(
+                encoding="utf-8"))
+        jit_ids = {rid for r in all_rules() if r.family == "jit"
+                   for rid in r.ids}
+        assert jit_ids == set(self.JIT_RULE_CEILING), \
+            "JIT_RULE_CEILING must name every MT-JIT rule id exactly"
+        for f in data["findings"]:
+            assert f["rule"] not in jit_ids, (
+                f"baseline contains {f['rule']} — compile-cache findings "
+                f"are never baselined: fix the site or acknowledge it "
+                f"inline with `# mtlint: ok -- reason`")

@@ -35,6 +35,14 @@ def _ownership_witness(ownership_witness):
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _jitwit_witness(jitwit_witness):
+    """Every backend compile this suite triggers must map to a site the
+    static jit model predicts, with no instrumented-key retrace
+    (ISSUE 17)."""
+    yield
+
+
 def run(coro):
     return asyncio.run(coro)
 
