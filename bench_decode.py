@@ -71,6 +71,25 @@ Env knobs:
                              (dense_beam_sentences_per_sec field); beam
                              from MARIAN_DECBENCH_BEAM, a bare value
                              > 1 overrides the page length
+  MARIAN_DECBENCH_PAGED_BEAM_SCAN
+                             paged_beam_scan stage (ISSUE 18): the
+                             fused on-device beam merge + multi-step
+                             scanned rounds (--iteration-steps) A/B'd
+                             against the single-step HOST-merge
+                             baseline — the SAME PagedBeamEngine class,
+                             IDENTICAL mixed-length sentences, merge=
+                             "fused" vs merge="host"
+                             (host_merge_sentences_per_sec field). The
+                             row records token parity between the two
+                             paths (every output string compared), both
+                             sides' warm-block compile_s, and the fused
+                             side's steady-window compile count
+                             (steady_compiles — must be 0: the
+                             closed-shape-set claim; a nonzero count or
+                             a parity break poisons the row). Scanned
+                             steps from MARIAN_DECBENCH_STEPS (default
+                             4); beam from MARIAN_DECBENCH_BEAM; a bare
+                             value > 1 overrides the page length
   MARIAN_DECBENCH_DEVICES    decode device count (default 1). Pinned to
                              ONE device because (a) the metric is
                              per-chip sent/s and every recorded row is
@@ -499,6 +518,120 @@ def main():
             "final_sync_s": final_sync_s,
         }
         if final_sync_s > FINAL_SYNC_POISON_S:
+            result["poisoned"] = True
+            result["poisoned_reason"] = (
+                f"final_sync_s {final_sync_s} > {FINAL_SYNC_POISON_S:g}: "
+                f"wedged final sync — round self-poisoned, not "
+                f"trajectory-worthy")
+        print(json.dumps(result))
+        return
+
+    scan_env = os.environ.get("MARIAN_DECBENCH_PAGED_BEAM_SCAN", "")
+    if scan_env:
+        # paged_beam_scan stage (ISSUE 18): the fused on-device beam
+        # merge + multi-step scanned rounds A/B'd against the HOST-merge
+        # baseline — the same engine class on IDENTICAL mixed-length
+        # sentences, so the pair isolates exactly what the tentpole
+        # changed: log-softmax + k·k merge + page retable on device,
+        # --iteration-steps decode steps per host sync vs one. Token
+        # parity between the two paths is checked per row (the fused
+        # merge claims bitwise-equal selection, not just equal speed).
+        if sl_gen is not None:
+            print("bench_decode: MARIAN_DECBENCH_PAGED_BEAM_SCAN ignores "
+                  "the shortlist stage", file=sys.stderr, flush=True)
+        from bench import FINAL_SYNC_POISON_S, retry_compile
+        from marian_tpu.translator.beam_iteration import PagedBeamEngine
+        page_len = (int(scan_env) if scan_env.isdigit()
+                    and int(scan_env) > 1 else 16)
+        steps = max(1, int(os.environ.get("MARIAN_DECBENCH_STEPS", "4")
+                           or 4))
+        n_batches = max(1, n_sents // batch)
+        texts = []
+        for _ in range(n_batches):
+            texts.append([
+                " ".join(f"w{rs.randint(0, dims['vocab'] - 4)}"
+                         for _ in range(max(4, min(
+                             src_len - 1,
+                             int(rng.lognormvariate(3.0, 0.4))))))
+                for _ in range(batch)])
+
+        def scan_engine(merge, steps_per_round):
+            return PagedBeamEngine(
+                model, params, vocab, vocab, beam_size=beam,
+                normalize=0.6, max_rows=batch * beam, page_len=page_len,
+                src_len_cap=src_len, max_length_cap=max_len,
+                merge=merge, steps_per_round=steps_per_round)
+
+        # fused side: warm the full compile-key grid (beam scan + the
+        # pressure-fallback host jits), then decode the first chunk for
+        # the parity record, then time the full set inside a STRICT
+        # retrace window — the steady loop must compile NOTHING
+        fused = scan_engine("fused", steps)
+        with jitwit.strict() as w_fused:
+            retry_compile(lambda: fused.warm_grid(),
+                          "fused beam-scan warm grid")
+        parity_fused = fused.decode_texts(texts[0])
+        with jitwit.strict() as w_steady:
+            t0 = time.perf_counter()
+            for chunk in texts:
+                fused.decode_texts(chunk)
+            dt_fused = time.perf_counter() - t0
+        # host-merge baseline: same engine class, merge="host" (rounds
+        # are single-step by construction — the host needs the sync)
+        host = scan_engine("host", 1)
+        with jitwit.strict() as w_host:
+            retry_compile(lambda: host.warm_grid(),
+                          "host beam-merge warm grid")
+        parity_host = host.decode_texts(texts[0])
+        t0 = time.perf_counter()
+        for chunk in texts:
+            host.decode_texts(chunk)
+        dt_host = time.perf_counter() - t0
+        t_sync = time.perf_counter()
+        jax.block_until_ready(jnp.zeros(()))
+        final_sync_s = round(time.perf_counter() - t_sync, 3)
+        sents = batch * len(texts)
+        parity_ok = parity_fused == parity_host
+        steady_compiles = len(w_steady.compiles) if jw_armed else None
+        result = {
+            "metric": "paged_beam_scan_sentences_per_sec",
+            "value": round(sents / dt_fused, 2),
+            "unit": "sent/sec",
+            "vs_baseline": None,
+            "chip": jax.devices()[0].device_kind,
+            "preset": preset,
+            "batch": batch,
+            "beam": beam,
+            "page_len": page_len,
+            "steps_per_round": steps,
+            "host_merge_sentences_per_sec": round(sents / dt_host, 2),
+            "speedup_vs_host": round(dt_host / dt_fused, 2),
+            "token_parity": parity_ok,
+            "fused_fallback_rounds": fused._counters.get(
+                "fused_fallback_rounds", 0),
+            "compile_s": _warm_compile_s(w_fused, jw_armed),
+            "host_compile_s": _warm_compile_s(w_host, jw_armed),
+            # compiles the fused TIMED loop paid (strict window): any
+            # nonzero here voids the closed-shape-set claim AND the
+            # throughput pair, so it poisons the row below
+            "steady_compiles": steady_compiles,
+            "final_sync_s": final_sync_s,
+        }
+        if not parity_ok:
+            bad = sum(1 for a, b in zip(parity_fused, parity_host)
+                      if a != b)
+            result["poisoned"] = True
+            result["poisoned_reason"] = (
+                f"token parity broke: {bad}/{len(parity_host)} sentences "
+                f"differ between fused and host merge — the speedup is "
+                f"measuring a different decode")
+        elif steady_compiles:
+            result["poisoned"] = True
+            result["poisoned_reason"] = (
+                f"{steady_compiles} compiles inside the fused timed "
+                f"window — the warm grid missed a shape; the pair is "
+                f"warm-vs-cold, not fused-vs-host")
+        elif final_sync_s > FINAL_SYNC_POISON_S:
             result["poisoned"] = True
             result["poisoned_reason"] = (
                 f"final_sync_s {final_sync_s} > {FINAL_SYNC_POISON_S:g}: "

@@ -938,9 +938,9 @@ def static_lock_graph(root) -> Tuple[Set[str], Set[Tuple[str, str]]]:
     acquisition orders against."""
     from pathlib import Path
 
-    from .core import Config, collect_sources
+    from .core import Config, collect_sources_cached
     root = Path(root)
     config = Config.load(root)
-    sources = collect_sources([root / "marian_tpu"], config)
+    sources = collect_sources_cached([root / "marian_tpu"], config)
     g = build_cached(sources)
     return (set(g.locks), {(e.src, e.dst) for e in g.lock_edges()})

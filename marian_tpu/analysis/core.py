@@ -611,6 +611,58 @@ def collect_sources(paths: Sequence[Path], config: Config,
     return sources
 
 
+_SOURCES_CACHE: Dict[Tuple[str, Tuple[str, ...]],
+                     Tuple[Tuple, List[Source]]] = {}
+
+
+def _tree_signature(paths: Sequence[Path], config: Config) -> Tuple:
+    """Stat signature (rel, mtime_ns, size) of every file
+    :func:`collect_sources` would read for ``paths`` — cheap enough
+    (no reads, no parses) to recompute on every cache probe."""
+    sig = []
+    for p in paths:
+        if p.is_dir():
+            files = sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            files = [p]
+        else:
+            continue
+        for f in files:
+            try:
+                rel = f.resolve().relative_to(
+                    config.root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            if config.excluded(rel):
+                continue
+            try:
+                st = f.stat()
+            except OSError:
+                continue
+            sig.append((rel, st.st_mtime_ns, st.st_size))
+    return tuple(sig)
+
+
+def collect_sources_cached(paths: Sequence[Path],
+                           config: Config) -> List[Source]:
+    """:func:`collect_sources` memoized on the tree's stat signature.
+    The runtime witnesses (common/{lockdep,ownwit,jitwit}
+    ``check_against_static``) re-derive their static model at EVERY
+    tier-1 module teardown; re-reading and re-parsing the whole
+    package each time turns a pure cross-check into real suite wall
+    time. One tree is kept per (root, paths) key; any file edit,
+    addition, or deletion changes the signature and re-parses."""
+    key = (str(config.root.resolve()),
+           tuple(str(p) for p in paths))
+    sig = _tree_signature(paths, config)
+    hit = _SOURCES_CACHE.get(key)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    sources = collect_sources(paths, config)
+    _SOURCES_CACHE[key] = (sig, sources)
+    return sources
+
+
 def run_lint(paths: Sequence[Path], config: Config,
              rule_filter: Optional[Sequence[str]] = None,
              errors: Optional[List[str]] = None,

@@ -453,14 +453,29 @@ class JitModel:
         return False
 
 
+_MODEL_CACHE: Dict[tuple, JitModel] = {}
+
+
+def _model_cached(sources) -> JitModel:
+    # same one-entry content-keyed policy as callgraph.build_cached:
+    # the witnesses re-check at every module teardown over unchanged
+    # sources, so the rebuild would be pure repeated work
+    key = tuple(sorted((s.rel, hash(s.text)) for s in sources))
+    m = _MODEL_CACHE.get(key)
+    if m is None:
+        _MODEL_CACHE.clear()
+        m = _MODEL_CACHE[key] = JitModel.build(sources)
+    return m
+
+
 def static_jit_model(root) -> JitModel:
     """The jit model for the repo at ``root`` — what the runtime
     retrace witness (common/jitwit.py) cross-checks observed backend
     compiles against. Stdlib-only, never imports the analyzed code."""
     from pathlib import Path
 
-    from .core import Config, collect_sources
+    from .core import Config, collect_sources_cached
     root = Path(root)
     config = Config.load(root)
-    sources = collect_sources([root / "marian_tpu"], config)
-    return JitModel.build(sources)
+    sources = collect_sources_cached([root / "marian_tpu"], config)
+    return _model_cached(sources)

@@ -378,6 +378,21 @@ class OwnershipGraph:
         return "\n".join(lines) + "\n"
 
 
+_GRAPH_CACHE: Dict[tuple, OwnershipGraph] = {}
+
+
+def _graph_cached(sources) -> OwnershipGraph:
+    # same one-entry content-keyed policy as callgraph.build_cached:
+    # the witnesses re-check at every module teardown over unchanged
+    # sources, so the rebuild would be pure repeated work
+    key = tuple(sorted((s.rel, hash(s.text)) for s in sources))
+    g = _GRAPH_CACHE.get(key)
+    if g is None:
+        _GRAPH_CACHE.clear()
+        g = _GRAPH_CACHE[key] = OwnershipGraph.build(sources)
+    return g
+
+
 def static_ownership_graph(root) -> OwnershipGraph:
     """The ownership graph for the repo at ``root`` — what the runtime
     witness (common/ownwit.py) cross-checks observed (acquire-site →
@@ -385,8 +400,8 @@ def static_ownership_graph(root) -> OwnershipGraph:
     analyzed code."""
     from pathlib import Path
 
-    from .core import Config, collect_sources
+    from .core import Config, collect_sources_cached
     root = Path(root)
     config = Config.load(root)
-    sources = collect_sources([root / "marian_tpu"], config)
-    return OwnershipGraph.build(sources)
+    sources = collect_sources_cached([root / "marian_tpu"], config)
+    return _graph_cached(sources)

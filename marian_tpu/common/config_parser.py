@@ -330,7 +330,8 @@ _TRANSLATION = [
     _f("batch-token-budget", int, 0, "marian-server continuous batching: token budget per device batch against the bucketed static-shape table (data/batch_generator buckets, so serve-time batches hit warm jit-cache shapes). Counted as real rows x bucketed width — the same --mini-batch-words semantics training uses; the realized device batch can exceed it by the row snap-up to the batch multiple. 0 = derive from mini-batch x bucketed max-length (TPU extension)", "translate"),
     _f("batching-mode", str, "request", "marian-server batching discipline: 'request' packs whole requests into device batches between decodes (the default continuous token-budget scheduler); 'iteration' moves scheduling INSIDE the decode loop over a paged KV-cache pool — sentences join a RUNNING decode at any step and leave the step they finish, admission prices queue debt in pool pages, and the headroom gauge's queue-pressure units become pages. --beam-size 1 decodes greedily; beam > 1 decodes with copy-on-write page sharing across hypotheses (full pages alias via refcounts, only partial pages copy on fork — translator/beam_iteration.py; a sentence occupies beam-size slots). Single model only; composes with a restricted option surface (validated loudly at boot; docs/DEPLOYMENT.md) (TPU extension)", "translate"),
     _f("iteration-rows", int, 32, "With --batching-mode iteration: decode slot count — the maximum concurrently decoding sentences; the per-step compiled shape rounds the OCCUPIED slot prefix up through the row-bucket table, so idle slots cost nothing compiled (TPU extension)", "translate"),
-    _f("iteration-steps", int, 1, "With --batching-mode iteration: decode steps per scheduling round, run as one jitted scan. 1 = joins possible at EVERY step (pure iteration-level); >1 amortizes per-step host dispatch on host-bound backends at the cost of up to N-1 steps of join latency and a few self-fed row-steps past each EOS (TPU extension)", "translate"),
+    _f("iteration-steps", int, 1, "With --batching-mode iteration: decode steps per scheduling round, run as one jitted scan. 1 = joins possible at EVERY step (pure iteration-level); >1 amortizes per-step host dispatch on host-bound backends at the cost of up to N-1 steps of join latency and a few self-fed row-steps past each EOS. Applies at ANY beam size: beam > 1 scans too under the default fused on-device merge (EOS freezing is an in-scan mask; the COW reorder is in-graph table math), while --iteration-beam-merge host pins beam rounds to single-step (the numpy merge needs the host between steps) (TPU extension)", "translate"),
+    _f("iteration-beam-merge", str, "fused", "With --batching-mode iteration and beam > 1: where the k*k candidate merge runs. 'fused' (default) merges on-device — one jitted flat top-k over every live sentence plus in-graph COW page bookkeeping, one host sync per round, composes with --iteration-steps > 1; 'host' keeps the per-step numpy merge (the pre-fused A/B baseline — single-step rounds, one sync per token). Sampling and the cow=False replication baseline always run the host path (TPU extension)", "translate"),
     _f("kv-page-len", int, 16, "With --batching-mode iteration: tokens per KV-cache page. Smaller pages waste less pool on short sentences (internal fragmentation <= page_len-1 tokens/row) but grow the page table; see docs/DECODE_ROOFLINE.md r7 for the HBM-line-size trade (TPU extension)", "translate"),
     _f("kv-pool-bytes", int, 0, "With --batching-mode iteration: byte budget for the paged KV pool across all decoder layers (K+V). 0 = size the pool so every slot can hold a full --max-length row (the pool is then never the admission constraint) (TPU extension)", "translate"),
     _f("max-queue-pages", int, 0, "With --batching-mode iteration: admission bound on queued KV-pool PAGE debt — requests are shed with !!SERVER-OVERLOADED when the queue already owes this many pages (0 = 4x the pool's allocatable pages). Beam-k requests are priced at the shared-trunk steady-state holding (one trunk + k-1 extra partial pages) — an optimistic estimate, never k-times full replication; fully divergent lineages can transiently hold more, which lazy claims cover with retriable mid-decode eviction when the pool runs dry (TPU extension)", "translate"),

@@ -124,6 +124,14 @@ CATALOG: Dict[str, str] = {
                         "program) — so the jit retrace witness "
                         "(common/jitwit.py) is proven to catch a REAL "
                         "recompile, never a mocked report",
+    "beam.diff_corrupt": "detection drill (ISSUE 18): an armed 'fail' "
+                         "truncates one live slot's device-computed "
+                         "retable diff before the host refcount plane "
+                         "applies it — the bad-device-diff bug class of "
+                         "the fused beam merge — so the pool auditor's "
+                         "table/claim cross-check is proven to catch a "
+                         "REAL divergence between the device page table "
+                         "and the host mirror, never a mocked report",
 }
 
 

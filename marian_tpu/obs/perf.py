@@ -92,7 +92,12 @@ def round_bucket_key(row_bucket: int, encode_width: int, steps: int) -> str:
     nobody warmed is a steady-state recompile incident exactly like an
     unwarmed width in request mode. The lifecycle warmup drives the
     engine's full grid (PagedDecodeEngine.warm_grid) and registers
-    these keys via ``warm_bucket``."""
+    these keys via ``warm_bucket``. Since ISSUE 18 the steps field is
+    live for beam too: the fused-merge beam engine scans
+    --iteration-steps decode steps per round (row buckets are
+    beam-block multiples there), so beam rounds land on s>1 keys just
+    like greedy — only the host-merge beam baseline stays pinned to
+    s1."""
     return f"r{int(row_bucket)}.w{int(encode_width)}.s{int(steps)}"
 
 

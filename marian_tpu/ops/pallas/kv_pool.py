@@ -219,12 +219,15 @@ class KVPool:
         with self._lock:
             return dict(self._refs)
 
-    def claim(self, owner, n: int) -> List[int]:
+    def claim(self, owner, n: int, row_cap: bool = True) -> List[int]:
         """Claim ``n`` fresh pages (refcount 1 each) for ``owner``
         (all-or-nothing); raises :class:`PoolExhausted` when the free
-        list is short."""
+        list is short. ``row_cap=False`` skips the per-row table bound —
+        for TRANSIENT hold owners that never become a table row (the
+        fused beam round's fresh-page pre-claim spans a whole sentence's
+        worth of rows, not one)."""
         n = int(n)
-        if n > self.max_pages_per_row:
+        if row_cap and n > self.max_pages_per_row:
             raise PoolExhausted(
                 f"row needs {n} pages but the page table holds "
                 f"{self.max_pages_per_row} (raise --kv-page-len or the "
@@ -625,6 +628,30 @@ def pool_fork_partial(pool_k: jax.Array, pool_v: jax.Array,
     new_k = pool_k.at[dst].set(pool_k[src])
     new_v = pool_v.at[dst].set(pool_v[src])
     return new_k, new_v
+
+
+def beam_table_reorder(page_table: jax.Array, parent: jax.Array,
+                       write_slot: jax.Array, fresh_page: jax.Array,
+                       needs_fresh: jax.Array, frozen: jax.Array
+                       ) -> jax.Array:
+    """The beam reorder's page-table half, as int32 table math: each
+    surviving row inherits its ``parent`` row's table, and the rows
+    that diverge (``needs_fresh`` — a page-boundary crossing or a
+    non-keeper child that must fork the partial page) get their
+    ``write_slot`` entry repointed at a host-claimed ``fresh_page``.
+    ``frozen`` rows (EOS'd hypotheses carried for the merge) zero their
+    table — they stop writing and hold no pages.
+
+    Pure table→table function so the multi-step beam scan can carry it;
+    refcounts stay a HOST concern: the engine applies the resulting
+    table as a ``retable`` diff after the round syncs."""
+    t = jnp.asarray(page_table, jnp.int32)
+    new = t[jnp.asarray(parent, jnp.int32)]
+    hot = (jnp.arange(t.shape[1], dtype=jnp.int32)[None, :]
+           == jnp.asarray(write_slot, jnp.int32)[:, None])
+    new = jnp.where(hot & jnp.asarray(needs_fresh)[:, None],
+                    jnp.asarray(fresh_page, jnp.int32)[:, None], new)
+    return jnp.where(jnp.asarray(frozen)[:, None], 0, new)
 
 
 def _reference(q, pool_k, pool_v, page_table, row_pos, scale):

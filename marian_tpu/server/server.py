@@ -394,6 +394,22 @@ class ServingApp:
         beam = int(options.get("beam-size", 6) or 6)
         if beam < 1:
             problems.append("--beam-size must be >= 1")
+        steps = int(options.get("iteration-steps", 1) or 1)
+        if steps < 1:
+            problems.append("--iteration-steps must be >= 1 (got "
+                            f"{steps})")
+        merge = str(options.get("iteration-beam-merge", "fused")
+                    or "fused")
+        if merge not in ("fused", "host"):
+            problems.append(f"--iteration-beam-merge {merge!r} "
+                            "(choose 'fused' or 'host')")
+        elif merge == "host" and steps > 1 \
+                and (beam > 1 or bool(options.get("n-best", False))):
+            problems.append(
+                "--iteration-beam-merge host with --iteration-steps "
+                f"{steps}: the host merge needs the host between steps "
+                "(rounds run single-step) — drop to --iteration-steps 1 "
+                "or keep the default fused merge")
         if beam > int(options.get("iteration-rows", 32) or 32):
             problems.append(
                 f"--beam-size {beam} exceeds --iteration-rows "
@@ -486,19 +502,24 @@ class ServingApp:
             # COW paged beam search (ISSUE 12): same slot engine, one
             # sentence = beam slots, full pages shared by refcount
             from ..translator.beam_iteration import PagedBeamEngine
-            if int(opts.get("iteration-steps", 1) or 1) > 1:
-                log.warn("--iteration-steps > 1 is ignored at beam > 1:"
-                         " the beam reorder needs the host between "
-                         "steps (rounds run single-step)")
             norm = opts.get("normalize", 0.0)
             if norm is True:
                 norm = 1.0
+            # beam rounds scan --iteration-steps like greedy since
+            # ISSUE 18: the fused on-device merge keeps EOS freezing
+            # and the COW reorder in-graph, one host sync per round.
+            # merge='host' (the A/B baseline) clamps itself to
+            # single-step inside the engine; the boot validator already
+            # rejected the explicit host+steps combo loudly.
             return PagedBeamEngine(
                 tr.model, tr.params_list[0], tr.src_vocab, tr.trg_vocab,
                 beam_size=beam,
                 normalize=float(norm or 0.0),
                 word_penalty=float(opts.get("word-penalty", 0.0) or 0.0),
                 allow_unk=bool(opts.get("allow-unk", False)),
+                merge=str(opts.get("iteration-beam-merge", "fused")
+                          or "fused"),
+                steps_per_round=int(opts.get("iteration-steps", 1) or 1),
                 **kw)
         return PagedDecodeEngine(
             tr.model, tr.params_list[0], tr.src_vocab, tr.trg_vocab,

@@ -147,7 +147,13 @@ def smoke_engine_grid(executor, version: str, trigger: str,
     registered in full: warm_grid drives each row bucket at one width
     and each width at one row bucket, but both component jits (step and
     install) are keyed independently, so every cross pairing is warm by
-    construction — the undriven pairings register at 0.0 s."""
+    construction — the undriven pairings register at 0.0 s.
+
+    This works unchanged for the fused-merge beam engine (ISSUE 18):
+    PagedBeamEngine overrides ``row_buckets`` to beam-block multiples
+    (block_bucket · beam_size) and ``steps_per_round`` to the scanned
+    step count, so the cross-fill below enumerates exactly the beam
+    scan's reachable round keys."""
     engine = getattr(executor, "engine", None)
     warm_grid = getattr(engine, "warm_grid", None)
     if warm_grid is None:

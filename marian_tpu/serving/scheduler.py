@@ -1206,7 +1206,10 @@ class ContinuousScheduler:
                 # the round's compile key is the (row bucket, encode
                 # width, steps) TRIPLE, not the padded width — pass the
                 # round key so an unwarmed engine shape fires the
-                # steady-state recompile incident (ISSUE 17)
+                # steady-state recompile incident (ISSUE 17). res.steps
+                # is live for fused-merge beam rounds too (ISSUE 18):
+                # the beam scan covers --iteration-steps steps per
+                # dispatch, so beam keys read r{block·k}.w{w}.s{steps}
                 obs.PERF.record_batch(
                     self._version_label(), rows=res.rows,
                     width=res.bucket, src_tokens=src_done,

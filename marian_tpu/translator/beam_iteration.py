@@ -45,6 +45,36 @@ the documented trade for not reserving the k·cap worst case up front,
 which would forfeit the sharing win admission pricing is built on
 (``pages_for_text``: trunk + k-1 extra partials, NOT k× replication).
 
+FUSED mode (ISSUE 18 tentpole, the default): the merge itself moves
+on-device. Sentences occupy k-ALIGNED slot blocks (hypothesis
+``dense_pos`` j lives at row ``base + j``), so one jitted
+``fused_merge`` runs the dense flat top-k over every live sentence's
+k·W candidate grid at once — same f32 log-softmax, cumulative add and
+(value desc, flat asc) tie-break as the host merge, candidate-for-
+candidate (``jax.lax.top_k`` prefers the lower flat index on ties,
+which IS the dense rule). Page bookkeeping rides along as int32 table
+math (``beam_table_reorder``): the scan carries the page table,
+keepers inherit their parent's partial in place, diverging children
+fork it in-graph (``pool_fork_partial``) into HOST-preclaimed fresh
+pages, and EOS freezing is a mask. That lets beam rounds
+``lax.scan`` ``steps_per_round`` steps like greedy — ONE host sync
+per round instead of one per token, which is the whole beam-iteration
+throughput gap (ROADMAP item 1). After the sync the host replays the
+per-step (lane, token, value) outputs into ``_Hyp`` bookkeeping and
+applies the final table as a ``retable`` diff: refcounts remain a
+host-only plane (the scan allocates nothing and frees nothing — the
+host's table mirror is re-uploaded every round, so in-scan table
+edits are ephemeral until the diff is applied, and no page can be
+freed mid-round). Fresh pages are preclaimed at WORST case per round;
+when that does not fit a pressured pool, the round falls back to one
+single-step host-merge round (lazy claims at ACTUAL demand — output
+unchanged, fused rounds resume when pressure clears), so a tight pool
+degrades to the pre-fused throughput instead of shedding sentences the
+host path could serve. ``merge="host"`` keeps the original per-step numpy
+merge as the A/B baseline; sampling and cow=False traffic stay on it
+(independent trajectories need no merge; replication is the other
+A/B arm).
+
 Threading contract, determinism and the audit discipline are inherited
 from translator/iteration.py; the auditor additionally pins the COW
 safety invariant (every live row's write-target page is refcount-1) and
@@ -53,19 +83,50 @@ the pool's reference-sum/refcount cross-check.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import faultpoints as fp
 from ..common import jitwit
 from ..data.vocab import EOS_ID, UNK_ID
 from ..ops.pallas.kv_pool import (DEFAULT_PAGE_LEN, PoolExhausted,
-                                  ROW_BUCKETS, bucket_rows,
-                                  pages_for_tokens)
+                                  ROW_BUCKETS, beam_table_reorder,
+                                  bucket_rows, pages_for_tokens,
+                                  pool_fork_partial)
 from .beam_search import NEG_INF
 from .iteration import PagedDecodeEngine, StepResult, _Slot
+
+
+def fused_merge(lp: jax.Array, score: jax.Array, fin: jax.Array,
+                k: int, eos_flat: int
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The dense beam search's flat top-k over every sentence at once.
+
+    ``lp`` is [R, W] per-row log-probs (R = nb·k rows, beam-major
+    within each k-aligned block), ``score`` the [R] cumulative path
+    scores, ``fin`` the [R] frozen markers. A live row contributes the
+    f32 candidates ``score + lp`` over all W coords; a frozen row
+    contributes its one {EOS: score} candidate at coord ``eos_flat``
+    (0 under a shortlist — EOS sits at coord 0 by construction — else
+    EOS_ID) and NEG_INF elsewhere, exactly the host merge's frozen
+    candidate. ``jax.lax.top_k`` over the flattened [nb, k·W] grid
+    ranks (value desc, flat index asc on ties) — the dense tie-break
+    the host merge sorts by, so parity holds THROUGH ties (NEG_INF
+    saturates in f32: real ties happen).
+
+    Returns ([nb,k] values, [nb,k] parent lanes, [nb,k] coords)."""
+    rows, width = lp.shape
+    nb = rows // k
+    eos_cand = jnp.where(
+        jnp.arange(width, dtype=jnp.int32)[None, :] == eos_flat,
+        score[:, None], NEG_INF)
+    comb = jnp.where(fin[:, None], eos_cand, score[:, None] + lp)
+    vals, flat = jax.lax.top_k(comb.reshape(nb, k * width), k)
+    return vals, flat // width, flat % width
 
 
 class _Hyp:
@@ -121,15 +182,37 @@ class PagedBeamEngine(PagedDecodeEngine):
                  word_penalty: float = 0.0,
                  allow_unk: bool = False,
                  cow: bool = True,
+                 merge: str = "fused",
                  **kw):
-        kw["steps_per_round"] = 1   # host beam bookkeeping every step
-        super().__init__(model, params, src_vocab, trg_vocab, **kw)
+        merge = str(merge)
+        if merge not in ("fused", "host"):
+            raise ValueError(
+                f"iteration-beam-merge must be 'fused' or 'host', "
+                f"got {merge!r}")
         # cow=False: the A/B baseline — every reorder child copies its
         # WHOLE history into fresh pages (the dense beam reorder's data
         # movement, expressed over the paged pool). Numerics are
         # bitwise-identical to cow=True by construction (aliased pages
         # hold exactly the content the copy would have made), which the
         # parity test pins; only bytes moved and pages held differ.
+        # It runs on the HOST merge path (the whole-history replication
+        # baseline is precisely what fused mode exists to beat), as
+        # does sampling (k independent trajectories never merge — no
+        # k·k grid exists to fuse).
+        if not cow:
+            merge = "host"
+        feats = kw.get("features")
+        if feats is not None and getattr(feats, "sampling", None):
+            merge = "host"
+        steps = max(1, int(kw.get("steps_per_round", 1) or 1))
+        if merge == "host":
+            steps = 1   # host beam bookkeeping every step
+        kw["steps_per_round"] = steps
+        # set before super().__init__: the unsized-pool budget hook
+        # (_default_pool_pages, called while the base builds the pool)
+        # sizes fused engines with round-preclaim headroom
+        self.merge = merge
+        super().__init__(model, params, src_vocab, trg_vocab, **kw)
         self.cow = bool(cow)
         self.beam_size = int(beam_size)
         if self.beam_size < 1:
@@ -140,6 +223,19 @@ class PagedBeamEngine(PagedDecodeEngine):
                 f"{self.max_rows} (one sentence needs beam_size slots)")
         if self.beam_size > len(trg_vocab):
             raise ValueError("beam_size exceeds the target vocab")
+        # k-ALIGNED slot blocks: a sentence occupies rows
+        # [b·k, b·k + k) so hypothesis dense_pos j IS row offset j —
+        # what lets the fused merge treat the [rows] device arrays as
+        # [nb, k] candidate grids with no gather. Row buckets become
+        # block-bucket multiples of k so every compiled shape stays a
+        # whole number of sentences (jitwit's ROW_BUCKETS domain covers
+        # them via the registry's cap-clamp rule; warm_grid drives the
+        # block grid).
+        self._n_blocks = self.max_rows // self.beam_size
+        self._block_buckets = tuple(sorted(
+            {min(b, self._n_blocks) for b in self.row_buckets}))
+        self.row_buckets = tuple(sorted(
+            {bb * self.beam_size for bb in self._block_buckets}))
         self.normalize = float(normalize)
         self.word_penalty = float(word_penalty)
         self.allow_unk = bool(allow_unk)
@@ -154,6 +250,22 @@ class PagedBeamEngine(PagedDecodeEngine):
         # (src_slot, [dst_slots]) rows to replicate after the next
         # install (worker thread only; one sentence = one encode)
         self._pending_replicate: List[Tuple[int, List[int]]] = []
+
+    def _default_pool_pages(self) -> int:
+        """Fused engines add round-transient headroom to the unsized
+        pool: each fused round PRECLAIMS its worst-case fresh pages
+        before the scan dispatches (k per sentence at a page boundary,
+        else k-1, per scanned step — bounded by steps · max_rows
+        across all sentences), and releases the over-claim after the
+        host sync. Without the headroom a full pool of full-cap rows
+        has no room for the transient and EVERY round would take the
+        single-step host-merge pressure fallback — correct but the
+        exact per-round sync the fused path exists to amortize. An
+        explicit --kv-pool-bytes overrides this like any sizing."""
+        base = super()._default_pool_pages()
+        if self.merge != "fused":
+            return base
+        return base + self.max_rows * self.steps_per_round
 
     # -- capacity (sentence-granular) ---------------------------------------
     def free_slots(self) -> int:
@@ -237,9 +349,15 @@ class PagedBeamEngine(PagedDecodeEngine):
                     f"(raise --kv-page-len or --kv-pool-bytes)")
             return "too_large"
         with self._lock:
-            if self.max_rows - self._n_active < k:
+            # lowest free k-ALIGNED block: fused mode needs hypothesis
+            # j at row base+j (dense_pos == row offset), and blocks
+            # can't fragment — a sentence holds all k slots to the end
+            base = next((b * k for b in range(self._n_blocks)
+                         if all(self._slots[b * k + j] is None
+                                for j in range(k))), None)
+            if base is None:
                 return "no_slot"
-            slots = [i for i, s in enumerate(self._slots) if s is None][:k]
+            slots = list(range(base, base + k))
         # one partial page per hypothesis row, all-or-nothing across
         # the sentence (prefix-cache pressure relief on the first)
         claimed: List[Tuple[object, List[int]]] = []
@@ -442,7 +560,8 @@ class PagedBeamEngine(PagedDecodeEngine):
             vals, idx = jax.lax.top_k(comb, k)
             return vals, idx, new_state
 
-        # beam rounds are single-step (steps_per_round forced to 1)
+        # host-merge rounds are single-step (steps_per_round clamps to
+        # 1 on this path; the fused path scans — _make_scan_step)
         jitwit.note_compile_key(self._jitwit_token, ("step", rb, 1),
                                 domains=(("ROW_BUCKETS", rb),))
         return jax.jit(step, donate_argnums=(0,))
@@ -472,7 +591,8 @@ class PagedBeamEngine(PagedDecodeEngine):
         row of a sentence shares the sentence's shortlist and forced
         trunk, but gets its OWN sampling lane (``feat.lane + j`` for the
         j-th slot — k independent trajectories), and ``forced`` is a
-        single step wide (steps_per_round is forced to 1)."""
+        single step wide (the host-merge path runs single-step rounds;
+        the fused path's _feature_args_scan is steps wide)."""
         plane = self.features
         if plane is None:
             return ()
@@ -511,6 +631,16 @@ class PagedBeamEngine(PagedDecodeEngine):
         return tuple(extras)
 
     def _step(self, res: StepResult) -> None:
+        # static per engine: which path a round takes never varies
+        if self.merge == "fused":
+            self._step_fused(res)
+        else:
+            self._step_host(res)
+
+    def _step_host(self, res: StepResult) -> None:
+        """One single-step round with the HOST merge (`_merge_sentence`)
+        — the pre-ISSUE-18 path, kept as the fused merge's A/B baseline
+        and as the home of the sampling and cow=False variants."""
         top = max(i for i, s in enumerate(self._slots) if s is not None)
         rb = bucket_rows(top + 1, self.row_buckets)
         pos_np = np.full((rb,), -1, np.int32)
@@ -575,6 +705,19 @@ class PagedBeamEngine(PagedDecodeEngine):
             dst[:len(fork_dst)] = fork_dst
             self._state = fj(self._state, jnp.asarray(src),
                              jnp.asarray(dst))
+        self._finish_round(res, finished_sents)
+        res.rows = live_rows
+        res.bucket = rb
+        res.tokens = live_rows
+        res.steps += 1
+        res.enc_bucket = self._enc_w   # round compile key (ISSUE 17)
+
+    def _finish_round(self, res: StepResult,
+                      finished_sents: List[Tuple[_Sent, _Hyp]]) -> None:
+        """Shared round tail for both merge paths: format and evict
+        finished sentences (n-best through the same OutputPrinter as
+        the dense driver), emit best-so-far streaming partials for the
+        sentences still decoding, refresh the token ledger."""
         plane = self.features
         for sent, best in finished_sents:
             toks = self._crop(best)
@@ -617,11 +760,6 @@ class PagedBeamEngine(PagedDecodeEngine):
                                            ignore_eos=True),
                      sent.t))
         self._recount_tokens()
-        res.rows = live_rows
-        res.bucket = rb
-        res.tokens = live_rows
-        res.steps += 1
-        res.enc_bucket = self._enc_w   # round compile key (ISSUE 17)
 
     def _merge_sentence(self, sent: _Sent, vals, idx,
                         fork_src: List[int], fork_dst: List[int]
@@ -689,9 +827,13 @@ class PagedBeamEngine(PagedDecodeEngine):
                                                            slot))
                       for slot in sent.slots}
         # group live children by parent slot; the lowest-dense_pos
-        # child KEEPS the parent's row in place (zero copies). cow=False
-        # (the A/B baseline) disables both levers: every child replicates
-        # its whole history into fresh pages, like the dense reorder.
+        # child of each parent KEEPS the parent's partial page (zero
+        # copies). cow=False (the A/B baseline) disables both levers:
+        # every child replicates its whole history into fresh pages,
+        # like the dense reorder. Children land on DENSE-ALIGNED rows
+        # (child i at slots[i]) — the fused scan's row convention, kept
+        # here too so a pressure round that falls back to this path
+        # leaves the layout the next fused round requires.
         keeper: Dict[int, _Hyp] = {}
         forkers: List[Tuple[_Hyp, int]] = []      # (child, parent_slot)
         for c in live:
@@ -699,16 +841,15 @@ class PagedBeamEngine(PagedDecodeEngine):
                 keeper[c.slot] = c
             else:
                 forkers.append((c, c.slot))
-        free_rows = [slot for slot in sent.slots if slot not in keeper]
         new_tables: Dict[int, List[int]] = {}
-        # hold every page any new table will reference, then claim the
-        # fresh pages, so no retable below can free an alias source
-        # before its incref (or a fork its copy source) lands
+        # hold every page ANY old row references, then claim the fresh
+        # pages, so no retable below can free an alias source before
+        # its incref (or a fork its copy source) lands — with dense
+        # re-homing a keeper's pages can move to a lower slot than its
+        # parent held, so the whole union must be pinned
         tmp = ("cow", sent.key)
-        aliased = []
+        aliased = [p for slot in sent.slots for p in old_tables[slot]]
         if self.cow:
-            for c, pslot in forkers:
-                aliased.extend(old_tables[pslot][:n_full])
             # exactly what the assignment below consumes: one copied
             # partial per forker, or — at a page boundary — one fresh
             # (unwritten) page per live child, keeper and forker alike
@@ -733,14 +874,14 @@ class PagedBeamEngine(PagedDecodeEngine):
                 raise
             fresh = hold_and_claim()
         fi = 0
-        for slot, c in keeper.items():
-            row = list(old_tables[slot])
+        for pslot, c in keeper.items():
+            row = list(old_tables[pslot])
             if not has_partial:
                 row.append(fresh[fi])     # boundary: fresh page, no copy
                 fi += 1
-            new_tables[slot] = row
+            c.slot = sent.slots[c.dense_pos]
+            new_tables[c.slot] = row
         for c, pslot in forkers:
-            slot = free_rows.pop(0)
             if self.cow:
                 row = list(old_tables[pslot][:n_full])
                 if has_partial:
@@ -760,8 +901,8 @@ class PagedBeamEngine(PagedDecodeEngine):
                         fork_src.append(old[j])
                         fork_dst.append(fresh[fi])
                     fi += 1
-            c.slot = slot
-            new_tables[slot] = row
+            c.slot = sent.slots[c.dense_pos]
+            new_tables[c.slot] = row
         # retable every slot (ascending, deterministic): increfs the
         # new rows, decrefs the old, frees dead lineages' pages
         for slot in sent.slots:
@@ -868,6 +1009,458 @@ class PagedBeamEngine(PagedDecodeEngine):
             self._slot_score[slot] = 0.0
         h.slot = None
 
+    # -- the fused round (ISSUE 18 tentpole) --------------------------------
+    # buckets: ROW_BUCKETS
+    def _make_scan_step(self, rows: int):
+        """The fused beam round: ``steps_per_round`` decode steps over
+        every live sentence as ONE ``lax.scan`` — model step, fused
+        flat top-k merge, in-graph COW reorder (table math + partial
+        forks into host-preclaimed fresh pages), EOS freezing by mask.
+        The scan carries (pools, prev, pos, table, score, fin, done);
+        per step it emits the [nb, k] (lane, token, value, fin) grids
+        the host replays into hypothesis bookkeeping after the round's
+        ONE sync. The host's page-table mirror is re-uploaded next
+        round, so in-scan table edits are ephemeral until the host
+        applies the final table as a retable diff — and since the scan
+        never frees a page (fresh pages are preclaimed, old references
+        drop only host-side after the sync), no in-scan read can ever
+        see a recycled page."""
+        model = self.model
+        k = self.beam_size
+        steps = self.steps_per_round
+        page_len = self.page_len
+        nb = rows // k
+        allow_unk = self.allow_unk
+        row_keys, pool_keys, whole_keys = self._state_key_groups()
+        k_keys = tuple(sorted(key for key in pool_keys
+                              if key.endswith("_pool_k")))
+        plane = self.features
+        has_sl = plane is not None and plane.shortlist_gen is not None
+        has_force = plane is not None and plane.force_decode
+        eos_flat = 0 if has_sl else EOS_ID
+        # jit.closure_vary drill nonce — see PagedDecodeEngine._make_step
+        drill_nonce = self._jit_drill_nonce
+        blk_base = jnp.arange(nb, dtype=jnp.int32) * k
+        lanes_k = jnp.arange(k, dtype=jnp.int32)
+        jitwit.note_compile_key(self._jitwit_token,
+                                ("bstep", rows, steps),
+                                domains=(("ROW_BUCKETS", rows),))
+
+        def step(state, src_mask, params, prev, pos, table, score, fin,
+                 blk_live, cap_blk, fresh, *extras):
+            it = iter(extras)
+            sl = next(it) if has_sl else None       # [rows, K] full ids
+            sl_len = next(it) if has_sl else None   # [rows] true width
+            forced = next(it) if has_force else None  # [rows, steps]
+            sl_blk = sl.reshape(nb, k, -1)[:, 0] if has_sl else None
+            sub0 = {key: state[key][:rows] for key in row_keys}
+            for key in whole_keys:
+                sub0[key] = state[key]
+            sm = src_mask[:rows]
+
+            def body(carry, xs):
+                (pools, prev_t, pos_t, table_t, score_t, fin_t,
+                 done_t) = carry
+                j, fresh_j = xs
+                st = dict(sub0)
+                st.update(pools)
+                st["pos"] = pos_t
+                st["page_table"] = table_t
+                logits, new_sub = model.step(params, st, prev_t, sm,
+                                             shortlist=sl)
+                # EXACTLY the dense beam search's per-row math (f32
+                # log-softmax, shortlist width mask, UNK suppression,
+                # forced-trunk gate) — see _make_step; then the fused
+                # flat top-k replaces the host _merge_sentence
+                lg = logits.astype(jnp.float32)
+                if has_sl:
+                    coords = jnp.arange(lg.shape[-1])[None, :]
+                    lg = jnp.where(coords < sl_len[:, None], lg,
+                                   NEG_INF)
+                lp = jax.nn.log_softmax(lg, axis=-1)
+                if not allow_unk and not has_sl:
+                    lp = lp.at[:, UNK_ID].set(NEG_INF)
+                if has_force:
+                    f = forced[:, j]
+                    gate = (f >= 0)[:, None]
+                    hot = jax.nn.one_hot(jnp.maximum(f, 0),
+                                         lp.shape[-1], dtype=bool)
+                    lp = jnp.where(gate & ~hot, NEG_INF, lp)
+                pools2 = {key: new_sub[key] for key in pool_keys}
+                val_f, lane, coord = fused_merge(lp, score_t, fin_t, k,
+                                                 eos_flat)
+                parent = blk_base[:, None] + lane         # [nb,k] rows
+                if has_sl:
+                    tok = jnp.take_along_axis(sl_blk, coord, axis=1)
+                else:
+                    tok = coord
+                tok = tok.astype(jnp.int32)
+                fin_c = fin_t[parent] | (tok == EOS_ID)
+                live_c = ~fin_c
+                # block position: live rows all sit at the sentence's
+                # t (frozen rows read -1, max() recovers t)
+                t_blk = jnp.max(pos_t.reshape(nb, k), axis=1)
+                next_pos = t_blk + 1
+                gate_blk = ~done_t
+                done_now = ((~jnp.any(live_c, axis=1))
+                            | (next_pos >= cap_blk)) & gate_blk
+                commit_blk = gate_blk & ~done_now
+                # keeper = lowest-dense-pos live child of each parent:
+                # it inherits the parent's partial page in place (the
+                # host merge's zero-copy lever, verbatim)
+                same_parent = lane[:, :, None] == lane[:, None, :]
+                earlier = lanes_k[None, None, :] < lanes_k[None, :, None]
+                dup = jnp.any(same_parent & earlier & live_c[:, None, :],
+                              axis=2)
+                keeper = live_c & ~dup
+                boundary = (next_pos % page_len) == 0         # [nb]
+                needs = live_c & (boundary[:, None] | ~keeper)
+                # fresh-page assignment: the host preclaimed this
+                # step's pages densely at the block base, in lane order
+                fidx = jnp.cumsum(needs.astype(jnp.int32), axis=1) - 1
+                pg = jnp.where(
+                    needs,
+                    jnp.take_along_axis(fresh_j.reshape(nb, k),
+                                        jnp.maximum(fidx, 0), axis=1),
+                    0)
+                commit_row = jnp.repeat(commit_blk, k)
+                gate_row = jnp.repeat(gate_blk, k)
+                next_pos_row = jnp.repeat(next_pos, k)
+                boundary_row = jnp.repeat(boundary, k)
+                write_slot = next_pos_row // page_len
+                parent_row = parent.reshape(rows)
+                tok_row = tok.reshape(rows)
+                fin_row = fin_c.reshape(rows)
+                needs_row = needs.reshape(rows) & commit_row
+                pg_row = jnp.where(needs_row, pg.reshape(rows), 0)
+                # in-scan COW fork: copy the parent's current partial
+                # (this step's KV write included — the children's
+                # shared history) into the child's fresh page; (0,0)
+                # pairs are trash-page no-ops
+                mid_fork = needs_row & ~boundary_row
+                src_pg = jnp.take_along_axis(
+                    table_t[parent_row], write_slot[:, None],
+                    axis=1)[:, 0]
+                csrc = jnp.where(mid_fork, src_pg, 0)
+                cdst = jnp.where(mid_fork, pg_row, 0)
+                for kk in k_keys:
+                    vk = kk[:-1] + "v"
+                    nk, nv = pool_fork_partial(pools2[kk], pools2[vk],
+                                               csrc, cdst)
+                    pools2[kk] = nk
+                    pools2[vk] = nv
+                new_tab = beam_table_reorder(table_t, parent_row,
+                                             write_slot, pg_row,
+                                             needs_row, fin_row)
+                new_tab = jnp.where(commit_row[:, None], new_tab,
+                                    table_t)
+                new_score = jnp.where(commit_row, val_f.reshape(rows),
+                                      score_t)
+                new_fin = jnp.where(commit_row, fin_row, fin_t)
+                new_prev = jnp.where(commit_row[:, None],
+                                     tok_row[:, None], prev_t)
+                # live committed rows advance; frozen children and
+                # finishing blocks idle at -1 (pool_insert redirects
+                # their writes to the trash page)
+                new_pos = jnp.where(
+                    commit_row & ~fin_row, next_pos_row,
+                    jnp.where(gate_row, -jnp.ones_like(pos_t), pos_t))
+                carry2 = (pools2, new_prev, new_pos, new_tab, new_score,
+                          new_fin, done_t | done_now)
+                return carry2, (lane, tok, val_f, fin_c)
+
+            init = ({key: state[key] for key in pool_keys}, prev,
+                    pos + drill_nonce - drill_nonce, table, score, fin,
+                    ~blk_live)
+            carry, ys = jax.lax.scan(
+                body, init, (jnp.arange(steps, dtype=jnp.int32), fresh))
+            pools_f, _, _, table_f, _, _, _ = carry
+            new_state = dict(state)
+            new_state.update(pools_f)
+            lanes, toks, vals, fins = ys       # each [steps, nb, k]
+            return lanes, toks, vals, fins, table_f, new_state
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _feature_args_scan(self, rows: int) -> Tuple[object, ...]:
+        """Fused-round feature arrays: the whole sentence block shares
+        its shortlist and forced trunk (all k rows — frozen rows'
+        outputs are merge-masked anyway), and ``forced`` is
+        [rows, steps_per_round] wide like greedy's. No sampling here:
+        sampling traffic is host-forced to merge='host'."""
+        plane = self.features
+        if plane is None:
+            return ()
+        steps = self.steps_per_round
+        extras: List[object] = []
+        if plane.shortlist_gen is not None:
+            kst = plane.k_static
+            sl_np = np.zeros((rows, kst), np.int32)
+            len_np = np.full((rows,), kst, np.int32)
+        if plane.force_decode:
+            forced_np = np.full((rows, steps), -1, np.int32)
+        for sent in self._sents.values():
+            f = sent.feat
+            if f is None:
+                continue
+            for slot in sent.slots:
+                if slot >= rows:
+                    continue
+                if plane.shortlist_gen is not None \
+                        and f.shortlist is not None:
+                    sl_np[slot, :] = f.shortlist
+                    len_np[slot] = f.sl_len
+                if plane.force_decode and f.forced:
+                    for j in range(steps):
+                        forced_np[slot, j] = f.forced_at(sent.t + j)
+        if plane.shortlist_gen is not None:
+            extras += [jnp.asarray(sl_np), jnp.asarray(len_np)]
+        if plane.force_decode:
+            extras.append(jnp.asarray(forced_np))
+        return tuple(extras)
+
+    def _claim_round_fresh(self, owner, n: int) -> List[int]:  # owns: caller -- the round's transient fresh-page owner; _step_fused releases it after the retable diffs land
+        """Claim the round's worst-case fresh pages for one sentence
+        under a transient owner (``("roundfresh", key)``), with the
+        same prefix-cache pressure relief the join path gets. No row
+        cap: the claim spans a whole sentence's k rows × steps, not
+        one table row."""
+        try:
+            return self.pool.claim(owner, n, row_cap=False)
+        except PoolExhausted:
+            if self.prefix is None or not self.prefix.evict_for_pages(
+                    self.pool, n):
+                raise
+            return self.pool.claim(owner, n, row_cap=False)
+
+    def _step_fused(self, res: StepResult) -> None:
+        """One fused round: preclaim fresh pages, run the scan, sync
+        once, replay the per-step merges into hypothesis bookkeeping,
+        apply the device-computed page tables as retable diffs."""
+        k = self.beam_size
+        steps = self.steps_per_round
+        page_len = self.page_len
+        # fresh-page preclaim, worst case per sentence: the scan cannot
+        # allocate, so every page a round could consume must be live
+        # before dispatch (k at a page boundary — every live child
+        # diverges onto an unwritten page — else k-1 forkers; nothing
+        # past the sentence's cap). Over-claims — real divergence below
+        # worst case, mid-round freezes — release harmlessly after the
+        # round.
+        fresh_by_key: Dict[object, List[int]] = {}
+        for key in list(self._sents):
+            sent = self._sents[key]
+            demand = 0
+            for j in range(steps):
+                npos = sent.t + j + 1
+                if npos >= sent.cap:
+                    break
+                demand += k if npos % page_len == 0 else k - 1
+            try:
+                fresh_by_key[key] = self._claim_round_fresh(
+                    ("roundfresh", key), demand)
+            except PoolExhausted:
+                # pressure fallback: the WORST-CASE preclaim does not
+                # fit, but the actual demand (what the merge really
+                # forks) usually does — run this round through the
+                # single-step host merge, which claims lazily after the
+                # merge and evicts retriably only on real exhaustion.
+                # Output is unchanged (the paths are merge-parity by
+                # test, and the host path keeps the dense row
+                # alignment); fused rounds resume once pressure clears.
+                # The host step jit may compile here on first pressure
+                # — a real, observable compile incident under a
+                # brownout, which is exactly what the round-key
+                # telemetry exists to surface (PERFORMANCE.md).
+                for k2 in fresh_by_key:
+                    self.pool.release(("roundfresh", k2))
+                self._count("fused_fallback_rounds")
+                self._step_host(res)
+                return
+        top = max(i for i, s in enumerate(self._slots) if s is not None)
+        rows = bucket_rows(top + 1, self.row_buckets)
+        nb = rows // k
+        pos_np = np.full((rows,), -1, np.int32)
+        prev_np = np.zeros((rows, 1), np.int32)
+        score_np = np.zeros((rows,), np.float32)
+        fin_np = np.zeros((rows,), bool)
+        blk_live_np = np.zeros((nb,), bool)
+        cap_np = np.zeros((nb,), np.int32)
+        fresh_np = np.zeros((steps, rows), np.int32)
+        live_rows = 0
+        for key, sent in self._sents.items():
+            base = sent.slots[0]
+            blk_live_np[base // k] = True
+            cap_np[base // k] = sent.cap
+            for j, h in enumerate(sent.hyps):
+                row = base + j
+                score_np[row] = h.score
+                if h.finished:
+                    fin_np[row] = True
+                else:
+                    pos_np[row] = sent.t
+                    prev_np[row, 0] = h.tokens[-1] if h.tokens else 0
+                    live_rows += 1
+            fresh = fresh_by_key[key]
+            fi = 0
+            for j in range(steps):
+                npos = sent.t + j + 1
+                if npos >= sent.cap:
+                    break
+                cnt = k if npos % page_len == 0 else k - 1
+                fresh_np[j, base:base + cnt] = fresh[fi:fi + cnt]
+                fi += cnt
+        # seeded retrace drill — see PagedDecodeEngine._step
+        try:
+            fp.fault_point("jit.closure_vary")
+        except fp.InjectedFault:
+            self._jit_drill_nonce += 1
+            self._step_jit.pop(("bstep", rows), None)
+        fn = self._step_jit.get(("bstep", rows))
+        if fn is None:
+            fn = self._make_scan_step(rows)
+            self._step_jit[("bstep", rows)] = fn
+        out = fn(self._state, self._src_mask, self.params,
+                 jnp.asarray(prev_np), jnp.asarray(pos_np),
+                 jnp.asarray(self._table[:rows]), jnp.asarray(score_np),
+                 jnp.asarray(fin_np), jnp.asarray(blk_live_np),
+                 jnp.asarray(cap_np), jnp.asarray(fresh_np),
+                 *self._feature_args_scan(rows))
+        lanes_d, toks_d, vals_d, fins_d, table_d, self._state = out
+        # the ONE host sync per round — the whole point of the fused
+        # path (the host path syncs per token)
+        lanes = np.asarray(lanes_d)  # mtlint: ok -- iteration-level decode syncs once per round by design; the replay below runs host-side between rounds
+        toks = np.asarray(toks_d)  # mtlint: ok -- same round boundary as lanes above; one fetch, already fenced
+        vals = np.asarray(vals_d)  # mtlint: ok -- same round boundary as lanes above
+        del fins_d   # the replay recomputes freezing from the tokens
+        table_f = np.asarray(table_d)  # mtlint: ok -- same round boundary as lanes above; the retable diff the host applies
+        self._ever_stepped = True
+        consumed = 0
+        forks_total = 0
+        copies_total = 0
+        finished_sents: List[Tuple[_Sent, _Hyp]] = []
+        for key in list(self._sents):
+            sent = self._sents[key]
+            base = sent.slots[0]
+            b = base // k
+            best: Optional[_Hyp] = None
+            for j in range(steps):
+                cur = sent.hyps
+                n_live = sum(1 for h in cur if not h.finished)
+                consumed += n_live
+                next_pos = sent.t + 1
+                children: List[_Hyp] = []
+                live_lanes: List[int] = []
+                for i in range(k):
+                    lane = int(lanes[j, b, i])
+                    parent = cur[lane]
+                    if parent.finished:
+                        children.append(_Hyp(parent.tokens,
+                                             parent.score,
+                                             parent.length, True, i,
+                                             None))
+                        continue
+                    tok = int(toks[j, b, i])
+                    fin = tok == EOS_ID
+                    children.append(_Hyp(parent.tokens + [tok],
+                                         np.float32(vals[j, b, i]),
+                                         next_pos, fin, i,
+                                         None if fin else base + i))
+                    if not fin:
+                        live_lanes.append(lane)
+                sent.hyps = children
+                sent.t = next_pos
+                if not live_lanes or next_pos >= sent.cap:
+                    # the host-path finish rule, verbatim: unfinished
+                    # hypotheses at the cap score at length = cap
+                    for c in children:
+                        if not c.finished:
+                            c.length = sent.cap
+                            c.slot = None
+                    best = self._best_hyp(sent)
+                    break
+                # committed reorder step: the same fork/copy ledger the
+                # host merge keeps (copies only off a page boundary —
+                # boundary forks land on unwritten pages)
+                forkers = len(live_lanes) - len(set(live_lanes))
+                forks_total += forkers
+                if next_pos % page_len != 0:
+                    copies_total += forkers
+            if best is not None:
+                self.pool.release(("roundfresh", key))
+                finished_sents.append((sent, best))
+                continue
+            # --- apply the device-computed retable diff ---------------
+            # hold every page any old table references (plus the still-
+            # held fresh claims) so no retable can free an alias source
+            # before its incref lands — then rewrite each row to the
+            # zero-terminated prefix of its device table
+            tmp = ("cow", key)
+            union: List[int] = []
+            seen = set()
+            for slot in sent.slots:
+                for p in self.pool.pages_of(self._owner(key, slot)):
+                    if p not in seen:
+                        seen.add(p)
+                        union.append(p)
+            self.pool.share(tmp, union, row_cap=False)
+            new_rows: Dict[int, List[int]] = {}
+            for slot in sent.slots:
+                row: List[int] = []
+                for p in table_f[slot]:
+                    if int(p) == 0:
+                        break
+                    row.append(int(p))
+                new_rows[slot] = row
+            # seeded-corruption drill (beam.diff_corrupt): apply ONE
+            # row's diff truncated while the device mirror keeps the
+            # full table — the invariant auditor must catch the
+            # divergence this same round (tests/test_translate_beam_fused.py)
+            corrupt_slot = None
+            try:
+                fp.fault_point("beam.diff_corrupt")
+            except fp.InjectedFault:
+                corrupt_slot = next(
+                    (s for s in sent.slots if new_rows.get(s)), None)
+            for slot in sent.slots:
+                row = new_rows.get(slot, [])
+                self.pool.retable(
+                    self._owner(key, slot),
+                    row[:-1] if slot == corrupt_slot else row)
+                self._table[slot, :] = 0
+                if row:
+                    self._table[slot, :len(row)] = row
+            self.pool.release(tmp)
+            self.pool.release(("roundfresh", key))
+            cur = sent.hyps
+            with self._lock:
+                for i, slot in enumerate(sent.slots):
+                    st = self._slots[slot]
+                    h = cur[i]
+                    if h.slot is not None:
+                        self._slot_pos[slot] = sent.t
+                        self._slot_prev[slot] = h.tokens[-1]
+                        self._slot_score[slot] = float(h.score)
+                        st.pos = sent.t
+                        st.expected_refs = len(new_rows[slot])
+                    else:
+                        self._slot_pos[slot] = -1
+                        self._slot_prev[slot] = 0
+                        self._slot_score[slot] = 0.0
+                        st.pos = 0
+                        st.expected_refs = 0
+        if forks_total:
+            self._round_copied += copies_total
+            self._count("forks", forks_total)
+            if self._metrics_declared:
+                self.m_forks.inc(forks_total)
+        self._finish_round(res, finished_sents)
+        res.rows = live_rows
+        res.bucket = rows
+        res.tokens = consumed
+        res.steps += steps
+        res.enc_bucket = self._enc_w   # round compile key (ISSUE 17)
+
     # -- scoring (the dense search's collect math, in np.float32) -----------
     def _norm_score(self, h: _Hyp) -> np.float32:
         ln = np.float32(h.length)
@@ -887,6 +1480,88 @@ class PagedBeamEngine(PagedDecodeEngine):
         if toks and toks[-1] == EOS_ID:
             toks = toks[:-1]
         return toks
+
+    # -- warmup (ISSUE 17 closed-shape-set, beam grid) ----------------------
+    def warm_grid(self) -> List[Tuple[int, int, int, float]]:
+        """The base warm_grid in BLOCK units: drive every block bucket
+        (and every join bucket, clamped to capacity) at every encode
+        width so each fused/host beam-step row bucket (block·k), each
+        install width, and each pow2 encoder-replication pad compiles
+        before serving. The replicate pads are covered because
+        next_pow2(2x) = 2·next_pow2(x): driving every pow2 block count
+        walks a gap-free chain of pad sizes. Fused mode compiles
+        nothing else per round — its COW forks live INSIDE the scan, so
+        there are no per-pad fork jits to warm at all (an extra
+        closed-shape win over the host path, see PERFORMANCE.md)."""
+        rows: List[Tuple[int, int, int, float]] = []
+        counts = sorted(set(self._block_buckets)
+                        | {min(jb, self._n_blocks)
+                           for jb in self.JOIN_BUCKETS})
+        for w in self.encode_widths():
+            n_words = max(1, min(w // 2, self.src_cap - 2))
+            text = " ".join(["a"] * n_words)
+            for n in counts:
+                t0 = time.perf_counter()
+                self.decode_texts([text] * n)
+                rows.append((bucket_rows(n * self.beam_size,
+                                         self.row_buckets),
+                             self._enc_w, self.steps_per_round,
+                             time.perf_counter() - t0))  # mtlint: ok -- decode_texts returns host strings: every round already synced, the window is wall-clock warmup cost by design
+            if self.merge == "fused":
+                # the pressure fallback's host-step jit retraces per
+                # encode width (it closes over this width's encoder
+                # state shapes) — warm it inside the width loop
+                rows.extend(self._warm_host_fallback())
+        if self.merge == "fused":
+            self._warm_host_forks()
+        return rows
+
+    def _warm_host_fallback(self) -> List[Tuple[int, int, int, float]]:
+        """Compile the pressure-fallback path off the serving path: the
+        single-step host-merge jit per row bucket, at the CURRENT
+        encode width. A pool-pressured fused round falls back to it
+        (see _step_fused); without this pass the first pressured round
+        would pay the compile inline — the exact steady-state incident
+        the warm grid exists to prevent. The calls run over idle rows
+        only (pos -1 everywhere: every KV write lands on the trash
+        page, the outputs are discarded), so no live state moves."""
+        out: List[Tuple[int, int, int, float]] = []
+        for rb in self.row_buckets:
+            t0 = time.perf_counter()
+            fn = self._step_jit.get(rb)
+            if fn is None:
+                fn = self._make_step(rb)
+                self._step_jit[rb] = fn
+            _vals, _idx, self._state = fn(
+                self._state, self._src_mask, self.params,
+                jnp.zeros((rb, 1), jnp.int32),
+                jnp.full((rb,), -1, jnp.int32),
+                jnp.asarray(self._table[:rb]),
+                jnp.zeros((rb,), jnp.float32),
+                *self._feature_args(rb))
+            out.append((rb, self._enc_w, 1,
+                        time.perf_counter() - t0))  # mtlint: ok -- warmup wall-clock, one idle-row dispatch per bucket off the serving path
+        return out
+
+    def _warm_host_forks(self) -> None:
+        """Warm the pow2 fork jits the host fallback batches its
+        partial-page copies through (all-(0,0) pairs: trash-page
+        no-ops). Worst case one host round forks every non-keeper live
+        row — rows minus one keeper per block — and the pow2 pad walks
+        a gap-free chain up to that ceiling."""
+        max_forks = max(1, self.max_rows
+                        - self.max_rows // self.beam_size)
+        n = 1
+        while True:
+            fj = self._step_jit.get(("fork", n))
+            if fj is None:
+                fj = self._make_pool_fork(n)
+                self._step_jit[("fork", n)] = fj
+            zero = jnp.zeros((n,), jnp.int32)
+            self._state = fj(self._state, zero, zero)
+            if n >= max_forks:
+                break
+            n *= 2
 
     # -- audit --------------------------------------------------------------
     def audit(self, context: str = "quiesce") -> List[str]:

@@ -216,10 +216,7 @@ class PagedDecodeEngine:
         if pool_bytes and pool_bytes > 0:
             n_pages = 1 + max(1, int(pool_bytes) // self.page_bytes)
         else:
-            # default: every slot can hold a full-cap row (the pool is
-            # then never the constraint — shrink --kv-pool-bytes to make
-            # admission page-bound)
-            n_pages = 1 + self.max_rows * self.max_pages
+            n_pages = 1 + self._default_pool_pages()
         self.pool = KVPool(n_pages, self.page_len,
                            max_pages_per_row=self.max_pages)
 
@@ -302,6 +299,14 @@ class PagedDecodeEngine:
         if registry is not None:
             self._declare_metrics(registry)
 
+    def _default_pool_pages(self) -> int:
+        """Unsized-pool page budget (no --kv-pool-bytes): every slot can
+        hold a full-cap row, so the pool is never the constraint —
+        shrink --kv-pool-bytes to make admission page-bound. Subclasses
+        add round-transient headroom on top (the fused beam merge
+        preclaims a round's worst-case fresh pages before each scan)."""
+        return self.max_rows * self.max_pages
+
     # -- metrics ------------------------------------------------------------
     def _declare_metrics(self, r) -> None:
         self.m_pool_pages = r.gauge(
@@ -359,8 +364,10 @@ class PagedDecodeEngine:
         self.m_pool_alias_ratio.set_function(self.cow_alias_ratio)
         self.m_rounds = r.counter(
             "marian_serving_engine_rounds_total",
-            "Admit+step rounds the paged engine ran (>= decode steps "
-            "at --iteration-steps 1; each round is one device dispatch)")
+            "Admit+step rounds the paged engine ran — each round is "
+            "one device dispatch covering --iteration-steps decode "
+            "steps (greedy AND fused-merge beam scan; only the "
+            "host-merge beam baseline pins rounds to one step)")
         self.m_pages_claimed = r.counter(
             "marian_serving_kv_pool_pages_claimed_total",
             "Fresh pages claimed off the pool free list (cold joins, "

@@ -218,8 +218,12 @@ class TestBeamParity:
         only partials produces BITWISE the tokens and raw path scores
         of full per-child page replication (the dense reorder's data
         movement over the same pool) — mid-decode forks included, since
-        every reorder with two live children of one parent is one."""
-        cow_o, cow_i = drive(make_beam_engine(tiny, cow=True), TEXTS)
+        every reorder with two live children of one parent is one.
+        merge="host" pins BOTH arms to the per-step host merge so this
+        stays a pure COW-vs-replication property (fused-vs-host merge
+        parity is its own test, tests/test_translate_beam_fused.py)."""
+        cow_o, cow_i = drive(make_beam_engine(tiny, cow=True,
+                                              merge="host"), TEXTS)
         eng = make_beam_engine(tiny, cow=False)
         rep = make_beam_engine(tiny, cow=False,
                                pool_bytes=64 * eng.page_bytes)
@@ -235,8 +239,11 @@ class TestBeamParity:
         bitwise: (a) a sentence evicted mid-decode (pages freed) and
         rejoined re-decodes onto the just-freed pages identically; (b)
         a long-lived engine whose every sentence reuses its
-        predecessors' pages (LIFO free list) matches fresh engines."""
-        eng = make_beam_engine(tiny, max_rows=K)
+        predecessors' pages (LIFO free list) matches fresh engines.
+        merge="host" everywhere: page recycling is merge-path-agnostic
+        (same pool verbs either way) and this test builds 7 engines —
+        the host path keeps it off the fused warm cost."""
+        eng = make_beam_engine(tiny, max_rows=K, merge="host")
         eng.admit_and_step([(0, TEXTS[4])])
         for _ in range(4):
             eng.admit_and_step([])
@@ -244,7 +251,8 @@ class TestBeamParity:
         assert eng.pool.free_pages() == eng.pool.usable_pages
         assert eng.audit(context="test") == []
         re_o, re_i = drive(eng, [TEXTS[4]])   # refork onto freed pages
-        fresh_o, fresh_i = drive(make_beam_engine(tiny, max_rows=K),
+        fresh_o, fresh_i = drive(make_beam_engine(tiny, max_rows=K,
+                                                  merge="host"),
                                  [TEXTS[4]])
         assert re_o == fresh_o
         assert np.float32(re_i[0]["score"]) \
@@ -252,7 +260,8 @@ class TestBeamParity:
         # (b): sequential reuse of one engine's recycled pages
         for i, t in enumerate(TEXTS):
             o, inf = drive(eng, [t])
-            f_o, f_i = drive(make_beam_engine(tiny, max_rows=K), [t])
+            f_o, f_i = drive(make_beam_engine(tiny, max_rows=K,
+                                              merge="host"), [t])
             assert o == f_o, i
             assert np.float32(inf[0]["score"]) \
                 == np.float32(f_i[0]["score"]), i
