@@ -189,6 +189,11 @@ _TRAINING = [
     _f("no-spm-encode", bool, False, "Input is already SentencePiece-encoded: skip encoding, split on whitespace", "training"),
     _f("input-reorder", int, [], "Permutation applied to TSV columns before they become streams, e.g. 1 0", "training", "*"),
     _f("throw-on-divergence", bool, False, "Raise (instead of logging) when the training cost goes non-finite, so orchestration restarts from the last checkpoint", "training"),
+    _f("on-divergence", str, "", "Divergence policy: throw | warn | rollback. 'rollback' self-heals in-process: restore the last good checkpoint bundle, rewind the data pipeline to the bundle's corpus snapshot (past the poison window), apply --divergence-lr-backoff per retry, and give up loudly (raise) after --divergence-retries attempts. Empty derives from --throw-on-divergence: throw when set, else warn (TPU extension; see docs/ROBUSTNESS.md)", "training"),
+    _f("divergence-retries", int, 3, "With --on-divergence rollback: in-process rollback attempts before giving up and raising like throw (TPU extension)", "training"),
+    _f("divergence-lr-backoff", float, 0.5, "With --on-divergence rollback: multiply the learning-rate decay factor by this on each retry (compounds across retries and persists in the saved training state; 1.0 = no backoff) (TPU extension)", "training"),
+    _f("divergence-skip-window", int, 10, "With --check-gradient-nan: treat this many CONSECUTIVE NaN-skipped updates as divergence, feeding --on-divergence without waiting for the display-boundary cost sync (0 = never; detection lags the hot loop by ~2 updates, not a display window) (TPU extension)", "training"),
+    _f("train-stall-timeout", float, 0.0, "Training-step watchdog: when the update loop makes no progress for this many seconds (a step that never fences — wedged collective, hung data feed), dump a flight recording naming the stalled step, save a host-side diagnostic progress file, and exit with the distinct retriable code 75 so a supervisor restarts into the checkpoint-resume path (0 = off) (TPU extension)", "training"),
     _f("diverged-after", str, None, "fp16 divergence-recovery horizon (no-op; see flag audit)", "training", "?"),
     _f("custom-fallbacks", str, [], "fp16 fallback config list (no-op; see flag audit)", "training", "*"),
     _f("fp16-fallback-to-fp32", bool, False, "fp16 fallback (no-op; see flag audit)", "training"),
@@ -708,8 +713,8 @@ UNIMPLEMENTED_FLAGS: Dict[str, tuple] = {
                             "to drop"),
     "diverged-after": ("warn", "fp16 divergence recovery does not apply: "
                                "bf16 keeps the f32 exponent range; use "
-                               "--throw-on-divergence + "
-                               "--check-gradient-nan"),
+                               "--check-gradient-nan + --on-divergence "
+                               "rollback (in-process self-heal) or throw"),
     "custom-fallbacks": ("warn", "fp16 fallback machinery does not apply "
                                  "to bf16 training"),
     "fp16-fallback-to-fp32": ("warn", "fp16 fallback machinery does not "

@@ -132,6 +132,24 @@ CATALOG: Dict[str, str] = {
                          "table/claim cross-check is proven to catch a "
                          "REAL divergence between the device page table "
                          "and the host mirror, never a mocked report",
+    "train.nan_grad": "divergence drill (ISSUE 19): an armed 'fail' "
+                      "poisons one training batch's target mask with NaN "
+                      "before dispatch — the transient bad-batch bug "
+                      "class — so --check-gradient-nan's skip/revert, the "
+                      "live skip counter, and the --on-divergence "
+                      "rollback ladder are proven against a REAL "
+                      "non-finite gradient, never a mocked loss",
+    "train.hang": "on the training loop, once per batch iteration before "
+                  "dispatch (hang mode wedges the step so it never "
+                  "fences — food for the --train-stall-timeout watchdog; "
+                  "kill mode is the mid-step preemption drill)",
+    "train.diverge_cost": "divergence drill (ISSUE 19): an armed 'fail' "
+                          "replaces one applied update's lazy loss sum "
+                          "with NaN before the scheduler accumulates it — "
+                          "the cost-blowup bug class that only surfaces "
+                          "at the display-boundary sync — proving the "
+                          "display-path detection and rollback without "
+                          "touching parameters",
 }
 
 

@@ -123,12 +123,16 @@ def save_checkpoint(model_path: str, params: Dict[str, Any], config_yaml: str,
         # freeze a deep copy now
         import copy
         state = copy.deepcopy(state) if state is not None else None
+        # geometry is read on the TRAINING thread too (the worker must
+        # not touch live mesh/device structures)
+        meta = _bundle_meta(state, graph_group)
 
         def _write():
             fp.fault_point("ckpt.async.worker")
             _write_checkpoint(path, params, config_yaml, smooth_params,
                               opt_flat, state, suffix, extra_paths,
-                              consume=True, keep_bundles=keep_bundles)
+                              consume=True, keep_bundles=keep_bundles,
+                              meta=meta)
         async_saver.submit(_write)
         return
 
@@ -136,7 +140,8 @@ def save_checkpoint(model_path: str, params: Dict[str, Any], config_yaml: str,
                 if graph_group is not None and not suffix else None)
     _write_checkpoint(path, params, config_yaml, smooth_params, opt_flat,
                       state, suffix, extra_paths,
-                      keep_bundles=keep_bundles)
+                      keep_bundles=keep_bundles,
+                      meta=_bundle_meta(state, graph_group))
 
 
 def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
@@ -145,7 +150,8 @@ def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
                       state: Optional[TrainingState], suffix: str,
                       extra_paths: Tuple[str, ...] = (),
                       consume: bool = False,
-                      keep_bundles: int = bdl.DEFAULT_KEEP) -> None:
+                      keep_bundles: int = bdl.DEFAULT_KEEP,
+                      meta: Optional[Dict[str, Any]] = None) -> None:
     # consume=True (async path only — the dicts are worker-owned
     # snapshots): np.asarray + pop releases each device-side snapshot
     # copy as soon as the host has the bytes, bounding the transient HBM
@@ -191,7 +197,8 @@ def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
     if state is not None:
         members[model_name + ".progress.yml"] = state.save
     committed = bdl.write_bundle(path, members, keep=keep_bundles,
-                                 meta=_bundle_meta(state),
+                                 meta=(meta if meta is not None
+                                       else _bundle_meta(state)),
                                  compat=_compat_from_yaml(config_yaml))
     for p in extra_paths:
         # the no---overwrite '.iterN' copies are permanent numbered
@@ -202,10 +209,20 @@ def _write_checkpoint(path: str, params: Dict[str, Any], config_yaml: str,
              os.path.basename(committed))
 
 
-def _bundle_meta(state: Optional[TrainingState]) -> Dict[str, Any]:
-    if state is None:
-        return {}
-    return {"batches": state.batches, "epochs": state.epochs}
+def _bundle_meta(state: Optional[TrainingState],
+                 graph_group=None) -> Dict[str, Any]:
+    meta: Dict[str, Any] = {}
+    if state is not None:
+        meta.update({"batches": state.batches, "epochs": state.epochs})
+    if graph_group is not None:
+        try:
+            # save-time device geometry (elastic resume, ISSUE 19): the
+            # optimizer member holds LOGICAL gathered arrays, so this is
+            # descriptive — restore re-shards for the current mesh
+            meta["geometry"] = graph_group.mesh_geometry()
+        except Exception as e:  # noqa: BLE001 — metadata must not fail a save
+            log.warn("could not record mesh geometry in bundle meta ({})", e)
+    return meta
 
 
 def _compat_from_yaml(config_yaml: str) -> Optional[Dict[str, Any]]:
@@ -227,6 +244,25 @@ def _compat_from_yaml(config_yaml: str) -> Optional[Dict[str, Any]]:
         return None
 
 
+def _log_elastic_resume(manifest: Optional[Dict[str, Any]]) -> None:
+    """Elastic resume (ISSUE 19): when the bundle was saved on a different
+    device geometry than the one restoring it, say so — and say why it is
+    safe. The .optimizer.npz members are LOGICAL (gathered, unsharded)
+    arrays, so GraphGroup.initialize re-shards them for the current mesh;
+    an 8-chip run resumes on 4 or 1 bit-identically at the logical level."""
+    try:
+        geo = (manifest or {}).get("meta", {}).get("geometry") or {}
+        saved = int(geo.get("devices", 0) or 0)
+        cur = int(jax.device_count())
+        if saved and saved != cur:
+            log.info("elastic resume: bundle saved on {} device(s) (mesh "
+                     "{}), restoring onto {} — optimizer state is stored "
+                     "logically and re-shards for the current mesh",
+                     saved, geo.get("mesh"), cur)
+    except Exception:  # noqa: BLE001 — a log line must never fail a restore
+        pass
+
+
 def load_checkpoint(model_path: str, graph_group=None
                     ) -> Tuple[Dict[str, np.ndarray], Optional[str],
                                Optional[TrainingState]]:
@@ -237,7 +273,7 @@ def load_checkpoint(model_path: str, graph_group=None
     loads as before when no bundle exists."""
     found = bdl.latest_valid_bundle(model_path)
     if found is not None:
-        bdir, _manifest = found
+        bdir, manifest = found
         base = os.path.join(bdir, os.path.basename(model_path))
         params, config = mio.load_model(base)
         state = None
@@ -245,6 +281,7 @@ def load_checkpoint(model_path: str, graph_group=None
             state = TrainingState.load(base + ".progress.yml")
         opt = base + ".optimizer.npz"
         if graph_group is not None and os.path.exists(opt):
+            _log_elastic_resume(manifest)
             with np.load(opt) as z:
                 graph_group.load_optimizer_arrays(
                     {k: z[k] for k in z.files})

@@ -54,6 +54,11 @@ class TrainOutput:
     loss_sum: Any
     labels: Any
     grad_norm: Any
+    # lazy 0/1 --check-gradient-nan flag: 1 when this update was skipped
+    # (params + optimizer reverted in-jit). None when the guard is off.
+    # Same laziness contract as the scalars above — the Scheduler drains
+    # it with bounded lag, never per-step (ISSUE 19).
+    skipped: Any = None
 
 
 class GraphGroup:
@@ -265,13 +270,13 @@ class GraphGroup:
             # dynamic scaling, clip-as-min, nan-skip — the heterogeneous-
             # delay fallback must not silently drop those flags
             from ..parallel.zero import finalize_update
-            new_p, new_opt, gnorm, _skipped = finalize_update(
+            new_p, new_opt, gnorm, skipped = finalize_update(
                 opt_cfg, opt_state, p, grads, lr, labels, denom)
-            return new_p, new_opt, gnorm, lr
+            return new_p, new_opt, gnorm, lr, skipped
 
         self._update_fn = jax.jit(
             update_step,
-            out_shardings=(p_sh, o_sh, rep, rep),
+            out_shardings=(p_sh, o_sh, rep, rep, rep),
             donate_argnums=(0, 1, 2) if self._donate else ())
 
     # -- one (macro-)update --------------------------------------------------
@@ -299,7 +304,7 @@ class GraphGroup:
             self.params, self.opt_state, metrics = self._fused(
                 self.params, self.opt_state, b, step_f, rng)
             return TrainOutput(metrics["ce_sum"], metrics["labels"],
-                               metrics["gnorm"])
+                               metrics["gnorm"], metrics.get("skipped"))
         if (self._fused_delay is not None and len(batches) == self.delay
                 and all(b.keys() == batches[0].keys()
                         and all(v.shape == batches[0][k].shape
@@ -319,7 +324,7 @@ class GraphGroup:
             self.params, self.opt_state, metrics = self._fused_delay(
                 self.params, self.opt_state, stacked, step_f, rng)
             return TrainOutput(metrics["ce_sum"], metrics["labels"],
-                               metrics["gnorm"])
+                               metrics["gnorm"], metrics.get("skipped"))
         total_loss = total_labels = 0.0
         n_sents = 0.0
         grads_acc = None
@@ -353,11 +358,13 @@ class GraphGroup:
                 jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32),
                     grads_acc, grads))
-        self.params, self.opt_state, gnorm, _lr = self._update_fn(
+        self.params, self.opt_state, gnorm, _lr, skipped = self._update_fn(
             self.params, self.opt_state, grads_acc, np.float32(step),
             jnp.asarray(total_labels, jnp.float32),
             jnp.asarray(n_sents, jnp.float32))
-        return TrainOutput(total_loss, total_labels, gnorm)
+        return TrainOutput(
+            total_loss, total_labels, gnorm,
+            skipped if self.opt_cfg.check_gradient_nan else None)
 
     def update_window(self, batches, step: int, rng) -> "list[TrainOutput]":
         """K = --dispatch-window full updates in ONE jitted dispatch.
@@ -384,8 +391,10 @@ class GraphGroup:
             self._dump_hlo = None
         self.params, self.opt_state, metrics = self._fused_window(
             self.params, self.opt_state, stacked, np.int32(step), rng)
+        skipped = metrics.get("skipped")
         return [TrainOutput(metrics["ce_sum"][i], metrics["labels"][i],
-                            metrics["gnorm"][i])
+                            metrics["gnorm"][i],
+                            None if skipped is None else skipped[i])
                 for i in range(self.window)]
 
     # -- EMA access for validation/saving -----------------------------------
@@ -399,6 +408,16 @@ class GraphGroup:
         return self._unstack(self.params)
 
     # -- checkpoint glue -----------------------------------------------------
+    def mesh_geometry(self) -> Dict[str, Any]:
+        """Save-time device geometry for the bundle manifest (elastic
+        resume, ISSUE 19). Purely descriptive: the .optimizer.npz members
+        are LOGICAL (gathered, unsharded) arrays, so restore re-shards for
+        whatever mesh the resuming process builds — this record is what
+        lets the restore log say so, and lets operators audit a resize."""
+        return {"devices": int(jax.device_count()),
+                "mesh": {str(name): int(size)
+                         for name, size in self.mesh.shape.items()}}
+
     def optimizer_device_arrays(self) -> Dict[str, Any]:
         """Flat-named optimizer state, still as device arrays (unstacked
         from any pipeline layout) — the async saver snapshots these and

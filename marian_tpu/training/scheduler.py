@@ -90,6 +90,25 @@ class Scheduler:
             "marian_train_updates_total", "Optimizer updates applied")
         self._m_labels = msm.counter(
             "marian_train_labels_total", "Target labels consumed")
+        self._m_skipped = msm.counter(
+            "marian_train_updates_skipped_total",
+            "Updates skipped by --check-gradient-nan (params and optimizer "
+            "state reverted; non-finite gradient)")
+        # -- divergence policy + live NaN-skip surfacing (ISSUE 19) --------
+        # the optimizer's per-update `skipped` flag used to vanish into the
+        # window average; here it is drained with BOUNDED lag (not a display
+        # window) so consecutive skips are detected within ~_skip_lag updates
+        mode = str(options.get("on-divergence", "") or "")
+        if mode and mode not in ("throw", "warn", "rollback"):
+            raise ValueError(
+                f"--on-divergence {mode!r}: expected throw, warn or rollback")
+        self._divergence_mode = mode or (
+            "throw" if options.get("throw-on-divergence", False) else "warn")
+        self.skip_window = int(options.get("divergence-skip-window", 0) or 0)
+        self._skip_lag = 2           # max updates a skip flag stays lazy
+        self._pending_skips: List = []   # [(batch_idx, lazy scalar)]
+        self._consec_skips = 0
+        self._skip_warned = False
         # --tensorboard DIR (TPU extension; the reference logs text only):
         # train/valid scalars via torch's SummaryWriter (baked-in). Never
         # a hard dependency — unavailable writer degrades to a warning.
@@ -146,11 +165,16 @@ class Scheduler:
 
     # -- per-update bookkeeping (reference: Scheduler::update) ---------------
     def update(self, loss_sum, labels: float, sentences: int,
-               src_words: float = 0.0, lr: Optional[float] = None) -> None:
+               src_words: float = 0.0, lr: Optional[float] = None,
+               skipped=None) -> None:
         """loss_sum may be a LAZY device scalar (jax.Array) — it is only
         accumulated here; the host-device sync happens at the display
         boundary (_display), keeping the hot loop free of per-step blocking
-        so dispatch can run ahead of the device."""
+        so dispatch can run ahead of the device.
+
+        `skipped` is the optimizer's lazy 0/1 --check-gradient-nan flag for
+        this update (None when the guard is off): queued and drained with
+        bounded lag by _drain_skips, never a per-step sync."""
         s = self.state
         s.batches += 1
         s.batches_epoch += 1
@@ -161,6 +185,9 @@ class Scheduler:
         self._max_labels_update = max(self._max_labels_update, int(labels))
         if lr is not None:
             s.eta = float(lr)
+        if skipped is not None:
+            self._pending_skips.append((s.batches, skipped))
+        self._drain_skips()
         self._cost_sum += loss_sum
         self._label_sum += labels
         self._words_sum += (src_words or labels)
@@ -186,6 +213,88 @@ class Scheduler:
             return (s.labels_total // freq.n) > ((s.labels_total - self._label_sum) // freq.n)
         return False  # epoch-based handled in new_epoch
 
+    # -- divergence detection + policy (ISSUE 19) ----------------------------
+    @property
+    def divergence_mode(self) -> str:
+        """Resolved --on-divergence policy: throw | warn | rollback."""
+        return self._divergence_mode
+
+    def _drain_skips(self, block: bool = False) -> None:
+        """Resolve queued --check-gradient-nan flags. Entries younger than
+        _skip_lag updates are only read when already fenced (is_ready —
+        non-blocking); older ones are force-synced, which is nearly free
+        under async dispatch because the device has long finished them.
+        Detection is therefore deterministic within ~_skip_lag updates of
+        the skip, instead of a display window later."""
+        s = self.state
+        while self._pending_skips:
+            batch, flag = self._pending_skips[0]
+            if not block and s.batches - batch < self._skip_lag:
+                ready = getattr(flag, "is_ready", None)
+                if ready is not None and not ready():
+                    return
+            self._pending_skips.pop(0)
+            if float(flag) <= 0.5:
+                self._consec_skips = 0
+                continue
+            self._m_skipped.inc()
+            self._consec_skips += 1
+            if not self._skip_warned:
+                self._skip_warned = True
+                log.warn(
+                    "Update {} skipped: non-finite gradient "
+                    "(--check-gradient-nan reverted params + optimizer "
+                    "state; counted in marian_train_updates_skipped_total)",
+                    batch)
+            if self.skip_window and self._consec_skips >= self.skip_window:
+                self._divergence(
+                    f"{self._consec_skips} consecutive NaN-skipped updates "
+                    f"through update {batch} "
+                    f"(--divergence-skip-window {self.skip_window})")
+
+    def _divergence(self, reason: str) -> None:
+        """Apply the resolved --on-divergence policy. throw and rollback
+        both raise DivergenceError — the train loop's retry ladder decides
+        whether to roll back in-process or let the raise abort the run."""
+        mode = self._divergence_mode
+        self._consec_skips = 0
+        if mode in ("throw", "rollback"):
+            raise DivergenceError(
+                f"training diverged: {reason} (--on-divergence {mode})")
+        armed = [
+            f"--check-gradient-nan "
+            f"{'on' if self.options.get('check-gradient-nan', False) else 'OFF'}",
+            f"--divergence-skip-window {self.skip_window or 'off'}",
+        ]
+        log.warn(
+            "training diverged: {} — continuing (--on-divergence warn; "
+            "armed guards: {}). --on-divergence rollback would restore the "
+            "last good checkpoint bundle, rewind the data pipeline to its "
+            "corpus snapshot, retry with learning-rate backoff x{}, and "
+            "give up after {} attempts",
+            reason, ", ".join(armed),
+            self.options.get("divergence-lr-backoff", 0.5),
+            self.options.get("divergence-retries", 3))
+
+    def drain_skips(self) -> None:
+        """Blocking end-of-run fence: resolve every still-lazy skip flag so
+        a divergence inside the final ~_skip_lag updates raises (into the
+        rollback ladder) instead of being silently saved as the final
+        checkpoint."""
+        self._drain_skips(block=True)
+
+    def reset_divergence_window(self) -> None:
+        """Post-rollback reset: drop every accumulator that straddles the
+        rollback point so the first display window of the retried run is
+        not polluted by pre-rollback (possibly non-finite) cost, and stale
+        lazy skip flags from the abandoned trajectory are never drained."""
+        self._pending_skips.clear()
+        self._consec_skips = 0
+        self._cost_sum = self._label_sum = self._words_sum = 0.0
+        self._sent_sum = 0
+        self._disp_count = 0
+        self._timer = time.perf_counter()
+
     def _display(self) -> None:
         s = self.state
         cost_type = self.options.get("cost-type", "ce-sum")
@@ -196,17 +305,14 @@ class Scheduler:
         # Pre-fix the delta was read before the sync — under async
         # dispatch that clocked ENQUEUE time and overstated throughput.
         dt = max(time.perf_counter() - self._timer, 1e-9)
+        self._drain_skips(block=True)   # display IS a fence — resolve all
         if not math.isfinite(self._cost_sum):
-            # divergence surfaces here, at the display boundary — the hot
-            # loop never syncs per step (reference: --throw-on-divergence
-            # aborts so orchestration restarts from the last checkpoint)
-            if self.options.get("throw-on-divergence", False):
-                raise DivergenceError(
-                    f"training diverged: non-finite cost at update "
-                    f"{s.batches} (--throw-on-divergence)")
-            log.warn("Non-finite training cost at update {} — continuing "
-                     "(--throw-on-divergence not set; consider "
-                     "--check-gradient-nan)", s.batches)
+            # cost divergence surfaces here, at the display boundary — the
+            # hot loop never syncs per step. (Consecutive NaN-SKIPPED
+            # updates are caught earlier by _drain_skips; a non-finite cost
+            # that reaches this sum means params actually took a bad step.)
+            self._divergence(
+                f"non-finite cost at update {s.batches}")
         if cost_type == "ce-mean-words" or cost_type == "ce-sum":
             cost = self._cost_sum / max(self._label_sum, 1.0)
         elif cost_type == "perplexity":

@@ -7,13 +7,18 @@ runs the epoch loop with validation/save triggers and SIGTERM-safe exit.
 
 from __future__ import annotations
 
+import dataclasses
 import os
+import sys
+import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import faultpoints as fp
 from ..common import logging as log
 from ..common import prng, signal_handling
 from ..data import BatchGenerator, Corpus, create_vocab
@@ -21,9 +26,99 @@ from ..models.encoder_decoder import batch_to_arrays, create_model
 from . import bundle as bdl
 from .checkpoint import load_checkpoint, save_checkpoint
 from .graph_group import GraphGroup
-from .scheduler import Scheduler
+from .scheduler import DivergenceError, Scheduler
 from .training_state import TrainingState
 from .validators import create_validators
+
+# Training-step watchdog exit code (--train-stall-timeout): EX_TEMPFAIL —
+# retriable, and distinct from faultpoints.FAULT_EXIT_CODE (117) and from
+# ordinary failures, so a supervisor can tell "stalled, restart into the
+# checkpoint-resume path" from "crashed, investigate".
+STALL_EXIT_CODE = 75
+
+
+class _StepWatchdog:
+    """Monitor thread for a training step that never fences (wedged
+    collective, hung data feed, device lockup) — the training twin of
+    serving's dispatch watchdog. The update loop beats once per batch
+    iteration; when no beat lands for --train-stall-timeout seconds the
+    watchdog dumps a flight recording naming the stalled step, saves the
+    host-side training state as a DIAGNOSTIC side file (device state is
+    not safely checkpointable from here — the training thread may be
+    wedged mid-dispatch, so resume comes from the last committed bundle),
+    and hard-exits with the retriable STALL_EXIT_CODE."""
+
+    def __init__(self, timeout: float, state: TrainingState,
+                 model_path: str):
+        self.timeout = float(timeout)
+        self._state = state
+        self._model_path = model_path
+        self._last = time.monotonic()
+        self._paused = False
+        self._halt = threading.Event()
+        from ..serving import metrics as msm
+        self._m_trips = msm.counter(
+            "marian_train_watchdog_trips_total",
+            "Training-step watchdog trips (--train-stall-timeout)")
+        self._thread = threading.Thread(target=self._run,
+                                        name="train-watchdog", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+        log.info("Training-step watchdog armed: stall timeout {}s "
+                 "(exit code {} on trip)", self.timeout, STALL_EXIT_CODE)
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def pause(self) -> None:
+        """Suspend during legitimately slow non-step work (rollback
+        reload + re-jit) so recovery is never mistaken for a stall."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._last = time.monotonic()
+        self._paused = False
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def _run(self) -> None:
+        poll = max(0.05, min(1.0, self.timeout / 4.0))
+        while not self._halt.wait(poll):
+            if self._paused:
+                continue
+            elapsed = time.monotonic() - self._last
+            if elapsed >= self.timeout:
+                self._trip(elapsed)
+                return
+
+    def _trip(self, elapsed: float) -> None:
+        s = self._state
+        stalled_step = s.batches + 1
+        detail = (f"training step {stalled_step} never fenced: no loop "
+                  f"progress for {elapsed:.1f}s "
+                  f"(--train-stall-timeout {self.timeout}); last completed "
+                  f"update {s.batches}, epoch {s.epochs + 1}")
+        # raw stderr first: must be visible even under --quiet, and even
+        # if the logging/obs stack is itself wedged
+        sys.stderr.write(f"TRAIN WATCHDOG: {detail}; "
+                         f"exiting {STALL_EXIT_CODE} (retriable)\n")
+        sys.stderr.flush()
+        log.error("TRAIN WATCHDOG: {}", detail)
+        self._m_trips.inc()
+        from .. import obs
+        obs.event("train.watchdog_trip", step=stalled_step,
+                  elapsed_s=round(elapsed, 3))
+        obs.FLIGHT.trip("train-watchdog", detail=detail,
+                        extra={"stalled_step": stalled_step,
+                               "last_completed_update": s.batches,
+                               "timeout_s": self.timeout})
+        try:
+            s.save(self._model_path + ".stalled.progress.yml")
+        except Exception:  # noqa: BLE001 — diagnostics must not mask exit
+            pass
+        os._exit(STALL_EXIT_CODE)
 
 
 class Train:
@@ -315,6 +410,58 @@ class Train:
         log.info("Training started")
         stop = False
 
+        # -- self-healing (ISSUE 19): divergence rollback ladder + step
+        # watchdog. DivergenceError can surface from any scheduler
+        # bookkeeping call (consecutive-NaN-skip detection or the display-
+        # boundary cost sync); under --on-divergence rollback the retry
+        # ladder below catches it, restores the last good bundle
+        # in-process, and re-enters the epoch loop.
+        from ..serving import metrics as msm
+        div_mode = scheduler.divergence_mode
+        div_retries = max(0, int(opts.get("divergence-retries", 3) or 0))
+        div_backoff = float(opts.get("divergence-lr-backoff", 0.5) or 1.0)
+        m_rollbacks = msm.counter(
+            "marian_train_divergence_rollbacks_total",
+            "In-process divergence rollbacks (--on-divergence rollback)")
+        base_train_key = train_key
+        watchdog = None
+        stall_timeout = float(opts.get("train-stall-timeout", 0.0) or 0.0)
+        if stall_timeout > 0:
+            watchdog = _StepWatchdog(stall_timeout, state, model_path)
+            watchdog.start()
+
+        def _arrays(batch):
+            """batch → device arrays, crossing the train.nan_grad drill
+            point: an armed 'fail' rebuilds this one batch in the
+            non-compact form and poisons its target mask with NaN — a REAL
+            non-finite gradient through the full backward pass, which is
+            what --check-gradient-nan's skip/revert and the rollback
+            ladder must be proven against."""
+            try:
+                fp.fault_point("train.nan_grad")
+            except fp.InjectedFault:
+                a = batch_to_arrays(batch, compact=False)
+                a["trg_mask"] = a["trg_mask"] * jnp.float32(float("nan"))
+                log.warn("FAULT train.nan_grad: target mask poisoned with "
+                         "NaN for update {}", state.batches + 1)
+                return a
+            return batch_to_arrays(batch, compact=compact,
+                                   vocab_sizes=vocab_sizes)
+
+        def _maybe_poison_cost(out):
+            """train.diverge_cost drill: replace one APPLIED update's lazy
+            loss sum with NaN before the scheduler accumulates it — the
+            cost-blowup class that only surfaces at the display-boundary
+            sync, without touching params (so post-rollback state really
+            is clean)."""
+            try:
+                fp.fault_point("train.diverge_cost")
+            except fp.InjectedFault:
+                log.warn("FAULT train.diverge_cost: loss sum for update {} "
+                         "replaced with NaN", state.batches + 1)
+                return dataclasses.replace(out, loss_sum=float("nan"))
+            return out
+
         def _check_stop():
             """Signal / stopping-condition tail shared by both update
             paths. Returns 'exit' (leave run() now), 'stop' (save done /
@@ -339,10 +486,12 @@ class Train:
             hot loop never blocks on the device."""
             if group[-1].corpus_state is not None:
                 last_corpus_state[0] = group[-1].corpus_state
+            out = _maybe_poison_cost(out)
             scheduler.update(out.loss_sum, sum(b.words for b in group),
                              sum(b.size for b in group),
                              src_words=sum(b.src_words for b in group),
-                             lr=gg.schedule.host_lr(state.batches + 1))
+                             lr=gg.schedule.host_lr(state.batches + 1),
+                             skipped=out.skipped)
             if scheduler.should_validate():
                 do_validate()
             if scheduler.should_save():
@@ -375,15 +524,25 @@ class Train:
                 return None
             stimer.phase("dispatch")
             trace.tick(state.batches + 1)
-            if len(win) == window:
-                outs = gg.update_window([a for a, _ in win],
-                                        state.batches + 1, train_key)
-                pairs = [(o, b) for o, (_, b) in zip(outs, win)]
-            else:
-                pairs = []
-                for idx, (a, b) in enumerate(win):
-                    s0 = state.batches + 1 + idx
-                    pairs.append((gg.update(a, s0, train_key), b))
+            # dispatch may block on a LEGITIMATE jit compile (first step,
+            # new bucket shape) — not a stall. Execution hangs are still
+            # caught: dispatch itself is async, and a wedged device
+            # surfaces at the scheduler's sync points, outside this pause.
+            if watchdog is not None:
+                watchdog.pause()
+            try:
+                if len(win) == window:
+                    outs = gg.update_window([a for a, _ in win],
+                                            state.batches + 1, train_key)
+                    pairs = [(o, b) for o, (_, b) in zip(outs, win)]
+                else:
+                    pairs = []
+                    for idx, (a, b) in enumerate(win):
+                        s0 = state.batches + 1 + idx
+                        pairs.append((gg.update(a, s0, train_key), b))
+            finally:
+                if watchdog is not None:
+                    watchdog.resume()
             win.clear()
             win_key.clear()
             stimer.phase("host")
@@ -391,9 +550,11 @@ class Train:
             if pairs[-1][1].corpus_state is not None:
                 last_corpus_state[0] = pairs[-1][1].corpus_state
             for out, b in pairs:
+                out = _maybe_poison_cost(out)
                 scheduler.update(out.loss_sum, b.words, b.size,
                                  src_words=b.src_words,
-                                 lr=gg.schedule.host_lr(state.batches + 1))
+                                 lr=gg.schedule.host_lr(state.batches + 1),
+                                 skipped=out.skipped)
             if scheduler.should_validate_since(before_b, before_l):
                 do_validate()
             if scheduler.should_save_since(before_b, before_l):
@@ -401,76 +562,198 @@ class Train:
             stimer.phase("data")
             return _check_stop()
 
-        while scheduler.keep_going() and not stop:
-            bg = native_bg if native_bg is not None \
-                else BatchGenerator(corpus, opts,
-                                    budget_scale=budget_scale)
-            micro: List = []
-            rc = None
-            stimer.phase("data")
-            for batch in bg:
-                if window > 1:
-                    # cheap host-side check per batch: a SIGTERM (or a
-                    # crossed stopping condition) must not wait for a
-                    # whole new window of batches to assemble
-                    if signal_handling.signal_flag() \
-                            or not scheduler.keep_going():
-                        if signal_handling.signal_flag() and \
-                                opts.get("sigterm", "save-and-exit") \
-                                == "exit-immediately":
-                            # drop the undispatched window: exit-
-                            # immediately must not do up to K more
-                            # updates of work the unwindowed path skips
-                            win.clear()
-                            win_key.clear()
-                        rc = _drain_window() or _check_stop()
-                        if rc == "exit":
-                            return
+        def _epoch_loop() -> Optional[str]:
+            nonlocal stop
+            while scheduler.keep_going() and not stop:
+                bg = native_bg if native_bg is not None \
+                    else BatchGenerator(corpus, opts,
+                                        budget_scale=budget_scale)
+                micro: List = []
+                rc = None
+                stimer.phase("data")
+                for batch in bg:
+                    if watchdog is not None:
+                        watchdog.beat()
+                    # once per batch iteration: hang mode wedges the loop
+                    # right here — a step that never fences, food for the
+                    # --train-stall-timeout watchdog; kill mode is the
+                    # mid-step preemption drill
+                    fp.fault_point("train.hang")
+                    if window > 1:
+                        # cheap host-side check per batch: a SIGTERM (or a
+                        # crossed stopping condition) must not wait for a
+                        # whole new window of batches to assemble
+                        if signal_handling.signal_flag() \
+                                or not scheduler.keep_going():
+                            if signal_handling.signal_flag() and \
+                                    opts.get("sigterm", "save-and-exit") \
+                                    == "exit-immediately":
+                                # drop the undispatched window: exit-
+                                # immediately must not do up to K more
+                                # updates of work the unwindowed path skips
+                                win.clear()
+                                win_key.clear()
+                            rc = _drain_window() or _check_stop()
+                            if rc == "exit":
+                                return "exit"
+                            stop = True
+                            break
+                        arrays = _arrays(batch)
+                        k_ = _shape_key(arrays)
+                        if win and k_ != win_key[0]:
+                            rc = _drain_window()      # bucket shape changed
+                        if rc is None:
+                            if not win:
+                                win_key[:] = [k_]
+                            win.append((arrays, batch))
+                            # fill to the window, but never past an update-
+                            # counted hard limit (--after-batches overshoot
+                            # bounded by the final PARTIAL window, not K)
+                            rem = scheduler.updates_remaining()
+                            if len(win) == window or \
+                                    (rem is not None and len(win) >= rem):
+                                rc = _drain_window()
+                    else:
+                        micro.append(batch)
+                        if len(micro) < delay:
+                            continue
+                        stimer.phase("dispatch")
+                        arrays = [_arrays(b) for b in micro]
+                        trace.tick(state.batches + 1)
+                        # same compile-is-not-a-stall pause as
+                        # _drain_window's dispatch
+                        if watchdog is not None:
+                            watchdog.pause()
+                        try:
+                            out = gg.update(arrays, state.batches + 1,
+                                            train_key)
+                        finally:
+                            if watchdog is not None:
+                                watchdog.resume()
+                        stimer.phase("host")
+                        rc = _after_update(out, micro)
+                        micro = []
+                        stimer.phase("data")
+                    if rc == "exit":
+                        return "exit"
+                    if rc is not None:
                         stop = True
                         break
-                    arrays = batch_to_arrays(batch, compact=compact,
-                                             vocab_sizes=vocab_sizes)
-                    k_ = _shape_key(arrays)
-                    if win and k_ != win_key[0]:
-                        rc = _drain_window()      # bucket shape changed
-                    if rc is None:
-                        if not win:
-                            win_key[:] = [k_]
-                        win.append((arrays, batch))
-                        # fill to the window, but never past an update-
-                        # counted hard limit (--after-batches overshoot
-                        # bounded by the final PARTIAL window, not K)
-                        rem = scheduler.updates_remaining()
-                        if len(win) == window or \
-                                (rem is not None and len(win) >= rem):
-                            rc = _drain_window()
-                else:
-                    micro.append(batch)
-                    if len(micro) < delay:
-                        continue
-                    stimer.phase("dispatch")
-                    arrays = [batch_to_arrays(b, compact=compact,
-                                              vocab_sizes=vocab_sizes)
-                              for b in micro]
-                    trace.tick(state.batches + 1)
-                    out = gg.update(arrays, state.batches + 1, train_key)
-                    stimer.phase("host")
-                    rc = _after_update(out, micro)
-                    micro = []
-                    stimer.phase("data")
-                if rc == "exit":
-                    return
-                if rc is not None:
-                    stop = True
+                if not stop:
+                    rc = _drain_window()              # epoch-end stragglers
+                    if rc == "exit":
+                        return "exit"
+                    if rc is not None:
+                        stop = True
+                    else:
+                        scheduler.new_epoch()
+            # skip flags from the last ~2 updates may still be lazily
+            # pending — resolve them so a divergence at the very end of
+            # the run raises here (inside the rollback ladder) instead of
+            # being silently saved as the final checkpoint. SIGTERM exits
+            # skip this: rolling back against an operator's stop is wrong.
+            if not signal_handling.signal_flag():
+                scheduler.drain_skips()
+            return None
+
+        def _rollback(n: int, reason: str) -> None:
+            """--on-divergence rollback, attempt n of div_retries: restore
+            the last good checkpoint bundle in-process (params + optimizer
+            shards + training state), rewind the data pipeline to the
+            bundle's corpus snapshot, back off the learning rate, and
+            perturb the dropout stream so the replayed window is not
+            forced down the bit-identical trajectory that just diverged."""
+            nonlocal stop, corpus, train_key
+            stop = False
+            if watchdog is not None:
+                watchdog.pause()     # reload + re-jit is not a stall
+            log.warn("DIVERGENCE ROLLBACK {}/{}: {} — restoring the last "
+                     "good checkpoint bundle", n, div_retries, reason)
+            m_rollbacks.inc()
+            obs.event("train.divergence_rollback", retry=n,
+                      update=state.batches, reason=reason)
+            # synchronous flight dump: one auditable artifact per rollback
+            obs.FLIGHT.trip("divergence-rollback",
+                            detail=f"rollback {n}/{div_retries} at update "
+                                   f"{state.batches}: {reason}",
+                            extra={"retry": n, "update": state.batches})
+            if saver is not None:
+                saver.wait()         # never reload under an in-flight save
+            win.clear()
+            win_key.clear()
+            gg.opt_state = None      # drop poisoned moments before reload
+            restored = TrainingState(seed=seed)
+            reinit_params = None
+            if (os.path.exists(model_path) or
+                    bool(bdl.list_bundles(bdl.bundle_root(model_path)))):
+                host_p, _, loaded = load_checkpoint(model_path, gg)
+                reinit_params = {k: jnp.asarray(v)
+                                 for k, v in host_p.items()}
+                if loaded is not None:
+                    restored = loaded
+            else:
+                # divergence before the first save: the only good state is
+                # the initialization itself — still a counted, LR-backed-
+                # off rollback, just to update 0
+                log.warn("no checkpoint bundle exists yet — rolling back "
+                         "to freshly initialized parameters")
+            # in-place field copy: scheduler and validators hold this
+            # TrainingState object by reference
+            for field in dataclasses.fields(TrainingState):
+                setattr(state, field.name, getattr(restored, field.name))
+            if div_backoff > 0 and div_backoff != 1.0:
+                prev = state.factor
+                state.factor *= div_backoff ** n
+                log.warn("learning-rate backoff: decay factor {} -> {} "
+                         "(x{} per retry, retry {})", prev, state.factor,
+                         div_backoff, n)
+            gg.schedule.decay_factor = state.factor
+            gg.initialize(prng.stream(key, prng.STREAM_INIT),
+                          reinit_params)
+            # data pipeline: a FRESH Corpus rewound to the bundle's
+            # snapshot — past the poison window. The abandoned
+            # BatchGenerator's prefetch thread still holds the old Corpus
+            # (it parks on its bounded queue; daemon, leaked once per
+            # rollback, bounded by --divergence-retries) — reusing that
+            # object would race the restore.
+            if native_bg is None:
+                corpus = Corpus(train_sets, vocabs, opts)
+                if state.corpus:
+                    corpus.restore(state.corpus)
+            elif state.corpus:
+                native_bg.seek(int(state.corpus.get("epoch", 1) or 1),
+                               int(state.corpus.get("position", 0)),
+                               seed=state.corpus.get("seed"))
+            last_corpus_state[0] = corpus.state.as_dict()
+            train_key = jax.random.fold_in(base_train_key, n)
+            scheduler.reset_divergence_window()
+            if watchdog is not None:
+                watchdog.resume()
+            log.info("rollback complete: resuming at update {} (epoch "
+                     "{}), LR decay factor {}", state.batches,
+                     state.epochs + 1, state.factor)
+
+        rollbacks = 0
+        try:
+            while True:
+                try:
+                    if _epoch_loop() == "exit":
+                        return
                     break
-            if not stop:
-                rc = _drain_window()              # epoch-end stragglers
-                if rc == "exit":
-                    return
-                if rc is not None:
-                    stop = True
-                else:
-                    scheduler.new_epoch()
+                except DivergenceError as err:
+                    if div_mode != "rollback":
+                        raise
+                    if rollbacks >= div_retries:
+                        detail = (f"divergence retries exhausted after "
+                                  f"{rollbacks} rollback(s): {err}")
+                        log.error("{}", detail)
+                        obs.FLIGHT.trip("divergence-giveup", detail=detail)
+                        raise DivergenceError(detail) from err
+                    rollbacks += 1
+                    _rollback(rollbacks, str(err))
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         trace.close()
         stimer.stop()
         stimer.report()         # phase breakdown + metrics mirror

@@ -22,6 +22,25 @@ Usage:
     python scripts/chaos.py --workdir /tmp/chaos --rounds 6 --seed 0
     python scripts/chaos.py ... --keep-going      # survey all failures
 
+Self-healing schedule (``--train``, ISSUE 19): rotates four drills
+against a trainer with the full self-healing ladder armed
+(--check-gradient-nan, --on-divergence rollback, --train-stall-timeout,
+flight recorder):
+
+  nan     — train.nan_grad poisons one batch; the run must roll back to
+            the last good bundle IN-PROCESS, leave a divergence-rollback
+            flight dump, and finish all updates finite (the healed
+            trajectory legitimately differs from the reference: LR
+            backoff — so the claim is completion, not bit-exactness);
+  diverge — train.diverge_cost poisons an APPLIED update's loss so the
+            divergence only surfaces at the display boundary; same
+            rollback contract;
+  hang    — train.hang wedges a step; the watchdog must exit with the
+            retriable code 75, write a train-watchdog dump naming the
+            stalled step, and an un-faulted restart resumes BIT-EXACT;
+  kill    — a randomized mid-save kill (the ISSUE 4 schedule) re-run
+            under the self-healing config: never torn, resume bit-exact.
+
 Swap schedule (``--swap``, ISSUE 5): drills the SERVING side of the same
 contract. Per round: commit a base bundle, boot a real marian-server
 (TCP transport) with ``--model-watch`` armed to die at a randomized
@@ -96,7 +115,10 @@ def make_config(d: str, src: str, vocab: str, async_save: bool) -> dict:
 
 
 def run_trainer(cfg: dict, d: str, faults: str = "", timeout: int = 300
-                ) -> int:
+                ) -> "tuple[int, str]":
+    """Run one trainer subprocess; returns (exit code, stderr text) —
+    the --train drills assert on stderr lines the self-healing machinery
+    writes below the logging layer (quiet-proof)."""
     cfg_path = os.path.join(d, "cfg.json")
     with open(cfg_path, "w") as fh:
         json.dump(cfg, fh)
@@ -108,10 +130,10 @@ def run_trainer(cfg: dict, d: str, faults: str = "", timeout: int = 300
                           env=env, timeout=timeout,
                           stdout=subprocess.DEVNULL,
                           stderr=subprocess.PIPE)
-    tail = proc.stderr.decode("utf-8", "replace").strip().splitlines()[-3:]
-    for ln in tail:
+    err = proc.stderr.decode("utf-8", "replace")
+    for ln in err.strip().splitlines()[-3:]:
         print(f"      | {ln}")
-    return proc.returncode
+    return proc.returncode, err
 
 
 def build_vocab(d: str) -> str:
@@ -192,6 +214,184 @@ def final_digest(model_path: str) -> dict:
         hashlib.sha256(open(p, "rb").read()).hexdigest()
         if os.path.isfile(p) else "MISSING")
     return out
+
+
+# ---------------------------------------------------------------------------
+# --train mode: self-healing training gauntlet (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+STALL_EXIT_CODE = 75    # the watchdog's retriable exit (train.py)
+TRAIN_DRILLS = ["nan", "diverge", "hang", "kill"]
+
+
+def make_train_config(d: str, src: str, vocab: str) -> dict:
+    """The kill-drill config plus the self-healing ladder: NaN-skip
+    guard armed, --on-divergence rollback with a bounded retry budget,
+    and the flight recorder armed so every rollback/watchdog trip leaves
+    an auditable dump."""
+    cfg = make_config(d, src, vocab, async_save=False)
+    cfg.update({
+        "after-batches": 6,
+        "check-gradient-nan": True, "on-divergence": "rollback",
+        "divergence-retries": 2, "divergence-skip-window": 1,
+        "divergence-lr-backoff": 0.5,
+        "trace-dump": os.path.join(d, "dumps"),
+    })
+    return cfg
+
+
+def count_dumps(d: str, slug: str) -> int:
+    import glob
+    return len(glob.glob(os.path.join(d, "dumps", f"flight-*{slug}*.json")))
+
+
+def train_round(r: int, drill: str, workdir: str, src: str, vocab: str,
+                rng: "random.Random", ref: dict) -> list:
+    """One --train round; returns a list of violation strings.
+
+    nan / diverge rounds self-heal IN-PROCESS (rollback + LR backoff —
+    the healed trajectory legitimately differs from the reference, so
+    the claim is completion + finiteness + never-torn, not bit-exact).
+    hang / kill rounds die and RESTART — no rollback touched the LR, so
+    the resumed run must be bit-exact with the uninterrupted reference."""
+    d = os.path.join(workdir, f"train{r:02d}")
+    shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d)
+    mp = os.path.join(d, "model.npz")
+    cfg = make_train_config(d, src, vocab)
+    violations = []
+
+    if drill in ("nan", "diverge"):
+        hit = rng.randint(2, 4)
+        if drill == "nan":
+            spec = f"train.nan_grad=fail@{hit}"
+        else:
+            spec = f"train.diverge_cost=fail@{hit}"
+            cfg["disp-freq"] = 1    # cost poison surfaces at the display
+        print(f"  [{r:02d}] {spec} (self-heal in-process)")
+        rc, err = run_trainer(cfg, d, faults=spec)
+        if rc != 0:
+            violations.append(f"self-heal run failed (exit {rc}) — "
+                              f"rollback did not recover")
+        rb = count_dumps(d, "divergence-rollback")
+        if rb < 1:
+            violations.append("no divergence-rollback flight dump — the "
+                              "rollback either never fired or was silent")
+        print(f"      healed exit {rc}, {rb} rollback dump(s)")
+        violations += [f"torn bundle after rollback: {b}"
+                       for b in validate_bundles(mp)]
+        violations += check_final(mp, batches=cfg["after-batches"])
+        return violations
+
+    if drill == "hang":
+        hit = rng.randint(2, 5)
+        spec = f"train.hang=hang@{hit}"
+        cfg["train-stall-timeout"] = 2.0
+        print(f"  [{r:02d}] {spec} (watchdog + restart)")
+        rc, err = run_trainer(cfg, d, faults=spec)
+        if rc != STALL_EXIT_CODE:
+            violations.append(f"watchdog run exited {rc}, expected the "
+                              f"retriable stall code {STALL_EXIT_CODE}")
+        if "TRAIN WATCHDOG" not in err:
+            violations.append("no TRAIN WATCHDOG stderr line")
+        if count_dumps(d, "train-watchdog") < 1:
+            violations.append("no train-watchdog flight dump")
+        print(f"      watchdog exit {rc}")
+    else:   # "kill": mid-step preemption, the ISSUE 4 contract re-run
+        hit = rng.randint(1, 3)
+        spec = f"{rng.choice(KILLABLE)}=kill@{hit}"
+        print(f"  [{r:02d}] {spec} (kill + restart)")
+        rc, _ = run_trainer(cfg, d, faults=spec)
+        print(f"      kill run exit {rc} "
+              f"({'killed as armed' if rc == FAULT_EXIT_CODE else 'fault not crossed'})")
+
+    violations += [f"torn bundle survived the kill: {b}"
+                   for b in validate_bundles(mp)]
+    rc, _ = run_trainer(cfg, d, faults="")
+    if rc != 0:
+        violations.append(f"resume run failed (exit {rc})")
+        return violations
+    violations += [
+        f"{k}: resumed {h} != reference {ref[k]}"
+        for k, h in final_digest(mp).items() if h != ref[k]]
+    violations += [f"post-resume: {b}" for b in validate_bundles(mp)]
+    return violations
+
+
+def check_final(mp: str, batches: int) -> list:
+    """Completion evidence for the self-healed rounds: the advertised
+    update count was reached and every published tensor is finite."""
+    import numpy as np
+    bad = []
+    prog = mp + ".progress.yml"
+    if not os.path.isfile(prog):
+        return [f"missing {prog}"]
+    got = None
+    for line in open(prog):
+        if line.startswith("batches:"):
+            got = int(line.split(":")[1])
+    if got != batches:
+        bad.append(f"finished at update {got}, expected {batches}")
+    with np.load(mp) as z:
+        for name in sorted(z.files):
+            if name.startswith("special:"):
+                continue
+            if not np.isfinite(z[name]).all():
+                bad.append(f"non-finite tensor in final model: {name}")
+                break
+    return bad
+
+
+def train_main(args) -> int:
+    rng = random.Random(args.seed)
+    os.makedirs(args.workdir, exist_ok=True)
+    src = os.path.join(args.workdir, "t.src")
+    with open(src, "w") as fh:
+        fh.write("\n".join(LINES) + "\n")
+    vocab = build_vocab(args.workdir)
+
+    print(f"chaos --train: seed {args.seed}, {args.rounds} rounds")
+    ref_dir = os.path.join(args.workdir, "ref")
+    shutil.rmtree(ref_dir, ignore_errors=True)
+    os.makedirs(ref_dir)
+    print("  [ref] uninterrupted run (self-heal flags armed, no faults)")
+    rc, _ = run_trainer(make_train_config(ref_dir, src, vocab), ref_dir)
+    if rc != 0:
+        print(f"chaos --train: reference run failed (exit {rc})")
+        return 2
+    # (the armed recorder writes a benign atexit "exit" snapshot — only
+    # self-healing trips count as contamination here)
+    if count_dumps(ref_dir, "divergence") or \
+            count_dumps(ref_dir, "watchdog"):
+        print("chaos --train: reference run tripped self-healing with no "
+              "fault armed")
+        return 2
+    ref = final_digest(os.path.join(ref_dir, "model.npz"))
+
+    failures = 0
+    for r in range(args.rounds):
+        drill = TRAIN_DRILLS[r % len(TRAIN_DRILLS)]
+        violations = train_round(r, drill, args.workdir, src, vocab,
+                                 rng, ref)
+        if violations:
+            failures += 1
+            for v in violations:
+                print(f"      VIOLATION: {v}")
+            if not args.keep_going:
+                break
+        else:
+            print("      ok: " + {
+                "nan": "rolled back past the poisoned batch, finished "
+                       "finite, never torn",
+                "diverge": "display-boundary divergence rolled back, "
+                           "finished finite, never torn",
+                "hang": "watchdog tripped (exit 75), restart resumed "
+                        "bit-exact",
+                "kill": "killed mid-step, never torn, resumed bit-exact",
+            }[drill])
+    print(f"chaos --train: {failures} failing round(s) out of "
+          f"{args.rounds} (seed {args.seed})")
+    return 1 if failures else 0
 
 
 # ---------------------------------------------------------------------------
@@ -542,6 +742,14 @@ def main(argv=None) -> int:
     ap.add_argument("--swap", action="store_true",
                     help="serving-side schedule: kill a marian-server at "
                          "randomized lifecycle points mid-hot-swap")
+    ap.add_argument("--train", action="store_true",
+                    help="self-healing training gauntlet (ISSUE 19): "
+                         "rotate nan / diverge / hang / kill drills "
+                         "against a trainer with --on-divergence rollback "
+                         "and --train-stall-timeout armed; asserts "
+                         "rollback dumps, watchdog trips (exit 75), "
+                         "never-torn bundles, and bit-exact resume where "
+                         "the trajectory was not legitimately healed")
     ap.add_argument("--iteration", action="store_true",
                     help="with --swap: run the server in --batching-mode "
                          "iteration with a deliberately tiny KV pool and "
@@ -552,8 +760,12 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.iteration and not args.swap:
         ap.error("--iteration requires --swap")
+    if args.train and args.swap:
+        ap.error("--train and --swap are separate schedules")
     if args.swap:
         return swap_main(args)
+    if args.train:
+        return train_main(args)
 
     rng = random.Random(args.seed)
     os.makedirs(args.workdir, exist_ok=True)
@@ -567,7 +779,7 @@ def main(argv=None) -> int:
     shutil.rmtree(ref_dir, ignore_errors=True)
     os.makedirs(ref_dir)
     print("  [ref] uninterrupted run")
-    rc = run_trainer(make_config(ref_dir, src, vocab, False), ref_dir)
+    rc, _ = run_trainer(make_config(ref_dir, src, vocab, False), ref_dir)
     if rc != 0:
         print(f"chaos: reference run failed (exit {rc})")
         return 2
@@ -586,13 +798,13 @@ def main(argv=None) -> int:
         mp = os.path.join(d, "model.npz")
         cfg = make_config(d, src, vocab, async_save)
         print(f"  [{r:02d}] {spec} async={async_save}")
-        rc = run_trainer(cfg, d, faults=spec)
+        rc, _ = run_trainer(cfg, d, faults=spec)
         killed = rc == FAULT_EXIT_CODE
         print(f"      kill run exit {rc} "
               f"({'killed as armed' if killed else 'fault not crossed'})")
         bad = validate_bundles(mp)
         violations = [f"torn bundle survived the kill: {b}" for b in bad]
-        rc = run_trainer(cfg, d, faults="")
+        rc, _ = run_trainer(cfg, d, faults="")
         if rc != 0:
             violations.append(f"resume run failed (exit {rc})")
         else:

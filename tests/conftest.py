@@ -130,6 +130,26 @@ SLOW_CORE_IDS = {"test_golden[transformer-base]",
                  "test_golden[pipe-expert-moe]"}
 
 
+# ---------------------------------------------------------------------------
+# Time-budgeted tier ordering (ISSUE 19): harnesses run the fast tier
+# under a wall-clock budget (CI step timeouts, the ROADMAP tier-1
+# command's `timeout`), and the self-healing drill suites below spawn
+# fresh interpreters that re-import jax and recompile the model — 5-20s
+# per test, ~100x the suite median. They are scheduled after the rest of
+# the suite so a truncated run sheds only these known-expensive drills
+# instead of an equal wall-clock's worth of cheap unit coverage pushed
+# past the deadline; an untruncated run (CI) executes the identical set.
+# The in-process divergence-policy tests are sub-second and stay in their
+# normal position. Everything else keeps plain collection order — per-test
+# cost-sorting was tried and regressed: recorded per-test durations are
+# warm-cache artifacts of the default order, so reordering silently moves
+# compile costs onto formerly-cheap tests and rebuilds module fixtures.
+# ---------------------------------------------------------------------------
+
+TRAILING_DRILL_FILES = {"test_elastic_resume.py", "test_selfheal.py"}
+TRAILING_EXEMPT_CLASSES = {"TestDivergencePolicy"}  # in-process, sub-second
+
+
 def pytest_collection_modifyitems(config, items):
     for item in items:
         base = item.name.split("[")[0]
@@ -138,6 +158,14 @@ def pytest_collection_modifyitems(config, items):
         fname = os.path.basename(str(item.fspath))
         if fname in SLOW_CORE_FILES or item.name in SLOW_CORE_IDS:
             item.add_marker(pytest.mark.slow_core)
+
+    def trailing(item):
+        if os.path.basename(str(item.fspath)) not in TRAILING_DRILL_FILES:
+            return False
+        cls = getattr(item, "cls", None)
+        return cls is None or cls.__name__ not in TRAILING_EXEMPT_CLASSES
+
+    items[:] = sorted(items, key=trailing)
 
 
 @pytest.fixture(scope="module")

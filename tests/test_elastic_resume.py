@@ -1,0 +1,228 @@
+"""Elastic resume across device geometries (ISSUE 19 tentpole part 2,
+plus ROADMAP item 3's ZeRO-1 no-re-replication regression).
+
+Checkpoint bundles store the optimizer state LOGICALLY (gathered,
+unsharded arrays in .optimizer.npz) and record the save-time mesh
+geometry in the bundle manifest. Restoring on a different device count
+must therefore (a) reassemble bit-identical logical optimizer state and
+(b) re-shard it for the CURRENT mesh — per-device optimizer-sweep bytes
+shrink ~N x instead of silently re-replicating.
+
+The geometry sweep runs real subprocesses under
+XLA_FLAGS=--xla_force_host_platform_device_count={8,4,1}: save at 8,
+restore at 4 and at 1, compare sha256 digests of the gathered state.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from marian_tpu.common import Options
+from marian_tpu.common import prng
+from marian_tpu.models.encoder_decoder import create_model
+from marian_tpu.parallel import zero
+from marian_tpu.training import bundle as bdl
+from marian_tpu.training.graph_group import GraphGroup
+
+
+def _tiny_gg():
+    opts = Options({"type": "transformer", "dim-emb": 16,
+                    "transformer-heads": 2, "transformer-dim-ffn": 32,
+                    "enc-depth": 1, "dec-depth": 1,
+                    "tied-embeddings-all": True, "label-smoothing": 0.0,
+                    "precision": ["float32", "float32"], "max-length": 16,
+                    "learn-rate": 0.05, "optimizer": "adam",
+                    "clip-norm": 0.0, "exponential-smoothing": 0.0})
+    model = create_model(opts, 64, 64)
+    gg = GraphGroup(model, opts)
+    gg.initialize(prng.root_key(21))
+    return gg
+
+
+def _batch(seed=0):
+    import jax.numpy as jnp
+    rs = np.random.RandomState(seed)
+    return {
+        "src_ids": jnp.asarray(rs.randint(2, 64, (8, 6)), jnp.int32),
+        "src_mask": jnp.ones((8, 6), jnp.float32),
+        "trg_ids": jnp.asarray(rs.randint(2, 64, (8, 7)), jnp.int32),
+        "trg_mask": jnp.ones((8, 7), jnp.float32),
+    }
+
+
+class TestZero1NoReplication:
+    """ROADMAP item 3: the regression that fails if per-device optimizer
+    bytes quietly re-replicate. Runs on conftest's 8 forced CPU devices."""
+
+    def test_sweep_bytes_shrink_per_device(self):
+        gg = _tiny_gg()
+        key = prng.stream(prng.root_key(21), prng.STREAM_DROPOUT)
+        gg.update(_batch(0), 1, key)
+        ndev = jax.device_count()
+        assert ndev == 8, "conftest forces 8 host devices"
+        sweep = zero.optimizer_sweep_bytes(gg.opt_state)
+        logical = zero.optimizer_logical_bytes(gg.opt_state)
+        assert logical > 0
+        assert len(sweep) == ndev, "optimizer state absent from a device"
+        # every tensor in the tiny model has a leading dim divisible by 8,
+        # so a correctly sharded sweep is exactly logical/8 per device;
+        # 1.5x slack tolerates a stray replicated scalar, while full
+        # re-replication (= logical per device) fails by ~5x
+        worst = max(sweep.values())
+        assert worst * ndev <= logical * 1.5, (
+            f"optimizer state re-replicated: {worst} bytes on one device "
+            f"vs {logical} logical bytes across {ndev} devices "
+            f"(sweep={sweep})")
+
+    def test_logical_bytes_count_gathered_state(self):
+        gg = _tiny_gg()
+        flat = gg.optimizer_arrays()
+        expect = sum(np.asarray(v).nbytes for k, v in flat.items()
+                     if ":" in k)       # m:/v: groups; skip scalar 't'
+        got = zero.optimizer_logical_bytes(gg.opt_state)
+        # logical bytes reflect the gathered per-parameter arrays (the
+        # scalar step count is noise either way)
+        assert abs(got - expect) <= 64, (got, expect)
+
+
+# ---------------------------------------------------------------------------
+# geometry sweep: save at 8 devices, restore at 4 and at 1
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import hashlib, json, os, sys
+mode, d, ndev = sys.argv[1], sys.argv[2], int(sys.argv[3])
+import jax
+assert jax.device_count() == ndev, (jax.device_count(), ndev)
+import numpy as np
+import jax.numpy as jnp
+from marian_tpu.common import Options, prng
+from marian_tpu.models.encoder_decoder import create_model
+from marian_tpu.parallel import zero
+from marian_tpu.training.checkpoint import load_checkpoint, save_checkpoint
+from marian_tpu.training.graph_group import GraphGroup
+from marian_tpu.training.training_state import TrainingState
+
+opts = Options({"type": "transformer", "dim-emb": 16,
+                "transformer-heads": 2, "transformer-dim-ffn": 32,
+                "enc-depth": 1, "dec-depth": 1,
+                "tied-embeddings-all": True, "label-smoothing": 0.0,
+                "precision": ["float32", "float32"], "max-length": 16,
+                "learn-rate": 0.05, "optimizer": "adam", "clip-norm": 0.0,
+                "exponential-smoothing": 0.0})
+model = create_model(opts, 64, 64)
+gg = GraphGroup(model, opts)
+key = prng.root_key(21)
+tk = prng.stream(key, prng.STREAM_DROPOUT)
+
+def batch(seed):
+    rs = np.random.RandomState(seed)
+    return {"src_ids": jnp.asarray(rs.randint(2, 64, (8, 6)), jnp.int32),
+            "src_mask": jnp.ones((8, 6), jnp.float32),
+            "trg_ids": jnp.asarray(rs.randint(2, 64, (8, 7)), jnp.int32),
+            "trg_mask": jnp.ones((8, 7), jnp.float32)}
+
+def digest():
+    flat = gg.optimizer_arrays()       # gathered LOGICAL state
+    h = hashlib.sha256()
+    for name in sorted(flat):
+        a = np.asarray(flat[name])
+        h.update(("%s|%s|%s" % (name, a.dtype, a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+mp = os.path.join(d, "model.npz")
+out = {"devices": ndev}
+if mode == "save":
+    gg.initialize(key)
+    for i in range(2):
+        gg.update(batch(i), i + 1, tk)
+    st = TrainingState(seed=21)
+    st.batches = 2
+    save_checkpoint(mp, gg.export_params(), opts.as_yaml(), gg, st)
+    out["digest"] = digest()
+else:
+    host_p, _, st = load_checkpoint(mp, gg)
+    assert st is not None and st.batches == 2, st
+    gg.initialize(key, {k: jnp.asarray(v) for k, v in host_p.items()})
+    out["digest"] = digest()
+    sweep = zero.optimizer_sweep_bytes(gg.opt_state)
+    out["n_dev_reported"] = len(sweep)
+    out["max_dev_bytes"] = max(sweep.values())
+    out["logical_bytes"] = zero.optimizer_logical_bytes(gg.opt_state)
+    o = gg.update(batch(5), 3, tk)
+    out["resumed_loss_finite"] = bool(
+        np.isfinite(float(np.asarray(o.loss_sum))))
+print("ELASTIC_JSON " + json.dumps(out))
+"""
+
+
+def _run_child(mode, d, ndev):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={ndev}")
+    env.pop("MARIAN_FAULTS", None)
+    p = subprocess.run(
+        [sys.executable, "-c", _CHILD, mode, d, str(ndev)],
+        env=env, timeout=600, capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr[-4000:]
+    lines = [ln for ln in p.stdout.splitlines()
+             if ln.startswith("ELASTIC_JSON ")]
+    assert lines, p.stdout + "\n" + p.stderr[-2000:]
+    return json.loads(lines[-1][len("ELASTIC_JSON "):]), p.stderr
+
+
+@pytest.fixture(scope="module")
+def saved_at_8(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("elastic"))
+    out, _ = _run_child("save", d, 8)
+    return d, out
+
+
+class TestElasticGeometry:
+    def test_manifest_records_save_geometry(self, saved_at_8):
+        d, _ = saved_at_8
+        root = bdl.bundle_root(os.path.join(d, "model.npz"))
+        names = bdl.list_bundles(root)
+        assert names
+        manifest = json.load(
+            open(os.path.join(root, names[-1], bdl.MANIFEST_NAME)))
+        geo = manifest["meta"]["geometry"]
+        assert geo["devices"] == 8
+        assert geo["mesh"]["data"] == 8
+        # manifest meta is the restore side's provenance record: the mesh
+        # axes must all be present so a future geometry can log the delta
+        assert set(geo["mesh"]) >= {"data", "model"}
+
+    def test_restore_at_4_bitwise_equal_and_resharded(self, saved_at_8):
+        d, saved = saved_at_8
+        out, err = _run_child("restore", d, 4)
+        assert out["digest"] == saved["digest"], (
+            "logical optimizer state changed across 8->4 restore")
+        assert out["resumed_loss_finite"]
+        # re-sharded for the CURRENT mesh: 4 devices each hold ~1/4
+        assert out["n_dev_reported"] == 4
+        assert out["max_dev_bytes"] * 4 <= out["logical_bytes"] * 1.5, out
+        # the elastic-resume breadcrumb names both geometries
+        assert "elastic resume" in err
+        assert "8 device" in err
+
+    def test_restore_at_1_bitwise_equal(self, saved_at_8):
+        d, saved = saved_at_8
+        out, err = _run_child("restore", d, 1)
+        assert out["digest"] == saved["digest"], (
+            "logical optimizer state changed across 8->1 restore")
+        assert out["resumed_loss_finite"]
+        # single device: the whole logical state lives on it — the sweep
+        # equals the logical bytes, nothing lost in the gather
+        assert out["n_dev_reported"] == 1
+        assert out["max_dev_bytes"] >= out["logical_bytes"]
+        assert "elastic resume" in err

@@ -1,0 +1,343 @@
+"""Self-healing training (ISSUE 19 tentpole): divergence policy ladder
+(--on-divergence throw | warn | rollback), live NaN-skip surfacing
+(marian_train_updates_skipped_total + bounded-lag consecutive-skip
+detection), and the --train-stall-timeout step watchdog.
+
+The subprocess drills inject the new train.* CATALOG fault points
+(train.nan_grad / train.diverge_cost / train.hang) into the real
+marian-train driver and assert on QUIET-PROOF evidence only: exit codes,
+flight-dump files (named by their trip slug), the Prometheus metrics text
+embedded in each dump, and the raw stderr lines the watchdog writes
+below the logging layer.
+"""
+
+import glob
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.training import bundle as bdl
+from marian_tpu.training.scheduler import DivergenceError, Scheduler
+from marian_tpu.training.train import STALL_EXIT_CODE
+from marian_tpu.training.training_state import TrainingState
+
+
+# ---------------------------------------------------------------------------
+# in-process: policy resolution + skip accounting (scheduler.py)
+# ---------------------------------------------------------------------------
+
+def _sched(**over):
+    base = {"disp-freq": 100, "cost-type": "ce-sum"}
+    base.update(over)
+    return Scheduler(Options(base), TrainingState())
+
+
+def _skip_counter():
+    from marian_tpu.serving import metrics as msm
+    return msm.counter("marian_train_updates_skipped_total")
+
+
+class _LazyFlag:
+    """Stand-in for the optimizer's lazy device scalar: not fenced until
+    someone forces it (float())."""
+
+    def __init__(self, value):
+        self.value = value
+        self.forced = False
+
+    def is_ready(self):
+        return False
+
+    def __float__(self):
+        self.forced = True
+        return float(self.value)
+
+
+class TestDivergencePolicy:
+    def test_mode_resolution(self):
+        assert _sched().divergence_mode == "warn"
+        assert _sched(**{"throw-on-divergence": True}) \
+            .divergence_mode == "throw"
+        assert _sched(**{"on-divergence": "rollback"}) \
+            .divergence_mode == "rollback"
+        # explicit flag wins over the legacy boolean
+        assert _sched(**{"on-divergence": "warn",
+                         "throw-on-divergence": True}) \
+            .divergence_mode == "warn"
+
+    def test_rejects_unknown_mode(self):
+        with pytest.raises(ValueError, match="on-divergence"):
+            _sched(**{"on-divergence": "explode"})
+
+    def test_skips_counted_and_warned_immediately(self, monkeypatch):
+        """A NaN-skipped update increments
+        marian_train_updates_skipped_total and warns on the FIRST skip —
+        no waiting for the display boundary."""
+        from marian_tpu.training import scheduler as sched_mod
+        warned = []
+        monkeypatch.setattr(
+            sched_mod.log, "warn",
+            lambda fmt, *a: warned.append(str(fmt).format(*a)))
+        s = _sched()
+        c = _skip_counter()
+        before = c.value
+        s.update(1.0, 10.0, 2, skipped=np.float32(0.0))
+        assert c.value == before
+        s.update(0.0, 0.0, 2, skipped=np.float32(1.0))
+        assert c.value == before + 1
+        assert any("skipped" in w and "non-finite gradient" in w
+                   for w in warned), warned
+
+    def test_consecutive_skips_raise_within_window(self):
+        s = _sched(**{"on-divergence": "throw",
+                      "divergence-skip-window": 2,
+                      "check-gradient-nan": True})
+        s.update(1.0, 10.0, 2, skipped=np.float32(1.0))
+        with pytest.raises(DivergenceError,
+                           match="consecutive NaN-skipped"):
+            s.update(0.0, 0.0, 2, skipped=np.float32(1.0))
+
+    def test_good_update_resets_the_window(self):
+        s = _sched(**{"on-divergence": "throw",
+                      "divergence-skip-window": 2,
+                      "check-gradient-nan": True})
+        s.update(0.0, 0.0, 2, skipped=np.float32(1.0))
+        s.update(1.0, 10.0, 2, skipped=np.float32(0.0))   # recovered
+        s.update(0.0, 0.0, 2, skipped=np.float32(1.0))    # not consecutive
+        assert s.state.batches == 3                       # no raise
+
+    def test_lazy_flags_drain_with_bounded_lag(self):
+        """An unfenced flag is left alone while young (never a hot-loop
+        sync) but force-synced once it is _skip_lag updates old."""
+        s = _sched(**{"on-divergence": "throw",
+                      "divergence-skip-window": 1,
+                      "check-gradient-nan": True})
+        flag = _LazyFlag(1.0)
+        s.update(0.0, 0.0, 2, skipped=flag)
+        assert not flag.forced                 # young + not ready: deferred
+        s.update(1.0, 10.0, 2)
+        assert not flag.forced                 # age 1 < _skip_lag: still lazy
+        with pytest.raises(DivergenceError):   # age 2: force-synced
+            s.update(1.0, 10.0, 2)
+        assert flag.forced
+
+    def test_drain_skips_is_an_end_of_run_fence(self):
+        """The train loop calls drain_skips() after its last update so a
+        divergence inside the final lag window still raises."""
+        s = _sched(**{"on-divergence": "throw",
+                      "divergence-skip-window": 1,
+                      "check-gradient-nan": True})
+        s.update(0.0, 0.0, 2, skipped=_LazyFlag(1.0))
+        with pytest.raises(DivergenceError):
+            s.drain_skips()
+
+    def test_warn_mode_names_armed_guards_and_rollback_plan(
+            self, monkeypatch):
+        """--on-divergence warn (the default) must say which guards were
+        armed and what rollback WOULD have done — the old one-liner left
+        the operator guessing (ISSUE 19 satellite fix)."""
+        from marian_tpu.training import scheduler as sched_mod
+        warned = []
+        monkeypatch.setattr(
+            sched_mod.log, "warn",
+            lambda fmt, *a: warned.append(str(fmt).format(*a)))
+        s = _sched(**{"disp-freq": 1, "check-gradient-nan": True,
+                      "divergence-retries": 5})
+        s.update(float("nan") * 10.0, 10.0, 2)    # display boundary syncs
+        msg = "\n".join(warned)
+        assert "armed guards" in msg
+        assert "--check-gradient-nan on" in msg
+        assert "rollback would restore the last good checkpoint" in msg
+        assert "give up after 5 attempts" in msg
+
+    def test_throw_mode_display_boundary_raises(self):
+        s = _sched(**{"disp-freq": 1, "on-divergence": "throw"})
+        with pytest.raises(DivergenceError, match="non-finite cost"):
+            s.update(float("nan"), 10.0, 2)
+
+
+# ---------------------------------------------------------------------------
+# subprocess drills: the real driver under injected train.* faults
+# ---------------------------------------------------------------------------
+
+_TRAIN_SNIPPET = (
+    "import json, sys\n"
+    "from marian_tpu.common import Options\n"
+    "from marian_tpu.training.train import train_main\n"
+    "train_main(Options(json.load(open(sys.argv[1]))))\n")
+
+
+def _selfheal_config(d, src, vocab, **over):
+    cfg = {
+        "type": "transformer", "dim-emb": 16, "transformer-heads": 2,
+        "transformer-dim-ffn": 32, "enc-depth": 1, "dec-depth": 1,
+        "tied-embeddings-all": True, "max-length": 16,
+        "precision": ["float32", "float32"], "seed": 7,
+        "train-sets": [src, src], "vocabs": [vocab, vocab],
+        "model": os.path.join(d, "model.npz"),
+        "mini-batch": 4, "maxi-batch": 1, "after-batches": 6,
+        "save-freq": "2u", "disp-freq": 10, "learn-rate": 0.01,
+        "shuffle": "none", "overwrite": True, "quiet": True,
+        # the self-healing ladder under test
+        "check-gradient-nan": True, "on-divergence": "rollback",
+        "divergence-retries": 2, "divergence-skip-window": 1,
+        "divergence-lr-backoff": 0.5,
+        # arm the flight recorder: dumps are the quiet-proof evidence
+        "trace-dump": os.path.join(d, "dumps"),
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _run_train(cfg, d, faults):
+    cfg_path = os.path.join(d, "cfg.json")
+    with open(cfg_path, "w") as fh:
+        json.dump(cfg, fh)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MARIAN_FAULTS=faults)
+    return subprocess.run(
+        [sys.executable, "-c", _TRAIN_SNIPPET, cfg_path], env=env,
+        timeout=300, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _dumps(d, slug):
+    return sorted(glob.glob(os.path.join(d, "dumps", f"flight-*{slug}*.json")))
+
+
+def _final_model_finite(mp):
+    with np.load(mp) as z:
+        for name in z.files:
+            if name.startswith("special:"):
+                continue
+            assert np.isfinite(z[name]).all(), f"non-finite {name}"
+
+
+def _progress_batches(mp):
+    for line in open(mp + ".progress.yml"):
+        if line.startswith("batches:"):
+            return int(line.split(":")[1])
+    raise AssertionError("no batches in progress.yml")
+
+
+@pytest.fixture(scope="module")
+def selfheal_env(tmp_path_factory):
+    base = tmp_path_factory.mktemp("selfheal")
+    lines = ["a b c d", "b c d e", "c d e f", "d e f g",
+             "e f g a", "f g a b", "g a b c", "a c e g"] * 2
+    src = str(base / "t.src")
+    with open(src, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    from marian_tpu.data.vocab import DefaultVocab
+    vocab = str(base / "v.yml")
+    DefaultVocab.build(lines).save(vocab)
+    return {"base": base, "src": src, "vocab": vocab}
+
+
+class TestRollbackDrill:
+    def test_nan_grad_rollback_recovers(self, selfheal_env):
+        """One poisoned batch at update 3 ("train.nan_grad=fail@3"): the
+        skip is detected within the bounded lag, the run rolls back to the
+        update-2 bundle, backs off the LR, replays past the poison window
+        (the exact-hit fault does not refire) and completes all 6 updates
+        with exit 0 — self-healed, loudly."""
+        d = str(selfheal_env["base"] / "rollback_recovers")
+        os.mkdir(d)
+        cfg = _selfheal_config(d, selfheal_env["src"], selfheal_env["vocab"])
+        mp = cfg["model"]
+        proc = _run_train(cfg, d, "train.nan_grad=fail@3")
+        assert proc.returncode == 0, \
+            proc.stderr.decode("utf-8", "replace")[-3000:]
+        dumps = _dumps(d, "divergence-rollback")
+        assert len(dumps) == 1, dumps
+        dump = json.load(open(dumps[0]))
+        assert "rollback 1/2" in dump["detail"]
+        assert "NaN-skipped" in dump["detail"]
+        # the dump's metrics snapshot carries the counters: skips seen,
+        # one rollback taken
+        assert "marian_train_divergence_rollbacks_total 1" in dump["metrics"]
+        assert "marian_train_updates_skipped_total" in dump["metrics"]
+        # rollback never tears checkpoints: every surviving bundle valid
+        root = bdl.bundle_root(mp)
+        for name in bdl.list_bundles(root):
+            ok, why, _ = bdl.validate_bundle(os.path.join(root, name))
+            assert ok, why
+        assert _progress_batches(mp) == 6
+        _final_model_finite(mp)
+        # LR backoff left its mark in the final progress: decay factor 0.5
+        assert "factor: 0.5" in open(mp + ".progress.yml").read()
+
+    def test_retries_exhausted_gives_up_loudly(self, selfheal_env):
+        """"train.nan_grad=fail@3+" poisons EVERY batch from hit 3 on —
+        rollback cannot outrun it. After --divergence-retries attempts the
+        driver must stop self-healing and abort with the full story, plus
+        a divergence-giveup flight dump."""
+        d = str(selfheal_env["base"] / "retries_exhausted")
+        os.mkdir(d)
+        cfg = _selfheal_config(d, selfheal_env["src"], selfheal_env["vocab"],
+                               **{"divergence-retries": 1})
+        proc = _run_train(cfg, d, "train.nan_grad=fail@3+")
+        err = proc.stderr.decode("utf-8", "replace")
+        assert proc.returncode not in (0, STALL_EXIT_CODE), err[-2000:]
+        assert "divergence retries exhausted after 1 rollback" in err
+        assert len(_dumps(d, "divergence-rollback")) == 1
+        assert len(_dumps(d, "divergence-giveup")) == 1
+
+    def test_diverge_cost_caught_at_display_boundary(self, selfheal_env):
+        """train.diverge_cost poisons the APPLIED loss sum — params took a
+        bad step, nothing for --check-gradient-nan to skip. The display
+        boundary's cost sync must still route it into the same rollback
+        ladder."""
+        d = str(selfheal_env["base"] / "diverge_cost")
+        os.mkdir(d)
+        cfg = _selfheal_config(d, selfheal_env["src"], selfheal_env["vocab"],
+                               **{"disp-freq": 1})
+        mp = cfg["model"]
+        proc = _run_train(cfg, d, "train.diverge_cost=fail@3")
+        assert proc.returncode == 0, \
+            proc.stderr.decode("utf-8", "replace")[-3000:]
+        dumps = _dumps(d, "divergence-rollback")
+        assert len(dumps) == 1, dumps
+        assert "non-finite cost" in json.load(open(dumps[0]))["detail"]
+        assert _progress_batches(mp) == 6
+        _final_model_finite(mp)
+
+
+class TestWatchdog:
+    def test_hang_trips_watchdog(self, selfheal_env):
+        """"train.hang=hang@2" wedges the loop before update 2 ever
+        dispatches. The watchdog must notice within --train-stall-timeout,
+        write a flight dump naming the stalled step, save a
+        .stalled.progress.yml breadcrumb, and exit with the DISTINCT
+        retriable code 75 (EX_TEMPFAIL) — not the generic fault code."""
+        d = str(selfheal_env["base"] / "watchdog")
+        os.mkdir(d)
+        cfg = _selfheal_config(d, selfheal_env["src"], selfheal_env["vocab"],
+                               **{"train-stall-timeout": 2.0})
+        mp = cfg["model"]
+        proc = _run_train(cfg, d, "train.hang=hang@2")
+        err = proc.stderr.decode("utf-8", "replace")
+        assert proc.returncode == STALL_EXIT_CODE, \
+            (proc.returncode, err[-2000:])
+        # raw stderr line survives --quiet (written below the log layer)
+        assert "TRAIN WATCHDOG" in err
+        dumps = _dumps(d, "train-watchdog")
+        assert len(dumps) == 1, dumps
+        dump = json.load(open(dumps[0]))
+        assert "training step 2 never fenced" in dump["detail"]
+        assert dump["extra"]["stalled_step"] == 2
+        assert dump["extra"]["last_completed_update"] == 1
+        assert "marian_train_watchdog_trips_total 1" in dump["metrics"]
+        # checkpoint-what's-safe: the host-side progress breadcrumb
+        assert os.path.exists(mp + ".stalled.progress.yml")
+
+    def test_math_guard(self):
+        # STALL_EXIT_CODE must stay distinct from the injected-fault code
+        from marian_tpu.common.faultpoints import FAULT_EXIT_CODE
+        assert STALL_EXIT_CODE == 75
+        assert STALL_EXIT_CODE != FAULT_EXIT_CODE
+        assert not math.isnan(STALL_EXIT_CODE)
