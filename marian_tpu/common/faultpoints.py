@@ -150,6 +150,16 @@ CATALOG: Dict[str, str] = {
                           "at the display-boundary sync — proving the "
                           "display-path detection and rollback without "
                           "touching parameters",
+    "tenant.page_leak": "detection drill (ISSUE 20): an armed 'fail' "
+                        "moves one page reference between the claim "
+                        "lists of owners in DIFFERENT tenants — a page "
+                        "charged to the wrong tenant. Refcounts are "
+                        "unchanged, so KVPool.audit() stays green by "
+                        "construction; only the tenant-level auditor "
+                        "(serving/fleet/accounting.py::audit_tenants) "
+                        "catches it, proving per-tenant isolation is "
+                        "checked against REAL mischarged state, never "
+                        "a mocked report",
 }
 
 

@@ -57,6 +57,12 @@ def snapshot(scheduler) -> Dict:
         "quiescing": scheduler._quiesce_depth(),
         "brownout_level": scheduler._brownout_level,
     }
+    # per-tenant page sums (ISSUE 20), recorded IN the document so a
+    # checker can later re-derive them from the page map and compare —
+    # a divergence is how a corrupted claims plane looks from outside.
+    # Lazy import: obs loads before serving in the package graph.
+    from ..serving.fleet import accounting as _facc
+    state["tenants"] = _facc.tenant_sums_from_state(state)
     return state
 
 
@@ -96,6 +102,12 @@ def check_consistency(state: Dict) -> List[str]:
         if row["pos"] > row["cap"]:
             v.append(f"slot {row['slot']} position {row['pos']} past "
                      f"its cap {row['cap']}")
+    # per-tenant isolation (ISSUE 20): re-derive the tenant sums from
+    # the page map's owner labels and compare to the recorded tenants
+    # block; flag cross-tenant pages and slot/page tenant mismatches —
+    # a dead process's flight dump proves (or disproves) isolation
+    from ..serving.fleet import accounting as _facc
+    v.extend(_facc.check_tenant_isolation(state))
     return v
 
 

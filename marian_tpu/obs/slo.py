@@ -98,9 +98,28 @@ class SloEngine:
                  fast_factor: float = DEFAULT_FAST_FACTOR,
                  slow_factor: float = DEFAULT_SLOW_FACTOR,
                  eval_interval: float = DEFAULT_EVAL_INTERVAL_S,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 outcomes_metric: str = OUTCOMES_METRIC,
+                 latency_metric: str = LATENCY_METRIC,
+                 label_filter: Optional[Tuple[int, str]] = None,
+                 latency_labels: Tuple[str, ...] = (),
+                 objective_prefix: str = ""):
         from ..serving import metrics as msm    # lazy: no import cycle
         self.registry = registry if registry is not None else msm.REGISTRY
+        # multi-tenant fleet serving (ISSUE 20): per-tenant engines read
+        # tenant-labeled fleet counters instead of the global serving
+        # series — `outcomes_metric`/`latency_metric` re-point the
+        # sources, `label_filter` (label index, value) restricts the
+        # outcome children to one tenant, `latency_labels` selects the
+        # tenant's latency-histogram child, and `objective_prefix`
+        # ("A:") keeps the shared marian_slo_* series' objective label
+        # values distinct per tenant. Defaults reproduce the
+        # single-tenant engine exactly.
+        self.outcomes_metric = outcomes_metric
+        self.latency_metric = latency_metric
+        self.label_filter = label_filter
+        self.latency_labels = tuple(latency_labels)
+        self.objective_prefix = objective_prefix
         self.window_s = float(window_s)
         self.slow_window_s = self.window_s * SLOW_WINDOW_MULT
         self.fast_factor = float(fast_factor)
@@ -110,14 +129,14 @@ class SloEngine:
         self.objectives: List[_Objective] = []
         if availability:
             self.objectives.append(_Objective(
-                "availability", float(availability),
+                objective_prefix + "availability", float(availability),
                 f"{float(availability):.6g} of resolved requests ok "
                 f"(bad = {'|'.join(BAD_OUTCOMES)})",
                 self._availability_source))
         if p99_ms:
             self.p99_target_s = float(p99_ms) / 1e3
             self.objectives.append(_Objective(
-                "latency_p99", 0.99,
+                objective_prefix + "latency_p99", 0.99,
                 f"99% of requests under {float(p99_ms):g} ms",
                 self._latency_source))
         if not self.objectives:
@@ -161,11 +180,15 @@ class SloEngine:
 
     # -- SLI sources --------------------------------------------------------
     def _availability_source(self) -> Tuple[float, float]:
-        m = self.registry.get(OUTCOMES_METRIC)
+        m = self.registry.get(self.outcomes_metric)
         if m is None:
             return 0.0, 0.0
         good = bad = 0.0
         for key, child in m.children().items():
+            if self.label_filter is not None:
+                idx, want = self.label_filter
+                if len(key) <= idx or key[idx] != want:
+                    continue
             outcome = key[0] if key else ""
             if outcome == "ok":
                 good += child.value
@@ -174,9 +197,13 @@ class SloEngine:
         return good, good + bad
 
     def _latency_source(self) -> Tuple[float, float]:
-        h = self.registry.get(LATENCY_METRIC)
+        h = self.registry.get(self.latency_metric)
         if h is None:
             return 0.0, 0.0
+        if self.latency_labels:
+            # the tenant's child histogram (auto-created on first read:
+            # a tenant that has not served yet reads (0, 0), not a miss)
+            h = h.labels(*self.latency_labels)
         buckets, counts, total, _sum = h.snapshot()
         good = 0.0
         for edge, c in zip(buckets, counts):

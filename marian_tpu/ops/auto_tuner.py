@@ -19,6 +19,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..common import logging as log
+
 
 class AutoTuner:
     """Generic per-key implementation chooser (reference: AutoTuner::run /
@@ -103,8 +105,95 @@ def _dh_scaled(base: int, dh: int) -> int:
     return max(v, 64)
 
 
+# ---------------------------------------------------------------------------
+# offline sweep overlay (ISSUE 20). The static KERNEL_BLOCKS table above
+# holds hand-validated v5e numbers; scripts/kernel_sweep.py measures the
+# same capacities ON a chip and records them WITH provenance (chip kind,
+# device count, jax version, timestamp, per-candidate timings). Pointing
+# MARIAN_KERNEL_SWEEP at that JSON overlays the table — but only when
+# the recorded chip matches the running one: blocks tuned for different
+# silicon are refused loudly (the provenance is the point — arxiv
+# 1802.04799's autotuning loop records where numbers came from; a
+# hand-edited table can't).
+# ---------------------------------------------------------------------------
+
+SWEEP_ENV = "MARIAN_KERNEL_SWEEP"
+# provenance of the applied sweep (None = static table); kept for
+# introspection/tests
+SWEEP_PROVENANCE: Optional[Dict] = None
+_sweep_checked = False
+
+
+def load_kernel_sweep(path: str, chip: Optional[str] = None) -> bool:
+    """Overlay ``KERNEL_BLOCKS`` from a kernel_sweep.py recording.
+    Returns True when applied. Refuses (False, with a loud warning)
+    when the recorded chip differs from the running one, when the file
+    is malformed, or when it names unknown kernels/keys — a sweep that
+    cannot be attributed must never silently change block sizes."""
+    global SWEEP_PROVENANCE
+    import json
+    import os
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warn("kernel sweep: cannot read {}: {} — keeping the "
+                 "static KERNEL_BLOCKS table", path, e)
+        return False
+    if chip is None:
+        try:
+            chip = str(getattr(jax.devices()[0], "device_kind", "unknown"))
+        except Exception:  # noqa: BLE001 — no backend: nothing to tune
+            chip = "unknown"
+    recorded = str(doc.get("chip", ""))
+    if not recorded or recorded != chip:
+        log.warn("kernel sweep: {} was recorded on chip '{}' but this "
+                 "process runs on '{}' — REFUSING the overlay (re-run "
+                 "scripts/kernel_sweep.py on this chip)",
+                 path, recorded or "?", chip)
+        return False
+    blocks = doc.get("blocks", {})
+    staged = {}
+    for kernel, entries in blocks.items():
+        if kernel not in KERNEL_BLOCKS:
+            log.warn("kernel sweep: unknown kernel {!r} in {} — "
+                     "refusing the whole overlay", kernel, path)
+            return False
+        for key, val in entries.items():
+            if key not in KERNEL_BLOCKS[kernel] or int(val) < 64:
+                log.warn("kernel sweep: bad entry {}.{}={!r} in {} — "
+                         "refusing the whole overlay",
+                         kernel, key, val, path)
+                return False
+            staged[(kernel, key)] = int(val)
+    for (kernel, key), val in staged.items():
+        KERNEL_BLOCKS[kernel][key] = val
+    SWEEP_PROVENANCE = {k: doc.get(k) for k in
+                        ("chip", "n_devices", "jax", "recorded_at",
+                         "timings") if k in doc}
+    SWEEP_PROVENANCE["path"] = os.path.abspath(path)
+    log.info("kernel sweep: applied {} block override(s) from {} "
+             "(chip '{}')", len(staged), path, recorded)
+    return True
+
+
+def _maybe_load_sweep_env() -> None:
+    """One-shot lazy overlay from $MARIAN_KERNEL_SWEEP (checked at the
+    first registry lookup, not import time — jax.devices() must not run
+    on import)."""
+    global _sweep_checked
+    if _sweep_checked:
+        return
+    _sweep_checked = True
+    import os
+    path = os.environ.get(SWEEP_ENV, "")
+    if path:
+        load_kernel_sweep(path)
+
+
 def kernel_block(kernel: str, key: str, dh: int) -> int:
     """Registry lookup with the dh-scaled VMEM convention applied."""
+    _maybe_load_sweep_env()
     return _dh_scaled(KERNEL_BLOCKS[kernel][key], dh)
 
 

@@ -569,6 +569,39 @@ class KVPool:
                     self._refs[p] += 1
                     break
 
+    def chaos_tenant_leak(self) -> None:
+        """Cross the ``tenant.page_leak`` detection drill (ISSUE 20): an
+        armed 'fail' moves ONE page reference from some tenant's claim
+        list into a claim list owned by a DIFFERENT tenant — the
+        mischarged-page bug class of multi-tenant accounting. The move
+        changes no refcount, so :meth:`audit` stays green BY
+        CONSTRUCTION; only the tenant-level auditor
+        (serving/fleet/accounting.py::audit_tenants) can catch it, which
+        is exactly what the drill proves. No-op (beyond the faultpoint
+        crossing) when the pool holds claims from fewer than two
+        distinct tenants."""
+        from ...common import faultpoints as fp
+        try:
+            fp.fault_point("tenant.page_leak")
+        except fp.InjectedFault:
+            from ...serving.fleet import accounting as acc  # lazy: leaf
+            with self._lock:
+                by_tenant = {}
+                for owner, pages in self._claims.items():
+                    t = acc.tenant_of_owner(owner)
+                    if t:
+                        by_tenant.setdefault(t, []).append(owner)
+                tenants = sorted(by_tenant)
+                for src_t in tenants:
+                    src = next((o for o in by_tenant[src_t]
+                                if self._claims[o]), None)
+                    dst_t = next((t for t in tenants if t != src_t), None)
+                    if src is None or dst_t is None:
+                        continue
+                    dst = by_tenant[dst_t][0]
+                    self._claims[dst].append(self._claims[src].pop())
+                    return
+
 
 # ---------------------------------------------------------------------------
 # device-side pool ops

@@ -77,6 +77,32 @@ def split_trace_header(text: str) -> Tuple[Optional[str], str]:
     return tid, rest if sep else ""
 
 
+# Tenant-selection protocol extension (ISSUE 20, backwards-compatible
+# like #trace): in --fleet mode a client picks its model family by
+# making the next line `#model:<tag>`. Headers stack in order #trace,
+# #model, #priority, #stream. A MALFORMED tag is payload, never an
+# error (the usual header discipline) — but a WELL-FORMED tag naming no
+# configured tenant is an explicit !!SERVER-ERROR reply: silently
+# translating legal text with the wrong model is the one failure mode a
+# fleet must never have. Tags share the trace-id alphabet plus '.', so
+# the first '/' in a pool owner label is an unambiguous tenant prefix
+# (serving/fleet/accounting.py).
+MODEL_PREFIX = "#model:"
+_MAX_MODEL_TAG = 64
+
+
+def split_model_header(text: str) -> Tuple[Optional[str], str]:
+    """(tenant tag | None, body) — see MODEL_PREFIX above."""
+    if not text.startswith(MODEL_PREFIX):
+        return None, text
+    first, sep, rest = text.partition("\n")
+    tag = first[len(MODEL_PREFIX):].strip()
+    if not tag or len(tag) > _MAX_MODEL_TAG \
+            or not all(c.isalnum() or c in "-_." for c in tag):
+        return None, text
+    return tag, rest if sep else ""
+
+
 # Priority-lane protocol extension (ISSUE 11, backwards-compatible like
 # #trace): a client MAY make the first body line `#priority:<int>`; the
 # server strips it and admits/schedules the request in that lane. Under
@@ -134,6 +160,16 @@ def split_stream_header(text: str) -> Tuple[Optional[bool], str]:
 # parser while a reply is pending — bounds what a flooding pipelined
 # client can make the server buffer
 MAX_READAHEAD = 1 << 20
+
+
+def _fleet_unrouted(lines: List[str]) -> List[str]:
+    """The fleet-mode scheduler's translate_lines: every request must
+    resolve through the tenant router, so reaching this is a routing
+    bug (handle_frame rejects un-tagged requests without a default
+    tenant BEFORE they queue), never a client error."""
+    raise RuntimeError(
+        "fleet-mode batch reached the un-routed translate path — a "
+        "request was queued without a tenant tag")
 
 
 class TranslationService:
@@ -201,6 +237,43 @@ class ServingApp:
             options.get("batching-mode", "request") or "request")
         if self.batching_mode == "iteration":
             self._validate_iteration_options(options)
+        # persisted compile cache (ISSUE 20): --compile-cache DIR points
+        # jax's persistent compilation cache there at boot, so this
+        # process both reuses prior compiles AND has a cache directory
+        # to pack into bundles (compile_cache.pack_member)
+        cc_dir = str(options.get("compile-cache", "") or "")
+        if cc_dir:
+            from ..serving.lifecycle import compile_cache as mcc
+            mcc.enable(cc_dir)
+        # multi-tenant fleet serving (ISSUE 20): --fleet replaces the
+        # single boot model with N tenants warmed on demand; requests
+        # route by the #model: header. Request mode only — the paged
+        # iteration engine drives ONE model's decode loop; iteration
+        # tenants belong on dedicated replicas.
+        self.fleet = None
+        self._fleet_default = str(
+            options.get("fleet-default-tenant", "") or "")
+        fleet_spec = str(options.get("fleet", "") or "")
+        if fleet_spec:
+            if self.batching_mode == "iteration":
+                raise ValueError(
+                    "--fleet serves --batching-mode request only: the "
+                    "paged iteration engine is single-model (route "
+                    "iteration tenants to dedicated replicas)")
+            if float(options.get("model-watch", 0) or 0) > 0:
+                raise ValueError(
+                    "--fleet and --model-watch are mutually exclusive: "
+                    "the fleet already runs one bundle watcher per "
+                    "tenant (--fleet-watch)")
+            if translate_lines is None:
+                # no single boot model to load — every request resolves
+                # through the tenant router; align the Translate-internal
+                # batcher exactly as the single-model path below does
+                options.set("mini-batch-words", budget)
+                options.set("mini-batch", budget)
+                options.set("maxi-batch", 1)
+                translate_lines = _fleet_unrouted
+                self.service = None
         if translate_lines is None:
             # align the Translate-internal batcher with the scheduler's
             # groups: one scheduler batch == one device batch, hitting the
@@ -352,6 +425,8 @@ class ServingApp:
         if watch_s > 0:
             self._init_lifecycle(watch_s, translate_lines,
                                  executor_factory)
+        if fleet_spec:
+            self._init_fleet(fleet_spec, executor_factory)
 
     # The decode-output-shaping flags iteration mode must take a
     # position on, and that position (ISSUE 16). True = lifted into the
@@ -728,6 +803,69 @@ class ServingApp:
             models=[os.path.join(bundle_dir, member)])
         return TranslationService(bopts).translate_lines
 
+    def _init_fleet(self, spec: str, executor_factory) -> None:
+        """--fleet (ISSUE 20): build the FleetManager — per-tenant
+        lifecycle stacks under a shared HBM budget — and wire it into
+        the scheduler's tenant router + per-tenant version labels and
+        the per-tenant SLO engines (docs/DEPLOYMENT.md "Fleet
+        serving")."""
+        from ..serving import fleet as mfleet
+        from ..serving.lifecycle import load_golden
+        specs = mfleet.parse_fleet_spec(spec)
+        tags = {s.tag for s in specs}
+        if self._fleet_default and self._fleet_default not in tags:
+            raise ValueError(
+                f"--fleet-default-tenant '{self._fleet_default}' is not "
+                f"a configured tenant (have: {', '.join(sorted(tags))})")
+        opts = self.options
+        self.fleet = mfleet.FleetManager(
+            specs,
+            executor_factory or self._fleet_executor_factory,
+            metrics_registry=self.registry,
+            hbm_budget_bytes=int(
+                float(opts.get("fleet-hbm-budget-mb", 0) or 0) * (1 << 20)),
+            watch_interval=float(opts.get("fleet-watch", 0) or 0),
+            golden=load_golden(opts.get("warmup-golden", "") or None),
+            canary_fraction=float(opts.get("canary-fraction", 0) or 0),
+            rollback_error_rate=float(
+                opts.get("rollback-error-rate", 0.5) or 0.5),
+            rollback_p99_factor=float(
+                opts.get("rollback-p99-factor", 0) or 0),
+            canary_min_batches=int(
+                opts.get("canary-min-batches", 8) or 8),
+            brownout_min_priority=self._brownout_min_priority)
+        n = self.fleet.build_slos(
+            availability=float(opts.get("slo-availability", 0) or 0),
+            p99_ms=float(opts.get("slo-p99-ms", 0) or 0))
+        if n:
+            log.info("fleet: per-tenant SLO engines armed for {} "
+                     "tenant(s)", n)
+        self.scheduler.tenant_router = self.fleet.executor_for
+        self.scheduler.tenant_version_fn = self.fleet.live_version_name
+        # every flight dump carries the fleet table (residency, per-
+        # tenant burn, page sums) — the CI smoke's failure artifact
+        obs.FLIGHT.add_snapshot_provider("fleet", self.fleet.status)
+
+    def _fleet_executor_factory(self, bundle_dir: str, manifest):
+        """Default per-tenant executor factory: a fresh
+        TranslationService against the bundle's model member — or
+        against ``bundle_dir`` itself when a tenant warms from a flat
+        model path (no bundles committed yet)."""
+        if os.path.isfile(bundle_dir):
+            model = bundle_dir
+        else:
+            members = (manifest or {}).get("members", {}) or {}
+            model = next(
+                (os.path.join(bundle_dir, rel) for rel in sorted(members)
+                 if rel.endswith(".npz") and "optimizer" not in rel),
+                None)
+            if model is None:
+                raise ValueError(
+                    f"fleet: bundle {bundle_dir} carries no model "
+                    f"member (members: {sorted(members) or 'none'})")
+        bopts = self.options.with_(models=[model])
+        return TranslationService(bopts).translate_lines
+
     def _admin_routes(self) -> Dict:
         """Lifecycle endpoints on the metrics port: GET /lifecyclez
         (version table + health), POST /admin/pin | /admin/unpin |
@@ -779,6 +917,13 @@ class ServingApp:
         routes.update(obs.pool_routes(lambda: self.scheduler))
         if self.lifecycle is not None:
             routes.update(self._admin_routes())
+        if self.fleet is not None:
+            # /fleetz: the fleet table — per-tenant residency, live
+            # version, in-flight batches, cold starts, SLO burn, page
+            # sums — same JSON the flight dump embeds
+            routes["/fleetz"] = lambda method, query: (
+                200, json.dumps(self.fleet.status(), indent=1).encode()
+                + b"\n", "application/json")
         self.metrics_server = msm.maybe_start_metrics_server(
             self.options, ready_fn=self.ready, routes=routes)
         if self.slo is not None:
@@ -792,6 +937,11 @@ class ServingApp:
             self._boot_warmup()
         if self.watcher is not None:
             self.watcher.start()
+        if self.fleet is not None:
+            # pre-warm every tenant the budget allows (spec order; the
+            # earliest-warmed become the LRU victims under pressure) and
+            # start the per-tenant SLO evaluator + bundle watchers
+            self.fleet.start()
         self._started = True
         log.info("Serving: token budget {} padded tokens/batch, queue "
                  "limit {} sentences, request timeout {}",
@@ -848,7 +998,9 @@ class ServingApp:
         strictly before this coroutine returns the final reply); None
         means the transport cannot stream — the header is then ignored,
         which is also the request-mode behavior."""
+        t0 = time.perf_counter()
         trace_id, body = split_trace_header(text)
+        model_tag, body = split_model_header(body)
         hdr_priority, body = split_priority_header(body)
         if hdr_priority is not None:
             priority = hdr_priority
@@ -858,56 +1010,88 @@ class ServingApp:
             def on_partial(idx: int, partial: str, _ntok: int) -> None:
                 send_partial(f"{PARTIAL_PREFIX}{idx} {partial}")
         lines = body.split("\n")
+        # fleet mode (ISSUE 20): the #model: tag picks the tenant (or
+        # --fleet-default-tenant); without a fleet the header is payload
+        tenant = ""
+        if self.fleet is not None:
+            tenant = model_tag or self._fleet_default
+
+        def finish(outcome: str, reply: str):
+            if self.fleet is not None and tenant:
+                # the tenant-labeled series the per-tenant SLO engines
+                # burn against (end-to-end latency, this coroutine)
+                self.fleet.note_outcome(tenant, outcome,
+                                        time.perf_counter() - t0)
+            return self._finish_frame(trace_id, meta, span, outcome,
+                                      reply)
+
         span = None
         if obs.enabled():
             span = obs.start_span("request", trace_id=trace_id or None,
                                   n_sentences=len(lines),
-                                  priority=priority)
+                                  priority=priority, tenant=tenant)
         # reply metadata (queue vs service breakdown) is collected iff
         # the client asked for it by sending a trace header
         meta: Optional[Dict] = {} if trace_id is not None else None
+        if self.fleet is not None and not self.fleet.has_tenant(tenant):
+            # a WELL-FORMED but unconfigured tag (or no tag and no
+            # default) is an explicit error — translating legal text
+            # with the wrong model is the one thing a fleet must never
+            # do. Shed label "?" — tags are client-controlled, and an
+            # unbounded label value would be a cardinality bomb.
+            self.fleet.note_shed("?", "unknown_tenant")
+            tenant = ""     # don't bill outcomes to the unknown tag
+            return finish(
+                "failure",
+                f"!!SERVER-ERROR unknown model tag "
+                f"'{model_tag or self._fleet_default or '(none)'}' — "
+                f"send #model:<tag> "
+                f"(configured: {', '.join(self.fleet.tags())})")
         n_pages = (sum(self._pages_for_text(l) for l in lines)
                    if self._pages_for_text is not None else 0)
         try:
             # admit inside the span context so a shed's timeline event
-            # inherits the trace id (flight dumps tie it to the victim)
+            # inherits the trace id (flight dumps tie it to the victim);
+            # the per-tenant gate runs first — a tenant burning its own
+            # error budget sheds before it costs global queue space
             with obs.TRACER.use(span):
+                if self.fleet is not None:
+                    self.fleet.gate(tenant, priority)
                 self.admission.admit(len(lines), n_pages=n_pages,
                                      priority=priority)
         except Overloaded as e:
-            return self._finish_frame(trace_id, meta, span, "shed",
-                                      f"!!SERVER-OVERLOADED {e}")
+            return finish("shed", f"!!SERVER-OVERLOADED {e}")
         with obs.TRACER.use(span):
             fut = self.scheduler.submit(
                 lines, priority=priority,
                 timeout=self.request_timeout or None,
-                meta=meta, trace_id=trace_id, on_partial=on_partial)
+                meta=meta, trace_id=trace_id, on_partial=on_partial,
+                tenant=tenant)
         try:
             out = await fut
         except RequestTimeout as e:
-            return self._finish_frame(trace_id, meta, span, "timeout",
-                                      f"!!SERVER-TIMEOUT {e}")
+            return finish("timeout", f"!!SERVER-TIMEOUT {e}")
         except DispatchStalled as e:
             # watchdog liveness trip: explicitly retriable — the replica
             # is healthy again (fresh device worker), resend the request
-            return self._finish_frame(trace_id, meta, span, "stalled",
-                                      f"!!SERVER-RETRY {e}")
+            return finish("stalled", f"!!SERVER-RETRY {e}")
         except RowEvicted as e:
             # quiesce-deadline / brownout / recoverable-engine-failure
             # eviction (ISSUE 11): pages freed, replica healthy or about
             # to be — explicitly retriable, counted, never silent
-            return self._finish_frame(trace_id, meta, span, "evicted",
-                                      f"!!SERVER-RETRY {e}")
+            return finish("evicted", f"!!SERVER-RETRY {e}")
         except asyncio.CancelledError:
             # client abort: record the root span before unwinding — an
             # aborted request is exactly what an operator inspects later,
             # and an un-ended span never reaches the ring
+            if self.fleet is not None and tenant:
+                self.fleet.note_outcome(tenant, "cancelled",
+                                        time.perf_counter() - t0)
             obs.end(span, outcome="cancelled")
             raise
         except Exception:  # error already logged by the scheduler
-            return self._finish_frame(trace_id, meta, span, "failure", "")
-        return self._finish_frame(trace_id, meta, span, "ok",
-                                  "\n".join(out))
+            return finish("failure", "")
+        return finish("ok", "\n".join(out))
 
     @staticmethod
     def _finish_frame(trace_id: Optional[str], meta: Optional[Dict],
@@ -987,6 +1171,10 @@ class ServingApp:
             bdl.remove_commit_hook(self._on_bundle_commit)
             self.watcher.stop()
             self.watcher = None
+        if self.fleet is not None:
+            obs.FLIGHT.remove_snapshot_provider("fleet")
+            self.fleet.stop()
+            self.fleet = None
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None

@@ -12,6 +12,9 @@ and the continuous-batching scheduler (serving/scheduler.py).
 - ``warmup``     — compat refusal, executor load, golden-set smoke
 - ``controller`` — SwapController: atomic between-batch re-pointing,
   --canary-fraction routing, failure-rate/p99 auto-rollback, admin verbs
+- ``compile_cache`` — persisted XLA compilation cache as a bundle
+  member (ISSUE 20): pack on commit, key-verify + adopt before warmup
+  so a swap (or fleet cold start) is load+verify instead of full jit
 
 Operator runbook: docs/DEPLOYMENT.md.
 """

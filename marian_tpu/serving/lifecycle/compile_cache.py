@@ -1,0 +1,265 @@
+"""Persisted XLA compilation cache as a checkpoint-bundle member
+(ISSUE 20 tentpole — the warm-on-demand cold-start enabler).
+
+Since ISSUE 17 the serving compile-key surface is ENUMERABLE: the
+``# buckets:`` registries + ``warm_grid`` manifest close the shape set,
+so "persist the compile cache" finally has a concrete manifest (the warm
+grid IS the list of programs the cache must hold) and a ledger
+(``marian_compile_backend_seconds_total{trigger=swap-warmup}`` must stay
+~flat across a cache-backed swap — tests/test_compile_cache.py pins it).
+
+Mechanism: jax's persistent compilation cache
+(``jax_compilation_cache_dir``) already content-addresses compiled
+executables by (computation, compile options, backend). This module adds
+the bundle plumbing around it:
+
+- :func:`enable` points the process at a cache directory (thresholds
+  zeroed so every serving-shape program persists, not just slow ones).
+- :func:`pack_member` is a ``write_bundle``-compatible member writer
+  that zips the live cache directory plus a :func:`cache_key` record
+  into the bundle (member ``xla_cache.zip`` —
+  training/bundle.py :: COMPILE_CACHE_MEMBER).
+- :func:`adopt` (called by warmup before the executor factory runs)
+  unpacks a candidate bundle's cache member, VERIFIES its recorded key
+  against the current (chip, geometry, flags), and only then enables
+  it — a cache built for different silicon or XLA flags must never be
+  installed (jax would re-key and miss anyway; the refusal makes the
+  mismatch visible in the hit/miss ledger instead of silent).
+
+The key is deliberately coarse — chip kind + device count + platform +
+jax version + XLA-flags hash + the bundle compat hash. jax's own cache
+key does the fine-grained content addressing; ours only answers "was
+this cache produced by an equivalent process on equivalent silicon".
+
+Everything degrades to a loud no-op when jax is unavailable (the
+stub-or-gate dependency rule) or the cache member is absent — warmup
+then pays the full jit exactly as before this ISSUE.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import zipfile
+from typing import Callable, Dict, Optional, Tuple
+
+from ...common import logging as log
+from .. import metrics as msm
+
+# bundle member name (mirrored as training/bundle.py::COMPILE_CACHE_MEMBER
+# so producers need no import of the serving tree)
+CACHE_MEMBER = "xla_cache.zip"
+# key record inside the zip, checked before enabling the unpacked cache
+KEY_FILE = "MARIAN_CACHE_KEY.json"
+
+_m_events = None
+
+
+def _events():
+    """marian_compile_cache_events_total{event}: the hit/miss ledger —
+    packed / adopted / miss (no member) / key-mismatch / error."""
+    global _m_events
+    if _m_events is None:
+        _m_events = msm.REGISTRY.counter(
+            "marian_compile_cache_events_total",
+            "Persisted-compile-cache lifecycle events "
+            "(adopted = warm-on-demand is load+verify, not full jit)",
+            labels=("event",))
+        # pre-declare every event so the ledger renders at zero — an
+        # operator alerting on key-mismatch needs the series to exist
+        # before the first mismatch
+        for ev in ("packed", "adopted", "miss", "key-mismatch", "error"):
+            _m_events.labels(ev).inc(0)
+    return _m_events
+
+
+def _flags_sha() -> str:
+    """Hash of the env-level compiler knobs that change compiled code
+    without changing the computation."""
+    blob = "\x1f".join(os.environ.get(k, "") for k in
+                       ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "JAX_PLATFORMS"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def cache_key(compat_hash: str = "") -> Optional[Dict[str, str]]:
+    """The (chip, geometry, flags) identity of caches this process can
+    adopt. None when jax is unavailable."""
+    try:
+        import jax
+        devs = jax.devices()
+    except Exception as e:  # noqa: BLE001 — no backend = no cache
+        log.warn("compile cache: no jax backend ({}) — cache disabled", e)
+        return None
+    return {
+        "chip": str(getattr(devs[0], "device_kind", "unknown")),
+        "platform": str(getattr(devs[0], "platform", "unknown")),
+        "n_devices": str(len(devs)),
+        "jax": str(getattr(jax, "__version__", "unknown")),
+        "flags_sha": _flags_sha(),
+        "compat": str(compat_hash or ""),
+    }
+
+
+def key_matches(recorded: Dict, current: Dict) -> Tuple[bool, str]:
+    """Strict equality on every field; compat is compared only when both
+    sides recorded one (v1 manifests carry none — documented fallback,
+    same permissiveness as bundle compat_ok)."""
+    for field in ("chip", "platform", "n_devices", "jax", "flags_sha"):
+        r, c = str(recorded.get(field, "")), str(current.get(field, ""))
+        if r != c:
+            return False, f"{field} mismatch (cache '{r}' vs here '{c}')"
+    r, c = str(recorded.get("compat", "")), str(current.get("compat", ""))
+    if r and c and r != c:
+        return False, f"compat mismatch (cache '{r}' vs here '{c}')"
+    return True, ""
+
+
+_enabled_dir: Optional[str] = None
+
+
+def enable(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``
+    (created if missing), with the persistence thresholds zeroed so the
+    small CPU-sized serving programs tier-1 runs under persist too.
+    Idempotent; returns False (loudly) when jax is unavailable."""
+    global _enabled_dir
+    try:
+        import jax
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # CRITICAL for adoption: by default jax parks XLA's own side
+        # caches (e.g. xla_gpu_per_fusion_autotune_cache_dir) INSIDE the
+        # cache dir and serializes those absolute paths into the compile
+        # options — which are hashed into every cache key. A cache
+        # unpacked at any other path (adopt() from a bundle — the whole
+        # feature) would then miss on every single entry. "none" keeps
+        # the key path-independent, so packed caches are portable across
+        # directories and processes.
+        try:
+            jax.config.update("jax_persistent_cache_enable_xla_caches",
+                              "none")
+        except Exception as e:  # noqa: BLE001 — option absent in old jax
+            log.warn("compile cache: cannot pin "
+                     "jax_persistent_cache_enable_xla_caches=none ({}); "
+                     "adopted caches may miss if the unpack dir differs "
+                     "from the producer's cache dir", e)
+        # jax memoizes its cache instance on first use; without a reset
+        # a mid-process dir switch (adopt() at swap time — the whole
+        # point) is silently ignored and the swap pays the full jit.
+        # Private API, so absence degrades to a loud warning: a server
+        # that enables the cache BEFORE its first compile is unaffected.
+        try:
+            from jax._src.compilation_cache import reset_cache
+            reset_cache()
+        except Exception as e:  # noqa: BLE001 — jax moved the hook
+            log.warn("compile cache: could not reset jax's cache "
+                     "instance ({}); a cache dir switched after first "
+                     "use may not take effect until restart", e)
+    except Exception as e:  # noqa: BLE001
+        log.warn("compile cache: could not enable persistent cache at "
+                 "{}: {}", cache_dir, e)
+        return False
+    _enabled_dir = cache_dir
+    log.info("compile cache: persistent XLA cache enabled at {}",
+             cache_dir)
+    return True
+
+
+def active_dir() -> Optional[str]:
+    """The enabled cache directory, or None."""
+    return _enabled_dir
+
+
+def pack_member(cache_dir: Optional[str] = None, compat_hash: str = ""
+                ) -> Callable[[str], None]:
+    """A ``write_bundle`` member writer for ``xla_cache.zip``: zips the
+    (enabled or given) cache directory with the current
+    :func:`cache_key` record. The writer raises if no cache is enabled
+    or the key cannot be derived — a producer asking to persist a cache
+    it does not have is a config error, not a silent empty member."""
+    def _write(path: str) -> None:
+        src = cache_dir or _enabled_dir
+        if not src or not os.path.isdir(src):
+            raise RuntimeError(
+                "compile cache: no persistent cache directory to pack "
+                "(call compile_cache.enable() / --compile-cache first)")
+        key = cache_key(compat_hash)
+        if key is None:
+            raise RuntimeError("compile cache: no jax backend — cannot "
+                               "record a cache key")
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(KEY_FILE, json.dumps(key, indent=1))
+            n = 0
+            for root, _dirs, files in os.walk(src):
+                for name in files:
+                    full = os.path.join(root, name)
+                    zf.write(full, os.path.relpath(full, src))
+                    n += 1
+        _events().labels("packed").inc()
+        log.info("compile cache: packed {} cache file(s) into {}", n,
+                 os.path.basename(path))
+    return _write
+
+
+def adopt(bundle_dir: str, compat_hash: str = "",
+          into_dir: Optional[str] = None) -> Tuple[bool, str]:
+    """Warm-on-demand entry point (warmup.py calls this BEFORE the
+    executor factory): if the bundle carries ``xla_cache.zip`` and its
+    recorded key matches this process, unpack and enable it — the
+    subsequent jit compiles become load+verify from disk. Returns
+    (adopted, why). Never raises: a bad/missing/mismatched member
+    degrades to the pre-cache full-jit warmup, counted in the event
+    ledger."""
+    member = os.path.join(bundle_dir, CACHE_MEMBER)
+    if not os.path.isfile(member):
+        _events().labels("miss").inc()
+        return False, "no compile-cache member in bundle"
+    current = cache_key(compat_hash)
+    if current is None:
+        _events().labels("error").inc()
+        return False, "no jax backend"
+    try:
+        with zipfile.ZipFile(member) as zf:
+            try:
+                recorded = json.loads(zf.read(KEY_FILE).decode("utf-8"))
+            except KeyError:
+                _events().labels("error").inc()
+                return False, f"member carries no {KEY_FILE}"
+            ok, why = key_matches(recorded, current)
+            if not ok:
+                _events().labels("key-mismatch").inc()
+                log.warn("compile cache: NOT adopting {} ({}) — warmup "
+                         "pays the full jit", member, why)
+                return False, why
+            dest = into_dir or tempfile.mkdtemp(prefix="marian-xla-cache-")
+            os.makedirs(dest, exist_ok=True)
+            for info in zf.infolist():
+                if info.filename == KEY_FILE or info.is_dir():
+                    continue
+                # path-traversal guard: members must unpack INSIDE dest
+                target = os.path.realpath(os.path.join(dest, info.filename))
+                if not target.startswith(os.path.realpath(dest) + os.sep):
+                    raise RuntimeError(
+                        f"compile cache: refusing member path "
+                        f"{info.filename!r} (escapes the unpack dir)")
+                os.makedirs(os.path.dirname(target), exist_ok=True)
+                with zf.open(info) as src, open(target, "wb") as out:
+                    shutil.copyfileobj(src, out)
+    except (OSError, zipfile.BadZipFile, RuntimeError) as e:
+        _events().labels("error").inc()
+        log.warn("compile cache: could not adopt {}: {}", member, e)
+        return False, str(e)
+    if not enable(dest):
+        _events().labels("error").inc()
+        return False, "could not enable the unpacked cache"
+    _events().labels("adopted").inc()
+    log.info("compile cache: adopted {} — swap warmup is load+verify "
+             "(chip {}, {} device(s))", member, current["chip"],
+             current["n_devices"])
+    return True, dest

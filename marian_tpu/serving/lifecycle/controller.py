@@ -314,7 +314,7 @@ class SwapController:
             # compile telemetry under trigger=swap-warmup (ISSUE 9), so
             # the lifecycle swap test can prove zero steady-state
             # recompiles after a hot-swap
-            executor = warm_executor(bundle_dir, manifest,
+            executor = warm_executor(bundle_dir, manifest,  # mtlint: disable=MT-LOCK-BLOCKING -- only the fleet's per-tenant _Tenant.warm_lock reaches here held (FleetManager._warm), and stalling a duplicate cold start of the same tenant behind the first one is that lock's purpose
                                      self.executor_factory,
                                      self.golden or list(DEFAULT_GOLDEN),
                                      version=name)

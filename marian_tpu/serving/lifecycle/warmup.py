@@ -199,6 +199,26 @@ def warm_executor(bundle_dir: str, manifest: Optional[Dict],
     combined call is kept — same refusal semantics, no telemetry."""
     fp.fault_point("lifecycle.warmup")
     t0 = time.perf_counter()
+    # persisted compile cache (ISSUE 20): a bundle carrying xla_cache.zip
+    # whose recorded (chip, geometry, flags) key matches this process
+    # turns the jit compiles below into load+verify from disk — the
+    # trigger=swap-warmup compile ledger stays ~flat across the swap.
+    # Any mismatch/absence degrades to the full jit, counted, never fatal.
+    if manifest is not None and bundle_dir:
+        from . import compile_cache as _cc
+        import os as _os
+        if _os.path.isdir(bundle_dir):
+            # merge into the already-enabled dir when there is one, so a
+            # server running with --compile-cache keeps its accumulated
+            # entries; otherwise adopt() unpacks into a fresh tempdir
+            adopted, _why = _cc.adopt(
+                bundle_dir,
+                compat_hash=bdl.compat_hash(bdl.manifest_compat(manifest)),
+                into_dir=_cc.active_dir())
+            if adopted:
+                log.info("warmup: adopted persisted compile cache from "
+                         "{} — expecting cache-hit compiles only",
+                         bundle_dir)
     try:
         executor = executor_factory(bundle_dir, manifest)
     except Exception as e:  # noqa: BLE001 — any load error refuses the swap

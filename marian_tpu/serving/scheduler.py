@@ -120,7 +120,7 @@ class _Request:
                  "first_dispatch", "timeout_handle", "dead_accounted",
                  "trace_id", "span", "own_root", "q_span", "d_span",
                  "meta", "rounds", "prefix_hits", "evictions_n",
-                 "on_partial", "ttft")
+                 "on_partial", "ttft", "tenant")
 
     def __init__(self, lines: List[str], future: "asyncio.Future",
                  priority: int, arrival: float, deadline: Optional[float]):
@@ -164,6 +164,12 @@ class _Request:
         # request's time-to-first-token, stamped at its FIRST partial
         self.on_partial: Optional[Callable[[int, str, int], None]] = None
         self.ttft: Optional[float] = None
+        # multi-tenant fleet serving (ISSUE 20): the #model: tag this
+        # request belongs to ("" = the single-model default). Batches
+        # are formed single-tenant and routed through tenant_router;
+        # fleet/accounting.py attributes KV-page owners through this
+        # field (owner.req.tenant).
+        self.tenant = ""
 
 
 class _Unit:
@@ -235,6 +241,15 @@ class ContinuousScheduler:
         # --dispatch-stall-timeout: liveness watchdog over each device
         # call (0 = off). See _translate_units / _trip_watchdog.
         self.stall_timeout = max(0.0, float(stall_timeout))
+        # multi-tenant fleet serving (ISSUE 20), set by the server in
+        # --fleet mode: tenant_router(tag) resolves (warming on demand)
+        # the tenant's route for one batch — called on the DEVICE WORKER
+        # thread so a cold start blocks only the batch that needs it;
+        # tenant_version_fn(tag) labels outcomes per tenant. Both None
+        # in single-model serving (tenant "" uses translate_lines).
+        self.tenant_router: Optional[
+            Callable[[str], Callable[[List[str]], List[str]]]] = None
+        self.tenant_version_fn: Optional[Callable[[str], str]] = None
         self.token_budget = max(1, int(token_budget))
         self.length_buckets = length_buckets
         self.batch_multiple = batch_multiple
@@ -609,7 +624,7 @@ class ContinuousScheduler:
                meta: Optional[dict] = None,
                trace_id: Optional[str] = None,
                on_partial: Optional[Callable[[int, str, int], None]]
-               = None) -> "asyncio.Future":
+               = None, tenant: str = "") -> "asyncio.Future":
         """Enqueue one request (a list of sentences); returns a future
         resolving to the list of translations in input order. Must be
         called from the event-loop thread (transports live there).
@@ -644,6 +659,7 @@ class ContinuousScheduler:
         req.meta = meta
         req.trace_id = trace_id or ""
         req.on_partial = on_partial
+        req.tenant = tenant or ""
         if obs.enabled():
             # span tree: reuse the context's request-root span when the
             # transport opened one (server.handle_frame); open our own
@@ -682,8 +698,13 @@ class ContinuousScheduler:
         self._wake.set()
         return fut
 
-    def _version_label(self) -> str:
+    def _version_label(self, req: Optional[_Request] = None) -> str:
         try:
+            # fleet mode: a tenanted request labels with ITS tenant's
+            # live version ("<tag>:<bundle>"), not the global one
+            if req is not None and req.tenant \
+                    and self.tenant_version_fn is not None:
+                return str(self.tenant_version_fn(req.tenant))
             return str(self.version_fn())
         except Exception:  # noqa: BLE001 — labeling must never fail a reply
             return "unknown"
@@ -694,7 +715,7 @@ class ContinuousScheduler:
         swap-correlated outcome shift is visible per version. With
         ``req``, also finish its span tree and fill its reply-metadata
         dict (queue-wait vs service breakdown)."""
-        version = self._version_label()
+        version = self._version_label(req)
         self.m_outcomes.labels(outcome, version).inc()
         if req is None:
             return
@@ -802,6 +823,7 @@ class ContinuousScheduler:
         batch: List[_Unit] = []
         width = 0
         scanned = 0
+        tenant: Optional[str] = None
         skipped: List[_Unit] = []
         with self._state_lock:
             for prio in sorted(self._lanes.keys(), reverse=True):
@@ -824,6 +846,15 @@ class ContinuousScheduler:
                             # the already-lowered req.queued instead
                             self._dead -= 1
                             self._dead_pages -= u.pages
+                        continue
+                    # fleet mode (ISSUE 20): batches are SINGLE-tenant —
+                    # one device call serves one model. The first live
+                    # unit seeds the batch's tenant; other tenants' units
+                    # keep FIFO order for the next pass via skipped
+                    if tenant is None:
+                        tenant = u.req.tenant
+                    elif u.req.tenant != tenant:
+                        skipped.append(u)
                         continue
                     new_width = max(width, bucket_length(u.tokens,
                                                          self.length_buckets))
@@ -1607,14 +1638,25 @@ class ContinuousScheduler:
             fp.fault_point("serving.dispatch")
             lines = [u.text for u in units]
             translate = self.translate_lines
+            # fleet mode (ISSUE 20): a tenanted batch (single-tenant by
+            # _form_batch) resolves its route through the tenant router
+            # ON THE WORKER THREAD — a warm-on-demand cold start blocks
+            # only this batch, never the event loop
+            tenant = units[0].req.tenant
+            router = self.tenant_router
 
             def _call_translate():
+                run = translate
+                if router is not None and tenant:
+                    # resolved BEFORE the device-time fence: a cold
+                    # start is warmup, not this batch's service time
+                    run = router(tenant)
                 # device-time fence: translate_lines returns host-side
                 # strings, so the perf_counter read AFTER it is an
                 # honest device-seconds boundary (obs/perf.py)
                 t0 = time.perf_counter()
                 try:
-                    out_ = translate(lines)
+                    out_ = run(lines)
                 finally:
                     if local_acc is not None:
                         local_acc[0] += time.perf_counter() - t0

@@ -56,6 +56,12 @@ from ..common import logging as log
 
 BUNDLE_SUFFIX = ".bundles"
 MANIFEST_NAME = "MANIFEST.json"
+# Optional member: the producer's persisted XLA compilation cache
+# (serving/lifecycle/compile_cache.py — pack_member writes it, warmup
+# adopt()s it after verifying its (chip, geometry, flags) key, so a
+# hot-swap / fleet cold start is load+verify instead of full jit).
+# Bundles without it warm exactly as before ISSUE 20.
+COMPILE_CACHE_MEMBER = "xla_cache.zip"
 # v2: + "compat" block (vocab sha256 + geometry config hash). Readers
 # accept 1..MANIFEST_VERSION; see manifest_compat for the v1 fallback.
 MANIFEST_VERSION = 2
@@ -356,7 +362,7 @@ def validate_bundle(bundle_dir: str) -> Tuple[bool, str, Optional[Dict]]:
     if not os.path.isfile(mpath):
         return False, "manifest missing", None
     try:
-        with open(mpath, "r", encoding="utf-8") as fh:
+        with open(mpath, "r", encoding="utf-8") as fh:  # mtlint: disable=MT-LOCK-BLOCKING -- reached under the fleet's per-tenant _Tenant.warm_lock during a cold start; serializing duplicate warmups of one tenant through this read is deliberate
             manifest = json.load(fh)
     except (OSError, ValueError) as e:
         return False, f"manifest unreadable ({e})", None

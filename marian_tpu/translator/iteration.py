@@ -984,18 +984,26 @@ class PagedDecodeEngine:
     def _owner_label(owner) -> str:
         """Human/JSON-safe label for a claim owner: serving units carry
         their request's trace id, prefix-cache owners their tag; bare
-        keys (library/test callers) fall back to repr."""
+        keys (library/test callers) fall back to repr. Tenanted owners
+        (ISSUE 20) get a ``<tag>/`` prefix — the label-level tenant
+        convention fleet/accounting.py re-derives per-tenant page sums
+        from, so a dead process's /poolz flight dump stays attributable
+        (the shared prefix cache stays untenanted on purpose)."""
         probe = owner
         if isinstance(owner, tuple) and len(owner) == 2:
             probe = owner[0]              # beam (key, slot) pair
-        tid = getattr(getattr(probe, "req", None), "trace_id", "")
+        req = getattr(probe, "req", None)
+        tenant = getattr(req, "tenant", "") if req is not None \
+            else getattr(probe, "tenant", "") or ""
+        prefix = f"{tenant}/" if tenant else ""
+        tid = getattr(req, "trace_id", "") if req is not None else ""
         if tid:
-            base = f"trace:{tid}"
+            base = f"{prefix}trace:{tid}"
             return base if probe is owner else f"{base}#{owner[1]}"
         if isinstance(owner, tuple) and len(owner) == 3 \
                 and owner[0] == "prefix":
             return "prefix-cache"
-        return repr(owner)[:96]
+        return (prefix + repr(owner))[:96]
 
     def pool_state(self) -> dict:
         """JSON-ready snapshot of the whole paged-serving data plane:

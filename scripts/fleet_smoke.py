@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Fleet-serving e2e smoke (ISSUE 20 CI leg): three tiny tenants on one
+ServingApp, one hot-swapped under load.
+
+Boots a real ServingApp in ``--fleet`` mode (stub executors — no model,
+no device; CPU-safe like the tier-1 suites) with three tenants behind
+the dependency-free TCP framing plus a live metrics port, then verifies
+the fleet contract end to end:
+
+  1. ROUTING — every ``#model:<tag>`` request is answered by THAT
+     tenant's executor (the reply is tagged with the tenant's model
+     name + bundle seq; a cross-tenant reply is the one failure a
+     fleet must never have), the default tenant serves untagged
+     traffic, and a well-formed tag naming no tenant gets an explicit
+     ``!!SERVER-ERROR`` — never a silent wrong-model translation;
+  2. SWAP UNDER LOAD — a new bundle committed for one tenant while
+     open-loop traffic runs against it swaps in via the fleet's
+     per-tenant watcher with ZERO failed requests; post-swap replies
+     carry the new seq, the other tenants' live versions are untouched;
+  3. SURFACES — /fleetz reports all three tenants resident with their
+     live versions, /metrics carries the marian_fleet_* series, and
+     /poolz?check=1 answers cleanly (request mode: enabled=false).
+
+On any violation the armed flight recorder trips a dump into
+``--workdir`` (CI uploads ``fleet-smoke/**/flight-*.json`` as the
+post-mortem artifact) and the script exits 1.
+
+Usage:
+    python scripts/fleet_smoke.py --workdir /tmp/fleet-smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import socket
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from marian_tpu import obs                                    # noqa: E402
+from marian_tpu.common import Options                         # noqa: E402
+from marian_tpu.training import bundle as bdl                 # noqa: E402
+
+SWAP_TENANT = "B"
+SWAP_DEADLINE_S = 15.0
+
+
+def commit_bundle(model_path: str, tag: str):
+    """One tiny committed bundle via the real commit protocol (the
+    member content is irrelevant to the stub factory — the SEQ is what
+    the reply tag proves)."""
+    def write(p):
+        with open(p, "w", encoding="utf-8") as fh:
+            fh.write(tag)
+    return bdl.write_bundle(model_path, {"m.npz": write})
+
+
+def stub_factory(bundle_dir: str, manifest):
+    """Executor factory: replies tagged ``<model stem>-b<seq>:<line>``
+    so the client can prove WHICH tenant's WHICH bundle answered."""
+    root = os.path.basename(os.path.dirname(os.path.abspath(bundle_dir)))
+    name = root.split(".")[0]                     # m_A.npz.bundles -> m_A
+    seq = int(manifest["seq"]) if manifest else 0
+
+    def translate(lines):
+        time.sleep(0.002)                 # a whiff of device time so the
+        return [f"{name}-b{seq}:{ln}"     # scheduler actually batches
+                for ln in lines]
+    return translate
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def tcp_request(port: int, text: str) -> str:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        payload = text.encode("utf-8")
+        writer.write(b"MTPU %d\n" % len(payload) + payload)
+        await writer.drain()
+        header = await reader.readline()
+        if not header.startswith(b"MTPU "):
+            raise RuntimeError(f"bad reply frame: {header!r}")
+        reply = await reader.readexactly(int(header.split()[1]))
+        return reply.decode("utf-8")
+    finally:
+        writer.close()
+
+
+def http_get(port: int, path: str):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as fh:
+        return fh.read().decode("utf-8")
+
+
+async def run_smoke(args) -> list:
+    from marian_tpu.server.server import ServingApp, _make_tcp_handler
+
+    violations: list = []
+
+    def check(ok: bool, what: str) -> bool:
+        if not ok:
+            violations.append(what)
+            print(f"  FAIL {what}")
+        elif args.verbose:
+            print(f"  ok   {what}")
+        return ok
+
+    wd = os.path.abspath(args.workdir)
+    os.makedirs(wd, exist_ok=True)
+    obs.FLIGHT.arm(wd)          # violations below trip a dump for CI
+
+    models = {t: os.path.join(wd, f"m_{t}.npz") for t in "ABC"}
+    for t, mp in models.items():
+        commit_bundle(mp, f"{t}1")
+
+    mport = free_port()
+    app = ServingApp(
+        Options({
+            "batch-token-budget": 256, "max-queue": 512,
+            "request-timeout": 0.0, "metrics-port": mport,
+            "fleet": ",".join(f"{t}={mp}" for t, mp in models.items()),
+            "fleet-default-tenant": "A",
+            "fleet-watch": args.watch,
+        }),
+        executor_factory=stub_factory)   # default registry: the app's
+    # metrics server scrapes the process-global registry, and this
+    # script IS the whole process — exactly the production shape
+    await app.start()
+    server = await asyncio.start_server(
+        _make_tcp_handler(app), "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    print(f"fleet up: tcp :{port}, metrics :{mport}, workdir {wd}")
+
+    try:
+        # -- 1. routing: every tenant answers its own traffic ------------
+        replies = await asyncio.gather(*[
+            tcp_request(port, f"#model:{t}\nhello {i}")
+            for i, t in enumerate("ABCABC")])
+        for i, t in enumerate("ABCABC"):
+            check(replies[i] == f"m_{t}-b1:hello {i}",
+                  f"tenant {t} answers its own request "
+                  f"(got {replies[i]!r})")
+        # untagged traffic lands on --fleet-default-tenant
+        r = await tcp_request(port, "plain")
+        check(r == "m_A-b1:plain", f"default tenant serves untagged "
+              f"traffic (got {r!r})")
+        # a well-formed tag naming no tenant is an EXPLICIT error
+        r = await tcp_request(port, "#model:Z\nhello")
+        check(r.startswith("!!SERVER-ERROR"),
+              f"unknown tag is refused loudly (got {r!r})")
+
+        # -- 2. hot swap of one tenant under open-loop load --------------
+        outcomes = {"ok": 0, "fail": 0}
+        seqs = set()
+        stop = asyncio.Event()
+
+        async def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    r = await tcp_request(
+                        port, f"#model:{SWAP_TENANT}\nswap load {i}")
+                except Exception:  # noqa: BLE001 — counted, not raised
+                    outcomes["fail"] += 1
+                else:
+                    if r.startswith(f"m_{SWAP_TENANT}-b") \
+                            and r.endswith(f":swap load {i}"):
+                        outcomes["ok"] += 1
+                        seqs.add(r.split(":", 1)[0])
+                    else:
+                        outcomes["fail"] += 1
+                i += 1
+                await asyncio.sleep(0.01)
+
+        loader = asyncio.ensure_future(load())
+        await asyncio.sleep(0.3)            # load running against b1
+        commit_bundle(models[SWAP_TENANT], f"{SWAP_TENANT}2")
+        t0 = time.monotonic()
+        swapped = False
+        while time.monotonic() - t0 < SWAP_DEADLINE_S:
+            fleet = json.loads(http_get(mport, "/fleetz"))
+            row = {r["tenant"]: r for r in fleet["tenants"]}[SWAP_TENANT]
+            if (row["live"] or "").endswith("bundle-00000002"):
+                swapped = True
+                break
+            await asyncio.sleep(0.2)
+        await asyncio.sleep(0.3)            # post-swap traffic on b2
+        stop.set()
+        await loader
+        check(swapped, f"tenant {SWAP_TENANT} swapped to bundle 2 "
+              f"within {SWAP_DEADLINE_S:.0f}s")
+        check(outcomes["fail"] == 0 and outcomes["ok"] > 10,
+              f"zero failed requests across the swap "
+              f"(ok={outcomes['ok']} fail={outcomes['fail']})")
+        check(f"m_{SWAP_TENANT}-b2" in seqs,
+              f"post-swap replies carry the new bundle (saw {seqs})")
+        # the OTHER tenants' live versions must be untouched by B's swap
+        fleet = json.loads(http_get(mport, "/fleetz"))
+        rows = {r["tenant"]: r for r in fleet["tenants"]}
+        for t in "AC":
+            check((rows[t]["live"] or "").endswith("bundle-00000001"),
+                  f"tenant {t} live version undisturbed "
+                  f"(got {rows[t]['live']!r})")
+
+        # -- 3. surfaces -------------------------------------------------
+        check(len(rows) == 3 and all(r["resident"] for r in
+                                     rows.values()),
+              "/fleetz reports 3 resident tenants")
+        metrics = http_get(mport, "/metrics")
+        for series in ("marian_fleet_tenants",
+                       "marian_fleet_resident",
+                       "marian_fleet_request_outcomes_total",
+                       "marian_fleet_cold_starts_total"):
+            check(series in metrics, f"/metrics carries {series}")
+        poolz = json.loads(http_get(mport, "/poolz?check=1"))
+        check(poolz.get("consistency", []) == [],
+              "/poolz?check=1 reports no discrepancies")
+    finally:
+        server.close()
+        await server.wait_closed()
+        await app.shutdown(drain_timeout=5.0)
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--watch", type=float, default=0.2,
+                    help="per-tenant bundle watch interval (s)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    violations = asyncio.run(run_smoke(args))
+    if violations:
+        print(f"\nfleet smoke: {len(violations)} violation(s):")
+        for v in violations:
+            print(f"  - {v}")
+        # leave the post-mortem artifact CI uploads
+        obs.FLIGHT.trip("fleet-smoke-failure",
+                        detail="; ".join(violations)[:1000])
+        return 1
+    print("\nfleet smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

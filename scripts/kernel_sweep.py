@@ -1,0 +1,199 @@
+"""Offline Pallas block-config sweep with recorded provenance (ISSUE 20).
+
+``auto_tuner.py::KERNEL_BLOCKS`` holds the per-kernel sequence-side
+capacities (the VMEM-bounded block caps) as hand-validated v5e numbers.
+This harness MEASURES them on whatever chip it runs on — the
+TVM-autotuning-loop shape (arxiv 1802.04799): enumerate candidates, time
+the real kernel at each, record winner AND evidence — and writes a JSON
+recording that ``MARIAN_KERNEL_SWEEP=<file>`` overlays onto the static
+table at runtime (``auto_tuner.load_kernel_sweep``; the overlay REFUSES
+a recording taken on different silicon, which is why the provenance
+block is not optional).
+
+Per kernel, candidates sweep the capacity axis upward; a candidate that
+crashes (Mosaic VMEM OOM on real silicon) ends the sweep for that
+kernel, and the pick is the largest surviving candidate whose
+per-token time is within ``--tolerance`` of the best — capacity is
+worth nothing if the cell runs slower than two smaller cells.
+
+Candidate grids respect the TPU tiling floor (sequence sides are
+multiples of 64, dh fixed at the validated 64 = half an MXU tile pair;
+see the accelerator guide's min-tile table) so every measured config is
+one the kernels can actually tile.
+
+    python scripts/kernel_sweep.py --out sweep.json
+    python scripts/kernel_sweep.py --kernels packed_attention --iters 5
+    MARIAN_KERNEL_SWEEP=sweep.json python -m marian_tpu ...   # apply
+
+On CPU the kernels run in interpret mode: the recording is still
+honest — it records chip "cpu"-kind and will only ever overlay another
+CPU process (where the caps gate fallback paths, not VMEM) — but block
+capacities for silicon must be swept ON that silicon.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# descending would fail-fast on OOM; ascending lets a crash END the
+# sweep with every smaller (working) candidate already measured
+CANDIDATES = {
+    "packed_attention": {"max_t": (64, 128, 256, 512)},
+    "decode_attention": {"max_len": (512, 1024, 2048, 4096)},
+    "kv_pool": {"max_tokens": (512, 1024, 2048, 4096)},
+}
+DH = 64          # the validated head width every base number is taken at
+HEADS = 8
+ROWS = 8
+PAGE_LEN = 64
+
+
+def _median_s(fn, iters):
+    import jax
+    jax.block_until_ready(fn())          # compile outside the timing
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def _bench_packed(t, iters):
+    import jax
+    import jax.numpy as jnp
+    from marian_tpu.ops.pallas.packed_attention import packed_attention
+    q = jnp.ones((2, HEADS, t, DH), jnp.bfloat16)
+    fn = jax.jit(lambda a: packed_attention(a, a, a, causal=True))
+    return _median_s(lambda: fn(q), iters) / t
+
+
+def _bench_decode(max_len, iters):
+    import jax
+    import jax.numpy as jnp
+    from marian_tpu.ops.pallas.decode_attention import decode_attention
+    q = jnp.ones((ROWS, HEADS, 1, DH), jnp.bfloat16)
+    cache = jnp.zeros((ROWS, HEADS, max_len, DH), jnp.bfloat16)
+    pos = jnp.full((ROWS,), max_len - 1, jnp.int32)
+    fn = jax.jit(lambda a, c, p: decode_attention(a, a, a, c, c, p)[0])
+    return _median_s(lambda: fn(q, cache, pos), iters) / max_len
+
+
+def _bench_kv_pool(max_tokens, iters):
+    import jax
+    import jax.numpy as jnp
+    from marian_tpu.ops.pallas.kv_pool import paged_decode_attention
+    max_pages = max_tokens // PAGE_LEN
+    n_pages = ROWS * max_pages + 1          # + trash page 0
+    q = jnp.ones((ROWS, HEADS, 1, DH), jnp.bfloat16)
+    pool = jnp.zeros((n_pages, HEADS, PAGE_LEN, DH), jnp.bfloat16)
+    table = (jnp.arange(ROWS * max_pages, dtype=jnp.int32)
+             .reshape(ROWS, max_pages) + 1)
+    row_pos = jnp.full((ROWS,), max_tokens - 1, jnp.int32)
+    fn = jax.jit(lambda a, pk, pv, tb, rp:
+                 paged_decode_attention(a, a, a, pk, pv, tb, rp)[0])
+    return _median_s(lambda: fn(q, pool, pool, table, row_pos),
+                     iters) / max_tokens
+
+
+BENCHES = {
+    ("packed_attention", "max_t"): _bench_packed,
+    ("decode_attention", "max_len"): _bench_decode,
+    ("kv_pool", "max_tokens"): _bench_kv_pool,
+}
+
+
+def sweep(kernels, iters, tolerance):
+    """Measure every candidate; per (kernel, key) pick the LARGEST
+    surviving candidate within ``tolerance`` of the best per-token
+    time. Returns (blocks, timings)."""
+    blocks, timings = {}, {}
+    for kernel in kernels:
+        for key, cands in CANDIDATES[kernel].items():
+            bench = BENCHES[(kernel, key)]
+            rows = []
+            for cap in cands:
+                try:
+                    per_tok = bench(cap, iters)
+                    rows.append({"candidate": cap, "ok": True,
+                                 "s_per_token": per_tok})
+                    print(f"  {kernel}.{key}={cap}: "
+                          f"{per_tok * 1e6:.2f} us/token")
+                except Exception as e:  # noqa: BLE001 — OOM/compile fail
+                    rows.append({"candidate": cap, "ok": False,
+                                 "error": repr(e)[:200]})
+                    print(f"  {kernel}.{key}={cap}: FAILED ({e})"
+                          [:160])
+                    break               # larger candidates only get worse
+            timings.setdefault(kernel, {})[key] = rows
+            ok = [r for r in rows if r.get("ok")]
+            if not ok:
+                print(f"  {kernel}.{key}: no candidate ran — entry "
+                      f"omitted (static table stays)")
+                continue
+            best = min(r["s_per_token"] for r in ok)
+            fit = [r for r in ok if r["s_per_token"] <= best * tolerance]
+            pick = max(r["candidate"] for r in fit)
+            blocks.setdefault(kernel, {})[key] = pick
+            print(f"  {kernel}.{key} -> {pick} "
+                  f"(best {best * 1e6:.2f} us/token, "
+                  f"tolerance x{tolerance:g})")
+    return blocks, timings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Sweep Pallas block capacities on THIS chip and "
+                    "record them with provenance for "
+                    "MARIAN_KERNEL_SWEEP.")
+    ap.add_argument("--out", default="", help="output JSON (default: "
+                    "kernel_sweep.<chip>.json)")
+    ap.add_argument("--kernels", default=",".join(CANDIDATES),
+                    help="comma-separated subset of: "
+                    + ", ".join(CANDIDATES))
+    ap.add_argument("--iters", type=int, default=7,
+                    help="timed iterations per candidate (median)")
+    ap.add_argument("--tolerance", type=float, default=1.10,
+                    help="pick the largest candidate within this factor "
+                    "of the best per-token time")
+    args = ap.parse_args(argv)
+
+    import jax
+    devs = jax.devices()
+    chip = str(getattr(devs[0], "device_kind", "unknown"))
+    kernels = [k.strip() for k in args.kernels.split(",") if k.strip()]
+    unknown = [k for k in kernels if k not in CANDIDATES]
+    if unknown:
+        ap.error(f"unknown kernel(s): {', '.join(unknown)}")
+
+    print(f"kernel sweep on chip '{chip}' ({len(devs)} device(s), "
+          f"jax {jax.__version__}); {args.iters} iters/candidate")
+    blocks, timings = sweep(kernels, args.iters, args.tolerance)
+
+    doc = {
+        "chip": chip,
+        "platform": str(getattr(devs[0], "platform", "unknown")),
+        "n_devices": len(devs),
+        "jax": str(jax.__version__),
+        "recorded_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "argv": sys.argv[1:],
+        "blocks": blocks,
+        "timings": timings,
+    }
+    out = args.out or f"kernel_sweep.{chip.replace(' ', '_')}.json"
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out} — apply with MARIAN_KERNEL_SWEEP={out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

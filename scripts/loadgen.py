@@ -96,6 +96,13 @@ def make_trace_id(i: int) -> str:
 
 PRIORITY_PREFIX = "#priority:"
 
+# fleet tenancy (ISSUE 20): --tenants 'A:0.5,B:0.3,C:0.2' stamps a
+# deterministic per-request `#model:<tag>` header, so one generator
+# drives a --fleet server's N model families in a fixed mix; the
+# per-window table and the summary then split by tenant — a cold start
+# or brownout on tenant B must show up in B's columns and ONLY B's.
+MODEL_PREFIX = "#model:"
+
 # streaming (ISSUE 16): --stream sends the `#stream:1` header; the
 # server then delivers `#partial:<idx> <text>` frames as the decode
 # progresses, before the normal final reply frame. The client-side
@@ -255,6 +262,54 @@ def parse_len_mix(raw: str):
     return short, long_, p_short
 
 
+def parse_tenants(raw: str):
+    """--tenants 'A:0.5,B:0.3,C:0.2' → [(tag, cum_weight)] with weights
+    normalized to cumulative [0, 1] boundaries, or None. Tags must be
+    the server's #model: alphabet ([A-Za-z0-9_.-]); weights must be
+    positive (they need not sum to 1 — the mix is the ratio)."""
+    if not raw:
+        return None
+    entries = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tag, sep, w = part.partition(":")
+        tag = tag.strip()
+        if not tag or any(not (c.isalnum() or c in "-_.") for c in tag):
+            raise ValueError(f"--tenants: bad tag in {part!r}")
+        try:
+            weight = float(w) if sep else 1.0
+        except ValueError:
+            raise ValueError(f"--tenants: bad weight in {part!r}")
+        if weight <= 0:
+            raise ValueError(f"--tenants: weight must be > 0 in {part!r}")
+        entries.append((tag, weight))
+    if not entries:
+        return None
+    total = sum(w for _, w in entries)
+    out, acc = [], 0.0
+    for tag, w in entries:
+        acc += w / total
+        out.append((tag, acc))
+    out[-1] = (out[-1][0], 1.0)        # close the interval exactly
+    return out
+
+
+def tenant_for(i: int, tenant_mix) -> str:
+    """Deterministic tenant for request i ('' without --tenants). A
+    different hash multiplier than mixed_words' draw, so tenant and
+    sentence length stay independent — tenant A must not accidentally
+    receive all the short sentences."""
+    if not tenant_mix:
+        return ""
+    u = ((i * 2246822519 + 3) % 1000) / 1000.0
+    for tag, cum in tenant_mix:
+        if u < cum:
+            return tag
+    return tenant_mix[-1][0]
+
+
 def mixed_words(i: int, words: int, len_mix) -> int:
     """Deterministic bimodal length for request i (no RNG state — the
     A/B's two runs see the same traffic)."""
@@ -313,11 +368,15 @@ def request_text(args, i: int, words: int) -> str:
 
 def _apply_headers(args, text: str, i: int) -> str:
     """Stack the protocol headers this run asked for: #trace outermost
-    (the server strips it first), then #priority, then #stream."""
+    (the server strips it first), then #model, then #priority, then
+    #stream — the order server.handle_frame peels them."""
     if getattr(args, "stream", False):
         text = f"{STREAM_PREFIX}1\n" + text
     if getattr(args, "priority", None) is not None:
         text = f"{PRIORITY_PREFIX}{args.priority}\n" + text
+    tag = tenant_for(i, getattr(args, "tenant_mix", None))
+    if tag:
+        text = MODEL_PREFIX + tag + "\n" + text
     if not args.no_trace:
         text = TRACE_PREFIX + make_trace_id(i) + "\n" + text
     return text
@@ -381,7 +440,8 @@ async def run_stream(args, request_fn, rate=None, duration=None,
     """Fire requests at a constant --rate for --duration seconds, start
     times fixed by the schedule (open loop). Returns
     [(t_start_rel, latency_s, kind, queue_s, service_s, n_retries,
-    ttft_s)] with kind in ok/overloaded/timeout/retry/other;
+    ttft_s, tenant, tokens_sent)] with kind in
+    ok/overloaded/timeout/retry/other;
     queue_s/service_s are None without reply metadata (--no-trace);
     ttft_s is the streaming time-to-first-token (None without --stream
     or when the server sent no partials). NOTE: the #trace header is an
@@ -406,6 +466,8 @@ async def run_stream(args, request_fn, rate=None, duration=None,
         words = mixed_words(i, args.words, len_mix)
         text = request_text(args, i, words)
         text = _apply_headers(args, text, i)
+        tenant = tenant_for(i, getattr(args, "tenant_mix", None))
+        tokens = words * args.sentences
         rel = time.perf_counter() - t0
         t = time.perf_counter()
         try:
@@ -418,7 +480,7 @@ async def run_stream(args, request_fn, rate=None, duration=None,
                 args.retries, args.retry_base_ms / 1e3)
         except Exception as e:  # noqa: BLE001
             results.append((rel, time.perf_counter() - t, "other",
-                            None, None, 0, None))
+                            None, None, 0, None, tenant, tokens))
             if args.verbose:
                 print(f"req {i}: {e}", file=sys.stderr)
             return
@@ -435,7 +497,7 @@ async def run_stream(args, request_fn, rate=None, duration=None,
         results.append((rel, dt, kind,
                         meta.get("queue_s") if meta else None,
                         meta.get("service_s") if meta else None,
-                        n_retries, ttft))
+                        n_retries, ttft, tenant, tokens))
 
     t0 = time.perf_counter()
 
@@ -582,13 +644,17 @@ def report_windows(results, window_s: float, pool_samples=None) -> None:
     decodes slower. With pool samples (ISSUE 14: --metrics-port against
     an iteration-mode server), pool%/cow% columns print the window's
     mean KV-pool occupancy and COW alias ratio, so a p99/evict blip is
-    attributable to pool pressure at a glance."""
+    attributable to pool pressure at a glance. With tenants in the
+    results (--tenants against a --fleet server), each window grows
+    per-tenant q/svc p50/p99 columns — a cold start or brownout on one
+    tenant must blip that tenant's columns and only those."""
     if not results:
         print("stream: no requests completed")
         return
     last = max(r[0] for r in results)
     n_windows = int(last // window_s) + 1
     have_meta = any(r[3] is not None for r in results)
+    tenants = sorted({r[7] for r in results if len(r) > 7 and r[7]})
     # pool columns only when at least one sample carried the gauges
     # (a request-mode server exports neither — all-NaN suppresses them)
     pool_samples = [s for s in (pool_samples or [])
@@ -610,6 +676,11 @@ def report_windows(results, window_s: float, pool_samples=None) -> None:
         hdr += f" {'retry':>6}"
     if have_meta:
         hdr += f" {'q_p50':>7} {'q_p99':>7} {'svc_p50':>7} {'svc_p99':>7}"
+    if tenants and have_meta:
+        for tag in tenants:
+            short = tag[:4]
+            hdr += (f" {short + ':q50':>9} {short + ':q99':>9}"
+                    f" {short + ':s50':>9} {short + ':s99':>9}")
     if have_ttft:
         hdr += f" {'ttft50':>7} {'ttft99':>7}"
     if have_pool:
@@ -658,6 +729,19 @@ def report_windows(results, window_s: float, pool_samples=None) -> None:
                      f" {pct(qs, 0.99) * 1e3:>7.1f}"
                      f" {pct(ss, 0.50) * 1e3:>7.1f}"
                      f" {pct(ss, 0.99) * 1e3:>7.1f}")
+        if tenants and have_meta:
+            for tag in tenants:
+                tq = [r[3] for r in rows if len(r) > 7 and r[7] == tag
+                      and r[2] == "ok" and r[3] is not None]
+                ts_ = [r[4] for r in rows if len(r) > 7 and r[7] == tag
+                       and r[2] == "ok" and r[4] is not None]
+                if tq or ts_:
+                    line += (f" {pct(tq, 0.50) * 1e3:>9.1f}"
+                             f" {pct(tq, 0.99) * 1e3:>9.1f}"
+                             f" {pct(ts_, 0.50) * 1e3:>9.1f}"
+                             f" {pct(ts_, 0.99) * 1e3:>9.1f}")
+                else:
+                    line += f" {'-':>9} {'-':>9} {'-':>9} {'-':>9}"
         if have_ttft:
             ts = [r[6] for r in rows
                   if len(r) > 6 and r[6] is not None and r[2] == "ok"]
@@ -676,6 +760,37 @@ def report_windows(results, window_s: float, pool_samples=None) -> None:
             else:
                 line += f" {'-':>6} {'-':>6}"
         print(line)
+
+
+def report_tenants(results) -> None:
+    """Per-tenant summary table (--tenants, ISSUE 20): request
+    outcomes, success rate, latency percentiles and source tokens
+    offered/served per tenant. The server-side mirror is
+    marian_fleet_request_outcomes_total{outcome,tenant} — this is the
+    client-visible cross-check (an ok here that the server counted as
+    someone else's would be the routing bug the fleet must never
+    have)."""
+    tenants = sorted({r[7] for r in results if len(r) > 7 and r[7]})
+    if not tenants:
+        return
+    print(f"{'tenant':>10} {'req':>6} {'ok':>6} {'shed':>5} {'retry':>6} "
+          f"{'err':>5} {'ok%':>6} {'p50_ms':>8} {'p99_ms':>8} "
+          f"{'tok_sent':>9} {'tok_ok':>8}")
+    for tag in tenants:
+        rows = [r for r in results if len(r) > 7 and r[7] == tag]
+        lat = [r[1] for r in rows if r[2] == "ok"]
+        shed = sum(1 for r in rows if r[2] == "overloaded")
+        err = sum(1 for r in rows if r[2] in ("timeout", "other"))
+        # retry column = resends honored + budget-exhausted finals,
+        # same accounting as the window table
+        n_retry = sum(r[5] + (1 if r[2] == "retry" else 0) for r in rows)
+        tok = sum(r[8] for r in rows if len(r) > 8)
+        tok_ok = sum(r[8] for r in rows if len(r) > 8 and r[2] == "ok")
+        print(f"{tag[:10]:>10} {len(rows):>6} {len(lat):>6} {shed:>5} "
+              f"{n_retry:>6} {err:>5} "
+              f"{100.0 * len(lat) / len(rows) if rows else 0:>6.1f} "
+              f"{pct(lat, 0.50) * 1e3:>8.1f} {pct(lat, 0.99) * 1e3:>8.1f} "
+              f"{tok:>9} {tok_ok:>8}")
 
 
 def main(argv=None) -> int:
@@ -732,6 +847,16 @@ def main(argv=None) -> int:
                          "the traffic shape that makes constrained "
                          "prefixes share pages. Deterministic per "
                          "request index")
+    ap.add_argument("--tenants", default="",
+                    help="mixed-tenant traffic against a --fleet "
+                         "server: 'A:0.5,B:0.3,C:0.2' stamps a "
+                         "deterministic per-request '#model:<tag>' "
+                         "header in those ratios (weights normalize; "
+                         "deterministic per request index, so A/B runs "
+                         "see identical traffic). Streaming mode adds "
+                         "per-tenant q/svc p50/p99 window columns and "
+                         "a per-tenant summary table (ok/shed/retry, "
+                         "success rate, tokens)")
     ap.add_argument("--sweep", default="",
                     help="capacity mode (ISSUE 9 / ROADMAP 4): comma-"
                          "separated offered rates in req/s (e.g. "
@@ -777,6 +902,11 @@ def main(argv=None) -> int:
                          "protocol extension — they would translate the "
                          "header as an extra sentence")
     args = ap.parse_args(argv)
+
+    try:
+        args.tenant_mix = parse_tenants(args.tenants)
+    except ValueError as e:
+        ap.error(str(e))
 
     transport = args.transport
     if transport == "auto":
@@ -837,6 +967,7 @@ def main(argv=None) -> int:
                   f"(evictions), {retried_ok} requests ok after retry, "
                   f"{exhausted} exhausted the --retries budget")
         report_windows(results, args.window, pool_samples=pool_samples)
+        report_tenants(results)
         if before or after:
             swaps = _delta(before, after, "marian_lifecycle_swaps_total")
             rollbacks = _delta(before, after,
@@ -906,6 +1037,18 @@ def _report_server_delta(before: dict, after: dict) -> None:
               f"{_delta(before, after, 'marian_prefix_pages_reused_total'):.0f} "
               f"prefix_evictions="
               f"{_delta(before, after, 'marian_prefix_evictions_total'):.0f}")
+    fleet_req = _delta(before, after,
+                       "marian_fleet_request_outcomes_total")
+    if fleet_req:
+        # fleet deltas (ISSUE 20): cold starts during the run are the
+        # warm-on-demand events; evictions are the HBM-budget pressure
+        print(f"server: fleet_requests={fleet_req:.0f} "
+              f"cold_starts="
+              f"{_delta(before, after, 'marian_fleet_cold_starts_total'):.0f} "
+              f"fleet_evictions="
+              f"{_delta(before, after, 'marian_fleet_evictions_total'):.0f} "
+              f"fleet_shed="
+              f"{_delta(before, after, 'marian_fleet_shed_total'):.0f}")
     joins = _delta(before, after, "marian_serving_joins_total")
     if joins:
         # iteration-mode deltas: mid-decode joins are the proof that

@@ -17,11 +17,17 @@ refcount >= 10), the per-slot decode table (trace id, pos/cap, pages
 held), the engine round counters, the prefix-cache holdings, and the
 last audit verdict.
 
+Against a --fleet server (ISSUE 20) the slot table grows a tenant
+column (from the owner labels' "<tag>/" prefix) and a per-tenant page
+accounting block prints the server-recorded sums.
+
 ``--check`` additionally re-derives the auditor's page-accounting
 invariants from the document itself (marian_tpu/obs/poolz.py ::
-check_consistency) and exits 1 on any discrepancy — the post-mortem
-question "did the exported page map even agree with itself?" answered
-without a live process.
+check_consistency) and exits 1 on any discrepancy — including the
+per-tenant sums and cross-tenant-page checks, so a dead process's
+flight dump can still prove (or disprove) tenant isolation — the
+post-mortem question "did the exported page map even agree with
+itself?" answered without a live process.
 
 Stdlib-only, like scripts/loadgen.py.
 """
@@ -38,6 +44,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from marian_tpu.obs.poolz import check_consistency  # noqa: E402
+from marian_tpu.serving.fleet import accounting  # noqa: E402
 
 PAGES_PER_LINE = 64
 
@@ -108,12 +115,36 @@ def render(state: dict, out=sys.stdout) -> None:
     w(f"\nslots: {rows.get('active', 0)}/{rows.get('max_rows', 0)} "
       f"active, {rows.get('used_tokens', 0)} tokens resident, "
       f"fragmentation {100 * rows.get('fragmentation', 0):.1f}%\n")
+    # tenant column (ISSUE 20): owner labels carry a "<tag>/" prefix
+    # when the request was tenanted (--fleet); '-' = shared/untenanted
+    # (e.g. the prefix cache). Only drawn when any tenant appears.
+    tenanted = any(accounting.tenant_of_label(str(s.get("owner", "")))
+                   for s in slots)
     if slots:
-        w(f"{'slot':>5} {'pos/cap':>9} {'pages':>6}  owner\n")
+        thdr = f" {'tenant':>8} " if tenanted else "  "
+        w(f"{'slot':>5} {'pos/cap':>9} {'pages':>6}{thdr}owner\n")
         for s in slots:
+            tcol = ""
+            if tenanted:
+                tag = accounting.tenant_of_label(str(s.get("owner", "")))
+                tcol = f" {tag or '-':>8} "
+            else:
+                tcol = "  "
             w(f"{s['slot']:>5} {s['pos']:>4}/{s['cap']:<4} "
-              f"{len(s['pages']):>6}  "
+              f"{len(s['pages']):>6}{tcol}"
               f"{s.get('trace_id') or s['owner']}\n")
+    tenants = state.get("tenants")
+    if tenants:
+        # per-tenant page accounting, as RECORDED by the server at
+        # snapshot time; --check re-derives the same sums from the page
+        # map's owner labels and flags any divergence — how a flight
+        # dump from a dead process proves (or disproves) cross-tenant
+        # isolation (ISSUE 20)
+        w("tenants (recorded page accounting):\n")
+        for tag in sorted(tenants):
+            ent = tenants[tag]
+            w(f"  {tag or '(shared)':>10}: {ent['refs']} page ref(s) "
+              f"across {ent['owners']} owner(s)\n")
     pc = state.get("prefix_cache")
     if pc:
         w(f"prefix cache: {pc['entries']} entr(ies), "

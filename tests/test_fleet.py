@@ -1,0 +1,509 @@
+"""Multi-tenant fleet serving (marian_tpu/serving/fleet/ — ISSUE 20):
+the #model: protocol header, --fleet spec parsing, per-tenant KV-page
+accounting + the tenant.page_leak detection drill, FleetManager
+warm-on-demand / HBM-budget eviction / per-tenant SLO separation, and
+the end-to-end ServingApp fleet contract with stub executors.
+
+Everything runs under JAX_PLATFORMS=cpu with stub factories — no model,
+no device; the CI leg scripts/fleet_smoke.py drills the same contract
+against a real TCP server with a hot swap under open-loop load.
+"""
+
+import asyncio
+
+import pytest
+
+from marian_tpu.common import Options
+from marian_tpu.common import faultpoints as fp
+from marian_tpu.ops.pallas.kv_pool import KVPool
+from marian_tpu.serving import metrics as msm
+from marian_tpu.serving.admission import Overloaded
+from marian_tpu.serving.fleet import accounting
+from marian_tpu.serving.fleet.tenancy import (FleetManager, TenantSpec,
+                                              UnknownTenant,
+                                              parse_fleet_spec, valid_tag)
+from marian_tpu.server.server import split_model_header
+from marian_tpu.training import bundle as bdl
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lockdep_witness(lockdep_witness):
+    """FleetManager._lock + tenant warm locks + the pool lock join the
+    running lattice here; the shared conftest witness asserts
+    observed ⊆ static at module teardown."""
+    yield
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def commit_bundle(model_path, tag="x", member="m.npz"):
+    """One tiny committed bundle via the real commit protocol; the
+    member CONTENT length is what the HBM residency estimate reads."""
+    def write(p):
+        with open(p, "w", encoding="utf-8") as fh:
+            fh.write(tag)
+    return bdl.write_bundle(str(model_path), {member: write})
+
+
+def name_factory(calls=None):
+    """Executor factory tagging replies ``<model stem>-b<seq>:<line>``
+    so tests can prove WHICH tenant's WHICH bundle answered."""
+    import os
+
+    def factory(bundle_dir, manifest):
+        if calls is not None:
+            calls.append(bundle_dir)
+        root = os.path.basename(os.path.dirname(os.path.abspath(
+            bundle_dir)))
+        name = root.split(".")[0]              # m_A.npz.bundles -> m_A
+        seq = int(manifest["seq"]) if manifest else 0
+
+        def translate(lines):
+            return [f"{name}-b{seq}:{ln}" for ln in lines]
+        return translate
+    return factory
+
+
+def make_fleet(tmp_path, tags="ABC", tag_bytes=4, registry=None, **kw):
+    """A fleet of tiny committed tenants (one bundle each, member
+    content ``tag_bytes`` long so est = tag_bytes * HBM_OVERHEAD)."""
+    specs = []
+    for t in tags:
+        mp = str(tmp_path / f"m_{t}.npz")
+        commit_bundle(mp, tag="x" * tag_bytes)
+        specs.append(TenantSpec(t, mp))
+    kw.setdefault("golden", ["hello"])
+    return FleetManager(specs, name_factory(),
+                        metrics_registry=registry or msm.Registry(),
+                        **kw)
+
+
+# ---------------------------------------------------------------------------
+# #model: protocol header
+# ---------------------------------------------------------------------------
+
+class TestModelHeader:
+    def test_tag_and_body(self):
+        assert split_model_header("#model:en-de\nhello") \
+            == ("en-de", "hello")
+
+    def test_no_header_is_payload(self):
+        assert split_model_header("hello world") == (None, "hello world")
+
+    def test_domain_style_tags(self):
+        assert split_model_header("#model:en-de.legal\nx")[0] \
+            == "en-de.legal"
+
+    def test_malformed_tag_is_payload_not_error(self):
+        # the usual header discipline: a malformed header line is BODY
+        for text in ("#model:\nx", "#model:has space\nx",
+                     "#model:" + "a" * 65 + "\nx", "#model:bad/slash\nx"):
+            tag, body = split_model_header(text)
+            assert tag is None and body == text
+
+    def test_header_without_body(self):
+        assert split_model_header("#model:A") == ("A", "")
+
+    def test_stacks_after_trace_before_priority(self):
+        # server strips #trace first, then #model, then #priority — here
+        # we only pin that #model yields the remaining headers as body
+        tag, body = split_model_header("#model:A\n#priority:2\nhi")
+        assert tag == "A" and body == "#priority:2\nhi"
+
+
+# ---------------------------------------------------------------------------
+# --fleet spec parsing
+# ---------------------------------------------------------------------------
+
+class TestFleetSpec:
+    def test_parse(self):
+        specs = parse_fleet_spec("A=/m/a.npz, B=/m/b.npz")
+        assert [(s.tag, s.model_path) for s in specs] \
+            == [("A", "/m/a.npz"), ("B", "/m/b.npz")]
+
+    def test_duplicate_tag_is_hard_error(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_fleet_spec("A=/m/a.npz,A=/m/b.npz")
+
+    def test_malformed_entry_is_hard_error(self):
+        for spec in ("A", "A=", "=x", "bad tag=/m/a.npz"):
+            with pytest.raises(ValueError):
+                parse_fleet_spec(spec)
+
+    def test_empty_spec_is_hard_error(self):
+        with pytest.raises(ValueError, match="no tenants"):
+            parse_fleet_spec(" , ")
+
+    def test_valid_tag(self):
+        assert valid_tag("en-de.legal_v2")
+        assert not valid_tag("")
+        assert not valid_tag("a" * 65)
+        assert not valid_tag("a/b")
+
+
+# ---------------------------------------------------------------------------
+# per-tenant page accounting (fleet/accounting.py)
+# ---------------------------------------------------------------------------
+
+class _Unit:
+    def __init__(self, tenant):
+        self.tenant = tenant
+
+
+class _Req:
+    def __init__(self, tenant):
+        self.req = _Unit(tenant)
+
+
+class TestAccounting:
+    def test_tenant_of_owner_conventions(self):
+        assert accounting.tenant_of_owner(_Unit("A")) == "A"
+        assert accounting.tenant_of_owner(_Req("B")) == "B"          # .req.tenant
+        assert accounting.tenant_of_owner((_Unit("C"), 3, "k")) == "C"
+        assert accounting.tenant_of_owner("D/slot-7") == "D"
+        assert accounting.tenant_of_owner("untenanted") == ""
+        assert accounting.tenant_of_owner(("plain", 1)) == ""
+
+    def test_tenant_page_sums(self):
+        sums = accounting.tenant_page_sums({
+            "A/r1": [1, 2], "A/r2": [2], "B/r1": [3], "shared": [4]})
+        assert sums["A"] == {"refs": 3, "owners": 2}
+        assert sums["B"] == {"refs": 1, "owners": 1}
+        assert sums[""] == {"refs": 1, "owners": 1}
+
+    def test_cross_tenant_pages(self):
+        # same-tenant sharing (beam COW) is legal; cross-tenant is not;
+        # untenanted owners (prefix cache) are exempt
+        assert accounting.cross_tenant_pages(
+            {"A/r1": [1], "A/r2": [1], "shared": [1]}) == []
+        bad = accounting.cross_tenant_pages({"A/r1": [1], "B/r1": [1]})
+        assert len(bad) == 1 and "page 1" in bad[0]
+
+    def test_audit_tenants_over_and_under(self):
+        pool = KVPool(16, page_len=4)
+        pool.claim("A/r1", 2)
+        pool.claim("B/r1", 1)
+        assert accounting.audit_tenants(pool, {"A": 2, "B": 1}) == []
+        bad = accounting.audit_tenants(pool, {"A": 3, "B": 1})
+        assert len(bad) == 1 and "'A'" in bad[0] and "under" in bad[0]
+        bad = accounting.audit_tenants(pool, {"A": 2})
+        assert any("'B'" in b and "over" in b for b in bad)
+
+    def test_merge_expected(self):
+        exp = accounting.merge_expected(
+            [("A", 2), ("A", 3), ("B", 1), ("B", -1)])
+        assert exp["A"] == 5 and exp["B"] == 0
+
+    def test_tenant_sums_from_state(self):
+        state = {"pages": {
+            "1": {"refs": 2, "owners": ["A/r1", "A/r2"]},
+            "2": {"refs": 1, "owners": ["B/r1"]},
+        }}
+        sums = accounting.tenant_sums_from_state(state)
+        assert sums["A"] == {"refs": 2, "pages": 1}
+        assert sums["B"] == {"refs": 1, "pages": 1}
+
+    def test_check_tenant_isolation_document(self):
+        clean = {
+            "pages": {"1": {"refs": 1, "owners": ["A/r1"]},
+                      "2": {"refs": 1, "owners": ["B/r1"]}},
+            "tenants": {"A": {"refs": 1, "owners": 1},
+                        "B": {"refs": 1, "owners": 1}},
+            "rows": {"slots": [
+                {"slot": 0, "owner": "A/r1", "pages": [1]}]},
+        }
+        assert accounting.check_tenant_isolation(clean) == []
+        # (a) recorded tenants block diverges from the page map
+        doc = dict(clean, tenants={"A": {"refs": 9, "owners": 1},
+                                   "B": {"refs": 1, "owners": 1}})
+        assert any("disagrees" in p
+                   for p in accounting.check_tenant_isolation(doc))
+        # (b) a page whose owner labels span two tenants
+        doc = dict(clean, pages={
+            "1": {"refs": 2, "owners": ["A/r1", "B/r9"]}})
+        assert any("cross-tenant page" in p
+                   for p in accounting.check_tenant_isolation(doc))
+        # (c) a slot referencing a page owned by another tenant
+        doc = dict(clean)
+        doc["rows"] = {"slots": [
+            {"slot": 0, "owner": "A/r1", "pages": [2]}]}
+        assert any("slot 0" in p
+                   for p in accounting.check_tenant_isolation(doc))
+
+
+# ---------------------------------------------------------------------------
+# the tenant.page_leak detection drill (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+class TestTenantLeakDrill:
+    def test_seeded_leak_caught_by_tenant_auditor_only(self):
+        """The mischarged-page bug class: move one page reference from
+        tenant A's claim list into tenant B's. No refcount changes, so
+        the pool auditor stays green BY CONSTRUCTION — only the
+        tenant-level auditor can catch it. The drill proves it does."""
+        pool = KVPool(16, page_len=4)
+        pool.claim("A/r1", 2)
+        pool.claim("B/r1", 1)
+        expected = {"A": 2, "B": 1}
+        assert accounting.audit_tenants(pool, expected) == []
+        with fp.active("tenant.page_leak=fail@*"):
+            pool.chaos_tenant_leak()
+        # the reference-level auditor CANNOT see the mischarge…
+        assert pool.audit() == []
+        # …the tenant-level auditor pins it: one tenant short EXACTLY
+        # the references the other gained (the whole page reference
+        # moved, so no page is cross-tenant — the sums are the tell)
+        bad = accounting.audit_tenants(pool, expected)
+        assert any("under by 1" in b for b in bad)
+        assert any("over by 1" in b for b in bad)
+
+    def test_unarmed_drill_is_a_noop(self):
+        pool = KVPool(16, page_len=4)
+        pool.claim("A/r1", 1)
+        pool.claim("B/r1", 1)
+        pool.chaos_tenant_leak()         # no faultpoint armed
+        assert accounting.audit_tenants(pool, {"A": 1, "B": 1}) == []
+
+    def test_single_tenant_pool_cannot_leak(self):
+        pool = KVPool(16, page_len=4)
+        pool.claim("A/r1", 2)
+        with fp.active("tenant.page_leak=fail@*"):
+            pool.chaos_tenant_leak()     # no second tenant: no-op
+        assert accounting.audit_tenants(pool, {"A": 2}) == []
+
+
+# ---------------------------------------------------------------------------
+# FleetManager: warm-on-demand, HBM budget, eviction
+# ---------------------------------------------------------------------------
+
+class TestFleetManager:
+    def test_warm_on_demand_and_routing(self, tmp_path):
+        fleet = make_fleet(tmp_path)
+        try:
+            st = {r["tenant"]: r for r in fleet.status()["tenants"]}
+            assert not any(r["resident"] for r in st.values())
+            run_a = fleet.executor_for("A")
+            assert run_a(["hi"]) == ["m_A-b1:hi"]
+            run_b = fleet.executor_for("B")
+            assert run_b(["yo"]) == ["m_B-b1:yo"]
+            st = {r["tenant"]: r for r in fleet.status()["tenants"]}
+            assert st["A"]["resident"] and st["B"]["resident"]
+            assert not st["C"]["resident"] and st["C"]["live"] is None
+            assert st["A"]["live"] == "bundle-00000001"
+            assert st["A"]["cold_starts"] == 1
+            assert fleet.m_cold_starts.labels("A").value == 1
+            assert fleet.m_cold_start_s.labels("A").value > 0
+            # a second request does NOT cold-start again
+            fleet.executor_for("A")(["x"])
+            assert fleet.m_cold_starts.labels("A").value == 1
+        finally:
+            fleet.stop()
+
+    def test_unknown_tenant_raises(self, tmp_path):
+        fleet = make_fleet(tmp_path, tags="A")
+        try:
+            with pytest.raises(UnknownTenant):
+                fleet.executor_for("Z")
+            assert fleet.live_version_name("Z") == "Z:unknown"
+            assert fleet.live_version_name("A") == "A:cold"
+        finally:
+            fleet.stop()
+
+    def test_evict_coldest_under_hbm_pressure(self, tmp_path):
+        """The LRU contract: with room for two tenants, warming the
+        third evicts the LEAST RECENTLY ROUTED one — and a shared KV
+        pool releases ONLY the victim's page claims, leaving the hot
+        tenant's live rows untouched."""
+        clk = {"t": 0.0}
+        pool = KVPool(16, page_len=4)
+        # est per tenant = 4 bytes * HBM_OVERHEAD(2.0) = 8; budget fits 2
+        fleet = make_fleet(tmp_path, tag_bytes=4,
+                           hbm_budget_bytes=20, kv_pool=pool,
+                           clock=lambda: clk["t"])
+        try:
+            clk["t"] = 1.0
+            fleet.executor_for("A")(["a"])
+            clk["t"] = 2.0
+            fleet.executor_for("B")(["b"])
+            pool.claim("A/row-1", 2)     # A's live decode rows
+            pool.claim("B/row-1", 1)     # B's
+            clk["t"] = 3.0
+            fleet.executor_for("A")(["a"])   # A re-used: B is now coldest
+            clk["t"] = 4.0
+            fleet.executor_for("C")(["c"])   # needs room -> evict B
+            st = {r["tenant"]: r for r in fleet.status()["tenants"]}
+            assert st["A"]["resident"] and st["C"]["resident"]
+            assert not st["B"]["resident"]
+            assert fleet.m_evictions.labels("hbm_pressure").value == 1
+            assert fleet.m_resident.labels("B").value == 0
+            # ONLY B's pages were released; A's live rows are untouched
+            assert pool.claims() == {"A/row-1": pool.claims()["A/row-1"]}
+            assert len(pool.claims()["A/row-1"]) == 2
+            assert accounting.audit_tenants(pool, {"A": 2}) == []
+            assert fleet.status()["hbm_resident_bytes"] \
+                <= fleet.hbm_budget_bytes
+        finally:
+            fleet.stop()
+
+    def test_busy_tenant_never_evicted(self, tmp_path):
+        """A tenant with an in-flight batch is never a victim: when
+        every resident tenant is busy the fleet runs over budget
+        LOUDLY instead of deadlocking the cold start."""
+        fleet = make_fleet(tmp_path, tags="AB", tag_bytes=4,
+                           hbm_budget_bytes=10)   # fits ONE tenant (8)
+        try:
+            run_a = fleet.executor_for("A")   # in-flight until called
+            fleet.executor_for("B")(["b"])    # would need A's room
+            st = {r["tenant"]: r for r in fleet.status()["tenants"]}
+            assert st["A"]["resident"] and st["B"]["resident"]
+            assert st["A"]["inflight_batches"] == 1
+            assert fleet.m_evictions.labels("hbm_pressure").value == 0
+            assert run_a(["a"]) == ["m_A-b1:a"]   # batch completes fine
+        finally:
+            fleet.stop()
+
+    def test_status_shape(self, tmp_path):
+        fleet = make_fleet(tmp_path, tags="A",
+                           hbm_budget_bytes=1 << 20)
+        try:
+            doc = fleet.status()
+            assert doc["hbm_budget_bytes"] == 1 << 20
+            assert doc["hbm_overhead_factor"] > 1
+            row = doc["tenants"][0]
+            for field in ("tenant", "model_path", "resident", "live",
+                          "est_bytes", "inflight_batches", "cold_starts",
+                          "slo", "pages"):
+                assert field in row
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant SLOs: one tenant's burn never sheds another's traffic
+# ---------------------------------------------------------------------------
+
+class TestFleetSlo:
+    def test_tenant_burn_sheds_only_its_own_low_priority(self, tmp_path):
+        clk = {"t": 0.0}
+        fleet = make_fleet(tmp_path, tags="AB", clock=lambda: clk["t"],
+                           brownout_min_priority=1)
+        try:
+            assert fleet.build_slos(availability=0.999, window_s=10) == 2
+            fleet.tick_slos(now=0.0)        # baseline sample
+            # tenant A: 50% failures — torches a 99.9% objective;
+            # tenant B: clean traffic on the SAME shared series
+            for _ in range(50):
+                fleet.note_outcome("A", "ok", 0.01)
+                fleet.note_outcome("A", "failure", 0.01)
+                fleet.note_outcome("B", "ok", 0.01)
+            clk["t"] = 1.0
+            fleet.tick_slos(now=1.0)
+            a, b = fleet.slo_engine("A"), fleet.slo_engine("B")
+            assert a.fast_burn() >= a.fast_factor
+            assert b.fast_burn() < b.fast_factor
+            # A's low-priority lane sheds; its high lane and ALL of B
+            # keep serving — tenant A's incident never browns out B
+            with pytest.raises(Overloaded):
+                fleet.gate("A", priority=0)
+            fleet.gate("A", priority=1)
+            fleet.gate("B", priority=0)
+            assert fleet.m_shed.labels("A", "tenant_brownout").value == 1
+            assert fleet.m_shed.labels("B", "tenant_brownout").value == 0
+        finally:
+            fleet.stop()
+
+    def test_no_engines_no_gate(self, tmp_path):
+        fleet = make_fleet(tmp_path, tags="A")
+        try:
+            fleet.gate("A", priority=0)     # no SLOs built: no shedding
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: ServingApp in --fleet mode (stub executors)
+# ---------------------------------------------------------------------------
+
+def make_fleet_app(tmp_path, tags="ABC", registry=None, **opt):
+    from marian_tpu.server.server import ServingApp
+    models = {}
+    for t in tags:
+        mp = str(tmp_path / f"m_{t}.npz")
+        commit_bundle(mp, tag=t)
+        models[t] = mp
+    base = {"batch-token-budget": 256, "max-queue": 512,
+            "request-timeout": 0.0, "metrics-port": 0,
+            "fleet": ",".join(f"{t}={mp}" for t, mp in models.items()),
+            "fleet-default-tenant": tags[0]}
+    base.update(opt)
+    return ServingApp(Options(base), registry=registry or msm.Registry(),
+                      executor_factory=name_factory())
+
+
+class TestFleetServing:
+    def test_routing_default_and_unknown(self, tmp_path):
+        async def scenario():
+            app = make_fleet_app(tmp_path)
+            await app.start()
+            try:
+                # every tenant answers its own tagged traffic
+                replies = await asyncio.gather(*[
+                    app.handle_text(f"#model:{t}\nhello {i}")
+                    for i, t in enumerate("ABCABC")])
+                for i, t in enumerate("ABCABC"):
+                    assert replies[i] == f"m_{t}-b1:hello {i}"
+                # untagged traffic lands on --fleet-default-tenant
+                assert await app.handle_text("plain") == "m_A-b1:plain"
+                # a well-formed tag naming no tenant is an EXPLICIT
+                # error — never a silent wrong-model translation
+                r = await app.handle_text("#model:Z\nhello")
+                assert r.startswith("!!SERVER-ERROR")
+                assert "unknown model tag" in r
+            finally:
+                await app.shutdown(drain_timeout=5.0)
+        run(scenario())
+
+    def test_fleet_metric_census(self, tmp_path):
+        """Every fleet series the runbooks page on must exist after
+        real traffic — a rename breaks this test before it breaks the
+        dashboards (the obs discipline)."""
+        reg = msm.Registry()
+
+        async def scenario():
+            app = make_fleet_app(tmp_path, registry=reg)
+            await app.start()
+            try:
+                await app.handle_text("#model:B\nhi")
+                await app.handle_text("#model:Z\nnope")
+            finally:
+                await app.shutdown(drain_timeout=5.0)
+        run(scenario())
+        text = reg.render()
+        for series in ("marian_fleet_tenants",
+                       "marian_fleet_resident",
+                       "marian_fleet_hbm_budget_bytes",
+                       "marian_fleet_hbm_resident_bytes",
+                       "marian_fleet_request_outcomes_total",
+                       "marian_fleet_request_latency_seconds",
+                       "marian_fleet_shed_total",
+                       "marian_fleet_evictions_total",
+                       "marian_fleet_cold_starts_total",
+                       "marian_fleet_cold_start_seconds"):
+            assert series in text, f"missing fleet series {series}"
+
+    def test_fleetz_document(self, tmp_path):
+        async def scenario():
+            app = make_fleet_app(tmp_path, tags="AB")
+            await app.start()
+            try:
+                await app.handle_text("#model:A\nhi")
+                doc = app.fleet.status()
+                rows = {r["tenant"]: r for r in doc["tenants"]}
+                assert set(rows) == {"A", "B"}
+                assert rows["A"]["resident"]
+                assert rows["A"]["live"] == "bundle-00000001"
+            finally:
+                await app.shutdown(drain_timeout=5.0)
+        run(scenario())
